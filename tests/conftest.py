@@ -1,0 +1,19 @@
+"""Test harness configuration.
+
+Mirrors the reference's test strategy (SURVEY.md §4): tests run on a cheap,
+always-available backend. Here that is the XLA CPU backend with 8 virtual
+devices, so every sharding/collective test exercises a real 8-device mesh
+without TPU hardware (the reference used in-process loopback ZeroMQ for the
+same purpose, veles/tests/test_network.py).
+
+Must run before jax is imported anywhere.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("VELES_TPU_TEST", "1")

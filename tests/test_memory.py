@@ -1,0 +1,72 @@
+"""Mirrors reference veles/tests/test_memory.py scope: Array coherence
+protocol + Watcher accounting, adapted to the host-newer/dev-newer model."""
+import pickle
+
+import numpy
+import pytest
+
+from veles_tpu.memory import Array, Watcher
+
+
+def test_array_host_basics():
+    a = Array(numpy.arange(6, dtype=numpy.float32).reshape(2, 3), name="t")
+    assert a.shape == (2, 3)
+    assert a.dtype == numpy.float32
+    assert bool(a)
+    assert len(a) == 2
+    assert a[0, 1] == 1.0
+    a[0, 1] = 9.0
+    assert a.mem[0, 1] == 9.0
+
+
+def test_array_device_roundtrip():
+    a = Array(numpy.ones((4, 4), dtype=numpy.float32), name="rt")
+    dv = a.device_view()
+    assert dv.shape == (4, 4)
+    # simulate a jitted step producing a new device array
+    import jax.numpy as jnp
+    a.assign_devmem(dv * 2)
+    host = a.map_read()
+    numpy.testing.assert_allclose(host, 2 * numpy.ones((4, 4)))
+
+
+def test_array_host_newer_pushes():
+    a = Array(numpy.zeros(3, dtype=numpy.float32), name="hn")
+    a.device_view()
+    a.map_write()[...] = 5.0
+    dv = a.device_view()
+    numpy.testing.assert_allclose(numpy.asarray(dv), 5.0)
+
+
+def test_array_map_invalidate_skips_sync():
+    a = Array(numpy.zeros(2, dtype=numpy.float32), name="mi")
+    dv = a.device_view()
+    a.assign_devmem(dv + 1)          # device newer
+    mem = a.map_invalidate()         # host claims full overwrite
+    mem[...] = 7.0
+    numpy.testing.assert_allclose(numpy.asarray(a.device_view()), 7.0)
+
+
+def test_array_pickle_syncs_device_first():
+    a = Array(numpy.zeros(2, dtype=numpy.float32), name="pk")
+    a.assign_devmem(a.device_view() + 3)
+    b = pickle.loads(pickle.dumps(a))
+    numpy.testing.assert_allclose(b.mem, 3.0)
+    assert b.devmem is None
+
+
+def test_watcher_accounting():
+    Watcher.reset()
+    a = Array(numpy.zeros((10, 10), dtype=numpy.float32), name="w")
+    a.device_view()
+    assert Watcher.total >= 400
+    assert Watcher.peak >= Watcher.total
+    a.reset(numpy.zeros(1, dtype=numpy.float32))
+    assert Watcher.total == 0
+
+
+def test_empty_array_falsey():
+    a = Array()
+    assert not bool(a)
+    with pytest.raises(Exception):
+        a.device_view()

@@ -1,0 +1,74 @@
+"""Mirrors reference veles/tests/test_mutable.py scope."""
+import pickle
+
+from veles_tpu.mutable import Bool, LinkableAttribute, link
+
+
+def test_bool_identity_mutation():
+    a = Bool(False)
+    holders = [a, a]
+    a <<= True
+    assert all(bool(h) for h in holders)
+    a <<= False
+    assert not any(bool(h) for h in holders)
+
+
+def test_bool_algebra_lazy():
+    a, b = Bool(False), Bool(True)
+    expr = ~a & b
+    assert bool(expr)
+    a <<= True
+    assert not bool(expr)        # re-evaluates operands
+    o = a | Bool(False)
+    assert bool(o)
+    x = a ^ b
+    assert not bool(x)
+    b <<= False
+    assert bool(x)
+
+
+def test_bool_derived_not_assignable():
+    e = Bool(True) & Bool(True)
+    try:
+        e <<= False
+        assert False
+    except ValueError:
+        pass
+
+
+def test_bool_on_true_callback():
+    fired = []
+    a = Bool(False)
+    a.on_true = lambda: fired.append(1)
+    a <<= True
+    assert fired == [1]
+
+
+def test_bool_pickles():
+    a, b = Bool(True), Bool(False)
+    expr = a & ~b
+    expr2 = pickle.loads(pickle.dumps(expr))
+    assert bool(expr2)
+
+
+class Thing:
+    def __init__(self):
+        self.val = 0
+
+
+def test_linkable_attribute():
+    src, dst = Thing(), Thing()
+    src.val = 42
+    link(dst, "val", src)
+    assert dst.val == 42
+    src.val = 7
+    assert dst.val == 7
+    dst.val = 9          # writes through
+    assert src.val == 9
+
+
+def test_linkable_tuple_mapping():
+    src, dst = Thing(), Thing()
+    src.other = "X"
+    LinkableAttribute.link(dst, "val", src, "other")
+    assert dst.val == "X"

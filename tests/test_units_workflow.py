@@ -1,0 +1,176 @@
+"""Mirrors reference veles/tests/test_units.py + test_workflow.py scope:
+gates, links, scheduler order, loops via Repeater, initialize re-queue."""
+import pytest
+
+from veles_tpu import Bool, Bug, Repeater, TrivialUnit, Unit, Workflow
+
+
+class Recorder(Unit):
+    hide_from_registry = True
+
+    def __init__(self, workflow, log, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.log = log
+
+    def run(self):
+        self.log.append(self.name)
+
+
+def build_chain(names):
+    wf = Workflow(name="wf")
+    log = []
+    units = [Recorder(wf, log, name=n) for n in names]
+    prev = wf.start_point
+    for u in units:
+        u.link_from(prev)
+        prev = u
+    wf.end_point.link_from(prev)
+    return wf, log, units
+
+
+def test_linear_chain_runs_in_order():
+    wf, log, _ = build_chain("abc")
+    wf.initialize()
+    wf.run()
+    assert log == ["a", "b", "c"]
+    assert bool(wf.stopped)
+
+
+def test_diamond_gate_waits_for_all():
+    wf = Workflow(name="wf")
+    log = []
+    a = Recorder(wf, log, name="a")
+    b = Recorder(wf, log, name="b")
+    c = Recorder(wf, log, name="c")
+    j = Recorder(wf, log, name="join")
+    a.link_from(wf.start_point)
+    b.link_from(a)
+    c.link_from(a)
+    j.link_from(b)
+    j.link_from(c)
+    wf.end_point.link_from(j)
+    wf.initialize()
+    wf.run()
+    assert log.index("join") > max(log.index("b"), log.index("c"))
+    assert log.count("join") == 1
+
+
+def test_gate_block_stops_propagation():
+    wf, log, units = build_chain("abc")
+    units[1].gate_block <<= True
+    wf.initialize()
+    wf.run()
+    assert log == ["a"]
+    assert not bool(wf.stopped)  # EndPoint never reached
+
+
+def test_gate_skip_propagates_without_running():
+    wf, log, units = build_chain("abc")
+    units[1].gate_skip <<= True
+    wf.initialize()
+    wf.run()
+    assert log == ["a", "c"]
+    assert bool(wf.stopped)
+
+
+def test_repeater_loop_with_decision():
+    wf = Workflow(name="loop")
+    log = []
+    rep = Repeater(wf)
+
+    class Counter(Recorder):
+        def __init__(self, workflow, log, **kw):
+            super().__init__(workflow, log, **kw)
+            self.complete = Bool(False)
+            self.n = 0
+
+        def run(self):
+            super().run()
+            self.n += 1
+            if self.n >= 3:
+                self.complete <<= True
+
+    cnt = Counter(wf, log, name="cnt")
+    rep.link_from(wf.start_point)
+    cnt.link_from(rep)
+    rep.link_from(cnt)               # back edge
+    rep.gate_block = cnt.complete    # stop looping when complete
+    wf.end_point.link_from(cnt)
+    wf.end_point.gate_block = ~cnt.complete
+    wf.initialize()
+    wf.run()
+    assert log == ["cnt"] * 3
+    assert bool(wf.stopped)
+
+
+def test_demand_initialize_requeue():
+    wf = Workflow(name="wf")
+
+    class Producer(TrivialUnit):
+        def initialize(self, **kw):
+            res = super().initialize(**kw)
+            self.out = 5
+            return res
+
+    class Consumer(TrivialUnit):
+        def __init__(self, workflow, **kw):
+            super().__init__(workflow, **kw)
+            self.demand("inp")
+
+    p = Producer(wf, name="p")
+    c = Consumer(wf, name="c")
+    # deliberately link c earlier in dependency order than p
+    c.link_from(wf.start_point)
+    p.link_from(c)
+    wf.end_point.link_from(p)
+    c.link_attrs(p, ("inp", "out"))
+    wf.initialize()
+    assert c.inp == 5
+
+
+def test_initialize_deadlock_detected():
+    wf = Workflow(name="wf")
+
+    class Needy(TrivialUnit):
+        def __init__(self, workflow, **kw):
+            super().__init__(workflow, **kw)
+            self.demand("never_set")
+
+    n = Needy(wf, name="n")
+    n.link_from(wf.start_point)
+    wf.end_point.link_from(n)
+    with pytest.raises(Bug):
+        wf.initialize()
+
+
+def test_max_steps_guard():
+    wf = Workflow(name="wf", max_steps=10)
+    rep = Repeater(wf)
+    rep.link_from(wf.start_point)
+    a = TrivialUnit(wf, name="a")
+    a.link_from(rep)
+    rep.link_from(a)
+    wf.initialize()
+    with pytest.raises(Bug):
+        wf.run()
+
+
+def test_graph_and_results_and_stats():
+    wf, log, units = build_chain("ab")
+    units[0].get_metric_values = lambda: {"m": 1}
+    wf.initialize()
+    wf.run()
+    dot = wf.generate_graph()
+    assert '"a" -> "b"' in dot
+    assert wf.gather_results() == {"m": 1}
+    assert wf.checksum()
+    stats = wf.print_stats()
+    assert any(name == "a" for _, name, _ in stats)
+
+
+def test_workflow_getitem_and_container():
+    wf, _, units = build_chain("ab")
+    assert wf["a"] is units[0]
+    assert len(wf) == 4  # a, b + start + end
+    wf.del_ref(units[0])
+    assert len(wf) == 3

@@ -1,0 +1,25 @@
+"""veles_tpu — a TPU-native dataflow deep-learning framework.
+
+A ground-up rebuild of the capabilities of Samsung VELES (the reference at
+/root/reference; see SURVEY.md) designed for TPUs: models are Workflows of
+linked Units, but the per-minibatch compute compiles to a single jitted XLA
+SPMD step over a ``jax.sharding.Mesh`` instead of per-unit kernel dispatch,
+and distributed data parallelism is ``psum`` over ICI instead of a ZeroMQ
+master–slave parameter server.
+"""
+
+__version__ = "0.1.0"
+
+from .config import root                              # noqa: F401
+from .error import (VelesError, Bug, NoMoreJobs)      # noqa: F401
+from .mutable import Bool, LinkableAttribute, link    # noqa: F401
+from .units import Unit, UnitRegistry, TrivialUnit    # noqa: F401
+from .workflow import Workflow                        # noqa: F401
+from .plumbing import (StartPoint, EndPoint, Repeater,
+                       FireStarter)                   # noqa: F401
+from .memory import Array, Watcher                    # noqa: F401
+from .backends import (Device_for, XLADevice, NumpyDevice,
+                       make_mesh)                     # noqa: F401
+from .accelerated import (AcceleratedUnit,
+                          AcceleratedWorkflow)        # noqa: F401
+from . import prng                                    # noqa: F401

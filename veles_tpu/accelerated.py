@@ -1,0 +1,118 @@
+"""AcceleratedUnit: base for every compute unit.
+
+Equivalent of the reference's veles/accelerated_units.py:130-867, minus
+everything XLA makes obsolete: there is no kernel source templating, no
+build_program/nvcc, no binary cache tarballs — a compute unit declares pure
+functions and ``jax.jit`` (with the persistent compilation cache) replaces
+the whole kernel build/cache machinery (reference :298-673).
+
+Preserved contract (SURVEY.md §4 "numpy is the oracle"):
+- every accelerated unit implements ``numpy_run`` (host oracle) and an XLA
+  path; ``--force-numpy`` (root.common.engine.force_numpy) switches, and the
+  test harness asserts both agree (reference: @multi_device,
+  veles/tests/accelerated_test.py:41-61);
+- ``initialize(device=...)`` attaches the device; per-backend method dispatch
+  (reference ocl_run/cuda_run/numpy_run binding, veles/backends.py:244-262)
+  collapses to two: ``xla_run`` / ``numpy_run``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+from .backends import Device, NumpyDevice, XLADevice
+from .config import root
+from .units import Unit
+from .workflow import Workflow
+
+
+class AcceleratedUnit(Unit):
+    """Compute unit with device dispatch (reference:
+    veles/accelerated_units.py:130)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.device: Optional[Device] = None
+        self._jit_cache: Dict[str, Any] = {}
+
+    # -- lifecycle ----------------------------------------------------------
+    def initialize(self, device: Optional[Device] = None, **kwargs):
+        res = super().initialize(device=device, **kwargs)
+        if res:
+            return res
+        self.device = device if device is not None else NumpyDevice()
+        if isinstance(self.device, XLADevice):
+            self.xla_init()
+        else:
+            self.numpy_init()
+        return None
+
+    def xla_init(self) -> None:
+        """Backend-specific setup (reference ocl_init/cuda_init)."""
+
+    def numpy_init(self) -> None:
+        pass
+
+    # -- dispatch -----------------------------------------------------------
+    @property
+    def accelerated(self) -> bool:
+        return (isinstance(self.device, XLADevice)
+                and not root.common.engine.force_numpy)
+
+    def run(self) -> None:
+        if self.accelerated:
+            self.xla_run()
+            if root.common.engine.sync_run:
+                self.device.sync()
+        else:
+            self.numpy_run()
+
+    def xla_run(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError("%s.xla_run" % type(self).__name__)
+
+    def numpy_run(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError("%s.numpy_run" % type(self).__name__)
+
+    # -- jit helper ---------------------------------------------------------
+    def jit(self, key: str, fn: Callable, **jit_kwargs) -> Callable:
+        """Cache a jitted callable per unit (the reference cached built
+        kernels per device, veles/accelerated_units.py:605-673; XLA's own
+        compilation cache does the heavy lifting — this only avoids
+        re-tracing)."""
+        cached = self._jit_cache.get(key)
+        if cached is None:
+            import jax
+            cached = self._jit_cache[key] = jax.jit(fn, **jit_kwargs)
+        return cached
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_jit_cache"] = {}
+        d["device"] = None
+        return d
+
+
+class AcceleratedWorkflow(Workflow):
+    """Workflow owning a device (reference:
+    veles/accelerated_units.py:827-858)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.device: Optional[Device] = None
+
+    def initialize(self, device: Optional[Device] = None, **kwargs):
+        self.device = device if device is not None else NumpyDevice()
+        return super().initialize(device=self.device, **kwargs)
+
+    @property
+    def computing_power(self) -> float:
+        """GFLOP/s of the attached device; the reference reported this to
+        the master for load balancing (veles/accelerated_units.py:843-858);
+        kept as telemetry."""
+        if isinstance(self.device, XLADevice):
+            return self.device.compute_power()
+        return 0.0

@@ -1,0 +1,197 @@
+"""Device backends: XLA (TPU/CPU) and NumPy oracle.
+
+Equivalent of the reference's veles/backends.py:166-949 (BackendRegistry,
+Device/OpenCLDevice/CUDADevice/NumpyDevice/AutoDevice). TPU-first redesign:
+
+- One accelerated backend — XLA — instead of per-vendor kernel dispatch;
+  ``XLADevice`` owns the device set, the logical ``jax.sharding.Mesh`` and
+  the dtype policy. The reference's OpenCL block-size auto-tuner
+  (veles/backends.py:672-731) has no equivalent: XLA tiles for the MXU.
+- ``NumpyDevice`` is kept as the universal test oracle (the reference's
+  "numpy is the oracle" property, SURVEY.md §4).
+- Selection via ``root.common.engine.backend`` or ``VELES_BACKEND`` env,
+  priority tpu > other-xla > numpy (reference AutoDevice priorities,
+  veles/backends.py:406-424).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy
+
+from .config import root
+from .error import VelesError
+from .logger import Logger
+
+
+class BackendRegistry(type):
+    """name → Device class (reference: veles/backends.py:166)."""
+
+    backends: Dict[str, type] = {}
+
+    def __init__(cls, name, bases, clsdict):
+        super().__init__(name, bases, clsdict)
+        backend = clsdict.get("BACKEND")
+        if backend:
+            BackendRegistry.backends[backend] = cls
+
+
+class Device(Logger, metaclass=BackendRegistry):
+    """Abstract device (reference: veles/backends.py:184)."""
+
+    BACKEND: Optional[str] = None
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.compute_dtype = numpy.dtype(root.common.engine.compute_dtype)
+        self.precision_dtype = numpy.dtype(
+            root.common.engine.precision_type)
+
+    @property
+    def is_accelerated(self) -> bool:
+        return True
+
+    @property
+    def name(self) -> str:
+        return self.BACKEND or type(self).__name__
+
+    def sync(self) -> None:
+        """Block until outstanding device work completes."""
+
+    def exists(self) -> bool:
+        return True
+
+
+class NumpyDevice(Device):
+    """Pure-host oracle backend (reference: veles/backends.py:918)."""
+
+    BACKEND = "numpy"
+
+    @property
+    def is_accelerated(self) -> bool:
+        return False
+
+
+class XLADevice(Device):
+    """JAX/XLA device set + logical mesh (the reference's
+    Device-per-accelerator model collapses to one object owning all chips:
+    SPMD means the framework addresses the *mesh*, not a chip)."""
+
+    BACKEND = "xla"
+
+    def __init__(self, platform: Optional[str] = None,
+                 mesh_axes: Optional[Dict[str, int]] = None) -> None:
+        super().__init__()
+        import jax
+        self._jax = jax
+        self.jax_devices = (jax.devices(platform) if platform
+                            else jax.devices())
+        if not self.jax_devices:
+            raise VelesError("no XLA devices for platform %r" % platform)
+        self.platform = self.jax_devices[0].platform
+        axes = dict(mesh_axes if mesh_axes is not None
+                    else root.common.mesh.axes.as_dict()
+                    if hasattr(root.common.mesh.axes, "as_dict")
+                    else root.common.mesh.axes)
+        self.mesh = make_mesh(self.jax_devices, axes)
+        self.info("XLA backend: %d %s device(s), mesh %s",
+                  len(self.jax_devices), self.platform,
+                  dict(zip(self.mesh.axis_names, self.mesh.devices.shape)))
+
+    @property
+    def device_count(self) -> int:
+        return len(self.jax_devices)
+
+    def sync(self) -> None:
+        (self._jax.device_put(0.0) + 0).block_until_ready()
+
+    def compute_power(self, n: int = 2048) -> float:
+        """GEMM benchmark → GFLOP/s; the reference used the same measurement
+        for load balancing (veles/accelerated_units.py:843-858); kept here
+        as telemetry."""
+        import jax
+        import jax.numpy as jnp
+        import time
+        a = jnp.ones((n, n), dtype=jnp.bfloat16)
+        f = jax.jit(lambda x: x @ x)
+        f(a).block_until_ready()
+        t0 = time.time()
+        reps = 8
+        for _ in range(reps):
+            r = f(a)
+        r.block_until_ready()
+        dt = (time.time() - t0) / reps
+        return 2.0 * n ** 3 / dt / 1e9
+
+
+def make_mesh(devices, axes: Dict[str, int]):
+    """Build a jax Mesh from an axis-name → size spec; one axis may be -1
+    (absorbs remaining devices). Reserved axis vocabulary:
+    data / fsdp / tensor / sequence / expert / pipeline (SURVEY.md §5.7)."""
+    import numpy as np
+    from jax.sharding import Mesh
+    total = len(devices)
+    sizes = dict(axes)
+    wild = [k for k, v in sizes.items() if v == -1]
+    fixed = int(np.prod([v for v in sizes.values() if v != -1])) if sizes \
+        else 1
+    if len(wild) > 1:
+        raise VelesError("at most one mesh axis may be -1: %s" % axes)
+    if wild:
+        if total % fixed:
+            raise VelesError("mesh %s does not divide %d devices" %
+                             (axes, total))
+        sizes[wild[0]] = total // fixed
+    shape = tuple(sizes.values()) or (total,)
+    names = tuple(sizes.keys()) or ("data",)
+    if int(np.prod(shape)) != total:
+        raise VelesError("mesh %s != %d devices" % (sizes, total))
+    return Mesh(np.asarray(devices).reshape(shape), names)
+
+
+_auto_device: Optional[Device] = None
+
+
+def Device_for(backend: Optional[str] = None) -> Device:
+    """Resolve a backend name to a Device (reference: Device.__new__
+    dispatch on -a/--backend or VELES_BACKEND, veles/backends.py:184-243)."""
+    backend = (backend or os.environ.get("VELES_BACKEND") or
+               root.common.engine.backend)
+    if backend in ("auto", None):
+        return AutoDevice()
+    if backend == "numpy" or root.common.engine.force_numpy:
+        return NumpyDevice()
+    if backend in ("xla", "tpu", "cpu", "gpu", "axon"):
+        platform = None if backend == "xla" else backend
+        if platform == "tpu":
+            # the tunnelled TPU registers as its own platform name on some
+            # stacks (e.g. "axon"); accept the default device set only if
+            # it actually is an accelerator — never silently run on CPU
+            # when the user explicitly asked for TPU
+            try:
+                return XLADevice("tpu")
+            except Exception:
+                dev = XLADevice(None)
+                if dev.platform == "cpu":
+                    raise VelesError(
+                        "backend 'tpu' requested but only CPU XLA devices "
+                        "are present")
+                return dev
+        return XLADevice(platform)
+    raise VelesError("unknown backend %r (have: %s)" %
+                     (backend, sorted(BackendRegistry.backends)))
+
+
+def AutoDevice() -> Device:
+    """Priority: accelerated XLA > numpy (reference: veles/backends.py:406)."""
+    global _auto_device
+    if _auto_device is not None:
+        return _auto_device
+    try:
+        _auto_device = XLADevice()
+    except Exception as e:  # pragma: no cover - jax always importable here
+        Logger().warning("XLA unavailable (%s); falling back to numpy", e)
+        _auto_device = NumpyDevice()
+    return _auto_device

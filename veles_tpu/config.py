@@ -1,0 +1,170 @@
+"""Auto-vivifying configuration tree.
+
+TPU-era equivalent of the reference's veles/config.py:60-325: a global
+attribute tree ``root`` where any ``root.a.b.c = v`` path springs into
+existence, with layered overrides (site file, user file, environment,
+explicit ``update()``), protected keys, and a printable/dumpable form.
+
+Differences from the reference, by design:
+- overrides come from python/JSON files and ``VELES_TPU_*`` env vars instead
+  of runpy-exec'd model config files (those still work via ``update_from_file``);
+- engine defaults describe the XLA/TPU backend (dtype policy, mesh axes,
+  compilation cache) instead of OpenCL block sizes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import runpy
+from typing import Any, Dict, Iterator, Tuple
+
+_PROTECTED = "_protected_"
+
+
+class Config:
+    """A node in the auto-vivifying config tree."""
+
+    def __init__(self, path: str = "root") -> None:
+        object.__setattr__(self, "_path_", path)
+        object.__setattr__(self, _PROTECTED, set())
+
+    # -- attribute protocol -------------------------------------------------
+    def __getattr__(self, name: str) -> "Config":
+        if name.startswith("__") and name.endswith("__"):
+            raise AttributeError(name)
+        child = Config("%s.%s" % (self._path_, name))
+        object.__setattr__(self, name, child)
+        return child
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in (self._protected_set()):
+            raise AttributeError(
+                "config key %s.%s is protected" % (self._path_, name))
+        object.__setattr__(self, name, value)
+
+    def _protected_set(self):
+        return object.__getattribute__(self, _PROTECTED)
+
+    def protect(self, *names: str) -> None:
+        """Forbid further assignment of the given child keys
+        (reference: veles/config.py:79-84)."""
+        self._protected_set().update(names)
+
+    # -- collection-ish protocol -------------------------------------------
+    def __contains__(self, name: str) -> bool:
+        return name in self.__dict__ and not name.endswith("_")
+
+    def get(self, name: str, default: Any = None) -> Any:
+        if name in self:
+            return self.__dict__[name]
+        return default
+
+    def items(self) -> Iterator[Tuple[str, Any]]:
+        # insertion order preserved: mesh-axis order etc. is semantic
+        for k, v in self.__dict__.items():
+            if k.endswith("_") or k.startswith("_"):
+                continue
+            yield k, v
+
+    def update(self, tree: Dict[str, Any] = None, **kwargs: Any) -> "Config":
+        """Deep-merge a nested dict (or kwargs) into this subtree
+        (reference: veles/config.py:103-133 ``Config.update``)."""
+        tree = dict(tree or {})
+        tree.update(kwargs)
+        for k, v in tree.items():
+            if isinstance(v, dict):
+                getattr(self, k).update(v)
+            else:
+                setattr(self, k, v)
+        return self
+
+    def update_from_file(self, path: str) -> "Config":
+        """Apply a .py (exec'd with ``root`` in scope, like the reference's
+        runpy path, veles/__main__.py:426-472) or .json override file."""
+        if path.endswith(".json"):
+            with open(path, "r") as fin:
+                self.update(json.load(fin))
+        else:
+            runpy.run_path(path, init_globals={"root": self})
+        return self
+
+    def update_from_env(self, prefix: str = "VELES_TPU_") -> "Config":
+        """``VELES_TPU_ENGINE__FORCE_NUMPY=true`` → engine.force_numpy.
+        Path components are separated by a DOUBLE underscore so config keys
+        containing single underscores survive."""
+        for key, val in os.environ.items():
+            if not key.startswith(prefix):
+                continue
+            node = self
+            *parents, leaf = key[len(prefix):].lower().split("__")
+            for part in parents:
+                node = getattr(node, part)
+            try:
+                val = json.loads(val)
+            except ValueError:
+                pass
+            setattr(node, leaf, val)
+        return self
+
+    # -- introspection ------------------------------------------------------
+    def as_dict(self) -> Dict[str, Any]:
+        out = {}
+        for k, v in self.items():
+            out[k] = v.as_dict() if isinstance(v, Config) else v
+        return out
+
+    def print_(self, indent: int = 0, file=None) -> None:
+        """Dump the tree (reference ``--dump-config``, veles/config.py:136)."""
+        import sys
+        file = file or sys.stdout
+        for k, v in self.items():
+            if isinstance(v, Config):
+                print("%s%s:" % ("  " * indent, k), file=file)
+                v.print_(indent + 1, file)
+            else:
+                print("%s%s: %r" % ("  " * indent, k, v), file=file)
+
+    def __repr__(self) -> str:
+        return "<Config %s: %s>" % (self._path_, sorted(
+            k for k, _ in self.items()))
+
+
+def _default_root() -> Config:
+    r = Config("root")
+    r.common.update({
+        "dirs": {
+            "cache": os.path.expanduser("~/.veles_tpu/cache"),
+            "snapshots": os.path.expanduser("~/.veles_tpu/snapshots"),
+            "datasets": os.path.expanduser("~/.veles_tpu/datasets"),
+        },
+        "engine": {
+            # dtype policy: params/compute dtype (reference precision_type,
+            # veles/config.py:241-248; on TPU the MXU wants bfloat16 compute)
+            "precision_type": "float32",
+            "compute_dtype": "bfloat16",
+            "backend": "auto",       # auto | tpu | cpu | numpy
+            "sync_run": False,       # block after each step (profiling aid)
+            "force_numpy": False,    # run numpy oracle instead of XLA
+        },
+        "mesh": {
+            # logical mesh axes reserved up front (SURVEY.md §5.7/§5.8):
+            # data, fsdp, tensor, sequence, expert, pipeline
+            "axes": {"data": -1},    # -1 = all remaining devices
+        },
+        "trace": {"run": False, "timings": False},
+        "disable": {"plotting": bool(os.environ.get("VELES_TPU_TEST"))},
+        "random_seed": 1234,
+    })
+    r.common.update_from_env()
+    # layered site/user overrides (reference: veles/config.py:294-308)
+    for site in ("/etc/veles_tpu.json",
+                 os.path.expanduser("~/.veles_tpu.json"),
+                 os.path.join(os.getcwd(), ".veles_tpu.json")):
+        if os.path.exists(site):
+            r.update_from_file(site)
+    return r
+
+
+#: The global configuration tree (reference: veles/config.py:152 ``root``).
+root = _default_root()

@@ -1,0 +1,27 @@
+"""Framework exception types.
+
+Equivalent of the reference's veles/error.py:1-49 (VelesException, Bug,
+MasterSlaveCommunicationError), renamed for the TPU-era runtime.
+"""
+
+
+class VelesError(Exception):
+    """Base class for all framework errors."""
+
+
+class Bug(VelesError):
+    """Internal invariant violation — indicates a framework bug."""
+
+
+class BadUnitLink(VelesError):
+    """Raised when control/data links form an invalid graph."""
+
+
+class NoMoreJobs(VelesError):
+    """Raised by a data source when the epoch/job stream is exhausted
+    (reference: veles/workflow.py:82)."""
+
+
+class DistributedCommunicationError(VelesError):
+    """Coordinator/multi-host communication failure
+    (reference: MasterSlaveCommunicationError, veles/error.py)."""

@@ -1,0 +1,114 @@
+"""Class-scoped logging + event spans.
+
+TPU-era equivalent of the reference's veles/logger.py:59-332: every framework
+object mixes in :class:`Logger` and gets a logger named after its class; event
+spans (``begin``/``end``/``single``) record timestamped intervals for
+observability. Where the reference duplicated records to MongoDB, this build
+appends JSON lines to a trace file (and keeps an in-memory ring) — the same
+data model, no external service. The span stream is also the hook point for
+``jax.profiler`` trace annotation.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Deque, Dict, Optional
+
+_event_lock = threading.Lock()
+_event_ring: Deque[Dict[str, Any]] = collections.deque(maxlen=65536)
+_event_file = None
+_event_path: Optional[str] = None
+
+
+def setup_logging(level: int = logging.INFO, logfile: Optional[str] = None,
+                  tracefile: Optional[str] = None) -> None:
+    """Configure root logging (reference: Logger.setup_logging,
+    veles/logger.py:107-151) and optionally an event-trace JSONL sink
+    (reference duplicated events to Mongo, veles/logger.py:210-213)."""
+    global _event_file, _event_path
+    fmt = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
+    logging.basicConfig(level=level, format=fmt)
+    if logfile:
+        handler = logging.FileHandler(logfile)
+        handler.setFormatter(logging.Formatter(fmt))
+        logging.getLogger().addHandler(handler)
+    if tracefile and tracefile != _event_path:
+        os.makedirs(os.path.dirname(tracefile) or ".", exist_ok=True)
+        _event_file = open(tracefile, "a")
+        _event_path = tracefile
+
+
+def events(name: Optional[str] = None):
+    """Snapshot of recorded event spans (newest last)."""
+    with _event_lock:
+        evs = list(_event_ring)
+    if name is not None:
+        evs = [e for e in evs if e["name"] == name]
+    return evs
+
+
+def clear_events() -> None:
+    with _event_lock:
+        _event_ring.clear()
+
+
+class Logger:
+    """Mixin granting ``self.logger`` plus debug/info/... helpers and
+    :meth:`event` span recording (reference: veles/logger.py:59,264-289)."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+
+    @property
+    def logger(self) -> logging.Logger:
+        return logging.getLogger(type(self).__name__)
+
+    def debug(self, msg: str, *args: Any) -> None:
+        self.logger.debug(msg, *args)
+
+    def info(self, msg: str, *args: Any) -> None:
+        self.logger.info(msg, *args)
+
+    def warning(self, msg: str, *args: Any) -> None:
+        self.logger.warning(msg, *args)
+
+    def error(self, msg: str, *args: Any) -> None:
+        self.logger.error(msg, *args)
+
+    def exception(self, msg: str = "Error", *args: Any) -> None:
+        self.logger.exception(msg, *args)
+
+    def event(self, name: str, etype: str = "single", **info: Any) -> None:
+        """Record a span edge: etype in {begin, end, single}
+        (reference: Logger.event, veles/logger.py:264-289)."""
+        assert etype in ("begin", "end", "single"), etype
+        rec = {"name": name, "type": etype, "time": time.time(),
+               "who": type(self).__name__}
+        rec.update(info)
+        with _event_lock:
+            _event_ring.append(rec)
+            if _event_file is not None:
+                _event_file.write(json.dumps(rec, default=str) + "\n")
+                _event_file.flush()
+
+
+class SpanTimer:
+    """``with SpanTimer(obj, "step"):`` → begin/end event pair + elapsed."""
+
+    def __init__(self, owner: Logger, name: str, **info: Any) -> None:
+        self.owner, self.name, self.info = owner, name, info
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "SpanTimer":
+        self._t0 = time.time()
+        self.owner.event(self.name, "begin", **self.info)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.elapsed = time.time() - self._t0
+        self.owner.event(self.name, "end", elapsed=self.elapsed, **self.info)

@@ -1,0 +1,209 @@
+"""Array: host-numpy + device-jax pair with explicit coherence.
+
+Equivalent of the reference's veles/memory.py:56-512 (``Array`` with the
+map_read/map_write/map_invalidate/unmap protocol and the device-memory
+``Watcher``). TPU-first redesign: ``jax.Array`` is immutable, so instead of
+mapped pointers the coherence protocol tracks *which side is newer*:
+
+- ``map_read()``  → make ``mem`` (numpy) current (device→host sync if needed);
+- ``map_write()`` → same, then mark host as the newer side;
+- ``assign_devmem(x)`` → a jitted step produced a new device array; device
+  side becomes the newer one (zero-copy, no host sync until someone reads);
+- ``device_view(sharding=None)`` → jax.Array for tracing/compute, pushing
+  host→device if host is newer (sharded placement via ``jax.device_put``).
+
+This preserves the reference's key property — snapshots and host-side units
+always observe coherent data (veles/memory.py:284-292 synced device→host on
+pickle) — while keeping steady-state training entirely on device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+import numpy
+
+from .error import Bug
+from .logger import Logger
+
+
+class Watcher:
+    """Device-memory accounting (reference: veles/memory.py:56-107)."""
+
+    lock = threading.Lock()
+    total = 0
+    peak = 0
+    per_name: Dict[str, int] = {}
+
+    @classmethod
+    def add(cls, name: str, nbytes: int) -> None:
+        with cls.lock:
+            cls.total += nbytes
+            cls.peak = max(cls.peak, cls.total)
+            cls.per_name[name] = cls.per_name.get(name, 0) + nbytes
+
+    @classmethod
+    def sub(cls, name: str, nbytes: int) -> None:
+        with cls.lock:
+            cls.total -= nbytes
+            cls.per_name[name] = cls.per_name.get(name, 0) - nbytes
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls.lock:
+            cls.total = cls.peak = 0
+            cls.per_name.clear()
+
+
+class Array(Logger):
+    """Host/device tensor pair (reference: veles/memory.py:110)."""
+
+    def __init__(self, data: Any = None, shape: Tuple[int, ...] = None,
+                 dtype: Any = numpy.float32, name: str = "") -> None:
+        super().__init__()
+        self.name = name
+        self._lock = threading.RLock()
+        self.mem: Optional[numpy.ndarray] = None
+        self.devmem = None          # jax.Array | None
+        self._host_newer = False
+        self._dev_newer = False
+        self._accounted = 0
+        if data is not None:
+            self.reset(numpy.asarray(data))
+        elif shape is not None:
+            self.reset(numpy.zeros(shape, dtype=dtype))
+
+    # -- shape/dtype passthrough --------------------------------------------
+    @property
+    def shape(self):
+        return self.mem.shape if self.mem is not None else None
+
+    @property
+    def dtype(self):
+        return self.mem.dtype if self.mem is not None else None
+
+    @property
+    def nbytes(self) -> int:
+        return self.mem.nbytes if self.mem is not None else 0
+
+    def __bool__(self) -> bool:
+        return self.mem is not None
+
+    def __len__(self) -> int:
+        return len(self.mem) if self.mem is not None else 0
+
+    def __getitem__(self, idx):
+        self.map_read()
+        return self.mem[idx]
+
+    def __setitem__(self, idx, value):
+        self.map_write()
+        self.mem[idx] = value
+
+    # -- lifecycle ----------------------------------------------------------
+    def reset(self, data: Optional[numpy.ndarray] = None) -> "Array":
+        """(Re)bind host storage, dropping any device copy
+        (reference: veles/memory.py:323-345)."""
+        with self._lock:
+            self._drop_devmem()
+            self.mem = data
+            self._host_newer = data is not None
+            self._dev_newer = False
+        return self
+
+    def initialize(self, device=None) -> None:
+        """Attach to a device; actual placement is lazy via device_view
+        (reference eagerly created cl/cuda buffers, veles/memory.py:347)."""
+        # retained for API parity with the reference unit contract
+
+    # -- coherence protocol -------------------------------------------------
+    def map_read(self) -> numpy.ndarray:
+        with self._lock:
+            if self._dev_newer:
+                self.mem = numpy.asarray(self.devmem).astype(
+                    self.mem.dtype if self.mem is not None else None,
+                    copy=False) if self.mem is not None else numpy.asarray(
+                        self.devmem)
+                self._dev_newer = False
+            return self.mem
+
+    def map_write(self) -> numpy.ndarray:
+        mem = self.map_read()
+        self._host_newer = True
+        return mem
+
+    def map_invalidate(self) -> numpy.ndarray:
+        """Host will fully overwrite; skip device→host sync
+        (reference: veles/memory.py:379)."""
+        with self._lock:
+            self._dev_newer = False
+            self._host_newer = True
+            return self.mem
+
+    def unmap(self) -> None:
+        """No-op kept for API parity (jax has no mapped pointers)."""
+
+    def assign_devmem(self, devmem) -> None:
+        """Adopt a device array produced by a jitted step (device becomes the
+        newer side; no host transfer until map_read)."""
+        with self._lock:
+            self._account(devmem)
+            self.devmem = devmem
+            self._dev_newer = True
+            self._host_newer = False
+
+    def device_view(self, device=None, sharding=None, dtype=None):
+        """The jax.Array for compute, pushing host data if it is newer."""
+        import jax
+        with self._lock:
+            if self.devmem is None or self._host_newer:
+                if self.mem is None:
+                    raise Bug("Array %s: device_view before reset" %
+                              self.name)
+                src = self.mem if dtype is None else self.mem.astype(dtype)
+                dev = (jax.device_put(src, sharding) if sharding is not None
+                       else jax.device_put(src))
+                self._account(dev)
+                self.devmem = dev
+                self._host_newer = False
+            return self.devmem
+
+    def __del__(self) -> None:
+        try:
+            self._drop_devmem()
+        except Exception:
+            pass
+
+    def _drop_devmem(self) -> None:
+        if self.devmem is not None and self._accounted:
+            Watcher.sub(self.name or "anon", self._accounted)
+            self._accounted = 0
+        self.devmem = None
+
+    def _account(self, dev) -> None:
+        nbytes = getattr(dev, "nbytes", 0)
+        if self._accounted:
+            Watcher.sub(self.name or "anon", self._accounted)
+        Watcher.add(self.name or "anon", nbytes)
+        self._accounted = nbytes
+
+    # -- pickling (reference: veles/memory.py:284-299) ----------------------
+    def __getstate__(self):
+        self.map_read()
+        return {"name": self.name, "mem": self.mem}
+
+    def __setstate__(self, state):
+        Logger.__init__(self)
+        self.name = state["name"]
+        self._lock = threading.RLock()
+        self.mem = state["mem"]
+        self.devmem = None
+        self._host_newer = self.mem is not None
+        self._dev_newer = False
+        self._accounted = 0
+
+    def __repr__(self) -> str:
+        return "<Array %r %s %s host_newer=%s dev_newer=%s>" % (
+            self.name, self.shape, self.dtype, self._host_newer,
+            self._dev_newer)

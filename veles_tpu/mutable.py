@@ -1,0 +1,154 @@
+"""Shared mutable booleans and cross-object attribute aliasing.
+
+Equivalent of the reference's veles/mutable.py:44-357. ``Bool`` is a mutable
+flag object shared by reference between units: gate expressions like
+``~decision.complete & loader.epoch_ended`` build derived Bools that re-read
+their operands at evaluation time. ``LinkableAttribute`` makes ``a.attr`` a
+live pointer to ``b.attr`` (reference ``link_attrs``).
+
+Unlike the reference (which composed pickled lambda expressions,
+veles/mutable.py:163-190), derived Bools here store an operator tree of plain
+objects, so they pickle/deepcopy naturally — important for checkpointing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+
+class Bool:
+    """Mutable shared boolean with lazy operator algebra
+    (reference: veles/mutable.py:44)."""
+
+    __slots__ = ("_value", "_op", "_operands", "on_true")
+
+    def __init__(self, value: bool = False) -> None:
+        self._value = bool(value)
+        self._op: Optional[str] = None
+        self._operands: Tuple["Bool", ...] = ()
+        #: optional callback fired by ``<<=`` when the flag becomes True
+        self.on_true: Optional[Callable[[], None]] = None
+
+    @classmethod
+    def _derived(cls, op: str, *operands: "Bool") -> "Bool":
+        b = cls()
+        b._op = op
+        b._operands = operands
+        return b
+
+    # -- evaluation ---------------------------------------------------------
+    def __bool__(self) -> bool:
+        if self._op is None:
+            return self._value
+        vals = [bool(o) for o in self._operands]
+        if self._op == "not":
+            return not vals[0]
+        if self._op == "and":
+            return all(vals)
+        if self._op == "or":
+            return any(vals)
+        if self._op == "xor":
+            return vals[0] != vals[1]
+        raise AssertionError(self._op)
+
+    # -- mutation -----------------------------------------------------------
+    def __ilshift__(self, value: Any) -> "Bool":
+        """``flag <<= True`` — in-place assignment that preserves identity so
+        every holder of the reference observes the change
+        (reference: veles/mutable.py:117-131)."""
+        if self._op is not None:
+            raise ValueError("cannot assign to a derived Bool expression")
+        self._value = bool(value)
+        if self._value and self.on_true is not None:
+            self.on_true()
+        return self
+
+    # -- algebra ------------------------------------------------------------
+    def __invert__(self) -> "Bool":
+        return Bool._derived("not", self)
+
+    def __and__(self, other: "Bool") -> "Bool":
+        return Bool._derived("and", self, _coerce(other))
+
+    def __or__(self, other: "Bool") -> "Bool":
+        return Bool._derived("or", self, _coerce(other))
+
+    def __xor__(self, other: "Bool") -> "Bool":
+        return Bool._derived("xor", self, _coerce(other))
+
+    __rand__ = __and__
+    __ror__ = __or__
+    __rxor__ = __xor__
+
+    def __repr__(self) -> str:
+        if self._op is None:
+            return "<Bool %s at 0x%x>" % (self._value, id(self))
+        return "<Bool %s(%s)>" % (self._op, ", ".join(map(repr,
+                                                          self._operands)))
+
+
+def _coerce(v: Any) -> Bool:
+    return v if isinstance(v, Bool) else Bool(bool(v))
+
+
+_MISSING = object()
+
+
+class LinkableAttribute:
+    """Descriptor making ``owner.attr`` an alias of ``(target, attr)``
+    (reference: veles/mutable.py:219-353). Installed on the *class* lazily;
+    per-instance pointers live in ``instance.__linked__``. Any pre-existing
+    class-level default is preserved for unlinked sibling instances."""
+
+    def __init__(self, name: str, default: Any = _MISSING) -> None:
+        self.name = name
+        self.default = default
+
+    def __get__(self, obj: Any, objtype: Any = None) -> Any:
+        if obj is None:
+            return self
+        links = obj.__dict__.get("__linked__", {})
+        if self.name in links:
+            target, attr = links[self.name]
+            return getattr(target, attr)
+        # unlinked instance of a class that has linked instances elsewhere
+        if self.name in obj.__dict__:
+            return obj.__dict__[self.name]
+        if self.default is not _MISSING:
+            return self.default
+        raise AttributeError(self.name)
+
+    def __set__(self, obj: Any, value: Any) -> None:
+        links = obj.__dict__.setdefault("__linked__", {})
+        if self.name in links:
+            target, attr = links[self.name]
+            setattr(target, attr, value)
+        else:
+            # direct assignment before linking: behave like a plain attr
+            obj.__dict__[self.name] = value
+
+    @staticmethod
+    def link(dst: Any, dst_attr: str, src: Any, src_attr: str,
+             two_way: bool = False) -> None:
+        """Make ``dst.dst_attr`` an alias of ``src.src_attr``
+        (reference: mutable.link, veles/mutable.py:353). Since the alias is
+        a live pointer, both reads AND writes through ``dst`` already reach
+        ``src`` — the reference's ``two_way`` mode (assignment direction)
+        is subsumed and accepted as a no-op for API parity; a reverse
+        pointer would create an unreadable cycle."""
+        cls = type(dst)
+        desc = cls.__dict__.get(dst_attr)
+        if not isinstance(desc, LinkableAttribute):
+            # preserve an inherited/class-level default for siblings
+            prev = getattr(cls, dst_attr, _MISSING)
+            if isinstance(prev, LinkableAttribute):
+                prev = _MISSING
+            setattr(cls, dst_attr, LinkableAttribute(dst_attr, prev))
+        dst.__dict__.pop(dst_attr, None)  # shadow removal
+        links = dst.__dict__.setdefault("__linked__", {})
+        links[dst_attr] = (src, src_attr)
+
+
+def link(dst: Any, dst_attr: str, src: Any, src_attr: str = None,
+         two_way: bool = False) -> None:
+    LinkableAttribute.link(dst, dst_attr, src, src_attr or dst_attr, two_way)

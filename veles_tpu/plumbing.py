@@ -1,0 +1,49 @@
+"""Graph-skeleton units (reference: veles/plumbing.py:17-112)."""
+
+from __future__ import annotations
+
+from .units import Unit
+
+
+class StartPoint(Unit):
+    """Workflow entry node (reference: veles/plumbing.py:44)."""
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("name", "Start")
+        super().__init__(workflow, **kwargs)
+
+
+class EndPoint(Unit):
+    """Workflow exit node: running it finishes the workflow
+    (reference: veles/plumbing.py:60-88)."""
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("name", "End")
+        super().__init__(workflow, **kwargs)
+
+    def run(self) -> None:
+        self.workflow.on_workflow_finished()
+
+
+class Repeater(Unit):
+    """Loop head: ignores its gate so the cycle back-edge can re-fire it
+    (reference: veles/plumbing.py:17-41)."""
+
+    def __init__(self, workflow, **kwargs):
+        kwargs.setdefault("name", "Repeater")
+        kwargs.setdefault("ignores_gate", True)
+        super().__init__(workflow, **kwargs)
+
+
+class FireStarter(Unit):
+    """Resets the ``stopped`` flag of attached units so a finished subgraph
+    can run again (reference: veles/plumbing.py:92-112)."""
+
+    def __init__(self, workflow, units=(), **kwargs):
+        kwargs.setdefault("name", "FireStarter")
+        super().__init__(workflow, **kwargs)
+        self.units = list(units)
+
+    def run(self) -> None:
+        for u in self.units:
+            u.stopped <<= False

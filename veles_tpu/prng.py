@@ -1,0 +1,129 @@
+"""Deterministic, seedable, state-preserving randomness.
+
+Equivalent of the reference's veles/prng/ (RandomGenerator with keyed global
+instances, seed files, ``preserve_state``, veles/prng/random_generator.py:64-160;
+the accelerated xorshift1024* Uniform unit, veles/prng/uniform.py).
+
+TPU-first redesign: on-device randomness uses JAX's counter-based threefry —
+a ``RandomGenerator`` owns a root ``jax.random.key`` plus a fold-in counter,
+so random streams are reproducible regardless of device count or sharding
+(the reference needed per-device xorshift state arrays for the same goal).
+A numpy ``numpy.random.RandomState`` mirror is kept for host-side choices
+(shuffles, splits) and as the oracle for tests.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Dict, Optional
+
+import numpy
+
+_lock = threading.Lock()
+_generators: Dict[str, "RandomGenerator"] = {}
+
+
+class RandomGenerator:
+    """Named random stream with independent host (numpy) and device (threefry)
+    sides, both derived from one seed."""
+
+    def __init__(self, key: str, seed: Optional[int] = None) -> None:
+        self.key = key
+        self._counter = 0
+        self.seed(seed if seed is not None else _default_seed(key))
+
+    def seed(self, seed: int) -> None:
+        """(Re)seed both sides (reference: veles/prng/random_generator.py:106)."""
+        self._seed = int(seed) & 0xFFFFFFFF
+        self.state = numpy.random.RandomState(self._seed)
+        self._counter = 0
+        self._jax_root = None  # lazy: jax import deferred
+
+    @property
+    def initial_seed(self) -> int:
+        return self._seed
+
+    # -- device side --------------------------------------------------------
+    def jax_key(self):
+        """Fresh, never-repeating threefry key: fold the stream counter into
+        the root key. Safe under jit if called at trace/step boundaries."""
+        import jax
+        if self._jax_root is None:
+            self._jax_root = jax.random.key(self._seed)
+        self._counter += 1
+        return jax.random.fold_in(self._jax_root, self._counter)
+
+    # -- host side (numpy mirror / oracle) ----------------------------------
+    def randint(self, low, high=None, size=None):
+        return self.state.randint(low, high, size)
+
+    def shuffle(self, arr) -> None:
+        self.state.shuffle(arr)
+
+    def permutation(self, n):
+        return self.state.permutation(n)
+
+    def rand(self, *shape):
+        return self.state.rand(*shape)
+
+    def normal(self, loc=0.0, scale=1.0, size=None):
+        return self.state.normal(loc, scale, size)
+
+    def fill_normal(self, arr, scale: float) -> None:
+        arr[...] = self.state.normal(0.0, scale,
+                                     arr.shape).astype(arr.dtype)
+
+    # -- state preservation (reference :132 ``preserve_state``) --------------
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["state"] = self.state.get_state()
+        d["_jax_root"] = None
+        return d
+
+    def __setstate__(self, d):
+        st = d.pop("state")
+        self.__dict__.update(d)
+        self.state = numpy.random.RandomState()
+        self.state.set_state(st)
+
+    class preserve_state:
+        """``with rng.preserve_state(rng):`` run a block without perturbing
+        the stream (reference: veles/prng/random_generator.py:132)."""
+
+        def __init__(self, rng: "RandomGenerator") -> None:
+            self.rng = rng
+
+        def __enter__(self):
+            self._saved = (self.rng.state.get_state(), self.rng._counter)
+            return self.rng
+
+        def __exit__(self, *exc):
+            self.rng.state.set_state(self._saved[0])
+            self.rng._counter = self._saved[1]
+
+
+def _default_seed(key: str) -> int:
+    from .config import root
+    base = int(root.common.random_seed)
+    h = int.from_bytes(hashlib.sha256(key.encode()).digest()[:4], "little")
+    return (base ^ h) & 0xFFFFFFFF
+
+
+def get(key: str = "default") -> RandomGenerator:
+    """Global keyed RNG instances (reference: veles/prng/__init__.py get())."""
+    with _lock:
+        gen = _generators.get(key)
+        if gen is None:
+            gen = _generators[key] = RandomGenerator(key)
+        return gen
+
+
+def seed_all(seed: int) -> None:
+    """Reseed every existing stream deterministically from one master seed
+    (reference: Main._seed_random, veles/__main__.py:483-537)."""
+    from .config import root
+    root.common.random_seed = int(seed)
+    with _lock:
+        for key, gen in _generators.items():
+            gen.seed(_default_seed(key))

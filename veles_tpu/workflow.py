@@ -1,0 +1,194 @@
+"""Workflow: unit container + scheduler + results root.
+
+Equivalent of the reference's veles/workflow.py:87-1051, re-architected for
+TPU (SURVEY.md §7): the reference dispatched each unit onto a thread pool per
+minibatch (event-driven hot loop, veles/workflow.py:351-364 →
+veles/units.py:782); here the scheduler is a deterministic, serial,
+gate-driven loop in Python — cheap because the actual compute inside any
+step-like unit is a single jitted XLA call (often covering forward+backward+
+update fused). Threads would only add nondeterminism; XLA owns the devices.
+
+Preserved surface: dependency-ordered ``initialize`` with partial-init
+re-queue, ``run`` until EndPoint, ``stopped``/``on_workflow_finished``,
+graphviz export, per-unit timing stats, ``gather_results``, checksums.
+The master–slave job plane (generate/apply_data_for_slave,
+veles/workflow.py:478-615) is intentionally absent: data parallelism is SPMD
+``psum`` inside the step function (see veles_tpu/parallel/).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import inspect
+import time
+from typing import Any, Dict, List, Optional
+
+from .error import Bug
+from .logger import Logger, SpanTimer
+from .mutable import Bool
+from .plumbing import EndPoint, StartPoint
+from .units import Unit
+
+
+class Workflow(Unit):
+    """Container of units; itself a Unit so workflows nest
+    (reference: veles/workflow.py:87, Container veles/units.py:925)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, **kwargs):
+        self._units: List[Unit] = []
+        super().__init__(workflow, **kwargs)
+        self.stopped = Bool(False)
+        self.start_point = StartPoint(self)
+        self.end_point = EndPoint(self)
+        self._run_time = 0.0
+        self._max_steps = kwargs.get("max_steps", None)  # safety valve
+
+    # -- container protocol -------------------------------------------------
+    def add_ref(self, unit: Unit) -> None:
+        if unit is not self:
+            self._units.append(unit)
+
+    def del_ref(self, unit: Unit) -> None:
+        if unit in self._units:
+            self._units.remove(unit)
+            unit.unlink_all()
+
+    @property
+    def units(self) -> List[Unit]:
+        return list(self._units)
+
+    def __iter__(self):
+        return iter(self._units)
+
+    def __len__(self):
+        return len(self._units)
+
+    def __getitem__(self, name: str) -> Unit:
+        for u in self._units:
+            if u.name == name:
+                return u
+        raise KeyError(name)
+
+    # -- dependency order ---------------------------------------------------
+    def units_in_dependency_order(self) -> List[Unit]:
+        """BFS from start_point over control links; unreachable units are
+        appended last (reference: veles/units.py:507)."""
+        seen: Dict[Unit, None] = {}
+        queue = collections.deque([self.start_point])
+        while queue:
+            u = queue.popleft()
+            if u in seen:
+                continue
+            seen[u] = None
+            for v in sorted(u.links_to, key=lambda x: x.name):
+                queue.append(v)
+        for u in self._units:
+            if u not in seen:
+                seen[u] = None
+        return list(seen)
+
+    # -- lifecycle ----------------------------------------------------------
+    def initialize(self, **kwargs) -> Optional[bool]:
+        """Initialize units in dependency order; a unit returning True is
+        re-queued until the set stops shrinking
+        (reference: veles/workflow.py:303-336)."""
+        with SpanTimer(self, "workflow.initialize", workflow=self.name):
+            pending = self.units_in_dependency_order()
+            while pending:
+                again: List[Unit] = []
+                for u in pending:
+                    if u.initialize(**kwargs):
+                        again.append(u)
+                if len(again) == len(pending):
+                    missing = {u.name: u.verify_demands() for u in again}
+                    raise Bug("initialization deadlock; unsatisfied demands: "
+                              "%s" % missing)
+                pending = again
+        self._initialized = True
+        return None
+
+    def run(self) -> None:
+        """Deterministic gate-driven scheduler: process units breadth-first
+        from start_point until stopped (reference hot loop:
+        veles/workflow.py:351-364 + veles/units.py:782-505, serialized)."""
+        if not self._initialized:
+            raise Bug("workflow %s run before initialize" % self.name)
+        self.stopped <<= False
+        # re-zero gate fired-flags: an interrupted previous run may have
+        # left join gates half-open
+        for u in self._units:
+            u._reset_fired()
+        t0 = time.time()
+        self.event("workflow.run", "begin", workflow=self.name)
+        queue = collections.deque([self.start_point])
+        steps = 0
+        try:
+            while queue and not bool(self.stopped):
+                unit = queue.popleft()
+                for downstream in unit.process():
+                    if bool(self.stopped):
+                        break
+                    if downstream.open_gate(unit):
+                        queue.append(downstream)
+                steps += 1
+                if self._max_steps is not None and steps > self._max_steps:
+                    raise Bug("workflow %s exceeded max_steps=%d" %
+                              (self.name, self._max_steps))
+        finally:
+            self._run_time += time.time() - t0
+            self.run_count += 1
+            self.event("workflow.run", "end", workflow=self.name, steps=steps)
+
+    def on_workflow_finished(self) -> None:
+        """Called by EndPoint (reference: veles/workflow.py:377-401)."""
+        self.stopped <<= True
+        for u in self._units:
+            u.stop()
+
+    def stop(self) -> None:
+        self.stopped <<= True
+
+    # -- results / stats / introspection ------------------------------------
+    def gather_results(self) -> Dict[str, Any]:
+        """Harvest metrics from units exposing ``get_metric_values``
+        (reference: IResultProvider, veles/workflow.py:827-849)."""
+        results: Dict[str, Any] = {}
+        for u in self._units:
+            getter = getattr(u, "get_metric_values", None)
+            if callable(getter):
+                results.update(getter())
+        return results
+
+    def print_stats(self, top: int = 10) -> List[tuple]:
+        """Top-N unit run-time table (reference: veles/workflow.py:788-825)."""
+        stats = sorted(((u.timers["run"], u.name, u.run_count)
+                        for u in self._units), reverse=True)[:top]
+        total = sum(s[0] for s in stats) or 1.0
+        for t, name, n in stats:
+            self.info("%6.2f%%  %-30s %8.3fs  ×%d", 100 * t / total, name,
+                      t, n)
+        return stats
+
+    def checksum(self) -> str:
+        """Stable identity of the workflow source (reference:
+        veles/workflow.py:852-866, used for master/slave handshake; here it
+        keys compilation/checkpoint compatibility)."""
+        try:
+            src = inspect.getsource(type(self))
+        except (OSError, TypeError):
+            src = repr(sorted(u.name for u in self._units))
+        return hashlib.sha256(src.encode()).hexdigest()
+
+    def generate_graph(self) -> str:
+        """DOT text of the control graph (reference:
+        veles/workflow.py:628-665)."""
+        lines = ["digraph %s {" % self.name.replace(" ", "_")]
+        for u in self._units:
+            lines.append('  "%s";' % u.name)
+            for v in u.links_to:
+                lines.append('  "%s" -> "%s";' % (u.name, v.name))
+        lines.append("}")
+        return "\n".join(lines)
