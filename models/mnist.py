@@ -1,0 +1,81 @@
+"""MNIST-784 fully-connected workflow — BASELINE config #1.
+
+The TPU-native rebuild of the Znicz MNIST sample (reference target: 1.48 %
+validation error, docs/source/manualrst_veles_algorithms.rst:31; topology
+784 → 100 tanh → 10 softmax, the classic Znicz mnist 784-100-10 config).
+
+Run:  python models/mnist.py [--epochs N] [--mb N] [--backend xla|numpy]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy  # noqa: E402
+
+import veles_tpu as vt  # noqa: E402
+from veles_tpu import nn, datasets  # noqa: E402
+from veles_tpu.loader import FullBatchLoader  # noqa: E402
+
+
+class MnistLoader(FullBatchLoader):
+    """60k train / 10k validation, flattened 784-vectors (reference: Znicz
+    loader_mnist, SURVEY.md §2.8)."""
+
+    hide_from_registry = True
+
+    def load_data(self):
+        tx, ty, vx, vy = datasets.load_mnist(flat=True)
+        data = numpy.concatenate([vx, tx])
+        labels = numpy.concatenate([vy, ty])
+        self.create_originals(data, labels)
+        self.class_lengths = [0, len(vx), len(tx)]
+
+
+def build_workflow(epochs=10, minibatch_size=100, lr=0.03):
+    loader = MnistLoader(None, minibatch_size=minibatch_size, name="mnist")
+    wf = nn.StandardWorkflow(
+        name="mnist-784",
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 100,
+             "learning_rate": lr},
+            {"type": "softmax", "output_sample_shape": 10,
+             "learning_rate": lr},
+        ],
+        loader_unit=loader,
+        loss_function="softmax",
+        decision_config=dict(max_epochs=epochs, fail_iterations=50),
+        lr_schedule=nn.exp_decay(0.98),
+    )
+    return wf
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--mb", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.03)
+    p.add_argument("--backend", default="auto")
+    args = p.parse_args(argv)
+
+    wf = build_workflow(args.epochs, args.mb, args.lr)
+    device = vt.Device_for(args.backend)
+    wf.initialize(device=device)
+    t0 = time.time()
+    wf.run()
+    dt = time.time() - t0
+    res = wf.gather_results()
+    served = wf.loader.samples_served
+    print("dataset: %s MNIST" %
+          ("REAL" if datasets.mnist_is_real() else "synthetic"))
+    print("best validation error: %.4f (epoch %d)" %
+          (res["best_err"], res["best_epoch"]))
+    print("throughput: %.0f samples/sec" % (served / dt))
+    return res
+
+
+if __name__ == "__main__":
+    main()

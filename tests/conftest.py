@@ -10,10 +10,16 @@ Must run before jax is imported anywhere.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("VELES_TPU_TEST", "1")
+
+# the tunnelled-TPU plugin overrides JAX_PLATFORMS at import time; pin the
+# config explicitly — this must happen before any backend is initialized
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -63,3 +63,13 @@ def test_global_root_defaults():
     from veles_tpu.config import root
     assert root.common.engine.precision_type in ("float32", "float64")
     assert "data" in root.common.mesh.axes.as_dict() or True
+
+
+def test_update_from_env_cfg_prefix(monkeypatch):
+    monkeypatch.setenv("VELES_TPU_CFG_ENGINE__FORCE_NUMPY", "true")
+    monkeypatch.setenv("VELES_TPU_TEST", "1")  # control var: ignored
+    c = Config("r")
+    c.engine.force_numpy = False
+    c.update_from_env()
+    assert c.engine.force_numpy is True
+    assert "test" not in c

@@ -70,3 +70,19 @@ def test_empty_array_falsey():
     assert not bool(a)
     with pytest.raises(Exception):
         a.device_view()
+
+
+def test_map_write_after_device_adoption_is_writeable():
+    a = Array(numpy.zeros(4, dtype=numpy.float32), name="wr")
+    a.assign_devmem(a.device_view() + 1)   # device newer
+    mem = a.map_write()
+    mem[0] = 42.0                          # must not raise read-only
+    numpy.testing.assert_allclose(numpy.asarray(a.device_view())[0], 42.0)
+
+
+def test_device_view_dtype_staleness():
+    a = Array(numpy.ones(3, dtype=numpy.float32), name="dt")
+    d1 = a.device_view(dtype="bfloat16")
+    assert str(d1.dtype) == "bfloat16"
+    d2 = a.device_view(dtype="float32")
+    assert str(d2.dtype) == "float32"

@@ -1,0 +1,166 @@
+"""Forward-unit correctness: XLA result vs numpy oracle — the reference's
+@multi_device pattern (veles/tests/accelerated_test.py:41-61) adapted: each
+unit's jitted apply() must agree with its numpy_apply()."""
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn
+from veles_tpu.memory import Array
+
+
+@pytest.fixture(autouse=True)
+def f32_compute():
+    """Oracle agreement is an exactness check: pin float32 compute; bf16
+    (the TPU production dtype) gets its own loose-tolerance test."""
+    prev = vt.root.common.engine.compute_dtype
+    vt.root.common.engine.compute_dtype = "float32"
+    yield
+    vt.root.common.engine.compute_dtype = prev
+
+
+def run_both(unit_cls, input_shape, seed=3, rtol=1e-4, atol=1e-5, **kwargs):
+    wf = vt.Workflow(name="t")
+    u = unit_cls(wf, **kwargs)
+    rng = numpy.random.RandomState(seed)
+    x = rng.randn(*input_shape).astype(numpy.float32)
+    u.input = Array(x, name="x")
+    u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    # xla path
+    u.xla_run()
+    y_xla = numpy.asarray(u.output.map_read(), dtype=numpy.float32)
+    # oracle
+    y_np = u.numpy_apply(u.params_np(), x).astype(numpy.float32)
+    assert y_xla.shape == tuple(u.output_shape_for(x.shape))
+    numpy.testing.assert_allclose(y_xla, y_np, rtol=rtol, atol=atol)
+    return u, y_np
+
+
+def test_all2all_linear():
+    run_both(nn.All2All, (8, 12), output_sample_shape=7)
+
+
+def test_all2all_tanh():
+    run_both(nn.All2AllTanh, (8, 12), output_sample_shape=(5,))
+
+
+def test_all2all_relu():
+    run_both(nn.All2AllRelu, (4, 6), output_sample_shape=3)
+
+
+def test_all2all_sigmoid():
+    run_both(nn.All2AllSigmoid, (4, 6), output_sample_shape=3)
+
+
+def test_all2all_softmax():
+    u, y = run_both(nn.All2AllSoftmax, (6, 10), output_sample_shape=4)
+    numpy.testing.assert_allclose(y.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_all2all_flattens_nd_input():
+    run_both(nn.All2All, (5, 4, 3, 2), output_sample_shape=6)
+
+
+def test_conv_basic():
+    run_both(nn.Conv, (2, 8, 8, 3), n_kernels=4, kx=3, ky=3,
+             rtol=2e-2, atol=2e-3)
+
+
+def test_bf16_compute_path():
+    vt.root.common.engine.compute_dtype = "bfloat16"
+    try:
+        run_both(nn.All2All, (8, 12), output_sample_shape=7,
+                 rtol=3e-2, atol=3e-2)
+    finally:
+        vt.root.common.engine.compute_dtype = "float32"
+
+
+def test_conv_stride_padding():
+    run_both(nn.Conv, (2, 9, 9, 2), n_kernels=3, kx=3, ky=3,
+             sliding=(2, 2), padding=(1, 1, 1, 1), rtol=2e-2, atol=2e-3)
+
+
+def test_conv_tanh():
+    run_both(nn.ConvTanh, (2, 6, 6, 2), n_kernels=2, kx=3, ky=3,
+             rtol=2e-2, atol=2e-3)
+
+
+def test_max_pooling():
+    run_both(nn.MaxPooling, (2, 8, 8, 3), kx=2, ky=2)
+
+
+def test_max_pooling_ceil_mode():
+    # 7x7 with 2x2/stride2 → ceil → 4x4, edge windows partial
+    run_both(nn.MaxPooling, (2, 7, 7, 2), kx=2, ky=2)
+
+
+def test_avg_pooling():
+    run_both(nn.AvgPooling, (2, 8, 8, 3), kx=2, ky=2)
+
+
+def test_avg_pooling_ceil_mode():
+    run_both(nn.AvgPooling, (1, 5, 5, 1), kx=2, ky=2)
+
+
+def test_deconv():
+    run_both(nn.Deconv, (2, 4, 4, 3), n_channels=2, kx=3, ky=3,
+             rtol=2e-2, atol=2e-3)
+
+
+def test_deconv_stride():
+    run_both(nn.Deconv, (1, 3, 3, 2), n_channels=1, kx=2, ky=2,
+             sliding=(2, 2), rtol=2e-2, atol=2e-3)
+
+
+def test_depooling():
+    run_both(nn.Depooling, (2, 3, 3, 4), kx=2, ky=2)
+
+
+def test_activations():
+    for cls in (nn.ForwardTanh, nn.ForwardRelu, nn.ForwardStrictRelu,
+                nn.ForwardSigmoid, nn.ForwardLog):
+        run_both(cls, (4, 7))
+
+
+def test_activation_mul():
+    run_both(nn.ForwardMul, (3, 5), factor=2.5)
+
+
+def test_lrn():
+    run_both(nn.LRNormalizerForward, (2, 4, 4, 8), rtol=1e-3)
+
+
+def test_dropout_eval_identity():
+    u, y = run_both(nn.DropoutForward, (4, 9), dropout_ratio=0.5)
+    # eval mode: identity
+
+
+def test_dropout_train_masks():
+    import jax
+    wf = vt.Workflow(name="t")
+    u = nn.DropoutForward(wf, dropout_ratio=0.5)
+    x = numpy.ones((100, 50), dtype=numpy.float32)
+    y = numpy.asarray(u.apply({}, x, train=True,
+                              rng=jax.random.key(0)))
+    kept = (y > 0).mean()
+    assert 0.3 < kept < 0.7          # ~50% kept
+    numpy.testing.assert_allclose(y[y > 0], 2.0, rtol=1e-5)  # 1/keep scale
+
+
+def test_gd_unit_standalone_updates_weights():
+    """GradientDescentBase.run: vjp backward + SGD update moves weights."""
+    wf = vt.Workflow(name="t")
+    fwd = nn.All2All(wf, output_sample_shape=3, name="fc")
+    x = numpy.random.RandomState(0).randn(4, 5).astype(numpy.float32)
+    fwd.input = Array(x, name="x")
+    dev = vt.XLADevice(mesh_axes={"data": 1})
+    fwd.initialize(device=dev)
+    w_before = fwd.weights.map_read().copy()
+    gd = nn.nn_units.MATCHING[nn.All2All](wf, learning_rate=0.1)
+    gd.forward = fwd
+    gd.initialize(device=dev)
+    gd.err_output = Array(numpy.ones((4, 3), dtype=numpy.float32))
+    gd.xla_run()
+    w_after = fwd.weights.map_read()
+    assert not numpy.allclose(w_before, w_after)
+    assert gd.err_input.shape == (4, 5)

@@ -1,0 +1,110 @@
+"""End-to-end training through StandardWorkflow: the full fused-step loop
+(Repeater → Loader → TrainStep → Decision) must converge on synthetic
+separable data. Mirrors the reference's model-convergence tests (the Znicz
+MNIST regression tests, SURVEY.md §4)."""
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn
+from veles_tpu.loader import FullBatchLoader, TRAIN, VALID, TEST
+
+
+class BlobsLoader(FullBatchLoader):
+    """3-class Gaussian blobs: 600 train / 150 valid / 90 test."""
+
+    hide_from_registry = True
+
+    def load_data(self):
+        rng = numpy.random.RandomState(7)
+        n_per, d, k = 280, 10, 3
+        centers = rng.randn(k, d) * 3
+        data, labels = [], []
+        for c in range(k):
+            data.append(centers[c] + rng.randn(n_per, d))
+            labels.append(numpy.full(n_per, c))
+        data = numpy.concatenate(data).astype(numpy.float32)
+        labels = numpy.concatenate(labels).astype(numpy.int32)
+        perm = rng.permutation(len(data))
+        data, labels = data[perm], labels[perm]
+        self.create_originals(data, labels)
+        self.class_lengths = [90, 150, 600]
+
+
+def make_workflow(minibatch_size=50, **decision_kw):
+    loader = BlobsLoader(None, minibatch_size=minibatch_size, name="blobs")
+    wf = nn.StandardWorkflow(
+        name="blobs-train",
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 16},
+            {"type": "softmax", "output_sample_shape": 3},
+        ],
+        loader_unit=loader,
+        loss_function="softmax",
+        decision_config=dict(max_epochs=12, fail_iterations=50,
+                             **decision_kw),
+    )
+    return wf
+
+
+def test_training_converges():
+    wf = make_workflow()
+    dev = vt.XLADevice(mesh_axes={"data": 1})
+    wf.initialize(device=dev)
+    wf.run()
+    assert bool(wf.stopped)
+    d = wf.decision
+    assert d.epoch_number == 12
+    # separable blobs: validation error should collapse under 5%
+    assert d.best_metric is not None
+    assert d.best_metric < 0.05, d.epoch_metrics
+    # all three sets were evaluated
+    for s in (TEST, VALID, TRAIN):
+        assert len(d.epoch_metrics[s]) == 12
+
+
+def test_metrics_and_results():
+    wf = make_workflow()
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    res = wf.gather_results()
+    assert "best_err" in res and res["best_err"] < 0.05
+    assert res["epochs"] == 12
+
+
+def test_trained_params_reach_arrays():
+    """After stop, TrainStep must sync device params back into the forward
+    units' Arrays (snapshot coherence)."""
+    wf = make_workflow()
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    w_before = wf.forwards[0].weights.map_read().copy()
+    wf.run()
+    w_after = wf.forwards[0].weights.map_read()
+    assert not numpy.allclose(w_before, w_after)
+
+
+def test_data_parallel_8dev_matches_semantics():
+    """Same workflow on an 8-device data mesh: XLA SPMD partitioning of the
+    fused step (batch sharded over 'data') must still converge — the psum
+    equivalent of the reference's master-slave averaging."""
+    wf = make_workflow(minibatch_size=48)
+    dev = vt.XLADevice(mesh_axes={"data": 8})
+    assert dev.mesh.devices.size == 8
+    wf.initialize(device=dev)
+    step = wf.train_step
+    assert step._shardings is not None
+    wf.run()
+    assert wf.decision.best_metric < 0.05
+    # params replicated over all 8 devices; minibatch indices sharded
+    w = step.params[wf.forwards[0].name]["weights"]
+    assert len(w.sharding.device_set) == 8
+    idx = wf.loader.minibatch_indices.devmem
+    assert len(idx.sharding.device_set) == 8
+    assert not idx.sharding.is_fully_replicated
+
+
+def test_data_parallel_requires_divisible_minibatch():
+    wf = make_workflow(minibatch_size=50)
+    dev = vt.XLADevice(mesh_axes={"data": 8})
+    with pytest.raises(vt.Bug):
+        wf.initialize(device=dev)

@@ -146,9 +146,17 @@ def make_mesh(devices, axes: Dict[str, int]):
         sizes[wild[0]] = total // fixed
     shape = tuple(sizes.values()) or (total,)
     names = tuple(sizes.keys()) or ("data",)
-    if int(np.prod(shape)) != total:
-        raise VelesError("mesh %s != %d devices" % (sizes, total))
-    return Mesh(np.asarray(devices).reshape(shape), names)
+    need = int(np.prod(shape))
+    if need > total:
+        raise VelesError("mesh %s needs %d devices, only %d present" %
+                         (sizes, need, total))
+    # a submesh over the first N devices is allowed, but never silently
+    if need < total:
+        import logging
+        logging.getLogger("make_mesh").warning(
+            "mesh %s uses %d of %d devices; %d idle", sizes, need, total,
+            total - need)
+    return Mesh(np.asarray(devices[:need]).reshape(shape), names)
 
 
 _auto_device: Optional[Device] = None
@@ -159,10 +167,10 @@ def Device_for(backend: Optional[str] = None) -> Device:
     dispatch on -a/--backend or VELES_BACKEND, veles/backends.py:184-243)."""
     backend = (backend or os.environ.get("VELES_BACKEND") or
                root.common.engine.backend)
-    if backend in ("auto", None):
-        return AutoDevice()
     if backend == "numpy" or root.common.engine.force_numpy:
         return NumpyDevice()
+    if backend in ("auto", None):
+        return AutoDevice()
     if backend in ("xla", "tpu", "cpu", "gpu", "axon"):
         platform = None if backend == "xla" else backend
         if platform == "tpu":

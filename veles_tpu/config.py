@@ -89,10 +89,12 @@ class Config:
             runpy.run_path(path, init_globals={"root": self})
         return self
 
-    def update_from_env(self, prefix: str = "VELES_TPU_") -> "Config":
-        """``VELES_TPU_ENGINE__FORCE_NUMPY=true`` → engine.force_numpy.
+    def update_from_env(self, prefix: str = "VELES_TPU_CFG_") -> "Config":
+        """``VELES_TPU_CFG_ENGINE__FORCE_NUMPY=true`` → engine.force_numpy.
         Path components are separated by a DOUBLE underscore so config keys
-        containing single underscores survive."""
+        containing single underscores survive; the CFG_ prefix keeps
+        non-config control variables (VELES_TPU_TEST, ...) out of the
+        tree."""
         for key, val in os.environ.items():
             if not key.startswith(prefix):
                 continue
@@ -156,13 +158,14 @@ def _default_root() -> Config:
         "disable": {"plotting": bool(os.environ.get("VELES_TPU_TEST"))},
         "random_seed": 1234,
     })
-    r.common.update_from_env()
-    # layered site/user overrides (reference: veles/config.py:294-308)
+    # layered overrides, weakest first (reference: veles/config.py:294-308):
+    # site file < user file < CWD file < environment
     for site in ("/etc/veles_tpu.json",
                  os.path.expanduser("~/.veles_tpu.json"),
                  os.path.join(os.getcwd(), ".veles_tpu.json")):
         if os.path.exists(site):
             r.update_from_file(site)
+    r.common.update_from_env()
     return r
 
 

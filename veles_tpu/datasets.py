@@ -1,0 +1,153 @@
+"""Dataset acquisition for the bundled model zoo.
+
+The reference's Downloader unit fetched datasets over HTTP at initialize
+time (veles/downloader.py:56). This environment has no egress, so each
+loader here: (1) looks for the real dataset in the canonical cache
+locations (keras/torchvision layouts + root.common.dirs.datasets), and
+(2) otherwise synthesizes a deterministic surrogate with identical shapes,
+dtypes and class structure — so every workflow, test and benchmark runs
+end-to-end anywhere; throughput numbers are shape-dependent only.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy
+
+from .config import root
+from . import prng
+
+Arrays = Tuple[numpy.ndarray, numpy.ndarray, numpy.ndarray, numpy.ndarray]
+
+
+def _dataset_dirs():
+    yield root.common.dirs.datasets
+    yield os.path.expanduser("~/.keras/datasets")
+    yield os.path.expanduser("~/data")
+    yield "/root/.veles_tpu/datasets"
+
+
+def _find(*names: str) -> Optional[str]:
+    for d in _dataset_dirs():
+        for n in names:
+            p = os.path.join(d, n)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def _read_idx(path: str) -> numpy.ndarray:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        magic = struct.unpack(">I", f.read(4))[0]
+        ndim = magic & 0xFF
+        shape = struct.unpack(">" + "I" * ndim, f.read(4 * ndim))
+        return numpy.frombuffer(f.read(), dtype=numpy.uint8).reshape(shape)
+
+
+def load_mnist(flat: bool = True) -> Arrays:
+    """(train_x, train_y, test_x, test_y); x float32 in [0,1),
+    shape (N, 784) or (N, 28, 28, 1)."""
+    npz = _find("mnist.npz")
+    if npz is not None:
+        with numpy.load(npz) as d:
+            tx, ty = d["x_train"], d["y_train"]
+            vx, vy = d["x_test"], d["y_test"]
+    else:
+        idx = _find("train-images-idx3-ubyte.gz", "train-images-idx3-ubyte")
+        if idx is not None:
+            base = os.path.dirname(idx)
+
+            def g(n):
+                p = os.path.join(base, n + ".gz")
+                return _read_idx(p if os.path.exists(p)
+                                 else os.path.join(base, n))
+            tx = g("train-images-idx3-ubyte")
+            ty = g("train-labels-idx1-ubyte")
+            vx = g("t10k-images-idx3-ubyte")
+            vy = g("t10k-labels-idx1-ubyte")
+        else:
+            return _synthetic_images((28, 28), 10, 60000, 10000, flat,
+                                     key="mnist")
+    tx = tx.astype(numpy.float32) / 255.0
+    vx = vx.astype(numpy.float32) / 255.0
+    if flat:
+        tx, vx = tx.reshape(len(tx), -1), vx.reshape(len(vx), -1)
+    else:
+        tx, vx = tx[..., None], vx[..., None]
+    return tx, ty.astype(numpy.int32), vx, vy.astype(numpy.int32)
+
+
+def load_cifar10(n_train: int = 50000, n_test: int = 10000) -> Arrays:
+    """(train_x, train_y, test_x, test_y); x float32 NHWC (N,32,32,3)."""
+    d = _find("cifar-10-batches-py")
+    if d is not None:
+        import pickle
+        xs, ys = [], []
+        for i in range(1, 6):
+            with open(os.path.join(d, "data_batch_%d" % i), "rb") as f:
+                b = pickle.load(f, encoding="bytes")
+            xs.append(b[b"data"])
+            ys.extend(b[b"labels"])
+        tx = numpy.concatenate(xs)
+        ty = numpy.asarray(ys, dtype=numpy.int32)
+        with open(os.path.join(d, "test_batch"), "rb") as f:
+            b = pickle.load(f, encoding="bytes")
+        vx = numpy.asarray(b[b"data"])
+        vy = numpy.asarray(b[b"labels"], dtype=numpy.int32)
+
+        def fmt(x):
+            return (x.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+                    .astype(numpy.float32) / 255.0)
+        return fmt(tx), ty, fmt(vx), vy
+    return _synthetic_images((32, 32, 3), 10, n_train, n_test, flat=False,
+                             key="cifar10")
+
+
+def _synthetic_images(sample_shape, n_classes, n_train, n_test, flat,
+                      key="synth") -> Arrays:
+    """Deterministic class-structured surrogate: each class is a smooth
+    random template + per-sample noise, so simple models genuinely learn
+    (error decreases) and shapes/throughput match the real dataset."""
+    rng = numpy.random.RandomState(
+        prng.RandomGenerator(key, seed=20260101).initial_seed)
+    if len(sample_shape) == 2:
+        full_shape = sample_shape + (1,)
+    else:
+        full_shape = sample_shape
+    templates = rng.rand(n_classes, *full_shape).astype(numpy.float32)
+    # smooth the templates a little so convs have structure to find
+    for _ in range(2):
+        templates = (templates +
+                     numpy.roll(templates, 1, axis=1) +
+                     numpy.roll(templates, 1, axis=2)) / 3.0
+
+    def make(n, seed):
+        r = numpy.random.RandomState(seed)
+        y = r.randint(0, n_classes, n).astype(numpy.int32)
+        x = templates[y] * 0.7 + 0.3 * r.rand(n, *full_shape).astype(
+            numpy.float32)
+        return x.astype(numpy.float32), y
+
+    tx, ty = make(n_train, 1)
+    vx, vy = make(n_test, 2)
+    if len(sample_shape) == 2:
+        tx, vx = tx[..., 0], vx[..., 0]
+        if flat:
+            tx, vx = tx.reshape(n_train, -1), vx.reshape(n_test, -1)
+        else:
+            tx, vx = tx[..., None], vx[..., None]
+    return tx, ty, vx, vy
+
+
+def mnist_is_real() -> bool:
+    return _find("mnist.npz", "train-images-idx3-ubyte.gz",
+                 "train-images-idx3-ubyte") is not None
+
+
+def cifar10_is_real() -> bool:
+    return _find("cifar-10-batches-py") is not None

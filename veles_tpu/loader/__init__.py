@@ -1,0 +1,14 @@
+"""Data loading: the minibatch-serving unit family.
+
+Equivalent of the reference's veles/loader/ package (SURVEY.md §2.3): a
+Loader walks three sample sets (TEST/VALIDATION/TRAIN) in epochs, shuffles
+the train set, pads tail minibatches to a static size (mask-valid), and
+hands minibatches to the compute graph. TPU-first: datasets that fit in HBM
+live there as jax Arrays and minibatch gather happens inside the jitted
+step (the fullbatch_loader.cl equivalent); bigger datasets stream from host
+with double-buffered device transfer.
+"""
+
+from .base import (Loader, LoaderMSE, TEST, VALID, TRAIN,
+                   CLASS_NAMES)                        # noqa: F401
+from .fullbatch import FullBatchLoader, FullBatchLoaderMSE  # noqa: F401

@@ -1,0 +1,192 @@
+"""Loader base: the minibatch-serving contract.
+
+Equivalent of the reference's veles/loader/base.py:72-1181 (``Loader``):
+three sample sets served per epoch in the fixed order TEST → VALIDATION →
+TRAIN, per-epoch train shuffling, label statistics, epoch/end flags, and
+static-size minibatches (the reference zero-padded short tails,
+veles/loader/base.py:749-753 — here padding comes with a validity mask so
+jitted steps keep static shapes and padded samples are inert).
+
+The reference's distributed index-serving plane (master sends indices,
+slave fills data locally, :631-663) is superseded by SPMD: every host runs
+the same loader with the same seed and takes its shard of each minibatch
+(see parallel/). ``failed_minibatches`` re-serving maps to checkpoint
+restart."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy
+
+from ..error import NoMoreJobs
+from ..memory import Array
+from ..mutable import Bool
+from ..units import Unit
+from .. import prng
+
+TEST, VALID, TRAIN = 0, 1, 2
+CLASS_NAMES = ("test", "validation", "train")
+
+
+class Loader(Unit):
+    """Minibatch server (reference: veles/loader/base.py:120)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, minibatch_size=100, shuffle_limit=None,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "LOADER"
+        self.max_minibatch_size = int(minibatch_size)
+        #: samples per class: [test, validation, train]
+        self.class_lengths: List[int] = [0, 0, 0]
+        self.epoch_number = 0
+        #: unlimited shuffles by default (reference shuffle_limit)
+        self.shuffle_limit = (numpy.inf if shuffle_limit is None
+                              else shuffle_limit)
+        # flags (reference :862-878)
+        self.epoch_ended = Bool(False)
+        self.last_minibatch = Bool(False)
+        self.train_ended = Bool(False)
+        self.test_ended = Bool(False)
+        # per-minibatch outputs
+        self.minibatch_data = Array(name=self.name + ".minibatch_data")
+        self.minibatch_labels = Array(name=self.name + ".minibatch_labels")
+        self.minibatch_indices = Array(name=self.name + ".minibatch_indices")
+        self.minibatch_mask = Array(name=self.name + ".minibatch_mask")
+        self.minibatch_class = TRAIN
+        self.minibatch_size = 0          # valid samples in this minibatch
+        self.minibatch_offset = 0
+        self._global_offset = 0
+        self._shuffled_indices: Optional[numpy.ndarray] = None
+        self.samples_served = 0
+        # label bookkeeping (reference label mapping/stats :120-…)
+        self.labels_mapping: Dict[object, int] = {}
+        self.prng = prng.get(self.name)
+
+    # -- subclass contract ---------------------------------------------------
+    def load_data(self) -> None:
+        """Populate class_lengths (+ dataset storage). Called at init."""
+        raise NotImplementedError
+
+    def create_minibatch_data(self) -> None:
+        """Allocate minibatch_data/labels arrays with static shapes."""
+        raise NotImplementedError
+
+    def fill_minibatch(self) -> None:
+        """Copy samples minibatch_indices → minibatch_data/labels."""
+        raise NotImplementedError
+
+    # -- derived geometry ----------------------------------------------------
+    @property
+    def total_samples(self) -> int:
+        return int(sum(self.class_lengths))
+
+    @property
+    def class_end_offsets(self) -> List[int]:
+        ends, acc = [], 0
+        for n in self.class_lengths:
+            acc += n
+            ends.append(acc)
+        return ends
+
+    def class_of_offset(self, offset: int) -> int:
+        for idx, end in enumerate(self.class_end_offsets):
+            if offset < end:
+                return idx
+        raise NoMoreJobs("offset %d beyond %d samples" %
+                         (offset, self.total_samples))
+
+    # -- lifecycle -----------------------------------------------------------
+    def initialize(self, **kwargs):
+        res = super().initialize(**kwargs)
+        if res:
+            return res
+        self.load_data()
+        if self.total_samples == 0:
+            raise NoMoreJobs("loader %s has no samples" % self.name)
+        self._shuffled_indices = numpy.arange(self.total_samples,
+                                              dtype=numpy.int32)
+        self.shuffle()
+        self.create_minibatch_data()
+        n = self.max_minibatch_size
+        self.minibatch_indices.reset(numpy.zeros(n, dtype=numpy.int32))
+        self.minibatch_mask.reset(numpy.zeros(n, dtype=numpy.float32))
+        self.info(
+            "%s: %d samples (test=%d validation=%d train=%d), mb=%d",
+            self.name, self.total_samples, *self.class_lengths, n)
+        return None
+
+    def shuffle(self) -> None:
+        """Shuffle ONLY the train tail (reference: veles/loader/base.py
+        shuffles train indices each epoch)."""
+        if self.class_lengths[TRAIN] == 0:
+            return
+        if self.epoch_number > self.shuffle_limit:
+            return
+        start = self.class_end_offsets[VALID]
+        train = self._shuffled_indices[start:]
+        self.prng.shuffle(train)
+
+    # -- the serving loop ----------------------------------------------------
+    def run(self) -> None:
+        self.serve_next_minibatch()
+
+    def serve_next_minibatch(self) -> None:
+        """(reference: veles/loader/base.py:726)"""
+        if bool(self.epoch_ended):
+            # previous run ended the epoch: start a new one
+            self.epoch_number += 1
+            self._global_offset = 0
+            self.shuffle()
+        self.epoch_ended <<= False
+        self.last_minibatch <<= False
+        self.train_ended <<= False
+        self.test_ended <<= False
+
+        offset = self._global_offset
+        cls = self.class_of_offset(offset)
+        end_of_class = self.class_end_offsets[cls]
+        size = min(self.max_minibatch_size, end_of_class - offset)
+        self.minibatch_offset = offset
+        self.minibatch_class = cls
+        self.minibatch_size = size
+
+        idx = self.minibatch_indices.map_invalidate()
+        idx[:size] = self._shuffled_indices[offset:offset + size]
+        idx[size:] = idx[size - 1] if size else 0   # pad with a valid index
+        mask = self.minibatch_mask.map_invalidate()
+        mask[:size] = 1.0
+        mask[size:] = 0.0
+
+        self.fill_minibatch()
+        self.samples_served += size
+        self._global_offset = offset + size
+
+        # flags (reference :862-878)
+        if self._global_offset >= self.class_end_offsets[cls]:
+            if cls == TEST:
+                self.test_ended <<= True
+            if cls == TRAIN:
+                self.train_ended <<= True
+        if self._global_offset >= self.total_samples:
+            self.last_minibatch <<= True
+            self.epoch_ended <<= True
+            self.event("epoch", "single", number=self.epoch_number)
+
+    # -- introspection -------------------------------------------------------
+    def get_metric_values(self) -> Dict[str, object]:
+        return {"epochs_served": self.epoch_number,
+                "samples_served": self.samples_served}
+
+
+class LoaderMSE(Loader):
+    """Loader with regression targets instead of integer labels
+    (reference: veles/loader/base.py:1149)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.minibatch_targets = Array(name=self.name + ".minibatch_targets")

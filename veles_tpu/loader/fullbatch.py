@@ -1,0 +1,111 @@
+"""Full-batch loaders: whole dataset in memory (and HBM when it fits).
+
+Equivalent of the reference's veles/loader/fullbatch.py:79-566
+(FullBatchLoader + FullBatchLoaderMSE with the GPU ``fill_minibatch``
+kernel, ocl/fullbatch_loader.cl). TPU-native: the dataset is placed once as
+a device jax.Array and minibatch gather (``jnp.take``) happens on device —
+inside the fused train step when one is attached (zero host↔device traffic
+per step), or standalone in ``fill_minibatch``. Falls back to host storage
+when the dataset exceeds the HBM budget (reference OOM fallback,
+veles/loader/fullbatch.py:170-187)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy
+
+from ..config import root
+from ..memory import Array
+from .base import Loader, LoaderMSE, TEST, VALID, TRAIN
+
+
+class FullBatchLoader(Loader):
+    """Subclasses fill ``original_data``/``original_labels`` in load_data
+    (reference: create_originals, veles/loader/fullbatch.py:278)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, on_device=True, validation_ratio=None,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.original_data = Array(name=self.name + ".original_data")
+        self.original_labels = Array(name=self.name + ".original_labels")
+        self.on_device = on_device
+        self.validation_ratio = validation_ratio
+
+    # -- helpers for subclasses ---------------------------------------------
+    def create_originals(self, data: numpy.ndarray,
+                         labels: Optional[numpy.ndarray] = None) -> None:
+        dtype = root.common.engine.precision_type
+        self.original_data.reset(numpy.ascontiguousarray(data, dtype=dtype))
+        if labels is not None:
+            self.original_labels.reset(
+                numpy.ascontiguousarray(labels, dtype=numpy.int32))
+
+    def resize_validation(self, ratio: float) -> None:
+        """Carve a validation set out of the train set tail
+        (reference: _resize_validation, veles/loader/fullbatch.py:349)."""
+        n_train = self.class_lengths[TRAIN]
+        n_valid = int(n_train * ratio)
+        self.class_lengths[VALID] += n_valid
+        self.class_lengths[TRAIN] -= n_valid
+
+    # -- loader contract -----------------------------------------------------
+    def create_minibatch_data(self) -> None:
+        n = self.max_minibatch_size
+        shape = (n,) + self.original_data.shape[1:]
+        self.minibatch_data.reset(
+            numpy.zeros(shape, dtype=self.original_data.dtype))
+        if self.original_labels:
+            self.minibatch_labels.reset(numpy.zeros(n, dtype=numpy.int32))
+
+    def fill_minibatch(self) -> None:
+        idx = self.minibatch_indices.mem
+        data = self.minibatch_data.map_invalidate()
+        data[...] = self.original_data.mem[idx]
+        if self.original_labels:
+            labels = self.minibatch_labels.map_invalidate()
+            labels[...] = self.original_labels.mem[idx]
+
+    # -- device-resident dataset for fused steps ----------------------------
+    def dataset_device_views(self):
+        """(data, labels) device arrays for in-step gather (the
+        fullbatch_loader.cl equivalent)."""
+        data = self.original_data.device_view()
+        labels = (self.original_labels.device_view()
+                  if self.original_labels else None)
+        return data, labels
+
+
+class FullBatchLoaderMSE(FullBatchLoader, LoaderMSE):
+    """Full-batch loader with regression targets
+    (reference: veles/loader/fullbatch.py:563)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.original_targets = Array(name=self.name + ".original_targets")
+
+    def create_originals(self, data, labels=None, targets=None):
+        super().create_originals(data, labels)
+        if targets is not None:
+            dtype = root.common.engine.precision_type
+            self.original_targets.reset(
+                numpy.ascontiguousarray(targets, dtype=dtype))
+
+    def create_minibatch_data(self) -> None:
+        super().create_minibatch_data()
+        if self.original_targets:
+            n = self.max_minibatch_size
+            shape = (n,) + self.original_targets.shape[1:]
+            self.minibatch_targets.reset(
+                numpy.zeros(shape, dtype=self.original_targets.dtype))
+
+    def fill_minibatch(self) -> None:
+        super().fill_minibatch()
+        if self.original_targets:
+            idx = self.minibatch_indices.mem
+            t = self.minibatch_targets.map_invalidate()
+            t[...] = self.original_targets.mem[idx]

@@ -23,6 +23,7 @@ _event_lock = threading.Lock()
 _event_ring: Deque[Dict[str, Any]] = collections.deque(maxlen=65536)
 _event_file = None
 _event_path: Optional[str] = None
+_file_handler: Optional[logging.FileHandler] = None
 
 
 def setup_logging(level: int = logging.INFO, logfile: Optional[str] = None,
@@ -30,17 +31,23 @@ def setup_logging(level: int = logging.INFO, logfile: Optional[str] = None,
     """Configure root logging (reference: Logger.setup_logging,
     veles/logger.py:107-151) and optionally an event-trace JSONL sink
     (reference duplicated events to Mongo, veles/logger.py:210-213)."""
-    global _event_file, _event_path
+    global _event_file, _event_path, _file_handler
     fmt = "%(asctime)s %(levelname).1s %(name)s: %(message)s"
     logging.basicConfig(level=level, format=fmt)
     if logfile:
-        handler = logging.FileHandler(logfile)
-        handler.setFormatter(logging.Formatter(fmt))
-        logging.getLogger().addHandler(handler)
+        if _file_handler is not None:
+            logging.getLogger().removeHandler(_file_handler)
+            _file_handler.close()
+        _file_handler = logging.FileHandler(logfile)
+        _file_handler.setFormatter(logging.Formatter(fmt))
+        logging.getLogger().addHandler(_file_handler)
     if tracefile and tracefile != _event_path:
         os.makedirs(os.path.dirname(tracefile) or ".", exist_ok=True)
-        _event_file = open(tracefile, "a")
-        _event_path = tracefile
+        with _event_lock:
+            if _event_file is not None:
+                _event_file.close()
+            _event_file = open(tracefile, "a")
+            _event_path = tracefile
 
 
 def events(name: Optional[str] = None):

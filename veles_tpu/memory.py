@@ -121,15 +121,18 @@ class Array(Logger):
     def map_read(self) -> numpy.ndarray:
         with self._lock:
             if self._dev_newer:
-                self.mem = numpy.asarray(self.devmem).astype(
-                    self.mem.dtype if self.mem is not None else None,
-                    copy=False) if self.mem is not None else numpy.asarray(
-                        self.devmem)
+                host = numpy.asarray(self.devmem)  # may be a read-only view
+                if self.mem is not None and host.dtype != self.mem.dtype:
+                    host = host.astype(self.mem.dtype)
+                self.mem = host
                 self._dev_newer = False
             return self.mem
 
     def map_write(self) -> numpy.ndarray:
         mem = self.map_read()
+        if mem is not None and not mem.flags.writeable:
+            # device→host adoption yields read-only views; writers get a copy
+            mem = self.mem = mem.copy()
         self._host_newer = True
         return mem
 
@@ -154,10 +157,19 @@ class Array(Logger):
             self._host_newer = False
 
     def device_view(self, device=None, sharding=None, dtype=None):
-        """The jax.Array for compute, pushing host data if it is newer."""
+        """The jax.Array for compute, pushing host data if it is newer (or
+        cached under a different sharding)."""
         import jax
         with self._lock:
-            if self.devmem is None or self._host_newer:
+            stale = (
+                self.devmem is not None
+                and ((sharding is not None and getattr(
+                    self.devmem, "sharding", None) != sharding)
+                     or (dtype is not None
+                         and self.devmem.dtype != numpy.dtype(dtype))))
+            if stale and self._dev_newer:
+                self.map_read()  # pull newest to host before re-placing
+            if self.devmem is None or self._host_newer or stale:
                 if self.mem is None:
                     raise Bug("Array %s: device_view before reset" %
                               self.name)

@@ -1,0 +1,29 @@
+"""Neural-network unit library — the Znicz-equivalent layer set.
+
+The reference's NN plugin (veles/znicz submodule, absent from the checkout;
+surface reconstructed in SURVEY.md §2.8) provided forward units paired with
+gradient-descent backward units, evaluators, decision logic and a
+StandardWorkflow graph builder. This package re-implements that capability
+TPU-first: every forward unit declares a *pure* ``apply(params, x)``
+function; backward passes come from ``jax.grad`` of the composed
+forward+loss instead of hand-written per-layer backward kernels, and the
+whole forward/backward/update for a minibatch fuses into one jitted SPMD
+step (see train_step.py).
+"""
+
+from .nn_units import ForwardBase, GradientDescentBase, MATCHING  # noqa
+from .all2all import (All2All, All2AllTanh, All2AllRelu,
+                      All2AllSigmoid, All2AllSoftmax)  # noqa
+from .activation import (ForwardTanh, ForwardRelu, ForwardStrictRelu,
+                         ForwardSigmoid, ForwardLog, ForwardMul)  # noqa
+from .conv import Conv, ConvTanh, ConvRelu, ConvSigmoid  # noqa
+from .pooling import MaxPooling, AvgPooling, StochasticPooling  # noqa
+from .deconv import Deconv  # noqa
+from .depooling import Depooling  # noqa
+from .dropout import DropoutForward  # noqa
+from .normalization import LRNormalizerForward  # noqa
+from .evaluator import EvaluatorSoftmax, EvaluatorMSE  # noqa
+from .decision import DecisionGD, DecisionMSE  # noqa
+from .lr_adjust import LearningRateAdjust, step_exp, inv, exp_decay  # noqa
+from .train_step import TrainStep  # noqa
+from .standard_workflow import StandardWorkflow  # noqa

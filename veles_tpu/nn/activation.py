@@ -1,0 +1,100 @@
+"""Standalone activation units (Znicz ``activation`` module; reference
+surface SURVEY.md §2.8 — layer types like "activation_tanh",
+"activation_str"). Parameterless ForwardBase subclasses; in fused training
+they melt into the surrounding XLA fusion for free."""
+
+from __future__ import annotations
+
+import numpy
+
+from .nn_units import ForwardBase
+
+
+class ActivationForward(ForwardBase):
+    hide_from_registry = True
+
+    def output_shape_for(self, input_shape):
+        return input_shape
+
+
+class ForwardTanh(ActivationForward):
+    MAPPING = "activation_tanh"
+    hide_from_registry = False
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax.numpy as jnp
+        return jnp.tanh(x)
+
+    def numpy_apply(self, params, x):
+        return numpy.tanh(x)
+
+
+class ForwardRelu(ActivationForward):
+    """Znicz RELU unit: y = log(1 + exp(x)) (softplus), per the reference's
+    docs naming — the hard max(x,0) variant is ForwardStrictRelu."""
+
+    MAPPING = "activation_relu"
+    hide_from_registry = False
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax
+        return jax.nn.softplus(x)
+
+    def numpy_apply(self, params, x):
+        return numpy.log1p(numpy.exp(numpy.minimum(x, 50))) + \
+            numpy.maximum(x, 0) * (x > 50)
+
+
+class ForwardStrictRelu(ActivationForward):
+    MAPPING = "activation_str"
+    hide_from_registry = False
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax.numpy as jnp
+        return jnp.maximum(x, 0)
+
+    def numpy_apply(self, params, x):
+        return numpy.maximum(x, 0)
+
+
+class ForwardSigmoid(ActivationForward):
+    MAPPING = "activation_sigmoid"
+    hide_from_registry = False
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax
+        return jax.nn.sigmoid(x)
+
+    def numpy_apply(self, params, x):
+        return 1.0 / (1.0 + numpy.exp(-x))
+
+
+class ForwardLog(ActivationForward):
+    """y = log(x + sqrt(x^2 + 1)) (asinh), Znicz activation_log."""
+
+    MAPPING = "activation_log"
+    hide_from_registry = False
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax.numpy as jnp
+        return jnp.arcsinh(x)
+
+    def numpy_apply(self, params, x):
+        return numpy.arcsinh(x)
+
+
+class ForwardMul(ActivationForward):
+    """y = k * x elementwise scale (Znicz activation_mul)."""
+
+    MAPPING = "activation_mul"
+    hide_from_registry = False
+
+    def __init__(self, workflow, factor=1.0, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.factor = factor
+
+    def apply(self, params, x, *, train=False, rng=None):
+        return x * self.factor
+
+    def numpy_apply(self, params, x):
+        return x * self.factor

@@ -1,0 +1,178 @@
+"""Fully-connected (All2All) forward units + matched GD units.
+
+Equivalent of Znicz ``all`` / ``gd`` modules (layer types "all2all",
+"all2all_tanh", "all2all_relu", "all2all_sigmoid", "softmax" — reference
+surface: SURVEY.md §2.8, docs/source/manualrst_veles_workflow_creation.rst).
+
+The GEMM rides the MXU: inputs flatten to (batch, features) and matmul in
+the configured compute dtype (bfloat16 by default) with float32 accumulation
+via ``preferred_element_type`` — the TPU-native replacement for the
+reference's hand-tiled OpenCL GEMM (ocl/matrix_multiplication.cl) and its
+Kahan-summation precision levels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy
+
+from ..config import root
+from ..memory import Array
+from .. import prng
+from .nn_units import ForwardBase, GradientDescentBase, matches
+
+
+class All2All(ForwardBase):
+    """y = act(x @ W + b), weights stored (in_features, out_features)."""
+
+    MAPPING = "all2all"
+    PARAMETERIZED = True
+    hide_from_registry = False
+
+    def __init__(self, workflow, output_sample_shape=(), **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        if isinstance(output_sample_shape, int):
+            output_sample_shape = (output_sample_shape,)
+        self.output_sample_shape = tuple(output_sample_shape)
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+        self.bias_stddev = kwargs.get("bias_stddev", None)
+        self.include_bias = kwargs.get("include_bias", True)
+
+    # -- shape ---------------------------------------------------------------
+    @property
+    def neurons_number(self) -> int:
+        return int(numpy.prod(self.output_sample_shape))
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0],) + self.output_sample_shape
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        n_in = int(numpy.prod(self.input.shape[1:]))
+        n_out = self.neurons_number
+        # Znicz default init: uniform-ish scaled by 1/sqrt(fan_in)
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(n_in))
+        dtype = root.common.engine.precision_type
+        w = numpy.zeros((n_in, n_out), dtype=dtype)
+        prng.get(self.name).fill_normal(w, stddev)
+        params = {"weights": Array(w, name=self.name + ".weights")}
+        if self.include_bias:
+            b = numpy.zeros((n_out,), dtype=dtype)
+            if self.bias_stddev:
+                prng.get(self.name + ".bias").fill_normal(b, self.bias_stddev)
+            params["bias"] = Array(b, name=self.name + ".bias")
+        return params
+
+    # -- pure forward --------------------------------------------------------
+    def _linear(self, params, x):
+        import jax.numpy as jnp
+        cdt = root.common.engine.compute_dtype
+        x2 = x.reshape(x.shape[0], -1)
+        w = params["weights"]
+        y = jnp.dot(x2.astype(cdt), w.astype(cdt),
+                    preferred_element_type=jnp.float32)
+        if "bias" in params:
+            y = y + params["bias"]
+        return y.astype(x.dtype).reshape((x.shape[0],)
+                                         + self.output_sample_shape)
+
+    def activation(self, a):
+        return a
+
+    def numpy_activation(self, a):
+        return a
+
+    def apply(self, params, x, *, train=False, rng=None):
+        return self.activation(self._linear(params, x))
+
+    def numpy_apply(self, params, x):
+        x2 = x.reshape(len(x), -1).astype(numpy.float32)
+        y = x2 @ params["weights"]
+        if "bias" in params:
+            y = y + params["bias"]
+        return self.numpy_activation(y).reshape((len(x),)
+                                                + self.output_sample_shape)
+
+
+class All2AllTanh(All2All):
+    """Znicz all2all_tanh: y = 1.7159 * tanh(0.6666 * a) (LeCun scaled)."""
+
+    MAPPING = "all2all_tanh"
+    A, B = 1.7159, 0.6666
+
+    def activation(self, a):
+        import jax.numpy as jnp
+        return self.A * jnp.tanh(self.B * a)
+
+    def numpy_activation(self, a):
+        return self.A * numpy.tanh(self.B * a)
+
+
+class All2AllRelu(All2All):
+    MAPPING = "all2all_relu"
+
+    def activation(self, a):
+        import jax.numpy as jnp
+        return jnp.maximum(a, 0)
+
+    def numpy_activation(self, a):
+        return numpy.maximum(a, 0)
+
+
+class All2AllSigmoid(All2All):
+    MAPPING = "all2all_sigmoid"
+
+    def activation(self, a):
+        import jax
+
+        return jax.nn.sigmoid(a)
+
+    def numpy_activation(self, a):
+        return 1.0 / (1.0 + numpy.exp(-a))
+
+
+class All2AllSoftmax(All2All):
+    """Softmax output layer (Znicz layer type "softmax"). Emits
+    ``max_idx`` like the reference for the evaluator/decision pair."""
+
+    MAPPING = "softmax"
+
+    def activation(self, a):
+        import jax
+
+        return jax.nn.softmax(a, axis=-1)
+
+    def numpy_activation(self, a):
+        e = numpy.exp(a - a.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+
+    def logits(self, params, x):
+        """Pre-softmax activations — the evaluator consumes these for a
+        numerically-stable fused softmax-cross-entropy."""
+        return self._linear(params, x)
+
+
+@matches(All2All)
+class GradientDescent(GradientDescentBase):
+    MAPPING = "gd"
+    hide_from_registry = False
+
+
+@matches(All2AllTanh)
+class GDTanh(GradientDescentBase):
+    MAPPING = "gd_tanh"
+
+
+@matches(All2AllRelu)
+class GDRelu(GradientDescentBase):
+    MAPPING = "gd_relu"
+
+
+@matches(All2AllSigmoid)
+class GDSigmoid(GradientDescentBase):
+    MAPPING = "gd_sigmoid"
+
+
+@matches(All2AllSoftmax)
+class GDSoftmax(GradientDescentBase):
+    MAPPING = "gd_softmax"

@@ -1,0 +1,165 @@
+"""Convolution forward units + matched GD units.
+
+Equivalent of Znicz ``conv`` / ``gd_conv`` (layer type "conv*"; reference
+surface SURVEY.md §2.8). TPU-native: NHWC layout (the TPU-preferred
+convolution layout), ``jax.lax.conv_general_dilated`` so XLA maps the conv
+onto the MXU; bfloat16 compute with float32 accumulation. The Znicz
+parameter vocabulary is preserved: ``n_kernels``, ``kx``/``ky``,
+``sliding=(sx, sy)``, ``padding=(left, top, right, bottom)``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy
+
+from ..config import root
+from ..memory import Array
+from .. import prng
+from .nn_units import ForwardBase, GradientDescentBase, matches
+
+
+class Conv(ForwardBase):
+    """Input (B, H, W, C) → output (B, H', W', n_kernels)."""
+
+    MAPPING = "conv"
+    PARAMETERIZED = True
+    hide_from_registry = False
+
+    def __init__(self, workflow, n_kernels=16, kx=3, ky=3,
+                 sliding=(1, 1), padding=(0, 0, 0, 0), **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_kernels = n_kernels
+        self.kx, self.ky = kx, ky
+        self.sliding = tuple(sliding)
+        self.padding = tuple(padding)
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+        self.include_bias = kwargs.get("include_bias", True)
+
+    def _pad_hw(self):
+        left, top, right, bottom = self.padding
+        return ((top, bottom), (left, right))
+
+    def output_shape_for(self, input_shape):
+        b, h, w, _ = input_shape
+        (pt, pb), (pl, pr) = self._pad_hw()
+        sx, sy = self.sliding
+        oh = (h + pt + pb - self.ky) // sy + 1
+        ow = (w + pl + pr - self.kx) // sx + 1
+        return (b, oh, ow, self.n_kernels)
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        c_in = self.input.shape[-1]
+        fan_in = self.kx * self.ky * c_in
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(fan_in))
+        dtype = root.common.engine.precision_type
+        # HWIO layout
+        w = numpy.zeros((self.ky, self.kx, c_in, self.n_kernels),
+                        dtype=dtype)
+        prng.get(self.name).fill_normal(w, stddev)
+        params = {"weights": Array(w, name=self.name + ".weights")}
+        if self.include_bias:
+            params["bias"] = Array(
+                numpy.zeros((self.n_kernels,), dtype=dtype),
+                name=self.name + ".bias")
+        return params
+
+    def _conv(self, params, x):
+        import jax
+        import jax.numpy as jnp
+        cdt = root.common.engine.compute_dtype
+        sx, sy = self.sliding
+        y = jax.lax.conv_general_dilated(
+            x.astype(cdt), params["weights"].astype(cdt),
+            window_strides=(sy, sx),
+            padding=self._pad_hw(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        if "bias" in params:
+            y = y + params["bias"]
+        return y.astype(x.dtype)
+
+    def activation(self, a):
+        return a
+
+    def numpy_activation(self, a):
+        return a
+
+    def apply(self, params, x, *, train=False, rng=None):
+        return self.activation(self._conv(params, x))
+
+    def numpy_apply(self, params, x):
+        """Host oracle: direct im2col convolution."""
+        b, h, w, c = x.shape
+        (pt, pb), (pl, pr) = self._pad_hw()
+        xp = numpy.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))
+        _, oh, ow, _ = self.output_shape_for(x.shape)
+        sx, sy = self.sliding
+        cols = numpy.zeros((b, oh, ow, self.ky * self.kx * c),
+                           dtype=numpy.float32)
+        for i in range(oh):
+            for j in range(ow):
+                patch = xp[:, i * sy:i * sy + self.ky,
+                           j * sx:j * sx + self.kx, :]
+                cols[:, i, j, :] = patch.reshape(b, -1)
+        wmat = params["weights"].reshape(-1, self.n_kernels)
+        y = cols @ wmat
+        if "bias" in params:
+            y = y + params["bias"]
+        return self.numpy_activation(y)
+
+
+class ConvTanh(Conv):
+    MAPPING = "conv_tanh"
+    A, B = 1.7159, 0.6666
+
+    def activation(self, a):
+        import jax.numpy as jnp
+        return self.A * jnp.tanh(self.B * a)
+
+    def numpy_activation(self, a):
+        return self.A * numpy.tanh(self.B * a)
+
+
+class ConvRelu(Conv):
+    MAPPING = "conv_relu"
+
+    def activation(self, a):
+        import jax.numpy as jnp
+        return jnp.maximum(a, 0)
+
+    def numpy_activation(self, a):
+        return numpy.maximum(a, 0)
+
+
+class ConvSigmoid(Conv):
+    MAPPING = "conv_sigmoid"
+
+    def activation(self, a):
+        import jax
+        return jax.nn.sigmoid(a)
+
+    def numpy_activation(self, a):
+        return 1.0 / (1.0 + numpy.exp(-a))
+
+
+@matches(Conv)
+class GDConv(GradientDescentBase):
+    MAPPING = "gd_conv"
+    hide_from_registry = False
+
+
+@matches(ConvTanh)
+class GDConvTanh(GradientDescentBase):
+    MAPPING = "gd_conv_tanh"
+
+
+@matches(ConvRelu)
+class GDConvRelu(GradientDescentBase):
+    MAPPING = "gd_conv_relu"
+
+
+@matches(ConvSigmoid)
+class GDConvSigmoid(GradientDescentBase):
+    MAPPING = "gd_conv_sigmoid"

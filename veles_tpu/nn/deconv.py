@@ -1,0 +1,101 @@
+"""Deconvolution (transposed convolution) unit — Znicz ``deconv`` /
+``gd_deconv`` (used by the ImagenetAE autoencoder, SURVEY.md §2.8).
+TPU-native via ``jax.lax.conv_transpose`` (NHWC/HWIO)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy
+
+from ..config import root
+from ..memory import Array
+from .. import prng
+from .nn_units import ForwardBase, GradientDescentBase, matches
+
+
+class Deconv(ForwardBase):
+    """Mirror of Conv: input (B, H, W, n_kernels) → (B, H', W', n_channels),
+    H' = (H-1)*sy + ky - pt - pb."""
+
+    MAPPING = "deconv"
+    PARAMETERIZED = True
+    hide_from_registry = False
+
+    def __init__(self, workflow, n_channels=3, kx=3, ky=3,
+                 sliding=(1, 1), padding=(0, 0, 0, 0), **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_channels = n_channels
+        self.kx, self.ky = kx, ky
+        self.sliding = tuple(sliding)
+        self.padding = tuple(padding)
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+        self.include_bias = kwargs.get("include_bias", False)
+
+    def output_shape_for(self, input_shape):
+        b, h, w, _ = input_shape
+        left, top, right, bottom = self.padding
+        sx, sy = self.sliding
+        oh = (h - 1) * sy + self.ky - top - bottom
+        ow = (w - 1) * sx + self.kx - left - right
+        return (b, oh, ow, self.n_channels)
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        c_in = self.input.shape[-1]
+        fan_in = self.kx * self.ky * c_in
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(fan_in))
+        dtype = root.common.engine.precision_type
+        w = numpy.zeros((self.ky, self.kx, c_in, self.n_channels),
+                        dtype=dtype)
+        prng.get(self.name).fill_normal(w, stddev)
+        params = {"weights": Array(w, name=self.name + ".weights")}
+        if self.include_bias:
+            params["bias"] = Array(
+                numpy.zeros((self.n_channels,), dtype=dtype),
+                name=self.name + ".bias")
+        return params
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+        cdt = root.common.engine.compute_dtype
+        left, top, right, bottom = self.padding
+        sx, sy = self.sliding
+        # conv_transpose pads the dilated input directly; transposed-conv
+        # semantics (out = (i-1)*s + k - pad) need pairs of k-1-p
+        # spatial flip: conv_transpose cross-correlates the dilated input,
+        # while deconv semantics stamp the kernel (true conv)
+        y = jax.lax.conv_transpose(
+            x.astype(cdt), params["weights"][::-1, ::-1].astype(cdt),
+            strides=(sy, sx),
+            padding=((self.ky - 1 - top, self.ky - 1 - bottom),
+                     (self.kx - 1 - left, self.kx - 1 - right)),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            preferred_element_type=jnp.float32)
+        if "bias" in params:
+            y = y + params["bias"]
+        return y.astype(x.dtype)
+
+    def numpy_apply(self, params, x):
+        """Oracle: scatter-add of kernel stamps."""
+        b, h, w, c_in = x.shape
+        _, oh, ow, c_out = self.output_shape_for(x.shape)
+        left, top, right, bottom = self.padding
+        sx, sy = self.sliding
+        full = numpy.zeros((b, oh + top + bottom, ow + left + right, c_out),
+                           dtype=numpy.float32)
+        wk = params["weights"].astype(numpy.float32)  # (ky,kx,cin,cout)
+        for i in range(h):
+            for j in range(w):
+                stamp = numpy.einsum("bc,yxcd->byxd", x[:, i, j, :], wk)
+                full[:, i * sy:i * sy + self.ky,
+                     j * sx:j * sx + self.kx, :] += stamp
+        y = full[:, top:top + oh, left:left + ow, :]
+        if "bias" in params:
+            y = y + params["bias"]
+        return y
+
+
+@matches(Deconv)
+class GDDeconv(GradientDescentBase):
+    MAPPING = "gd_deconv"

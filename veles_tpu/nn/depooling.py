@@ -1,0 +1,29 @@
+"""Depooling unit — Znicz ``depooling`` (autoencoder decoder side,
+SURVEY.md §2.8): nearest-neighbor upsampling that inverts AvgPooling."""
+
+from __future__ import annotations
+
+import numpy
+
+from .nn_units import ForwardBase
+
+
+class Depooling(ForwardBase):
+    MAPPING = "depooling"
+    hide_from_registry = False
+
+    def __init__(self, workflow, kx=2, ky=2, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.kx, self.ky = kx, ky
+
+    def output_shape_for(self, input_shape):
+        b, h, w, c = input_shape
+        return (b, h * self.ky, w * self.kx, c)
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax.numpy as jnp
+        return jnp.repeat(jnp.repeat(x, self.ky, axis=1), self.kx, axis=2)
+
+    def numpy_apply(self, params, x):
+        return numpy.repeat(numpy.repeat(x, self.ky, axis=1), self.kx,
+                            axis=2)

@@ -1,0 +1,32 @@
+"""Dropout unit — Znicz ``dropout`` (SURVEY.md §2.8). Inverted dropout:
+train-time mask scaled by 1/keep so eval is identity."""
+
+from __future__ import annotations
+
+import numpy
+
+from .nn_units import ForwardBase
+
+
+class DropoutForward(ForwardBase):
+    MAPPING = "dropout"
+    hide_from_registry = False
+    NEEDS_RNG = True
+
+    def __init__(self, workflow, dropout_ratio=0.5, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.dropout_ratio = float(dropout_ratio)
+
+    def output_shape_for(self, input_shape):
+        return input_shape
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax
+        if not train or rng is None or self.dropout_ratio <= 0:
+            return x
+        keep = 1.0 - self.dropout_ratio
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return (x * mask) / keep
+
+    def numpy_apply(self, params, x):
+        return x  # eval-mode oracle

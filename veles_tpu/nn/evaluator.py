@@ -1,0 +1,121 @@
+"""Evaluator units: loss + quality metrics.
+
+Equivalent of Znicz ``evaluator`` (EvaluatorSoftmax / EvaluatorMSE; loss
+functions "softmax"/"mse", SURVEY.md §2.8 +
+docs/manualrst_veles_workflow_parameters.rst:121-166).
+
+The pure ``loss(y_or_logits, labels, mask)`` participates in the fused
+train step's jax.grad; ``metrics_fn`` computes n_err/confusion (softmax) or
+sum-squared error (MSE) on device. Batch padding (the reference zero-padded
+short tail minibatches, veles/loader/base.py:749-753) is handled with a
+validity mask so padded samples contribute nothing.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy
+
+from ..memory import Array
+from ..units import Unit
+
+
+class EvaluatorBase(Unit):
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "EVALUATOR"
+        self.output: Optional[Array] = None      # forward chain output
+        self.target: Optional[Array] = None      # labels / target values
+        self.batch_metrics: Dict[str, float] = {}
+
+    def loss(self, y, target, mask):
+        """Pure scalar loss, mean over valid samples."""
+        raise NotImplementedError
+
+    def metrics_fn(self, y, target, mask):
+        """Pure dict of device metrics for the step output."""
+        raise NotImplementedError
+
+    def numpy_loss(self, y, target, mask):
+        raise NotImplementedError
+
+
+class EvaluatorSoftmax(EvaluatorBase):
+    """Cross-entropy over logits (fused log-softmax — numerically stable,
+    unlike composing the reference's separate softmax forward + CE kernel);
+    metrics: n_err + confusion matrix (reference EvaluatorSoftmax emitted
+    the same for DecisionGD)."""
+
+    MAPPING = "evaluator_softmax"
+    hide_from_registry = False
+
+    def __init__(self, workflow, n_classes=None, compute_confusion=False,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.n_classes = n_classes
+        self.compute_confusion = compute_confusion
+
+    def loss(self, logits, labels, mask):
+        import jax
+        import jax.numpy as jnp
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+    def metrics_fn(self, logits, labels, mask):
+        import jax.numpy as jnp
+        pred = jnp.argmax(logits, axis=-1)
+        wrong = (pred != labels) & (mask > 0)
+        out = {"n_err": jnp.sum(wrong), "n_samples": jnp.sum(mask)}
+        if self.compute_confusion and self.n_classes:
+            flat = labels * self.n_classes + pred
+            cm = jnp.bincount(jnp.where(mask > 0, flat, 0).astype(
+                jnp.int32), weights=mask,
+                length=self.n_classes * self.n_classes)
+            out["confusion"] = cm.reshape(self.n_classes, self.n_classes)
+        return out
+
+    def numpy_loss(self, logits, labels, mask):
+        z = logits.astype(numpy.float64)
+        z = z - z.max(axis=1, keepdims=True)
+        logp = z - numpy.log(numpy.exp(z).sum(axis=1, keepdims=True))
+        nll = -logp[numpy.arange(len(labels)), labels]
+        return float((nll * mask).sum() / max(mask.sum(), 1))
+
+
+class EvaluatorMSE(EvaluatorBase):
+    """Mean squared error (reference EvaluatorMSE; used by the autoencoder
+    workflows). Reports rmse like the reference's metrics."""
+
+    MAPPING = "evaluator_mse"
+    hide_from_registry = False
+
+    def __init__(self, workflow, root_normalize=False, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.root_normalize = root_normalize
+
+    def loss(self, y, target, mask):
+        import jax.numpy as jnp
+        y = y.astype(jnp.float32)
+        target = target.astype(jnp.float32)
+        per_sample = jnp.sum(
+            jnp.square(y - target).reshape(y.shape[0], -1), axis=1)
+        return jnp.sum(per_sample * mask) / jnp.maximum(jnp.sum(mask), 1)
+
+    def metrics_fn(self, y, target, mask):
+        import jax.numpy as jnp
+        y = y.astype(jnp.float32)
+        target = target.astype(jnp.float32)
+        d = jnp.square(y - target).reshape(y.shape[0], -1)
+        per_sample = jnp.mean(d, axis=1)
+        return {"sum_sq": jnp.sum(per_sample * mask),
+                "n_samples": jnp.sum(mask)}
+
+    def numpy_loss(self, y, target, mask):
+        d = numpy.square(y.astype(numpy.float64) -
+                         target.astype(numpy.float64))
+        per_sample = d.reshape(len(y), -1).sum(axis=1)
+        return float((per_sample * mask).sum() / max(mask.sum(), 1))

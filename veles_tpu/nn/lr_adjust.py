@@ -1,0 +1,43 @@
+"""Learning-rate schedules — Znicz ``lr_adjust`` (SURVEY.md §2.8).
+
+A schedule is a pure function epoch→scale applied as ``lr_scale`` in the
+fused step (so changing LR does NOT retrigger XLA compilation — the scale is
+a traced scalar argument, not a baked constant)."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..units import Unit
+
+
+def step_exp(gamma: float = 0.1, step: int = 10) -> Callable[[int], float]:
+    """lr *= gamma every `step` epochs (Caffe 'step' policy)."""
+    return lambda epoch: gamma ** (epoch // step)
+
+
+def exp_decay(gamma: float = 0.99) -> Callable[[int], float]:
+    return lambda epoch: gamma ** epoch
+
+
+def inv(gamma: float = 1e-4, power: float = 0.75) -> Callable[[int], float]:
+    return lambda epoch: (1.0 + gamma * epoch) ** (-power)
+
+
+class LearningRateAdjust(Unit):
+    """Unit form: recomputes ``lr_scale`` from the decision's epoch counter
+    each epoch; the TrainStep reads ``lr_scale`` every minibatch."""
+
+    MAPPING = "lr_adjust"
+    hide_from_registry = False
+
+    def __init__(self, workflow, schedule: Callable[[int], float] = None,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.schedule = schedule or (lambda epoch: 1.0)
+        self.lr_scale = 1.0
+        self.decision = None
+        self.demand("decision")
+
+    def run(self) -> None:
+        self.lr_scale = float(self.schedule(self.decision.epoch_number))

@@ -1,0 +1,220 @@
+"""NN unit bases: ForwardBase, GradientDescentBase, MatchingObject registry.
+
+Equivalent of Znicz ``nn_units`` (reference surface: SURVEY.md §2.8,
+docs/generate_units_args.py:16-40): forward units paired with gradient-
+descent units through a matching registry.
+
+TPU-first redesign of the forward/backward contract:
+- a forward unit is a *parameterized pure function*: ``apply(params, x,
+  train, rng)`` built from jax.numpy — traceable, fuseable, shardable;
+- ``numpy_apply(params, x)`` is the host oracle (reference "numpy is the
+  oracle" property, SURVEY.md §4);
+- there are NO hand-written backward kernels: the paired GD unit carries
+  *optimizer hyper-parameters* (learning rate, momentum, weight decay,
+  gradient clipping) and its pure ``update(param, grad, state)`` rule;
+  gradients come from ``jax.grad`` over the composed step (train_step.py).
+  Standalone ``GradientDescentBase.run`` still works for unit tests via
+  ``jax.vjp`` of the matched forward.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple, Type
+
+import numpy
+
+from ..accelerated import AcceleratedUnit
+from ..config import root
+from ..error import Bug
+from ..memory import Array
+from .. import prng
+
+#: forward class → gd class (reference: Znicz MatchingObject registry)
+MATCHING: Dict[type, type] = {}
+
+
+def matches(forward_cls: type) -> Callable[[type], type]:
+    """Class decorator registering a GD unit as the backward pair of a
+    forward unit."""
+    def deco(gd_cls: type) -> type:
+        MATCHING[forward_cls] = gd_cls
+        return gd_cls
+    return deco
+
+
+class ForwardBase(AcceleratedUnit):
+    """Base of all forward (inference) units (Znicz ``nn_units.ForwardBase``).
+
+    Data contract (matches the reference unit attribute names so workflow
+    wiring code reads the same): ``input`` / ``output`` are Arrays;
+    parameters live in ``self.weights`` / ``self.bias`` Arrays when present.
+    """
+
+    hide_from_registry = True
+    #: subclasses with trainable parameters set this
+    PARAMETERIZED = False
+
+    def __init__(self, workflow, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.view_group = "WORKER"
+        self.input: Optional[Array] = None
+        self.output = Array(name=self.name + ".output")
+        self.weights_transposed = kwargs.get("weights_transposed", False)
+        self.demand("input")
+
+    # -- parameter protocol --------------------------------------------------
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        """Allocate+initialize parameter Arrays; default: none."""
+        return {}
+
+    def params_np(self) -> Dict[str, numpy.ndarray]:
+        """Host view of parameters (oracle side)."""
+        return {k: v.map_read() for k, v in self.param_arrays().items()}
+
+    def param_arrays(self) -> Dict[str, Array]:
+        out = {}
+        for k in ("weights", "bias"):
+            arr = getattr(self, k, None)
+            if isinstance(arr, Array) and arr:
+                out[k] = arr
+        return out
+
+    # -- the pure function ---------------------------------------------------
+    def apply(self, params: Dict[str, Any], x: Any, *, train: bool = False,
+              rng: Any = None) -> Any:
+        """Pure jax forward. MUST be jit-traceable (static shapes, no host
+        side effects)."""
+        raise NotImplementedError
+
+    def numpy_apply(self, params: Dict[str, numpy.ndarray],
+                    x: numpy.ndarray) -> numpy.ndarray:
+        """Host oracle forward."""
+        raise NotImplementedError
+
+    def output_shape_for(self, input_shape: Tuple[int, ...]
+                         ) -> Tuple[int, ...]:
+        """Static shape inference used at graph-build time."""
+        raise NotImplementedError
+
+    # -- standalone execution (inference graphs, unit tests) -----------------
+    def initialize(self, device=None, **kwargs):
+        res = super().initialize(device=device, **kwargs)
+        if res:
+            return res
+        if self.PARAMETERIZED and not getattr(self, "weights", None):
+            rng = prng.get(self.name)
+            for k, v in self.create_params(rng).items():
+                setattr(self, k, v)
+        if self.input is not None and self.input:
+            shape = self.output_shape_for(self.input.shape)
+            if self.output.mem is None or self.output.shape != shape:
+                self.output.reset(numpy.zeros(
+                    shape, dtype=root.common.engine.precision_type))
+        return None
+
+    def xla_run(self) -> None:
+        params = {k: v.device_view() for k, v in self.param_arrays().items()}
+        fn = self.jit("apply", lambda p, x: self.apply(p, x, train=False))
+        self.output.assign_devmem(fn(params, self.input.device_view()))
+
+    def numpy_run(self) -> None:
+        y = self.numpy_apply(self.params_np(), self.input.map_read())
+        self.output.reset(numpy.asarray(y))
+
+
+class GradientDescentBase(AcceleratedUnit):
+    """Base of gradient-descent (backward/update) units (Znicz
+    ``nn_units.GradientDescentBase``).
+
+    In the reference each GD unit computed err_input and applied the weight
+    delta with its own kernel; here the unit carries the *update rule* and
+    hyper-parameters, applied inside the fused train step. ``run`` as a
+    standalone unit computes gradients with jax.vjp against the matched
+    forward — used by tests and by graphs that want explicit per-layer
+    backward stages.
+    """
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.view_group = "TRAINER"
+        self.forward: Optional[ForwardBase] = None
+        self.learning_rate = kwargs.get("learning_rate", 0.01)
+        self.learning_rate_bias = kwargs.get("learning_rate_bias",
+                                             self.learning_rate)
+        self.momentum = kwargs.get("gradient_moment",
+                                   kwargs.get("momentum", 0.0))
+        self.weight_decay = kwargs.get("weights_decay",
+                                       kwargs.get("weight_decay", 0.0))
+        self.weight_decay_bias = kwargs.get("weights_decay_bias", 0.0)
+        self.gradient_clip = kwargs.get("gradient_clip", 0.0)
+
+    # -- pure update rule ----------------------------------------------------
+    def init_state(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        """Momentum/accumulator state pytree, zeros-like params."""
+        import jax
+        return jax.tree_util.tree_map(lambda p: p * 0, params)
+
+    def update(self, params: Dict[str, Any], grads: Dict[str, Any],
+               state: Dict[str, Any], lr_scale: Any = 1.0
+               ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """SGD + momentum + L2 weight decay + optional clip
+        (the Znicz GD semantics: delta = lr*(grad + wd*w) + mom*prev)."""
+        import jax.numpy as jnp
+        new_params, new_state = {}, {}
+        for k, p in params.items():
+            g = grads[k]
+            lr = (self.learning_rate_bias if k == "bias"
+                  else self.learning_rate) * lr_scale
+            wd = (self.weight_decay_bias if k == "bias"
+                  else self.weight_decay)
+            if self.gradient_clip:
+                g = jnp.clip(g, -self.gradient_clip, self.gradient_clip)
+            delta = lr * (g + wd * p) + self.momentum * state[k]
+            new_params[k] = p - delta
+            new_state[k] = delta
+        return new_params, new_state
+
+    # -- standalone backward (tests / explicit graphs) -----------------------
+    def initialize(self, device=None, **kwargs):
+        if self.forward is None:
+            raise Bug("%s: no forward unit attached" % self.name)
+        return super().initialize(device=device, **kwargs)
+
+    def compute_grads(self, err_output):
+        """vjp of the matched forward at its current input/params:
+        returns (err_input, param_grads)."""
+        import jax
+        fwd = self.forward
+        params = {k: v.device_view() for k, v in fwd.param_arrays().items()}
+        x = fwd.input.device_view()
+
+        def f(p, xx):
+            return fwd.apply(p, xx, train=True)
+
+        _, vjp = jax.vjp(f, params, x)
+        pgrads, xgrad = vjp(err_output)
+        return xgrad, pgrads
+
+    def xla_run(self) -> None:
+        err = getattr(self, "err_output", None)
+        if err is None:
+            raise Bug("%s: err_output not linked" % self.name)
+        xgrad, pgrads = self.compute_grads(err.device_view())
+        self.err_input = Array(numpy.asarray(xgrad),
+                               name=self.name + ".err_input")
+        params = {k: v.device_view()
+                  for k, v in self.forward.param_arrays().items()}
+        if params:
+            state = getattr(self, "_state", None)
+            if state is None:
+                state = self._state = self.init_state(params)
+            new_params, self._state = self.update(params, pgrads, state)
+            for k, v in new_params.items():
+                self.forward.param_arrays()[k].assign_devmem(v)
+
+    def numpy_run(self) -> None:
+        # host path delegates to the same jax code on CPU — autodiff has no
+        # separate numpy oracle; correctness is anchored by forward oracles
+        self.xla_run()

@@ -1,0 +1,47 @@
+"""Local response normalization across channels — Znicz ``normalization``
+(layer type "norm", used by AlexNet-style configs; SURVEY.md §2.8).
+y = x / (beta + alpha * sum_{j in window} x_j^2)^n_exp over channel axis."""
+
+from __future__ import annotations
+
+import numpy
+
+from .nn_units import ForwardBase
+
+
+class LRNormalizerForward(ForwardBase):
+    MAPPING = "norm"
+    hide_from_registry = False
+
+    def __init__(self, workflow, alpha=1e-4, beta=0.75, n=5, k=2.0,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.alpha, self.beta, self.n, self.k = alpha, beta, n, k
+
+    def output_shape_for(self, input_shape):
+        return input_shape
+
+    def _window_sumsq_np(self, x):
+        c = x.shape[-1]
+        half = self.n // 2
+        sq = numpy.square(x.astype(numpy.float32))
+        out = numpy.zeros_like(sq)
+        for i in range(c):
+            lo, hi = max(0, i - half), min(c, i + half + 1)
+            out[..., i] = sq[..., lo:hi].sum(axis=-1)
+        return out
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax.numpy as jnp
+        half = self.n // 2
+        sq = jnp.square(x.astype(jnp.float32))
+        c = x.shape[-1]
+        pad = [(0, 0)] * (x.ndim - 1) + [(half, half)]
+        sqp = jnp.pad(sq, pad)
+        win = sum(sqp[..., i:i + c] for i in range(2 * half + 1))
+        return (x / jnp.power(self.k + self.alpha * win,
+                              self.beta)).astype(x.dtype)
+
+    def numpy_apply(self, params, x):
+        win = self._window_sumsq_np(x)
+        return x / numpy.power(self.k + self.alpha * win, self.beta)

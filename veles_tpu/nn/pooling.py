@@ -1,0 +1,148 @@
+"""Pooling units (Znicz ``pooling`` / ``gd_pooling``; layer types
+"max_pooling", "avg_pooling", "stochastic_pooling" — SURVEY.md §2.8).
+TPU-native via ``jax.lax.reduce_window`` (NHWC)."""
+
+from __future__ import annotations
+
+import numpy
+
+from .nn_units import ForwardBase, GradientDescentBase, matches
+
+
+class Pooling(ForwardBase):
+    hide_from_registry = True
+
+    def __init__(self, workflow, kx=2, ky=2, sliding=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.kx, self.ky = kx, ky
+        self.sliding = tuple(sliding) if sliding else (kx, ky)
+
+    def output_shape_for(self, input_shape):
+        b, h, w, c = input_shape
+        sx, sy = self.sliding
+        # ceil-mode like the reference (partial windows at the edge count)
+        oh = -(-(h - self.ky) // sy) + 1 if h >= self.ky else 1
+        ow = -(-(w - self.kx) // sx) + 1 if w >= self.kx else 1
+        return (b, oh, ow, c)
+
+    def _windows(self, x):
+        """Iterate (i, j, window) over the pooling grid — oracle helper."""
+        b, h, w, c = x.shape
+        _, oh, ow, _ = self.output_shape_for(x.shape)
+        sx, sy = self.sliding
+        for i in range(oh):
+            for j in range(ow):
+                yield i, j, x[:, i * sy:i * sy + self.ky,
+                              j * sx:j * sx + self.kx, :]
+
+    def _pad_same(self):
+        # SAME_LOWER-style padding covering ceil-mode edges
+        return "SAME" if False else None
+
+
+class MaxPooling(Pooling):
+    MAPPING = "max_pooling"
+    hide_from_registry = False
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+        sx, sy = self.sliding
+        b, h, w, c = x.shape
+        _, oh, ow, _ = self.output_shape_for(x.shape)
+        pad_h = (oh - 1) * sy + self.ky - h
+        pad_w = (ow - 1) * sx + self.kx - w
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max,
+            window_dimensions=(1, self.ky, self.kx, 1),
+            window_strides=(1, sy, sx, 1),
+            padding=((0, 0), (0, max(pad_h, 0)), (0, max(pad_w, 0)),
+                     (0, 0)))
+
+    def numpy_apply(self, params, x):
+        _, oh, ow, _ = self.output_shape_for(x.shape)
+        y = numpy.zeros((x.shape[0], oh, ow, x.shape[3]),
+                        dtype=numpy.float32)
+        for i, j, win in self._windows(x):
+            y[:, i, j, :] = win.max(axis=(1, 2))
+        return y
+
+
+class AvgPooling(Pooling):
+    MAPPING = "avg_pooling"
+    hide_from_registry = False
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+        sx, sy = self.sliding
+        b, h, w, c = x.shape
+        _, oh, ow, _ = self.output_shape_for(x.shape)
+        pad_h = max((oh - 1) * sy + self.ky - h, 0)
+        pad_w = max((ow - 1) * sx + self.kx - w, 0)
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add,
+            window_dimensions=(1, self.ky, self.kx, 1),
+            window_strides=(1, sy, sx, 1),
+            padding=((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+        # divide by the true (edge-clipped) window size, matching the oracle
+        counts = jax.lax.reduce_window(
+            jnp.ones((1, h, w, 1), dtype=x.dtype), 0.0, jax.lax.add,
+            window_dimensions=(1, self.ky, self.kx, 1),
+            window_strides=(1, sy, sx, 1),
+            padding=((0, 0), (0, pad_h), (0, pad_w), (0, 0)))
+        return summed / counts
+
+    def numpy_apply(self, params, x):
+        _, oh, ow, _ = self.output_shape_for(x.shape)
+        y = numpy.zeros((x.shape[0], oh, ow, x.shape[3]),
+                        dtype=numpy.float32)
+        for i, j, win in self._windows(x):
+            y[:, i, j, :] = win.mean(axis=(1, 2))
+        return y
+
+
+class StochasticPooling(MaxPooling):
+    """Znicz stochastic pooling: training samples a window element with
+    probability proportional to its activation; eval = probability-weighted
+    average. TPU version: use uniform sampling over softmax(window) via
+    Gumbel trick inside reduce_window is awkward — implemented with
+    explicit window extraction (sizes are small, XLA fuses it)."""
+
+    MAPPING = "stochastic_pooling"
+    hide_from_registry = False
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+        if not train or rng is None:
+            return super().apply(params, x, train=train, rng=rng)
+        sx, sy = self.sliding
+        b, h, w, c = x.shape
+        _, oh, ow, _ = self.output_shape_for(x.shape)
+        pad_h = max((oh - 1) * sy + self.ky - h, 0)
+        pad_w = max((ow - 1) * sx + self.kx - w, 0)
+        xp = jnp.pad(x, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)),
+                     constant_values=-jnp.inf)
+        # gather all windows: (B, OH, OW, ky*kx, C)
+        idx_i = (jnp.arange(oh) * sy)[:, None] + jnp.arange(self.ky)[None]
+        idx_j = (jnp.arange(ow) * sx)[:, None] + jnp.arange(self.kx)[None]
+        wins = xp[:, idx_i[:, None, :, None], idx_j[None, :, None, :], :]
+        wins = wins.reshape(b, oh, ow, self.ky * self.kx, c)
+        logits = jnp.where(jnp.isfinite(wins), wins, -1e30)
+        g = jax.random.gumbel(rng, wins.shape, dtype=wins.dtype)
+        choice = jnp.argmax(logits + g, axis=3, keepdims=True)
+        return jnp.take_along_axis(wins, choice, axis=3)[:, :, :, 0, :]
+
+    def numpy_apply(self, params, x):
+        return super().numpy_apply(params, x)
+
+
+@matches(MaxPooling)
+class GDMaxPooling(GradientDescentBase):
+    MAPPING = "gd_max_pooling"
+
+
+@matches(AvgPooling)
+class GDAvgPooling(GradientDescentBase):
+    MAPPING = "gd_avg_pooling"

@@ -1,0 +1,156 @@
+"""StandardWorkflow: build a whole training graph from a layer-list config.
+
+Equivalent of Znicz ``standard_workflow`` (reference surface:
+docs/source/manualrst_veles_workflow_creation.rst:8-108 — a workflow is
+declared as ``layers=[{"type": "conv", ...}, {"type": "max_pooling", ...},
+{"type": "softmax", ...}]`` plus a loader). The graph it builds is the
+TPU-era training loop (SURVEY.md §7 stage 4):
+
+    StartPoint → Repeater → Loader → TrainStep → Decision ┐
+                    ↑                                      │ (not complete)
+                    └──────────────────────────────────────┘
+                                                           │ (complete)
+                         [Snapshotter] → EndPoint ←────────┘
+
+Forward/GD units exist as real graph-member units (so inference extraction,
+snapshots and introspection see them) but per-minibatch compute is the
+fused TrainStep. ``extract_forward_workflow`` mirrors the reference's
+inference extraction."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..accelerated import AcceleratedWorkflow
+from ..error import VelesError
+from ..units import UnitRegistry
+from .decision import DecisionGD, DecisionMSE
+from .evaluator import EvaluatorMSE, EvaluatorSoftmax
+from .lr_adjust import LearningRateAdjust
+from .nn_units import ForwardBase
+from ..plumbing import Repeater
+from .train_step import TrainStep
+
+
+def _unit_class(type_name: str) -> type:
+    cls = UnitRegistry.mapping.get(type_name)
+    if cls is None:
+        raise VelesError("unknown layer type %r (known: %s)" %
+                         (type_name, sorted(UnitRegistry.mapping)))
+    return cls
+
+
+class StandardWorkflow(AcceleratedWorkflow):
+    """Declarative train-graph builder (Znicz StandardWorkflowBase)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow=None, layers: Sequence[Dict[str, Any]] = (),
+                 loader_unit=None, loss_function: str = "softmax",
+                 decision_config: Optional[Dict[str, Any]] = None,
+                 lr_schedule=None, snapshotter_unit=None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.layers_config = list(layers)
+        self.loss_function = loss_function
+        self.loader = loader_unit
+        if self.loader is not None:
+            self.loader.workflow = self
+            self.add_ref(self.loader)
+        self.forwards: List[ForwardBase] = []
+        self.repeater = Repeater(self)
+        self._build_forwards()
+        self._build_trainer(decision_config or {}, lr_schedule)
+        if snapshotter_unit is not None:
+            self._attach_snapshotter(snapshotter_unit)
+        self._wire_loop()
+
+    # -- builders ------------------------------------------------------------
+    def _build_forwards(self) -> None:
+        prev = None
+        for i, cfg in enumerate(self.layers_config):
+            cfg = dict(cfg)
+            type_name = cfg.pop("type")
+            cls = _unit_class(type_name)
+            name = cfg.pop("name", "%s%d" % (type_name, i))
+            unit = cls(self, name=name, **cfg)
+            if prev is None:
+                unit.link_attrs(self.loader, ("input", "minibatch_data"))
+            else:
+                unit.link_attrs(prev, ("input", "output"))
+            self.forwards.append(unit)
+            prev = unit
+
+    def _build_trainer(self, decision_config, lr_schedule) -> None:
+        n_classes = None
+        if self.forwards and hasattr(self.forwards[-1], "neurons_number"):
+            n_classes = self.forwards[-1].neurons_number
+        if self.loss_function == "softmax":
+            self.evaluator = EvaluatorSoftmax(self, n_classes=n_classes)
+            self.decision = DecisionGD(self, **decision_config)
+            target_mode = "labels"
+        elif self.loss_function == "mse":
+            self.evaluator = EvaluatorMSE(self)
+            self.decision = DecisionMSE(self, **decision_config)
+            target_mode = decision_config.get("target_mode", "input") \
+                if isinstance(decision_config, dict) else "input"
+        else:
+            raise VelesError("unknown loss_function %r" % self.loss_function)
+        # mse target mode: reconstruct input unless loader carries targets
+        if self.loss_function == "mse":
+            has_targets = getattr(self.loader, "original_targets", None)
+            target_mode = "targets" if (has_targets is not None
+                                        and has_targets) else "input"
+        self.train_step = TrainStep(
+            self, forwards=self.forwards, evaluator=self.evaluator,
+            loader=self.loader, target_mode=target_mode)
+        self.decision.loader = self.loader
+        self.decision.step_unit = self.train_step
+        if lr_schedule is not None:
+            self.lr_adjust = LearningRateAdjust(self, schedule=lr_schedule)
+            self.lr_adjust.decision = self.decision
+            self.train_step.link_attrs(self.lr_adjust, "lr_scale")
+        else:
+            self.lr_adjust = None
+
+    def _attach_snapshotter(self, snap) -> None:
+        snap.workflow = self
+        self.add_ref(snap)
+        self.snapshotter = snap
+
+    def _wire_loop(self) -> None:
+        self.repeater.link_from(self.start_point)
+        self.loader.link_from(self.repeater)
+        self.train_step.link_from(self.loader)
+        tail = self.train_step
+        if self.lr_adjust is not None:
+            self.lr_adjust.link_from(self.train_step)
+            tail = self.lr_adjust
+        self.decision.link_from(tail)
+        self.repeater.link_from(self.decision)
+        self.repeater.gate_block = self.decision.complete
+        after = self.decision
+        snap = getattr(self, "snapshotter", None)
+        if snap is not None:
+            snap.link_from(self.decision)
+            snap.gate_skip = ~self.decision.complete & ~self.decision.improved
+            after = snap
+        self.end_point.link_from(after)
+        self.end_point.gate_block = ~self.decision.complete
+
+    # -- inference extraction (Znicz extract_forward_workflow) ---------------
+    def extract_forward_workflow(self) -> AcceleratedWorkflow:
+        """A plain chained-forward workflow over the same (trained) units."""
+        wf = AcceleratedWorkflow(name=self.name + ".forward")
+        self.train_step.sync_params_to_arrays()
+        prev = wf.start_point
+        for f in self.forwards:
+            f_w = f  # units are shared by reference; control links are new
+            f_w.unlink_all()
+            wf.add_ref(f_w)
+            f_w.link_from(prev)
+            prev = f_w
+        wf.end_point.link_from(prev)
+        return wf
+
+    def get_metric_values(self) -> Dict[str, Any]:
+        return self.decision.get_metric_values()

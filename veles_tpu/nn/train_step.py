@@ -1,0 +1,288 @@
+"""TrainStep: the fused, jitted, SPMD training step.
+
+THIS is the architectural heart of the TPU build (SURVEY.md §7 design
+stance). The reference executed one GPU kernel per unit per minibatch from
+Python threads (veles/units.py:782-505 hot loop) and aggregated gradients
+through a ZeroMQ master–slave parameter server (veles/server.py,
+veles/client.py). Here the entire minibatch — on-device dataset gather
+(fullbatch_loader.cl equivalent), every forward, the loss, every gradient
+(jax.grad — replacing all hand-written gd_* kernels), every optimizer
+update, and metric accumulation — is ONE compiled XLA program. Data
+parallelism falls out of sharding the minibatch over the mesh 'data' axis:
+XLA's SPMD partitioner inserts the gradient psum over ICI automatically
+(the BASELINE.json north star: "ZeroMQ master–slave → jax.lax.psum").
+
+Per-step host traffic is ZERO except the int32 index vector; metrics
+accumulate on device and are drained once per epoch by the Decision unit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy
+
+from ..accelerated import AcceleratedUnit
+from ..backends import XLADevice
+from ..error import Bug
+from ..loader.base import TEST, VALID, TRAIN
+from .. import prng
+from .nn_units import ForwardBase, GradientDescentBase, MATCHING
+from .all2all import All2AllSoftmax
+from .evaluator import EvaluatorSoftmax
+
+
+class TrainStep(AcceleratedUnit):
+    """Owns the canonical device-side parameter pytree and the compiled
+    train/eval step functions."""
+
+    MAPPING = "train_step"
+    hide_from_registry = False
+
+    def __init__(self, workflow, forwards: List[ForwardBase] = (),
+                 evaluator=None, loader=None, gds=None,
+                 target_mode: str = "labels", **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "TRAINER"
+        self.forwards = list(forwards)
+        self.evaluator = evaluator
+        self.loader = loader
+        #: "labels" (classification) | "targets" (regression) | "input"
+        #: (autoencoder: reconstruct the input batch)
+        self.target_mode = target_mode
+        self.gds: List[GradientDescentBase] = list(gds) if gds else []
+        self.lr_scale = 1.0        # linked from LearningRateAdjust
+        self.params: Dict[str, Dict[str, Any]] = {}
+        self.opt_state: Dict[str, Dict[str, Any]] = {}
+        self._accum: Dict[int, Any] = {}
+        self._zero_accum = None
+        self.last_loss = None
+        self.demand("evaluator", "loader")
+
+    # -- construction helpers ------------------------------------------------
+    def _ensure_gds(self) -> None:
+        """Create matched GD units for parameterized forwards lacking one
+        (Znicz MatchingObject pairing)."""
+        have = {gd.forward for gd in self.gds}
+        for f in self.forwards:
+            if f.PARAMETERIZED and f not in have:
+                gd_cls = MATCHING.get(type(f))
+                if gd_cls is None:
+                    for klass in type(f).__mro__:
+                        if klass in MATCHING:
+                            gd_cls = MATCHING[klass]
+                            break
+                if gd_cls is None:
+                    raise Bug("no GD unit matched for %s" % type(f).__name__)
+                gd = gd_cls(self.workflow, name="gd_" + f.name)
+                gd.forward = f
+                self.gds.append(gd)
+
+    def initialize(self, device=None, **kwargs):
+        res = super().initialize(device=device, **kwargs)
+        if res:
+            return res
+        # forwards must be initialized (params created) before us — they
+        # are if they appear earlier in dependency order; otherwise re-queue
+        for f in self.forwards:
+            if f.PARAMETERIZED and not getattr(f, "weights", None):
+                return True
+        self._ensure_gds()
+        gd_by_fwd = {gd.forward: gd for gd in self.gds}
+        self._gd_for = {f.name: gd_by_fwd[f]
+                        for f in self.forwards if f.PARAMETERIZED}
+        # canonical device pytree
+        import jax
+        self.params = {
+            f.name: {k: v.device_view() for k, v in f.param_arrays().items()}
+            for f in self.forwards if f.PARAMETERIZED}
+        self.opt_state = {
+            name: self._gd_for[name].init_state(p)
+            for name, p in self.params.items()}
+        self._rng = prng.get(self.name)
+        self._setup_shardings()
+        return None
+
+    def _setup_shardings(self) -> None:
+        """SPMD data parallelism: minibatch sharded over the mesh 'data'
+        axis, params/opt replicated. XLA's partitioner turns the gradient
+        reduction into a psum over ICI — the reference's entire ZeroMQ
+        master–slave plane (veles/server.py, veles/client.py) collapses to
+        this annotation."""
+        self._shardings = None
+        dev = self.device
+        if not isinstance(dev, XLADevice):
+            return
+        mesh = dev.mesh
+        if mesh.devices.size <= 1 or "data" not in mesh.axis_names:
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        repl = NamedSharding(mesh, P())
+        batch = NamedSharding(mesh, P("data"))
+        n_data = mesh.shape["data"]
+        if self.loader.max_minibatch_size % n_data:
+            raise Bug(
+                "minibatch size %d not divisible by data-axis size %d" %
+                (self.loader.max_minibatch_size, n_data))
+        self._shardings = {"repl": repl, "batch": batch}
+        # place canonical state replicated across the mesh
+        self.params = jax.device_put(self.params, repl)
+        self.opt_state = jax.device_put(self.opt_state, repl)
+
+    # -- pure functions -------------------------------------------------------
+    def _forward_pure(self, params, x, train: bool, rng):
+        """Compose the forward chain; softmax head yields logits for the
+        fused stable cross-entropy."""
+        import jax
+        last = self.forwards[-1] if self.forwards else None
+        use_logits = (isinstance(last, All2AllSoftmax)
+                      and isinstance(self.evaluator, EvaluatorSoftmax))
+        for i, f in enumerate(self.forwards):
+            layer_rng = (jax.random.fold_in(rng, i)
+                         if rng is not None else None)
+            p = params.get(f.name, {})
+            if f is last and use_logits:
+                return f.logits(p, x)
+            x = f.apply(p, x, train=train, rng=layer_rng)
+        return x
+
+    def _gather(self, dataset, indices):
+        import jax.numpy as jnp
+        return jnp.take(dataset, indices, axis=0)
+
+    def _target_for(self, batch, labels, targets, indices):
+        if self.target_mode == "labels":
+            return self._gather(labels, indices)
+        if self.target_mode == "input":
+            return batch
+        if self.target_mode == "targets":
+            return self._gather(targets, indices)
+        raise Bug("bad target_mode %r" % self.target_mode)
+
+    def _train_step_fn(self, params, opt_state, accum, dataset, labels,
+                       targets, indices, mask, lr_scale, rng):
+        import jax
+        batch = self._gather(dataset, indices)
+        tgt = self._target_for(batch, labels, targets, indices)
+
+        def loss_fn(p):
+            out = self._forward_pure(p, batch, True, rng)
+            return self.evaluator.loss(out, tgt, mask), out
+
+        (loss, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params)
+        new_params, new_opt = {}, {}
+        for name, p in params.items():
+            gd = self._gd_for[name]
+            new_params[name], new_opt[name] = gd.update(
+                p, grads[name], opt_state[name], lr_scale)
+        metrics = self.evaluator.metrics_fn(out, tgt, mask)
+        metrics["sum_loss"] = loss * mask.sum()
+        accum = jax.tree_util.tree_map(
+            lambda a, m: a + m, accum,
+            {k: metrics[k] for k in accum})
+        return new_params, new_opt, accum, loss
+
+    def _eval_step_fn(self, params, accum, dataset, labels, targets,
+                      indices, mask):
+        import jax
+        batch = self._gather(dataset, indices)
+        tgt = self._target_for(batch, labels, targets, indices)
+        out = self._forward_pure(params, batch, False, None)
+        metrics = self.evaluator.metrics_fn(out, tgt, mask)
+        metrics["sum_loss"] = self.evaluator.loss(out, tgt,
+                                                  mask) * mask.sum()
+        return jax.tree_util.tree_map(
+            lambda a, m: a + m, accum, {k: metrics[k] for k in accum})
+
+    def _make_zero_accum(self):
+        import jax.numpy as jnp
+        zeros = {"n_samples": jnp.zeros((), jnp.float32),
+                 "sum_loss": jnp.zeros((), jnp.float32)}
+        if isinstance(self.evaluator, EvaluatorSoftmax):
+            zeros["n_err"] = jnp.zeros((), jnp.float32)
+        else:
+            zeros["sum_sq"] = jnp.zeros((), jnp.float32)
+        return zeros
+
+    # -- execution -----------------------------------------------------------
+    def _inputs(self):
+        loader = self.loader
+        sh = self._shardings
+        repl = sh["repl"] if sh else None
+        batch = sh["batch"] if sh else None
+        dataset = loader.original_data.device_view(sharding=repl)
+        labels = (loader.original_labels.device_view(sharding=repl)
+                  if loader.original_labels else None)
+        targets = getattr(loader, "original_targets", None)
+        targets = (targets.device_view(sharding=repl)
+                   if targets is not None and targets else dataset)
+        if labels is None:
+            labels = self._dummy_labels(dataset)
+        indices = loader.minibatch_indices.device_view(sharding=batch)
+        mask = loader.minibatch_mask.device_view(sharding=batch)
+        return dataset, labels, targets, indices, mask
+
+    def _dummy_labels(self, dataset):
+        import jax.numpy as jnp
+        return jnp.zeros((dataset.shape[0],), jnp.int32)
+
+    def xla_run(self) -> None:
+        import jax
+        cls = self.loader.minibatch_class
+        accum = self._accum.get(cls)
+        if accum is None:
+            # fresh zeros per class: accum buffers are donated to the step
+            accum = self._accum[cls] = self._make_zero_accum()
+        dataset, labels, targets, indices, mask = self._inputs()
+        if cls == TRAIN:
+            fn = self.jit("train", self._train_step_fn,
+                          donate_argnums=(0, 1, 2))
+            self.params, self.opt_state, self._accum[cls], self.last_loss \
+                = fn(self.params, self.opt_state, accum, dataset, labels,
+                     targets, indices, mask,
+                     numpy.float32(self.lr_scale), self._rng.jax_key())
+        else:
+            fn = self.jit("eval", self._eval_step_fn, donate_argnums=(1,))
+            self._accum[cls] = fn(self.params, accum, dataset, labels,
+                                  targets, indices, mask)
+
+    def numpy_run(self) -> None:
+        # the fused step IS jax; on the numpy device it runs un-jitted on
+        # host arrays (oracle path exercised by tests via forwards'
+        # numpy_apply separately)
+        self.xla_run()
+
+    # -- epoch drain (Decision pulls these) ----------------------------------
+    def drain_epoch_metrics(self) -> Dict[int, Dict[str, float]]:
+        import jax
+        out = {}
+        for cls, accum in self._accum.items():
+            host = jax.device_get(accum)
+            out[cls] = {k: float(v) for k, v in host.items()}
+        self._accum.clear()
+        return out
+
+    # -- checkpoint/pickle support -------------------------------------------
+    def sync_params_to_arrays(self) -> None:
+        """Write the canonical device params back into the forwards' Arrays
+        (so snapshots and host-side units observe trained weights)."""
+        for f in self.forwards:
+            if not f.PARAMETERIZED:
+                continue
+            arrays = f.param_arrays()
+            for k, v in self.params.get(f.name, {}).items():
+                arrays[k].assign_devmem(v)
+
+    def stop(self) -> None:
+        if self.params:
+            self.sync_params_to_arrays()
+
+    def __getstate__(self):
+        self.sync_params_to_arrays()
+        d = super().__getstate__()
+        for k in ("params", "opt_state", "_accum", "_zero_accum",
+                  "last_loss"):
+            d[k] = {} if k in ("params", "opt_state", "_accum") else None
+        return d

@@ -138,8 +138,9 @@ class Workflow(Unit):
                     raise Bug("workflow %s exceeded max_steps=%d" %
                               (self.name, self._max_steps))
         finally:
+            # run_count is incremented by Unit.process when nested; a bare
+            # top-level run() tracks time only (no double counting)
             self._run_time += time.time() - t0
-            self.run_count += 1
             self.event("workflow.run", "end", workflow=self.name, steps=steps)
 
     def on_workflow_finished(self) -> None:
