@@ -1,0 +1,74 @@
+"""Driver benchmark: prints ONE JSON line with the headline metric.
+
+Metric (BASELINE.json): Znicz MNIST-784 workflow training throughput,
+samples/sec/chip, on the fused SPMD step. The reference published no
+throughput numbers ("published": {}), so vs_baseline is against the first
+recorded number of this build (stored in BENCH_BASELINE.json after the
+first run; 1.0 on the first run).
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import veles_tpu as vt
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "models"))
+    from mnist import build_workflow
+
+    dev = vt.Device_for("auto")
+    n_chips = getattr(dev, "device_count", 1)
+
+    # large dispatch plan: 600 train minibatches → few dispatches
+    wf = build_workflow(epochs=10 ** 9, minibatch_size=100)
+    wf.train_step.loader.plan_steps = 50
+    wf.loader.plan_steps = 50
+    wf.initialize(device=dev)
+
+    loader, step = wf.loader, wf.train_step
+
+    def run_epoch():
+        served0 = loader.samples_served
+        while True:
+            loader.run()
+            step.run()
+            if bool(loader.epoch_ended):
+                break
+        return loader.samples_served - served0
+
+    run_epoch()                  # warmup: compile + first placement
+    import jax
+    jax.block_until_ready(step.params)
+    t0 = time.time()
+    n = 0
+    epochs = 0
+    while time.time() - t0 < 10.0 or epochs < 2:
+        n += run_epoch()
+        epochs += 1
+    jax.block_until_ready(step.params)
+    dt = time.time() - t0
+    sps = n / dt / n_chips
+
+    base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_BASELINE.json")
+    if os.path.exists(base_path):
+        with open(base_path) as f:
+            base = json.load(f)["value"]
+    else:
+        base = sps
+        with open(base_path, "w") as f:
+            json.dump({"value": sps, "ts": time.time()}, f)
+    print(json.dumps({
+        "metric": "mnist784_train_samples_per_sec_per_chip",
+        "value": round(sps, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round(sps / base, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -164,3 +164,13 @@ def test_gd_unit_standalone_updates_weights():
     w_after = fwd.weights.map_read()
     assert not numpy.allclose(w_before, w_after)
     assert gd.err_input.shape == (4, 5)
+
+
+def test_relu_softplus_oracle_large_inputs():
+    u = nn.ForwardRelu(vt.Workflow(name="t"))
+    x = numpy.array([[-100.0, -1.0, 0.0, 1.0, 60.0, 500.0]],
+                    dtype=numpy.float32)
+    import jax
+    y_dev = numpy.asarray(jax.jit(lambda z: u.apply({}, z))(x))
+    y_np = u.numpy_apply({}, x)
+    numpy.testing.assert_allclose(y_dev, y_np, rtol=1e-5, atol=1e-6)

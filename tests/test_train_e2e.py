@@ -108,3 +108,22 @@ def test_data_parallel_requires_divisible_minibatch():
     dev = vt.XLADevice(mesh_axes={"data": 8})
     with pytest.raises(vt.Bug):
         wf.initialize(device=dev)
+
+
+def test_extract_forward_workflow_inference():
+    """Inference extraction: trained forwards chained, fed a real batch
+    (must NOT see the never-filled fused minibatch zeros)."""
+    wf = make_workflow()
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    fwf = wf.extract_forward_workflow()
+    x = wf.loader.original_data.mem[:20]
+    y_true = wf.loader.original_labels.mem[:20]
+    from veles_tpu.memory import Array
+    wf.forwards[0].input = Array(x, name="x")
+    fwf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    fwf.run()
+    probs = wf.forwards[-1].output.map_read()
+    assert probs.shape == (20, 3)
+    acc = (probs.argmax(1) == y_true).mean()
+    assert acc > 0.9, acc

@@ -58,6 +58,15 @@ class Loader(Unit):
         self.minibatch_class = TRAIN
         self.minibatch_size = 0          # valid samples in this minibatch
         self.minibatch_offset = 0
+        #: serve N minibatches per run() as a (N, mb) index plan — the
+        #: fused TrainStep scans over them in ONE device dispatch (kills
+        #: per-step dispatch latency; crucial over a tunnelled TPU)
+        self.plan_steps = 1
+        #: number of valid rows in the current plan
+        self.plan_length = 1
+        #: when True, a fused step consumes indices on device and the host
+        #: minibatch_data fill is skipped entirely
+        self.fused = False
         self._global_offset = 0
         self._shuffled_indices: Optional[numpy.ndarray] = None
         self.samples_served = 0
@@ -111,8 +120,14 @@ class Loader(Unit):
         self.shuffle()
         self.create_minibatch_data()
         n = self.max_minibatch_size
-        self.minibatch_indices.reset(numpy.zeros(n, dtype=numpy.int32))
-        self.minibatch_mask.reset(numpy.zeros(n, dtype=numpy.float32))
+        k = self.plan_steps
+        if k > 1 and not self.fused:
+            from ..error import Bug
+            raise Bug("plan_steps>1 requires a fused consumer (host "
+                      "fill_minibatch cannot batch plans)")
+        shape = (k, n) if k > 1 else (n,)
+        self.minibatch_indices.reset(numpy.zeros(shape, dtype=numpy.int32))
+        self.minibatch_mask.reset(numpy.zeros(shape, dtype=numpy.float32))
         self.info(
             "%s: %d samples (test=%d validation=%d train=%d), mb=%d",
             self.name, self.total_samples, *self.class_lengths, n)
@@ -131,10 +146,12 @@ class Loader(Unit):
 
     # -- the serving loop ----------------------------------------------------
     def run(self) -> None:
-        self.serve_next_minibatch()
+        if self.plan_steps > 1:
+            self.serve_plan()
+        else:
+            self.serve_next_minibatch()
 
-    def serve_next_minibatch(self) -> None:
-        """(reference: veles/loader/base.py:726)"""
+    def _begin_serving(self) -> None:
         if bool(self.epoch_ended):
             # previous run ended the epoch: start a new one
             self.epoch_number += 1
@@ -145,26 +162,25 @@ class Loader(Unit):
         self.train_ended <<= False
         self.test_ended <<= False
 
+    def _next_geometry(self):
+        """(offset, class, valid_size) of the next minibatch."""
         offset = self._global_offset
         cls = self.class_of_offset(offset)
-        end_of_class = self.class_end_offsets[cls]
-        size = min(self.max_minibatch_size, end_of_class - offset)
-        self.minibatch_offset = offset
-        self.minibatch_class = cls
-        self.minibatch_size = size
+        size = min(self.max_minibatch_size,
+                   self.class_end_offsets[cls] - offset)
+        return offset, cls, size
 
-        idx = self.minibatch_indices.map_invalidate()
-        idx[:size] = self._shuffled_indices[offset:offset + size]
-        idx[size:] = idx[size - 1] if size else 0   # pad with a valid index
-        mask = self.minibatch_mask.map_invalidate()
-        mask[:size] = 1.0
-        mask[size:] = 0.0
+    def _fill_row(self, idx_row, mask_row, offset, size) -> None:
+        idx_row[:size] = self._shuffled_indices[offset:offset + size]
+        idx_row[size:] = idx_row[size - 1] if size else 0
+        mask_row[:size] = 1.0
+        mask_row[size:] = 0.0
 
-        self.fill_minibatch()
+    def _advance(self, cls, size) -> None:
+        """Move the global offset and update flags
+        (reference: veles/loader/base.py:862-878)."""
         self.samples_served += size
-        self._global_offset = offset + size
-
-        # flags (reference :862-878)
+        self._global_offset += size
         if self._global_offset >= self.class_end_offsets[cls]:
             if cls == TEST:
                 self.test_ended <<= True
@@ -174,6 +190,48 @@ class Loader(Unit):
             self.last_minibatch <<= True
             self.epoch_ended <<= True
             self.event("epoch", "single", number=self.epoch_number)
+
+    def serve_next_minibatch(self) -> None:
+        """(reference: veles/loader/base.py:726)"""
+        self._begin_serving()
+        offset, cls, size = self._next_geometry()
+        self.minibatch_offset = offset
+        self.minibatch_class = cls
+        self.minibatch_size = size
+        self._fill_row(self.minibatch_indices.map_invalidate(),
+                       self.minibatch_mask.map_invalidate(), offset, size)
+        if not self.fused:
+            self.fill_minibatch()
+        self._advance(cls, size)
+
+    def serve_plan(self) -> None:
+        """Serve up to plan_steps minibatches of ONE sample class as a
+        (plan_steps, mb) index/mask plan; unused rows are mask-zero.
+        Stops early at class or epoch boundaries so Decision/flag semantics
+        stay exact."""
+        self._begin_serving()
+        idx = self.minibatch_indices.map_invalidate()
+        mask = self.minibatch_mask.map_invalidate()
+        first_cls = None
+        k = 0
+        while k < self.plan_steps:
+            if self._global_offset >= self.total_samples:
+                break
+            offset, cls, size = self._next_geometry()
+            if first_cls is None:
+                first_cls = cls
+                self.minibatch_offset = offset
+            elif cls != first_cls:
+                break
+            self._fill_row(idx[k], mask[k], offset, size)
+            self._advance(cls, size)
+            k += 1
+        mask[k:] = 0.0
+        idx[k:] = 0
+        self.minibatch_class = first_cls if first_cls is not None else TRAIN
+        self.plan_length = k
+        self.minibatch_size = int(mask.sum())
+        # no host fill: plan mode is fused-only (enforced at initialize)
 
     # -- introspection -------------------------------------------------------
     def get_metric_values(self) -> Dict[str, object]:

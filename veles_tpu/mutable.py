@@ -149,6 +149,17 @@ class LinkableAttribute:
         links[dst_attr] = (src, src_attr)
 
 
+    @staticmethod
+    def unlink(obj: Any, attr: str) -> None:
+        """Remove a pointer: the attribute keeps its current value as plain
+        instance storage and stops tracking the link source."""
+        links = obj.__dict__.get("__linked__", {})
+        if attr in links:
+            value = getattr(obj, attr)
+            del links[attr]
+            obj.__dict__[attr] = value
+
+
 def link(dst: Any, dst_attr: str, src: Any, src_attr: str = None,
          two_way: bool = False) -> None:
     LinkableAttribute.link(dst, dst_attr, src, src_attr or dst_attr, two_way)

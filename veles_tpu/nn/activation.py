@@ -41,8 +41,8 @@ class ForwardRelu(ActivationForward):
         return jax.nn.softplus(x)
 
     def numpy_apply(self, params, x):
-        return numpy.log1p(numpy.exp(numpy.minimum(x, 50))) + \
-            numpy.maximum(x, 0) * (x > 50)
+        # stable softplus: max(x,0) + log1p(exp(-|x|))
+        return numpy.maximum(x, 0) + numpy.log1p(numpy.exp(-numpy.abs(x)))
 
 
 class ForwardStrictRelu(ActivationForward):

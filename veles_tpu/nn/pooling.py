@@ -35,10 +35,6 @@ class Pooling(ForwardBase):
                 yield i, j, x[:, i * sy:i * sy + self.ky,
                               j * sx:j * sx + self.kx, :]
 
-    def _pad_same(self):
-        # SAME_LOWER-style padding covering ceil-mode edges
-        return "SAME" if False else None
-
 
 class MaxPooling(Pooling):
     MAPPING = "max_pooling"

@@ -48,7 +48,11 @@ class StandardWorkflow(AcceleratedWorkflow):
     def __init__(self, workflow=None, layers: Sequence[Dict[str, Any]] = (),
                  loader_unit=None, loss_function: str = "softmax",
                  decision_config: Optional[Dict[str, Any]] = None,
-                 lr_schedule=None, snapshotter_unit=None, **kwargs):
+                 lr_schedule=None, snapshotter_unit=None,
+                 steps_per_dispatch: int = 16, target_mode: str = None,
+                 **kwargs):
+        self._steps_per_dispatch = steps_per_dispatch
+        self._target_mode = target_mode
         super().__init__(workflow, **kwargs)
         self.layers_config = list(layers)
         self.loss_function = loss_function
@@ -91,18 +95,15 @@ class StandardWorkflow(AcceleratedWorkflow):
         elif self.loss_function == "mse":
             self.evaluator = EvaluatorMSE(self)
             self.decision = DecisionMSE(self, **decision_config)
-            target_mode = decision_config.get("target_mode", "input") \
-                if isinstance(decision_config, dict) else "input"
+            # loader data isn't loaded yet — TrainStep resolves at init:
+            # targets if the loader carries them, else reconstruct input
+            target_mode = self._target_mode or "auto"
         else:
             raise VelesError("unknown loss_function %r" % self.loss_function)
-        # mse target mode: reconstruct input unless loader carries targets
-        if self.loss_function == "mse":
-            has_targets = getattr(self.loader, "original_targets", None)
-            target_mode = "targets" if (has_targets is not None
-                                        and has_targets) else "input"
         self.train_step = TrainStep(
             self, forwards=self.forwards, evaluator=self.evaluator,
-            loader=self.loader, target_mode=target_mode)
+            loader=self.loader, target_mode=target_mode,
+            steps_per_dispatch=self._steps_per_dispatch)
         self.decision.loader = self.loader
         self.decision.step_unit = self.train_step
         if lr_schedule is not None:
@@ -140,15 +141,19 @@ class StandardWorkflow(AcceleratedWorkflow):
     # -- inference extraction (Znicz extract_forward_workflow) ---------------
     def extract_forward_workflow(self) -> AcceleratedWorkflow:
         """A plain chained-forward workflow over the same (trained) units."""
+        from ..mutable import LinkableAttribute
         wf = AcceleratedWorkflow(name=self.name + ".forward")
         self.train_step.sync_params_to_arrays()
         prev = wf.start_point
-        for f in self.forwards:
-            f_w = f  # units are shared by reference; control links are new
-            f_w.unlink_all()
-            wf.add_ref(f_w)
-            f_w.link_from(prev)
-            prev = f_w
+        for i, f in enumerate(self.forwards):
+            f.unlink_all()
+            if i == 0:
+                # detach from the (fused, never-filled) loader minibatch:
+                # the caller assigns f.input directly
+                LinkableAttribute.unlink(f, "input")
+            wf.add_ref(f)
+            f.link_from(prev)
+            prev = f
         wf.end_point.link_from(prev)
         return wf
 

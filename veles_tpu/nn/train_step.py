@@ -41,14 +41,21 @@ class TrainStep(AcceleratedUnit):
 
     def __init__(self, workflow, forwards: List[ForwardBase] = (),
                  evaluator=None, loader=None, gds=None,
-                 target_mode: str = "labels", **kwargs):
+                 target_mode: str = "labels", steps_per_dispatch: int = 16,
+                 **kwargs):
         super().__init__(workflow, **kwargs)
         self.view_group = "TRAINER"
         self.forwards = list(forwards)
         self.evaluator = evaluator
         self.loader = loader
+        if loader is not None:
+            # fused consumption: host minibatch fill skipped; K minibatches
+            # scanned per dispatch (must be set before loader.initialize)
+            loader.fused = True
+            loader.plan_steps = max(1, int(steps_per_dispatch))
         #: "labels" (classification) | "targets" (regression) | "input"
-        #: (autoencoder: reconstruct the input batch)
+        #: (autoencoder: reconstruct the input batch) | "auto" (resolve at
+        #: initialize, after the loader has loaded: targets if present)
         self.target_mode = target_mode
         self.gds: List[GradientDescentBase] = list(gds) if gds else []
         self.lr_scale = 1.0        # linked from LearningRateAdjust
@@ -100,6 +107,11 @@ class TrainStep(AcceleratedUnit):
             name: self._gd_for[name].init_state(p)
             for name, p in self.params.items()}
         self._rng = prng.get(self.name)
+        if self.target_mode == "auto":
+            # resolvable only now: the loader's load_data has run
+            has_t = getattr(self.loader, "original_targets", None)
+            self.target_mode = ("targets" if has_t is not None and has_t
+                                else "input")
         self._setup_shardings()
         return None
 
@@ -172,17 +184,46 @@ class TrainStep(AcceleratedUnit):
 
         (loss, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params)
+        import jax.numpy as jnp
+        valid = mask.sum() > 0  # all-padded plan rows must not decay params
         new_params, new_opt = {}, {}
         for name, p in params.items():
             gd = self._gd_for[name]
-            new_params[name], new_opt[name] = gd.update(
-                p, grads[name], opt_state[name], lr_scale)
+            up_p, up_s = gd.update(p, grads[name], opt_state[name],
+                                   lr_scale)
+            new_params[name] = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(valid, new, old), up_p, p)
+            new_opt[name] = jax.tree_util.tree_map(
+                lambda new, old: jnp.where(valid, new, old), up_s,
+                opt_state[name])
         metrics = self.evaluator.metrics_fn(out, tgt, mask)
         metrics["sum_loss"] = loss * mask.sum()
         accum = jax.tree_util.tree_map(
             lambda a, m: a + m, accum,
             {k: metrics[k] for k in accum})
         return new_params, new_opt, accum, loss
+
+    def _train_plan_fn(self, params, opt_state, accum, dataset, labels,
+                       targets, idx_plan, mask_plan, lr_scale, rng):
+        """lax.scan over a (K, mb) index plan: K optimizer steps in ONE
+        dispatch. The TPU-era answer to per-unit dispatch overhead —
+        sequential dependence between steps is real (param updates), so
+        scan, not vmap."""
+        import jax
+
+        def body(carry, xs):
+            p, o, a = carry
+            idx, msk, i = xs
+            step_rng = jax.random.fold_in(rng, i)
+            p, o, a, loss = self._train_step_fn(
+                p, o, a, dataset, labels, targets, idx, msk, lr_scale,
+                step_rng)
+            return (p, o, a), loss
+        import jax.numpy as jnp
+        steps = jnp.arange(idx_plan.shape[0])
+        (params, opt_state, accum), losses = jax.lax.scan(
+            body, (params, opt_state, accum), (idx_plan, mask_plan, steps))
+        return params, opt_state, accum, losses[-1]
 
     def _eval_step_fn(self, params, accum, dataset, labels, targets,
                       indices, mask):
@@ -195,6 +236,17 @@ class TrainStep(AcceleratedUnit):
                                                   mask) * mask.sum()
         return jax.tree_util.tree_map(
             lambda a, m: a + m, accum, {k: metrics[k] for k in accum})
+
+    def _eval_plan_fn(self, params, accum, dataset, labels, targets,
+                      idx_plan, mask_plan):
+        import jax
+
+        def body(a, xs):
+            idx, msk = xs
+            return self._eval_step_fn(params, a, dataset, labels, targets,
+                                      idx, msk), None
+        accum, _ = jax.lax.scan(body, accum, (idx_plan, mask_plan))
+        return accum
 
     def _make_zero_accum(self):
         import jax.numpy as jnp
@@ -220,6 +272,10 @@ class TrainStep(AcceleratedUnit):
                    if targets is not None and targets else dataset)
         if labels is None:
             labels = self._dummy_labels(dataset)
+        if batch is not None and loader.plan_steps > 1:
+            # plans are (K, mb): shard the minibatch axis, not the scan axis
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            batch = NamedSharding(batch.mesh, P(None, "data"))
         indices = loader.minibatch_indices.device_view(sharding=batch)
         mask = loader.minibatch_mask.device_view(sharding=batch)
         return dataset, labels, targets, indices, mask
@@ -236,15 +292,20 @@ class TrainStep(AcceleratedUnit):
             # fresh zeros per class: accum buffers are donated to the step
             accum = self._accum[cls] = self._make_zero_accum()
         dataset, labels, targets, indices, mask = self._inputs()
+        planned = self.loader.plan_steps > 1
         if cls == TRAIN:
-            fn = self.jit("train", self._train_step_fn,
+            fn = self.jit("train",
+                          self._train_plan_fn if planned
+                          else self._train_step_fn,
                           donate_argnums=(0, 1, 2))
             self.params, self.opt_state, self._accum[cls], self.last_loss \
                 = fn(self.params, self.opt_state, accum, dataset, labels,
                      targets, indices, mask,
                      numpy.float32(self.lr_scale), self._rng.jax_key())
         else:
-            fn = self.jit("eval", self._eval_step_fn, donate_argnums=(1,))
+            fn = self.jit("eval",
+                          self._eval_plan_fn if planned
+                          else self._eval_step_fn, donate_argnums=(1,))
             self._accum[cls] = fn(self.params, accum, dataset, labels,
                                   targets, indices, mask)
 
