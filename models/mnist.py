@@ -35,8 +35,11 @@ class MnistLoader(FullBatchLoader):
         self.class_lengths = [0, len(vx), len(tx)]
 
 
-def build_workflow(epochs=10, minibatch_size=100, lr=0.03):
+def build_workflow(epochs=10, minibatch_size=100, lr=0.03,
+                   snapshot_dir=None):
     loader = MnistLoader(None, minibatch_size=minibatch_size, name="mnist")
+    snap = (vt.Snapshotter(None, prefix="mnist", directory=snapshot_dir)
+            if snapshot_dir else None)
     wf = nn.StandardWorkflow(
         name="mnist-784",
         layers=[
@@ -49,6 +52,7 @@ def build_workflow(epochs=10, minibatch_size=100, lr=0.03):
         loss_function="softmax",
         decision_config=dict(max_epochs=epochs, fail_iterations=50),
         lr_schedule=nn.exp_decay(0.98),
+        snapshotter_unit=snap,
     )
     return wf
 
@@ -59,11 +63,19 @@ def main(argv=None):
     p.add_argument("--mb", type=int, default=100)
     p.add_argument("--lr", type=float, default=0.03)
     p.add_argument("--backend", default="auto")
+    p.add_argument("--snapshot-dir", default=None)
+    p.add_argument("--resume", default=None,
+                   help="snapshot file to resume from")
     args = p.parse_args(argv)
 
-    wf = build_workflow(args.epochs, args.mb, args.lr)
+    wf = build_workflow(args.epochs, args.mb, args.lr, args.snapshot_dir)
     device = vt.Device_for(args.backend)
     wf.initialize(device=device)
+    if args.resume:
+        vt.resume(wf, args.resume)
+        wf.decision.complete <<= False
+        print("resumed from %s at epoch %d" %
+              (args.resume, wf.decision.epoch_number))
     t0 = time.time()
     wf.run()
     dt = time.time() - t0

@@ -1,0 +1,137 @@
+"""Checkpoint/resume: mirrors the reference's snapshot guarantees
+(SURVEY.md §5.4) — resume restores params, optimizer state, loader
+position, decision bests AND RNG streams; training continuation after
+resume is identical to uninterrupted training."""
+import glob
+import os
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn, prng
+from veles_tpu.loader import FullBatchLoader
+
+
+class TinyLoader(FullBatchLoader):
+    hide_from_registry = True
+
+    def load_data(self):
+        rng = numpy.random.RandomState(5)
+        n = 240
+        self.create_originals(rng.rand(n, 8).astype(numpy.float32),
+                              rng.randint(0, 3, n).astype(numpy.int32))
+        self.class_lengths = [0, 40, 200]
+
+
+def build(tmpdir, max_epochs, with_snap=True, lr_schedule=None):
+    loader = TinyLoader(None, minibatch_size=20, name="tiny")
+    snap = vt.Snapshotter(None, prefix="tiny", directory=str(tmpdir),
+                          compression="gz") if with_snap else None
+    wf = nn.StandardWorkflow(
+        name="snap-wf",
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 8},
+                {"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=max_epochs, fail_iterations=99),
+        snapshotter_unit=snap, steps_per_dispatch=4,
+        lr_schedule=lr_schedule,
+    )
+    return wf
+
+
+def fresh_prng():
+    with prng._lock:
+        prng._generators.clear()
+    prng.seed_all(1234)
+
+
+def test_snapshot_write_and_current_symlink(tmp_path):
+    fresh_prng()
+    wf = build(tmp_path, 3)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    files = glob.glob(str(tmp_path / "tiny_*.pickle.gz"))
+    assert files, "no snapshot written"
+    cur = tmp_path / "tiny_current.pickle.gz"
+    assert cur.exists()
+    state = vt.load_snapshot(str(cur))
+    assert "all2all_tanh0" in state["__units__"]
+    assert "weights" in state["__units__"]["all2all_tanh0"]
+
+
+def test_resume_restores_everything(tmp_path):
+    fresh_prng()
+    wf = build(tmp_path, 4)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    cur = str(tmp_path / "tiny_current.pickle.gz")
+    w_trained = numpy.array(wf.forwards[0].weights.map_read())
+    epoch = wf.decision.epoch_number
+    best = wf.decision.best_metric
+
+    fresh_prng()
+    wf2 = build(tmp_path, 4, with_snap=False)
+    wf2.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    vt.resume(wf2, cur)
+    numpy.testing.assert_array_equal(
+        wf2.forwards[0].weights.map_read(), w_trained)
+    assert wf2.decision.epoch_number == epoch
+    assert wf2.decision.best_metric == best
+    assert wf2.loader.epoch_number == wf.loader.epoch_number
+    assert wf2.restored_from_snapshot
+
+
+def test_resume_continuation_identical(tmp_path):
+    """Train 2+2 epochs with a snapshot boundary vs 4 straight epochs:
+    final weights must match exactly (RNG/shuffle/lr-schedule state
+    restored)."""
+    sched = nn.exp_decay(0.9)
+    fresh_prng()
+    wf_a = build(tmp_path / "a", 4, with_snap=False, lr_schedule=sched)
+    wf_a.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf_a.run()
+    w_straight = numpy.array(wf_a.forwards[0].weights.map_read())
+
+    fresh_prng()
+    wf_b1 = build(tmp_path / "b", 2, lr_schedule=nn.exp_decay(0.9))
+    wf_b1.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf_b1.run()
+    cur = str(tmp_path / "b" / "tiny_current.pickle.gz")
+
+    fresh_prng()
+    wf_b2 = build(tmp_path / "b2", 4, with_snap=False,
+                  lr_schedule=nn.exp_decay(0.9))
+    wf_b2.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    vt.resume(wf_b2, cur)
+    wf_b2.decision.complete <<= False
+    wf_b2.run()
+    w_resumed = numpy.array(wf_b2.forwards[0].weights.map_read())
+    numpy.testing.assert_allclose(w_straight, w_resumed, rtol=1e-6,
+                                  atol=1e-7)
+
+
+def test_snapshot_gating_interval(tmp_path):
+    fresh_prng()
+    snap = vt.Snapshotter(None, prefix="g", directory=str(tmp_path),
+                          interval=3)
+    wf = vt.Workflow(name="w")
+    snap.workflow = wf
+    wf.add_ref(snap)
+    wf.initialize()
+    for _ in range(6):
+        snap.run()
+    files = glob.glob(str(tmp_path / "g_2*.pickle.gz"))
+    assert len(files) == 2           # runs 3 and 6
+
+
+def test_snapshot_skip_bool(tmp_path):
+    fresh_prng()
+    snap = vt.Snapshotter(None, prefix="s", directory=str(tmp_path))
+    wf = vt.Workflow(name="w")
+    snap.workflow = wf
+    wf.add_ref(snap)
+    wf.initialize()
+    snap.skip <<= True
+    snap.run()
+    assert not glob.glob(str(tmp_path / "s_*"))

@@ -22,4 +22,7 @@ from .backends import (Device_for, XLADevice, NumpyDevice,
                        make_mesh)                     # noqa: F401
 from .accelerated import (AcceleratedUnit,
                           AcceleratedWorkflow)        # noqa: F401
+from .snapshotter import (Snapshotter, load_snapshot,
+                          resume, collect_state,
+                          apply_state)                # noqa: F401
 from . import prng                                    # noqa: F401

@@ -233,6 +233,32 @@ class Loader(Unit):
         self.minibatch_size = int(mask.sum())
         # no host fill: plan mode is fused-only (enforced at initialize)
 
+    # -- checkpoint protocol -------------------------------------------------
+    def state_dict(self):
+        return {
+            "epoch_number": self.epoch_number,
+            "global_offset": self._global_offset,
+            "shuffled_indices": (None if self._shuffled_indices is None
+                                 else numpy.array(self._shuffled_indices)),
+            "samples_served": self.samples_served,
+            "flags": {"epoch_ended": bool(self.epoch_ended),
+                      "last_minibatch": bool(self.last_minibatch),
+                      "train_ended": bool(self.train_ended),
+                      "test_ended": bool(self.test_ended)},
+        }
+
+    def load_state_dict(self, sd) -> None:
+        self.epoch_number = sd["epoch_number"]
+        self._global_offset = sd["global_offset"]
+        if sd["shuffled_indices"] is not None:
+            self._shuffled_indices = numpy.array(sd["shuffled_indices"])
+        self.samples_served = sd["samples_served"]
+        flags = sd["flags"]
+        self.epoch_ended <<= flags["epoch_ended"]
+        self.last_minibatch <<= flags["last_minibatch"]
+        self.train_ended <<= flags["train_ended"]
+        self.test_ended <<= flags["test_ended"]
+
     # -- introspection -------------------------------------------------------
     def get_metric_values(self) -> Dict[str, object]:
         return {"epochs_served": self.epoch_number,

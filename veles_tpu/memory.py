@@ -121,6 +121,10 @@ class Array(Logger):
     def map_read(self) -> numpy.ndarray:
         with self._lock:
             if self._dev_newer:
+                if getattr(self.devmem, "is_deleted", lambda: False)():
+                    raise Bug(
+                        "Array %s: device buffer was deleted (donated to a "
+                        "jitted step?) before host sync" % self.name)
                 host = numpy.asarray(self.devmem)  # may be a read-only view
                 if self.mem is not None and host.dtype != self.mem.dtype:
                     host = host.astype(self.mem.dtype)
@@ -146,6 +150,16 @@ class Array(Logger):
 
     def unmap(self) -> None:
         """No-op kept for API parity (jax has no mapped pointers)."""
+
+    def detach_devmem(self) -> None:
+        """Forget the device copy, keeping the current host mirror as
+        canonical. Used when another owner (e.g. a fused step's parameter
+        pytree) takes over the device side and may donate those buffers."""
+        with self._lock:
+            if self._dev_newer:
+                self.map_read()
+            self._drop_devmem()
+            self._host_newer = self.mem is not None
 
     def assign_devmem(self, devmem) -> None:
         """Adopt a device array produced by a jitted step (device becomes the
@@ -174,6 +188,13 @@ class Array(Logger):
                     raise Bug("Array %s: device_view before reset" %
                               self.name)
                 src = self.mem if dtype is None else self.mem.astype(dtype)
+                # ALWAYS copy the staging buffer: on host-backed platforms
+                # jax.device_put can be zero-copy, and a later in-place
+                # host mutation (e.g. the loader refilling minibatch
+                # indices) would race with the async computation still
+                # reading this memory
+                if src is self.mem:
+                    src = numpy.array(src)
                 dev = (jax.device_put(src, sharding) if sharding is not None
                        else jax.device_put(src))
                 self._account(dev)

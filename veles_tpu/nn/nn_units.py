@@ -112,6 +112,20 @@ class ForwardBase(AcceleratedUnit):
                     shape, dtype=root.common.engine.precision_type))
         return None
 
+    # -- checkpoint protocol (SURVEY.md §5.4 explicit state schema) ----------
+    def state_dict(self) -> Dict[str, numpy.ndarray]:
+        return {k: numpy.array(v.map_read())
+                for k, v in self.param_arrays().items()}
+
+    def load_state_dict(self, sd: Dict[str, numpy.ndarray]) -> None:
+        for k, v in sd.items():
+            arr = getattr(self, k, None)
+            if isinstance(arr, Array):
+                arr.reset(numpy.array(v))
+            else:
+                setattr(self, k, Array(numpy.array(v),
+                                       name="%s.%s" % (self.name, k)))
+
     def xla_run(self) -> None:
         params = {k: v.device_view() for k, v in self.param_arrays().items()}
         fn = self.jit("apply", lambda p, x: self.apply(p, x, train=False))

@@ -106,6 +106,11 @@ class TrainStep(AcceleratedUnit):
         self.opt_state = {
             name: self._gd_for[name].init_state(p)
             for name, p in self.params.items()}
+        # the step owns (and donates) the device-side params from here on;
+        # the forwards' Arrays keep their host mirror only
+        for f in self.forwards:
+            for arr in f.param_arrays().values():
+                arr.detach_devmem()
         self._rng = prng.get(self.name)
         if self.target_mode == "auto":
             # resolvable only now: the loader's load_data has run
@@ -327,18 +332,57 @@ class TrainStep(AcceleratedUnit):
 
     # -- checkpoint/pickle support -------------------------------------------
     def sync_params_to_arrays(self) -> None:
-        """Write the canonical device params back into the forwards' Arrays
-        (so snapshots and host-side units observe trained weights)."""
+        """Copy the canonical device params back into the forwards' host
+        Arrays (so snapshots and host-side units observe trained weights).
+        Host copies, not buffer refs: the step donates its param buffers on
+        the next dispatch, which would leave the Arrays dangling."""
+        import jax
         for f in self.forwards:
             if not f.PARAMETERIZED:
                 continue
             arrays = f.param_arrays()
             for k, v in self.params.get(f.name, {}).items():
-                arrays[k].assign_devmem(v)
+                arrays[k].reset(numpy.array(jax.device_get(v)))
 
     def stop(self) -> None:
         if self.params:
             self.sync_params_to_arrays()
+
+    # -- checkpoint protocol -------------------------------------------------
+    def on_snapshot(self) -> None:
+        if self.params:
+            self.sync_params_to_arrays()
+
+    def state_dict(self):
+        import jax
+        return {"opt_state": jax.device_get(self.opt_state),
+                "lr_scale": float(self.lr_scale)}
+
+    def load_state_dict(self, sd) -> None:
+        """Called after the forwards restored their Arrays (apply order =
+        unit construction order): rebuild the canonical device pytree."""
+        import jax
+        self.params = {
+            f.name: {k: v.device_view() for k, v in
+                     f.param_arrays().items()}
+            for f in self.forwards if f.PARAMETERIZED}
+        self.opt_state = sd["opt_state"]
+        if self._shardings is not None:
+            repl = self._shardings["repl"]
+            self.params = jax.device_put(self.params, repl)
+            self.opt_state = jax.device_put(self.opt_state, repl)
+        # the step re-takes device ownership (buffers will be donated)
+        for f in self.forwards:
+            for arr in f.param_arrays().values():
+                arr.detach_devmem()
+        # restore the schedule scale so the first resumed dispatch trains
+        # at the snapshot's learning rate (identical-continuation guarantee)
+        if "lr_scale" in sd:
+            try:
+                self.lr_scale = float(sd["lr_scale"])
+            except AttributeError:
+                pass  # linked read-only alias; LearningRateAdjust rules
+        self._accum.clear()
 
     def __getstate__(self):
         self.sync_params_to_arrays()

@@ -1,0 +1,198 @@
+"""Checkpoint / resume.
+
+Equivalent of the reference's veles/snapshotter.py:84-535 (SnapshotterBase /
+SnapshotterToFile: cadence gates ``interval``/``time_interval``, ``skip``
+Bool, gz/bz2/xz codecs, ``_current`` symlink, forced snapshot on stop) and
+its resume path (veles/__main__.py:539-589).
+
+TPU-first redesign (SURVEY.md §5.4 mapping): the reference pickled the whole
+Workflow object graph — impossible under jit (compiled callables, device
+buffers). Here every unit contributes an explicit, numpy-only state tree via
+``state_dict()``/``load_state_dict()``; the Snapshotter writes
+{unit name → state} plus global PRNG states. The guarantees preserved:
+- resume restores parameters, optimizer state, loader position, epoch
+  counters, decision bests AND RNG streams (identical continuation,
+  reference veles/units.py:859-885);
+- resume may change topology/backend (host-numpy state is device-free);
+- snapshot on improvement + forced snapshot on stop;
+- in multi-host SPMD only process 0 writes (reference: only master
+  snapshots, veles/snapshotter.py:160).
+"""
+
+from __future__ import annotations
+
+import bz2
+import gzip
+import lzma
+import os
+import pickle
+import time
+from typing import Any, Dict, Optional
+
+from .config import root
+from .logger import Logger
+from .mutable import Bool
+from .units import Unit
+
+CODECS = {
+    "": (open, ""),
+    "gz": (gzip.open, ".gz"),
+    "bz2": (bz2.open, ".bz2"),
+    "xz": (lzma.open, ".xz"),
+}
+
+
+def collect_state(workflow) -> Dict[str, Any]:
+    """{unit name → state_dict} for every stateful unit + prng streams."""
+    from . import prng
+    state: Dict[str, Any] = {"__units__": {}, "__prng__": {}, "__meta__": {
+        "time": time.time(), "checksum": workflow.checksum()}}
+    for unit in workflow:
+        # pre-pass: owners of device-side state flush it to host Arrays
+        hook = getattr(unit, "on_snapshot", None)
+        if callable(hook):
+            hook()
+    for unit in workflow:
+        sd = unit.state_dict() if hasattr(unit, "state_dict") else None
+        if sd:
+            state["__units__"][unit.name] = sd
+    with prng._lock:
+        for key, gen in prng._generators.items():
+            state["__prng__"][key] = gen.__getstate__()
+    return state
+
+
+def apply_state(workflow, state: Dict[str, Any],
+                strict: bool = False) -> None:
+    from . import prng
+    units = {u.name: u for u in workflow}
+    for name, sd in state.get("__units__", {}).items():
+        unit = units.get(name)
+        if unit is None:
+            if strict:
+                raise KeyError("snapshot unit %r not in workflow" % name)
+            continue
+        if hasattr(unit, "load_state_dict"):
+            unit.load_state_dict(sd)
+    with prng._lock:
+        for key, st in state.get("__prng__", {}).items():
+            gen = prng._generators.get(key)
+            if gen is None:
+                gen = prng._generators[key] = object.__new__(
+                    prng.RandomGenerator)
+            gen.__setstate__(dict(st))
+
+
+class Snapshotter(Unit):
+    """Periodic checkpoint writer unit (reference: SnapshotterToFile,
+    veles/snapshotter.py:360; auto-dispatch __new__ :522 collapses to this
+    one file backend — the ODBC variant is out of scope for TPU v1)."""
+
+    MAPPING = "snapshotter"
+    hide_from_registry = False
+
+    def __init__(self, workflow, prefix: str = "wf", directory: str = None,
+                 compression: str = "gz", interval: int = 1,
+                 time_interval: float = 0.0, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.prefix = prefix
+        self.directory = directory or root.common.dirs.snapshots
+        if compression not in CODECS:
+            raise ValueError("compression %r not in %s" %
+                             (compression, sorted(CODECS)))
+        self.compression = compression
+        self.interval = interval
+        self.time_interval = time_interval
+        self.skip = Bool(False)
+        self.suffix = ""            # e.g. current best metric, set by owner
+        self.destination: Optional[str] = None
+        self._runs = 0
+        self._last_time = 0.0
+
+    # -- gating (reference: veles/snapshotter.py:159-179) --------------------
+    def run(self) -> None:
+        self._runs += 1
+        if bool(self.skip):
+            return
+        if self.interval > 1 and self._runs % self.interval:
+            return
+        now = time.time()
+        if self.time_interval and now - self._last_time < self.time_interval:
+            return
+        self._last_time = now
+        self.export()
+
+    def _is_writer(self) -> bool:
+        try:
+            import jax
+            return jax.process_index() == 0
+        except Exception:
+            return True
+
+    def export(self) -> str:
+        if not self._is_writer():
+            return ""
+        os.makedirs(self.directory, exist_ok=True)
+        opener, ext = CODECS[self.compression]
+        suffix = ("_" + self.suffix) if self.suffix else ""
+        fname = "%s%s_%s_%04d.pickle%s" % (
+            self.prefix, suffix, time.strftime("%Y%m%d_%H%M%S"),
+            self._runs, ext)
+        path = os.path.join(self.directory, fname)
+        state = collect_state(self.workflow)
+        tmp = path + ".tmp"
+        with opener(tmp, "wb") as fout:
+            pickle.dump(state, fout, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)
+        # "_current" symlink (reference: veles/snapshotter.py:404-409)
+        link = os.path.join(self.directory, "%s_current.pickle%s" %
+                            (self.prefix, ext))
+        try:
+            if os.path.islink(link) or os.path.exists(link):
+                os.unlink(link)
+            os.symlink(fname, link)
+        except OSError:
+            pass
+        self.destination = path
+        size = os.path.getsize(path)
+        self.info("snapshot → %s (%.1f KiB)", path, size / 1024)
+        self.event("snapshot", "single", path=path, bytes=size)
+        return path
+
+    def stop(self) -> None:
+        """Forced snapshot on workflow stop
+        (reference: veles/snapshotter.py:175-179)."""
+        if self._runs and not bool(self.skip):
+            self.export()
+
+    def get_metric_values(self) -> Dict[str, Any]:
+        return {"snapshot": self.destination}
+
+
+def load_snapshot(path: str) -> Dict[str, Any]:
+    """Read a snapshot state tree; path may be a ``_current`` symlink
+    (reference: --snapshot FILE, veles/__main__.py:539-589)."""
+    for codec, (opener, ext) in CODECS.items():
+        if path.endswith(".pickle" + ext) and ext:
+            with opener(path, "rb") as fin:
+                return pickle.load(fin)
+    with open(path, "rb") as fin:
+        head = fin.read(6)
+    if head[:2] == b"\x1f\x8b":
+        opener = gzip.open
+    elif head[:3] == b"BZh":
+        opener = bz2.open
+    elif head[:6] == b"\xfd7zXZ\x00":
+        opener = lzma.open
+    else:
+        opener = open
+    with opener(path, "rb") as fin:
+        return pickle.load(fin)
+
+
+def resume(workflow, path: str, strict: bool = False) -> None:
+    """Apply a snapshot to an initialized workflow and mark it restored."""
+    state = load_snapshot(path)
+    apply_state(workflow, state, strict=strict)
+    workflow.restored_from_snapshot = True
