@@ -1,0 +1,98 @@
+"""CIFAR-10 convolutional workflow — BASELINE config #4.
+
+TPU-native rebuild of the Znicz AlexNet/CIFAR sample (reference target:
+17.21 % validation error with the caffe config,
+docs/source/manualrst_veles_algorithms.rst:50). Layer stack follows the
+caffe cifar10_quick recipe the reference shipped; NHWC + MXU convs.
+
+Run: python models/cifar.py [--epochs N] [--mb N] [--data-par N]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy  # noqa: E402
+
+import veles_tpu as vt  # noqa: E402
+from veles_tpu import nn, datasets  # noqa: E402
+from veles_tpu.loader import FullBatchLoader  # noqa: E402
+
+
+class CifarLoader(FullBatchLoader):
+    """50k train / 10k validation NHWC images, mean-normalized."""
+
+    hide_from_registry = True
+
+    def load_data(self):
+        tx, ty, vx, vy = datasets.load_cifar10()
+        mean = tx.mean(axis=0)
+        data = numpy.concatenate([vx, tx]) - mean
+        labels = numpy.concatenate([vy, ty])
+        self.create_originals(data, labels)
+        self.class_lengths = [0, len(vx), len(tx)]
+
+
+def build_workflow(epochs=30, minibatch_size=100, lr=0.001,
+                   data_par=1):
+    loader = CifarLoader(None, minibatch_size=minibatch_size, name="cifar")
+    layers = [
+        {"type": "conv", "n_kernels": 32, "kx": 5, "ky": 5,
+         "padding": (2, 2, 2, 2), "learning_rate": lr,
+         "weights_decay": 1e-4},
+        {"type": "max_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+        {"type": "activation_str"},
+        {"type": "conv_relu", "n_kernels": 32, "kx": 5, "ky": 5,
+         "padding": (2, 2, 2, 2), "learning_rate": lr,
+         "weights_decay": 1e-4},
+        {"type": "avg_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+        {"type": "conv_relu", "n_kernels": 64, "kx": 5, "ky": 5,
+         "padding": (2, 2, 2, 2), "learning_rate": lr,
+         "weights_decay": 1e-4},
+        {"type": "avg_pooling", "kx": 3, "ky": 3, "sliding": (2, 2)},
+        {"type": "all2all", "output_sample_shape": 64,
+         "learning_rate": lr, "weights_decay": 1e-4},
+        {"type": "softmax", "output_sample_shape": 10,
+         "learning_rate": lr, "weights_decay": 1e-4},
+    ]
+    wf = nn.StandardWorkflow(
+        name="cifar-conv",
+        layers=layers, loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=epochs, fail_iterations=100),
+        lr_schedule=nn.step_exp(0.5, 20),
+    )
+    return wf
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=30)
+    p.add_argument("--mb", type=int, default=100)
+    p.add_argument("--lr", type=float, default=0.001)
+    p.add_argument("--backend", default="auto")
+    p.add_argument("--data-par", type=int, default=1,
+                   help="size of the mesh 'data' axis")
+    args = p.parse_args(argv)
+
+    wf = build_workflow(args.epochs, args.mb, args.lr)
+    device = (vt.XLADevice(mesh_axes={"data": args.data_par})
+              if args.data_par > 1 else vt.Device_for(args.backend))
+    wf.initialize(device=device)
+    t0 = time.time()
+    wf.run()
+    dt = time.time() - t0
+    res = wf.gather_results()
+    print("dataset: %s CIFAR-10" %
+          ("REAL" if datasets.cifar10_is_real() else "synthetic"))
+    print("best validation error: %.4f (epoch %d)" %
+          (res["best_err"], res["best_epoch"]))
+    print("throughput: %.0f samples/sec" %
+          (wf.loader.samples_served / dt))
+    return res
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""LSTM sequence-classification workflow — BASELINE config #5.
+
+TPU-native rebuild of the Znicz LSTM genre_recognition sample (reference:
+music-genre classification over audio feature sequences; the audio
+front-end used libsndfile, SURVEY.md §2.3). Feature sequences here come
+from the datasets module (real features if cached, synthetic
+genre-structured sequences otherwise); the model is LSTM → softmax under
+one fused jitted step, recurrence via lax.scan.
+
+Run: python models/genre_recognition.py [--epochs N]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy  # noqa: E402
+
+import veles_tpu as vt  # noqa: E402
+from veles_tpu import nn  # noqa: E402
+from veles_tpu.loader import FullBatchLoader  # noqa: E402
+
+
+N_GENRES = 6
+SEQ_LEN = 64
+N_FEATURES = 24
+
+
+class GenreLoader(FullBatchLoader):
+    """Synthetic genre-structured sequences: each genre is a distinct
+    frequency/phase signature + noise (deterministic; real dataset drops
+    in by overriding load_data)."""
+
+    hide_from_registry = True
+
+    def load_data(self):
+        rng = numpy.random.RandomState(11)
+        n_train, n_valid = 1800, 360
+        freqs = rng.rand(N_GENRES, N_FEATURES) * 0.5 + 0.05
+        phases = rng.rand(N_GENRES, N_FEATURES) * numpy.pi
+
+        def make(n, seed):
+            r = numpy.random.RandomState(seed)
+            y = r.randint(0, N_GENRES, n).astype(numpy.int32)
+            t = numpy.arange(SEQ_LEN)[None, :, None]
+            x = numpy.sin(t * freqs[y][:, None, :] + phases[y][:, None, :])
+            x = (x + 0.5 * r.randn(n, SEQ_LEN, N_FEATURES)).astype(
+                numpy.float32)
+            return x, y
+        tx, ty = make(n_train, 1)
+        vx, vy = make(n_valid, 2)
+        self.create_originals(numpy.concatenate([vx, tx]),
+                              numpy.concatenate([vy, ty]))
+        self.class_lengths = [0, n_valid, n_train]
+
+
+def build_workflow(epochs=15, minibatch_size=60, lr=0.05, hidden=64):
+    loader = GenreLoader(None, minibatch_size=minibatch_size, name="genre")
+    wf = nn.StandardWorkflow(
+        name="genre-lstm",
+        layers=[
+            {"type": "lstm", "hidden_size": hidden, "learning_rate": lr},
+            {"type": "softmax", "output_sample_shape": N_GENRES,
+             "learning_rate": lr},
+        ],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=epochs, fail_iterations=50),
+    )
+    return wf
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=15)
+    p.add_argument("--mb", type=int, default=60)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--backend", default="auto")
+    args = p.parse_args(argv)
+
+    wf = build_workflow(args.epochs, args.mb, args.lr)
+    wf.initialize(device=vt.Device_for(args.backend))
+    t0 = time.time()
+    wf.run()
+    dt = time.time() - t0
+    res = wf.gather_results()
+    print("best validation error: %.4f (epoch %d)" %
+          (res["best_err"], res["best_epoch"]))
+    print("throughput: %.0f samples/sec" %
+          (wf.loader.samples_served / dt))
+    return res
+
+
+if __name__ == "__main__":
+    main()

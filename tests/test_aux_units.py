@@ -1,0 +1,97 @@
+"""Aux compute units (BASELINE config #2: mean_disp_normalizer +
+fullbatch pipeline vs numpy oracle) + normalizer registry."""
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.memory import Array
+from veles_tpu import normalization
+
+
+def dev():
+    return vt.XLADevice(mesh_axes={"data": 1})
+
+
+def test_mean_disp_normalizer_oracle():
+    rng = numpy.random.RandomState(0)
+    data = (rng.rand(50, 7, 3) * 255).astype(numpy.uint8)
+    mean, rdisp = vt.MeanDispNormalizer.compute_mean_rdisp(
+        data.astype(numpy.float32))
+    wf = vt.Workflow(name="t")
+    u = vt.MeanDispNormalizer(wf)
+    u.input = Array(data.astype(numpy.float32))
+    u.mean, u.rdisp = Array(mean), Array(rdisp)
+    u.initialize(device=dev())
+    u.xla_run()
+    y_dev = numpy.asarray(u.output.map_read())
+    u.numpy_run()
+    y_np = u.output.map_read()
+    numpy.testing.assert_allclose(y_dev, y_np, rtol=1e-5, atol=1e-6)
+    # normalized data spans about [-1, 1]
+    assert abs(y_np).max() <= 1.0 + 1e-5
+
+
+def test_input_joiner():
+    wf = vt.Workflow(name="t")
+    a = Array(numpy.ones((4, 3), dtype=numpy.float32))
+    b = Array(numpy.full((4, 2, 2), 2.0, dtype=numpy.float32))
+    u = vt.InputJoiner(wf, inputs=[a, b])
+    u.initialize(device=dev())
+    u.xla_run()
+    y = numpy.asarray(u.output.map_read())
+    assert y.shape == (4, 7)
+    numpy.testing.assert_allclose(y[:, :3], 1.0)
+    numpy.testing.assert_allclose(y[:, 3:], 2.0)
+    u.numpy_run()
+    numpy.testing.assert_allclose(u.output.map_read(), y)
+
+
+def test_avatar_clones_and_isolates():
+    wf = vt.Workflow(name="t")
+
+    class Src(vt.Unit):
+        hide_from_registry = True
+    src = Src(wf, name="src")
+    src.output = Array(numpy.arange(6, dtype=numpy.float32))
+    av = vt.Avatar(wf, source=src)
+    av.initialize(device=dev())
+    av.xla_run()
+    numpy.testing.assert_allclose(
+        numpy.asarray(av.output.map_read()), numpy.arange(6))
+    # producer overwrites; avatar keeps the old copy until next run
+    src.output.map_write()[...] = 99.0
+    numpy.testing.assert_allclose(
+        numpy.asarray(av.output.map_read()), numpy.arange(6))
+
+
+@pytest.mark.parametrize("name", sorted(normalization.NORMALIZERS))
+def test_normalizer_roundtrip(name):
+    rng = numpy.random.RandomState(3)
+    data = (rng.rand(20, 5) * 10 - 3).astype(numpy.float32)
+    kwargs = {}
+    if name == "external_mean":
+        kwargs["mean_source"] = data.mean(axis=0)
+    n = normalization.get_normalizer(name, **kwargs)
+    n.analyze(data)
+    out = n.normalize(data.copy())
+    assert out.shape == data.shape
+    if name in ("range", "mean_disp", "external_mean", "pointwise", "exp"):
+        back = n.denormalize(out)
+        numpy.testing.assert_allclose(back, data, rtol=1e-4, atol=1e-4)
+    if name in ("linear", "range", "pointwise"):
+        assert out.min() >= -1 - 1e-5 and out.max() <= 1 + 1e-5
+
+
+def test_normalizer_state_roundtrip():
+    n = normalization.get_normalizer("range")
+    n.analyze(numpy.array([0.0, 10.0]))
+    sd = n.state_dict()
+    n2 = normalization.get_normalizer("range")
+    n2.load_state_dict(sd)
+    numpy.testing.assert_allclose(
+        n2.normalize(numpy.array([5.0])), [0.0])
+
+
+def test_unknown_normalizer():
+    with pytest.raises(KeyError):
+        normalization.get_normalizer("nope")

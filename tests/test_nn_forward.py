@@ -67,12 +67,28 @@ def test_conv_basic():
 
 
 def test_bf16_compute_path():
+    """The precision knob must map bf16 → MXU DEFAULT, f32 → HIGHEST, and
+    mixed-dtype operands must be promoted rather than rejected."""
+    import jax
+    import jax.lax
+    import jax.numpy as jnp
+    from veles_tpu.ops import matmul_precision
+    from veles_tpu.ops.precision import promote_operands
     vt.root.common.engine.compute_dtype = "bfloat16"
     try:
-        run_both(nn.All2All, (8, 12), output_sample_shape=7,
-                 rtol=3e-2, atol=3e-2)
+        assert matmul_precision() == jax.lax.Precision.DEFAULT
     finally:
         vt.root.common.engine.compute_dtype = "float32"
+    assert matmul_precision() == jax.lax.Precision.HIGHEST
+    x = jnp.ones((2, 3), jnp.float32)
+    w = jnp.ones((3, 4), jnp.bfloat16)
+    xx, ww, ct = promote_operands(x, w)
+    assert xx.dtype == ww.dtype == ct == jnp.float32
+    # a bf16-param FC layer must still run (promoted, not rejected)
+    wf = vt.Workflow(name="t")
+    u = nn.All2All(wf, output_sample_shape=4)
+    y = u.apply({"weights": w, "bias": jnp.zeros(4, jnp.bfloat16)}, x)
+    assert y.shape == (2, 4)
 
 
 def test_conv_stride_padding():
@@ -174,3 +190,21 @@ def test_relu_softplus_oracle_large_inputs():
     y_dev = numpy.asarray(jax.jit(lambda z: u.apply({}, z))(x))
     y_np = u.numpy_apply({}, x)
     numpy.testing.assert_allclose(y_dev, y_np, rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_oracle():
+    run_both(nn.LSTM, (3, 7, 5), hidden_size=6, rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_sequences_oracle():
+    run_both(nn.LSTM, (2, 5, 4), hidden_size=3, return_sequences=True,
+             rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_oracle():
+    run_both(nn.RNN, (3, 6, 4), hidden_size=5, rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_sequences_oracle():
+    run_both(nn.RNN, (2, 4, 3), hidden_size=2, return_sequences=True,
+             rtol=1e-4, atol=1e-5)

@@ -127,3 +127,20 @@ def test_extract_forward_workflow_inference():
     assert probs.shape == (20, 3)
     acc = (probs.argmax(1) == y_true).mean()
     assert acc > 0.9, acc
+
+
+def test_layer_config_reaches_gd_units():
+    """Per-layer learning_rate/weights_decay must reach the GD units."""
+    loader = BlobsLoader(None, minibatch_size=50, name="blobs")
+    wf = nn.StandardWorkflow(
+        name="lr-check",
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 4,
+                 "learning_rate": 0.05, "weights_decay": 1e-3,
+                 "gradient_moment": 0.9}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=1))
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    gd = wf.train_step.gds[0]
+    assert gd.learning_rate == 0.05
+    assert gd.weight_decay == 1e-3
+    assert gd.momentum == 0.9

@@ -25,4 +25,8 @@ from .accelerated import (AcceleratedUnit,
 from .snapshotter import (Snapshotter, load_snapshot,
                           resume, collect_state,
                           apply_state)                # noqa: F401
+from .mean_disp_normalizer import MeanDispNormalizer  # noqa: F401
+from .input_joiner import InputJoiner                 # noqa: F401
+from .avatar import Avatar                            # noqa: F401
+from . import normalization                           # noqa: F401
 from . import prng                                    # noqa: F401

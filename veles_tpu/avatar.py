@@ -1,0 +1,53 @@
+"""Avatar: device-side clone of another unit's output attributes.
+
+Equivalent of the reference's veles/avatar.py:22 (used to decouple a
+consumer from a producer whose buffers are overwritten each minibatch)."""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy
+
+from .accelerated import AcceleratedUnit
+from .memory import Array
+
+
+class Avatar(AcceleratedUnit):
+    MAPPING = "avatar"
+    hide_from_registry = False
+
+    def __init__(self, workflow, source=None, attrs=("output",), **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "WORKER"
+        self.source = source
+        self.attrs = tuple(attrs)
+        self.clones: Dict[str, Array] = {}
+        self.demand("source")
+
+    def initialize(self, device=None, **kwargs):
+        res = super().initialize(device=device, **kwargs)
+        if res:
+            return res
+        for a in self.attrs:
+            src = getattr(self.source, a, None)
+            if not (isinstance(src, Array) and src):
+                # producer not allocated yet: use the re-queue protocol
+                return True
+        for a in self.attrs:
+            src = getattr(self.source, a)
+            clone = Array(numpy.array(src.map_read()),
+                          name="%s.%s" % (self.name, a))
+            self.clones[a] = clone
+            setattr(self, a, clone)
+        return None
+
+    def xla_run(self) -> None:
+        for a, clone in self.clones.items():
+            src = getattr(self.source, a)
+            clone.assign_devmem(src.device_view() + 0)  # device-side copy
+
+    def numpy_run(self) -> None:
+        for a, clone in self.clones.items():
+            src = getattr(self.source, a)
+            clone.reset(numpy.array(src.map_read()))

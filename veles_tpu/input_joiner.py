@@ -1,0 +1,52 @@
+"""InputJoiner: concatenate several input vectors on device.
+
+Equivalent of the reference's veles/input_joiner.py:49 with its Jinja2
+templated ocl/join.jcl kernel — here a single jnp.concatenate the XLA
+fusion absorbs."""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy
+
+from .accelerated import AcceleratedUnit
+from .error import Bug
+from .memory import Array
+
+
+class InputJoiner(AcceleratedUnit):
+    MAPPING = "input_joiner"
+    hide_from_registry = False
+
+    def __init__(self, workflow, inputs: List[Array] = (), **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "WORKER"
+        self.inputs = list(inputs)
+        self.output = Array(name=self.name + ".output")
+
+    def initialize(self, device=None, **kwargs):
+        res = super().initialize(device=device, **kwargs)
+        if res:
+            return res
+        if not self.inputs:
+            raise Bug("%s: no inputs to join" % self.name)
+        b = self.inputs[0].shape[0]
+        width = sum(int(numpy.prod(a.shape[1:])) for a in self.inputs)
+        self.output.reset(numpy.zeros((b, width), dtype=numpy.float32))
+        return None
+
+    def apply(self, *xs):
+        import jax.numpy as jnp
+        return jnp.concatenate(
+            [x.reshape(x.shape[0], -1) for x in xs], axis=1)
+
+    def xla_run(self) -> None:
+        fn = self.jit("join", self.apply)
+        self.output.assign_devmem(
+            fn(*[a.device_view() for a in self.inputs]))
+
+    def numpy_run(self) -> None:
+        self.output.reset(numpy.concatenate(
+            [a.map_read().reshape(len(a.mem), -1) for a in self.inputs],
+            axis=1))

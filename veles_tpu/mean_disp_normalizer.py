@@ -1,0 +1,67 @@
+"""MeanDispNormalizer: y = (x - mean) * rdisp, elementwise over samples.
+
+Equivalent of the reference's veles/mean_disp_normalizer.py:50 with its
+ocl/cuda kernels (mean_disp_normalizer.cl/.cu) — BASELINE config #2. The
+kernel body collapses to a fused XLA expression; the reduction that builds
+``rdisp`` from dispersion is the matrix_reduce.cl equivalent (a jnp
+reduction XLA tiles itself)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy
+
+from .accelerated import AcceleratedUnit
+from .config import root
+from .memory import Array
+
+
+class MeanDispNormalizer(AcceleratedUnit):
+    """input (B, ...), mean (...), rdisp (...) → output (B, ...) float."""
+
+    MAPPING = "mean_disp_normalizer"
+    hide_from_registry = False
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.view_group = "WORKER"
+        self.input: Optional[Array] = None
+        self.mean: Optional[Array] = None
+        self.rdisp: Optional[Array] = None
+        self.output = Array(name=self.name + ".output")
+        self.demand("input", "mean", "rdisp")
+
+    def initialize(self, device=None, **kwargs):
+        res = super().initialize(device=device, **kwargs)
+        if res:
+            return res
+        dtype = root.common.engine.precision_type
+        if (self.output.mem is None
+                or self.output.shape != self.input.shape):
+            self.output.reset(numpy.zeros(self.input.shape, dtype=dtype))
+        return None
+
+    @staticmethod
+    def compute_mean_rdisp(data: numpy.ndarray):
+        """Build (mean, rdisp) from a dataset — single definition shared
+        with the host-side normalizer registry."""
+        from .normalization import MeanDispNormalizerHost
+        host = MeanDispNormalizerHost()
+        host.analyze(data)
+        host._finish()
+        return host.mean, host.rdisp
+
+    def apply(self, x, mean, rdisp):
+        return (x - mean) * rdisp
+
+    def xla_run(self) -> None:
+        fn = self.jit("norm", self.apply)
+        self.output.assign_devmem(fn(
+            self.input.device_view(), self.mean.device_view(),
+            self.rdisp.device_view()))
+
+    def numpy_run(self) -> None:
+        x = self.input.map_read().astype(numpy.float32)
+        self.output.reset(
+            (x - self.mean.map_read()) * self.rdisp.map_read())

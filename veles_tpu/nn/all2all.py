@@ -66,15 +66,18 @@ class All2All(ForwardBase):
     # -- pure forward --------------------------------------------------------
     def _linear(self, params, x):
         import jax.numpy as jnp
-        cdt = root.common.engine.compute_dtype
+        from ..ops import matmul_precision
+        from ..ops.precision import promote_operands
         x2 = x.reshape(x.shape[0], -1)
-        w = params["weights"]
-        y = jnp.dot(x2.astype(cdt), w.astype(cdt),
+        # precision (not dtype casting) steers the MXU: bf16 compute =
+        # Precision.DEFAULT, keeping autodiff dtype-consistent
+        xx, ww, ct = promote_operands(x2, params["weights"])
+        y = jnp.dot(xx, ww, precision=matmul_precision(),
                     preferred_element_type=jnp.float32)
         if "bias" in params:
             y = y + params["bias"]
-        return y.astype(x.dtype).reshape((x.shape[0],)
-                                         + self.output_sample_shape)
+        return y.astype(ct).reshape((x.shape[0],)
+                                    + self.output_sample_shape)
 
     def activation(self, a):
         return a

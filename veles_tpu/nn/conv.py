@@ -68,17 +68,20 @@ class Conv(ForwardBase):
     def _conv(self, params, x):
         import jax
         import jax.numpy as jnp
-        cdt = root.common.engine.compute_dtype
+        from ..ops import matmul_precision
+        from ..ops.precision import promote_operands
         sx, sy = self.sliding
+        xx, ww, ct = promote_operands(x, params["weights"])
         y = jax.lax.conv_general_dilated(
-            x.astype(cdt), params["weights"].astype(cdt),
+            xx, ww,
             window_strides=(sy, sx),
             padding=self._pad_hw(),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
-            preferred_element_type=jnp.float32)
+            precision=matmul_precision(),
+            preferred_element_type=jnp.float32)  # f32 MXU accumulation
         if "bias" in params:
             y = y + params["bias"]
-        return y.astype(x.dtype)
+        return y.astype(ct)
 
     def activation(self, a):
         return a

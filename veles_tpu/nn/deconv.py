@@ -58,23 +58,26 @@ class Deconv(ForwardBase):
     def apply(self, params, x, *, train=False, rng=None):
         import jax
         import jax.numpy as jnp
-        cdt = root.common.engine.compute_dtype
+        from ..ops import matmul_precision
+        from ..ops.precision import promote_operands
         left, top, right, bottom = self.padding
         sx, sy = self.sliding
         # conv_transpose pads the dilated input directly; transposed-conv
-        # semantics (out = (i-1)*s + k - pad) need pairs of k-1-p
-        # spatial flip: conv_transpose cross-correlates the dilated input,
-        # while deconv semantics stamp the kernel (true conv)
+        # semantics (out = (i-1)*s + k - pad) need pairs of k-1-p.
+        # Kernel spatially flipped: conv_transpose cross-correlates, deconv
+        # stamps. Precision (not dtype casts) steers the MXU.
+        xx, ww, ct = promote_operands(x, params["weights"][::-1, ::-1])
         y = jax.lax.conv_transpose(
-            x.astype(cdt), params["weights"][::-1, ::-1].astype(cdt),
+            xx, ww,
             strides=(sy, sx),
             padding=((self.ky - 1 - top, self.ky - 1 - bottom),
                      (self.kx - 1 - left, self.kx - 1 - right)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            precision=matmul_precision(),
             preferred_element_type=jnp.float32)
         if "bias" in params:
             y = y + params["bias"]
-        return y.astype(x.dtype)
+        return y.astype(ct)
 
     def numpy_apply(self, params, x):
         """Oracle: scatter-add of kernel stamps."""

@@ -54,7 +54,17 @@ class ForwardBase(AcceleratedUnit):
     #: subclasses with trainable parameters set this
     PARAMETERIZED = False
 
+    #: layer-config keys routed to the paired GD unit (Znicz put these on
+    #: the layer dict too, e.g. {"type": "conv", "learning_rate": …})
+    GD_KEYS = ("learning_rate", "learning_rate_bias", "weights_decay",
+               "weight_decay", "weights_decay_bias", "gradient_moment",
+               "momentum", "gradient_clip")
+
     def __init__(self, workflow, **kwargs) -> None:
+        #: hyper-parameters for the matched GD unit, captured from the
+        #: layer config before Unit.__init__ would discard them
+        self.gd_config = {k: kwargs.pop(k) for k in list(kwargs)
+                          if k in self.GD_KEYS}
         super().__init__(workflow, **kwargs)
         self.view_group = "WORKER"
         self.input: Optional[Array] = None

@@ -1,0 +1,180 @@
+"""Recurrent units: LSTM and vanilla RNN.
+
+Equivalent of Znicz's RNN/LSTM units ("developed for CUDA, OPENCL and
+NUMPY", reference docs/source/manualrst_veles_algorithms.rst:118-143;
+source absent with the submodule — SURVEY.md §2.8). TPU-first: the time
+recurrence is a ``jax.lax.scan`` (single compiled loop, weights resident
+in registers/VMEM across steps); the four gate matmuls are fused into one
+(D+H)×4H GEMM per step so the MXU sees one large matmul instead of eight
+small ones. Backward = autodiff through the scan (BPTT for free).
+
+Sequence lengths are static per compilation; variable-length batches use
+a length mask (same pattern as the loader's minibatch mask).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy
+
+from ..config import root
+from ..memory import Array
+from .. import prng
+from .nn_units import ForwardBase, GradientDescentBase, matches
+
+
+class LSTM(ForwardBase):
+    """Input (B, T, D) → output (B, H) (final hidden state) or (B, T, H)
+    when return_sequences=True."""
+
+    MAPPING = "lstm"
+    PARAMETERIZED = True
+    hide_from_registry = False
+
+    def __init__(self, workflow, hidden_size=128, return_sequences=False,
+                 forget_bias=1.0, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.hidden_size = int(hidden_size)
+        self.return_sequences = return_sequences
+        self.forget_bias = float(forget_bias)
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+
+    def output_shape_for(self, input_shape):
+        b, t, _ = input_shape
+        if self.return_sequences:
+            return (b, t, self.hidden_size)
+        return (b, self.hidden_size)
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        d = self.input.shape[-1]
+        h = self.hidden_size
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(d + h))
+        dtype = root.common.engine.precision_type
+        w = numpy.zeros((d + h, 4 * h), dtype=dtype)
+        prng.get(self.name).fill_normal(w, stddev)
+        b = numpy.zeros((4 * h,), dtype=dtype)
+        return {"weights": Array(w, name=self.name + ".weights"),
+                "bias": Array(b, name=self.name + ".bias")}
+
+    # gate order: i, f, g, o
+    def _step(self, params, carry, x_t):
+        import jax
+        import jax.numpy as jnp
+        from ..ops import matmul_precision
+        h_prev, c_prev = carry
+        z = jnp.dot(jnp.concatenate([x_t, h_prev], axis=-1),
+                    params["weights"],
+                    precision=matmul_precision()) + params["bias"]
+        i, f, g, o = jnp.split(z, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f + self.forget_bias)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c = f * c_prev + i * g
+        h = o * jnp.tanh(c)
+        return (h, c), h
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+        b = x.shape[0]
+        h0 = jnp.zeros((b, self.hidden_size), dtype=x.dtype)
+        carry = (h0, h0)
+        xs = jnp.swapaxes(x, 0, 1)              # (T, B, D) for scan
+
+        def body(c, x_t):
+            return self._step(params, c, x_t)
+        (h_last, _), hs = jax.lax.scan(body, carry, xs)
+        if self.return_sequences:
+            return jnp.swapaxes(hs, 0, 1)       # (B, T, H)
+        return h_last
+
+    def numpy_apply(self, params, x):
+        def sig(v):
+            return 1.0 / (1.0 + numpy.exp(-v))
+        b, t, d = x.shape
+        hsz = self.hidden_size
+        h = numpy.zeros((b, hsz), dtype=numpy.float32)
+        c = numpy.zeros((b, hsz), dtype=numpy.float32)
+        w, bias = params["weights"], params["bias"]
+        hs = numpy.zeros((b, t, hsz), dtype=numpy.float32)
+        for step in range(t):
+            z = numpy.concatenate([x[:, step, :], h], axis=1) @ w + bias
+            i, f, g, o = numpy.split(z, 4, axis=1)
+            c = sig(f + self.forget_bias) * c + sig(i) * numpy.tanh(g)
+            h = sig(o) * numpy.tanh(c)
+            hs[:, step, :] = h
+        return hs if self.return_sequences else h
+
+
+class RNN(ForwardBase):
+    """Vanilla tanh RNN: h_t = tanh([x_t, h_{t-1}] @ W + b)."""
+
+    MAPPING = "rnn"
+    PARAMETERIZED = True
+    hide_from_registry = False
+
+    def __init__(self, workflow, hidden_size=128, return_sequences=False,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.hidden_size = int(hidden_size)
+        self.return_sequences = return_sequences
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+
+    def output_shape_for(self, input_shape):
+        b, t, _ = input_shape
+        if self.return_sequences:
+            return (b, t, self.hidden_size)
+        return (b, self.hidden_size)
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        d = self.input.shape[-1]
+        h = self.hidden_size
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(d + h))
+        dtype = root.common.engine.precision_type
+        w = numpy.zeros((d + h, h), dtype=dtype)
+        prng.get(self.name).fill_normal(w, stddev)
+        return {"weights": Array(w, name=self.name + ".weights"),
+                "bias": Array(numpy.zeros((h,), dtype=dtype),
+                              name=self.name + ".bias")}
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax
+        import jax.numpy as jnp
+        from ..ops import matmul_precision
+        b = x.shape[0]
+        h0 = jnp.zeros((b, self.hidden_size), dtype=x.dtype)
+        xs = jnp.swapaxes(x, 0, 1)
+
+        def body(h, x_t):
+            z = jnp.dot(jnp.concatenate([x_t, h], axis=-1),
+                        params["weights"],
+                        precision=matmul_precision()) + params["bias"]
+            h_new = jnp.tanh(z)
+            return h_new, h_new
+        h_last, hs = jax.lax.scan(body, h0, xs)
+        if self.return_sequences:
+            return jnp.swapaxes(hs, 0, 1)
+        return h_last
+
+    def numpy_apply(self, params, x):
+        b, t, d = x.shape
+        h = numpy.zeros((b, self.hidden_size), dtype=numpy.float32)
+        hs = numpy.zeros((b, t, self.hidden_size), dtype=numpy.float32)
+        for step in range(t):
+            z = numpy.concatenate([x[:, step, :], h], axis=1) @ \
+                params["weights"] + params["bias"]
+            h = numpy.tanh(z)
+            hs[:, step, :] = h
+        return hs if self.return_sequences else h
+
+
+@matches(LSTM)
+class GDLSTM(GradientDescentBase):
+    MAPPING = "gd_lstm"
+
+
+@matches(RNN)
+class GDRNN(GradientDescentBase):
+    MAPPING = "gd_rnn"

@@ -81,7 +81,8 @@ class TrainStep(AcceleratedUnit):
                             break
                 if gd_cls is None:
                     raise Bug("no GD unit matched for %s" % type(f).__name__)
-                gd = gd_cls(self.workflow, name="gd_" + f.name)
+                gd = gd_cls(self.workflow, name="gd_" + f.name,
+                            **getattr(f, "gd_config", {}))
                 gd.forward = f
                 self.gds.append(gd)
 

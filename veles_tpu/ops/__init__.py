@@ -1,0 +1,3 @@
+"""Low-level op helpers shared by compute units."""
+
+from .precision import matmul_precision  # noqa: F401

@@ -1,0 +1,33 @@
+"""MXU precision policy.
+
+The reference exposed OpenCL summation precision levels (simple / Kahan /
+multipartial, veles/config.py:245-248 — +9 % and +90 % cost). The TPU
+equivalent is the matmul/conv precision knob: ``bfloat16`` compute maps to
+``lax.Precision.DEFAULT`` (one MXU pass over bf16-rounded operands),
+``float32`` to ``Precision.HIGHEST`` (3-pass bf16 expansion). Keeping
+arrays f32 and steering precision through this knob — instead of casting
+operands — keeps autodiff dtype-consistent (mixed-dtype conv transposes
+are rejected by lax) and lets the same code run full-precision on CPU.
+"""
+
+from __future__ import annotations
+
+from ..config import root
+
+
+def matmul_precision():
+    """lax.Precision for dots/convs under the current engine config."""
+    import jax.lax as lax
+    cdt = str(root.common.engine.compute_dtype)
+    if cdt in ("bfloat16", "bf16"):
+        return lax.Precision.DEFAULT
+    return lax.Precision.HIGHEST
+
+
+def promote_operands(x, w):
+    """Cast both MXU operands to their promoted common dtype so lax conv/
+    dot never sees a mixed-dtype pair (f32 activations × bf16 params is
+    legal config, illegal lax input)."""
+    import jax.numpy as jnp
+    ct = jnp.promote_types(x.dtype, w.dtype)
+    return x.astype(ct), w.astype(ct), ct
