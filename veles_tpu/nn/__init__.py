@@ -26,5 +26,6 @@ from .evaluator import EvaluatorSoftmax, EvaluatorMSE  # noqa
 from .decision import DecisionGD, DecisionMSE  # noqa
 from .lr_adjust import LearningRateAdjust, step_exp, inv, exp_decay  # noqa
 from .rnn import LSTM, RNN  # noqa
+from .attention import MultiHeadAttention  # noqa
 from .train_step import TrainStep  # noqa
 from .standard_workflow import StandardWorkflow  # noqa

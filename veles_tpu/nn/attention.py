@@ -1,0 +1,109 @@
+"""Multi-head attention forward unit.
+
+New capability vs the reference (its sequence models were Znicz RNN/LSTM
+only, SURVEY.md §5.7); required for long-context parity goals. The unit is
+a standard ForwardBase: pure ``apply``, numpy oracle, matched GD unit.
+When the attached mesh has a 'sequence' axis larger than 1, the attention
+core routes through parallel.ring_attention (exact, sequence-sharded,
+K/V rotating over ICI); otherwise a single fused softmax(QK^T)V.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy
+
+from ..config import root
+from ..memory import Array
+from .. import prng
+from .nn_units import ForwardBase, GradientDescentBase, matches
+
+
+class MultiHeadAttention(ForwardBase):
+    """(B, T, D) → (B, T, D); params wq/wk/wv/wo each (D, D)."""
+
+    MAPPING = "multi_head_attention"
+    PARAMETERIZED = True
+    hide_from_registry = False
+
+    def __init__(self, workflow, n_heads=4, causal=False, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.n_heads = int(n_heads)
+        self.causal = causal
+        self.mesh = None          # set at initialize from the device
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+
+    PARAM_NAMES = ("wq", "wk", "wv", "wo")
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        d = self.input.shape[-1]
+        if d % self.n_heads:
+            raise ValueError("model dim %d not divisible by %d heads" %
+                             (d, self.n_heads))
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(d))
+        dtype = root.common.engine.precision_type
+        params = {}
+        for k in ("wq", "wk", "wv", "wo"):
+            w = numpy.zeros((d, d), dtype=dtype)
+            prng.get("%s.%s" % (self.name, k)).fill_normal(w, stddev)
+            params[k] = Array(w, name="%s.%s" % (self.name, k))
+        return params
+
+    def initialize(self, device=None, **kwargs):
+        res = super().initialize(device=device, **kwargs)
+        if res:
+            return res
+        mesh = getattr(device, "mesh", None)
+        if mesh is not None and "sequence" in mesh.axis_names \
+                and mesh.shape["sequence"] > 1:
+            self.mesh = mesh
+        return None
+
+    def _split_heads(self, x):
+        b, t, d = x.shape
+        return x.reshape(b, t, self.n_heads, d // self.n_heads)
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax.numpy as jnp
+        from ..ops import matmul_precision
+        from ..parallel.ring_attention import (ring_attention,
+                                               attention_reference)
+        prec = matmul_precision()
+        b, t, d = x.shape
+        q = self._split_heads(jnp.dot(x, params["wq"], precision=prec))
+        k = self._split_heads(jnp.dot(x, params["wk"], precision=prec))
+        v = self._split_heads(jnp.dot(x, params["wv"], precision=prec))
+        if self.mesh is not None:
+            o = ring_attention(q, k, v, self.mesh, causal=self.causal)
+        else:
+            o = attention_reference(q, k, v, causal=self.causal)
+        o = o.reshape(b, t, d)
+        return jnp.dot(o, params["wo"], precision=prec)
+
+    def numpy_apply(self, params, x):
+        b, t, d = x.shape
+        h = self.n_heads
+        hd = d // h
+
+        def split(m):
+            return (x @ m).reshape(b, t, h, hd)
+        q, k, v = split(params["wq"]), split(params["wk"]), \
+            split(params["wv"])
+        s = numpy.einsum("bqhd,bkhd->bhqk", q, k) / numpy.sqrt(hd)
+        if self.causal:
+            mask = numpy.tril(numpy.ones((t, t), bool))
+            s = numpy.where(mask[None, None], s, -1e30)
+        s = s - s.max(axis=-1, keepdims=True)
+        p = numpy.exp(s)
+        p /= p.sum(axis=-1, keepdims=True)
+        o = numpy.einsum("bhqk,bkhd->bqhd", p, v).reshape(b, t, d)
+        return (o @ params["wo"]).astype(numpy.float32)
+
+
+@matches(MultiHeadAttention)
+class GDMultiHeadAttention(GradientDescentBase):
+    MAPPING = "gd_multi_head_attention"

@@ -81,9 +81,12 @@ class ForwardBase(AcceleratedUnit):
         """Host view of parameters (oracle side)."""
         return {k: v.map_read() for k, v in self.param_arrays().items()}
 
+    #: parameter attribute names (subclasses with other params override)
+    PARAM_NAMES = ("weights", "bias")
+
     def param_arrays(self) -> Dict[str, Array]:
         out = {}
-        for k in ("weights", "bias"):
+        for k in self.PARAM_NAMES:
             arr = getattr(self, k, None)
             if isinstance(arr, Array) and arr:
                 out[k] = arr
@@ -111,7 +114,7 @@ class ForwardBase(AcceleratedUnit):
         res = super().initialize(device=device, **kwargs)
         if res:
             return res
-        if self.PARAMETERIZED and not getattr(self, "weights", None):
+        if self.PARAMETERIZED and not self.param_arrays():
             rng = prng.get(self.name)
             for k, v in self.create_params(rng).items():
                 setattr(self, k, v)

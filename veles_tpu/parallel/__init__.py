@@ -1,0 +1,27 @@
+"""Parallelism: mesh axes, sharding rules, collectives, multi-host.
+
+The TPU-native replacement for the reference's entire distributed runtime
+(SURVEY.md §2.4/§5.8): where VELES shipped a ZeroMQ master–slave parameter
+server (veles/server.py, veles/client.py, txzmq/) carrying pickled per-unit
+job/update payloads, this package expresses every parallelism as shardings
+over a named ``jax.sharding.Mesh`` and lets XLA insert the collectives over
+ICI/DCN:
+
+- **data**      minibatch axis (psum of grads ≡ the master's update-apply)
+- **fsdp**      parameter shards, all-gathered at use (ZeRO-3 style)
+- **tensor**    intra-layer model parallelism (column/row splits)
+- **sequence**  long-context axis: ring attention via shard_map+ppermute
+- **expert**    MoE expert axis (reserved)
+- **pipeline**  inter-layer pipelining (reserved)
+
+The reference's parallelism inventory maps as: sync DP → 'data'; async DP
+→ superseded (documented non-goal); ensemble/GA population parallelism →
+veles_tpu.ensemble / veles_tpu.genetics; everything else (fsdp/tensor/
+sequence) is new capability the reference never had (SURVEY.md §5.7).
+"""
+
+from .sharding import (param_shardings, batch_sharding,
+                       replicated)                        # noqa: F401
+from .distributed import (initialize_multihost, is_coordinator,
+                          process_count)                  # noqa: F401
+from .ring_attention import ring_attention                # noqa: F401

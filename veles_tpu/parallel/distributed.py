@@ -1,0 +1,135 @@
+"""Multi-host runtime: coordinator init, membership, fault handling.
+
+Replaces the reference's control plane (Twisted TCP JSON handshake +
+ZeroMQ data plane + SSH slave spawning, veles/server.py / veles/client.py /
+veles/launcher.py:808-842) with the JAX distributed runtime: one GRPC
+coordinator, N processes, global device mesh over ICI/DCN.
+
+Capability mapping (SURVEY.md §5.3):
+- slave join/handshake+checksum   → jax.distributed.initialize barrier
+  (+ workflow checksum verification helper)
+- slave death / job re-serving    → SPMD has no per-slave jobs; recovery is
+  checkpoint restart (restore_latest) — the reference itself called
+  snapshots the disaster-recovery story
+- hang detection (mean+3σ timeout)→ step_watchdog context manager
+- --slave-death-probability       → fault_injection() preserved as a
+  testing flag that kills the process with the same semantics
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import time
+from contextlib import contextmanager
+from typing import Optional
+
+from ..config import root
+from ..error import DistributedCommunicationError
+from ..logger import Logger
+
+_initialized = False
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> None:
+    """Join the multi-host job. No-op on single host. Arguments default to
+    the standard env vars the TPU runtime provides; explicit values mirror
+    the reference's -m/--master-address & node-index flags."""
+    global _initialized
+    if _initialized:
+        return
+    import jax
+    if coordinator_address is None and num_processes is None \
+            and "JAX_COORDINATOR_ADDRESS" not in os.environ \
+            and "COORDINATOR_ADDRESS" not in os.environ:
+        return  # single host
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        _initialized = True
+    except Exception as e:
+        raise DistributedCommunicationError(
+            "multi-host init failed: %s" % e) from e
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def is_coordinator() -> bool:
+    import jax
+    return jax.process_index() == 0
+
+
+def verify_checksums(workflow) -> None:
+    """All hosts must run the same workflow code — the reference refused
+    mismatched slaves at handshake (veles/server.py:478-529). Gathers the
+    workflow checksum from every process and raises on mismatch."""
+    import jax
+    import jax.numpy as jnp
+    import numpy
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+    digest = numpy.frombuffer(
+        bytes.fromhex(workflow.checksum()[:16]), dtype=numpy.uint8)
+    all_digests = multihost_utils.process_allgather(digest)
+    if not (all_digests == all_digests[0]).all():
+        raise DistributedCommunicationError(
+            "workflow checksum mismatch across hosts")
+
+
+@contextmanager
+def step_watchdog(name: str = "step", timeout: float = 0.0,
+                  history: Optional[list] = None):
+    """Detect hung steps: warn when a step exceeds max(mean+3σ of its own
+    history, timeout) — the reference's job-timeout dropper semantics
+    (veles/server.py:619-635) as a local watchdog."""
+    t0 = time.time()
+    yield
+    dt = time.time() - t0
+    if history is not None:
+        # threshold from PRIOR history only: including the current sample
+        # would inflate its own baseline (no sample can exceed
+        # mean+sqrt(n-1)·std of a set containing it)
+        if len(history) >= 8:
+            import numpy
+            mean, std = numpy.mean(history), numpy.std(history)
+            threshold = max(mean + 3 * std, timeout)
+            if dt > threshold:
+                Logger().warning(
+                    "%s took %.2fs (mean %.2fs + 3σ %.2fs) — possible hang",
+                    name, dt, mean, 3 * std)
+        history.append(dt)
+
+
+def fault_injection(probability: Optional[float] = None) -> None:
+    """Randomly kill this process — the reference's
+    --slave-death-probability fault-injection flag
+    (veles/client.py:303-307,438-442) for testing recovery paths."""
+    from .. import prng
+    p = probability if probability is not None else float(
+        root.common.get("slave_death_probability", 0.0) or 0.0)
+    if p > 0 and prng.get("fault_injection").rand() < p:
+        Logger().warning("fault injection: terminating process")
+        os._exit(42)
+
+
+def restore_latest(workflow, directory: str, prefix: str = "wf") -> bool:
+    """Elastic recovery: resume from the newest snapshot if one exists
+    (preemption/restart path). Returns True if restored."""
+    from ..snapshotter import resume
+    pattern = os.path.join(directory, "%s*_current.pickle*" % prefix)
+    candidates = sorted(glob.glob(pattern), key=os.path.getmtime)
+    if not candidates:
+        candidates = sorted(
+            glob.glob(os.path.join(directory, "%s*.pickle*" % prefix)),
+            key=os.path.getmtime)
+    if not candidates:
+        return False
+    resume(workflow, candidates[-1])
+    return True

@@ -1,0 +1,90 @@
+"""Ring attention: exact attention over a sequence-sharded axis.
+
+Long-context capability (green-field vs the reference, SURVEY.md §5.7):
+sequences sharded over the mesh 'sequence' axis, each device holding a
+T/n block of Q, K, V. K/V blocks rotate around the ring via
+``lax.ppermute`` over ICI while each device accumulates its Q block's
+attention with the online-softmax (running max / denominator) recurrence —
+memory O(T/n) per device, compute overlapped with neighbor transfers by
+XLA. This is the blockwise ring attention construction (Liu et al.) built
+from shard_map + XLA collectives rather than custom kernels.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+
+def ring_attention(q, k, v, mesh, axis: str = "sequence",
+                   causal: bool = False, scale: Optional[float] = None):
+    """q, k, v: (B, T, H, D) GLOBAL arrays (or already sharded); returns
+    (B, T, H, D) attention output, sequence axis sharded over ``axis``."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    n = mesh.shape[axis]
+    # carry the batch sharding through: without 'data' in the specs a
+    # dp x sp mesh would all-gather the batch and compute it redundantly
+    batch_axis = "data" if "data" in mesh.axis_names else None
+
+    def local(q_blk, k_blk, v_blk):
+        # q_blk: (B, Tl, H, D)
+        my = jax.lax.axis_index(axis)
+        tl = q_blk.shape[1]
+        q_pos = my * tl + jnp.arange(tl)
+
+        def body(carry, i):
+            o, m, l, kb, vb = carry
+            src = (my - i) % n          # who produced this K/V block
+            s = jnp.einsum("bqhd,bkhd->bhqk", q_blk, kb) * scale
+            s = s.astype(jnp.float32)
+            if causal:
+                k_pos = src * tl + jnp.arange(tl)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                s = jnp.where(mask[None, None], s, -1e30)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[..., None])
+            l_new = l * alpha + p.sum(axis=-1)
+            pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(q_blk.dtype), vb)
+            o_new = o * alpha.transpose(0, 2, 1)[..., None] + pv
+            # rotate K/V to the next device on the ring
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            return (o_new, m_new, l_new, kb, vb), None
+
+        b, tl_, h, d = q_blk.shape
+        o0 = jnp.zeros((b, tl_, h, d), dtype=q_blk.dtype)
+        m0 = jnp.full((b, h, tl_), -jnp.inf, dtype=jnp.float32)
+        l0 = jnp.zeros((b, h, tl_), dtype=jnp.float32)
+        (o, m, l, _, _), _ = jax.lax.scan(
+            body, (o0, m0, l0, k_blk, v_blk), jnp.arange(n))
+        denom = l.transpose(0, 2, 1)[..., None]
+        return (o / jnp.maximum(denom, 1e-30)).astype(q_blk.dtype)
+
+    spec = P(batch_axis, axis, None, None)
+    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_vma=False)
+    return fn(q, k, v)
+
+
+def attention_reference(q, k, v, causal: bool = False,
+                        scale: Optional[float] = None):
+    """Single-device exact attention — the oracle for ring_attention."""
+    import jax.numpy as jnp
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        tq, tk = s.shape[-2], s.shape[-1]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    p = jnp.exp(s - s.max(axis=-1, keepdims=True))
+    p = p / p.sum(axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)

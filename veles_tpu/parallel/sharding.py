@@ -1,0 +1,67 @@
+"""Sharding rules: parameter pytrees → NamedShardings.
+
+The reference classified per-unit state as master-only / replicated /
+aggregated in its generate/apply protocol (veles/distributable.py:222 —
+the IDistributable 4-method plane). The TPU equivalent is a *rule table*
+mapping parameter names+shapes to PartitionSpecs over the mesh:
+
+- 'tensor' in mesh → All2All/Conv kernels column-split over their output
+  axis (Megatron-style; XLA inserts the activation collectives);
+- 'fsdp' in mesh → remaining large params sharded over their biggest
+  divisible axis, all-gathered at use (ZeRO-3, free via XLA SPMD);
+- otherwise replicated.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def replicated(mesh):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh, ndim: int = 1, plan: bool = False):
+    """Minibatch arrays: shard the sample axis over 'data'
+    (plan=True for (K, mb) scan plans: sample axis is axis 1)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    if "data" not in mesh.axis_names:
+        return replicated(mesh)
+    spec = [None] * ndim
+    spec[1 if plan else 0] = "data"
+    return NamedSharding(mesh, P(*spec))
+
+
+def _spec_for(name: str, shape, mesh) -> tuple:
+    """PartitionSpec elements for one parameter (by name AND shape)."""
+    sizes = dict(mesh.shape)
+    tp = sizes.get("tensor", 1)
+    fsdp = sizes.get("fsdp", 1)
+    spec = [None] * len(shape)
+    if name in ("bias",):
+        # small vectors: replicating is cheaper than the gather traffic
+        return tuple(spec)
+    if tp > 1 and len(shape) >= 2 and shape[-1] % tp == 0:
+        # column parallel: split the output-features axis
+        spec[-1] = "tensor"
+    if fsdp > 1:
+        # shard the largest remaining divisible axis over fsdp
+        order = sorted(range(len(shape)), key=lambda i: -shape[i])
+        for i in order:
+            if spec[i] is None and shape[i] % fsdp == 0:
+                spec[i] = "fsdp"
+                break
+    return tuple(spec)
+
+
+def param_shardings(params: Dict[str, Dict[str, Any]], mesh):
+    """NamedSharding pytree matching a {layer: {param: array}} tree."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    out: Dict[str, Dict[str, Any]] = {}
+    for layer, tree in params.items():
+        out[layer] = {}
+        for pname, arr in tree.items():
+            spec = _spec_for(pname, arr.shape, mesh)
+            out[layer][pname] = NamedSharding(mesh, P(*spec))
+    return out
