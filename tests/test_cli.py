@@ -1,0 +1,90 @@
+"""CLI surface: mirrors reference veles/tests/test_velescli.py scope."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_cli(*argv, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    # --backend cpu: the TPU plugin ignores JAX_PLATFORMS, and tests must
+    # not contend for the real chip
+    return subprocess.run(
+        [sys.executable, "-m", "veles_tpu", *argv, "--backend", "cpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout, env=env)
+
+
+@pytest.fixture(scope="module")
+def tiny_model(tmp_path_factory):
+    path = tmp_path_factory.mktemp("m") / "tiny_model.py"
+    path.write_text(textwrap.dedent("""
+        import numpy
+        from veles_tpu import nn
+        from veles_tpu.loader import FullBatchLoader
+
+        class L(FullBatchLoader):
+            hide_from_registry = True
+            def load_data(self):
+                rng = numpy.random.RandomState(0)
+                self.create_originals(
+                    rng.rand(120, 6).astype(numpy.float32),
+                    rng.randint(0, 3, 120).astype(numpy.int32))
+                self.class_lengths = [0, 24, 96]
+
+        def build_workflow():
+            return nn.StandardWorkflow(
+                name="tiny",
+                layers=[{"type": "softmax", "output_sample_shape": 3}],
+                loader_unit=L(None, minibatch_size=24, name="l"),
+                loss_function="softmax",
+                decision_config=dict(max_epochs=2))
+    """))
+    return str(path)
+
+
+def test_cli_dry_run(tiny_model):
+    r = run_cli(tiny_model, "--dry-run", "-v")
+    assert r.returncode == 0, r.stderr
+    assert "dry run: initialize OK" in r.stderr + r.stdout
+
+
+def test_cli_full_run_with_results(tiny_model, tmp_path):
+    res = tmp_path / "r.json"
+    r = run_cli(tiny_model, "--result-file", str(res), "-v")
+    assert r.returncode == 0, r.stderr
+    data = json.loads(res.read_text())
+    assert data["epochs"] == 2
+    assert "best_err" in data
+
+
+def test_cli_workflow_graph(tiny_model, tmp_path):
+    dot = tmp_path / "g.dot"
+    r = run_cli(tiny_model, "--workflow-graph", str(dot))
+    assert r.returncode == 0, r.stderr
+    assert "digraph" in dot.read_text()
+
+
+def test_cli_config_override(tiny_model):
+    r = run_cli(tiny_model, "root.common.trace.run=true", "--dry-run",
+                "-v")
+    assert r.returncode == 0, r.stderr
+
+
+def test_cli_dump_config(tiny_model):
+    r = run_cli(tiny_model, "--dump-config")
+    assert r.returncode == 0
+    assert "engine:" in r.stdout
+
+
+def test_cli_bad_model(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("x = 1\n")
+    r = run_cli(str(bad), "--dry-run")
+    assert r.returncode != 0
+    assert "build_workflow" in r.stderr

@@ -1,0 +1,109 @@
+"""CLI entry: ``python -m veles_tpu MODEL.py [CONFIG] [overrides] [flags]``.
+
+Equivalent of the reference's veles/__main__.py:136-867 (Main): argv →
+config → model import → Launcher boot → run → results. Model contract
+(both reference styles supported):
+- ``build_workflow(**kwargs) -> Workflow``  (preferred, simple), or
+- ``run(load, main)``: the reference's canonical protocol
+  (veles/__main__.py:591-627) — the module calls ``load(WorkflowClass,
+  **kw)`` to construct/resume and ``main(**kw)`` to initialize+run.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from .cmdline import apply_config_overrides, make_parser, parse_mesh
+from .config import root
+from .error import VelesError
+from .import_file import import_file_as_module
+from .launcher import Launcher
+from .logger import setup_logging
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    level = (logging.WARNING, logging.INFO,
+             logging.DEBUG)[min(args.verbose, 2)]
+    setup_logging(level=level, tracefile=args.trace_file)
+
+    # config layering: file, then inline overrides; a bare root.x=y in the
+    # config position is an override, not a file
+    if args.config and "=" in args.config:
+        args.config_list.insert(0, args.config)
+        args.config = None
+    if args.config:
+        root.update_from_file(args.config)
+    if args.config_list:
+        apply_config_overrides(root, args.config_list)
+    if args.force_numpy:
+        root.common.engine.force_numpy = True
+    if args.backend in ("cpu", "numpy"):
+        # keep jax away from the (exclusive, possibly busy) TPU tunnel
+        # when the user explicitly asked for a host backend
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if args.slave_death_probability:
+        root.common.slave_death_probability = args.slave_death_probability
+    if args.timings:
+        root.common.trace.timings = True
+    if args.dump_config:
+        root.print_()
+        return 0
+
+    launcher = Launcher(
+        backend=args.backend,
+        mesh=parse_mesh(args.mesh) if args.mesh else None,
+        coordinator=args.coordinator, num_processes=args.num_processes,
+        process_id=args.process_id, random_seed=args.random_seed,
+        test_mode=args.test)
+
+    module = import_file_as_module(args.model)
+
+    if hasattr(module, "run"):
+        # reference-style protocol
+        state = {}
+
+        def load(workflow_cls, **kwargs):
+            state["workflow"] = workflow_cls(**kwargs)
+            return state["workflow"], bool(args.snapshot)
+
+        def main_(**kwargs):
+            return _drive(launcher, state["workflow"], args)
+        module.run(load, main_)
+        return 0
+    if hasattr(module, "build_workflow"):
+        workflow = module.build_workflow()
+        _drive(launcher, workflow, args)
+        return 0
+    raise VelesError(
+        "%s defines neither build_workflow() nor run(load, main)"
+        % args.model)
+
+
+def _drive(launcher: Launcher, workflow, args):
+    launcher.initialize(workflow)
+    if args.snapshot:
+        launcher.resume(args.snapshot)
+    if args.workflow_graph:
+        with open(args.workflow_graph, "w") as fout:
+            fout.write(workflow.generate_graph())
+        launcher.info("workflow graph → %s", args.workflow_graph)
+        return None
+    if args.dry_run:
+        launcher.info("dry run: initialize OK (%d units)", len(workflow))
+        return None
+    results = launcher.run()
+    if args.timings:
+        launcher.print_stats()
+    if args.result_file:
+        launcher.write_results(results, args.result_file)
+    for key, value in sorted(results.items()):
+        if not isinstance(value, dict):
+            launcher.info("result %s = %s", key, value)
+    return results
+
+
+if __name__ == "__main__":
+    sys.exit(main())

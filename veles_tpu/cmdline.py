@@ -1,0 +1,88 @@
+"""Command-line surface.
+
+Equivalent of the reference's veles/cmdline.py:61-278 (the veles(1) arg
+set) collapsed to one explicit parser — the reference's metaclass-
+distributed `init_parser` registry existed to merge flags from dozens of
+optional units; here the surface is small enough to state in one place,
+and unit-specific knobs ride the config tree (root.x.y=z overrides).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="veles_tpu",
+        description="TPU-native dataflow deep-learning framework "
+                    "(rebuild of Samsung VELES capabilities)")
+    p.add_argument("model", help="workflow .py file (defines "
+                   "build_workflow() or run(load, main))")
+    p.add_argument("config", nargs="?", default=None,
+                   help="optional config .py/.json applied to root")
+    p.add_argument("config_list", nargs="*", default=[],
+                   help="inline overrides root.x.y=value")
+    p.add_argument("-b", "--backend", default=None,
+                   help="auto | tpu | cpu | xla | numpy")
+    p.add_argument("--mesh", default=None,
+                   help="mesh spec, e.g. data=8 or data=4,tensor=2")
+    p.add_argument("-s", "--snapshot", default=None,
+                   help="resume from snapshot file")
+    p.add_argument("--snapshot-dir", default=None)
+    p.add_argument("--random-seed", type=int, default=None)
+    p.add_argument("--test", action="store_true",
+                   help="run in test (inference) mode")
+    p.add_argument("--result-file", default=None,
+                   help="write gathered metrics JSON here")
+    p.add_argument("--workflow-graph", default=None,
+                   help="write the control graph DOT file and exit "
+                        "after initialize")
+    p.add_argument("--dump-config", action="store_true")
+    p.add_argument("--dry-run", action="store_true",
+                   help="build + initialize only")
+    p.add_argument("--timings", action="store_true",
+                   help="print per-unit timing table at exit")
+    p.add_argument("--trace-file", default=None,
+                   help="append event spans as JSON lines here")
+    p.add_argument("--force-numpy", action="store_true")
+    p.add_argument("-v", "--verbose", action="count", default=0)
+    # multi-host (replaces master/slave -l/-m, veles/launcher.py:193-267)
+    p.add_argument("--coordinator", default=None,
+                   help="host:port of the jax distributed coordinator")
+    p.add_argument("--num-processes", type=int, default=None)
+    p.add_argument("--process-id", type=int, default=None)
+    p.add_argument("--slave-death-probability", type=float, default=0.0,
+                   help="fault injection for recovery testing")
+    return p
+
+
+def parse_mesh(spec: str):
+    """'data=4,tensor=2' → {'data': 4, 'tensor': 2}."""
+    out = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        out[name.strip()] = int(size)
+    return out
+
+
+def apply_config_overrides(root, items):
+    """Inline ``root.x.y=value`` overrides (reference --config-list,
+    veles/__main__.py:474-481)."""
+    import json
+    for item in items:
+        path, _, value = item.partition("=")
+        if not _:
+            raise ValueError("override %r is not of form root.x.y=value"
+                             % item)
+        parts = path.split(".")
+        if parts[0] == "root":
+            parts = parts[1:]
+        node = root
+        for part in parts[:-1]:
+            node = getattr(node, part)
+        try:
+            value = json.loads(value)
+        except ValueError:
+            pass
+        setattr(node, parts[-1], value)

@@ -1,0 +1,30 @@
+"""Import a user workflow .py file as a module.
+
+Equivalent of the reference's veles/import_file.py:1-80
+(import_file_as_module / as_package, used by Main._load_model,
+veles/__main__.py:396-424)."""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from types import ModuleType
+
+
+def import_file_as_module(path: str, name: str = None) -> ModuleType:
+    path = os.path.abspath(path)
+    if name is None:
+        name = os.path.splitext(os.path.basename(path))[0]
+    spec = importlib.util.spec_from_file_location(name, path)
+    if spec is None or spec.loader is None:
+        raise ImportError("cannot import %s" % path)
+    module = importlib.util.module_from_spec(spec)
+    sys.modules[name] = module
+    # the model file's siblings (shared loaders etc.) become importable
+    sys.path.insert(0, os.path.dirname(path))
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.pop(0)
+    return module

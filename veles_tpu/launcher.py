@@ -1,0 +1,101 @@
+"""Launcher: run-mode resolution and workflow lifecycle.
+
+Equivalent of the reference's veles/launcher.py:100-906. Mode resolution
+simplifies radically: the reference arbitrated standalone/master/slave and
+spawned slaves over SSH; here every process is a peer in one SPMD job
+(jax distributed runtime), so the modes are standalone vs multi-host
+participant (+ train vs test). Preserved surface: device creation,
+workflow initialize ordering, snapshot resume, graceful stop, results
+gathering/reporting, elapsed/timing reporting, status beacon hook.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, Optional
+
+from .backends import Device_for, XLADevice
+from .config import root
+from .logger import Logger
+from . import prng
+from .parallel import distributed
+
+
+class Launcher(Logger):
+    def __init__(self, backend: Optional[str] = None,
+                 mesh: Optional[Dict[str, int]] = None,
+                 coordinator: Optional[str] = None,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None,
+                 random_seed: Optional[int] = None,
+                 test_mode: bool = False) -> None:
+        super().__init__()
+        self.test_mode = test_mode
+        self.workflow = None
+        self.device = None
+        self._backend = backend
+        self._mesh = mesh
+        self._dist = (coordinator, num_processes, process_id)
+        if random_seed is not None:
+            prng.seed_all(random_seed)
+        self._start_time = None
+        self.stopped = False
+
+    # -- lifecycle -----------------------------------------------------------
+    def initialize(self, workflow) -> None:
+        coordinator, nproc, pid = self._dist
+        distributed.initialize_multihost(coordinator, nproc, pid)
+        if self._mesh:
+            self.device = XLADevice(mesh_axes=self._mesh)
+        else:
+            self.device = Device_for(self._backend)
+        self.workflow = workflow
+        workflow.initialize(device=self.device)
+        distributed.verify_checksums(workflow)
+        self.event("launcher.initialize", "single",
+                   device=self.device.name,
+                   processes=distributed.process_count()
+                   if hasattr(distributed, "process_count") else 1)
+
+    def resume(self, snapshot_path: str) -> None:
+        from .snapshotter import resume
+        resume(self.workflow, snapshot_path)
+        decision = getattr(self.workflow, "decision", None)
+        if decision is not None:
+            decision.complete <<= False
+        self.info("resumed from %s", snapshot_path)
+
+    def run(self) -> Dict[str, Any]:
+        self._start_time = time.time()
+        self.event("launcher.work", "begin")
+        try:
+            self.workflow.run()
+        except KeyboardInterrupt:
+            self.warning("interrupted — stopping workflow")
+            self.workflow.stop()
+        finally:
+            self.event("launcher.work", "end")
+            self.stopped = True
+        elapsed = time.time() - self._start_time
+        self.info("elapsed: %.1fs", elapsed)
+        results = self.workflow.gather_results()
+        results["elapsed_sec"] = round(elapsed, 3)
+        return results
+
+    def stop(self) -> None:
+        if self.workflow is not None:
+            self.workflow.stop()
+        self.stopped = True
+
+    # -- reporting -----------------------------------------------------------
+    def write_results(self, results: Dict[str, Any], path: str) -> None:
+        """--result-file (reference: veles/workflow.py:827-849)."""
+        if not distributed.is_coordinator():
+            return
+        with open(path, "w") as fout:
+            json.dump(results, fout, indent=2, default=str)
+        self.info("results → %s", path)
+
+    def print_stats(self) -> None:
+        self.workflow.print_stats()
