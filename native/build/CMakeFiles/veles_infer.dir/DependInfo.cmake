@@ -1,0 +1,21 @@
+
+# Consider dependencies only in project.
+set(CMAKE_DEPENDS_IN_PROJECT_ONLY OFF)
+
+# The set of languages for which implicit dependencies are needed:
+set(CMAKE_DEPENDS_LANGUAGES
+  )
+
+# The set of dependency files which are needed:
+set(CMAKE_DEPENDS_DEPENDENCY_FILES
+  "/root/repo/native/src/json.cc" "CMakeFiles/veles_infer.dir/src/json.cc.o" "gcc" "CMakeFiles/veles_infer.dir/src/json.cc.o.d"
+  "/root/repo/native/src/model.cc" "CMakeFiles/veles_infer.dir/src/model.cc.o" "gcc" "CMakeFiles/veles_infer.dir/src/model.cc.o.d"
+  "/root/repo/native/src/npy.cc" "CMakeFiles/veles_infer.dir/src/npy.cc.o" "gcc" "CMakeFiles/veles_infer.dir/src/npy.cc.o.d"
+  )
+
+# Targets to which this target links.
+set(CMAKE_TARGET_LINKED_INFO_FILES
+  )
+
+# Fortran module output directory.
+set(CMAKE_Fortran_TARGET_MODULE_DIR "")

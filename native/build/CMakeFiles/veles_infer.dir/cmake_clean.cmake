@@ -1,0 +1,15 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/veles_infer.dir/src/json.cc.o"
+  "CMakeFiles/veles_infer.dir/src/json.cc.o.d"
+  "CMakeFiles/veles_infer.dir/src/model.cc.o"
+  "CMakeFiles/veles_infer.dir/src/model.cc.o.d"
+  "CMakeFiles/veles_infer.dir/src/npy.cc.o"
+  "CMakeFiles/veles_infer.dir/src/npy.cc.o.d"
+  "libveles_infer.pdb"
+  "libveles_infer.so"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/veles_infer.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,13 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/veles_infer_cli.dir/src/main.cc.o"
+  "CMakeFiles/veles_infer_cli.dir/src/main.cc.o.d"
+  "CMakeFiles/veles_infer_cli.dir/src/npy.cc.o"
+  "CMakeFiles/veles_infer_cli.dir/src/npy.cc.o.d"
+  "veles_infer"
+  "veles_infer.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/veles_infer_cli.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

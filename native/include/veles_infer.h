@@ -1,0 +1,47 @@
+/* veles_infer — standalone C++ inference runtime for veles_tpu packages.
+ *
+ * The TPU-era equivalent of the reference's libVeles (WorkflowLoader::Load
+ * / Workflow::Run / UnitFactory, libVeles/inc/veles/*.h): loads a package
+ * directory (contents.json + .npy parameters, written by
+ * veles_tpu.export.package_export) and executes the forward chain on the
+ * host, no Python required. C ABI so ctypes/cffi can bind it.
+ */
+#ifndef VELES_INFER_H_
+#define VELES_INFER_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct vi_model vi_model;
+
+/* Load a package directory. Returns NULL on failure (see vi_last_error). */
+vi_model *vi_load(const char *package_dir);
+
+/* Input element count per sample (product of input_shape[1:]). */
+size_t vi_input_size(const vi_model *m);
+
+/* Output element count per sample, for a given batch of 1. */
+size_t vi_output_size(const vi_model *m);
+
+/* Run the forward chain: in = batch*vi_input_size floats, out must hold
+ * batch*vi_output_size floats. Returns 0 on success. */
+int vi_run(vi_model *m, const float *in, size_t batch, float *out);
+
+/* Number of units in the chain. */
+size_t vi_unit_count(const vi_model *m);
+
+const char *vi_unit_name(const vi_model *m, size_t idx);
+const char *vi_unit_type(const vi_model *m, size_t idx);
+
+const char *vi_last_error(void);
+
+void vi_free(vi_model *m);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* VELES_INFER_H_ */

@@ -1,0 +1,128 @@
+#include "json.h"
+
+namespace veles {
+namespace {
+
+struct Parser {
+  const std::string &s;
+  size_t pos = 0;
+
+  explicit Parser(const std::string &text) : s(text) {}
+
+  void SkipWs() {
+    while (pos < s.size() && (s[pos] == ' ' || s[pos] == '\n' ||
+                              s[pos] == '\t' || s[pos] == '\r'))
+      ++pos;
+  }
+
+  char Peek() {
+    SkipWs();
+    if (pos >= s.size()) throw std::runtime_error("json: eof");
+    return s[pos];
+  }
+
+  void Expect(char c) {
+    if (Peek() != c)
+      throw std::runtime_error(std::string("json: expected ") + c);
+    ++pos;
+  }
+
+  Json Value() {
+    char c = Peek();
+    if (c == '{') return Object();
+    if (c == '[') return Array();
+    if (c == '"') return String();
+    if (c == 't' || c == 'f') return Boolean();
+    if (c == 'n') { pos += 4; return Json(); }
+    return Number();
+  }
+
+  Json Object() {
+    Json j;
+    j.type = Json::Type::Object;
+    Expect('{');
+    if (Peek() == '}') { ++pos; return j; }
+    while (true) {
+      Json key = String();
+      Expect(':');
+      j.obj[key.str] = Value();
+      char c = Peek();
+      ++pos;
+      if (c == '}') break;
+      if (c != ',') throw std::runtime_error("json: bad object");
+    }
+    return j;
+  }
+
+  Json Array() {
+    Json j;
+    j.type = Json::Type::Array;
+    Expect('[');
+    if (Peek() == ']') { ++pos; return j; }
+    while (true) {
+      j.arr.push_back(Value());
+      char c = Peek();
+      ++pos;
+      if (c == ']') break;
+      if (c != ',') throw std::runtime_error("json: bad array");
+    }
+    return j;
+  }
+
+  Json String() {
+    Json j;
+    j.type = Json::Type::String;
+    Expect('"');
+    while (pos < s.size() && s[pos] != '"') {
+      char c = s[pos++];
+      if (c == '\\' && pos < s.size()) {
+        char e = s[pos++];
+        switch (e) {
+          case 'n': c = '\n'; break;
+          case 't': c = '\t'; break;
+          case 'r': c = '\r'; break;
+          case 'u': {  // keep it simple: latin-1 subset
+            int code = std::stoi(s.substr(pos, 4), nullptr, 16);
+            pos += 4;
+            c = static_cast<char>(code);
+            break;
+          }
+          default: c = e;
+        }
+      }
+      j.str.push_back(c);
+    }
+    ++pos;  // closing quote
+    return j;
+  }
+
+  Json Boolean() {
+    Json j;
+    j.type = Json::Type::Bool;
+    if (s.compare(pos, 4, "true") == 0) { j.bval = true; pos += 4; }
+    else { j.bval = false; pos += 5; }
+    return j;
+  }
+
+  Json Number() {
+    size_t end = pos;
+    while (end < s.size() && (isdigit(s[end]) || s[end] == '-' ||
+                              s[end] == '+' || s[end] == '.' ||
+                              s[end] == 'e' || s[end] == 'E'))
+      ++end;
+    Json j;
+    j.type = Json::Type::Number;
+    j.num = std::stod(s.substr(pos, end - pos));
+    pos = end;
+    return j;
+  }
+};
+
+}  // namespace
+
+Json Json::Parse(const std::string &text) {
+  Parser p(text);
+  return p.Value();
+}
+
+}  // namespace veles

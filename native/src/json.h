@@ -1,0 +1,49 @@
+// Minimal JSON parser for contents.json (the reference vendored rapidjson,
+// libVeles .gitmodules; this runtime keeps zero external dependencies).
+#ifndef VELES_JSON_H_
+#define VELES_JSON_H_
+
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+namespace veles {
+
+class Json {
+ public:
+  enum class Type { Null, Bool, Number, String, Array, Object };
+
+  Type type = Type::Null;
+  bool bval = false;
+  double num = 0;
+  std::string str;
+  std::vector<Json> arr;
+  std::map<std::string, Json> obj;
+
+  static Json Parse(const std::string &text);
+
+  bool Has(const std::string &key) const {
+    return type == Type::Object && obj.count(key) > 0;
+  }
+  const Json &operator[](const std::string &key) const {
+    auto it = obj.find(key);
+    if (it == obj.end()) throw std::runtime_error("missing key " + key);
+    return it->second;
+  }
+  const Json &operator[](size_t i) const { return arr.at(i); }
+  int AsInt() const { return static_cast<int>(num); }
+  double AsDouble() const { return num; }
+  bool AsBool() const { return type == Type::Bool ? bval : num != 0; }
+  const std::string &AsString() const { return str; }
+  std::vector<int> AsIntVector() const {
+    std::vector<int> out;
+    for (const auto &v : arr) out.push_back(v.AsInt());
+    return out;
+  }
+};
+
+}  // namespace veles
+
+#endif  // VELES_JSON_H_

@@ -1,0 +1,76 @@
+// veles_infer CLI: run an exported package on a .npy input batch.
+// Usage: veles_infer <package_dir> <input.npy> <output.npy>
+// (the libVeles equivalent of a standalone Workflow::Run driver)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <string>
+#include <vector>
+
+#include "../include/veles_infer.h"
+#include "npy.h"
+
+namespace {
+
+void SaveNpyF32(const std::string &path, const std::vector<int> &shape,
+                const float *data, size_t n) {
+  std::string header = "{'descr': '<f4', 'fortran_order': False, "
+                       "'shape': (";
+  for (size_t i = 0; i < shape.size(); ++i) {
+    header += std::to_string(shape[i]);
+    if (shape.size() == 1 || i + 1 < shape.size()) header += ", ";
+  }
+  header += "), }";
+  size_t total = 10 + header.size() + 1;
+  size_t pad = (64 - total % 64) % 64;
+  header += std::string(pad, ' ');
+  header += '\n';
+
+  std::ofstream fout(path, std::ios::binary);
+  fout.write("\x93NUMPY\x01\x00", 8);
+  uint16_t len = static_cast<uint16_t>(header.size());
+  fout.write(reinterpret_cast<const char *>(&len), 2);
+  fout.write(header.data(), header.size());
+  fout.write(reinterpret_cast<const char *>(data),
+             static_cast<std::streamsize>(sizeof(float) * n));
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc != 4) {
+    std::fprintf(stderr,
+                 "usage: %s <package_dir> <input.npy> <output.npy>\n",
+                 argv[0]);
+    return 2;
+  }
+  vi_model *model = vi_load(argv[1]);
+  if (!model) {
+    std::fprintf(stderr, "load failed: %s\n", vi_last_error());
+    return 1;
+  }
+  veles::NpyArray input = veles::LoadNpy(argv[2]);
+  size_t batch = static_cast<size_t>(input.shape[0]);
+  size_t per_sample = input.size() / batch;
+  if (per_sample != vi_input_size(model)) {
+    std::fprintf(stderr, "input size %zu != model input %zu\n",
+                 per_sample, vi_input_size(model));
+    vi_free(model);
+    return 1;
+  }
+  std::vector<float> out(batch * vi_output_size(model));
+  if (vi_run(model, input.data.data(), batch, out.data())) {
+    std::fprintf(stderr, "run failed: %s\n", vi_last_error());
+    vi_free(model);
+    return 1;
+  }
+  std::vector<int> out_shape = {static_cast<int>(batch),
+                                static_cast<int>(vi_output_size(model))};
+  SaveNpyF32(argv[3], out_shape, out.data(), out.size());
+  std::fprintf(stderr, "OK: %zu samples through %zu units\n", batch,
+               vi_unit_count(model));
+  vi_free(model);
+  return 0;
+}

@@ -1,0 +1,602 @@
+// Package loader + forward executor — the libVeles equivalent
+// (libVeles/src/workflow_loader.cc, workflow.cc, unit_factory.cc):
+// UnitFactory keyed by the package's unit "type" strings, buffer ping-pong
+// between units (the reference ran a MemoryOptimizer arena,
+// libVeles/src/memory_optimizer.h:43; two reusable buffers suffice for a
+// chain), std::thread batch splitting for the hot matmul/conv loops.
+
+#include "../include/veles_infer.h"
+
+#include <algorithm>
+#include <cmath>
+#include <cstring>
+#include <fstream>
+#include <functional>
+#include <map>
+#include <memory>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "json.h"
+#include "npy.h"
+
+namespace veles {
+namespace {
+
+thread_local std::string g_error;
+
+struct Tensor {
+  std::vector<int> shape;
+  std::vector<float> data;
+
+  size_t size() const {
+    size_t n = 1;
+    for (int d : shape) n *= static_cast<size_t>(d);
+    return n;
+  }
+  void Resize(std::vector<int> s) {
+    shape = std::move(s);
+    data.resize(size());
+  }
+};
+
+void ParallelFor(int n, const std::function<void(int, int)> &fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int workers = std::min<int>(std::max(1u, hw), n);
+  if (workers <= 1 || n < 4) {
+    fn(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  int chunk = (n + workers - 1) / workers;
+  for (int w = 0; w < workers; ++w) {
+    int lo = w * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back(fn, lo, hi);
+  }
+  for (auto &t : threads) t.join();
+}
+
+inline float Sigmoid(float v) { return 1.0f / (1.0f + std::exp(-v)); }
+
+// ---------------------------------------------------------------------------
+// Units
+
+struct Unit {
+  std::string name, type;
+  std::map<std::string, NpyArray> params;
+
+  virtual ~Unit() = default;
+  virtual void Run(const Tensor &in, Tensor *out) = 0;
+
+  const NpyArray *Param(const std::string &key) const {
+    auto it = params.find(key);
+    return it == params.end() ? nullptr : &it->second;
+  }
+};
+
+enum class Act { kNone, kTanhScaled, kRelu, kSigmoid, kSoftmax };
+
+void ApplyAct(Act act, float *data, int batch, int features) {
+  switch (act) {
+    case Act::kNone:
+      return;
+    case Act::kTanhScaled:
+      for (int i = 0; i < batch * features; ++i)
+        data[i] = 1.7159f * std::tanh(0.6666f * data[i]);
+      return;
+    case Act::kRelu:
+      for (int i = 0; i < batch * features; ++i)
+        data[i] = std::max(data[i], 0.0f);
+      return;
+    case Act::kSigmoid:
+      for (int i = 0; i < batch * features; ++i) data[i] = Sigmoid(data[i]);
+      return;
+    case Act::kSoftmax:
+      for (int b = 0; b < batch; ++b) {
+        float *row = data + static_cast<size_t>(b) * features;
+        float mx = row[0];
+        for (int j = 1; j < features; ++j) mx = std::max(mx, row[j]);
+        float sum = 0;
+        for (int j = 0; j < features; ++j) {
+          row[j] = std::exp(row[j] - mx);
+          sum += row[j];
+        }
+        for (int j = 0; j < features; ++j) row[j] /= sum;
+      }
+      return;
+  }
+}
+
+struct All2All : Unit {
+  Act act = Act::kNone;
+  std::vector<int> out_shape;  // per-sample
+
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *w = Param("weights");
+    const NpyArray *bias = Param("bias");
+    int batch = in.shape[0];
+    int fin = static_cast<int>(in.size()) / batch;
+    int fout = w->shape[1];
+    std::vector<int> os = {batch};
+    for (int d : out_shape) os.push_back(d);
+    out->Resize(os);
+    ParallelFor(batch, [&](int lo, int hi) {
+      for (int b = lo; b < hi; ++b) {
+        const float *x = in.data.data() + static_cast<size_t>(b) * fin;
+        float *y = out->data.data() + static_cast<size_t>(b) * fout;
+        for (int j = 0; j < fout; ++j)
+          y[j] = bias ? bias->data[j] : 0.0f;
+        for (int i = 0; i < fin; ++i) {
+          float xv = x[i];
+          if (xv == 0.0f) continue;
+          const float *wrow = w->data.data() +
+                              static_cast<size_t>(i) * fout;
+          for (int j = 0; j < fout; ++j) y[j] += xv * wrow[j];
+        }
+      }
+    });
+    ApplyAct(act, out->data.data(), batch, fout);
+  }
+};
+
+struct Activation : Unit {
+  std::string kind;
+  double factor = 1.0;
+
+  void Run(const Tensor &in, Tensor *out) override {
+    *out = in;
+    size_t n = out->size();
+    float *d = out->data.data();
+    if (kind == "activation_tanh")
+      for (size_t i = 0; i < n; ++i) d[i] = std::tanh(d[i]);
+    else if (kind == "activation_relu")  // softplus (Znicz naming)
+      for (size_t i = 0; i < n; ++i)
+        d[i] = std::max(d[i], 0.0f) + std::log1p(std::exp(-std::fabs(d[i])));
+    else if (kind == "activation_str")
+      for (size_t i = 0; i < n; ++i) d[i] = std::max(d[i], 0.0f);
+    else if (kind == "activation_sigmoid")
+      for (size_t i = 0; i < n; ++i) d[i] = Sigmoid(d[i]);
+    else if (kind == "activation_log")
+      for (size_t i = 0; i < n; ++i) d[i] = std::asinh(d[i]);
+    else if (kind == "activation_mul")
+      for (size_t i = 0; i < n; ++i) d[i] *= static_cast<float>(factor);
+    else if (kind == "dropout") {
+      // eval mode: identity
+    } else {
+      throw std::runtime_error("unknown activation " + kind);
+    }
+  }
+};
+
+struct Conv : Unit {
+  int n_kernels, kx, ky, sx, sy, pl, pt, pr, pb;
+  Act act = Act::kNone;
+
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *w = Param("weights");  // (ky, kx, cin, cout)
+    const NpyArray *bias = Param("bias");
+    int batch = in.shape[0], h = in.shape[1], wd = in.shape[2],
+        c = in.shape[3];
+    int oh = (h + pt + pb - ky) / sy + 1;
+    int ow = (wd + pl + pr - kx) / sx + 1;
+    out->Resize({batch, oh, ow, n_kernels});
+    ParallelFor(batch, [&](int blo, int bhi) {
+      for (int b = blo; b < bhi; ++b) {
+        for (int i = 0; i < oh; ++i) {
+          for (int j = 0; j < ow; ++j) {
+            float *y = out->data.data() +
+                       (((static_cast<size_t>(b) * oh + i) * ow + j) *
+                        n_kernels);
+            for (int k = 0; k < n_kernels; ++k)
+              y[k] = bias ? bias->data[k] : 0.0f;
+            for (int dy = 0; dy < ky; ++dy) {
+              int yy = i * sy + dy - pt;
+              if (yy < 0 || yy >= h) continue;
+              for (int dx = 0; dx < kx; ++dx) {
+                int xx = j * sx + dx - pl;
+                if (xx < 0 || xx >= wd) continue;
+                const float *xrow =
+                    in.data.data() +
+                    (((static_cast<size_t>(b) * h + yy) * wd + xx) * c);
+                const float *wrow =
+                    w->data.data() +
+                    ((static_cast<size_t>(dy) * kx + dx) * c) * n_kernels;
+                for (int ci = 0; ci < c; ++ci) {
+                  float xv = xrow[ci];
+                  const float *wk = wrow + static_cast<size_t>(ci) *
+                                    n_kernels;
+                  for (int k = 0; k < n_kernels; ++k) y[k] += xv * wk[k];
+                }
+              }
+            }
+          }
+        }
+      }
+    });
+    ApplyAct(act, out->data.data(), batch * oh * ow, n_kernels);
+  }
+};
+
+struct Pooling : Unit {
+  int kx, ky, sx, sy;
+  bool is_max = true;
+
+  void Run(const Tensor &in, Tensor *out) override {
+    int batch = in.shape[0], h = in.shape[1], w = in.shape[2],
+        c = in.shape[3];
+    // ceil mode with edge-clipped windows (matches the python oracle)
+    int oh = h >= ky ? (h - ky + sy - 1) / sy + 1 : 1;
+    int ow = w >= kx ? (w - kx + sx - 1) / sx + 1 : 1;
+    out->Resize({batch, oh, ow, c});
+    ParallelFor(batch, [&](int blo, int bhi) {
+      for (int b = blo; b < bhi; ++b)
+        for (int i = 0; i < oh; ++i)
+          for (int j = 0; j < ow; ++j)
+            for (int ci = 0; ci < c; ++ci) {
+              float acc = is_max ? -1e30f : 0.0f;
+              int count = 0;
+              for (int dy = 0; dy < ky; ++dy) {
+                int yy = i * sy + dy;
+                if (yy >= h) continue;
+                for (int dx = 0; dx < kx; ++dx) {
+                  int xx = j * sx + dx;
+                  if (xx >= w) continue;
+                  float v = in.data[
+                      ((static_cast<size_t>(b) * h + yy) * w + xx) * c +
+                      ci];
+                  if (is_max)
+                    acc = std::max(acc, v);
+                  else
+                    acc += v;
+                  ++count;
+                }
+              }
+              out->data[((static_cast<size_t>(b) * oh + i) * ow + j) * c +
+                        ci] = is_max ? acc : acc / std::max(count, 1);
+            }
+    });
+  }
+};
+
+struct Depooling : Unit {
+  int kx, ky;
+
+  void Run(const Tensor &in, Tensor *out) override {
+    int batch = in.shape[0], h = in.shape[1], w = in.shape[2],
+        c = in.shape[3];
+    out->Resize({batch, h * ky, w * kx, c});
+    for (int b = 0; b < batch; ++b)
+      for (int i = 0; i < h * ky; ++i)
+        for (int j = 0; j < w * kx; ++j)
+          std::memcpy(
+              out->data.data() +
+                  ((static_cast<size_t>(b) * h * ky + i) * w * kx + j) * c,
+              in.data.data() +
+                  ((static_cast<size_t>(b) * h + i / ky) * w + j / kx) * c,
+              sizeof(float) * c);
+  }
+};
+
+struct Deconv : Unit {
+  int n_channels, kx, ky, sx, sy, pl, pt, pr, pb;
+
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *w = Param("weights");  // (ky, kx, cin, cout)
+    const NpyArray *bias = Param("bias");
+    int batch = in.shape[0], h = in.shape[1], wd = in.shape[2],
+        cin = in.shape[3];
+    int oh = (h - 1) * sy + ky - pt - pb;
+    int ow = (wd - 1) * sx + kx - pl - pr;
+    out->Resize({batch, oh, ow, n_channels});
+    std::fill(out->data.begin(), out->data.end(), 0.0f);
+    ParallelFor(batch, [&](int blo, int bhi) {
+      for (int b = blo; b < bhi; ++b)
+        for (int i = 0; i < h; ++i)
+          for (int j = 0; j < wd; ++j) {
+            const float *x = in.data.data() +
+                ((static_cast<size_t>(b) * h + i) * wd + j) * cin;
+            for (int dy = 0; dy < ky; ++dy) {
+              int yy = i * sy + dy - pt;
+              if (yy < 0 || yy >= oh) continue;
+              for (int dx = 0; dx < kx; ++dx) {
+                int xx = j * sx + dx - pl;
+                if (xx < 0 || xx >= ow) continue;
+                float *y = out->data.data() +
+                    ((static_cast<size_t>(b) * oh + yy) * ow + xx) *
+                    n_channels;
+                const float *wk = w->data.data() +
+                    ((static_cast<size_t>(dy) * kx + dx) * cin) *
+                    n_channels;
+                for (int ci = 0; ci < cin; ++ci)
+                  for (int k = 0; k < n_channels; ++k)
+                    y[k] += x[ci] * wk[static_cast<size_t>(ci) *
+                                       n_channels + k];
+              }
+            }
+          }
+    });
+    if (bias)
+      for (size_t i = 0; i < out->size(); ++i)
+        out->data[i] += bias->data[i % n_channels];
+  }
+};
+
+struct LRN : Unit {
+  double alpha = 1e-4, beta = 0.75, k = 2.0;
+  int n = 5;
+
+  void Run(const Tensor &in, Tensor *out) override {
+    *out = in;
+    int c = in.shape.back();
+    size_t rows = in.size() / c;
+    int half = n / 2;
+    for (size_t r = 0; r < rows; ++r) {
+      const float *x = in.data.data() + r * c;
+      float *y = out->data.data() + r * c;
+      for (int i = 0; i < c; ++i) {
+        float win = 0;
+        for (int j = std::max(0, i - half);
+             j < std::min(c, i + half + 1); ++j)
+          win += x[j] * x[j];
+        y[i] = x[i] / std::pow(static_cast<float>(k) +
+                               static_cast<float>(alpha) * win,
+                               static_cast<float>(beta));
+      }
+    }
+  }
+};
+
+struct Lstm : Unit {
+  int hidden;
+  bool return_sequences = false;
+  float forget_bias = 1.0f;
+
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *w = Param("weights");  // (d+h, 4h)
+    const NpyArray *bias = Param("bias");
+    int batch = in.shape[0], t = in.shape[1], d = in.shape[2];
+    int h4 = 4 * hidden;
+    if (return_sequences)
+      out->Resize({batch, t, hidden});
+    else
+      out->Resize({batch, hidden});
+    ParallelFor(batch, [&](int blo, int bhi) {
+      std::vector<float> hs(hidden, 0.0f), cs(hidden, 0.0f), z(h4);
+      for (int b = blo; b < bhi; ++b) {
+        std::fill(hs.begin(), hs.end(), 0.0f);
+        std::fill(cs.begin(), cs.end(), 0.0f);
+        for (int step = 0; step < t; ++step) {
+          const float *x = in.data.data() +
+              (static_cast<size_t>(b) * t + step) * d;
+          for (int j = 0; j < h4; ++j) z[j] = bias ? bias->data[j] : 0.0f;
+          for (int i = 0; i < d; ++i) {
+            float xv = x[i];
+            const float *wrow = w->data.data() +
+                                static_cast<size_t>(i) * h4;
+            for (int j = 0; j < h4; ++j) z[j] += xv * wrow[j];
+          }
+          for (int i = 0; i < hidden; ++i) {
+            float hv = hs[i];
+            const float *wrow = w->data.data() +
+                                static_cast<size_t>(d + i) * h4;
+            for (int j = 0; j < h4; ++j) z[j] += hv * wrow[j];
+          }
+          for (int i = 0; i < hidden; ++i) {
+            float ig = Sigmoid(z[i]);
+            float fg = Sigmoid(z[hidden + i] + forget_bias);
+            float gg = std::tanh(z[2 * hidden + i]);
+            float og = Sigmoid(z[3 * hidden + i]);
+            cs[i] = fg * cs[i] + ig * gg;
+            hs[i] = og * std::tanh(cs[i]);
+          }
+          if (return_sequences)
+            std::memcpy(out->data.data() +
+                            (static_cast<size_t>(b) * t + step) * hidden,
+                        hs.data(), sizeof(float) * hidden);
+        }
+        if (!return_sequences)
+          std::memcpy(out->data.data() +
+                          static_cast<size_t>(b) * hidden,
+                      hs.data(), sizeof(float) * hidden);
+      }
+    });
+  }
+};
+
+// ---------------------------------------------------------------------------
+// Factory
+
+std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
+  auto get_pair = [&](const std::string &key, int a, int b) {
+    std::pair<int, int> out{a, b};
+    if (cfg.Has(key)) {
+      auto v = cfg[key].AsIntVector();
+      out = {v.at(0), v.at(1)};
+    }
+    return out;
+  };
+  auto get4 = [&](const std::string &key) {
+    std::vector<int> v = {0, 0, 0, 0};
+    if (cfg.Has(key)) v = cfg[key].AsIntVector();
+    return v;
+  };
+
+  if (type.rfind("all2all", 0) == 0 || type == "softmax") {
+    auto u = std::make_unique<All2All>();
+    if (type == "all2all_tanh") u->act = Act::kTanhScaled;
+    else if (type == "all2all_relu") u->act = Act::kRelu;
+    else if (type == "all2all_sigmoid") u->act = Act::kSigmoid;
+    else if (type == "softmax") u->act = Act::kSoftmax;
+    if (cfg.Has("output_sample_shape"))
+      u->out_shape = cfg["output_sample_shape"].AsIntVector();
+    return u;
+  }
+  if (type.rfind("conv", 0) == 0) {
+    auto u = std::make_unique<Conv>();
+    u->n_kernels = cfg["n_kernels"].AsInt();
+    u->kx = cfg["kx"].AsInt();
+    u->ky = cfg["ky"].AsInt();
+    auto s = get_pair("sliding", 1, 1);
+    u->sx = s.first;
+    u->sy = s.second;
+    auto p = get4("padding");
+    u->pl = p[0]; u->pt = p[1]; u->pr = p[2]; u->pb = p[3];
+    if (type == "conv_tanh") u->act = Act::kTanhScaled;
+    else if (type == "conv_relu") u->act = Act::kRelu;
+    else if (type == "conv_sigmoid") u->act = Act::kSigmoid;
+    return u;
+  }
+  if (type == "max_pooling" || type == "avg_pooling" ||
+      type == "stochastic_pooling") {
+    auto u = std::make_unique<Pooling>();
+    u->is_max = (type != "avg_pooling");
+    u->kx = cfg.Has("kx") ? cfg["kx"].AsInt() : 2;
+    u->ky = cfg.Has("ky") ? cfg["ky"].AsInt() : 2;
+    auto s = get_pair("sliding", u->kx, u->ky);
+    u->sx = s.first;
+    u->sy = s.second;
+    return u;
+  }
+  if (type == "depooling") {
+    auto u = std::make_unique<Depooling>();
+    u->kx = cfg.Has("kx") ? cfg["kx"].AsInt() : 2;
+    u->ky = cfg.Has("ky") ? cfg["ky"].AsInt() : 2;
+    return u;
+  }
+  if (type == "deconv") {
+    auto u = std::make_unique<Deconv>();
+    u->n_channels = cfg["n_channels"].AsInt();
+    u->kx = cfg["kx"].AsInt();
+    u->ky = cfg["ky"].AsInt();
+    auto s = get_pair("sliding", 1, 1);
+    u->sx = s.first;
+    u->sy = s.second;
+    auto p = get4("padding");
+    u->pl = p[0]; u->pt = p[1]; u->pr = p[2]; u->pb = p[3];
+    return u;
+  }
+  if (type == "norm") {
+    auto u = std::make_unique<LRN>();
+    if (cfg.Has("alpha")) u->alpha = cfg["alpha"].AsDouble();
+    if (cfg.Has("beta")) u->beta = cfg["beta"].AsDouble();
+    if (cfg.Has("k")) u->k = cfg["k"].AsDouble();
+    if (cfg.Has("n")) u->n = cfg["n"].AsInt();
+    return u;
+  }
+  if (type == "lstm") {
+    auto u = std::make_unique<Lstm>();
+    u->hidden = cfg["hidden_size"].AsInt();
+    if (cfg.Has("return_sequences"))
+      u->return_sequences = cfg["return_sequences"].AsBool();
+    if (cfg.Has("forget_bias"))
+      u->forget_bias = static_cast<float>(cfg["forget_bias"].AsDouble());
+    return u;
+  }
+  if (type.rfind("activation", 0) == 0 || type == "dropout") {
+    auto u = std::make_unique<Activation>();
+    u->kind = type;
+    if (cfg.Has("factor")) u->factor = cfg["factor"].AsDouble();
+    return u;
+  }
+  throw std::runtime_error("unit factory: unsupported type " + type);
+}
+
+}  // namespace
+
+}  // namespace veles
+
+// ---------------------------------------------------------------------------
+// C ABI
+
+struct vi_model {
+  std::vector<std::unique_ptr<veles::Unit>> units;
+  std::vector<int> input_shape;
+  size_t output_size = 0;
+};
+
+extern "C" {
+
+const char *vi_last_error(void) { return veles::g_error.c_str(); }
+
+vi_model *vi_load(const char *package_dir) {
+  try {
+    std::string dir(package_dir);
+    std::ifstream fin(dir + "/contents.json");
+    if (!fin) throw std::runtime_error("cannot open contents.json in " +
+                                       dir);
+    std::stringstream ss;
+    ss << fin.rdbuf();
+    veles::Json contents = veles::Json::Parse(ss.str());
+
+    auto model = std::make_unique<vi_model>();
+    model->input_shape = contents["input_shape"].AsIntVector();
+    for (const auto &uj : contents["units"].arr) {
+      auto unit = veles::MakeUnit(uj["type"].AsString(), uj["config"]);
+      unit->name = uj["name"].AsString();
+      unit->type = uj["type"].AsString();
+      for (const auto &kv : uj["params"].obj)
+        unit->params[kv.first] =
+            veles::LoadNpy(dir + "/" + kv.second.AsString());
+      model->units.push_back(std::move(unit));
+    }
+    // probe output size with batch 1
+    veles::Tensor probe, next;
+    std::vector<int> shape = model->input_shape;
+    shape[0] = 1;
+    probe.Resize(shape);
+    for (auto &u : model->units) {
+      u->Run(probe, &next);
+      std::swap(probe, next);
+    }
+    model->output_size = probe.size();
+    return model.release();
+  } catch (const std::exception &e) {
+    veles::g_error = e.what();
+    return nullptr;
+  }
+}
+
+size_t vi_input_size(const vi_model *m) {
+  size_t n = 1;
+  for (size_t i = 1; i < m->input_shape.size(); ++i)
+    n *= static_cast<size_t>(m->input_shape[i]);
+  return n;
+}
+
+size_t vi_output_size(const vi_model *m) { return m->output_size; }
+
+size_t vi_unit_count(const vi_model *m) { return m->units.size(); }
+
+const char *vi_unit_name(const vi_model *m, size_t idx) {
+  return m->units[idx]->name.c_str();
+}
+
+const char *vi_unit_type(const vi_model *m, size_t idx) {
+  return m->units[idx]->type.c_str();
+}
+
+int vi_run(vi_model *m, const float *in, size_t batch, float *out) {
+  try {
+    veles::Tensor cur, next;
+    std::vector<int> shape = m->input_shape;
+    shape[0] = static_cast<int>(batch);
+    cur.Resize(shape);
+    std::memcpy(cur.data.data(), in, sizeof(float) * cur.size());
+    for (auto &u : m->units) {
+      u->Run(cur, &next);
+      std::swap(cur, next);
+    }
+    std::memcpy(out, cur.data.data(), sizeof(float) * cur.size());
+    return 0;
+  } catch (const std::exception &e) {
+    veles::g_error = e.what();
+    return 1;
+  }
+}
+
+void vi_free(vi_model *m) { delete m; }
+
+}  // extern "C"

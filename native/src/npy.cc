@@ -1,0 +1,112 @@
+#include "npy.h"
+
+#include <cstdint>
+#include <cstring>
+#include <fstream>
+#include <stdexcept>
+
+namespace veles {
+namespace {
+
+std::string ReadFile(const std::string &path) {
+  std::ifstream fin(path, std::ios::binary);
+  if (!fin) throw std::runtime_error("npy: cannot open " + path);
+  return std::string(std::istreambuf_iterator<char>(fin),
+                     std::istreambuf_iterator<char>());
+}
+
+// Extract "'key': value" fields from the python-dict header.
+std::string HeaderField(const std::string &header, const std::string &key) {
+  size_t k = header.find("'" + key + "'");
+  if (k == std::string::npos)
+    throw std::runtime_error("npy: header missing " + key);
+  size_t colon = header.find(':', k);
+  size_t end = colon + 1;
+  int depth = 0;
+  while (end < header.size()) {
+    char c = header[end];
+    if (c == '(' || c == '[') ++depth;
+    if (c == ')' || c == ']') --depth;
+    if ((c == ',' && depth == 0) || c == '}') break;
+    ++end;
+  }
+  return header.substr(colon + 1, end - colon - 1);
+}
+
+template <typename T>
+void Convert(const char *raw, size_t n, std::vector<float> *out) {
+  const T *src = reinterpret_cast<const T *>(raw);
+  out->resize(n);
+  for (size_t i = 0; i < n; ++i) (*out)[i] = static_cast<float>(src[i]);
+}
+
+}  // namespace
+
+NpyArray LoadNpy(const std::string &path) {
+  std::string blob = ReadFile(path);
+  if (blob.size() < 10 || blob.compare(1, 5, "NUMPY") != 0)
+    throw std::runtime_error("npy: bad magic in " + path);
+  uint8_t major = static_cast<uint8_t>(blob[6]);
+  size_t header_len, header_off;
+  if (major == 1) {
+    uint16_t len;
+    std::memcpy(&len, blob.data() + 8, 2);
+    header_len = len;
+    header_off = 10;
+  } else {
+    uint32_t len;
+    std::memcpy(&len, blob.data() + 8, 4);
+    header_len = len;
+    header_off = 12;
+  }
+  std::string header = blob.substr(header_off, header_len);
+
+  if (HeaderField(header, "fortran_order").find("True") !=
+      std::string::npos)
+    throw std::runtime_error("npy: fortran order unsupported: " + path);
+
+  NpyArray arr;
+  std::string shape = HeaderField(header, "shape");
+  for (size_t i = 0; i < shape.size();) {
+    if (isdigit(shape[i])) {
+      size_t end = i;
+      while (end < shape.size() && isdigit(shape[end])) ++end;
+      arr.shape.push_back(std::stoi(shape.substr(i, end - i)));
+      i = end;
+    } else {
+      ++i;
+    }
+  }
+
+  std::string descr = HeaderField(header, "descr");
+  const char *data = blob.data() + header_off + header_len;
+  size_t n = arr.size();
+  size_t avail = blob.size() - header_off - header_len;
+  auto need = [&](size_t esz) {
+    if (avail < n * esz)
+      throw std::runtime_error("npy: truncated " + path);
+  };
+  if (descr.find("<f4") != std::string::npos ||
+      descr.find("|f4") != std::string::npos) {
+    need(4);
+    Convert<float>(data, n, &arr.data);
+  } else if (descr.find("<f8") != std::string::npos) {
+    need(8);
+    Convert<double>(data, n, &arr.data);
+  } else if (descr.find("<i4") != std::string::npos) {
+    need(4);
+    Convert<int32_t>(data, n, &arr.data);
+  } else if (descr.find("<i8") != std::string::npos) {
+    need(8);
+    Convert<int64_t>(data, n, &arr.data);
+  } else if (descr.find("|u1") != std::string::npos) {
+    need(1);
+    Convert<uint8_t>(data, n, &arr.data);
+  } else {
+    throw std::runtime_error("npy: unsupported dtype " + descr + " in " +
+                             path);
+  }
+  return arr;
+}
+
+}  // namespace veles

@@ -1,0 +1,28 @@
+// Minimal .npy (v1/v2) reader — the equivalent of the reference's
+// NumpyArrayLoader (libVeles/src/numpy_array_loader.cc:1-250): parses the
+// header dict (dtype, fortran flag, shape) and yields float32 data.
+#ifndef VELES_NPY_H_
+#define VELES_NPY_H_
+
+#include <string>
+#include <vector>
+
+namespace veles {
+
+struct NpyArray {
+  std::vector<int> shape;
+  std::vector<float> data;  // always converted to float32, C order
+
+  size_t size() const {
+    size_t n = 1;
+    for (int d : shape) n *= static_cast<size_t>(d);
+    return n;
+  }
+};
+
+// Throws std::runtime_error on malformed files / unsupported dtypes.
+NpyArray LoadNpy(const std::string &path);
+
+}  // namespace veles
+
+#endif  // VELES_NPY_H_

@@ -88,3 +88,32 @@ def test_cli_bad_model(tmp_path):
     r = run_cli(str(bad), "--dry-run")
     assert r.returncode != 0
     assert "build_workflow" in r.stderr
+
+
+def test_cli_test_mode_no_updates(tiny_model, tmp_path):
+    """--test runs one evaluation pass without changing params."""
+    res = tmp_path / "t.json"
+    r = run_cli(tiny_model, "--test", "--result-file", str(res))
+    assert r.returncode == 0, r.stderr
+    data = json.loads(res.read_text())
+    assert data["epochs"] == 1
+
+
+def test_import_file_does_not_clobber_stdlib(tmp_path):
+    from veles_tpu.import_file import import_file_as_module
+    p = tmp_path / "json.py"
+    p.write_text("VALUE = 42\n")
+    m = import_file_as_module(str(p))
+    assert m.VALUE == 42
+    import json as real_json
+    assert hasattr(real_json, "dumps")
+
+
+def test_import_file_error_cleans_sys_modules(tmp_path):
+    import sys as _sys
+    from veles_tpu.import_file import import_file_as_module
+    p = tmp_path / "broken_model.py"
+    p.write_text("raise RuntimeError('boom')\n")
+    with pytest.raises(RuntimeError):
+        import_file_as_module(str(p))
+    assert "veles_model_broken_model" not in _sys.modules

@@ -46,6 +46,8 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
     if args.slave_death_probability:
         root.common.slave_death_probability = args.slave_death_probability
+    if args.snapshot_dir:
+        root.common.dirs.snapshots = args.snapshot_dir
     if args.timings:
         root.common.trace.timings = True
     if args.dump_config:
@@ -102,6 +104,8 @@ def _drive(launcher: Launcher, workflow, args):
     for key, value in sorted(results.items()):
         if not isinstance(value, dict):
             launcher.info("result %s = %s", key, value)
+    if launcher.interrupted:
+        sys.exit(130)   # Ctrl-C must not look like a completed run
     return results
 
 

@@ -1,0 +1,14 @@
+"""Model export + standalone inference runtimes.
+
+Equivalent of the reference's export pipeline (Workflow.package_export,
+veles/workflow.py:868-975 → libVeles C++ runtime, SURVEY.md §2.7): a
+trained workflow exports to a self-describing package (contents.json +
+.npy parameter/metadata files + a serialized StableHLO copy of the jitted
+forward), consumed by:
+- the C++ runtime in native/ (CMake target ``veles_infer`` +
+  ``libveles_infer.so``) — the libVeles equivalent, zero Python;
+- the ctypes in-process binding (export/native.py);
+- any PJRT-capable loader via the embedded StableHLO artifact.
+"""
+
+from .package import package_export, package_import, run_package  # noqa

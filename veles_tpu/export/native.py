@@ -1,0 +1,102 @@
+"""ctypes binding to the C++ inference runtime (libveles_infer.so).
+
+The in-process path to the native runtime (the reference linked libVeles
+into C++ apps; Python binds over the C ABI — no pybind11 needed)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy
+
+from ..error import VelesError
+
+_lib = None
+
+
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def find_library() -> Optional[str]:
+    for cand in (
+            os.environ.get("VELES_INFER_LIB"),
+            os.path.join(_repo_root(), "native", "build",
+                         "libveles_infer.so"),
+            "libveles_infer.so"):
+        if cand and os.path.exists(cand):
+            return cand
+    return None
+
+
+def load_library():
+    global _lib
+    if _lib is not None:
+        return _lib
+    path = find_library()
+    if path is None:
+        raise VelesError(
+            "libveles_infer.so not built; run: cmake -S native -B "
+            "native/build && cmake --build native/build -j")
+    lib = ctypes.CDLL(path)
+    lib.vi_load.restype = ctypes.c_void_p
+    lib.vi_load.argtypes = [ctypes.c_char_p]
+    lib.vi_input_size.restype = ctypes.c_size_t
+    lib.vi_input_size.argtypes = [ctypes.c_void_p]
+    lib.vi_output_size.restype = ctypes.c_size_t
+    lib.vi_output_size.argtypes = [ctypes.c_void_p]
+    lib.vi_unit_count.restype = ctypes.c_size_t
+    lib.vi_unit_count.argtypes = [ctypes.c_void_p]
+    lib.vi_run.restype = ctypes.c_int
+    lib.vi_run.argtypes = [ctypes.c_void_p,
+                           ctypes.POINTER(ctypes.c_float),
+                           ctypes.c_size_t,
+                           ctypes.POINTER(ctypes.c_float)]
+    lib.vi_last_error.restype = ctypes.c_char_p
+    lib.vi_free.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return lib
+
+
+class NativeModel:
+    """A loaded package running through the C++ engine."""
+
+    def __init__(self, package_dir: str) -> None:
+        self._lib = load_library()
+        self._handle = self._lib.vi_load(package_dir.encode())
+        if not self._handle:
+            raise VelesError("native load failed: %s" %
+                             self._lib.vi_last_error().decode())
+        self.input_size = self._lib.vi_input_size(self._handle)
+        self.output_size = self._lib.vi_output_size(self._handle)
+        self.unit_count = self._lib.vi_unit_count(self._handle)
+
+    def __call__(self, batch: numpy.ndarray) -> numpy.ndarray:
+        x = numpy.ascontiguousarray(batch, dtype=numpy.float32)
+        n = len(x)
+        if x.size != n * self.input_size:
+            raise VelesError("input size %d != %d per sample" %
+                             (x.size // n, self.input_size))
+        out = numpy.empty((n, self.output_size), dtype=numpy.float32)
+        rc = self._lib.vi_run(
+            self._handle,
+            x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc:
+            raise VelesError("native run failed: %s" %
+                             self._lib.vi_last_error().decode())
+        return out
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.vi_free(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,169 @@
+"""Workflow package export / import.
+
+Format (the reference's contents.json + .npy arrays scheme,
+libVeles/src/main_file_loader.cc / workflow_loader.cc, modernised):
+
+    <pkg>/contents.json     workflow name, input spec, ordered unit list
+                            (type, config, parameter file refs)
+    <pkg>/<unit>_<param>.npy parameter tensors (C-order, native endian)
+    <pkg>/forward.stablehlo  serialized jax.export artifact of the whole
+                            forward chain (portable XLA program)
+
+A package is a plain directory (optionally zipped with .zip suffix for
+transport — the C++ runtime consumes the directory form).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import zipfile
+from typing import Any, Dict, List, Optional
+
+import numpy
+
+from ..error import VelesError
+
+FORMAT_VERSION = 1
+
+#: unit config keys exported per type (subset that defines inference)
+_EXPORT_KEYS = (
+    "output_sample_shape", "n_kernels", "n_channels", "kx", "ky",
+    "sliding", "padding", "include_bias", "factor", "alpha", "beta",
+    "n", "k", "hidden_size", "return_sequences", "forget_bias",
+    "n_heads", "causal", "dropout_ratio",
+)
+
+
+def _unit_entry(fwd, pkg_dir: str) -> Dict[str, Any]:
+    cfg = {}
+    for key in _EXPORT_KEYS:
+        if hasattr(fwd, key):
+            val = getattr(fwd, key)
+            if isinstance(val, tuple):
+                val = list(val)
+            cfg[key] = val
+    params = {}
+    for pname, arr in fwd.param_arrays().items():
+        fname = "%s_%s.npy" % (fwd.name, pname)
+        numpy.save(os.path.join(pkg_dir, fname),
+                   numpy.ascontiguousarray(arr.map_read()))
+        params[pname] = fname
+    return {"name": fwd.name, "type": fwd.MAPPING, "config": cfg,
+            "params": params}
+
+
+def package_export(workflow, path: str,
+                   input_shape: Optional[List[int]] = None,
+                   with_stablehlo: bool = True) -> str:
+    """Export the workflow's forward chain (reference:
+    Workflow.package_export, veles/workflow.py:868)."""
+    forwards = getattr(workflow, "forwards", None)
+    if not forwards:
+        raise VelesError("workflow %s has no forward chain to export"
+                         % workflow.name)
+    step = getattr(workflow, "train_step", None)
+    if step is not None and step.params:
+        step.sync_params_to_arrays()
+
+    zipped = path.endswith(".zip")
+    pkg_dir = path[:-4] if zipped else path
+    os.makedirs(pkg_dir, exist_ok=True)
+
+    if input_shape is None:
+        first = forwards[0]
+        if first.input is None or not first.input:
+            raise VelesError("cannot infer input shape; pass input_shape")
+        input_shape = list(first.input.shape)
+
+    units = [_unit_entry(f, pkg_dir) for f in forwards]
+    contents = {
+        "format_version": FORMAT_VERSION,
+        "workflow": workflow.name,
+        "checksum": workflow.checksum(),
+        "input_shape": list(input_shape),
+        "input_dtype": "float32",
+        "units": units,
+    }
+    if with_stablehlo:
+        try:
+            contents["stablehlo"] = _export_stablehlo(
+                forwards, input_shape, pkg_dir)
+        except Exception as e:  # noqa: BLE001 - optional artifact
+            workflow.warning("stablehlo export skipped: %s", e)
+    with open(os.path.join(pkg_dir, "contents.json"), "w") as fout:
+        json.dump(contents, fout, indent=2)
+
+    if zipped:
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            for fname in sorted(os.listdir(pkg_dir)):
+                zf.write(os.path.join(pkg_dir, fname), fname)
+        shutil.rmtree(pkg_dir)
+        return path
+    return pkg_dir
+
+
+def _export_stablehlo(forwards, input_shape, pkg_dir: str) -> str:
+    """Serialize the composed forward as a portable XLA program
+    (the TPU-era replacement for shipping kernels: jax.export)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+
+    params = [{k: v.device_view() for k, v in f.param_arrays().items()}
+              for f in forwards]
+
+    def fwd(params, x):
+        for f, p in zip(forwards, params):
+            x = f.apply(p, x, train=False)
+        return x
+
+    x_spec = jax.ShapeDtypeStruct(tuple(input_shape), jnp.float32)
+    exported = jexport.export(jax.jit(fwd))(
+        jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params),
+        x_spec)
+    blob = exported.serialize()
+    fname = "forward.stablehlo"
+    with open(os.path.join(pkg_dir, fname), "wb") as fout:
+        fout.write(blob)
+    return fname
+
+
+def package_import(path: str) -> Dict[str, Any]:
+    """Load a package directory/zip → {contents, params{unit:{name:arr}}}."""
+    if path.endswith(".zip"):
+        import tempfile
+        tmp = tempfile.mkdtemp(prefix="veles_pkg_")
+        with zipfile.ZipFile(path) as zf:
+            zf.extractall(tmp)
+        path = tmp
+    with open(os.path.join(path, "contents.json")) as fin:
+        contents = json.load(fin)
+    params: Dict[str, Dict[str, numpy.ndarray]] = {}
+    for unit in contents["units"]:
+        params[unit["name"]] = {
+            pname: numpy.load(os.path.join(path, fname))
+            for pname, fname in unit["params"].items()}
+    return {"contents": contents, "params": params, "dir": path}
+
+
+def run_package(path_or_pkg, batch: numpy.ndarray) -> numpy.ndarray:
+    """Pure-python reference executor for a package (the oracle the C++
+    runtime is tested against)."""
+    from ..units import UnitRegistry
+    pkg = (package_import(path_or_pkg) if isinstance(path_or_pkg, str)
+           else path_or_pkg)
+    x = numpy.asarray(batch, dtype=numpy.float32)
+    for unit in pkg["contents"]["units"]:
+        cls = UnitRegistry.mapping[unit["type"]]
+        obj = cls.__new__(cls)
+        for k, v in unit["config"].items():
+            if isinstance(v, list):
+                v = tuple(v)   # json round-trips tuples as lists
+            setattr(obj, k, v)
+        # minimal attrs some numpy_apply impls expect
+        obj.name = unit["name"]
+        x = obj.numpy_apply(pkg["params"][unit["name"]], x)
+    return x

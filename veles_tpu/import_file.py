@@ -15,7 +15,10 @@ from types import ModuleType
 def import_file_as_module(path: str, name: str = None) -> ModuleType:
     path = os.path.abspath(path)
     if name is None:
-        name = os.path.splitext(os.path.basename(path))[0]
+        # namespaced key: a model file named json.py/numpy.py must not
+        # clobber the real library in sys.modules
+        name = "veles_model_" + os.path.splitext(
+            os.path.basename(path))[0]
     spec = importlib.util.spec_from_file_location(name, path)
     if spec is None or spec.loader is None:
         raise ImportError("cannot import %s" % path)
@@ -25,6 +28,9 @@ def import_file_as_module(path: str, name: str = None) -> ModuleType:
     sys.path.insert(0, os.path.dirname(path))
     try:
         spec.loader.exec_module(module)
+    except BaseException:
+        sys.modules.pop(name, None)  # no half-initialized cache entry
+        raise
     finally:
         sys.path.pop(0)
     return module

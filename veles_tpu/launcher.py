@@ -41,22 +41,42 @@ class Launcher(Logger):
             prng.seed_all(random_seed)
         self._start_time = None
         self.stopped = False
+        self.interrupted = False
 
     # -- lifecycle -----------------------------------------------------------
     def initialize(self, workflow) -> None:
+        from .error import VelesError
         coordinator, nproc, pid = self._dist
         distributed.initialize_multihost(coordinator, nproc, pid)
         if self._mesh:
-            self.device = XLADevice(mesh_axes=self._mesh)
+            if self._backend == "numpy" or root.common.engine.force_numpy:
+                raise VelesError(
+                    "--mesh requires an XLA backend; it cannot combine "
+                    "with numpy/--force-numpy")
+            platform = (self._backend
+                        if self._backend in ("cpu", "tpu") else None)
+            self.device = XLADevice(platform=platform,
+                                    mesh_axes=self._mesh)
         else:
             self.device = Device_for(self._backend)
         self.workflow = workflow
         workflow.initialize(device=self.device)
         distributed.verify_checksums(workflow)
+        if self.test_mode:
+            self._enter_test_mode(workflow)
         self.event("launcher.initialize", "single",
                    device=self.device.name,
-                   processes=distributed.process_count()
-                   if hasattr(distributed, "process_count") else 1)
+                   processes=distributed.process_count())
+
+    def _enter_test_mode(self, workflow) -> None:
+        """--test: one evaluation-only pass — no parameter updates
+        (reference test mode, veles/launcher.py mode resolution)."""
+        step = getattr(workflow, "train_step", None)
+        decision = getattr(workflow, "decision", None)
+        if step is not None:
+            step.evaluation_mode = True
+        if decision is not None:
+            decision.max_epochs = decision.epoch_number + 1
 
     def resume(self, snapshot_path: str) -> None:
         from .snapshotter import resume
@@ -74,6 +94,7 @@ class Launcher(Logger):
         except KeyboardInterrupt:
             self.warning("interrupted — stopping workflow")
             self.workflow.stop()
+            self.interrupted = True
         finally:
             self.event("launcher.work", "end")
             self.stopped = True
@@ -81,6 +102,8 @@ class Launcher(Logger):
         self.info("elapsed: %.1fs", elapsed)
         results = self.workflow.gather_results()
         results["elapsed_sec"] = round(elapsed, 3)
+        if self.interrupted:
+            results["interrupted"] = True
         return results
 
     def stop(self) -> None:

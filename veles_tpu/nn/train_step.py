@@ -59,6 +59,8 @@ class TrainStep(AcceleratedUnit):
         self.target_mode = target_mode
         self.gds: List[GradientDescentBase] = list(gds) if gds else []
         self.lr_scale = 1.0        # linked from LearningRateAdjust
+        #: --test mode: TRAIN minibatches evaluate without updating params
+        self.evaluation_mode = False
         self.params: Dict[str, Dict[str, Any]] = {}
         self.opt_state: Dict[str, Dict[str, Any]] = {}
         self._accum: Dict[int, Any] = {}
@@ -306,7 +308,7 @@ class TrainStep(AcceleratedUnit):
             accum = self._accum[cls] = self._make_zero_accum()
         dataset, labels, targets, indices, mask = self._inputs()
         planned = self.loader.plan_steps > 1
-        if cls == TRAIN:
+        if cls == TRAIN and not self.evaluation_mode:
             fn = self.jit("train",
                           self._train_plan_fn if planned
                           else self._train_step_fn,
