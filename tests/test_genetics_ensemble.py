@@ -1,0 +1,182 @@
+"""Meta-learning: GA hyper-parameter optimization + ensembles
+(SURVEY.md §2.6). Mirrors the reference's genetics/ensemble surface:
+gray coding, crossover families, Range markers ⇄ config mapping,
+optimizer driving real training runs, ensemble train/soft-vote-test."""
+import json
+import os
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn
+from veles_tpu.config import Config
+from veles_tpu.genetics import (GeneticsOptimizer, Population, Range,
+                                find_tuneables, fix_config)
+from veles_tpu.genetics.core import gray_decode, gray_encode
+from veles_tpu.ensemble import EnsembleTrainer, EnsembleTester
+from veles_tpu.loader import FullBatchLoader
+
+
+# -- GA core -----------------------------------------------------------------
+
+def test_gray_code_roundtrip():
+    for n in (0, 1, 5, 255, 1000, 65535):
+        assert gray_decode(gray_encode(n)) == n
+    # adjacent values differ by one bit in gray space
+    for n in range(200):
+        assert bin(gray_encode(n) ^ gray_encode(n + 1)).count("1") == 1
+
+
+@pytest.mark.parametrize("crossover",
+                         ["uniform", "arithmetic", "geometric", "pointed"])
+def test_population_optimizes_quadratic(crossover):
+    pop = Population(mins=[0.0, 0.0], maxs=[1.0, 1.0], size=16,
+                     crossover=crossover)
+
+    def fitness(chromo, _):
+        x, y = chromo.genes
+        return -((x - 0.3) ** 2 + (y - 0.7) ** 2)
+
+    for _ in range(15):
+        pop.evolve(fitness)
+    best = pop.best
+    assert best.fitness > -0.02, (crossover, best.genes)
+    # bounds respected everywhere
+    for c in pop.chromosomes:
+        assert (c.genes >= 0.0).all() and (c.genes <= 1.0).all()
+
+
+def test_integer_genes_stay_integer():
+    pop = Population(mins=[1], maxs=[64], ints=[True], size=8)
+    pop.evolve(lambda c, i: -abs(c.genes[0] - 17))
+    for c in pop.chromosomes:
+        assert c.genes[0] == round(c.genes[0])
+    assert isinstance(pop.best.values()[0], int)
+
+
+# -- Range markers ⇄ config --------------------------------------------------
+
+def test_find_and_fix_tuneables():
+    cfg = Config("root")
+    cfg.model.lr = Range(0.03, 0.001, 0.1)
+    cfg.model.hidden = Range(100, 10, 500)
+    cfg.other.fixed = 42
+    tuns = find_tuneables(cfg)
+    assert [t[0] for t in tuns] == ["root.model.lr", "root.model.hidden"]
+    assert tuns[1][3].is_int and not tuns[0][3].is_int
+    fix_config(tuns, [0.05, 200.3])
+    assert cfg.model.lr == 0.05
+    assert cfg.model.hidden == 200 and isinstance(cfg.model.hidden, int)
+
+
+def test_range_validates_default():
+    with pytest.raises(ValueError):
+        Range(5.0, 0.0, 1.0)
+
+
+def test_materialize_defaults_for_plain_runs():
+    """A config written for --optimize must run plainly: markers collapse
+    to their default values."""
+    from veles_tpu.genetics import materialize_defaults
+    cfg = Config("plain")
+    cfg.m.lr = Range(0.03, 0.001, 0.1)
+    cfg.m.hidden = Range(100, 10, 500)
+    assert materialize_defaults(cfg) == 2
+    assert cfg.m.lr == 0.03 and cfg.m.hidden == 100
+    assert materialize_defaults(cfg) == 0
+
+
+def test_optimizer_plumbing_with_fake_workflow():
+    """GeneticsOptimizer end to end against a stub workflow: fitness must
+    drive the config toward the known optimum."""
+    cfg = Config("opt")
+    cfg.m.x = Range(0.5, 0.0, 1.0)
+
+    class FakeWF:
+        def initialize(self, device=None):
+            pass
+
+        def run(self):
+            pass
+
+        def gather_results(self):
+            return {"best_err": abs(cfg.m.x - 0.25)}
+
+    opt = GeneticsOptimizer(build_workflow=FakeWF, config_node=cfg,
+                            size=10, generations=8)
+    res = opt.run()
+    assert abs(res["best_config"]["opt.m.x"] - 0.25) < 0.05
+    assert res["evaluations"] >= 10
+    # markers restored for subsequent runs
+    assert isinstance(cfg.m.x, Range)
+
+
+# -- real-training integration ----------------------------------------------
+
+class TinyBlobsLoader(FullBatchLoader):
+    """2-class blobs, small enough for many short trainings."""
+
+    hide_from_registry = True
+
+    def load_data(self):
+        rng = numpy.random.RandomState(3)
+        n, d = 120, 6
+        x0 = rng.randn(n, d).astype(numpy.float32) + 2.0
+        x1 = rng.randn(n, d).astype(numpy.float32) - 2.0
+        data = numpy.concatenate([x0, x1])
+        labels = numpy.concatenate(
+            [numpy.zeros(n), numpy.ones(n)]).astype(numpy.int32)
+        perm = rng.permutation(len(data))
+        self.create_originals(data[perm], labels[perm])
+        self.class_lengths = [0, 60, 180]
+
+
+def _tiny_workflow(epochs=3, lr=0.05):
+    loader = TinyBlobsLoader(None, minibatch_size=30, name="tinyblobs")
+    return nn.StandardWorkflow(
+        name="tiny", layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 8,
+             "learning_rate": lr},
+            {"type": "softmax", "output_sample_shape": 2,
+             "learning_rate": lr},
+        ], loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=epochs, fail_iterations=20))
+
+
+def test_ensemble_train_and_soft_vote(tmp_path):
+    dev = vt.XLADevice(mesh_axes={"data": 1})
+    manifest_file = str(tmp_path / "ens.json")
+    trainer = EnsembleTrainer(
+        _tiny_workflow, n_models=3, train_ratio=0.8, device=dev,
+        out_file=manifest_file, directory=str(tmp_path), base_seed=99)
+    manifest = trainer.run()
+    assert len(manifest["models"]) == 3
+    assert os.path.exists(manifest_file)
+    # distinct seeds, snapshots on disk
+    seeds = {m["seed"] for m in manifest["models"]}
+    assert seeds == {99, 100, 101}
+    for m in manifest["models"]:
+        assert os.path.exists(m["snapshot"])
+        assert m["results"]["best_err"] < 0.2
+
+    tester = EnsembleTester(_tiny_workflow, manifest_file, device=dev)
+    out = tester.run()
+    assert out["n_models"] == 3
+    assert out["ensemble_err"] <= 0.2
+    # soft vote can't be (much) worse than the worst member on this data
+    assert out["ensemble_err"] <= max(out["member_errs"]) + 1e-9
+
+
+def test_train_ratio_subsamples_train_class():
+    loader = TinyBlobsLoader(None, minibatch_size=30, name="sub")
+    loader.train_ratio = 0.5
+    wf = nn.StandardWorkflow(
+        name="sub", layers=[{"type": "softmax", "output_sample_shape": 2}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=1))
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    assert loader.class_lengths == [0, 60, 90]
+    # indices stay valid rows of the original data
+    assert loader._shuffled_indices.max() < 240
+    assert len(loader._shuffled_indices) == 150

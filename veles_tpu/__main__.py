@@ -62,6 +62,15 @@ def main(argv=None) -> int:
         test_mode=args.test)
 
     module = import_file_as_module(args.model)
+    # a model module may (re)set config keys at import time (including
+    # Range markers); inline overrides must win — re-apply them
+    if args.config_list:
+        apply_config_overrides(root, args.config_list)
+
+    if args.optimize or args.ensemble_train or args.ensemble_test:
+        return _run_meta(launcher, module, args)
+
+    _materialize(args)
 
     if hasattr(module, "run"):
         # reference-style protocol
@@ -82,6 +91,53 @@ def main(argv=None) -> int:
     raise VelesError(
         "%s defines neither build_workflow() nor run(load, main)"
         % args.model)
+
+
+def _materialize(args) -> None:
+    """Collapse Range/Tuneable markers to defaults — any run that is not
+    itself the optimizer must still work with an optimize-ready config."""
+    from .genetics.config import materialize_defaults
+    n = materialize_defaults(root)
+    if n:
+        logging.getLogger("veles_tpu").info(
+            "collapsed %d Range marker(s) to defaults (no --optimize)", n)
+
+
+def _run_meta(launcher: Launcher, module, args) -> int:
+    """--optimize / --ensemble-train / --ensemble-test modes
+    (reference: veles/__main__.py:334-361,724-732)."""
+    if not hasattr(module, "build_workflow"):
+        raise VelesError("meta-learning modes need build_workflow() in %s"
+                         % args.model)
+    device = launcher.make_device()   # honors --mesh/--coordinator/...
+    if args.optimize:
+        from .genetics import GeneticsOptimizer
+        size, _, gens = args.optimize.partition(":")
+        extra = []               # forwarded to subprocess candidates
+        if args.config:
+            extra.append(args.config)
+        if args.backend:
+            extra += ["--backend", args.backend]
+        result = GeneticsOptimizer(
+            build_workflow=module.build_workflow, model_path=args.model,
+            size=int(size), generations=int(gens or 3),
+            device=device, extra_argv=extra).run()
+    elif args.ensemble_train:
+        _materialize(args)
+        from .ensemble import EnsembleTrainer
+        n, _, ratio = args.ensemble_train.partition(":")
+        result = EnsembleTrainer(
+            module.build_workflow, n_models=int(n),
+            train_ratio=float(ratio or 1.0), device=device,
+            out_file=args.ensemble_file).run()
+    else:
+        from .ensemble import EnsembleTester
+        _materialize(args)
+        result = EnsembleTester(module.build_workflow, args.ensemble_test,
+                                device=device).run()
+    if args.result_file:
+        launcher.write_results(result, args.result_file)
+    return 0
 
 
 def _drive(launcher: Launcher, workflow, args):

@@ -54,6 +54,18 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--slave-death-probability", type=float, default=0.0,
                    help="fault injection for recovery testing")
+    # meta-learning (reference --optimize / --ensemble-train/-test,
+    # veles/__main__.py:334-361,724-732)
+    p.add_argument("--optimize", default=None, metavar="SIZE[:GENS]",
+                   help="GA hyper-parameter search over Range() markers "
+                        "in the config tree")
+    p.add_argument("--ensemble-train", default=None, metavar="N[:RATIO]",
+                   help="train N ensemble members, each on RATIO of the "
+                        "train set (default 1.0)")
+    p.add_argument("--ensemble-test", default=None, metavar="MANIFEST",
+                   help="soft-vote evaluate a trained ensemble manifest")
+    p.add_argument("--ensemble-file", default="ensemble.json",
+                   help="where --ensemble-train writes its manifest")
     return p
 
 

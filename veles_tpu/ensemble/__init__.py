@@ -1,0 +1,146 @@
+"""Ensemble meta-learning: train/test N model instances, aggregate.
+
+Rebuild of the reference's veles/ensemble/ (SURVEY.md §2.6:
+EnsembleModelManagerBase veles/ensemble/base_workflow.py:59,
+EnsembleModelWorkflow model_workflow.py:137, EnsembleTestWorkflow
+test_workflow.py:102; CLI --ensemble-train N[:r] / --ensemble-test,
+veles/__main__.py:347-361,727-732).
+
+- EnsembleTrainer: trains ``n_models`` instances of one workflow, each
+  with a distinct master seed and (optionally) a random ``train_ratio``
+  subset of the train set; writes per-model snapshot + results into one
+  ensemble JSON manifest.
+- EnsembleTester: rebuilds each instance, resumes its snapshot, runs the
+  forward chain over the validation set, and soft-votes (mean class
+  probability) into aggregate metrics.
+
+The reference evaluated members as master–slave jobs or subprocesses; here
+members run sequentially on the chip (they own all devices) — multi-slice
+fan-out is the scale-out story (SURVEY.md §2.4 "ensemble parallelism").
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import pickle
+import time
+from typing import Callable, Optional
+
+import numpy
+
+from .. import prng
+from ..config import root
+from ..error import VelesError
+from ..logger import Logger
+from ..loader.base import VALID
+from ..snapshotter import collect_state, load_snapshot, apply_state
+
+
+class EnsembleTrainer(Logger):
+    def __init__(self, build_workflow: Callable, n_models: int = 3,
+                 train_ratio: float = 1.0, device=None,
+                 out_file: str = "ensemble.json", base_seed: Optional[int]
+                 = None, directory: Optional[str] = None,
+                 prefix: str = "ensemble") -> None:
+        super().__init__()
+        self.build_workflow = build_workflow
+        self.n_models = int(n_models)
+        self.train_ratio = float(train_ratio)
+        self.device = device
+        self.out_file = out_file
+        self.base_seed = (int(base_seed) if base_seed is not None
+                          else int(root.common.random_seed))
+        self.directory = directory or root.common.dirs.snapshots
+        self.prefix = prefix
+
+    def _train_one(self, index: int) -> dict:
+        seed = self.base_seed + index
+        prng.seed_all(seed)
+        workflow = self.build_workflow()
+        if self.train_ratio < 1.0 and workflow.loader is not None:
+            workflow.loader.train_ratio = self.train_ratio
+        workflow.initialize(device=self.device)
+        t0 = time.time()
+        workflow.run()
+        results = workflow.gather_results()
+        os.makedirs(self.directory, exist_ok=True)
+        snap_path = os.path.join(
+            self.directory, "%s_%d.pickle.gz" % (self.prefix, index))
+        with gzip.open(snap_path, "wb") as fout:
+            pickle.dump(collect_state(workflow), fout,
+                        protocol=pickle.HIGHEST_PROTOCOL)
+        self.info("member %d/%d: seed %d, %.1fs, results %s",
+                  index + 1, self.n_models, seed, time.time() - t0,
+                  {k: v for k, v in results.items()
+                   if not isinstance(v, dict)})
+        return {"id": index, "seed": seed, "snapshot": snap_path,
+                "results": {k: v for k, v in results.items()
+                            if isinstance(v, (int, float, str, bool))
+                            or v is None}}
+
+    def run(self) -> dict:
+        manifest = {"n_models": self.n_models,
+                    "train_ratio": self.train_ratio,
+                    "base_seed": self.base_seed,
+                    "models": [self._train_one(i)
+                               for i in range(self.n_models)]}
+        with open(self.out_file, "w") as fout:
+            json.dump(manifest, fout, indent=2)
+        self.info("ensemble manifest → %s", self.out_file)
+        return manifest
+
+
+class EnsembleTester(Logger):
+    """Soft-voting evaluation of a trained ensemble over VALIDATION."""
+
+    def __init__(self, build_workflow: Callable, manifest: str | dict,
+                 device=None) -> None:
+        super().__init__()
+        self.build_workflow = build_workflow
+        if isinstance(manifest, str):
+            with open(manifest) as fin:
+                manifest = json.load(fin)
+        self.manifest = manifest
+        self.device = device
+
+    def _member_probs(self, entry: dict):
+        """(probs over VALID set, labels) for one member, via the trained
+        forward chain on host numpy (oracle path — identical math to the
+        jitted chain, veles_tpu/nn tests assert that)."""
+        prng.seed_all(entry["seed"])
+        workflow = self.build_workflow()
+        workflow.initialize(device=self.device)
+        apply_state(workflow, load_snapshot(entry["snapshot"]))
+        workflow.train_step.sync_params_to_arrays()
+        loader = workflow.loader
+        start = loader.class_end_offsets[VALID] - loader.class_lengths[VALID]
+        end = loader.class_end_offsets[VALID]
+        idx = numpy.arange(start, end)
+        x = loader.original_data.mem[idx]
+        if not loader.original_labels:
+            raise VelesError(
+                "EnsembleTester soft-voting needs integer labels; loader "
+                "%s has none (MSE/autoencoder ensembles are aggregated "
+                "from their results manifests instead)" % loader.name)
+        y = loader.original_labels.mem[idx]
+        for f in workflow.forwards:
+            x = f.numpy_apply(f.params_np(), x)
+        return x, y
+
+    def run(self) -> dict:
+        probs_sum, labels = None, None
+        member_errs = []
+        for entry in self.manifest["models"]:
+            probs, labels = self._member_probs(entry)
+            errs = float((probs.argmax(1) != labels).mean())
+            member_errs.append(errs)
+            probs_sum = probs if probs_sum is None else probs_sum + probs
+            self.info("member %d: validation error %.4f", entry["id"], errs)
+        ens_err = float((probs_sum.argmax(1) != labels).mean())
+        out = {"ensemble_err": ens_err, "member_errs": member_errs,
+               "n_models": len(self.manifest["models"])}
+        self.info("ensemble soft-vote validation error: %.4f "
+                  "(best member %.4f)", ens_err, min(member_errs))
+        return out

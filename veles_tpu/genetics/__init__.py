@@ -1,0 +1,17 @@
+"""Genetic hyper-parameter optimization (meta-learning).
+
+TPU-era rebuild of the reference's veles/genetics/ package (SURVEY.md §2.6):
+- core.py        — Chromosome / Population GA engine (gray coding, four
+                   crossover families, binary + gaussian mutation,
+                   roulette selection with elitism).
+- config.py      — Range/Tuneable markers placed inside the config tree
+                   and the chromosome ⇄ config mapping.
+- optimization.py— GeneticsOptimizer: evaluates each chromosome by
+                   building + running the user workflow, fitness from its
+                   gathered results.
+"""
+
+from .core import Chromosome, Population  # noqa: F401
+from .config import (Range, Tuneable, find_tuneables,  # noqa: F401
+                     fix_config, materialize_defaults)
+from .optimization import GeneticsOptimizer  # noqa: F401

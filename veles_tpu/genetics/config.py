@@ -1,0 +1,96 @@
+"""Range/Tuneable markers inside the config tree.
+
+Rebuild of the reference's veles/genetics/config.py:45-223: a user writes
+
+    root.my_model.lr = Range(0.03, 0.001, 0.1)
+    root.my_model.layers = Range(2, 1, 5)
+
+and the optimizer walks the tree, collects the markers (chromosome ⇄
+config mapping), and ``fix_config`` materializes one chromosome's values
+back into the tree before each evaluation (reference ``fix_config``
+:164).
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+from ..config import Config
+
+
+class Tuneable:
+    """Base marker for values the optimizer may change. In a plain
+    (non ``--optimize``) run, ``materialize_defaults`` collapses every
+    marker to its default before the workflow is built."""
+
+    def __init__(self, default: Any) -> None:
+        self.default = default
+
+    def __repr__(self) -> str:
+        return "%s(%r)" % (type(self).__name__, self.default)
+
+
+class Range(Tuneable):
+    """Numeric gene: default value plus inclusive [min, max] bounds.
+    Integer-ness is inferred from the default's type (reference
+    veles/genetics/config.py:45-130)."""
+
+    def __init__(self, default, vmin, vmax) -> None:
+        super().__init__(default)
+        if not vmin <= default <= vmax:
+            raise ValueError("Range default %r outside [%r, %r]"
+                             % (default, vmin, vmax))
+        self.min = vmin
+        self.max = vmax
+        self.is_int = isinstance(default, int) and not isinstance(
+            default, bool)
+
+    def __repr__(self) -> str:
+        return "Range(%r, %r, %r)" % (self.default, self.min, self.max)
+
+
+def find_tuneables(node: Config, path: str = None) -> List[
+        Tuple[str, Config, str, Range]]:
+    """DFS the config tree for Tuneable leaves.
+
+    Returns [(dotted_path, parent_node, attr_name, marker), ...] in
+    deterministic (insertion) order — gene order must be stable across
+    processes for distributed evaluation.
+    """
+    if path is None:
+        path = node._path_
+    found = []
+    for key, value in node.items():
+        sub = "%s.%s" % (path, key)
+        if isinstance(value, Config):
+            found.extend(find_tuneables(value, sub))
+        elif isinstance(value, Tuneable):
+            found.append((sub, node, key, value))
+    return found
+
+
+def fix_config(tuneables, values) -> None:
+    """Write one chromosome's values into the tree in marker order."""
+    if len(tuneables) != len(values):
+        raise ValueError("%d tuneables vs %d values"
+                         % (len(tuneables), len(values)))
+    for (path, node, key, marker), value in zip(tuneables, values):
+        setattr(node, key, int(value) if getattr(marker, "is_int", False)
+                else value)
+
+
+def materialize_defaults(node: Config) -> int:
+    """Collapse every Tuneable marker to its default value — called for
+    normal (non-optimizing) runs so a config written for ``--optimize``
+    still works as-is. Returns how many markers were replaced."""
+    replaced = 0
+    for path, parent, key, marker in find_tuneables(node):
+        setattr(parent, key, marker.default)
+        replaced += 1
+    return replaced
+
+
+def restore_markers(tuneables) -> None:
+    """Put the markers back (so repeated optimize runs see them)."""
+    for path, node, key, marker in tuneables:
+        setattr(node, key, marker)

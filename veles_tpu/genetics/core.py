@@ -1,0 +1,211 @@
+"""GA engine: chromosomes over bounded numeric genes.
+
+Rebuild of the reference's veles/genetics/core.py:58-830 capabilities:
+gray-coded binary genomes (helpers :58-121), Chromosome (:133) with
+binary-flip and gaussian "altering" mutations (:257), Population (:371)
+with uniform / arithmetic / geometric / pointed crossover (:428-429,
+633-659), roulette selection and elitism. The numeric representation here
+is a flat numpy vector per chromosome instead of the reference's
+per-gene python lists — the GA itself is host-side and tiny; device time
+is spent only inside the fitness evaluations (full training runs).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence
+
+import numpy
+
+from .. import prng
+from ..logger import Logger
+
+#: bits used for the gray-coded integer image of each gene
+GRAY_BITS = 16
+
+
+def gray_encode(n: int, bits: int = GRAY_BITS) -> int:
+    return n ^ (n >> 1)
+
+
+def gray_decode(g: int, bits: int = GRAY_BITS) -> int:
+    n = 0
+    while g:
+        n ^= g
+        g >>= 1
+    return n
+
+
+def _to_units(value: float, vmin: float, vmax: float,
+              bits: int = GRAY_BITS) -> int:
+    """Quantize value∈[vmin,vmax] onto a 2^bits grid."""
+    span = vmax - vmin
+    if span <= 0:
+        return 0
+    q = int(round((value - vmin) / span * ((1 << bits) - 1)))
+    return max(0, min((1 << bits) - 1, q))
+
+
+def _from_units(q: int, vmin: float, vmax: float,
+                bits: int = GRAY_BITS) -> float:
+    return vmin + (vmax - vmin) * q / float((1 << bits) - 1)
+
+
+class Chromosome:
+    """One candidate: a vector of genes, each bounded by [mins, maxs].
+
+    ``binary`` mutation operates on the gray-code image of each gene so a
+    single bit flip moves the value a (usually) small, occasionally large
+    step — the reference's mutation_binary_point behavior
+    (veles/genetics/core.py:257+).
+    """
+
+    def __init__(self, genes: numpy.ndarray, mins: numpy.ndarray,
+                 maxs: numpy.ndarray, ints: Sequence[bool]) -> None:
+        self.genes = numpy.asarray(genes, dtype=numpy.float64).copy()
+        self.mins = mins
+        self.maxs = maxs
+        self.ints = list(ints)
+        self.fitness: Optional[float] = None
+        self._snap()
+
+    def _snap(self) -> None:
+        numpy.clip(self.genes, self.mins, self.maxs, out=self.genes)
+        for i, isint in enumerate(self.ints):
+            if isint:
+                self.genes[i] = round(self.genes[i])
+
+    def values(self) -> list:
+        return [int(g) if isint else float(g)
+                for g, isint in zip(self.genes, self.ints)]
+
+    # -- mutations -----------------------------------------------------------
+    def mutate_binary(self, points: int, rand) -> None:
+        """Flip ``points`` random bits in the gray image of random genes."""
+        for _ in range(points):
+            i = int(rand.randint(0, len(self.genes)))
+            q = _to_units(self.genes[i], self.mins[i], self.maxs[i])
+            bit = int(rand.randint(0, GRAY_BITS))
+            q = gray_encode(q) ^ (1 << bit)
+            self.genes[i] = _from_units(gray_decode(q),
+                                        self.mins[i], self.maxs[i])
+        self._snap()
+
+    def mutate_gaussian(self, points: int, scale: float, rand) -> None:
+        """The reference's "altering" mutation: add gaussian noise scaled
+        to the gene's range."""
+        for _ in range(points):
+            i = int(rand.randint(0, len(self.genes)))
+            span = self.maxs[i] - self.mins[i]
+            self.genes[i] += rand.normal(0.0, scale * max(span, 1e-12))
+        self._snap()
+
+
+class Population(Logger):
+    """Fixed-size population with elitism.
+
+    evaluator(chromosome, index) -> float fitness (HIGHER is better);
+    assigned to chromosome.fitness by ``evolve``.
+    """
+
+    def __init__(self, mins: Sequence[float], maxs: Sequence[float],
+                 ints: Optional[Sequence[bool]] = None, size: int = 20,
+                 crossover: str = "uniform", elite_fraction: float = 0.15,
+                 mutation_rate: float = 0.25, rand=None) -> None:
+        super().__init__()
+        self.mins = numpy.asarray(mins, dtype=numpy.float64)
+        self.maxs = numpy.asarray(maxs, dtype=numpy.float64)
+        if self.mins.shape != self.maxs.shape or self.mins.ndim != 1:
+            raise ValueError("mins/maxs must be equal-length 1-D")
+        self.ints = list(ints) if ints is not None else [False] * len(mins)
+        self.size = int(size)
+        self.crossover = crossover
+        self.elite_fraction = float(elite_fraction)
+        self.mutation_rate = float(mutation_rate)
+        self.rand = rand or prng.get("genetics")
+        self.generation = 0
+        self.chromosomes: List[Chromosome] = [
+            self._random_chromosome() for _ in range(self.size)]
+
+    def _random_chromosome(self) -> Chromosome:
+        genes = self.mins + (self.maxs - self.mins) * self.rand.rand(
+            len(self.mins))
+        return Chromosome(genes, self.mins, self.maxs, self.ints)
+
+    @property
+    def best(self) -> Chromosome:
+        scored = [c for c in self.chromosomes if c.fitness is not None]
+        return max(scored, key=lambda c: c.fitness)
+
+    # -- selection -----------------------------------------------------------
+    def _roulette_pick(self) -> Chromosome:
+        fits = numpy.array([c.fitness for c in self.chromosomes])
+        # failed evaluations report -inf; give them zero selection weight
+        # without poisoning the arithmetic below
+        finite = numpy.isfinite(fits)
+        if not finite.any():
+            return self.chromosomes[int(self.rand.randint(0, len(fits)))]
+        fits = numpy.where(finite, fits, fits[finite].min())
+        fits = fits - fits.min() + 1e-9
+        fits[~finite] = 0.0
+        probs = fits / fits.sum()
+        i = int(numpy.searchsorted(numpy.cumsum(probs), self.rand.rand()))
+        return self.chromosomes[min(i, len(self.chromosomes) - 1)]
+
+    # -- crossover family (reference veles/genetics/core.py:428-429,633-659) --
+    def _cross(self, a: Chromosome, b: Chromosome) -> Chromosome:
+        kind = self.crossover
+        if kind == "uniform":
+            mask = self.rand.rand(len(a.genes)) < 0.5
+            genes = numpy.where(mask, a.genes, b.genes)
+        elif kind == "arithmetic":
+            t = self.rand.rand(len(a.genes))
+            genes = t * a.genes + (1.0 - t) * b.genes
+        elif kind == "geometric":
+            # geometric mean in range-normalized space keeps bounds
+            na = (a.genes - self.mins) / numpy.maximum(
+                self.maxs - self.mins, 1e-12)
+            nb = (b.genes - self.mins) / numpy.maximum(
+                self.maxs - self.mins, 1e-12)
+            g = numpy.sqrt(numpy.maximum(na, 1e-12) *
+                           numpy.maximum(nb, 1e-12))
+            genes = self.mins + g * (self.maxs - self.mins)
+        elif kind == "pointed":
+            # n-point crossover on the flat gene vector
+            n = max(1, len(a.genes) // 2)
+            points = sorted(set(
+                int(self.rand.randint(1, max(2, len(a.genes))))
+                for _ in range(n)))
+            genes = a.genes.copy()
+            src_b = False
+            prev = 0
+            for pt in points + [len(a.genes)]:
+                if src_b:
+                    genes[prev:pt] = b.genes[prev:pt]
+                src_b = not src_b
+                prev = pt
+        else:
+            raise ValueError("unknown crossover %r" % kind)
+        return Chromosome(genes, self.mins, self.maxs, self.ints)
+
+    # -- generation step ------------------------------------------------------
+    def evolve(self, evaluator: Callable[[Chromosome, int], float]) -> None:
+        """Evaluate all unscored chromosomes, then breed the next
+        generation (elite carried over unchanged)."""
+        for i, chromo in enumerate(self.chromosomes):
+            if chromo.fitness is None:
+                chromo.fitness = float(evaluator(chromo, i))
+        self.chromosomes.sort(key=lambda c: -c.fitness)
+        n_elite = max(1, int(round(self.size * self.elite_fraction)))
+        next_gen = self.chromosomes[:n_elite]
+        while len(next_gen) < self.size:
+            child = self._cross(self._roulette_pick(), self._roulette_pick())
+            if self.rand.rand() < self.mutation_rate:
+                if self.rand.rand() < 0.5:
+                    child.mutate_binary(1, self.rand)
+                else:
+                    child.mutate_gaussian(1, 0.1, self.rand)
+            next_gen.append(child)
+        self.chromosomes = next_gen
+        self.generation += 1
+        self.info("generation %d: best fitness %.6g",
+                  self.generation, self.chromosomes[0].fitness)

@@ -1,0 +1,138 @@
+"""GeneticsOptimizer: GA over the config tree, fitness = training result.
+
+Rebuild of the reference's veles/genetics/optimization_workflow.py:70-406
+(--optimize N[:G], veles/__main__.py:334-345,724-726): each chromosome
+evaluation is one full training run of the user model with the chromosome
+written into the config tree. Two evaluation modes:
+
+- inline (default): build_workflow() in-process, one jitted run per
+  candidate — recompiles only when a tuneable changes a traced shape.
+- subprocess: each candidate runs ``python -m veles_tpu MODEL --result-file
+  ...`` with root.x.y=value overrides, isolating device state (the
+  reference ran candidates as slave jobs / subprocesses).
+
+Fitness is read from the run's gathered results: ``-results[minimize]``
+(default minimize="best_err") or ``+results[maximize]``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Callable, Optional
+
+from ..config import root
+from ..logger import Logger
+from .config import find_tuneables, fix_config, restore_markers
+from .core import Population
+
+
+class GeneticsOptimizer(Logger):
+    def __init__(self, build_workflow: Optional[Callable] = None,
+                 model_path: Optional[str] = None,
+                 config_node=None, size: int = 10, generations: int = 5,
+                 minimize: str = "best_err", maximize: Optional[str] = None,
+                 device=None, subprocess_mode: bool = False,
+                 crossover: str = "uniform",
+                 extra_argv: Optional[list] = None) -> None:
+        super().__init__()
+        self.build_workflow = build_workflow
+        self.model_path = model_path
+        self.config_node = config_node if config_node is not None else root
+        self.minimize = minimize
+        self.maximize = maximize
+        self.device = device
+        self.subprocess_mode = subprocess_mode
+        self.extra_argv = list(extra_argv or [])
+        self.generations = int(generations)
+        self.tuneables = find_tuneables(self.config_node)
+        if not self.tuneables:
+            raise ValueError(
+                "no Range/Tuneable markers found in the config tree; "
+                "set e.g. root.model.lr = Range(0.03, 0.001, 0.1)")
+        self.population = Population(
+            mins=[t[3].min for t in self.tuneables],
+            maxs=[t[3].max for t in self.tuneables],
+            ints=[t[3].is_int for t in self.tuneables],
+            size=size, crossover=crossover)
+        self.evaluations = 0
+        self.history = []   # (values, fitness) of every evaluation
+
+    # -- fitness --------------------------------------------------------------
+    def _fitness_from_results(self, results: dict) -> float:
+        if self.maximize:
+            return float(results[self.maximize])
+        return -float(results[self.minimize])
+
+    def _evaluate_inline(self, values) -> float:
+        fix_config(self.tuneables, values)
+        try:
+            workflow = self.build_workflow()
+            workflow.initialize(device=self.device)
+            workflow.run()
+            return self._fitness_from_results(workflow.gather_results())
+        except Exception as exc:
+            # one pathological candidate (divergent lr, OOM shape, missing
+            # metric) must not abort the whole search — roulette gives
+            # -inf zero weight (core.py _roulette_pick)
+            self.warning("candidate %s failed: %s", values, exc)
+            return -float("inf")
+
+    def _evaluate_subprocess(self, values) -> float:
+        fd, result_file = tempfile.mkstemp(suffix=".json")
+        os.close(fd)
+        try:
+            overrides = ["%s=%s" % (path, json.dumps(v)) for
+                         (path, _, _, _), v in zip(self.tuneables, values)]
+            # overrides are re-applied by the child AFTER it imports the
+            # model module, so they win over import-time Range markers
+            cmd = ([sys.executable, "-m", "veles_tpu", self.model_path,
+                    "--result-file", result_file]
+                   + self.extra_argv + overrides)
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            if proc.returncode != 0:
+                self.warning("candidate failed (%s): %s",
+                             values, proc.stderr[-500:])
+                return -float("inf")
+            with open(result_file) as fin:
+                return self._fitness_from_results(json.load(fin))
+        finally:
+            os.unlink(result_file)
+
+    def _evaluate(self, chromo, index) -> float:
+        values = chromo.values()
+        if self.subprocess_mode:
+            fit = self._evaluate_subprocess(values)
+        else:
+            fit = self._evaluate_inline(values)
+        self.evaluations += 1
+        self.history.append((values, fit))
+        self.info("eval %d: %s → fitness %.6g", self.evaluations,
+                  dict(zip((t[0] for t in self.tuneables), values)), fit)
+        return fit
+
+    # -- driver ---------------------------------------------------------------
+    def run(self) -> dict:
+        """Evolve; returns {'best_config': {path: value}, 'best_fitness': f,
+        'evaluations': n, 'generations': g}."""
+        if self.subprocess_mode and not self.model_path:
+            raise ValueError("subprocess mode needs model_path")
+        if not self.subprocess_mode and self.build_workflow is None:
+            raise ValueError("inline mode needs build_workflow")
+        try:
+            for _ in range(self.generations):
+                self.population.evolve(self._evaluate)
+            best = self.population.best
+            best_cfg = dict(zip((t[0] for t in self.tuneables),
+                                best.values()))
+            self.info("optimize done: best %s fitness %.6g",
+                      best_cfg, best.fitness)
+            return {"best_config": best_cfg,
+                    "best_fitness": best.fitness,
+                    "evaluations": self.evaluations,
+                    "generations": self.population.generation}
+        finally:
+            restore_markers(self.tuneables)

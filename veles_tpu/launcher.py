@@ -44,7 +44,9 @@ class Launcher(Logger):
         self.interrupted = False
 
     # -- lifecycle -----------------------------------------------------------
-    def initialize(self, workflow) -> None:
+    def make_device(self):
+        """Distributed init + device/mesh resolution; shared by the normal
+        path and the meta-learning modes (--optimize/--ensemble-*)."""
         from .error import VelesError
         coordinator, nproc, pid = self._dist
         distributed.initialize_multihost(coordinator, nproc, pid)
@@ -59,6 +61,10 @@ class Launcher(Logger):
                                     mesh_axes=self._mesh)
         else:
             self.device = Device_for(self._backend)
+        return self.device
+
+    def initialize(self, workflow) -> None:
+        self.make_device()
         self.workflow = workflow
         workflow.initialize(device=self.device)
         distributed.verify_checksums(workflow)

@@ -45,6 +45,9 @@ class Loader(Unit):
         #: unlimited shuffles by default (reference shuffle_limit)
         self.shuffle_limit = (numpy.inf if shuffle_limit is None
                               else shuffle_limit)
+        #: train on a random subset of the train class (ensemble members,
+        #: reference --ensemble-train N:r, veles/ensemble/base_workflow.py:59)
+        self.train_ratio = 1.0
         # flags (reference :862-878)
         self.epoch_ended = Bool(False)
         self.last_minibatch = Bool(False)
@@ -117,6 +120,17 @@ class Loader(Unit):
             raise NoMoreJobs("loader %s has no samples" % self.name)
         self._shuffled_indices = numpy.arange(self.total_samples,
                                               dtype=numpy.int32)
+        if self.train_ratio < 1.0 and self.class_lengths[TRAIN]:
+            # random train subset: keep head (test+valid) intact, replace
+            # the train tail with a sampled subset of itself
+            start = self.class_end_offsets[VALID]
+            train = self._shuffled_indices[start:]
+            keep = max(1, int(round(len(train) * self.train_ratio)))
+            subset = self.prng.permutation(len(train))[:keep] + start
+            self._shuffled_indices = numpy.concatenate(
+                [self._shuffled_indices[:start],
+                 subset.astype(numpy.int32)])
+            self.class_lengths[TRAIN] = keep
         self.shuffle()
         self.create_minibatch_data()
         n = self.max_minibatch_size
@@ -238,6 +252,10 @@ class Loader(Unit):
         return {
             "epoch_number": self.epoch_number,
             "global_offset": self._global_offset,
+            # train_ratio subsetting rewrites geometry at initialize;
+            # a resume in a fresh process (default ratio 1.0) must see
+            # the subset geometry the indices were built for
+            "class_lengths": list(self.class_lengths),
             "shuffled_indices": (None if self._shuffled_indices is None
                                  else numpy.array(self._shuffled_indices)),
             "samples_served": self.samples_served,
@@ -250,6 +268,8 @@ class Loader(Unit):
     def load_state_dict(self, sd) -> None:
         self.epoch_number = sd["epoch_number"]
         self._global_offset = sd["global_offset"]
+        if "class_lengths" in sd:
+            self.class_lengths = list(sd["class_lengths"])
         if sd["shuffled_indices"] is not None:
             self._shuffled_indices = numpy.array(sd["shuffled_indices"])
         self.samples_served = sd["samples_served"]
