@@ -168,6 +168,52 @@ def test_ensemble_train_and_soft_vote(tmp_path):
     assert out["ensemble_err"] <= max(out["member_errs"]) + 1e-9
 
 
+def test_optimizer_subprocess_mode(tmp_path):
+    """Subprocess evaluation: candidate overrides must beat import-time
+    Range markers in the child (re-applied post-import)."""
+    model = tmp_path / "m.py"
+    model.write_text("""
+import os, sys
+sys.path.insert(0, %r)
+from veles_tpu.config import root
+from veles_tpu.genetics import Range
+
+root.subm.x = Range(0.5, 0.0, 1.0)
+
+
+class _WF:
+    loader = None
+
+    def initialize(self, device=None):
+        pass
+
+    def run(self):
+        pass
+
+    def gather_results(self):
+        return {"best_err": abs(float(root.subm.x) - 0.25)}
+
+
+def build_workflow():
+    return _WF()
+""" % os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from veles_tpu.config import root as cfg_root
+    from veles_tpu.genetics import Range as R
+    cfg_root.subm.x = R(0.5, 0.0, 1.0)
+    try:
+        opt = GeneticsOptimizer(
+            model_path=str(model), config_node=cfg_root.subm,
+            size=4, generations=1, subprocess_mode=True)
+        res = opt.run()
+        # fitness must VARY across candidates (not stuck at the marker
+        # default, which would mean overrides lost to import-time config)
+        fits = {round(f, 6) for _, f in opt.history}
+        assert len(fits) > 1, opt.history
+        assert 0.0 <= res["best_config"]["root.subm.x"] <= 1.0
+    finally:
+        delattr(cfg_root, "subm")
+
+
 def test_train_ratio_subsamples_train_class():
     loader = TinyBlobsLoader(None, minibatch_size=30, name="sub")
     loader.train_ratio = 0.5

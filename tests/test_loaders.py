@@ -1,0 +1,327 @@
+"""Loader family (SURVEY.md §2.3): file scanning, images+augmentation,
+pickles, HDF5, minibatch record/replay, streaming (interactive/zmq),
+downloader, ensemble outputs loader."""
+import gzip
+import json
+import os
+import pickle
+import tarfile
+import threading
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn
+from veles_tpu.downloader import Downloader
+from veles_tpu.error import VelesError
+from veles_tpu.loader import (FileFilter, FileListScanner, auto_label,
+                              ImageLoader, PicklesLoader, HDF5Loader,
+                              MinibatchesSaver, MinibatchesLoader,
+                              InteractiveLoader, ZeroMQLoader,
+                              EnsembleLoader, TEST, VALID, TRAIN)
+from veles_tpu.loader.image import decode_image, augment
+
+
+# -- file scanning -----------------------------------------------------------
+
+def _make_tree(tmp_path, spec):
+    for rel, content in spec.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_bytes(content)
+    return tmp_path
+
+
+def test_file_filter_and_scanner(tmp_path):
+    _make_tree(tmp_path, {
+        "train/cat/a.png": b"x", "train/cat/b.jpg": b"x",
+        "train/dog/c.png": b"x", "train/dog/skip.txt": b"x",
+        "valid/cat/d.png": b"x"})
+    f = FileFilter(include=("*.png", "*.jpg"), exclude=("b.*",))
+    files = f.scan(str(tmp_path / "train"))
+    names = [os.path.basename(p) for p in files]
+    assert names == ["a.png", "c.png"]
+    scanner = FileListScanner([str(tmp_path / "train")],
+                              [str(tmp_path / "valid")],
+                              file_filter=FileFilter(include=("*.png",)))
+    test_f, valid_f, train_f = scanner.scan()
+    assert len(train_f) == 2 and len(valid_f) == 1 and not test_f
+    assert auto_label(train_f[0]) == "cat"
+    with pytest.raises(VelesError):
+        FileListScanner(["/nonexistent/xyz"]).scan()
+
+
+# -- images ------------------------------------------------------------------
+
+def _write_png(path, color, size=(12, 10)):
+    from PIL import Image
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    Image.new("RGB", size, color).save(path)
+
+
+def test_decode_and_augment(tmp_path):
+    p = str(tmp_path / "img.png")
+    _write_png(p, (255, 0, 0), size=(10, 8))
+    arr = decode_image(p)                    # HWC in [0,1]
+    assert arr.shape == (8, 10, 3)
+    assert arr[..., 0].max() == 1.0 and arr[..., 1].max() == 0.0
+    arr = decode_image(p, size=(4, 6))
+    assert arr.shape == (4, 6, 3)
+    gray = decode_image(p, color="L")
+    assert gray.shape == (8, 10, 1)
+    variants = augment(arr, mirror=True, rotations=(0, 180))
+    assert len(variants) == 4
+    crops = augment(arr, crop=(2, 2), crop_number=3,
+                    rand=numpy.random.RandomState(0))
+    assert len(crops) == 3 and crops[0].shape == (2, 2, 3)
+
+
+def test_image_loader_end_to_end(tmp_path):
+    for i in range(4):
+        _write_png(str(tmp_path / ("train/red/r%d.png" % i)), (255, 0, 0))
+        _write_png(str(tmp_path / ("train/blue/b%d.png" % i)), (0, 0, 255))
+    _write_png(str(tmp_path / "valid/red/v0.png"), (255, 0, 0))
+    _write_png(str(tmp_path / "valid/blue/v1.png"), (0, 0, 255))
+    loader = ImageLoader(
+        None, train_paths=[str(tmp_path / "train")],
+        validation_paths=[str(tmp_path / "valid")],
+        size=(8, 8), mirror=True, minibatch_size=4, name="imgs")
+    loader.initialize(device=None)
+    # 8 train images ×2 (mirror) = 16 train samples, 2 validation
+    assert loader.class_lengths == [0, 2, 16]
+    assert loader.labels_mapping == {"blue": 0, "red": 1}
+    assert loader.original_data.shape == (18, 8, 8, 3)
+    # labels match pixel content: red channel high ⇒ label "red"
+    data, labels = loader.original_data.mem, loader.original_labels.mem
+    for row, lab in zip(data, labels):
+        assert lab == (1 if row[..., 0].mean() > 0.5 else 0)
+
+
+def test_image_loader_shape_mismatch(tmp_path):
+    _write_png(str(tmp_path / "train/a/x.png"), (1, 2, 3), size=(5, 5))
+    _write_png(str(tmp_path / "train/b/y.png"), (1, 2, 3), size=(7, 7))
+    loader = ImageLoader(None, train_paths=[str(tmp_path / "train")],
+                         name="bad")
+    with pytest.raises(VelesError, match="differing shapes"):
+        loader.initialize(device=None)
+
+
+# -- pickles / hdf5 ----------------------------------------------------------
+
+def _blob(n, d=4, seed=0):
+    rng = numpy.random.RandomState(seed)
+    return (rng.randn(n, d).astype(numpy.float32),
+            rng.randint(0, 3, n).astype(numpy.int32))
+
+
+def test_pickles_loader(tmp_path):
+    tr, trl = _blob(30)
+    va, val = _blob(10, seed=1)
+    ptr, pva = str(tmp_path / "tr.pickle"), str(tmp_path / "va.pickle")
+    pickle.dump((tr, trl), open(ptr, "wb"))
+    pickle.dump({"data": va, "labels": val}, open(pva, "wb"))
+    loader = PicklesLoader(None, files=(None, pva, ptr),
+                           minibatch_size=10, name="pk")
+    loader.initialize(device=None)
+    assert loader.class_lengths == [0, 10, 30]
+    numpy.testing.assert_allclose(loader.original_data.mem[:10], va,
+                                  rtol=1e-6)
+    assert (loader.original_labels.mem[10:] == trl).all()
+
+
+def test_hdf5_loader(tmp_path):
+    import h5py
+    tr, trl = _blob(20)
+    path = str(tmp_path / "d.h5")
+    with h5py.File(path, "w") as f:
+        f["data"] = tr
+        f["labels"] = trl
+    loader = HDF5Loader(None, files=(None, None, path),
+                        validation_ratio=0.25, minibatch_size=5, name="h5")
+    loader.initialize(device=None)
+    assert loader.class_lengths == [0, 5, 15]
+    assert loader.total_samples == 20
+
+
+# -- minibatch record / replay ----------------------------------------------
+
+class _TinyLoader(vt.loader.FullBatchLoader):
+    hide_from_registry = True
+
+    def load_data(self):
+        data, labels = _blob(24, seed=2)
+        self.create_originals(data, labels)
+        self.class_lengths = [0, 8, 16]
+
+
+def test_minibatches_saver_roundtrip(tmp_path):
+    fname = str(tmp_path / "mb.vtmb")
+    loader = _TinyLoader(None, minibatch_size=8, name="src")
+    saver = MinibatchesSaver(None, file_name=fname, name="saver")
+    saver.loader = loader
+    loader.initialize(device=None)
+    saver.initialize(device=None)
+    served = []
+    for _ in range(3):   # one epoch: 1 valid + 2 train minibatches
+        loader.run()
+        saver.run()
+        served.append(numpy.array(loader.minibatch_data.mem))
+    saver.stop()
+    replay = MinibatchesLoader(None, file_name=fname, minibatch_size=8,
+                               name="replay")
+    replay.initialize(device=None)
+    assert replay.class_lengths == [0, 8, 16]
+    # recorded sample set equals the source dataset (order may differ)
+    src = numpy.sort(numpy.concatenate(served), axis=0)
+    rec = numpy.sort(replay.original_data.mem, axis=0)
+    numpy.testing.assert_allclose(src, rec, rtol=1e-6)
+
+
+def test_minibatches_saver_fused_loader(tmp_path):
+    """The default training path (fused TrainStep) never fills
+    minibatch_data on host — the saver must gather from the originals."""
+    fname = str(tmp_path / "fused.vtmb")
+    loader = _TinyLoader(None, minibatch_size=8, name="fsrc")
+    saver = MinibatchesSaver(None, file_name=fname, name="fsaver")
+    saver.loader = loader
+    loader.fused = True
+    loader.initialize(device=None)
+    saver.initialize(device=None)
+    for _ in range(3):
+        loader.run()
+        saver.run()
+    saver.stop()
+    replay = MinibatchesLoader(None, file_name=fname, minibatch_size=8,
+                               name="freplay")
+    replay.initialize(device=None)
+    assert replay.total_samples == 24
+    assert numpy.abs(replay.original_data.mem).sum() > 0   # not zeros
+    src = numpy.sort(loader.original_data.mem, axis=0)
+    rec = numpy.sort(replay.original_data.mem, axis=0)
+    numpy.testing.assert_allclose(src, rec, rtol=1e-6)
+
+
+def test_hdf5_inconsistent_labels_rejected(tmp_path):
+    import h5py
+    tr, trl = _blob(20)
+    va, _ = _blob(6, seed=1)
+    p_tr, p_va = str(tmp_path / "tr.h5"), str(tmp_path / "va.h5")
+    with h5py.File(p_tr, "w") as f:
+        f["data"], f["labels"] = tr, trl
+    with h5py.File(p_va, "w") as f:
+        f["data"] = va          # no labels
+    loader = HDF5Loader(None, files=(None, p_va, p_tr), name="badh5")
+    with pytest.raises(VelesError, match="inconsistent"):
+        loader.initialize(device=None)
+
+
+def test_validation_carve_is_class_balanced():
+    """resize_validation must not slice a class-sorted head (would yield a
+    single-class validation set)."""
+
+    class SortedLoader(vt.loader.FullBatchLoader):
+        hide_from_registry = True
+
+        def load_data(self):
+            # class-sorted: first 50 rows label 0, next 50 label 1
+            data = numpy.arange(100, dtype=numpy.float32)[:, None]
+            labels = numpy.repeat([0, 1], 50).astype(numpy.int32)
+            self.create_originals(data, labels)
+            self.class_lengths = [0, 0, 100]
+            self.resize_validation(0.3)
+
+    loader = SortedLoader(None, minibatch_size=10, name="sorted")
+    loader.initialize(device=None)
+    assert loader.class_lengths == [0, 30, 70]
+    valid_labels = loader.original_labels.mem[:30]
+    assert 0 < valid_labels.mean() < 1   # both classes present
+
+
+# -- streaming ---------------------------------------------------------------
+
+def test_interactive_loader_feed_and_close():
+    wf = vt.Workflow(name="stream-wf")
+    loader = InteractiveLoader(wf, sample_shape=(4,), timeout=5.0,
+                               name="inter")
+    loader.initialize(device=None)
+    loader.feed(numpy.ones(4), label=2, ticket="t1")
+    loader.run()
+    assert loader.minibatch_size == 1
+    assert loader.minibatch_class == TEST
+    assert loader.current_ticket == "t1"
+    assert (loader.minibatch_data.mem[0] == 1).all()
+    assert loader.minibatch_labels.mem[0] == 2
+    loader.close()
+    loader.run()
+    assert bool(wf.stopped)
+    with pytest.raises(VelesError):
+        loader.feed(numpy.zeros(4))
+
+
+def test_zeromq_loader_roundtrip():
+    import zmq
+    wf = vt.Workflow(name="zmq-wf")
+    loader = ZeroMQLoader(wf, sample_shape=(3,), timeout=10.0, name="zl")
+    loader.initialize(device=None)
+    ctx = zmq.Context.instance()
+    sock = ctx.socket(zmq.DEALER)
+    sock.connect(loader.bound_endpoint)
+    sock.send(pickle.dumps((numpy.arange(3.0), 1)))
+    assert sock.recv() == b"ok"
+    loader.run()
+    assert loader.minibatch_size == 1
+    numpy.testing.assert_allclose(loader.minibatch_data.mem[0],
+                                  [0, 1, 2])
+    sock.send(b"")           # close the stream
+    assert sock.recv() == b"bye"
+    loader.run()
+    assert bool(wf.stopped)
+    sock.close(0)
+
+
+# -- downloader --------------------------------------------------------------
+
+def test_downloader_unpack_and_idempotence(tmp_path):
+    src = tmp_path / "src"
+    src.mkdir()
+    (src / "data.npy").write_bytes(b"hello")
+    tar_path = tmp_path / "bundle.tar.gz"
+    with tarfile.open(tar_path, "w:gz") as t:
+        t.add(src / "data.npy", arcname="data.npy")
+    dest = tmp_path / "dest"
+    d = Downloader(None, url="file://" + str(tar_path),
+                   directory=str(dest), files=["data.npy"], name="dl")
+    d.initialize(device=None)
+    assert (dest / "data.npy").read_bytes() == b"hello"
+    # second run: nothing re-downloaded (delete archive to prove skip)
+    (dest / "bundle.tar.gz").unlink()
+    d2 = Downloader(None, url="file://" + str(tar_path),
+                    directory=str(dest), files=["data.npy"], name="dl2")
+    d2.initialize(device=None)
+    assert not (dest / "bundle.tar.gz").exists()
+    miss = Downloader(None, directory=str(dest), files=["nope.npy"],
+                      name="dl3")
+    with pytest.raises(VelesError):
+        miss.initialize(device=None)
+
+
+# -- ensemble outputs loader -------------------------------------------------
+
+def test_ensemble_loader_stacks_member_outputs(tmp_path):
+    n, k = 12, 3
+    rng = numpy.random.RandomState(0)
+    outputs = []
+    for i in range(2):
+        p = str(tmp_path / ("m%d.npy" % i))
+        numpy.save(p, rng.rand(n, k).astype(numpy.float32))
+        outputs.append(p)
+    labels_path = str(tmp_path / "labels.npy")
+    numpy.save(labels_path, rng.randint(0, k, n).astype(numpy.int32))
+    man = str(tmp_path / "outputs.json")
+    json.dump({"outputs": outputs, "labels": labels_path}, open(man, "w"))
+    loader = EnsembleLoader(None, manifest=man, minibatch_size=4,
+                            name="ens")
+    loader.initialize(device=None)
+    assert loader.original_data.shape == (n, 2 * k)
+    assert loader.class_lengths == [0, 0, n]

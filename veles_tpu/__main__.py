@@ -109,19 +109,26 @@ def _run_meta(launcher: Launcher, module, args) -> int:
     if not hasattr(module, "build_workflow"):
         raise VelesError("meta-learning modes need build_workflow() in %s"
                          % args.model)
-    device = launcher.make_device()   # honors --mesh/--coordinator/...
+    # subprocess candidates need the (exclusive) TPU for themselves —
+    # the parent must not initialize a device it will never use
+    device = (None if (args.optimize and args.optimize_subprocess)
+              else launcher.make_device())
     if args.optimize:
         from .genetics import GeneticsOptimizer
         size, _, gens = args.optimize.partition(":")
         extra = []               # forwarded to subprocess candidates
         if args.config:
             extra.append(args.config)
+        extra += args.config_list     # user's inline overrides still apply
         if args.backend:
             extra += ["--backend", args.backend]
+        if args.random_seed is not None:
+            extra += ["--random-seed", str(args.random_seed)]
         result = GeneticsOptimizer(
             build_workflow=module.build_workflow, model_path=args.model,
             size=int(size), generations=int(gens or 3),
-            device=device, extra_argv=extra).run()
+            device=device, subprocess_mode=args.optimize_subprocess,
+            extra_argv=extra).run()
     elif args.ensemble_train:
         _materialize(args)
         from .ensemble import EnsembleTrainer

@@ -59,6 +59,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimize", default=None, metavar="SIZE[:GENS]",
                    help="GA hyper-parameter search over Range() markers "
                         "in the config tree")
+    p.add_argument("--optimize-subprocess", action="store_true",
+                   help="evaluate each candidate in an isolated "
+                        "subprocess instead of inline")
     p.add_argument("--ensemble-train", default=None, metavar="N[:RATIO]",
                    help="train N ensemble members, each on RATIO of the "
                         "train set (default 1.0)")

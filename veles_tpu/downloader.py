@@ -1,0 +1,75 @@
+"""Downloader: fetch + unpack datasets at initialize.
+
+Equivalent of the reference's veles/downloader.py:56-131 (Downloader
+unit): link it before a loader; at initialize it ensures ``files`` exist
+under ``directory``, downloading ``url`` (http(s)/file) and unpacking
+archives (tar.*, zip) when they do not. Skips entirely when the files are
+already present (idempotent re-runs)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tarfile
+import urllib.request
+import zipfile
+from typing import Sequence
+
+from .config import root
+from .error import VelesError
+from .units import Unit
+
+
+class Downloader(Unit):
+    MAPPING = "downloader"
+
+    def __init__(self, workflow, url: str = "", directory: str = None,
+                 files: Sequence[str] = (), **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.url = url
+        self.directory = directory or root.common.dirs.datasets
+        self.files = list(files)
+
+    def _have_all(self) -> bool:
+        return all(os.path.exists(os.path.join(self.directory, f))
+                   for f in self.files)
+
+    def initialize(self, **kwargs):
+        res = super().initialize(**kwargs)
+        if res:
+            return res
+        if self.files and self._have_all():
+            self.debug("%s: all files present in %s", self.name,
+                       self.directory)
+            return None
+        if not self.url:
+            raise VelesError("%s: files missing from %s and no url set"
+                             % (self.name, self.directory))
+        os.makedirs(self.directory, exist_ok=True)
+        local = os.path.join(self.directory, os.path.basename(self.url))
+        if not os.path.exists(local):
+            self.info("downloading %s → %s", self.url, local)
+            tmp = local + ".part"
+            with urllib.request.urlopen(self.url) as rin, \
+                    open(tmp, "wb") as fout:
+                shutil.copyfileobj(rin, fout)
+            os.replace(tmp, local)
+        self._unpack(local)
+        if self.files and not self._have_all():
+            raise VelesError("%s: %s still missing after download"
+                             % (self.name, self.files))
+        return None
+
+    def _unpack(self, path: str) -> None:
+        if tarfile.is_tarfile(path):
+            self.info("unpacking tar %s", path)
+            with tarfile.open(path) as tin:
+                tin.extractall(self.directory, filter="data")
+        elif zipfile.is_zipfile(path):
+            self.info("unpacking zip %s", path)
+            with zipfile.ZipFile(path) as zin:
+                zin.extractall(self.directory)
+
+    def run(self) -> None:
+        pass

@@ -84,10 +84,13 @@ class EnsembleTrainer(Logger):
         manifest = {"n_models": self.n_models,
                     "train_ratio": self.train_ratio,
                     "base_seed": self.base_seed,
-                    "models": [self._train_one(i)
-                               for i in range(self.n_models)]}
-        with open(self.out_file, "w") as fout:
-            json.dump(manifest, fout, indent=2)
+                    "models": []}
+        for i in range(self.n_models):
+            manifest["models"].append(self._train_one(i))
+            # incremental write: a member crash must not discard the
+            # record of the members already trained
+            with open(self.out_file, "w") as fout:
+                json.dump(manifest, fout, indent=2)
         self.info("ensemble manifest → %s", self.out_file)
         return manifest
 
@@ -96,7 +99,7 @@ class EnsembleTester(Logger):
     """Soft-voting evaluation of a trained ensemble over VALIDATION."""
 
     def __init__(self, build_workflow: Callable, manifest: str | dict,
-                 device=None) -> None:
+                 device=None, save_outputs: Optional[str] = None) -> None:
         super().__init__()
         self.build_workflow = build_workflow
         if isinstance(manifest, str):
@@ -104,6 +107,9 @@ class EnsembleTester(Logger):
                 manifest = json.load(fin)
         self.manifest = manifest
         self.device = device
+        #: directory to dump per-member probability .npy files + an
+        #: outputs manifest consumable by loader.EnsembleLoader (stacking)
+        self.save_outputs = save_outputs
 
     def _member_probs(self, entry: dict):
         """(probs over VALID set, labels) for one member, via the trained
@@ -118,6 +124,11 @@ class EnsembleTester(Logger):
         start = loader.class_end_offsets[VALID] - loader.class_lengths[VALID]
         end = loader.class_end_offsets[VALID]
         idx = numpy.arange(start, end)
+        if len(idx) == 0:
+            raise VelesError(
+                "EnsembleTester needs a validation set; loader %s has "
+                "none (set validation_ratio or provide VALID samples)"
+                % loader.name)
         x = loader.original_data.mem[idx]
         if not loader.original_labels:
             raise VelesError(
@@ -131,16 +142,30 @@ class EnsembleTester(Logger):
 
     def run(self) -> dict:
         probs_sum, labels = None, None
-        member_errs = []
+        member_errs, output_files = [], []
         for entry in self.manifest["models"]:
             probs, labels = self._member_probs(entry)
             errs = float((probs.argmax(1) != labels).mean())
             member_errs.append(errs)
             probs_sum = probs if probs_sum is None else probs_sum + probs
             self.info("member %d: validation error %.4f", entry["id"], errs)
+            if self.save_outputs:
+                os.makedirs(self.save_outputs, exist_ok=True)
+                path = os.path.join(self.save_outputs,
+                                    "member_%d.npy" % entry["id"])
+                numpy.save(path, probs)
+                output_files.append(path)
         ens_err = float((probs_sum.argmax(1) != labels).mean())
         out = {"ensemble_err": ens_err, "member_errs": member_errs,
                "n_models": len(self.manifest["models"])}
+        if self.save_outputs:
+            labels_path = os.path.join(self.save_outputs, "labels.npy")
+            numpy.save(labels_path, labels)
+            man_path = os.path.join(self.save_outputs, "outputs.json")
+            with open(man_path, "w") as fout:
+                json.dump({"outputs": output_files,
+                           "labels": labels_path}, fout, indent=2)
+            out["outputs_manifest"] = man_path
         self.info("ensemble soft-vote validation error: %.4f "
                   "(best member %.4f)", ens_err, min(member_errs))
         return out

@@ -97,8 +97,15 @@ class GeneticsOptimizer(Logger):
                 self.warning("candidate failed (%s): %s",
                              values, proc.stderr[-500:])
                 return -float("inf")
-            with open(result_file) as fin:
-                return self._fitness_from_results(json.load(fin))
+            try:
+                with open(result_file) as fin:
+                    return self._fitness_from_results(json.load(fin))
+            except (KeyError, ValueError, OSError) as exc:
+                # same contract as inline mode: a candidate whose results
+                # lack the metric scores -inf, it must not kill the search
+                self.warning("candidate %s produced unusable results: %s",
+                             values, exc)
+                return -float("inf")
         finally:
             os.unlink(result_file)
 

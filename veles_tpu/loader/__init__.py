@@ -12,3 +12,12 @@ with double-buffered device transfer.
 from .base import (Loader, LoaderMSE, TEST, VALID, TRAIN,
                    CLASS_NAMES)                        # noqa: F401
 from .fullbatch import FullBatchLoader, FullBatchLoaderMSE  # noqa: F401
+from .file_loader import (FileFilter, FileListScanner,      # noqa: F401
+                          auto_label)
+from .image import ImageLoader, decode_image, augment  # noqa: F401
+from .pickles import PicklesLoader                     # noqa: F401
+from .hdf5 import HDF5Loader                           # noqa: F401
+from .saver import MinibatchesSaver, MinibatchesLoader  # noqa: F401
+from .stream import (StreamLoader, InteractiveLoader,  # noqa: F401
+                     RestfulLoader, ZeroMQLoader)
+from .ensemble import EnsembleLoader                   # noqa: F401

@@ -44,10 +44,19 @@ class FullBatchLoader(Loader):
                 numpy.ascontiguousarray(labels, dtype=numpy.int32))
 
     def resize_validation(self, ratio: float) -> None:
-        """Carve a validation set out of the train set tail
-        (reference: _resize_validation, veles/loader/fullbatch.py:349)."""
+        """Carve a RANDOM validation subset out of the train region
+        (reference: _resize_validation, veles/loader/fullbatch.py:349).
+        The train rows are permuted first — datasets usually arrive
+        class-sorted, and a head-slice split would be 100% one class."""
         n_train = self.class_lengths[TRAIN]
         n_valid = int(n_train * ratio)
+        start = self.class_lengths[0] + self.class_lengths[VALID]
+        perm = start + self.prng.permutation(n_train)
+        self.original_data.mem[start:] = self.original_data.mem[perm]
+        for arr in (self.original_labels,
+                    getattr(self, "original_targets", None)):
+            if arr is not None and arr:
+                arr.mem[start:] = arr.mem[perm]
         self.class_lengths[VALID] += n_valid
         self.class_lengths[TRAIN] -= n_valid
 

@@ -1,0 +1,141 @@
+"""Image loaders: directory scanning + decode + augmentation.
+
+Equivalent of the reference's veles/loader/image.py /
+veles/loader/file_image.py / veles/loader/fullbatch_image.py surface
+(ImageLoader with scale/crop/mirror/rotation augmentation, channel
+handling, auto-labelling): decode via PIL, normalize to NHWC float32,
+materialize the whole (augmented) dataset as a full-batch array — the
+TPU-native shape: the dataset lives in HBM and minibatch gather happens
+inside the fused step, so augmentation multiplicity is paid once at load
+time, not per epoch.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy
+
+from ..error import VelesError
+from .base import TEST, VALID, TRAIN
+from .file_loader import FileFilter, FileListScanner, auto_label
+from .fullbatch import FullBatchLoader
+
+IMAGE_PATTERNS = ("*.png", "*.jpg", "*.jpeg", "*.bmp", "*.gif", "*.tiff",
+                  "*.webp")
+
+
+def decode_image(path: str, size: Optional[Tuple[int, int]] = None,
+                 color: str = "RGB") -> numpy.ndarray:
+    """File → HWC float32 in [0, 1] (reference decode path used PIL or
+    jpeg4py, veles/loader/image.py:106+)."""
+    from PIL import Image
+    with Image.open(path) as img:
+        img = img.convert(color)
+        if size is not None:
+            img = img.resize((size[1], size[0]), Image.BILINEAR)
+        arr = numpy.asarray(img, dtype=numpy.float32) / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def augment(arr: numpy.ndarray, mirror: bool = False,
+            rotations: Sequence[int] = (0,),
+            crop: Optional[Tuple[int, int]] = None,
+            crop_number: int = 1, rand=None) -> list:
+    """All augmented variants of one HWC image (reference knobs: scale,
+    crop, rotation, mirror — veles/loader/image.py augmentation)."""
+    variants = []
+    for rot in rotations:
+        v = numpy.rot90(arr, rot // 90) if rot else arr
+        variants.append(v)
+        if mirror:
+            variants.append(v[:, ::-1])
+    if crop is not None:
+        ch, cw = crop
+        cropped = []
+        for v in variants:
+            h, w = v.shape[:2]
+            if h < ch or w < cw:
+                raise VelesError("crop %s larger than image %s"
+                                 % (crop, v.shape))
+            for _ in range(crop_number):
+                y = rand.randint(0, h - ch + 1) if rand else (h - ch) // 2
+                x = rand.randint(0, w - cw + 1) if rand else (w - cw) // 2
+                cropped.append(v[y:y + ch, x:x + cw])
+        variants = cropped
+    return [numpy.ascontiguousarray(v) for v in variants]
+
+
+class ImageLoader(FullBatchLoader):
+    """Scan directories of images per class, decode, augment, label.
+
+    - ``train_paths``/``validation_paths``/``test_paths``: directories or
+      files (reference FileImageLoader contract).
+    - labels come from the containing directory name unless the subclass
+      overrides ``get_label`` (reference AutoLabelFileLoader).
+    - augmentation (train class only): mirror, rotations, random crops.
+    """
+
+    MAPPING = "image_loader"
+
+    def __init__(self, workflow, train_paths: Sequence[str] = (),
+                 validation_paths: Sequence[str] = (),
+                 test_paths: Sequence[str] = (),
+                 size: Optional[Tuple[int, int]] = None,
+                 color: str = "RGB", mirror: bool = False,
+                 rotations: Sequence[int] = (0,),
+                 crop: Optional[Tuple[int, int]] = None,
+                 crop_number: int = 1, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.scanner = FileListScanner(
+            train_paths, validation_paths, test_paths,
+            FileFilter(include=IMAGE_PATTERNS))
+        self.size = size
+        self.color = color
+        self.mirror = mirror
+        self.rotations = tuple(rotations)
+        self.crop = crop
+        self.crop_number = crop_number
+        #: label string → index (reference labels_mapping)
+        self.label_names: Dict[int, str] = {}
+
+    def get_label(self, path: str) -> str:
+        return auto_label(path)
+
+    def load_data(self) -> None:
+        per_class = self.scanner.scan()
+        # deterministic label mapping over ALL classes
+        names = sorted({self.get_label(p)
+                        for files in per_class for p in files})
+        self.labels_mapping = {n: i for i, n in enumerate(names)}
+        self.label_names = {i: n for n, i in self.labels_mapping.items()}
+        data, labels = [], []
+        lengths = [0, 0, 0]
+        for cls in (TEST, VALID, TRAIN):
+            for path in per_class[cls]:
+                arr = decode_image(path, self.size, self.color)
+                if cls == TRAIN:
+                    variants = augment(
+                        arr, self.mirror, self.rotations, self.crop,
+                        self.crop_number, self.prng)
+                elif self.crop is not None:
+                    # eval classes: deterministic center crop only
+                    variants = augment(arr, crop=self.crop)
+                else:
+                    variants = [arr]
+                label = self.labels_mapping[self.get_label(path)]
+                data.extend(variants)
+                labels.extend([label] * len(variants))
+                lengths[cls] += len(variants)
+        shapes = {v.shape for v in data}
+        if len(shapes) != 1:
+            raise VelesError(
+                "images have differing shapes %s — pass size=(H, W) or "
+                "crop=(H, W)" % sorted(shapes))
+        self.create_originals(numpy.stack(data),
+                              numpy.asarray(labels, dtype=numpy.int32))
+        self.class_lengths = lengths
+        if self.validation_ratio and not lengths[VALID]:
+            self.resize_validation(self.validation_ratio)

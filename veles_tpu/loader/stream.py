@@ -1,0 +1,172 @@
+"""Streaming loaders: samples arrive at run time, not load time.
+
+Equivalents of the reference's runtime-fed loaders (SURVEY.md §2.3):
+- InteractiveLoader (veles/loader/interactive.py:57) — feed samples from
+  the owning process;
+- RestfulLoader (veles/loader/restful.py:52) — fed by the RESTful serving
+  unit, one (ticket, sample) per HTTP request;
+- ZeroMQLoader (veles/zmq_loader.py:74) — receive work items over a
+  ZeroMQ ROUTER socket from external producers.
+
+All are one StreamLoader mechanism: a thread-safe queue of samples pulled
+by ``run()``; ``close()`` stops the owning workflow. Streamed serving is
+inherently minibatch-1-ish and host-bound — it exists for the serve path
+(forward workflow), not the fused training loop.
+"""
+
+from __future__ import annotations
+
+import pickle
+import queue as queue_mod
+import threading
+from typing import Any, Optional, Tuple
+
+import numpy
+
+from ..error import VelesError
+from ..memory import Array
+from .base import Loader, TEST
+
+
+class StreamLoader(Loader):
+    """Queue-fed loader. ``feed(sample[, label])`` from any thread;
+    ``run()`` blocks until a sample (or close) arrives."""
+
+    MAPPING = "interactive_loader"
+
+    def __init__(self, workflow, sample_shape: Tuple[int, ...] = (),
+                 timeout: float = 60.0, **kwargs) -> None:
+        kwargs.setdefault("minibatch_size", 1)
+        super().__init__(workflow, **kwargs)
+        self.sample_shape = tuple(sample_shape)
+        self.timeout = timeout
+        self._queue: "queue_mod.Queue" = queue_mod.Queue()
+        self._closed = threading.Event()
+        #: ticket of the sample currently in minibatch_data (REST routing)
+        self.current_ticket: Any = None
+
+    # -- producer side (any thread) ------------------------------------------
+    def feed(self, sample, label: Optional[int] = None,
+             ticket: Any = None) -> None:
+        if self._closed.is_set():
+            raise VelesError("%s is closed" % self.name)
+        self._queue.put((numpy.asarray(sample), label, ticket))
+
+    def close(self) -> None:
+        self._closed.set()
+        self._queue.put(None)   # wake a blocked run()
+
+    # -- loader contract ------------------------------------------------------
+    def load_data(self) -> None:
+        if not self.sample_shape:
+            raise VelesError("%s needs sample_shape" % self.name)
+        # stream length is unknown; geometry is per-sample
+        self.class_lengths = [1, 0, 0]   # serving = TEST class
+
+    def create_minibatch_data(self) -> None:
+        from ..config import root
+        dtype = root.common.engine.precision_type
+        self.minibatch_data.reset(numpy.zeros(
+            (self.max_minibatch_size,) + self.sample_shape, dtype=dtype))
+        self.minibatch_labels.reset(numpy.zeros(
+            self.max_minibatch_size, dtype=numpy.int32))
+
+    def fill_minibatch(self) -> None:  # pragma: no cover - not used
+        pass
+
+    def run(self) -> None:
+        try:
+            item = self._queue.get(timeout=self.timeout)
+        except queue_mod.Empty:
+            raise VelesError("%s: no sample within %.0fs"
+                             % (self.name, self.timeout))
+        if item is None or self._closed.is_set():
+            self.workflow.stop()
+            return
+        sample, label, ticket = item
+        if sample.shape != self.sample_shape:
+            raise VelesError("sample shape %s != declared %s"
+                             % (sample.shape, self.sample_shape))
+        data = self.minibatch_data.map_invalidate()
+        data[0] = sample
+        if label is not None:
+            self.minibatch_labels.map_invalidate()[0] = label
+        self.minibatch_class = TEST
+        self.minibatch_size = 1
+        self.current_ticket = ticket
+        self.samples_served += 1
+
+
+class InteractiveLoader(StreamLoader):
+    """Reference naming (veles/loader/interactive.py:57)."""
+
+
+class RestfulLoader(StreamLoader):
+    """Fed by the RESTfulAPI service unit with per-request tickets
+    (reference: veles/loader/restful.py:52)."""
+
+    MAPPING = "restful_loader"
+
+
+class ZeroMQLoader(StreamLoader):
+    """Receives pickled (sample, label) work items over a ZeroMQ ROUTER
+    socket (reference: veles/zmq_loader.py:74). A background thread drains
+    the socket into the stream queue; producers use DEALER sockets and get
+    a b"ok" ack per item; an empty payload closes the stream."""
+
+    MAPPING = "zeromq_loader"
+
+    def __init__(self, workflow, endpoint: str = "tcp://*:0",
+                 **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.endpoint = endpoint
+        #: actual endpoint after bind (port resolved)
+        self.bound_endpoint: Optional[str] = None
+        self._thread: Optional[threading.Thread] = None
+        self._ctx = None
+
+    def initialize(self, **kwargs):
+        res = super().initialize(**kwargs)
+        if res:
+            return res
+        import zmq
+        self._ctx = zmq.Context.instance()
+        sock = self._ctx.socket(zmq.ROUTER)
+        if self.endpoint.endswith(":0"):
+            port = sock.bind_to_random_port(self.endpoint[:-2])
+            self.bound_endpoint = "%s:%d" % (
+                self.endpoint[:-2].replace("*", "127.0.0.1"), port)
+        else:
+            sock.bind(self.endpoint)
+            self.bound_endpoint = self.endpoint.replace("*", "127.0.0.1")
+        self._thread = threading.Thread(
+            target=self._drain, args=(sock,), daemon=True,
+            name=self.name + ".zmq")
+        self._thread.start()
+        self.info("%s: listening on %s", self.name, self.bound_endpoint)
+        return None
+
+    def _drain(self, sock) -> None:
+        import zmq
+        poller = zmq.Poller()
+        poller.register(sock, zmq.POLLIN)
+        # poll with timeout so stop()/close() can end the thread (and
+        # release the bound port) without cross-thread socket access
+        while not self._closed.is_set():
+            if not poller.poll(200):
+                continue
+            try:
+                ident, payload = sock.recv_multipart()
+            except Exception:
+                break
+            if not payload:
+                sock.send_multipart([ident, b"bye"])
+                self.close()
+                break
+            sample, label = pickle.loads(payload)
+            self.feed(sample, label, ticket=ident)
+            sock.send_multipart([ident, b"ok"])
+        sock.close(0)
+
+    def stop(self) -> None:
+        self._closed.set()
