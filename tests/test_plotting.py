@@ -1,0 +1,128 @@
+"""Plotting subsystem tests (reference: veles/tests/test_plotting_units.py,
+graphics server/client round trip)."""
+import os
+import pickle
+import time
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.config import root
+from veles_tpu import graphics
+
+
+@pytest.fixture
+def plotting_enabled():
+    old = root.common.disable.plotting
+    root.common.disable.plotting = False
+    yield
+    root.common.disable.plotting = old
+
+
+def test_accumulating_plotter(plotting_enabled, tmp_path):
+    wf = vt.Workflow(name="t")
+    holder = {"v": 1.0}
+    p = vt.AccumulatingPlotter(wf, input=lambda: holder["v"],
+                               label="err", redraw_interval=0.0)
+    for v in (3.0, 2.0, 1.0):
+        holder["v"] = v
+        p.run()
+    snap = p.last_snapshot
+    assert snap["kind"] == "lines" and snap["values"] == [3.0, 2.0, 1.0]
+    out = graphics.render_snapshot(snap, str(tmp_path / "lines.png"))
+    assert os.path.getsize(out) > 0
+
+
+def test_matrix_plotter_confusion(plotting_enabled, tmp_path):
+    wf = vt.Workflow(name="t")
+    conf = numpy.arange(9).reshape(3, 3)
+    p = vt.MatrixPlotter(wf, input=lambda: conf, redraw_interval=0.0)
+    p.run()
+    assert p.last_snapshot["matrix"].shape == (3, 3)
+    graphics.render_snapshot(p.last_snapshot, str(tmp_path / "m.png"))
+
+
+def test_image_histogram_table_stepstats(plotting_enabled, tmp_path):
+    wf = vt.Workflow(name="t")
+    imgs = numpy.random.RandomState(0).rand(5, 49)  # 7x7 flat
+    ip = vt.ImagePlotter(wf, input=lambda: imgs, redraw_interval=0.0)
+    ip.run()
+    assert ip.last_snapshot["images"].shape == (5, 7, 7)
+    h = vt.Histogram(wf, input=lambda: imgs, redraw_interval=0.0,
+                     n_bins=10)
+    h.run()
+    assert h.last_snapshot["counts"].sum() == imgs.size
+    mh = vt.MultiHistogram(wf, input=lambda: imgs, redraw_interval=0.0,
+                           n_bins=5, hist_number=4)
+    mh.run()
+    assert mh.last_snapshot["counts"].shape == (4, 5)
+    t = vt.TableMaxMin(wf, redraw_interval=0.0)
+    t.add_source("imgs", lambda: imgs)
+    t.run()
+    assert t.last_snapshot["rows"][0][0] == "imgs"
+    s = vt.StepStats(wf, redraw_interval=0.0)
+    s.run()
+    assert s.last_snapshot["header"] == ["unit", "runs", "total s"]
+    for u, fname in ((ip, "i"), (h, "h"), (mh, "mh"), (t, "t"), (s, "s")):
+        graphics.render_snapshot(u.last_snapshot,
+                                 str(tmp_path / (fname + ".png")))
+
+
+def test_redraw_throttling(plotting_enabled):
+    wf = vt.Workflow(name="t")
+    p = vt.AccumulatingPlotter(wf, input=lambda: 1.0,
+                               redraw_interval=3600.0)
+    p.run()
+    p.run()     # throttled: second run must not append
+    assert len(p.last_snapshot["values"]) == 1
+    p.finalize()  # forced redraw bypasses the throttle
+    assert len(p.last_snapshot["values"]) == 2
+
+
+def test_plotting_disabled_is_noop():
+    assert root.common.disable.plotting  # test harness default
+    wf = vt.Workflow(name="t")
+    p = vt.AccumulatingPlotter(wf, input=lambda: 1.0, redraw_interval=0.0)
+    p.run()
+    assert p.last_snapshot is None
+
+
+def test_graphics_pubsub_roundtrip(plotting_enabled):
+    zmq = pytest.importorskip("zmq")
+    server = graphics.GraphicsServer()
+    assert server.endpoint
+    sub = zmq.Context.instance().socket(zmq.SUB)
+    sub.connect(server.endpoint)
+    sub.setsockopt(zmq.SUBSCRIBE, b"")
+    assert server.wait_subscriber(5.0)
+    wf = vt.Workflow(name="t")
+    wf.graphics = server
+    p = vt.AccumulatingPlotter(wf, input=lambda: 2.5, redraw_interval=0.0)
+    p.run()
+    poller = zmq.Poller()
+    poller.register(sub, zmq.POLLIN)
+    assert poller.poll(5000), "snapshot not delivered over PUB/SUB"
+    snap = pickle.loads(sub.recv())
+    assert snap["name"] == p.name and snap["values"] == [2.5]
+    assert server.snapshots[p.name]["values"] == [2.5]
+    sub.close(linger=0)
+    server.shutdown()
+
+
+def test_graphics_client_subprocess(plotting_enabled, tmp_path):
+    pytest.importorskip("zmq")
+    server = graphics.GraphicsServer()
+    pid = server.launch_client(out_dir=str(tmp_path))
+    assert pid
+    wf = vt.Workflow(name="t")
+    wf.graphics = server
+    p = vt.AccumulatingPlotter(wf, input=lambda: 1.5, name="train err",
+                               redraw_interval=0.0)
+    p.run()
+    deadline = time.time() + 15
+    png = tmp_path / "train_err.png"
+    while time.time() < deadline and not png.exists():
+        time.sleep(0.2)
+    server.shutdown()
+    assert png.exists() and png.stat().st_size > 0

@@ -1,0 +1,67 @@
+"""Publisher report generation (reference: veles/tests/test_publisher.py)."""
+import os
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.config import root
+from veles_tpu.publishing import BACKENDS
+
+
+@pytest.fixture
+def plotting_enabled():
+    old = root.common.disable.plotting
+    root.common.disable.plotting = False
+    yield
+    root.common.disable.plotting = old
+
+
+def build_workflow_with_plots():
+    wf = vt.Workflow(name="report-wf")
+    p = vt.AccumulatingPlotter(wf, input=lambda: 0.5, label="err",
+                               redraw_interval=0.0, name="err curve")
+    p.run()
+    m = vt.MatrixPlotter(wf, input=lambda: numpy.eye(3),
+                         redraw_interval=0.0, name="confusion")
+    m.run()
+    return wf
+
+
+def test_markdown_report(plotting_enabled, tmp_path):
+    wf = build_workflow_with_plots()
+    pub = vt.Publisher(wf, backends=("markdown",), out_dir=str(tmp_path))
+    pub.run()
+    report = tmp_path / "report.md"
+    assert report.exists()
+    text = report.read_text()
+    assert "report-wf" in text and "## Plots" in text
+    assert (tmp_path / "figures" / "err_curve.png").exists()
+    assert (tmp_path / "figures" / "confusion.png").exists()
+    assert "digraph" in text            # workflow graph embedded
+    assert pub.get_metric_values()["reports"] == [str(report)]
+
+
+def test_html_report(plotting_enabled, tmp_path):
+    wf = build_workflow_with_plots()
+    pub = vt.Publisher(wf, backends=("html",), out_dir=str(tmp_path))
+    pub.run()
+    html = (tmp_path / "report.html").read_text()
+    assert "data:image/png;base64," in html
+    assert "report-wf" in html
+
+
+def test_unknown_backend_rejected():
+    wf = vt.Workflow(name="t")
+    with pytest.raises(KeyError):
+        vt.Publisher(wf, backends=("confluence",))
+    assert set(BACKENDS) >= {"markdown", "html"}
+
+
+def test_publisher_without_plots(tmp_path):
+    wf = vt.Workflow(name="bare")
+    pub = vt.Publisher(wf, backends=("markdown",), out_dir=str(tmp_path),
+                       include_config=False)
+    pub.run()
+    text = (tmp_path / "report.md").read_text()
+    assert "bare" in text and "## Plots" not in text

@@ -1,0 +1,115 @@
+"""Service layer: RESTful serving unit + web status server
+(reference: veles/tests/test_restful.py, test_web_status.py)."""
+import json
+import threading
+import urllib.request
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn
+from veles_tpu.loader.stream import RestfulLoader
+from veles_tpu.plumbing import Repeater
+from veles_tpu.web_status import StatusReporter, WebStatusServer
+
+
+def _post(url, payload, timeout=30):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            return e.code, json.loads(body)
+        except ValueError:
+            return e.code, body.decode(errors="replace")
+
+
+def build_serving_workflow():
+    wf = vt.Workflow(name="serve")
+    rep = Repeater(wf)
+    loader = RestfulLoader(wf, sample_shape=(4,), timeout=30.0,
+                           name="rest_loader")
+    fwd = nn.All2AllSoftmax(wf, output_sample_shape=3, name="fwd")
+    api = vt.RESTfulAPI(wf, loader=loader, port=0, request_timeout=30.0)
+    rep.link_from(wf.start_point)
+    loader.link_from(rep)
+    fwd.link_from(loader)
+    fwd.link_attrs(loader, ("input", "minibatch_data"))
+    api.link_from(fwd)
+    api.link_attrs(fwd, ("input", "output"))
+    rep.link_from(api)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    return wf, loader, fwd, api
+
+
+def test_restful_api_roundtrip():
+    wf, loader, fwd, api = build_serving_workflow()
+    t = threading.Thread(target=wf.run, daemon=True)
+    t.start()
+    url = "http://127.0.0.1:%d/api" % api.port
+    x = [0.1, -0.2, 0.3, 0.4]
+    status, body = _post(url, {"input": x})
+    assert status == 200, body
+    got = numpy.asarray(body["result"])
+    expect = fwd.numpy_apply(fwd.params_np(),
+                             numpy.asarray([x], dtype=numpy.float32))[0]
+    numpy.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+    assert abs(got.sum() - 1.0) < 1e-4
+    # malformed request does not kill the service
+    status, body = _post(url, {"wrong": 1})
+    assert status == 400
+    status, body = _post(url, {"input": x})
+    assert status == 200
+    assert api.requests_served == 2
+    loader.close()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    api.stop()
+
+
+def test_restful_api_rejects_unknown_path():
+    wf, loader, fwd, api = build_serving_workflow()
+    status, _ = _post("http://127.0.0.1:%d/nope" % api.port, {"input": []},
+                      timeout=5)
+    assert status == 404
+    loader.close()
+    api.stop()
+
+
+def test_web_status_update_and_snapshot():
+    server = WebStatusServer(port=0).start()
+    base = "http://127.0.0.1:%d" % server.port
+    reporter = StatusReporter(base)
+    assert reporter.send({"id": "wf@1", "name": "mnist", "device": "tpu",
+                          "epoch": 3, "metric": 0.02, "elapsed_sec": 12.5})
+    with urllib.request.urlopen(base + "/status.json", timeout=5) as resp:
+        snap = json.loads(resp.read())
+    assert snap["wf@1"]["name"] == "mnist"
+    assert snap["wf@1"]["epoch"] == 3
+    with urllib.request.urlopen(base + "/", timeout=5) as resp:
+        page = resp.read().decode()
+    assert "veles_tpu" in page and "status.json" in page
+    status, body = _post(base + "/update", {"no_id": True}, timeout=5)
+    assert status == 400
+    server.stop()
+
+
+def test_web_status_stale_eviction():
+    server = WebStatusServer(port=0, stale_after=0.0).start()
+    server.update("w", {"name": "x"})
+    assert server.snapshot() == {}      # immediately stale
+    server.stop()
+
+
+def test_launcher_payload_shape():
+    from veles_tpu.launcher import Launcher
+    launcher = Launcher(backend="numpy")
+    wf = vt.Workflow(name="w")
+    launcher.workflow = wf
+    payload = launcher._status_payload()
+    assert payload["name"] == "w" and "elapsed_sec" in payload

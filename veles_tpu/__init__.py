@@ -30,3 +30,9 @@ from .input_joiner import InputJoiner                 # noqa: F401
 from .avatar import Avatar                            # noqa: F401
 from . import normalization                           # noqa: F401
 from . import prng                                    # noqa: F401
+from .plotter import Plotter, PlotSink                # noqa: F401
+from .plotting_units import (AccumulatingPlotter, MatrixPlotter,
+                             ImagePlotter, Histogram, MultiHistogram,
+                             TableMaxMin, StepStats)  # noqa: F401
+from .restful_api import RESTfulAPI                   # noqa: F401
+from .publishing import Publisher                     # noqa: F401

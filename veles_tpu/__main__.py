@@ -59,7 +59,10 @@ def main(argv=None) -> int:
         mesh=parse_mesh(args.mesh) if args.mesh else None,
         coordinator=args.coordinator, num_processes=args.num_processes,
         process_id=args.process_id, random_seed=args.random_seed,
-        test_mode=args.test)
+        test_mode=args.test,
+        graphics=args.graphics, plots_dir=args.plots_dir,
+        status_url=args.status_url,
+        notification_interval=args.status_interval)
 
     module = import_file_as_module(args.model)
     # a model module may (re)set config keys at import time (including

@@ -47,6 +47,17 @@ def make_parser() -> argparse.ArgumentParser:
                    help="append event spans as JSON lines here")
     p.add_argument("--force-numpy", action="store_true")
     p.add_argument("-v", "--verbose", action="count", default=0)
+    # observability services (reference graphics/web-status,
+    # veles/graphics_server.py:73, veles/launcher.py:852-885)
+    p.add_argument("--graphics", action="store_true",
+                   help="live plots: spawn the renderer subprocess")
+    p.add_argument("--plots-dir", default=None,
+                   help="where the renderer writes plot PNGs")
+    p.add_argument("--status-url", default=None,
+                   help="web-status server to POST beacons to "
+                        "(see python -m veles_tpu.web_status)")
+    p.add_argument("--status-interval", type=float, default=10.0,
+                   help="beacon period in seconds")
     # multi-host (replaces master/slave -l/-m, veles/launcher.py:193-267)
     p.add_argument("--coordinator", default=None,
                    help="host:port of the jax distributed coordinator")

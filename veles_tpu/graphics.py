@@ -1,0 +1,289 @@
+"""Graphics pipeline: snapshot pub-sub + matplotlib renderer.
+
+Equivalent of the reference's veles/graphics_server.py:73 (ZeroMQ PUB of
+plot snapshots; client subprocess launch) and veles/graphics_client.py:84
+(SUB socket → matplotlib). Differences, deliberate:
+
+- payloads are the declarative snapshots of veles_tpu/plotter.py, not
+  pickled Plotter units — the renderer holds one draw function per ``kind``
+  and no framework state;
+- endpoints are tcp://127.0.0.1 or ipc:// only (the reference's epgm
+  multicast served cluster-wide spectators; the SPMD build has exactly one
+  program to watch, veles/graphics_server.py:100-136);
+- the Agg backend writes ``<out>/<plot name>.png`` continuously; these files
+  double as the Publisher's figures.
+
+``render_snapshot`` is also importable directly (no zmq, no subprocess) —
+that in-process path is what tests and the Publisher use.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import subprocess
+import sys
+import tempfile
+from typing import Any, Dict, List, Optional
+
+from .config import root
+from .logger import Logger
+from .plotter import PlotSink
+
+PROTOCOL = 4  # stable across supported interpreters
+
+
+class GraphicsServer(PlotSink, Logger):
+    """Publishes plot snapshots over ZeroMQ PUB and optionally owns a
+    renderer subprocess (reference: veles/graphics_server.py:73,174-220)."""
+
+    def __init__(self, endpoint: Optional[str] = None) -> None:
+        PlotSink.__init__(self)
+        Logger.__init__(self)
+        self._zmq_socket = None
+        self._client: Optional[subprocess.Popen] = None
+        self.endpoint: Optional[str] = None
+        if root.common.disable.plotting:
+            return
+        try:
+            import zmq
+        except ImportError:             # pragma: no cover
+            self.warning("pyzmq unavailable; plots collected in-process "
+                         "only")
+            return
+        ctx = zmq.Context.instance()
+        # XPUB, not PUB: the server can observe subscription handshakes and
+        # hold the first snapshots until the renderer is actually listening
+        # (plain PUB silently drops everything sent before the SUB connects)
+        sock = ctx.socket(zmq.XPUB)
+        if endpoint:
+            sock.bind(endpoint)
+            self.endpoint = endpoint
+        else:
+            # same-host tiering as the reference (ipc preferred, tcp
+            # fallback), veles/server.py:721-732
+            try:
+                path = os.path.join(tempfile.gettempdir(),
+                                    "veles-graphics-%d.ipc" % os.getpid())
+                self.endpoint = "ipc://" + path
+                sock.bind(self.endpoint)
+            except zmq.ZMQError:
+                port = sock.bind_to_random_port("tcp://127.0.0.1")
+                self.endpoint = "tcp://127.0.0.1:%d" % port
+        self._zmq_socket = sock
+        self.info("graphics PUB on %s", self.endpoint)
+
+    def publish(self, snapshot: Dict[str, Any]) -> None:
+        super().publish(snapshot)
+        if self._zmq_socket is not None:
+            try:
+                self._zmq_socket.send(
+                    pickle.dumps(snapshot, protocol=PROTOCOL),
+                    flags=getattr(__import__("zmq"), "NOBLOCK", 1))
+            except Exception as e:      # PUB drops are fine; never stall
+                self.debug("snapshot drop: %s", e)
+
+    def wait_subscriber(self, timeout: float = 10.0) -> bool:
+        """Block until at least one SUB completes its handshake (XPUB
+        delivers subscription frames to the server side)."""
+        if self._zmq_socket is None:
+            return False
+        import zmq
+        poller = zmq.Poller()
+        poller.register(self._zmq_socket, zmq.POLLIN)
+        if poller.poll(int(timeout * 1000)):
+            frame = self._zmq_socket.recv()
+            return bool(frame) and frame[0] == 1
+        return False
+
+    def launch_client(self, backend: str = "Agg",
+                      out_dir: Optional[str] = None) -> Optional[int]:
+        """Spawn the renderer subprocess and wait for it to subscribe
+        (reference: veles/graphics_server.py:174-220)."""
+        if self._zmq_socket is None:
+            return None
+        out_dir = out_dir or os.path.join(
+            root.common.dirs.cache, "plots")
+        os.makedirs(out_dir, exist_ok=True)
+        log = open(os.path.join(out_dir, "client.log"), "ab")
+        # run from the package's parent so `-m veles_tpu.graphics` resolves
+        # regardless of the caller's cwd/sys.path setup
+        pkg_parent = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        self._client = subprocess.Popen(
+            [sys.executable, "-m", "veles_tpu.graphics", self.endpoint,
+             "--backend", backend, "--out", out_dir],
+            stdout=log, stderr=log, cwd=pkg_parent)
+        log.close()
+        if not self.wait_subscriber(30.0):
+            self.warning("graphics client did not subscribe within "
+                         "timeout; see %s", os.path.join(out_dir,
+                                                         "client.log"))
+        self.info("graphics client pid %d → %s", self._client.pid, out_dir)
+        return self._client.pid
+
+    def shutdown(self) -> None:
+        if self._zmq_socket is not None:
+            try:
+                self._zmq_socket.send(
+                    pickle.dumps({"kind": "__stop__", "name": "__stop__"},
+                                 protocol=PROTOCOL))
+                self._zmq_socket.close(linger=200)
+            except Exception:
+                pass
+            self._zmq_socket = None
+        if self._client is not None:
+            try:
+                self._client.wait(timeout=5)
+            except Exception:
+                self._client.kill()
+            self._client = None
+        if self.endpoint and self.endpoint.startswith("ipc://"):
+            try:
+                os.unlink(self.endpoint[len("ipc://"):])
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# Renderers: one draw function per snapshot kind.
+# ---------------------------------------------------------------------------
+
+def _draw_lines(ax, snap):
+    ax.plot(snap["values"], snap.get("style", "-"))
+    ax.set_ylabel(snap.get("label", "value"))
+    ax.set_xlabel("updates")
+    if snap.get("ylim"):
+        ax.set_ylim(*snap["ylim"])
+    ax.grid(True, alpha=0.3)
+
+
+def _draw_matrix(ax, snap):
+    m = snap["matrix"]
+    im = ax.imshow(m, interpolation="nearest", cmap="viridis")
+    ax.figure.colorbar(im, ax=ax)
+    ax.set_xticks(range(m.shape[1]))
+    ax.set_xticklabels(snap["column_labels"], rotation=90, fontsize=7)
+    ax.set_yticks(range(m.shape[0]))
+    ax.set_yticklabels(snap["row_labels"], fontsize=7)
+    if m.size <= 400:                   # annotate small matrices only
+        thresh = (m.max() + m.min()) / 2.0
+        for i in range(m.shape[0]):
+            for j in range(m.shape[1]):
+                ax.text(j, i, "%g" % m[i, j], ha="center", va="center",
+                        fontsize=6,
+                        color="white" if m[i, j] < thresh else "black")
+
+
+def _draw_image_grid(ax, snap):
+    import numpy
+    imgs = snap["images"]
+    n = len(imgs)
+    cols = max(1, int(numpy.ceil(numpy.sqrt(n))))
+    rows = (n + cols - 1) // cols
+    h, w = imgs.shape[1], imgs.shape[2]
+    canvas = numpy.ones((rows * (h + 2), cols * (w + 2)) + imgs.shape[3:],
+                        dtype=imgs.dtype)
+    for k, img in enumerate(imgs):
+        r, c = divmod(k, cols)
+        canvas[r * (h + 2):r * (h + 2) + h,
+               c * (w + 2):c * (w + 2) + w] = img
+    ax.imshow(canvas, cmap=None if canvas.ndim == 3 else "gray")
+    ax.axis("off")
+
+
+def _draw_histogram(ax, snap):
+    edges, counts = snap["edges"], snap["counts"]
+    ax.bar(edges[:-1], counts, width=(edges[1:] - edges[:-1]),
+           align="edge")
+    ax.grid(True, alpha=0.3)
+
+
+def _draw_multi_histogram(ax, snap):
+    import numpy
+    fig = ax.figure
+    ax.axis("off")
+    counts, edges = snap["counts"], snap["edges"]
+    n = len(counts)
+    cols = max(1, int(numpy.ceil(numpy.sqrt(n))))
+    rows = (n + cols - 1) // cols
+    for k in range(n):
+        sub = fig.add_subplot(rows, cols, k + 1)
+        sub.bar(edges[k][:-1], counts[k],
+                width=(edges[k][1:] - edges[k][:-1]), align="edge")
+        sub.set_xticks(())
+        sub.set_yticks(())
+
+
+def _draw_table(ax, snap):
+    ax.axis("off")
+    table = ax.table(cellText=snap["rows"], colLabels=snap["header"],
+                     loc="center")
+    table.auto_set_font_size(False)
+    table.set_fontsize(8)
+
+
+RENDERERS = {
+    "lines": _draw_lines,
+    "matrix": _draw_matrix,
+    "image_grid": _draw_image_grid,
+    "histogram": _draw_histogram,
+    "multi_histogram": _draw_multi_histogram,
+    "table": _draw_table,
+}
+
+
+def render_snapshot(snapshot: Dict[str, Any], path: str) -> str:
+    """Draw one snapshot to an image file; returns the path. Usable without
+    zmq or a subprocess (tests, Publisher)."""
+    import matplotlib
+    matplotlib.use("Agg", force=False)
+    from matplotlib import pyplot
+    fig = pyplot.figure(figsize=(6, 4.5), dpi=100)
+    ax = fig.add_subplot(111)
+    renderer = RENDERERS.get(snapshot["kind"])
+    if renderer is None:
+        raise KeyError("no renderer for snapshot kind %r" %
+                       snapshot["kind"])
+    renderer(ax, snapshot)
+    ax.set_title(snapshot["name"])
+    fig.tight_layout()
+    fig.savefig(path)
+    pyplot.close(fig)
+    return path
+
+
+def client_main(argv: Optional[List[str]] = None) -> int:
+    """``python -m veles_tpu.graphics ENDPOINT`` — the renderer process
+    (reference: veles/graphics_client.py:84)."""
+    import argparse
+    parser = argparse.ArgumentParser(description=client_main.__doc__)
+    parser.add_argument("endpoint")
+    parser.add_argument("--backend", default="Agg",
+                        help="matplotlib backend (Agg renders PNG files)")
+    parser.add_argument("--out", default=".", help="output directory")
+    args = parser.parse_args(argv)
+    import zmq
+    import matplotlib
+    matplotlib.use(args.backend)
+    os.makedirs(args.out, exist_ok=True)
+    ctx = zmq.Context.instance()
+    sock = ctx.socket(zmq.SUB)
+    sock.connect(args.endpoint)
+    sock.setsockopt(zmq.SUBSCRIBE, b"")
+    while True:
+        snap = pickle.loads(sock.recv())
+        if snap.get("kind") == "__stop__":
+            break
+        name = "".join(c if c.isalnum() or c in "-_" else "_"
+                       for c in snap["name"])
+        try:
+            render_snapshot(snap, os.path.join(args.out, name + ".png"))
+        except Exception as e:          # keep rendering subsequent plots
+            print("render error for %s: %s" % (snap.get("name"), e),
+                  file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":             # pragma: no cover
+    sys.exit(client_main())

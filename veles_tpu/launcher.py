@@ -12,6 +12,7 @@ gathering/reporting, elapsed/timing reporting, status beacon hook.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Any, Dict, Optional
 
@@ -29,11 +30,21 @@ class Launcher(Logger):
                  num_processes: Optional[int] = None,
                  process_id: Optional[int] = None,
                  random_seed: Optional[int] = None,
-                 test_mode: bool = False) -> None:
+                 test_mode: bool = False,
+                 graphics: bool = False,
+                 plots_dir: Optional[str] = None,
+                 status_url: Optional[str] = None,
+                 notification_interval: float = 10.0) -> None:
         super().__init__()
         self.test_mode = test_mode
         self.workflow = None
         self.device = None
+        self._graphics_enabled = graphics
+        self._plots_dir = plots_dir
+        self.graphics_server = None
+        self._status_url = status_url
+        self._notification_interval = notification_interval
+        self.status_reporter = None
         self._backend = backend
         self._mesh = mesh
         self._dist = (coordinator, num_processes, process_id)
@@ -66,8 +77,18 @@ class Launcher(Logger):
     def initialize(self, workflow) -> None:
         self.make_device()
         self.workflow = workflow
+        if self._graphics_enabled and not root.common.disable.plotting:
+            from .graphics import GraphicsServer
+            self.graphics_server = GraphicsServer()
+            workflow.graphics = self.graphics_server
+            self.graphics_server.launch_client(out_dir=self._plots_dir)
         workflow.initialize(device=self.device)
         distributed.verify_checksums(workflow)
+        if self._status_url and distributed.is_coordinator():
+            from .web_status import StatusReporter
+            self.status_reporter = StatusReporter(
+                self._status_url, self._notification_interval)
+            self.status_reporter.start_periodic(self._status_payload)
         if self.test_mode:
             self._enter_test_mode(workflow)
         self.event("launcher.initialize", "single",
@@ -104,6 +125,19 @@ class Launcher(Logger):
         finally:
             self.event("launcher.work", "end")
             self.stopped = True
+            from .plotter import Plotter
+            for u in getattr(self.workflow, "units", ()):
+                if isinstance(u, Plotter):
+                    try:
+                        u.finalize()
+                    except Exception as e:
+                        self.warning("final redraw of %s failed: %s",
+                                     u.name, e)
+            if self.graphics_server is not None:
+                self.graphics_server.shutdown()
+            if self.status_reporter is not None:
+                self.status_reporter.send(self._status_payload())
+                self.status_reporter.stop()
         elapsed = time.time() - self._start_time
         self.info("elapsed: %.1fs", elapsed)
         results = self.workflow.gather_results()
@@ -116,6 +150,31 @@ class Launcher(Logger):
         if self.workflow is not None:
             self.workflow.stop()
         self.stopped = True
+
+    def _status_payload(self) -> Dict[str, Any]:
+        """Beacon body (reference: veles/launcher.py:852-885)."""
+        wf = self.workflow
+        decision = getattr(wf, "decision", None)
+        metric = None
+        if decision is not None:
+            try:
+                values = decision.get_metric_values()
+                for key in ("best_err", "best_rmse", "err", "rmse"):
+                    if key in values:
+                        metric = values[key]
+                        break
+            except Exception:
+                metric = None
+        return {
+            "id": "%s@%d" % (getattr(wf, "name", "?"), os.getpid()),
+            "name": getattr(wf, "name", "?"),
+            "device": getattr(self.device, "name", None),
+            "epoch": getattr(decision, "epoch_number", None),
+            "metric": metric,
+            "elapsed_sec": (round(time.time() - self._start_time, 1)
+                            if self._start_time else 0.0),
+            "stopped": self.stopped,
+        }
 
     # -- reporting -----------------------------------------------------------
     def write_results(self, results: Dict[str, Any], path: str) -> None:

@@ -1,0 +1,95 @@
+"""Plotter base: units that emit plot snapshots.
+
+Equivalent of the reference's veles/plotter.py:48 (Plotter) +
+veles/iplotter.py (IPlotter), with one deliberate change: the reference
+pickled the *whole unit object* to the graphics client process, which then
+called its ``redraw()`` — coupling the renderer to framework code and
+executing pickled code cross-process. Here a plotter emits a declarative
+**snapshot** (plain dict of scalars/numpy arrays + a ``kind`` tag) and the
+renderer (veles_tpu/graphics.py) owns one draw function per kind. Snapshots
+are cheap host-side data; nothing device-resident crosses the boundary, so
+plotting never synchronizes the TPU stream beyond the values already
+fetched by the decision/evaluator units.
+
+Redraw throttling semantics preserved from the reference (Plotter redraw
+throttling, veles/plotter.py:48).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from .config import root
+from .units import Unit
+
+
+class PlotSink:
+    """Where snapshots go. The default sink just remembers the last snapshot
+    per plot (usable by tests and by the Publisher); GraphicsServer extends
+    it with ZeroMQ pub-sub fan-out to a renderer process."""
+
+    def __init__(self) -> None:
+        self.snapshots: Dict[str, Dict[str, Any]] = {}
+
+    def publish(self, snapshot: Dict[str, Any]) -> None:
+        self.snapshots[snapshot["name"]] = snapshot
+
+
+#: process-wide fallback sink (a Launcher/Workflow normally installs a
+#: GraphicsServer as ``workflow.graphics``)
+default_sink = PlotSink()
+
+
+class Plotter(Unit):
+    """Base of all plot-emitting units (reference: veles/plotter.py:48).
+
+    Subclasses implement ``fill_snapshot() -> dict`` returning the payload;
+    this base adds the ``kind`` tag, throttles redraws and routes the result
+    to the graphics sink. ``run()`` is always cheap and host-side.
+    """
+
+    hide_from_registry = True
+    KIND = "none"
+
+    def __init__(self, workflow, **kwargs) -> None:
+        self.redraw_interval: float = kwargs.pop("redraw_interval", 0.1)
+        super().__init__(workflow, **kwargs)
+        self.view_group = "PLOTTER"
+        self.clear_plot: bool = False
+        self.last_snapshot: Optional[Dict[str, Any]] = None
+        self._last_redraw = 0.0
+
+    @property
+    def sink(self) -> PlotSink:
+        wf = self.workflow
+        while wf is not None:
+            g = getattr(wf, "graphics", None)
+            if g is not None:
+                return g
+            wf = getattr(wf, "workflow", None)
+        return default_sink
+
+    def fill_snapshot(self) -> Optional[Dict[str, Any]]:
+        raise NotImplementedError
+
+    def run(self) -> None:
+        if root.common.disable.plotting:
+            return
+        now = time.time()
+        if now - self._last_redraw < self.redraw_interval:
+            return
+        data = self.fill_snapshot()
+        if data is None:
+            return
+        snapshot = {"name": self.name, "kind": self.KIND, "time": now}
+        snapshot.update(data)
+        self.last_snapshot = snapshot
+        self._last_redraw = now
+        self.sink.publish(snapshot)
+
+    def finalize(self) -> None:
+        """Force one final redraw regardless of throttling (the reference
+        flushed pending plots on workflow finish)."""
+        self._last_redraw = 0.0
+        self.run()

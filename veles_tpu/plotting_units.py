@@ -1,0 +1,230 @@
+"""Standard plotting units.
+
+Equivalent of the reference's veles/plotting_units.py:52-903
+(AccumulatingPlotter, MatrixPlotter, ImagePlotter, Histogram,
+MultiHistogram, TableMaxMin, SlaveStats) re-expressed as declarative
+snapshot emitters (see veles_tpu/plotter.py). ``SlaveStats`` — a table of
+per-slave job throughput — has no meaning under SPMD; its role (live view
+of where time goes) is taken by ``StepStats`` over per-unit timers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy
+
+from .plotter import Plotter
+
+
+def _fetch(obj: Any, field: Optional[str]) -> Any:
+    """Resolve a plotter input: call it if callable, then optionally take
+    ``field`` (attribute or mapping key)."""
+    v = obj() if callable(obj) else obj
+    if field is not None:
+        if isinstance(v, dict):
+            v = v[field]
+        else:
+            v = getattr(v, field)
+    if hasattr(v, "map_read"):          # veles_tpu.memory.Array
+        v = v.map_read()
+    return v
+
+
+class AccumulatingPlotter(Plotter):
+    """Accumulates a scalar per run and plots the series — the workhorse
+    error/loss-curve plot (reference: veles/plotting_units.py:52)."""
+
+    MAPPING = "accumulating_plotter"
+    hide_from_registry = False
+    KIND = "lines"
+
+    def __init__(self, workflow, input=None, input_field=None, **kwargs):
+        self.label: str = kwargs.pop("label", "value")
+        self.plot_style: str = kwargs.pop("plot_style", "-")
+        self.ylim: Optional[Sequence[float]] = kwargs.pop("ylim", None)
+        super().__init__(workflow, **kwargs)
+        self.input = input
+        self.input_field = input_field
+        self.values: List[float] = []
+
+    def fill_snapshot(self) -> Optional[Dict[str, Any]]:
+        v = _fetch(self.input, self.input_field)
+        if v is None:
+            return None
+        self.values.append(float(numpy.asarray(v).ravel()[0]))
+        if self.clear_plot:
+            self.values = self.values[-1:]
+            self.clear_plot = False
+        return {"label": self.label, "style": self.plot_style,
+                "ylim": self.ylim, "values": list(self.values)}
+
+
+class MatrixPlotter(Plotter):
+    """2-D matrix heatmap with per-cell annotations — the confusion-matrix
+    plot (reference: veles/plotting_units.py:184)."""
+
+    MAPPING = "matrix_plotter"
+    hide_from_registry = False
+    KIND = "matrix"
+
+    def __init__(self, workflow, input=None, input_field=None, **kwargs):
+        self.reversed_labels: bool = kwargs.pop("reversed_labels", False)
+        super().__init__(workflow, **kwargs)
+        self.input = input
+        self.input_field = input_field
+        self.row_labels: Optional[Sequence[str]] = None
+        self.column_labels: Optional[Sequence[str]] = None
+
+    def fill_snapshot(self) -> Optional[Dict[str, Any]]:
+        m = _fetch(self.input, self.input_field)
+        if m is None:
+            return None
+        m = numpy.asarray(m)
+        if m.ndim != 2:
+            raise ValueError("%s: expected 2-D matrix, got %s" %
+                             (self.name, m.shape))
+        return {"matrix": numpy.array(m),
+                "row_labels": list(self.row_labels or
+                                   map(str, range(m.shape[0]))),
+                "column_labels": list(self.column_labels or
+                                      map(str, range(m.shape[1])))}
+
+
+class ImagePlotter(Plotter):
+    """Grid of images (weights, reconstructions, worst samples)
+    (reference: veles/plotting_units.py:368)."""
+
+    MAPPING = "image_plotter"
+    hide_from_registry = False
+    KIND = "image_grid"
+
+    def __init__(self, workflow, input=None, input_field=None, **kwargs):
+        self.yuv: bool = kwargs.pop("yuv", False)
+        self.max_images: int = kwargs.pop("max_images", 16)
+        self.color_space: str = kwargs.pop("color_space", "RGB")
+        super().__init__(workflow, **kwargs)
+        self.input = input
+        self.input_field = input_field
+
+    @staticmethod
+    def normalize(img: numpy.ndarray) -> numpy.ndarray:
+        img = numpy.asarray(img, dtype=numpy.float32)
+        lo, hi = float(img.min()), float(img.max())
+        if hi - lo < 1e-12:
+            return numpy.zeros_like(img)
+        return (img - lo) / (hi - lo)
+
+    def fill_snapshot(self) -> Optional[Dict[str, Any]]:
+        imgs = _fetch(self.input, self.input_field)
+        if imgs is None:
+            return None
+        imgs = numpy.asarray(imgs)[:self.max_images]
+        if imgs.ndim == 2:          # flat samples: try square reshape
+            side = int(round(imgs.shape[1] ** 0.5))
+            if side * side == imgs.shape[1]:
+                imgs = imgs.reshape(imgs.shape[0], side, side)
+        return {"images": numpy.stack([self.normalize(i) for i in imgs])}
+
+
+class Histogram(Plotter):
+    """Histogram of one vector (e.g. a weight matrix flattened)
+    (reference: veles/plotting_units.py:480)."""
+
+    MAPPING = "histogram_plotter"
+    hide_from_registry = False
+    KIND = "histogram"
+
+    def __init__(self, workflow, input=None, input_field=None, **kwargs):
+        self.n_bins: int = kwargs.pop("n_bins", 50)
+        super().__init__(workflow, **kwargs)
+        self.input = input
+        self.input_field = input_field
+
+    def fill_snapshot(self) -> Optional[Dict[str, Any]]:
+        v = _fetch(self.input, self.input_field)
+        if v is None:
+            return None
+        v = numpy.asarray(v, dtype=numpy.float64).ravel()
+        counts, edges = numpy.histogram(v, bins=self.n_bins)
+        return {"counts": counts, "edges": edges}
+
+
+class MultiHistogram(Plotter):
+    """One histogram per row/slice — e.g. per-neuron weight distributions
+    (reference: veles/plotting_units.py:536)."""
+
+    MAPPING = "multi_histogram_plotter"
+    hide_from_registry = False
+    KIND = "multi_histogram"
+
+    def __init__(self, workflow, input=None, input_field=None, **kwargs):
+        self.n_bins: int = kwargs.pop("n_bins", 20)
+        self.hist_number: int = kwargs.pop("hist_number", 16)
+        super().__init__(workflow, **kwargs)
+        self.input = input
+        self.input_field = input_field
+
+    def fill_snapshot(self) -> Optional[Dict[str, Any]]:
+        m = _fetch(self.input, self.input_field)
+        if m is None:
+            return None
+        m = numpy.asarray(m, dtype=numpy.float64)
+        m = m.reshape(m.shape[0], -1)[:self.hist_number]
+        counts, edges = [], []
+        for row in m:
+            c, e = numpy.histogram(row, bins=self.n_bins)
+            counts.append(c)
+            edges.append(e)
+        return {"counts": numpy.stack(counts), "edges": numpy.stack(edges)}
+
+
+class TableMaxMin(Plotter):
+    """Table of max/min per watched array — quick NaN/blow-up telemetry
+    (reference: veles/plotting_units.py:629)."""
+
+    MAPPING = "table_maxmin_plotter"
+    hide_from_registry = False
+    KIND = "table"
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        #: list of (label, supplier, field)
+        self._sources: List[tuple] = []
+
+    def add_source(self, label: str, supplier: Any,
+                   field: Optional[str] = None) -> "TableMaxMin":
+        self._sources.append((label, supplier, field))
+        return self
+
+    def fill_snapshot(self) -> Optional[Dict[str, Any]]:
+        if not self._sources:
+            return None
+        rows = []
+        for label, supplier, field in self._sources:
+            v = numpy.asarray(_fetch(supplier, field), dtype=numpy.float64)
+            rows.append([label, "%.6g" % v.max(), "%.6g" % v.min()])
+        return {"header": ["array", "max", "min"], "rows": rows}
+
+
+class StepStats(Plotter):
+    """Table of per-unit run counts and cumulative wall time — the SPMD-era
+    replacement of the reference's per-slave SlaveStats
+    (veles/plotting_units.py:822): under pjit there are no slaves, the
+    interesting live breakdown is where workflow wall-time goes."""
+
+    MAPPING = "step_stats_plotter"
+    hide_from_registry = False
+    KIND = "table"
+
+    def __init__(self, workflow, top: int = 10, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.top = top
+
+    def fill_snapshot(self) -> Optional[Dict[str, Any]]:
+        units = [(u.timers.get("run", 0.0), u.run_count, u.name)
+                 for u in self.workflow if u is not self]
+        units.sort(reverse=True)
+        rows = [[name, str(count), "%.3f" % t]
+                for t, count, name in units[:self.top]]
+        return {"header": ["unit", "runs", "total s"], "rows": rows}

@@ -1,0 +1,199 @@
+"""Publisher: end-of-training report generation.
+
+Equivalent of the reference's veles/publishing/publisher.py:57 + backends
+(Markdown/Confluence/PDF via jinja2 templates, gathering plots, the
+workflow graph and results). Here:
+
+- ``MarkdownBackend`` writes ``report.md`` + a ``figures/`` directory
+  (plots rendered from the graphics sink's snapshots);
+- ``HTMLBackend`` renders the same material to a single self-contained
+  ``report.html`` via jinja2 (images inlined base64);
+- Confluence upload is out of scope (no egress in the target environment);
+  the backend registry accepts third-party additions the same way the
+  reference's MappedObjectsRegistry did.
+
+The Publisher is a Unit gated exactly like a Snapshotter: link it after
+the decision and open its gate when training completes.
+"""
+
+from __future__ import annotations
+
+import base64
+import datetime
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from .config import root
+from .units import Unit
+
+BACKENDS: Dict[str, type] = {}
+
+
+def register_backend(name: str):
+    def deco(cls):
+        BACKENDS[name] = cls
+        return cls
+    return deco
+
+
+class PublishingBackend:
+    """Renders gathered report material to some destination."""
+
+    def render(self, material: Dict[str, Any], out_dir: str) -> str:
+        raise NotImplementedError
+
+
+@register_backend("markdown")
+class MarkdownBackend(PublishingBackend):
+    def render(self, material: Dict[str, Any], out_dir: str) -> str:
+        fig_dir = os.path.join(out_dir, "figures")
+        os.makedirs(fig_dir, exist_ok=True)
+        lines: List[str] = ["# %s — training report" % material["name"], ""]
+        lines += ["*Generated: %s*" % material["date"], ""]
+        lines += ["## Results", ""]
+        for k, v in sorted(material["results"].items()):
+            lines.append("- **%s**: %s" % (k, v))
+        lines += ["", "## Unit timing (top 10)", "",
+                  "| unit | runs | total s |", "|---|---|---|"]
+        for t, name, count in material["stats"]:
+            lines.append("| %s | %d | %.3f |" % (name, count, t))
+        figures = self._render_figures(material, fig_dir)
+        if figures:
+            lines += ["", "## Plots", ""]
+            for name, path in figures:
+                rel = os.path.relpath(path, out_dir)
+                lines += ["### %s" % name, "", "![%s](%s)" % (name, rel),
+                          ""]
+        if material.get("graph"):
+            lines += ["", "## Workflow graph", "", "```dot",
+                      material["graph"], "```"]
+        if material.get("config"):
+            lines += ["", "## Configuration", "", "```json",
+                      json.dumps(material["config"], indent=2,
+                                 default=str), "```"]
+        path = os.path.join(out_dir, "report.md")
+        with open(path, "w") as fout:
+            fout.write("\n".join(lines) + "\n")
+        return path
+
+    @staticmethod
+    def _render_figures(material, fig_dir) -> List[tuple]:
+        from .graphics import render_snapshot
+        out = []
+        for name, snap in sorted(material["snapshots"].items()):
+            safe = "".join(c if c.isalnum() or c in "-_" else "_"
+                           for c in name)
+            try:
+                out.append((name, render_snapshot(
+                    snap, os.path.join(fig_dir, safe + ".png"))))
+            except Exception:
+                pass
+        return out
+
+
+@register_backend("html")
+class HTMLBackend(PublishingBackend):
+    TEMPLATE = """<!doctype html><html><head><meta charset="utf-8">
+<title>{{ name }} — report</title><style>
+body { font-family: sans-serif; max-width: 60em; margin: 2em auto; }
+table { border-collapse: collapse; } td, th { border: 1px solid #999;
+padding: 4px 10px; } th { background: #eee; } img { max-width: 100%; }
+pre { background: #f5f5f5; padding: 1em; overflow-x: auto; }
+</style></head><body>
+<h1>{{ name }} — training report</h1><p><i>Generated: {{ date }}</i></p>
+<h2>Results</h2><ul>
+{% for k, v in results|dictsort %}<li><b>{{ k }}</b>: {{ v }}</li>
+{% endfor %}</ul>
+<h2>Unit timing</h2><table><tr><th>unit</th><th>runs</th><th>total s</th>
+</tr>{% for t, uname, count in stats %}
+<tr><td>{{ uname }}</td><td>{{ count }}</td>
+<td>{{ "%.3f"|format(t) }}</td></tr>{% endfor %}</table>
+{% if figures %}<h2>Plots</h2>
+{% for fname, b64 in figures %}<h3>{{ fname }}</h3>
+<img src="data:image/png;base64,{{ b64 }}">{% endfor %}{% endif %}
+{% if graph %}<h2>Workflow graph</h2><pre>{{ graph }}</pre>{% endif %}
+{% if config %}<h2>Configuration</h2>
+<pre>{{ config_json }}</pre>{% endif %}
+</body></html>"""
+
+    def render(self, material: Dict[str, Any], out_dir: str) -> str:
+        import tempfile
+        import jinja2
+        from .graphics import render_snapshot
+        figures = []
+        with tempfile.TemporaryDirectory() as tmp:
+            for name, snap in sorted(material["snapshots"].items()):
+                try:
+                    p = render_snapshot(snap, os.path.join(tmp, "f.png"))
+                except Exception:
+                    continue
+                with open(p, "rb") as fin:
+                    figures.append(
+                        (name, base64.b64encode(fin.read()).decode()))
+        html = jinja2.Template(self.TEMPLATE).render(
+            figures=figures,
+            config_json=json.dumps(material.get("config"), indent=2,
+                                   default=str),
+            **material)
+        path = os.path.join(out_dir, "report.html")
+        with open(path, "w") as fout:
+            fout.write(html)
+        return path
+
+
+class Publisher(Unit):
+    """Report-generating unit (reference: veles/publishing/publisher.py:57).
+
+    Typical wiring (exactly like a Snapshotter):
+        pub = Publisher(wf, backends=("markdown", "html"))
+        pub.link_from(decision); pub.gate_skip = ~decision.complete
+    """
+
+    MAPPING = "publisher"
+    hide_from_registry = False
+
+    def __init__(self, workflow, backends=("markdown",),
+                 out_dir: Optional[str] = None,
+                 include_config: bool = True, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.backend_names = tuple(backends)
+        self.out_dir = out_dir
+        self.include_config = include_config
+        self.reports: List[str] = []
+        for b in self.backend_names:
+            if b not in BACKENDS:
+                raise KeyError("unknown publishing backend %r (have %s)" %
+                               (b, sorted(BACKENDS)))
+
+    def gather_material(self) -> Dict[str, Any]:
+        wf = self.workflow
+        from .plotter import Plotter
+        # only THIS workflow's plots: the process-wide default sink may hold
+        # snapshots of other workflows in the same process
+        snapshots = {u.name: u.last_snapshot for u in wf
+                     if isinstance(u, Plotter) and u.last_snapshot}
+        return {
+            "name": wf.name,
+            "date": datetime.datetime.now().isoformat(timespec="seconds"),
+            "results": wf.gather_results(),
+            "stats": wf.print_stats(),
+            "graph": wf.generate_graph(),
+            "snapshots": snapshots,
+            "config": root.common.as_dict() if self.include_config else None,
+        }
+
+    def run(self) -> None:
+        out_dir = self.out_dir or os.path.join(
+            root.common.dirs.cache, "reports",
+            datetime.datetime.now().strftime("%Y%m%d-%H%M%S"))
+        os.makedirs(out_dir, exist_ok=True)
+        material = self.gather_material()
+        for name in self.backend_names:
+            path = BACKENDS[name]().render(material, out_dir)
+            self.reports.append(path)
+            self.info("%s: published %s", self.name, path)
+
+    def get_metric_values(self) -> Dict[str, Any]:
+        return {"reports": list(self.reports)} if self.reports else {}

@@ -1,0 +1,152 @@
+"""RESTful serving: HTTP POST a sample, get the model's answer.
+
+Equivalent of the reference's veles/restful_api.py:78 (RESTfulAPI unit:
+twisted Site; POST /api JSON → RestfulLoader feed → workflow run in test
+mode → JSON result). Stdlib ``http.server`` replaces twisted (not in this
+environment); the serving workflow itself is the same shape: a Repeater
+loop of RestfulLoader → forwards → RESTfulAPI, where this unit runs after
+the forwards each pass and answers the HTTP request that fed the sample.
+
+The HTTP thread and the workflow thread meet through per-request tickets:
+the handler feeds (sample, ticket) to the loader and blocks on the
+ticket's event; this unit's ``run()`` fills the ticket from the forward
+output and sets the event.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+import numpy
+
+from .error import VelesError
+from .units import Unit
+
+
+class _Ticket:
+    __slots__ = ("event", "result", "error")
+
+    def __init__(self) -> None:
+        self.event = threading.Event()
+        self.result: Any = None
+        self.error: Optional[str] = None
+
+
+class RESTfulAPI(Unit):
+    """Serving endpoint unit (reference: veles/restful_api.py:78).
+
+    Wire into a forward workflow:
+        api = RESTfulAPI(wf, port=8080, loader=rest_loader)
+        api.link_attrs(last_forward, ("input", "output"))
+        api.link_from(last_forward); repeater.link_from(api)
+    """
+
+    MAPPING = "restful_api"
+    hide_from_registry = False
+
+    def __init__(self, workflow, loader=None, port: int = 0,
+                 path: str = "/api", request_timeout: float = 60.0,
+                 **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.loader = loader
+        self.port = port
+        self.path = path
+        self.request_timeout = request_timeout
+        #: forward output to answer from (link_attrs from the last forward)
+        self.input = None
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self.requests_served = 0
+        self.demand("loader")
+
+    # -- lifecycle ----------------------------------------------------------
+    def initialize(self, **kwargs):
+        res = super().initialize(**kwargs)
+        if res:
+            return res
+        if self._httpd is not None:
+            return None
+        api = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route into our logger
+                api.debug("http: " + fmt, *args)
+
+            def do_POST(self):
+                if self.path != api.path:
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    sample = numpy.asarray(body["input"],
+                                           dtype=numpy.float32)
+                except (ValueError, KeyError) as e:
+                    self._reply(400, {"error": "bad request: %s" % e})
+                    return
+                ticket = _Ticket()
+                try:
+                    api.loader.feed(sample, ticket=ticket)
+                except Exception as e:
+                    self._reply(503, {"error": str(e)})
+                    return
+                if not ticket.event.wait(api.request_timeout):
+                    self._reply(504, {"error": "inference timed out"})
+                    return
+                if ticket.error is not None:
+                    self._reply(500, {"error": ticket.error})
+                    return
+                self._reply(200, {"result": ticket.result})
+
+            def _reply(self, code: int, payload: Dict[str, Any]):
+                data = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name=self.name + ".http")
+        self._thread.start()
+        self.info("%s: REST API on http://127.0.0.1:%d%s", self.name,
+                  self.port, self.path)
+        return None
+
+    # -- graph side ---------------------------------------------------------
+    def run(self) -> None:
+        ticket = getattr(self.loader, "current_ticket", None)
+        if not isinstance(ticket, _Ticket):
+            return      # sample came from somewhere else (e.g. warm-up)
+        try:
+            out = self.input
+            if out is None:
+                raise VelesError("%s: no forward output linked" % self.name)
+            if hasattr(out, "map_read"):
+                out = out.map_read()
+            out = numpy.asarray(out)
+            if out.ndim > 1:            # minibatch of 1: unwrap
+                out = out[0]
+            ticket.result = out.tolist()
+            self.requests_served += 1
+        except Exception as e:
+            ticket.error = "%s: %s" % (type(e).__name__, e)
+        finally:
+            self.loader.current_ticket = None
+            ticket.event.set()
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,188 @@
+"""Web status: one dashboard aggregating every running training.
+
+Equivalent of the reference's veles/web_status.py:113 (tornado app: masters
+POST a status beacon to ``/update``; a browser dashboard lists them) and of
+the launcher beacon (veles/launcher.py:852-885). Stdlib ``http.server``
+replaces tornado: the dashboard is one self-contained HTML page polling
+``/status.json`` — no external frontend tree (the reference's ``web/`` viz.js
+bundle is an absent submodule anyway).
+
+Server:  ``python -m veles_tpu.web_status [--port 8090]`` or
+         ``WebStatusServer(port=...).start()``.
+Client:  ``StatusReporter(url).send(payload)`` — used by the Launcher when
+         constructed with ``status_url=...``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from .logger import Logger
+
+_PAGE = """<!doctype html>
+<html><head><title>veles_tpu status</title><style>
+body { font-family: sans-serif; margin: 2em; }
+table { border-collapse: collapse; }
+td, th { border: 1px solid #999; padding: 4px 10px; }
+th { background: #eee; }
+</style></head><body>
+<h2>veles_tpu — running workflows</h2>
+<table id="t"><tr><th>id</th><th>name</th><th>device</th><th>epoch</th>
+<th>metric</th><th>elapsed&nbsp;s</th><th>updated</th></tr></table>
+<script>
+async function tick() {
+  const r = await fetch('status.json'); const all = await r.json();
+  const t = document.getElementById('t');
+  while (t.rows.length > 1) t.deleteRow(1);
+  for (const [id, s] of Object.entries(all)) {
+    const row = t.insertRow();
+    for (const v of [id, s.name, s.device, s.epoch, s.metric,
+                     s.elapsed_sec, new Date(s._received * 1000)
+                     .toLocaleTimeString()])
+      row.insertCell().textContent = v ?? '';
+  }
+}
+tick(); setInterval(tick, 2000);
+</script></body></html>"""
+
+
+class WebStatusServer(Logger):
+    """Aggregation server (reference: veles/web_status.py:113)."""
+
+    def __init__(self, port: int = 0, stale_after: float = 180.0) -> None:
+        super().__init__()
+        self.stale_after = stale_after
+        self._statuses: Dict[str, Dict[str, Any]] = {}
+        self._lock = threading.Lock()
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                server.debug("http: " + fmt, *args)
+
+            def do_GET(self):
+                if self.path in ("/", "/index.html"):
+                    self._reply(200, _PAGE.encode(), "text/html")
+                elif self.path == "/status.json":
+                    self._reply(200, json.dumps(
+                        server.snapshot()).encode(), "application/json")
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                if self.path != "/update":
+                    self.send_error(404)
+                    return
+                try:
+                    length = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(length))
+                    wid = str(payload["id"])
+                except (ValueError, KeyError) as e:
+                    self._reply(400, json.dumps(
+                        {"error": str(e)}).encode(), "application/json")
+                    return
+                server.update(wid, payload)
+                self._reply(200, b'{"ok": true}', "application/json")
+
+            def _reply(self, code, data, ctype):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+        self.port = self._httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+
+    # -- state --------------------------------------------------------------
+    def update(self, wid: str, payload: Dict[str, Any]) -> None:
+        payload = dict(payload)
+        payload["_received"] = time.time()
+        with self._lock:
+            self._statuses[wid] = payload
+
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        now = time.time()
+        with self._lock:
+            self._statuses = {
+                k: v for k, v in self._statuses.items()
+                if now - v["_received"] < self.stale_after}
+            return dict(self._statuses)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "WebStatusServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True, name="web_status")
+        self._thread.start()
+        self.info("web status on http://127.0.0.1:%d/", self.port)
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+class StatusReporter(Logger):
+    """Beacon client: POSTs workflow status to a WebStatusServer
+    (reference: veles/launcher.py:852-885 _notify_status)."""
+
+    def __init__(self, url: str, interval: float = 10.0) -> None:
+        super().__init__()
+        self.url = url.rstrip("/") + "/update"
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def send(self, payload: Dict[str, Any]) -> bool:
+        try:
+            req = urllib.request.Request(
+                self.url, data=json.dumps(payload).encode(),
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                return resp.status == 200
+        except Exception as e:
+            self.debug("status beacon failed: %s", e)
+            return False
+
+    def start_periodic(self, supplier) -> None:
+        """``supplier() -> payload dict`` polled every ``interval``."""
+        def loop():
+            while not self._stop.wait(self.interval):
+                self.send(supplier())
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="status_beacon")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+
+def main(argv=None) -> int:     # pragma: no cover - thin CLI
+    import argparse
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--port", type=int, default=8090)
+    args = parser.parse_args(argv)
+    server = WebStatusServer(port=args.port).start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":      # pragma: no cover
+    import sys
+    sys.exit(main())
