@@ -1,0 +1,167 @@
+"""Kohonen SOM + RBM units: XLA-vs-numpy oracle agreement and small
+convergence checks (reference test strategy SURVEY.md §4)."""
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn
+from veles_tpu.memory import Array
+from veles_tpu.nn.kohonen import som_step
+from veles_tpu.nn.rbm import cd1_step
+
+
+def dev():
+    return vt.XLADevice(mesh_axes={"data": 1})
+
+
+@pytest.fixture(autouse=True)
+def f32_compute():
+    prev = vt.root.common.engine.compute_dtype
+    vt.root.common.engine.compute_dtype = "float32"
+    yield
+    vt.root.common.engine.compute_dtype = prev
+
+
+def clusters(n=96, seed=0):
+    rng = numpy.random.RandomState(seed)
+    centers = numpy.array([[0.0, 0.0], [4.0, 4.0], [0.0, 4.0]],
+                          dtype=numpy.float32)
+    x = numpy.concatenate([
+        c + 0.3 * rng.randn(n // 3, 2).astype(numpy.float32)
+        for c in centers])
+    rng.shuffle(x)
+    return x
+
+
+def test_kohonen_forward_oracle():
+    wf = vt.Workflow(name="t")
+    u = nn.KohonenForward(wf, shape=(4, 4))
+    x = clusters()
+    u.input = Array(x)
+    u.initialize(device=dev())
+    u.xla_run()
+    y_xla = numpy.asarray(u.output.map_read())
+    y_np = u.numpy_apply(u.params_np(), x)
+    numpy.testing.assert_array_equal(y_xla, y_np)
+    assert y_xla.dtype == numpy.int32
+    assert (0 <= y_xla).all() and (y_xla < 16).all()
+
+
+def test_kohonen_trainer_oracle_step():
+    """One batch-SOM step agrees between jitted path and numpy."""
+    wf = vt.Workflow(name="t")
+    u = nn.KohonenTrainer(wf, shape=(3, 3))
+    x = clusters(30)
+    u.input = Array(x)
+    u.initialize(device=dev())
+    w0 = numpy.array(u.weights.map_read())
+    w_np, win_np, qerr_np = som_step(w0.copy(), u.grid, x, 0.4, 1.2,
+                                     numpy)
+    u.xla_run()
+    # re-run the same step from the same start on the oracle path
+    u2 = nn.KohonenTrainer(wf, shape=(3, 3), name="t2")
+    u2.input = Array(x)
+    u2.initialize(device=dev())
+    u2.weights.reset(w0.copy())
+    u2.numpy_run()
+    # both used schedule() at time 0 — same lr/sigma
+    numpy.testing.assert_allclose(numpy.asarray(u.weights.map_read()),
+                                  u2.weights.map_read(), rtol=1e-4,
+                                  atol=1e-5)
+    numpy.testing.assert_array_equal(u.winners, u2.winners)
+
+
+def test_kohonen_convergence():
+    """Quantization error falls as the map organizes."""
+    wf = vt.Workflow(name="t")
+    u = nn.KohonenTrainer(wf, shape=(5, 5), lr0=0.6, decay=60.0)
+    x = clusters(150)
+    u.input = Array(x)
+    u.initialize(device=dev())
+    u.xla_run()
+    first = u.quantization_error
+    for _ in range(40):
+        u.xla_run()
+    assert u.quantization_error < first * 0.5, (first,
+                                                u.quantization_error)
+    m = u.get_metric_values()
+    assert m["som_steps"] == 41
+
+
+def test_kohonen_state_roundtrip():
+    wf = vt.Workflow(name="t")
+    u = nn.KohonenTrainer(wf, shape=(3, 3))
+    u.input = Array(clusters(30))
+    u.initialize(device=dev())
+    u.xla_run()
+    sd = u.state_dict()
+    u2 = nn.KohonenTrainer(wf, shape=(3, 3), name="u2")
+    u2.input = Array(clusters(30))
+    u2.initialize(device=dev())
+    u2.load_state_dict(sd)
+    assert u2.time == u.time
+    numpy.testing.assert_allclose(u2.weights.map_read(),
+                                  numpy.asarray(u.weights.map_read()))
+
+
+def bars(n=64, side=4, seed=1):
+    """Bars dataset: each sample lights up full rows/columns."""
+    rng = numpy.random.RandomState(seed)
+    x = numpy.zeros((n, side, side), dtype=numpy.float32)
+    for i in range(n):
+        for r in range(side):
+            if rng.rand() < 0.3:
+                x[i, r, :] = 1.0
+    return x.reshape(n, side * side)
+
+
+def test_rbm_forward_oracle():
+    wf = vt.Workflow(name="t")
+    u = nn.RBM(wf, n_hidden=12)
+    x = bars()
+    u.input = Array(x)
+    u.initialize(device=dev())
+    u.xla_run()
+    y_xla = numpy.asarray(u.output.map_read())
+    y_np = u.numpy_apply(u.params_np(), x)
+    numpy.testing.assert_allclose(y_xla, y_np, rtol=1e-4, atol=1e-5)
+    assert ((0 < y_xla) & (y_xla < 1)).all()
+
+
+def test_rbm_cd1_oracle_same_noise():
+    """With identical sampling uniforms, the jitted CD-1 update equals the
+    numpy oracle update."""
+    wf = vt.Workflow(name="t")
+    u = nn.RBMTrainer(wf, n_hidden=8, learning_rate=0.2)
+    x = bars(16)
+    u.input = Array(x)
+    u.initialize(device=dev())
+    params = {k: numpy.array(v.map_read())
+              for k, v in u.param_arrays().items()}
+    uni = numpy.random.RandomState(7).rand(16, 8).astype(numpy.float32)
+    new_np, err_np = cd1_step(params, x, uni, 0.2, numpy)
+    import jax.numpy as jnp
+    new_x, err_x = cd1_step({k: jnp.asarray(v) for k, v in params.items()},
+                            jnp.asarray(x), jnp.asarray(uni), 0.2, jnp)
+    for k in params:
+        numpy.testing.assert_allclose(numpy.asarray(new_x[k]), new_np[k],
+                                      rtol=1e-4, atol=1e-5)
+    assert abs(float(err_x) - float(err_np)) < 1e-5
+
+
+def test_rbm_training_reduces_reconstruction_error():
+    wf = vt.Workflow(name="t")
+    u = nn.RBMTrainer(wf, n_hidden=16, learning_rate=0.5)
+    x = bars(64)
+    u.input = Array(x)
+    u.initialize(device=dev())
+    u.xla_run()
+    first = u.reconstruction_error
+    for _ in range(200):
+        u.xla_run()
+    assert u.reconstruction_error < first * 0.7, (
+        first, u.reconstruction_error)
+    # mean-field reconstruction resembles the data
+    vhat = u.reconstruct_np({k: numpy.array(v.map_read())
+                             for k, v in u.param_arrays().items()}, x)
+    assert ((vhat - x) ** 2).mean() < 0.1

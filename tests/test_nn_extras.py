@@ -1,0 +1,154 @@
+"""Tensor-plumbing NN extras: cutter, channel split/merge, zero filler,
+image saver, nn plotting units (Znicz modules, SURVEY.md §2.8)."""
+import os
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn
+from veles_tpu.config import root
+from veles_tpu.error import VelesError
+from veles_tpu.memory import Array
+
+
+def dev():
+    return vt.XLADevice(mesh_axes={"data": 1})
+
+
+def run_oracle_pair(u, x):
+    u.input = Array(x)
+    u.initialize(device=dev())
+    u.xla_run()
+    y_xla = numpy.asarray(u.output.map_read())
+    y_np = u.numpy_apply(u.params_np(), x)
+    numpy.testing.assert_allclose(y_xla, y_np, rtol=1e-5, atol=1e-6)
+    return y_np
+
+
+def test_cutter():
+    wf = vt.Workflow(name="t")
+    x = numpy.arange(2 * 6 * 8 * 3, dtype=numpy.float32).reshape(2, 6, 8, 3)
+    u = nn.Cutter(wf, padding=(2, 1, 1, 2))
+    y = run_oracle_pair(u, x)
+    assert y.shape == (2, 3, 5, 3)
+    numpy.testing.assert_array_equal(y, x[:, 1:4, 2:7])
+    with pytest.raises(ValueError):
+        nn.Cutter(wf, padding=(4, 0, 4, 0)).output_shape_for((1, 6, 8, 3))
+
+
+def test_channel_splitter_groups():
+    wf = vt.Workflow(name="t")
+    x = numpy.random.RandomState(0).rand(3, 4, 4, 6).astype(numpy.float32)
+    u = nn.ChannelSplitter(wf, groups=3)
+    u.input = Array(x)
+    u.initialize(device=dev())
+    u.xla_run()
+    assert len(u.outputs) == 3
+    for i, arr in enumerate(u.outputs):
+        numpy.testing.assert_allclose(numpy.asarray(arr.map_read()),
+                                      x[..., 2 * i:2 * i + 2])
+    u.numpy_run()
+    for i, arr in enumerate(u.outputs):
+        numpy.testing.assert_allclose(arr.map_read(),
+                                      x[..., 2 * i:2 * i + 2])
+
+
+def test_channel_split_merge_roundtrip():
+    wf = vt.Workflow(name="t")
+    x = numpy.random.RandomState(1).rand(2, 3, 3, 4).astype(numpy.float32)
+    split = nn.ChannelSplitter(wf, sizes=(1, 3))
+    split.input = Array(x)
+    split.initialize(device=dev())
+    split.xla_run()
+    merge = nn.ChannelMerger(wf, inputs=split.outputs)
+    merge.initialize(device=dev())
+    merge.xla_run()
+    numpy.testing.assert_allclose(numpy.asarray(merge.output.map_read()),
+                                  x, rtol=1e-6)
+    merge.numpy_run()
+    numpy.testing.assert_allclose(merge.output.map_read(), x, rtol=1e-6)
+
+
+def test_channel_splitter_validates():
+    wf = vt.Workflow(name="t")
+    with pytest.raises(VelesError):
+        nn.ChannelSplitter(wf)                     # neither groups nor sizes
+    u = nn.ChannelSplitter(wf, groups=4)
+    with pytest.raises(VelesError):
+        u.output_shape_for((1, 2, 2, 6))           # 6 % 4 != 0
+
+
+def test_zero_filler_masks_weights():
+    wf = vt.Workflow(name="t")
+    fc = nn.All2All(wf, output_sample_shape=4, name="fc")
+    x = numpy.random.RandomState(2).rand(5, 6).astype(numpy.float32)
+    fc.input = Array(x)
+    fc.initialize(device=dev())
+    zf = nn.ZeroFiller(wf, target=fc, grouping=2)
+    assert zf.initialize() is None
+    w = numpy.asarray(fc.weights.map_read())
+    assert (w[:3, 2:] == 0).all() and (w[3:, :2] == 0).all()
+    assert (w[:3, :2] != 0).any() and (w[3:, 2:] != 0).any()
+    # wrong-shape mask rejected
+    bad = nn.ZeroFiller(wf, target=fc, mask=numpy.ones((2, 2)),
+                        name="bad")
+    with pytest.raises(VelesError):
+        bad.initialize()
+
+
+def test_image_saver(tmp_path):
+    wf = vt.Workflow(name="t")
+    saver = nn.ImageSaver(wf, out_dir=str(tmp_path / "dump"), limit=10)
+    data = numpy.random.RandomState(3).rand(6, 16).astype(numpy.float32)
+    labels = numpy.array([0, 1, 0, 1, 0, 1])
+    preds = numpy.zeros((6, 2), dtype=numpy.float32)
+    preds[:, 0] = 1.0           # predicts class 0 for everything
+    saver.input, saver.labels, saver.output = (Array(data), Array(labels),
+                                               Array(preds))
+    saver.run()
+    # the three label-1 samples were wrong → saved under truth dir "1"
+    files = os.listdir(tmp_path / "dump" / "1")
+    assert len(files) == 3 and all(f.endswith(".png") for f in files)
+    assert not (tmp_path / "dump" / "0").exists()
+    assert saver.get_metric_values() == {"images_saved": 3}
+    saver.reset_epoch()
+    assert saver.saved_count == 0
+    assert not (tmp_path / "dump").exists()
+
+
+@pytest.fixture
+def plotting_enabled():
+    old = root.common.disable.plotting
+    root.common.disable.plotting = False
+    yield
+    root.common.disable.plotting = old
+
+
+def test_weights2d_plotter(plotting_enabled, tmp_path):
+    wf = vt.Workflow(name="t")
+    fc = nn.All2All(wf, output_sample_shape=6, name="fc")
+    fc.input = Array(numpy.zeros((2, 9), dtype=numpy.float32))
+    fc.initialize(device=dev())
+    p = nn.Weights2D(wf, unit=fc, redraw_interval=0.0)
+    p.run()
+    snap = p.last_snapshot
+    assert snap["images"].shape == (6, 3, 3)    # 9 weights → 3x3 tiles
+    from veles_tpu import graphics
+    graphics.render_snapshot(snap, str(tmp_path / "w.png"))
+
+
+def test_kohonen_hits_plotter(plotting_enabled):
+    wf = vt.Workflow(name="t")
+    tr = nn.KohonenTrainer(wf, shape=(2, 2))
+    tr.input = Array(numpy.random.RandomState(0)
+                     .rand(20, 3).astype(numpy.float32))
+    tr.initialize(device=dev())
+    tr.xla_run()
+    p = nn.KohonenHits(wf, trainer=tr, redraw_interval=0.0)
+    p.run()
+    m = p.last_snapshot["matrix"]
+    assert m.shape == (2, 2) and m.sum() == 20
+    tr.xla_run()
+    p.run()
+    assert p.last_snapshot["matrix"].sum() == 40    # accumulates

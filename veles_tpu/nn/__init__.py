@@ -26,6 +26,13 @@ from .evaluator import EvaluatorSoftmax, EvaluatorMSE  # noqa
 from .decision import DecisionGD, DecisionMSE  # noqa
 from .lr_adjust import LearningRateAdjust, step_exp, inv, exp_decay  # noqa
 from .rnn import LSTM, RNN  # noqa
+from .kohonen import KohonenForward, KohonenTrainer  # noqa
+from .rbm import RBM, RBMTrainer  # noqa
+from .cutter import Cutter  # noqa
+from .channel_split import ChannelSplitter, ChannelMerger  # noqa
+from .zerofill import ZeroFiller  # noqa
+from .image_saver import ImageSaver  # noqa
+from .nn_plotting import Weights2D, KohonenHits  # noqa
 from .attention import MultiHeadAttention  # noqa
 from .train_step import TrainStep  # noqa
 from .standard_workflow import StandardWorkflow  # noqa

@@ -1,0 +1,52 @@
+"""Cutter: static spatial crop of NHWC minibatches.
+
+Equivalent of Znicz ``cutter`` (reference surface: SURVEY.md §2.8 "cutter,
+channel_splitting, weights_zerofilling … tensor plumbing layers"). A pure
+slice — statically shaped, so XLA fuses it for free; its backward (zero-pad
+of the gradient, a hand-written kernel in the reference era) comes from
+autodiff of the slice inside the fused train step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy
+
+from .nn_units import ForwardBase
+
+
+class Cutter(ForwardBase):
+    """Crops ``padding = (left, top, right, bottom)`` pixels off NHWC."""
+
+    MAPPING = "cutter"
+    hide_from_registry = False
+
+    def __init__(self, workflow, padding: Tuple[int, int, int, int] =
+                 (0, 0, 0, 0), **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        if len(padding) != 4 or any(p < 0 for p in padding):
+            raise ValueError("padding must be 4 non-negative ints "
+                             "(left, top, right, bottom), got %r"
+                             % (padding,))
+        self.padding = tuple(int(p) for p in padding)
+
+    def output_shape_for(self, input_shape):
+        n, h, w = input_shape[0], input_shape[1], input_shape[2]
+        left, top, right, bottom = self.padding
+        oh, ow = h - top - bottom, w - left - right
+        if oh <= 0 or ow <= 0:
+            raise ValueError("%s: padding %s consumes the whole %dx%d "
+                             "input" % (self.name, self.padding, h, w))
+        return (n, oh, ow) + tuple(input_shape[3:])
+
+    def _slices(self, shape):
+        left, top, right, bottom = self.padding
+        return (slice(None), slice(top, shape[1] - bottom),
+                slice(left, shape[2] - right))
+
+    def apply(self, params, x, *, train=False, rng=None):
+        return x[self._slices(x.shape)]
+
+    def numpy_apply(self, params, x):
+        return numpy.ascontiguousarray(x[self._slices(x.shape)])

@@ -1,0 +1,103 @@
+"""ImageSaver: dump interesting (usually misclassified) samples as PNGs.
+
+Equivalent of Znicz ``image_saver`` (reference surface: SURVEY.md §2.8):
+writes per-class directories of the samples the model got wrong, with the
+truth/prediction encoded in the file name — the classic "show me what it
+confuses" debugging loop.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+import numpy
+
+from ..config import root
+from ..units import Unit
+
+
+class ImageSaver(Unit):
+    """Saves up to ``limit`` wrong samples per run.
+
+    Wire after the evaluator:
+        saver = ImageSaver(wf, out_dir=...)
+        saver.link_attrs(loader, ("input", "minibatch_data"),
+                         ("labels", "minibatch_labels"))
+        saver.link_attrs(evaluator, ("output", "output"))
+    """
+
+    MAPPING = "image_saver"
+    hide_from_registry = False
+
+    def __init__(self, workflow, out_dir: Optional[str] = None,
+                 limit: int = 64, only_wrong: bool = True,
+                 **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.out_dir = out_dir or os.path.join(root.common.dirs.cache,
+                                               "image_saver")
+        self.limit = int(limit)
+        self.only_wrong = only_wrong
+        self.input = None       # minibatch data (B, ...) floats
+        self.labels = None      # (B,) int truth
+        self.output = None      # (B, classes) predictions
+        self.saved_count = 0
+        self.demand("input", "output", "labels")
+
+    def reset_epoch(self) -> None:
+        """Clear the directory + counter (link from decision on epoch end)."""
+        self.saved_count = 0
+        if os.path.isdir(self.out_dir):
+            shutil.rmtree(self.out_dir)
+
+    @staticmethod
+    def _to_image(sample: numpy.ndarray) -> numpy.ndarray:
+        img = numpy.asarray(sample, dtype=numpy.float32)
+        if img.ndim == 1:           # flat: try square
+            side = int(round(img.shape[0] ** 0.5))
+            if side * side == img.shape[0]:
+                img = img.reshape(side, side)
+            else:
+                img = img[None, :]
+        lo, hi = float(img.min()), float(img.max())
+        scaled = (img - lo) / (hi - lo) if hi > lo else img * 0
+        return (scaled * 255).astype(numpy.uint8)
+
+    def run(self) -> None:
+        if self.saved_count >= self.limit:
+            return
+        data = self._read(self.input)
+        labels = self._read(self.labels).astype(int)
+        out = self._read(self.output)
+        preds = (out.argmax(axis=1) if out.ndim > 1
+                 else out.astype(int))
+        n = min(len(data), len(labels), len(preds))
+        for i in range(n):
+            if self.saved_count >= self.limit:
+                break
+            truth, pred = int(labels[i]), int(preds[i])
+            if self.only_wrong and truth == pred:
+                continue
+            sub = os.path.join(self.out_dir, str(truth))
+            os.makedirs(sub, exist_ok=True)
+            fname = "%05d_truth%d_pred%d.png" % (self.saved_count, truth,
+                                                 pred)
+            self._write_png(self._to_image(data[i]),
+                            os.path.join(sub, fname))
+            self.saved_count += 1
+
+    @staticmethod
+    def _read(arr):
+        return numpy.asarray(arr.map_read() if hasattr(arr, "map_read")
+                             else arr)
+
+    @staticmethod
+    def _write_png(img: numpy.ndarray, path: str) -> None:
+        from PIL import Image
+        Image.fromarray(img).save(path)
+
+    def get_metric_values(self):
+        return {"images_saved": self.saved_count} if self.saved_count \
+            else {}

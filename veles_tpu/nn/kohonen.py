@@ -1,0 +1,193 @@
+"""Kohonen self-organizing map: forward (winner lookup) + trainer.
+
+Equivalent of Znicz ``kohonen`` (reference surface: SURVEY.md §2.8;
+docs/source/manualrst_veles_algorithms.rst:72-117 lists Kohonen with
+OpenCL+numpy backends). TPU-first formulation: the whole batch-SOM update
+is one pure function — pairwise distances ride the MXU as a GEMM
+(``x·Wᵀ`` expansion of ‖x−w‖²), the winner argmin / Gaussian neighborhood
+/ weight pull are fused elementwise XLA ops — instead of the reference's
+per-sample winner search kernels.
+
+The classic SOM trains by per-sample sequential pulls; the batch variant
+computed here (neighborhood-weighted mean pull per minibatch) is the
+standard data-parallel formulation and is what makes the unit shardable
+over the ``data`` mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy
+
+from ..config import root
+from ..memory import Array
+from .. import prng
+from .nn_units import ForwardBase
+
+
+def _grid_coords(sy: int, sx: int) -> numpy.ndarray:
+    yy, xx = numpy.mgrid[0:sy, 0:sx]
+    return numpy.stack([yy.ravel(), xx.ravel()], axis=1).astype("float32")
+
+
+def _pairwise_sqdist(x, w, np_mod):
+    """‖x−w‖² per (sample, neuron) via the GEMM expansion."""
+    x2 = (x * x).sum(axis=1)[:, None]
+    w2 = (w * w).sum(axis=1)[None, :]
+    return x2 - 2.0 * (x @ w.T) + w2
+
+
+def som_step(weights, grid, x, lr, sigma, np_mod=numpy):
+    """One batch-SOM update; pure in both numpy and jax.numpy.
+
+    Returns (new_weights, winners, quantization_error)."""
+    d2 = _pairwise_sqdist(x, weights, np_mod)
+    winners = np_mod.argmin(d2, axis=1)
+    qerr = np_mod.sqrt(np_mod.maximum(
+        d2[np_mod.arange(x.shape[0]), winners], 0.0)).mean()
+    # neighborhood over the 2-D grid: h[i, j] = exp(-‖g_win(i) − g_j‖²/2σ²)
+    gwin = grid[winners]                          # (batch, 2)
+    gd2 = ((gwin[:, None, :] - grid[None, :, :]) ** 2).sum(axis=2)
+    h = np_mod.exp(-gd2 / (2.0 * sigma * sigma))  # (batch, neurons)
+    # neighborhood-weighted mean pull toward the batch
+    num = h.T @ x                                 # (neurons, features)
+    den = h.sum(axis=0)[:, None]                  # (neurons, 1)
+    target = num / np_mod.maximum(den, 1e-12)
+    new_w = weights + lr * np_mod.minimum(den, 1.0) * (target - weights)
+    return new_w, winners.astype("int32"), qerr
+
+
+class KohonenForward(ForwardBase):
+    """Maps each sample to its best-matching unit index
+    (Znicz ``kohonen.KohonenForward``)."""
+
+    MAPPING = "kohonen_forward"
+    PARAMETERIZED = True
+    hide_from_registry = False
+    PARAM_NAMES = ("weights",)
+
+    def __init__(self, workflow, shape: Tuple[int, int] = (8, 8),
+                 **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.shape = tuple(shape)
+        self.weights_stddev = kwargs.get("weights_stddev", 0.05)
+
+    @property
+    def neurons_number(self) -> int:
+        return self.shape[0] * self.shape[1]
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0],)
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        n_features = int(numpy.prod(self.input.shape[1:]))
+        w = rng.normal(0.0, self.weights_stddev,
+                       (self.neurons_number, n_features)).astype("float32")
+        return {"weights": Array(w, name=self.name + ".weights")}
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax.numpy as jnp
+        x = x.reshape(x.shape[0], -1)
+        d2 = _pairwise_sqdist(x, params["weights"], jnp)
+        return jnp.argmin(d2, axis=1).astype(jnp.int32)
+
+    def numpy_apply(self, params, x):
+        x = numpy.asarray(x, dtype=numpy.float32).reshape(x.shape[0], -1)
+        d2 = _pairwise_sqdist(x, params["weights"], numpy)
+        return numpy.argmin(d2, axis=1).astype(numpy.int32)
+
+    def initialize(self, device=None, **kwargs):
+        res = super().initialize(device=device, **kwargs)
+        if res:
+            return res
+        # winner indices are int32, not the float minibatch dtype
+        if self.input is not None and self.input:
+            self.output.reset(numpy.zeros(self.input.shape[0],
+                                          dtype=numpy.int32))
+        return None
+
+
+class KohonenTrainer(ForwardBase):
+    """Batch-SOM trainer with exponentially decaying radius and rate
+    (Znicz ``kohonen.KohonenTrainer``). Owns the weights; a
+    KohonenForward can link_attrs to them for inference."""
+
+    MAPPING = "kohonen_trainer"
+    PARAMETERIZED = True
+    hide_from_registry = False
+    PARAM_NAMES = ("weights",)
+
+    def __init__(self, workflow, shape: Tuple[int, int] = (8, 8),
+                 sigma0: Optional[float] = None, lr0: float = 0.5,
+                 decay: float = 200.0, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.shape = tuple(shape)
+        self.sigma0 = float(sigma0 if sigma0 is not None
+                            else max(self.shape) / 2.0)
+        self.lr0 = float(lr0)
+        self.decay = float(decay)
+        self.time = 0
+        self.weights_stddev = kwargs.get("weights_stddev", 0.05)
+        self.grid = _grid_coords(*self.shape)
+        #: last winner per sample + quantization error (metrics surface)
+        self.winners: Optional[numpy.ndarray] = None
+        self.quantization_error = float("nan")
+
+    neurons_number = KohonenForward.neurons_number
+    create_params = KohonenForward.create_params
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0],)
+
+    def schedule(self) -> Tuple[float, float]:
+        t = float(self.time)
+        factor = numpy.exp(-t / self.decay)
+        return (max(self.lr0 * factor, 1e-4),
+                max(self.sigma0 * factor, 0.35))
+
+    # -- one training step ---------------------------------------------------
+    def xla_run(self) -> None:
+        import jax.numpy as jnp
+        lr, sigma = self.schedule()
+
+        def step(w, g, x, lr_, sig_):
+            x = x.reshape(x.shape[0], -1)
+            return som_step(w, g, x, lr_, sig_, jnp)
+
+        fn = self.jit("som_step", step)
+        w, winners, qerr = fn(self.weights.device_view(),
+                              self.grid, self.input.device_view(),
+                              lr, sigma)
+        self.weights.assign_devmem(w)
+        self.winners = numpy.asarray(winners)
+        self.quantization_error = float(qerr)
+        self.time += 1
+
+    def numpy_run(self) -> None:
+        lr, sigma = self.schedule()
+        x = self.input.map_read().reshape(self.input.shape[0], -1)
+        w, winners, qerr = som_step(
+            self.weights.map_read().astype(numpy.float32), self.grid,
+            numpy.asarray(x, dtype=numpy.float32), lr, sigma, numpy)
+        self.weights.reset(w)
+        self.winners = winners
+        self.quantization_error = float(qerr)
+        self.time += 1
+
+    def get_metric_values(self) -> Dict[str, Any]:
+        return {"som_qerr": self.quantization_error,
+                "som_steps": self.time}
+
+    # trainer state beyond params: the decay clock
+    def state_dict(self):
+        sd = super().state_dict()
+        sd["__time__"] = numpy.int64(self.time)
+        return sd
+
+    def load_state_dict(self, sd):
+        sd = dict(sd)
+        t = sd.pop("__time__", None)
+        if t is not None:
+            self.time = int(t)
+        super().load_state_dict(sd)

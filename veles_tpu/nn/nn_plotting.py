@@ -1,0 +1,80 @@
+"""NN-specific plotting units.
+
+Equivalent of Znicz ``nn_plotting_units`` (reference surface: SURVEY.md
+§2.8): weight-matrix image grids and Kohonen map views, built on the
+declarative snapshot plotters (veles_tpu/plotting_units.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy
+
+from ..plotting_units import ImagePlotter, MatrixPlotter
+
+
+class Weights2D(ImagePlotter):
+    """Each output neuron's incoming weights rendered as a tile
+    (Znicz ``nn_plotting_units.Weights2D``)."""
+
+    MAPPING = "weights_2d_plotter"
+    hide_from_registry = False
+
+    def __init__(self, workflow, unit=None, param: str = "weights",
+                 **kwargs) -> None:
+        kwargs.setdefault("max_images", 25)
+        super().__init__(workflow, **kwargs)
+        self.unit = unit
+        self.param = param
+
+    def fill_snapshot(self) -> Optional[Dict[str, Any]]:
+        target = self.unit
+        if target is None:
+            return None
+        step = getattr(target.workflow, "train_step", None)
+        if step is not None and getattr(step, "params", None) and \
+                target.name in step.params and \
+                self.param in step.params[target.name]:
+            w = numpy.asarray(step.params[target.name][self.param],
+                              dtype=numpy.float32)
+        else:
+            arr = getattr(target, self.param, None)
+            if arr is None or not arr:
+                return None
+            w = numpy.asarray(arr.map_read(), dtype=numpy.float32)
+        # (in_features, out_neurons) → one tile per neuron
+        tiles = w.T[:self.max_images]
+        side = int(round(tiles.shape[1] ** 0.5))
+        if side * side == tiles.shape[1]:
+            tiles = tiles.reshape(-1, side, side)
+        else:
+            tiles = tiles[:, None, :]
+        return {"images": numpy.stack(
+            [self.normalize(t) for t in tiles])}
+
+
+class KohonenHits(MatrixPlotter):
+    """Winner-count heatmap over the SOM grid
+    (Znicz ``nn_plotting_units.KohonenHits``)."""
+
+    MAPPING = "kohonen_hits_plotter"
+    hide_from_registry = False
+
+    def __init__(self, workflow, trainer=None, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.trainer = trainer
+        self._hits: Optional[numpy.ndarray] = None
+
+    def fill_snapshot(self) -> Optional[Dict[str, Any]]:
+        t = self.trainer
+        if t is None or t.winners is None:
+            return None
+        sy, sx = t.shape
+        if self._hits is None:
+            self._hits = numpy.zeros((sy, sx), dtype=numpy.int64)
+        counts = numpy.bincount(t.winners, minlength=sy * sx)
+        self._hits += counts.reshape(sy, sx)
+        return {"matrix": self._hits.astype(numpy.float64),
+                "row_labels": [str(i) for i in range(sy)],
+                "column_labels": [str(i) for i in range(sx)]}

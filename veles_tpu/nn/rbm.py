@@ -1,0 +1,144 @@
+"""Restricted Boltzmann Machine: forward + CD-1 trainer.
+
+Equivalent of Znicz ``rbm`` (reference surface: SURVEY.md §2.8;
+docs/source/manualrst_veles_algorithms.rst lists RBM with a numpy
+backend only — here the jitted XLA path is primary and numpy stays the
+oracle). Bernoulli–Bernoulli RBM, contrastive divergence with one Gibbs
+step (Hinton's CD-1): both GEMMs of the positive/negative phase ride the
+MXU.
+
+Determinism design: the sampling uniforms are an explicit *input* of the
+pure update function, so the jitted path and the numpy oracle can be fed
+identical noise and agree bit-for-bit-ish (same reduction order caveats)
+— the "numpy is the oracle" testing property survives stochastic units.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import numpy
+
+from ..memory import Array
+from .. import prng
+from .nn_units import ForwardBase
+
+
+def _sigmoid(z, np_mod):
+    return 1.0 / (1.0 + np_mod.exp(-z))
+
+
+def cd1_step(params, v0, u_h0, lr, np_mod=numpy):
+    """One CD-1 update from visible batch ``v0`` with sampling uniforms
+    ``u_h0``; returns (new_params, reconstruction_error)."""
+    w, vb, hb = params["weights"], params["vbias"], params["hbias"]
+    h0_prob = _sigmoid(v0 @ w + hb, np_mod)
+    h0 = (u_h0 < h0_prob).astype(v0.dtype)
+    v1_prob = _sigmoid(h0 @ w.T + vb, np_mod)
+    h1_prob = _sigmoid(v1_prob @ w + hb, np_mod)
+    n = v0.shape[0]
+    dw = (v0.T @ h0_prob - v1_prob.T @ h1_prob) / n
+    dvb = (v0 - v1_prob).mean(axis=0)
+    dhb = (h0_prob - h1_prob).mean(axis=0)
+    new = {"weights": w + lr * dw, "vbias": vb + lr * dvb,
+           "hbias": hb + lr * dhb}
+    err = ((v0 - v1_prob) ** 2).mean()
+    return new, err
+
+
+class RBM(ForwardBase):
+    """Forward: hidden unit probabilities ``sigmoid(x·W + hbias)``."""
+
+    MAPPING = "rbm"
+    PARAMETERIZED = True
+    hide_from_registry = False
+    PARAM_NAMES = ("weights", "vbias", "hbias")
+
+    def __init__(self, workflow, n_hidden: int = 64, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_hidden = int(n_hidden)
+        self.weights_stddev = kwargs.get("weights_stddev", 0.01)
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0], self.n_hidden)
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        n_vis = int(numpy.prod(self.input.shape[1:]))
+        return {
+            "weights": Array(rng.normal(
+                0.0, self.weights_stddev,
+                (n_vis, self.n_hidden)).astype("float32"),
+                name=self.name + ".weights"),
+            "vbias": Array(numpy.zeros(n_vis, dtype="float32"),
+                           name=self.name + ".vbias"),
+            "hbias": Array(numpy.zeros(self.n_hidden, dtype="float32"),
+                           name=self.name + ".hbias"),
+        }
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax.numpy as jnp
+        x = x.reshape(x.shape[0], -1)
+        return _sigmoid(x @ params["weights"] + params["hbias"], jnp)
+
+    def numpy_apply(self, params, x):
+        x = numpy.asarray(x, dtype=numpy.float32).reshape(x.shape[0], -1)
+        return _sigmoid(x @ params["weights"] + params["hbias"], numpy)
+
+    def reconstruct_np(self, params, x):
+        """v → h_prob → v̂ (deterministic mean-field reconstruction)."""
+        h = self.numpy_apply(params, x)
+        return _sigmoid(h @ params["weights"].T + params["vbias"], numpy)
+
+
+class RBMTrainer(RBM):
+    """CD-1 trainer owning the RBM parameters
+    (Znicz ``rbm`` gradient units)."""
+
+    MAPPING = "rbm_trainer"
+    hide_from_registry = False
+
+    def __init__(self, workflow, n_hidden: int = 64,
+                 learning_rate: float = 0.1, **kwargs) -> None:
+        super().__init__(workflow, n_hidden=n_hidden, **kwargs)
+        self.learning_rate = float(learning_rate)
+        self.reconstruction_error = float("nan")
+        self.steps = 0
+        self._rng = prng.get(self.name)
+
+    # -- one CD-1 step -------------------------------------------------------
+    def xla_run(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        def step(p, v0, u, lr):
+            v0 = v0.reshape(v0.shape[0], -1)
+            return cd1_step(p, v0, u, lr, jnp)
+
+        fn = self.jit("cd1", step)
+        params = {k: v.device_view()
+                  for k, v in self.param_arrays().items()}
+        u = jax.random.uniform(
+            self._rng.jax_key(),
+            (self.input.shape[0], self.n_hidden), dtype=jnp.float32)
+        new, err = fn(params, self.input.device_view(), u,
+                      self.learning_rate)
+        for k, arr in self.param_arrays().items():
+            arr.assign_devmem(new[k])
+        self.reconstruction_error = float(err)
+        self.steps += 1
+
+    def numpy_run(self) -> None:
+        v0 = numpy.asarray(self.input.map_read(),
+                           dtype=numpy.float32)
+        v0 = v0.reshape(v0.shape[0], -1)
+        u = self._rng.rand(v0.shape[0], self.n_hidden).astype("float32")
+        new, err = cd1_step(self.params_np(), v0, u,
+                            self.learning_rate, numpy)
+        for k, arr in self.param_arrays().items():
+            arr.reset(new[k].astype("float32"))
+        self.reconstruction_error = float(err)
+        self.steps += 1
+
+    def get_metric_values(self) -> Dict[str, Any]:
+        return {"rbm_reconstruction_error": self.reconstruction_error,
+                "rbm_steps": self.steps}
