@@ -1,0 +1,137 @@
+"""Forge model hub (reference: veles/tests/test_forge_client.py,
+test_forge_server.py — real in-process server, no transport mocks)."""
+import json
+import os
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.error import VelesError
+from veles_tpu import forge
+
+
+def make_src(tmp_path, content=b"weights"):
+    src = tmp_path / "src"
+    src.mkdir(exist_ok=True)
+    (src / "model.npy").write_bytes(content)
+    (src / "workflow.py").write_text("# model source\n")
+    return str(src)
+
+
+def manifest(**over):
+    m = {"name": "mnist-fc", "version": "1.0", "author": "test",
+         "description": "MNIST 784-100-10"}
+    m.update(over)
+    return m
+
+
+def test_pack_and_read_manifest(tmp_path):
+    pkg = forge.make_package(make_src(tmp_path), manifest(),
+                             str(tmp_path / "p.tar.gz"))
+    m = forge.read_package_manifest(pkg)
+    assert m["name"] == "mnist-fc"
+    dest = tmp_path / "out"
+    forge.extract_package(pkg, str(dest))
+    assert (dest / "model.npy").read_bytes() == b"weights"
+    assert (dest / "workflow.py").exists()
+
+
+def test_manifest_validation(tmp_path):
+    with pytest.raises(VelesError):
+        forge.make_package(make_src(tmp_path), manifest(name=""))
+    with pytest.raises(VelesError):
+        forge.make_package(make_src(tmp_path),
+                           manifest(name="../escape"))
+
+
+def test_server_roundtrip(tmp_path):
+    server = forge.ForgeServer(str(tmp_path / "store"), port=0,
+                               upload_tokens=["sekrit"]).start()
+    client = forge.ForgeClient("http://127.0.0.1:%d" % server.port)
+    pkg = forge.make_package(make_src(tmp_path), manifest(),
+                             str(tmp_path / "p.tar.gz"))
+    # bad token rejected
+    with pytest.raises(VelesError):
+        client.upload(pkg, token="wrong")
+    out = client.upload(pkg, token="sekrit")
+    assert out == {"ok": True, "name": "mnist-fc", "version": "1.0"}
+    # second version
+    pkg2 = forge.make_package(make_src(tmp_path, b"w2"),
+                              manifest(version="1.1"),
+                              str(tmp_path / "p2.tar.gz"))
+    client.upload(pkg2, token="sekrit")
+    lst = client.list()
+    assert len(lst) == 1 and lst[0]["versions"] == ["1.0", "1.1"]
+    det = client.details("mnist-fc")
+    assert det["version"] == "1.1"      # latest
+    dest = tmp_path / "fetched"
+    m = client.fetch("mnist-fc", str(dest))
+    assert m["version"] == "1.1"
+    assert (dest / "model.npy").read_bytes() == b"w2"
+    m = client.fetch("mnist-fc", str(tmp_path / "f10"), version="1.0")
+    assert m["version"] == "1.0"
+    server.stop()
+
+
+def test_server_rejects_garbage_and_unknown(tmp_path):
+    server = forge.ForgeServer(str(tmp_path / "store"), port=0).start()
+    client = forge.ForgeClient("http://127.0.0.1:%d" % server.port)
+    assert client.list() == []
+    with pytest.raises(Exception):
+        client.details("nope")
+    with pytest.raises(Exception):
+        client.fetch("nope", str(tmp_path / "x"))
+    # garbage upload (no token list → open upload)
+    import urllib.request
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d/upload" % server.port, data=b"not a tarball")
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(req, timeout=10)
+    server.stop()
+
+
+def test_forge_roundtrip_of_exported_workflow(tmp_path):
+    """The canonical flow: package_export → forge upload → fetch →
+    run_package gives identical outputs."""
+    from veles_tpu import nn
+    from veles_tpu.export.package import package_export, run_package
+    wf = vt.Workflow(name="exp")
+    f1 = nn.All2AllTanh(wf, output_sample_shape=6, name="fc1")
+    x = numpy.random.RandomState(0).rand(3, 5).astype(numpy.float32)
+    f1.input = vt.Array(x)
+    f2 = nn.All2AllSoftmax(wf, output_sample_shape=4, name="fc2")
+    dev = vt.XLADevice(mesh_axes={"data": 1})
+    f1.initialize(device=dev)
+    f2.input = vt.Array(f1.numpy_apply(f1.params_np(), x))
+    f2.initialize(device=dev)
+    wf.forwards = [f1, f2]
+    pkg_dir = str(tmp_path / "pkg")
+    package_export(wf, pkg_dir, with_stablehlo=False)
+    expected = run_package(pkg_dir, x)
+
+    pkg = forge.make_package(pkg_dir, manifest(name="exp"),
+                             str(tmp_path / "exp.tar.gz"))
+    server = forge.ForgeServer(str(tmp_path / "store"), port=0).start()
+    client = forge.ForgeClient("http://127.0.0.1:%d" % server.port)
+    client.upload(pkg)
+    dest = str(tmp_path / "fetched")
+    client.fetch("exp", dest)
+    got = run_package(dest, x)
+    numpy.testing.assert_allclose(got, expected, rtol=1e-6)
+    server.stop()
+
+
+def test_version_ordering(tmp_path):
+    """1.10 beats 1.9 (lexicographic sort would invert this)."""
+    server = forge.ForgeServer(str(tmp_path / "store"), port=0).start()
+    client = forge.ForgeClient("http://127.0.0.1:%d" % server.port)
+    for v in ("1.9", "1.10", "1.2"):
+        client.upload(forge.make_package(
+            make_src(tmp_path, v.encode()), manifest(version=v),
+            str(tmp_path / ("p%s.tar.gz" % v))))
+    assert client.details("mnist-fc")["version"] == "1.10"
+    assert client.list()[0]["versions"] == ["1.2", "1.9", "1.10"]
+    m = client.fetch("mnist-fc", str(tmp_path / "latest"))
+    assert m["version"] == "1.10"
+    server.stop()

@@ -126,3 +126,13 @@ def test_graphics_client_subprocess(plotting_enabled, tmp_path):
         time.sleep(0.2)
     server.shutdown()
     assert png.exists() and png.stat().st_size > 0
+
+
+def test_image_plotter_non_square_flat(plotting_enabled, tmp_path):
+    """Non-square flat samples render as 1-pixel-high strips, not crash."""
+    wf = vt.Workflow(name="t")
+    imgs = numpy.random.RandomState(0).rand(3, 10)   # 10 is not square
+    p = vt.ImagePlotter(wf, input=lambda: imgs, redraw_interval=0.0)
+    p.run()
+    assert p.last_snapshot["images"].shape == (3, 1, 10)
+    graphics.render_snapshot(p.last_snapshot, str(tmp_path / "strip.png"))

@@ -113,3 +113,18 @@ def test_launcher_payload_shape():
     launcher.workflow = wf
     payload = launcher._status_payload()
     assert payload["name"] == "w" and "elapsed_sec" in payload
+
+
+def test_non_object_json_bodies_get_400():
+    """Valid-JSON non-dict bodies must 400, not kill the handler thread."""
+    server = WebStatusServer(port=0).start()
+    status, _ = _post("http://127.0.0.1:%d/update" % server.port, [1, 2],
+                      timeout=5)
+    assert status == 400
+    server.stop()
+    wf, loader, fwd, api = build_serving_workflow()
+    status, _ = _post("http://127.0.0.1:%d/api" % api.port, "just a string",
+                      timeout=5)
+    assert status == 400
+    loader.close()
+    api.stop()

@@ -1,0 +1,173 @@
+"""Operator tooling: interaction shell, compare_snapshots,
+generate_frontend, sound loader, numpy JSON encoder (reference:
+veles/scripts/, veles/interaction.py, veles/tests/test_snd_file_loader.py)."""
+import json
+import os
+import wave
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.json_encoders import NumpyJSONEncoder, dumps
+from veles_tpu.loader.sound import SoundFileLoader, decode_audio
+from veles_tpu.scripts import compare_snapshots, generate_frontend
+
+
+# -- interaction shell -------------------------------------------------------
+
+class RecordingShell(vt.Shell):
+    hide_from_registry = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.opened = []
+
+    def open_console(self, ns, banner):
+        self.opened.append((sorted(ns), banner))
+
+
+def test_shell_activation_paths(tmp_path):
+    wf = vt.Workflow(name="t")
+    shell = RecordingShell(wf, name="shell")
+    shell.run()
+    assert not shell.opened                 # idle by default
+    shell.activate()
+    shell.run()
+    assert len(shell.opened) == 1
+    names, banner = shell.opened[0]
+    assert "workflow" in names and "np" in names
+    shell.run()
+    assert len(shell.opened) == 1           # one-shot
+    trigger = tmp_path / "poke"
+    shell.trigger_file = str(trigger)
+    trigger.touch()
+    shell.run()
+    assert len(shell.opened) == 2
+    assert not trigger.exists()             # consumed
+
+
+def test_shell_every_n():
+    wf = vt.Workflow(name="t")
+    shell = RecordingShell(wf, every=3)
+    for _ in range(7):
+        shell.process()                     # increments run_count after run
+    assert len(shell.opened) == 2           # at run_count 3 and 6
+
+
+def test_shell_namespace_has_units():
+    wf = vt.Workflow(name="t")
+    vt.TrivialUnit(wf, name="my unit")
+    shell = RecordingShell(wf)
+    ns = shell.namespace()
+    assert ns["my_unit"] is wf["my unit"]
+
+
+# -- compare_snapshots -------------------------------------------------------
+
+def test_compare_snapshots_logic():
+    a = {"__units__": {"fc": {"weights": numpy.ones((2, 2)),
+                              "bias": numpy.zeros(2)}},
+         "__meta__": {"checksum": "abc"}}
+    b = {"__units__": {"fc": {"weights": numpy.ones((2, 2)) + 1e-9,
+                              "bias": numpy.zeros(3)}},
+         "__meta__": {"checksum": "xyz"}}
+    rows = {r["path"]: r for r in compare_snapshots.compare(a, b)}
+    assert rows["/__units__/fc/weights"]["status"] == "close"
+    assert rows["/__units__/fc/bias"]["status"] == "shape"
+    assert rows["/__meta__/checksum"]["status"] == "differs"
+
+
+def test_compare_snapshots_cli(tmp_path):
+    """End to end over real snapshot files."""
+    from veles_tpu.snapshotter import Snapshotter
+    from veles_tpu import nn
+    from veles_tpu.memory import Array
+
+    def make(seed, directory):
+        wf = vt.Workflow(name="w")
+        fc = nn.All2All(wf, output_sample_shape=3, name="fc")
+        rng = numpy.random.RandomState(seed)
+        fc.input = Array(rng.rand(4, 5).astype(numpy.float32))
+        fc.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        snap = Snapshotter(wf, prefix="s", directory=str(directory),
+                           interval=1)
+        snap.run()
+        return snap.destination
+
+    pa = make(0, tmp_path / "a")
+    pb = make(1, tmp_path / "b")
+    assert compare_snapshots.main([pa, pb]) == 1        # differ
+    assert compare_snapshots.main([pa, pa]) == 0        # identical
+
+
+# -- generate_frontend -------------------------------------------------------
+
+def test_generate_frontend(tmp_path):
+    out = str(tmp_path / "frontend.html")
+    assert generate_frontend.main(["-o", out]) == 0
+    page = open(out).read()
+    assert "--backend" in page and "--mesh" in page
+    assert "command composer" in page.lower()
+
+
+# -- sound loader ------------------------------------------------------------
+
+def make_wav(path, seconds=0.5, rate=8000, freq=440.0):
+    t = numpy.arange(int(seconds * rate)) / rate
+    samples = (numpy.sin(2 * numpy.pi * freq * t) * 32000).astype("<i2")
+    with wave.open(str(path), "wb") as f:
+        f.setnchannels(1)
+        f.setsampwidth(2)
+        f.setframerate(rate)
+        f.writeframes(samples.tobytes())
+
+
+def test_decode_audio_wav(tmp_path):
+    p = tmp_path / "tone.wav"
+    make_wav(p)
+    data, rate = decode_audio(str(p))
+    assert rate == 8000 and data.shape == (4000, 1)
+    assert abs(data).max() <= 1.0
+    # dominant frequency ≈ 440 Hz
+    spec = numpy.abs(numpy.fft.rfft(data[:, 0]))
+    peak_hz = spec.argmax() * rate / len(data)
+    assert abs(peak_hz - 440.0) < 5
+
+
+def test_sound_file_loader(tmp_path):
+    wavs = []
+    for i, freq in enumerate((220.0, 880.0)):
+        p = tmp_path / ("f%d.wav" % i)
+        make_wav(p, freq=freq)
+        wavs.append(str(p))
+    loader = SoundFileLoader(None, files=wavs, labels=[0, 1],
+                             window=256, stride=256, minibatch_size=16)
+    loader.load_data()
+    n = loader.total_samples
+    assert n > 0
+    assert loader.original_data.shape == (n, 256)
+    assert set(numpy.unique(loader.original_labels)) == {0, 1}
+    assert loader.class_lengths[1] > 0          # validation split present
+    assert loader.sample_rate == 8000
+
+
+# -- JSON encoder ------------------------------------------------------------
+
+def test_numpy_json_encoder():
+    blob = dumps({"a": numpy.float32(1.5), "b": numpy.arange(3),
+                  "c": numpy.bool_(True), "d": {numpy.int64(3)},
+                  "e": b"bytes"})
+    back = json.loads(blob)
+    assert back == {"a": 1.5, "b": [0, 1, 2], "c": True, "d": [3],
+                    "e": "bytes"}
+    assert json.loads(json.dumps({"x": numpy.int32(7)},
+                                 cls=NumpyJSONEncoder)) == {"x": 7}
+
+
+def test_compare_snapshots_missing_unit_fails():
+    """Structural asymmetry (only_a/only_b) must exit nonzero."""
+    a = {"__units__": {"fc": {"w": numpy.ones(2)}}}
+    b = {"__units__": {}}
+    rows = compare_snapshots.compare(a, b)
+    assert any(r["status"] == "only_a" for r in rows)

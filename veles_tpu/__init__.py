@@ -36,3 +36,5 @@ from .plotting_units import (AccumulatingPlotter, MatrixPlotter,
                              TableMaxMin, StepStats)  # noqa: F401
 from .restful_api import RESTfulAPI                   # noqa: F401
 from .publishing import Publisher                     # noqa: F401
+from .interaction import Shell                        # noqa: F401
+from .json_encoders import NumpyJSONEncoder           # noqa: F401

@@ -1,0 +1,61 @@
+"""Shared scaffolding for the stdlib-HTTP services (web status, REST
+serving, forge). One place for the JSON reply helper and the
+daemon-thread serve/shutdown lifecycle."""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+
+def json_reply(handler, code: int, payload: Any) -> None:
+    data = json.dumps(payload).encode()
+    bytes_reply(handler, code, data, "application/json")
+
+
+def bytes_reply(handler, code: int, data: bytes, ctype: str) -> None:
+    handler.send_response(code)
+    handler.send_header("Content-Type", ctype)
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    handler.wfile.write(data)
+
+
+def read_json_object(handler) -> Dict[str, Any]:
+    """Parse the request body as a JSON *object*; raises ValueError on
+    malformed JSON and on valid-JSON non-objects (lists, strings, …) so
+    one `except ValueError` covers every bad body."""
+    length = int(handler.headers.get("Content-Length", 0))
+    body = json.loads(handler.rfile.read(length) or b"{}")
+    if not isinstance(body, dict):
+        raise ValueError("JSON object expected, got %s" %
+                         type(body).__name__)
+    return body
+
+
+class HTTPService:
+    """Owns a ThreadingHTTPServer + daemon thread (start/stop lifecycle
+    shared by WebStatusServer / ForgeServer / RESTfulAPI)."""
+
+    def __init__(self, handler_cls, port: int = 0,
+                 thread_name: str = "http") -> None:
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler_cls)
+        self.port = self._httpd.server_port
+        self._thread: Optional[threading.Thread] = None
+        self._thread_name = thread_name
+
+    def start_serving(self) -> None:
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True,
+                                        name=self._thread_name)
+        self._thread.start()
+
+    def stop_serving(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -1,0 +1,365 @@
+"""Forge: the model hub — publish, list and fetch workflow packages.
+
+Equivalent of the reference's veles/forge/forge_client.py:91 +
+veles/forge/forge_server.py:462 (tornado service exchanging
+manifest.json + tarball packages, token-authenticated uploads) and
+veles/forge_common.py (package/manifest validation). Stdlib http.server
+replaces tornado; the e-mail/registration machinery of the reference is
+out of scope (tokens are provisioned by the operator instead).
+
+A forge package is a ``.tar.gz`` whose root holds ``manifest.json``::
+
+    {"name": ..., "version": ..., "author": ..., "description": ...,
+     "workflow": <entry file or exported package member>}
+
+plus the payload — typically a veles_tpu ``package_export`` directory
+(contents.json + .npy weights + optional StableHLO) and/or the model's
+.py source.
+
+Endpoints (mirroring the reference's service/fetch/upload URL surface):
+    GET  /service?query=list                → JSON manifest summaries
+    GET  /service?query=details&name=N      → full manifest
+    GET  /fetch?name=N[&version=V]          → package tarball
+    POST /upload?token=T                    → body is the tarball
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import re
+import shutil
+import tarfile
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Dict, List, Optional, Tuple
+
+from ._http import HTTPService, bytes_reply, json_reply
+from .error import VelesError
+from .logger import Logger
+
+MANIFEST = "manifest.json"
+REQUIRED_KEYS = ("name", "version", "author", "description")
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]*$")
+
+
+def version_key(version: str) -> Tuple:
+    """Order versions numerically where possible: 1.10 > 1.9, 10.0 > 2.0
+    (plain lexicographic sort gets these wrong)."""
+    parts = []
+    for piece in re.split(r"[._-]", str(version)):
+        parts.append((0, int(piece)) if piece.isdigit() else (1, piece))
+    return tuple(parts)
+
+
+# ---------------------------------------------------------------------------
+# package helpers
+# ---------------------------------------------------------------------------
+
+def validate_manifest(manifest: Dict[str, Any]) -> None:
+    missing = [k for k in REQUIRED_KEYS if not manifest.get(k)]
+    if missing:
+        raise VelesError("manifest lacks %s" % ", ".join(missing))
+    for key in ("name", "version"):
+        if not _NAME_RE.match(str(manifest[key])):
+            raise VelesError("manifest %s %r must match %s" %
+                             (key, manifest[key], _NAME_RE.pattern))
+
+
+def make_package(src_dir: str, manifest: Dict[str, Any],
+                 out_path: Optional[str] = None) -> str:
+    """Bundle ``src_dir`` + manifest into ``<name>-<version>.tar.gz``."""
+    validate_manifest(manifest)
+    out_path = out_path or "%s-%s.tar.gz" % (manifest["name"],
+                                             manifest["version"])
+    with tarfile.open(out_path, "w:gz") as tar:
+        data = json.dumps(manifest, indent=2).encode()
+        info = tarfile.TarInfo(MANIFEST)
+        info.size = len(data)
+        tar.addfile(info, io.BytesIO(data))
+        for fname in sorted(os.listdir(src_dir)):
+            if fname == MANIFEST:
+                continue
+            tar.add(os.path.join(src_dir, fname), arcname=fname)
+    return out_path
+
+
+def read_package_manifest(path: str) -> Dict[str, Any]:
+    with tarfile.open(path, "r:gz") as tar:
+        member = tar.extractfile(MANIFEST)
+        if member is None:
+            raise VelesError("%s: no %s" % (path, MANIFEST))
+        manifest = json.load(member)
+    validate_manifest(manifest)
+    return manifest
+
+
+def extract_package(path: str, dest_dir: str) -> Dict[str, Any]:
+    manifest = read_package_manifest(path)
+    os.makedirs(dest_dir, exist_ok=True)
+    with tarfile.open(path, "r:gz") as tar:
+        tar.extractall(dest_dir, filter="data")   # refuses path escapes
+    return manifest
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class ForgeServer(Logger):
+    """Package registry (reference: veles/forge/forge_server.py:462).
+
+    Storage layout: ``<store>/<name>/<version>/package.tar.gz`` +
+    extracted ``manifest.json`` for cheap listing.
+    """
+
+    def __init__(self, store_dir: str, port: int = 0,
+                 upload_tokens: Optional[List[str]] = None) -> None:
+        super().__init__()
+        self.store_dir = store_dir
+        os.makedirs(store_dir, exist_ok=True)
+        self.upload_tokens = set(upload_tokens or ())
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                server.debug("http: " + fmt, *args)
+
+            def do_GET(self):
+                url = urllib.parse.urlparse(self.path)
+                query = urllib.parse.parse_qs(url.query)
+                if url.path == "/service":
+                    self._service(query)
+                elif url.path == "/fetch":
+                    self._fetch(query)
+                else:
+                    self.send_error(404)
+
+            def _service(self, query):
+                kind = query.get("query", [""])[0]
+                if kind == "list":
+                    json_reply(self, 200, server.list_packages())
+                elif kind == "details":
+                    name = query.get("name", [""])[0]
+                    try:
+                        json_reply(self, 200, server.details(name))
+                    except KeyError:
+                        json_reply(self, 404,
+                                   {"error": "unknown %r" % name})
+                else:
+                    json_reply(self, 400, {"error": "bad query %r" % kind})
+
+            def _fetch(self, query):
+                name = query.get("name", [""])[0]
+                version = query.get("version", [None])[0]
+                try:
+                    path = server.package_path(name, version)
+                except KeyError as e:
+                    json_reply(self, 404, {"error": str(e)})
+                    return
+                with open(path, "rb") as fin:
+                    data = fin.read()
+                bytes_reply(self, 200, data, "application/gzip")
+
+            def do_POST(self):
+                url = urllib.parse.urlparse(self.path)
+                if url.path != "/upload":
+                    self.send_error(404)
+                    return
+                query = urllib.parse.parse_qs(url.query)
+                token = query.get("token", [""])[0]
+                if server.upload_tokens and \
+                        token not in server.upload_tokens:
+                    json_reply(self, 403, {"error": "bad token"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                blob = self.rfile.read(length)
+                try:
+                    manifest = server.store(blob)
+                except VelesError as e:
+                    json_reply(self, 400, {"error": str(e)})
+                    return
+                json_reply(self, 200, {"ok": True,
+                                       "name": manifest["name"],
+                                       "version": manifest["version"]})
+
+        self._service = HTTPService(Handler, port, "forge")
+        self.port = self._service.port
+
+    # -- storage ------------------------------------------------------------
+    def list_packages(self) -> List[Dict[str, Any]]:
+        out = []
+        for name in sorted(os.listdir(self.store_dir)):
+            versions = sorted(os.listdir(
+                os.path.join(self.store_dir, name)), key=version_key)
+            if not versions:
+                continue
+            with open(os.path.join(self.store_dir, name, versions[-1],
+                                   MANIFEST)) as fin:
+                manifest = json.load(fin)
+            manifest["versions"] = versions
+            out.append(manifest)
+        return out
+
+    def details(self, name: str) -> Dict[str, Any]:
+        for entry in self.list_packages():
+            if entry["name"] == name:
+                return entry
+        raise KeyError(name)
+
+    def package_path(self, name: str, version: Optional[str]) -> str:
+        if not _NAME_RE.match(name or ""):
+            raise KeyError("bad name %r" % name)
+        base = os.path.join(self.store_dir, name)
+        if not os.path.isdir(base):
+            raise KeyError("unknown package %r" % name)
+        if version is None:
+            version = sorted(os.listdir(base), key=version_key)[-1]
+        elif not _NAME_RE.match(version):
+            raise KeyError("bad version %r" % version)
+        path = os.path.join(base, version, "package.tar.gz")
+        if not os.path.exists(path):
+            raise KeyError("no %s version %s" % (name, version))
+        return path
+
+    def store(self, blob: bytes) -> Dict[str, Any]:
+        import tempfile
+        with tempfile.NamedTemporaryFile(suffix=".tar.gz") as tmp:
+            tmp.write(blob)
+            tmp.flush()
+            try:
+                manifest = read_package_manifest(tmp.name)
+            except (tarfile.TarError, ValueError) as e:
+                raise VelesError("bad package: %s" % e)
+            dest = os.path.join(self.store_dir, manifest["name"],
+                                str(manifest["version"]))
+            os.makedirs(dest, exist_ok=True)
+            shutil.copy(tmp.name, os.path.join(dest, "package.tar.gz"))
+            with open(os.path.join(dest, MANIFEST), "w") as fout:
+                json.dump(manifest, fout, indent=2)
+        self.info("stored %s %s", manifest["name"], manifest["version"])
+        return manifest
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ForgeServer":
+        self._service.start_serving()
+        self.info("forge on http://127.0.0.1:%d/", self.port)
+        return self
+
+    def stop(self) -> None:
+        self._service.stop_serving()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class ForgeClient(Logger):
+    """Talks to a ForgeServer (reference: veles/forge/forge_client.py:91)."""
+
+    def __init__(self, base_url: str) -> None:
+        super().__init__()
+        self.base_url = base_url.rstrip("/")
+
+    def _get_json(self, path: str) -> Any:
+        with urllib.request.urlopen(self.base_url + path,
+                                    timeout=30) as resp:
+            return json.loads(resp.read())
+
+    def list(self) -> List[Dict[str, Any]]:
+        return self._get_json("/service?query=list")
+
+    def details(self, name: str) -> Dict[str, Any]:
+        return self._get_json("/service?query=details&name=" +
+                              urllib.parse.quote(name))
+
+    def fetch(self, name: str, dest_dir: str,
+              version: Optional[str] = None) -> Dict[str, Any]:
+        """Download and extract; returns the manifest."""
+        url = self.base_url + "/fetch?name=" + urllib.parse.quote(name)
+        if version:
+            url += "&version=" + urllib.parse.quote(version)
+        os.makedirs(dest_dir, exist_ok=True)
+        tar_path = os.path.join(dest_dir, name + ".tar.gz")
+        with urllib.request.urlopen(url, timeout=60) as resp, \
+                open(tar_path, "wb") as fout:
+            shutil.copyfileobj(resp, fout)
+        manifest = extract_package(tar_path, dest_dir)
+        os.unlink(tar_path)
+        self.info("fetched %s %s → %s", manifest["name"],
+                  manifest["version"], dest_dir)
+        return manifest
+
+    def upload(self, package_path: str, token: str = "") -> Dict[str, Any]:
+        read_package_manifest(package_path)      # validate before sending
+        with open(package_path, "rb") as fin:
+            blob = fin.read()
+        req = urllib.request.Request(
+            self.base_url + "/upload?token=" +
+            urllib.parse.quote(token), data=blob,
+            headers={"Content-Type": "application/gzip"})
+        try:
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            raise VelesError("upload rejected (%d): %s" %
+                             (e.code, e.read().decode(errors="replace")))
+
+
+def main(argv=None) -> int:
+    """``python -m veles_tpu.forge {serve,list,details,fetch,upload,pack}``
+    (reference CLI: velescli forge / veles/scripts/update_forge.py)."""
+    import argparse
+    parser = argparse.ArgumentParser(description=main.__doc__)
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    ps = sub.add_parser("serve")
+    ps.add_argument("store_dir")
+    ps.add_argument("--port", type=int, default=8070)
+    ps.add_argument("--token", action="append", default=[])
+    for name in ("list", "details", "fetch", "upload"):
+        p = sub.add_parser(name)
+        p.add_argument("--server", required=True)
+        if name in ("details", "fetch"):
+            p.add_argument("name")
+        if name == "fetch":
+            p.add_argument("--dest", default=".")
+            p.add_argument("--version", default=None)
+        if name == "upload":
+            p.add_argument("package")
+            p.add_argument("--token", default="")
+    pp = sub.add_parser("pack")
+    pp.add_argument("src_dir")
+    for key in REQUIRED_KEYS:
+        pp.add_argument("--" + key, required=True)
+    args = parser.parse_args(argv)
+    if args.cmd == "serve":
+        server = ForgeServer(args.store_dir, port=args.port,
+                             upload_tokens=args.token).start()
+        import time
+        try:
+            while True:
+                time.sleep(3600)
+        except KeyboardInterrupt:
+            server.stop()
+        return 0
+    if args.cmd == "pack":
+        manifest = {k: getattr(args, k) for k in REQUIRED_KEYS}
+        print(make_package(args.src_dir, manifest))
+        return 0
+    client = ForgeClient(args.server)
+    if args.cmd == "list":
+        print(json.dumps(client.list(), indent=2))
+    elif args.cmd == "details":
+        print(json.dumps(client.details(args.name), indent=2))
+    elif args.cmd == "fetch":
+        client.fetch(args.name, args.dest, args.version)
+    elif args.cmd == "upload":
+        print(json.dumps(client.upload(args.package, args.token)))
+    return 0
+
+
+if __name__ == "__main__":      # pragma: no cover
+    import sys
+    sys.exit(main())

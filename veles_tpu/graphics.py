@@ -33,6 +33,12 @@ from .plotter import PlotSink
 PROTOCOL = 4  # stable across supported interpreters
 
 
+def safe_name(name: str) -> str:
+    """Plot name → file-system-safe stem (one rule shared by the renderer
+    subprocess and the Publisher, so both write the same file names)."""
+    return "".join(c if c.isalnum() or c in "-_" else "_" for c in name)
+
+
 class GraphicsServer(PlotSink, Logger):
     """Publishes plot snapshots over ZeroMQ PUB and optionally owns a
     renderer subprocess (reference: veles/graphics_server.py:73,174-220)."""
@@ -275,8 +281,7 @@ def client_main(argv: Optional[List[str]] = None) -> int:
         snap = pickle.loads(sock.recv())
         if snap.get("kind") == "__stop__":
             break
-        name = "".join(c if c.isalnum() or c in "-_" else "_"
-                       for c in snap["name"])
+        name = safe_name(snap["name"])
         try:
             render_snapshot(snap, os.path.join(args.out, name + ".png"))
         except Exception as e:          # keep rendering subsequent plots

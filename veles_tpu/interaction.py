@@ -1,0 +1,88 @@
+"""Interaction: drop into a live shell inside a running workflow.
+
+Equivalent of the reference's veles/interaction.py:49 (``Shell`` unit: an
+embedded IPython console). Differences: the reference bound it
+to the 'i' hot-key through its thread-pool/manhole machinery; here
+activation is explicit — programmatic ``activate()``, a trigger file
+(``touch <path>`` from another terminal — the moral equivalent of the
+hot-key for a headless TPU job), or every N runs — because the scheduler
+is deterministic and single-threaded between steps, which is exactly when
+inspecting live state is safe.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from .units import Unit
+
+
+class Shell(Unit):
+    """Interactive inspection point.
+
+    Place anywhere in the loop (typically after the decision). When
+    triggered, opens IPython (if installed) or a stdlib ``code`` console
+    whose namespace holds the workflow, its units by name, and numpy.
+    """
+
+    MAPPING = "shell"
+    hide_from_registry = False
+
+    def __init__(self, workflow, trigger_file: Optional[str] = None,
+                 every: int = 0, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.view_group = "SERVICE"
+        self.trigger_file = trigger_file
+        self.every = int(every)
+        self._armed = False
+        self.sessions = 0
+
+    def activate(self) -> None:
+        """Arm the shell: the next ``run()`` opens it."""
+        self._armed = True
+
+    def _should_open(self) -> bool:
+        if self._armed:
+            return True
+        if self.every and self.run_count and \
+                self.run_count % self.every == 0:
+            return True
+        if self.trigger_file and os.path.exists(self.trigger_file):
+            try:
+                os.unlink(self.trigger_file)    # one shot per touch
+            except OSError:
+                pass
+            return True
+        return False
+
+    def namespace(self) -> Dict[str, Any]:
+        import numpy
+        ns: Dict[str, Any] = {"workflow": self.workflow, "numpy": numpy,
+                              "np": numpy}
+        for u in getattr(self.workflow, "units", ()):
+            key = u.name.replace(" ", "_").replace("-", "_")
+            if key.isidentifier() and key not in ns:
+                ns[key] = u
+        return ns
+
+    def run(self) -> None:
+        if not self._should_open():
+            return
+        self._armed = False
+        self.sessions += 1
+        ns = self.namespace()
+        banner = ("veles_tpu shell — workflow %r; names: %s\n"
+                  "Ctrl-D resumes training." %
+                  (getattr(self.workflow, "name", "?"),
+                   ", ".join(sorted(ns))))
+        self.open_console(ns, banner)
+
+    # separated for testability (overridden / monkeypatched in tests)
+    def open_console(self, ns: Dict[str, Any], banner: str) -> None:
+        try:
+            from IPython import embed
+            embed(user_ns=ns, banner1=banner)
+        except ImportError:
+            import code
+            code.interact(banner=banner, local=ns)

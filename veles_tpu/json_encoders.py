@@ -1,0 +1,33 @@
+"""numpy-aware JSON encoding (reference: NumpyJSONEncoder,
+veles/json_encoders.py)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+import numpy
+
+
+class NumpyJSONEncoder(json.JSONEncoder):
+    """Serializes numpy scalars/arrays (and sets/bytes) transparently."""
+
+    def default(self, o: Any) -> Any:
+        if isinstance(o, numpy.integer):
+            return int(o)
+        if isinstance(o, numpy.floating):
+            return float(o)
+        if isinstance(o, numpy.bool_):
+            return bool(o)
+        if isinstance(o, numpy.ndarray):
+            return o.tolist()
+        if isinstance(o, (set, frozenset)):
+            return sorted(o)
+        if isinstance(o, bytes):
+            return o.decode(errors="replace")
+        return str(o)
+
+
+def dumps(obj: Any, **kwargs: Any) -> str:
+    kwargs.setdefault("cls", NumpyJSONEncoder)
+    return json.dumps(obj, **kwargs)

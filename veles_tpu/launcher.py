@@ -181,8 +181,9 @@ class Launcher(Logger):
         """--result-file (reference: veles/workflow.py:827-849)."""
         if not distributed.is_coordinator():
             return
+        from .json_encoders import NumpyJSONEncoder
         with open(path, "w") as fout:
-            json.dump(results, fout, indent=2, default=str)
+            json.dump(results, fout, indent=2, cls=NumpyJSONEncoder)
         self.info("results → %s", path)
 
     def print_stats(self) -> None:

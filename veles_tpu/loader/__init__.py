@@ -21,3 +21,4 @@ from .saver import MinibatchesSaver, MinibatchesLoader  # noqa: F401
 from .stream import (StreamLoader, InteractiveLoader,  # noqa: F401
                      RestfulLoader, ZeroMQLoader)
 from .ensemble import EnsembleLoader                   # noqa: F401
+from .sound import SoundFileLoader, decode_audio       # noqa: F401

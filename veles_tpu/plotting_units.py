@@ -120,10 +120,12 @@ class ImagePlotter(Plotter):
         if imgs is None:
             return None
         imgs = numpy.asarray(imgs)[:self.max_images]
-        if imgs.ndim == 2:          # flat samples: try square reshape
+        if imgs.ndim == 2:          # flat samples: square if possible,
             side = int(round(imgs.shape[1] ** 0.5))
             if side * side == imgs.shape[1]:
                 imgs = imgs.reshape(imgs.shape[0], side, side)
+            else:                   # else one-row strips (renderers need 3D+)
+                imgs = imgs[:, None, :]
         return {"images": numpy.stack([self.normalize(i) for i in imgs])}
 
 

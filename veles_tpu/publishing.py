@@ -79,11 +79,10 @@ class MarkdownBackend(PublishingBackend):
 
     @staticmethod
     def _render_figures(material, fig_dir) -> List[tuple]:
-        from .graphics import render_snapshot
+        from .graphics import render_snapshot, safe_name
         out = []
         for name, snap in sorted(material["snapshots"].items()):
-            safe = "".join(c if c.isalnum() or c in "-_" else "_"
-                           for c in name)
+            safe = safe_name(name)
             try:
                 out.append((name, render_snapshot(
                     snap, os.path.join(fig_dir, safe + ".png"))))

@@ -15,13 +15,13 @@ output and sets the event.
 
 from __future__ import annotations
 
-import json
 import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Dict, Optional
 
 import numpy
 
+from ._http import HTTPService, json_reply, read_json_object
 from .error import VelesError
 from .units import Unit
 
@@ -58,8 +58,7 @@ class RESTfulAPI(Unit):
         self.request_timeout = request_timeout
         #: forward output to answer from (link_attrs from the last forward)
         self.input = None
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
+        self._service: Optional[HTTPService] = None
         self.requests_served = 0
         self.demand("loader")
 
@@ -68,7 +67,7 @@ class RESTfulAPI(Unit):
         res = super().initialize(**kwargs)
         if res:
             return res
-        if self._httpd is not None:
+        if self._service is not None:
             return None
         api = self
 
@@ -81,8 +80,7 @@ class RESTfulAPI(Unit):
                     self.send_error(404)
                     return
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    body = json.loads(self.rfile.read(length) or b"{}")
+                    body = read_json_object(self)
                     sample = numpy.asarray(body["input"],
                                            dtype=numpy.float32)
                 except (ValueError, KeyError) as e:
@@ -103,19 +101,12 @@ class RESTfulAPI(Unit):
                 self._reply(200, {"result": ticket.result})
 
             def _reply(self, code: int, payload: Dict[str, Any]):
-                data = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
+                json_reply(self, code, payload)
 
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
-        self.port = self._httpd.server_port
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True,
-                                        name=self.name + ".http")
-        self._thread.start()
+        self._service = HTTPService(Handler, self.port,
+                                    self.name + ".http")
+        self.port = self._service.port
+        self._service.start_serving()
         self.info("%s: REST API on http://127.0.0.1:%d%s", self.name,
                   self.port, self.path)
         return None
@@ -143,10 +134,6 @@ class RESTfulAPI(Unit):
             ticket.event.set()
 
     def stop(self) -> None:
-        if self._httpd is not None:
-            self._httpd.shutdown()
-            self._httpd.server_close()
-            self._httpd = None
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        if self._service is not None:
+            self._service.stop_serving()
+            self._service = None

@@ -1,0 +1,3 @@
+"""Operator CLI tools (reference: veles/scripts/ — compare_snapshots,
+generate_frontend, bboxer, update_forge; forge CLI lives in
+veles_tpu/forge.py)."""

@@ -1,0 +1,114 @@
+"""generate_frontend: static HTML command composer for the CLI.
+
+Equivalent of the reference's veles/scripts/generate_frontend.py (which
+walked the distributed argparse registry and emitted the ``--frontend``
+wizard HTML). Here the single source of truth is
+veles_tpu/cmdline.py's parser: every option becomes a form control and
+the page assembles the ``python -m veles_tpu …`` command line live.
+
+Usage: ``python -m veles_tpu.scripts.generate_frontend [-o frontend.html]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+from typing import Any, Dict, List
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>veles_tpu command composer</title>
+<style>
+body {{ font-family: sans-serif; max-width: 60em; margin: 2em auto; }}
+fieldset {{ margin-bottom: 1em; }} label {{ display: inline-block;
+min-width: 16em; }} .row {{ margin: 0.3em 0; }}
+#cmd {{ background: #222; color: #9f9; padding: 1em; display: block;
+white-space: pre-wrap; word-break: break-all; }}
+small {{ color: #666; }}
+</style></head><body>
+<h1>veles_tpu — command composer</h1>
+<div id="form"></div>
+<h2>Command</h2><code id="cmd"></code>
+<script>
+const OPTIONS = {options_json};
+const form = document.getElementById('form');
+const state = {{}};
+function rebuild() {{
+  let cmd = 'python -m veles_tpu';
+  const pos = OPTIONS.filter(o => !o.flag);
+  for (const o of pos) if (state[o.dest]) cmd += ' ' + state[o.dest];
+  for (const o of OPTIONS.filter(o => o.flag)) {{
+    const v = state[o.dest];
+    if (o.is_bool) {{ if (v) cmd += ' ' + o.flag; }}
+    else if (v !== undefined && v !== '') cmd += ' ' + o.flag + ' ' + v;
+  }}
+  document.getElementById('cmd').textContent = cmd;
+}}
+for (const o of OPTIONS) {{
+  const row = document.createElement('div'); row.className = 'row';
+  const label = document.createElement('label');
+  label.textContent = o.flag || o.dest;
+  row.appendChild(label);
+  let input;
+  if (o.is_bool) {{
+    input = document.createElement('input'); input.type = 'checkbox';
+    input.onchange = () => {{ state[o.dest] = input.checked; rebuild(); }};
+  }} else {{
+    input = document.createElement('input'); input.type = 'text';
+    if (o.default !== null) input.placeholder = String(o.default);
+    input.oninput = () => {{ state[o.dest] = input.value; rebuild(); }};
+  }}
+  row.appendChild(input);
+  if (o.help) {{
+    const help = document.createElement('small');
+    help.textContent = ' ' + o.help; row.appendChild(help);
+  }}
+  form.appendChild(row);
+}}
+rebuild();
+</script></body></html>"""
+
+
+def collect_options(parser: argparse.ArgumentParser
+                    ) -> List[Dict[str, Any]]:
+    out = []
+    for action in parser._actions:      # the argparse introspection surface
+        if isinstance(action, argparse._HelpAction):
+            continue
+        flag = max(action.option_strings, key=len) \
+            if action.option_strings else None
+        out.append({
+            "dest": action.dest,
+            "flag": flag,
+            "is_bool": isinstance(action, (argparse._StoreTrueAction,
+                                           argparse._StoreFalseAction,
+                                           argparse._CountAction)),
+            "default": action.default
+            if isinstance(action.default, (int, float, str, bool,
+                                           type(None))) else None,
+            "help": (action.help or "").replace("\n", " "),
+        })
+    return out
+
+
+def generate(out_path: str) -> str:
+    from ..cmdline import make_parser
+    options = collect_options(make_parser())
+    page = _PAGE.format(options_json=html.escape(
+        json.dumps(options), quote=False))
+    with open(out_path, "w") as fout:
+        fout.write(page)
+    return out_path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("-o", "--output", default="frontend.html")
+    args = parser.parse_args(argv)
+    print(generate(args.output))
+    return 0
+
+
+if __name__ == "__main__":      # pragma: no cover
+    import sys
+    sys.exit(main())

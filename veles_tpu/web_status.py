@@ -19,9 +19,10 @@ import json
 import threading
 import time
 import urllib.request
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
 from typing import Any, Dict, Optional
 
+from ._http import HTTPService, bytes_reply, json_reply, read_json_object
 from .logger import Logger
 
 _PAGE = """<!doctype html>
@@ -67,10 +68,9 @@ class WebStatusServer(Logger):
 
             def do_GET(self):
                 if self.path in ("/", "/index.html"):
-                    self._reply(200, _PAGE.encode(), "text/html")
+                    bytes_reply(self, 200, _PAGE.encode(), "text/html")
                 elif self.path == "/status.json":
-                    self._reply(200, json.dumps(
-                        server.snapshot()).encode(), "application/json")
+                    json_reply(self, 200, server.snapshot())
                 else:
                     self.send_error(404)
 
@@ -79,26 +79,16 @@ class WebStatusServer(Logger):
                     self.send_error(404)
                     return
                 try:
-                    length = int(self.headers.get("Content-Length", 0))
-                    payload = json.loads(self.rfile.read(length))
+                    payload = read_json_object(self)
                     wid = str(payload["id"])
                 except (ValueError, KeyError) as e:
-                    self._reply(400, json.dumps(
-                        {"error": str(e)}).encode(), "application/json")
+                    json_reply(self, 400, {"error": str(e)})
                     return
                 server.update(wid, payload)
-                self._reply(200, b'{"ok": true}', "application/json")
+                json_reply(self, 200, {"ok": True})
 
-            def _reply(self, code, data, ctype):
-                self.send_response(code)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(data)))
-                self.end_headers()
-                self.wfile.write(data)
-
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
-        self.port = self._httpd.server_port
-        self._thread: Optional[threading.Thread] = None
+        self._service = HTTPService(Handler, port, "web_status")
+        self.port = self._service.port
 
     # -- state --------------------------------------------------------------
     def update(self, wid: str, payload: Dict[str, Any]) -> None:
@@ -117,18 +107,12 @@ class WebStatusServer(Logger):
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "WebStatusServer":
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True, name="web_status")
-        self._thread.start()
+        self._service.start_serving()
         self.info("web status on http://127.0.0.1:%d/", self.port)
         return self
 
     def stop(self) -> None:
-        self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
+        self._service.stop_serving()
 
 
 class StatusReporter(Logger):
