@@ -135,3 +135,38 @@ def test_version_ordering(tmp_path):
     m = client.fetch("mnist-fc", str(tmp_path / "latest"))
     assert m["version"] == "1.10"
     server.stop()
+
+
+def test_tarball_without_manifest_rejected(tmp_path):
+    """Missing manifest.json must raise VelesError (HTTP 400), never
+    KeyError (HTTP 500)."""
+    import tarfile
+    bad = tmp_path / "bad.tar.gz"
+    with tarfile.open(bad, "w:gz") as tar:
+        tar.add(make_src(tmp_path), arcname="payload")
+    with pytest.raises(VelesError):
+        forge.read_package_manifest(str(bad))
+    server = forge.ForgeServer(str(tmp_path / "store"), port=0).start()
+    import urllib.request
+    req = urllib.request.Request(
+        "http://127.0.0.1:%d/upload" % server.port,
+        data=bad.read_bytes())
+    with pytest.raises(urllib.error.HTTPError) as err:
+        urllib.request.urlopen(req, timeout=10)
+    assert err.value.code == 400
+    server.stop()
+
+
+def test_stray_file_in_store_ignored(tmp_path):
+    store = tmp_path / "store"
+    store.mkdir()
+    (store / ".DS_Store").write_bytes(b"junk")
+    server = forge.ForgeServer(str(store), port=0).start()
+    client = forge.ForgeClient("http://127.0.0.1:%d" % server.port)
+    assert client.list() == []
+    server.stop()
+
+
+def test_stop_before_start_does_not_hang(tmp_path):
+    server = forge.ForgeServer(str(tmp_path / "store"), port=0)
+    server.stop()       # never started; must return, not deadlock

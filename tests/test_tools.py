@@ -171,3 +171,23 @@ def test_compare_snapshots_missing_unit_fails():
     b = {"__units__": {}}
     rows = compare_snapshots.compare(a, b)
     assert any(r["status"] == "only_a" for r in rows)
+
+
+def test_sound_loader_rejects_mixed_rates(tmp_path):
+    from veles_tpu.error import VelesError
+    a, b = tmp_path / "a.wav", tmp_path / "b.wav"
+    make_wav(a, rate=8000)
+    make_wav(b, rate=16000)
+    loader = SoundFileLoader(None, files=[str(a), str(b)], labels=[0, 1],
+                             window=256, minibatch_size=4)
+    with pytest.raises(VelesError):
+        loader.load_data()
+
+
+def test_frontend_js_safe_embedding(tmp_path):
+    """Help strings with < > & must reach the page JS-escaped, without
+    HTML entities."""
+    out = str(tmp_path / "f.html")
+    generate_frontend.main(["-o", out])
+    page = open(out).read()
+    assert "&lt;" not in page.split("<script>")[1].split("</script>")[0]

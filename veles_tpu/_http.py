@@ -40,8 +40,9 @@ class HTTPService:
     shared by WebStatusServer / ForgeServer / RESTfulAPI)."""
 
     def __init__(self, handler_cls, port: int = 0,
-                 thread_name: str = "http") -> None:
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), handler_cls)
+                 thread_name: str = "http",
+                 host: str = "127.0.0.1") -> None:
+        self._httpd = ThreadingHTTPServer((host, port), handler_cls)
         self.port = self._httpd.server_port
         self._thread: Optional[threading.Thread] = None
         self._thread_name = thread_name
@@ -54,7 +55,10 @@ class HTTPService:
 
     def stop_serving(self) -> None:
         if self._httpd is not None:
-            self._httpd.shutdown()
+            if self._thread is not None:
+                # shutdown() waits on an event only serve_forever() sets —
+                # calling it on a never-started server deadlocks
+                self._httpd.shutdown()
             self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)

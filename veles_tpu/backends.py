@@ -105,7 +105,11 @@ class XLADevice(Device):
         return len(self.jax_devices)
 
     def sync(self) -> None:
-        (self._jax.device_put(0.0) + 0).block_until_ready()
+        # NOT block_until_ready: through the tunnelled-TPU transport that
+        # returns immediately. A host fetch of a freshly enqueued scalar
+        # drains the (in-order) compute stream for real.
+        import numpy
+        numpy.asarray(self._jax.device_put(0.0) + 0)
 
     def compute_power(self, n: int = 2048) -> float:
         """GEMM benchmark → GFLOP/s; the reference used the same measurement
@@ -114,14 +118,16 @@ class XLADevice(Device):
         import jax
         import jax.numpy as jnp
         import time
-        a = jnp.ones((n, n), dtype=jnp.bfloat16)
-        f = jax.jit(lambda x: x @ x)
-        f(a).block_until_ready()
+        import numpy
+        a = jnp.ones((n, n), dtype=jnp.bfloat16) * 1e-3
+        f = jax.jit(lambda x: x @ x * 1e-3)
+        numpy.asarray(f(a)[0, :1].astype(jnp.float32))   # warm + true sync
         t0 = time.time()
         reps = 8
-        for _ in range(reps):
-            r = f(a)
-        r.block_until_ready()
+        r = a
+        for _ in range(reps):            # dependency chain: no overlap games
+            r = f(r)
+        numpy.asarray(r[0, :1].astype(jnp.float32))      # host fetch = sync
         dt = (time.time() - t0) / reps
         return 2.0 * n ** 3 / dt / 1e9
 

@@ -148,6 +148,9 @@ def _default_root() -> Config:
             "backend": "auto",       # auto | tpu | cpu | numpy
             "sync_run": False,       # block after each step (profiling aid)
             "force_numpy": False,    # run numpy oracle instead of XLA
+            # pallas flash-attention kernel for the single-chip attention
+            # core (falls back automatically when shapes don't qualify)
+            "flash_attention": True,
         },
         "mesh": {
             # logical mesh axes reserved up front (SURVEY.md §5.7/§5.8):

@@ -88,7 +88,10 @@ def make_package(src_dir: str, manifest: Dict[str, Any],
 
 def read_package_manifest(path: str) -> Dict[str, Any]:
     with tarfile.open(path, "r:gz") as tar:
-        member = tar.extractfile(MANIFEST)
+        try:
+            member = tar.extractfile(MANIFEST)
+        except KeyError:        # missing member raises, not returns None
+            member = None
         if member is None:
             raise VelesError("%s: no %s" % (path, MANIFEST))
         manifest = json.load(member)
@@ -116,7 +119,8 @@ class ForgeServer(Logger):
     """
 
     def __init__(self, store_dir: str, port: int = 0,
-                 upload_tokens: Optional[List[str]] = None) -> None:
+                 upload_tokens: Optional[List[str]] = None,
+                 host: str = "127.0.0.1") -> None:
         super().__init__()
         self.store_dir = store_dir
         os.makedirs(store_dir, exist_ok=True)
@@ -185,13 +189,15 @@ class ForgeServer(Logger):
                                        "name": manifest["name"],
                                        "version": manifest["version"]})
 
-        self._service = HTTPService(Handler, port, "forge")
+        self._service = HTTPService(Handler, port, "forge", host=host)
         self.port = self._service.port
 
     # -- storage ------------------------------------------------------------
     def list_packages(self) -> List[Dict[str, Any]]:
         out = []
         for name in sorted(os.listdir(self.store_dir)):
+            if not os.path.isdir(os.path.join(self.store_dir, name)):
+                continue        # stray files must not break the registry
             versions = sorted(os.listdir(
                 os.path.join(self.store_dir, name)), key=version_key)
             if not versions:
@@ -317,6 +323,8 @@ def main(argv=None) -> int:
     ps = sub.add_parser("serve")
     ps.add_argument("store_dir")
     ps.add_argument("--port", type=int, default=8070)
+    ps.add_argument("--host", default="0.0.0.0",
+                    help="bind address (hub serves remote clients)")
     ps.add_argument("--token", action="append", default=[])
     for name in ("list", "details", "fetch", "upload"):
         p = sub.add_parser(name)
@@ -336,6 +344,7 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.cmd == "serve":
         server = ForgeServer(args.store_dir, port=args.port,
+                             host=args.host,
                              upload_tokens=args.token).start()
         import time
         try:

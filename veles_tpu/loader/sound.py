@@ -150,6 +150,11 @@ class SoundFileLoader(FullBatchLoader):
         data, rate = decode_audio(path)
         if self.sample_rate is None:
             self.sample_rate = rate
+        elif rate != self.sample_rate:
+            raise VelesError(
+                "%s: sample rate %d differs from the dataset's %d — "
+                "resample before loading" % (path, rate,
+                                             self.sample_rate))
         mono = data.mean(axis=1)
         n = (len(mono) - self.window) // self.stride + 1
         if n <= 0:

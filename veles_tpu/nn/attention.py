@@ -69,7 +69,9 @@ class MultiHeadAttention(ForwardBase):
 
     def apply(self, params, x, *, train=False, rng=None):
         import jax.numpy as jnp
+        from ..config import root
         from ..ops import matmul_precision
+        from ..ops import flash_attention as fa
         from ..parallel.ring_attention import (ring_attention,
                                                attention_reference)
         prec = matmul_precision()
@@ -79,6 +81,10 @@ class MultiHeadAttention(ForwardBase):
         v = self._split_heads(jnp.dot(x, params["wv"], precision=prec))
         if self.mesh is not None:
             o = ring_attention(q, k, v, self.mesh, causal=self.causal)
+        elif root.common.engine.flash_attention and \
+                fa.supported(t, d // self.n_heads):
+            # pallas kernel: no (T, T) score materialization in HBM
+            o = fa.flash_attention(q, k, v, causal=self.causal)
         else:
             o = attention_reference(q, k, v, causal=self.causal)
         o = o.reshape(b, t, d)

@@ -1,0 +1,217 @@
+"""Flash attention: Pallas TPU kernel for the attention core.
+
+The one place in the op set where XLA fusion is genuinely insufficient
+(SURVEY.md §7 "Pallas only where XLA fusion is insufficient"): naive
+attention materializes the (B, H, T, T) score matrix in HBM, so for long
+sequences the op is HBM-bandwidth-bound. This kernel streams K/V blocks
+through VMEM with an online softmax (running max/sum rescaling), keeping
+the working set at (block_q × block_k) — the standard flash-attention
+recipe expressed in Pallas (guide: /opt/skills/guides/pallas_guide.md;
+same tiling discipline as the public jax.experimental.pallas TPU ops).
+
+The backward pass recomputes scores blockwise from the saved
+log-sum-exp (``lse``) under ``jax.custom_vjp`` — O(T·block) memory, no
+(T, T) materialization — in plain jnp (a lax.scan over K/V blocks), which
+XLA maps onto the MXU well; the forward is where the pallas win is.
+
+Layout contract: (B, T, H, D) like the rest of the attention stack; heads
+are folded into the grid's leading dimension. D is zero-padded to the
+128-lane width (zero features change neither scores nor outputs).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+LANE = 128
+NEG_INF = -1e30
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
+            scale: float, causal: bool, block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _step():
+        q = q_ref[0]                    # (bq, D)
+        k = k_ref[0]                    # (bk, D)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_prev = m_scr[:, :1]                       # (bq, 1)
+        m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_cur)             # (bq, 1)
+        p = jnp.exp(s - m_cur)                      # (bq, bk)
+        l_scr[:] = jnp.broadcast_to(
+            l_scr[:, :1] * alpha + p.sum(axis=1, keepdims=True),
+            l_scr.shape)
+        m_scr[:] = jnp.broadcast_to(m_cur, m_scr.shape)
+        acc[:] = acc[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # skip K/V blocks strictly above the causal diagonal
+        pl.when(k_start <= q_start + block_q - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:, :1], 1e-30)
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+        # (8, bq) sublane-padded: TPU block shapes need ≥(8, 128) tiles
+        lse_ref[0] = jnp.broadcast_to(
+            (m_scr[:, :1] + jnp.log(l))[:, 0][None, :], lse_ref.shape[1:])
+
+
+def _fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
+                block_k: int, interpret: bool):
+    """q, k, v: (G, T, D) with D == LANE; → (o (G, T, D),
+    lse (G, 8, T) sublane-padded — callers use ``lse[:, 0, :]``)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    g, t, d = q.shape
+    grid = (g, t // block_q, t // block_k)
+    kernel = functools.partial(_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, t, d), q.dtype),
+            jax.ShapeDtypeStruct((g, 8, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, LANE), jnp.float32),
+            pltpu.VMEM((block_q, LANE), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _bwd_blockwise(causal, scale, block_k, res, do):
+    """Blockwise recompute backward (no (T, T) materialization)."""
+    q, k, v, o, lse = res
+    g, t, d = q.shape
+    nk = t // block_k
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)
+             ).sum(-1)                                      # (G, T)
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    q_pos = jnp.arange(t)
+
+    def body(dq, j):
+        ks = jax.lax.dynamic_slice_in_dim(k, j * block_k, block_k, 1)
+        vs = jax.lax.dynamic_slice_in_dim(v, j * block_k, block_k, 1)
+        ksf = ks.astype(jnp.float32)
+        s = jnp.einsum("gqd,gkd->gqk", qf, ksf) * scale
+        if causal:
+            k_pos = j * block_k + jnp.arange(block_k)
+            s = jnp.where(q_pos[None, :, None] >= k_pos[None, None, :],
+                          s, NEG_INF)
+        p = jnp.exp(s - lse[..., None])                     # (G, T, bk)
+        dv = jnp.einsum("gqk,gqd->gkd", p, dof)
+        dp = jnp.einsum("gqd,gkd->gqk", dof, vs.astype(jnp.float32))
+        ds = p * (dp - delta[..., None]) * scale
+        dq = dq + jnp.einsum("gqk,gkd->gqd", ds, ksf)
+        dk = jnp.einsum("gqk,gqd->gkd", ds, qf)
+        return dq, (dk, dv)
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dq, (dks, dvs) = jax.lax.scan(body, dq0, jnp.arange(nk))
+    dk = jnp.moveaxis(dks, 0, 1).reshape(g, t, d)
+    dv = jnp.moveaxis(dvs, 0, 1).reshape(g, t, d)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, _ = _fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                       interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    o, lse = _fwd_pallas(q, k, v, causal, scale, block_q, block_k,
+                         interpret)
+    return o, (q, k, v, o, lse[:, 0, :])
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    return _bwd_blockwise(causal, scale, block_k, res, do)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def supported(t: int, d: int, block_q: int = 128,
+              block_k: int = 128) -> bool:
+    return t % block_q == 0 and t % block_k == 0 and d <= LANE
+
+
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: Optional[bool] = None):
+    """(B, T, H, D) × 3 → (B, T, H, D), differentiable.
+
+    Falls back is the caller's job — check ``supported(T, D)`` first.
+    ``interpret`` defaults to True off-TPU so tests exercise the same
+    kernel on the CPU backend.
+    """
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if not supported(t, d, block_q, block_k):
+        raise ValueError("flash_attention: T=%d D=%d not supported with "
+                         "blocks (%d, %d)" % (t, d, block_q, block_k))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def fold(x):
+        xt = jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
+        if d < LANE:
+            xt = jnp.pad(xt, ((0, 0), (0, 0), (0, LANE - d)))
+        return xt
+
+    o = _flash(fold(q), fold(k), fold(v), causal, float(scale),
+               block_q, block_k, interpret)
+    o = o[..., :d].reshape(b, h, t, d)
+    return jnp.moveaxis(o, 1, 2)

@@ -12,7 +12,6 @@ Usage: ``python -m veles_tpu.scripts.generate_frontend [-o frontend.html]``
 from __future__ import annotations
 
 import argparse
-import html
 import json
 from typing import Any, Dict, List
 
@@ -94,8 +93,10 @@ def collect_options(parser: argparse.ArgumentParser
 def generate(out_path: str) -> str:
     from ..cmdline import make_parser
     options = collect_options(make_parser())
-    page = _PAGE.format(options_json=html.escape(
-        json.dumps(options), quote=False))
+    # JS-context embedding: escape '<' as < (prevents </script>
+    # breakout); html.escape would leave &lt; entities undecoded in JS
+    page = _PAGE.format(
+        options_json=json.dumps(options).replace("<", "\\u003c"))
     with open(out_path, "w") as fout:
         fout.write(page)
     return out_path
