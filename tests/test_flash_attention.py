@@ -62,7 +62,10 @@ def test_supported_predicate():
 
 def test_mha_unit_routes_through_flash():
     prev = vt.root.common.engine.compute_dtype
+    prev_flash = vt.root.common.engine.flash_attention
     vt.root.common.engine.compute_dtype = "float32"
+    # CPU harness: production gating skips flash off-TPU; force interpret
+    vt.root.common.engine.flash_attention = "force"
     try:
         wf = vt.Workflow(name="t")
         u = nn.MultiHeadAttention(wf, n_heads=2, causal=True)
@@ -82,5 +85,5 @@ def test_mha_unit_routes_through_flash():
         y_np = u.numpy_apply(u.params_np(), x)
         numpy.testing.assert_allclose(y_flash, y_np, rtol=1e-3, atol=1e-4)
     finally:
-        vt.root.common.engine.flash_attention = True
+        vt.root.common.engine.flash_attention = prev_flash
         vt.root.common.engine.compute_dtype = prev

@@ -170,3 +170,10 @@ def test_stray_file_in_store_ignored(tmp_path):
 def test_stop_before_start_does_not_hang(tmp_path):
     server = forge.ForgeServer(str(tmp_path / "store"), port=0)
     server.stop()       # never started; must return, not deadlock
+
+
+def test_serve_cli_requires_token_off_loopback(tmp_path, capsys):
+    with pytest.raises(SystemExit):
+        forge.main(["serve", str(tmp_path / "s"), "--host", "0.0.0.0"])
+    err = capsys.readouterr().err
+    assert "--token" in err

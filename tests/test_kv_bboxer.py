@@ -1,0 +1,78 @@
+"""LMDB/HDFS gated loaders + bboxer annotation tool."""
+import json
+import urllib.request
+
+import numpy
+import pytest
+
+from veles_tpu.error import VelesError
+from veles_tpu.loader.kv_store import (HDFSTextLoader, LMDBLoader,
+                                       parse_tsv_line)
+from veles_tpu.scripts.bboxer import BBoxerServer
+
+
+def test_lmdb_loader_gates_without_lmdb():
+    loader = LMDBLoader(None, databases=[None, None, "/tmp/nope"],
+                        minibatch_size=4)
+    with pytest.raises(VelesError) as err:
+        loader.load_data()
+    assert "lmdb" in str(err.value)
+    with pytest.raises(VelesError):
+        LMDBLoader(None, databases=["just-one"])
+
+
+def test_hdfs_parsing_without_cluster():
+    loader = HDFSTextLoader(None, namenode="", paths=[None, None, "/x"],
+                            minibatch_size=4)
+    data, labels = loader.parse_text("1.0\t2.0\t0\n3.0\t4.0\t1\n")
+    numpy.testing.assert_allclose(data, [[1, 2], [3, 4]])
+    numpy.testing.assert_array_equal(labels, [0, 1])
+    sample, label = parse_tsv_line("0.5\t7")
+    assert label == 7 and sample.tolist() == [0.5]
+    with pytest.raises(VelesError):
+        loader.load_data()      # no namenode configured
+
+
+def make_png(path, w=16, h=12):
+    from PIL import Image
+    Image.new("RGB", (w, h), (100, 50, 25)).save(path)
+
+
+def test_bboxer_annotation_roundtrip(tmp_path):
+    make_png(tmp_path / "a.png")
+    make_png(tmp_path / "b.png")
+    server = BBoxerServer(str(tmp_path), port=0).start()
+    base = "http://127.0.0.1:%d" % server.port
+
+    def get(path):
+        with urllib.request.urlopen(base + path, timeout=10) as r:
+            return r.headers.get_content_type(), r.read()
+
+    ctype, page = get("/")
+    assert ctype == "text/html" and b"bboxer" in page
+    _, listing = get("/list")
+    assert json.loads(listing)["images"] == ["a.png", "b.png"]
+    ctype, img = get("/image?name=a.png")
+    assert ctype == "image/png" and img[:4] == b"\x89PNG"
+    # path escape refused
+    with pytest.raises(urllib.error.HTTPError):
+        get("/image?name=../secret")
+
+    def post(payload):
+        req = urllib.request.Request(base + "/boxes",
+                                     data=json.dumps(payload).encode())
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    out = post({"image": "a.png",
+                "box": {"x": 1, "y": 2, "w": 5, "h": 4, "label": "cat"}})
+    assert out["count"] == 1
+    saved = json.loads((tmp_path / "bboxes.json").read_text())
+    assert saved["a.png"][0]["label"] == "cat"
+    post({"image": "a.png", "clear": True})
+    saved = json.loads((tmp_path / "bboxes.json").read_text())
+    assert saved["a.png"] == []
+    server.stop()
+    # persisted annotations reload
+    server2 = BBoxerServer(str(tmp_path), port=0)
+    assert server2.boxes["a.png"] == []

@@ -1,0 +1,133 @@
+"""All2All variants (rprop_all / resizable_all) and the mcdnnic topology
+shorthand (Znicz parity, SURVEY.md §2.8)."""
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn
+from veles_tpu.error import VelesError
+from veles_tpu.loader import FullBatchLoader
+from veles_tpu.memory import Array
+from veles_tpu.nn.standard_workflow import parse_mcdnnic
+
+
+def dev():
+    return vt.XLADevice(mesh_axes={"data": 1})
+
+
+def test_rprop_rule_sign_dynamics():
+    import jax.numpy as jnp
+    wf = vt.Workflow(name="t")
+    gd = nn.GDRProp(wf, initial_step=0.1)
+    params = {"weights": jnp.asarray([[1.0, 1.0]])}
+    state = gd.init_state(params)
+    g1 = {"weights": jnp.asarray([[0.5, -0.5]])}
+    p1, s1 = gd.update(params, g1, state)
+    # first step: move by initial step against the gradient sign
+    numpy.testing.assert_allclose(numpy.asarray(p1["weights"]),
+                                  [[0.9, 1.1]], rtol=1e-6)
+    # same sign → step grows ×1.2
+    p2, s2 = gd.update(p1, g1, s1)
+    numpy.testing.assert_allclose(numpy.asarray(s2["step"]["weights"]),
+                                  [[0.12, 0.12]], rtol=1e-6)
+    # sign flip → step shrinks ×0.5 and no move this round
+    g3 = {"weights": jnp.asarray([[-0.5, 0.5]])}
+    p3, s3 = gd.update(p2, g3, s2)
+    numpy.testing.assert_allclose(numpy.asarray(p3["weights"]),
+                                  numpy.asarray(p2["weights"]), rtol=1e-6)
+    numpy.testing.assert_allclose(numpy.asarray(s3["step"]["weights"]),
+                                  [[0.06, 0.06]], rtol=1e-6)
+
+
+def test_rprop_trains_end_to_end():
+    class XorishLoader(FullBatchLoader):
+        hide_from_registry = True
+
+        def load_data(self):
+            rng = numpy.random.RandomState(0)
+            x = rng.rand(256, 8).astype(numpy.float32)
+            y = (x[:, 0] > x[:, 1]).astype(numpy.int32)
+            self.create_originals(x, y)
+            self.class_lengths = [0, 64, 192]
+
+    loader = XorishLoader(None, minibatch_size=32)
+    wf = nn.StandardWorkflow(
+        name="rprop",
+        layers=[{"type": "rprop_all2all", "output_sample_shape": 16},
+                {"type": "softmax", "output_sample_shape": 2}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=15))
+    wf.initialize(device=dev())
+    assert any(isinstance(g, nn.GDRProp) for g in wf.train_step.gds)
+    wf.run()
+    res = wf.gather_results()
+    assert res["best_err"] < 0.35, res
+
+
+def test_resizable_all2all_preserves_trained_slice():
+    wf = vt.Workflow(name="t")
+    u = nn.ResizableAll2All(wf, output_sample_shape=4)
+    x = numpy.random.RandomState(0).rand(6, 5).astype(numpy.float32)
+    u.input = Array(x)
+    u.initialize(device=dev())
+    w_before = numpy.array(u.weights.map_read())
+    y_before = u.numpy_apply(u.params_np(), x)
+    u.resize(7)
+    assert u.weights.shape == (5, 7)
+    numpy.testing.assert_allclose(
+        numpy.asarray(u.weights.map_read())[:, :4], w_before)
+    y_after = u.numpy_apply(u.params_np(), x)
+    numpy.testing.assert_allclose(y_after[:, :4], y_before, rtol=1e-5)
+    u.xla_run()         # device path works after resize
+    numpy.testing.assert_allclose(numpy.asarray(u.output.map_read()),
+                                  y_after, rtol=1e-4, atol=1e-5)
+    u.resize(2)         # shrink too
+    assert u.weights.shape == (5, 2)
+    numpy.testing.assert_allclose(
+        numpy.asarray(u.weights.map_read()), w_before[:, :2])
+
+
+def test_parse_mcdnnic():
+    layers = parse_mcdnnic("28x28-8C3-MP2-32N-10N",
+                           {"learning_rate": 0.05})
+    assert [l["type"] for l in layers] == [
+        "conv_tanh", "max_pooling", "all2all_tanh", "softmax"]
+    assert layers[0]["n_kernels"] == 8 and layers[0]["kx"] == 3
+    assert layers[1]["kx"] == 2
+    assert layers[2]["output_sample_shape"] == 32
+    assert layers[3]["output_sample_shape"] == 10
+    assert all(l["learning_rate"] == 0.05 for l in layers)
+    with pytest.raises(VelesError):
+        parse_mcdnnic("28x28-whoops")
+    with pytest.raises(VelesError):
+        parse_mcdnnic("justinput")
+
+
+def test_mcdnnic_workflow_builds_and_trains():
+    class TinyImages(FullBatchLoader):
+        hide_from_registry = True
+
+        def load_data(self):
+            rng = numpy.random.RandomState(0)
+            x = rng.rand(128, 8, 8, 1).astype(numpy.float32)
+            y = (x.mean(axis=(1, 2, 3)) > 0.5).astype(numpy.int32)
+            self.create_originals(x, y)
+            self.class_lengths = [0, 32, 96]
+
+    loader = TinyImages(None, minibatch_size=32)
+    wf = nn.StandardWorkflow(
+        name="mcdnnic",
+        mcdnnic_topology="8x8-4C3-MP2-16N-2N",
+        mcdnnic_parameters={"learning_rate": 0.1},
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=3))
+    wf.initialize(device=dev())
+    types = [type(f).MAPPING for f in wf.forwards]
+    assert types == ["conv_tanh", "max_pooling", "all2all_tanh",
+                     "softmax"]
+    wf.run()
+    assert wf.gather_results()["epochs"] >= 3
+    with pytest.raises(VelesError):
+        nn.StandardWorkflow(layers=[{"type": "softmax"}],
+                            mcdnnic_topology="8x8-2N",
+                            loader_unit=None)

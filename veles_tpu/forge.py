@@ -343,6 +343,12 @@ def main(argv=None) -> int:
         pp.add_argument("--" + key, required=True)
     args = parser.parse_args(argv)
     if args.cmd == "serve":
+        if args.host not in ("127.0.0.1", "localhost", "::1") and \
+                not args.token:
+            parser.error("serving on %s requires at least one --token "
+                         "(open upload on a non-loopback bind would let "
+                         "any host publish executable model code)"
+                         % args.host)
         server = ForgeServer(args.store_dir, port=args.port,
                              host=args.host,
                              upload_tokens=args.token).start()

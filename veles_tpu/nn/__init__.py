@@ -34,5 +34,7 @@ from .zerofill import ZeroFiller  # noqa
 from .image_saver import ImageSaver  # noqa
 from .nn_plotting import Weights2D, KohonenHits  # noqa
 from .attention import MultiHeadAttention  # noqa
+from .variants import (All2AllRProp, GDRProp,
+                       ResizableAll2All)  # noqa
 from .train_step import TrainStep  # noqa
-from .standard_workflow import StandardWorkflow  # noqa
+from .standard_workflow import StandardWorkflow, parse_mcdnnic  # noqa

@@ -79,10 +79,16 @@ class MultiHeadAttention(ForwardBase):
         q = self._split_heads(jnp.dot(x, params["wq"], precision=prec))
         k = self._split_heads(jnp.dot(x, params["wk"], precision=prec))
         v = self._split_heads(jnp.dot(x, params["wv"], precision=prec))
+        flash_cfg = root.common.engine.flash_attention
+        # the kernel only pays off compiled on TPU; off-TPU it would run
+        # in pallas interpret mode (orders of magnitude slower than the
+        # fused XLA reference). "force" opts tests into interpret mode.
+        import jax
+        use_flash = (flash_cfg == "force" or
+                     (flash_cfg and jax.default_backend() == "tpu"))
         if self.mesh is not None:
             o = ring_attention(q, k, v, self.mesh, causal=self.causal)
-        elif root.common.engine.flash_attention and \
-                fa.supported(t, d // self.n_heads):
+        elif use_flash and fa.supported(t, d // self.n_heads):
             # pallas kernel: no (T, T) score materialization in HBM
             o = fa.flash_attention(q, k, v, causal=self.causal)
         else:

@@ -32,6 +32,47 @@ from ..plumbing import Repeater
 from .train_step import TrainStep
 
 
+def parse_mcdnnic(topology: str,
+                  common: Optional[Dict[str, Any]] = None
+                  ) -> List[Dict[str, Any]]:
+    """Znicz ``mcdnnic_topology`` shorthand → layers list
+    (reference: docs/source/manualrst_veles_workflow_parameters.rst, e.g.
+    ``"12x256x256-32C4-MP2-64C4-MP3-32N-4N"``): the first dash-token is
+    the input geometry (informational), ``<n>C<k>`` a conv layer with n
+    kernels of size k, ``MP<k>`` max-pooling k×k, ``<n>N`` a
+    fully-connected tanh layer — the last N becomes the softmax output.
+    ``common`` kwargs (e.g. learning_rate) are merged into every layer."""
+    import re
+    common = dict(common or {})
+    tokens = topology.split("-")
+    if not tokens or len(tokens) < 2:
+        raise VelesError("mcdnnic topology needs input+layers: %r"
+                         % topology)
+    layers: List[Dict[str, Any]] = []
+    for tok in tokens[1:]:
+        m = re.fullmatch(r"(\d+)C(\d+)", tok)
+        if m:
+            layers.append(dict(common, type="conv_tanh",
+                               n_kernels=int(m.group(1)),
+                               kx=int(m.group(2)), ky=int(m.group(2))))
+            continue
+        m = re.fullmatch(r"MP(\d+)", tok)
+        if m:
+            layers.append(dict(common, type="max_pooling",
+                               kx=int(m.group(1)), ky=int(m.group(1))))
+            continue
+        m = re.fullmatch(r"(\d+)N", tok)
+        if m:
+            layers.append(dict(common, type="all2all_tanh",
+                               output_sample_shape=int(m.group(1))))
+            continue
+        raise VelesError("bad mcdnnic token %r in %r" % (tok, topology))
+    if layers and layers[-1]["type"] == "all2all_tanh":
+        last = layers[-1]
+        last["type"] = "softmax"
+    return layers
+
+
 def _unit_class(type_name: str) -> type:
     cls = UnitRegistry.mapping.get(type_name)
     if cls is None:
@@ -50,10 +91,17 @@ class StandardWorkflow(AcceleratedWorkflow):
                  decision_config: Optional[Dict[str, Any]] = None,
                  lr_schedule=None, snapshotter_unit=None,
                  steps_per_dispatch: int = 16, target_mode: str = None,
+                 mcdnnic_topology: str = None,
+                 mcdnnic_parameters: Optional[Dict[str, Any]] = None,
                  **kwargs):
         self._steps_per_dispatch = steps_per_dispatch
         self._target_mode = target_mode
         super().__init__(workflow, **kwargs)
+        if mcdnnic_topology:
+            if layers:
+                raise VelesError("pass layers OR mcdnnic_topology, "
+                                 "not both")
+            layers = parse_mcdnnic(mcdnnic_topology, mcdnnic_parameters)
         self.layers_config = list(layers)
         self.loss_function = loss_function
         self.loader = loader_unit

@@ -1,0 +1,123 @@
+"""All2All variants: RProp training and resizable topology.
+
+Equivalent of Znicz ``rprop_all`` and ``resizable_all`` (reference
+surface: SURVEY.md §2.8 "variants rprop_all, resizable_all"):
+
+- ``All2AllRProp`` / ``GDRProp``: fully-connected layer trained with
+  resilient backpropagation — per-weight adaptive step sizes driven by
+  gradient sign agreement, not magnitude (Riedmiller & Braun '93 rule:
+  grow the step ×1.2 on same sign, shrink ×0.5 on flip). The rule is a
+  pure elementwise function of (grad, prev_grad, step), so it fuses into
+  the train step like any optimizer.
+- ``ResizableAll2All``: output width can change after initialization;
+  existing rows/columns are preserved, new ones freshly initialized —
+  the reference used this for grow-as-you-train experiments.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy
+
+from ..memory import Array
+from .. import prng
+from .all2all import All2All
+from .nn_units import GradientDescentBase, matches
+
+
+class All2AllRProp(All2All):
+    """Forward identical to All2All; paired with GDRProp
+    (Znicz ``rprop_all``)."""
+
+    MAPPING = "rprop_all2all"
+    hide_from_registry = False
+
+
+@matches(All2AllRProp)
+class GDRProp(GradientDescentBase):
+    """Resilient backpropagation update rule."""
+
+    MAPPING = "gd_rprop"
+    hide_from_registry = False
+
+    ETA_PLUS = 1.2
+    ETA_MINUS = 0.5
+    STEP_MIN = 1e-6
+    STEP_MAX = 50.0
+
+    def __init__(self, workflow, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.initial_step = kwargs.get("initial_step", 0.01)
+
+    def init_state(self, params: Dict[str, Any]) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+        return {
+            "step": jax.tree_util.tree_map(
+                lambda p: jnp.full_like(p, self.initial_step), params),
+            "prev_grad": jax.tree_util.tree_map(
+                lambda p: p * 0, params),
+        }
+
+    def update(self, params: Dict[str, Any], grads: Dict[str, Any],
+               state: Dict[str, Any], lr_scale: Any = 1.0
+               ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        import jax.numpy as jnp
+        new_params: Dict[str, Any] = {}
+        new_step: Dict[str, Any] = {}
+        new_prev: Dict[str, Any] = {}
+        for k, p in params.items():
+            g = grads[k]
+            if self.weight_decay:
+                g = g + self.weight_decay * p
+            sign = g * state["prev_grad"][k]
+            step = state["step"][k]
+            step = jnp.where(sign > 0, step * self.ETA_PLUS,
+                             jnp.where(sign < 0, step * self.ETA_MINUS,
+                                       step))
+            step = jnp.clip(step, self.STEP_MIN, self.STEP_MAX)
+            # on sign flip: no move this round, forget the gradient
+            move = jnp.where(sign < 0, 0.0, jnp.sign(g) * step)
+            new_params[k] = p - move * lr_scale
+            new_step[k] = step
+            new_prev[k] = jnp.where(sign < 0, 0.0, g)
+        return new_params, {"step": new_step, "prev_grad": new_prev}
+
+
+class ResizableAll2All(All2All):
+    """All2All whose output width can change after initialization
+    (Znicz ``resizable_all``)."""
+
+    MAPPING = "resizable_all2all"
+    hide_from_registry = False
+
+    def resize(self, new_neurons: int) -> None:
+        """Grow or shrink the output dimension in place; preserved slice
+        keeps its trained values, new columns are freshly initialized."""
+        old = self.neurons_number
+        if new_neurons == old:
+            return
+        self.output_sample_shape = (int(new_neurons),)
+        if not self.param_arrays():
+            return                      # not initialized yet: nothing to do
+        w_old = numpy.asarray(self.weights.map_read())
+        b_old = (numpy.asarray(self.bias.map_read())
+                 if getattr(self, "bias", None) else None)
+        fresh = self.create_params(prng.get(self.name + ".resize"))
+        w_new = numpy.asarray(fresh["weights"].map_read())
+        keep = min(old, new_neurons)
+        w_new[:, :keep] = w_old[:, :keep]
+        self.weights.reset(w_new)
+        if b_old is not None and "bias" in fresh:
+            b_new = numpy.asarray(fresh["bias"].map_read())
+            b_new[:keep] = b_old[:keep]
+            self.bias.reset(b_new)
+        if self.input is not None and self.input:
+            self.output.reset(numpy.zeros(
+                self.output_shape_for(self.input.shape),
+                dtype=numpy.float32))
+        # any compiled apply is stale now
+        self._jit_cache.clear()
+        self.info("%s: resized %d → %d neurons", self.name, old,
+                  new_neurons)

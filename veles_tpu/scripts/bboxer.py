@@ -1,0 +1,225 @@
+"""bboxer: web tool for drawing bounding-box labels on an image folder.
+
+Equivalent of the reference's veles/scripts/bboxer.py (collaborative
+image labelling web app). One self-contained page: pick an image, drag
+boxes on a canvas, assign a class label; annotations persist to a JSON
+file next to the images (``bboxes.json``: {image: [{x, y, w, h,
+label}]}), which an ImageLoader pipeline can consume as ground truth.
+
+Usage: ``python -m veles_tpu.scripts.bboxer IMAGE_DIR [--port 8095]``
+"""
+
+from __future__ import annotations
+
+import json
+import mimetypes
+import os
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler
+from typing import Dict, List
+
+from .._http import HTTPService, bytes_reply, json_reply, read_json_object
+from ..logger import Logger
+
+IMAGE_EXTS = (".png", ".jpg", ".jpeg", ".bmp", ".gif")
+
+_PAGE = """<!doctype html><html><head><meta charset="utf-8">
+<title>bboxer</title><style>
+body { font-family: sans-serif; margin: 1em; }
+#canvas { border: 1px solid #888; cursor: crosshair; }
+#images span { margin-right: .8em; cursor: pointer; color: #04c; }
+#images span.current { font-weight: bold; }
+</style></head><body>
+<h2>bboxer — drag to draw, enter label, saved instantly</h2>
+<div id="images"></div>
+<p>label: <input id="label" value="object">
+<button onclick="clearBoxes()">clear image boxes</button></p>
+<canvas id="canvas"></canvas>
+<script>
+let current = null, boxes = {}, img = new Image(), drag = null;
+const canvas = document.getElementById('canvas');
+const ctx = canvas.getContext('2d');
+async function load() {
+  const r = await fetch('list'); const data = await r.json();
+  boxes = data.boxes;
+  const div = document.getElementById('images'); div.innerHTML = '';
+  for (const name of data.images) {
+    const s = document.createElement('span');
+    s.textContent = name + ' (' + (boxes[name]||[]).length + ')';
+    s.onclick = () => show(name);
+    if (name === current) s.className = 'current';
+    div.appendChild(s);
+  }
+  if (!current && data.images.length) show(data.images[0]);
+}
+function show(name) {
+  current = name;
+  img = new Image();
+  img.onload = () => { canvas.width = img.width;
+    canvas.height = img.height; redraw(); load(); };
+  img.src = 'image?name=' + encodeURIComponent(name);
+}
+function redraw() {
+  ctx.drawImage(img, 0, 0);
+  ctx.strokeStyle = '#f00'; ctx.fillStyle = '#f00'; ctx.font = '12px sans-serif';
+  for (const b of boxes[current] || []) {
+    ctx.strokeRect(b.x, b.y, b.w, b.h);
+    ctx.fillText(b.label, b.x + 2, b.y + 12);
+  }
+  if (drag) ctx.strokeRect(drag.x, drag.y, drag.w, drag.h);
+}
+canvas.onmousedown = e => {
+  drag = {x: e.offsetX, y: e.offsetY, w: 0, h: 0}; };
+canvas.onmousemove = e => { if (!drag) return;
+  drag.w = e.offsetX - drag.x; drag.h = e.offsetY - drag.y; redraw(); };
+canvas.onmouseup = async e => {
+  if (!drag) return;
+  const b = {x: Math.min(drag.x, drag.x + drag.w),
+             y: Math.min(drag.y, drag.y + drag.h),
+             w: Math.abs(drag.w), h: Math.abs(drag.h),
+             label: document.getElementById('label').value};
+  drag = null;
+  if (b.w > 2 && b.h > 2) {
+    await fetch('boxes', {method: 'POST', body: JSON.stringify(
+      {image: current, box: b})});
+    (boxes[current] = boxes[current] || []).push(b);
+  }
+  redraw(); load();
+};
+async function clearBoxes() {
+  await fetch('boxes', {method: 'POST', body: JSON.stringify(
+    {image: current, clear: true})});
+  boxes[current] = []; redraw(); load();
+}
+load();
+</script></body></html>"""
+
+
+class BBoxerServer(Logger):
+    """Annotation server over one image directory."""
+
+    def __init__(self, image_dir: str, port: int = 0) -> None:
+        super().__init__()
+        self.image_dir = os.path.abspath(image_dir)
+        if not os.path.isdir(self.image_dir):
+            raise NotADirectoryError(self.image_dir)
+        self.store_path = os.path.join(self.image_dir, "bboxes.json")
+        self._lock = threading.Lock()
+        self.boxes: Dict[str, List[dict]] = {}
+        if os.path.exists(self.store_path):
+            with open(self.store_path) as fin:
+                self.boxes = json.load(fin)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                server.debug("http: " + fmt, *args)
+
+            def do_GET(self):
+                url = urllib.parse.urlparse(self.path)
+                if url.path in ("/", "/index.html"):
+                    bytes_reply(self, 200, _PAGE.encode(), "text/html")
+                elif url.path == "/list":
+                    json_reply(self, 200, {"images": server.images(),
+                                           "boxes": server.boxes})
+                elif url.path == "/image":
+                    name = urllib.parse.parse_qs(url.query).get(
+                        "name", [""])[0]
+                    data = server.read_image(name)
+                    if data is None:
+                        self.send_error(404)
+                        return
+                    ctype = mimetypes.guess_type(name)[0] or \
+                        "application/octet-stream"
+                    bytes_reply(self, 200, data, ctype)
+                else:
+                    self.send_error(404)
+
+            def do_POST(self):
+                if urllib.parse.urlparse(self.path).path != "/boxes":
+                    self.send_error(404)
+                    return
+                try:
+                    body = read_json_object(self)
+                    image = str(body["image"])
+                except (ValueError, KeyError) as e:
+                    json_reply(self, 400, {"error": str(e)})
+                    return
+                if image not in server.images():
+                    json_reply(self, 404, {"error": "unknown image"})
+                    return
+                if body.get("clear"):
+                    server.set_boxes(image, [])
+                else:
+                    box = body.get("box")
+                    if not isinstance(box, dict):
+                        json_reply(self, 400, {"error": "box required"})
+                        return
+                    server.add_box(image, box)
+                json_reply(self, 200, {"ok": True,
+                                       "count": len(
+                                           server.boxes.get(image, []))})
+
+        self._service = HTTPService(Handler, port, "bboxer")
+        self.port = self._service.port
+
+    # -- state ---------------------------------------------------------------
+    def images(self) -> List[str]:
+        return sorted(
+            f for f in os.listdir(self.image_dir)
+            if f.lower().endswith(IMAGE_EXTS))
+
+    def read_image(self, name: str):
+        if name not in self.images():       # whitelist: no path escapes
+            return None
+        with open(os.path.join(self.image_dir, name), "rb") as fin:
+            return fin.read()
+
+    def add_box(self, image: str, box: dict) -> None:
+        clean = {"x": float(box.get("x", 0)), "y": float(box.get("y", 0)),
+                 "w": float(box.get("w", 0)), "h": float(box.get("h", 0)),
+                 "label": str(box.get("label", "object"))}
+        with self._lock:
+            self.boxes.setdefault(image, []).append(clean)
+            self._save()
+
+    def set_boxes(self, image: str, boxes: List[dict]) -> None:
+        with self._lock:
+            self.boxes[image] = boxes
+            self._save()
+
+    def _save(self) -> None:
+        with open(self.store_path, "w") as fout:
+            json.dump(self.boxes, fout, indent=1)
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "BBoxerServer":
+        self._service.start_serving()
+        self.info("bboxer on http://127.0.0.1:%d/ (%d images)",
+                  self.port, len(self.images()))
+        return self
+
+    def stop(self) -> None:
+        self._service.stop_serving()
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("image_dir")
+    parser.add_argument("--port", type=int, default=8095)
+    args = parser.parse_args(argv)
+    server = BBoxerServer(args.image_dir, port=args.port).start()
+    try:
+        import time
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":      # pragma: no cover
+    import sys
+    sys.exit(main())
