@@ -76,3 +76,23 @@ def test_bboxer_annotation_roundtrip(tmp_path):
     # persisted annotations reload
     server2 = BBoxerServer(str(tmp_path), port=0)
     assert server2.boxes["a.png"] == []
+
+
+def test_all_empty_splits_rejected():
+    loader = HDFSTextLoader(None, namenode="x", paths=[None, None, None],
+                            minibatch_size=4)
+    with pytest.raises(VelesError) as err:
+        loader.load_data()
+    assert "no databases/paths" in str(err.value)
+
+
+def test_bboxer_save_is_atomic(tmp_path):
+    make_png(tmp_path / "a.png")
+    server = BBoxerServer(str(tmp_path), port=0)
+    server.add_box("a.png", {"x": 0, "y": 0, "w": 3, "h": 3,
+                             "label": "z"})
+    assert not (tmp_path / "bboxes.json.tmp").exists()
+    assert json.loads((tmp_path / "bboxes.json").read_text())["a.png"]
+    snap = server.boxes_copy()
+    snap["a.png"].append("mutation")     # copies, not aliases
+    assert server.count("a.png") == 1

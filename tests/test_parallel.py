@@ -127,3 +127,55 @@ def test_fault_injection_zero_probability_noop():
 def test_restore_latest_no_snapshots(tmp_path):
     wf = vt.Workflow(name="w")
     assert parallel.distributed.restore_latest(wf, str(tmp_path)) is False
+
+
+def test_ulysses_attention_matches_reference():
+    import jax.numpy as jnp
+    from veles_tpu.parallel.ulysses import ulysses_attention
+    rng = numpy.random.RandomState(2)
+    b, t, h, d = 2, 32, 8, 4
+    q, k, v = [jnp.asarray(rng.randn(b, t, h, d).astype(numpy.float32))
+               for _ in range(3)]
+    mesh = seq_mesh(4)
+    for causal in (False, True):
+        out = ulysses_attention(q, k, v, mesh, causal=causal)
+        ref = attention_reference(q, k, v, causal=causal)
+        numpy.testing.assert_allclose(numpy.asarray(out),
+                                      numpy.asarray(ref),
+                                      rtol=2e-4, atol=2e-5)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    import jax.numpy as jnp
+    from veles_tpu.parallel.ulysses import ulysses_attention
+    q = jnp.zeros((1, 16, 3, 4))
+    with pytest.raises(ValueError):
+        ulysses_attention(q, q, q, seq_mesh(4))
+
+
+def test_mha_routes_by_sequence_parallel_config():
+    """With sequence_parallel='ulysses' and divisible heads, the unit
+    output still matches the numpy oracle on a dp×sp mesh."""
+    import veles_tpu as vt
+    from veles_tpu import nn
+    from veles_tpu.memory import Array
+    prev_dtype = vt.root.common.engine.compute_dtype
+    prev_scheme = vt.root.common.engine.sequence_parallel
+    vt.root.common.engine.compute_dtype = "float32"
+    vt.root.common.engine.sequence_parallel = "ulysses"
+    try:
+        wf = vt.Workflow(name="t")
+        u = nn.MultiHeadAttention(wf, n_heads=4, causal=True)
+        x = numpy.random.RandomState(0).randn(2, 16, 8).astype(
+            numpy.float32)
+        u.input = Array(x)
+        u.initialize(device=vt.XLADevice(
+            mesh_axes={"data": 2, "sequence": 4}))
+        assert u.mesh is not None
+        u.xla_run()
+        y = numpy.asarray(u.output.map_read())
+        y_np = u.numpy_apply(u.params_np(), x)
+        numpy.testing.assert_allclose(y, y_np, rtol=1e-3, atol=1e-4)
+    finally:
+        vt.root.common.engine.compute_dtype = prev_dtype
+        vt.root.common.engine.sequence_parallel = prev_scheme

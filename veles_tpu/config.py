@@ -149,8 +149,15 @@ def _default_root() -> Config:
             "sync_run": False,       # block after each step (profiling aid)
             "force_numpy": False,    # run numpy oracle instead of XLA
             # pallas flash-attention kernel for the single-chip attention
-            # core (falls back automatically when shapes don't qualify)
+            # core. True = use it when compiled on a TPU backend and the
+            # shapes qualify; False = always the fused XLA reference;
+            # "force" = run it even off-TPU via pallas interpret mode
+            # (slow — test harness use only)
             "flash_attention": True,
+            # long-context scheme over the 'sequence' mesh axis:
+            # "ring" (K/V rotation, memory-flat in T) or "ulysses"
+            # (all-to-all head re-sharding; needs heads % n_seq == 0)
+            "sequence_parallel": "ring",
         },
         "mesh": {
             # logical mesh axes reserved up front (SURVEY.md §5.7/§5.8):

@@ -18,7 +18,6 @@ user ``line_parser``.
 
 from __future__ import annotations
 
-import json
 import pickle
 import urllib.parse
 import urllib.request
@@ -28,6 +27,27 @@ import numpy
 
 from ..error import VelesError
 from .fullbatch import FullBatchLoader
+
+
+def _load_splits(loader: FullBatchLoader, paths, read_fn) -> None:
+    """Shared (test, validation, train) aggregation: read each configured
+    split with ``read_fn(path) -> (data, labels)`` and install the
+    concatenated dataset on ``loader``."""
+    datas, lbls, lengths = [], [], []
+    for path in paths:
+        if not path:
+            lengths.append(0)
+            continue
+        d, l = read_fn(path)
+        datas.append(d)
+        lbls.append(l)
+        lengths.append(len(d))
+    if not datas:
+        raise VelesError("%s: no databases/paths configured (all three "
+                         "split entries are empty)" % loader.name)
+    loader.create_originals(numpy.concatenate(datas),
+                            numpy.concatenate(lbls))
+    loader.class_lengths = lengths
 
 
 class LMDBLoader(FullBatchLoader):
@@ -72,18 +92,7 @@ class LMDBLoader(FullBatchLoader):
                                                    dtype=numpy.int32)
 
     def load_data(self) -> None:
-        datas, lbls, lengths = [], [], []
-        for path in self.databases:
-            if not path:
-                lengths.append(0)
-                continue
-            d, l = self._read_db(path)
-            datas.append(d)
-            lbls.append(l)
-            lengths.append(len(d))
-        self.create_originals(numpy.concatenate(datas),
-                              numpy.concatenate(lbls))
-        self.class_lengths = lengths
+        _load_splits(self, self.databases, self._read_db)
 
 
 def parse_tsv_line(line: str) -> Tuple[numpy.ndarray, int]:
@@ -135,15 +144,5 @@ class HDFSTextLoader(FullBatchLoader):
                                                    dtype=numpy.int32)
 
     def load_data(self) -> None:
-        datas, lbls, lengths = [], [], []
-        for path in self.paths:
-            if not path:
-                lengths.append(0)
-                continue
-            d, l = self.parse_text(self._webhdfs_open(path))
-            datas.append(d)
-            lbls.append(l)
-            lengths.append(len(d))
-        self.create_originals(numpy.concatenate(datas),
-                              numpy.concatenate(lbls))
-        self.class_lengths = lengths
+        _load_splits(self, self.paths,
+                     lambda p: self.parse_text(self._webhdfs_open(p)))

@@ -34,6 +34,7 @@ from .zerofill import ZeroFiller  # noqa
 from .image_saver import ImageSaver  # noqa
 from .nn_plotting import Weights2D, KohonenHits  # noqa
 from .attention import MultiHeadAttention  # noqa
+from .moe import MoEFFN  # noqa
 from .variants import (All2AllRProp, GDRProp,
                        ResizableAll2All)  # noqa
 from .train_step import TrainStep  # noqa

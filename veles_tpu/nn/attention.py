@@ -87,7 +87,15 @@ class MultiHeadAttention(ForwardBase):
         use_flash = (flash_cfg == "force" or
                      (flash_cfg and jax.default_backend() == "tpu"))
         if self.mesh is not None:
-            o = ring_attention(q, k, v, self.mesh, causal=self.causal)
+            scheme = root.common.engine.sequence_parallel
+            n_seq = self.mesh.shape["sequence"]
+            if scheme == "ulysses" and self.n_heads % n_seq == 0:
+                from ..parallel.ulysses import ulysses_attention
+                o = ulysses_attention(q, k, v, self.mesh,
+                                      causal=self.causal)
+            else:
+                o = ring_attention(q, k, v, self.mesh,
+                                   causal=self.causal)
         elif use_flash and fa.supported(t, d // self.n_heads):
             # pallas kernel: no (T, T) score materialization in HBM
             o = fa.flash_attention(q, k, v, causal=self.causal)

@@ -1,0 +1,101 @@
+"""Mixture-of-Experts feed-forward layer (`expert` mesh axis consumer).
+
+New capability vs the reference (the SURVEY §5.7 mesh vocabulary
+reserves an ``expert`` axis; nothing in the 2015 codebase uses one).
+Soft (dense) mixture: every expert computes, the router's softmax
+weights combine — exact, differentiable, and shardable purely through
+GSPMD annotations: the expert-leading parameters shard over the
+``expert`` axis (parallel/sharding.py) and XLA partitions the einsum,
+no hand-written dispatch. Sparse top-k dispatch with all-to-all is the
+production-scale follow-up; the dense form is the correctness anchor it
+would be tested against (the framework's "oracle first" discipline).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy
+
+from ..memory import Array
+from .. import prng
+from .nn_units import ForwardBase, GradientDescentBase, matches
+
+
+class MoEFFN(ForwardBase):
+    """y = Σ_e softmax(x·router)_e · FFN_e(x); input (B, D) or (B, T, D)."""
+
+    MAPPING = "moe_ffn"
+    PARAMETERIZED = True
+    hide_from_registry = False
+    PARAM_NAMES = ("router", "w1", "b1", "w2", "b2")
+
+    def __init__(self, workflow, n_experts: int = 4,
+                 hidden: int = 0, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        self.n_experts = int(n_experts)
+        self.hidden = int(hidden)
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        d = self.input.shape[-1]
+        f = self.hidden or 4 * d
+        e = self.n_experts
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(d))
+
+        def mk(name, shape, scale):
+            w = numpy.zeros(shape, dtype="float32")
+            prng.get("%s.%s" % (self.name, name)).fill_normal(w, scale)
+            return Array(w, name="%s.%s" % (self.name, name))
+
+        return {
+            "router": mk("router", (d, e), stddev),
+            "w1": mk("w1", (e, d, f), stddev),
+            "b1": Array(numpy.zeros((e, f), "float32"),
+                        name=self.name + ".b1"),
+            "w2": mk("w2", (e, f, d), 1.0 / numpy.sqrt(f)),
+            "b2": Array(numpy.zeros((e, d), "float32"),
+                        name=self.name + ".b2"),
+        }
+
+    @staticmethod
+    def _mix(params, x, np_mod, precision=None):
+        """Shared fwd math; x: (tokens, D)."""
+        def ein(expr, *ops):
+            if precision is None:
+                return np_mod.einsum(expr, *ops)
+            return np_mod.einsum(expr, *ops, precision=precision)
+
+        logits = ein("nd,de->ne", x, params["router"])        # (N, E)
+        z = logits - logits.max(axis=-1, keepdims=True)
+        gates = np_mod.exp(z)
+        gates = gates / gates.sum(axis=-1, keepdims=True)
+        h = ein("nd,edf->nef", x, params["w1"]) + params["b1"][None]
+        h = np_mod.tanh(h)
+        y = ein("nef,efd->ned", h, params["w2"]) + params["b2"][None]
+        return ein("ne,ned->nd", gates, y)
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax.numpy as jnp
+        from ..ops import matmul_precision
+        shape = x.shape
+        y = self._mix(params, x.reshape(-1, shape[-1]), jnp,
+                      precision=matmul_precision())
+        return y.reshape(shape)
+
+    def numpy_apply(self, params, x):
+        x = numpy.asarray(x, dtype=numpy.float32)
+        shape = x.shape
+        y = self._mix(params, x.reshape(-1, shape[-1]), numpy)
+        return y.reshape(shape)
+
+
+@matches(MoEFFN)
+class GDMoEFFN(GradientDescentBase):
+    """Standard SGD rule over the expert parameter tree."""
+
+    MAPPING = "gd_moe_ffn"
+    hide_from_registry = False

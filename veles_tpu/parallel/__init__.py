@@ -25,3 +25,4 @@ from .sharding import (param_shardings, batch_sharding,
 from .distributed import (initialize_multihost, is_coordinator,
                           process_count)                  # noqa: F401
 from .ring_attention import ring_attention                # noqa: F401
+from .ulysses import ulysses_attention                    # noqa: F401

@@ -1,0 +1,96 @@
+"""Pipeline parallelism: GPipe-style microbatched stage pipeline.
+
+New capability vs the reference (SURVEY.md §2.4 row "Model-parallel /
+pipeline — absent; new capability"). The standard TPU formulation (the
+scaling-book recipe): each device on the ``pipeline`` mesh axis holds one
+stage's parameters; microbatches ripple through, activations hopping
+stage-to-stage with ``ppermute`` inside ``shard_map``; the schedule runs
+``M + n_stages - 1`` ticks (fill + drain). Differentiable end to end —
+``jax.grad`` through the scan/ppermute yields the reverse schedule
+automatically, so the fused train step can wrap a pipelined forward like
+any other pure function.
+
+This implementation handles the uniform-stage case (every stage maps an
+activation of shape S to shape S — e.g. a stack of residual blocks),
+which is the shape pipeline parallelism is actually used in.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+
+def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, xs: Any,
+          mesh, axis: str = "pipeline"):
+    """Run ``y_m = fn_{n-1}(…fn_0(x_m))`` for M microbatches.
+
+    - ``fn(params_slice, x)`` — one stage; same activation shape in/out.
+    - ``stage_params`` — pytree whose leaves have a leading ``n_stages``
+      axis (sharded over ``axis``; each device sees its slice with the
+      leading axis of size 1).
+    - ``xs`` — (M, mb, …) microbatches, replicated.
+
+    Returns (M, mb, …) outputs, replicated.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis]
+    m = xs.shape[0]
+    ticks = m + n - 1
+
+    def local(params, x_all):
+        # params leaves: (1, …) — this stage's slice
+        my_params = jax.tree_util.tree_map(lambda p: p[0], params)
+        idx = jax.lax.axis_index(axis)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        zero = jnp.zeros_like(x_all[0])
+
+        def tick(carry, t):
+            buf, outputs = carry
+            # stage 0 injects microbatch t (garbage after the fill phase —
+            # those lanes never reach a collected slot)
+            inject = x_all[jnp.clip(t, 0, m - 1)]
+            inp = jnp.where(idx == 0, inject, buf)
+            y = fn(my_params, inp)
+            # the LAST stage emits microbatch (t - (n-1)) at tick t
+            out_slot = t - (n - 1)
+            collect = jnp.logical_and(idx == n - 1, out_slot >= 0)
+            outputs = jax.lax.cond(
+                collect,
+                lambda o: jax.lax.dynamic_update_index_in_dim(
+                    o, y, jnp.maximum(out_slot, 0), 0),
+                lambda o: o, outputs)
+            buf = jax.lax.ppermute(y, axis, perm)
+            return (buf, outputs), None
+
+        outputs0 = jnp.zeros((m,) + x_all.shape[1:], x_all.dtype)
+        (_, outputs), _ = jax.lax.scan(tick, (zero, outputs0),
+                                       jnp.arange(ticks))
+        # only the last stage holds real outputs; psum replicates them
+        # (all other stages contribute zeros)
+        outputs = jnp.where(idx == n - 1, outputs, 0.0)
+        return jax.lax.psum(outputs, axis)
+
+    params_spec = jax.tree_util.tree_map(
+        lambda _: P(axis), stage_params)
+    fn_sharded = shard_map(
+        local, mesh=mesh,
+        in_specs=(params_spec, P()), out_specs=P(),
+        check_vma=False)
+    return fn_sharded(stage_params, xs)
+
+
+def microbatch(x, n_micro: int):
+    """(B, …) → (M, B/M, …); B must divide."""
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError("batch %d not divisible into %d microbatches"
+                         % (b, n_micro))
+    return x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+
+def unmicrobatch(y):
+    return y.reshape((-1,) + y.shape[2:])

@@ -38,9 +38,16 @@ def _spec_for(name: str, shape, mesh) -> tuple:
     sizes = dict(mesh.shape)
     tp = sizes.get("tensor", 1)
     fsdp = sizes.get("fsdp", 1)
+    ep = sizes.get("expert", 1)
     spec = [None] * len(shape)
     if name in ("bias",):
         # small vectors: replicating is cheaper than the gather traffic
+        return tuple(spec)
+    if ep > 1 and name in ("w1", "b1", "w2", "b2") and \
+            shape[0] % ep == 0:
+        # expert-leading MoE params shard over the expert axis; GSPMD
+        # partitions the expert einsum, no hand-written dispatch
+        spec[0] = "expert"
         return tuple(spec)
     if tp > 1 and len(shape) >= 2 and shape[-1] % tp == 0:
         # column parallel: split the output-features axis

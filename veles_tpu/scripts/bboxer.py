@@ -122,7 +122,7 @@ class BBoxerServer(Logger):
                     bytes_reply(self, 200, _PAGE.encode(), "text/html")
                 elif url.path == "/list":
                     json_reply(self, 200, {"images": server.images(),
-                                           "boxes": server.boxes})
+                                           "boxes": server.boxes_copy()})
                 elif url.path == "/image":
                     name = urllib.parse.parse_qs(url.query).get(
                         "name", [""])[0]
@@ -158,13 +158,21 @@ class BBoxerServer(Logger):
                         return
                     server.add_box(image, box)
                 json_reply(self, 200, {"ok": True,
-                                       "count": len(
-                                           server.boxes.get(image, []))})
+                                       "count": server.count(image)})
 
         self._service = HTTPService(Handler, port, "bboxer")
         self.port = self._service.port
 
     # -- state ---------------------------------------------------------------
+    def boxes_copy(self) -> Dict[str, List[dict]]:
+        """Snapshot under the lock: /list serializes while POSTs mutate."""
+        with self._lock:
+            return {k: list(v) for k, v in self.boxes.items()}
+
+    def count(self, image: str) -> int:
+        with self._lock:
+            return len(self.boxes.get(image, []))
+
     def images(self) -> List[str]:
         return sorted(
             f for f in os.listdir(self.image_dir)
@@ -190,8 +198,11 @@ class BBoxerServer(Logger):
             self._save()
 
     def _save(self) -> None:
-        with open(self.store_path, "w") as fout:
+        # atomic: a crash mid-write must never destroy prior annotations
+        tmp = self.store_path + ".tmp"
+        with open(tmp, "w") as fout:
             json.dump(self.boxes, fout, indent=1)
+        os.replace(tmp, self.store_path)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "BBoxerServer":
