@@ -117,3 +117,13 @@ def test_import_file_error_cleans_sys_modules(tmp_path):
     with pytest.raises(RuntimeError):
         import_file_as_module(str(p))
     assert "veles_model_broken_model" not in _sys.modules
+
+
+def test_debug_flag_and_rss(tiny_model):
+    """--debug Class enables that logger; max RSS logged at exit."""
+    from veles_tpu.logger import enable_debug
+    import logging
+    enable_debug("SomeUnitClass")
+    assert logging.getLogger("SomeUnitClass").level == logging.DEBUG
+    out = run_cli(str(tiny_model), "--debug", "Launcher")
+    assert "max RSS" in out.stderr + out.stdout

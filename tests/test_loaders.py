@@ -325,3 +325,72 @@ def test_ensemble_loader_stacks_member_outputs(tmp_path):
     loader.initialize(device=None)
     assert loader.original_data.shape == (n, 2 * k)
     assert loader.class_lengths == [0, 0, n]
+
+
+def test_label_diversity_check():
+    """χ² homogeneity of validation vs train labels (reference:
+    veles/loader/base.py:1007)."""
+    from veles_tpu.loader import FullBatchLoader
+
+    class Balanced(FullBatchLoader):
+        hide_from_registry = True
+
+        def load_data(self):
+            rng = numpy.random.RandomState(0)
+            labels = rng.randint(0, 4, 400).astype(numpy.int32)
+            self.create_originals(
+                rng.rand(400, 3).astype(numpy.float32), labels)
+            self.class_lengths = [0, 100, 300]
+
+    loader = Balanced(None, minibatch_size=10)
+    loader.initialize()
+    p = loader.check_label_diversity()
+    assert p is not None and p > 0.01
+
+    class Skewed(Balanced):
+        def load_data(self):
+            rng = numpy.random.RandomState(0)
+            labels = numpy.concatenate([
+                numpy.zeros(100, numpy.int32),           # valid: class 0
+                rng.randint(0, 4, 300).astype(numpy.int32)])
+            self.create_originals(
+                rng.rand(400, 3).astype(numpy.float32), labels)
+            self.class_lengths = [0, 100, 300]
+            self.shuffle_limit = 0
+
+    sk = Skewed(None, minibatch_size=10)
+    sk.load_data()
+    assert sk.check_label_diversity() < 0.01
+
+
+def test_label_check_runs_before_train_ratio_subset():
+    """The χ² check must see the full train block, not the post-subset
+    head (which could be class-ordered and falsely skewed)."""
+    from veles_tpu.loader import FullBatchLoader
+
+    class Ordered(FullBatchLoader):
+        hide_from_registry = True
+
+        def load_data(self):
+            rng = numpy.random.RandomState(0)
+            valid = rng.randint(0, 2, 100).astype(numpy.int32)
+            train = numpy.sort(rng.randint(0, 2, 300)).astype(numpy.int32)
+            labels = numpy.concatenate([valid, train])
+            self.create_originals(
+                rng.rand(400, 3).astype(numpy.float32), labels)
+            self.class_lengths = [0, 100, 300]
+
+    seen = {}
+    loader = Ordered(None, minibatch_size=10)
+    loader.train_ratio = 0.25
+    real = loader.check_label_diversity
+
+    def spy():
+        seen["train_len"] = loader.class_lengths[2]
+        return real()
+
+    loader.check_label_diversity = spy
+    loader.initialize()
+    # called with the FULL train block (300), not the 75-sample subset
+    assert seen["train_len"] == 300
+    assert loader.class_lengths[2] == 75

@@ -119,3 +119,10 @@ def test_moe_trains_in_standard_workflow_on_expert_mesh():
     assert not w1.sharding.is_fully_replicated      # expert-sharded
     wf.run()
     assert wf.gather_results()["best_err"] < 0.4
+
+
+def test_gpipe_rejects_wrong_stage_count():
+    params = make_params(8, 4)      # 8 stages on a 4-device mesh
+    x = jnp.zeros((8, 4))
+    with pytest.raises(ValueError):
+        gpipe(stage, params, microbatch(x, 4), pipe_mesh(4))

@@ -27,6 +27,9 @@ def main(argv=None) -> int:
     level = (logging.WARNING, logging.INFO,
              logging.DEBUG)[min(args.verbose, 2)]
     setup_logging(level=level, tracefile=args.trace_file)
+    if args.debug:
+        from .logger import enable_debug
+        enable_debug(args.debug)
 
     # config layering: file, then inline overrides; a bare root.x=y in the
     # config position is an override, not a file
@@ -170,6 +173,12 @@ def _drive(launcher: Launcher, workflow, args):
     for key, value in sorted(results.items()):
         if not isinstance(value, dict):
             launcher.info("result %s = %s", key, value)
+    try:        # peak memory at exit (reference: veles/__main__.py:791-797)
+        import resource
+        launcher.info("max RSS: %.1f MiB", resource.getrusage(
+            resource.RUSAGE_SELF).ru_maxrss / 1024.0)
+    except Exception:
+        pass
     if launcher.interrupted:
         sys.exit(130)   # Ctrl-C must not look like a completed run
     return results

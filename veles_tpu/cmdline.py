@@ -47,6 +47,9 @@ def make_parser() -> argparse.ArgumentParser:
                    help="append event spans as JSON lines here")
     p.add_argument("--force-numpy", action="store_true")
     p.add_argument("-v", "--verbose", action="count", default=0)
+    p.add_argument("--debug", default="", metavar="ClassA,ClassB",
+                   help="enable DEBUG for specific unit/class loggers "
+                        "('all' raises the root logger)")
     # observability services (reference graphics/web-status,
     # veles/graphics_server.py:73, veles/launcher.py:852-885)
     p.add_argument("--graphics", action="store_true",

@@ -118,6 +118,9 @@ class Loader(Unit):
         self.load_data()
         if self.total_samples == 0:
             raise NoMoreJobs("loader %s has no samples" % self.name)
+        # BEFORE train_ratio subsetting: the check must see the labels the
+        # class_lengths geometry still describes
+        self.check_label_diversity()
         self._shuffled_indices = numpy.arange(self.total_samples,
                                               dtype=numpy.int32)
         if self.train_ratio < 1.0 and self.class_lengths[TRAIN]:
@@ -146,6 +149,45 @@ class Loader(Unit):
             "%s: %d samples (test=%d validation=%d train=%d), mb=%d",
             self.name, self.total_samples, *self.class_lengths, n)
         return None
+
+    def check_label_diversity(self) -> Optional[float]:
+        """χ² homogeneity check of VALIDATION vs TRAIN label distributions
+        (reference: veles/loader/base.py:1007): a skewed split usually
+        means a broken loader. Warns; returns the p-value (None when not
+        applicable)."""
+        labels = getattr(self, "original_labels", None)
+        if labels is None or not labels:
+            return None
+        labels = numpy.asarray(labels.mem if hasattr(labels, "mem")
+                               else labels).ravel()
+        offs = self.class_end_offsets
+        valid = labels[offs[TEST]:offs[VALID]]
+        train = labels[offs[VALID]:offs[TRAIN]]
+        if len(valid) == 0 or len(train) == 0:
+            return None
+        classes = numpy.union1d(numpy.unique(valid), numpy.unique(train))
+        if len(classes) < 2:
+            return None
+        cv = numpy.array([(valid == c).sum() for c in classes], float)
+        ct = numpy.array([(train == c).sum() for c in classes], float)
+        # χ² two-sample homogeneity statistic
+        n1, n2 = cv.sum(), ct.sum()
+        pooled = (cv + ct) / (n1 + n2)
+        expected_v, expected_t = pooled * n1, pooled * n2
+        with numpy.errstate(divide="ignore", invalid="ignore"):
+            chi2 = numpy.nansum((cv - expected_v) ** 2 / expected_v +
+                                (ct - expected_t) ** 2 / expected_t)
+        try:        # optional dep, like lmdb/h5py: diagnostic only
+            from scipy.stats import chi2 as chi2_dist
+        except ImportError:
+            return None
+        p = float(chi2_dist.sf(chi2, df=len(classes) - 1))
+        if p < 0.01:
+            self.warning(
+                "%s: validation/train label distributions differ "
+                "(χ²=%.1f, p=%.2g) — check the dataset split",
+                self.name, chi2, p)
+        return p
 
     def shuffle(self) -> None:
         """Shuffle ONLY the train tail (reference: veles/loader/base.py

@@ -64,6 +64,19 @@ def clear_events() -> None:
         _event_ring.clear()
 
 
+def enable_debug(names) -> None:
+    """Per-class debug enable: ``--debug ClassA,ClassB`` sets just those
+    loggers to DEBUG (reference: veles/__main__.py:834-835); the name
+    ``all`` raises the root logger."""
+    import logging
+    if isinstance(names, str):
+        names = [n.strip() for n in names.split(",") if n.strip()]
+    for name in names:
+        target = logging.getLogger() if name == "all" \
+            else logging.getLogger(name)
+        target.setLevel(logging.DEBUG)
+
+
 class Logger:
     """Mixin granting ``self.logger`` plus debug/info/... helpers and
     :meth:`event` span recording (reference: veles/logger.py:59,264-289)."""

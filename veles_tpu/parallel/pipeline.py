@@ -40,6 +40,12 @@ def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, xs: Any,
     n = mesh.shape[axis]
     m = xs.shape[0]
     ticks = m + n - 1
+    for leaf in jax.tree_util.tree_leaves(stage_params):
+        if leaf.shape[0] != n:
+            raise ValueError(
+                "stage_params leading axis %d != %d pipeline stages "
+                "(a multiple would shard silently and drop stages)"
+                % (leaf.shape[0], n))
 
     def local(params, x_all):
         # params leaves: (1, …) — this stage's slice
