@@ -175,8 +175,11 @@ def _drive(launcher: Launcher, workflow, args):
             launcher.info("result %s = %s", key, value)
     try:        # peak memory at exit (reference: veles/__main__.py:791-797)
         import resource
+        # ru_maxrss units are platform-defined: KiB on Linux, bytes on
+        # Darwin
+        div = 1024.0 * 1024.0 if sys.platform == "darwin" else 1024.0
         launcher.info("max RSS: %.1f MiB", resource.getrusage(
-            resource.RUSAGE_SELF).ru_maxrss / 1024.0)
+            resource.RUSAGE_SELF).ru_maxrss / div)
     except Exception:
         pass
     if launcher.interrupted:

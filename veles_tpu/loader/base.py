@@ -156,10 +156,20 @@ class Loader(Unit):
         means a broken loader. Warns; returns the p-value (None when not
         applicable)."""
         labels = getattr(self, "original_labels", None)
-        if labels is None or not labels:
+        if labels is None:
             return None
-        labels = numpy.asarray(labels.mem if hasattr(labels, "mem")
-                               else labels).ravel()
+        if hasattr(labels, "mem"):      # veles_tpu Array
+            labels = labels.mem
+        if labels is None:              # Array allocated but empty
+            return None
+        labels = numpy.asarray(labels).ravel()
+        if labels.size == 0:
+            return None
+        try:        # optional dep, like lmdb/h5py: diagnostic only —
+            # probe before doing any counting work
+            from scipy.stats import chi2 as chi2_dist
+        except ImportError:
+            return None
         offs = self.class_end_offsets
         valid = labels[offs[TEST]:offs[VALID]]
         train = labels[offs[VALID]:offs[TRAIN]]
@@ -177,10 +187,6 @@ class Loader(Unit):
         with numpy.errstate(divide="ignore", invalid="ignore"):
             chi2 = numpy.nansum((cv - expected_v) ** 2 / expected_v +
                                 (ct - expected_t) ** 2 / expected_t)
-        try:        # optional dep, like lmdb/h5py: diagnostic only
-            from scipy.stats import chi2 as chi2_dist
-        except ImportError:
-            return None
         p = float(chi2_dist.sf(chi2, df=len(classes) - 1))
         if p < 0.01:
             self.warning(

@@ -45,6 +45,13 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
             and "JAX_COORDINATOR_ADDRESS" not in os.environ \
             and "COORDINATOR_ADDRESS" not in os.environ:
         return  # single host
+    # CPU processes talk gloo (the multi-host CI/loopback path — the
+    # reference's in-process master+slave tests, SURVEY.md §4); TPU pods
+    # use the native runtime and ignore this setting
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     try:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -41,11 +41,11 @@ def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, xs: Any,
     m = xs.shape[0]
     ticks = m + n - 1
     for leaf in jax.tree_util.tree_leaves(stage_params):
-        if leaf.shape[0] != n:
+        if leaf.ndim == 0 or leaf.shape[0] != n:
             raise ValueError(
-                "stage_params leading axis %d != %d pipeline stages "
-                "(a multiple would shard silently and drop stages)"
-                % (leaf.shape[0], n))
+                "stage_params leaves need a leading axis of exactly %d "
+                "pipeline stages, got shape %s (a multiple would shard "
+                "silently and drop stages)" % (n, leaf.shape))
 
     def local(params, x_all):
         # params leaves: (1, …) — this stage's slice
