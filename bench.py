@@ -1,43 +1,78 @@
 """Driver benchmark: prints ONE JSON line with the headline metric.
 
-Metric (BASELINE.json): Znicz MNIST-784 workflow training throughput,
-samples/sec/chip, on the fused SPMD step. The reference published no
-throughput numbers ("published": {}), so vs_baseline is against the first
-recorded number of this build (stored in BENCH_BASELINE.json after the
-first run; 1.0 on the first run).
+Two measurements, one line:
 
-Measurement note (re-baselined 2026-07-29): jax.block_until_ready is a
-no-op through the tunnelled-TPU transport, so the original baseline
-(3.07M samples/s) measured the *enqueue* rate, not compute. The benchmark
-now synchronizes by fetching a parameter scalar to the host (drains the
-in-order device stream); BENCH_BASELINE.json was re-recorded with the
-honest method.
+1. headline (BASELINE.json): Znicz MNIST-784 workflow training throughput,
+   samples/sec/chip, on the fused SPMD step. The reference published no
+   throughput numbers ("published": {}), so vs_baseline is against the
+   first recorded number of this build (BENCH_BASELINE.json). This config
+   is latency-bound through the tunnel — it proves dispatch amortization.
+2. extras[0]: the compute-bound proof — the ImagenetAE conv autoencoder
+   (models/imagenet_ae.build_bench_workflow) at 128x128, bf16 compute /
+   f32 accumulation, reporting samples/sec/chip, achieved model TFLOP/s
+   and MFU against the chip's nominal bf16 peak. This is where the MXU
+   actually works (BASELINE.json names ImagenetAE samples/sec/chip).
+
+Measurement notes (methodology fixed 2026-07-29, provenance stamped into
+the JSON):
+- jax.block_until_ready is a no-op through the tunnelled-TPU transport;
+  true sync = fetching a parameter scalar to the host ("host_fetch").
+- windows: median of 3 x 10 s (max recorded as a secondary field; the
+  median is the regression-detection number — best-of-N inflates).
+- MNIST plan_steps=600 (one epoch per dispatch; host round trips dominate
+  that config). AE plan_steps=16 (one epoch per dispatch at n_train=1024,
+  mb=64; compute dominates there).
+- FLOPs are analytic model FLOPs (2*spatial*weight_size per conv position,
+  x3 for training fwd+bwd), NOT hardware-counter FLOPs — the standard MFU
+  numerator.
 """
 import json
 import os
+import statistics
 import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.abspath(__file__)), "models"))
+
+#: nominal dense bf16 peak FLOP/s per chip by device kind (public numbers;
+#: substring-matched against jax device_kind, first hit wins)
+PEAK_BF16 = [
+    ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+]
 
 
-def main():
-    import veles_tpu as vt
-    sys.path.insert(0, os.path.join(os.path.dirname(
-        os.path.abspath(__file__)), "models"))
-    from mnist import build_workflow
+def host_sync(step):
+    """True device sync. jax.block_until_ready is a no-op through the
+    axon TPU tunnel — only a host transfer actually waits for the
+    compute stream, so fetch a scalar from the parameter tree."""
+    import jax
+    import numpy
+    leaf = jax.tree_util.tree_leaves(step.params)[0]
+    numpy.asarray(leaf.ravel()[0:1].astype("float32"))
 
-    dev = vt.Device_for("auto")
-    n_chips = getattr(dev, "device_count", 1)
 
-    # one whole epoch (600 train minibatches) per dispatch: host round
-    # trips are the dominant cost on the tunnelled chip (measured sweep:
-    # plan 50 → 0.47M, 150 → 1.0M, 300 → 1.5M, 600 → 1.9M samples/s)
-    wf = build_workflow(epochs=10 ** 9, minibatch_size=100)
-    wf.train_step.loader.plan_steps = 600
-    wf.loader.plan_steps = 600
-    wf.initialize(device=dev)
+def measure_windows(run_epoch, sync, n_windows=3, secs=10.0):
+    """Each window: >= secs wall time and >= 2 epochs, synced at the end.
+    Returns (per-window samples/sec, epoch counts, durations)."""
+    rates, epoch_counts, durations = [], [], []
+    for _ in range(n_windows):
+        t0 = time.time()
+        n = epochs = 0
+        while time.time() - t0 < secs or epochs < 2:
+            n += run_epoch()
+            epochs += 1
+        sync()
+        dt = time.time() - t0
+        rates.append(n / dt)
+        epoch_counts.append(epochs)
+        durations.append(dt)
+    return rates, epoch_counts, durations
 
+
+def epoch_runner(wf):
     loader, step = wf.loader, wf.train_step
 
     def run_epoch():
@@ -48,46 +83,138 @@ def main():
             if bool(loader.epoch_ended):
                 break
         return loader.samples_served - served0
+    return run_epoch
 
-    import numpy
 
-    def host_sync():
-        """True device sync. jax.block_until_ready is a no-op through the
-        axon TPU tunnel — only a host transfer actually waits for the
-        compute stream, so fetch a scalar from the parameter tree."""
-        import jax
-        leaf = jax.tree_util.tree_leaves(step.params)[0]
-        numpy.asarray(leaf.ravel()[0:1].astype("float32"))
+def model_flops_per_sample(wf):
+    """Analytic forward model-FLOPs per sample: 2 * spatial positions *
+    weight elements for convs (output spatial) / deconvs (input spatial),
+    2 * weight elements for dense. Pool/activation/bias FLOPs are noise
+    at MFU scale and excluded (standard practice)."""
+    from veles_tpu.nn.conv import Conv
+    from veles_tpu.nn.deconv import Deconv
+    total = 0
+    for f in wf.train_step.forwards:
+        if not f.PARAMETERIZED:
+            continue
+        w = f.param_arrays().get("weights")
+        if w is None:
+            continue
+        if isinstance(f, Conv):
+            _, oh, ow, _ = f.output.shape
+            total += 2 * oh * ow * w.mem.size
+        elif isinstance(f, Deconv):
+            _, ih, iw, _ = f.input.shape
+            total += 2 * ih * iw * w.mem.size
+        else:
+            total += 2 * w.mem.size
+    return total
 
+
+def bench_mnist(dev, n_chips):
+    from mnist import build_workflow
+    # one whole epoch (600 train minibatches) per dispatch: host round
+    # trips are the dominant cost on the tunnelled chip (measured sweep:
+    # plan 50 -> 0.47M, 150 -> 1.0M, 300 -> 1.5M, 600 -> 1.9M samples/s)
+    wf = build_workflow(epochs=10 ** 9, minibatch_size=100)
+    wf.train_step.loader.plan_steps = 600
+    wf.loader.plan_steps = 600
+    wf.initialize(device=dev)
+    run_epoch = epoch_runner(wf)
     run_epoch()                  # warmup: compile + first placement
-    host_sync()
-    # best of 3 windows: the tunnelled transport adds multi-hundred-ms
-    # latency jitter that a single window cannot average out
-    sps = 0.0
-    for _ in range(3):
-        t0 = time.time()
-        n = 0
-        epochs = 0
-        while time.time() - t0 < 10.0 or epochs < 2:
-            n += run_epoch()
-            epochs += 1
-        host_sync()
-        sps = max(sps, n / (time.time() - t0) / n_chips)
+    host_sync(wf.train_step)
+    rates, _, _ = measure_windows(run_epoch,
+                                  lambda: host_sync(wf.train_step))
+    from veles_tpu import datasets
+    return {
+        "samples_per_sec_per_chip": statistics.median(rates) / n_chips,
+        "max_window": max(rates) / n_chips,
+        "plan_steps": 600,
+        "data": "real" if datasets.mnist_is_real() else "synthetic",
+    }
 
+
+def bench_conv_ae(dev, n_chips):
+    from imagenet_ae import build_bench_workflow
+    wf = build_bench_workflow(image_size=128, minibatch_size=64,
+                              n_train=1024, n_valid=128)
+    wf.initialize(device=dev)
+    fwd_flops = model_flops_per_sample(wf)
+    loader = wf.loader
+    # per-epoch model FLOPs: train x3 (fwd + bwd), valid x1 (eval fwd)
+    epoch_flops = (loader.class_lengths[2] * 3 * fwd_flops
+                   + loader.class_lengths[1] * fwd_flops)
+    run_epoch = epoch_runner(wf)
+    run_epoch()
+    host_sync(wf.train_step)
+    rates, epochs, durs = measure_windows(
+        run_epoch, lambda: host_sync(wf.train_step))
+    tflops = statistics.median(
+        [e * epoch_flops / d for e, d in zip(epochs, durs)]) / 1e12
+    import jax
+    kind = getattr(jax.devices()[0], "device_kind", "unknown")
+    peak = next((p for key, p in PEAK_BF16 if key in str(kind).lower()),
+                275e12)
+    from veles_tpu.config import root
+    return {
+        "metric": "imagenet_ae_train_samples_per_sec_per_chip",
+        "samples_per_sec_per_chip": statistics.median(rates) / n_chips,
+        "max_window": max(rates) / n_chips,
+        "model_tflops_per_sec_per_chip": tflops / n_chips,
+        "mfu": tflops / n_chips / (peak / 1e12),
+        "peak_bf16_tflops_assumed": peak / 1e12,
+        "fwd_gflops_per_sample": fwd_flops / 1e9,
+        "image_size": 128, "minibatch": 64, "plan_steps":
+            wf.loader.plan_steps,
+        "compute_dtype": str(root.common.engine.compute_dtype),
+        "data": "synthetic",
+    }
+
+
+def main():
+    import veles_tpu as vt
+
+    dev = vt.Device_for("auto")
+    n_chips = getattr(dev, "device_count", 1)
+
+    mnist = bench_mnist(dev, n_chips)
+    ae = bench_conv_ae(dev, n_chips)
+
+    sps = mnist["samples_per_sec_per_chip"]
+    method = "median_of_3x10s"
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BENCH_BASELINE.json")
+    rebaselined = False
+    base = None
     if os.path.exists(base_path):
         with open(base_path) as f:
-            base = json.load(f)["value"]
-    else:
+            stored = json.load(f)
+        # comparable only when recorded with the same window statistic —
+        # the r1 baseline used best-of-3 (max), which would make every
+        # median-based run read as a phantom regression
+        if stored.get("method") == method:
+            base = stored["value"]
+    if base is None:
         base = sps
+        rebaselined = True
         with open(base_path, "w") as f:
-            json.dump({"value": sps, "ts": time.time()}, f)
+            json.dump({"value": sps, "method": method,
+                       "ts": time.time()}, f)
+    import jax
     print(json.dumps({
         "metric": "mnist784_train_samples_per_sec_per_chip",
         "value": round(sps, 1),
         "unit": "samples/sec/chip",
         "vs_baseline": round(sps / base, 3),
+        "rebaselined": rebaselined,
+        "window": method,
+        "max_window": round(mnist["max_window"], 1),
+        "data": mnist["data"],
+        "plan_steps": mnist["plan_steps"],
+        "sync": "host_fetch",
+        "device_kind": str(getattr(jax.devices()[0], "device_kind",
+                                   "unknown")),
+        "extras": [ae],
     }))
 
 

@@ -394,3 +394,15 @@ def test_label_check_runs_before_train_ratio_subset():
     # called with the FULL train block (300), not the 75-sample subset
     assert seen["train_len"] == 300
     assert loader.class_lengths[2] == 75
+
+
+def test_lmdb_record_codec():
+    """LMDB records are data-only npy+label bytes decodable with
+    allow_pickle=False (the untrusted-database posture; pickle_records=True
+    is the documented legacy opt-in)."""
+    from veles_tpu.loader.kv_store import encode_record, decode_record
+    sample = numpy.random.RandomState(7).rand(4, 3).astype(numpy.float32)
+    rec = encode_record(sample, -12)
+    out, label = decode_record(rec)
+    assert label == -12
+    numpy.testing.assert_array_equal(out, sample)

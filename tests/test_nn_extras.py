@@ -152,3 +152,26 @@ def test_kohonen_hits_plotter(plotting_enabled):
     tr.xla_run()
     p.run()
     assert p.last_snapshot["matrix"].sum() == 40    # accumulates
+
+
+def test_zero_filler_holds_inside_fused_dispatch():
+    """ADVICE r1: with steps_per_dispatch>1 the mask must hold after every
+    optimizer update inside the scan, not just at dispatch boundaries —
+    ZeroFiller registers a param mask enforced by the compiled step."""
+    from tests.test_train_e2e import make_workflow
+    wf = make_workflow(minibatch_size=50)
+    fc = wf.forwards[0]
+    mask = numpy.ones((10, 16), dtype=numpy.float32)
+    mask[:, 8:] = 0.0                      # kill half the first layer
+    zf = nn.ZeroFiller(wf, target=fc, mask=mask)
+    wf.initialize(device=dev())
+    zf.run()                               # register with the fused step
+    assert fc.name in wf.train_step.param_masks
+    assert wf.train_step.loader.plan_steps > 1   # multi-step dispatch
+    # run a few dispatches of real training
+    for _ in range(3):
+        wf.loader.run()
+        wf.train_step.run()
+    w = numpy.asarray(wf.train_step.params[fc.name]["weights"])
+    assert (w[:, 8:] == 0).all(), "mask drifted inside the fused dispatch"
+    assert (w[:, :8] != 0).any()

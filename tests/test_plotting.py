@@ -1,7 +1,6 @@
 """Plotting subsystem tests (reference: veles/tests/test_plotting_units.py,
 graphics server/client round trip)."""
 import os
-import pickle
 import time
 
 import numpy
@@ -103,7 +102,7 @@ def test_graphics_pubsub_roundtrip(plotting_enabled):
     poller = zmq.Poller()
     poller.register(sub, zmq.POLLIN)
     assert poller.poll(5000), "snapshot not delivered over PUB/SUB"
-    snap = pickle.loads(sub.recv())
+    snap = graphics.unpack_snapshot(sub.recv())
     assert snap["name"] == p.name and snap["values"] == [2.5]
     assert server.snapshots[p.name]["values"] == [2.5]
     sub.close(linger=0)
@@ -136,3 +135,26 @@ def test_image_plotter_non_square_flat(plotting_enabled, tmp_path):
     p.run()
     assert p.last_snapshot["images"].shape == (3, 1, 10)
     graphics.render_snapshot(p.last_snapshot, str(tmp_path / "strip.png"))
+
+
+def test_pack_unpack_roundtrip():
+    """The graphics wire codec is data-only (no pickle): arrays — also
+    nested in lists (multi_histogram) — survive exactly, scalars/strings
+    pass through JSON."""
+    snap = {
+        "kind": "multi_histogram", "name": "hist",
+        "counts": [numpy.arange(4.0), numpy.arange(3.0) * 2],
+        "edges": [numpy.linspace(0, 1, 5), numpy.linspace(0, 1, 4)],
+        "matrix": numpy.eye(3, dtype=numpy.float32),
+        "label": "x", "ylim": (0.0, 1.0), "n": 7,
+    }
+    out = graphics.unpack_snapshot(graphics.pack_snapshot(snap))
+    assert out["kind"] == "multi_histogram" and out["name"] == "hist"
+    assert out["label"] == "x" and out["n"] == 7
+    assert list(out["ylim"]) == [0.0, 1.0]
+    numpy.testing.assert_array_equal(out["matrix"], snap["matrix"])
+    for a, b in zip(out["counts"], snap["counts"]):
+        numpy.testing.assert_array_equal(a, b)
+    assert out["matrix"].dtype == numpy.float32
+    # frames must not be unpicklable payloads: codec never calls pickle
+    assert b"pickle" not in graphics.pack_snapshot(snap)

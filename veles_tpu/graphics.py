@@ -19,18 +19,73 @@ that in-process path is what tests and the Publisher use.
 
 from __future__ import annotations
 
+import io
+import json
 import os
-import pickle
+import struct
 import subprocess
 import sys
 import tempfile
 from typing import Any, Dict, List, Optional
 
+import numpy
+
 from .config import root
 from .logger import Logger
 from .plotter import PlotSink
 
-PROTOCOL = 4  # stable across supported interpreters
+
+# ---------------------------------------------------------------------------
+# Wire codec: JSON header + npz payload. Snapshots are declarative data
+# (scalars, strings, numpy arrays — see plotter.py), so the frame format is
+# data-only by construction: the renderer subprocess never unpickles, which
+# closes the deserialization surface the reference's pickled-Plotter protocol
+# had (veles/graphics_client.py:84 executed pickled framework objects).
+# ---------------------------------------------------------------------------
+
+def pack_snapshot(snapshot: Dict[str, Any]) -> bytes:
+    """Encode a snapshot as ``<u32 header len><JSON header><npz arrays>``.
+    Arrays (including arrays nested in lists) become npz entries referenced
+    from the header; everything else must be JSON-serializable."""
+    arrays: List[numpy.ndarray] = []
+
+    def enc(v):
+        if isinstance(v, numpy.ndarray):
+            arrays.append(v)
+            return {"__npy__": len(arrays) - 1}
+        if isinstance(v, (list, tuple)):
+            return {"__seq__": [enc(x) for x in v]}
+        if isinstance(v, dict):
+            return {k: enc(x) for k, x in v.items()}
+        if isinstance(v, numpy.integer):
+            return int(v)
+        if isinstance(v, (numpy.floating, numpy.bool_)):
+            return v.item()
+        return v
+
+    header = json.dumps({k: enc(v) for k, v in snapshot.items()}).encode()
+    buf = io.BytesIO()
+    numpy.savez(buf, **{"a%d" % i: a for i, a in enumerate(arrays)})
+    return struct.pack("<I", len(header)) + header + buf.getvalue()
+
+
+def unpack_snapshot(frame: bytes) -> Dict[str, Any]:
+    """Inverse of :func:`pack_snapshot`; never unpickles
+    (``allow_pickle=False``)."""
+    (hlen,) = struct.unpack_from("<I", frame)
+    meta = json.loads(frame[4:4 + hlen].decode())
+    npz = numpy.load(io.BytesIO(frame[4 + hlen:]), allow_pickle=False)
+
+    def dec(v):
+        if isinstance(v, dict):
+            if "__npy__" in v:
+                return npz["a%d" % v["__npy__"]]
+            if "__seq__" in v:
+                return [dec(x) for x in v["__seq__"]]
+            return {k: dec(x) for k, x in v.items()}
+        return v
+
+    return {k: dec(v) for k, v in meta.items()}
 
 
 def safe_name(name: str) -> str:
@@ -84,7 +139,7 @@ class GraphicsServer(PlotSink, Logger):
         if self._zmq_socket is not None:
             try:
                 self._zmq_socket.send(
-                    pickle.dumps(snapshot, protocol=PROTOCOL),
+                    pack_snapshot(snapshot),
                     flags=getattr(__import__("zmq"), "NOBLOCK", 1))
             except Exception as e:      # PUB drops are fine; never stall
                 self.debug("snapshot drop: %s", e)
@@ -132,8 +187,7 @@ class GraphicsServer(PlotSink, Logger):
         if self._zmq_socket is not None:
             try:
                 self._zmq_socket.send(
-                    pickle.dumps({"kind": "__stop__", "name": "__stop__"},
-                                 protocol=PROTOCOL))
+                    pack_snapshot({"kind": "__stop__", "name": "__stop__"}))
                 self._zmq_socket.close(linger=200)
             except Exception:
                 pass
@@ -278,7 +332,7 @@ def client_main(argv: Optional[List[str]] = None) -> int:
     sock.connect(args.endpoint)
     sock.setsockopt(zmq.SUBSCRIBE, b"")
     while True:
-        snap = pickle.loads(sock.recv())
+        snap = unpack_snapshot(sock.recv())
         if snap.get("kind") == "__stop__":
             break
         name = safe_name(snap["name"])

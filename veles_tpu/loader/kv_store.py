@@ -7,9 +7,13 @@ optional in this environment (``lmdb`` wheel / a reachable HDFS namenode):
 the loaders gate cleanly with an actionable error, and the parsing layer
 is importable and tested without the backing store.
 
-LMDB records follow the Caffe-era convention the reference consumed:
-``value = pickle((numpy sample, int label))`` (we use pickle where Caffe
-used its Datum protobuf — no proto dependency).
+LMDB records use a data-only format where Caffe used its Datum protobuf:
+``value = <i32 label little-endian><.npy sample bytes>`` — decoded with
+``numpy.load(allow_pickle=False)``, so reading a database can never
+execute code. The reference-era convention ``value = pickle((sample,
+label))`` is still readable via ``pickle_records=True``, but that is an
+explicit trust statement: **unpickling an LMDB from an untrusted source
+executes arbitrary code**; only enable it for databases you created.
 
 HDFS text is served through WebHDFS (stdlib HTTP; the reference used the
 ``hdfs`` package's InsecureClient) — one sample per line, parsed by a
@@ -18,7 +22,8 @@ user ``line_parser``.
 
 from __future__ import annotations
 
-import pickle
+import io
+import struct
 import urllib.parse
 import urllib.request
 from typing import Callable, List, Optional, Sequence, Tuple
@@ -27,6 +32,20 @@ import numpy
 
 from ..error import VelesError
 from .fullbatch import FullBatchLoader
+
+
+def encode_record(sample: numpy.ndarray, label: int) -> bytes:
+    """(sample, label) → the data-only LMDB record format."""
+    buf = io.BytesIO()
+    numpy.save(buf, numpy.asarray(sample))
+    return struct.pack("<i", int(label)) + buf.getvalue()
+
+
+def decode_record(value: bytes) -> Tuple[numpy.ndarray, int]:
+    """Inverse of :func:`encode_record`; never unpickles."""
+    (label,) = struct.unpack_from("<i", value)
+    sample = numpy.load(io.BytesIO(value[4:]), allow_pickle=False)
+    return sample, label
 
 
 def _load_splits(loader: FullBatchLoader, paths, read_fn) -> None:
@@ -58,15 +77,17 @@ class LMDBLoader(FullBatchLoader):
     hide_from_registry = False
 
     def __init__(self, workflow, databases: Sequence[Optional[str]] = (),
-                 **kwargs) -> None:
+                 pickle_records: bool = False, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         if len(databases) != 3:
             raise VelesError(
                 "databases must be (test, validation, train) paths")
         self.databases = list(databases)
+        #: SECURITY: legacy reference-era records are pickled tuples;
+        #: enabling this executes whatever the database author pickled.
+        self.pickle_records = bool(pickle_records)
 
-    @staticmethod
-    def _read_db(path: str) -> Tuple[numpy.ndarray, numpy.ndarray]:
+    def _read_db(self, path: str) -> Tuple[numpy.ndarray, numpy.ndarray]:
         try:
             import lmdb
         except ImportError:
@@ -74,13 +95,18 @@ class LMDBLoader(FullBatchLoader):
                 "LMDBLoader needs the 'lmdb' package (not installed in "
                 "this environment); convert the dataset with "
                 "PicklesLoader or FullBatchLoader instead")
+        if self.pickle_records:
+            import pickle
+            decode = pickle.loads
+        else:
+            decode = decode_record
         samples: List[numpy.ndarray] = []
         labels: List[int] = []
         env = lmdb.open(path, readonly=True, lock=False)
         try:
             with env.begin() as txn:
                 for _key, value in txn.cursor():
-                    sample, label = pickle.loads(value)
+                    sample, label = decode(value)
                     samples.append(numpy.asarray(sample,
                                                  dtype=numpy.float32))
                     labels.append(int(label))

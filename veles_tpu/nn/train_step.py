@@ -63,6 +63,12 @@ class TrainStep(AcceleratedUnit):
         self.evaluation_mode = False
         self.params: Dict[str, Dict[str, Any]] = {}
         self.opt_state: Dict[str, Dict[str, Any]] = {}
+        #: {unit name: {param key: mask array}} — applied multiplicatively
+        #: after EVERY optimizer update inside the fused step (ZeroFiller's
+        #: sparsity contract must hold within a multi-step dispatch, not
+        #: just at dispatch boundaries)
+        self.param_masks: Dict[str, Dict[str, Any]] = {}
+        self._param_masks_np: Dict[Any, numpy.ndarray] = {}
         self._accum: Dict[int, Any] = {}
         self._zero_accum = None
         self.last_loss = None
@@ -157,6 +163,27 @@ class TrainStep(AcceleratedUnit):
         self.opt_state = jax.tree_util.tree_map(
             jax.device_put, self.opt_state, pspec)
 
+    def register_param_mask(self, unit_name: str, key: str, mask) -> None:
+        """Install (or refresh) a sparsity mask enforced after every update
+        inside the compiled step. Masks are baked into the jitted program as
+        constants, so (re)registration invalidates the jit cache — callers
+        re-registering an identical mask are a no-op (checked host-side:
+        no device transfer or stream sync on the steady-state path)."""
+        m_np = numpy.asarray(mask)
+        cur_np = self._param_masks_np.get((unit_name, key))
+        if cur_np is not None and numpy.array_equal(cur_np, m_np):
+            return
+        self._param_masks_np[(unit_name, key)] = m_np
+        import jax.numpy as jnp
+        m = jnp.asarray(m_np)
+        self.param_masks.setdefault(unit_name, {})[key] = m
+        self._jit_cache.clear()
+        # enforce immediately on the canonical pytree too
+        if self.params.get(unit_name) and key in self.params[unit_name]:
+            p = dict(self.params[unit_name])
+            p[key] = p[key] * m.astype(p[key].dtype)
+            self.params[unit_name] = p
+
     # -- pure functions -------------------------------------------------------
     def _forward_pure(self, params, x, train: bool, rng):
         """Compose the forward chain; softmax head yields logits for the
@@ -211,6 +238,13 @@ class TrainStep(AcceleratedUnit):
             new_opt[name] = jax.tree_util.tree_map(
                 lambda new, old: jnp.where(valid, new, old), up_s,
                 opt_state[name])
+        for name, masks in self.param_masks.items():
+            if name in new_params:
+                for k, m in masks.items():
+                    # cast: the product must keep the param dtype or the
+                    # scan carry structure would change
+                    new_params[name][k] = (new_params[name][k]
+                                           * m.astype(new_params[name][k].dtype))
         metrics = self.evaluator.metrics_fn(out, tgt, mask)
         metrics["sum_loss"] = loss * mask.sum()
         accum = jax.tree_util.tree_map(
@@ -400,4 +434,7 @@ class TrainStep(AcceleratedUnit):
         for k in ("params", "opt_state", "_accum", "_zero_accum",
                   "last_loss"):
             d[k] = {} if k in ("params", "opt_state", "_accum") else None
+        d["param_masks"] = {
+            n: {k: numpy.asarray(m) for k, m in ms.items()}
+            for n, ms in self.param_masks.items()}
         return d

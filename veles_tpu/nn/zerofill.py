@@ -2,10 +2,12 @@
 
 Equivalent of Znicz ``weights_zerofilling`` (reference surface: SURVEY.md
 §2.8): after every update, masked weight entries are forced back to zero
-— used for grouped/local connectivity experiments. The mask multiply is a
-device-side elementwise op; when the target participates in the fused
-train step the mask is applied to the step's parameter tree, otherwise to
-the unit's own weight Array.
+— used for grouped/local connectivity experiments. When the target
+participates in the fused train step the mask is *registered with the
+step* and applied after every optimizer update inside the compiled scan
+(so the contract holds within a multi-step dispatch, not merely at
+dispatch boundaries); otherwise it is a device-side elementwise multiply
+on the unit's own weight Array.
 """
 
 from __future__ import annotations
@@ -72,11 +74,10 @@ class ZeroFiller(Unit):
         step = getattr(self.workflow, "train_step", None)
         if step is not None and getattr(step, "params", None) and \
                 self.target.name in step.params:
-            import jax.numpy as jnp
-            p = dict(step.params[self.target.name])
-            p["weights"] = p["weights"] * jnp.asarray(
-                self.mask.map_read(), dtype=p["weights"].dtype)
-            step.params[self.target.name] = p
+            # enforced after EVERY update inside the fused scan; re-runs
+            # with an unchanged mask are a no-op (no recompile)
+            step.register_param_mask(self.target.name, "weights",
+                                     self.mask.map_read())
             return
         weights = self.target.weights
         if weights.devmem is not None:

@@ -128,8 +128,12 @@ class StatusReporter(Logger):
 
     def send(self, payload: Dict[str, Any]) -> bool:
         try:
+            # NumpyJSONEncoder: launcher payloads routinely carry numpy
+            # scalars (epoch metrics); plain json.dumps would raise and the
+            # beacon would be silently dropped
+            from .json_encoders import dumps as np_dumps
             req = urllib.request.Request(
-                self.url, data=json.dumps(payload).encode(),
+                self.url, data=np_dumps(payload).encode(),
                 headers={"Content-Type": "application/json"})
             with urllib.request.urlopen(req, timeout=5) as resp:
                 return resp.status == 200
