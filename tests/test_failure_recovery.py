@@ -1,0 +1,180 @@
+"""Failure story, wired end-to-end (SURVEY.md §5.3).
+
+The reference ran its failure machinery in production paths: the server's
+job-timeout dropper (veles/server.py:619-635), the client's random-death
+fault injection (veles/client.py:303-307,438-442), and snapshot-based
+disaster recovery. These tests assert the TPU build's equivalents are
+actually ARMED by the launcher — not just importable library functions:
+
+- Launcher wraps every TrainStep dispatch in the hang watchdog;
+- --slave-death-probability kills a real training subprocess mid-run;
+- rerunning the same command auto-resumes from the newest snapshot and
+  completes with sane metrics (kill-and-resume integration).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn
+from veles_tpu.config import root
+from veles_tpu.launcher import Launcher
+from veles_tpu.loader import FullBatchLoader
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TinyLoader(FullBatchLoader):
+    hide_from_registry = True
+
+    def load_data(self):
+        rng = numpy.random.RandomState(3)
+        self.create_originals(
+            rng.rand(150, 8).astype(numpy.float32),
+            rng.randint(0, 3, 150).astype(numpy.int32))
+        self.class_lengths = [0, 30, 120]
+
+
+def _workflow(snapshotter=None, **decision_kw):
+    return nn.StandardWorkflow(
+        name="failure-wiring",
+        layers=[{"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=TinyLoader(None, minibatch_size=24, name="l"),
+        loss_function="softmax",
+        decision_config=dict(max_epochs=2, **decision_kw),
+        snapshotter_unit=snapshotter)
+
+
+def test_launcher_arms_watchdog():
+    """Every dispatch must be timed into the launcher's step history —
+    proof the watchdog context manager wraps the production run path."""
+    launcher = Launcher(backend="cpu")
+    launcher.initialize(_workflow())
+    step = launcher.workflow.train_step
+    assert getattr(step, "_failure_hooks_armed", False)
+    launcher.run()
+    # 2 epochs × (1 train + 1 valid dispatch) = 4 watchdog'd dispatches
+    assert len(launcher.step_history) >= 4
+    assert all(t >= 0 for t in launcher.step_history)
+
+
+def test_try_restore_latest(tmp_path):
+    """Launcher-level elastic restart: newest snapshot in the workflow's
+    snapshot dir is applied, decision reopened."""
+    snap = vt.Snapshotter(None, prefix="rec", directory=str(tmp_path))
+    wf = _workflow(snapshotter=snap)
+    launcher = Launcher(backend="cpu")
+    launcher.initialize(wf)
+    launcher.run()
+    assert wf.decision.epoch_number == 2
+
+    snap2 = vt.Snapshotter(None, prefix="rec", directory=str(tmp_path))
+    wf2 = _workflow(snapshotter=snap2)
+    launcher2 = Launcher(backend="cpu")
+    launcher2.initialize(wf2)
+    assert launcher2.try_restore_latest() is True
+    assert wf2.decision.epoch_number == 2
+    assert not bool(wf2.decision.complete)
+
+
+def test_try_restore_latest_empty_dir(tmp_path):
+    snap = vt.Snapshotter(None, prefix="rec", directory=str(tmp_path))
+    wf = _workflow(snapshotter=snap)
+    launcher = Launcher(backend="cpu")
+    launcher.initialize(wf)
+    assert launcher.try_restore_latest() is False
+
+
+# -- subprocess integration: kill and resume --------------------------------
+
+MODEL_SRC = textwrap.dedent("""
+    import os
+    import numpy
+    import veles_tpu as vt
+    from veles_tpu import nn
+    from veles_tpu.loader import FullBatchLoader
+
+    class L(FullBatchLoader):
+        hide_from_registry = True
+        def load_data(self):
+            rng = numpy.random.RandomState(3)
+            centers = rng.randn(3, 8) * 3
+            y = rng.randint(0, 3, 300).astype(numpy.int32)
+            x = (centers[y] + rng.randn(300, 8)).astype(numpy.float32)
+            self.create_originals(x, y)
+            self.class_lengths = [0, 60, 240]
+
+    def build_workflow():
+        snap = vt.Snapshotter(None, prefix="rec")
+        return nn.StandardWorkflow(
+            name="recovery",
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 8},
+                {"type": "softmax", "output_sample_shape": 3},
+            ],
+            loader_unit=L(None, minibatch_size=24, name="l"),
+            loss_function="softmax",
+            decision_config=dict(
+                max_epochs=int(os.environ.get("MAX_EPOCHS", "4")),
+                fail_iterations=100),
+            snapshotter_unit=snap)
+""")
+
+
+def _run_cli(model, *argv, env_extra=None, timeout=240):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "veles_tpu", str(model), *argv,
+         "--backend", "cpu", "-v"],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout, env=env)
+
+
+@pytest.fixture(scope="module")
+def recovery_model(tmp_path_factory):
+    path = tmp_path_factory.mktemp("rec") / "recovery_model.py"
+    path.write_text(MODEL_SRC)
+    return path
+
+
+def test_fault_injection_kills_process(recovery_model, tmp_path):
+    """--slave-death-probability 1.0: the process must die with the
+    fault-injection exit code (42) instead of completing."""
+    r = _run_cli(recovery_model, "--snapshot-dir", str(tmp_path),
+                 "--slave-death-probability", "1.0", "--random-seed", "5")
+    assert r.returncode == 42, (r.returncode, r.stderr[-2000:])
+    assert "fault injection" in r.stderr
+
+
+def test_kill_and_resume_completes(recovery_model, tmp_path):
+    """The elastic-restart loop: run with random fault injection until the
+    job completes; every relaunch must pick up the newest snapshot. Seeds
+    are fixed per attempt (varying across attempts, as real restarts do),
+    so the whole trajectory is reproducible."""
+    res = tmp_path / "r.json"
+    deaths = resumes = 0
+    final = None
+    for attempt in range(10):
+        r = _run_cli(recovery_model, "--snapshot-dir", str(tmp_path),
+                     "--slave-death-probability", "0.3",
+                     "--random-seed", str(7 + attempt),
+                     "--result-file", str(res))
+        if "auto-resumed" in r.stderr:
+            resumes += 1
+        if r.returncode == 42:
+            deaths += 1
+            continue
+        assert r.returncode == 0, r.stderr[-2000:]
+        final = json.loads(res.read_text())
+        break
+    assert final is not None, "never completed in 10 attempts"
+    assert deaths >= 1, "fault injection never fired (p=0.3, seeded)"
+    assert resumes >= 1, "no relaunch ever auto-resumed"
+    assert final["epochs"] >= 4
+    assert final["best_err"] < 0.2

@@ -12,16 +12,19 @@ __version__ = "0.1.0"
 
 import os as _os
 
-if _os.environ.get("JAX_PLATFORMS"):
-    # Honor an explicit platform pin in EVERY process, including
-    # subprocesses the framework spawns (genetics candidates, ensemble
-    # members, multihost launcher children). Tunnelled-TPU plugins can
-    # override the JAX_PLATFORMS env var at import time, which would make
-    # a child ignore the parent's pin and block on hardware the parent
-    # never intended it to touch — the config key wins over the plugin.
+if _os.environ.get("JAX_PLATFORMS", "").lower() in ("cpu", "cpu,"):
+    # Honor a host-platform pin in EVERY process, including subprocesses
+    # the framework spawns (genetics candidates, ensemble members,
+    # multihost launcher children). Tunnelled-TPU plugins can override
+    # the JAX_PLATFORMS env var at import time, which would make a child
+    # ignore the parent's cpu pin and block on hardware the parent never
+    # intended it to touch — the config key wins over the plugin.
+    # Only the standard 'cpu' name is pinned: plugin platform names
+    # (e.g. 'axon') must resolve through the plugin's own env-var path —
+    # pinning them via jax.config breaks backend discovery entirely.
     import jax as _jax
 
-    _jax.config.update("jax_platforms", _os.environ["JAX_PLATFORMS"])
+    _jax.config.update("jax_platforms", "cpu")
 
 from .config import root                              # noqa: F401
 from .error import (VelesError, Bug, NoMoreJobs)      # noqa: F401

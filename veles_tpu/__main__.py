@@ -49,6 +49,8 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
     if args.slave_death_probability:
         root.common.slave_death_probability = args.slave_death_probability
+    if args.job_timeout:
+        root.common.job_timeout = args.job_timeout
     if args.snapshot_dir:
         root.common.dirs.snapshots = args.snapshot_dir
     if args.timings:
@@ -157,6 +159,11 @@ def _drive(launcher: Launcher, workflow, args):
     launcher.initialize(workflow)
     if args.snapshot:
         launcher.resume(args.snapshot)
+    elif args.snapshot_dir:
+        # elastic restart: rerunning the same command after a crash or
+        # preemption resumes from the newest snapshot automatically
+        # (reference disaster-recovery story, SURVEY.md §5.3)
+        launcher.try_restore_latest()
     if args.workflow_graph:
         with open(args.workflow_graph, "w") as fout:
             fout.write(workflow.generate_graph())

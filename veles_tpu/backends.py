@@ -38,6 +38,17 @@ class BackendRegistry(type):
             BackendRegistry.backends[backend] = cls
 
 
+def _resolve_dtype(name) -> numpy.dtype:
+    """numpy.dtype() extended with the ml_dtypes names (bfloat16 &c.) —
+    plain numpy does not know them, so NumpyDevice would crash on the
+    default bf16 compute policy."""
+    try:
+        return numpy.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return numpy.dtype(getattr(ml_dtypes, str(name)))
+
+
 class Device(Logger, metaclass=BackendRegistry):
     """Abstract device (reference: veles/backends.py:184)."""
 
@@ -45,8 +56,9 @@ class Device(Logger, metaclass=BackendRegistry):
 
     def __init__(self) -> None:
         super().__init__()
-        self.compute_dtype = numpy.dtype(root.common.engine.compute_dtype)
-        self.precision_dtype = numpy.dtype(
+        self.compute_dtype = _resolve_dtype(
+            root.common.engine.compute_dtype)
+        self.precision_dtype = _resolve_dtype(
             root.common.engine.precision_type)
 
     @property

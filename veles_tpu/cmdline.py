@@ -68,6 +68,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--slave-death-probability", type=float, default=0.0,
                    help="fault injection for recovery testing")
+    p.add_argument("--job-timeout", type=float, default=0.0,
+                   help="floor (seconds) for the per-dispatch hang "
+                        "watchdog; 0 keeps only the mean+3σ adaptive "
+                        "threshold (reference: veles/server.py:619-635)")
     # meta-learning (reference --optimize / --ensemble-train/-test,
     # veles/__main__.py:334-361,724-732)
     p.add_argument("--optimize", default=None, metavar="SIZE[:GENS]",

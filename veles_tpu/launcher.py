@@ -84,6 +84,7 @@ class Launcher(Logger):
             self.graphics_server.launch_client(out_dir=self._plots_dir)
         workflow.initialize(device=self.device)
         distributed.verify_checksums(workflow)
+        self._arm_failure_hooks(workflow)
         if self._status_url and distributed.is_coordinator():
             from .web_status import StatusReporter
             self.status_reporter = StatusReporter(
@@ -104,6 +105,52 @@ class Launcher(Logger):
             step.evaluation_mode = True
         if decision is not None:
             decision.max_epochs = decision.epoch_number + 1
+
+    def _arm_failure_hooks(self, workflow) -> None:
+        """Production wiring of the failure story (SURVEY.md §5.3): every
+        TrainStep dispatch runs under the hang watchdog (the reference's
+        job-timeout dropper, veles/server.py:619-635, as a local monitor)
+        and, when --slave-death-probability is set, rolls the
+        fault-injection die after each dispatch (veles/client.py:303-307)."""
+        step = getattr(workflow, "train_step", None)
+        if step is None or getattr(step, "_failure_hooks_armed", False):
+            return
+        death_p = float(
+            root.common.get("slave_death_probability", 0.0) or 0.0)
+        timeout = float(root.common.get("job_timeout", 0.0) or 0.0)
+        self.step_history = []      # per-dispatch wall times (telemetry)
+        inner_run = step.run
+
+        def armed_run():
+            with distributed.step_watchdog(
+                    step.name, timeout=timeout, history=self.step_history):
+                inner_run()
+            if death_p > 0:
+                distributed.fault_injection(death_p)
+        step.run = armed_run
+        step._failure_hooks_armed = True
+
+    def try_restore_latest(self) -> bool:
+        """Elastic restart: resume from the newest snapshot in the
+        configured snapshot directory, if any (preemption/crash recovery —
+        the reference's 'recover from any disaster' story,
+        docs/manualrst_veles_distributed_training.rst:10)."""
+        wf = self.workflow
+        directory, prefix = root.common.dirs.snapshots, "wf"
+        from .snapshotter import Snapshotter
+        for u in getattr(wf, "units", ()):
+            if isinstance(u, Snapshotter):
+                directory, prefix = u.directory, u.prefix
+                break
+        if not directory or not os.path.isdir(directory):
+            return False
+        if not distributed.restore_latest(wf, directory, prefix):
+            return False
+        decision = getattr(wf, "decision", None)
+        if decision is not None:
+            decision.complete <<= False
+        self.info("auto-resumed from latest snapshot in %s", directory)
+        return True
 
     def resume(self, snapshot_path: str) -> None:
         from .snapshotter import resume

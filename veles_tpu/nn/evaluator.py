@@ -98,10 +98,14 @@ class EvaluatorMSE(EvaluatorBase):
         self.root_normalize = root_normalize
 
     def loss(self, y, target, mask):
+        """Per-feature mean, like ``metrics_fn``'s rmse: keeps gradient
+        scale (and therefore usable learning rates) independent of the
+        output dimensionality — a sum-over-features loss made the conv AE
+        diverge at any lr that worked for small heads."""
         import jax.numpy as jnp
         y = y.astype(jnp.float32)
         target = target.astype(jnp.float32)
-        per_sample = jnp.sum(
+        per_sample = jnp.mean(
             jnp.square(y - target).reshape(y.shape[0], -1), axis=1)
         return jnp.sum(per_sample * mask) / jnp.maximum(jnp.sum(mask), 1)
 
@@ -117,5 +121,5 @@ class EvaluatorMSE(EvaluatorBase):
     def numpy_loss(self, y, target, mask):
         d = numpy.square(y.astype(numpy.float64) -
                          target.astype(numpy.float64))
-        per_sample = d.reshape(len(y), -1).sum(axis=1)
+        per_sample = d.reshape(len(y), -1).mean(axis=1)
         return float((per_sample * mask).sum() / max(mask.sum(), 1))

@@ -121,7 +121,7 @@ def fault_injection(probability: Optional[float] = None) -> None:
     from .. import prng
     p = probability if probability is not None else float(
         root.common.get("slave_death_probability", 0.0) or 0.0)
-    if p > 0 and prng.get("fault_injection").rand() < p:
+    if p > 0 and prng.get("fault_injection", ephemeral=True).rand() < p:
         Logger().warning("fault injection: terminating process")
         os._exit(42)
 

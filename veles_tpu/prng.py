@@ -22,6 +22,13 @@ import numpy
 
 _lock = threading.Lock()
 _generators: Dict[str, "RandomGenerator"] = {}
+#: streams excluded from checkpoints (ops/testing concerns, not model
+#: state): restoring them would replay e.g. the fault-injection die rolls
+#: after every resume, turning random crashes into deterministic livelock.
+#: Known ops streams are listed eagerly so the snapshot-restore skip works
+#: even before their first get() — lazy registration would let a legacy
+#: snapshot reinstall the stream during launcher startup.
+_ephemeral: set = {"fault_injection"}
 
 
 class RandomGenerator:
@@ -110,9 +117,12 @@ def _default_seed(key: str) -> int:
     return (base ^ h) & 0xFFFFFFFF
 
 
-def get(key: str = "default") -> RandomGenerator:
-    """Global keyed RNG instances (reference: veles/prng/__init__.py get())."""
+def get(key: str = "default", ephemeral: bool = False) -> RandomGenerator:
+    """Global keyed RNG instances (reference: veles/prng/__init__.py get()).
+    ``ephemeral`` marks the stream as non-checkpointed (see ``_ephemeral``)."""
     with _lock:
+        if ephemeral:
+            _ephemeral.add(key)
         gen = _generators.get(key)
         if gen is None:
             gen = _generators[key] = RandomGenerator(key)

@@ -58,6 +58,8 @@ def collect_state(workflow) -> Dict[str, Any]:
             state["__units__"][unit.name] = sd
     with prng._lock:
         for key, gen in prng._generators.items():
+            if key in prng._ephemeral:
+                continue
             state["__prng__"][key] = gen.__getstate__()
     return state
 
@@ -76,6 +78,8 @@ def apply_state(workflow, state: Dict[str, Any],
             unit.load_state_dict(sd)
     with prng._lock:
         for key, st in state.get("__prng__", {}).items():
+            if key in prng._ephemeral:
+                continue  # old snapshots may carry now-ephemeral streams
             gen = prng._generators.get(key)
             if gen is None:
                 gen = prng._generators[key] = object.__new__(
