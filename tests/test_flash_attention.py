@@ -54,10 +54,20 @@ def test_head_dim_padding():
                                   rtol=1e-4, atol=1e-5)
 
 
+def test_multi_lane_head_dim():
+    """D=256 > one 128-lane group: runs with multi-lane blocks."""
+    q, k, v = qkv(b=1, t=128, h=1, d=256, seed=5)
+    o = flash_attention(q, k, v, causal=True)
+    ref = attention_reference(q, k, v, causal=True)
+    numpy.testing.assert_allclose(numpy.asarray(o), numpy.asarray(ref),
+                                  rtol=1e-4, atol=1e-5)
+
+
 def test_supported_predicate():
     assert supported(256, 64)
     assert not supported(200, 64)       # T not divisible by block
-    assert not supported(256, 256)      # D > lane width
+    assert supported(256, 256)          # multi-lane head dim
+    assert not supported(256, 1024)     # beyond the VMEM budget bound
 
 
 def test_mha_unit_routes_through_flash():

@@ -177,3 +177,80 @@ def test_serve_cli_requires_token_off_loopback(tmp_path, capsys):
         forge.main(["serve", str(tmp_path / "s"), "--host", "0.0.0.0"])
     err = capsys.readouterr().err
     assert "--token" in err
+
+
+def test_registration_and_ownership(tmp_path):
+    """Author registration + package ownership (reference:
+    veles/forge/forge_server.py:462 token/registration machinery; the
+    confirmation-mail loop is replaced by returning the token — no
+    egress here)."""
+    server = forge.ForgeServer(str(tmp_path / "store"), port=0,
+                               registration_open=True).start()
+    try:
+        client = forge.ForgeClient("http://127.0.0.1:%d" % server.port)
+        alice = client.register("alice", "alice@example.com")
+        bob = client.register("bob")
+        assert alice != bob
+        pkg = forge.make_package(make_src(tmp_path), manifest(),
+                                 str(tmp_path / "p.tar.gz"))
+        # no token once tokens exist → rejected
+        with pytest.raises(VelesError, match="403"):
+            client.upload(pkg)
+        assert client.upload(pkg, token=alice)["ok"]
+        # bob cannot publish over alice's package
+        pkg2 = forge.make_package(make_src(tmp_path),
+                                  manifest(version="1.1"),
+                                  str(tmp_path / "p2.tar.gz"))
+        with pytest.raises(VelesError, match="owned by"):
+            client.upload(pkg2, token=bob)
+        # alice can ship the new version
+        assert client.upload(pkg2, token=alice)["version"] == "1.1"
+        # the token store and ownership survive a server restart
+        server.stop()
+        server2 = forge.ForgeServer(str(tmp_path / "store"),
+                                    port=0).start()
+        try:
+            client2 = forge.ForgeClient(
+                "http://127.0.0.1:%d" % server2.port)
+            pkg3 = forge.make_package(make_src(tmp_path),
+                                      manifest(version="1.2"),
+                                      str(tmp_path / "p3.tar.gz"))
+            with pytest.raises(VelesError, match="owned by"):
+                client2.upload(pkg3, token=bob)
+            assert client2.upload(pkg3, token=alice)["ok"]
+            # the listing is not confused by _tokens.json/_owner entries
+            (entry,) = client2.list()
+            assert entry["versions"] == ["1.0", "1.1", "1.2"]
+        finally:
+            server2.stop()
+    finally:
+        server.stop()
+
+
+def test_registration_closed_by_default(tmp_path):
+    server = forge.ForgeServer(str(tmp_path / "store"), port=0).start()
+    try:
+        client = forge.ForgeClient("http://127.0.0.1:%d" % server.port)
+        with pytest.raises(VelesError, match="registration"):
+            client.register("mallory")
+    finally:
+        server.stop()
+
+
+def test_operator_token_is_admin(tmp_path):
+    """--token operator tokens bypass ownership (hub admin)."""
+    server = forge.ForgeServer(str(tmp_path / "store"), port=0,
+                               upload_tokens=["admin-t"],
+                               registration_open=True).start()
+    try:
+        client = forge.ForgeClient("http://127.0.0.1:%d" % server.port)
+        carol = client.register("carol")
+        pkg = forge.make_package(make_src(tmp_path), manifest(),
+                                 str(tmp_path / "p.tar.gz"))
+        assert client.upload(pkg, token=carol)["ok"]
+        pkg2 = forge.make_package(make_src(tmp_path),
+                                  manifest(version="2.0"),
+                                  str(tmp_path / "p2.tar.gz"))
+        assert client.upload(pkg2, token="admin-t")["ok"]
+    finally:
+        server.stop()

@@ -135,3 +135,58 @@ def test_snapshot_skip_bool(tmp_path):
     snap.skip <<= True
     snap.run()
     assert not glob.glob(str(tmp_path / "s_*"))
+
+
+def test_db_sink_roundtrip(tmp_path):
+    """SnapshotterToDB (the ODBC-era sink, veles/snapshotter.py:428):
+    export into sqlite, resume from the sqlite:// DSN."""
+    fresh_prng()
+    loader = TinyLoader(None, minibatch_size=20, name="tiny-db")
+    snap = vt.SnapshotterToDB(None, prefix="db", directory=str(tmp_path))
+    wf = nn.StandardWorkflow(
+        name="snap-db",
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 8},
+                {"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=2, fail_iterations=99),
+        snapshotter_unit=snap, steps_per_dispatch=4)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    assert snap.destination and snap.destination.startswith("sqlite://")
+    w_trained = numpy.array(wf.forwards[0].weights.map_read())
+
+    fresh_prng()
+    wf2 = build(tmp_path, 4, with_snap=False)
+    wf2.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    vt.resume(wf2, snap.destination)          # explicit row DSN
+    numpy.testing.assert_allclose(
+        numpy.array(wf2.forwards[0].weights.map_read()), w_trained)
+    assert wf2.decision.epoch_number == 2
+
+    # bare .sqlite3 path → newest row
+    state = vt.load_snapshot(str(tmp_path / "snapshots.sqlite3"))
+    assert "all2all_tanh0" in state["__units__"]
+
+
+def test_only_coordinator_writes(tmp_path, monkeypatch):
+    """Multihost semantics: only process 0 writes snapshots (reference:
+    master-only snapshot, veles/snapshotter.py:160). Both sink types."""
+    import jax
+    fresh_prng()
+    wf = build(tmp_path, 1)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    snap_file = vt.Snapshotter(None, prefix="nonzero",
+                               directory=str(tmp_path))
+    snap_file.workflow = wf
+    snap_db = vt.SnapshotterToDB(None, prefix="nonzero",
+                                 directory=str(tmp_path / "db2"))
+    snap_db.workflow = wf
+    monkeypatch.setattr(jax, "process_index", lambda: 1)
+    assert snap_file.export() == ""
+    assert snap_db.export() == ""
+    assert not glob.glob(str(tmp_path / "nonzero*"))
+    assert not (tmp_path / "db2").exists()
+    monkeypatch.setattr(jax, "process_index", lambda: 0)
+    assert snap_file.export() != ""
+    assert snap_db.export().startswith("sqlite://")

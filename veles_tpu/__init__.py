@@ -38,7 +38,7 @@ from .backends import (Device_for, XLADevice, NumpyDevice,
                        make_mesh)                     # noqa: F401
 from .accelerated import (AcceleratedUnit,
                           AcceleratedWorkflow)        # noqa: F401
-from .snapshotter import (Snapshotter, load_snapshot,
+from .snapshotter import (Snapshotter, SnapshotterToDB, load_snapshot,
                           resume, collect_state,
                           apply_state)                # noqa: F401
 from .mean_disp_normalizer import MeanDispNormalizer  # noqa: F401

@@ -118,13 +118,26 @@ class ForgeServer(Logger):
     extracted ``manifest.json`` for cheap listing.
     """
 
+    TOKENS_FILE = "_tokens.json"
+
     def __init__(self, store_dir: str, port: int = 0,
                  upload_tokens: Optional[List[str]] = None,
-                 host: str = "127.0.0.1") -> None:
+                 host: str = "127.0.0.1",
+                 registration_open: bool = False) -> None:
         super().__init__()
         self.store_dir = store_dir
         os.makedirs(store_dir, exist_ok=True)
         self.upload_tokens = set(upload_tokens or ())
+        #: POST /register issues author-bound tokens (the reference's
+        #: email-verification loop, forge_server.py:462 — this image has
+        #: no egress, so the token returns in the response instead of a
+        #: confirmation mail; the author/ownership semantics are kept)
+        self.registration_open = registration_open
+        import threading
+        #: guards _tokens and the ownership check-then-write in store()
+        #: (handlers run on ThreadingHTTPServer threads)
+        self._auth_lock = threading.Lock()
+        self._tokens: Dict[str, Dict[str, str]] = self._load_tokens()
         server = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -169,19 +182,25 @@ class ForgeServer(Logger):
 
             def do_POST(self):
                 url = urllib.parse.urlparse(self.path)
+                if url.path == "/register":
+                    self._register()
+                    return
                 if url.path != "/upload":
                     self.send_error(404)
                     return
                 query = urllib.parse.parse_qs(url.query)
                 token = query.get("token", [""])[0]
-                if server.upload_tokens and \
-                        token not in server.upload_tokens:
+                author = server.authorize(token)
+                if author is None:
                     json_reply(self, 403, {"error": "bad token"})
                     return
                 length = int(self.headers.get("Content-Length", 0))
                 blob = self.rfile.read(length)
                 try:
-                    manifest = server.store(blob)
+                    manifest = server.store(blob, author=author)
+                except PermissionError as e:
+                    json_reply(self, 403, {"error": str(e)})
+                    return
                 except VelesError as e:
                     json_reply(self, 400, {"error": str(e)})
                     return
@@ -189,8 +208,81 @@ class ForgeServer(Logger):
                                        "name": manifest["name"],
                                        "version": manifest["version"]})
 
+            def _register(self):
+                if not server.registration_open:
+                    json_reply(self, 403,
+                               {"error": "registration closed; ask the "
+                                         "operator for a token"})
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                try:
+                    body = json.loads(self.rfile.read(length) or b"{}")
+                    author = str(body["author"])
+                    email = str(body.get("email", ""))
+                except (ValueError, KeyError):
+                    json_reply(self, 400,
+                               {"error": "body must be JSON with "
+                                         "'author' (+optional 'email')"})
+                    return
+                if not _NAME_RE.match(author):
+                    # '' would alias the operator/admin sentinel in
+                    # authorize() — ownership bypass for anyone
+                    json_reply(self, 400,
+                               {"error": "author must match %s"
+                                         % _NAME_RE.pattern})
+                    return
+                token = server.register(author, email)
+                json_reply(self, 200, {"ok": True, "token": token,
+                                       "author": author})
+
         self._service = HTTPService(Handler, port, "forge", host=host)
         self.port = self._service.port
+
+    # -- auth ----------------------------------------------------------------
+    def _tokens_path(self) -> str:
+        return os.path.join(self.store_dir, self.TOKENS_FILE)
+
+    def _load_tokens(self) -> Dict[str, Dict[str, str]]:
+        try:
+            with open(self._tokens_path()) as fin:
+                return json.load(fin)
+        except (OSError, ValueError):
+            return {}
+
+    def _save_tokens(self) -> None:
+        tmp = self._tokens_path() + ".tmp"
+        with open(tmp, "w") as fout:
+            json.dump(self._tokens, fout, indent=2)
+        os.replace(tmp, self._tokens_path())
+
+    def register(self, author: str, email: str = "") -> str:
+        """Issue an author-bound token (persisted across restarts)."""
+        import secrets
+        import time as _time
+        if not _NAME_RE.match(author or ""):
+            raise VelesError("author must match %s" % _NAME_RE.pattern)
+        token = secrets.token_urlsafe(24)
+        with self._auth_lock:
+            self._tokens[token] = {"author": author, "email": email,
+                                   "created": _time.time()}
+            self._save_tokens()
+        self.info("registered author %r", author)
+        return token
+
+    def authorize(self, token: str) -> Optional[str]:
+        """token → author name; '' when auth is disabled entirely; None
+        when rejected. Operator tokens (--token) act as admin ('')."""
+        if token in self.upload_tokens:
+            return ""
+        with self._auth_lock:
+            entry = self._tokens.get(token)
+            no_auth = (not self.upload_tokens and not self._tokens
+                       and not self.registration_open)
+        if entry is not None:
+            return entry["author"] or ""
+        if no_auth:
+            return ""        # open hub (loopback/dev): no auth configured
+        return None
 
     # -- storage ------------------------------------------------------------
     def list_packages(self) -> List[Dict[str, Any]]:
@@ -198,8 +290,10 @@ class ForgeServer(Logger):
         for name in sorted(os.listdir(self.store_dir)):
             if not os.path.isdir(os.path.join(self.store_dir, name)):
                 continue        # stray files must not break the registry
-            versions = sorted(os.listdir(
-                os.path.join(self.store_dir, name)), key=version_key)
+            versions = sorted(
+                (v for v in os.listdir(os.path.join(self.store_dir, name))
+                 if os.path.isdir(os.path.join(self.store_dir, name, v))),
+                key=version_key)
             if not versions:
                 continue
             with open(os.path.join(self.store_dir, name, versions[-1],
@@ -222,7 +316,10 @@ class ForgeServer(Logger):
         if not os.path.isdir(base):
             raise KeyError("unknown package %r" % name)
         if version is None:
-            version = sorted(os.listdir(base), key=version_key)[-1]
+            version = sorted(
+                (v for v in os.listdir(base)
+                 if os.path.isdir(os.path.join(base, v))),
+                key=version_key)[-1]
         elif not _NAME_RE.match(version):
             raise KeyError("bad version %r" % version)
         path = os.path.join(base, version, "package.tar.gz")
@@ -230,7 +327,7 @@ class ForgeServer(Logger):
             raise KeyError("no %s version %s" % (name, version))
         return path
 
-    def store(self, blob: bytes) -> Dict[str, Any]:
+    def store(self, blob: bytes, author: str = "") -> Dict[str, Any]:
         import tempfile
         with tempfile.NamedTemporaryFile(suffix=".tar.gz") as tmp:
             tmp.write(blob)
@@ -239,13 +336,33 @@ class ForgeServer(Logger):
                 manifest = read_package_manifest(tmp.name)
             except (tarfile.TarError, ValueError) as e:
                 raise VelesError("bad package: %s" % e)
-            dest = os.path.join(self.store_dir, manifest["name"],
-                                str(manifest["version"]))
-            os.makedirs(dest, exist_ok=True)
-            shutil.copy(tmp.name, os.path.join(dest, "package.tar.gz"))
-            with open(os.path.join(dest, MANIFEST), "w") as fout:
-                json.dump(manifest, fout, indent=2)
-        self.info("stored %s %s", manifest["name"], manifest["version"])
+            base = os.path.join(self.store_dir, manifest["name"])
+            owner_file = os.path.join(base, "_owner")
+            with self._auth_lock:
+                if os.path.exists(owner_file):
+                    with open(owner_file) as fin:
+                        owner = fin.read().strip()
+                    # author '' = admin/operator token: may publish over
+                    # anyone; a non-admin may never publish over a
+                    # package they don't own — including operator-owned
+                    # packages (owner '')
+                    if author != "" and owner != author:
+                        raise PermissionError(
+                            "package %r is owned by %r" %
+                            (manifest["name"], owner or "<operator>"))
+                dest = os.path.join(base, str(manifest["version"]))
+                os.makedirs(dest, exist_ok=True)
+                if not os.path.exists(owner_file):
+                    # operator-published packages record '' so a later
+                    # registered author cannot claim them
+                    with open(owner_file, "w") as fout:
+                        fout.write(author)
+                shutil.copy(tmp.name,
+                            os.path.join(dest, "package.tar.gz"))
+                with open(os.path.join(dest, MANIFEST), "w") as fout:
+                    json.dump(manifest, fout, indent=2)
+        self.info("stored %s %s%s", manifest["name"], manifest["version"],
+                  (" (author %s)" % author) if author else "")
         return manifest
 
     # -- lifecycle -----------------------------------------------------------
@@ -298,6 +415,21 @@ class ForgeClient(Logger):
                   manifest["version"], dest_dir)
         return manifest
 
+    def register(self, author: str, email: str = "") -> str:
+        """Self-register and return an author-bound upload token
+        (reference: forge registration, minus the confirmation mail)."""
+        req = urllib.request.Request(
+            self.base_url + "/register",
+            data=json.dumps({"author": author,
+                             "email": email}).encode(),
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                return json.loads(resp.read())["token"]
+        except urllib.error.HTTPError as e:
+            raise VelesError("registration rejected (%d): %s" %
+                             (e.code, e.read().decode(errors="replace")))
+
     def upload(self, package_path: str, token: str = "") -> Dict[str, Any]:
         read_package_manifest(package_path)      # validate before sending
         with open(package_path, "rb") as fin:
@@ -326,6 +458,13 @@ def main(argv=None) -> int:
     ps.add_argument("--host", default="0.0.0.0",
                     help="bind address (hub serves remote clients)")
     ps.add_argument("--token", action="append", default=[])
+    ps.add_argument("--open-registration", action="store_true",
+                    help="allow POST /register to self-issue "
+                         "author-bound upload tokens")
+    pr = sub.add_parser("register")
+    pr.add_argument("--server", required=True)
+    pr.add_argument("--author", required=True)
+    pr.add_argument("--email", default="")
     for name in ("list", "details", "fetch", "upload"):
         p = sub.add_parser(name)
         p.add_argument("--server", required=True)
@@ -344,14 +483,15 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
     if args.cmd == "serve":
         if args.host not in ("127.0.0.1", "localhost", "::1") and \
-                not args.token:
-            parser.error("serving on %s requires at least one --token "
-                         "(open upload on a non-loopback bind would let "
-                         "any host publish executable model code)"
-                         % args.host)
+                not args.token and not args.open_registration:
+            parser.error("serving on %s requires --token or "
+                         "--open-registration (anonymous upload on a "
+                         "non-loopback bind would let any host publish "
+                         "executable model code)" % args.host)
         server = ForgeServer(args.store_dir, port=args.port,
-                             host=args.host,
-                             upload_tokens=args.token).start()
+                             host=args.host, upload_tokens=args.token,
+                             registration_open=args.open_registration
+                             ).start()
         import time
         try:
             while True:
@@ -364,6 +504,9 @@ def main(argv=None) -> int:
         print(make_package(args.src_dir, manifest))
         return 0
     client = ForgeClient(args.server)
+    if args.cmd == "register":
+        print(client.register(args.author, args.email))
+        return 0
     if args.cmd == "list":
         print(json.dumps(client.list(), indent=2))
     elif args.cmd == "details":

@@ -137,15 +137,27 @@ class Launcher(Logger):
         docs/manualrst_veles_distributed_training.rst:10)."""
         wf = self.workflow
         directory, prefix = root.common.dirs.snapshots, "wf"
-        from .snapshotter import Snapshotter
+        from .snapshotter import Snapshotter, SnapshotterToDB, resume
+        snap_unit = None
         for u in getattr(wf, "units", ()):
             if isinstance(u, Snapshotter):
+                snap_unit = u
                 directory, prefix = u.directory, u.prefix
                 break
-        if not directory or not os.path.isdir(directory):
-            return False
-        if not distributed.restore_latest(wf, directory, prefix):
-            return False
+        if isinstance(snap_unit, SnapshotterToDB):
+            # DB sink: newest row in the sqlite store
+            dsn = snap_unit._resolve_dsn()
+            if not os.path.exists(dsn):
+                return False
+            try:
+                resume(wf, "sqlite://" + dsn)
+            except FileNotFoundError:
+                return False
+        else:
+            if not directory or not os.path.isdir(directory):
+                return False
+            if not distributed.restore_latest(wf, directory, prefix):
+                return False
         decision = getattr(wf, "decision", None)
         if decision is not None:
             decision.complete <<= False

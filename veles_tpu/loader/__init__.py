@@ -14,7 +14,8 @@ from .base import (Loader, LoaderMSE, TEST, VALID, TRAIN,
 from .fullbatch import FullBatchLoader, FullBatchLoaderMSE  # noqa: F401
 from .file_loader import (FileFilter, FileListScanner,      # noqa: F401
                           auto_label)
-from .image import ImageLoader, decode_image, augment  # noqa: F401
+from .image import (ImageLoader, ClassImageLoader, decode_image,  # noqa
+                    augment, deterministic_split)
 from .pickles import PicklesLoader                     # noqa: F401
 from .hdf5 import HDF5Loader                           # noqa: F401
 from .saver import MinibatchesSaver, MinibatchesLoader  # noqa: F401

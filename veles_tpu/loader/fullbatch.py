@@ -63,9 +63,14 @@ class FullBatchLoader(Loader):
     # -- loader contract -----------------------------------------------------
     def create_minibatch_data(self) -> None:
         n = self.max_minibatch_size
-        shape = (n,) + self.original_data.shape[1:]
+        # on-device augmentation may change the sample shape (e.g. random
+        # crop): downstream units must see the post-augment shape
+        shape_for = getattr(self, "sample_shape_after_augment", None)
+        sample = (shape_for() if callable(shape_for)
+                  else self.original_data.shape[1:])
         self.minibatch_data.reset(
-            numpy.zeros(shape, dtype=self.original_data.dtype))
+            numpy.zeros((n,) + tuple(sample),
+                        dtype=self.original_data.dtype))
         if self.original_labels:
             self.minibatch_labels.reset(numpy.zeros(n, dtype=numpy.int32))
 

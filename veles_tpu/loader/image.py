@@ -12,6 +12,7 @@ time, not per epoch.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional, Sequence, Tuple
 
 import numpy
@@ -27,17 +28,97 @@ IMAGE_PATTERNS = ("*.png", "*.jpg", "*.jpeg", "*.bmp", "*.gif", "*.tiff",
 
 def decode_image(path: str, size: Optional[Tuple[int, int]] = None,
                  color: str = "RGB") -> numpy.ndarray:
-    """File → HWC float32 in [0, 1] (reference decode path used PIL or
-    jpeg4py, veles/loader/image.py:106+)."""
-    from PIL import Image
-    with Image.open(path) as img:
-        img = img.convert(color)
-        if size is not None:
-            img = img.resize((size[1], size[0]), Image.BILINEAR)
-        arr = numpy.asarray(img, dtype=numpy.float32) / 255.0
+    """File → HWC float32 in [0, 1] with a codec-fallback chain
+    (reference used jpeg4py with a PIL fallback, veles/loader/image.py:
+    106+): PIL → imageio → matplotlib; .npy/.npz arrays load directly."""
+    if path.endswith((".npy", ".npz")):
+        arr = numpy.load(path)
+        if hasattr(arr, "files"):          # npz: first member
+            arr = arr[arr.files[0]]
+        arr = numpy.asarray(arr, dtype=numpy.float32)
+        if arr.max() > 1.5:
+            arr /= 255.0
+    else:
+        arr = None
+        errors = []
+        try:
+            from PIL import Image
+            with Image.open(path) as img:
+                img = img.convert(color)
+                if size is not None:
+                    img = img.resize((size[1], size[0]), Image.BILINEAR)
+                arr = numpy.asarray(img, dtype=numpy.float32) / 255.0
+        except Exception as e:        # PIL missing codec / truncated file
+            errors.append("PIL: %s" % e)
+        if arr is None:
+            for mod, fn in (("imageio", "imread"),
+                            ("matplotlib.image", "imread")):
+                try:
+                    import importlib
+                    m = importlib.import_module(mod)
+                    arr = numpy.asarray(getattr(m, fn)(path),
+                                        dtype=numpy.float32)
+                    if arr.max() > 1.5:
+                        arr /= 255.0
+                    arr = _convert_channels(arr, color)
+                    break
+                except Exception as e:
+                    errors.append("%s: %s" % (mod, e))
+        if arr is None:
+            raise VelesError("cannot decode %s (%s)" %
+                             (path, "; ".join(errors)))
     if arr.ndim == 2:
         arr = arr[:, :, None]
+    if size is not None and arr.shape[:2] != tuple(size):
+        # fallback decoders have no resize: nearest-neighbour index map
+        h, w = arr.shape[:2]
+        yi = (numpy.arange(size[0]) * h // size[0]).clip(0, h - 1)
+        xi = (numpy.arange(size[1]) * w // size[1]).clip(0, w - 1)
+        arr = arr[yi][:, xi]
     return arr
+
+
+def _convert_channels(arr: numpy.ndarray, color: str) -> numpy.ndarray:
+    """Normalize fallback-decoder output to the requested color mode —
+    the PIL path does this via Image.convert; imageio/matplotlib return
+    whatever the file holds (RGBA pngs, grayscale…), which would mix
+    channel counts inside one dataset."""
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    c = arr.shape[-1]
+    if color in ("L", "I") :
+        if c >= 3:       # ITU-R 601 luma, like PIL convert('L')
+            arr = (arr[..., 0] * 0.299 + arr[..., 1] * 0.587
+                   + arr[..., 2] * 0.114)[..., None]
+        return arr[..., :1]
+    # RGB-like targets
+    if c == 1:
+        return numpy.repeat(arr, 3, axis=-1)
+    if c >= 4:
+        return numpy.ascontiguousarray(arr[..., :3])
+    return arr
+
+
+def deterministic_split(paths: Sequence[str], valid_ratio: float = 0.0,
+                        test_ratio: float = 0.0,
+                        key: str = "split") -> Tuple[list, list, list]:
+    """Stable (machine/run/order independent) train/valid/test split by
+    hashing each file's basename — re-scanning a grown dataset keeps
+    every previously-assigned file in its old set (the property the
+    reference's shuffled-index splits lacked)."""
+    import hashlib
+    train, valid, test = [], [], []
+    for p in sorted(paths):
+        h = int.from_bytes(hashlib.sha1(
+            (key + "/" + os.path.basename(p)).encode()).digest()[:8],
+            "little") / 2.0 ** 64
+        if h < test_ratio:
+            test.append(p)
+        elif h < test_ratio + valid_ratio:
+            valid.append(p)
+        else:
+            train.append(p)
+    return train, valid, test
 
 
 def augment(arr: numpy.ndarray, mirror: bool = False,
@@ -87,7 +168,8 @@ class ImageLoader(FullBatchLoader):
                  color: str = "RGB", mirror: bool = False,
                  rotations: Sequence[int] = (0,),
                  crop: Optional[Tuple[int, int]] = None,
-                 crop_number: int = 1, **kwargs) -> None:
+                 crop_number: int = 1,
+                 device_augmentation: bool = False, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.scanner = FileListScanner(
             train_paths, validation_paths, test_paths,
@@ -98,8 +180,16 @@ class ImageLoader(FullBatchLoader):
         self.rotations = tuple(rotations)
         self.crop = crop
         self.crop_number = crop_number
+        #: TPU-first augmentation: keep ONE copy of each image in the
+        #: device-resident dataset and apply random mirror/crop INSIDE
+        #: the fused train step (memory multiplicity 1 instead of
+        #: mirror x rotations x crop_number — the host-materializing
+        #: path pays that multiplicity in RAM/HBM)
+        self.device_augmentation = device_augmentation
         #: label string → index (reference labels_mapping)
         self.label_names: Dict[int, str] = {}
+        self.device_augment_fn = None
+        self.device_eval_fn = None
 
     def get_label(self, path: str) -> str:
         return auto_label(path)
@@ -116,7 +206,9 @@ class ImageLoader(FullBatchLoader):
         for cls in (TEST, VALID, TRAIN):
             for path in per_class[cls]:
                 arr = decode_image(path, self.size, self.color)
-                if cls == TRAIN:
+                if self.device_augmentation:
+                    variants = [arr]       # multiplicity lives on device
+                elif cls == TRAIN:
                     variants = augment(
                         arr, self.mirror, self.rotations, self.crop,
                         self.crop_number, self.prng)
@@ -137,5 +229,100 @@ class ImageLoader(FullBatchLoader):
         self.create_originals(numpy.stack(data),
                               numpy.asarray(labels, dtype=numpy.int32))
         self.class_lengths = lengths
+        if self.device_augmentation:
+            self._build_device_augment()
         if self.validation_ratio and not lengths[VALID]:
             self.resize_validation(self.validation_ratio)
+
+    def _build_device_augment(self) -> None:
+        """Pure-jax per-batch augmentation, applied by TrainStep after
+        the on-device gather: random horizontal mirror (when enabled)
+        and random crop (train) / center crop (eval). Rotations need
+        host multiplicity — use the materializing path for those."""
+        if any(r % 360 for r in self.rotations):
+            raise VelesError("device_augmentation supports mirror/crop; "
+                             "rotations need the host path")
+        mirror, crop = self.mirror, self.crop
+
+        def eval_fn(batch):
+            if crop is None:
+                return batch
+            ch, cw = crop
+            h, w = batch.shape[1:3]
+            y, x = (h - ch) // 2, (w - cw) // 2
+            return batch[:, y:y + ch, x:x + cw, :]
+
+        def train_fn(batch, rng):
+            import jax
+            import jax.numpy as jnp
+            if rng is None:
+                return eval_fn(batch)
+            b = batch.shape[0]
+            if mirror:
+                flip = jax.random.bernoulli(
+                    jax.random.fold_in(rng, 1), 0.5, (b,))
+                batch = jnp.where(flip[:, None, None, None],
+                                  batch[:, :, ::-1, :], batch)
+            if crop is not None:
+                ch, cw = crop
+                h, w = batch.shape[1:3]
+                ys = jax.random.randint(jax.random.fold_in(rng, 2),
+                                        (b,), 0, h - ch + 1)
+                xs = jax.random.randint(jax.random.fold_in(rng, 3),
+                                        (b,), 0, w - cw + 1)
+
+                def one(img, y, x):
+                    return jax.lax.dynamic_slice(
+                        img, (y, x, 0), (ch, cw, img.shape[-1]))
+                batch = jax.vmap(one)(batch, ys, xs)
+            return batch
+
+        self.device_augment_fn = train_fn
+        self.device_eval_fn = eval_fn
+
+    def sample_shape_after_augment(self) -> Tuple[int, ...]:
+        base = tuple(self.original_data.shape[1:])
+        if self.device_augmentation and self.crop is not None:
+            return tuple(self.crop) + base[2:]
+        return base
+
+
+class ClassImageLoader(ImageLoader):
+    """Per-class directory tree loader (reference: FileImageLoader over
+    class subdirectories, veles/loader/file_image.py):
+
+        root/daisy/001.png
+        root/rose/xyz.jpg …
+
+    Labels come from the first-level subdirectory name; files split
+    train/valid/test by the deterministic hash split (stable as the
+    dataset grows). Pass explicit ``train``/``validation``/``test``
+    subtrees instead by using ImageLoader directly."""
+
+    MAPPING = "class_image_loader"
+
+    def __init__(self, workflow, root_dir: str,
+                 valid_ratio: float = 0.15, test_ratio: float = 0.0,
+                 **kwargs) -> None:
+        import glob as _glob
+        train, valid, test = [], [], []
+        if not os.path.isdir(root_dir):
+            raise VelesError("no such dataset root: %s" % root_dir)
+        for cls_dir in sorted(os.listdir(root_dir)):
+            full = os.path.join(root_dir, cls_dir)
+            if not os.path.isdir(full):
+                continue
+            files = []
+            for pat in IMAGE_PATTERNS + ("*.npy",):
+                files += _glob.glob(os.path.join(full, pat))
+            tr, va, te = deterministic_split(files, valid_ratio,
+                                             test_ratio, key=cls_dir)
+            train += tr
+            valid += va
+            test += te
+        super().__init__(workflow, train_paths=train,
+                         validation_paths=valid, test_paths=test,
+                         **kwargs)
+
+    def get_label(self, path: str) -> str:
+        return os.path.basename(os.path.dirname(path))

@@ -84,8 +84,13 @@ class MultiHeadAttention(ForwardBase):
         # in pallas interpret mode (orders of magnitude slower than the
         # fused XLA reference). "force" opts tests into interpret mode.
         import jax
+        # per-shape choice: XLA's fused attention wins while the (T, T)
+        # scores still tile well; the pallas kernel wins once they are
+        # HBM-bound (crossover measured in scripts/bench_attention.py)
+        min_t = int(root.common.engine.flash_attention_min_t or 0)
         use_flash = (flash_cfg == "force" or
-                     (flash_cfg and jax.default_backend() == "tpu"))
+                     (flash_cfg and jax.default_backend() == "tpu"
+                      and t >= min_t))
         if self.mesh is not None:
             scheme = root.common.engine.sequence_parallel
             n_seq = self.mesh.shape["sequence"]

@@ -42,6 +42,7 @@ class TrainStep(AcceleratedUnit):
     def __init__(self, workflow, forwards: List[ForwardBase] = (),
                  evaluator=None, loader=None, gds=None,
                  target_mode: str = "labels", steps_per_dispatch: int = 16,
+                 pipeline_microbatches: Optional[int] = None,
                  **kwargs):
         super().__init__(workflow, **kwargs)
         self.view_group = "TRAINER"
@@ -63,6 +64,12 @@ class TrainStep(AcceleratedUnit):
         self.evaluation_mode = False
         self.params: Dict[str, Dict[str, Any]] = {}
         self.opt_state: Dict[str, Dict[str, Any]] = {}
+        #: microbatches per minibatch under a 'pipeline' mesh axis
+        #: (default: one per stage; more shrinks the fill/drain bubble)
+        self.pipeline_microbatches = pipeline_microbatches
+        #: pipeline plan ({"pipeline": N} mesh axis): set by
+        #: _setup_pipeline when the mesh has the axis, else None
+        self._pp = None
         #: {unit name: {param key: mask array}} — applied multiplicatively
         #: after EVERY optimizer update inside the fused step (ZeroFiller's
         #: sparsity contract must hold within a multi-step dispatch, not
@@ -126,8 +133,61 @@ class TrainStep(AcceleratedUnit):
             has_t = getattr(self.loader, "original_targets", None)
             self.target_mode = ("targets" if has_t is not None and has_t
                                 else "input")
+        self._setup_pipeline()
         self._setup_shardings()
         return None
+
+    def _setup_pipeline(self) -> None:
+        """{"pipeline": N} mesh axis: stage-group the forward chain and
+        restructure the canonical pytree so each device on the axis holds
+        only its stages' parameters (pipeline.py gpipe schedule inside
+        the fused step — a capability the reference never had, SURVEY.md
+        §2.4 'new capability' row)."""
+        dev = self.device
+        if not isinstance(dev, XLADevice):
+            return
+        mesh = dev.mesh
+        n_stages = dict(mesh.shape).get("pipeline", 1)
+        if n_stages <= 1:
+            return
+        from ..parallel.pipeline import plan_pipeline
+        from ..parallel.sharding import PP_BLOCK
+        try:
+            pre, block, post = plan_pipeline(self.forwards, n_stages)
+        except ValueError as e:
+            raise Bug(str(e))
+        import jax.numpy as jnp
+        names = [f.name for f in block]
+        for masked in self.param_masks:
+            if masked in names:
+                raise Bug("ZeroFiller masks are not supported on "
+                          "pipelined layers (%s)" % masked)
+        stacked = {k: jnp.stack([self.params[n][k] for n in names])
+                   for k in self.params[names[0]]}
+        gd = self._gd_for[names[0]]
+        for n in names:
+            del self.params[n]
+            del self.opt_state[n]
+            del self._gd_for[n]
+        self.params[PP_BLOCK] = stacked
+        self.opt_state[PP_BLOCK] = gd.init_state(stacked)
+        self._gd_for[PP_BLOCK] = gd
+        mb = self.loader.max_minibatch_size
+        n_micro = int(self.pipeline_microbatches or n_stages)
+        if mb % n_micro:
+            raise Bug("minibatch size %d not divisible into %d pipeline "
+                      "microbatches" % (mb, n_micro))
+        n_data = dict(mesh.shape).get("data", 1)
+        if (mb // n_micro) % n_data:
+            raise Bug("pipeline microbatch size %d not divisible by "
+                      "data-axis size %d" % (mb // n_micro, n_data))
+        self._pp = {"pre": pre, "block": block, "post": post,
+                    "names": names, "n_stages": n_stages,
+                    "n_micro": n_micro, "mesh": mesh}
+        self.info("pipeline plan: %d stages x %d layers, %d microbatches "
+                  "(%d pre, %d post replicated)",
+                  n_stages, len(names) // n_stages, n_micro,
+                  len(pre), len(post))
 
     def _setup_shardings(self) -> None:
         """SPMD parallelism from mesh axes (see veles_tpu/parallel/):
@@ -169,6 +229,9 @@ class TrainStep(AcceleratedUnit):
         constants, so (re)registration invalidates the jit cache — callers
         re-registering an identical mask are a no-op (checked host-side:
         no device transfer or stream sync on the steady-state path)."""
+        if self._pp is not None and unit_name in self._pp["names"]:
+            raise Bug("ZeroFiller masks are not supported on pipelined "
+                      "layers (%s)" % unit_name)
         m_np = numpy.asarray(mask)
         cur_np = self._param_masks_np.get((unit_name, key))
         if cur_np is not None and numpy.array_equal(cur_np, m_np):
@@ -189,6 +252,8 @@ class TrainStep(AcceleratedUnit):
         """Compose the forward chain; softmax head yields logits for the
         fused stable cross-entropy."""
         import jax
+        if self._pp is not None:
+            return self._forward_pure_pp(params, x, train, rng)
         last = self.forwards[-1] if self.forwards else None
         use_logits = (isinstance(last, All2AllSoftmax)
                       and isinstance(self.evaluator, EvaluatorSoftmax))
@@ -200,6 +265,55 @@ class TrainStep(AcceleratedUnit):
                 return f.logits(p, x)
             x = f.apply(p, x, train=train, rng=layer_rng)
         return x
+
+    def _forward_pure_pp(self, params, x, train: bool, rng):
+        """Pipelined forward: pre-chain replicated → gpipe over the
+        stage-grouped block (ppermute ring inside shard_map; jax.grad
+        derives the reverse schedule) → post-chain replicated. Dropout
+        inside the block runs rng-less (deterministic) — per-layer rng
+        streams do not thread through the stage scan."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.pipeline import gpipe, microbatch, unmicrobatch
+        from ..parallel.sharding import PP_BLOCK
+        pp = self._pp
+        last = self.forwards[-1] if self.forwards else None
+        use_logits = (isinstance(last, All2AllSoftmax)
+                      and isinstance(self.evaluator, EvaluatorSoftmax))
+
+        def seq(units, x, base):
+            for i, f in enumerate(units):
+                layer_rng = (jax.random.fold_in(rng, base + i)
+                             if rng is not None else None)
+                p = params.get(f.name, {})
+                if f is last and use_logits:
+                    return f.logits(p, x)
+                x = f.apply(p, x, train=train, rng=layer_rng)
+            return x
+
+        x = seq(pp["pre"], x, 0)
+        mesh = pp["mesh"]
+        n_stages, n_micro = pp["n_stages"], pp["n_micro"]
+        layers_per_stage = len(pp["names"]) // n_stages
+        staged = jax.tree_util.tree_map(
+            lambda a: a.reshape((n_stages, layers_per_stage)
+                                + a.shape[1:]),
+            params[PP_BLOCK])
+        block_apply = pp["block"][0].apply
+
+        def stage_fn(stage_params, h):
+            # stage_params leaves: (layers_per_stage, …) — this stage's
+            # slice; scan composes its layers
+            def body(h, layer_p):
+                return block_apply(layer_p, h, train=train, rng=None), None
+            h, _ = jax.lax.scan(body, h, stage_params)
+            return h
+
+        bspec = (P(None, "data") if "data" in mesh.axis_names else P())
+        xs = microbatch(x, n_micro)
+        y = gpipe(stage_fn, staged, xs, mesh, batch_spec=bspec)
+        x = unmicrobatch(y)
+        return seq(pp["post"], x, 1000)
 
     def _gather(self, dataset, indices):
         import jax.numpy as jnp
@@ -218,6 +332,11 @@ class TrainStep(AcceleratedUnit):
                        targets, indices, mask, lr_scale, rng):
         import jax
         batch = self._gather(dataset, indices)
+        # loader-supplied on-device augmentation (e.g. random mirror/crop
+        # fused into the step — loader/image.py device_augmentation)
+        aug = getattr(self.loader, "device_augment_fn", None)
+        if aug is not None:
+            batch = aug(batch, jax.random.fold_in(rng, 0x417))
         tgt = self._target_for(batch, labels, targets, indices)
 
         def loss_fn(p):
@@ -278,6 +397,9 @@ class TrainStep(AcceleratedUnit):
                       indices, mask):
         import jax
         batch = self._gather(dataset, indices)
+        ev = getattr(self.loader, "device_eval_fn", None)
+        if ev is not None:
+            batch = ev(batch)       # deterministic center crop
         tgt = self._target_for(batch, labels, targets, indices)
         out = self._forward_pure(params, batch, False, None)
         metrics = self.evaluator.metrics_fn(out, tgt, mask)
@@ -321,7 +443,8 @@ class TrainStep(AcceleratedUnit):
                    if targets is not None and targets else dataset)
         if labels is None:
             labels = self._dummy_labels(dataset)
-        if batch is not None and loader.plan_steps > 1:
+        if batch is not None and loader.plan_steps > 1 \
+                and "data" in batch.mesh.axis_names:
             # plans are (K, mb): shard the minibatch axis, not the scan axis
             from jax.sharding import NamedSharding, PartitionSpec as P
             batch = NamedSharding(batch.mesh, P(None, "data"))
@@ -381,10 +504,19 @@ class TrainStep(AcceleratedUnit):
         Host copies, not buffer refs: the step donates its param buffers on
         the next dispatch, which would leave the Arrays dangling."""
         import jax
+        from ..parallel.sharding import PP_BLOCK
+        pp_names = self._pp["names"] if self._pp is not None else []
+        stacked = (jax.device_get(self.params[PP_BLOCK])
+                   if pp_names else {})
         for f in self.forwards:
             if not f.PARAMETERIZED:
                 continue
             arrays = f.param_arrays()
+            if f.name in pp_names:
+                i = pp_names.index(f.name)
+                for k in arrays:
+                    arrays[k].reset(numpy.array(stacked[k][i]))
+                continue
             for k, v in self.params.get(f.name, {}).items():
                 arrays[k].reset(numpy.array(jax.device_get(v)))
 
@@ -399,8 +531,16 @@ class TrainStep(AcceleratedUnit):
 
     def state_dict(self):
         import jax
-        return {"opt_state": jax.device_get(self.opt_state),
-                "lr_scale": float(self.lr_scale)}
+        opt = jax.device_get(self.opt_state)
+        if self._pp is not None:
+            # snapshots stay per-layer so a checkpoint moves freely
+            # between pipeline topologies (resume-with-different-mesh
+            # guarantee, SURVEY.md §5.4)
+            from ..parallel.sharding import PP_BLOCK
+            blk = opt.pop(PP_BLOCK)
+            for i, n in enumerate(self._pp["names"]):
+                opt[n] = {k: v[i] for k, v in blk.items()}
+        return {"opt_state": opt, "lr_scale": float(self.lr_scale)}
 
     def load_state_dict(self, sd) -> None:
         """Called after the forwards restored their Arrays (apply order =
@@ -410,11 +550,29 @@ class TrainStep(AcceleratedUnit):
             f.name: {k: v.device_view() for k, v in
                      f.param_arrays().items()}
             for f in self.forwards if f.PARAMETERIZED}
-        self.opt_state = sd["opt_state"]
+        self.opt_state = {k: v for k, v in sd["opt_state"].items()}
+        if self._pp is not None:
+            # restack the per-layer snapshot into the pipeline block
+            import jax.numpy as jnp
+            from ..parallel.sharding import PP_BLOCK
+            names = self._pp["names"]
+            keys = list(self.params[names[0]].keys())
+            self.params[PP_BLOCK] = {
+                k: jnp.stack([self.params[n][k] for n in names])
+                for k in keys}
+            self.opt_state[PP_BLOCK] = {
+                k: jnp.stack([numpy.asarray(self.opt_state[n][k])
+                              for n in names]) for k in keys}
+            for n in names:
+                del self.params[n]
+                del self.opt_state[n]
         if self._shardings is not None:
-            repl = self._shardings["repl"]
-            self.params = jax.device_put(self.params, repl)
-            self.opt_state = jax.device_put(self.opt_state, repl)
+            from ..parallel.sharding import param_shardings
+            pspec = param_shardings(self.params, self.device.mesh)
+            self.params = jax.tree_util.tree_map(
+                jax.device_put, self.params, pspec)
+            self.opt_state = jax.tree_util.tree_map(
+                jax.device_put, self.opt_state, pspec)
         # the step re-takes device ownership (buffers will be donated)
         for f in self.forwards:
             for arr in f.param_arrays().values():
@@ -432,7 +590,7 @@ class TrainStep(AcceleratedUnit):
         self.sync_params_to_arrays()
         d = super().__getstate__()
         for k in ("params", "opt_state", "_accum", "_zero_accum",
-                  "last_loss"):
+                  "last_loss", "_pp"):
             d[k] = {} if k in ("params", "opt_state", "_accum") else None
         d["param_masks"] = {
             n: {k: numpy.asarray(m) for k, m in ms.items()}

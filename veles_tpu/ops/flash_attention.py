@@ -181,9 +181,18 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+#: VMEM budget bound: the kernel keeps ~5 (block, D_padded) f32 tiles
+#: resident; 512 lanes ≈ 1.3 MiB — comfortably inside the ~16 MiB VMEM
+MAX_D = 512
+
+
 def supported(t: int, d: int, block_q: int = 128,
               block_k: int = 128) -> bool:
-    return t % block_q == 0 and t % block_k == 0 and d <= LANE
+    """Head dims beyond one lane group run with D zero-padded to the next
+    128 multiple (zero features change neither scores nor outputs);
+    above MAX_D the padded working set would pressure VMEM — callers
+    fall back to the fused XLA reference."""
+    return t % block_q == 0 and t % block_k == 0 and d <= MAX_D
 
 
 def flash_attention(q, k, v, causal: bool = False,
@@ -205,10 +214,12 @@ def flash_attention(q, k, v, causal: bool = False,
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    d_pad = ((d + LANE - 1) // LANE) * LANE  # next lane-group multiple
+
     def fold(x):
         xt = jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
-        if d < LANE:
-            xt = jnp.pad(xt, ((0, 0), (0, 0), (0, LANE - d)))
+        if d < d_pad:
+            xt = jnp.pad(xt, ((0, 0), (0, 0), (0, d_pad - d)))
         return xt
 
     o = _flash(fold(q), fold(k), fold(v), causal, float(scale),

@@ -13,30 +13,37 @@ any other pure function.
 This implementation handles the uniform-stage case (every stage maps an
 activation of shape S to shape S — e.g. a stack of residual blocks),
 which is the shape pipeline parallelism is actually used in.
+``plan_pipeline`` below stage-groups a workflow's forward chain into that
+form so ``{"pipeline": N}`` is a StandardWorkflow/TrainStep capability,
+not a standalone demo.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, List, Tuple
 
 
 def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, xs: Any,
-          mesh, axis: str = "pipeline"):
+          mesh, axis: str = "pipeline", batch_spec=None):
     """Run ``y_m = fn_{n-1}(…fn_0(x_m))`` for M microbatches.
 
     - ``fn(params_slice, x)`` — one stage; same activation shape in/out.
     - ``stage_params`` — pytree whose leaves have a leading ``n_stages``
       axis (sharded over ``axis``; each device sees its slice with the
       leading axis of size 1).
-    - ``xs`` — (M, mb, …) microbatches, replicated.
+    - ``xs`` — (M, mb, …) microbatches; ``batch_spec`` is their
+      PartitionSpec (e.g. ``P(None, "data")`` when the minibatch axis is
+      data-sharded in the surrounding SPMD program; default replicated).
 
-    Returns (M, mb, …) outputs, replicated.
+    Returns (M, mb, …) outputs, sharded like ``batch_spec``.
     """
     import jax
     import jax.numpy as jnp
     from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
+    if batch_spec is None:
+        batch_spec = P()
     n = mesh.shape[axis]
     m = xs.shape[0]
     ticks = m + n - 1
@@ -84,9 +91,62 @@ def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, xs: Any,
         lambda _: P(axis), stage_params)
     fn_sharded = shard_map(
         local, mesh=mesh,
-        in_specs=(params_spec, P()), out_specs=P(),
+        in_specs=(params_spec, batch_spec), out_specs=batch_spec,
         check_vma=False)
     return fn_sharded(stage_params, xs)
+
+
+def plan_pipeline(forwards: List[Any], n_stages: int
+                  ) -> Tuple[List[Any], List[Any], List[Any]]:
+    """Stage-group a forward chain for ``{"pipeline": N}``.
+
+    Returns ``(pre, block, post)``: the longest contiguous run of
+    *identical, shape-preserving, parameterized* forwards (same class,
+    same parameter signature, same GD hyper-parameters, activation shape
+    in == out), trimmed to a multiple of ``n_stages``; everything before/
+    after runs replicated outside the pipelined region. Raises ValueError
+    when no viable run exists — pipelining a heterogeneous chain would
+    silently serialize, which is worse than failing loudly.
+    """
+    def signature(f):
+        if not getattr(f, "PARAMETERIZED", False):
+            return None
+        if f.input is None or not f.input or not f.output:
+            return None
+        if tuple(f.input.shape) != tuple(f.output.shape):
+            return None  # stages must be shape-preserving
+        params = tuple(sorted(
+            (k, tuple(v.shape), str(v.dtype))
+            for k, v in f.param_arrays().items()))
+        gd = tuple(sorted(getattr(f, "gd_config", {}).items()))
+        return (type(f).__name__, params, gd)
+
+    sigs = [signature(f) for f in forwards]
+    best = (0, 0)  # (length, start)
+    i = 0
+    while i < len(sigs):
+        if sigs[i] is None:
+            i += 1
+            continue
+        j = i
+        while j < len(sigs) and sigs[j] == sigs[i]:
+            j += 1
+        if j - i > best[0]:
+            best = (j - i, i)
+        i = j
+    length, start = best
+    usable = (length // n_stages) * n_stages
+    if usable < n_stages or usable == 0:
+        raise ValueError(
+            "pipeline axis of size %d needs >= %d contiguous identical "
+            "shape-preserving parameterized layers; longest run is %d. "
+            "Stack repeated blocks (e.g. N x all2all_tanh of equal width) "
+            "or drop the 'pipeline' mesh axis." % (n_stages, n_stages,
+                                                   length))
+    block = list(forwards[start:start + usable])
+    pre = list(forwards[:start])
+    post = list(forwards[start + usable:])
+    return pre, block, post
 
 
 def microbatch(x, n_micro: int):

@@ -62,6 +62,24 @@ def _spec_for(name: str, shape, mesh) -> tuple:
     return tuple(spec)
 
 
+#: TrainStep's stacked pipeline-block entry (train_step.py): leaves carry
+#: a leading n_layers axis that shards over 'pipeline'
+PP_BLOCK = "__pp_block__"
+
+
+def _pp_block_spec(name: str, shape, mesh) -> tuple:
+    """Stacked pipeline block: leading layer axis over 'pipeline', output
+    features over 'tensor' when divisible (biases replicate per stage)."""
+    sizes = dict(mesh.shape)
+    spec = [None] * len(shape)
+    spec[0] = "pipeline"
+    tp = sizes.get("tensor", 1)
+    if name not in ("bias",) and tp > 1 and len(shape) >= 3 \
+            and shape[-1] % tp == 0:
+        spec[-1] = "tensor"
+    return tuple(spec)
+
+
 def param_shardings(params: Dict[str, Dict[str, Any]], mesh):
     """NamedSharding pytree matching a {layer: {param: array}} tree."""
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -69,6 +87,9 @@ def param_shardings(params: Dict[str, Dict[str, Any]], mesh):
     for layer, tree in params.items():
         out[layer] = {}
         for pname, arr in tree.items():
-            spec = _spec_for(pname, arr.shape, mesh)
+            if layer == PP_BLOCK and "pipeline" in mesh.axis_names:
+                spec = _pp_block_spec(pname, arr.shape, mesh)
+            else:
+                spec = _spec_for(pname, arr.shape, mesh)
             out[layer][pname] = NamedSharding(mesh, P(*spec))
     return out

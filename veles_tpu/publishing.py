@@ -141,6 +141,99 @@ pre { background: #f5f5f5; padding: 1em; overflow-x: auto; }
         return path
 
 
+@register_backend("pdf")
+class PDFBackend(PublishingBackend):
+    """Multi-page PDF report via matplotlib's PdfPages (reference:
+    veles/publishing/pdf_backend.py — this environment has no egress and
+    no LaTeX, matplotlib is the in-image PDF engine). Page 1: results +
+    timing; one page per plot snapshot; final page: workflow graph
+    source + config."""
+
+    def render(self, material: Dict[str, Any], out_dir: str) -> str:
+        import tempfile
+        import matplotlib
+        matplotlib.use("Agg")
+        from matplotlib import pyplot
+        from matplotlib.backends.backend_pdf import PdfPages
+        from matplotlib import image as mpimg
+        from .graphics import render_snapshot
+
+        path = os.path.join(out_dir, "report.pdf")
+        a4 = (8.27, 11.69)
+        with PdfPages(path) as pdf:
+            fig = pyplot.figure(figsize=a4)
+            fig.text(0.08, 0.95, "%s — training report" % material["name"],
+                     size=18, weight="bold")
+            fig.text(0.08, 0.92, "Generated: %s" % material["date"],
+                     size=9, style="italic")
+            y = 0.87
+            fig.text(0.08, y, "Results", size=14, weight="bold")
+            y -= 0.03
+            for k, v in sorted(material["results"].items()):
+                if isinstance(v, dict):
+                    continue
+                fig.text(0.10, y, "%s: %s" % (k, v), size=10,
+                         family="monospace")
+                y -= 0.022
+            y -= 0.02
+            fig.text(0.08, y, "Unit timing (top 10)", size=14,
+                     weight="bold")
+            y -= 0.03
+            fig.text(0.10, y, "%-28s %6s %10s" % ("unit", "runs",
+                                                  "total s"),
+                     size=9, family="monospace", weight="bold")
+            y -= 0.02
+            for t, name, count in material["stats"]:
+                fig.text(0.10, y, "%-28s %6d %10.3f" % (name[:28], count,
+                                                        t),
+                         size=9, family="monospace")
+                y -= 0.02
+            pdf.savefig(fig)
+            pyplot.close(fig)
+            with tempfile.TemporaryDirectory() as tmp:
+                for name, snap in sorted(material["snapshots"].items()):
+                    try:
+                        png = render_snapshot(
+                            snap, os.path.join(tmp, "f.png"))
+                        img = mpimg.imread(png)
+                    except Exception:
+                        continue
+                    fig = pyplot.figure(figsize=a4)
+                    fig.text(0.08, 0.95, name, size=14, weight="bold")
+                    ax = fig.add_axes([0.05, 0.1, 0.9, 0.8])
+                    ax.imshow(img)
+                    ax.axis("off")
+                    pdf.savefig(fig)
+                    pyplot.close(fig)
+            if material.get("graph") or material.get("config"):
+                fig = pyplot.figure(figsize=a4)
+                y = 0.95
+                if material.get("graph"):
+                    fig.text(0.08, y, "Workflow graph (dot)", size=14,
+                             weight="bold")
+                    y -= 0.03
+                    for line in material["graph"].splitlines()[:40]:
+                        fig.text(0.08, y, line[:100], size=6,
+                                 family="monospace")
+                        y -= 0.014
+                if material.get("config"):
+                    cfg = json.dumps(material["config"], indent=1,
+                                     default=str)
+                    fig.text(0.08, y - 0.02, "Configuration", size=14,
+                             weight="bold")
+                    y -= 0.05
+                    for line in cfg.splitlines()[:45]:
+                        fig.text(0.08, y, line[:100], size=6,
+                                 family="monospace")
+                        y -= 0.014
+                pdf.savefig(fig)
+                pyplot.close(fig)
+            meta = pdf.infodict()
+            meta["Title"] = "%s training report" % material["name"]
+            meta["Creator"] = "veles_tpu publisher"
+        return path
+
+
 class Publisher(Unit):
     """Report-generating unit (reference: veles/publishing/publisher.py:57).
 

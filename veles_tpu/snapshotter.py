@@ -23,9 +23,11 @@ from __future__ import annotations
 
 import bz2
 import gzip
+import io
 import lzma
 import os
 import pickle
+import sqlite3
 import time
 from typing import Any, Dict, Optional
 
@@ -40,6 +42,54 @@ CODECS = {
     "bz2": (bz2.open, ".bz2"),
     "xz": (lzma.open, ".xz"),
 }
+
+
+def _snappy_module():
+    try:
+        import snappy
+        return snappy
+    except ImportError:
+        return None
+
+
+if _snappy_module() is not None:
+    import snappy as _snappy
+
+    class _SnappyFile:
+        """Minimal file-like snappy stream (reference: SnappyFile,
+        veles/snapshotter.py:249). Registered only when python-snappy is
+        installed; callers get a clear error otherwise."""
+
+        def __init__(self, path, mode):
+            self._f = open(path, mode)
+            self._mode = mode
+            if "r" in mode:
+                self._buf = _snappy.StreamDecompressor().decompress(
+                    self._f.read())
+                self._pos = 0
+            else:
+                self._comp = _snappy.StreamCompressor()
+
+        def write(self, data):
+            self._f.write(self._comp.add_chunk(bytes(data)))
+
+        def read(self, n=-1):
+            if n < 0:
+                n = len(self._buf) - self._pos
+            out = self._buf[self._pos:self._pos + n]
+            self._pos += len(out)
+            return out
+
+        def readline(self):  # pickle never needs it; keep file-like
+            raise io.UnsupportedOperation("readline")
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            self._f.close()
+
+    CODECS["snappy"] = (_SnappyFile, ".snappy")
 
 
 def collect_state(workflow) -> Dict[str, Any]:
@@ -89,8 +139,8 @@ def apply_state(workflow, state: Dict[str, Any],
 
 class Snapshotter(Unit):
     """Periodic checkpoint writer unit (reference: SnapshotterToFile,
-    veles/snapshotter.py:360; auto-dispatch __new__ :522 collapses to this
-    one file backend — the ODBC variant is out of scope for TPU v1)."""
+    veles/snapshotter.py:360; the ODBC variant maps to SnapshotterToDB
+    below, sqlite being the ODBC-era equivalent this image can run)."""
 
     MAPPING = "snapshotter"
     hide_from_registry = False
@@ -174,9 +224,85 @@ class Snapshotter(Unit):
         return {"snapshot": self.destination}
 
 
+class SnapshotterToDB(Snapshotter):
+    """Checkpoints into a sqlite database (reference: SnapshotterToDB via
+    ODBC, veles/snapshotter.py:428-518 — sqlite is the ODBC-era
+    equivalent runnable in this image; the row schema mirrors the
+    reference's id/prefix/timestamp/state columns). Resume with
+    ``--snapshot sqlite://FILE`` (newest row) or ``sqlite://FILE#ID``."""
+
+    MAPPING = "snapshotter_db"
+    hide_from_registry = False
+
+    SCHEMA = ("CREATE TABLE IF NOT EXISTS snapshots ("
+              "id INTEGER PRIMARY KEY AUTOINCREMENT, prefix TEXT, "
+              "suffix TEXT, created REAL, runs INTEGER, checksum TEXT, "
+              "state BLOB)")
+
+    def __init__(self, workflow, dsn: str = None, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.dsn = dsn
+
+    def _resolve_dsn(self) -> str:
+        if self.dsn:
+            return self.dsn
+        os.makedirs(self.directory, exist_ok=True)
+        return os.path.join(self.directory, "snapshots.sqlite3")
+
+    def export(self) -> str:
+        if not self._is_writer():
+            return ""
+        state = collect_state(self.workflow)
+        blob = gzip.compress(pickle.dumps(
+            state, protocol=pickle.HIGHEST_PROTOCOL))
+        dsn = self._resolve_dsn()
+        con = sqlite3.connect(dsn)
+        try:
+            con.execute(self.SCHEMA)
+            cur = con.execute(
+                "INSERT INTO snapshots (prefix, suffix, created, runs, "
+                "checksum, state) VALUES (?, ?, ?, ?, ?, ?)",
+                (self.prefix, self.suffix, time.time(), self._runs,
+                 state["__meta__"]["checksum"], blob))
+            con.commit()
+            rowid = cur.lastrowid
+        finally:
+            con.close()
+        self.destination = "sqlite://%s#%d" % (dsn, rowid)
+        self.info("snapshot → %s (%.1f KiB)", self.destination,
+                  len(blob) / 1024)
+        self.event("snapshot", "single", path=self.destination,
+                   bytes=len(blob))
+        return self.destination
+
+
+def _load_sqlite(path: str) -> Dict[str, Any]:
+    """sqlite://FILE[#ID] → state tree (newest row when no #ID)."""
+    path = path[len("sqlite://"):] if path.startswith("sqlite://") else path
+    path, _, rowid = path.partition("#")
+    con = sqlite3.connect(path)
+    try:
+        if rowid:
+            row = con.execute(
+                "SELECT state FROM snapshots WHERE id = ?",
+                (int(rowid),)).fetchone()
+        else:
+            row = con.execute(
+                "SELECT state FROM snapshots ORDER BY id DESC LIMIT 1"
+            ).fetchone()
+    finally:
+        con.close()
+    if row is None:
+        raise FileNotFoundError("no snapshot row in %s" % path)
+    return pickle.loads(gzip.decompress(row[0]))
+
+
 def load_snapshot(path: str) -> Dict[str, Any]:
-    """Read a snapshot state tree; path may be a ``_current`` symlink
-    (reference: --snapshot FILE, veles/__main__.py:539-589)."""
+    """Read a snapshot state tree; path may be a ``_current`` symlink,
+    or a ``sqlite://FILE[#ID]`` DSN (reference: --snapshot FILE|odbc://,
+    veles/__main__.py:539-589)."""
+    if path.startswith("sqlite://") or path.endswith(".sqlite3"):
+        return _load_sqlite(path)
     for codec, (opener, ext) in CODECS.items():
         if path.endswith(".pickle" + ext) and ext:
             with opener(path, "rb") as fin:
