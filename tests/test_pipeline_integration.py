@@ -153,3 +153,25 @@ def test_pipeline_rejects_heterogeneous_chain():
 def test_pipeline_microbatch_divisibility():
     with pytest.raises(Bug, match="microbatch"):
         _run({"pipeline": 4}, microbatches=7)
+
+
+def test_expert_parallel_through_workflow():
+    """EP as a workflow capability: a {"type": "moe_ffn"} layer under a
+    {'data': D, 'expert': E} mesh gets its expert-leading params sharded
+    over 'expert' by the rule table, inside the fused step."""
+    loader = BlobsLoader(None, minibatch_size=24, name="blobs-ep")
+    wf = nn.StandardWorkflow(
+        name="ep-train",
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 16},
+                {"type": "moe_ffn", "n_experts": 4, "hidden": 8},
+                {"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=6, fail_iterations=100))
+    prng.seed_all(4242)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 2, "expert": 2}))
+    step = wf.train_step
+    w1 = step.params["moe_ffn1"]["w1"]
+    assert w1.sharding.spec[0] == "expert", w1.sharding
+    assert not w1.sharding.is_fully_replicated
+    wf.run()
+    assert wf.decision.best_metric < 0.1, wf.decision.epoch_metrics
