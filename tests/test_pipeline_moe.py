@@ -126,3 +126,99 @@ def test_gpipe_rejects_wrong_stage_count():
     x = jnp.zeros((8, 4))
     with pytest.raises(ValueError):
         gpipe(stage, params, microbatch(x, 4), pipe_mesh(4))
+
+
+def test_sparse_moe_oracle_agreement():
+    """top_k sparse dispatch: jnp path vs numpy oracle."""
+    prev = vt.root.common.engine.compute_dtype
+    vt.root.common.engine.compute_dtype = "float32"
+    try:
+        wf = vt.Workflow(name="ts")
+        u = nn.MoEFFN(wf, n_experts=4, hidden=16, top_k=2,
+                      capacity_factor=2.0)
+        x = numpy.random.RandomState(1).randn(10, 8).astype("float32")
+        u.input = Array(x)
+        u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        u.xla_run()
+        y = numpy.asarray(u.output.map_read())
+        y_np = u.numpy_apply(u.params_np(), x)
+        numpy.testing.assert_allclose(y, y_np, rtol=1e-4, atol=1e-5)
+    finally:
+        vt.root.common.engine.compute_dtype = prev
+
+
+def test_sparse_topk_full_matches_dense():
+    """top_k == n_experts with ample capacity selects every expert with
+    the full softmax weights — must equal the dense mixture."""
+    prev = vt.root.common.engine.compute_dtype
+    vt.root.common.engine.compute_dtype = "float32"
+    try:
+        wf = vt.Workflow(name="teq")
+        u = nn.MoEFFN(wf, n_experts=3, hidden=8, top_k=3,
+                      capacity_factor=4.0)
+        x = numpy.random.RandomState(2).randn(12, 6).astype("float32")
+        u.input = Array(x)
+        u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        params = u.params_np()
+        y_sparse = u.numpy_apply(params, x)
+        u.top_k = 0
+        y_dense = u.numpy_apply(params, x)
+        numpy.testing.assert_allclose(y_sparse, y_dense, rtol=1e-5,
+                                      atol=1e-6)
+    finally:
+        vt.root.common.engine.compute_dtype = prev
+
+
+def test_sparse_moe_capacity_drops_tokens():
+    """Overflowing tokens get zero combine weight (residual carries
+    them) — outputs stay finite, dropped rows are exactly zero."""
+    wf = vt.Workflow(name="tc")
+    u = nn.MoEFFN(wf, n_experts=2, hidden=8, top_k=1,
+                  capacity_factor=0.25)
+    x = numpy.zeros((8, 6), "float32")      # all tokens identical
+    x[:] = numpy.random.RandomState(3).randn(6)
+    u.input = Array(x)
+    u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    y = u.numpy_apply(u.params_np(), x)
+    assert numpy.isfinite(y).all()
+    # identical tokens all route to one expert; capacity 1 → first kept
+    nonzero_rows = (numpy.abs(y).sum(-1) > 1e-9).sum()
+    assert nonzero_rows == 1, nonzero_rows
+
+
+def test_sparse_moe_trains():
+    from veles_tpu.loader import FullBatchLoader
+
+    class L(FullBatchLoader):
+        hide_from_registry = True
+
+        def load_data(self):
+            rng = numpy.random.RandomState(5)
+            centers = rng.randn(3, 8) * 3
+            y = rng.randint(0, 3, 240).astype(numpy.int32)
+            xx = (centers[y] + rng.randn(240, 8)).astype(numpy.float32)
+            self.create_originals(xx, y)
+            self.class_lengths = [0, 48, 192]
+
+    wf = nn.StandardWorkflow(
+        name="sparse-moe",
+        layers=[{"type": "moe_ffn", "n_experts": 4, "hidden": 16,
+                 "top_k": 2, "learning_rate": 0.05},
+                {"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=L(None, minibatch_size=24, name="l"),
+        loss_function="softmax",
+        decision_config=dict(max_epochs=8, fail_iterations=100))
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 2,
+                                                 "expert": 2}))
+    w1 = wf.train_step.params["moe_ffn0"]["w1"]
+    assert w1.sharding.spec[0] == "expert"
+    wf.run()
+    assert wf.decision.best_metric < 0.15, wf.decision.epoch_metrics
+
+
+def test_moe_topk_validation():
+    wf = vt.Workflow(name="tv")
+    with pytest.raises(vt.Bug, match="top_k"):
+        nn.MoEFFN(wf, n_experts=4, top_k=5)
+    with pytest.raises(vt.Bug, match="top_k"):
+        nn.MoEFFN(wf, n_experts=4, top_k=-1)

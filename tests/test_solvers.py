@@ -1,0 +1,155 @@
+"""Per-layer solvers (GD-unit update rules): sgd (Znicz semantics),
+adam, adagrad — routed from the layer dict like the lr knobs, running
+inside the fused step, sharded state, snapshot-portable."""
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn, prng
+from veles_tpu.error import Bug
+from veles_tpu.loader import FullBatchLoader, VALID
+
+
+class BlobsLoader(FullBatchLoader):
+    hide_from_registry = True
+
+    def load_data(self):
+        rng = numpy.random.RandomState(7)
+        n_per, d, k = 120, 10, 3
+        centers = rng.randn(k, d) * 3
+        data = numpy.concatenate(
+            [centers[c] + rng.randn(n_per, d) for c in range(k)])
+        labels = numpy.concatenate(
+            [numpy.full(n_per, c) for c in range(k)])
+        perm = rng.permutation(len(data))
+        self.create_originals(data[perm].astype(numpy.float32),
+                              labels[perm].astype(numpy.int32))
+        self.class_lengths = [0, 90, 270]
+
+
+def make_wf(solver, lr, epochs=6, **extra):
+    loader = BlobsLoader(None, minibatch_size=24, name="blobs-" + solver)
+    return nn.StandardWorkflow(
+        name="solver-" + solver,
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 16,
+                 "solver": solver, "learning_rate": lr, **extra},
+                {"type": "softmax", "output_sample_shape": 3,
+                 "solver": solver, "learning_rate": lr, **extra}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=epochs, fail_iterations=100))
+
+
+@pytest.mark.parametrize("solver,lr", [("adam", 0.01),
+                                       ("adagrad", 0.05)])
+def test_solver_converges(solver, lr):
+    prng.seed_all(99)
+    wf = make_wf(solver, lr)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    gd = wf.train_step.gds[0]
+    assert gd.solver == solver
+    wf.run()
+    assert wf.decision.best_metric < 0.1, wf.decision.epoch_metrics
+
+
+def test_adam_state_shards_on_data_mesh():
+    """Nested Adam state (m/v trees + scalar step counter) must survive
+    multi-device placement — state leaves inherit the matching param's
+    sharding, the counter replicates."""
+    prng.seed_all(99)
+    wf = make_wf("adam", 0.01, epochs=4)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 8}))
+    st = wf.train_step.opt_state["all2all_tanh0"]
+    assert set(st) == {"m", "v", "t"}
+    wf.run()
+    assert wf.decision.best_metric < 0.1
+    # re-read: dispatch donates the state buffers (old refs are deleted)
+    st = wf.train_step.opt_state["all2all_tanh0"]
+    assert int(st["t"]) > 0  # counter device-resident and advancing
+
+
+def test_adam_snapshot_roundtrip(tmp_path):
+    prng.seed_all(99)
+    wf = make_wf("adam", 0.01, epochs=3)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    snap = vt.Snapshotter(None, prefix="adam", directory=str(tmp_path))
+    snap.workflow = wf
+    path = snap.export()
+    import jax
+    t_before = int(jax.device_get(
+        wf.train_step.opt_state["all2all_tanh0"]["t"]))
+    assert t_before > 0
+
+    prng.seed_all(99)
+    wf2 = make_wf("adam", 0.01, epochs=6)
+    wf2.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    vt.resume(wf2, path)
+    t_after = int(jax.device_get(
+        wf2.train_step.opt_state["all2all_tanh0"]["t"]))
+    assert t_after == t_before
+    wf2.decision.complete <<= False     # reopen (launcher.resume does)
+    wf2.run()          # continues training with restored moments
+    assert wf2.decision.epoch_number == 6
+
+
+def test_adam_through_pipeline(tmp_path):
+    """Adam + {'pipeline': 2}: stacked m/v, shared step counter; the
+    per-layer snapshot moves to a plain mesh."""
+    prng.seed_all(99)
+    loader = BlobsLoader(None, minibatch_size=24, name="blobs-ppadam")
+    layers = ([{"type": "all2all_tanh", "output_sample_shape": 16,
+                "name": "stem", "solver": "adam",
+                "learning_rate": 0.01}]
+              + [{"type": "all2all_tanh", "output_sample_shape": 16,
+                  "name": "blk%d" % i, "solver": "adam",
+                  "learning_rate": 0.01} for i in range(2)]
+              + [{"type": "softmax", "output_sample_shape": 3,
+                  "solver": "adam", "learning_rate": 0.01}])
+    wf = nn.StandardWorkflow(
+        name="pp-adam", layers=layers, loader_unit=loader,
+        loss_function="softmax",
+        decision_config=dict(max_epochs=3, fail_iterations=100))
+    wf.initialize(device=vt.XLADevice(mesh_axes={"pipeline": 2}))
+    from veles_tpu.parallel.sharding import PP_BLOCK
+    st = wf.train_step.opt_state[PP_BLOCK]
+    assert st["m"]["weights"].shape[0] == 2    # stacked moments
+    wf.run()
+    snap = vt.Snapshotter(None, prefix="ppa", directory=str(tmp_path))
+    snap.workflow = wf
+    path = snap.export()
+
+    wf2 = nn.StandardWorkflow(
+        name="pp-adam", layers=layers,
+        loader_unit=BlobsLoader(None, minibatch_size=24, name="b2"),
+        loss_function="softmax",
+        decision_config=dict(max_epochs=3, fail_iterations=100))
+    wf2.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    vt.resume(wf2, path)
+    assert wf2.decision.epoch_number == 3
+    assert set(wf2.train_step.opt_state["blk1"]) == {"m", "v", "t"}
+
+
+def test_unknown_solver_rejected():
+    wf = make_wf("rmsprop", 0.01)      # GD units are created lazily
+    with pytest.raises(Bug, match="solver"):
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+
+
+def test_remat_identical_numerics():
+    """remat=True recomputes activations in the backward (jax.checkpoint)
+    — memory knob only, trajectories must match exactly."""
+    def run(remat):
+        prng.seed_all(99)
+        loader = BlobsLoader(None, minibatch_size=24, name="b-remat")
+        wf = nn.StandardWorkflow(
+            name="remat-%s" % remat,
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 16},
+                    {"type": "softmax", "output_sample_shape": 3}],
+            loader_unit=loader, loss_function="softmax",
+            decision_config=dict(max_epochs=4, fail_iterations=100),
+            remat=remat)
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        wf.run()
+        return numpy.asarray(wf.decision.epoch_metrics[VALID])
+
+    numpy.testing.assert_array_equal(run(True), run(False))

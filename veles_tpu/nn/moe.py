@@ -2,13 +2,21 @@
 
 New capability vs the reference (the SURVEY §5.7 mesh vocabulary
 reserves an ``expert`` axis; nothing in the 2015 codebase uses one).
-Soft (dense) mixture: every expert computes, the router's softmax
-weights combine — exact, differentiable, and shardable purely through
-GSPMD annotations: the expert-leading parameters shard over the
-``expert`` axis (parallel/sharding.py) and XLA partitions the einsum,
-no hand-written dispatch. Sparse top-k dispatch with all-to-all is the
-production-scale follow-up; the dense form is the correctness anchor it
-would be tested against (the framework's "oracle first" discipline).
+
+Two gating modes on one layer:
+- **dense** (``top_k=0``): every expert computes, the router's softmax
+  weights combine — exact, differentiable, the correctness anchor;
+- **sparse** (``top_k=k``): GShard/Switch-style capacity-slotted
+  dispatch — top-k routing with renormalized gates, position-in-expert
+  by cumulative sum, tokens beyond ``capacity_factor · k·N/E`` dropped
+  (their combine weight is zero, the residual path carries them).
+  Expressed entirely as einsums over an (E, C, D) dispatch tensor, so
+  GSPMD shards it over the ``expert`` axis and inserts the all-to-alls
+  itself — no hand-written collective (the TPU-native form of the
+  reference-era "send tensors to ranks" dispatch).
+
+Expert-leading parameters shard over ``expert`` (parallel/sharding.py);
+XLA partitions every einsum.
 """
 
 from __future__ import annotations
@@ -31,10 +39,17 @@ class MoEFFN(ForwardBase):
     PARAM_NAMES = ("router", "w1", "b1", "w2", "b2")
 
     def __init__(self, workflow, n_experts: int = 4,
-                 hidden: int = 0, **kwargs) -> None:
+                 hidden: int = 0, top_k: int = 0,
+                 capacity_factor: float = 1.25, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.n_experts = int(n_experts)
         self.hidden = int(hidden)
+        self.top_k = int(top_k)
+        if not 0 <= self.top_k <= self.n_experts:
+            from ..error import Bug
+            raise Bug("top_k=%d out of range for %d experts (0 = dense)"
+                      % (self.top_k, self.n_experts))
+        self.capacity_factor = float(capacity_factor)
         self.weights_stddev = kwargs.get("weights_stddev", None)
 
     def output_shape_for(self, input_shape):
@@ -78,18 +93,74 @@ class MoEFFN(ForwardBase):
         y = ein("nef,efd->ned", h, params["w2"]) + params["b2"][None]
         return ein("ne,ned->nd", gates, y)
 
+    def _capacity(self, n_tokens: int) -> int:
+        per = self.top_k * n_tokens / self.n_experts
+        return max(1, int(numpy.ceil(per * self.capacity_factor)))
+
+    def _mix_sparse(self, params, x, np_mod, precision=None):
+        """GShard-style capacity dispatch; x: (N, D) → (N, D)."""
+        def ein(expr, *ops):
+            if precision is None:
+                return np_mod.einsum(expr, *ops)
+            return np_mod.einsum(expr, *ops, precision=precision)
+
+        n, d = x.shape
+        e, k = self.n_experts, self.top_k
+        c = self._capacity(n)
+        logits = ein("nd,de->ne", x, params["router"])
+        z = logits - logits.max(axis=-1, keepdims=True)
+        gates = np_mod.exp(z)
+        gates = gates / gates.sum(axis=-1, keepdims=True)     # (N, E)
+        # top-k mask + renormalized weights (exact float ties — where
+        # >k gates survive — are vanishingly rare; the numpy oracle
+        # below enforces strictness for the comparison tests)
+        thresh = np_mod.sort(gates, axis=-1)[:, -k][:, None]
+        m = (gates >= thresh).astype(gates.dtype)
+        # strict top-k even under gate ties: keep the k largest only
+        if np_mod is numpy:
+            excess = m.sum(-1) > k
+            if excess.any():
+                for i in numpy.where(excess)[0]:
+                    keep = numpy.argsort(gates[i])[-k:]
+                    m[i] = 0
+                    m[i, keep] = 1
+        w = gates * m
+        w = w / np_mod.maximum(w.sum(-1, keepdims=True), 1e-9)
+        # position of each token within its expert's capacity slots
+        pos = np_mod.cumsum(m, axis=0) * m - 1                # (N, E)
+        keep = (pos >= 0) & (pos < c)
+        pos_c = np_mod.clip(pos, 0, c - 1).astype("int32")
+        # dispatch tensor (N, E, C): one-hot in C where kept
+        onehot_c = (pos_c[..., None]
+                    == np_mod.arange(c)[None, None, :])
+        disp = (keep[..., None] & onehot_c).astype(x.dtype)   # (N,E,C)
+        xe = ein("nec,nd->ecd", disp, x)                      # (E, C, D)
+        h = np_mod.tanh(ein("ecd,edf->ecf", xe, params["w1"])
+                        + params["b1"][:, None, :])
+        ye = ein("ecf,efd->ecd", h, params["w2"]) \
+            + params["b2"][:, None, :]
+        comb = disp * w[..., None]                            # (N, E, C)
+        return ein("nec,ecd->nd", comb, ye)
+
     def apply(self, params, x, *, train=False, rng=None):
         import jax.numpy as jnp
         from ..ops import matmul_precision
         shape = x.shape
-        y = self._mix(params, x.reshape(-1, shape[-1]), jnp,
-                      precision=matmul_precision())
+        flat = x.reshape(-1, shape[-1])
+        if self.top_k:
+            y = self._mix_sparse(params, flat, jnp,
+                                 precision=matmul_precision())
+        else:
+            y = self._mix(params, flat, jnp,
+                          precision=matmul_precision())
         return y.reshape(shape)
 
     def numpy_apply(self, params, x):
         x = numpy.asarray(x, dtype=numpy.float32)
         shape = x.shape
-        y = self._mix(params, x.reshape(-1, shape[-1]), numpy)
+        flat = x.reshape(-1, shape[-1])
+        y = (self._mix_sparse(params, flat, numpy) if self.top_k
+             else self._mix(params, flat, numpy))
         return y.reshape(shape)
 
 

@@ -58,7 +58,8 @@ class ForwardBase(AcceleratedUnit):
     #: the layer dict too, e.g. {"type": "conv", "learning_rate": …})
     GD_KEYS = ("learning_rate", "learning_rate_bias", "weights_decay",
                "weight_decay", "weights_decay_bias", "gradient_moment",
-               "momentum", "gradient_clip")
+               "momentum", "gradient_clip", "solver", "beta1", "beta2",
+               "epsilon")
 
     def __init__(self, workflow, **kwargs) -> None:
         #: hyper-parameters for the matched GD unit, captured from the
@@ -176,29 +177,73 @@ class GradientDescentBase(AcceleratedUnit):
                                        kwargs.get("weight_decay", 0.0))
         self.weight_decay_bias = kwargs.get("weights_decay_bias", 0.0)
         self.gradient_clip = kwargs.get("gradient_clip", 0.0)
+        #: per-layer update rule: "sgd" (Znicz semantics) | "adam" |
+        #: "adagrad" — routed from the layer dict like the lr knobs
+        self.solver = kwargs.get("solver", "sgd")
+        self.beta1 = kwargs.get("beta1", 0.9)
+        self.beta2 = kwargs.get("beta2", 0.999)
+        self.epsilon = kwargs.get("epsilon", 1e-8)
+        if self.solver not in ("sgd", "adam", "adagrad"):
+            raise Bug("unknown solver %r (sgd | adam | adagrad)"
+                      % self.solver)
 
     # -- pure update rule ----------------------------------------------------
     def init_state(self, params: Dict[str, Any]) -> Dict[str, Any]:
-        """Momentum/accumulator state pytree, zeros-like params."""
+        """Optimizer state pytree (momentum / Adam moments / AdaGrad
+        accumulators), zeros-like params."""
         import jax
-        return jax.tree_util.tree_map(lambda p: p * 0, params)
+        import jax.numpy as jnp
+        zeros = jax.tree_util.tree_map(lambda p: p * 0, params)
+        if self.solver == "adam":
+            return {"m": zeros, "v": jax.tree_util.tree_map(
+                lambda p: p * 0, params), "t": jnp.zeros((), jnp.int32)}
+        if self.solver == "adagrad":
+            return {"a": zeros}
+        return zeros
 
     def update(self, params: Dict[str, Any], grads: Dict[str, Any],
                state: Dict[str, Any], lr_scale: Any = 1.0
                ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
-        """SGD + momentum + L2 weight decay + optional clip
-        (the Znicz GD semantics: delta = lr*(grad + wd*w) + mom*prev)."""
+        """Per-layer update rule. Default: SGD + momentum + L2 weight
+        decay + optional clip (the Znicz GD semantics:
+        delta = lr*(grad + wd*w) + mom*prev); "adam"/"adagrad" keep the
+        same lr/wd/clip knobs around their own accumulators."""
         import jax.numpy as jnp
-        new_params, new_state = {}, {}
-        for k, p in params.items():
-            g = grads[k]
+
+        def knobs(k, p, g):
             lr = (self.learning_rate_bias if k == "bias"
                   else self.learning_rate) * lr_scale
             wd = (self.weight_decay_bias if k == "bias"
                   else self.weight_decay)
             if self.gradient_clip:
                 g = jnp.clip(g, -self.gradient_clip, self.gradient_clip)
-            delta = lr * (g + wd * p) + self.momentum * state[k]
+            return lr, g + wd * p
+
+        if self.solver == "adam":
+            t = state["t"] + 1
+            new_m, new_v, new_params = {}, {}, {}
+            for k, p in params.items():
+                lr, g = knobs(k, p, grads[k])
+                m = self.beta1 * state["m"][k] + (1 - self.beta1) * g
+                v = self.beta2 * state["v"][k] + (1 - self.beta2) * g * g
+                mhat = m / (1 - self.beta1 ** t.astype(m.dtype))
+                vhat = v / (1 - self.beta2 ** t.astype(v.dtype))
+                new_params[k] = p - lr * mhat / (jnp.sqrt(vhat)
+                                                 + self.epsilon)
+                new_m[k], new_v[k] = m, v
+            return new_params, {"m": new_m, "v": new_v, "t": t}
+        if self.solver == "adagrad":
+            new_a, new_params = {}, {}
+            for k, p in params.items():
+                lr, g = knobs(k, p, grads[k])
+                a = state["a"][k] + g * g
+                new_params[k] = p - lr * g / (jnp.sqrt(a) + self.epsilon)
+                new_a[k] = a
+            return new_params, {"a": new_a}
+        new_params, new_state = {}, {}
+        for k, p in params.items():
+            lr, g = knobs(k, p, grads[k])
+            delta = lr * g + self.momentum * state[k]
             new_params[k] = p - delta
             new_state[k] = delta
         return new_params, new_state

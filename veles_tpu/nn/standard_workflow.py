@@ -92,12 +92,14 @@ class StandardWorkflow(AcceleratedWorkflow):
                  lr_schedule=None, snapshotter_unit=None,
                  steps_per_dispatch: int = 16, target_mode: str = None,
                  pipeline_microbatches: Optional[int] = None,
+                 remat: bool = False,
                  mcdnnic_topology: str = None,
                  mcdnnic_parameters: Optional[Dict[str, Any]] = None,
                  **kwargs):
         self._steps_per_dispatch = steps_per_dispatch
         self._target_mode = target_mode
         self._pipeline_microbatches = pipeline_microbatches
+        self._remat = remat
         super().__init__(workflow, **kwargs)
         if mcdnnic_topology:
             if layers:
@@ -154,7 +156,8 @@ class StandardWorkflow(AcceleratedWorkflow):
             self, forwards=self.forwards, evaluator=self.evaluator,
             loader=self.loader, target_mode=target_mode,
             steps_per_dispatch=self._steps_per_dispatch,
-            pipeline_microbatches=self._pipeline_microbatches)
+            pipeline_microbatches=self._pipeline_microbatches,
+            remat=self._remat)
         self.decision.loader = self.loader
         self.decision.step_unit = self.train_step
         if lr_schedule is not None:

@@ -43,7 +43,7 @@ class TrainStep(AcceleratedUnit):
                  evaluator=None, loader=None, gds=None,
                  target_mode: str = "labels", steps_per_dispatch: int = 16,
                  pipeline_microbatches: Optional[int] = None,
-                 **kwargs):
+                 remat: bool = False, **kwargs):
         super().__init__(workflow, **kwargs)
         self.view_group = "TRAINER"
         self.forwards = list(forwards)
@@ -70,6 +70,11 @@ class TrainStep(AcceleratedUnit):
         #: pipeline plan ({"pipeline": N} mesh axis): set by
         #: _setup_pipeline when the mesh has the axis, else None
         self._pp = None
+        #: rematerialize the forward under jax.checkpoint: activations
+        #: are recomputed in the backward instead of living in HBM for
+        #: the whole step — FLOPs traded for memory (SURVEY.md HBM
+        #: guidance); numerics are identical
+        self.remat = bool(remat)
         #: {unit name: {param key: mask array}} — applied multiplicatively
         #: after EVERY optimizer update inside the fused step (ZeroFiller's
         #: sparsity contract must hold within a multi-step dispatch, not
@@ -217,11 +222,13 @@ class TrainStep(AcceleratedUnit):
         else:
             batch = repl
         self._shardings = {"repl": repl, "batch": batch}
+        from ..parallel.sharding import state_shardings
         pspec = param_shardings(self.params, mesh)
+        sspec = state_shardings(self.opt_state, self.params, pspec, mesh)
         self.params = jax.tree_util.tree_map(
             jax.device_put, self.params, pspec)
         self.opt_state = jax.tree_util.tree_map(
-            jax.device_put, self.opt_state, pspec)
+            jax.device_put, self.opt_state, sspec)
 
     def register_param_mask(self, unit_name: str, key: str, mask) -> None:
         """Install (or refresh) a sparsity mask enforced after every update
@@ -340,7 +347,12 @@ class TrainStep(AcceleratedUnit):
         tgt = self._target_for(batch, labels, targets, indices)
 
         def loss_fn(p):
-            out = self._forward_pure(p, batch, True, rng)
+            if self.remat:
+                out = jax.checkpoint(
+                    lambda pp, bb: self._forward_pure(pp, bb, True,
+                                                      rng))(p, batch)
+            else:
+                out = self._forward_pure(p, batch, True, rng)
             return self.evaluator.loss(out, tgt, mask), out
 
         (loss, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -535,11 +547,14 @@ class TrainStep(AcceleratedUnit):
         if self._pp is not None:
             # snapshots stay per-layer so a checkpoint moves freely
             # between pipeline topologies (resume-with-different-mesh
-            # guarantee, SURVEY.md §5.4)
+            # guarantee, SURVEY.md §5.4). Works for any state structure:
+            # per-param buffers unstack along the layer axis, scalars
+            # (e.g. Adam's shared step counter) copy to every layer.
             from ..parallel.sharding import PP_BLOCK
             blk = opt.pop(PP_BLOCK)
             for i, n in enumerate(self._pp["names"]):
-                opt[n] = {k: v[i] for k, v in blk.items()}
+                opt[n] = jax.tree_util.tree_map(
+                    lambda v, _i=i: v[_i] if numpy.ndim(v) else v, blk)
         return {"opt_state": opt, "lr_scale": float(self.lr_scale)}
 
     def load_state_dict(self, sd) -> None:
@@ -552,27 +567,31 @@ class TrainStep(AcceleratedUnit):
             for f in self.forwards if f.PARAMETERIZED}
         self.opt_state = {k: v for k, v in sd["opt_state"].items()}
         if self._pp is not None:
-            # restack the per-layer snapshot into the pipeline block
+            # restack the per-layer snapshot into the pipeline block;
+            # scalar leaves (shared counters) take the first layer's
             import jax.numpy as jnp
             from ..parallel.sharding import PP_BLOCK
             names = self._pp["names"]
-            keys = list(self.params[names[0]].keys())
             self.params[PP_BLOCK] = {
                 k: jnp.stack([self.params[n][k] for n in names])
-                for k in keys}
-            self.opt_state[PP_BLOCK] = {
-                k: jnp.stack([numpy.asarray(self.opt_state[n][k])
-                              for n in names]) for k in keys}
+                for k in self.params[names[0]]}
+            self.opt_state[PP_BLOCK] = jax.tree_util.tree_map(
+                lambda *ls: (jnp.stack([numpy.asarray(x) for x in ls])
+                             if numpy.ndim(ls[0]) else ls[0]),
+                *[self.opt_state[n] for n in names])
             for n in names:
                 del self.params[n]
                 del self.opt_state[n]
         if self._shardings is not None:
-            from ..parallel.sharding import param_shardings
+            from ..parallel.sharding import (param_shardings,
+                                             state_shardings)
             pspec = param_shardings(self.params, self.device.mesh)
+            sspec = state_shardings(self.opt_state, self.params, pspec,
+                                    self.device.mesh)
             self.params = jax.tree_util.tree_map(
                 jax.device_put, self.params, pspec)
             self.opt_state = jax.tree_util.tree_map(
-                jax.device_put, self.opt_state, pspec)
+                jax.device_put, self.opt_state, sspec)
         # the step re-takes device ownership (buffers will be donated)
         for f in self.forwards:
             for arr in f.param_arrays().values():

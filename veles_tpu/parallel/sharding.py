@@ -80,6 +80,39 @@ def _pp_block_spec(name: str, shape, mesh) -> tuple:
     return tuple(spec)
 
 
+def state_shardings(opt_state: Dict[str, Any],
+                    params: Dict[str, Dict[str, Any]],
+                    pspec: Dict[str, Dict[str, Any]], mesh):
+    """Shardings for optimizer-state pytrees of ANY structure (SGD's
+    flat {param: buf}, Adam's {m, v, t}, rprop's nested trees): every
+    state leaf whose shape matches a parameter of the same layer
+    inherits that parameter's sharding; anything else (step counters,
+    odd-shaped accumulators) replicates."""
+    import jax
+    repl = replicated(mesh)
+    out: Dict[str, Any] = {}
+    for layer, st in opt_state.items():
+        layer_params = params.get(layer, {})
+        layer_spec = pspec.get(layer, {})
+        by_shape = {}
+        for k, arr in layer_params.items():
+            by_shape.setdefault(tuple(arr.shape), layer_spec[k])
+
+        def sh_for(path, leaf, _shapes=by_shape, _p=layer_params,
+                   _s=layer_spec):
+            # exact match first: the innermost dict key naming a param
+            # (SGD's {param: buf}, Adam's {m: {param: buf}}) — shape
+            # lookup alone mis-binds when two params share a shape
+            for entry in reversed(path):
+                key = getattr(entry, "key", None)
+                if key in _p:
+                    return _s[key]
+            return _shapes.get(tuple(getattr(leaf, "shape", ())), repl)
+
+        out[layer] = jax.tree_util.tree_map_with_path(sh_for, st)
+    return out
+
+
 def param_shardings(params: Dict[str, Dict[str, Any]], mesh):
     """NamedSharding pytree matching a {layer: {param: array}} tree."""
     from jax.sharding import NamedSharding, PartitionSpec as P
