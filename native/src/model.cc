@@ -406,6 +406,193 @@ struct Lstm : Unit {
   }
 };
 
+// y = x @ w, row-major (n, k) x (k, m) — shared by the attention/MoE
+// projections (the skip-zero inner loop mirrors All2All::Run)
+void MatMulRM(const float *x, const float *w, float *y, int n, int k,
+              int m) {
+  for (int r = 0; r < n; ++r) {
+    float *yr = y + static_cast<size_t>(r) * m;
+    std::fill(yr, yr + m, 0.0f);
+    const float *xr = x + static_cast<size_t>(r) * k;
+    for (int i = 0; i < k; ++i) {
+      float xv = xr[i];
+      if (xv == 0.0f) continue;
+      const float *wr = w + static_cast<size_t>(i) * m;
+      for (int j = 0; j < m; ++j) yr[j] += xv * wr[j];
+    }
+  }
+}
+
+struct MultiHeadAttention : Unit {
+  // inference twin of veles_tpu/nn/attention.py (B, T, D) contract:
+  // heads are contiguous hd-slices of the feature axis
+  int n_heads = 4;
+  bool causal = false;
+
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *wq = Param("wq"), *wk = Param("wk"),
+                   *wv = Param("wv"), *wo = Param("wo");
+    int batch = in.shape[0], t = in.shape[1], d = in.shape[2];
+    int h = n_heads, hd = d / h;
+    float scale = 1.0f / std::sqrt(static_cast<float>(hd));
+    out->Resize({batch, t, d});
+    size_t plane = static_cast<size_t>(t) * d;
+    std::vector<float> q(static_cast<size_t>(batch) * plane),
+        k(q.size()), v(q.size()), ctx(q.size());
+    ParallelFor(batch, [&](int lo, int hi) {
+      std::vector<float> s(t);
+      for (int b = lo; b < hi; ++b) {
+        const float *x = in.data.data() + b * plane;
+        MatMulRM(x, wq->data.data(), q.data() + b * plane, t, d, d);
+        MatMulRM(x, wk->data.data(), k.data() + b * plane, t, d, d);
+        MatMulRM(x, wv->data.data(), v.data() + b * plane, t, d, d);
+        for (int head = 0; head < h; ++head) {
+          int off = head * hd;
+          for (int qi = 0; qi < t; ++qi) {
+            const float *qv = q.data() + b * plane +
+                              static_cast<size_t>(qi) * d + off;
+            int kmax = causal ? qi + 1 : t;
+            float mx = -1e30f;
+            for (int ki = 0; ki < kmax; ++ki) {
+              const float *kv = k.data() + b * plane +
+                                static_cast<size_t>(ki) * d + off;
+              float dot = 0;
+              for (int e = 0; e < hd; ++e) dot += qv[e] * kv[e];
+              s[ki] = dot * scale;
+              mx = std::max(mx, s[ki]);
+            }
+            float sum = 0;
+            for (int ki = 0; ki < kmax; ++ki) {
+              s[ki] = std::exp(s[ki] - mx);
+              sum += s[ki];
+            }
+            float *cv = ctx.data() + b * plane +
+                        static_cast<size_t>(qi) * d + off;
+            std::fill(cv, cv + hd, 0.0f);
+            for (int ki = 0; ki < kmax; ++ki) {
+              float p = s[ki] / sum;
+              const float *vv = v.data() + b * plane +
+                                static_cast<size_t>(ki) * d + off;
+              for (int e = 0; e < hd; ++e) cv[e] += p * vv[e];
+            }
+          }
+        }
+        MatMulRM(ctx.data() + b * plane, wo->data.data(),
+                 out->data.data() + b * plane, t, d, d);
+      }
+    });
+  }
+};
+
+struct MoEFFN : Unit {
+  // inference twin of veles_tpu/nn/moe.py: dense softmax mixture, or
+  // GShard-style top-k dispatch with the SAME capacity semantics as the
+  // python _mix_sparse (top-k renormalized gates; tokens beyond an
+  // expert's capacity — assigned in token order — combine with zero
+  // weight, the residual path carries them)
+  int top_k = 0;
+  double capacity_factor = 1.25;
+
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *router = Param("router"), *w1 = Param("w1"),
+                   *b1 = Param("b1"), *w2 = Param("w2"),
+                   *b2 = Param("b2");
+    int d = in.shape.back();
+    int n = static_cast<int>(in.size()) / d;   // tokens
+    // expert count/width from the weights themselves (a config key that
+    // disagreed with the arrays would index out of bounds)
+    int e = router->shape[1], f = w1->shape[2];
+    if (w1->shape[0] != e)
+      throw std::runtime_error("moe_ffn: router/w1 expert mismatch");
+    out->Resize(in.shape);
+    // pass 1 (serial: capacity slots are claimed in token order) —
+    // per-token combine weights after top-k + capacity filtering
+    std::vector<float> weights(static_cast<size_t>(n) * e, 0.0f);
+    {
+      std::vector<float> gates(e);
+      std::vector<int> used(e, 0);
+      int c = n;  // dense: no capacity pressure
+      if (top_k > 0 && top_k < e)
+        c = std::max(1, static_cast<int>(std::ceil(
+                top_k * static_cast<double>(n) / e * capacity_factor)));
+      for (int tok = 0; tok < n; ++tok) {
+        const float *x = in.data.data() + static_cast<size_t>(tok) * d;
+        for (int ex = 0; ex < e; ++ex) {
+          float z = 0;
+          for (int i = 0; i < d; ++i)
+            z += x[i] * router->data[static_cast<size_t>(i) * e + ex];
+          gates[ex] = z;
+        }
+        float mx = *std::max_element(gates.begin(), gates.end());
+        float sum = 0;
+        for (int ex = 0; ex < e; ++ex) {
+          gates[ex] = std::exp(gates[ex] - mx);
+          sum += gates[ex];
+        }
+        for (int ex = 0; ex < e; ++ex) gates[ex] /= sum;
+        if (top_k > 0 && top_k < e) {
+          std::vector<float> sorted(gates);
+          std::nth_element(sorted.begin(), sorted.end() - top_k,
+                           sorted.end());
+          float thresh = sorted[e - top_k];
+          float kept = 0;
+          for (int ex = 0; ex < e; ++ex) {
+            if (gates[ex] < thresh) gates[ex] = 0;
+            kept += gates[ex];
+          }
+          for (int ex = 0; ex < e; ++ex) gates[ex] /= kept;
+        }
+        float *wrow = weights.data() + static_cast<size_t>(tok) * e;
+        for (int ex = 0; ex < e; ++ex) {
+          if (gates[ex] == 0.0f) continue;
+          if (used[ex] >= c) continue;       // over capacity: dropped
+          ++used[ex];
+          wrow[ex] = gates[ex];
+        }
+      }
+    }
+    // pass 2 (parallel): expert FFNs weighted by the kept gates
+    ParallelFor(n, [&](int lo, int hi) {
+      std::vector<float> hbuf(f), ybuf(d);
+      for (int tok = lo; tok < hi; ++tok) {
+        const float *x = in.data.data() + static_cast<size_t>(tok) * d;
+        float *y = out->data.data() + static_cast<size_t>(tok) * d;
+        const float *wrow = weights.data() +
+                            static_cast<size_t>(tok) * e;
+        std::fill(y, y + d, 0.0f);
+        for (int ex = 0; ex < e; ++ex) {
+          float g = wrow[ex];
+          if (g == 0.0f) continue;
+          const float *w1e = w1->data.data() +
+                             static_cast<size_t>(ex) * d * f;
+          const float *b1e = b1->data.data() +
+                             static_cast<size_t>(ex) * f;
+          const float *w2e = w2->data.data() +
+                             static_cast<size_t>(ex) * f * d;
+          const float *b2e = b2->data.data() +
+                             static_cast<size_t>(ex) * d;
+          for (int j = 0; j < f; ++j) hbuf[j] = b1e[j];
+          for (int i = 0; i < d; ++i) {
+            float xv = x[i];
+            if (xv == 0.0f) continue;
+            const float *row = w1e + static_cast<size_t>(i) * f;
+            for (int j = 0; j < f; ++j) hbuf[j] += xv * row[j];
+          }
+          for (int j = 0; j < f; ++j) hbuf[j] = std::tanh(hbuf[j]);
+          for (int i = 0; i < d; ++i) ybuf[i] = b2e[i];
+          for (int j = 0; j < f; ++j) {
+            float hv = hbuf[j];
+            if (hv == 0.0f) continue;
+            const float *row = w2e + static_cast<size_t>(j) * d;
+            for (int i = 0; i < d; ++i) ybuf[i] += hv * row[i];
+          }
+          for (int i = 0; i < d; ++i) y[i] += g * ybuf[i];
+        }
+      }
+    });
+  }
+};
+
 // ---------------------------------------------------------------------------
 // Factory
 
@@ -493,6 +680,19 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
       u->return_sequences = cfg["return_sequences"].AsBool();
     if (cfg.Has("forget_bias"))
       u->forget_bias = static_cast<float>(cfg["forget_bias"].AsDouble());
+    return u;
+  }
+  if (type == "multi_head_attention") {
+    auto u = std::make_unique<MultiHeadAttention>();
+    if (cfg.Has("n_heads")) u->n_heads = cfg["n_heads"].AsInt();
+    if (cfg.Has("causal")) u->causal = cfg["causal"].AsBool();
+    return u;
+  }
+  if (type == "moe_ffn") {
+    auto u = std::make_unique<MoEFFN>();
+    if (cfg.Has("top_k")) u->top_k = cfg["top_k"].AsInt();
+    if (cfg.Has("capacity_factor"))
+      u->capacity_factor = cfg["capacity_factor"].AsDouble();
     return u;
   }
   if (type.rfind("activation", 0) == 0 || type == "dropout") {

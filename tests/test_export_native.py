@@ -125,3 +125,62 @@ def test_stablehlo_roundtrip(trained_pkg):
     out = numpy.asarray(exported.call(params, full))
     numpy.testing.assert_allclose(out[:len(batch)], truth,
                                   rtol=2e-3, atol=2e-4)
+
+
+@pytest.fixture(scope="module")
+def attention_moe_pkg(tmp_path_factory):
+    """Sequence model with the round-2 layer types: attention + sparse
+    MoE + lstm head — exported and compared against the jitted chain."""
+    class Seqs(FullBatchLoader):
+        hide_from_registry = True
+
+        def load_data(self):
+            rng = numpy.random.RandomState(4)
+            n = 64
+            self.create_originals(
+                rng.rand(n, 6, 8).astype(numpy.float32),
+                rng.randint(0, 3, n).astype(numpy.int32))
+            self.class_lengths = [0, 16, 48]
+
+    wf = nn.StandardWorkflow(
+        name="attn-moe-net",
+        layers=[
+            {"type": "multi_head_attention", "n_heads": 2,
+             "causal": True},
+            {"type": "moe_ffn", "n_experts": 4, "hidden": 16,
+             "top_k": 2, "capacity_factor": 1.0},
+            {"type": "lstm", "hidden_size": 8},
+            {"type": "softmax", "output_sample_shape": 3},
+        ],
+        loader_unit=Seqs(None, minibatch_size=16, name="seqs"),
+        loss_function="softmax",
+        decision_config=dict(max_epochs=1), steps_per_dispatch=2)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    pkg = str(tmp_path_factory.mktemp("pkg2") / "attn-moe-net")
+    package_export(wf, pkg, with_stablehlo=False)
+    batch = wf.loader.original_data.mem[:5].copy()
+    import jax
+    x = batch
+    for f in wf.forwards:
+        p = {k: v.device_view() for k, v in f.param_arrays().items()}
+        x = f.apply(p, x, train=False)
+    return pkg, batch, numpy.asarray(jax.device_get(x))
+
+
+@needs_native
+def test_native_attention_moe_parity(attention_moe_pkg):
+    """C++ engine vs jitted chain on attention + sparse MoE — tight
+    capacity_factor, so token drops must match the GShard dispatch
+    exactly, not just the top-k-renorm weights."""
+    pkg, batch, truth = attention_moe_pkg
+    model = NativeModel(pkg)
+    out = model(batch).reshape(truth.shape)
+    numpy.testing.assert_allclose(out, truth, rtol=2e-3, atol=2e-4)
+    model.close()
+
+
+def test_python_executor_attention_moe(attention_moe_pkg):
+    pkg, batch, truth = attention_moe_pkg
+    out = run_package(pkg, batch)
+    numpy.testing.assert_allclose(out, truth, rtol=2e-3, atol=2e-4)

@@ -178,3 +178,15 @@ def test_kill_and_resume_completes(recovery_model, tmp_path):
     assert resumes >= 1, "no relaunch ever auto-resumed"
     assert final["epochs"] >= 4
     assert final["best_err"] < 0.2
+
+
+def test_profiler_trace_capture(tmp_path):
+    """--profile-dir writes an XPlane trace of the run (SURVEY.md §5.1:
+    the reference's Mongo event spans map to jax profiler traces)."""
+    launcher = Launcher(backend="cpu", profile_dir=str(tmp_path))
+    launcher.initialize(_workflow())
+    launcher.run()
+    import glob as _glob
+    traces = _glob.glob(str(tmp_path / "**" / "*.xplane.pb"),
+                        recursive=True)
+    assert traces, list(tmp_path.rglob("*"))

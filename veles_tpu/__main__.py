@@ -67,7 +67,8 @@ def main(argv=None) -> int:
         test_mode=args.test,
         graphics=args.graphics, plots_dir=args.plots_dir,
         status_url=args.status_url,
-        notification_interval=args.status_interval)
+        notification_interval=args.status_interval,
+        profile_dir=args.profile_dir)
 
     module = import_file_as_module(args.model)
     # a model module may (re)set config keys at import time (including

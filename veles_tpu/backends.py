@@ -86,6 +86,34 @@ class NumpyDevice(Device):
         return False
 
 
+_cache_enabled = False
+
+
+def _enable_compilation_cache(jax) -> None:
+    """Persistent compiled-program cache (reference analogue: the
+    device-keyed kernel-binary tarballs, veles/accelerated_units.py:
+    605-673). Off when root.common.engine.compilation_cache is empty."""
+    global _cache_enabled
+    if _cache_enabled:
+        return
+    if os.environ.get("VELES_TPU_TEST"):
+        return    # the test harness must not grow a cache in $HOME
+    path = str(root.common.engine.get("compilation_cache", "") or "")
+    if not path:
+        return
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        # only compiles worth re-reading get persisted: sub-second
+        # compiles would pay a disk write for nothing and the cache has
+        # no eviction — bounding what enters is the size control
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+        _cache_enabled = True
+    except Exception as e:       # never let caching break device init
+        Logger().warning("compilation cache disabled: %s", e)
+
+
 class XLADevice(Device):
     """JAX/XLA device set + logical mesh (the reference's
     Device-per-accelerator model collapses to one object owning all chips:
@@ -98,6 +126,7 @@ class XLADevice(Device):
         super().__init__()
         import jax
         self._jax = jax
+        _enable_compilation_cache(jax)
         self.jax_devices = (jax.devices(platform) if platform
                             else jax.devices())
         if not self.jax_devices:

@@ -68,6 +68,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--slave-death-probability", type=float, default=0.0,
                    help="fault injection for recovery testing")
+    p.add_argument("--profile-dir", default=None,
+                   help="capture a jax/XPlane profiler trace of the run "
+                        "into this directory (view with tensorboard or "
+                        "xprof; the TPU-era --timings deep dive)")
     p.add_argument("--job-timeout", type=float, default=0.0,
                    help="floor (seconds) for the per-dispatch hang "
                         "watchdog; 0 keeps only the mean+3σ adaptive "

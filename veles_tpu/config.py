@@ -164,6 +164,12 @@ def _default_root() -> Config:
             # "ring" (K/V rotation, memory-flat in T) or "ulysses"
             # (all-to-all head re-sharding; needs heads % n_seq == 0)
             "sequence_parallel": "ring",
+            # persistent XLA compilation cache (replaces the reference's
+            # kernel-binary tarball cache, veles/accelerated_units.py:
+            # 605-673): compiled programs survive process restarts, so
+            # resume/relaunch skips the 20-40 s first-compile. "" = off.
+            "compilation_cache": os.path.expanduser(
+                "~/.veles_tpu/cache/xla"),
         },
         "mesh": {
             # logical mesh axes reserved up front (SURVEY.md §5.7/§5.8):

@@ -34,7 +34,8 @@ class Launcher(Logger):
                  graphics: bool = False,
                  plots_dir: Optional[str] = None,
                  status_url: Optional[str] = None,
-                 notification_interval: float = 10.0) -> None:
+                 notification_interval: float = 10.0,
+                 profile_dir: Optional[str] = None) -> None:
         super().__init__()
         self.test_mode = test_mode
         self.workflow = None
@@ -47,6 +48,10 @@ class Launcher(Logger):
         self.status_reporter = None
         self._backend = backend
         self._mesh = mesh
+        #: XPlane trace capture (SURVEY.md §5.1 TPU mapping of the
+        #: reference's event spans + --timings): device timeline,
+        #: compiled-op breakdown, host/device overlap
+        self._profile_dir = profile_dir
         self._dist = (coordinator, num_processes, process_id)
         if random_seed is not None:
             prng.seed_all(random_seed)
@@ -175,6 +180,15 @@ class Launcher(Logger):
     def run(self) -> Dict[str, Any]:
         self._start_time = time.time()
         self.event("launcher.work", "begin")
+        profiling = False
+        if self._profile_dir:
+            try:
+                import jax
+                jax.profiler.start_trace(self._profile_dir)
+                profiling = True
+                self.info("profiler trace → %s", self._profile_dir)
+            except Exception as e:
+                self.warning("profiler unavailable: %s", e)
         try:
             self.workflow.run()
         except KeyboardInterrupt:
@@ -182,6 +196,12 @@ class Launcher(Logger):
             self.workflow.stop()
             self.interrupted = True
         finally:
+            if profiling:
+                try:
+                    import jax
+                    jax.profiler.stop_trace()
+                except Exception as e:
+                    self.warning("profiler stop failed: %s", e)
             self.event("launcher.work", "end")
             self.stopped = True
             from .plotter import Plotter
