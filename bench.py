@@ -176,15 +176,38 @@ def bench_conv_ae(dev, n_chips):
     }
 
 
-def main():
+def _acquire_device(retries=6, delay=30.0):
+    """The tunnelled TPU is exclusive and occasionally drops; a silent
+    CPU fallback would record a bogus headline number, so retry for the
+    real chip and stamp the platform either way."""
     import veles_tpu as vt
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
+        return vt.Device_for("auto")      # explicit CPU pin: no retries
+    last = None
+    for attempt in range(retries):
+        try:
+            dev = vt.XLADevice()
+            if dev.platform != "cpu":
+                return dev
+            last = "only cpu XLA devices present"
+        except Exception as e:
+            last = str(e)
+        print("bench: TPU unavailable (attempt %d/%d): %s"
+              % (attempt + 1, retries, last), file=sys.stderr)
+        time.sleep(delay)
+    print("bench: proceeding on CPU after %d attempts" % retries,
+          file=sys.stderr)
+    return vt.Device_for("auto")
 
-    dev = vt.Device_for("auto")
+
+def main():
+    dev = _acquire_device()
     n_chips = getattr(dev, "device_count", 1)
 
     mnist = bench_mnist(dev, n_chips)
     ae = bench_conv_ae(dev, n_chips)
 
+    platform = getattr(dev, "platform", "numpy")
     sps = mnist["samples_per_sec_per_chip"]
     method = "median_of_3x10s"
     base_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -199,12 +222,14 @@ def main():
         # median-based run read as a phantom regression
         if stored.get("method") == method:
             base = stored["value"]
-    if base is None:
+    if base is None and platform != "cpu":
         base = sps
         rebaselined = True
         with open(base_path, "w") as f:
             json.dump({"value": sps, "method": method,
                        "ts": time.time()}, f)
+    elif base is None:
+        base = sps      # CPU fallback run: never becomes the baseline
     import jax
     print(json.dumps({
         "metric": "mnist784_train_samples_per_sec_per_chip",
@@ -217,6 +242,7 @@ def main():
         "data": mnist["data"],
         "plan_steps": mnist["plan_steps"],
         "sync": "host_fetch",
+        "platform": platform,
         "device_kind": str(getattr(jax.devices()[0], "device_kind",
                                    "unknown")),
         "extras": [ae],
