@@ -1,0 +1,112 @@
+"""Lines — synthetic line-orientation classification.
+
+TPU-native rebuild of the VELES "Lines" demo (reference model zoo,
+SURVEY.md §2.8 samples row: "MNIST, CIFAR, AlexNet, ImagenetAE, Lines,
+kanji…"): classify which of 4 orientations (horizontal / vertical /
+the two diagonals) a noisy line segment has. Fully synthetic by
+construction — the one zoo member whose REAL dataset is a generator, so
+its accuracy anchor is meaningful in-image. Uses the round-2 knobs:
+per-layer adam solver + on-the-fly generated data.
+
+Run: python models/lines.py [--epochs N]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy  # noqa: E402
+
+import veles_tpu as vt  # noqa: E402
+from veles_tpu import nn  # noqa: E402
+from veles_tpu.loader import FullBatchLoader  # noqa: E402
+
+SIZE = 16
+N_CLASSES = 4       # horizontal, vertical, diag, anti-diag
+
+
+def draw_line(rng, angle_class, size=SIZE):
+    """One noisy line image (H, W, 1) in [0, 1]."""
+    img = rng.rand(size, size).astype(numpy.float32) * 0.3
+    c = size // 2 + rng.randint(-2, 3)
+    thickness = rng.randint(1, 3)
+    for t in range(-size, size):
+        if angle_class == 0:
+            y, x = c, c + t               # horizontal
+        elif angle_class == 1:
+            y, x = c + t, c               # vertical
+        elif angle_class == 2:
+            y, x = c + t, c + t           # diagonal
+        else:
+            y, x = c + t, c - t           # anti-diagonal
+        for d in range(thickness):
+            yy, xx = y + d, x
+            if 0 <= yy < size and 0 <= xx < size:
+                img[yy, xx] = 0.7 + 0.3 * rng.rand()
+    return img[:, :, None]
+
+
+class LinesLoader(FullBatchLoader):
+    hide_from_registry = True
+
+    def __init__(self, workflow, n_train=2400, n_valid=480, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.n_train, self.n_valid = n_train, n_valid
+
+    def load_data(self):
+        rng = numpy.random.RandomState(17)
+        n = self.n_valid + self.n_train
+        labels = rng.randint(0, N_CLASSES, n).astype(numpy.int32)
+        data = numpy.stack([draw_line(rng, c) for c in labels])
+        self.create_originals(data, labels)
+        self.class_lengths = [0, self.n_valid, self.n_train]
+
+
+def build_workflow(epochs=10, minibatch_size=80, lr=0.002,
+                   n_train=2400, n_valid=480):
+    loader = LinesLoader(None, n_train=n_train, n_valid=n_valid,
+                         minibatch_size=minibatch_size, name="lines")
+    wf = nn.StandardWorkflow(
+        name="lines",
+        layers=[
+            {"type": "conv_relu", "n_kernels": 8, "kx": 5, "ky": 5,
+             "padding": (2, 2, 2, 2), "solver": "adam",
+             "learning_rate": lr},
+            {"type": "max_pooling", "kx": 2, "ky": 2},
+            {"type": "all2all_tanh", "output_sample_shape": 32,
+             "solver": "adam", "learning_rate": lr},
+            {"type": "softmax", "output_sample_shape": N_CLASSES,
+             "solver": "adam", "learning_rate": lr},
+        ],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=epochs, fail_iterations=50),
+    )
+    return wf
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--mb", type=int, default=80)
+    p.add_argument("--lr", type=float, default=0.002)
+    p.add_argument("--backend", default="auto")
+    args = p.parse_args(argv)
+
+    wf = build_workflow(args.epochs, args.mb, args.lr)
+    wf.initialize(device=vt.Device_for(args.backend))
+    t0 = time.time()
+    wf.run()
+    dt = time.time() - t0
+    res = wf.gather_results()
+    print("best validation error: %.4f (epoch %d)" %
+          (res["best_err"], res["best_epoch"]))
+    print("throughput: %.0f samples/sec" %
+          (wf.loader.samples_served / dt))
+    return res
+
+
+if __name__ == "__main__":
+    main()

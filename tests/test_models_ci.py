@@ -109,6 +109,19 @@ def test_genre_lstm_converges():
     assert res["best_err"] < 0.35, res
 
 
+def test_lines_converges():
+    """Lines demo (reference zoo member; generator-backed, so its
+    accuracy is a REAL anchor, not a surrogate proxy). Exercises the
+    per-layer adam solver in CI."""
+    lines = _import_model("lines")
+    wf = lines.build_workflow(epochs=5, minibatch_size=80,
+                              n_train=960, n_valid=240)
+    wf.initialize(device=_dev())
+    wf.run()
+    res = wf.gather_results()
+    assert res["best_err"] < 0.1, res
+
+
 def test_bench_workflow_builds(monkeypatch):
     """The compute-bound bench surface (bench.py's second metric) must
     keep building and running one dispatch — a regression here silently
