@@ -35,15 +35,18 @@ def draw_line(rng, angle_class, size=SIZE):
     thickness = rng.randint(1, 3)
     for t in range(-size, size):
         if angle_class == 0:
-            y, x = c, c + t               # horizontal
+            y, x, dy, dx = c, c + t, 1, 0          # horizontal
         elif angle_class == 1:
-            y, x = c + t, c               # vertical
+            y, x, dy, dx = c + t, c, 0, 1          # vertical
         elif angle_class == 2:
-            y, x = c + t, c + t           # diagonal
+            y, x, dy, dx = c + t, c + t, 0, 1      # diagonal
         else:
-            y, x = c + t, c - t           # anti-diagonal
+            y, x, dy, dx = c + t, c - t, 0, 1      # anti-diagonal
+        # thickness grows PERPENDICULAR to the line (an offset along it
+        # would redraw the same pixels, making thickness class-dependent
+        # — a spurious intensity cue)
         for d in range(thickness):
-            yy, xx = y + d, x
+            yy, xx = y + d * dy, x + d * dx
             if 0 <= yy < size and 0 <= xx < size:
                 img[yy, xx] = 0.7 + 0.3 * rng.rand()
     return img[:, :, None]

@@ -423,6 +423,45 @@ void MatMulRM(const float *x, const float *w, float *y, int n, int k,
   }
 }
 
+// Per-head scaled-dot-product attention over one sequence: q/k/v/ctx
+// are (t, d) planes with heads as contiguous hd slices; `scratch` must
+// hold t floats. Shared by MultiHeadAttention and TransformerBlock so
+// masking/stability fixes cannot diverge between them (the python side
+// shares nn/attention.attention_core the same way).
+void AttentionHeads(const float *q, const float *k, const float *v,
+                    float *ctx, float *scratch, int t, int d, int h,
+                    bool causal) {
+  int hd = d / h;
+  float scale = 1.0f / std::sqrt(static_cast<float>(hd));
+  for (int head = 0; head < h; ++head) {
+    int off = head * hd;
+    for (int qi = 0; qi < t; ++qi) {
+      const float *qv = q + static_cast<size_t>(qi) * d + off;
+      int kmax = causal ? qi + 1 : t;
+      float mx = -1e30f;
+      for (int ki = 0; ki < kmax; ++ki) {
+        const float *kv = k + static_cast<size_t>(ki) * d + off;
+        float dot = 0;
+        for (int e = 0; e < hd; ++e) dot += qv[e] * kv[e];
+        scratch[ki] = dot * scale;
+        mx = std::max(mx, scratch[ki]);
+      }
+      float sum = 0;
+      for (int ki = 0; ki < kmax; ++ki) {
+        scratch[ki] = std::exp(scratch[ki] - mx);
+        sum += scratch[ki];
+      }
+      float *cv = ctx + static_cast<size_t>(qi) * d + off;
+      std::fill(cv, cv + hd, 0.0f);
+      for (int ki = 0; ki < kmax; ++ki) {
+        float p = scratch[ki] / sum;
+        const float *vv = v + static_cast<size_t>(ki) * d + off;
+        for (int e = 0; e < hd; ++e) cv[e] += p * vv[e];
+      }
+    }
+  }
+}
+
 struct MultiHeadAttention : Unit {
   // inference twin of veles_tpu/nn/attention.py (B, T, D) contract:
   // heads are contiguous hd-slices of the feature axis
@@ -433,54 +472,124 @@ struct MultiHeadAttention : Unit {
     const NpyArray *wq = Param("wq"), *wk = Param("wk"),
                    *wv = Param("wv"), *wo = Param("wo");
     int batch = in.shape[0], t = in.shape[1], d = in.shape[2];
-    int h = n_heads, hd = d / h;
-    float scale = 1.0f / std::sqrt(static_cast<float>(hd));
     out->Resize({batch, t, d});
     size_t plane = static_cast<size_t>(t) * d;
-    std::vector<float> q(static_cast<size_t>(batch) * plane),
-        k(q.size()), v(q.size()), ctx(q.size());
     ParallelFor(batch, [&](int lo, int hi) {
-      std::vector<float> s(t);
+      std::vector<float> q(plane), k(plane), v(plane), ctx(plane),
+          s(t);
       for (int b = lo; b < hi; ++b) {
         const float *x = in.data.data() + b * plane;
-        MatMulRM(x, wq->data.data(), q.data() + b * plane, t, d, d);
-        MatMulRM(x, wk->data.data(), k.data() + b * plane, t, d, d);
-        MatMulRM(x, wv->data.data(), v.data() + b * plane, t, d, d);
-        for (int head = 0; head < h; ++head) {
-          int off = head * hd;
-          for (int qi = 0; qi < t; ++qi) {
-            const float *qv = q.data() + b * plane +
-                              static_cast<size_t>(qi) * d + off;
-            int kmax = causal ? qi + 1 : t;
-            float mx = -1e30f;
-            for (int ki = 0; ki < kmax; ++ki) {
-              const float *kv = k.data() + b * plane +
-                                static_cast<size_t>(ki) * d + off;
-              float dot = 0;
-              for (int e = 0; e < hd; ++e) dot += qv[e] * kv[e];
-              s[ki] = dot * scale;
-              mx = std::max(mx, s[ki]);
-            }
-            float sum = 0;
-            for (int ki = 0; ki < kmax; ++ki) {
-              s[ki] = std::exp(s[ki] - mx);
-              sum += s[ki];
-            }
-            float *cv = ctx.data() + b * plane +
-                        static_cast<size_t>(qi) * d + off;
-            std::fill(cv, cv + hd, 0.0f);
-            for (int ki = 0; ki < kmax; ++ki) {
-              float p = s[ki] / sum;
-              const float *vv = v.data() + b * plane +
-                                static_cast<size_t>(ki) * d + off;
-              for (int e = 0; e < hd; ++e) cv[e] += p * vv[e];
-            }
-          }
-        }
-        MatMulRM(ctx.data() + b * plane, wo->data.data(),
+        MatMulRM(x, wq->data.data(), q.data(), t, d, d);
+        MatMulRM(x, wk->data.data(), k.data(), t, d, d);
+        MatMulRM(x, wv->data.data(), v.data(), t, d, d);
+        AttentionHeads(q.data(), k.data(), v.data(), ctx.data(),
+                       s.data(), t, d, n_heads, causal);
+        MatMulRM(ctx.data(), wo->data.data(),
                  out->data.data() + b * plane, t, d, d);
       }
     });
+  }
+};
+
+struct TransformerBlock : Unit {
+  // inference twin of veles_tpu/nn/transformer.py: pre-LN residual
+  // block — h = x + Wo·attn(LN1 x); y = h + W2·gelu(W1·LN2 h)
+  int n_heads = 4;
+  bool causal = true;
+
+  static void LayerNorm(const float *x, const float *g, const float *b,
+                        float *y, int n, int d) {
+    for (int r = 0; r < n; ++r) {
+      const float *xr = x + static_cast<size_t>(r) * d;
+      float *yr = y + static_cast<size_t>(r) * d;
+      float mu = 0;
+      for (int i = 0; i < d; ++i) mu += xr[i];
+      mu /= d;
+      float var = 0;
+      for (int i = 0; i < d; ++i) var += (xr[i] - mu) * (xr[i] - mu);
+      var /= d;
+      float inv = 1.0f / std::sqrt(var + 1e-5f);
+      for (int i = 0; i < d; ++i)
+        yr[i] = (xr[i] - mu) * inv * g[i] + b[i];
+    }
+  }
+
+  static float Gelu(float x) {
+    const float c = 0.7978845608028654f;  // sqrt(2/pi)
+    return 0.5f * x * (1.0f + std::tanh(c * (x + 0.044715f * x * x * x)));
+  }
+
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *wq = Param("wq"), *wk = Param("wk"),
+                   *wv = Param("wv"), *wo = Param("wo"),
+                   *w1 = Param("w1"), *b1 = Param("b1"),
+                   *w2 = Param("w2"), *b2 = Param("b2"),
+                   *g1 = Param("ln1_g"), *bb1 = Param("ln1_b"),
+                   *g2 = Param("ln2_g"), *bb2 = Param("ln2_b");
+    int batch = in.shape[0], t = in.shape[1], d = in.shape[2];
+    int f = w1->shape[1];
+    int h = n_heads;
+    *out = in;                         // residual accumulator
+    size_t plane = static_cast<size_t>(t) * d;
+    ParallelFor(batch, [&](int lo, int hi) {
+      std::vector<float> ln(plane), q(plane), k(plane), v(plane),
+          ctx(plane), proj(plane), s(t), hbuf(f);
+      for (int b = lo; b < hi; ++b) {
+        float *xb = out->data.data() + b * plane;
+        // attention sub-block
+        LayerNorm(xb, g1->data.data(), bb1->data.data(), ln.data(), t,
+                  d);
+        MatMulRM(ln.data(), wq->data.data(), q.data(), t, d, d);
+        MatMulRM(ln.data(), wk->data.data(), k.data(), t, d, d);
+        MatMulRM(ln.data(), wv->data.data(), v.data(), t, d, d);
+        AttentionHeads(q.data(), k.data(), v.data(), ctx.data(),
+                       s.data(), t, d, h, causal);
+        MatMulRM(ctx.data(), wo->data.data(), proj.data(), t, d, d);
+        for (size_t i = 0; i < plane; ++i) xb[i] += proj[i];
+        // FFN sub-block
+        LayerNorm(xb, g2->data.data(), bb2->data.data(), ln.data(), t,
+                  d);
+        for (int r = 0; r < t; ++r) {
+          const float *xr = ln.data() + static_cast<size_t>(r) * d;
+          for (int j = 0; j < f; ++j) hbuf[j] = b1->data[j];
+          for (int i = 0; i < d; ++i) {
+            float xv = xr[i];
+            if (xv == 0.0f) continue;
+            const float *row = w1->data.data() +
+                               static_cast<size_t>(i) * f;
+            for (int j = 0; j < f; ++j) hbuf[j] += xv * row[j];
+          }
+          for (int j = 0; j < f; ++j) hbuf[j] = Gelu(hbuf[j]);
+          float *yr = xb + static_cast<size_t>(r) * d;
+          for (int i = 0; i < d; ++i) yr[i] += b2->data[i];
+          for (int j = 0; j < f; ++j) {
+            float hv = hbuf[j];
+            if (hv == 0.0f) continue;
+            const float *row = w2->data.data() +
+                               static_cast<size_t>(j) * d;
+            for (int i = 0; i < d; ++i) yr[i] += hv * row[i];
+          }
+        }
+      }
+    });
+  }
+};
+
+struct MeanPool : Unit {
+  void Run(const Tensor &in, Tensor *out) override {
+    int batch = in.shape[0], t = in.shape[1];
+    int d = static_cast<int>(in.size()) / (batch * t);
+    out->Resize({batch, d});
+    for (int b = 0; b < batch; ++b) {
+      float *y = out->data.data() + static_cast<size_t>(b) * d;
+      std::fill(y, y + d, 0.0f);
+      for (int step = 0; step < t; ++step) {
+        const float *x = in.data.data() +
+                         (static_cast<size_t>(b) * t + step) * d;
+        for (int i = 0; i < d; ++i) y[i] += x[i];
+      }
+      for (int i = 0; i < d; ++i) y[i] /= t;
+    }
   }
 };
 
@@ -688,6 +797,13 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
     if (cfg.Has("causal")) u->causal = cfg["causal"].AsBool();
     return u;
   }
+  if (type == "transformer_block") {
+    auto u = std::make_unique<TransformerBlock>();
+    if (cfg.Has("n_heads")) u->n_heads = cfg["n_heads"].AsInt();
+    if (cfg.Has("causal")) u->causal = cfg["causal"].AsBool();
+    return u;
+  }
+  if (type == "mean_pool") return std::make_unique<MeanPool>();
   if (type == "moe_ffn") {
     auto u = std::make_unique<MoEFFN>();
     if (cfg.Has("top_k")) u->top_k = cfg["top_k"].AsInt();

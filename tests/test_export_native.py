@@ -184,3 +184,58 @@ def test_python_executor_attention_moe(attention_moe_pkg):
     pkg, batch, truth = attention_moe_pkg
     out = run_package(pkg, batch)
     numpy.testing.assert_allclose(out, truth, rtol=2e-3, atol=2e-4)
+
+
+@pytest.fixture(scope="module")
+def transformer_pkg(tmp_path_factory):
+    """Transformer stack export: block + mean_pool + softmax."""
+    class Seqs2(FullBatchLoader):
+        hide_from_registry = True
+
+        def load_data(self):
+            rng = numpy.random.RandomState(8)
+            n = 48
+            self.create_originals(
+                rng.rand(n, 6, 8).astype(numpy.float32),
+                rng.randint(0, 3, n).astype(numpy.int32))
+            self.class_lengths = [0, 12, 36]
+
+    wf = nn.StandardWorkflow(
+        name="tf-net",
+        layers=[
+            {"type": "transformer_block", "n_heads": 2,
+             "ffn_hidden": 16, "causal": True},
+            {"type": "transformer_block", "n_heads": 2,
+             "ffn_hidden": 16, "causal": True},
+            {"type": "mean_pool"},
+            {"type": "softmax", "output_sample_shape": 3},
+        ],
+        loader_unit=Seqs2(None, minibatch_size=12, name="s2"),
+        loss_function="softmax",
+        decision_config=dict(max_epochs=1), steps_per_dispatch=2)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    pkg = str(tmp_path_factory.mktemp("pkg3") / "tf-net")
+    package_export(wf, pkg, with_stablehlo=False)
+    batch = wf.loader.original_data.mem[:5].copy()
+    import jax
+    x = batch
+    for f in wf.forwards:
+        p = {k: v.device_view() for k, v in f.param_arrays().items()}
+        x = f.apply(p, x, train=False)
+    return pkg, batch, numpy.asarray(jax.device_get(x))
+
+
+@needs_native
+def test_native_transformer_parity(transformer_pkg):
+    pkg, batch, truth = transformer_pkg
+    model = NativeModel(pkg)
+    out = model(batch).reshape(truth.shape)
+    numpy.testing.assert_allclose(out, truth, rtol=2e-3, atol=2e-4)
+    model.close()
+
+
+def test_python_executor_transformer(transformer_pkg):
+    pkg, batch, truth = transformer_pkg
+    out = run_package(pkg, batch)
+    numpy.testing.assert_allclose(out, truth, rtol=2e-3, atol=2e-4)

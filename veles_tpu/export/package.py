@@ -33,7 +33,7 @@ _EXPORT_KEYS = (
     "sliding", "padding", "include_bias", "factor", "alpha", "beta",
     "n", "k", "hidden_size", "return_sequences", "forget_bias",
     "n_heads", "causal", "dropout_ratio",
-    "n_experts", "hidden", "top_k", "capacity_factor",
+    "n_experts", "hidden", "top_k", "capacity_factor", "ffn_hidden",
 )
 
 

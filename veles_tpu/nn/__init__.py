@@ -33,8 +33,9 @@ from .channel_split import ChannelSplitter, ChannelMerger  # noqa
 from .zerofill import ZeroFiller  # noqa
 from .image_saver import ImageSaver  # noqa
 from .nn_plotting import Weights2D, KohonenHits  # noqa
-from .attention import MultiHeadAttention  # noqa
+from .attention import MultiHeadAttention, attention_core  # noqa
 from .moe import MoEFFN  # noqa
+from .transformer import TransformerBlock, MeanPool  # noqa
 from .variants import (All2AllRProp, GDRProp,
                        ResizableAll2All)  # noqa
 from .train_step import TrainStep  # noqa

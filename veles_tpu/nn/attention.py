@@ -20,6 +20,34 @@ from .. import prng
 from .nn_units import ForwardBase, GradientDescentBase, matches
 
 
+def attention_core(q, k, v, *, causal=False, mesh=None, n_heads=1):
+    """The per-shape attention chooser, shared by MultiHeadAttention and
+    TransformerBlock. q/k/v: (B, T, H, Dh) → (B, T, H, Dh).
+    sequence-mesh → ring/Ulysses; long T on TPU → Pallas flash; else the
+    fused XLA reference (crossover: engine.flash_attention_min_t,
+    docs/perf.md)."""
+    import jax
+    from ..ops import flash_attention as fa
+    from ..parallel.ring_attention import (ring_attention,
+                                           attention_reference)
+    t, hd = q.shape[1], q.shape[-1]
+    flash_cfg = root.common.engine.flash_attention
+    min_t = int(root.common.engine.flash_attention_min_t or 0)
+    use_flash = (flash_cfg == "force" or
+                 (flash_cfg and jax.default_backend() == "tpu"
+                  and t >= min_t))
+    if mesh is not None:
+        scheme = root.common.engine.sequence_parallel
+        n_seq = mesh.shape["sequence"]
+        if scheme == "ulysses" and n_heads % n_seq == 0:
+            from ..parallel.ulysses import ulysses_attention
+            return ulysses_attention(q, k, v, mesh, causal=causal)
+        return ring_attention(q, k, v, mesh, causal=causal)
+    if use_flash and fa.supported(t, hd):
+        return fa.flash_attention(q, k, v, causal=causal)
+    return attention_reference(q, k, v, causal=causal)
+
+
 class MultiHeadAttention(ForwardBase):
     """(B, T, D) → (B, T, D); params wq/wk/wv/wo each (D, D)."""
 
@@ -69,43 +97,14 @@ class MultiHeadAttention(ForwardBase):
 
     def apply(self, params, x, *, train=False, rng=None):
         import jax.numpy as jnp
-        from ..config import root
         from ..ops import matmul_precision
-        from ..ops import flash_attention as fa
-        from ..parallel.ring_attention import (ring_attention,
-                                               attention_reference)
         prec = matmul_precision()
         b, t, d = x.shape
         q = self._split_heads(jnp.dot(x, params["wq"], precision=prec))
         k = self._split_heads(jnp.dot(x, params["wk"], precision=prec))
         v = self._split_heads(jnp.dot(x, params["wv"], precision=prec))
-        flash_cfg = root.common.engine.flash_attention
-        # the kernel only pays off compiled on TPU; off-TPU it would run
-        # in pallas interpret mode (orders of magnitude slower than the
-        # fused XLA reference). "force" opts tests into interpret mode.
-        import jax
-        # per-shape choice: XLA's fused attention wins while the (T, T)
-        # scores still tile well; the pallas kernel wins once they are
-        # HBM-bound (crossover measured in scripts/bench_attention.py)
-        min_t = int(root.common.engine.flash_attention_min_t or 0)
-        use_flash = (flash_cfg == "force" or
-                     (flash_cfg and jax.default_backend() == "tpu"
-                      and t >= min_t))
-        if self.mesh is not None:
-            scheme = root.common.engine.sequence_parallel
-            n_seq = self.mesh.shape["sequence"]
-            if scheme == "ulysses" and self.n_heads % n_seq == 0:
-                from ..parallel.ulysses import ulysses_attention
-                o = ulysses_attention(q, k, v, self.mesh,
-                                      causal=self.causal)
-            else:
-                o = ring_attention(q, k, v, self.mesh,
-                                   causal=self.causal)
-        elif use_flash and fa.supported(t, d // self.n_heads):
-            # pallas kernel: no (T, T) score materialization in HBM
-            o = fa.flash_attention(q, k, v, causal=self.causal)
-        else:
-            o = attention_reference(q, k, v, causal=self.causal)
+        o = attention_core(q, k, v, causal=self.causal, mesh=self.mesh,
+                           n_heads=self.n_heads)
         o = o.reshape(b, t, d)
         return jnp.dot(o, params["wo"], precision=prec)
 

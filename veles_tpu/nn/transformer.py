@@ -1,0 +1,176 @@
+"""TransformerBlock: attention + FFN + layernorm as ONE forward unit.
+
+New capability vs the reference (sequence models there were Znicz
+RNN/LSTM, SURVEY.md §5.7). Fusing the whole pre-LN residual block into
+one shape-preserving unit is deliberate TPU-first design: a stack of
+``{"type": "transformer_block", ...} * N`` layers is exactly the
+"contiguous identical shape-preserving run" that TrainStep's pipeline
+stage-grouper consumes (parallel/pipeline.plan_pipeline), so the same
+model pipelines over ``{'pipeline': P}`` with no model changes — and
+the attention core routes through the shared per-shape chooser
+(flash / ring / Ulysses / fused-XLA, nn/attention.attention_core).
+
+Block (pre-LN, GPT-style):
+    h = x + W_o · attn(LN1(x))
+    y = h + W2 · gelu(W1 · LN2(h))
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy
+
+from ..config import root
+from ..memory import Array
+from .. import prng
+from .nn_units import ForwardBase, GradientDescentBase, matches
+from .attention import attention_core
+
+
+def _layernorm(np_mod, x, g, b, eps=1e-5):
+    mu = x.mean(axis=-1, keepdims=True)
+    var = ((x - mu) ** 2).mean(axis=-1, keepdims=True)
+    return (x - mu) / np_mod.sqrt(var + eps) * g + b
+
+
+def _gelu(np_mod, x):
+    # tanh approximation — identical formula on both jnp and numpy
+    c = numpy.sqrt(2.0 / numpy.pi).astype("float32")
+    return 0.5 * x * (1.0 + np_mod.tanh(c * (x + 0.044715 * x ** 3)))
+
+
+class TransformerBlock(ForwardBase):
+    """(B, T, D) → (B, T, D); the canonical pipelineable stage."""
+
+    MAPPING = "transformer_block"
+    PARAMETERIZED = True
+    hide_from_registry = False
+    PARAM_NAMES = ("wq", "wk", "wv", "wo", "w1", "b1", "w2", "b2",
+                   "ln1_g", "ln1_b", "ln2_g", "ln2_b")
+
+    def __init__(self, workflow, n_heads=4, ffn_hidden=0, causal=True,
+                 **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.n_heads = int(n_heads)
+        self.ffn_hidden = int(ffn_hidden)
+        self.causal = causal
+        self.mesh = None
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        d = self.input.shape[-1]
+        if d % self.n_heads:
+            raise ValueError("model dim %d not divisible by %d heads"
+                             % (d, self.n_heads))
+        f = self.ffn_hidden or 4 * d
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(d))
+        dtype = root.common.engine.precision_type
+
+        def mk(name, shape, scale):
+            w = numpy.zeros(shape, dtype=dtype)
+            prng.get("%s.%s" % (self.name, name)).fill_normal(w, scale)
+            return Array(w, name="%s.%s" % (self.name, name))
+
+        ones = numpy.ones((d,), dtype=dtype)
+        zeros = numpy.zeros((d,), dtype=dtype)
+        return {
+            "wq": mk("wq", (d, d), stddev),
+            "wk": mk("wk", (d, d), stddev),
+            "wv": mk("wv", (d, d), stddev),
+            "wo": mk("wo", (d, d), stddev),
+            "w1": mk("w1", (d, f), stddev),
+            "b1": Array(numpy.zeros((f,), dtype=dtype),
+                        name=self.name + ".b1"),
+            "w2": mk("w2", (f, d), 1.0 / numpy.sqrt(f)),
+            "b2": Array(zeros.copy(), name=self.name + ".b2"),
+            "ln1_g": Array(ones.copy(), name=self.name + ".ln1_g"),
+            "ln1_b": Array(zeros.copy(), name=self.name + ".ln1_b"),
+            "ln2_g": Array(ones.copy(), name=self.name + ".ln2_g"),
+            "ln2_b": Array(zeros.copy(), name=self.name + ".ln2_b"),
+        }
+
+    def initialize(self, device=None, **kwargs):
+        res = super().initialize(device=device, **kwargs)
+        if res:
+            return res
+        mesh = getattr(device, "mesh", None)
+        if mesh is not None and "sequence" in mesh.axis_names \
+                and mesh.shape["sequence"] > 1:
+            self.mesh = mesh
+        return None
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax.numpy as jnp
+        from ..ops import matmul_precision
+        prec = matmul_precision()
+        b, t, d = x.shape
+        h = self.n_heads
+
+        def heads(m):
+            return m.reshape(b, t, h, d // h)
+
+        a_in = _layernorm(jnp, x, params["ln1_g"], params["ln1_b"])
+        q = heads(jnp.dot(a_in, params["wq"], precision=prec))
+        k = heads(jnp.dot(a_in, params["wk"], precision=prec))
+        v = heads(jnp.dot(a_in, params["wv"], precision=prec))
+        o = attention_core(q, k, v, causal=self.causal, mesh=self.mesh,
+                           n_heads=h).reshape(b, t, d)
+        x = x + jnp.dot(o, params["wo"], precision=prec)
+        f_in = _layernorm(jnp, x, params["ln2_g"], params["ln2_b"])
+        hmid = _gelu(jnp, jnp.dot(f_in, params["w1"], precision=prec)
+                     + params["b1"])
+        return x + jnp.dot(hmid, params["w2"], precision=prec) \
+            + params["b2"]
+
+    def numpy_apply(self, params, x):
+        x = numpy.asarray(x, dtype=numpy.float32)
+        b, t, d = x.shape
+        h = self.n_heads
+        hd = d // h
+        a_in = _layernorm(numpy, x, params["ln1_g"], params["ln1_b"])
+
+        def heads(m):
+            return (a_in @ m).reshape(b, t, h, hd)
+
+        q, k, v = heads(params["wq"]), heads(params["wk"]), \
+            heads(params["wv"])
+        s = numpy.einsum("bqhd,bkhd->bhqk", q, k) / numpy.sqrt(hd)
+        if self.causal:
+            mask = numpy.tril(numpy.ones((t, t), bool))
+            s = numpy.where(mask[None, None], s, -1e30)
+        s = s - s.max(axis=-1, keepdims=True)
+        p = numpy.exp(s)
+        p /= p.sum(axis=-1, keepdims=True)
+        o = numpy.einsum("bhqk,bkhd->bqhd", p, v).reshape(b, t, d)
+        x = x + o @ params["wo"]
+        f_in = _layernorm(numpy, x, params["ln2_g"], params["ln2_b"])
+        hmid = _gelu(numpy, f_in @ params["w1"] + params["b1"])
+        return (x + hmid @ params["w2"] + params["b2"]).astype(
+            numpy.float32)
+
+
+@matches(TransformerBlock)
+class GDTransformerBlock(GradientDescentBase):
+    MAPPING = "gd_transformer_block"
+    hide_from_registry = False
+
+
+class MeanPool(ForwardBase):
+    """(B, T, D) → (B, D): mean over the sequence axis (classification
+    head plumbing for sequence stacks)."""
+
+    MAPPING = "mean_pool"
+    hide_from_registry = False
+
+    def output_shape_for(self, input_shape):
+        return (input_shape[0],) + tuple(input_shape[2:])
+
+    def apply(self, params, x, *, train=False, rng=None):
+        return x.mean(axis=1)
+
+    def numpy_apply(self, params, x):
+        return numpy.asarray(x, dtype=numpy.float32).mean(axis=1)
