@@ -1,0 +1,101 @@
+"""Tiny transformer — sequence-classification zoo member.
+
+New-capability showcase beyond the reference zoo (its sequence models
+were Znicz RNN/LSTM; SURVEY.md §5.7): a stack of identical fused
+pre-LN TransformerBlocks + positional embedding + mean-pool head. The
+identical-block shape means the same model pipelines over
+``--mesh pipeline=N`` and sequence-shards over ``--mesh sequence=N``
+with no changes here.
+
+Task (generated; real anchor like models/lines.py): classify the
+ORDER of two marker bursts in the sequence — position-dependent, so
+the positional embedding is load-bearing, and attention must relate
+the two marker positions.
+
+Run: python models/tiny_transformer.py [--epochs N]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy  # noqa: E402
+
+import veles_tpu as vt  # noqa: E402
+from veles_tpu import nn  # noqa: E402
+from veles_tpu.loader import FullBatchLoader  # noqa: E402
+
+SEQ_LEN = 16
+DIM = 32
+N_CLASSES = 2       # marker A before B, or B before A
+
+
+class OrderLoader(FullBatchLoader):
+    hide_from_registry = True
+
+    def __init__(self, workflow, n_train=2560, n_valid=512, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.n_train, self.n_valid = n_train, n_valid
+
+    def load_data(self):
+        rng = numpy.random.RandomState(23)
+        n = self.n_valid + self.n_train
+        x = 0.2 * rng.randn(n, SEQ_LEN, DIM).astype(numpy.float32)
+        y = rng.randint(0, 2, n).astype(numpy.int32)
+        for i in range(n):
+            pa, pb = sorted(rng.choice(SEQ_LEN, 2, replace=False))
+            first, second = (pa, pb) if y[i] == 0 else (pb, pa)
+            x[i, first, :8] += 1.0       # marker A at `first`
+            x[i, second, 8:16] += 1.0    # marker B at `second`
+        self.create_originals(x, y)
+        self.class_lengths = [0, self.n_valid, self.n_train]
+
+
+def build_workflow(epochs=20, minibatch_size=64, lr=0.003, n_blocks=4,
+                   n_train=2560, n_valid=512):
+    loader = OrderLoader(None, n_train=n_train, n_valid=n_valid,
+                         minibatch_size=minibatch_size, name="order")
+    layers = ([{"type": "pos_embedding", "solver": "adam",
+                "learning_rate": lr}]
+              + [{"type": "transformer_block", "n_heads": 4,
+                  "ffn_hidden": 64, "causal": False,
+                  "solver": "adam", "learning_rate": lr,
+                  "name": "blk%d" % i} for i in range(n_blocks)]
+              + [{"type": "mean_pool"},
+                 {"type": "softmax", "output_sample_shape": N_CLASSES,
+                  "solver": "adam", "learning_rate": lr}])
+    wf = nn.StandardWorkflow(
+        name="tiny-transformer",
+        layers=layers, loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=epochs, fail_iterations=50),
+    )
+    return wf
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=20)
+    p.add_argument("--mb", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.003)
+    p.add_argument("--blocks", type=int, default=4)
+    p.add_argument("--backend", default="auto")
+    args = p.parse_args(argv)
+
+    wf = build_workflow(args.epochs, args.mb, args.lr, args.blocks)
+    wf.initialize(device=vt.Device_for(args.backend))
+    t0 = time.time()
+    wf.run()
+    dt = time.time() - t0
+    res = wf.gather_results()
+    print("best validation error: %.4f (epoch %d)" %
+          (res["best_err"], res["best_epoch"]))
+    print("throughput: %.0f samples/sec" %
+          (wf.loader.samples_served / dt))
+    return res
+
+
+if __name__ == "__main__":
+    main()

@@ -575,6 +575,30 @@ struct TransformerBlock : Unit {
   }
 };
 
+struct PosEmbedding : Unit {
+  // adds the learned (T, D) position table (transformer.py twin)
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *table = Param("table");
+    int batch = in.shape[0], t = in.shape[1], d = in.shape[2];
+    if (table->shape[0] < t || table->shape[1] != d)
+      throw std::runtime_error(
+          "pos_embedding: input (t=" + std::to_string(t) + ", d=" +
+          std::to_string(d) + ") exceeds table (" +
+          std::to_string(table->shape[0]) + ", " +
+          std::to_string(table->shape[1]) + ")");
+    *out = in;
+    for (int b = 0; b < batch; ++b) {
+      float *y = out->data.data() +
+                 static_cast<size_t>(b) * t * d;
+      for (int step = 0; step < t; ++step)
+        for (int i = 0; i < d; ++i)
+          y[static_cast<size_t>(step) * d + i] +=
+              table->data[static_cast<size_t>(step) *
+                          table->shape[1] + i];
+    }
+  }
+};
+
 struct MeanPool : Unit {
   void Run(const Tensor &in, Tensor *out) override {
     int batch = in.shape[0], t = in.shape[1];
@@ -804,6 +828,7 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
     return u;
   }
   if (type == "mean_pool") return std::make_unique<MeanPool>();
+  if (type == "pos_embedding") return std::make_unique<PosEmbedding>();
   if (type == "moe_ffn") {
     auto u = std::make_unique<MoEFFN>();
     if (cfg.Has("top_k")) u->top_k = cfg["top_k"].AsInt();

@@ -203,6 +203,7 @@ def transformer_pkg(tmp_path_factory):
     wf = nn.StandardWorkflow(
         name="tf-net",
         layers=[
+            {"type": "pos_embedding"},
             {"type": "transformer_block", "n_heads": 2,
              "ffn_hidden": 16, "causal": True},
             {"type": "transformer_block", "n_heads": 2,

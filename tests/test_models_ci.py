@@ -122,6 +122,20 @@ def test_lines_converges():
     assert res["best_err"] < 0.1, res
 
 
+def test_tiny_transformer_converges():
+    """Transformer zoo member (generated order-classification task —
+    position-dependent, so pos_embedding + attention are load-bearing;
+    a real anchor like lines)."""
+    tt = _import_model("tiny_transformer")
+    wf = tt.build_workflow(epochs=15, minibatch_size=64, n_blocks=2,
+                           n_train=2048, n_valid=512)
+    wf.initialize(device=_dev())
+    wf.run()
+    res = wf.gather_results()
+    # chance is 0.5; calibrated best on this task: ~0.27 at epoch 14
+    assert res["best_err"] < 0.35, res
+
+
 def test_bench_workflow_builds(monkeypatch):
     """The compute-bound bench surface (bench.py's second metric) must
     keep building and running one dispatch — a regression here silently

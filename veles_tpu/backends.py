@@ -114,6 +114,17 @@ def _enable_compilation_cache(jax) -> None:
         Logger().warning("compilation cache disabled: %s", e)
 
 
+def _disable_compilation_cache(jax) -> None:
+    global _cache_enabled
+    if not _cache_enabled:
+        return
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
+    _cache_enabled = False
+
+
 class XLADevice(Device):
     """JAX/XLA device set + logical mesh (the reference's
     Device-per-accelerator model collapses to one object owning all chips:
@@ -126,12 +137,20 @@ class XLADevice(Device):
         super().__init__()
         import jax
         self._jax = jax
-        _enable_compilation_cache(jax)
         self.jax_devices = (jax.devices(platform) if platform
                             else jax.devices())
         if not self.jax_devices:
             raise VelesError("no XLA devices for platform %r" % platform)
         self.platform = self.jax_devices[0].platform
+        # accelerators only: XLA:CPU caches AOT results keyed without
+        # host machine features — reloading one compiled elsewhere (or
+        # with other flags) risks SIGILL; and CPU compiles are fast
+        # enough not to need persistence. The jax setting is process-
+        # global, so a CPU device must actively switch it OFF again.
+        if self.platform != "cpu":
+            _enable_compilation_cache(jax)
+        else:
+            _disable_compilation_cache(jax)
         axes = dict(mesh_axes if mesh_axes is not None
                     else root.common.mesh.axes.as_dict()
                     if hasattr(root.common.mesh.axes, "as_dict")

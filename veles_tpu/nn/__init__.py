@@ -35,7 +35,8 @@ from .image_saver import ImageSaver  # noqa
 from .nn_plotting import Weights2D, KohonenHits  # noqa
 from .attention import MultiHeadAttention, attention_core  # noqa
 from .moe import MoEFFN  # noqa
-from .transformer import TransformerBlock, MeanPool  # noqa
+from .transformer import (TransformerBlock, MeanPool,  # noqa
+                          PositionalEmbedding)
 from .variants import (All2AllRProp, GDRProp,
                        ResizableAll2All)  # noqa
 from .train_step import TrainStep  # noqa

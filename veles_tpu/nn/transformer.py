@@ -159,6 +159,44 @@ class GDTransformerBlock(GradientDescentBase):
     hide_from_registry = False
 
 
+class PositionalEmbedding(ForwardBase):
+    """(B, T, D) → (B, T, D): adds a learned per-position table.
+    Transformer blocks are permutation-equivariant; position-dependent
+    tasks need this (or a rotary variant) ahead of the stack. Shape-
+    preserving, so it sits in `pre` when the block run pipelines."""
+
+    MAPPING = "pos_embedding"
+    PARAMETERIZED = True
+    hide_from_registry = False
+    PARAM_NAMES = ("table",)
+
+    def __init__(self, workflow, stddev=0.02, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.stddev = float(stddev)
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        t, d = self.input.shape[1], self.input.shape[2]
+        w = numpy.zeros((t, d), dtype=root.common.engine.precision_type)
+        prng.get(self.name + ".table").fill_normal(w, self.stddev)
+        return {"table": Array(w, name=self.name + ".table")}
+
+    def apply(self, params, x, *, train=False, rng=None):
+        return x + params["table"][None, :x.shape[1]]
+
+    def numpy_apply(self, params, x):
+        return (numpy.asarray(x, dtype=numpy.float32)
+                + params["table"][None, :x.shape[1]])
+
+
+@matches(PositionalEmbedding)
+class GDPositionalEmbedding(GradientDescentBase):
+    MAPPING = "gd_pos_embedding"
+    hide_from_registry = False
+
+
 class MeanPool(ForwardBase):
     """(B, T, D) → (B, D): mean over the sequence axis (classification
     head plumbing for sequence stacks)."""
