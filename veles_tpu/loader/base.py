@@ -35,10 +35,16 @@ class Loader(Unit):
     hide_from_registry = True
 
     def __init__(self, workflow, minibatch_size=100, shuffle_limit=None,
-                 **kwargs):
+                 shard_dataset=False, **kwargs):
         super().__init__(workflow, **kwargs)
         self.view_group = "LOADER"
         self.max_minibatch_size = int(minibatch_size)
+        #: shard the device-resident dataset over the mesh 'data' axis
+        #: instead of replicating it on every chip: HBM per chip scales
+        #: 1/n with the axis (GSPMD turns the in-step gather into the
+        #: needed collectives). Keep False for small datasets — the
+        #: replicated gather is collective-free.
+        self.shard_dataset = bool(shard_dataset)
         #: samples per class: [test, validation, train]
         self.class_lengths: List[int] = [0, 0, 0]
         self.epoch_number = 0

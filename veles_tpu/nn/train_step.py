@@ -447,11 +447,24 @@ class TrainStep(AcceleratedUnit):
         sh = self._shardings
         repl = sh["repl"] if sh else None
         batch = sh["batch"] if sh else None
-        dataset = loader.original_data.device_view(sharding=repl)
-        labels = (loader.original_labels.device_view(sharding=repl)
+        ds_sh = repl
+        if sh is not None and getattr(loader, "shard_dataset", False):
+            mesh = repl.mesh
+            if "data" in mesh.axis_names and mesh.shape["data"] > 1:
+                n_data = mesh.shape["data"]
+                if loader.total_samples % n_data:
+                    raise Bug(
+                        "shard_dataset: %d samples not divisible by "
+                        "data-axis size %d" % (loader.total_samples,
+                                               n_data))
+                from jax.sharding import NamedSharding
+                from jax.sharding import PartitionSpec as P
+                ds_sh = NamedSharding(mesh, P("data"))
+        dataset = loader.original_data.device_view(sharding=ds_sh)
+        labels = (loader.original_labels.device_view(sharding=ds_sh)
                   if loader.original_labels else None)
         targets = getattr(loader, "original_targets", None)
-        targets = (targets.device_view(sharding=repl)
+        targets = (targets.device_view(sharding=ds_sh)
                    if targets is not None and targets else dataset)
         if labels is None:
             labels = self._dummy_labels(dataset)
