@@ -452,14 +452,24 @@ class TrainStep(AcceleratedUnit):
             mesh = repl.mesh
             if "data" in mesh.axis_names and mesh.shape["data"] > 1:
                 n_data = mesh.shape["data"]
-                if loader.total_samples % n_data:
+                n_rows = loader.original_data.shape[0]
+                if n_rows % n_data:
+                    # the stored array is what shards, not the (possibly
+                    # train_ratio-subsetted) logical sample count
                     raise Bug(
-                        "shard_dataset: %d samples not divisible by "
-                        "data-axis size %d" % (loader.total_samples,
-                                               n_data))
+                        "shard_dataset: dataset of %d rows not "
+                        "divisible by data-axis size %d"
+                        % (n_rows, n_data))
                 from jax.sharding import NamedSharding
                 from jax.sharding import PartitionSpec as P
                 ds_sh = NamedSharding(mesh, P("data"))
+            elif mesh.devices.size > 1 and \
+                    not getattr(self, "_warned_shard_dataset", False):
+                self._warned_shard_dataset = True   # once, not per step
+                self.warning(
+                    "%s: shard_dataset=True but the mesh has no 'data' "
+                    "axis (>1) — dataset stays fully replicated on "
+                    "every chip", loader.name)
         dataset = loader.original_data.device_view(sharding=ds_sh)
         labels = (loader.original_labels.device_view(sharding=ds_sh)
                   if loader.original_labels else None)
