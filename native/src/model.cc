@@ -491,11 +491,39 @@ struct MultiHeadAttention : Unit {
   }
 };
 
+// rotary position embedding on a (t, d) plane with heads as contiguous
+// hd slices (transformer.py _rope twin): HALF-SPLIT pairing (GPT-NeoX
+// convention, feature j rotates with j+half — not interleaved even/odd)
+void RopeRotate(float *plane, int t, int d, int h,
+                float base = 10000.0f) {
+  int hd = d / h;
+  int half = hd / 2;
+  std::vector<float> inv(half), cosv(half), sinv(half);
+  for (int j = 0; j < half; ++j)   // position-independent: hoist pow
+    inv[j] = std::pow(base, -static_cast<float>(j) / half);
+  for (int pos = 0; pos < t; ++pos) {
+    for (int j = 0; j < half; ++j) {
+      float ang = pos * inv[j];
+      cosv[j] = std::cos(ang);
+      sinv[j] = std::sin(ang);
+    }
+    for (int head = 0; head < h; ++head) {
+      float *x = plane + static_cast<size_t>(pos) * d + head * hd;
+      for (int j = 0; j < half; ++j) {
+        float a = x[j], b = x[half + j];
+        x[j] = a * cosv[j] - b * sinv[j];
+        x[half + j] = a * sinv[j] + b * cosv[j];
+      }
+    }
+  }
+}
+
 struct TransformerBlock : Unit {
   // inference twin of veles_tpu/nn/transformer.py: pre-LN residual
   // block — h = x + Wo·attn(LN1 x); y = h + W2·gelu(W1·LN2 h)
   int n_heads = 4;
   bool causal = true;
+  bool rope = false;
 
   static void LayerNorm(const float *x, const float *g, const float *b,
                         float *y, int n, int d) {
@@ -542,6 +570,10 @@ struct TransformerBlock : Unit {
         MatMulRM(ln.data(), wq->data.data(), q.data(), t, d, d);
         MatMulRM(ln.data(), wk->data.data(), k.data(), t, d, d);
         MatMulRM(ln.data(), wv->data.data(), v.data(), t, d, d);
+        if (rope) {
+          RopeRotate(q.data(), t, d, h);
+          RopeRotate(k.data(), t, d, h);
+        }
         AttentionHeads(q.data(), k.data(), v.data(), ctx.data(),
                        s.data(), t, d, h, causal);
         MatMulRM(ctx.data(), wo->data.data(), proj.data(), t, d, d);
@@ -825,6 +857,7 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
     auto u = std::make_unique<TransformerBlock>();
     if (cfg.Has("n_heads")) u->n_heads = cfg["n_heads"].AsInt();
     if (cfg.Has("causal")) u->causal = cfg["causal"].AsBool();
+    if (cfg.Has("rope")) u->rope = cfg["rope"].AsBool();
     return u;
   }
   if (type == "mean_pool") return std::make_unique<MeanPool>();

@@ -23,3 +23,18 @@ os.environ.setdefault("VELES_TPU_TEST", "1")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def import_model(name):
+    """Import models/<name>.py as a module (models/ is not a package —
+    mirrors the reference's import_file machinery, veles/import_file.py).
+    Shared by model-zoo CI and feature tests."""
+    import importlib.util
+    import sys as _sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "models", name + ".py")
+    spec = importlib.util.spec_from_file_location("models_" + name, path)
+    mod = importlib.util.module_from_spec(spec)
+    _sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod

@@ -207,7 +207,7 @@ def transformer_pkg(tmp_path_factory):
             {"type": "transformer_block", "n_heads": 2,
              "ffn_hidden": 16, "causal": True},
             {"type": "transformer_block", "n_heads": 2,
-             "ffn_hidden": 16, "causal": True},
+             "ffn_hidden": 16, "causal": True, "rope": True},
             {"type": "mean_pool"},
             {"type": "softmax", "output_sample_shape": 3},
         ],

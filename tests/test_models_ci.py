@@ -13,9 +13,7 @@ models genuinely learn). They are intentionally loose: the gate is
 "learns at all", not "matches the published anchor" (which needs the real
 datasets, absent in-image; BASELINE.md documents the anchors).
 """
-import importlib.util
 import os
-import sys
 
 import numpy
 import pytest
@@ -27,15 +25,7 @@ from veles_tpu.datasets import _synthetic_images
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _import_model(name):
-    """Import models/<name>.py as a module (models/ is not a package —
-    mirrors the reference's import_file machinery, veles/import_file.py)."""
-    path = os.path.join(REPO, "models", name + ".py")
-    spec = importlib.util.spec_from_file_location("models_" + name, path)
-    mod = importlib.util.module_from_spec(spec)
-    sys.modules[spec.name] = mod
-    spec.loader.exec_module(mod)
-    return mod
+from conftest import import_model as _import_model  # noqa: E402
 
 
 def _dev():

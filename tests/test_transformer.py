@@ -92,3 +92,52 @@ def test_transformer_pipelines():
     e_pp = numpy.asarray(wf.decision.epoch_metrics[VALID])
     e_pl = numpy.asarray(plain.decision.epoch_metrics[VALID])
     numpy.testing.assert_allclose(e_pp, e_pl, atol=0.03)
+
+
+def test_rope_oracle_agreement():
+    prev = vt.root.common.engine.compute_dtype
+    vt.root.common.engine.compute_dtype = "float32"
+    try:
+        wf = vt.Workflow(name="tr")
+        u = nn.TransformerBlock(wf, n_heads=2, ffn_hidden=16,
+                                causal=True, rope=True)
+        x = numpy.random.RandomState(4).randn(2, 8, 12).astype("float32")
+        u.input = Array(x)
+        u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        u.xla_run()
+        y = numpy.asarray(u.output.map_read())
+        y_np = u.numpy_apply(u.params_np(), x)
+        numpy.testing.assert_allclose(y, y_np, rtol=1e-4, atol=1e-4)
+        # rope actually changes the computation
+        u2 = nn.TransformerBlock(wf, n_heads=2, ffn_hidden=16,
+                                 causal=True, rope=False, name="nr")
+        y_plain = u2.numpy_apply(u.params_np(), x)
+        assert numpy.abs(y_np - y_plain).max() > 1e-3
+    finally:
+        vt.root.common.engine.compute_dtype = prev
+
+
+def test_rope_solves_position_task():
+    """RoPE provides positions WITHOUT a pos_embedding unit: the
+    order-classification task (position-dependent) must be learnable
+    from rope alone."""
+    from conftest import import_model
+    mod = import_model("tiny_transformer")
+
+    prng.seed_all(31)
+    loader = mod.OrderLoader(None, n_train=2048, n_valid=512,
+                             minibatch_size=64, name="order-rope")
+    layers = ([{"type": "transformer_block", "n_heads": 4,
+                "ffn_hidden": 64, "causal": False, "rope": True,
+                "solver": "adam", "learning_rate": 0.003,
+                "name": "blk%d" % i} for i in range(2)]
+              + [{"type": "mean_pool"},
+                 {"type": "softmax", "output_sample_shape": 2,
+                  "solver": "adam", "learning_rate": 0.003}])
+    wf = nn.StandardWorkflow(
+        name="rope-order", layers=layers, loader_unit=loader,
+        loss_function="softmax",
+        decision_config=dict(max_epochs=15, fail_iterations=50))
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    assert wf.decision.best_metric < 0.35, wf.decision.epoch_metrics

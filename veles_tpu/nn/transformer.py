@@ -40,6 +40,30 @@ def _gelu(np_mod, x):
     return 0.5 * x * (1.0 + np_mod.tanh(c * (x + 0.044715 * x ** 3)))
 
 
+def _rope(np_mod, x, base=10000.0):
+    """Rotary position embedding on (B, T, H, Dh), HALF-SPLIT pairing
+    (GPT-NeoX convention: feature j rotates with j+half — NOT the
+    interleaved even/odd RoFormer layout; the two are not weight-
+    compatible). Relative by construction, so it needs no learned table
+    and no length cap; applied to the GLOBAL q/k before attention_core,
+    it stays correct under every attention path (single-chip, flash,
+    ring, Ulysses)."""
+    t, hd = x.shape[1], x.shape[-1]
+    half = hd // 2
+    inv = (base ** (-numpy.arange(half, dtype="float32") / half))
+    ang = np_mod.asarray(
+        numpy.arange(t, dtype="float32")[:, None] * inv[None, :])
+    cos, sin = np_mod.cos(ang), np_mod.sin(ang)
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:2 * half]
+    rot1 = x1 * cos - x2 * sin
+    rot2 = x1 * sin + x2 * cos
+    if 2 * half == hd:
+        return np_mod.concatenate([rot1, rot2], axis=-1)
+    return np_mod.concatenate([rot1, rot2, x[..., 2 * half:]], axis=-1)
+
+
 class TransformerBlock(ForwardBase):
     """(B, T, D) → (B, T, D); the canonical pipelineable stage."""
 
@@ -50,11 +74,15 @@ class TransformerBlock(ForwardBase):
                    "ln1_g", "ln1_b", "ln2_g", "ln2_b")
 
     def __init__(self, workflow, n_heads=4, ffn_hidden=0, causal=True,
-                 **kwargs):
+                 rope=False, **kwargs):
         super().__init__(workflow, **kwargs)
         self.n_heads = int(n_heads)
         self.ffn_hidden = int(ffn_hidden)
         self.causal = causal
+        #: rotary position embedding on q/k — position information with
+        #: no learned table and no trained-length cap (the alternative
+        #: to a pos_embedding unit ahead of the stack)
+        self.rope = bool(rope)
         self.mesh = None
         self.weights_stddev = kwargs.get("weights_stddev", None)
 
@@ -117,6 +145,8 @@ class TransformerBlock(ForwardBase):
         q = heads(jnp.dot(a_in, params["wq"], precision=prec))
         k = heads(jnp.dot(a_in, params["wk"], precision=prec))
         v = heads(jnp.dot(a_in, params["wv"], precision=prec))
+        if self.rope:
+            q, k = _rope(jnp, q), _rope(jnp, k)
         o = attention_core(q, k, v, causal=self.causal, mesh=self.mesh,
                            n_heads=h).reshape(b, t, d)
         x = x + jnp.dot(o, params["wo"], precision=prec)
@@ -138,6 +168,8 @@ class TransformerBlock(ForwardBase):
 
         q, k, v = heads(params["wq"]), heads(params["wk"]), \
             heads(params["wv"])
+        if self.rope:
+            q, k = _rope(numpy, q), _rope(numpy, k)
         s = numpy.einsum("bqhd,bkhd->bhqk", q, k) / numpy.sqrt(hd)
         if self.causal:
             mask = numpy.tril(numpy.ones((t, t), bool))

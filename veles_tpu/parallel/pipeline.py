@@ -119,7 +119,15 @@ def plan_pipeline(forwards: List[Any], n_stages: int
             (k, tuple(v.shape), str(v.dtype))
             for k, v in f.param_arrays().items()))
         gd = tuple(sorted(getattr(f, "gd_config", {}).items()))
-        return (type(f).__name__, params, gd)
+        # semantic config must match too: the grouped block runs every
+        # layer through block[0].apply, so e.g. rope=True/False or
+        # causal differences would silently apply block 0's setting to
+        # all stages. The export key list IS the inference-defining
+        # config inventory — reuse it.
+        from ..export.package import _EXPORT_KEYS
+        cfg = tuple((k, repr(getattr(f, k))) for k in _EXPORT_KEYS
+                    if hasattr(f, k))
+        return (type(f).__name__, params, gd, cfg)
 
     sigs = [signature(f) for f in forwards]
     best = (0, 0)  # (length, start)
