@@ -175,3 +175,66 @@ def test_expert_parallel_through_workflow():
     assert not w1.sharding.is_fully_replicated
     wf.run()
     assert wf.decision.best_metric < 0.1, wf.decision.epoch_metrics
+
+
+def test_pipeline_rejects_mixed_config_blocks():
+    """Same class + same shapes but different semantic config (rope
+    on/off): grouping would silently run block 0's settings on every
+    stage — must fail loudly instead."""
+    layers = ([{"type": "transformer_block", "n_heads": 2,
+                "ffn_hidden": 8, "rope": bool(i % 2),
+                "name": "tb%d" % i} for i in range(4)]
+              + [{"type": "mean_pool"},
+                 {"type": "softmax", "output_sample_shape": 3}])
+    import numpy as _np
+
+    class SeqL(FullBatchLoader):
+        hide_from_registry = True
+
+        def load_data(self):
+            rng = _np.random.RandomState(1)
+            self.create_originals(
+                rng.rand(96, 6, 16).astype(_np.float32),
+                rng.randint(0, 3, 96).astype(_np.int32))
+            self.class_lengths = [0, 24, 72]
+
+    wf = nn.StandardWorkflow(
+        name="pp-mixed", layers=layers,
+        loader_unit=SeqL(None, minibatch_size=24, name="seql"),
+        loss_function="softmax", decision_config=dict(max_epochs=1))
+    with pytest.raises(Bug, match="pipeline"):
+        wf.initialize(device=vt.XLADevice(mesh_axes={"pipeline": 4}))
+
+
+def test_pipeline_clip_norm_matches_plain():
+    """gradient_clip_norm under stacking clips per layer slice — the
+    pipelined run must match the plain run exactly like the unclipped
+    equivalence test does."""
+    def run(mesh_axes):
+        prng.seed_all(4242)
+        loader = BlobsLoader(None, minibatch_size=24, name="b-ppclip")
+        layers = ([{"type": "all2all_tanh", "output_sample_shape": 16,
+                    "name": "stem", "learning_rate": 0.5,
+                    "gradient_clip_norm": 0.1}]
+                  + [{"type": "all2all_tanh", "output_sample_shape": 16,
+                      "name": "blk%d" % i, "learning_rate": 0.5,
+                      "gradient_clip_norm": 0.1} for i in range(4)]
+                  + [{"type": "softmax", "output_sample_shape": 3,
+                      "name": "head", "learning_rate": 0.5,
+                      "gradient_clip_norm": 0.1}])
+        wf = nn.StandardWorkflow(
+            name="ppclip", layers=layers, loader_unit=loader,
+            loss_function="softmax",
+            decision_config=dict(max_epochs=4, fail_iterations=100))
+        wf.initialize(device=vt.XLADevice(mesh_axes=mesh_axes))
+        wf.run()
+        import jax
+        if wf.train_step._pp is not None:
+            w = wf.train_step.params[PP_BLOCK]["weights"][1]
+        else:
+            w = wf.train_step.params["blk1"]["weights"]
+        return numpy.asarray(jax.device_get(w))
+
+    w_plain = run({"data": 1})
+    w_pp = run({"pipeline": 4})
+    numpy.testing.assert_allclose(w_pp, w_plain, rtol=2e-3, atol=2e-4)

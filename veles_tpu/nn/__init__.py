@@ -24,7 +24,8 @@ from .dropout import DropoutForward  # noqa
 from .normalization import LRNormalizerForward  # noqa
 from .evaluator import EvaluatorSoftmax, EvaluatorMSE  # noqa
 from .decision import DecisionGD, DecisionMSE  # noqa
-from .lr_adjust import LearningRateAdjust, step_exp, inv, exp_decay  # noqa
+from .lr_adjust import (LearningRateAdjust, step_exp, inv,  # noqa
+                        exp_decay, warmup_cosine)
 from .rnn import LSTM, RNN  # noqa
 from .kohonen import KohonenForward, KohonenTrainer  # noqa
 from .rbm import RBM, RBMTrainer  # noqa

@@ -24,6 +24,22 @@ def inv(gamma: float = 1e-4, power: float = 0.75) -> Callable[[int], float]:
     return lambda epoch: (1.0 + gamma * epoch) ** (-power)
 
 
+def warmup_cosine(warmup_epochs: int, total_epochs: int,
+                  floor: float = 0.0) -> Callable[[int], float]:
+    """Linear warmup then cosine decay to ``floor`` — the standard
+    schedule for adam-trained attention stacks (epoch granularity: the
+    scale feeds the fused step as a traced scalar)."""
+    import math
+
+    def schedule(epoch: int) -> float:
+        if warmup_epochs > 0 and epoch < warmup_epochs:
+            return (epoch + 1) / warmup_epochs
+        span = max(1, total_epochs - warmup_epochs)
+        frac = min(1.0, (epoch - warmup_epochs) / span)
+        return floor + (1 - floor) * 0.5 * (1 + math.cos(math.pi * frac))
+    return schedule
+
+
 class LearningRateAdjust(Unit):
     """Unit form: recomputes ``lr_scale`` from the decision's epoch counter
     each epoch; the TrainStep reads ``lr_scale`` every minibatch."""

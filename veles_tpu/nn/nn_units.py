@@ -58,8 +58,8 @@ class ForwardBase(AcceleratedUnit):
     #: the layer dict too, e.g. {"type": "conv", "learning_rate": …})
     GD_KEYS = ("learning_rate", "learning_rate_bias", "weights_decay",
                "weight_decay", "weights_decay_bias", "gradient_moment",
-               "momentum", "gradient_clip", "solver", "beta1", "beta2",
-               "epsilon")
+               "momentum", "gradient_clip", "gradient_clip_norm",
+               "solver", "beta1", "beta2", "epsilon")
 
     def __init__(self, workflow, **kwargs) -> None:
         #: hyper-parameters for the matched GD unit, captured from the
@@ -177,6 +177,10 @@ class GradientDescentBase(AcceleratedUnit):
                                        kwargs.get("weight_decay", 0.0))
         self.weight_decay_bias = kwargs.get("weights_decay_bias", 0.0)
         self.gradient_clip = kwargs.get("gradient_clip", 0.0)
+        #: clip this layer's gradients by their joint L2 norm (the
+        #: transformer-era stabilizer; gradient_clip stays the
+        #: element-wise Znicz semantic)
+        self.gradient_clip_norm = kwargs.get("gradient_clip_norm", 0.0)
         #: per-layer update rule: "sgd" (Znicz semantics) | "adam" |
         #: "adagrad" — routed from the layer dict like the lr knobs
         self.solver = kwargs.get("solver", "sgd")
@@ -209,6 +213,35 @@ class GradientDescentBase(AcceleratedUnit):
         delta = lr*(grad + wd*w) + mom*prev); "adam"/"adagrad" keep the
         same lr/wd/clip knobs around their own accumulators."""
         import jax.numpy as jnp
+
+        if self.gradient_clip_norm:
+            # joint L2 over this LAYER's grad tree. When TrainStep hands
+            # this GD a stacked pipeline block (leaves carry a leading
+            # layer axis; stacked_layers set by _setup_pipeline), the
+            # norm is computed per layer slice so pipelined and plain
+            # runs clip identically.
+            import jax
+            leaves = jax.tree_util.tree_leaves(grads)
+            n_stk = getattr(self, "stacked_layers", 0)
+            if n_stk:
+                sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))
+                                 .reshape(n_stk, -1), axis=1)
+                         for g in leaves)                       # (L,)
+                factor = jnp.minimum(
+                    1.0, self.gradient_clip_norm
+                    / jnp.maximum(jnp.sqrt(sq), 1e-12))
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g * factor.reshape(
+                        (n_stk,) + (1,) * (g.ndim - 1))).astype(g.dtype),
+                    grads)
+            else:
+                sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in leaves)
+                factor = jnp.minimum(
+                    1.0, self.gradient_clip_norm
+                    / jnp.maximum(jnp.sqrt(sq), 1e-12))
+                grads = jax.tree_util.tree_map(
+                    lambda g: (g * factor).astype(g.dtype), grads)
 
         def knobs(k, p, g):
             lr = (self.learning_rate_bias if k == "bias"

@@ -177,6 +177,9 @@ class TrainStep(AcceleratedUnit):
         self.params[PP_BLOCK] = stacked
         self.opt_state[PP_BLOCK] = gd.init_state(stacked)
         self._gd_for[PP_BLOCK] = gd
+        # per-layer semantics (e.g. gradient_clip_norm) must survive the
+        # stacking: tell the GD its tree now carries a leading layer axis
+        gd.stacked_layers = len(names)
         mb = self.loader.max_minibatch_size
         n_micro = int(self.pipeline_microbatches or n_stages)
         if mb % n_micro:

@@ -145,7 +145,7 @@ class TransformerBlock(ForwardBase):
         q = heads(jnp.dot(a_in, params["wq"], precision=prec))
         k = heads(jnp.dot(a_in, params["wk"], precision=prec))
         v = heads(jnp.dot(a_in, params["wv"], precision=prec))
-        if self.rope:
+        if getattr(self, "rope", False):   # absent in pre-rope exports
             q, k = _rope(jnp, q), _rope(jnp, k)
         o = attention_core(q, k, v, causal=self.causal, mesh=self.mesh,
                            n_heads=h).reshape(b, t, d)
@@ -168,7 +168,7 @@ class TransformerBlock(ForwardBase):
 
         q, k, v = heads(params["wq"]), heads(params["wk"]), \
             heads(params["wv"])
-        if self.rope:
+        if getattr(self, "rope", False):   # absent in pre-rope exports
             q, k = _rope(numpy, q), _rope(numpy, k)
         s = numpy.einsum("bqhd,bkhd->bhqk", q, k) / numpy.sqrt(hd)
         if self.causal:
