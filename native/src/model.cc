@@ -631,6 +631,28 @@ struct PosEmbedding : Unit {
   }
 };
 
+struct Embedding : Unit {
+  // (B, T) token ids (stored as floats in the runtime's tensors) →
+  // (B, T, D) rows of the table (transformer.py Embedding twin)
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *table = Param("table");
+    int vocab = table->shape[0], d = table->shape[1];
+    size_t n = in.size();
+    std::vector<int> shape = in.shape;
+    shape.push_back(d);
+    out->Resize(shape);
+    for (size_t i = 0; i < n; ++i) {
+      int tok = static_cast<int>(std::lround(in.data[i]));
+      // clamp, matching jnp.take(mode="clip") and the numpy oracle —
+      // one OOB semantic across every runtime
+      tok = std::min(std::max(tok, 0), vocab - 1);
+      std::memcpy(out->data.data() + i * d,
+                  table->data.data() + static_cast<size_t>(tok) * d,
+                  sizeof(float) * d);
+    }
+  }
+};
+
 struct MeanPool : Unit {
   void Run(const Tensor &in, Tensor *out) override {
     int batch = in.shape[0], t = in.shape[1];
@@ -862,6 +884,7 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
   }
   if (type == "mean_pool") return std::make_unique<MeanPool>();
   if (type == "pos_embedding") return std::make_unique<PosEmbedding>();
+  if (type == "embedding") return std::make_unique<Embedding>();
   if (type == "moe_ffn") {
     auto u = std::make_unique<MoEFFN>();
     if (cfg.Has("top_k")) u->top_k = cfg["top_k"].AsInt();

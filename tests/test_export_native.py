@@ -240,3 +240,44 @@ def test_python_executor_transformer(transformer_pkg):
     pkg, batch, truth = transformer_pkg
     out = run_package(pkg, batch)
     numpy.testing.assert_allclose(out, truth, rtol=2e-3, atol=2e-4)
+
+
+@needs_native
+def test_native_embedding_parity(tmp_path):
+    """Token stem through the C++ engine (ids travel as floats in the
+    runtime tensors; the unit rounds + bounds-checks)."""
+    class Toks(FullBatchLoader):
+        hide_from_registry = True
+
+        def load_data(self):
+            rng = numpy.random.RandomState(3)
+            self.create_originals(
+                rng.randint(0, 11, (48, 6)).astype(numpy.int32),
+                rng.randint(0, 3, 48).astype(numpy.int32))
+            self.class_lengths = [0, 12, 36]
+
+    wf = nn.StandardWorkflow(
+        name="tok-net",
+        layers=[{"type": "embedding", "vocab_size": 11, "dim": 8},
+                {"type": "transformer_block", "n_heads": 2,
+                 "ffn_hidden": 16, "rope": True},
+                {"type": "mean_pool"},
+                {"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=Toks(None, minibatch_size=12, name="tk"),
+        loss_function="softmax",
+        decision_config=dict(max_epochs=1), steps_per_dispatch=2)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    pkg = str(tmp_path / "tok-net")
+    package_export(wf, pkg, with_stablehlo=False)
+    batch = wf.loader.original_data.mem[:5].copy()
+    import jax
+    x = batch
+    for f in wf.forwards:
+        p = {k: v.device_view() for k, v in f.param_arrays().items()}
+        x = f.apply(p, x, train=False)
+    truth = numpy.asarray(jax.device_get(x))
+    model = NativeModel(pkg)
+    out = model(batch.astype(numpy.float32)).reshape(truth.shape)
+    numpy.testing.assert_allclose(out, truth, rtol=2e-3, atol=2e-4)
+    model.close()

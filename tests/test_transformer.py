@@ -141,3 +141,73 @@ def test_rope_solves_position_task():
     wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
     wf.run()
     assert wf.decision.best_metric < 0.35, wf.decision.epoch_metrics
+
+
+def test_embedding_text_model_trains():
+    """Token path end to end: int sequences → Embedding → rope block →
+    pool → softmax. Task: does token 7 appear in the sequence."""
+    class TokenLoader(FullBatchLoader):
+        hide_from_registry = True
+
+        def load_data(self):
+            rng = numpy.random.RandomState(9)
+            n, t, vocab = 480, 10, 12
+            x = rng.randint(0, vocab, (n, t)).astype(numpy.int32)
+            x[x == 7] = 6                     # clear marker everywhere
+            y = rng.randint(0, 2, n).astype(numpy.int32)
+            for i in numpy.where(y == 1)[0]:
+                x[i, rng.randint(0, t)] = 7   # plant the marker
+            self.create_originals(x, y)
+            self.class_lengths = [0, 96, 384]
+
+    prng.seed_all(55)
+    wf = nn.StandardWorkflow(
+        name="text-clf",
+        layers=[{"type": "embedding", "vocab_size": 12, "dim": 16,
+                 "solver": "adam", "learning_rate": 0.01},
+                {"type": "transformer_block", "n_heads": 2,
+                 "ffn_hidden": 32, "causal": False, "rope": True,
+                 "solver": "adam", "learning_rate": 0.01},
+                {"type": "mean_pool"},
+                {"type": "softmax", "output_sample_shape": 2,
+                 "solver": "adam", "learning_rate": 0.01}],
+        loader_unit=TokenLoader(None, minibatch_size=48, name="toks"),
+        loss_function="softmax",
+        decision_config=dict(max_epochs=10, fail_iterations=50))
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    assert wf.decision.best_metric < 0.1, wf.decision.epoch_metrics
+
+
+def test_embedding_oracle():
+    wf = vt.Workflow(name="te")
+    u = nn.Embedding(wf, vocab_size=9, dim=5)
+    x = numpy.random.RandomState(2).randint(0, 9, (3, 7)).astype("int32")
+    u.input = Array(x)
+    u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    u.xla_run()
+    y = numpy.asarray(u.output.map_read())
+    y_np = u.numpy_apply(u.params_np(), x)
+    numpy.testing.assert_allclose(y, y_np, rtol=1e-5, atol=1e-6)
+    assert y.shape == (3, 7, 5)
+
+
+def test_embedding_oob_clips_consistently():
+    """Out-of-range ids clamp identically in jax, oracle and (by
+    construction) the C++ twin — the one semantic on-device code can
+    express."""
+    wf = vt.Workflow(name="teo")
+    u = nn.Embedding(wf, vocab_size=4, dim=3)
+    x = numpy.array([[-1, 0, 3, 4, 99]], dtype="int32")
+    u.input = Array(numpy.clip(x, 0, 3))
+    u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    params = u.params_np()
+    y_np = u.numpy_apply(params, x)
+    import jax
+    y_jax = numpy.asarray(jax.device_get(
+        u.apply({k: jax.numpy.asarray(v) for k, v in params.items()},
+                jax.numpy.asarray(x))))
+    numpy.testing.assert_allclose(y_jax, y_np, rtol=1e-6)
+    numpy.testing.assert_allclose(y_np[0, 0], params["table"][0])
+    numpy.testing.assert_allclose(y_np[0, 3], params["table"][3])
+    numpy.testing.assert_allclose(y_np[0, 4], params["table"][3])

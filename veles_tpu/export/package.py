@@ -34,7 +34,7 @@ _EXPORT_KEYS = (
     "n", "k", "hidden_size", "return_sequences", "forget_bias",
     "n_heads", "causal", "dropout_ratio",
     "n_experts", "hidden", "top_k", "capacity_factor", "ffn_hidden",
-    "rope",
+    "rope", "vocab_size", "dim",
 )
 
 

@@ -229,6 +229,53 @@ class GDPositionalEmbedding(GradientDescentBase):
     hide_from_registry = False
 
 
+class Embedding(ForwardBase):
+    """(B, T) int tokens → (B, T, D) vectors: the text-model stem.
+    The lookup is a device-side take, so the fused step's gradient is
+    the usual scatter-add into the table (jax.grad of jnp.take)."""
+
+    MAPPING = "embedding"
+    PARAMETERIZED = True
+    hide_from_registry = False
+    PARAM_NAMES = ("table",)
+
+    def __init__(self, workflow, vocab_size: int, dim: int,
+                 stddev: float = 0.02, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.vocab_size = int(vocab_size)
+        self.dim = int(dim)
+        self.stddev = float(stddev)
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape) + (self.dim,)
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        w = numpy.zeros((self.vocab_size, self.dim),
+                        dtype=root.common.engine.precision_type)
+        prng.get(self.name + ".table").fill_normal(w, self.stddev)
+        return {"table": Array(w, name=self.name + ".table")}
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax.numpy as jnp
+        # mode="clip" made explicit: out-of-range ids clamp to the edge
+        # rows, and ALL runtimes (oracle, C++ twin) mirror exactly that
+        # — XLA cannot raise on device, so clip is the one semantic
+        # every path can share
+        return jnp.take(params["table"], x.astype(jnp.int32), axis=0,
+                        mode="clip")
+
+    def numpy_apply(self, params, x):
+        ids = numpy.clip(numpy.asarray(x, dtype=numpy.int64), 0,
+                         params["table"].shape[0] - 1)
+        return params["table"][ids]
+
+
+@matches(Embedding)
+class GDEmbedding(GradientDescentBase):
+    MAPPING = "gd_embedding"
+    hide_from_registry = False
+
+
 class MeanPool(ForwardBase):
     """(B, T, D) → (B, D): mean over the sequence axis (classification
     head plumbing for sequence stacks)."""
