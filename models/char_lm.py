@@ -1,0 +1,108 @@
+"""Character language model — next-token prediction zoo member.
+
+New capability vs the reference (no language modeling anywhere in 2015
+VELES): embedding → RoPE transformer stack → LM head, trained with
+``loss_function="softmax_seq"`` (per-token cross-entropy on shifted
+targets). The corpus is generated from a small deterministic grammar,
+so the next-token structure is real and in-image (anchor like
+models/lines.py); swap ``make_corpus`` for a file to train on text.
+
+Identical-block stacks pipeline over ``--mesh pipeline=N`` and the
+sequence axis shards over ``--mesh sequence=N`` unchanged.
+
+Run: python models/char_lm.py [--epochs N]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy  # noqa: E402
+
+import veles_tpu as vt  # noqa: E402
+from veles_tpu import nn  # noqa: E402
+from veles_tpu.loader import FullBatchLoaderMSE  # noqa: E402
+
+SEQ_LEN = 32
+VOCAB = 16
+
+
+def make_corpus(rng, n_chars):
+    """Markov-ish grammar: each symbol strongly prefers (s + 1) % 8 or
+    a jump into the 8-15 'punctuation' range that returns to 0."""
+    out = numpy.empty(n_chars, dtype=numpy.int32)
+    s = 0
+    for i in range(n_chars):
+        out[i] = s
+        r = rng.rand()
+        if s < 8:
+            s = (s + 1) % 8 if r < 0.8 else 8 + rng.randint(0, 8)
+        else:
+            s = 0 if r < 0.9 else 8 + rng.randint(0, 8)
+    return out
+
+
+class CharLMLoader(FullBatchLoaderMSE):
+    hide_from_registry = True
+
+    def __init__(self, workflow, n_train=1536, n_valid=256, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.n_train, self.n_valid = n_train, n_valid
+
+    def load_data(self):
+        rng = numpy.random.RandomState(41)
+        n = self.n_valid + self.n_train
+        corpus = make_corpus(rng, n * SEQ_LEN + 1)
+        x = corpus[:-1].reshape(n, SEQ_LEN)
+        y = corpus[1:].reshape(n, SEQ_LEN)       # next-token targets
+        self.create_originals(x, None, targets=y)
+        self.class_lengths = [0, self.n_valid, self.n_train]
+
+
+def build_workflow(epochs=10, minibatch_size=64, lr=0.003, n_blocks=2,
+                   dim=32, n_train=1536, n_valid=256):
+    loader = CharLMLoader(None, n_train=n_train, n_valid=n_valid,
+                          minibatch_size=minibatch_size, name="chars")
+    layers = ([{"type": "embedding", "vocab_size": VOCAB, "dim": dim,
+                "solver": "adam", "learning_rate": lr}]
+              + [{"type": "transformer_block", "n_heads": 4,
+                  "ffn_hidden": 2 * dim, "causal": True, "rope": True,
+                  "solver": "adam", "learning_rate": lr,
+                  "name": "blk%d" % i} for i in range(n_blocks)]
+              + [{"type": "lm_head", "vocab_size": VOCAB,
+                  "solver": "adam", "learning_rate": lr}])
+    wf = nn.StandardWorkflow(
+        name="char-lm", layers=layers, loader_unit=loader,
+        loss_function="softmax_seq",
+        decision_config=dict(max_epochs=epochs, fail_iterations=50),
+    )
+    return wf
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--mb", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.003)
+    p.add_argument("--blocks", type=int, default=2)
+    p.add_argument("--backend", default="auto")
+    args = p.parse_args(argv)
+
+    wf = build_workflow(args.epochs, args.mb, args.lr, args.blocks)
+    wf.initialize(device=vt.Device_for(args.backend))
+    t0 = time.time()
+    wf.run()
+    dt = time.time() - t0
+    res = wf.gather_results()
+    print("best per-token error: %.4f (epoch %d)" %
+          (res["best_err"], res["best_epoch"]))
+    print("throughput: %.0f samples/sec" %
+          (wf.loader.samples_served / dt))
+    return res
+
+
+if __name__ == "__main__":
+    main()

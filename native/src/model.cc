@@ -653,6 +653,31 @@ struct Embedding : Unit {
   }
 };
 
+struct LMHead : Unit {
+  // (B, T, D) → (B, T, V) per-position logits (transformer.py twin)
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *w = Param("weights");
+    const NpyArray *bias = Param("bias");
+    int d = w->shape[0], vocab = w->shape[1];
+    int rows = static_cast<int>(in.size()) / d;
+    std::vector<int> shape(in.shape.begin(), in.shape.end() - 1);
+    shape.push_back(vocab);
+    out->Resize(shape);
+    ParallelFor(rows, [&](int lo, int hi) {
+      MatMulRM(in.data.data() + static_cast<size_t>(lo) * d,
+               w->data.data(),
+               out->data.data() + static_cast<size_t>(lo) * vocab,
+               hi - lo, d, vocab);
+      if (bias) {
+        for (int r = lo; r < hi; ++r) {
+          float *y = out->data.data() + static_cast<size_t>(r) * vocab;
+          for (int j = 0; j < vocab; ++j) y[j] += bias->data[j];
+        }
+      }
+    });
+  }
+};
+
 struct MeanPool : Unit {
   void Run(const Tensor &in, Tensor *out) override {
     int batch = in.shape[0], t = in.shape[1];
@@ -885,6 +910,7 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
   if (type == "mean_pool") return std::make_unique<MeanPool>();
   if (type == "pos_embedding") return std::make_unique<PosEmbedding>();
   if (type == "embedding") return std::make_unique<Embedding>();
+  if (type == "lm_head") return std::make_unique<LMHead>();
   if (type == "moe_ffn") {
     auto u = std::make_unique<MoEFFN>();
     if (cfg.Has("top_k")) u->top_k = cfg["top_k"].AsInt();

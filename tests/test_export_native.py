@@ -281,3 +281,32 @@ def test_native_embedding_parity(tmp_path):
     out = model(batch.astype(numpy.float32)).reshape(truth.shape)
     numpy.testing.assert_allclose(out, truth, rtol=2e-3, atol=2e-4)
     model.close()
+
+
+@needs_native
+def test_native_char_lm_parity():
+    """Full LM net (embedding → rope block → lm_head) through the C++
+    engine vs the jitted chain."""
+    from conftest import import_model
+    lm = import_model("char_lm")
+    import tempfile
+    wf = lm.build_workflow(epochs=1, minibatch_size=32, n_blocks=1,
+                           dim=16, n_train=128, n_valid=32)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    with tempfile.TemporaryDirectory() as tmp:
+        pkg = os.path.join(tmp, "lm")
+        from veles_tpu.export import package_export
+        package_export(wf, pkg, with_stablehlo=False)
+        batch = wf.loader.original_data.mem[:4].copy()
+        import jax
+        x = batch
+        for f in wf.forwards:
+            p = {k: v.device_view()
+                 for k, v in f.param_arrays().items()}
+            x = f.apply(p, x, train=False)
+        truth = numpy.asarray(jax.device_get(x))
+        model = NativeModel(pkg)
+        out = model(batch.astype(numpy.float32)).reshape(truth.shape)
+        numpy.testing.assert_allclose(out, truth, rtol=2e-3, atol=2e-4)
+        model.close()

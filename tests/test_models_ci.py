@@ -19,7 +19,7 @@ import numpy
 import pytest
 
 import veles_tpu as vt
-from veles_tpu import datasets
+from veles_tpu import datasets, prng
 from veles_tpu.datasets import _synthetic_images
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -33,6 +33,7 @@ def _dev():
 
 
 def test_mnist_converges(monkeypatch):
+    prng.seed_all(1234)
     """BASELINE config #1 (MNIST-784 FC). Real anchor: 1.48 % val error."""
     monkeypatch.setattr(
         datasets, "load_mnist",
@@ -48,6 +49,7 @@ def test_mnist_converges(monkeypatch):
 
 
 def test_cifar_converges(monkeypatch):
+    prng.seed_all(1234)
     """BASELINE config #4 (CIFAR conv net). Real anchor: 17.21 % val
     error. The surrogate shrinks to 16x16 so the conv stack stays CI-
     affordable on the CPU mesh; the gate is "clearly beats chance"
@@ -69,6 +71,7 @@ def test_cifar_converges(monkeypatch):
 
 
 def test_imagenet_ae_converges(monkeypatch):
+    prng.seed_all(1234)
     """BASELINE config #3 (conv autoencoder). Real anchor: 0.5478 RMSE on
     the MNIST AE variant. Gate: reconstruction RMSE drops below the
     do-nothing bound (std of the surrogate pixels ~0.29) and improves
@@ -87,6 +90,7 @@ def test_imagenet_ae_converges(monkeypatch):
 
 
 def test_genre_lstm_converges():
+    prng.seed_all(1234)
     """BASELINE config #5 (LSTM genre recognition). The loader is already
     synthetic-by-design (frequency/phase signatures per genre)."""
     genre = _import_model("genre_recognition")
@@ -100,6 +104,7 @@ def test_genre_lstm_converges():
 
 
 def test_lines_converges():
+    prng.seed_all(1234)
     """Lines demo (reference zoo member; generator-backed, so its
     accuracy is a REAL anchor, not a surrogate proxy). Exercises the
     per-layer adam solver in CI."""
@@ -113,6 +118,7 @@ def test_lines_converges():
 
 
 def test_tiny_transformer_converges():
+    prng.seed_all(1234)
     """Transformer zoo member (generated order-classification task —
     position-dependent, so pos_embedding + attention are load-bearing;
     a real anchor like lines)."""
@@ -140,3 +146,18 @@ def test_bench_workflow_builds(monkeypatch):
     wf.loader.run()
     wf.train_step.run()
     assert wf.train_step.params
+
+
+def test_char_lm_converges():
+    """Language-model zoo member (new capability: per-token CE via
+    loss_function='softmax_seq'). The grammar's optimal per-token error
+    is ~0.2-0.3 (stochastic branches); a broken LM path sits near
+    1 - 1/16 = 0.94."""
+    prng.seed_all(1234)
+    lm = _import_model("char_lm")
+    wf = lm.build_workflow(epochs=6, minibatch_size=64, n_blocks=1,
+                           dim=32, n_train=768, n_valid=128)
+    wf.initialize(device=_dev())
+    wf.run()
+    res = wf.gather_results()
+    assert res["best_err"] < 0.45, res

@@ -37,7 +37,13 @@ class FullBatchLoader(Loader):
     # -- helpers for subclasses ---------------------------------------------
     def create_originals(self, data: numpy.ndarray,
                          labels: Optional[numpy.ndarray] = None) -> None:
-        dtype = root.common.engine.precision_type
+        data = numpy.asarray(data)
+        # integer data (token-id sequences for an embedding stem) keeps
+        # its dtype — casting ids through a float policy dtype (e.g.
+        # float16) would silently corrupt large ids
+        dtype = (data.dtype
+                 if numpy.issubdtype(data.dtype, numpy.integer)
+                 else root.common.engine.precision_type)
         self.original_data.reset(numpy.ascontiguousarray(data, dtype=dtype))
         if labels is not None:
             self.original_labels.reset(
@@ -105,7 +111,13 @@ class FullBatchLoaderMSE(FullBatchLoader, LoaderMSE):
     def create_originals(self, data, labels=None, targets=None):
         super().create_originals(data, labels)
         if targets is not None:
-            dtype = root.common.engine.precision_type
+            targets = numpy.asarray(targets)
+            # integer targets (token sequences for softmax_seq) keep
+            # their dtype; float regression targets get the precision
+            # policy
+            dtype = (targets.dtype
+                     if numpy.issubdtype(targets.dtype, numpy.integer)
+                     else root.common.engine.precision_type)
             self.original_targets.reset(
                 numpy.ascontiguousarray(targets, dtype=dtype))
 

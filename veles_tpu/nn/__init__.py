@@ -37,7 +37,8 @@ from .nn_plotting import Weights2D, KohonenHits  # noqa
 from .attention import MultiHeadAttention, attention_core  # noqa
 from .moe import MoEFFN  # noqa
 from .transformer import (TransformerBlock, MeanPool,  # noqa
-                          PositionalEmbedding, Embedding)
+                          PositionalEmbedding, Embedding, LMHead)
+from .evaluator import EvaluatorSoftmaxSeq  # noqa
 from .variants import (All2AllRProp, GDRProp,
                        ResizableAll2All)  # noqa
 from .train_step import TrainStep  # noqa

@@ -35,6 +35,12 @@ class EvaluatorBase(Unit):
         """Pure scalar loss, mean over valid samples."""
         raise NotImplementedError
 
+    def sum_loss_weight(self, out, mask):
+        """Weight turning the mean ``loss`` back into the accumulable
+        sum matching ``metrics_fn``'s n_samples unit (samples by
+        default; sequence evaluators count tokens)."""
+        return mask.sum()
+
     def metrics_fn(self, y, target, mask):
         """Pure dict of device metrics for the step output."""
         raise NotImplementedError
@@ -84,6 +90,50 @@ class EvaluatorSoftmax(EvaluatorBase):
         logp = z - numpy.log(numpy.exp(z).sum(axis=1, keepdims=True))
         nll = -logp[numpy.arange(len(labels)), labels]
         return float((nll * mask).sum() / max(mask.sum(), 1))
+
+
+class EvaluatorSoftmaxSeq(EvaluatorBase):
+    """Per-position cross-entropy for sequence models (language
+    modeling): logits (B, T, V) vs int targets (B, T). The batch
+    validity mask extends over every position of a valid sample;
+    metrics count positions, so err = per-token error rate and
+    avg loss = mean NLL/token (report perplexity as exp of it).
+    New capability vs the reference (no LM anywhere in 2015 VELES)."""
+
+    MAPPING = "evaluator_softmax_seq"
+    hide_from_registry = False
+
+    def loss(self, logits, targets, mask):
+        import jax
+        import jax.numpy as jnp
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(
+            logp, targets.astype(jnp.int32)[..., None], axis=-1)[..., 0]
+        w = mask[:, None] * jnp.ones(nll.shape[1])[None, :]
+        return jnp.sum(nll * w) / jnp.maximum(jnp.sum(w), 1)
+
+    def metrics_fn(self, logits, targets, mask):
+        import jax.numpy as jnp
+        pred = jnp.argmax(logits, axis=-1)
+        w = mask[:, None] * jnp.ones(pred.shape[1])[None, :]
+        wrong = (pred != targets.astype(pred.dtype)) * w
+        return {"n_err": jnp.sum(wrong), "n_samples": jnp.sum(w)}
+
+    def sum_loss_weight(self, out, mask):
+        # n_samples counts TOKENS: weight the per-token mean loss by
+        # token count so sum_loss/n_samples is NLL/token (perplexity =
+        # exp of it)
+        return mask.sum() * out.shape[1]
+
+    def numpy_loss(self, logits, targets, mask):
+        z = logits.astype(numpy.float64)
+        z = z - z.max(axis=-1, keepdims=True)
+        logp = z - numpy.log(numpy.exp(z).sum(axis=-1, keepdims=True))
+        b, t = targets.shape
+        nll = -logp[numpy.arange(b)[:, None], numpy.arange(t)[None, :],
+                    targets]
+        w = numpy.asarray(mask)[:, None] * numpy.ones(t)[None, :]
+        return float((nll * w).sum() / max(w.sum(), 1))
 
 
 class EvaluatorMSE(EvaluatorBase):

@@ -144,6 +144,12 @@ class StandardWorkflow(AcceleratedWorkflow):
             self.evaluator = EvaluatorSoftmax(self, n_classes=n_classes)
             self.decision = DecisionGD(self, **decision_config)
             target_mode = "labels"
+        elif self.loss_function == "softmax_seq":
+            # language modeling: per-token CE on (B, T) int targets
+            from .evaluator import EvaluatorSoftmaxSeq
+            self.evaluator = EvaluatorSoftmaxSeq(self)
+            self.decision = DecisionGD(self, **decision_config)
+            target_mode = "targets"
         elif self.loss_function == "mse":
             self.evaluator = EvaluatorMSE(self)
             self.decision = DecisionMSE(self, **decision_config)

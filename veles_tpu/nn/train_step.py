@@ -380,7 +380,8 @@ class TrainStep(AcceleratedUnit):
                     new_params[name][k] = (new_params[name][k]
                                            * m.astype(new_params[name][k].dtype))
         metrics = self.evaluator.metrics_fn(out, tgt, mask)
-        metrics["sum_loss"] = loss * mask.sum()
+        metrics["sum_loss"] = loss * self.evaluator.sum_loss_weight(
+            out, mask)
         accum = jax.tree_util.tree_map(
             lambda a, m: a + m, accum,
             {k: metrics[k] for k in accum})
@@ -418,8 +419,9 @@ class TrainStep(AcceleratedUnit):
         tgt = self._target_for(batch, labels, targets, indices)
         out = self._forward_pure(params, batch, False, None)
         metrics = self.evaluator.metrics_fn(out, tgt, mask)
-        metrics["sum_loss"] = self.evaluator.loss(out, tgt,
-                                                  mask) * mask.sum()
+        metrics["sum_loss"] = (self.evaluator.loss(out, tgt, mask)
+                               * self.evaluator.sum_loss_weight(out,
+                                                                mask))
         return jax.tree_util.tree_map(
             lambda a, m: a + m, accum, {k: metrics[k] for k in accum})
 
@@ -436,9 +438,11 @@ class TrainStep(AcceleratedUnit):
 
     def _make_zero_accum(self):
         import jax.numpy as jnp
+        from .evaluator import EvaluatorSoftmaxSeq
         zeros = {"n_samples": jnp.zeros((), jnp.float32),
                  "sum_loss": jnp.zeros((), jnp.float32)}
-        if isinstance(self.evaluator, EvaluatorSoftmax):
+        if isinstance(self.evaluator, (EvaluatorSoftmax,
+                                       EvaluatorSoftmaxSeq)):
             zeros["n_err"] = jnp.zeros((), jnp.float32)
         else:
             zeros["sum_sq"] = jnp.zeros((), jnp.float32)

@@ -276,6 +276,53 @@ class GDEmbedding(GradientDescentBase):
     hide_from_registry = False
 
 
+class LMHead(ForwardBase):
+    """(B, T, D) → (B, T, V) per-position logits — the language-model
+    output head, paired with ``loss_function="softmax_seq"`` (per-token
+    cross-entropy on shifted targets)."""
+
+    MAPPING = "lm_head"
+    PARAMETERIZED = True
+    hide_from_registry = False
+
+    def __init__(self, workflow, vocab_size: int, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.vocab_size = int(vocab_size)
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape[:-1]) + (self.vocab_size,)
+
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        d = self.input.shape[-1]
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(d))
+        dtype = root.common.engine.precision_type
+        w = numpy.zeros((d, self.vocab_size), dtype=dtype)
+        prng.get(self.name + ".weights").fill_normal(w, stddev)
+        return {"weights": Array(w, name=self.name + ".weights"),
+                "bias": Array(numpy.zeros((self.vocab_size,),
+                                          dtype=dtype),
+                              name=self.name + ".bias")}
+
+    def apply(self, params, x, *, train=False, rng=None):
+        import jax.numpy as jnp
+        from ..ops import matmul_precision
+        return (jnp.dot(x, params["weights"],
+                        precision=matmul_precision())
+                + params["bias"])
+
+    def numpy_apply(self, params, x):
+        return (numpy.asarray(x, dtype=numpy.float32)
+                @ params["weights"] + params["bias"]).astype(
+            numpy.float32)
+
+
+@matches(LMHead)
+class GDLMHead(GradientDescentBase):
+    MAPPING = "gd_lm_head"
+    hide_from_registry = False
+
+
 class MeanPool(ForwardBase):
     """(B, T, D) → (B, D): mean over the sequence axis (classification
     head plumbing for sequence stacks)."""
