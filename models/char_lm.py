@@ -82,12 +82,46 @@ def build_workflow(epochs=10, minibatch_size=64, lr=0.003, n_blocks=2,
     return wf
 
 
+def generate(wf, prompt, n_new, temperature=1.0, seed=0):
+    """Sample continuations from the trained causal stack: re-forward
+    the growing window each step (fine at demo scale; KV caching is a
+    serving optimization, not a training-framework concern)."""
+    import jax
+    import jax.numpy as jnp
+    params = {f.name: {k: v.device_view()
+                       for k, v in f.param_arrays().items()}
+              for f in wf.forwards if f.PARAMETERIZED}
+
+    @jax.jit
+    def logits_fn(tokens):
+        x = tokens[None, :]
+        for f in wf.forwards:
+            x = f.apply(params.get(f.name, {}), x, train=False)
+        return x[0, -1]
+
+    key = jax.random.key(seed)
+    toks = list(int(t) for t in prompt)
+    for _ in range(n_new):
+        window = jnp.asarray(toks[-SEQ_LEN:], dtype=jnp.int32)
+        logits = logits_fn(jnp.pad(window, (SEQ_LEN - len(window), 0))
+                           if len(window) < SEQ_LEN else window)
+        key, sub = jax.random.split(key)
+        if temperature <= 0:
+            nxt = int(jnp.argmax(logits))
+        else:
+            nxt = int(jax.random.categorical(sub, logits / temperature))
+        toks.append(nxt)
+    return toks[len(prompt):]
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--mb", type=int, default=64)
     p.add_argument("--lr", type=float, default=0.003)
     p.add_argument("--blocks", type=int, default=2)
+    p.add_argument("--sample", type=int, default=48,
+                   help="tokens to sample after training (0 = skip)")
     p.add_argument("--backend", default="auto")
     args = p.parse_args(argv)
 
@@ -101,6 +135,9 @@ def main(argv=None):
           (res["best_err"], res["best_epoch"]))
     print("throughput: %.0f samples/sec" %
           (wf.loader.samples_served / dt))
+    if args.sample:
+        toks = generate(wf, [0, 1, 2], args.sample, temperature=0.8)
+        print("sample:", " ".join(str(t) for t in toks))
     return res
 
 

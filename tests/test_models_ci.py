@@ -161,3 +161,22 @@ def test_char_lm_converges():
     wf.run()
     res = wf.gather_results()
     assert res["best_err"] < 0.45, res
+
+
+def test_char_lm_generates_grammar():
+    """Sampling: a briefly trained LM's GREEDY continuations follow the
+    grammar's dominant transition (s -> s+1 mod 8 within 0..7)."""
+    prng.seed_all(1234)
+    lm = _import_model("char_lm")
+    wf = lm.build_workflow(epochs=6, minibatch_size=64, n_blocks=1,
+                           dim=32, n_train=768, n_valid=128)
+    wf.initialize(device=_dev())
+    wf.run()
+    rng = numpy.random.RandomState(3)
+    prompt = list(lm.make_corpus(rng, lm.SEQ_LEN))
+    toks = lm.generate(wf, prompt, 64, temperature=0)
+    seq = prompt[-1:] + toks
+    follow = sum(1 for a, b in zip(seq, seq[1:])
+                 if (a < 8 and b == (a + 1) % 8) or (a >= 8 and b == 0))
+    # dominant transitions fire ~80-90% in the grammar; chance ~1/16
+    assert follow / (len(seq) - 1) > 0.5, (follow, seq)
