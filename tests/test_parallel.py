@@ -179,3 +179,34 @@ def test_mha_routes_by_sequence_parallel_config():
     finally:
         vt.root.common.engine.compute_dtype = prev_dtype
         vt.root.common.engine.sequence_parallel = prev_scheme
+
+
+def test_ulysses_flash_inner_matches_reference():
+    """After the all-to-all each device holds the full sequence, so the
+    pallas flash kernel can take the inner attention (forced into
+    interpret mode here); result must match the exact reference."""
+    import jax.numpy as jnp
+    from veles_tpu.parallel.ulysses import ulysses_attention
+    rng = numpy.random.RandomState(5)
+    b, t, h, d = 1, 128, 4, 16          # t divisible by flash blocks
+    q, k, v = [jnp.asarray(rng.randn(b, t, h, d).astype(numpy.float32))
+               for _ in range(3)]
+    mesh = seq_mesh(4)
+    from veles_tpu.ops import flash_attention as fa
+    calls = []
+    real_flash = fa.flash_attention
+    prev = vt.root.common.engine.flash_attention
+    vt.root.common.engine.flash_attention = "force"
+    fa.flash_attention = lambda *a, **k2: (calls.append(1),
+                                           real_flash(*a, **k2))[1]
+    try:
+        out = ulysses_attention(q, k, v, mesh, causal=True)
+    finally:
+        vt.root.common.engine.flash_attention = prev
+        fa.flash_attention = real_flash
+    assert calls, "flash path never taken — test would compare " \
+                  "reference against itself"
+    ref = attention_reference(q, k, v, causal=True)
+    numpy.testing.assert_allclose(numpy.asarray(out),
+                                  numpy.asarray(ref),
+                                  rtol=2e-4, atol=2e-5)

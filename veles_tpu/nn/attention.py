@@ -26,16 +26,10 @@ def attention_core(q, k, v, *, causal=False, mesh=None, n_heads=1):
     sequence-mesh → ring/Ulysses; long T on TPU → Pallas flash; else the
     fused XLA reference (crossover: engine.flash_attention_min_t,
     docs/perf.md)."""
-    import jax
     from ..ops import flash_attention as fa
     from ..parallel.ring_attention import (ring_attention,
                                            attention_reference)
     t, hd = q.shape[1], q.shape[-1]
-    flash_cfg = root.common.engine.flash_attention
-    min_t = int(root.common.engine.flash_attention_min_t or 0)
-    use_flash = (flash_cfg == "force" or
-                 (flash_cfg and jax.default_backend() == "tpu"
-                  and t >= min_t))
     if mesh is not None:
         scheme = root.common.engine.sequence_parallel
         n_seq = mesh.shape["sequence"]
@@ -43,7 +37,7 @@ def attention_core(q, k, v, *, causal=False, mesh=None, n_heads=1):
             from ..parallel.ulysses import ulysses_attention
             return ulysses_attention(q, k, v, mesh, causal=causal)
         return ring_attention(q, k, v, mesh, causal=causal)
-    if use_flash and fa.supported(t, hd):
+    if fa.choose_flash(t, hd):
         return fa.flash_attention(q, k, v, causal=causal)
     return attention_reference(q, k, v, causal=causal)
 

@@ -195,6 +195,27 @@ def supported(t: int, d: int, block_q: int = 128,
     return t % block_q == 0 and t % block_k == 0 and d <= MAX_D
 
 
+def choose_flash(t: int, d: int) -> bool:
+    """THE policy predicate for picking this kernel over the fused XLA
+    reference — one definition shared by every call site
+    (nn/attention.attention_core, parallel/ulysses) so the crossover
+    cannot silently diverge between paths. True when the config enables
+    flash, the shapes qualify, and T is past the measured crossover
+    (engine.flash_attention_min_t, docs/perf.md); "force" overrides the
+    backend/length gates (pallas interpret mode — tests only)."""
+    import jax
+    from ..config import root
+    cfg = root.common.engine.flash_attention
+    if not cfg:
+        return False
+    if not supported(t, d):
+        return False
+    if cfg == "force":
+        return True
+    min_t = int(root.common.engine.get("flash_attention_min_t", 0) or 0)
+    return jax.default_backend() == "tpu" and t >= min_t
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None, block_q: int = 128,
                     block_k: int = 128,

@@ -45,7 +45,19 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sequence",
                                       concat_axis=1, tiled=True)
 
         qh, kh, vh = spread(q_blk), spread(k_blk), spread(v_blk)
-        o = attention_reference(qh, kh, vh, causal=causal, scale=scale)
+        # after the re-shard each device holds the FULL sequence for
+        # its head group — exactly the single-chip attention problem,
+        # so the per-shape chooser applies: the Pallas flash kernel
+        # takes the long-T regime Ulysses exists for, the fused XLA
+        # reference the short one (same crossover as attention_core)
+        t, hd = qh.shape[1], qh.shape[-1]
+        from ..ops import flash_attention as fa
+        if fa.choose_flash(t, hd):
+            o = fa.flash_attention(qh, kh, vh, causal=causal,
+                                   scale=scale)
+        else:
+            o = attention_reference(qh, kh, vh, causal=causal,
+                                    scale=scale)
         # (B, T, H/n, D) → all-to-all back → (B, T/n, H, D)
         return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
                                   tiled=True)
