@@ -1,0 +1,105 @@
+"""AlexNet — mcdnnic-topology convolutional classifier.
+
+TPU-native rebuild of the VELES "AlexNet" sample (reference zoo,
+docs/source/manualrst_veles_algorithms.rst:49: "AlexNet/
+imagenet_workflow.py" in the conv-net family). The reference authored
+AlexNet-style nets via the ``mcdnnic_topology`` shorthand
+(docs/source/manualrst_veles_workflow_parameters.rst) — this model is
+the zoo member exercising that authoring path end-to-end: the whole
+conv-pool-conv-pool-dense stack comes from one topology string, scaled
+by an ``image_size`` knob (default 32 keeps CI affordable; 224 gives
+the classic geometry for bench runs).
+
+Data: the imagenet surrogate from veles_tpu.datasets (class-template
+images — real ImageNet is absent in-image; BASELINE.md documents the
+anchors).
+
+Run: python models/alexnet.py [--epochs N] [--size 64]
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy  # noqa: E402
+
+import veles_tpu as vt  # noqa: E402
+from veles_tpu import nn  # noqa: E402
+from veles_tpu.datasets import load_synthetic  # noqa: E402
+from veles_tpu.loader import FullBatchLoader  # noqa: E402
+
+N_CLASSES = 10
+
+
+class SyntheticImagenet(FullBatchLoader):
+    hide_from_registry = True
+
+    def __init__(self, workflow, image_size=32, n_train=1600,
+                 n_valid=320, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.image_size = image_size
+        self.n_train, self.n_valid = n_train, n_valid
+
+    def load_data(self):
+        tx, ty, vx, vy = load_synthetic(
+            (self.image_size, self.image_size, 3), N_CLASSES,
+            self.n_train, self.n_valid, flat=False, key="alexnet")
+        self.create_originals(numpy.concatenate([vx, tx]),
+                              numpy.concatenate([vy, ty]))
+        self.class_lengths = [0, self.n_valid, self.n_train]
+
+
+def topology(image_size: int) -> str:
+    """AlexNet-shaped stack scaled to the input size: two conv+pool
+    stages and two dense layers at CI scale, the full five-conv stack
+    at >= 96 px."""
+    if image_size >= 96:
+        return ("3x%dx%d-48C7-MP2-128C5-MP2-192C3-192C3-128C3-MP2-"
+                "512N-512N-%dN" % (image_size, image_size, N_CLASSES))
+    return ("3x%dx%d-16C5-MP2-32C3-MP2-64N-%dN"
+            % (image_size, image_size, N_CLASSES))
+
+
+def build_workflow(epochs=10, minibatch_size=64, lr=0.001, image_size=32,
+                   n_train=1600, n_valid=320):
+    loader = SyntheticImagenet(None, image_size=image_size,
+                               n_train=n_train, n_valid=n_valid,
+                               minibatch_size=minibatch_size,
+                               name="imagenet")
+    wf = nn.StandardWorkflow(
+        name="alexnet",
+        mcdnnic_topology=topology(image_size),
+        mcdnnic_parameters={"learning_rate": lr, "solver": "adam"},
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=epochs, fail_iterations=40),
+    )
+    return wf
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=10)
+    p.add_argument("--mb", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.001)
+    p.add_argument("--size", type=int, default=32)
+    p.add_argument("--backend", default="auto")
+    args = p.parse_args(argv)
+
+    wf = build_workflow(args.epochs, args.mb, args.lr, args.size)
+    wf.initialize(device=vt.Device_for(args.backend))
+    t0 = time.time()
+    wf.run()
+    dt = time.time() - t0
+    res = wf.gather_results()
+    print("best validation error: %.4f (epoch %d)" %
+          (res["best_err"], res["best_epoch"]))
+    print("throughput: %.0f samples/sec" %
+          (wf.loader.samples_served / dt))
+    return res
+
+
+if __name__ == "__main__":
+    main()

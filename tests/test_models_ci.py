@@ -132,6 +132,83 @@ def test_tiny_transformer_converges():
     assert res["best_err"] < 0.35, res
 
 
+def test_kanji_converges():
+    prng.seed_all(1234)
+    """Kanji zoo member (reference: "MSE NN with standard workflow",
+    algorithms doc :29): the ONE model exercising loader-provided
+    regression targets (target_mode='targets' / FullBatchLoaderMSE)
+    through StandardWorkflow. Generator-backed — a real anchor.
+    Do-nothing bound: predicting 0 gives RMSE ~0.5 on the stroke
+    templates; calibrated best on 6 epochs: ~0.12."""
+    kanji = _import_model("kanji")
+    wf = kanji.build_workflow(epochs=6, minibatch_size=80,
+                              n_train=960, n_valid=240)
+    wf.initialize(device=_dev())
+    wf.run()
+    res = wf.gather_results()
+    assert res["best_rmse"] < 0.3, res
+
+
+def test_video_ae_converges():
+    prng.seed_all(1234)
+    """VideoAE zoo member (reference AE family, algorithms doc :70):
+    the fully-connected bottleneck AE (imagenet_ae covers conv/deconv).
+    Do-nothing bound: frame std ~0.22; calibrated best on 6 epochs:
+    ~0.13."""
+    vae = _import_model("video_ae")
+    wf = vae.build_workflow(epochs=6, minibatch_size=64,
+                            n_train=768, n_valid=192)
+    wf.initialize(device=_dev())
+    wf.run()
+    res = wf.gather_results()
+    assert res["best_rmse"] < 0.19, res
+
+
+def test_kohonen_demo_organizes():
+    prng.seed_all(1234)
+    """DemoKohonen zoo member (algorithms doc :89): custom (non-GD)
+    workflow loop around the batch-SOM trainer. The map must organize:
+    final quantization error below the cluster noise radius (0.25)."""
+    kd = _import_model("kohonen_demo")
+    wf = kd.build_workflow(epochs=8, minibatch_size=100, n_train=600)
+    wf.initialize(device=_dev())
+    wf.run()
+    res = wf.gather_results()
+    assert res["epochs"] == 8
+    assert res["final_qerr"] < 0.25, res
+    # error actually fell as the map organized
+    assert res["qerr_history"][-1] < res["qerr_history"][0]
+
+
+def test_alexnet_converges():
+    prng.seed_all(1234)
+    """AlexNet zoo member (algorithms doc :49), authored via the
+    mcdnnic_topology shorthand — this gate covers that authoring path
+    end-to-end. Calibrated: 0 % by epoch 3 on the surrogate."""
+    m = _import_model("alexnet")
+    wf = m.build_workflow(epochs=5, minibatch_size=64,
+                          n_train=960, n_valid=240)
+    wf.initialize(device=_dev())
+    wf.run()
+    res = wf.gather_results()
+    assert res["best_err"] < 0.2, res
+
+
+def test_stl10_converges():
+    prng.seed_all(1234)
+    """STL-10 variant of the conv family (anchor: 35.10 % on real data,
+    algorithms doc :51): same caffe-quick stack, STL geometry. CI
+    shrinks to 32 px; the gate is "clearly beats chance"."""
+    cifar = _import_model("cifar")
+    wf = cifar.build_stl10_workflow(epochs=10, minibatch_size=60, lr=0.05,
+                                    image_size=32, n_train=960,
+                                    n_valid=120)
+    wf.initialize(device=_dev())
+    wf.run()
+    res = wf.gather_results()
+    assert res["best_err"] < 0.7, res
+
+
 def test_bench_workflow_builds(monkeypatch):
     """The compute-bound bench surface (bench.py's second metric) must
     keep building and running one dispatch — a regression here silently

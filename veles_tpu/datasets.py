@@ -108,6 +108,15 @@ def load_cifar10(n_train: int = 50000, n_test: int = 10000) -> Arrays:
                              key="cifar10")
 
 
+def load_synthetic(sample_shape, n_classes, n_train, n_test,
+                   flat=False, key="synth") -> Arrays:
+    """Public class-template surrogate generator (the same one the real
+    loaders fall back to): zoo models for datasets absent in-image
+    (AlexNet/ImageNet, STL-10) build on THIS, not the private helper."""
+    return _synthetic_images(sample_shape, n_classes, n_train, n_test,
+                             flat, key=key)
+
+
 def _synthetic_images(sample_shape, n_classes, n_train, n_test, flat,
                       key="synth") -> Arrays:
     """Deterministic class-structured surrogate: each class is a smooth
