@@ -135,6 +135,20 @@ def bench_mnist(dev, n_chips):
 
 
 def bench_conv_ae(dev, n_chips):
+    from veles_tpu.config import root as vt_root
+    # the AE roofline is HBM-bound (docs/perf.md): bf16 activation
+    # storage is the bandwidth lever, f32 masters/accumulation keep the
+    # numerics honest — stamped into the JSON for comparability. The
+    # flag is restored afterwards so no other measurement inherits it.
+    prev_mp = vt_root.common.engine.get("mixed_precision", False)
+    vt_root.common.engine.mixed_precision = True
+    try:
+        return _bench_conv_ae_inner(dev, n_chips)
+    finally:
+        vt_root.common.engine.mixed_precision = prev_mp
+
+
+def _bench_conv_ae_inner(dev, n_chips):
     from imagenet_ae import build_bench_workflow
     wf = build_bench_workflow(image_size=128, minibatch_size=64,
                               n_train=1024, n_valid=128)
@@ -172,6 +186,7 @@ def bench_conv_ae(dev, n_chips):
         "image_size": 128, "minibatch": 64, "plan_steps":
             wf.loader.plan_steps,
         "compute_dtype": str(root.common.engine.compute_dtype),
+        "mixed_precision": bool(wf.train_step.mixed_precision),
         "data": "synthetic",
     }
 
@@ -205,7 +220,14 @@ def main():
     n_chips = getattr(dev, "device_count", 1)
 
     mnist = bench_mnist(dev, n_chips)
-    ae = bench_conv_ae(dev, n_chips)
+    try:
+        ae = bench_conv_ae(dev, n_chips)
+    except Exception as e:        # noqa: BLE001
+        # the AE extra must never take the headline line down with it
+        import traceback
+        traceback.print_exc()
+        ae = {"metric": "imagenet_ae_train_samples_per_sec_per_chip",
+              "error": str(e)}
 
     platform = getattr(dev, "platform", "numpy")
     sps = mnist["samples_per_sec_per_chip"]

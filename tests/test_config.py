@@ -73,3 +73,19 @@ def test_update_from_env_cfg_prefix(monkeypatch):
     c.update_from_env()
     assert c.engine.force_numpy is True
     assert "test" not in c
+
+
+def test_get_treats_vivified_husk_as_unset():
+    """__getattr__ vivifies truthy nodes on mere READS (`if root.x.y:`
+    creates y); Config.get must not hand such husks back as values —
+    the class of bug that needed ad-hoc guards in train_step/publishing
+    before this rule lived in get() itself."""
+    from veles_tpu.config import Config
+    c = Config("test")
+    assert c.a.b is not None          # vivifies a and a.b
+    assert c.get("a").get("b", "dflt") == "dflt"
+    assert c.a.get("b", 7) == 7
+    # a REAL subtree still comes back
+    c.a.b.value = 3
+    sub = c.a.get("b")
+    assert sub is not None and sub.value == 3

@@ -238,3 +238,24 @@ def test_pipeline_clip_norm_matches_plain():
     w_plain = run({"data": 1})
     w_pp = run({"pipeline": 4})
     numpy.testing.assert_allclose(w_pp, w_plain, rtol=2e-3, atol=2e-4)
+
+
+def test_pipeline_with_mixed_precision():
+    """AMP composes with the pipeline axis: the gpipe stage scan's
+    carry runs bf16 (cast params + cast microbatches keep the carry
+    dtype consistent through ppermute) while masters stay f32."""
+    import jax.numpy as jnp
+    from veles_tpu.config import root
+    from veles_tpu.parallel.sharding import PP_BLOCK
+    root.common.engine.mixed_precision = True
+    try:
+        wf = _run({"pipeline": 4}, epochs=4)
+    finally:
+        root.common.engine.mixed_precision = False
+    assert wf.train_step._pp is not None
+    assert wf.train_step.mixed_precision
+    d = wf.decision
+    assert d.best_metric is not None and d.best_metric < 0.35, \
+        d.epoch_metrics
+    for leaf in wf.train_step.params[PP_BLOCK].values():
+        assert leaf.dtype == jnp.float32

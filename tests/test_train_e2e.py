@@ -144,3 +144,26 @@ def test_layer_config_reaches_gd_units():
     assert gd.learning_rate == 0.05
     assert gd.weight_decay == 1e-3
     assert gd.momentum == 0.9
+
+
+def test_mixed_precision_converges():
+    """AMP knob (root.common.engine.mixed_precision): forward/backward on
+    a bf16 cast of params+batch (activation storage halves — the HBM
+    lever for image-scale conv nets), f32 masters/loss. Must converge
+    like the f32 run and leave master params f32."""
+    import jax.numpy as jnp
+    from veles_tpu.config import root
+    root.common.engine.mixed_precision = True
+    try:
+        wf = make_workflow()
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        assert wf.train_step.mixed_precision
+        wf.run()
+    finally:
+        root.common.engine.mixed_precision = False
+    d = wf.decision
+    assert d.best_metric is not None
+    assert d.best_metric < 0.05, d.epoch_metrics
+    for tree in wf.train_step.params.values():
+        for leaf in tree.values():
+            assert leaf.dtype == jnp.float32

@@ -42,6 +42,8 @@ def main(argv=None) -> int:
         apply_config_overrides(root, args.config_list)
     if args.force_numpy:
         root.common.engine.force_numpy = True
+    if args.mixed_precision:
+        root.common.engine.mixed_precision = True
     if args.backend in ("cpu", "numpy"):
         # keep jax away from the (exclusive, possibly busy) TPU tunnel
         # when the user explicitly asked for a host backend

@@ -46,6 +46,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-file", default=None,
                    help="append event spans as JSON lines here")
     p.add_argument("--force-numpy", action="store_true")
+    p.add_argument("--mixed-precision", action="store_true",
+                   help="bf16 activation/param storage in the fused "
+                        "step (f32 masters + accumulation); the HBM "
+                        "lever for image-scale nets")
     p.add_argument("-v", "--verbose", action="count", default=0)
     p.add_argument("--debug", default="", metavar="ClassA,ClassB",
                    help="enable DEBUG for specific unit/class loggers "

@@ -56,8 +56,18 @@ class Config:
         return name in self.__dict__ and not name.endswith("_")
 
     def get(self, name: str, default: Any = None) -> Any:
+        """Like dict.get — and an EMPTY child node counts as unset.
+        ``__getattr__`` auto-vivifies (truthy) nodes on mere reads, so
+        ``if root.x.y:`` creates ``y``; without this rule every later
+        ``get`` would see that husk and return it instead of the
+        default (the footgun guards in train_step/publishing existed
+        for exactly this)."""
         if name in self:
-            return self.__dict__[name]
+            val = self.__dict__[name]
+            if isinstance(val, Config) and not any(True
+                                                   for _ in val.items()):
+                return default
+            return val
         return default
 
     def items(self) -> Iterator[Tuple[str, Any]]:

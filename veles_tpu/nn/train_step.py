@@ -75,6 +75,17 @@ class TrainStep(AcceleratedUnit):
         #: the whole step — FLOPs traded for memory (SURVEY.md HBM
         #: guidance); numerics are identical
         self.remat = bool(remat)
+        #: classic AMP (resolved at initialize from
+        #: root.common.engine.mixed_precision): forward/backward run on a
+        #: bfloat16 cast of params + batch, so ACTIVATION STORAGE halves —
+        #: conv nets at image scale are HBM-bandwidth-bound, not
+        #: FLOP-bound, and bf16 activations double the effective
+        #: bandwidth. Master params, optimizer state, loss and metric
+        #: accumulation stay float32 (evaluators upcast); MXU
+        #: accumulation stays f32 via preferred_element_type. The
+        #: compute_dtype knob (ops/precision.py) only steers MXU operand
+        #: rounding — THIS one changes what lives in HBM between layers.
+        self.mixed_precision = False
         #: {unit name: {param key: mask array}} — applied multiplicatively
         #: after EVERY optimizer update inside the fused step (ZeroFiller's
         #: sparsity contract must hold within a multi-step dispatch, not
@@ -133,6 +144,10 @@ class TrainStep(AcceleratedUnit):
             for arr in f.param_arrays().values():
                 arr.detach_devmem()
         self._rng = prng.get(self.name)
+        from ..config import root
+        # Config.get treats auto-vivified empty nodes as unset
+        self.mixed_precision = bool(
+            root.common.engine.get("mixed_precision", False))
         if self.target_mode == "auto":
             # resolvable only now: the loader's load_data has run
             has_t = getattr(self.loader, "original_targets", None)
@@ -329,6 +344,18 @@ class TrainStep(AcceleratedUnit):
         import jax.numpy as jnp
         return jnp.take(dataset, indices, axis=0)
 
+    def _amp_cast(self, tree):
+        """bf16 view of a float32 pytree (mixed_precision): autodiff
+        through the cast returns float32 grads for the f32 masters."""
+        import jax
+        import jax.numpy as jnp
+
+        def cast(a):
+            return (a.astype(jnp.bfloat16)
+                    if hasattr(a, "dtype") and a.dtype == jnp.float32
+                    else a)
+        return jax.tree_util.tree_map(cast, tree)
+
     def _target_for(self, batch, labels, targets, indices):
         if self.target_mode == "labels":
             return self._gather(labels, indices)
@@ -348,8 +375,12 @@ class TrainStep(AcceleratedUnit):
         if aug is not None:
             batch = aug(batch, jax.random.fold_in(rng, 0x417))
         tgt = self._target_for(batch, labels, targets, indices)
+        if self.mixed_precision:
+            batch = self._amp_cast(batch)
 
         def loss_fn(p):
+            if self.mixed_precision:
+                p = self._amp_cast(p)
             if self.remat:
                 out = jax.checkpoint(
                     lambda pp, bb: self._forward_pure(pp, bb, True,
@@ -417,6 +448,9 @@ class TrainStep(AcceleratedUnit):
         if ev is not None:
             batch = ev(batch)       # deterministic center crop
         tgt = self._target_for(batch, labels, targets, indices)
+        if self.mixed_precision:
+            batch = self._amp_cast(batch)
+            params = self._amp_cast(params)
         out = self._forward_pure(params, batch, False, None)
         metrics = self.evaluator.metrics_fn(out, tgt, mask)
         metrics["sum_loss"] = (self.evaluator.loss(out, tgt, mask)
