@@ -41,26 +41,41 @@ def time_fn(fn, *args, iters=8):
     return (time.time() - t0) / iters
 
 
-def bench(t, b=1, h=8, d=64, causal=True, dtype=jnp.bfloat16):
+def bench(t, b=1, h=8, d=64, causal=True, dtype=jnp.bfloat16,
+          train=False):
+    """train=True times value+grad (exercises the blockwise custom-VJP
+    backward — the path a training step actually runs)."""
     rng = numpy.random.RandomState(0)
     shape = (b, t, h, d)
     q, k, v = (jnp.asarray(rng.randn(*shape), dtype) for _ in range(3))
-    flash = jax.jit(lambda q, k, v: flash_attention(q, k, v,
-                                                    causal=causal))
-    naive = jax.jit(lambda q, k, v: attention_reference(q, k, v,
-                                                        causal=causal))
-    t_flash = time_fn(flash, q, k, v)
-    t_naive = time_fn(naive, q, k, v)
-    # attention core FLOPs: 2 matmuls of 2*B*H*T^2*D, halved when causal
-    flops = 2 * 2 * b * h * t * t * d * (0.5 if causal else 1.0)
+
+    def wrap(core):
+        if not train:
+            return jax.jit(lambda q, k, v: core(q, k, v, causal=causal))
+        return jax.jit(jax.grad(
+            lambda q, k, v: core(q, k, v,
+                                 causal=causal).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2)))
+
+    t_flash = time_fn(wrap(flash_attention), q, k, v)
+    t_naive = time_fn(wrap(attention_reference), q, k, v)
+    # attention core FLOPs: 2 matmuls of 2*B*H*T^2*D, halved when causal.
+    # Training: the backward re-walks both matmuls twice (3x); the flash
+    # custom-VJP additionally RECOMPUTES the forward blockwise (3.5x) —
+    # the naive VJP reuses stored scores, so each path gets its own
+    # numerator (speedup stays a pure time ratio either way).
+    base = 2 * 2 * b * h * t * t * d * (0.5 if causal else 1.0)
+    flash_flops = base * (3.5 if train else 1.0)
+    naive_flops = base * (3.0 if train else 1.0)
     return {
         "T": t, "B": b, "H": h, "D": d, "causal": causal,
+        "mode": "train" if train else "fwd",
         "dtype": str(dtype.__name__ if hasattr(dtype, "__name__")
                      else dtype),
         "flash_ms": round(t_flash * 1e3, 3),
         "naive_ms": round(t_naive * 1e3, 3),
-        "flash_tflops": round(flops / t_flash / 1e12, 2),
-        "naive_tflops": round(flops / t_naive / 1e12, 2),
+        "flash_tflops": round(flash_flops / t_flash / 1e12, 2),
+        "naive_tflops": round(naive_flops / t_naive / 1e12, 2),
         "speedup": round(t_naive / t_flash, 3),
     }
 
@@ -71,10 +86,11 @@ def main():
     # batch scaled so the short-T config is compute-bound, not dispatch-
     # latency-bound through the TPU tunnel (~09 ms floor per call chain)
     for t, b in ((2048, 16), (8192, 1)):
-        r = bench(t, b=b)
-        r["backend"] = backend
-        results.append(r)
-        print(json.dumps(r))
+        for train in (False, True):
+            r = bench(t, b=b, train=train)
+            r["backend"] = backend
+            results.append(r)
+            print(json.dumps(r))
     if backend == "tpu":
         from veles_tpu.config import root
         min_t = int(root.common.engine.flash_attention_min_t or 0)

@@ -54,8 +54,8 @@ def test_html_report(plotting_enabled, tmp_path):
 def test_unknown_backend_rejected():
     wf = vt.Workflow(name="t")
     with pytest.raises(KeyError):
-        vt.Publisher(wf, backends=("confluence",))
-    assert set(BACKENDS) >= {"markdown", "html"}
+        vt.Publisher(wf, backends=("no_such_backend",))
+    assert set(BACKENDS) >= {"markdown", "html", "pdf", "confluence"}
 
 
 def test_publisher_without_plots(tmp_path):
@@ -65,6 +65,88 @@ def test_publisher_without_plots(tmp_path):
     pub.run()
     text = (tmp_path / "report.md").read_text()
     assert "bare" in text and "## Plots" not in text
+
+
+class _StubConfluence:
+    """Minimal local double of the Confluence REST content API — no
+    egress exists in-image, so the upload path is proven against this
+    (same in-process-loopback policy as test_forge/test_services)."""
+
+    def __init__(self):
+        import json as _json
+        from http.server import BaseHTTPRequestHandler
+        from veles_tpu._http import HTTPService, json_reply
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                stub.requests.append(
+                    (self.path, dict(self.headers), body))
+                if self.path == "/rest/api/content":
+                    page = _json.loads(body)
+                    stub.pages.append(page)
+                    json_reply(self, 200, {"id": "4242"})
+                elif "/child/attachment" in self.path:
+                    stub.attachments.append(body)
+                    json_reply(self, 200, {"results": [{}]})
+                else:
+                    json_reply(self, 404, {})
+
+            def log_message(self, *a):
+                pass
+
+        self.requests, self.pages, self.attachments = [], [], []
+        self.service = HTTPService(Handler, thread_name="stub-confluence")
+        self.service.start_serving()
+
+    @property
+    def url(self):
+        return "http://127.0.0.1:%d" % self.service.port
+
+    def stop(self):
+        self.service.stop_serving()
+
+
+def test_confluence_report(plotting_enabled, tmp_path):
+    """Confluence backend (reference:
+    veles/publishing/confluence_backend.py): page created via the REST
+    content API with basic auth, figures attached, local HTML copy
+    kept."""
+    stub = _StubConfluence()
+    cfg = root.common.publishing.confluence
+    try:
+        cfg.update(server=stub.url, space="ML",
+                   username="builder", token="s3cret")
+        wf = build_workflow_with_plots()
+        pub = vt.Publisher(wf, backends=("confluence",),
+                           out_dir=str(tmp_path))
+        pub.run()
+        assert pub.reports and pub.reports[0].endswith("/pages/4242")
+        # page: right space, XHTML body, basic auth header present
+        (page,) = stub.pages
+        assert page["space"]["key"] == "ML"
+        assert "report-wf" in page["title"]
+        assert "Results" in page["body"]["storage"]["value"]
+        auth = stub.requests[0][1].get("Authorization", "")
+        assert auth.startswith("Basic ")
+        # both plots uploaded as attachments; local copy kept
+        assert len(stub.attachments) == 2
+        assert b"image/png" in stub.attachments[0]
+        assert (tmp_path / "report.html").exists()
+    finally:
+        stub.stop()
+        cfg.update(server="", space="", username="", token="")
+
+
+def test_confluence_unconfigured_raises(tmp_path):
+    root.common.publishing.confluence.server = ""
+    wf = vt.Workflow(name="t")
+    pub = vt.Publisher(wf, backends=("confluence",),
+                       out_dir=str(tmp_path))
+    with pytest.raises(Exception, match="not configured"):
+        pub.run()
 
 
 def test_pdf_report(plotting_enabled, tmp_path):

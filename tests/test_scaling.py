@@ -2,6 +2,9 @@
 'data' mesh — real 8-chip hardware is unavailable, so the virtual
 8-device mesh validates the sharded program; the driver's
 dryrun_multichip covers the composed dp×tp×sp case)."""
+import os
+import tempfile
+
 import jax
 import numpy
 import pytest
@@ -54,6 +57,34 @@ def test_conv_dp8_trains_and_shards():
     # params replicated across the data axis (pure DP)
     w = wf.train_step.params["conv_tanh0"]["weights"]
     assert w.sharding.is_fully_replicated
+
+
+def test_scaling_sweep_1_to_64():
+    """The 1→64 proof (BASELINE.json: "master-slave→psum scaling 1→64"):
+    scripts/scaling_sweep.py in subprocesses — the conftest's 8-device
+    pin can't cover 64, a fresh XLA init per width can. Two widths keep
+    CI affordable; the full 1..64 table is SCALING.json at the repo
+    root (regenerate with the script)."""
+    import json
+    import subprocess
+    import sys
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "scaling_sweep.py")
+    with tempfile.TemporaryDirectory() as tmp:
+        out = os.path.join(tmp, "scaling.json")
+        # outer budget > sum of the script's per-width child budgets
+        # (900 s each) so a slow width can't surface as an opaque
+        # TimeoutExpired here instead of the script's own error report
+        proc = subprocess.run(
+            [sys.executable, script, "--widths", "1,64", "--out", out],
+            capture_output=True, text=True, timeout=2 * 900 + 60)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        with open(out) as fin:
+            report = json.load(fin)
+    assert report["equivalent"] is True
+    w64 = report["widths"][-1]
+    assert w64["n"] == 64 and w64["n_devices_used"] == 64
+    assert w64["indices_sharded"] and w64["params_replicated"]
 
 
 def test_dp1_vs_dp8_same_learning_trajectory():

@@ -8,9 +8,13 @@ workflow graph and results). Here:
   (plots rendered from the graphics sink's snapshots);
 - ``HTMLBackend`` renders the same material to a single self-contained
   ``report.html`` via jinja2 (images inlined base64);
-- Confluence upload is out of scope (no egress in the target environment);
-  the backend registry accepts third-party additions the same way the
-  reference's MappedObjectsRegistry did.
+- ``ConfluenceBackend`` uploads the report as a wiki page through the
+  Confluence REST content API (reference:
+  veles/publishing/confluence_backend.py — its 2015-era XML-RPC endpoint
+  is long dead, the REST shape is today's equivalent). Gated on a
+  configured server URL (``root.common.publishing.confluence.server``) —
+  this environment has no egress, so CI exercises it against a local
+  stub server (tests/test_publishing.py).
 
 The Publisher is a Unit gated exactly like a Snapshotter: link it after
 the decision and open its gate when training completes.
@@ -42,6 +46,21 @@ class PublishingBackend:
 
     def render(self, material: Dict[str, Any], out_dir: str) -> str:
         raise NotImplementedError
+
+
+def render_figures(material: Dict[str, Any], fig_dir: str) -> List[tuple]:
+    """Render every plot snapshot to ``fig_dir`` ONCE; backends share the
+    resulting (name, png_path) list instead of re-running matplotlib."""
+    from .graphics import render_snapshot, safe_name
+    out = []
+    for name, snap in sorted(material["snapshots"].items()):
+        safe = safe_name(name)
+        try:
+            out.append((name, render_snapshot(
+                snap, os.path.join(fig_dir, safe + ".png"))))
+        except Exception:
+            pass
+    return out
 
 
 @register_backend("markdown")
@@ -79,16 +98,7 @@ class MarkdownBackend(PublishingBackend):
 
     @staticmethod
     def _render_figures(material, fig_dir) -> List[tuple]:
-        from .graphics import render_snapshot, safe_name
-        out = []
-        for name, snap in sorted(material["snapshots"].items()):
-            safe = safe_name(name)
-            try:
-                out.append((name, render_snapshot(
-                    snap, os.path.join(fig_dir, safe + ".png"))))
-            except Exception:
-                pass
-        return out
+        return render_figures(material, fig_dir)
 
 
 @register_backend("html")
@@ -116,17 +126,17 @@ pre { background: #f5f5f5; padding: 1em; overflow-x: auto; }
 <pre>{{ config_json }}</pre>{% endif %}
 </body></html>"""
 
-    def render(self, material: Dict[str, Any], out_dir: str) -> str:
+    def render(self, material: Dict[str, Any], out_dir: str,
+               fig_paths: Optional[List[tuple]] = None) -> str:
+        """``fig_paths``: pre-rendered (name, png_path) pairs (see
+        render_figures) — callers composing backends pass them so each
+        snapshot hits matplotlib once."""
         import tempfile
         import jinja2
-        from .graphics import render_snapshot
         figures = []
         with tempfile.TemporaryDirectory() as tmp:
-            for name, snap in sorted(material["snapshots"].items()):
-                try:
-                    p = render_snapshot(snap, os.path.join(tmp, "f.png"))
-                except Exception:
-                    continue
+            for name, p in (fig_paths if fig_paths is not None
+                            else render_figures(material, tmp)):
                 with open(p, "rb") as fin:
                     figures.append(
                         (name, base64.b64encode(fin.read()).decode()))
@@ -232,6 +242,102 @@ class PDFBackend(PublishingBackend):
             meta["Title"] = "%s training report" % material["name"]
             meta["Creator"] = "veles_tpu publisher"
         return path
+
+
+@register_backend("confluence")
+class ConfluenceBackend(PublishingBackend):
+    """Publish the report as a Confluence page + figure attachments.
+
+    Speaks the REST content API (POST /rest/api/content, attachments via
+    POST /rest/api/content/{id}/child/attachment) with basic-auth
+    credentials from the config tree:
+
+        root.common.publishing.confluence.server    e.g. "http://host:8090"
+        root.common.publishing.confluence.space     space key
+        root.common.publishing.confluence.username / .token
+
+    Unconfigured server → the backend raises at render time (callers list
+    it explicitly; there is no silent skip). A local report.html is also
+    written so the material survives a failed upload."""
+
+    @staticmethod
+    def _cfg_str(cfg, key: str) -> str:
+        """A string config leaf; Config.get already treats auto-vivified
+        empty nodes as unset."""
+        val = cfg.get(key)
+        return "" if val is None else str(val)
+
+    def render(self, material: Dict[str, Any], out_dir: str) -> str:
+        import tempfile
+        import urllib.request
+        cfg = root.common.publishing.confluence
+        server = self._cfg_str(cfg, "server")
+        if not server:
+            raise RuntimeError(
+                "confluence backend: root.common.publishing.confluence."
+                "server is not configured")
+        # one matplotlib pass per snapshot: the same PNGs feed the page
+        # body (inlined by HTMLBackend) and the attachment uploads
+        with tempfile.TemporaryDirectory() as tmp:
+            fig_paths = render_figures(material, tmp)
+            # local copy doubles as the page body (Confluence storage
+            # format accepts XHTML)
+            local = HTMLBackend().render(material, out_dir,
+                                         fig_paths=fig_paths)
+            with open(local) as fin:
+                html = fin.read()
+            body = html.split("<body>", 1)[-1].split("</body>", 1)[0]
+            page = {
+                "type": "page",
+                "title": "%s — training report (%s)" % (material["name"],
+                                                        material["date"]),
+                "space": {"key": self._cfg_str(cfg, "space") or "VELES"},
+                "body": {"storage": {"value": body,
+                                     "representation": "storage"}},
+            }
+            headers = {"Content-Type": "application/json"}
+            user = self._cfg_str(cfg, "username")
+            token = self._cfg_str(cfg, "token")
+            if user or token:
+                cred = base64.b64encode(
+                    ("%s:%s" % (user, token)).encode()).decode()
+                headers["Authorization"] = "Basic " + cred
+            req = urllib.request.Request(
+                server.rstrip("/") + "/rest/api/content",
+                data=json.dumps(page).encode(), headers=headers,
+                method="POST")
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                created = json.loads(resp.read())
+            page_id = str(created.get("id") or "")
+            if not page_id:
+                raise RuntimeError(
+                    "confluence backend: create-page response carried "
+                    "no id (%r)" % (created,))
+            self._upload_figures(fig_paths, server, headers, page_id)
+        return "%s/pages/%s" % (server.rstrip("/"), page_id)
+
+    @staticmethod
+    def _upload_figures(fig_paths, server, headers, page_id) -> None:
+        import urllib.request
+        boundary = "veles-tpu-figure"
+        for _name, png in fig_paths:
+            with open(png, "rb") as fin:
+                payload = fin.read()
+            fname = os.path.basename(png)
+            part = (("--%s\r\nContent-Disposition: form-data; "
+                     "name=\"file\"; filename=\"%s\"\r\n"
+                     "Content-Type: image/png\r\n\r\n"
+                     % (boundary, fname)).encode()
+                    + payload + ("\r\n--%s--\r\n" % boundary).encode())
+            h = dict(headers)
+            h["Content-Type"] = ("multipart/form-data; boundary=%s"
+                                 % boundary)
+            h["X-Atlassian-Token"] = "no-check"
+            req = urllib.request.Request(
+                "%s/rest/api/content/%s/child/attachment"
+                % (server.rstrip("/"), page_id),
+                data=part, headers=h, method="POST")
+            urllib.request.urlopen(req, timeout=30).read()
 
 
 class Publisher(Unit):
