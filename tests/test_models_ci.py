@@ -257,3 +257,15 @@ def test_char_lm_generates_grammar():
                  if (a < 8 and b == (a + 1) % 8) or (a >= 8 and b == 0))
     # dominant transitions fire ~80-90% in the grammar; chance ~1/16
     assert follow / (len(seq) - 1) > 0.5, (follow, seq)
+
+
+def test_genetic_example_solves():
+    """GeneticExample zoo member (reference samples/GeneticExample —
+    the GA engine used directly on plain objectives): the integer-gene
+    knapsack must reach its known optimum; continuous Rosenbrock must
+    get into the valley (random init scatters f across ~1e2-1e3)."""
+    ge = _import_model("genetic_example")
+    take, value = ge.solve_knapsack()
+    assert value == 15.0, (take, value)
+    _genes, f = ge.solve_rosenbrock(generations=60)
+    assert f < 0.5, f

@@ -191,3 +191,23 @@ def test_frontend_js_safe_embedding(tmp_path):
     generate_frontend.main(["-o", out])
     page = open(out).read()
     assert "&lt;" not in page.split("<script>")[1].split("</script>")[0]
+
+
+def test_generate_docs_manual():
+    """The generated unit-reference manual (reference analogue:
+    docs/generate_units_args.py): every registry entry appears, every
+    entry carries a description (own docstring, variant-of pointer, or
+    module blurb)."""
+    import re
+    from veles_tpu.scripts import generate_docs
+    from veles_tpu.units import UnitRegistry
+    text = generate_docs.generate()
+    assert "# Unit reference" in text
+    for mapping in UnitRegistry.mapping:
+        assert "### `%s`" % mapping in text, mapping
+    entries = re.findall(
+        r"### `[^`]+` — \w+\n\n(.*?)(?=\n### |\n## |\Z)", text, re.S)
+    assert len(entries) >= len(UnitRegistry.mapping) - 15
+    for e in entries:
+        first = e.strip().splitlines()[0]
+        assert not first.startswith("Arguments:"), first[:60]

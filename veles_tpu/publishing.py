@@ -77,7 +77,7 @@ class MarkdownBackend(PublishingBackend):
                   "| unit | runs | total s |", "|---|---|---|"]
         for t, name, count in material["stats"]:
             lines.append("| %s | %d | %.3f |" % (name, count, t))
-        figures = self._render_figures(material, fig_dir)
+        figures = render_figures(material, fig_dir)
         if figures:
             lines += ["", "## Plots", ""]
             for name, path in figures:
@@ -95,10 +95,6 @@ class MarkdownBackend(PublishingBackend):
         with open(path, "w") as fout:
             fout.write("\n".join(lines) + "\n")
         return path
-
-    @staticmethod
-    def _render_figures(material, fig_dir) -> List[tuple]:
-        return render_figures(material, fig_dir)
 
 
 @register_backend("html")
