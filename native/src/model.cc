@@ -406,6 +406,141 @@ struct Lstm : Unit {
   }
 };
 
+struct Rnn : Unit {
+  // vanilla tanh RNN (veles_tpu/nn/rnn.py RNN): h_t = tanh([x,h] W + b)
+  int hidden;
+  bool return_sequences = false;
+
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *w = Param("weights");  // (d+h, h)
+    const NpyArray *bias = Param("bias");
+    int batch = in.shape[0], t = in.shape[1], d = in.shape[2];
+    if (return_sequences)
+      out->Resize({batch, t, hidden});
+    else
+      out->Resize({batch, hidden});
+    ParallelFor(batch, [&](int blo, int bhi) {
+      std::vector<float> hs(hidden, 0.0f), z(hidden);
+      for (int b = blo; b < bhi; ++b) {
+        std::fill(hs.begin(), hs.end(), 0.0f);
+        for (int step = 0; step < t; ++step) {
+          const float *x = in.data.data() +
+              (static_cast<size_t>(b) * t + step) * d;
+          for (int j = 0; j < hidden; ++j)
+            z[j] = bias ? bias->data[j] : 0.0f;
+          for (int i = 0; i < d; ++i) {
+            float xv = x[i];
+            const float *wrow = w->data.data() +
+                                static_cast<size_t>(i) * hidden;
+            for (int j = 0; j < hidden; ++j) z[j] += xv * wrow[j];
+          }
+          for (int i = 0; i < hidden; ++i) {
+            float hv = hs[i];
+            const float *wrow = w->data.data() +
+                                static_cast<size_t>(d + i) * hidden;
+            for (int j = 0; j < hidden; ++j) z[j] += hv * wrow[j];
+          }
+          for (int i = 0; i < hidden; ++i) hs[i] = std::tanh(z[i]);
+          if (return_sequences)
+            std::memcpy(out->data.data() +
+                            (static_cast<size_t>(b) * t + step) * hidden,
+                        hs.data(), sizeof(float) * hidden);
+        }
+        if (!return_sequences)
+          std::memcpy(out->data.data() +
+                          static_cast<size_t>(b) * hidden,
+                      hs.data(), sizeof(float) * hidden);
+      }
+    });
+  }
+};
+
+struct Cutter : Unit {
+  // static NHWC crop (veles_tpu/nn/cutter.py); padding = (l, t, r, b)
+  int pl = 0, pt = 0, pr = 0, pb = 0;
+
+  void Run(const Tensor &in, Tensor *out) override {
+    int batch = in.shape[0], h = in.shape[1], w = in.shape[2],
+        c = in.shape[3];
+    int oh = h - pt - pb, ow = w - pl - pr;
+    out->Resize({batch, oh, ow, c});
+    // whole output rows are contiguous in both tensors: one memcpy per
+    // (b, i), not one per pixel
+    size_t row = static_cast<size_t>(ow) * c;
+    for (int b = 0; b < batch; ++b)
+      for (int i = 0; i < oh; ++i)
+        std::memcpy(
+            out->data.data() +
+                (static_cast<size_t>(b) * oh + i) * row,
+            in.data.data() +
+                ((static_cast<size_t>(b) * h + (i + pt)) * w + pl) * c,
+            sizeof(float) * row);
+  }
+};
+
+struct KohonenForward : Unit {
+  // best-matching-unit lookup (veles_tpu/nn/kohonen.py KohonenForward):
+  // argmin_j ||x - w_j||^2 over the flattened sample; emits the winner
+  // index as a float scalar per sample (the chain carries one dtype)
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *w = Param("weights");  // (neurons, features)
+    int batch = in.shape[0];
+    size_t features = in.size() / batch;
+    int neurons = w->shape[0];
+    out->Resize({batch});
+    ParallelFor(batch, [&](int blo, int bhi) {
+      for (int b = blo; b < bhi; ++b) {
+        const float *x = in.data.data() + b * features;
+        int best = 0;
+        float best_d = 0;
+        for (int j = 0; j < neurons; ++j) {
+          const float *wj = w->data.data() +
+                            static_cast<size_t>(j) * features;
+          float d = 0;
+          for (size_t i = 0; i < features; ++i) {
+            float diff = x[i] - wj[i];
+            d += diff * diff;
+          }
+          if (j == 0 || d < best_d) {
+            best_d = d;
+            best = j;
+          }
+        }
+        out->data[b] = static_cast<float>(best);
+      }
+    });
+  }
+};
+
+struct Rbm : Unit {
+  // hidden-unit probabilities sigmoid(x W + hbias)
+  // (veles_tpu/nn/rbm.py RBM forward)
+  void Run(const Tensor &in, Tensor *out) override {
+    const NpyArray *w = Param("weights");  // (n_vis, n_hidden)
+    const NpyArray *hb = Param("hbias");
+    int batch = in.shape[0];
+    size_t n_vis = in.size() / batch;
+    int n_hidden = w->shape[1];
+    out->Resize({batch, n_hidden});
+    ParallelFor(batch, [&](int blo, int bhi) {
+      for (int b = blo; b < bhi; ++b) {
+        const float *x = in.data.data() + b * n_vis;
+        float *y = out->data.data() +
+                   static_cast<size_t>(b) * n_hidden;
+        for (int j = 0; j < n_hidden; ++j)
+          y[j] = hb ? hb->data[j] : 0.0f;
+        for (size_t i = 0; i < n_vis; ++i) {
+          float xv = x[i];
+          if (xv == 0.0f) continue;
+          const float *wr = w->data.data() + i * n_hidden;
+          for (int j = 0; j < n_hidden; ++j) y[j] += xv * wr[j];
+        }
+        for (int j = 0; j < n_hidden; ++j) y[j] = Sigmoid(y[j]);
+      }
+    });
+  }
+};
+
 // y = x @ w, row-major (n, k) x (k, m) — shared by the attention/MoE
 // projections (the skip-zero inner loop mirrors All2All::Run)
 void MatMulRM(const float *x, const float *w, float *y, int n, int k,
@@ -894,6 +1029,21 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
       u->forget_bias = static_cast<float>(cfg["forget_bias"].AsDouble());
     return u;
   }
+  if (type == "rnn") {
+    auto u = std::make_unique<Rnn>();
+    u->hidden = cfg["hidden_size"].AsInt();
+    if (cfg.Has("return_sequences"))
+      u->return_sequences = cfg["return_sequences"].AsBool();
+    return u;
+  }
+  if (type == "cutter") {
+    auto u = std::make_unique<Cutter>();
+    auto p = get4("padding");
+    u->pl = p[0]; u->pt = p[1]; u->pr = p[2]; u->pb = p[3];
+    return u;
+  }
+  if (type == "kohonen_forward") return std::make_unique<KohonenForward>();
+  if (type == "rbm") return std::make_unique<Rbm>();
   if (type == "multi_head_attention") {
     auto u = std::make_unique<MultiHeadAttention>();
     if (cfg.Has("n_heads")) u->n_heads = cfg["n_heads"].AsInt();

@@ -310,3 +310,76 @@ def test_native_char_lm_parity():
         out = model(batch.astype(numpy.float32)).reshape(truth.shape)
         numpy.testing.assert_allclose(out, truth, rtol=2e-3, atol=2e-4)
         model.close()
+
+
+@needs_native
+def test_native_rnn_cutter_parity(tmp_path):
+    """Round-2 native additions: plain RNN, Cutter crop. A cutter→rnn
+    chain exported and compared against the python oracle."""
+    wf = vt.Workflow(name="rc")
+    cut = nn.Cutter(wf, padding=(1, 1, 1, 1), name="cut")
+    rng = numpy.random.RandomState(3)
+    x = rng.rand(6, 5, 8, 3).astype(numpy.float32)
+    cut.input = vt.Array(x)
+    cut.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    cropped = cut.numpy_apply({}, x)            # (6, 3, 6, 3)
+    seq = cropped.reshape(6, 3, 18)
+    rnn = nn.RNN(wf, hidden_size=7, return_sequences=True, name="r")
+    rnn.input = vt.Array(seq)
+    rnn.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    truth = rnn.numpy_apply(rnn.params_np(), seq)
+
+    pkg = str(tmp_path / "rnn-pkg")
+    wf.forwards = [rnn]
+    package_export(wf, pkg, input_shape=[6, 3, 18],
+                   with_stablehlo=False)
+    model = NativeModel(pkg)
+    out = model(seq).reshape(truth.shape)
+    numpy.testing.assert_allclose(out, truth, rtol=2e-3, atol=2e-4)
+    model.close()
+
+    wf2 = vt.Workflow(name="cut-wf")
+    wf2.forwards = [cut]
+    pkg2 = str(tmp_path / "cut-pkg")
+    package_export(wf2, pkg2, input_shape=list(x.shape),
+                   with_stablehlo=False)
+    m2 = NativeModel(pkg2)
+    out2 = m2(x).reshape(cropped.shape)
+    numpy.testing.assert_allclose(out2, cropped, rtol=1e-5, atol=1e-6)
+    m2.close()
+
+
+@needs_native
+def test_native_kohonen_rbm_parity(tmp_path):
+    """Round-2 native additions: Kohonen BMU lookup + RBM hidden
+    probabilities, vs their python oracles."""
+    rng = numpy.random.RandomState(5)
+    x = rng.rand(12, 6).astype(numpy.float32)
+
+    wf = vt.Workflow(name="kf")
+    kf = nn.KohonenForward(wf, shape=(3, 3), name="k")
+    kf.input = vt.Array(x)
+    kf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    winners = kf.numpy_apply(kf.params_np(), x)
+    pkg = str(tmp_path / "kf-pkg")
+    wf.forwards = [kf]
+    package_export(wf, pkg, input_shape=list(x.shape),
+                   with_stablehlo=False)
+    m = NativeModel(pkg)
+    out = m(x).reshape(winners.shape)
+    numpy.testing.assert_array_equal(out.astype(numpy.int32), winners)
+    m.close()
+
+    wf2 = vt.Workflow(name="rb")
+    rbm = nn.RBM(wf2, n_hidden=5, name="rbm")
+    rbm.input = vt.Array(x)
+    rbm.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    truth = rbm.numpy_apply(rbm.params_np(), x)
+    pkg2 = str(tmp_path / "rbm-pkg")
+    wf2.forwards = [rbm]
+    package_export(wf2, pkg2, input_shape=list(x.shape),
+                   with_stablehlo=False)
+    m2 = NativeModel(pkg2)
+    out2 = m2(x).reshape(truth.shape)
+    numpy.testing.assert_allclose(out2, truth, rtol=2e-3, atol=2e-4)
+    m2.close()

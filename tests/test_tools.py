@@ -211,3 +211,12 @@ def test_generate_docs_manual():
     for e in entries:
         first = e.strip().splitlines()[0]
         assert not first.startswith("Arguments:"), first[:60]
+    # the committed manual must BE the generator's output — that is the
+    # whole no-drift claim (regenerate with
+    # `python -m veles_tpu.scripts.generate_docs` after registry edits)
+    import os
+    committed = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "units_reference.md")
+    with open(committed) as fin:
+        assert fin.read() == text, \
+            "docs/units_reference.md is stale — regenerate it"

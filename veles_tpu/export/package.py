@@ -154,7 +154,13 @@ def package_import(path: str) -> Dict[str, Any]:
 def run_package(path_or_pkg, batch: numpy.ndarray) -> numpy.ndarray:
     """Pure-python reference executor for a package (the oracle the C++
     runtime is tested against)."""
+    import importlib
     from ..units import UnitRegistry
+    # a fresh process may have imported only veles_tpu.export: pull in
+    # the unit library so the registry actually contains the package's
+    # types (importing veles_tpu alone does not load every nn module)
+    for mod in ("veles_tpu.nn", "veles_tpu.loader"):
+        importlib.import_module(mod)
     pkg = (package_import(path_or_pkg) if isinstance(path_or_pkg, str)
            else path_or_pkg)
     x = numpy.asarray(batch, dtype=numpy.float32)
