@@ -214,7 +214,6 @@ def test_generate_docs_manual():
     # the committed manual must BE the generator's output — that is the
     # whole no-drift claim (regenerate with
     # `python -m veles_tpu.scripts.generate_docs` after registry edits)
-    import os
     committed = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "units_reference.md")
     with open(committed) as fin:
