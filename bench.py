@@ -19,9 +19,10 @@ the JSON):
   true sync = fetching a parameter scalar to the host ("host_fetch").
 - windows: median of 3 x 10 s (max recorded as a secondary field; the
   median is the regression-detection number — best-of-N inflates).
-- MNIST plan_steps=600 (one epoch per dispatch; host round trips dominate
-  that config). AE plan_steps=16 (one epoch per dispatch at n_train=1024,
-  mb=64; compute dominates there).
+- MNIST: epochs_per_dispatch=8 — eight whole epochs (valid eval + train,
+  600+100 minibatch rows each) fused into ONE device program; host round
+  trips dominate that config. AE plan_steps=16 (one epoch per dispatch at
+  n_train=1024, mb=64; compute dominates there) under mixed_precision.
 - FLOPs are analytic model FLOPs (2*spatial*weight_size per conv position,
   x3 for training fwd+bwd), NOT hardware-counter FLOPs — the standard MFU
   numerator.
@@ -111,14 +112,18 @@ def model_flops_per_sample(wf):
     return total
 
 
+BLOCK_EPOCHS = 8
+
+
 def bench_mnist(dev, n_chips):
     from mnist import build_workflow
-    # one whole epoch (600 train minibatches) per dispatch: host round
-    # trips are the dominant cost on the tunnelled chip (measured sweep:
-    # plan 50 -> 0.47M, 150 -> 1.0M, 300 -> 1.5M, 600 -> 1.9M samples/s)
-    wf = build_workflow(epochs=10 ** 9, minibatch_size=100)
-    wf.train_step.loader.plan_steps = 600
-    wf.loader.plan_steps = 600
+    # host round trips are the dominant cost on the tunnelled chip
+    # (measured plan-size sweep: 50 -> 0.47M ... 600 -> 1.9M samples/s);
+    # epochs_per_dispatch fuses 8 WHOLE epochs (valid eval + train) into
+    # one device program, cutting the per-epoch dispatch+drain round
+    # trips by 8x on top of the per-epoch scan
+    wf = build_workflow(epochs=10 ** 9, minibatch_size=100,
+                        epochs_per_dispatch=BLOCK_EPOCHS)
     wf.initialize(device=dev)
     run_epoch = epoch_runner(wf)
     run_epoch()                  # warmup: compile + first placement
@@ -129,7 +134,7 @@ def bench_mnist(dev, n_chips):
     return {
         "samples_per_sec_per_chip": statistics.median(rates) / n_chips,
         "max_window": max(rates) / n_chips,
-        "plan_steps": 600,
+        "epochs_per_dispatch": BLOCK_EPOCHS,
         "data": "real" if datasets.mnist_is_real() else "synthetic",
     }
 
@@ -262,7 +267,7 @@ def main():
         "window": method,
         "max_window": round(mnist["max_window"], 1),
         "data": mnist["data"],
-        "plan_steps": mnist["plan_steps"],
+        "epochs_per_dispatch": mnist["epochs_per_dispatch"],
         "sync": "host_fetch",
         "platform": platform,
         "device_kind": str(getattr(jax.devices()[0], "device_kind",

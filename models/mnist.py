@@ -36,7 +36,7 @@ class MnistLoader(FullBatchLoader):
 
 
 def build_workflow(epochs=10, minibatch_size=100, lr=0.03,
-                   snapshot_dir=None):
+                   snapshot_dir=None, epochs_per_dispatch=1):
     loader = MnistLoader(None, minibatch_size=minibatch_size, name="mnist")
     snap = (vt.Snapshotter(None, prefix="mnist", directory=snapshot_dir)
             if snapshot_dir else None)
@@ -53,6 +53,7 @@ def build_workflow(epochs=10, minibatch_size=100, lr=0.03,
         decision_config=dict(max_epochs=epochs, fail_iterations=50),
         lr_schedule=nn.exp_decay(0.98),
         snapshotter_unit=snap,
+        epochs_per_dispatch=epochs_per_dispatch,
     )
     return wf
 

@@ -167,3 +167,108 @@ def test_mixed_precision_converges():
     for tree in wf.train_step.params.values():
         for leaf in tree.values():
             assert leaf.dtype == jnp.float32
+
+
+def test_epoch_block_matches_classic():
+    """epochs_per_dispatch=H fuses H whole epochs (eval+train) into ONE
+    device dispatch; the Decision replays per-epoch bookkeeping from the
+    stacked accums. Same seed → the trajectory and final weights must
+    match the classic per-epoch loop."""
+    import jax
+    from veles_tpu import prng
+
+    def run(h):
+        prng.seed_all(99)
+        loader = BlobsLoader(None, minibatch_size=50, name="blobs-blk")
+        wf = nn.StandardWorkflow(
+            name="blk-%d" % h,
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 16},
+                {"type": "softmax", "output_sample_shape": 3},
+            ],
+            loader_unit=loader, loss_function="softmax",
+            decision_config=dict(max_epochs=12, fail_iterations=50),
+            lr_schedule=nn.exp_decay(0.95),
+            epochs_per_dispatch=h,
+        )
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        wf.run()
+        d = wf.decision
+        return {
+            "train": numpy.asarray(d.epoch_metrics[TRAIN]),
+            "valid": numpy.asarray(d.epoch_metrics[VALID]),
+            "test": numpy.asarray(d.epoch_metrics[TEST]),
+            "epochs": d.epoch_number,
+            "w": numpy.asarray(jax.device_get(
+                wf.train_step.params[wf.forwards[0].name]["weights"])),
+        }
+
+    classic = run(1)
+    for h in (4, 5):
+        # h=5 does NOT divide max_epochs=12: the final block clamps to
+        # the 2 remaining epochs, so the weights stop exactly at the cap
+        block = run(h)
+        assert classic["epochs"] == block["epochs"] == 12
+        for k in ("train", "valid", "test"):
+            assert classic[k].shape == block[k].shape == (12,)
+            numpy.testing.assert_allclose(block[k], classic[k],
+                                          atol=0.02)
+        numpy.testing.assert_allclose(block["w"], classic["w"],
+                                      rtol=2e-3, atol=2e-4)
+
+
+def test_epoch_block_with_data_axis():
+    """Block dispatch composes with data parallelism: plans shard over
+    the minibatch axis, trajectory still converges."""
+    from veles_tpu import prng
+    prng.seed_all(99)
+    loader = BlobsLoader(None, minibatch_size=48, name="blobs-blk8")
+    wf = nn.StandardWorkflow(
+        name="blk-dp",
+        layers=[
+            {"type": "all2all_tanh", "output_sample_shape": 16},
+            {"type": "softmax", "output_sample_shape": 3},
+        ],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=8, fail_iterations=50),
+        epochs_per_dispatch=4,
+    )
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 8}))
+    wf.run()
+    d = wf.decision
+    assert d.epoch_number == 8
+    assert d.best_metric is not None and d.best_metric < 0.05, \
+        d.epoch_metrics
+
+
+def test_block_drain_improved_flag_ors_over_epochs():
+    """The snapshot gate reads `improved` once per drain: improvement at
+    an INTERIOR epoch of a block must leave it True even if the final
+    epochs plateau (else best models never snapshot under long blocks)."""
+    from veles_tpu.nn.decision import DecisionGD
+    from veles_tpu.mutable import Bool
+
+    class FakeLoader:
+        epoch_ended = Bool(True)
+
+    class FakeStep:
+        def __init__(self, blocks):
+            self.blocks = blocks
+
+        def drain_epoch_blocks(self):
+            return self.blocks
+
+    wf = vt.Workflow(name="t")
+    d = DecisionGD(wf, max_epochs=10)
+    d.loader = FakeLoader()
+    # err improves at epoch 2 of 4, then plateaus
+    d.step_unit = FakeStep([
+        {TRAIN: {"n_err": 50.0, "n_samples": 100.0}},
+        {TRAIN: {"n_err": 10.0, "n_samples": 100.0}},
+        {TRAIN: {"n_err": 30.0, "n_samples": 100.0}},
+        {TRAIN: {"n_err": 30.0, "n_samples": 100.0}},
+    ])
+    d.run()
+    assert d.epoch_number == 4
+    assert d.best_metric == 0.1 and d.best_epoch == 2
+    assert bool(d.improved)      # interior improvement kept visible

@@ -76,6 +76,21 @@ class Loader(Unit):
         #: when True, a fused step consumes indices on device and the host
         #: minibatch_data fill is skipped entirely
         self.fused = False
+        #: serve H whole epochs per run() as per-class (H, K_c, mb) index
+        #: plans (TrainStep epochs_per_dispatch: ONE device dispatch
+        #: covers H epochs of eval+train — the per-epoch host round trip
+        #: disappears). Set by TrainStep; fused-only.
+        self.block_epochs = 1
+        #: {class: (idx Array (H, K_c, mb) int32, mask Array f32)} —
+        #: allocated on first serve_epoch_block
+        self.block_plans: Dict[int, tuple] = {}
+        #: hard epoch cap (Decision.max_epochs, set by StandardWorkflow):
+        #: the FINAL block clamps to the epochs remaining under it —
+        #: training past max_epochs would desynchronize the reported
+        #: trajectory from the actual weights
+        self.block_epochs_cap: Optional[int] = None
+        #: epochs actually served by the last serve_epoch_block
+        self.block_length = 0
         self._global_offset = 0
         self._shuffled_indices: Optional[numpy.ndarray] = None
         self.samples_served = 0
@@ -214,7 +229,9 @@ class Loader(Unit):
 
     # -- the serving loop ----------------------------------------------------
     def run(self) -> None:
-        if self.plan_steps > 1:
+        if self.block_epochs > 1:
+            self.serve_epoch_block()
+        elif self.plan_steps > 1:
             self.serve_plan()
         else:
             self.serve_next_minibatch()
@@ -300,6 +317,58 @@ class Loader(Unit):
         self.plan_length = k
         self.minibatch_size = int(mask.sum())
         # no host fill: plan mode is fused-only (enforced at initialize)
+
+    def plan_rows_for(self, cls: int) -> int:
+        """Static plan height for one sample class: ceil(len / mb)."""
+        n = self.class_lengths[cls]
+        mb = self.max_minibatch_size
+        return -(-n // mb) if n else 0
+
+    def serve_epoch_block(self) -> None:
+        """Serve ``block_epochs`` WHOLE epochs as per-class stacked index
+        plans: for each class c with samples, (H, K_c, mb) indices+mask.
+        The epoch walk order inside each epoch is the offset order
+        (test → validation → train), exactly the classic loop's order;
+        flags/counters advance as if the epochs were served one by one,
+        so Decision/Snapshotter semantics are unchanged (they just see H
+        epochs per drain)."""
+        from ..error import Bug
+        if not self.fused:
+            raise Bug("serve_epoch_block requires a fused consumer")
+        h = self.block_epochs
+        if self.block_epochs_cap is not None:
+            completed = self.epoch_number + (1 if bool(self.epoch_ended)
+                                             else 0)
+            h = max(1, min(h, self.block_epochs_cap - completed))
+        mb = self.max_minibatch_size
+        if not self.block_plans:
+            for cls in (TEST, VALID, TRAIN):
+                rows = self.plan_rows_for(cls)
+                if not rows:
+                    continue
+                shape = (h, rows, mb)
+                self.block_plans[cls] = (
+                    Array(numpy.zeros(shape, numpy.int32),
+                          name="%s.block_idx%d" % (self.name, cls)),
+                    Array(numpy.zeros(shape, numpy.float32),
+                          name="%s.block_mask%d" % (self.name, cls)))
+        self.block_length = h
+        views = {cls: (idx.map_invalidate(), mask.map_invalidate())
+                 for cls, (idx, mask) in self.block_plans.items()}
+        for e in range(h):
+            self._begin_serving()
+            rows_done = {cls: 0 for cls in views}
+            while self._global_offset < self.total_samples:
+                offset, cls, size = self._next_geometry()
+                idx, mask = views[cls]
+                k = rows_done[cls]
+                self._fill_row(idx[e, k], mask[e, k], offset, size)
+                rows_done[cls] = k + 1
+                self._advance(cls, size)
+            # epoch_ended is now True; the next e re-enters a new epoch
+        self.minibatch_class = TRAIN
+        self.plan_length = self.plan_rows_for(TRAIN)
+        self.minibatch_size = mb
 
     # -- checkpoint protocol -------------------------------------------------
     def state_dict(self):

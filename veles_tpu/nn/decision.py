@@ -65,8 +65,25 @@ class DecisionBase(Unit):
         if not bool(loader.epoch_ended):
             return
         if self.step_unit is not None:
-            for set_idx, m in self.step_unit.drain_epoch_metrics().items():
-                self.accumulate(set_idx, m)
+            # one entry per epoch: H entries after a fused epoch-block
+            # dispatch (TrainStep.epochs_per_dispatch), one otherwise —
+            # bookkeeping replays each epoch exactly as the classic loop
+            any_improved = False
+            for per_epoch in self.step_unit.drain_epoch_blocks():
+                for set_idx, m in per_epoch.items():
+                    self.accumulate(set_idx, m)
+                self._finish_epoch()
+                any_improved |= bool(self.improved)
+                if bool(self.complete):
+                    break
+            # the snapshot gate reads `improved` once per drain: an
+            # improvement at ANY replayed epoch must open it, not just
+            # one at the block's final epoch
+            self.improved <<= any_improved
+        else:
+            self._finish_epoch()
+
+    def _finish_epoch(self) -> None:
         self.epoch_number += 1
         line = ["epoch %d" % self.epoch_number]
         for set_idx in (TEST, VALID, TRAIN):

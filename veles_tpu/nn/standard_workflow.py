@@ -90,13 +90,15 @@ class StandardWorkflow(AcceleratedWorkflow):
                  loader_unit=None, loss_function: str = "softmax",
                  decision_config: Optional[Dict[str, Any]] = None,
                  lr_schedule=None, snapshotter_unit=None,
-                 steps_per_dispatch: int = 16, target_mode: str = None,
+                 steps_per_dispatch: int = 16,
+                 epochs_per_dispatch: int = 1, target_mode: str = None,
                  pipeline_microbatches: Optional[int] = None,
                  remat: bool = False,
                  mcdnnic_topology: str = None,
                  mcdnnic_parameters: Optional[Dict[str, Any]] = None,
                  **kwargs):
         self._steps_per_dispatch = steps_per_dispatch
+        self._epochs_per_dispatch = epochs_per_dispatch
         self._target_mode = target_mode
         self._pipeline_microbatches = pipeline_microbatches
         self._remat = remat
@@ -162,10 +164,16 @@ class StandardWorkflow(AcceleratedWorkflow):
             self, forwards=self.forwards, evaluator=self.evaluator,
             loader=self.loader, target_mode=target_mode,
             steps_per_dispatch=self._steps_per_dispatch,
+            epochs_per_dispatch=self._epochs_per_dispatch,
             pipeline_microbatches=self._pipeline_microbatches,
             remat=self._remat)
         self.decision.loader = self.loader
         self.decision.step_unit = self.train_step
+        if self._epochs_per_dispatch > 1 and self.loader is not None:
+            # the final block must clamp to the epochs remaining under
+            # max_epochs: device weights past the cap would desync from
+            # the reported trajectory
+            self.loader.block_epochs_cap = self.decision.max_epochs
         if lr_schedule is not None:
             self.lr_adjust = LearningRateAdjust(self, schedule=lr_schedule)
             self.lr_adjust.decision = self.decision

@@ -42,6 +42,7 @@ class TrainStep(AcceleratedUnit):
     def __init__(self, workflow, forwards: List[ForwardBase] = (),
                  evaluator=None, loader=None, gds=None,
                  target_mode: str = "labels", steps_per_dispatch: int = 16,
+                 epochs_per_dispatch: int = 1,
                  pipeline_microbatches: Optional[int] = None,
                  remat: bool = False, **kwargs):
         super().__init__(workflow, **kwargs)
@@ -49,11 +50,19 @@ class TrainStep(AcceleratedUnit):
         self.forwards = list(forwards)
         self.evaluator = evaluator
         self.loader = loader
+        #: H > 1 fuses H WHOLE epochs (eval+train segments) into one
+        #: dispatch — the per-epoch host round trips (train dispatch +
+        #: eval dispatch + metric drain) collapse to 1/H. Decision
+        #: bookkeeping stays per-epoch (drain_epoch_blocks); early-stop
+        #: granularity coarsens to the block (documented trade).
+        self.epochs_per_dispatch = max(1, int(epochs_per_dispatch))
         if loader is not None:
             # fused consumption: host minibatch fill skipped; K minibatches
             # scanned per dispatch (must be set before loader.initialize)
             loader.fused = True
             loader.plan_steps = max(1, int(steps_per_dispatch))
+            if self.epochs_per_dispatch > 1:
+                loader.block_epochs = self.epochs_per_dispatch
         #: "labels" (classification) | "targets" (regression) | "input"
         #: (autoencoder: reconstruct the input batch) | "auto" (resolve at
         #: initialize, after the loader has loaded: targets if present)
@@ -94,6 +103,9 @@ class TrainStep(AcceleratedUnit):
         self._param_masks_np: Dict[Any, numpy.ndarray] = {}
         self._accum: Dict[int, Any] = {}
         self._zero_accum = None
+        #: (stacked device accums, H) from the last block dispatch —
+        #: converted to per-epoch dicts lazily in drain_epoch_blocks
+        self._block_metrics = None
         self.last_loss = None
         self.demand("evaluator", "loader")
 
@@ -532,8 +544,104 @@ class TrainStep(AcceleratedUnit):
         import jax.numpy as jnp
         return jnp.zeros((dataset.shape[0],), jnp.int32)
 
+    def _epoch_block_fn(self, params, opt_state, dataset, labels,
+                        targets, xs_template_keys, xs, rng):
+        """H whole epochs in one program: lax.scan over epochs; each
+        epoch runs the eval plans (test, validation) then the train
+        plan, in the classic loop's offset order. Per-epoch metric
+        accums come back stacked (H,) for the Decision to replay."""
+        import jax
+
+        def one_epoch(carry, per_epoch):
+            p, o = carry
+            e_rng = jax.random.fold_in(rng, per_epoch["e"])
+            outs = {}
+            for cls in (TEST, VALID):
+                key = "c%d" % cls
+                if key + "_idx" not in xs_template_keys:
+                    continue
+                acc = self._eval_plan_fn(
+                    p, self._make_zero_accum(), dataset, labels,
+                    targets, per_epoch[key + "_idx"],
+                    per_epoch[key + "_mask"])
+                outs[cls] = acc
+            p, o, acc_tr, loss = self._train_plan_fn(
+                p, o, self._make_zero_accum(), dataset, labels, targets,
+                per_epoch["c%d_idx" % TRAIN],
+                per_epoch["c%d_mask" % TRAIN],
+                per_epoch["lr"], e_rng)
+            outs[TRAIN] = acc_tr
+            return (p, o), (outs, loss)
+
+        (params, opt_state), (stacked, losses) = jax.lax.scan(
+            one_epoch, (params, opt_state), xs)
+        return params, opt_state, stacked, losses[-1]
+
+    def _run_epoch_block(self) -> None:
+        import jax
+        import numpy as _np
+        loader = self.loader
+        dataset, labels, targets, _, _ = self._inputs()
+        sh = self._shardings
+        plan_sh = None
+        if sh is not None and "data" in sh["repl"].mesh.axis_names:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            plan_sh = NamedSharding(sh["repl"].mesh,
+                                    P(None, None, "data"))
+        # the loader may have clamped the FINAL block below H
+        # (block_epochs_cap); slice the host plans to what was served —
+        # the tail block traces/compiles once at its own scan length
+        h = loader.block_length or loader.block_epochs
+        xs = {"e": _np.arange(h, dtype=_np.int32)}
+        for cls, (idx, mask) in sorted(loader.block_plans.items()):
+            xs["c%d_idx" % cls] = jax.device_put(
+                idx.map_read()[:h], plan_sh)
+            xs["c%d_mask" % cls] = jax.device_put(
+                mask.map_read()[:h], plan_sh)
+        # per-epoch LR scales from the schedule, host-evaluated exactly
+        # as the classic loop would have (epoch k trains at schedule(k))
+        lr_adjust = getattr(self.workflow, "lr_adjust", None)
+        decision = getattr(self.workflow, "decision", None)
+        e0 = decision.epoch_number if decision is not None else 0
+        if lr_adjust is not None:
+            scales = [float(lr_adjust.schedule(e0 + i)) for i in range(h)]
+        else:
+            scales = [float(self.lr_scale)] * h
+        xs["lr"] = _np.asarray(scales, dtype=_np.float32)
+        keys = frozenset(xs)
+
+        def fn(params, opt_state, dataset, labels, targets, xs, rng):
+            return self._epoch_block_fn(params, opt_state, dataset,
+                                        labels, targets, keys, xs, rng)
+
+        jitted = self.jit("epoch_block", fn, donate_argnums=(0, 1))
+        self.params, self.opt_state, stacked, self.last_loss = jitted(
+            self.params, self.opt_state, dataset, labels, targets, xs,
+            self._rng.jax_key())
+        # stays on device until the Decision drains: the host must NOT
+        # block here, or consecutive blocks lose their async overlap
+        self._block_metrics = (stacked, h)
+
+    def drain_epoch_blocks(self) -> List[Dict[int, Dict[str, float]]]:
+        """Per-epoch metric dicts since the last drain: H entries after
+        a block dispatch, one entry in the classic per-epoch mode."""
+        if self._block_metrics is not None:
+            import jax
+            stacked, h = self._block_metrics
+            self._block_metrics = None
+            host = jax.device_get(stacked)
+            return [
+                {cls: {k: float(v[e]) for k, v in acc.items()}
+                 for cls, acc in host.items()}
+                for e in range(h)]
+        return [self.drain_epoch_metrics()]
+
     def xla_run(self) -> None:
         import jax
+        if self.loader.block_epochs > 1:
+            if self.evaluation_mode:
+                raise Bug("epochs_per_dispatch>1 requires training mode")
+            return self._run_epoch_block()
         cls = self.loader.minibatch_class
         accum = self._accum.get(cls)
         if accum is None:
@@ -673,7 +781,7 @@ class TrainStep(AcceleratedUnit):
         self.sync_params_to_arrays()
         d = super().__getstate__()
         for k in ("params", "opt_state", "_accum", "_zero_accum",
-                  "last_loss", "_pp"):
+                  "last_loss", "_pp", "_block_metrics"):
             d[k] = {} if k in ("params", "opt_state", "_accum") else None
         d["param_masks"] = {
             n: {k: numpy.asarray(m) for k, m in ms.items()}
