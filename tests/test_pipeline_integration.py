@@ -259,3 +259,20 @@ def test_pipeline_with_mixed_precision():
         d.epoch_metrics
     for leaf in wf.train_step.params[PP_BLOCK].values():
         assert leaf.dtype == jnp.float32
+
+
+def test_pipeline_with_epoch_block():
+    """epochs_per_dispatch composes with the pipeline axis: the epoch
+    scan wraps the gpipe step; Decision replays per-epoch entries."""
+    prng.seed_all(4242)
+    wf = make_workflow(epochs=4)
+    wf.train_step.epochs_per_dispatch = 2
+    wf.loader.block_epochs = 2
+    wf.loader.block_epochs_cap = 4
+    wf.initialize(device=vt.XLADevice(mesh_axes={"pipeline": 4}))
+    wf.run()
+    d = wf.decision
+    assert wf.train_step._pp is not None
+    assert d.epoch_number == 4
+    assert d.best_metric is not None and d.best_metric < 0.35, \
+        d.epoch_metrics

@@ -74,8 +74,11 @@ class DecisionBase(Unit):
                     self.accumulate(set_idx, m)
                 self._finish_epoch()
                 any_improved |= bool(self.improved)
-                if bool(self.complete):
-                    break
+                # no early break: the device weights already contain the
+                # WHOLE block's training (one dispatch), so bookkeeping
+                # must record every drained epoch or the trajectory
+                # desyncs from the weights; `complete` latches and the
+                # repeater stops at the block boundary regardless
             # the snapshot gate reads `improved` once per drain: an
             # improvement at ANY replayed epoch must open it, not just
             # one at the block's final epoch

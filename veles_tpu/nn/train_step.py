@@ -106,6 +106,9 @@ class TrainStep(AcceleratedUnit):
         #: (stacked device accums, H) from the last block dispatch —
         #: converted to per-epoch dicts lazily in drain_epoch_blocks
         self._block_metrics = None
+        #: {(class, h): (idx, mask) device arrays} — eval plans are
+        #: epoch-invariant, uploaded once per scan length
+        self._eval_plan_dev: Dict[Any, Any] = {}
         self.last_loss = None
         self.demand("evaluator", "loader")
 
@@ -594,6 +597,18 @@ class TrainStep(AcceleratedUnit):
         h = loader.block_length or loader.block_epochs
         xs = {"e": _np.arange(h, dtype=_np.int32)}
         for cls, (idx, mask) in sorted(loader.block_plans.items()):
+            if cls != TRAIN:
+                # eval plans never change (only the TRAIN tail of the
+                # shuffle permutes per epoch): upload once per scan
+                # length, reuse the device copies across blocks
+                cached = self._eval_plan_dev.get((cls, h))
+                if cached is None:
+                    cached = (jax.device_put(idx.map_read()[:h], plan_sh),
+                              jax.device_put(mask.map_read()[:h],
+                                             plan_sh))
+                    self._eval_plan_dev[(cls, h)] = cached
+                xs["c%d_idx" % cls], xs["c%d_mask" % cls] = cached
+                continue
             xs["c%d_idx" % cls] = jax.device_put(
                 idx.map_read()[:h], plan_sh)
             xs["c%d_mask" % cls] = jax.device_put(
@@ -781,7 +796,8 @@ class TrainStep(AcceleratedUnit):
         self.sync_params_to_arrays()
         d = super().__getstate__()
         for k in ("params", "opt_state", "_accum", "_zero_accum",
-                  "last_loss", "_pp", "_block_metrics"):
+                  "last_loss", "_pp", "_block_metrics",
+                  "_eval_plan_dev"):
             d[k] = {} if k in ("params", "opt_state", "_accum") else None
         d["param_masks"] = {
             n: {k: numpy.asarray(m) for k, m in ms.items()}
