@@ -272,3 +272,36 @@ def test_block_drain_improved_flag_ors_over_epochs():
     assert d.epoch_number == 4
     assert d.best_metric == 0.1 and d.best_epoch == 2
     assert bool(d.improved)      # interior improvement kept visible
+
+
+def test_mixed_precision_composes_with_remat():
+    """AMP + remat: jax.checkpoint wraps the bf16 forward — both knobs
+    on together must still converge with f32 masters."""
+    import jax.numpy as jnp
+    from veles_tpu.config import root
+    from veles_tpu import prng
+    prng.seed_all(5)
+    root.common.engine.mixed_precision = True
+    try:
+        loader = BlobsLoader(None, minibatch_size=50, name="blobs-ar")
+        wf = nn.StandardWorkflow(
+            name="amp-remat",
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 16},
+                {"type": "softmax", "output_sample_shape": 3},
+            ],
+            loader_unit=loader, loss_function="softmax",
+            decision_config=dict(max_epochs=8, fail_iterations=50),
+            remat=True,
+        )
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        assert wf.train_step.mixed_precision and wf.train_step.remat
+        wf.run()
+    finally:
+        root.common.engine.mixed_precision = False
+    d = wf.decision
+    assert d.best_metric is not None and d.best_metric < 0.05, \
+        d.epoch_metrics
+    for tree in wf.train_step.params.values():
+        for leaf in tree.values():
+            assert leaf.dtype == jnp.float32
