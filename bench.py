@@ -1,6 +1,6 @@
 """Driver benchmark: prints ONE JSON line with the headline metric.
 
-Two measurements, one line:
+Three measurements, one line:
 
 1. headline (BASELINE.json): Znicz MNIST-784 workflow training throughput,
    samples/sec/chip, on the fused SPMD step. The reference published no
@@ -12,6 +12,10 @@ Two measurements, one line:
    f32 accumulation, reporting samples/sec/chip, achieved model TFLOP/s
    and MFU against the chip's nominal bf16 peak. This is where the MXU
    actually works (BASELINE.json names ImagenetAE samples/sec/chip).
+3. extras[1]: transformer-LM training throughput (tokens/sec/chip) —
+   GPT-style stack (512 dim x 6 RoPE blocks, T=512, per-token CE) under
+   mixed precision with 4 whole epochs per dispatch; the modern-workload
+   surface the reference never had.
 
 Measurement notes (methodology fixed 2026-07-29, provenance stamped into
 the JSON):
@@ -139,18 +143,44 @@ def bench_mnist(dev, n_chips):
     }
 
 
-def bench_conv_ae(dev, n_chips):
+import contextlib
+
+
+@contextlib.contextmanager
+def mixed_precision_on():
+    """bf16 activation storage for the measurement inside (docs/perf.md
+    roofline: the image/LM benches are HBM-bound); restored on exit so
+    no other measurement inherits the flag."""
     from veles_tpu.config import root as vt_root
-    # the AE roofline is HBM-bound (docs/perf.md): bf16 activation
-    # storage is the bandwidth lever, f32 masters/accumulation keep the
-    # numerics honest — stamped into the JSON for comparability. The
-    # flag is restored afterwards so no other measurement inherits it.
-    prev_mp = vt_root.common.engine.get("mixed_precision", False)
+    prev = vt_root.common.engine.get("mixed_precision", False)
     vt_root.common.engine.mixed_precision = True
     try:
-        return _bench_conv_ae_inner(dev, n_chips)
+        yield
     finally:
-        vt_root.common.engine.mixed_precision = prev_mp
+        vt_root.common.engine.mixed_precision = prev
+
+
+def peak_bf16_flops():
+    import jax
+    kind = getattr(jax.devices()[0], "device_kind", "unknown")
+    return next((p for key, p in PEAK_BF16
+                 if key in str(kind).lower()), 275e12)
+
+
+def measured_tflops(epoch_counts, durations, epoch_flops,
+                    epochs_per_call=1):
+    """Median across windows of executed model TFLOP/s.
+    measure_windows counts run_epoch CALLS; under block dispatch each
+    call executes epochs_per_call whole epochs — forgetting that factor
+    under-reports FLOPs by exactly that factor."""
+    return statistics.median(
+        [e * epochs_per_call * epoch_flops / d
+         for e, d in zip(epoch_counts, durations)]) / 1e12
+
+
+def bench_conv_ae(dev, n_chips):
+    with mixed_precision_on():
+        return _bench_conv_ae_inner(dev, n_chips)
 
 
 def _bench_conv_ae_inner(dev, n_chips):
@@ -168,12 +198,8 @@ def _bench_conv_ae_inner(dev, n_chips):
     host_sync(wf.train_step)
     rates, epochs, durs = measure_windows(
         run_epoch, lambda: host_sync(wf.train_step))
-    tflops = statistics.median(
-        [e * epoch_flops / d for e, d in zip(epochs, durs)]) / 1e12
-    import jax
-    kind = getattr(jax.devices()[0], "device_kind", "unknown")
-    peak = next((p for key, p in PEAK_BF16 if key in str(kind).lower()),
-                275e12)
+    tflops = measured_tflops(epochs, durs, epoch_flops)
+    peak = peak_bf16_flops()
     from veles_tpu.config import root
     # rates count every served sample; the metric is labeled TRAIN
     # throughput, so scale out the validation passes each epoch carries
@@ -194,6 +220,51 @@ def _bench_conv_ae_inner(dev, n_chips):
         "mixed_precision": bool(wf.train_step.mixed_precision),
         "data": "synthetic",
     }
+
+
+def bench_lm(dev, n_chips):
+    """Transformer-LM training throughput (tokens/sec/chip) — the
+    modern-workload surface: embedding → RoPE blocks → per-token CE,
+    under mixed precision with 4 whole epochs per dispatch."""
+    from char_lm import build_bench_workflow
+    with mixed_precision_on():
+        cfg = dict(seq_len=512, dim=512, n_blocks=6, ffn_hidden=2048,
+                   n_heads=8, vocab=256, minibatch_size=16,
+                   n_train=1024, n_valid=128)
+        wf = build_bench_workflow(epochs_per_dispatch=4, **cfg)
+        wf.initialize(device=dev)
+        # analytic model FLOPs per token (matmul weights x2, embedding
+        # gather excluded, + the attention T-term per block), x3 train
+        d, t_len = cfg["dim"], cfg["seq_len"]
+        p_block = 4 * d * d + 2 * d * cfg["ffn_hidden"]
+        p_mat = cfg["n_blocks"] * p_block + d * cfg["vocab"]
+        fwd_per_token = 2 * p_mat + cfg["n_blocks"] * 2 * 2 * t_len * d
+        loader = wf.loader
+        n_tr, n_va = loader.class_lengths[2], loader.class_lengths[1]
+        epoch_flops = t_len * fwd_per_token * (3 * n_tr + n_va)
+        run_epoch = epoch_runner(wf)
+        run_epoch()
+        host_sync(wf.train_step)
+        rates, epochs, durs = measure_windows(
+            run_epoch, lambda: host_sync(wf.train_step))
+        # each run_epoch call = one BLOCK of 4 whole epochs
+        tflops = measured_tflops(
+            epochs, durs, epoch_flops,
+            epochs_per_call=wf.loader.block_length or 1)
+        peak = peak_bf16_flops()
+        train_frac = n_tr / (n_tr + n_va)
+        return {
+            "metric": "lm_train_tokens_per_sec_per_chip",
+            "tokens_per_sec_per_chip":
+                statistics.median(rates) * t_len * train_frac / n_chips,
+            "model_tflops_per_sec_per_chip": tflops / n_chips,
+            "mfu": tflops / n_chips / (peak / 1e12),
+            "config": {k: cfg[k] for k in ("seq_len", "dim", "n_blocks",
+                                           "minibatch_size")},
+            "epochs_per_dispatch": 4,
+            "mixed_precision": True,
+            "data": "synthetic",
+        }
 
 
 def _acquire_device(retries=6, delay=30.0):
@@ -232,6 +303,13 @@ def main():
         import traceback
         traceback.print_exc()
         ae = {"metric": "imagenet_ae_train_samples_per_sec_per_chip",
+              "error": str(e)}
+    try:
+        lm = bench_lm(dev, n_chips)
+    except Exception as e:        # noqa: BLE001
+        import traceback
+        traceback.print_exc()
+        lm = {"metric": "lm_train_tokens_per_sec_per_chip",
               "error": str(e)}
 
     platform = getattr(dev, "platform", "numpy")
@@ -272,7 +350,7 @@ def main():
         "platform": platform,
         "device_kind": str(getattr(jax.devices()[0], "device_kind",
                                    "unknown")),
-        "extras": [ae],
+        "extras": [ae, lm],
     }))
 
 

@@ -82,6 +82,58 @@ def build_workflow(epochs=10, minibatch_size=64, lr=0.003, n_blocks=2,
     return wf
 
 
+class SyntheticTokenLoader(FullBatchLoaderMSE):
+    """Random token streams at arbitrary (seq_len, vocab) — the LM
+    throughput-bench surface (content does not affect throughput; the
+    tiny int32 upload matters through the tunnel, unlike image data)."""
+
+    hide_from_registry = True
+
+    def __init__(self, workflow, seq_len=512, vocab=256, n_train=1024,
+                 n_valid=128, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.seq_len, self.vocab = seq_len, vocab
+        self.n_train, self.n_valid = n_train, n_valid
+
+    def load_data(self):
+        rng = numpy.random.RandomState(2027)
+        n = self.n_valid + self.n_train
+        stream = rng.randint(0, self.vocab, n * self.seq_len + 1,
+                             dtype=numpy.int32)
+        self.create_originals(stream[:-1].reshape(n, self.seq_len), None,
+                              targets=stream[1:].reshape(n, self.seq_len))
+        self.class_lengths = [0, self.n_valid, self.n_train]
+
+
+def build_bench_workflow(seq_len=512, dim=512, n_blocks=6,
+                         ffn_hidden=2048, n_heads=8, vocab=256,
+                         minibatch_size=16, n_train=1024, n_valid=128,
+                         lr=1e-4, epochs_per_dispatch=1):
+    """GPT-style stack at throughput-bench scale (the modern-workload
+    counterpart of the AE bench): token embedding → N pre-LN RoPE
+    blocks → LM head, per-token CE. Sized so the matmuls dominate
+    dispatch latency (~19M matmul params at the defaults)."""
+    loader = SyntheticTokenLoader(
+        None, seq_len=seq_len, vocab=vocab, n_train=n_train,
+        n_valid=n_valid, minibatch_size=minibatch_size, name="lm-bench")
+    layers = ([{"type": "embedding", "vocab_size": vocab, "dim": dim,
+                "solver": "adam", "learning_rate": lr}]
+              + [{"type": "transformer_block", "n_heads": n_heads,
+                  "ffn_hidden": ffn_hidden, "causal": True, "rope": True,
+                  "solver": "adam", "learning_rate": lr,
+                  "name": "blk%d" % i} for i in range(n_blocks)]
+              + [{"type": "lm_head", "vocab_size": vocab,
+                  "solver": "adam", "learning_rate": lr}])
+    return nn.StandardWorkflow(
+        name="char-lm-bench", layers=layers, loader_unit=loader,
+        loss_function="softmax_seq",
+        decision_config=dict(max_epochs=10 ** 9,
+                             fail_iterations=10 ** 9),
+        steps_per_dispatch=n_train // minibatch_size,
+        epochs_per_dispatch=epochs_per_dispatch,
+    )
+
+
 def generate(wf, prompt, n_new, temperature=1.0, seed=0):
     """Sample continuations from the trained causal stack: re-forward
     the growing window each step (fine at demo scale; KV caching is a

@@ -269,3 +269,18 @@ def test_genetic_example_solves():
     assert value == 15.0, (take, value)
     _genes, f = ge.solve_rosenbrock(generations=60)
     assert f < 0.5, f
+
+
+def test_lm_bench_workflow_builds():
+    """The LM throughput-bench surface (bench.py extras[1]) must keep
+    building and running one block dispatch."""
+    lm = _import_model("char_lm")
+    wf = lm.build_bench_workflow(seq_len=32, dim=32, n_blocks=2,
+                                 ffn_hidden=64, n_heads=4, vocab=32,
+                                 minibatch_size=8, n_train=32, n_valid=8,
+                                 epochs_per_dispatch=2)
+    wf.initialize(device=_dev())
+    wf.loader.run()
+    wf.train_step.run()
+    assert wf.loader.block_length == 2
+    assert wf.train_step.params
