@@ -222,6 +222,9 @@ def _bench_conv_ae_inner(dev, n_chips):
     }
 
 
+LM_BLOCK_EPOCHS = 4
+
+
 def bench_lm(dev, n_chips):
     """Transformer-LM training throughput (tokens/sec/chip) — the
     modern-workload surface: embedding → RoPE blocks → per-token CE,
@@ -231,7 +234,8 @@ def bench_lm(dev, n_chips):
         cfg = dict(seq_len=512, dim=512, n_blocks=6, ffn_hidden=2048,
                    n_heads=8, vocab=256, minibatch_size=16,
                    n_train=1024, n_valid=128)
-        wf = build_bench_workflow(epochs_per_dispatch=4, **cfg)
+        wf = build_bench_workflow(epochs_per_dispatch=LM_BLOCK_EPOCHS,
+                                  **cfg)
         wf.initialize(device=dev)
         # analytic model FLOPs per token (matmul weights x2, embedding
         # gather excluded, + the attention T-term per block), x3 train
@@ -261,7 +265,7 @@ def bench_lm(dev, n_chips):
             "mfu": tflops / n_chips / (peak / 1e12),
             "config": {k: cfg[k] for k in ("seq_len", "dim", "n_blocks",
                                            "minibatch_size")},
-            "epochs_per_dispatch": 4,
+            "epochs_per_dispatch": LM_BLOCK_EPOCHS,
             "mixed_precision": True,
             "data": "synthetic",
         }

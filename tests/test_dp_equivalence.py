@@ -33,19 +33,32 @@ class BlobsLoader(FullBatchLoader):
         self.class_lengths = [0, 120, 360]
 
 
-def _run(n_devices, epochs=6):
+def _run(n_devices=None, epochs=6, mesh_axes=None, n_classes=3,
+         check_sharding=None):
+    """One seeded blobs training run under the given mesh; the shared
+    body of every equivalence test in this module. check_sharding, if
+    given, receives the first layer's param sharding BEFORE the run —
+    tests must assert the axis actually engaged, or they pass vacuously
+    when a mesh regression silently falls back to replication."""
+    if mesh_axes is None:
+        mesh_axes = {"data": n_devices}
     prng.seed_all(1234)
     loader = BlobsLoader(None, minibatch_size=40, name="blobs-eq")
     wf = nn.StandardWorkflow(
-        name="dp-eq-%d" % n_devices,
+        name="eq-%s" % "x".join("%s%d" % kv for kv in
+                                sorted(mesh_axes.items())),
         layers=[
             {"type": "all2all_tanh", "output_sample_shape": 16},
-            {"type": "softmax", "output_sample_shape": 3},
+            {"type": "softmax", "output_sample_shape": n_classes},
         ],
         loader_unit=loader, loss_function="softmax",
         decision_config=dict(max_epochs=epochs, fail_iterations=100),
     )
-    wf.initialize(device=vt.XLADevice(mesh_axes={"data": n_devices}))
+    wf.initialize(device=vt.XLADevice(mesh_axes=mesh_axes))
+    if check_sharding is not None:
+        check_sharding(
+            wf.train_step.params[wf.forwards[0].name]["weights"]
+            .sharding)
     wf.run()
     d = wf.decision
     import jax
@@ -193,3 +206,40 @@ def test_sp_composes_with_dp():
                                   atol=0.02)
     numpy.testing.assert_allclose(r24["wq"], r1["wq"], rtol=5e-3,
                                   atol=5e-4)
+
+
+def test_fsdp_matches_replicated():
+    """ZeRO-3-style parameter sharding ({'fsdp': 8}: params sharded over
+    their largest divisible axis, all-gathered at use by GSPMD) must
+    train identically to the replicated layout — it changes placement,
+    not math. Composed {'data': 2, 'fsdp': 4} likewise."""
+    base = _run(1, epochs=4)
+
+    def sharded(sh):
+        assert not sh.is_fully_replicated, sh
+
+    for axes in ({"fsdp": 8}, {"data": 2, "fsdp": 4}):
+        r = _run(mesh_axes=axes, epochs=4, check_sharding=sharded)
+        numpy.testing.assert_allclose(r["train_err"],
+                                      base["train_err"], atol=0.01)
+        numpy.testing.assert_allclose(r["weights"], base["weights"],
+                                      rtol=2e-3, atol=2e-4)
+
+
+def test_tensor_parallel_matches_replicated():
+    """Megatron-style column sharding ({'tensor': 4}: output-feature
+    axis split, activation collectives inserted by GSPMD) — same
+    trajectory and weights as the replicated run; composed
+    {'data': 2, 'tensor': 4} likewise."""
+    base = _run(1, epochs=4, n_classes=4)
+
+    def column_split(sh):
+        assert sh.spec[-1] == "tensor", sh
+
+    for axes in ({"tensor": 4}, {"data": 2, "tensor": 4}):
+        r = _run(mesh_axes=axes, epochs=4, n_classes=4,
+                 check_sharding=column_split)
+        numpy.testing.assert_allclose(r["train_err"],
+                                      base["train_err"], atol=0.01)
+        numpy.testing.assert_allclose(r["weights"], base["weights"],
+                                      rtol=2e-3, atol=2e-4)
