@@ -135,9 +135,20 @@ def build_bench_workflow(seq_len=512, dim=512, n_blocks=6,
 
 
 def generate(wf, prompt, n_new, temperature=1.0, seed=0):
-    """Sample continuations from the trained causal stack: re-forward
-    the growing window each step (fine at demo scale; KV caching is a
-    serving optimization, not a training-framework concern)."""
+    """Sample continuations from the trained causal stack via the
+    KV-cached on-device sampler (nn/sampling.py: prefill + one
+    lax.scan — a single dispatch end to end)."""
+    from veles_tpu.nn import sampling
+    return sampling.generate(wf, prompt, n_new, temperature=temperature,
+                             seed=seed)
+
+
+def generate_naive(wf, prompt, n_new, temperature=1.0, seed=0):
+    """Reference sampler: re-forward the FULL growing sequence each
+    step — O(T^2) per token and one retrace per length; kept as the
+    oracle the KV-cached path is tested against
+    (tests/test_transformer.py). RoPE has no trained-length cap, so the
+    growing context needs no windowing."""
     import jax
     import jax.numpy as jnp
     params = {f.name: {k: v.device_view()
@@ -154,9 +165,7 @@ def generate(wf, prompt, n_new, temperature=1.0, seed=0):
     key = jax.random.key(seed)
     toks = list(int(t) for t in prompt)
     for _ in range(n_new):
-        window = jnp.asarray(toks[-SEQ_LEN:], dtype=jnp.int32)
-        logits = logits_fn(jnp.pad(window, (SEQ_LEN - len(window), 0))
-                           if len(window) < SEQ_LEN else window)
+        logits = logits_fn(jnp.asarray(toks, dtype=jnp.int32))
         key, sub = jax.random.split(key)
         if temperature <= 0:
             nxt = int(jnp.argmax(logits))

@@ -1,0 +1,71 @@
+"""Capture an XPlane profiler trace of a zoo model's fused train step.
+
+The per-op view the reference never had (its profiling was wall-clock
+unit timers, SURVEY.md §5.1; kernel-level profiling "none") — this
+drives any `models/` member for a few dispatches under
+``jax.profiler.trace`` and writes a TensorBoard-loadable XPlane
+directory. Works on the CPU mesh for program-structure inspection and
+on the real chip for MXU/HBM utilization (pair with docs/perf.md's
+roofline notes).
+
+Usage:
+    python scripts/profile_step.py --model mnist --dispatches 3 \
+        --out /tmp/trace
+    tensorboard --logdir /tmp/trace     # wherever tensorboard exists
+"""
+import argparse
+import importlib
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "models"))
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--model", default="mnist",
+                   help="models/<name>.py with build_workflow()")
+    p.add_argument("--builder", default="build_workflow",
+                   help="builder function (e.g. build_bench_workflow)")
+    p.add_argument("--dispatches", type=int, default=3)
+    p.add_argument("--out", default="/tmp/veles_trace")
+    p.add_argument("--backend", default="auto")
+    args = p.parse_args(argv)
+
+    import jax
+    import veles_tpu as vt
+
+    mod = importlib.import_module(args.model)
+    wf = getattr(mod, args.builder)()
+    wf.initialize(device=vt.Device_for(args.backend))
+    loader, step = wf.loader, wf.train_step
+
+    # warmup outside the trace: compile + first placement would swamp
+    # the per-op timeline
+    loader.run()
+    step.run()
+    jax.block_until_ready(step.params)
+
+    os.makedirs(args.out, exist_ok=True)
+    with jax.profiler.trace(args.out):
+        for _ in range(args.dispatches):
+            loader.run()
+            step.run()
+        jax.block_until_ready(step.params)
+
+    produced = []
+    for root_dir, _dirs, files in os.walk(args.out):
+        produced += [os.path.join(root_dir, f) for f in files]
+    if not produced:
+        print("no trace files produced", file=sys.stderr)
+        return 1
+    print("trace: %d files under %s" % (len(produced), args.out))
+    for f in sorted(produced)[:5]:
+        print("  ", os.path.relpath(f, args.out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

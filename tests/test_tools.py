@@ -219,3 +219,20 @@ def test_generate_docs_manual():
     with open(committed) as fin:
         assert fin.read() == text, \
             "docs/units_reference.md is stale — regenerate it"
+
+
+def test_profile_step_produces_trace(tmp_path):
+    """scripts/profile_step.py: the per-op profiling tool (reference had
+    only wall-clock unit timers, SURVEY §5.1) must emit an XPlane dir."""
+    import subprocess
+    import sys
+    script = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "scripts", "profile_step.py")
+    out = str(tmp_path / "trace")
+    r = subprocess.run(
+        [sys.executable, script, "--model", "lines",
+         "--dispatches", "1", "--out", out],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    produced = [f for _r, _d, fs in os.walk(out) for f in fs]
+    assert any(f.endswith(".xplane.pb") for f in produced), produced

@@ -211,3 +211,43 @@ def test_embedding_oob_clips_consistently():
     numpy.testing.assert_allclose(y_np[0, 0], params["table"][0])
     numpy.testing.assert_allclose(y_np[0, 3], params["table"][3])
     numpy.testing.assert_allclose(y_np[0, 4], params["table"][3])
+
+
+def test_cached_generation_matches_naive():
+    """nn/sampling.py KV-cached sampler: prefill + scan must reproduce
+    the re-forward-the-window oracle EXACTLY under greedy decoding
+    (same params, same positions, same rope) — and run as one dispatch."""
+    import importlib
+    import sys as _sys
+    import os as _os
+    _sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))), "models"))
+    lm = importlib.import_module("char_lm")
+    prng.seed_all(1234)
+    wf = lm.build_workflow(epochs=3, minibatch_size=64, n_blocks=2,
+                           dim=32, n_train=512, n_valid=128)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    rng = numpy.random.RandomState(3)
+    # the oracle forwards the FULL growing sequence, the cached path
+    # keeps full context too — structurally identical at any length
+    # (24 new tokens deliberately crosses the training SEQ_LEN)
+    prompt = list(lm.make_corpus(rng, lm.SEQ_LEN // 2))
+    naive = lm.generate_naive(wf, prompt, 24, temperature=0)
+    cached = lm.generate(wf, prompt, 24, temperature=0)
+    assert naive == cached, (naive, cached)
+    # stochastic path stays in-vocab and runs
+    toks = lm.generate(wf, prompt, 16, temperature=1.0, seed=7)
+    assert len(toks) == 16
+    assert all(0 <= t < lm.VOCAB for t in toks)
+
+
+def test_cached_generation_rejects_non_lm_stack():
+    from veles_tpu.error import VelesError
+    from veles_tpu.nn import sampling
+
+    class FakeUnit:
+        PARAMETERIZED = False
+    wf = type("WF", (), {"forwards": [FakeUnit()]})()
+    with pytest.raises(VelesError):
+        sampling.generate(wf, [1, 2, 3], 4)

@@ -36,6 +36,7 @@ from .image_saver import ImageSaver  # noqa
 from .nn_plotting import Weights2D, KohonenHits  # noqa
 from .attention import MultiHeadAttention, attention_core  # noqa
 from .moe import MoEFFN  # noqa
+from . import sampling  # noqa
 from .transformer import (TransformerBlock, MeanPool,  # noqa
                           PositionalEmbedding, Embedding, LMHead)
 from .evaluator import EvaluatorSoftmaxSeq  # noqa

@@ -1,0 +1,244 @@
+"""KV-cached autoregressive sampling — the LM serving path.
+
+New capability vs the reference (its inference story was the libVeles
+chain executor; no autoregressive models existed). Naive sampling
+re-forwards the whole window per new token — O(T²) matmuls per token
+and a fresh device round trip each step. This module keeps per-block
+K/V caches on device and runs the WHOLE generation as one
+``lax.scan``: per token only the single-position projections + one
+attention row run, and the host gets back the finished sequence.
+
+Operates on the public parameter contract of the ``Embedding`` →
+``TransformerBlock``×N → ``LMHead`` stack (optionally with a
+``PositionalEmbedding`` after the stem); reuses transformer.py's
+layernorm/gelu/rope math so cached and full paths cannot drift.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy
+
+from ..error import VelesError
+from .transformer import (Embedding, LMHead, PositionalEmbedding,
+                          TransformerBlock, _gelu, _layernorm, _rope)
+
+
+def _rope_at(np_mod, x, pos, base=10000.0):
+    """RoPE for a SINGLE position: x (B, 1, H, Dh), pos scalar (traced
+    ok). Same half-split pairing as transformer._rope."""
+    hd = x.shape[-1]
+    half = hd // 2
+    inv = np_mod.asarray(
+        (base ** (-numpy.arange(half, dtype="float32") / half)))
+    ang = pos.astype("float32") * inv           # (half,)
+    cos = np_mod.cos(ang)[None, None, None, :]
+    sin = np_mod.sin(ang)[None, None, None, :]
+    x1, x2 = x[..., :half], x[..., half:2 * half]
+    rot1 = x1 * cos - x2 * sin
+    rot2 = x1 * sin + x2 * cos
+    if 2 * half == hd:
+        return np_mod.concatenate([rot1, rot2], axis=-1)
+    return np_mod.concatenate([rot1, rot2, x[..., 2 * half:]], axis=-1)
+
+
+def split_stack(forwards) -> Dict[str, object]:
+    """Stem / block-list / head decomposition of a generation-capable
+    forward chain; raises for anything else."""
+    stem = pos_emb = head = None
+    blocks: List[TransformerBlock] = []
+    for f in forwards:
+        if isinstance(f, Embedding):
+            stem = f
+        elif isinstance(f, PositionalEmbedding):
+            pos_emb = f
+        elif isinstance(f, TransformerBlock):
+            blocks.append(f)
+        elif isinstance(f, LMHead):
+            head = f
+        else:
+            raise VelesError(
+                "cached sampling supports Embedding → [PositionalEmbedding]"
+                " → TransformerBlock* → LMHead chains; found %s"
+                % type(f).__name__)
+    if stem is None or head is None or not blocks:
+        raise VelesError("not a generation stack: stem=%r head=%r "
+                         "blocks=%d" % (stem, head, len(blocks)))
+    return {"stem": stem, "pos_emb": pos_emb, "blocks": blocks,
+            "head": head}
+
+
+def _block_prefill(block, p, x, cache_k, cache_v):
+    """Full-window pass through one block, writing K/V into the caches'
+    first T positions. The attention goes through the SAME per-shape
+    chooser as TransformerBlock.apply (attention_core: f32 softmax,
+    flash kernel above the crossover) so prefill logits cannot drift
+    from the trained forward."""
+    import jax.numpy as jnp
+    from .attention import attention_core
+    from ..ops import matmul_precision
+    prec = matmul_precision()
+    b, t, d = x.shape
+    h = block.n_heads
+
+    def heads(m):
+        return m.reshape(b, t, h, d // h)
+
+    a_in = _layernorm(jnp, x, p["ln1_g"], p["ln1_b"])
+    q = heads(jnp.dot(a_in, p["wq"], precision=prec))
+    k = heads(jnp.dot(a_in, p["wk"], precision=prec))
+    v = heads(jnp.dot(a_in, p["wv"], precision=prec))
+    if block.rope:
+        q, k = _rope(jnp, q), _rope(jnp, k)
+    cache_k = cache_k.at[:, :t].set(k)
+    cache_v = cache_v.at[:, :t].set(v)
+    o = attention_core(q, k, v, causal=True, mesh=None,
+                       n_heads=h).reshape(b, t, d)
+    x = x + jnp.dot(o, p["wo"], precision=prec)
+    f_in = _layernorm(jnp, x, p["ln2_g"], p["ln2_b"])
+    hmid = _gelu(jnp, jnp.dot(f_in, p["w1"], precision=prec) + p["b1"])
+    return x + jnp.dot(hmid, p["w2"], precision=prec) + p["b2"], \
+        cache_k, cache_v
+
+
+def _block_step(block, p, x_t, cache_k, cache_v, pos):
+    """One-token pass: x_t (B, 1, D), caches (B, T_max, H, Dh), pos =
+    tokens already cached. Attention reads the cache rows <= pos."""
+    import jax.numpy as jnp
+    from ..ops import matmul_precision
+    prec = matmul_precision()
+    b, _, d = x_t.shape
+    h = block.n_heads
+    hd = d // h
+
+    def heads(m):
+        return m.reshape(b, 1, h, hd)
+
+    a_in = _layernorm(jnp, x_t, p["ln1_g"], p["ln1_b"])
+    q = heads(jnp.dot(a_in, p["wq"], precision=prec))
+    k = heads(jnp.dot(a_in, p["wk"], precision=prec))
+    v = heads(jnp.dot(a_in, p["wv"], precision=prec))
+    if block.rope:
+        q, k = _rope_at(jnp, q, pos), _rope_at(jnp, k, pos)
+    cache_k = jnp.asarray(cache_k).at[:, pos].set(k[:, 0])
+    cache_v = jnp.asarray(cache_v).at[:, pos].set(v[:, 0])
+    t_max = cache_k.shape[1]
+    # single-row attention over the cache; scores/softmax in f32 like
+    # attention_reference so the step matches the full-window forward
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   cache_k.astype(jnp.float32)) / numpy.sqrt(hd)
+    valid = (jnp.arange(t_max) <= pos)[None, None, None, :]
+    s = jnp.where(valid, s, -1e30)
+    w = jnp.exp(s - s.max(axis=-1, keepdims=True))
+    w = w / w.sum(axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bkhd->bqhd", w,
+                   cache_v.astype(jnp.float32)).astype(x_t.dtype)
+    o = o.reshape(b, 1, d)
+    x_t = x_t + jnp.dot(o, p["wo"], precision=prec)
+    f_in = _layernorm(jnp, x_t, p["ln2_g"], p["ln2_b"])
+    hmid = _gelu(jnp, jnp.dot(f_in, p["w1"], precision=prec) + p["b1"])
+    return x_t + jnp.dot(hmid, p["w2"], precision=prec) + p["b2"], \
+        cache_k, cache_v
+
+
+def _build_sampler(wf, t_p, n_new, temperature):
+    """Compile-once generation program for one (prompt length, n_new,
+    temperature) shape; params are ARGUMENTS (not baked constants), so
+    repeated calls — and continued training between them — reuse the
+    executable."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops import matmul_precision
+    stack = split_stack(list(wf.forwards))
+    stem, pos_emb = stack["stem"], stack["pos_emb"]
+    blocks, head = stack["blocks"], stack["head"]
+    t_max = t_p + int(n_new)
+    d = stem.dim
+    h = blocks[0].n_heads
+    hd = d // h
+    prec = matmul_precision()
+    if pos_emb is not None:
+        table_len = pos_emb.param_arrays()["table"].shape[0]
+        if t_max > table_len:
+            raise VelesError(
+                "generation to %d positions exceeds the trained "
+                "PositionalEmbedding table (%d rows); the real forward "
+                "would fail too — use RoPE blocks for open-ended "
+                "generation" % (t_max, table_len))
+    greedy = temperature <= 0
+
+    def embed(params, ids, pos0):
+        x = jnp.take(params[stem.name]["table"],
+                     ids.astype(jnp.int32), axis=0, mode="clip")
+        if pos_emb is not None:
+            table = params[pos_emb.name]["table"]
+            idx = pos0 + jnp.arange(ids.shape[-1])
+            x = x + jnp.take(table, idx, axis=0, mode="clip")[None]
+        return x
+
+    def sample(logits, key):
+        if greedy:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1).astype(jnp.int32)
+
+    def head_logits(params, x_last):
+        return (jnp.dot(x_last, params[head.name]["weights"],
+                        precision=prec) + params[head.name]["bias"])
+
+    @jax.jit
+    def run(params, prompt_ids, key):
+        x = embed(params, prompt_ids[0], 0)[None]
+        caches = []
+        for blk in blocks:
+            ck = jnp.zeros((1, t_max, h, hd), x.dtype)
+            cv = jnp.zeros((1, t_max, h, hd), x.dtype)
+            x, ck, cv = _block_prefill(blk, params[blk.name], x, ck, cv)
+            caches.append((ck, cv))
+        key, sub = jax.random.split(key)
+        first = sample(head_logits(params, x[:, -1]), sub)[0]
+
+        def step(carry, i):
+            tok, caches, key = carry
+            pos = t_p + i
+            x_t = embed(params, tok[None], pos)[None]
+            new_caches = []
+            for blk, (ck, cv) in zip(blocks, caches):
+                x_t, ck, cv = _block_step(blk, params[blk.name], x_t,
+                                          ck, cv, pos)
+                new_caches.append((ck, cv))
+            key, sub = jax.random.split(key)
+            nxt = sample(head_logits(params, x_t[:, 0]), sub)[0]
+            return (nxt, tuple(new_caches), key), tok
+
+        (_, _, _), toks = jax.lax.scan(
+            step, (first, tuple(caches), key), jnp.arange(n_new))
+        return toks
+
+    return run
+
+
+def generate(wf, prompt, n_new, temperature=1.0, seed=0):
+    """Sample ``n_new`` tokens continuing ``prompt`` (list/array of
+    ids) from a trained Embedding→blocks→LMHead workflow. Prefill runs
+    one full-window pass to warm the caches; generation is one
+    ``lax.scan`` — a single device dispatch end to end.
+    ``temperature <= 0`` = greedy. The compiled program is cached on
+    the workflow per (prompt length, n_new, temperature)."""
+    import jax
+    import jax.numpy as jnp
+    prompt = numpy.asarray(prompt, dtype=numpy.int32)[None, :]
+    t_p = prompt.shape[1]
+    cache = getattr(wf, "_sampler_cache", None)
+    if cache is None:
+        cache = wf._sampler_cache = {}
+    key = (t_p, int(n_new), float(temperature))
+    run = cache.get(key)
+    if run is None:
+        run = cache[key] = _build_sampler(wf, t_p, n_new, temperature)
+    params = {f.name: {k: v.device_view()
+                       for k, v in f.param_arrays().items()}
+              for f in wf.forwards if f.PARAMETERIZED}
+    toks = run(params, jnp.asarray(prompt), jax.random.PRNGKey(seed))
+    return [int(t) for t in numpy.asarray(toks)]
