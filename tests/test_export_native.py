@@ -383,3 +383,23 @@ def test_native_kohonen_rbm_parity(tmp_path):
     out2 = m2(x).reshape(truth.shape)
     numpy.testing.assert_allclose(out2, truth, rtol=2e-3, atol=2e-4)
     m2.close()
+
+
+def test_package_tgz_roundtrip(tmp_path):
+    """The reference exported zip OR tgz (Workflow.package_export,
+    veles/workflow.py:868): the WRITER's .tgz branch must produce an
+    archive the executor round-trips."""
+    from veles_tpu.memory import Array
+    wf = vt.Workflow(name="tgz-wf")
+    fc = nn.All2AllTanh(wf, output_sample_shape=6, name="fc")
+    x = numpy.random.RandomState(0).rand(5, 9).astype(numpy.float32)
+    fc.input = Array(x)
+    fc.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.forwards = [fc]
+    tgz = package_export(wf, str(tmp_path / "net.tgz"),
+                         input_shape=[5, 9], with_stablehlo=False)
+    assert tgz.endswith(".tgz") and os.path.exists(tgz)
+    assert not (tmp_path / "net").exists()      # staging dir cleaned
+    out = run_package(tgz, x)
+    oracle = fc.numpy_apply(fc.params_np(), x)
+    numpy.testing.assert_allclose(out, oracle, rtol=1e-5, atol=1e-6)

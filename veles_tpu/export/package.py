@@ -9,8 +9,9 @@ libVeles/src/main_file_loader.cc / workflow_loader.cc, modernised):
     <pkg>/forward.stablehlo  serialized jax.export artifact of the whole
                             forward chain (portable XLA program)
 
-A package is a plain directory (optionally zipped with .zip suffix for
-transport — the C++ runtime consumes the directory form).
+A package is a plain directory (optionally archived with a .zip or
+.tgz/.tar.gz suffix for transport, like the reference's
+zip-or-tgz export — the C++ runtime consumes the directory form).
 """
 
 from __future__ import annotations
@@ -26,6 +27,43 @@ import numpy
 from ..error import VelesError
 
 FORMAT_VERSION = 1
+
+
+def _write_zip(pkg_dir: str, path: str) -> None:
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        for fname in sorted(os.listdir(pkg_dir)):
+            zf.write(os.path.join(pkg_dir, fname), fname)
+
+
+def _write_tgz(pkg_dir: str, path: str) -> None:
+    import tarfile
+    with tarfile.open(path, "w:gz") as tf:
+        for fname in sorted(os.listdir(pkg_dir)):
+            tf.add(os.path.join(pkg_dir, fname), fname)
+
+
+def _extract_zip(path: str, tmp: str) -> None:
+    with zipfile.ZipFile(path) as zf:
+        zf.extractall(tmp)
+
+
+def _extract_tgz(path: str, tmp: str) -> None:
+    import tarfile
+    with tarfile.open(path) as tf:
+        tf.extractall(tmp, filter="data")
+
+
+#: suffix → (writer, extractor); ONE table drives both export and import
+_ARCHIVES = ((".zip", _write_zip, _extract_zip),
+             (".tgz", _write_tgz, _extract_tgz),
+             (".tar.gz", _write_tgz, _extract_tgz))
+
+
+def _archive_kind(path: str):
+    for suffix, writer, extractor in _ARCHIVES:
+        if path.endswith(suffix):
+            return suffix, writer, extractor
+    return None
 
 #: unit config keys exported per type (subset that defines inference)
 _EXPORT_KEYS = (
@@ -69,8 +107,8 @@ def package_export(workflow, path: str,
     if step is not None and step.params:
         step.sync_params_to_arrays()
 
-    zipped = path.endswith(".zip")
-    pkg_dir = path[:-4] if zipped else path
+    archive = _archive_kind(path)
+    pkg_dir = path[:-len(archive[0])] if archive else path
     os.makedirs(pkg_dir, exist_ok=True)
 
     if input_shape is None:
@@ -97,10 +135,8 @@ def package_export(workflow, path: str,
     with open(os.path.join(pkg_dir, "contents.json"), "w") as fout:
         json.dump(contents, fout, indent=2)
 
-    if zipped:
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-            for fname in sorted(os.listdir(pkg_dir)):
-                zf.write(os.path.join(pkg_dir, fname), fname)
+    if archive:
+        archive[1](pkg_dir, path)
         shutil.rmtree(pkg_dir)
         return path
     return pkg_dir
@@ -135,11 +171,11 @@ def _export_stablehlo(forwards, input_shape, pkg_dir: str) -> str:
 
 def package_import(path: str) -> Dict[str, Any]:
     """Load a package directory/zip → {contents, params{unit:{name:arr}}}."""
-    if path.endswith(".zip"):
+    archive = _archive_kind(path)
+    if archive:
         import tempfile
         tmp = tempfile.mkdtemp(prefix="veles_pkg_")
-        with zipfile.ZipFile(path) as zf:
-            zf.extractall(tmp)
+        archive[2](path, tmp)
         path = tmp
     with open(os.path.join(path, "contents.json")) as fin:
         contents = json.load(fin)
