@@ -305,3 +305,23 @@ def test_mixed_precision_composes_with_remat():
     for tree in wf.train_step.params.values():
         for leaf in tree.values():
             assert leaf.dtype == jnp.float32
+
+
+def test_bf16_dataset_storage_converges():
+    """engine.dataset_dtype='bfloat16': dataset stored/staged at half
+    width (the tunnel/HBM lever for image data); training on the bf16
+    dataset must still converge."""
+    from veles_tpu.config import root
+    from veles_tpu import prng
+    import jax.numpy as jnp
+    prng.seed_all(6)
+    root.common.engine.dataset_dtype = "bfloat16"
+    try:
+        wf = make_workflow()
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        assert wf.loader.original_data.mem.dtype == jnp.bfloat16
+        wf.run()
+    finally:
+        root.common.engine.dataset_dtype = None
+    assert wf.decision.best_metric is not None
+    assert wf.decision.best_metric < 0.06, wf.decision.epoch_metrics

@@ -172,18 +172,26 @@ def _export_stablehlo(forwards, input_shape, pkg_dir: str) -> str:
 def package_import(path: str) -> Dict[str, Any]:
     """Load a package directory/zip → {contents, params{unit:{name:arr}}}."""
     archive = _archive_kind(path)
+    tmp = None
     if archive:
         import tempfile
         tmp = tempfile.mkdtemp(prefix="veles_pkg_")
         archive[2](path, tmp)
         path = tmp
-    with open(os.path.join(path, "contents.json")) as fin:
-        contents = json.load(fin)
-    params: Dict[str, Dict[str, numpy.ndarray]] = {}
-    for unit in contents["units"]:
-        params[unit["name"]] = {
-            pname: numpy.load(os.path.join(path, fname))
-            for pname, fname in unit["params"].items()}
+    try:
+        with open(os.path.join(path, "contents.json")) as fin:
+            contents = json.load(fin)
+        params: Dict[str, Dict[str, numpy.ndarray]] = {}
+        for unit in contents["units"]:
+            params[unit["name"]] = {
+                pname: numpy.load(os.path.join(path, fname))
+                for pname, fname in unit["params"].items()}
+    finally:
+        if tmp is not None:
+            # arrays are loaded into memory above; the extracted copy
+            # would otherwise leak one full model per import
+            shutil.rmtree(tmp, ignore_errors=True)
+            path = os.path.dirname(path)  # dir is gone; report parent
     return {"contents": contents, "params": params, "dir": path}
 
 

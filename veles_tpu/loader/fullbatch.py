@@ -40,10 +40,14 @@ class FullBatchLoader(Loader):
         data = numpy.asarray(data)
         # integer data (token-id sequences for an embedding stem) keeps
         # its dtype — casting ids through a float policy dtype (e.g.
-        # float16) would silently corrupt large ids
+        # float16) would silently corrupt large ids. Float data takes
+        # engine.dataset_dtype when set (bf16 storage halves the
+        # device-resident dataset AND the host->device staging — a real
+        # cost through a tunnelled chip), else the param policy dtype.
         dtype = (data.dtype
                  if numpy.issubdtype(data.dtype, numpy.integer)
-                 else root.common.engine.precision_type)
+                 else (root.common.engine.get("dataset_dtype", None)
+                       or root.common.engine.precision_type))
         self.original_data.reset(numpy.ascontiguousarray(data, dtype=dtype))
         if labels is not None:
             self.original_labels.reset(
@@ -113,11 +117,14 @@ class FullBatchLoaderMSE(FullBatchLoader, LoaderMSE):
         if targets is not None:
             targets = numpy.asarray(targets)
             # integer targets (token sequences for softmax_seq) keep
-            # their dtype; float regression targets get the precision
-            # policy
+            # their dtype; float regression targets follow the SAME
+            # storage policy as the data (dataset_dtype when set) —
+            # targets are pixel-volume arrays in the AE/kanji cases,
+            # half the staging saving lives here
             dtype = (targets.dtype
                      if numpy.issubdtype(targets.dtype, numpy.integer)
-                     else root.common.engine.precision_type)
+                     else (root.common.engine.get("dataset_dtype", None)
+                           or root.common.engine.precision_type))
             self.original_targets.reset(
                 numpy.ascontiguousarray(targets, dtype=dtype))
 
