@@ -171,6 +171,7 @@ def _export_stablehlo(forwards, input_shape, pkg_dir: str) -> str:
 
 def package_import(path: str) -> Dict[str, Any]:
     """Load a package directory/zip → {contents, params{unit:{name:arr}}}."""
+    orig_path = path
     archive = _archive_kind(path)
     tmp = None
     if archive:
@@ -191,7 +192,7 @@ def package_import(path: str) -> Dict[str, Any]:
             # arrays are loaded into memory above; the extracted copy
             # would otherwise leak one full model per import
             shutil.rmtree(tmp, ignore_errors=True)
-            path = os.path.dirname(path)  # dir is gone; report parent
+            path = orig_path     # the archive itself is the package
     return {"contents": contents, "params": params, "dir": path}
 
 

@@ -20,6 +20,18 @@ from ..memory import Array
 from .base import Loader, LoaderMSE, TEST, VALID, TRAIN
 
 
+def _storage_dtype(arr: numpy.ndarray):
+    """Storage dtype policy shared by dataset and MSE-target arrays:
+    integer arrays (token ids) keep their dtype — casting ids through a
+    float policy dtype would silently corrupt large values; float
+    arrays take engine.dataset_dtype when set (bf16 halves device
+    residency and host->device staging), else the param policy dtype."""
+    if numpy.issubdtype(arr.dtype, numpy.integer):
+        return arr.dtype
+    return (root.common.engine.get("dataset_dtype", None)
+            or root.common.engine.precision_type)
+
+
 class FullBatchLoader(Loader):
     """Subclasses fill ``original_data``/``original_labels`` in load_data
     (reference: create_originals, veles/loader/fullbatch.py:278)."""
@@ -38,17 +50,8 @@ class FullBatchLoader(Loader):
     def create_originals(self, data: numpy.ndarray,
                          labels: Optional[numpy.ndarray] = None) -> None:
         data = numpy.asarray(data)
-        # integer data (token-id sequences for an embedding stem) keeps
-        # its dtype — casting ids through a float policy dtype (e.g.
-        # float16) would silently corrupt large ids. Float data takes
-        # engine.dataset_dtype when set (bf16 storage halves the
-        # device-resident dataset AND the host->device staging — a real
-        # cost through a tunnelled chip), else the param policy dtype.
-        dtype = (data.dtype
-                 if numpy.issubdtype(data.dtype, numpy.integer)
-                 else (root.common.engine.get("dataset_dtype", None)
-                       or root.common.engine.precision_type))
-        self.original_data.reset(numpy.ascontiguousarray(data, dtype=dtype))
+        self.original_data.reset(numpy.ascontiguousarray(
+            data, dtype=_storage_dtype(data)))
         if labels is not None:
             self.original_labels.reset(
                 numpy.ascontiguousarray(labels, dtype=numpy.int32))
@@ -116,17 +119,10 @@ class FullBatchLoaderMSE(FullBatchLoader, LoaderMSE):
         super().create_originals(data, labels)
         if targets is not None:
             targets = numpy.asarray(targets)
-            # integer targets (token sequences for softmax_seq) keep
-            # their dtype; float regression targets follow the SAME
-            # storage policy as the data (dataset_dtype when set) —
-            # targets are pixel-volume arrays in the AE/kanji cases,
-            # half the staging saving lives here
-            dtype = (targets.dtype
-                     if numpy.issubdtype(targets.dtype, numpy.integer)
-                     else (root.common.engine.get("dataset_dtype", None)
-                           or root.common.engine.precision_type))
-            self.original_targets.reset(
-                numpy.ascontiguousarray(targets, dtype=dtype))
+            # targets are pixel-volume arrays in the AE/kanji cases —
+            # the same storage policy as the data applies
+            self.original_targets.reset(numpy.ascontiguousarray(
+                targets, dtype=_storage_dtype(targets)))
 
     def create_minibatch_data(self) -> None:
         super().create_minibatch_data()
