@@ -89,6 +89,48 @@ class NumpyDevice(Device):
 _cache_enabled = False
 
 
+def guard_unresponsive_backend(timeout: float = 150.0) -> bool:
+    """Probe device enumeration in a killable SUBPROCESS; pin the CPU
+    platform when it HANGS (a dead tunnel relay blocks in-process
+    jax.devices() forever — observed live 2026-07-30). Fast failures
+    are left alone: they surface as normal exceptions to the caller.
+    Returns True when the guard engaged (CPU pinned). No-op when a
+    platform is already pinned, when jax is already initialized in
+    this process, or under VELES_TPU_NO_PROBE=1."""
+    import subprocess
+    import sys as _sys
+    if os.environ.get("JAX_PLATFORMS") or             os.environ.get("VELES_TPU_NO_PROBE"):
+        return False
+    if "jax" in _sys.modules and getattr(
+            _sys.modules["jax"], "_veles_probe_done", False):
+        return False
+    try:
+        subprocess.run([_sys.executable, "-c",
+                        "import jax; jax.devices()"],
+                       capture_output=True, timeout=timeout)
+        engaged = False
+    except subprocess.TimeoutExpired:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        Logger().warning(
+            "accelerator backend unresponsive after %.0fs (transport "
+            "down?) — pinning JAX_PLATFORMS=cpu so this process cannot "
+            "hang", timeout)
+        engaged = True
+    import jax
+    if engaged:
+        # the env var alone is NOT enough: the tunnelled-TPU plugin
+        # re-writes jax_platforms at registration (veles_tpu/__init__
+        # re-pins from the env only at ITS import, already past here)
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            from jax.extend.backend import clear_backends
+            clear_backends()
+            jax.config.update("jax_platforms", "cpu")
+    jax._veles_probe_done = True
+    return engaged
+
+
 def _enable_compilation_cache(jax) -> None:
     """Persistent compiled-program cache (reference analogue: the
     device-keyed kernel-binary tarballs, veles/accelerated_units.py:
