@@ -281,35 +281,16 @@ def bench_lm(dev, n_chips):
         }
 
 
-def _device_backend_responds(timeout=150.0):
-    """Probe device enumeration in a SUBPROCESS with a hard timeout.
-    When the tunnel relay is dead, in-process jax.devices() HANGS
-    forever (observed 2026-07-30: relay gone → every new client blocks
-    in backend init) — a hung bench is worse than a CPU-stamped one.
-    Only a child process can be killed out of that state."""
-    import subprocess
-    try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True, timeout=timeout)
-        # ANY exit (even an error, e.g. "chip already in use" from a
-        # transient holder) means the backend RESPONDS — only the hang
-        # pins CPU; fast failures fall through to the retry loop that
-        # exists for exactly the transient case
-        return True
-    except subprocess.TimeoutExpired:
-        return False
-
-
 def _acquire_device(retries=6, delay=30.0):
     """The tunnelled TPU is exclusive and occasionally drops; a silent
     CPU fallback would record a bogus headline number, so retry for the
-    real chip and stamp the platform either way."""
-    if not os.environ.get("JAX_PLATFORMS", "").startswith("cpu") \
-            and not _device_backend_responds():
+    real chip and stamp the platform either way. A DEAD transport makes
+    in-process device init hang forever, so the shared liveness guard
+    (killable-subprocess probe) runs first and pins CPU on a hang."""
+    from veles_tpu.backends import guard_unresponsive_backend
+    if guard_unresponsive_backend():
         print("bench: device backend unresponsive (tunnel down?) — "
-              "pinning CPU so the run cannot hang", file=sys.stderr)
-        os.environ["JAX_PLATFORMS"] = "cpu"
+              "pinned CPU so the run cannot hang", file=sys.stderr)
     import veles_tpu as vt
     if os.environ.get("JAX_PLATFORMS", "").startswith("cpu"):
         return vt.Device_for("auto")      # explicit CPU pin: no retries

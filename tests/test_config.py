@@ -83,9 +83,22 @@ def test_get_treats_vivified_husk_as_unset():
     from veles_tpu.config import Config
     c = Config("test")
     assert c.a.b is not None          # vivifies a and a.b
-    assert c.get("a").get("b", "dflt") == "dflt"
+    assert c.get("a", "dflt") == "dflt"   # all-husk subtree = unset
     assert c.a.get("b", 7) == 7
     # a REAL subtree still comes back
     c.a.b.value = 3
     sub = c.a.get("b")
     assert sub is not None and sub.value == 3
+
+
+def test_get_husk_check_recurses():
+    """A chain `if c.a.b.c:` vivifies the whole path; get('b') one
+    level up must treat the all-husk subtree as unset too."""
+    from veles_tpu.config import Config
+    c = Config("test")
+    assert c.a.b.deep is not None       # vivifies a→b→deep
+    assert c.a.get("b", "dflt") == "dflt"
+    assert c.get("a", "dflt") == "dflt"
+    c.a.b.deep.value = 1                # now a real subtree
+    assert c.get("a") is not None
+    assert c.a.get("b").deep.value == 1

@@ -99,16 +99,34 @@ def guard_unresponsive_backend(timeout: float = 150.0) -> bool:
     this process, or under VELES_TPU_NO_PROBE=1."""
     import subprocess
     import sys as _sys
-    if os.environ.get("JAX_PLATFORMS") or             os.environ.get("VELES_TPU_NO_PROBE"):
+    import tempfile
+    import time as _time
+    if os.environ.get("JAX_PLATFORMS") or \
+            os.environ.get("VELES_TPU_NO_PROBE"):
         return False
     if "jax" in _sys.modules and getattr(
             _sys.modules["jax"], "_veles_probe_done", False):
         return False
+    # a fresh last-good stamp skips the probe: the child pays a full
+    # backend init (seconds + a transient claim on an exclusive chip),
+    # too costly on EVERY healthy launch
+    stamp = os.path.join(tempfile.gettempdir(),
+                         "veles_tpu_backend_ok_%d" % os.getuid())
+    try:
+        if _time.time() - os.path.getmtime(stamp) < 600:
+            return False
+    except OSError:
+        pass
     try:
         subprocess.run([_sys.executable, "-c",
                         "import jax; jax.devices()"],
                        capture_output=True, timeout=timeout)
         engaged = False
+        try:
+            with open(stamp, "w"):
+                pass
+        except OSError:
+            pass
     except subprocess.TimeoutExpired:
         os.environ["JAX_PLATFORMS"] = "cpu"
         Logger().warning(

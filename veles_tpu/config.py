@@ -55,17 +55,24 @@ class Config:
     def __contains__(self, name: str) -> bool:
         return name in self.__dict__ and not name.endswith("_")
 
+    def _is_husk(self) -> bool:
+        """True when this node holds NOTHING but (recursively) empty
+        Config children — the shape mere reads auto-vivify."""
+        for _k, v in self.items():
+            if not (isinstance(v, Config) and v._is_husk()):
+                return False
+        return True
+
     def get(self, name: str, default: Any = None) -> Any:
-        """Like dict.get — and an EMPTY child node counts as unset.
-        ``__getattr__`` auto-vivifies (truthy) nodes on mere reads, so
-        ``if root.x.y:`` creates ``y``; without this rule every later
-        ``get`` would see that husk and return it instead of the
-        default (the footgun guards in train_step/publishing existed
-        for exactly this)."""
+        """Like dict.get — and a node vivified by mere READS counts as
+        unset. ``__getattr__`` auto-vivifies (truthy) nodes, so
+        ``if root.x.y.z:`` creates the whole x→y→z chain; the husk test
+        recurses, or ``get("y")`` one level up would still hand back
+        the all-husk subtree (the footgun guards in
+        train_step/publishing existed for exactly this)."""
         if name in self:
             val = self.__dict__[name]
-            if isinstance(val, Config) and not any(True
-                                                   for _ in val.items()):
+            if isinstance(val, Config) and val._is_husk():
                 return default
             return val
         return default

@@ -170,8 +170,10 @@ def _export_stablehlo(forwards, input_shape, pkg_dir: str) -> str:
 
 
 def package_import(path: str) -> Dict[str, Any]:
-    """Load a package directory/zip → {contents, params{unit:{name:arr}}}."""
-    orig_path = path
+    """Load a package directory/archive → {contents, params, dir}.
+    ``dir`` is the readable package directory — ``None`` for archive
+    imports (the extraction tempdir is removed once the arrays are in
+    memory; unpack manually if the stablehlo artifact is needed)."""
     archive = _archive_kind(path)
     tmp = None
     if archive:
@@ -192,7 +194,7 @@ def package_import(path: str) -> Dict[str, Any]:
             # arrays are loaded into memory above; the extracted copy
             # would otherwise leak one full model per import
             shutil.rmtree(tmp, ignore_errors=True)
-            path = orig_path     # the archive itself is the package
+            path = None          # no readable dir remains
     return {"contents": contents, "params": params, "dir": path}
 
 

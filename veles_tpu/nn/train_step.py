@@ -798,7 +798,8 @@ class TrainStep(AcceleratedUnit):
         for k in ("params", "opt_state", "_accum", "_zero_accum",
                   "last_loss", "_pp", "_block_metrics",
                   "_eval_plan_dev"):
-            d[k] = {} if k in ("params", "opt_state", "_accum") else None
+            d[k] = ({} if k in ("params", "opt_state", "_accum",
+                                "_eval_plan_dev") else None)
         d["param_masks"] = {
             n: {k: numpy.asarray(m) for k, m in ms.items()}
             for n, ms in self.param_masks.items()}
