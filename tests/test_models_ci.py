@@ -210,18 +210,37 @@ def test_stl10_converges():
 
 
 def test_bench_workflow_builds(monkeypatch):
-    """The compute-bound bench surface (bench.py's second metric) must
-    keep building and running one dispatch — a regression here silently
-    kills the driver's headline number."""
-    ae = _import_model("imagenet_ae")
-    wf = ae.build_bench_workflow(image_size=16, minibatch_size=8,
-                                 n_train=32, n_valid=8)
-    wf.initialize(device=_dev())
-    loader = wf.loader
-    assert loader.total_samples == 40
-    # one host-side dispatch, not a full run (max_epochs is huge)
-    wf.loader.run()
-    wf.train_step.run()
+    """The compute-bound bench surface (bench.py extras[0]) must keep
+    building and running a WHOLE epoch under the exact bench knobs
+    (mixed_precision + bf16 dataset). One dispatch is not enough: the
+    epoch's first dispatch is the VALID eval — an AMP regression in the
+    conv/deconv TRAIN grad shipped invisibly behind a single-dispatch
+    gate once (preferred_element_type f32 broke the conv transpose rule
+    on bf16 operands)."""
+    from veles_tpu.config import root
+    root.common.engine.mixed_precision = True
+    root.common.engine.dataset_dtype = "bfloat16"
+    try:
+        ae = _import_model("imagenet_ae")
+        wf = ae.build_bench_workflow(image_size=16, minibatch_size=8,
+                                     n_train=32, n_valid=8)
+        wf.initialize(device=_dev())
+        loader = wf.loader
+        assert loader.total_samples == 40
+        assert wf.train_step.mixed_precision
+        # a full epoch: the valid-eval dispatch AND the train dispatch
+        served0 = loader.samples_served
+        while True:
+            loader.run()
+            wf.train_step.run()
+            if bool(loader.epoch_ended):
+                break
+        assert loader.samples_served - served0 == 40
+        import jax
+        jax.block_until_ready(wf.train_step.params)
+    finally:
+        root.common.engine.mixed_precision = False
+        root.common.engine.dataset_dtype = None
     assert wf.train_step.params
 
 

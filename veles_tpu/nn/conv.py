@@ -72,13 +72,18 @@ class Conv(ForwardBase):
         from ..ops.precision import promote_operands
         sx, sy = self.sliding
         xx, ww, ct = promote_operands(x, params["weights"])
+        # f32 result only for f32 operands: for bf16 (AMP) the MXU
+        # still accumulates f32 in hardware, and requesting an f32
+        # RESULT breaks the conv transpose rule (f32 cotangent meets
+        # bf16 operands in the VJP — TypeError at grad time)
+        pref = jnp.float32 if ct == jnp.float32 else None
         y = jax.lax.conv_general_dilated(
             xx, ww,
             window_strides=(sy, sx),
             padding=self._pad_hw(),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             precision=matmul_precision(),
-            preferred_element_type=jnp.float32)  # f32 MXU accumulation
+            preferred_element_type=pref)
         if "bias" in params:
             y = y + params["bias"]
         return y.astype(ct)

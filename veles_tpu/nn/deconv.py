@@ -67,6 +67,9 @@ class Deconv(ForwardBase):
         # Kernel spatially flipped: conv_transpose cross-correlates, deconv
         # stamps. Precision (not dtype casts) steers the MXU.
         xx, ww, ct = promote_operands(x, params["weights"][::-1, ::-1])
+        # see Conv._conv: f32 result only for f32 operands — an f32
+        # RESULT on bf16 operands breaks the transpose rule at grad time
+        pref = jnp.float32 if ct == jnp.float32 else None
         y = jax.lax.conv_transpose(
             xx, ww,
             strides=(sy, sx),
@@ -74,7 +77,7 @@ class Deconv(ForwardBase):
                      (self.kx - 1 - left, self.kx - 1 - right)),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
             precision=matmul_precision(),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=pref)
         if "bias" in params:
             y = y + params["bias"]
         return y.astype(ct)
