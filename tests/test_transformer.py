@@ -251,3 +251,23 @@ def test_cached_generation_rejects_non_lm_stack():
     wf = type("WF", (), {"forwards": [FakeUnit()]})()
     with pytest.raises(VelesError):
         sampling.generate(wf, [1, 2, 3], 4)
+
+
+def test_cached_generation_batched():
+    """A batch of equal-length prompts decodes in ONE dispatch; each
+    row must equal its own single-prompt greedy generation."""
+    from conftest import import_model
+    lm = import_model("char_lm")
+    prng.seed_all(1234)
+    wf = lm.build_workflow(epochs=3, minibatch_size=64, n_blocks=2,
+                           dim=32, n_train=512, n_valid=128)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    rng = numpy.random.RandomState(5)
+    prompts = [list(lm.make_corpus(rng, 12)) for _ in range(3)]
+    from veles_tpu.nn import sampling
+    batch_out = sampling.generate(wf, prompts, 10, temperature=0)
+    assert len(batch_out) == 3 and all(len(r) == 10 for r in batch_out)
+    for p, row in zip(prompts, batch_out):
+        single = sampling.generate(wf, p, 10, temperature=0)
+        assert row == single, (row, single)

@@ -189,56 +189,70 @@ def _build_sampler(wf, t_p, n_new, temperature):
 
     @jax.jit
     def run(params, prompt_ids, key):
-        x = embed(params, prompt_ids[0], 0)[None]
+        b = prompt_ids.shape[0]
+        x = embed(params, prompt_ids, 0)       # (B, T_p, D)
         caches = []
         for blk in blocks:
-            ck = jnp.zeros((1, t_max, h, hd), x.dtype)
-            cv = jnp.zeros((1, t_max, h, hd), x.dtype)
+            ck = jnp.zeros((b, t_max, h, hd), x.dtype)
+            cv = jnp.zeros((b, t_max, h, hd), x.dtype)
             x, ck, cv = _block_prefill(blk, params[blk.name], x, ck, cv)
             caches.append((ck, cv))
         key, sub = jax.random.split(key)
-        first = sample(head_logits(params, x[:, -1]), sub)[0]
+        first = sample(head_logits(params, x[:, -1]), sub)   # (B,)
 
         def step(carry, i):
             tok, caches, key = carry
             pos = t_p + i
-            x_t = embed(params, tok[None], pos)[None]
+            x_t = embed(params, tok[:, None], pos)   # (B, 1, D)
             new_caches = []
             for blk, (ck, cv) in zip(blocks, caches):
                 x_t, ck, cv = _block_step(blk, params[blk.name], x_t,
                                           ck, cv, pos)
                 new_caches.append((ck, cv))
             key, sub = jax.random.split(key)
-            nxt = sample(head_logits(params, x_t[:, 0]), sub)[0]
+            nxt = sample(head_logits(params, x_t[:, 0]), sub)
             return (nxt, tuple(new_caches), key), tok
 
         (_, _, _), toks = jax.lax.scan(
             step, (first, tuple(caches), key), jnp.arange(n_new))
-        return toks
+        return toks                                  # (n_new, B)
 
     return run
 
 
 def generate(wf, prompt, n_new, temperature=1.0, seed=0):
-    """Sample ``n_new`` tokens continuing ``prompt`` (list/array of
-    ids) from a trained Embedding→blocks→LMHead workflow. Prefill runs
-    one full-window pass to warm the caches; generation is one
-    ``lax.scan`` — a single device dispatch end to end.
-    ``temperature <= 0`` = greedy. The compiled program is cached on
-    the workflow per (prompt length, n_new, temperature)."""
+    """Sample ``n_new`` tokens continuing ``prompt`` from a trained
+    Embedding→blocks→LMHead workflow. ``prompt`` is a list of ids (→
+    returns a flat token list) or a batch of B equal-length prompts (→
+    returns B lists; the whole batch decodes in the same single
+    dispatch). Prefill warms the caches in one full-window pass;
+    generation is one ``lax.scan``. ``temperature <= 0`` = greedy.
+    Compiled programs cache per (batch, prompt length, n_new,
+    temperature)."""
     import jax
     import jax.numpy as jnp
-    prompt = numpy.asarray(prompt, dtype=numpy.int32)[None, :]
+    try:
+        prompt = numpy.asarray(prompt, dtype=numpy.int32)
+    except ValueError as e:
+        raise VelesError(
+            "batched generation needs EQUAL-length prompts (pad or "
+            "group by length): %s" % e) from e
+    batched = prompt.ndim == 2
+    if not batched:
+        prompt = prompt[None, :]
     t_p = prompt.shape[1]
     cache = getattr(wf, "_sampler_cache", None)
     if cache is None:
         cache = wf._sampler_cache = {}
-    key = (t_p, int(n_new), float(temperature))
+    key = (prompt.shape[0], t_p, int(n_new), float(temperature))
     run = cache.get(key)
     if run is None:
         run = cache[key] = _build_sampler(wf, t_p, n_new, temperature)
     params = {f.name: {k: v.device_view()
                        for k, v in f.param_arrays().items()}
               for f in wf.forwards if f.PARAMETERIZED}
-    toks = run(params, jnp.asarray(prompt), jax.random.PRNGKey(seed))
-    return [int(t) for t in numpy.asarray(toks)]
+    toks = numpy.asarray(
+        run(params, jnp.asarray(prompt), jax.random.PRNGKey(seed)))
+    if not batched:
+        return [int(t) for t in toks[:, 0]]
+    return [[int(t) for t in toks[:, i]] for i in range(toks.shape[1])]
