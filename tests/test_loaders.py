@@ -406,3 +406,95 @@ def test_lmdb_record_codec():
     out, label = decode_record(rec)
     assert label == -12
     numpy.testing.assert_array_equal(out, sample)
+
+
+def test_text_file_loader_char_lm(tmp_path):
+    """TextFileLoader: real text file → char-id windows with shifted
+    targets; a char-LM stack trains on it unchanged and beats the
+    uniform-vocab entropy (the text is highly repetitive)."""
+    import jax
+    from veles_tpu.loader import TextFileLoader
+    from veles_tpu import nn, prng
+    text = ("the quick brown fox jumps over the lazy dog. " * 120)
+    p = tmp_path / "corpus.txt"
+    p.write_text(text)
+    prng.seed_all(11)
+    loader = TextFileLoader(None, files=[str(p)], seq_len=32,
+                            minibatch_size=16, name="text")
+    wf = nn.StandardWorkflow(
+        name="text-lm",
+        layers=[{"type": "embedding", "vocab_size": 64, "dim": 24,
+                 "solver": "adam", "learning_rate": 0.01},
+                {"type": "transformer_block", "n_heads": 4,
+                 "ffn_hidden": 48, "causal": True, "rope": True,
+                 "solver": "adam", "learning_rate": 0.01},
+                {"type": "lm_head", "vocab_size": 64,
+                 "solver": "adam", "learning_rate": 0.01}],
+        loader_unit=loader, loss_function="softmax_seq",
+        decision_config=dict(max_epochs=4, fail_iterations=50))
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    assert loader.vocab_size < 32       # a-z + punctuation + space
+    # round-trip encode/decode
+    assert loader.decode(loader.encode("the fox")) == "the fox"
+    wf.run()
+    res = wf.gather_results()
+    # per-token error: the corpus is a repeated sentence — a working
+    # LM path gets far below the ~0.96 uniform-chance error
+    assert res["best_err"] < 0.35, res
+    # validation windows came from the tail, train from the head
+    assert loader.class_lengths[1] > 0
+
+
+def test_text_file_loader_guards(tmp_path):
+    from veles_tpu.error import VelesError
+    from veles_tpu.loader import TextFileLoader
+    with pytest.raises(VelesError):
+        TextFileLoader(None, files=[], minibatch_size=4)
+    p = tmp_path / "tiny.txt"
+    p.write_text("abc")
+    loader = TextFileLoader(None, files=[str(p)], seq_len=128,
+                            minibatch_size=4, name="tiny")
+    with pytest.raises(VelesError):
+        loader.load_data()
+    with pytest.raises(VelesError):
+        TextFileLoader(None, files=[str(tmp_path / "missing.txt")],
+                       minibatch_size=4, name="m").load_data()
+
+
+def test_text_loader_window_accounting(tmp_path):
+    """Exactly the right windows: the last valid start is included, and
+    an overlapping-stride split drops the straddling windows so train
+    and validation never share text."""
+    from veles_tpu.loader import TextFileLoader
+    p = tmp_path / "t.txt"
+    p.write_text("abcdefghi")            # 9 chars
+    ld = TextFileLoader(None, files=[str(p)], seq_len=4, stride=4,
+                        validation_ratio=0.0, minibatch_size=2,
+                        name="w")
+    ld.load_data()
+    assert ld.class_lengths == [0, 0, 2]   # starts 0 AND 4 both served
+
+    # oversampling mode: distinct chars let us read window offsets
+    # back out of the ids and assert the no-shared-text invariant
+    p2 = tmp_path / "t2.txt"
+    alphabet = "".join(chr(33 + (i % 90)) for i in range(400))
+    p2.write_text(alphabet)
+    ld2 = TextFileLoader(None, files=[str(p2)], seq_len=32, stride=8,
+                         validation_ratio=0.2, minibatch_size=4,
+                         name="w2")
+    ld2.load_data()
+    n_train, n_valid = ld2.class_lengths[2], ld2.class_lengths[1]
+    assert n_valid > 0
+    seq = ld2.original_data.mem
+    starts = numpy.arange(0, 400 - 32, 8)
+    # rows are [valid (corpus tail) | train]; recover each row's corpus
+    # offset via its first char id (vocab is sorted, corpus cycles with
+    # period 90 > nothing here exceeds 400 distinct positions? period
+    # 90 repeats — instead recover offsets from the window id pattern)
+    # train windows are starts[:n_train]; the first valid window must
+    # begin AFTER the last train window's final (target) character:
+    last_train_end = starts[n_train - 1] + 32 + 1     # exclusive
+    first_valid_start = int(
+        starts[n_train:][len(starts) - n_train - n_valid])
+    assert first_valid_start >= last_train_end, (
+        first_valid_start, last_train_end)

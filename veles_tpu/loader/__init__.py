@@ -24,3 +24,4 @@ from .stream import (StreamLoader, InteractiveLoader,  # noqa: F401
 from .ensemble import EnsembleLoader                   # noqa: F401
 from .sound import SoundFileLoader, decode_audio       # noqa: F401
 from .kv_store import LMDBLoader, HDFSTextLoader       # noqa: F401
+from .text import TextFileLoader                       # noqa: F401

@@ -1,0 +1,120 @@
+"""Character-level text loader for language-model workflows.
+
+New capability vs the reference (2015 VELES had no text pipeline at
+all; the closest was the per-format family of SURVEY.md §2.3):
+``TextFileLoader`` reads plain text files, builds (or accepts) a
+character vocabulary, and serves fixed-length windows of token ids
+with shifted next-token targets — exactly the contract
+``loss_function="softmax_seq"`` + ``Embedding``/``LMHead`` consume
+(models/char_lm.py trains on it unchanged by passing
+``loader_unit=TextFileLoader(...)``).
+
+Windows are non-overlapping by default (``stride = seq_len``); a
+smaller stride oversamples long documents. The validation split is
+carved from the TAIL of the corpus so train/valid never share text.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy
+
+from ..error import VelesError
+from .fullbatch import FullBatchLoaderMSE
+
+
+class TextFileLoader(FullBatchLoaderMSE):
+    """``files``: text file paths (concatenated in order). ``vocab``:
+    optional explicit string of characters (index = id); by default the
+    vocabulary is every distinct character in the corpus, sorted.
+    Characters outside the vocabulary map to id 0."""
+
+    MAPPING = "text_loader"
+
+    def __init__(self, workflow, files: Sequence[str] = (),
+                 seq_len: int = 128, stride: Optional[int] = None,
+                 vocab: Optional[str] = None,
+                 validation_ratio: float = 0.1, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        if not files:
+            raise VelesError("TextFileLoader needs at least one file")
+        self.files = list(files)
+        self.seq_len = int(seq_len)
+        self.stride = int(stride) if stride else self.seq_len
+        self.vocab: Optional[str] = vocab
+        self.char_to_id: Dict[str, int] = {}
+        self.text_validation_ratio = float(validation_ratio)
+
+    # -- vocabulary ----------------------------------------------------------
+    def encode(self, text: str) -> numpy.ndarray:
+        table = self.char_to_id
+        return numpy.fromiter((table.get(c, 0) for c in text),
+                              dtype=numpy.int32, count=len(text))
+
+    def decode(self, ids) -> str:
+        if not self.vocab:
+            raise VelesError("decode before load_data: no vocabulary yet")
+        return "".join(self.vocab[i] if 0 <= i < len(self.vocab) else "?"
+                       for i in numpy.asarray(ids).ravel())
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self.vocab or "")
+
+    # -- loader contract -----------------------------------------------------
+    def load_data(self) -> None:
+        corpus_parts: List[str] = []
+        for path in self.files:
+            if not os.path.exists(path):
+                raise VelesError("text file missing: %s" % path)
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                corpus_parts.append(f.read())
+        corpus = "".join(corpus_parts)
+        if len(corpus) < self.seq_len + 1:
+            raise VelesError(
+                "corpus of %d chars cannot fill one %d-char window"
+                % (len(corpus), self.seq_len))
+        if self.vocab is None:
+            self.vocab = "".join(sorted(set(corpus)))
+        self.char_to_id = {c: i for i, c in enumerate(self.vocab)}
+        ids = self.encode(corpus)
+
+        # a window at s consumes ids[s : s+seq_len+1] (input + shifted
+        # target), so the last valid start is len - seq_len - 1 —
+        # arange's stop is exclusive, hence - seq_len
+        starts = numpy.arange(0, len(ids) - self.seq_len, self.stride)
+        n = len(starts)
+        n_valid = int(round(n * self.text_validation_ratio))
+        n_train = n - n_valid
+        if n_valid and self.stride < self.seq_len + 1:
+            # overlapping windows share text across the split boundary
+            # (a window at s covers ids[s : s+seq_len+1] including the
+            # shifted target): drop the straddling VALID-side windows
+            # until first_valid_start >= last_train_end, so
+            # 'train/valid never share text' stays true in
+            # oversampling mode
+            gap = max(0, -(-(self.seq_len + 1 - self.stride)
+                           // self.stride))
+            gap = min(gap, n_valid)
+            keep = numpy.ones(n, dtype=bool)
+            keep[n_train:n_train + gap] = False
+            starts = starts[keep]
+            n = len(starts)
+            n_valid = n - n_train
+        if n_train <= 0:
+            raise VelesError("validation_ratio %.2f leaves no training "
+                             "windows (%d total)"
+                             % (self.text_validation_ratio, n))
+        x = numpy.stack([ids[s:s + self.seq_len] for s in starts])
+        y = numpy.stack([ids[s + 1:s + self.seq_len + 1]
+                         for s in starts])
+        # validation = the corpus TAIL: no shared text with train
+        order = numpy.concatenate([numpy.arange(n_train, n),
+                                   numpy.arange(n_train)])
+        self.create_originals(x[order], None, targets=y[order])
+        self.class_lengths = [0, n_valid, n_train]
+        self.info("%s: %d chars, vocab %d, %d windows of %d "
+                  "(%d train / %d valid)", self.name, len(corpus),
+                  self.vocab_size, n, self.seq_len, n_train, n_valid)
