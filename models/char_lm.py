@@ -63,16 +63,35 @@ class CharLMLoader(FullBatchLoaderMSE):
 
 
 def build_workflow(epochs=10, minibatch_size=64, lr=0.003, n_blocks=2,
-                   dim=32, n_train=1536, n_valid=256):
-    loader = CharLMLoader(None, n_train=n_train, n_valid=n_valid,
-                          minibatch_size=minibatch_size, name="chars")
-    layers = ([{"type": "embedding", "vocab_size": VOCAB, "dim": dim,
+                   dim=32, n_train=1536, n_valid=256, text_file=None,
+                   seq_len=SEQ_LEN):
+    """``text_file``: train on a real text file via TextFileLoader
+    (vocab sized to the corpus) instead of the generated grammar."""
+    if text_file:
+        from veles_tpu.loader import TextFileLoader
+        # one cheap scan for the vocabulary (embedding/head sizes need
+        # it BEFORE the loader's load_data runs at initialize); passing
+        # it back in pins the loader to the same table
+        with open(text_file, "r", encoding="utf-8",
+                  errors="replace") as f:
+            chars = "".join(sorted(set(f.read())))
+        loader = TextFileLoader(None, files=[text_file],
+                                seq_len=seq_len, vocab=chars,
+                                minibatch_size=minibatch_size,
+                                name="chars")
+        vocab = len(chars)
+    else:
+        loader = CharLMLoader(None, n_train=n_train, n_valid=n_valid,
+                              minibatch_size=minibatch_size,
+                              name="chars")
+        vocab = VOCAB
+    layers = ([{"type": "embedding", "vocab_size": vocab, "dim": dim,
                 "solver": "adam", "learning_rate": lr}]
               + [{"type": "transformer_block", "n_heads": 4,
                   "ffn_hidden": 2 * dim, "causal": True, "rope": True,
                   "solver": "adam", "learning_rate": lr,
                   "name": "blk%d" % i} for i in range(n_blocks)]
-              + [{"type": "lm_head", "vocab_size": VOCAB,
+              + [{"type": "lm_head", "vocab_size": vocab,
                   "solver": "adam", "learning_rate": lr}])
     wf = nn.StandardWorkflow(
         name="char-lm", layers=layers, loader_unit=loader,
@@ -183,10 +202,14 @@ def main(argv=None):
     p.add_argument("--blocks", type=int, default=2)
     p.add_argument("--sample", type=int, default=48,
                    help="tokens to sample after training (0 = skip)")
+    p.add_argument("--text", default=None, metavar="FILE",
+                   help="train on a real text file (TextFileLoader) "
+                        "instead of the generated grammar")
     p.add_argument("--backend", default="auto")
     args = p.parse_args(argv)
 
-    wf = build_workflow(args.epochs, args.mb, args.lr, args.blocks)
+    wf = build_workflow(args.epochs, args.mb, args.lr, args.blocks,
+                        text_file=args.text)
     wf.initialize(device=vt.Device_for(args.backend))
     t0 = time.time()
     wf.run()
@@ -197,8 +220,19 @@ def main(argv=None):
     print("throughput: %.0f samples/sec" %
           (wf.loader.samples_served / dt))
     if args.sample:
-        toks = generate(wf, [0, 1, 2], args.sample, temperature=0.8)
-        print("sample:", " ".join(str(t) for t in toks))
+        loader = wf.loader
+        if args.text:
+            # prompt with text that EXISTS in the corpus vocabulary —
+            # encode() maps unknown chars to id 0, which would prompt
+            # the model with something other than what we print
+            seed_text = loader.decode(
+                loader.original_data.mem[0][:8])
+            prompt = list(loader.encode(seed_text))
+            toks = generate(wf, prompt, args.sample, temperature=0.8)
+            print("sample: %r" % loader.decode(prompt + toks))
+        else:
+            toks = generate(wf, [0, 1, 2], args.sample, temperature=0.8)
+            print("sample:", " ".join(str(t) for t in toks))
     return res
 
 
