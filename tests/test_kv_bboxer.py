@@ -96,3 +96,52 @@ def test_bboxer_save_is_atomic(tmp_path):
     snap = server.boxes_copy()
     snap["a.png"].append("mutation")     # copies, not aliases
     assert server.count("a.png") == 1
+
+
+def test_hdfs_loader_against_stub_namenode():
+    """The WebHDFS path proven end to end against a local stub namenode
+    (in-process-loopback policy, like the forge/confluence stubs): OPEN
+    requests serve TSV splits — including through the 307
+    namenode→datanode redirect real clusters answer with — and the
+    loader builds its three sample classes from them."""
+    import threading
+    from http.server import BaseHTTPRequestHandler
+    from veles_tpu._http import HTTPService, bytes_reply
+
+    train = "".join("%f\t%f\t%d\n" % (i * 0.1, 1 - i * 0.1, i % 2)
+                    for i in range(8))
+    valid = "0.5\t0.5\t0\n0.25\t0.75\t1\n"
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path.startswith("/webhdfs/v1/data/train.tsv"):
+                # real namenodes 307-redirect OPEN to a datanode;
+                # urllib must follow it transparently
+                self.send_response(307)
+                self.send_header(
+                    "Location",
+                    "http://127.0.0.1:%d/datanode/train" % svc.port)
+                self.end_headers()
+            elif self.path.startswith("/datanode/train"):
+                bytes_reply(self, 200, train.encode(), "text/plain")
+            elif self.path.startswith("/webhdfs/v1/data/valid.tsv"):
+                bytes_reply(self, 200, valid.encode(), "text/plain")
+            else:
+                bytes_reply(self, 404, b"nope", "text/plain")
+
+        def log_message(self, *a):
+            pass
+
+    svc = HTTPService(Handler, thread_name="stub-namenode")
+    svc.start_serving()
+    try:
+        loader = HDFSTextLoader(
+            None, namenode="http://127.0.0.1:%d" % svc.port,
+            paths=[None, "/data/valid.tsv", "/data/train.tsv"],
+            minibatch_size=4, name="hdfs")
+        loader.load_data()
+        assert loader.class_lengths == [0, 2, 8]
+        assert loader.original_data.shape == (10, 2)
+        assert set(numpy.unique(loader.original_labels.mem)) == {0, 1}
+    finally:
+        svc.stop_serving()
