@@ -34,12 +34,15 @@ class BlobsLoader(FullBatchLoader):
 
 
 def _run(n_devices=None, epochs=6, mesh_axes=None, n_classes=3,
-         check_sharding=None):
+         check_sharding=None, layers=None, watch_param="weights"):
     """One seeded blobs training run under the given mesh; the shared
     body of every equivalence test in this module. check_sharding, if
-    given, receives the first layer's param sharding BEFORE the run —
-    tests must assert the axis actually engaged, or they pass vacuously
-    when a mesh regression silently falls back to replication."""
+    given, receives the first layer's watched-param sharding BEFORE the
+    run — tests must assert the axis actually engaged, or they pass
+    vacuously when a mesh regression silently falls back to
+    replication. ``layers`` overrides the 2-layer FC stack (EP uses a
+    MoE layer); ``watch_param`` names the first layer's param to
+    check/extract."""
     if mesh_axes is None:
         mesh_axes = {"data": n_devices}
     prng.seed_all(1234)
@@ -47,7 +50,7 @@ def _run(n_devices=None, epochs=6, mesh_axes=None, n_classes=3,
     wf = nn.StandardWorkflow(
         name="eq-%s" % "x".join("%s%d" % kv for kv in
                                 sorted(mesh_axes.items())),
-        layers=[
+        layers=layers or [
             {"type": "all2all_tanh", "output_sample_shape": 16},
             {"type": "softmax", "output_sample_shape": n_classes},
         ],
@@ -57,7 +60,7 @@ def _run(n_devices=None, epochs=6, mesh_axes=None, n_classes=3,
     wf.initialize(device=vt.XLADevice(mesh_axes=mesh_axes))
     if check_sharding is not None:
         check_sharding(
-            wf.train_step.params[wf.forwards[0].name]["weights"]
+            wf.train_step.params[wf.forwards[0].name][watch_param]
             .sharding)
     wf.run()
     d = wf.decision
@@ -67,7 +70,7 @@ def _run(n_devices=None, epochs=6, mesh_axes=None, n_classes=3,
         "valid_err": numpy.asarray(d.epoch_metrics[VALID]),
         "weights": numpy.asarray(
             jax.device_get(wf.train_step.params[wf.forwards[0].name]
-                           ["weights"])),
+                           [watch_param])),
     }
 
 
@@ -239,6 +242,27 @@ def test_tensor_parallel_matches_replicated():
     for axes in ({"tensor": 4}, {"data": 2, "tensor": 4}):
         r = _run(mesh_axes=axes, epochs=4, n_classes=4,
                  check_sharding=column_split)
+        numpy.testing.assert_allclose(r["train_err"],
+                                      base["train_err"], atol=0.01)
+        numpy.testing.assert_allclose(r["weights"], base["weights"],
+                                      rtol=2e-3, atol=2e-4)
+
+
+def test_expert_parallel_matches_replicated():
+    """{'expert': 4}: MoE expert-leading params shard over the axis and
+    GSPMD partitions the expert einsums — placement, not math, so the
+    run must match the replicated one exactly (completing the
+    per-axis equivalence matrix: dp / tp / fsdp / sp / ep)."""
+    moe = [{"type": "moe_ffn", "n_experts": 4, "hidden": 16},
+           {"type": "softmax", "output_sample_shape": 3}]
+    base = _run(1, epochs=4, layers=moe, watch_param="w1")
+
+    def expert_sharded(sh):
+        assert sh.spec[0] == "expert", sh
+
+    for axes in ({"expert": 4}, {"data": 2, "expert": 4}):
+        r = _run(mesh_axes=axes, epochs=4, layers=moe,
+                 watch_param="w1", check_sharding=expert_sharded)
         numpy.testing.assert_allclose(r["train_err"],
                                       base["train_err"], atol=0.01)
         numpy.testing.assert_allclose(r["weights"], base["weights"],

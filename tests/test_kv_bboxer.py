@@ -104,7 +104,6 @@ def test_hdfs_loader_against_stub_namenode():
     requests serve TSV splits — including through the 307
     namenode→datanode redirect real clusters answer with — and the
     loader builds its three sample classes from them."""
-    import threading
     from http.server import BaseHTTPRequestHandler
     from veles_tpu._http import HTTPService, bytes_reply
 
