@@ -101,7 +101,11 @@ def guard_unresponsive_backend(timeout: float = 150.0) -> bool:
     import sys as _sys
     import tempfile
     import time as _time
-    if os.environ.get("JAX_PLATFORMS") or \
+    # only a HOST pin makes probing redundant: an accelerator pin
+    # (this rig exports JAX_PLATFORMS=axon globally) carries the exact
+    # hang risk the guard exists for — round 2's guard skipped on it
+    # and the bench slow-failed for 25 minutes in-process
+    if os.environ.get("JAX_PLATFORMS", "").startswith("cpu") or \
             os.environ.get("VELES_TPU_NO_PROBE"):
         return False
     if "jax" in _sys.modules and getattr(
@@ -117,23 +121,37 @@ def guard_unresponsive_backend(timeout: float = 150.0) -> bool:
             return False
     except OSError:
         pass
-    try:
-        subprocess.run([_sys.executable, "-c",
-                        "import jax; jax.devices()"],
-                       capture_output=True, timeout=timeout)
-        engaged = False
+    engaged = False
+    for probe_round in range(2):
         try:
-            with open(stamp, "w"):
-                pass
-        except OSError:
-            pass
-    except subprocess.TimeoutExpired:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        Logger().warning(
-            "accelerator backend unresponsive after %.0fs (transport "
-            "down?) — pinning JAX_PLATFORMS=cpu so this process cannot "
-            "hang", timeout)
-        engaged = True
+            proc = subprocess.run([_sys.executable, "-c",
+                                   "import jax; jax.devices()"],
+                                  capture_output=True, timeout=timeout)
+            # the stamp means "backend KNOWN GOOD"; a fast nonzero exit
+            # is a failure, not health — stamping it would advertise a
+            # broken backend for 10 minutes
+            if proc.returncode == 0:
+                try:
+                    with open(stamp, "w"):
+                        pass
+                except OSError:
+                    pass
+            break
+        except subprocess.TimeoutExpired:
+            if probe_round == 0:
+                # one retry before pinning: an exclusive chip held by
+                # another client probes exactly like a dead relay, but
+                # busy chips free up — dead relays stay dead
+                Logger().warning(
+                    "backend probe hung %.0fs — retrying once before "
+                    "pinning CPU (chip may be busy, not dead)", timeout)
+                continue
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            Logger().warning(
+                "accelerator backend unresponsive after 2x%.0fs "
+                "(transport down?) — pinning JAX_PLATFORMS=cpu so this "
+                "process cannot hang", timeout)
+            engaged = True
     import jax
     if engaged:
         # the env var alone is NOT enough: the tunnelled-TPU plugin
