@@ -79,7 +79,9 @@ def build_workflow(epochs=10, minibatch_size=64, lr=0.003, n_blocks=2,
                                 seq_len=seq_len, vocab=chars,
                                 minibatch_size=minibatch_size,
                                 name="chars")
-        vocab = len(chars)
+        # vocab_size includes the loader's reserved unk slot — sizing
+        # the embedding/head from len(chars) would put unk out of range
+        vocab = loader.vocab_size
     else:
         loader = CharLMLoader(None, n_train=n_train, n_valid=n_valid,
                               minibatch_size=minibatch_size,

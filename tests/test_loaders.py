@@ -498,3 +498,30 @@ def test_text_loader_window_accounting(tmp_path):
         starts[n_train:][len(starts) - n_train - n_valid])
     assert first_valid_start >= last_train_end, (
         first_valid_start, last_train_end)
+
+
+def test_text_loader_oov_maps_to_reserved_unk(tmp_path, caplog):
+    """ADVICE r2: with a user-restricted vocab, OOV characters must NOT
+    alias onto id 0 (a real character) — they get the reserved unk id
+    (one past the vocab), decode renders them distinctly, and load
+    warns with a count."""
+    import logging
+    from veles_tpu.loader import TextFileLoader
+    p = tmp_path / "t.txt"
+    p.write_text("abcabcabzQQ" * 8)          # z/Q outside the vocab
+    ld = TextFileLoader(None, files=[str(p)], seq_len=8, stride=8,
+                        vocab="abc", validation_ratio=0.0,
+                        minibatch_size=2, name="oov")
+    with caplog.at_level(logging.WARNING):
+        ld.load_data()
+    assert ld.unk_id == 3                      # one PAST 'abc'
+    assert ld.vocab_size == 4                  # unk is id space
+    ids = ld.encode("azbQ")
+    assert ids.tolist() == [0, 3, 1, 3]
+    assert ld.decode(ids) == "a" + ld.UNK_CHAR + "b" + ld.UNK_CHAR
+    # id 0 kept its real meaning: only genuine 'a's decode to 'a'
+    assert ld.decode(ld.encode("aaa")) == "aaa"
+    assert any("unk" in r.message for r in caplog.records), \
+        [r.message for r in caplog.records]
+    # the served windows contain unk ids, never silent zeros for OOV
+    assert (ld.original_data.mem == 3).any()

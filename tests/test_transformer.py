@@ -271,3 +271,38 @@ def test_cached_generation_batched():
     for p, row in zip(prompts, batch_out):
         single = sampling.generate(wf, p, 10, temperature=0)
         assert row == single, (row, single)
+
+
+def test_cached_generation_heterogeneous_heads(tmp_path):
+    """ADVICE r2: the sampler sized every block's KV cache from
+    blocks[0].n_heads while the per-block step reshaped with its own —
+    a stack with differing per-block n_heads (allowed by the layers
+    config) trace-failed. Each cache now takes its block's own shape."""
+    from veles_tpu.loader import TextFileLoader
+    from veles_tpu.nn import sampling
+    p = tmp_path / "c.txt"
+    p.write_text("abcdabcdabcd" * 40)
+    prng.seed_all(7)
+    loader = TextFileLoader(None, files=[str(p)], seq_len=16,
+                            minibatch_size=8, name="text")
+    wf = nn.StandardWorkflow(
+        name="het-heads",
+        layers=[{"type": "embedding", "vocab_size": 8, "dim": 24,
+                 "solver": "adam", "learning_rate": 0.01},
+                {"type": "transformer_block", "n_heads": 4,
+                 "ffn_hidden": 48, "causal": True, "rope": True,
+                 "solver": "adam", "learning_rate": 0.01, "name": "b4"},
+                {"type": "transformer_block", "n_heads": 2,
+                 "ffn_hidden": 48, "causal": True, "rope": True,
+                 "solver": "adam", "learning_rate": 0.01, "name": "b2"},
+                {"type": "lm_head", "vocab_size": 8,
+                 "solver": "adam", "learning_rate": 0.01}],
+        loader_unit=loader, loss_function="softmax_seq",
+        decision_config=dict(max_epochs=1, fail_iterations=50))
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    toks = sampling.generate(wf, [0, 1, 2], 6, temperature=0)
+    assert len(toks) == 6
+    assert all(0 <= t < 8 for t in toks)
+    # greedy decode is deterministic: same prompt, same continuation
+    assert toks == sampling.generate(wf, [0, 1, 2], 6, temperature=0)

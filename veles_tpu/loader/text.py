@@ -29,9 +29,14 @@ class TextFileLoader(FullBatchLoaderMSE):
     """``files``: text file paths (concatenated in order). ``vocab``:
     optional explicit string of characters (index = id); by default the
     vocabulary is every distinct character in the corpus, sorted.
-    Characters outside the vocabulary map to id 0."""
+    Characters outside the vocabulary map to the reserved unk id
+    (``len(vocab)``, one past the last real character — included in
+    ``vocab_size``); ``decode`` renders it as ``UNK_CHAR``."""
 
     MAPPING = "text_loader"
+
+    #: what decode() renders for the reserved unknown id
+    UNK_CHAR = "�"
 
     def __init__(self, workflow, files: Sequence[str] = (),
                  seq_len: int = 128, stride: Optional[int] = None,
@@ -48,20 +53,32 @@ class TextFileLoader(FullBatchLoaderMSE):
         self.text_validation_ratio = float(validation_ratio)
 
     # -- vocabulary ----------------------------------------------------------
+    @property
+    def unk_id(self) -> int:
+        """Dedicated id for out-of-vocabulary characters — one past the
+        vocabulary, NEVER a real character's id: aliasing OOV onto id 0
+        (a real char) silently skewed training targets and decode
+        output toward that character (ADVICE r2)."""
+        return len(self.vocab or "")
+
     def encode(self, text: str) -> numpy.ndarray:
-        table = self.char_to_id
-        return numpy.fromiter((table.get(c, 0) for c in text),
+        table, unk = self.char_to_id, self.unk_id
+        return numpy.fromiter((table.get(c, unk) for c in text),
                               dtype=numpy.int32, count=len(text))
 
     def decode(self, ids) -> str:
         if not self.vocab:
             raise VelesError("decode before load_data: no vocabulary yet")
-        return "".join(self.vocab[i] if 0 <= i < len(self.vocab) else "?"
+        return "".join(self.vocab[i] if 0 <= i < len(self.vocab)
+                       else self.UNK_CHAR
                        for i in numpy.asarray(ids).ravel())
 
     @property
     def vocab_size(self) -> int:
-        return len(self.vocab or "")
+        """len(vocab) + 1: the unk slot is part of the id space, so
+        embedding tables / LM heads sized from here stay in range for
+        every id encode() can produce."""
+        return len(self.vocab or "") + 1
 
     # -- loader contract -----------------------------------------------------
     def load_data(self) -> None:
@@ -80,6 +97,15 @@ class TextFileLoader(FullBatchLoaderMSE):
             self.vocab = "".join(sorted(set(corpus)))
         self.char_to_id = {c: i for i, c in enumerate(self.vocab)}
         ids = self.encode(corpus)
+        n_oov = int((ids == self.unk_id).sum())
+        if n_oov:
+            # only possible with a user-restricted vocab; loud because
+            # every such position trains the model on the unk token
+            self.warning(
+                "%d of %d corpus characters are outside the supplied "
+                "%d-char vocabulary; they map to the reserved unk id "
+                "%d (decoded as %r)", n_oov, len(ids),
+                len(self.vocab), self.unk_id, self.UNK_CHAR)
 
         # a window at s consumes ids[s : s+seq_len+1] (input + shifted
         # target), so the last valid start is len - seq_len - 1 —

@@ -155,8 +155,6 @@ def _build_sampler(wf, t_p, n_new, temperature):
     blocks, head = stack["blocks"], stack["head"]
     t_max = t_p + int(n_new)
     d = stem.dim
-    h = blocks[0].n_heads
-    hd = d // h
     prec = matmul_precision()
     if pos_emb is not None:
         table_len = pos_emb.param_arrays()["table"].shape[0]
@@ -193,8 +191,12 @@ def _build_sampler(wf, t_p, n_new, temperature):
         x = embed(params, prompt_ids, 0)       # (B, T_p, D)
         caches = []
         for blk in blocks:
-            ck = jnp.zeros((b, t_max, h, hd), x.dtype)
-            cv = jnp.zeros((b, t_max, h, hd), x.dtype)
+            # each block's OWN head count: the layers config allows
+            # heterogeneous n_heads per block, and a cache shaped from
+            # blocks[0] trace-fails with an opaque reshape error
+            bh = blk.n_heads
+            ck = jnp.zeros((b, t_max, bh, d // bh), x.dtype)
+            cv = jnp.zeros((b, t_max, bh, d // bh), x.dtype)
             x, ck, cv = _block_prefill(blk, params[blk.name], x, ck, cv)
             caches.append((ck, cv))
         key, sub = jax.random.split(key)
