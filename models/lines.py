@@ -22,10 +22,30 @@ import numpy  # noqa: E402
 
 import veles_tpu as vt  # noqa: E402
 from veles_tpu import nn  # noqa: E402
+from veles_tpu.config import root  # noqa: E402
+from veles_tpu.genetics import Range  # noqa: E402
+from veles_tpu.genetics.config import Tuneable  # noqa: E402
 from veles_tpu.loader import FullBatchLoader  # noqa: E402
 
 SIZE = 16
 N_CLASSES = 4       # horizontal, vertical, diag, anti-diag
+
+# hyper-parameters live in the config tree so ``--optimize`` can search
+# them through Range markers (the reference samples carried the same
+# optimize-ready configs, e.g. veles/znicz samples' *_config.py).
+# Plain runs collapse markers to defaults (materialize_defaults); the
+# CLI re-applies root.lines.* overrides after this import.
+root.lines.lr = Range(0.002, 0.0005, 0.01)
+root.lines.mb = 80
+root.lines.epochs = 10
+root.lines.n_train = 2400
+root.lines.n_valid = 480
+
+
+def _cfg(value):
+    """Config value or, for a yet-uncollapsed marker (direct script
+    import, no CLI), its default."""
+    return value.default if isinstance(value, Tuneable) else value
 
 
 def draw_line(rng, angle_class, size=SIZE):
@@ -68,8 +88,17 @@ class LinesLoader(FullBatchLoader):
         self.class_lengths = [0, self.n_valid, self.n_train]
 
 
-def build_workflow(epochs=10, minibatch_size=80, lr=0.002,
-                   n_train=2400, n_valid=480):
+def build_workflow(epochs=None, minibatch_size=None, lr=None,
+                   n_train=None, n_valid=None):
+    """Explicit arguments win; anything left None resolves from
+    ``root.lines`` (where --optimize writes each candidate's genes)."""
+    c = root.lines
+    epochs = int(_cfg(c.epochs)) if epochs is None else epochs
+    minibatch_size = (int(_cfg(c.mb)) if minibatch_size is None
+                      else minibatch_size)
+    lr = float(_cfg(c.lr)) if lr is None else lr
+    n_train = int(_cfg(c.n_train)) if n_train is None else n_train
+    n_valid = int(_cfg(c.n_valid)) if n_valid is None else n_valid
     loader = LinesLoader(None, n_train=n_train, n_valid=n_valid,
                          minibatch_size=minibatch_size, name="lines")
     wf = nn.StandardWorkflow(
@@ -92,9 +121,9 @@ def build_workflow(epochs=10, minibatch_size=80, lr=0.002,
 
 def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--epochs", type=int, default=10)
-    p.add_argument("--mb", type=int, default=80)
-    p.add_argument("--lr", type=float, default=0.002)
+    p.add_argument("--epochs", type=int, default=None)
+    p.add_argument("--mb", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
     p.add_argument("--backend", default="auto")
     args = p.parse_args(argv)
 

@@ -74,7 +74,10 @@ def main(argv=None) -> int:
 
     module = import_file_as_module(args.model)
     # a model module may (re)set config keys at import time (including
-    # Range markers); inline overrides must win — re-apply them
+    # Range markers); the user's config FILE and inline overrides must
+    # win — re-apply both, in layering order
+    if args.config:
+        root.update_from_file(args.config)
     if args.config_list:
         apply_config_overrides(root, args.config_list)
 
@@ -122,8 +125,12 @@ def _run_meta(launcher: Launcher, module, args) -> int:
                          % args.model)
     # subprocess candidates need the (exclusive) TPU for themselves —
     # the parent must not initialize a device it will never use
-    device = (None if (args.optimize and args.optimize_subprocess)
-              else launcher.make_device())
+    subprocess_candidates = (
+        (args.optimize and (args.optimize_subprocess
+                            or args.optimize_workers > 1))
+        or (args.ensemble_train and args.ensemble_workers > 1
+            and args.ensemble_member is None))
+    device = None if subprocess_candidates else launcher.make_device()
     if args.optimize:
         from .genetics import GeneticsOptimizer
         size, _, gens = args.optimize.partition(":")
@@ -139,15 +146,37 @@ def _run_meta(launcher: Launcher, module, args) -> int:
             build_workflow=module.build_workflow, model_path=args.model,
             size=int(size), generations=int(gens or 3),
             device=device, subprocess_mode=args.optimize_subprocess,
+            n_workers=args.optimize_workers,
+            crossover=args.optimize_crossover,
+            selection=args.optimize_selection,
             extra_argv=extra).run()
     elif args.ensemble_train:
         _materialize(args)
         from .ensemble import EnsembleTrainer
         n, _, ratio = args.ensemble_train.partition(":")
-        result = EnsembleTrainer(
-            module.build_workflow, n_models=int(n),
-            train_ratio=float(ratio or 1.0), device=device,
-            out_file=args.ensemble_file).run()
+        if args.ensemble_member is not None:
+            # parallel-worker child: train exactly one member; the
+            # parent assembles the manifest from the entry we emit
+            result = EnsembleTrainer(
+                module.build_workflow, n_models=int(n),
+                train_ratio=float(ratio or 1.0), device=device,
+                base_seed=args.random_seed,
+                out_file=args.ensemble_file).train_member(
+                    args.ensemble_member)
+        else:
+            extra = []
+            if args.config:
+                extra.append(args.config)
+            extra += args.config_list
+            if args.backend:
+                extra += ["--backend", args.backend]
+            result = EnsembleTrainer(
+                module.build_workflow, n_models=int(n),
+                train_ratio=float(ratio or 1.0), device=device,
+                base_seed=args.random_seed,
+                out_file=args.ensemble_file,
+                n_workers=args.ensemble_workers,
+                model_path=args.model, extra_argv=extra).run()
     else:
         from .ensemble import EnsembleTester
         _materialize(args)

@@ -88,6 +88,18 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimize-subprocess", action="store_true",
                    help="evaluate each candidate in an isolated "
                         "subprocess instead of inline")
+    p.add_argument("--optimize-workers", type=int, default=1, metavar="W",
+                   help="evaluate up to W candidates concurrently via "
+                        "the trial scheduler (implies subprocess "
+                        "isolation; each worker slot gets its own "
+                        "device placement)")
+    p.add_argument("--optimize-crossover", default="uniform",
+                   choices=("uniform", "arithmetic", "geometric",
+                            "pointed"),
+                   help="GA crossover operator")
+    p.add_argument("--optimize-selection", default="roulette",
+                   choices=("roulette", "random", "tournament"),
+                   help="GA parent-selection procedure")
     p.add_argument("--ensemble-train", default=None, metavar="N[:RATIO]",
                    help="train N ensemble members, each on RATIO of the "
                         "train set (default 1.0)")
@@ -95,7 +107,41 @@ def make_parser() -> argparse.ArgumentParser:
                    help="soft-vote evaluate a trained ensemble manifest")
     p.add_argument("--ensemble-file", default="ensemble.json",
                    help="where --ensemble-train writes its manifest")
+    p.add_argument("--ensemble-workers", type=int, default=1, metavar="W",
+                   help="train up to W ensemble members concurrently via "
+                        "the trial scheduler (members become CLI "
+                        "subprocesses)")
+    p.add_argument("--ensemble-member", type=int, default=None,
+                   metavar="I",
+                   help="(internal) train only member I of the "
+                        "--ensemble-train set and write its manifest "
+                        "entry to --result-file — the unit a parallel "
+                        "ensemble worker executes")
     return p
+
+
+def split_child_argv(extra):
+    """Partition forwarded argv into (positional config overrides,
+    flag arguments). Child commands built for the trial scheduler must
+    group ALL positionals (``root.x=y`` overrides, config files)
+    directly after the model path — argparse cannot consume a second
+    positional group appearing after optionals like ``--backend cpu``.
+    """
+    positionals, flags = [], []
+    it = iter(extra)
+    for item in it:
+        if item.startswith("-"):
+            flags.append(item)
+            # flags used by forwarded child argv are all value-taking
+            # (--backend X, --random-seed N); keep the pair together
+            if "=" not in item:
+                try:
+                    flags.append(next(it))
+                except StopIteration:
+                    pass
+        else:
+            positionals.append(item)
+    return positionals, flags
 
 
 def parse_mesh(spec: str):

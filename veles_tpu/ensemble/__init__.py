@@ -14,9 +14,14 @@ veles/__main__.py:347-361,727-732).
   forward chain over the validation set, and soft-votes (mean class
   probability) into aggregate metrics.
 
-The reference evaluated members as master–slave jobs or subprocesses; here
-members run sequentially on the chip (they own all devices) — multi-slice
-fan-out is the scale-out story (SURVEY.md §2.4 "ensemble parallelism").
+The reference evaluated members as master–slave jobs
+(veles/ensemble/model_workflow.py:137); here ``n_workers > 1`` farms
+members through ``parallel.trials.TrialScheduler`` — each member is one
+subprocess running the normal CLI with ``--ensemble-member i``, placed
+on its own device slice by the scheduler's placement hook (private
+XLA:CPU by default; mesh_slice_placement on multi-chip hosts). On the
+single exclusive chip members run sequentially (n_workers=1), which is
+also the default.
 """
 
 from __future__ import annotations
@@ -43,7 +48,11 @@ class EnsembleTrainer(Logger):
                  train_ratio: float = 1.0, device=None,
                  out_file: str = "ensemble.json", base_seed: Optional[int]
                  = None, directory: Optional[str] = None,
-                 prefix: str = "ensemble") -> None:
+                 prefix: str = "ensemble", n_workers: int = 1,
+                 model_path: Optional[str] = None,
+                 extra_argv: Optional[list] = None,
+                 trial_timeout: Optional[float] = None,
+                 placement=None) -> None:
         super().__init__()
         self.build_workflow = build_workflow
         self.n_models = int(n_models)
@@ -54,6 +63,15 @@ class EnsembleTrainer(Logger):
                           else int(root.common.random_seed))
         self.directory = directory or root.common.dirs.snapshots
         self.prefix = prefix
+        self.n_workers = int(n_workers)
+        self.model_path = model_path
+        self.extra_argv = list(extra_argv or [])
+        self.trial_timeout = trial_timeout
+        self.placement = placement
+        if self.n_workers > 1 and not self.model_path:
+            raise VelesError(
+                "parallel ensemble training (n_workers > 1) farms "
+                "members out as CLI subprocesses and needs model_path")
 
     def _train_one(self, index: int) -> dict:
         seed = self.base_seed + index
@@ -80,7 +98,62 @@ class EnsembleTrainer(Logger):
                             if isinstance(v, (int, float, str, bool))
                             or v is None}}
 
+    def train_member(self, index: int) -> dict:
+        """Train ONE member and return its manifest entry — the unit a
+        ``--ensemble-member`` CLI child executes when members are
+        farmed out by the trial scheduler."""
+        return self._train_one(index)
+
+    def _run_parallel(self) -> dict:
+        import sys
+        from ..cmdline import split_child_argv
+        from ..parallel.trials import run_json_trials
+        positionals, flags = split_child_argv(self.extra_argv)
+
+        def member_argv(i, rf):
+            return ([sys.executable, "-m", "veles_tpu",
+                     self.model_path] + positionals +
+                    ["--ensemble-member", str(i),
+                     "--ensemble-train",
+                     "%d:%s" % (self.n_models, self.train_ratio),
+                     "--random-seed", str(self.base_seed),
+                     "--snapshot-dir", self.directory,
+                     "--result-file", rf] + flags)
+
+        manifest = {"n_models": self.n_models,
+                    "train_ratio": self.train_ratio,
+                    "base_seed": self.base_seed,
+                    "models": []}
+        failed = []
+        for i, (res, doc) in enumerate(run_json_trials(
+                member_argv, self.n_models, self.n_workers,
+                placement=self.placement, timeout=self.trial_timeout)):
+            if doc is None:
+                # the reference's job farm survived slave death
+                # (veles/server.py:315-338); a failed member — dead
+                # process OR unusable result file — is recorded and
+                # the rest of the ensemble stands
+                self.warning("member %d failed (rc=%s%s): %s", i,
+                             res.returncode,
+                             ", no result file" if res.ok else "",
+                             res.stderr_tail[-500:])
+                failed.append(i)
+                continue
+            manifest["models"].append(doc)
+        if not manifest["models"]:
+            raise VelesError(
+                "all %d ensemble members failed" % self.n_models)
+        if failed:
+            manifest["failed_members"] = failed
+        with open(self.out_file, "w") as fout:
+            json.dump(manifest, fout, indent=2)
+        self.info("ensemble manifest → %s (%d workers)", self.out_file,
+                  self.n_workers)
+        return manifest
+
     def run(self) -> dict:
+        if self.n_workers > 1:
+            return self._run_parallel()
         manifest = {"n_models": self.n_models,
                     "train_ratio": self.train_ratio,
                     "base_seed": self.base_seed,

@@ -91,12 +91,37 @@ class Chromosome:
         self._snap()
 
     def mutate_gaussian(self, points: int, scale: float, rand) -> None:
-        """The reference's "altering" mutation: add gaussian noise scaled
-        to the gene's range."""
+        """Add gaussian noise scaled to the gene's range (reference:
+        mutation_gaussian, veles/genetics/core.py:310)."""
         for _ in range(points):
             i = int(rand.randint(0, len(self.genes)))
             span = self.maxs[i] - self.mins[i]
             self.genes[i] += rand.normal(0.0, scale * max(span, 1e-12))
+        self._snap()
+
+    def mutate_uniform(self, points: int, rand) -> None:
+        """Replace a gene with a fresh uniform draw from its range
+        (reference: mutation_uniform, veles/genetics/core.py:346)."""
+        for _ in range(points):
+            i = int(rand.randint(0, len(self.genes)))
+            # mins + span*rand(): the project RandomGenerator exposes
+            # rand/randint/normal but no uniform()
+            self.genes[i] = self.mins[i] + \
+                (self.maxs[i] - self.mins[i]) * float(rand.rand())
+        self._snap()
+
+    def mutate_altering(self, points: int, rand) -> None:
+        """Swap the values of two gene positions (reference:
+        mutation_altering, veles/genetics/core.py:277). The swapped
+        values are re-snapped to each TARGET position's own bounds —
+        gene ranges differ, unlike the reference's homogeneous-range
+        chromosomes. No-op on single-gene chromosomes."""
+        if len(self.genes) < 2:
+            return
+        for _ in range(points):
+            i = int(rand.randint(0, len(self.genes)))
+            j = int(rand.randint(0, len(self.genes)))
+            self.genes[i], self.genes[j] = self.genes[j], self.genes[i]
         self._snap()
 
 
@@ -107,10 +132,19 @@ class Population(Logger):
     assigned to chromosome.fitness by ``evolve``.
     """
 
+    #: mutation operator census (reference veles/genetics/core.py:205-211:
+    #: binary_point / gaussian / uniform / altering)
+    MUTATIONS = ("binary", "gaussian", "uniform", "altering")
+    #: selection procedures (reference :573-616: roulette / random /
+    #: tournament)
+    SELECTIONS = ("roulette", "random", "tournament")
+
     def __init__(self, mins: Sequence[float], maxs: Sequence[float],
                  ints: Optional[Sequence[bool]] = None, size: int = 20,
                  crossover: str = "uniform", elite_fraction: float = 0.15,
-                 mutation_rate: float = 0.25, rand=None) -> None:
+                 mutation_rate: float = 0.25, rand=None,
+                 selection: str = "roulette",
+                 tournament_size: int = 3) -> None:
         super().__init__()
         self.mins = numpy.asarray(mins, dtype=numpy.float64)
         self.maxs = numpy.asarray(maxs, dtype=numpy.float64)
@@ -119,6 +153,11 @@ class Population(Logger):
         self.ints = list(ints) if ints is not None else [False] * len(mins)
         self.size = int(size)
         self.crossover = crossover
+        if selection not in self.SELECTIONS:
+            raise ValueError("unknown selection %r (have: %s)"
+                             % (selection, self.SELECTIONS))
+        self.selection = selection
+        self.tournament_size = int(tournament_size)
         self.elite_fraction = float(elite_fraction)
         self.mutation_rate = float(mutation_rate)
         self.rand = rand or prng.get("genetics")
@@ -137,6 +176,25 @@ class Population(Logger):
         return max(scored, key=lambda c: c.fitness)
 
     # -- selection -----------------------------------------------------------
+    def _pick(self) -> Chromosome:
+        """One parent by the configured procedure (reference
+        select_roulette/select_random/select_tournament,
+        veles/genetics/core.py:578-616)."""
+        if self.selection == "roulette":
+            return self._roulette_pick()
+        if self.selection == "random":
+            return self.chromosomes[
+                int(self.rand.randint(0, len(self.chromosomes)))]
+        # tournament: best of a small uniform sample
+        k = max(2, min(self.tournament_size, len(self.chromosomes)))
+        idx = [int(self.rand.randint(0, len(self.chromosomes)))
+               for _ in range(k)]
+        pool = [self.chromosomes[i] for i in idx]
+        fit = [c.fitness if (c.fitness is not None and
+                             numpy.isfinite(c.fitness))
+               else -numpy.inf for c in pool]
+        return pool[int(numpy.argmax(fit))]
+
     def _roulette_pick(self) -> Chromosome:
         fits = numpy.array([c.fitness for c in self.chromosomes])
         # failed evaluations report -inf; give them zero selection weight
@@ -187,23 +245,55 @@ class Population(Logger):
             raise ValueError("unknown crossover %r" % kind)
         return Chromosome(genes, self.mins, self.maxs, self.ints)
 
+    def _mutate_child(self, child: Chromosome) -> None:
+        """One operator drawn uniformly from the census (the reference
+        applied every configured mutation with per-operator
+        probabilities, core.py:549-566; one-draw keeps the per-child
+        mutation pressure at ``mutation_rate`` exactly)."""
+        op = self.MUTATIONS[int(self.rand.randint(0, len(self.MUTATIONS)))]
+        if op == "binary":
+            child.mutate_binary(1, self.rand)
+        elif op == "gaussian":
+            child.mutate_gaussian(1, 0.1, self.rand)
+        elif op == "uniform":
+            child.mutate_uniform(1, self.rand)
+        else:
+            child.mutate_altering(1, self.rand)
+
     # -- generation step ------------------------------------------------------
-    def evolve(self, evaluator: Callable[[Chromosome, int], float]) -> None:
+    def evolve(self, evaluator: Optional[
+            Callable[[Chromosome, int], float]] = None,
+            batch_evaluator: Optional[
+                Callable[[List[Chromosome]], Sequence[float]]] = None
+            ) -> None:
         """Evaluate all unscored chromosomes, then breed the next
-        generation (elite carried over unchanged)."""
-        for i, chromo in enumerate(self.chromosomes):
-            if chromo.fitness is None:
+        generation (elite carried over unchanged).
+
+        ``batch_evaluator(chromosomes) -> fitnesses`` scores every
+        unscored candidate in ONE call — the hook the parallel trial
+        scheduler plugs into (the generation is the natural fan-out
+        unit: its members are independent by construction)."""
+        pending = [(i, c) for i, c in enumerate(self.chromosomes)
+                   if c.fitness is None]
+        if batch_evaluator is not None:
+            fits = list(batch_evaluator([c for _, c in pending]))
+            if len(fits) != len(pending):
+                raise ValueError("batch evaluator returned %d scores for "
+                                 "%d candidates" % (len(fits), len(pending)))
+            for (_, chromo), fit in zip(pending, fits):
+                chromo.fitness = float(fit)
+        else:
+            if evaluator is None:
+                raise ValueError("evolve needs evaluator or batch_evaluator")
+            for i, chromo in pending:
                 chromo.fitness = float(evaluator(chromo, i))
         self.chromosomes.sort(key=lambda c: -c.fitness)
         n_elite = max(1, int(round(self.size * self.elite_fraction)))
         next_gen = self.chromosomes[:n_elite]
         while len(next_gen) < self.size:
-            child = self._cross(self._roulette_pick(), self._roulette_pick())
+            child = self._cross(self._pick(), self._pick())
             if self.rand.rand() < self.mutation_rate:
-                if self.rand.rand() < 0.5:
-                    child.mutate_binary(1, self.rand)
-                else:
-                    child.mutate_gaussian(1, 0.1, self.rand)
+                self._mutate_child(child)
             next_gen.append(child)
         self.chromosomes = next_gen
         self.generation += 1

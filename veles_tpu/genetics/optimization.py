@@ -36,7 +36,9 @@ class GeneticsOptimizer(Logger):
                  config_node=None, size: int = 10, generations: int = 5,
                  minimize: str = "best_err", maximize: Optional[str] = None,
                  device=None, subprocess_mode: bool = False,
-                 crossover: str = "uniform",
+                 crossover: str = "uniform", selection: str = "roulette",
+                 n_workers: int = 1, trial_timeout: Optional[float] = None,
+                 placement=None,
                  extra_argv: Optional[list] = None) -> None:
         super().__init__()
         self.build_workflow = build_workflow
@@ -45,7 +47,12 @@ class GeneticsOptimizer(Logger):
         self.minimize = minimize
         self.maximize = maximize
         self.device = device
-        self.subprocess_mode = subprocess_mode
+        # concurrent candidates need process isolation — n_workers > 1
+        # implies the subprocess path (the reference's job-farm analog)
+        self.n_workers = int(n_workers)
+        self.subprocess_mode = subprocess_mode or self.n_workers > 1
+        self.trial_timeout = trial_timeout
+        self.placement = placement
         self.extra_argv = list(extra_argv or [])
         self.generations = int(generations)
         self.tuneables = find_tuneables(self.config_node)
@@ -57,7 +64,7 @@ class GeneticsOptimizer(Logger):
             mins=[t[3].min for t in self.tuneables],
             maxs=[t[3].max for t in self.tuneables],
             ints=[t[3].is_int for t in self.tuneables],
-            size=size, crossover=crossover)
+            size=size, crossover=crossover, selection=selection)
         self.evaluations = 0
         self.history = []   # (values, fitness) of every evaluation
 
@@ -81,33 +88,77 @@ class GeneticsOptimizer(Logger):
             self.warning("candidate %s failed: %s", values, exc)
             return -float("inf")
 
+    def _candidate_cmd(self, values, result_file) -> list:
+        from ..cmdline import split_child_argv
+        overrides = ["%s=%s" % (path, json.dumps(v)) for
+                     (path, _, _, _), v in zip(self.tuneables, values)]
+        # overrides are re-applied by the child AFTER it imports the
+        # model module, so they win over import-time Range markers.
+        # All positionals grouped right after the model path: argparse
+        # rejects a second positional group after flags like --backend
+        positionals, flags = split_child_argv(self.extra_argv)
+        return ([sys.executable, "-m", "veles_tpu", self.model_path]
+                + positionals + overrides
+                + ["--result-file", result_file] + flags)
+
+    def _fitness_from_file(self, values, result_file) -> float:
+        try:
+            with open(result_file) as fin:
+                return self._fitness_from_results(json.load(fin))
+        except (KeyError, ValueError, OSError) as exc:
+            # same contract as inline mode: a candidate whose results
+            # lack the metric scores -inf, it must not kill the search
+            self.warning("candidate %s produced unusable results: %s",
+                         values, exc)
+            return -float("inf")
+
     def _evaluate_subprocess(self, values) -> float:
         fd, result_file = tempfile.mkstemp(suffix=".json")
         os.close(fd)
         try:
-            overrides = ["%s=%s" % (path, json.dumps(v)) for
-                         (path, _, _, _), v in zip(self.tuneables, values)]
-            # overrides are re-applied by the child AFTER it imports the
-            # model module, so they win over import-time Range markers
-            cmd = ([sys.executable, "-m", "veles_tpu", self.model_path,
-                    "--result-file", result_file]
-                   + self.extra_argv + overrides)
-            proc = subprocess.run(cmd, capture_output=True, text=True)
+            proc = subprocess.run(self._candidate_cmd(values, result_file),
+                                  capture_output=True, text=True)
             if proc.returncode != 0:
                 self.warning("candidate failed (%s): %s",
                              values, proc.stderr[-500:])
                 return -float("inf")
-            try:
-                with open(result_file) as fin:
-                    return self._fitness_from_results(json.load(fin))
-            except (KeyError, ValueError, OSError) as exc:
-                # same contract as inline mode: a candidate whose results
-                # lack the metric scores -inf, it must not kill the search
-                self.warning("candidate %s produced unusable results: %s",
-                             values, exc)
-                return -float("inf")
+            return self._fitness_from_file(values, result_file)
         finally:
             os.unlink(result_file)
+
+    def _evaluate_batch(self, chromosomes) -> list:
+        """One GENERATION of candidates through the trial scheduler —
+        the reference farmed exactly this unit to its slaves
+        (veles/genetics/optimization_workflow.py:70)."""
+        from ..parallel.trials import run_json_trials
+        outcomes = run_json_trials(
+            lambda i, rf: self._candidate_cmd(chromosomes[i].values(), rf),
+            len(chromosomes), self.n_workers, placement=self.placement,
+            timeout=self.trial_timeout,
+            tags=[tuple(c.values()) for c in chromosomes])
+        fits = []
+        for chromo, (res, doc) in zip(chromosomes, outcomes):
+            values = chromo.values()
+            if doc is None:
+                self.warning("candidate failed (%s): rc=%s%s %s",
+                             values, res.returncode,
+                             ", no result file" if res.ok else "",
+                             res.stderr_tail[-500:])
+                fit = -float("inf")
+            else:
+                try:
+                    fit = self._fitness_from_results(doc)
+                except (KeyError, ValueError, TypeError) as exc:
+                    self.warning("candidate %s produced unusable "
+                                 "results: %s", values, exc)
+                    fit = -float("inf")
+            self.evaluations += 1
+            self.history.append((values, fit))
+            self.info("eval %d: %s → fitness %.6g", self.evaluations,
+                      dict(zip((t[0] for t in self.tuneables),
+                               values)), fit)
+            fits.append(fit)
+        return fits
 
     def _evaluate(self, chromo, index) -> float:
         values = chromo.values()
@@ -131,7 +182,11 @@ class GeneticsOptimizer(Logger):
             raise ValueError("inline mode needs build_workflow")
         try:
             for _ in range(self.generations):
-                self.population.evolve(self._evaluate)
+                if self.n_workers > 1:
+                    self.population.evolve(
+                        batch_evaluator=self._evaluate_batch)
+                else:
+                    self.population.evolve(self._evaluate)
             best = self.population.best
             best_cfg = dict(zip((t[0] for t in self.tuneables),
                                 best.values()))

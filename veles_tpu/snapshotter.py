@@ -125,7 +125,20 @@ def apply_state(workflow, state: Dict[str, Any],
                 raise KeyError("snapshot unit %r not in workflow" % name)
             continue
         if hasattr(unit, "load_state_dict"):
-            unit.load_state_dict(sd)
+            try:
+                unit.load_state_dict(sd)
+            except Exception as exc:
+                # shape/schema drift (e.g. a contract change like the
+                # TextFileLoader reserved-unk vocab growing every LM
+                # head by one row) must reject LOUDLY with the unit
+                # named, not crash deep inside an array assign
+                from .error import VelesError
+                raise VelesError(
+                    "snapshot state for unit %r does not fit the "
+                    "current workflow (%s: %s) — the snapshot was "
+                    "taken under a different model/config contract; "
+                    "rebuild it or pin the old code"
+                    % (name, type(exc).__name__, exc)) from exc
     with prng._lock:
         for key, st in state.get("__prng__", {}).items():
             if key in prng._ephemeral:
