@@ -43,17 +43,17 @@ CHILD = textwrap.dedent("""
 
     pid = int(sys.argv[1])
     launcher = Launcher(coordinator="127.0.0.1:%(port)d",
-                        num_processes=2, process_id=pid,
-                        mesh={"data": 2}, random_seed=11)
+                        num_processes=%(nproc)d, process_id=pid,
+                        mesh={"data": %(nproc)d}, random_seed=11)
     wf = nn.StandardWorkflow(
         name="mh",
         layers=[{"type": "softmax", "output_sample_shape": 2,
                  "learning_rate": 0.2}],
         loader_unit=Toy(None, minibatch_size=32),
         loss_function="softmax",
-        decision_config=dict(max_epochs=4))
+        decision_config=dict(max_epochs=%(max_epochs)d))
     launcher.initialize(wf)
-    assert launcher.device.mesh.devices.size == 2
+    assert launcher.device.mesh.devices.size == %(nproc)d
     results = launcher.run()
     launcher.write_results(results, %(out)r + str(pid) + ".json")
     print("RANK%%d DONE err=%%.4f" %% (pid, results["best_err"]))
@@ -70,7 +70,8 @@ def test_two_process_training(tmp_path):
     port = free_port()
     script = tmp_path / "child.py"
     out = str(tmp_path / "results_rank")
-    script.write_text(CHILD % {"repo": REPO, "port": port, "out": out})
+    script.write_text(CHILD % {"repo": REPO, "port": port, "out": out,
+                               "nproc": 2, "max_epochs": 4})
     procs = [subprocess.Popen([sys.executable, str(script), str(i)],
                               stdout=subprocess.PIPE,
                               stderr=subprocess.STDOUT, text=True,
@@ -89,3 +90,159 @@ def test_two_process_training(tmp_path):
     with open(out + "0.json") as fin:
         res = json.load(fin)
     assert res["epochs"] >= 4 and res["best_err"] < 0.5
+
+
+def test_four_process_training(tmp_path):
+    """SPMD over a 4-process × 1-device logical mesh (VERDICT r2 #9:
+    multihost depth beyond the 2-process pair)."""
+    port = free_port()
+    script = tmp_path / "child4.py"
+    out = str(tmp_path / "r4_rank")
+    script.write_text(CHILD % {"repo": REPO, "port": port, "out": out,
+                               "nproc": 4, "max_epochs": 2})
+    procs = [subprocess.Popen([sys.executable, str(script), str(i)],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True,
+                              cwd=REPO)
+             for i in range(4)]
+    outs = []
+    for p in procs:
+        stdout, _ = p.communicate(timeout=600)
+        outs.append(stdout)
+    for i, (p, stdout) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, "rank %d:\n%s" % (i, stdout[-3000:])
+        assert "RANK%d DONE" % i in stdout
+    assert os.path.exists(out + "0.json")
+    # coordinator-only writes hold at every non-zero rank
+    for i in (1, 2, 3):
+        assert not os.path.exists(out + "%d.json" % i)
+
+
+DRILL_CHILD = textwrap.dedent("""
+    import json, os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("XLA_FLAGS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, %(repo)r)
+    import numpy
+    import veles_tpu as vt
+    from veles_tpu import nn
+    from veles_tpu.launcher import Launcher
+    from veles_tpu.loader import FullBatchLoader
+
+    class Toy(FullBatchLoader):
+        hide_from_registry = True
+        def load_data(self):
+            rng = numpy.random.RandomState(0)
+            centers = rng.randn(3, 8) * 3
+            y = rng.randint(0, 3, 192).astype(numpy.int32)
+            x = (centers[y] + rng.randn(192, 8)).astype(numpy.float32)
+            self.create_originals(x, y)
+            self.class_lengths = [0, 32, 160]
+
+    pid = int(sys.argv[1])
+    port = int(sys.argv[2])
+    max_epochs = int(sys.argv[3])
+    launcher = Launcher(coordinator="127.0.0.1:%%d" %% port,
+                        num_processes=2, process_id=pid,
+                        mesh={"data": 2}, random_seed=11)
+    snap = vt.Snapshotter(None, prefix="mhdrill",
+                          directory=%(snapdir)r, interval=1)
+    wf = nn.StandardWorkflow(
+        name="mh-drill",
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 8,
+                 "learning_rate": 0.1},
+                {"type": "softmax", "output_sample_shape": 3,
+                 "learning_rate": 0.1}],
+        loader_unit=Toy(None, minibatch_size=32),
+        loss_function="softmax",
+        decision_config=dict(max_epochs=max_epochs,
+                             fail_iterations=100),
+        snapshotter_unit=snap)
+    launcher.initialize(wf)
+    resumed = launcher.try_restore_latest()
+    print("RANK%%d RESUMED=%%s epoch=%%d" %% (
+        pid, resumed, wf.decision.epoch_number), flush=True)
+    results = launcher.run()
+    launcher.write_results(results, %(out)r + str(pid) + ".json")
+    print("RANK%%d DONE epochs=%%d err=%%.4f" %% (
+        pid, results["epochs"], results["best_err"]), flush=True)
+""")
+
+
+def test_coordinator_kill_and_resume(tmp_path):
+    """The SPMD analog of the reference's slave-death story
+    (veles/server.py:315-338): the COORDINATOR process is SIGKILLed
+    mid-training; a fresh 2-process job over the same snapshot dir
+    auto-resumes from the newest coordinator-written snapshot and
+    completes."""
+    import glob
+    import signal
+    import time
+    snapdir = str(tmp_path / "snaps")
+    os.makedirs(snapdir)
+    out = str(tmp_path / "drill_rank")
+    script = tmp_path / "drill.py"
+    script.write_text(DRILL_CHILD % {
+        "repo": REPO, "snapdir": snapdir, "out": out})
+
+    # phase 1: effectively-unbounded epochs; killed once snapshots land
+    port = free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(port), "1000"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO) for i in range(2)]
+    def real_snaps():
+        # COMPLETE snapshots only: counting the '_current' link or an
+        # in-flight '.tmp' partial would green-light the SIGKILL while
+        # the coordinator is mid-write — exactly the race this drill
+        # must not inject artificially
+        return [p for p in glob.glob(os.path.join(snapdir, "*.pickle*"))
+                if not p.endswith(".tmp") and "_current" not in p]
+
+    deadline = time.time() + 240
+    try:
+        while time.time() < deadline:
+            if len(real_snaps()) >= 2:
+                break
+            if any(p.poll() is not None for p in procs):
+                break
+            time.sleep(0.5)
+        # liveness first: a startup crash must surface the child's
+        # output, not a bare "no snapshot" message
+        assert all(p.poll() is None for p in procs), \
+            "phase-1 died early:\n" + "\n".join(
+                p.communicate()[0][-2000:] for p in procs
+                if p.poll() is not None)
+        assert real_snaps(), "no snapshot before deadline"
+        os.kill(procs[0].pid, signal.SIGKILL)      # the coordinator
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+            p.communicate()
+
+    # phase 2: fresh job, same dir — must resume past epoch 0 and finish
+    port = free_port()
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(port), "6"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=REPO) for i in range(2)]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for i, (p, stdout) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, "rank %d:\n%s" % (i, stdout[-3000:])
+        assert "RANK%d RESUMED=True" % i in stdout, stdout[-2000:]
+        assert "RANK%d DONE" % i in stdout
+    # resumed mid-trajectory, not from scratch
+    import re
+    epoch = int(re.search(r"RANK0 RESUMED=True epoch=(\d+)",
+                          outs[0]).group(1))
+    assert epoch >= 1, outs[0][-1000:]
+    assert os.path.exists(out + "0.json")
+    assert not os.path.exists(out + "1.json")
+    with open(out + "0.json") as fin:
+        res = json.load(fin)
+    assert res["best_err"] < 0.35, res
