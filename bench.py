@@ -120,18 +120,21 @@ def model_flops_per_sample(wf):
 BLOCK_EPOCHS = 8
 
 
-def bench_mnist(dev, n_chips, smoke=False):
+def bench_mnist(dev, n_chips, smoke=False, h=None):
     """smoke=True (CPU fallback): one short window, classic per-epoch
     dispatch — a host core cannot absorb 8-epoch blocks of the full
     config in bench-able time; the stamped platform/smoke keep the
-    number from ever being compared to a chip run."""
+    number from ever being compared to a chip run. ``h`` overrides the
+    dispatch block size (chip experiments measure h=1 vs h=8
+    explicitly)."""
     from mnist import build_workflow
     # host round trips are the dominant cost on the tunnelled chip
     # (measured plan-size sweep: 50 -> 0.47M ... 600 -> 1.9M samples/s);
     # epochs_per_dispatch fuses 8 WHOLE epochs (valid eval + train) into
     # one device program, cutting the per-epoch dispatch+drain round
     # trips by 8x on top of the per-epoch scan
-    h = 1 if smoke else BLOCK_EPOCHS
+    if h is None:
+        h = 1 if smoke else BLOCK_EPOCHS
     wf = build_workflow(epochs=10 ** 9, minibatch_size=100,
                         epochs_per_dispatch=h)
     wf.initialize(device=dev)

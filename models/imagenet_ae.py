@@ -86,7 +86,7 @@ class SyntheticImageLoader(FullBatchLoader):
 
 
 def build_bench_workflow(image_size=128, minibatch_size=64, n_train=1024,
-                         n_valid=128, lr=1e-4):
+                         n_valid=128, lr=1e-4, remat=False):
     """MXU-weighted AE: most FLOPs sit in 64→128 and 128→128 3×3 convs
     (contraction dims ≥64 tile cleanly onto the 128×128 systolic array);
     only the unavoidable RGB stem is narrow. This is the compute-bound
@@ -117,6 +117,7 @@ def build_bench_workflow(image_size=128, minibatch_size=64, n_train=1024,
         name="imagenet-ae-bench",
         layers=layers, loader_unit=loader, loss_function="mse",
         decision_config=dict(max_epochs=10 ** 9, fail_iterations=10 ** 9),
+        remat=remat,
     )
     return wf
 

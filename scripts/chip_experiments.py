@@ -1,0 +1,215 @@
+"""Round-3 chip measurement batch — ONE process, ONE staging, in
+priority order (the tunnelled chip is exclusive and fragile: batching
+every experiment into a single client with incremental saves means a
+mid-session relay death still leaves the sections that finished —
+learned the hard way in round 2).
+
+Sections (most important first, per VERDICT r3 items 1/2/5):
+  mnist    — MNIST-784 h=8 block dispatch (the driver headline config)
+  ae_amp   — conv-AE 128px mb=64 under bf16 activations + bf16 dataset
+  ae_fp32  — same net, f32 everything: the AMP delta, measured
+  lm       — transformer-LM tokens/s (mixed precision, 4-epoch blocks)
+  attn     — flash vs fused-XLA at T=2048/8192, fwd and train mode,
+             sweeping Pallas block shapes (the T=2048 0.62x regression)
+  profile  — XPlane trace of AE steps for the HBM-residual analysis
+
+Run:  python scripts/chip_experiments.py [--sections mnist,ae_amp,...]
+Results: docs/chip_r03.json (atomic incremental writes per section).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "models"))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+OUT = os.path.join(REPO, "docs", "chip_r03.json")
+
+
+def save(section, value):
+    doc = {}
+    if os.path.exists(OUT):
+        with open(OUT) as f:
+            doc = json.load(f)
+    doc[section] = value
+    doc["_updated"] = time.strftime("%Y-%m-%d %H:%M:%S")
+    tmp = OUT + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2)
+    os.replace(tmp, OUT)
+    print("== saved %s" % section, flush=True)
+
+
+def _on_cpu(dev):
+    # --allow-cpu debug runs must not fuse 8 full epochs per dispatch
+    # on a host core (bench.py's own CPU path forces smoke for this)
+    return getattr(dev, "platform", "numpy") in ("cpu", "numpy")
+
+
+def sec_mnist(bench, dev, n):
+    return bench.bench_mnist(dev, n, smoke=_on_cpu(dev))  # h=8 blocks
+
+
+def sec_mnist_h1(bench, dev, n):
+    """Plan-mode (one epoch per dispatch): comparable to the stored
+    1.52M 'median_of_3x10s' anchor, isolating the h=8 effect."""
+    return bench.bench_mnist(dev, n, smoke=_on_cpu(dev), h=1)
+
+
+def sec_ae_amp(bench, dev, n):
+    return bench.bench_conv_ae(dev, n)      # AMP + bf16 dataset (bench cfg)
+
+
+def sec_ae_fp32(bench, dev, n):
+    return bench._bench_conv_ae_inner(dev, n)   # no AMP, f32 dataset
+
+
+def sec_ae_amp_remat(bench, dev, n):
+    """AMP + activation rematerialization: for an HBM-bound net,
+    recomputing activations in the backward trades cheap MXU FLOPs for
+    the expensive stored-activation traffic — the roofline says that
+    direction is free up to ~3x FLOPs."""
+    import imagenet_ae
+    orig = imagenet_ae.build_bench_workflow
+    imagenet_ae.build_bench_workflow = \
+        lambda **kw: orig(remat=True, **kw)
+    try:
+        out = bench.bench_conv_ae(dev, n)
+    finally:
+        imagenet_ae.build_bench_workflow = orig
+    out["remat"] = True
+    return out
+
+
+def sec_lm(bench, dev, n):
+    return bench.bench_lm(dev, n)
+
+
+def sec_attn(bench, dev, n):
+    import jax.numpy as jnp
+    import bench_attention as ba
+    from veles_tpu.ops.flash_attention import flash_attention
+    from veles_tpu.parallel.ring_attention import attention_reference
+    import jax
+    results = []
+    # (T, B) pairs from docs/perf.md so old and new numbers compare
+    for t, b in ((2048, 16), (8192, 1)):
+        h, d = 8, 64
+        import numpy
+        rng = numpy.random.RandomState(0)
+        q, k, v = (jnp.asarray(rng.randn(b, t, h, d), jnp.bfloat16)
+                   for _ in range(3))
+        flops_fwd = 4.0 * b * h * t * t * d / 2     # causal half
+        for train in (False, True):
+            flops = flops_fwd * (3.5 if train else 1.0)
+
+            def wrap(core):
+                if not train:
+                    return jax.jit(
+                        lambda q, k, v: core(q, k, v, causal=True))
+                return jax.jit(jax.grad(
+                    lambda q, k, v: core(
+                        q, k, v,
+                        causal=True).astype(jnp.float32).sum(),
+                    argnums=(0, 1, 2)))
+
+            row = {"t": t, "b": b, "train": train, "variants": {}}
+            dt = ba.time_fn(wrap(attention_reference), q, k, v)
+            row["variants"]["fused_xla"] = {
+                "ms": round(dt * 1e3, 2),
+                "tflops": round(flops / dt / 1e12, 2)}
+            for bq, bk in ((128, 128), (256, 128), (512, 128),
+                           (256, 256), (512, 512)):
+                if t % bq or t % bk:
+                    continue
+                name = "flash_%dx%d" % (bq, bk)
+
+                def core(q, k, v, causal=True, bq=bq, bk=bk):
+                    return flash_attention(q, k, v, causal=causal,
+                                           block_q=bq, block_k=bk)
+                try:
+                    dt = ba.time_fn(wrap(core), q, k, v)
+                    row["variants"][name] = {
+                        "ms": round(dt * 1e3, 2),
+                        "tflops": round(flops / dt / 1e12, 2)}
+                except Exception as e:        # noqa: BLE001
+                    row["variants"][name] = {"error": str(e)[-300:]}
+                print("  attn t=%d train=%s %s: %s"
+                      % (t, train, name, row["variants"][name]),
+                      flush=True)
+            results.append(row)
+    return results
+
+
+def sec_profile(bench, dev, n):
+    import jax
+    from imagenet_ae import build_bench_workflow
+    prof_dir = os.path.join(REPO, "docs", "profiles", "r03_ae")
+    os.makedirs(prof_dir, exist_ok=True)
+    with bench.mixed_precision_on():
+        wf = build_bench_workflow(image_size=128, minibatch_size=64,
+                                  n_train=256, n_valid=64)
+        wf.initialize(device=dev)
+        run_epoch = bench.epoch_runner(wf)
+        run_epoch()                           # compile outside the trace
+        bench.host_sync(wf.train_step)
+        with jax.profiler.trace(prof_dir):
+            run_epoch()
+            bench.host_sync(wf.train_step)
+    return {"trace_dir": prof_dir}
+
+
+SECTIONS = [("mnist", sec_mnist), ("mnist_h1", sec_mnist_h1),
+            ("ae_amp", sec_ae_amp),
+            ("ae_fp32", sec_ae_fp32), ("ae_amp_remat", sec_ae_amp_remat),
+            ("lm", sec_lm), ("attn", sec_attn),
+            ("profile", sec_profile)]
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--sections", default=",".join(k for k, _ in SECTIONS))
+    p.add_argument("--allow-cpu", action="store_true",
+                   help="debug only: numbers from a host are not "
+                        "recorded as chip results")
+    args = p.parse_args()
+    want = [s.strip() for s in args.sections.split(",") if s.strip()]
+
+    import bench
+    dev = bench._acquire_device()     # time-boxed probes; raises if dead
+    n = getattr(dev, "device_count", 1)
+    platform = getattr(dev, "platform", "numpy")
+    if platform in ("cpu", "numpy") and not args.allow_cpu:
+        print("no accelerator (platform=%s); refusing to record host "
+              "numbers as chip results" % platform, file=sys.stderr)
+        return 2
+    import jax
+    save("_device", {"platform": platform, "n_chips": n,
+                     "device_kind": str(getattr(jax.devices()[0],
+                                                "device_kind", "?"))})
+    by_name = dict(SECTIONS)
+    for name in want:
+        fn = by_name.get(name)
+        if fn is None:
+            print("unknown section %r" % name, file=sys.stderr)
+            continue
+        print("== section %s" % name, flush=True)
+        t0 = time.time()
+        try:
+            out = fn(bench, dev, n)
+            save(name, {"result": out,
+                        "elapsed_s": round(time.time() - t0, 1)})
+        except Exception as e:        # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+            save(name, {"error": str(e)[-500:],
+                        "elapsed_s": round(time.time() - t0, 1)})
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

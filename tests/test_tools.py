@@ -236,3 +236,56 @@ def test_profile_step_produces_trace(tmp_path):
     assert r.returncode == 0, r.stdout + r.stderr
     produced = [f for _r, _d, fs in os.walk(out) for f in fs]
     assert any(f.endswith(".xplane.pb") for f in produced), produced
+
+
+def test_frontend_wizard_serving_round_trip():
+    """The live wizard (reference veles/__main__.py:258-332 tornado
+    composer): GET the page and options, POST a state dict, get back
+    the assembled command VALIDATED by the real parser."""
+    import json as _json
+    import urllib.request
+    httpd = generate_frontend.serve(port=0)
+    import threading
+    th = threading.Thread(target=httpd.serve_forever, daemon=True)
+    th.start()
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        page = urllib.request.urlopen(base + "/").read().decode()
+        assert "command composer" in page
+        opts = _json.loads(urllib.request.urlopen(
+            base + "/options").read())
+        assert any(o["flag"] == "--optimize" for o in opts)
+
+        def post(state):
+            req = urllib.request.Request(
+                base + "/compose", data=_json.dumps(state).encode(),
+                headers={"Content-Type": "application/json"})
+            return _json.loads(urllib.request.urlopen(req).read())
+
+        out = post({"model": "models/lines.py", "optimize": "4:2",
+                    "optimize_workers": 4, "backend": "cpu",
+                    "config_list": ["root.lines.epochs=2"]})
+        assert out["valid"], out
+        assert "--optimize 4:2" in out["cmd"]
+        assert "--optimize-workers 4" in out["cmd"]
+        assert out["argv"][0] == "models/lines.py"   # positionals first
+        assert "root.lines.epochs=2" in out["argv"][1]
+        # a bad value must come back as a parser error, not a 500
+        bad = post({"model": "m.py", "optimize_workers": "lots"})
+        assert not bad["valid"]
+        assert "lots" in bad["error"] or "invalid" in bad["error"]
+        # zero is a VALUE (rank 0 is the coordinator), not "unset"
+        zero = post({"model": "m.py", "process_id": 0,
+                     "num_processes": 2,
+                     "coordinator": "127.0.0.1:5000"})
+        assert "--process-id 0" in zero["cmd"], zero
+        # positionals bind in PARSER order regardless of JSON key order
+        swapped = post({"config_list": ["root.x=1"], "model": "m.py"})
+        assert swapped["argv"][0] == "m.py", swapped
+        # cmd is shell-safe: spaces survive as one token
+        spacey = post({"model": "my models/m.py"})
+        assert "'my models/m.py'" in spacey["cmd"], spacey
+        assert spacey["argv"][0] == "my models/m.py"
+    finally:
+        httpd.shutdown()
+        httpd.server_close()

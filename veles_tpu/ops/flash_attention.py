@@ -122,6 +122,12 @@ def _fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
             pltpu.VMEM((block_q, LANE), jnp.float32),
             pltpu.VMEM((block_q, LANE), jnp.float32),
         ],
+        # scheduling hint, not semantics: head and Q-block grid dims
+        # carry no state between steps, so Mosaic may parallelize /
+        # pipeline them; only the K/V dim accumulates in scratch and
+        # must stay sequential ("arbitrary")
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
 

@@ -1,12 +1,24 @@
-"""generate_frontend: static HTML command composer for the CLI.
+"""generate_frontend: HTML command composer for the CLI.
 
 Equivalent of the reference's veles/scripts/generate_frontend.py (which
 walked the distributed argparse registry and emitted the ``--frontend``
-wizard HTML). Here the single source of truth is
-veles_tpu/cmdline.py's parser: every option becomes a form control and
-the page assembles the ``python -m veles_tpu …`` command line live.
+wizard HTML) plus the live wizard the reference served from
+veles/__main__.py:258-332 (an interactive tornado command composer).
+Here the single source of truth is veles_tpu/cmdline.py's parser: every
+option becomes a form control and the page assembles the ``python -m
+veles_tpu …`` command line live.
 
-Usage: ``python -m veles_tpu.scripts.generate_frontend [-o frontend.html]``
+Usage:
+  python -m veles_tpu.scripts.generate_frontend [-o frontend.html]
+  python -m veles_tpu.scripts.generate_frontend --serve [--port N]
+
+``--serve`` adds the interactive round trip the static page cannot do:
+``POST /compose`` with a ``{dest: value}`` state dict returns the
+assembled command line AND validates it against the real parser (the
+reference wizard's compose step; launching the command stays with the
+user — a web endpoint that executes arbitrary CLI strings would be an
+injection surface, which is also why the reference's execute button
+stayed on localhost).
 """
 
 from __future__ import annotations
@@ -102,10 +114,131 @@ def generate(out_path: str) -> str:
     return out_path
 
 
+def compose(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Assemble ``python -m veles_tpu …`` argv from a ``{dest: value}``
+    state dict and VALIDATE it against the real parser. Returns
+    ``{"cmd", "argv", "valid", "error"}`` — the server-side half of the
+    wizard round trip."""
+    import shlex
+    from ..cmdline import make_parser
+    parser = make_parser()
+    actions = [a for a in parser._actions
+               if not isinstance(a, argparse._HelpAction)]
+
+    def skipped(value):
+        # None/empty/unchecked-box are "not set". NOT `in (None, "",
+        # False)`: 0 == False, which would silently drop legitimate
+        # zero values (--process-id 0 is exactly the coordinator)
+        return value is None or value is False or value == ""
+
+    argv: List[str] = []
+    # positionals in the PARSER's declared order (model, config,
+    # config_list) — client JSON key order must not re-bind them — and
+    # first overall (argparse cannot take a second positional group
+    # after flags, the same rule the trial-scheduler children follow)
+    for a in actions:
+        if a.option_strings:
+            continue
+        value = state.get(a.dest)
+        if skipped(value):
+            continue
+        argv.extend([str(v) for v in value] if isinstance(value, list)
+                    else [str(value)])
+    for a in actions:
+        if not a.option_strings:
+            continue
+        value = state.get(a.dest)
+        if skipped(value):
+            continue
+        flag = max(a.option_strings, key=len)
+        if isinstance(a, (argparse._StoreTrueAction,
+                          argparse._StoreFalseAction)):
+            argv.append(flag)
+        elif isinstance(a, argparse._CountAction):
+            argv.extend([flag] * int(value))
+        else:
+            argv.extend([flag, str(value)])
+    parser.error = lambda message: (_ for _ in ()).throw(
+        ValueError(message))
+    try:
+        parser.parse_args(argv)
+        valid, error = True, None
+    except (ValueError, SystemExit) as exc:
+        valid, error = False, str(exc)
+    # shlex.join: a value with spaces/metachars must round-trip through
+    # a shell into exactly this argv
+    return {"cmd": "python -m veles_tpu " + shlex.join(argv),
+            "argv": argv, "valid": valid, "error": error}
+
+
+def serve(port: int = 0):
+    """Serve the wizard: GET / (the page), GET /options (parser
+    surface), POST /compose (assemble + validate). Returns the server;
+    caller owns shutdown. Binds localhost only."""
+    import json as _json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    from ..cmdline import make_parser
+    page = _PAGE.format(options_json=_json.dumps(
+        collect_options(make_parser())).replace("<", "\\u003c"))
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, body: bytes, ctype: str, code: int = 200):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/options":
+                self._send(_json.dumps(collect_options(
+                    make_parser())).encode(), "application/json")
+            elif self.path in ("/", "/index.html"):
+                self._send(page.encode(), "text/html; charset=utf-8")
+            else:
+                self._send(b"not found", "text/plain", 404)
+
+        def do_POST(self):
+            if self.path != "/compose":
+                self._send(b"not found", "text/plain", 404)
+                return
+            n = int(self.headers.get("Content-Length", 0))
+            try:
+                state = _json.loads(self.rfile.read(n) or b"{}")
+                out = compose(state)
+                self._send(_json.dumps(out).encode(),
+                           "application/json")
+            except Exception as exc:      # noqa: BLE001
+                self._send(_json.dumps(
+                    {"valid": False, "error": str(exc)}).encode(),
+                    "application/json", 400)
+
+        def log_message(self, *a):        # quiet test runs
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", port), Handler)
+    return httpd
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("-o", "--output", default="frontend.html")
+    parser.add_argument("--serve", action="store_true",
+                        help="serve the interactive wizard instead of "
+                             "writing a static page")
+    parser.add_argument("--port", type=int, default=8968)
     args = parser.parse_args(argv)
+    if args.serve:
+        httpd = serve(args.port)
+        print("wizard at http://127.0.0.1:%d/"
+              % httpd.server_address[1], flush=True)
+        try:
+            httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            httpd.server_close()
+        return 0
     print(generate(args.output))
     return 0
 
