@@ -15,7 +15,8 @@ from .fullbatch import FullBatchLoader, FullBatchLoaderMSE  # noqa: F401
 from .file_loader import (FileFilter, FileListScanner,      # noqa: F401
                           auto_label)
 from .image import (ImageLoader, ClassImageLoader, decode_image,  # noqa
-                    augment, deterministic_split)
+                    augment, deterministic_split,
+                    FileListImageLoader, ImageLoaderMSE)
 from .pickles import PicklesLoader                     # noqa: F401
 from .hdf5 import HDF5Loader                           # noqa: F401
 from .saver import MinibatchesSaver, MinibatchesLoader  # noqa: F401

@@ -70,6 +70,11 @@ class FullBatchLoader(Loader):
                     getattr(self, "original_targets", None)):
             if arr is not None and arr:
                 arr.mem[start:] = arr.mem[perm]
+        paths = getattr(self, "row_paths", None)
+        if paths:
+            # provenance must follow the row permutation or downstream
+            # path-keyed matching (ImageLoaderMSE basenames) misaligns
+            self.row_paths = paths[:start] + [paths[i] for i in perm]
         self.class_lengths[VALID] += n_valid
         self.class_lengths[TRAIN] -= n_valid
 

@@ -13,14 +13,14 @@ time, not per epoch.
 from __future__ import annotations
 
 import os
-from typing import Dict, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy
 
 from ..error import VelesError
 from .base import TEST, VALID, TRAIN
 from .file_loader import FileFilter, FileListScanner, auto_label
-from .fullbatch import FullBatchLoader
+from .fullbatch import FullBatchLoader, FullBatchLoaderMSE
 
 IMAGE_PATTERNS = ("*.png", "*.jpg", "*.jpeg", "*.bmp", "*.gif", "*.tiff",
                   "*.webp")
@@ -202,6 +202,9 @@ class ImageLoader(FullBatchLoader):
         self.labels_mapping = {n: i for i, n in enumerate(names)}
         self.label_names = {i: n for n, i in self.labels_mapping.items()}
         data, labels = [], []
+        #: source file per dataset ROW (augment variants repeat their
+        #: source) — provenance for debugging and the MSE target match
+        self.row_paths: List[str] = []
         lengths = [0, 0, 0]
         for cls in (TEST, VALID, TRAIN):
             for path in per_class[cls]:
@@ -220,6 +223,7 @@ class ImageLoader(FullBatchLoader):
                 label = self.labels_mapping[self.get_label(path)]
                 data.extend(variants)
                 labels.extend([label] * len(variants))
+                self.row_paths.extend([path] * len(variants))
                 lengths[cls] += len(variants)
         shapes = {v.shape for v in data}
         if len(shapes) != 1:
@@ -326,3 +330,154 @@ class ClassImageLoader(ImageLoader):
 
     def get_label(self, path: str) -> str:
         return os.path.basename(os.path.dirname(path))
+
+
+class FileListImageLoader(ImageLoader):
+    """Index-file driven image loader (reference: FileListImageLoader,
+    veles/loader/file_image.py:130 — "text file, with each line giving
+    an image filename and label"; useful for large datasets where the
+    split lives in manifest files, not directory structure).
+
+    ``train_list`` / ``validation_list`` / ``test_list``: text files
+    with one ``path[<whitespace>label]`` per line (blank lines and
+    ``#`` comments skipped). Relative paths resolve against the list
+    file's own directory. Lines without a label fall back to the
+    containing-directory convention (auto_label)."""
+
+    MAPPING = "file_list_image_loader"
+
+    def __init__(self, workflow, train_list: Optional[str] = None,
+                 validation_list: Optional[str] = None,
+                 test_list: Optional[str] = None, **kwargs) -> None:
+        self._explicit_labels: Dict[str, str] = {}
+        per_class = {}
+        for key, list_path in (("train_paths", train_list),
+                               ("validation_paths", validation_list),
+                               ("test_paths", test_list)):
+            per_class[key] = (self._parse_list(list_path)
+                              if list_path else ())
+        super().__init__(workflow, **per_class, **kwargs)
+
+    def _parse_list(self, list_path: str) -> List[str]:
+        if not os.path.exists(list_path):
+            raise VelesError("no such list file: %s" % list_path)
+        base = os.path.dirname(os.path.abspath(list_path))
+        paths = []
+        with open(list_path) as fin:
+            for line in fin:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split(None, 1)
+                path = parts[0]
+                if not os.path.isabs(path):
+                    path = os.path.join(base, path)
+                paths.append(path)
+                if len(parts) == 2:
+                    self._explicit_labels[path] = parts[1].strip()
+        if not paths:
+            raise VelesError("list file %s has no entries" % list_path)
+        return paths
+
+    def get_label(self, path: str) -> str:
+        return self._explicit_labels.get(path) or auto_label(path)
+
+
+class ImageLoaderMSE(ImageLoader, FullBatchLoaderMSE):
+    """Image-target regression loader (reference: ImageLoaderMSE /
+    FileImageLoaderMSE, veles/loader/image_mse.py): each input image's
+    MSE target is itself an image from ``target_paths``.
+
+    Matching (the reference's two schemes):
+    - ``target_by_label=True`` (default): ONE target image per label —
+      the target whose auto_label equals the input's label (the classic
+      VELES channels setup: per-class ideal template).
+    - ``target_by_label=False``: 1:1 by file BASENAME (a denoising /
+      reconstruction pair tree); requires augmentation multiplicity 1
+      (each row must map to exactly one target).
+
+    Targets are decoded with the same size/color policy as inputs and
+    are never augmented (reference behavior)."""
+
+    MAPPING = "image_mse_loader"
+
+    def __init__(self, workflow, target_paths: Sequence[str] = (),
+                 target_by_label: bool = True, **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        if not target_paths:
+            raise VelesError("ImageLoaderMSE needs target_paths")
+        self.target_paths = list(target_paths)
+        self.target_by_label = bool(target_by_label)
+        if not self.target_by_label and (
+                self.mirror or self.crop is not None
+                or any(r % 360 for r in self.rotations)):
+            # not just multiplicity: ANY spatial transform of the input
+            # (including a single random crop, host or device path)
+            # misaligns a basename-matched reconstruction pair while
+            # the target stays untransformed
+            raise VelesError(
+                "basename-matched targets need geometrically "
+                "untransformed inputs (set target_by_label=True for "
+                "per-label targets, or drop mirror/rotations/crop)")
+
+    def load_data(self) -> None:
+        super().load_data()
+        file_filter = FileFilter(include=IMAGE_PATTERNS + ("*.npy",))
+        targets = []
+        for path in self.target_paths:
+            if os.path.isfile(path):
+                targets.append(path)
+            else:
+                targets.extend(file_filter.scan(path))
+        if not targets:
+            raise VelesError("no target images under %s"
+                             % self.target_paths)
+        decoded = {p: decode_image(p, self.size, self.color)
+                   for p in targets}
+        if self.target_by_label:
+            by_label = {}
+            for p, arr in decoded.items():
+                label = auto_label(p)
+                if label in by_label:
+                    raise VelesError(
+                        "duplicate target for label %r" % label)
+                by_label[label] = arr
+            missing = {self.label_names[l]
+                       for l in self.original_labels.mem
+                       } - set(by_label)
+            if missing:
+                raise VelesError("labels with no target image: %s"
+                                 % sorted(missing))
+            rows = [by_label[self.label_names[int(l)]]
+                    for l in self.original_labels.mem]
+        else:
+            by_base: Dict[str, numpy.ndarray] = {}
+            for p, arr in decoded.items():
+                base = os.path.basename(p)
+                if base in by_base:
+                    # same ambiguity the label branch rejects: which
+                    # target a row trains against must never depend on
+                    # directory walk order
+                    raise VelesError(
+                        "duplicate target basename %r across target "
+                        "paths" % base)
+                by_base[base] = arr
+            missing = [p for p in self.row_paths
+                       if os.path.basename(p) not in by_base]
+            if missing:
+                raise VelesError(
+                    "inputs with no basename-matched target: %s"
+                    % sorted(os.path.basename(p)
+                             for p in missing)[:10])
+            rows = [by_base[os.path.basename(p)]
+                    for p in self.row_paths]
+        shapes = {r.shape for r in rows}
+        if len(shapes) != 1:
+            raise VelesError("target images have differing shapes %s — "
+                             "pass size=(H, W)" % sorted(shapes))
+        from .fullbatch import _storage_dtype
+        stacked = numpy.stack(rows)
+        # same storage policy as every other originals path (e.g. the
+        # bf16 dataset_dtype bench config must apply to targets too)
+        self.original_targets.reset(numpy.ascontiguousarray(
+            stacked, dtype=_storage_dtype(stacked)))
