@@ -141,6 +141,32 @@ def sec_attn(bench, dev, n):
                 print("  attn t=%d train=%s %s: %s"
                       % (t, train, name, row["variants"][name]),
                       flush=True)
+            if train:
+                # pallas-bwd (default) vs jnp blockwise bwd, same
+                # 128x128 forward — the new backward's own A/B
+                from veles_tpu.config import root as vt_root
+                prev_bwd = vt_root.common.engine.get(
+                    "flash_attention_pallas_bwd", True)
+                vt_root.common.engine.flash_attention_pallas_bwd = False
+                try:
+                    jax.clear_caches()
+                    dt = ba.time_fn(wrap(flash_attention), q, k, v)
+                    row["variants"]["flash_128x128_jnpbwd"] = {
+                        "ms": round(dt * 1e3, 2),
+                        "tflops": round(flops / dt / 1e12, 2)}
+                except Exception as e:        # noqa: BLE001
+                    row["variants"]["flash_128x128_jnpbwd"] = {
+                        "error": str(e)[-300:]}
+                finally:
+                    # restore what the OPERATOR configured, not a
+                    # hard-coded default — later sections must measure
+                    # the configured setup
+                    vt_root.common.engine.flash_attention_pallas_bwd = \
+                        prev_bwd
+                    jax.clear_caches()
+                print("  attn t=%d train=True flash_128x128_jnpbwd: %s"
+                      % (t, row["variants"]["flash_128x128_jnpbwd"]),
+                      flush=True)
             results.append(row)
     return results
 

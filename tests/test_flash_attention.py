@@ -97,3 +97,34 @@ def test_mha_unit_routes_through_flash():
     finally:
         vt.root.common.engine.flash_attention = prev_flash
         vt.root.common.engine.compute_dtype = prev
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_bwd_matches_jnp_bwd(causal):
+    """The Pallas backward twins the jnp blockwise oracle exactly
+    (same math, same f32 accumulation) and the config switch selects
+    between them."""
+    from veles_tpu.config import root
+    rng = numpy.random.RandomState(9)
+    q, k, v = (jnp.asarray(rng.randn(2, 256, 2, 64), jnp.float32)
+               for _ in range(3))
+
+    def loss_fn(qq, kk, vv):
+        return (flash_attention(qq, kk, vv, causal=causal)
+                .astype(jnp.float32) ** 2).sum()
+
+    def g(qq, kk, vv):
+        return jax.grad(loss_fn, argnums=(0, 1, 2))(qq, kk, vv)
+
+    assert root.common.engine.get("flash_attention_pallas_bwd",
+                                  True) is True
+    g_pallas = g(q, k, v)
+    root.common.engine.flash_attention_pallas_bwd = False
+    try:
+        jax.clear_caches()      # the switch lives outside the trace
+        g_jnp = g(q, k, v)
+    finally:
+        root.common.engine.flash_attention_pallas_bwd = True
+        jax.clear_caches()
+    for a, b in zip(g_pallas, g_jnp):
+        numpy.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)

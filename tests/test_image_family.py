@@ -172,13 +172,28 @@ def test_image_mse_loader_label_targets(tmp_path):
         target_paths=[str(tmp_path / "targets")],
         validation_ratio=0.25, minibatch_size=2, name="mse-l")
     loader.load_data()
-    assert loader.original_targets.shape == (8, 5, 5, 3)
-    # each row's target matches its LABEL's template (survives the
-    # validation-ratio row permutation)
+    # one TABLE row per label, not one per dataset row (8 rows would
+    # mean per-row template copies — the HBM-doubling bug)
+    assert loader.targets_by_label is True
+    assert loader.original_targets.shape == (2, 5, 5, 3)
+    # each row's effective target (table gathered through its label)
+    # matches its class template — survives the validation-ratio
+    # row permutation
     for row, label in enumerate(loader.original_labels.mem):
         want = 0.25 if loader.label_names[int(label)] == "a" else 0.75
-        got = float(loader.original_targets.mem[row].mean())
+        got = float(
+            loader.original_targets.mem[int(label)].mean())
         assert abs(got - want) < 0.02, (row, label, got)
+    # the host minibatch fill composes the same gather
+    loader.create_minibatch_data()
+    loader.minibatch_indices.reset(numpy.arange(2))
+    loader.minibatch_size = 2
+    loader.fill_minibatch()
+    for i in range(2):
+        lab = int(loader.original_labels.mem[i])
+        want = 0.25 if loader.label_names[lab] == "a" else 0.75
+        assert abs(float(loader.minibatch_targets.mem[i].mean())
+                   - want) < 0.02
 
 
 def test_image_mse_loader_basename_targets(tmp_path):
@@ -253,3 +268,39 @@ def test_image_mse_trains_end_to_end(tmp_path):
     # a 1x1 conv can represent x -> 1-x exactly; well under the
     # do-nothing rmse (~0.41 for uniform pixels)
     assert res["best_rmse"] < 0.15, res
+
+
+def test_image_mse_label_targets_train_through_fused_step(tmp_path):
+    """Label-indexed target TABLE through the fused device step: the
+    composed gather (row → label → template) must train — class-coded
+    inputs regress onto their class template (affine-learnable)."""
+    from veles_tpu.loader import ImageLoaderMSE
+    rng = numpy.random.RandomState(4)
+    for cls, level, tgt in (("lo", 0.2, 0.25), ("hi", 0.8, 0.75)):
+        d = tmp_path / "train" / cls
+        d.mkdir(parents=True)
+        for i in range(8):
+            img = numpy.clip(level + 0.02 * rng.randn(6, 6, 3), 0, 1)
+            _write_png(str(d / ("s%d.png" % i)), img)
+        t = tmp_path / "targets" / cls
+        t.mkdir(parents=True)
+        _write_png(str(t / "ideal.png"), numpy.full((6, 6, 3), tgt))
+    loader = ImageLoaderMSE(
+        None, train_paths=[str(tmp_path / "train")],
+        target_paths=[str(tmp_path / "targets")],
+        validation_ratio=0.25, minibatch_size=4, name="mse-tbl")
+    wf = nn.StandardWorkflow(
+        name="tbl", layers=[
+            {"type": "conv", "n_kernels": 3, "kx": 1, "ky": 1,
+             "learning_rate": 0.5},
+        ], loader_unit=loader, loss_function="mse",
+        decision_config=dict(max_epochs=30, fail_iterations=30))
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    assert loader.targets_by_label is True
+    assert loader.original_targets.shape[0] == 2    # table, not rows
+    wf.run()
+    res = wf.gather_results()
+    # affine map level→target is exactly representable; do-nothing rmse
+    # is ~0.06 (|0.2-0.25|, |0.8-0.75|) + noise — gate well below the
+    # all-zeros rmse (~0.56) and below predict-global-mean (~0.25)
+    assert res["best_rmse"] < 0.06, res

@@ -66,8 +66,12 @@ class FullBatchLoader(Loader):
         start = self.class_lengths[0] + self.class_lengths[VALID]
         perm = start + self.prng.permutation(n_train)
         self.original_data.mem[start:] = self.original_data.mem[perm]
-        for arr in (self.original_labels,
-                    getattr(self, "original_targets", None)):
+        to_permute = [self.original_labels]
+        # a label-indexed target TABLE is row-order independent — it
+        # must never be permuted like row-aligned targets
+        if not getattr(self, "targets_by_label", False):
+            to_permute.append(getattr(self, "original_targets", None))
+        for arr in to_permute:
             if arr is not None and arr:
                 arr.mem[start:] = arr.mem[perm]
         paths = getattr(self, "row_paths", None)
@@ -112,9 +116,19 @@ class FullBatchLoader(Loader):
 
 class FullBatchLoaderMSE(FullBatchLoader, LoaderMSE):
     """Full-batch loader with regression targets
-    (reference: veles/loader/fullbatch.py:563)."""
+    (reference: veles/loader/fullbatch.py:563).
+
+    ``targets_by_label = True`` switches ``original_targets`` from a
+    row-aligned array to a per-LABEL table indexed by the row's label
+    (the channels scheme: one template per class stored ONCE, not
+    copied per row — per-row materialization would double the dominant
+    HBM buffer). The fused step and the host minibatch fill both
+    compose the gather through ``original_labels``."""
 
     hide_from_registry = True
+
+    #: when True, original_targets rows are LABEL ids, not dataset rows
+    targets_by_label = False
 
     def __init__(self, workflow, **kwargs):
         super().__init__(workflow, **kwargs)
@@ -142,4 +156,8 @@ class FullBatchLoaderMSE(FullBatchLoader, LoaderMSE):
         if self.original_targets:
             idx = self.minibatch_indices.mem
             t = self.minibatch_targets.map_invalidate()
-            t[...] = self.original_targets.mem[idx]
+            if self.targets_by_label:
+                t[...] = self.original_targets.mem[
+                    self.original_labels.mem[idx]]
+            else:
+                t[...] = self.original_targets.mem[idx]

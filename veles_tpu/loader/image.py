@@ -442,14 +442,17 @@ class ImageLoaderMSE(ImageLoader, FullBatchLoaderMSE):
                     raise VelesError(
                         "duplicate target for label %r" % label)
                 by_label[label] = arr
-            missing = {self.label_names[l]
-                       for l in self.original_labels.mem
-                       } - set(by_label)
+            missing = set(self.labels_mapping) - set(by_label)
             if missing:
                 raise VelesError("labels with no target image: %s"
                                  % sorted(missing))
-            rows = [by_label[self.label_names[int(l)]]
-                    for l in self.original_labels.mem]
+            # a TABLE with one row per label id — stored once, gathered
+            # through the row's label by both the host fill and the
+            # fused step (per-row materialization would copy each
+            # class template n_rows times)
+            rows = [by_label[self.label_names[i]]
+                    for i in range(len(self.label_names))]
+            self.targets_by_label = True
         else:
             by_base: Dict[str, numpy.ndarray] = {}
             for p, arr in decoded.items():

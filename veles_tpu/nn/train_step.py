@@ -377,6 +377,12 @@ class TrainStep(AcceleratedUnit):
         if self.target_mode == "input":
             return batch
         if self.target_mode == "targets":
+            if getattr(self.loader, "targets_by_label", False):
+                # per-label template TABLE: row → label → template,
+                # composed gathers (the table is n_labels rows, stored
+                # once — never materialized per dataset row)
+                return self._gather(targets,
+                                    self._gather(labels, indices))
             return self._gather(targets, indices)
         raise Bug("bad target_mode %r" % self.target_mode)
 
@@ -530,7 +536,12 @@ class TrainStep(AcceleratedUnit):
         labels = (loader.original_labels.device_view(sharding=ds_sh)
                   if loader.original_labels else None)
         targets = getattr(loader, "original_targets", None)
-        targets = (targets.device_view(sharding=ds_sh)
+        # a label-indexed table has n_labels rows, not n_rows — row
+        # sharding over 'data' would be wrong AND wasteful (it is tiny:
+        # replicate it)
+        tgt_sh = (repl if getattr(loader, "targets_by_label", False)
+                  else ds_sh)
+        targets = (targets.device_view(sharding=tgt_sh)
                    if targets is not None and targets else dataset)
         if labels is None:
             labels = self._dummy_labels(dataset)

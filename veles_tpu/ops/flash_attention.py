@@ -11,8 +11,11 @@ same tiling discipline as the public jax.experimental.pallas TPU ops).
 
 The backward pass recomputes scores blockwise from the saved
 log-sum-exp (``lse``) under ``jax.custom_vjp`` — O(T·block) memory, no
-(T, T) materialization — in plain jnp (a lax.scan over K/V blocks), which
-XLA maps onto the MXU well; the forward is where the pallas win is.
+(T, T) materialization. Two Pallas kernels (dk/dv accumulating over Q
+blocks; dq accumulating over K/V blocks) keep the recompute working set
+VMEM-resident like the forward; ``_bwd_blockwise`` (plain jnp) is kept
+as the oracle and the fallback
+(``root.common.engine.flash_attention_pallas_bwd = False``).
 
 Layout contract: (B, T, H, D) like the rest of the attention stack; heads
 are folded into the grid's leading dimension. D is zero-padded to the
@@ -167,6 +170,193 @@ def _bwd_blockwise(causal, scale, block_k, res, do):
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype))
 
 
+def _bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale: float, causal: bool, block_q: int,
+                    block_k: int):
+    from jax.experimental import pallas as pl
+
+    ki, qi = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _step():
+        q = q_ref[0]                       # (bq, D)
+        do = do_ref[0]                     # (bq, D)
+        k = k_ref[0]                       # (bk, D)
+        v = v_ref[0]
+        lse = lse_ref[0][:1].T             # (bq, 1) from (8, bq) row 0
+        delta = delta_ref[0][:1].T         # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)               # (bq, bk) f32
+        # dv_j += p^T do_i    (contract the bq axis)
+        dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (bq, bk)
+        ds = p * (dp - delta) * scale
+        # dk_j += ds^T q_i
+        dk_acc[:] = dk_acc[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        # q blocks entirely above the diagonal contribute nothing to
+        # this k block
+        pl.when(q_start + block_q - 1 >= k_start)(_step)
+    else:
+        _step()
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale: float, causal: bool,
+                   block_q: int, block_k: int):
+    from jax.experimental import pallas as pl
+
+    qi, ki = pl.program_id(1), pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = qi * block_q
+    k_start = ki * block_k
+
+    def _step():
+        q = q_ref[0]
+        do = do_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        lse = lse_ref[0][:1].T
+        delta = delta_ref[0][:1].T
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        # dq_i += ds k_j
+        dq_acc[:] = dq_acc[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(k_start <= q_start + block_q - 1)(_step)
+    else:
+        _step()
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
+                block_q: int, block_k: int, interpret: bool):
+    """Pallas twin of ``_bwd_blockwise``: same math, VMEM-resident
+    blockwise recompute. delta = rowsum(do*o) is O(T·D) and computed
+    outside; lse/delta ride in the forward's (G, 8, T) sublane-padded
+    layout."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    g, t, d = q.shape
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
+    pad8 = jnp.broadcast_to(delta[:, None, :], (g, 8, t))
+    lse8 = jnp.broadcast_to(lse[:, None, :], (g, 8, t))
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k)
+    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM)
+    kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    row_q = pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, j),
+                         memory_space=pltpu.VMEM)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(g, t // block_k, t // block_q),
+        in_specs=[qspec, qspec, kspec, kspec, row_q, row_q],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((g, t, d), k.dtype),
+            jax.ShapeDtypeStruct((g, t, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, do, k, v, lse8, pad8)
+    dq, = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(g, t // block_q, t // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((g, t, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, do, k, v, lse8, pad8)
+    return dq, dk, dv
+
+
+def _use_pallas_bwd() -> bool:
+    from ..config import root
+    return bool(root.common.engine.get("flash_attention_pallas_bwd",
+                                       True))
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
     o, _ = _fwd_pallas(q, k, v, causal, scale, block_q, block_k,
@@ -181,6 +371,10 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    if _use_pallas_bwd():
+        q, k, v, o, lse = res
+        return _bwd_pallas(q, k, v, o, lse, do, causal, scale,
+                           block_q, block_k, interpret)
     return _bwd_blockwise(causal, scale, block_k, res, do)
 
 
