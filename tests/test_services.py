@@ -128,3 +128,70 @@ def test_non_object_json_bodies_get_400():
     assert status == 400
     loader.close()
     api.stop()
+
+
+def test_restful_image_serving_roundtrip(tmp_path):
+    """Image serving (reference RestfulImageLoader,
+    veles/loader/restful.py:133): POST a base64-encoded PNG; the loader
+    decodes it with the training-time size/color policy and the
+    forward chain answers — the 'input' numeric path keeps working."""
+    import base64
+    import io
+    from PIL import Image
+    from veles_tpu.loader import RestfulImageLoader
+
+    wf = vt.Workflow(name="serve-img")
+    rep = Repeater(wf)
+    loader = RestfulImageLoader(wf, sample_shape=(4, 4, 3),
+                                size=(4, 4), color="RGB", timeout=30.0,
+                                name="img_loader")
+    fwd = nn.All2AllSoftmax(wf, output_sample_shape=2, name="fwd")
+    api = vt.RESTfulAPI(wf, loader=loader, port=0, request_timeout=30.0)
+    rep.link_from(wf.start_point)
+    loader.link_from(rep)
+    fwd.link_from(loader)
+    fwd.link_attrs(loader, ("input", "minibatch_data"))
+    api.link_from(fwd)
+    api.link_attrs(fwd, ("input", "output"))
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    rep.link_from(api)
+    t = threading.Thread(target=wf.run, daemon=True)
+    t.start()
+    url = "http://127.0.0.1:%d/api" % api.port
+    rng = numpy.random.RandomState(0)
+    img = (rng.rand(8, 8, 3) * 255).astype(numpy.uint8)   # resized 8→4
+    buf = io.BytesIO()
+    Image.fromarray(img).save(buf, format="PNG")
+    payload = base64.b64encode(buf.getvalue()).decode()
+    status, body = _post(url, {"image": payload})
+    assert status == 200, body
+    got = numpy.asarray(body["result"])
+    assert got.shape == (2,) and abs(got.sum() - 1.0) < 1e-4
+    # undecodable image → 400, service stays alive
+    status, _ = _post(url, {"image": base64.b64encode(b"junk").decode()})
+    assert status == 400
+    status, body = _post(url, {"image": payload})
+    assert status == 200
+    loader.close()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    api.stop()
+
+
+def test_restful_bad_shape_does_not_kill_service():
+    """A wrong-shaped (but well-formed) sample must fail THAT request
+    with 400 — not raise later on the workflow thread and 504 every
+    subsequent request (producer-side validation in feed)."""
+    wf, loader, fwd, api = build_serving_workflow()
+    t = threading.Thread(target=wf.run, daemon=True)
+    t.start()
+    url = "http://127.0.0.1:%d/api" % api.port
+    status, body = _post(url, {"input": [1.0, 2.0]})     # declared (4,)
+    assert status == 400, body
+    # the loop survived: a good request still works
+    status, body = _post(url, {"input": [0.1, 0.2, 0.3, 0.4]})
+    assert status == 200, body
+    loader.close()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    api.stop()

@@ -21,7 +21,8 @@ from .pickles import PicklesLoader                     # noqa: F401
 from .hdf5 import HDF5Loader                           # noqa: F401
 from .saver import MinibatchesSaver, MinibatchesLoader  # noqa: F401
 from .stream import (StreamLoader, InteractiveLoader,  # noqa: F401
-                     RestfulLoader, ZeroMQLoader)
+                     RestfulLoader, ZeroMQLoader,
+                     InteractiveImageLoader, RestfulImageLoader)
 from .ensemble import EnsembleLoader                   # noqa: F401
 from .sound import SoundFileLoader, decode_audio       # noqa: F401
 from .kv_store import LMDBLoader, HDFSTextLoader       # noqa: F401

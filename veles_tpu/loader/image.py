@@ -26,12 +26,17 @@ IMAGE_PATTERNS = ("*.png", "*.jpg", "*.jpeg", "*.bmp", "*.gif", "*.tiff",
                   "*.webp")
 
 
-def decode_image(path: str, size: Optional[Tuple[int, int]] = None,
+def decode_image(path, size: Optional[Tuple[int, int]] = None,
                  color: str = "RGB") -> numpy.ndarray:
-    """File → HWC float32 in [0, 1] with a codec-fallback chain
-    (reference used jpeg4py with a PIL fallback, veles/loader/image.py:
-    106+): PIL → imageio → matplotlib; .npy/.npz arrays load directly."""
-    if path.endswith((".npy", ".npz")):
+    """File (or raw encoded ``bytes`` — the serving path posts image
+    payloads, not paths) → HWC float32 in [0, 1] with a codec-fallback
+    chain (reference used jpeg4py with a PIL fallback,
+    veles/loader/image.py:106+): PIL → imageio → matplotlib; .npy/.npz
+    arrays load directly."""
+    if isinstance(path, (bytes, bytearray)):
+        import io
+        path = io.BytesIO(bytes(path))
+    if isinstance(path, str) and path.endswith((".npy", ".npz")):
         arr = numpy.load(path)
         if hasattr(arr, "files"):          # npz: first member
             arr = arr[arr.files[0]]
@@ -43,6 +48,8 @@ def decode_image(path: str, size: Optional[Tuple[int, int]] = None,
         errors = []
         try:
             from PIL import Image
+            if hasattr(path, "seek"):
+                path.seek(0)      # fallback chain may retry the stream
             with Image.open(path) as img:
                 img = img.convert(color)
                 if size is not None:
@@ -56,6 +63,8 @@ def decode_image(path: str, size: Optional[Tuple[int, int]] = None,
                 try:
                     import importlib
                     m = importlib.import_module(mod)
+                    if hasattr(path, "seek"):
+                        path.seek(0)
                     arr = numpy.asarray(getattr(m, fn)(path),
                                         dtype=numpy.float32)
                     if arr.max() > 1.5:

@@ -28,6 +28,11 @@ from ..memory import Array
 from .base import Loader, TEST
 
 
+class LoaderClosed(VelesError):
+    """Feed after close(): service is shutting down — a SERVER state,
+    distinct from client-fault rejections (REST maps it to 503)."""
+
+
 class StreamLoader(Loader):
     """Queue-fed loader. ``feed(sample[, label])`` from any thread;
     ``run()`` blocks until a sample (or close) arrives."""
@@ -49,8 +54,24 @@ class StreamLoader(Loader):
     def feed(self, sample, label: Optional[int] = None,
              ticket: Any = None) -> None:
         if self._closed.is_set():
-            raise VelesError("%s is closed" % self.name)
-        self._queue.put((numpy.asarray(sample), label, ticket))
+            raise LoaderClosed("%s is closed" % self.name)
+        sample = numpy.asarray(sample)
+        # validate on the PRODUCER side: a bad sample must fail the one
+        # request that sent it, not raise later inside run() on the
+        # workflow thread and kill the serving loop for every client
+        if self.sample_shape and sample.shape != self.sample_shape:
+            raise VelesError("sample shape %s != declared %s"
+                             % (sample.shape, self.sample_shape))
+        self._queue.put((sample, label, ticket))
+
+    def parse_request(self, body: dict) -> numpy.ndarray:
+        """REST request body → sample array. The base loader reads the
+        numeric ``input`` field; subclasses specialize (the image
+        variant decodes an ``image`` payload) — the RESTfulAPI unit
+        delegates here so the loader owns its wire format, mirroring
+        the reference's loader-specific derive/feed split
+        (veles/loader/restful.py:133)."""
+        return numpy.asarray(body["input"], dtype=numpy.float32)
 
     def close(self) -> None:
         self._closed.set()
@@ -106,6 +127,53 @@ class RestfulLoader(StreamLoader):
     (reference: veles/loader/restful.py:52)."""
 
     MAPPING = "restful_loader"
+
+
+class _ImageStreamMixin:
+    """Decode-before-enqueue for image serving: accepts raw encoded
+    image bytes (feed) or a base64 ``image`` JSON field (REST), decoded
+    with the SAME size/color policy the training loader used — the
+    geometry contract the reference carried via derive_from
+    (veles/loader/restful.py:137-152)."""
+
+    def __init__(self, workflow, size=None, color: str = "RGB",
+                 **kwargs) -> None:
+        super().__init__(workflow, **kwargs)
+        # default geometry comes from the declared sample shape: with
+        # size=None a decodable image of any other dimensions would
+        # pass feed() and blow up downstream instead of being resized
+        if size is None and len(self.sample_shape) >= 2:
+            size = self.sample_shape[:2]
+        self.size = size
+        self.color = color
+
+    def decode_sample(self, data: bytes) -> numpy.ndarray:
+        from .image import decode_image
+        return decode_image(bytes(data), self.size, self.color)
+
+    def feed(self, sample, label: Optional[int] = None,
+             ticket: Any = None) -> None:
+        if isinstance(sample, (bytes, bytearray)):
+            sample = self.decode_sample(sample)
+        super().feed(sample, label, ticket)
+
+    def parse_request(self, body: dict) -> numpy.ndarray:
+        if "image" in body:
+            import base64
+            return self.decode_sample(base64.b64decode(body["image"]))
+        return super().parse_request(body)
+
+
+class InteractiveImageLoader(_ImageStreamMixin, InteractiveLoader):
+    """Reference: InteractiveImageLoader (veles/loader/interactive.py)."""
+
+    MAPPING = "interactive_image_loader"
+
+
+class RestfulImageLoader(_ImageStreamMixin, RestfulLoader):
+    """Reference: RestfulImageLoader (veles/loader/restful.py:133)."""
+
+    MAPPING = "restful_image_loader"
 
 
 class ZeroMQLoader(StreamLoader):

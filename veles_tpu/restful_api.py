@@ -81,14 +81,25 @@ class RESTfulAPI(Unit):
                     return
                 try:
                     body = read_json_object(self)
-                    sample = numpy.asarray(body["input"],
-                                           dtype=numpy.float32)
-                except (ValueError, KeyError) as e:
+                    # the LOADER owns its wire format (image loaders
+                    # decode base64 payloads; the base reads "input")
+                    sample = api.loader.parse_request(body)
+                except (ValueError, KeyError, VelesError) as e:
+                    # client-fault only — a server-side bug (missing
+                    # parse_request, broken override) must surface as
+                    # a 5xx, not masquerade as a bad request
                     self._reply(400, {"error": "bad request: %s" % e})
                     return
                 ticket = _Ticket()
                 try:
                     api.loader.feed(sample, ticket=ticket)
+                except VelesError as e:
+                    from .loader.stream import LoaderClosed
+                    # shape rejection is the CLIENT's fault; a closed
+                    # loader is the server shutting down
+                    code = 503 if isinstance(e, LoaderClosed) else 400
+                    self._reply(code, {"error": str(e)})
+                    return
                 except Exception as e:
                     self._reply(503, {"error": str(e)})
                     return
