@@ -249,7 +249,7 @@ def test_interactive_loader_feed_and_close():
     loader.run()
     assert loader.minibatch_size == 1
     assert loader.minibatch_class == TEST
-    assert loader.current_ticket == "t1"
+    assert loader.current_tickets == ["t1"]
     assert (loader.minibatch_data.mem[0] == 1).all()
     assert loader.minibatch_labels.mem[0] == 2
     loader.close()

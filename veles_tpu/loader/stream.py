@@ -47,8 +47,13 @@ class StreamLoader(Loader):
         self.timeout = timeout
         self._queue: "queue_mod.Queue" = queue_mod.Queue()
         self._closed = threading.Event()
-        #: ticket of the sample currently in minibatch_data (REST routing)
-        self.current_ticket: Any = None
+        #: per-row tickets of the samples currently in minibatch_data
+        #: (REST routing). minibatch_size > 1 enables DYNAMIC BATCHING:
+        #: one dispatch serves every request queued at that moment —
+        #: the TPU-first serving shape (one compiled program, batch
+        #: dimension amortizes the dispatch; the reference served one
+        #: request per run)
+        self.current_tickets: list = []
 
     # -- producer side (any thread) ------------------------------------------
     def feed(self, sample, label: Optional[int] = None,
@@ -104,18 +109,35 @@ class StreamLoader(Loader):
         if item is None or self._closed.is_set():
             self.workflow.stop()
             return
-        sample, label, ticket = item
-        if sample.shape != self.sample_shape:
-            raise VelesError("sample shape %s != declared %s"
-                             % (sample.shape, self.sample_shape))
+        # dynamic batching: block for the FIRST sample, then drain
+        # whatever else is already queued (up to capacity) into the
+        # same dispatch — concurrent clients share one program run
+        batch = [item]
+        while len(batch) < self.max_minibatch_size:
+            try:
+                nxt = self._queue.get_nowait()
+            except queue_mod.Empty:
+                break
+            if nxt is None:
+                # close() landed mid-drain: serve this batch, stop on
+                # the NEXT run
+                self._queue.put(None)
+                break
+            batch.append(nxt)
         data = self.minibatch_data.map_invalidate()
-        data[0] = sample
-        if label is not None:
-            self.minibatch_labels.map_invalidate()[0] = label
+        labels_arr = self.minibatch_labels.map_invalidate()
+        self.current_tickets = []
+        # shape validation lives in feed() (producer side — failures
+        # belong to the request that sent them, never to this loop)
+        for row, (sample, label, ticket) in enumerate(batch):
+            data[row] = sample
+            # unlabeled rows must not inherit a previous dispatch's
+            # label parked at the same row
+            labels_arr[row] = 0 if label is None else label
+            self.current_tickets.append(ticket)
         self.minibatch_class = TEST
-        self.minibatch_size = 1
-        self.current_ticket = ticket
-        self.samples_served += 1
+        self.minibatch_size = len(batch)
+        self.samples_served += len(batch)
 
 
 class InteractiveLoader(StreamLoader):

@@ -124,9 +124,11 @@ class RESTfulAPI(Unit):
 
     # -- graph side ---------------------------------------------------------
     def run(self) -> None:
-        ticket = getattr(self.loader, "current_ticket", None)
-        if not isinstance(ticket, _Ticket):
-            return      # sample came from somewhere else (e.g. warm-up)
+        tickets = list(getattr(self.loader, "current_tickets", ()))
+        real = [(i, t) for i, t in enumerate(tickets)
+                if isinstance(t, _Ticket)]
+        if not real:
+            return      # samples came from somewhere else (e.g. warm-up)
         try:
             out = self.input
             if out is None:
@@ -134,15 +136,21 @@ class RESTfulAPI(Unit):
             if hasattr(out, "map_read"):
                 out = out.map_read()
             out = numpy.asarray(out)
-            if out.ndim > 1:            # minibatch of 1: unwrap
-                out = out[0]
-            ticket.result = out.tolist()
-            self.requests_served += 1
+            # the linked output's FIRST axis is minibatch rows (the
+            # serving wiring links the batched forward output): row i
+            # answers ticket i — also when each row is a scalar
+            # (ndim==1), where returning the whole vector would leak
+            # every client's result to every client
+            for i, ticket in real:
+                ticket.result = numpy.asarray(out[i]).tolist()
+            self.requests_served += len(real)
         except Exception as e:
-            ticket.error = "%s: %s" % (type(e).__name__, e)
+            for _, ticket in real:
+                ticket.error = "%s: %s" % (type(e).__name__, e)
         finally:
-            self.loader.current_ticket = None
-            ticket.event.set()
+            self.loader.current_tickets = []
+            for _, ticket in real:
+                ticket.event.set()
 
     def stop(self) -> None:
         if self._service is not None:
