@@ -171,6 +171,39 @@ def sec_attn(bench, dev, n):
     return results
 
 
+def sec_generation(bench, dev, n):
+    """KV-cached decode throughput on chip (tokens/s). The re-forward
+    oracle is SKIPPED here: it recompiles per context length — hours
+    through the tunnel; its parity is CPU-gated in CI."""
+    import importlib
+    import time as _time
+    import numpy
+    from veles_tpu import prng
+    from veles_tpu.nn import sampling
+    lm = importlib.import_module("char_lm")
+    rows = []
+    for n_blocks, dim, n_new in ((2, 64, 96), (4, 256, 128)):
+        prng.seed_all(7)
+        wf = lm.build_workflow(epochs=1, minibatch_size=64,
+                               n_blocks=n_blocks, dim=dim,
+                               n_train=256, n_valid=64)
+        wf.initialize(device=dev)
+        prompt = list(lm.make_corpus(numpy.random.RandomState(3), 24))
+        sampling.generate(wf, prompt, n_new, temperature=0)  # compile
+        t0 = _time.time()
+        reps = 3
+        for _ in range(reps):
+            out = sampling.generate(wf, prompt, n_new, temperature=0)
+        dt = (_time.time() - t0) / reps
+        rows.append({"n_blocks": n_blocks, "dim": dim, "n_new": n_new,
+                     "cached_tok_s": round(n_new / dt, 1),
+                     "out_len": len(out)})
+        print("  gen %dx%d: %s tok/s" % (n_blocks, dim,
+                                         rows[-1]["cached_tok_s"]),
+              flush=True)
+    return rows
+
+
 def sec_profile(bench, dev, n):
     import jax
     from imagenet_ae import build_bench_workflow
@@ -193,7 +226,7 @@ SECTIONS = [("mnist", sec_mnist), ("mnist_h1", sec_mnist_h1),
             ("ae_amp", sec_ae_amp),
             ("ae_fp32", sec_ae_fp32), ("ae_amp_remat", sec_ae_amp_remat),
             ("lm", sec_lm), ("attn", sec_attn),
-            ("profile", sec_profile)]
+            ("generation", sec_generation), ("profile", sec_profile)]
 
 
 def main():

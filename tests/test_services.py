@@ -256,3 +256,35 @@ def test_dynamic_batching_serves_concurrent_requests():
     t.join(timeout=10)
     assert not t.is_alive()
     api.stop()
+
+
+def test_web_status_metric_history_sparkline():
+    """The dashboard accumulates per-workflow metric history server-side
+    (the beacon stays a stateless POST) and the page renders it as an
+    inline SVG sparkline."""
+    server = WebStatusServer(port=0).start()
+    base = "http://127.0.0.1:%d" % server.port
+    reporter = StatusReporter(base)
+    for epoch, m in enumerate([0.9, 0.5, 0.3, 0.2]):
+        assert reporter.send({"id": "w1", "name": "m", "epoch": epoch,
+                              "metric": m})
+    with urllib.request.urlopen(base + "/status.json", timeout=5) as r:
+        snap = json.loads(r.read())
+    assert snap["w1"]["_history"] == [0.9, 0.5, 0.3, 0.2]
+    # non-numeric / non-finite / bool metrics don't poison the series
+    # (a bare inf in history would render as invalid JSON 'Infinity'
+    # and freeze the dashboard poll for every workflow)
+    for bad in ("n/a", float("inf"), float("-inf"), float("nan"), True):
+        assert reporter.send({"id": "w1", "name": "m", "metric": bad})
+    with urllib.request.urlopen(base + "/status.json", timeout=5) as r:
+        snap = json.loads(r.read())
+    assert snap["w1"]["_history"] == [0.9, 0.5, 0.3, 0.2]
+    with urllib.request.urlopen(base + "/", timeout=5) as r:
+        page = r.read().decode()
+    assert "spark" in page and "svg" in page
+    # history is bounded
+    from veles_tpu.web_status import HISTORY_LEN
+    for i in range(HISTORY_LEN + 20):
+        server.update("w2", {"metric": float(i)})
+    assert len(server.snapshot()["w2"]["_history"]) == HISTORY_LEN
+    server.stop()

@@ -31,25 +31,47 @@ body { font-family: sans-serif; margin: 2em; }
 table { border-collapse: collapse; }
 td, th { border: 1px solid #999; padding: 4px 10px; }
 th { background: #eee; }
+svg { vertical-align: middle; }
 </style></head><body>
 <h2>veles_tpu — running workflows</h2>
 <table id="t"><tr><th>id</th><th>name</th><th>device</th><th>epoch</th>
-<th>metric</th><th>elapsed&nbsp;s</th><th>updated</th></tr></table>
+<th>metric</th><th>history</th><th>elapsed&nbsp;s</th><th>updated</th>
+</tr></table>
 <script>
+function spark(points) {
+  // inline SVG sparkline of the metric history (the reference's d3
+  // dashboard role, dependency-free)
+  if (!points || points.length < 2) return '';
+  const w = 120, h = 24;
+  const lo = Math.min(...points), hi = Math.max(...points);
+  const span = (hi - lo) || 1;
+  const step = w / (points.length - 1);
+  const d = points.map((p, i) =>
+    (i ? 'L' : 'M') + (i * step).toFixed(1) + ',' +
+    (h - 2 - (p - lo) / span * (h - 4)).toFixed(1)).join(' ');
+  return '<svg width="' + w + '" height="' + h + '">' +
+         '<path d="' + d + '" fill="none" stroke="#36c" ' +
+         'stroke-width="1.5"/></svg>';
+}
 async function tick() {
   const r = await fetch('status.json'); const all = await r.json();
   const t = document.getElementById('t');
   while (t.rows.length > 1) t.deleteRow(1);
   for (const [id, s] of Object.entries(all)) {
     const row = t.insertRow();
-    for (const v of [id, s.name, s.device, s.epoch, s.metric,
-                     s.elapsed_sec, new Date(s._received * 1000)
-                     .toLocaleTimeString()])
+    for (const v of [id, s.name, s.device, s.epoch, s.metric])
+      row.insertCell().textContent = v ?? '';
+    row.insertCell().innerHTML = spark(s._history);
+    for (const v of [s.elapsed_sec,
+                     new Date(s._received * 1000).toLocaleTimeString()])
       row.insertCell().textContent = v ?? '';
   }
 }
 tick(); setInterval(tick, 2000);
 </script></body></html>"""
+
+#: metric samples retained per workflow for the dashboard sparkline
+HISTORY_LEN = 120
 
 
 class WebStatusServer(Logger):
@@ -95,6 +117,21 @@ class WebStatusServer(Logger):
         payload = dict(payload)
         payload["_received"] = time.time()
         with self._lock:
+            prev = self._statuses.get(wid)
+            # metric history accumulates SERVER-side so the beacon
+            # stays a stateless one-shot POST (reference behavior)
+            history = list(prev.get("_history", ())) if prev else []
+            metric = payload.get("metric")
+            # finite numerics only: one inf (divergent run) in the
+            # persistent history would make json.dumps emit bare
+            # 'Infinity' — invalid JSON that freezes the dashboard's
+            # poll for EVERY workflow until it slides out of the window
+            import math
+            if (isinstance(metric, (int, float))
+                    and not isinstance(metric, bool)
+                    and math.isfinite(metric)):
+                history.append(float(metric))
+            payload["_history"] = history[-HISTORY_LEN:]
             self._statuses[wid] = payload
 
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
