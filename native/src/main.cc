@@ -1,9 +1,15 @@
 // veles_infer CLI: run an exported package on a .npy input batch.
 // Usage: veles_infer <package_dir> <input.npy> <output.npy>
-// (the libVeles equivalent of a standalone Workflow::Run driver)
+//        veles_infer --generate N <package_dir> <prompt.npy> <out.npy>
+// (the libVeles equivalent of a standalone Workflow::Run driver;
+// --generate is native greedy LM decoding: the prompt is one full
+// model window of token ids, each step re-forwards the SLIDING window
+// and appends the argmax of the last position's logits — serving an
+// exported language model with no Python runtime at all)
 
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <fstream>
 #include <string>
@@ -37,13 +43,77 @@ void SaveNpyF32(const std::string &path, const std::vector<int> &shape,
              static_cast<std::streamsize>(sizeof(float) * n));
 }
 
+int Generate(int n_new, const char *pkg, const char *prompt_path,
+             const char *out_path) {
+  vi_model *model = vi_load(pkg);
+  if (!model) {
+    std::fprintf(stderr, "load failed: %s\n", vi_last_error());
+    return 1;
+  }
+  veles::NpyArray prompt = veles::LoadNpy(prompt_path);
+  size_t t = vi_input_size(model);        // window length in token ids
+  if (prompt.size() != t) {
+    std::fprintf(stderr,
+                 "prompt holds %zu ids; the model window is %zu "
+                 "(pass one full window)\n",
+                 prompt.size(), t);
+    vi_free(model);
+    return 1;
+  }
+  if (t == 0 || vi_output_size(model) % t != 0 ||
+      vi_output_size(model) / t < 2) {
+    std::fprintf(stderr,
+                 "--generate needs a per-position LM package "
+                 "(output %zu is not vocab x window %zu)\n",
+                 vi_output_size(model), t);
+    vi_free(model);
+    return 1;
+  }
+  size_t vocab = vi_output_size(model) / t;
+  std::vector<float> window(prompt.data.begin(), prompt.data.end());
+  std::vector<float> logits(vi_output_size(model));
+  std::vector<float> generated;
+  generated.reserve(static_cast<size_t>(n_new));
+  for (int step = 0; step < n_new; ++step) {
+    if (vi_run(model, window.data(), 1, logits.data())) {
+      std::fprintf(stderr, "run failed: %s\n", vi_last_error());
+      vi_free(model);
+      return 1;
+    }
+    const float *last = logits.data() + (t - 1) * vocab;
+    size_t best = 0;
+    for (size_t c = 1; c < vocab; ++c)
+      if (last[c] > last[best]) best = c;
+    // slide: drop the oldest id, append the new one
+    window.erase(window.begin());
+    window.push_back(static_cast<float>(best));
+    generated.push_back(static_cast<float>(best));
+  }
+  std::vector<int> shape = {n_new};
+  SaveNpyF32(out_path, shape, generated.data(), generated.size());
+  std::fprintf(stderr, "OK: generated %d tokens (window %zu, vocab %zu)\n",
+               n_new, t, vocab);
+  vi_free(model);
+  return 0;
+}
+
 }  // namespace
 
 int main(int argc, char **argv) {
+  if (argc == 6 && std::strcmp(argv[1], "--generate") == 0) {
+    int n_new = std::atoi(argv[2]);
+    if (n_new <= 0) {
+      std::fprintf(stderr, "--generate needs a positive token count\n");
+      return 2;
+    }
+    return Generate(n_new, argv[3], argv[4], argv[5]);
+  }
   if (argc != 4) {
     std::fprintf(stderr,
-                 "usage: %s <package_dir> <input.npy> <output.npy>\n",
-                 argv[0]);
+                 "usage: %s <package_dir> <input.npy> <output.npy>\n"
+                 "       %s --generate N <package_dir> <prompt.npy> "
+                 "<out.npy>\n",
+                 argv[0], argv[0]);
     return 2;
   }
   vi_model *model = vi_load(argv[1]);

@@ -175,12 +175,10 @@ def sec_generation(bench, dev, n):
     """KV-cached decode throughput on chip (tokens/s). The re-forward
     oracle is SKIPPED here: it recompiles per context length — hours
     through the tunnel; its parity is CPU-gated in CI."""
-    import importlib
-    import time as _time
     import numpy
+    import char_lm as lm
     from veles_tpu import prng
     from veles_tpu.nn import sampling
-    lm = importlib.import_module("char_lm")
     rows = []
     for n_blocks, dim, n_new in ((2, 64, 96), (4, 256, 128)):
         prng.seed_all(7)
@@ -190,11 +188,11 @@ def sec_generation(bench, dev, n):
         wf.initialize(device=dev)
         prompt = list(lm.make_corpus(numpy.random.RandomState(3), 24))
         sampling.generate(wf, prompt, n_new, temperature=0)  # compile
-        t0 = _time.time()
+        t0 = time.time()
         reps = 3
         for _ in range(reps):
             out = sampling.generate(wf, prompt, n_new, temperature=0)
-        dt = (_time.time() - t0) / reps
+        dt = (time.time() - t0) / reps
         rows.append({"n_blocks": n_blocks, "dim": dim, "n_new": n_new,
                      "cached_tok_s": round(n_new / dt, 1),
                      "out_len": len(out)})

@@ -403,3 +403,59 @@ def test_package_tgz_roundtrip(tmp_path):
     out = run_package(tgz, x)
     oracle = fc.numpy_apply(fc.params_np(), x)
     numpy.testing.assert_allclose(out, oracle, rtol=1e-5, atol=1e-6)
+
+
+@needs_native
+def test_native_cli_greedy_generation(tmp_path):
+    """veles_infer --generate: native greedy LM decoding over an
+    exported package (sliding full-window re-forward, argmax of the
+    last position) — serving a language model with zero Python. Oracle:
+    the same sliding-window decode through the python numpy chain."""
+    from conftest import import_model
+    lm = import_model("char_lm")
+    from veles_tpu import prng
+    prng.seed_all(11)
+    wf = lm.build_workflow(epochs=2, minibatch_size=32, n_blocks=1,
+                           dim=16, n_train=128, n_valid=32)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    pkg = str(tmp_path / "lm_pkg")
+    from veles_tpu.export import package_export
+    package_export(wf, pkg, with_stablehlo=False)
+
+    t = lm.SEQ_LEN
+    rng = numpy.random.RandomState(5)
+    prompt = numpy.asarray(list(lm.make_corpus(rng, t)),
+                           dtype=numpy.float32)
+    n_new = 12
+    inp = str(tmp_path / "prompt.npy")
+    outp = str(tmp_path / "gen.npy")
+    numpy.save(inp, prompt)
+    r = subprocess.run([BIN, "--generate", str(n_new), pkg, inp, outp],
+                       capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr
+    got = numpy.load(outp).astype(numpy.int32).tolist()
+
+    # python oracle: identical sliding-window semantics via numpy chain
+    params = [(f, f.params_np()) for f in wf.forwards]
+
+    def forward(window):
+        x = numpy.asarray(window, dtype=numpy.float32)[None]
+        for f, p in params:
+            x = f.numpy_apply(p, x)
+        return x[0]                      # (T, vocab)
+
+    window = prompt.tolist()
+    expect = []
+    for _ in range(n_new):
+        logits = forward(window)
+        nxt = int(numpy.argmax(logits[-1]))
+        expect.append(nxt)
+        window = window[1:] + [nxt]
+    assert got == expect, (got, expect)
+    # wrong-length prompt refused loudly
+    short = str(tmp_path / "short.npy")
+    numpy.save(short, prompt[: t // 2])
+    r = subprocess.run([BIN, "--generate", "4", pkg, short, outp],
+                       capture_output=True, text=True, timeout=60)
+    assert r.returncode != 0 and "window" in r.stderr

@@ -277,8 +277,14 @@ def test_web_status_metric_history_sparkline():
     for bad in ("n/a", float("inf"), float("-inf"), float("nan"), True):
         assert reporter.send({"id": "w1", "name": "m", "metric": bad})
     with urllib.request.urlopen(base + "/status.json", timeout=5) as r:
-        snap = json.loads(r.read())
+        raw = r.read().decode()
+    snap = json.loads(raw)
     assert snap["w1"]["_history"] == [0.9, 0.5, 0.3, 0.2]
+    # the stored payload is sanitized too: bare Infinity/NaN is invalid
+    # JSON for the browser's JSON.parse (python json accepts it, so the
+    # check must be on the TEXT)
+    for tok in ("Infinity", "NaN"):
+        assert tok not in raw, raw
     with urllib.request.urlopen(base + "/", timeout=5) as r:
         page = r.read().decode()
     assert "spark" in page and "svg" in page

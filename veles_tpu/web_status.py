@@ -114,7 +114,16 @@ class WebStatusServer(Logger):
 
     # -- state --------------------------------------------------------------
     def update(self, wid: str, payload: Dict[str, Any]) -> None:
-        payload = dict(payload)
+        import math
+        payload = {
+            # a non-finite float ANYWHERE in the stored payload would
+            # serialize as bare Infinity/NaN — invalid JSON that makes
+            # the browser's JSON.parse throw on every poll, freezing
+            # the dashboard for every workflow until the entry goes
+            # stale; keep the information as a string instead
+            k: (repr(v) if isinstance(v, float) and not math.isfinite(v)
+                else v)
+            for k, v in payload.items()}
         payload["_received"] = time.time()
         with self._lock:
             prev = self._statuses.get(wid)
@@ -122,11 +131,8 @@ class WebStatusServer(Logger):
             # stays a stateless one-shot POST (reference behavior)
             history = list(prev.get("_history", ())) if prev else []
             metric = payload.get("metric")
-            # finite numerics only: one inf (divergent run) in the
-            # persistent history would make json.dumps emit bare
-            # 'Infinity' — invalid JSON that freezes the dashboard's
-            # poll for EVERY workflow until it slides out of the window
-            import math
+            # finite numerics only (non-finite floats were stringified
+            # above; bools would plot as 0/1 noise)
             if (isinstance(metric, (int, float))
                     and not isinstance(metric, bool)
                     and math.isfinite(metric)):
