@@ -24,7 +24,7 @@ import veles_tpu as vt  # noqa: E402
 from veles_tpu import nn  # noqa: E402
 from veles_tpu.config import root  # noqa: E402
 from veles_tpu.genetics import Range  # noqa: E402
-from veles_tpu.genetics.config import Tuneable  # noqa: E402
+from veles_tpu.genetics.config import resolve as _cfg  # noqa: E402
 from veles_tpu.loader import FullBatchLoader  # noqa: E402
 
 SIZE = 16
@@ -40,12 +40,6 @@ root.lines.mb = 80
 root.lines.epochs = 10
 root.lines.n_train = 2400
 root.lines.n_valid = 480
-
-
-def _cfg(value):
-    """Config value or, for a yet-uncollapsed marker (direct script
-    import, no CLI), its default."""
-    return value.default if isinstance(value, Tuneable) else value
 
 
 def draw_line(rng, angle_class, size=SIZE):

@@ -18,7 +18,15 @@ import numpy  # noqa: E402
 
 import veles_tpu as vt  # noqa: E402
 from veles_tpu import nn, datasets  # noqa: E402
+from veles_tpu.config import root  # noqa: E402
+from veles_tpu.genetics import Range  # noqa: E402
+from veles_tpu.genetics.config import resolve as _cfg  # noqa: E402
 from veles_tpu.loader import FullBatchLoader  # noqa: E402
+
+# optimize-ready config (the reference shipped mnist_config.py with the
+# same markers): --optimize searches these; plain runs collapse them
+root.mnist.lr = Range(0.03, 0.001, 0.3)
+root.mnist.hidden = Range(100, 25, 400)
 
 
 class MnistLoader(FullBatchLoader):
@@ -35,15 +43,20 @@ class MnistLoader(FullBatchLoader):
         self.class_lengths = [0, len(vx), len(tx)]
 
 
-def build_workflow(epochs=10, minibatch_size=100, lr=0.03,
+def build_workflow(epochs=10, minibatch_size=100, lr=None, hidden=None,
                    snapshot_dir=None, epochs_per_dispatch=1):
+    """Explicit arguments win; ``lr``/``hidden`` left None resolve from
+    ``root.mnist.*`` (where --optimize writes each candidate's
+    genes)."""
+    lr = float(_cfg(root.mnist.lr)) if lr is None else lr
+    hidden = int(_cfg(root.mnist.hidden)) if hidden is None else hidden
     loader = MnistLoader(None, minibatch_size=minibatch_size, name="mnist")
     snap = (vt.Snapshotter(None, prefix="mnist", directory=snapshot_dir)
             if snapshot_dir else None)
     wf = nn.StandardWorkflow(
         name="mnist-784",
         layers=[
-            {"type": "all2all_tanh", "output_sample_shape": 100,
+            {"type": "all2all_tanh", "output_sample_shape": hidden,
              "learning_rate": lr},
             {"type": "softmax", "output_sample_shape": 10,
              "learning_rate": lr},
@@ -62,14 +75,15 @@ def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--epochs", type=int, default=10)
     p.add_argument("--mb", type=int, default=100)
-    p.add_argument("--lr", type=float, default=0.03)
+    p.add_argument("--lr", type=float, default=None)
     p.add_argument("--backend", default="auto")
     p.add_argument("--snapshot-dir", default=None)
     p.add_argument("--resume", default=None,
                    help="snapshot file to resume from")
     args = p.parse_args(argv)
 
-    wf = build_workflow(args.epochs, args.mb, args.lr, args.snapshot_dir)
+    wf = build_workflow(args.epochs, args.mb, args.lr,
+                        snapshot_dir=args.snapshot_dir)
     device = vt.Device_for(args.backend)
     wf.initialize(device=device)
     if args.resume:

@@ -196,3 +196,23 @@ def test_cli_config_file_survives_model_import(tmp_path):
     assert r.returncode == 0, r.stderr[-2000:]
     with open(rf) as f:
         assert json.load(f)["x"] == 2
+
+
+def test_cli_optimize_mnist_integer_gene(tmp_path):
+    """Second optimize-ready zoo model (mnist): an INTEGER gene (hidden
+    width) changes traced shapes per candidate — recompile-per-candidate
+    must work through the parallel CLI path."""
+    rf = str(tmp_path / "opt.json")
+    r = run_cli(os.path.join(REPO, "models", "mnist.py"),
+                "--optimize", "3:1", "--optimize-workers", "3",
+                "--result-file", rf,
+                timeout=900)
+    assert r.returncode == 0, r.stderr[-2000:]
+    with open(rf) as f:
+        res = json.load(f)
+    assert res["evaluations"] == 3
+    cfg = res["best_config"]
+    assert 0.001 <= cfg["root.mnist.lr"] <= 0.3
+    assert isinstance(cfg["root.mnist.hidden"], int)
+    assert 25 <= cfg["root.mnist.hidden"] <= 400
+    assert res["best_fitness"] > -0.5, res      # really trained

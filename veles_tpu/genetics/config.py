@@ -49,6 +49,13 @@ class Range(Tuneable):
         return "Range(%r, %r, %r)" % (self.default, self.min, self.max)
 
 
+def resolve(value: Any) -> Any:
+    """Config value or, for a yet-uncollapsed marker (direct script
+    import, no CLI to call materialize_defaults), its default — the
+    one resolver every optimize-ready model shares."""
+    return value.default if isinstance(value, Tuneable) else value
+
+
 def find_tuneables(node: Config, path: str = None) -> List[
         Tuple[str, Config, str, Range]]:
     """DFS the config tree for Tuneable leaves.
