@@ -24,7 +24,6 @@ from typing import Any, Optional, Tuple
 import numpy
 
 from ..error import VelesError
-from ..memory import Array
 from .base import Loader, TEST
 
 

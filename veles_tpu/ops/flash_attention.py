@@ -25,7 +25,7 @@ are folded into the grid's leading dimension. D is zero-padded to the
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
