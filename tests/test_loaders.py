@@ -530,9 +530,7 @@ def test_text_loader_oov_maps_to_reserved_unk(tmp_path, caplog):
 def test_zeromq_loader_batches_queued_items():
     """Dynamic batching reaches the ZMQ path too: items queued before
     the next run() share one dispatch, in arrival order."""
-    import pickle as _pickle
     zmq = pytest.importorskip("zmq")
-    from veles_tpu.loader import ZeroMQLoader
     wf = vt.Workflow(name="zmq-batch-wf")
     loader = ZeroMQLoader(wf, sample_shape=(3,), timeout=10.0,
                           minibatch_size=4, name="zb")
@@ -542,7 +540,7 @@ def test_zeromq_loader_batches_queued_items():
     sock.RCVTIMEO = 10000       # a dead drain thread must FAIL, not hang
     sock.connect(loader.bound_endpoint)
     for i in range(3):
-        sock.send(_pickle.dumps((numpy.full(3, float(i)), i)))
+        sock.send(pickle.dumps((numpy.full(3, float(i)), i)))
         assert sock.recv() == b"ok"
     loader.run()
     assert loader.minibatch_size == 3        # one dispatch, three items

@@ -195,7 +195,8 @@ def _drive(launcher: Launcher, workflow, args):
         # elastic restart: rerunning the same command after a crash or
         # preemption resumes from the newest snapshot automatically
         # (reference disaster-recovery story, SURVEY.md §5.3)
-        launcher.try_restore_latest()
+        launcher.try_restore_latest()   # warns if nothing can WRITE
+        # snapshots either (no Snapshotter unit linked)
     if args.workflow_graph:
         with open(args.workflow_graph, "w") as fout:
             fout.write(workflow.generate_graph())

@@ -156,6 +156,18 @@ class Launcher(Logger):
                 snap_unit = u
                 directory, prefix = u.directory, u.prefix
                 break
+        if snap_unit is None:
+            # restoring works off bare directory contents, but WRITING
+            # needs a Snapshotter unit: a user running with
+            # --snapshot-dir and none linked thinks they have disaster
+            # recovery and doesn't
+            self.warning(
+                "workflow %r has no Snapshotter unit — snapshots will "
+                "NOT be written this run; link "
+                "vt.Snapshotter(None, prefix=...) (directory defaults "
+                "to the --snapshot-dir / root.common.dirs.snapshots "
+                "setting) via StandardWorkflow(snapshotter_unit=...)",
+                getattr(wf, "name", "?"))
         if isinstance(snap_unit, SnapshotterToDB):
             # DB sink: newest row in the sqlite store
             dsn = snap_unit._resolve_dsn()
