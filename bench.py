@@ -550,7 +550,14 @@ def main():
                 json.loads(line)      # refuse to relay a broken line
                 print(line)
                 return
-            reason = "tpu child rc=%d" % proc.returncode
+            # the child's last stderr line usually names the cause
+            # (e.g. "no accelerator within 360s acquisition budget") —
+            # carry it into the JSON so a dead-tunnel round is
+            # diagnosable from BENCH_r{N}.json alone
+            tail = (err or "").strip().splitlines()
+            reason = "tpu child rc=%d%s" % (
+                proc.returncode,
+                (": " + tail[-1][-160:]) if tail else "")
         except subprocess.TimeoutExpired:
             reason = ("tpu child exceeded %.0fs budget"
                       % TPU_CHILD_BUDGET)

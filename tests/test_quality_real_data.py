@@ -18,6 +18,18 @@ from veles_tpu import nn, prng
 from veles_tpu.loader import FullBatchLoader
 
 
+def _split_originals(loader, x, y, n_valid, seed):
+    """Shared anchor convention: seeded permutation, then loader row
+    order [test | valid | train] — the first n_valid permuted rows ARE
+    the held-out set, the rest train."""
+    rng = numpy.random.RandomState(seed)
+    perm = rng.permutation(len(x))
+    x, y = x[perm], y[perm]
+    loader.create_originals(x, y)
+    loader.class_lengths = [0, n_valid, len(x) - n_valid]
+    return x
+
+
 class DigitsLoader(FullBatchLoader):
     """Real UCI digits, deterministic 80/20 split, [0,1] scaling."""
 
@@ -26,17 +38,8 @@ class DigitsLoader(FullBatchLoader):
     def load_data(self):
         from sklearn.datasets import load_digits
         d = load_digits()
-        x = (d.data / 16.0).astype(numpy.float32)
-        y = d.target.astype(numpy.int32)
-        rng = numpy.random.RandomState(0)
-        perm = rng.permutation(len(x))
-        x, y = x[perm], y[perm]
-        n_valid = 360
-        # loader row order is [test | valid | train]
-        self.create_originals(
-            numpy.concatenate([x[:n_valid], x[n_valid:]]),
-            numpy.concatenate([y[:n_valid], y[n_valid:]]))
-        self.class_lengths = [0, n_valid, len(x) - n_valid]
+        _split_originals(self, (d.data / 16.0).astype(numpy.float32),
+                         d.target.astype(numpy.int32), 360, seed=0)
 
 
 def test_digits_real_data_anchor():
@@ -70,21 +73,15 @@ class BreastCancerLoader(FullBatchLoader):
     def load_data(self):
         from sklearn.datasets import load_breast_cancer
         d = load_breast_cancer()
-        x = d.data.astype(numpy.float32)
-        y = d.target.astype(numpy.int32)
-        rng = numpy.random.RandomState(1)
-        perm = rng.permutation(len(x))
-        x, y = x[perm], y[perm]
         n_valid = 114
+        x = _split_originals(self, d.data.astype(numpy.float32),
+                             d.target.astype(numpy.int32), n_valid,
+                             seed=1)
         # z-score with TRAIN-rows statistics only: whole-dataset stats
         # would leak held-out information into the anchor
         mu = x[n_valid:].mean(0)
         sd = x[n_valid:].std(0) + 1e-6
-        x = (x - mu) / sd
-        self.create_originals(
-            numpy.concatenate([x[:n_valid], x[n_valid:]]),
-            numpy.concatenate([y[:n_valid], y[n_valid:]]))
-        self.class_lengths = [0, n_valid, len(x) - n_valid]
+        self.original_data.mem[...] = (x - mu) / sd
 
 
 def test_breast_cancer_real_data_anchor():
