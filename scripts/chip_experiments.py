@@ -54,10 +54,23 @@ def sec_mnist(bench, dev, n):
     return bench.bench_mnist(dev, n, smoke=_on_cpu(dev))  # h=8 blocks
 
 
-def sec_mnist_h1(bench, dev, n):
-    """Plan-mode (one epoch per dispatch): comparable to the stored
-    1.52M 'median_of_3x10s' anchor, isolating the h=8 effect."""
-    return bench.bench_mnist(dev, n, smoke=_on_cpu(dev), h=1)
+def sec_mnist_h_sweep(bench, dev, n):
+    """Dispatch-amortization knee: h=1 (plan mode — comparable to the
+    stored 1.52M 'median_of_3x10s' anchor) and h=32 (4x the headline's
+    block) bracket the h=8 headline. If h=32 keeps scaling, the
+    headline config should move."""
+    out = {}
+    for h in (1, 32):
+        if _on_cpu(dev) and h > 4:
+            # a 32-epoch fused block on a host core is the exact stall
+            # the smoke guard exists to prevent; the debug run only
+            # needs the section's wiring proven
+            h = 4
+        out["h%d" % h] = bench.bench_mnist(dev, n, smoke=_on_cpu(dev),
+                                           h=h)
+        print("  mnist h=%d: %.0f samples/s/chip" % (
+            h, out["h%d" % h]["samples_per_sec_per_chip"]), flush=True)
+    return out
 
 
 def sec_ae_amp(bench, dev, n):
@@ -220,7 +233,7 @@ def sec_profile(bench, dev, n):
     return {"trace_dir": prof_dir}
 
 
-SECTIONS = [("mnist", sec_mnist), ("mnist_h1", sec_mnist_h1),
+SECTIONS = [("mnist", sec_mnist), ("mnist_h_sweep", sec_mnist_h_sweep),
             ("ae_amp", sec_ae_amp),
             ("ae_fp32", sec_ae_fp32), ("ae_amp_remat", sec_ae_amp_remat),
             ("lm", sec_lm), ("attn", sec_attn),
