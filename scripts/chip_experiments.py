@@ -135,8 +135,14 @@ def sec_attn(bench, dev, n):
             row["variants"]["fused_xla"] = {
                 "ms": round(dt * 1e3, 2),
                 "tflops": round(flops / dt / 1e12, 2)}
-            for bq, bk in ((128, 128), (256, 128), (512, 128),
-                           (256, 256), (512, 512)):
+            # ~40 tunnel compiles at 20-40s each for the full sweep;
+            # VELES_CHIP_QUICK=1 keeps the two ends of the block range
+            # when the tunnel window might be short
+            shapes = ((128, 128), (512, 512)) if os.environ.get(
+                "VELES_CHIP_QUICK") else (
+                (128, 128), (256, 128), (512, 128),
+                (256, 256), (512, 512))
+            for bq, bk in shapes:
                 if t % bq or t % bk:
                     continue
                 name = "flash_%dx%d" % (bq, bk)
