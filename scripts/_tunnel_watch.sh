@@ -1,0 +1,13 @@
+#!/bin/bash
+# Probe the tunnel every 5 min; when it answers, fire the remaining chip sections.
+cd /root/repo
+while true; do
+  if timeout 150 python -c "import jax, jax.numpy as jnp; x=jnp.ones((256,256),jnp.bfloat16); float((x@x).sum())" >/dev/null 2>&1; then
+    echo "$(date) tunnel alive — firing remaining sections" >> docs/chip_r03.log
+    python scripts/chip_experiments.py --sections ae_amp,ae_fp32,ae_amp_remat,lm,attn,generation,profile >> docs/chip_r03.log 2>&1
+    echo "$(date) batch done rc=$?" >> docs/chip_r03.log
+    break
+  fi
+  echo "$(date) tunnel still dead" >> docs/tunnel_watch.log
+  sleep 300
+done
