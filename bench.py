@@ -60,9 +60,18 @@ def host_sync(step):
 
 
 def measure_windows(run_epoch, sync, n_windows=3, secs=10.0,
-                    min_epochs=2):
+                    min_epochs=2, sync_every=32):
     """Each window: >= secs wall time and >= min_epochs epochs, synced
-    at the end. Returns (per-window samples/sec, epochs, durations)."""
+    at the end. Returns (per-window samples/sec, epochs, durations).
+
+    ``sync_every`` bounds the number of un-synced dispatches in flight:
+    JAX dispatch is async and the wall-clock loop condition measures
+    *enqueue* time, so a small program (e.g. epochs_per_dispatch=1)
+    can flood the exclusive tunnelled chip with thousands of queued
+    executions per window — observed 2026-07-31 to wedge the relay hard
+    enough that even a fresh client's probe hung. Syncing every N
+    epochs keeps the backlog bounded at a cost of one device round trip
+    per N dispatches, inside the timed window, so rates stay honest."""
     rates, epoch_counts, durations = [], [], []
     for _ in range(n_windows):
         t0 = time.time()
@@ -70,6 +79,8 @@ def measure_windows(run_epoch, sync, n_windows=3, secs=10.0,
         while time.time() - t0 < secs or epochs < min_epochs:
             n += run_epoch()
             epochs += 1
+            if epochs % sync_every == 0:
+                sync()
         sync()
         dt = time.time() - t0
         rates.append(n / dt)

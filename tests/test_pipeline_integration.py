@@ -177,10 +177,12 @@ def test_expert_parallel_through_workflow():
     assert wf.decision.best_metric < 0.1, wf.decision.epoch_metrics
 
 
-def test_pipeline_rejects_mixed_config_blocks():
+def test_pipeline_mixed_config_blocks_take_hetero_path():
     """Same class + same shapes but different semantic config (rope
-    on/off): grouping would silently run block 0's settings on every
-    stage — must fail loudly instead."""
+    on/off): the uniform planner refuses (grouping would silently run
+    block 0's settings on every stage) and the heterogeneous schedule
+    picks the chain up instead — each stage applies its own unit, so
+    per-block config is honored."""
     layers = ([{"type": "transformer_block", "n_heads": 2,
                 "ffn_hidden": 8, "rope": bool(i % 2),
                 "name": "tb%d" % i} for i in range(4)]
@@ -202,8 +204,12 @@ def test_pipeline_rejects_mixed_config_blocks():
         name="pp-mixed", layers=layers,
         loader_unit=SeqL(None, minibatch_size=24, name="seql"),
         loss_function="softmax", decision_config=dict(max_epochs=1))
-    with pytest.raises(Bug, match="pipeline"):
-        wf.initialize(device=vt.XLADevice(mesh_axes={"pipeline": 4}))
+    wf.initialize(device=vt.XLADevice(mesh_axes={"pipeline": 4}))
+    step = wf.train_step
+    assert step._pp is None
+    assert step._pp_hetero is not None
+    wf.run()
+    assert wf.decision.epoch_number == 1
 
 
 def test_pipeline_clip_norm_matches_plain():

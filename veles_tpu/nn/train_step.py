@@ -79,6 +79,11 @@ class TrainStep(AcceleratedUnit):
         #: pipeline plan ({"pipeline": N} mesh axis): set by
         #: _setup_pipeline when the mesh has the axis, else None
         self._pp = None
+        #: heterogeneous-pipeline plan (shape-changing chains the
+        #: uniform planner refuses): list-of-stage-groups; params stay
+        #: per-unit (replicated over the axis), so checkpoints/masks
+        #: need no special casing
+        self._pp_hetero = None
         #: rematerialize the forward under jax.checkpoint: activations
         #: are recomputed in the backward instead of living in HBM for
         #: the whole step — FLOPs traded for memory (SURVEY.md HBM
@@ -189,8 +194,14 @@ class TrainStep(AcceleratedUnit):
         from ..parallel.sharding import PP_BLOCK
         try:
             pre, block, post = plan_pipeline(self.forwards, n_stages)
-        except ValueError as e:
-            raise Bug(str(e))
+        except ValueError as uniform_err:
+            # no identical shape-preserving run: fall back to the
+            # heterogeneous schedule (lax.switch per stage, padded-wire
+            # ppermute ring) — AlexNet/ImagenetAE-shaped chains pipeline
+            # too, trading parameter-memory scaling for compute overlap
+            # (parallel/pipeline.py gpipe_hetero docstring)
+            self._setup_pipeline_hetero(n_stages, mesh, uniform_err)
+            return
         import jax.numpy as jnp
         names = [f.name for f in block]
         for masked in self.param_masks:
@@ -210,6 +221,19 @@ class TrainStep(AcceleratedUnit):
         # per-layer semantics (e.g. gradient_clip_norm) must survive the
         # stacking: tell the GD its tree now carries a leading layer axis
         gd.stacked_layers = len(names)
+        n_micro = self._plan_microbatches(mesh, n_stages)
+        self._pp = {"pre": pre, "block": block, "post": post,
+                    "names": names, "n_stages": n_stages,
+                    "n_micro": n_micro, "mesh": mesh}
+        self.info("pipeline plan: %d stages x %d layers, %d microbatches "
+                  "(%d pre, %d post replicated)",
+                  n_stages, len(names) // n_stages, n_micro,
+                  len(pre), len(post))
+
+    def _plan_microbatches(self, mesh, n_stages: int) -> int:
+        """Resolve the microbatch count (default: one per stage) and
+        check the divisibility chain: minibatch → microbatches →
+        data-axis shards."""
         mb = self.loader.max_minibatch_size
         n_micro = int(self.pipeline_microbatches or n_stages)
         if mb % n_micro:
@@ -219,13 +243,31 @@ class TrainStep(AcceleratedUnit):
         if (mb // n_micro) % n_data:
             raise Bug("pipeline microbatch size %d not divisible by "
                       "data-axis size %d" % (mb // n_micro, n_data))
-        self._pp = {"pre": pre, "block": block, "post": post,
-                    "names": names, "n_stages": n_stages,
-                    "n_micro": n_micro, "mesh": mesh}
-        self.info("pipeline plan: %d stages x %d layers, %d microbatches "
-                  "(%d pre, %d post replicated)",
-                  n_stages, len(names) // n_stages, n_micro,
-                  len(pre), len(post))
+        return n_micro
+
+    def _setup_pipeline_hetero(self, n_stages, mesh, uniform_err) -> None:
+        """Stage-group a shape-changing forward chain for the
+        heterogeneous gpipe schedule. The head (last forward) stays
+        outside the pipelined region so the softmax-logits/loss fusion
+        and evaluator wiring are untouched; everything before it is
+        split into ``n_stages`` contiguous groups balanced by the
+        stage_cost FLOP proxy. Params remain per-unit (replicated over
+        the axis), so snapshots, masks and the update loop are exactly
+        the non-pipelined ones."""
+        from ..parallel.pipeline import plan_pipeline_hetero
+        pipe = self.forwards[:-1]
+        try:
+            stages = plan_pipeline_hetero(pipe, n_stages)
+        except ValueError as e:
+            raise Bug("%s (uniform-stage plan also failed: %s)"
+                      % (e, uniform_err))
+        n_micro = self._plan_microbatches(mesh, n_stages)
+        self._pp_hetero = {"stages": stages, "post": [self.forwards[-1]],
+                           "n_micro": n_micro, "mesh": mesh}
+        self.info(
+            "heterogeneous pipeline plan: %d stages (%s units each), %d "
+            "microbatches; params replicated over the axis",
+            n_stages, "/".join(str(len(s)) for s in stages), n_micro)
 
     def _setup_shardings(self) -> None:
         """SPMD parallelism from mesh axes (see veles_tpu/parallel/):
@@ -288,23 +330,32 @@ class TrainStep(AcceleratedUnit):
             self.params[unit_name] = p
 
     # -- pure functions -------------------------------------------------------
-    def _forward_pure(self, params, x, train: bool, rng):
-        """Compose the forward chain; softmax head yields logits for the
-        fused stable cross-entropy."""
+    def _apply_chain(self, units, params, x, train: bool, rng, base: int):
+        """Apply a replicated run of forwards (``base`` offsets the
+        per-layer rng streams); the softmax head yields logits when the
+        evaluator fuses the stable cross-entropy. The single copy of
+        the head-handling loop all three forward paths share."""
         import jax
-        if self._pp is not None:
-            return self._forward_pure_pp(params, x, train, rng)
         last = self.forwards[-1] if self.forwards else None
         use_logits = (isinstance(last, All2AllSoftmax)
                       and isinstance(self.evaluator, EvaluatorSoftmax))
-        for i, f in enumerate(self.forwards):
-            layer_rng = (jax.random.fold_in(rng, i)
+        for i, f in enumerate(units):
+            layer_rng = (jax.random.fold_in(rng, base + i)
                          if rng is not None else None)
             p = params.get(f.name, {})
             if f is last and use_logits:
                 return f.logits(p, x)
             x = f.apply(p, x, train=train, rng=layer_rng)
         return x
+
+    def _forward_pure(self, params, x, train: bool, rng):
+        """Compose the forward chain; softmax head yields logits for the
+        fused stable cross-entropy."""
+        if self._pp is not None:
+            return self._forward_pure_pp(params, x, train, rng)
+        if self._pp_hetero is not None:
+            return self._forward_pure_pp_hetero(params, x, train, rng)
+        return self._apply_chain(self.forwards, params, x, train, rng, 0)
 
     def _forward_pure_pp(self, params, x, train: bool, rng):
         """Pipelined forward: pre-chain replicated → gpipe over the
@@ -317,21 +368,7 @@ class TrainStep(AcceleratedUnit):
         from ..parallel.pipeline import gpipe, microbatch, unmicrobatch
         from ..parallel.sharding import PP_BLOCK
         pp = self._pp
-        last = self.forwards[-1] if self.forwards else None
-        use_logits = (isinstance(last, All2AllSoftmax)
-                      and isinstance(self.evaluator, EvaluatorSoftmax))
-
-        def seq(units, x, base):
-            for i, f in enumerate(units):
-                layer_rng = (jax.random.fold_in(rng, base + i)
-                             if rng is not None else None)
-                p = params.get(f.name, {})
-                if f is last and use_logits:
-                    return f.logits(p, x)
-                x = f.apply(p, x, train=train, rng=layer_rng)
-            return x
-
-        x = seq(pp["pre"], x, 0)
+        x = self._apply_chain(pp["pre"], params, x, train, rng, 0)
         mesh = pp["mesh"]
         n_stages, n_micro = pp["n_stages"], pp["n_micro"]
         layers_per_stage = len(pp["names"]) // n_stages
@@ -353,7 +390,39 @@ class TrainStep(AcceleratedUnit):
         xs = microbatch(x, n_micro)
         y = gpipe(stage_fn, staged, xs, mesh, batch_spec=bspec)
         x = unmicrobatch(y)
-        return seq(pp["post"], x, 1000)
+        return self._apply_chain(pp["post"], params, x, train, rng, 1000)
+
+    def _forward_pure_pp_hetero(self, params, x, train: bool, rng):
+        """Heterogeneous pipelined forward: the staged chain runs under
+        gpipe_hetero (lax.switch selects each device's stage; activations
+        hop the ppermute ring as padded flat buffers), the head runs
+        replicated after. Dropout inside stages is rng-less, as in the
+        uniform schedule."""
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.pipeline import (gpipe_hetero, microbatch,
+                                         unmicrobatch)
+        pp = self._pp_hetero
+        mesh = pp["mesh"]
+
+        def make_stage(units):
+            def stage_fn(stage_params, h):
+                for f in units:
+                    h = f.apply(stage_params.get(f.name, {}), h,
+                                train=train, rng=None)
+                return h
+            return stage_fn
+
+        stage_fns = [make_stage(us) for us in pp["stages"]]
+        stage_params = [
+            {f.name: params.get(f.name, {})
+             for f in us if f.PARAMETERIZED}
+            for us in pp["stages"]]
+        bspec = (P(None, "data") if "data" in mesh.axis_names else P())
+        xs = microbatch(x, pp["n_micro"])
+        y = gpipe_hetero(stage_fns, stage_params, xs, mesh,
+                         batch_spec=bspec)
+        x = unmicrobatch(y)
+        return self._apply_chain(pp["post"], params, x, train, rng, 1000)
 
     def _gather(self, dataset, indices):
         import jax.numpy as jnp
@@ -807,7 +876,7 @@ class TrainStep(AcceleratedUnit):
         self.sync_params_to_arrays()
         d = super().__getstate__()
         for k in ("params", "opt_state", "_accum", "_zero_accum",
-                  "last_loss", "_pp", "_block_metrics",
+                  "last_loss", "_pp", "_pp_hetero", "_block_metrics",
                   "_eval_plan_dev"):
             d[k] = ({} if k in ("params", "opt_state", "_accum",
                                 "_eval_plan_dev") else None)
