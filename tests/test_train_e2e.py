@@ -169,6 +169,38 @@ def test_mixed_precision_converges():
             assert leaf.dtype == jnp.float32
 
 
+def test_evaluation_mode_downgrades_block_dispatch():
+    """--test of a config trained with epochs_per_dispatch>1 is a
+    capability, not an error: entering evaluation mode downgrades the
+    loader's block serving to the classic per-epoch loop (a fused
+    H-epoch block would re-evaluate the same sets H times). Mirrors
+    launcher._enter_test_mode's sequence. Params must not move."""
+    import jax
+    from veles_tpu import prng
+    prng.seed_all(123)
+    loader = BlobsLoader(None, minibatch_size=50, name="blobs-evb")
+    wf = nn.StandardWorkflow(
+        name="evb",
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 16},
+                {"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=1, fail_iterations=50),
+        epochs_per_dispatch=4)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    assert loader.block_epochs == 4
+    step = wf.train_step
+    step.evaluation_mode = True
+    assert loader.block_epochs == 1
+    before = jax.device_get(step.params)
+    wf.run()
+    assert wf.decision.epoch_number == 1
+    after = jax.device_get(step.params)
+    for name, tree in before.items():
+        for k, v in tree.items():
+            numpy.testing.assert_array_equal(numpy.asarray(after[name][k]),
+                                             numpy.asarray(v))
+
+
 def test_epoch_block_matches_classic():
     """epochs_per_dispatch=H fuses H whole epochs (eval+train) into ONE
     device dispatch; the Decision replays per-epoch bookkeeping from the

@@ -70,6 +70,7 @@ class TrainStep(AcceleratedUnit):
         self.gds: List[GradientDescentBase] = list(gds) if gds else []
         self.lr_scale = 1.0        # linked from LearningRateAdjust
         #: --test mode: TRAIN minibatches evaluate without updating params
+        #: (property: setting it downgrades block serving, see setter)
         self.evaluation_mode = False
         self.params: Dict[str, Dict[str, Any]] = {}
         self.opt_state: Dict[str, Dict[str, Any]] = {}
@@ -229,6 +230,23 @@ class TrainStep(AcceleratedUnit):
                   "(%d pre, %d post replicated)",
                   n_stages, len(names) // n_stages, n_micro,
                   len(pre), len(post))
+
+    @property
+    def evaluation_mode(self) -> bool:
+        return self._evaluation_mode
+
+    @evaluation_mode.setter
+    def evaluation_mode(self, value) -> None:
+        """Entering evaluation mode downgrades epoch-block serving to the
+        classic per-epoch loop: evaluation has no dispatch-amortization
+        need, and a fused H-epoch block would re-evaluate the same sets H
+        times — so ``--test`` of a snapshot trained with
+        ``epochs_per_dispatch>1`` is a capability, not an error."""
+        self._evaluation_mode = bool(value)
+        loader = getattr(self, "loader", None)
+        if self._evaluation_mode and loader is not None \
+                and getattr(loader, "block_epochs", 1) > 1:
+            loader.block_epochs = 1
 
     def _plan_microbatches(self, mesh, n_stages: int) -> int:
         """Resolve the microbatch count (default: one per stage) and
