@@ -17,8 +17,34 @@ from veles_tpu.loader import FullBatchLoader
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BIN = os.path.join(REPO, "native", "build", "veles_infer")
 
+
+def _ensure_native_built() -> bool:
+    """Build the C++ runtime on demand: native/build is untracked, so a
+    fresh checkout would otherwise silently SKIP the 16 parity tests
+    (which happened — round-3 session 2). ~20 s with ninja; returns
+    False only when no toolchain is available."""
+    if find_library() is not None:
+        return True
+    import shutil
+    if shutil.which("cmake") is None:
+        return False
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    try:
+        subprocess.run(["cmake", "-S", os.path.join(REPO, "native"),
+                        "-B", os.path.join(REPO, "native", "build"),
+                        "-DCMAKE_BUILD_TYPE=Release"] + gen,
+                       check=True, capture_output=True, timeout=300)
+        subprocess.run(["cmake", "--build",
+                        os.path.join(REPO, "native", "build"), "-j"],
+                       check=True, capture_output=True, timeout=600)
+    except (subprocess.SubprocessError, OSError):
+        return False
+    return find_library() is not None
+
+
 needs_native = pytest.mark.skipif(
-    find_library() is None, reason="native runtime not built")
+    not _ensure_native_built(), reason="native runtime not built "
+    "and no toolchain to build it")
 
 
 class SmallImages(FullBatchLoader):
