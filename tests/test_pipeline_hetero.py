@@ -180,6 +180,33 @@ def test_cifar_model_takes_hetero_pipeline(monkeypatch):
     #                                     staged chain trains at all
 
 
+def test_imagenet_ae_takes_hetero_pipeline(monkeypatch):
+    """The conv-AE (encoder conv→pool→conv, decoder depool→deconv — the
+    ImagenetAE shape) trains through {'pipeline': 2} via the hetero
+    schedule with the deconv head replicated after the staged region;
+    reconstruction RMSE must fall."""
+    import sys, os
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "models"))
+    from veles_tpu import datasets
+    from test_models_ci import _synthetic_images, _import_model
+    prng.seed_all(55)
+    monkeypatch.setattr(
+        datasets, "load_cifar10",
+        lambda n_train=50000, n_test=10000: _synthetic_images(
+            (16, 16, 3), 10, 240, 60, flat=False, key="cifar10"))
+    ae = _import_model("imagenet_ae")
+    wf = ae.build_workflow(epochs=3, minibatch_size=30, lr=0.02)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"pipeline": 2}))
+    step = wf.train_step
+    assert step._pp is None
+    assert step._pp_hetero is not None
+    wf.run()
+    res = wf.gather_results()
+    hist = res["rmse_history"]["validation"]
+    assert hist[-1] < hist[0], hist
+
+
 def test_hetero_short_chain_refuses():
     """A chain shorter than the pipeline axis has no viable hetero plan
     either — the refusal must stay loud."""
