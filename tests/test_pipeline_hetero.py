@@ -262,6 +262,23 @@ def test_imagenet_ae_takes_hetero_pipeline(monkeypatch):
     assert hist[-1] < hist[0], hist
 
 
+def test_pipeline_sequence_axes_refuse_to_compose():
+    """pp x sp nests two manual shard_maps (ring attention inside the
+    pipelined region) — XLA's raw error is an opaque context-mesh
+    mismatch; the plan must name the real reason at initialize time."""
+    loader = TinyImagesLoader(None, minibatch_size=24, name="timg-ps")
+    wf = nn.StandardWorkflow(
+        name="pp-sp-refuse",
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 8},
+                {"type": "all2all_tanh", "output_sample_shape": 8},
+                {"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=1))
+    with pytest.raises(Bug, match="sequence"):
+        wf.initialize(device=vt.XLADevice(
+            mesh_axes={"pipeline": 2, "sequence": 2}))
+
+
 def test_hetero_short_chain_refuses():
     """A chain shorter than the pipeline axis has no viable hetero plan
     either — the refusal must stay loud."""

@@ -191,6 +191,19 @@ class TrainStep(AcceleratedUnit):
         n_stages = dict(mesh.shape).get("pipeline", 1)
         if n_stages <= 1:
             return
+        if "sequence" in mesh.axis_names:
+            # ring/Ulysses attention wraps its own shard_map over
+            # 'sequence'; inside the pipeline's manual mesh region that
+            # nests two manual meshes and XLA refuses with an opaque
+            # context-mesh mismatch — fail at plan time with the real
+            # reason instead (v1 scope: pipeline composes with
+            # data/tensor/fsdp/expert, sequence composes with
+            # data/tensor; not with each other)
+            raise Bug(
+                "'pipeline' and 'sequence' mesh axes cannot compose: "
+                "sequence-parallel attention runs its own shard_map, "
+                "which cannot nest inside the pipelined region. Drop "
+                "one of the axes.")
         from ..parallel.pipeline import plan_pipeline
         from ..parallel.sharding import PP_BLOCK
         try:
