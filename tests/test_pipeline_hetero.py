@@ -262,6 +262,37 @@ def test_imagenet_ae_takes_hetero_pipeline(monkeypatch):
     assert hist[-1] < hist[0], hist
 
 
+def test_hetero_composes_with_amp_and_remat():
+    """The two throughput/memory knobs must ride through the hetero
+    schedule: AMP casts params+batch to bf16 (stage dtype check passes
+    because every stage sees bf16), remat wraps the whole pipelined
+    forward in jax.checkpoint. Training must still converge."""
+    from veles_tpu.config import root
+    root.common.engine.mixed_precision = True
+    try:
+        prng.seed_all(909)
+        loader = TinyImagesLoader(None, minibatch_size=24,
+                                  name="timg-amp")
+        wf = nn.StandardWorkflow(
+            name="pp-amp", layers=[
+                {"type": "conv", "n_kernels": 4, "kx": 3, "ky": 3,
+                 "padding": (1, 1, 1, 1)},
+                {"type": "max_pooling", "kx": 2, "ky": 2},
+                {"type": "activation_str"},
+                {"type": "all2all_tanh", "output_sample_shape": 16},
+                {"type": "softmax", "output_sample_shape": 3}],
+            loader_unit=loader, loss_function="softmax",
+            decision_config=dict(max_epochs=6, fail_iterations=100),
+            remat=True)
+        wf.initialize(device=vt.XLADevice(mesh_axes={"pipeline": 4}))
+        assert wf.train_step._pp_hetero is not None
+        assert wf.train_step.mixed_precision
+        wf.run()
+        assert wf.decision.best_metric < 0.25
+    finally:
+        root.common.engine.mixed_precision = False
+
+
 def test_pipeline_sequence_axes_refuse_to_compose():
     """pp x sp nests two manual shard_maps (ring attention inside the
     pipelined region) — XLA's raw error is an opaque context-mesh
