@@ -1,13 +1,33 @@
 #!/bin/bash
-# Probe the tunnel every 5 min; when it answers, fire the remaining chip sections.
+# Probe the tunnel every 5 min; when it answers, run the chip batch for
+# whatever sections docs/chip_r03.json is still missing. The batch runs
+# under a timeout so a mid-section relay wedge (observed 2026-07-31,
+# h=1 dispatch flood) cannot block the loop forever; on the next alive
+# probe only the missing sections re-fire. Exits when nothing is
+# missing. Section priority: unmeasured levers first, the h-sweep last.
 cd /root/repo
 while true; do
-  if timeout 150 python -c "import jax, jax.numpy as jnp; x=jnp.ones((256,256),jnp.bfloat16); float((x@x).sum())" >/dev/null 2>&1; then
-    echo "$(date) tunnel alive — firing remaining sections" >> docs/chip_r03.log
-    python scripts/chip_experiments.py --sections ae_amp,ae_fp32,ae_amp_remat,lm,attn,generation,profile >> docs/chip_r03.log 2>&1
-    echo "$(date) batch done rc=$?" >> docs/chip_r03.log
+  missing=$(python3 - <<'PY'
+import json, os
+order = ("ae_amp ae_fp32 ae_amp_remat lm attn generation profile "
+         "mnist mnist_h_sweep").split()
+done_keys = set()
+p = "docs/chip_r03.json"
+if os.path.exists(p):
+    done_keys = set(json.load(open(p)))
+print(",".join(k for k in order if k not in done_keys))
+PY
+)
+  if [ -z "$missing" ]; then
+    echo "$(date) all chip sections recorded — watcher exiting" >> docs/tunnel_watch.log
     break
   fi
-  echo "$(date) tunnel still dead" >> docs/tunnel_watch.log
+  if timeout 150 python -c "import jax, jax.numpy as jnp; x=jnp.ones((256,256),jnp.bfloat16); float((x@x).sum())" >/dev/null 2>&1; then
+    echo "$(date) tunnel alive — firing sections: $missing" >> docs/tunnel_watch.log
+    timeout 7200 python scripts/chip_experiments.py --sections "$missing" >> docs/chip_r03.log 2>&1
+    echo "$(date) batch exited rc=$? (timeout 7200)" >> docs/tunnel_watch.log
+  else
+    echo "$(date) tunnel still dead" >> docs/tunnel_watch.log
+  fi
   sleep 300
 done
