@@ -558,24 +558,31 @@ void MatMulRM(const float *x, const float *w, float *y, int n, int k,
   }
 }
 
-// Per-head scaled-dot-product attention over one sequence: q/k/v/ctx
-// are (t, d) planes with heads as contiguous hd slices; `scratch` must
-// hold t floats. Shared by MultiHeadAttention and TransformerBlock so
-// masking/stability fixes cannot diverge between them (the python side
-// shares nn/attention.attention_core the same way).
+// Per-head scaled-dot-product attention over one sequence: q/ctx are
+// (t, d) planes with h heads as contiguous hd slices; k/v are
+// (t, kv_h*hd) planes with kv_h heads (GQA twin of the python units:
+// query head `head` reads KV head `head / (h / kv_h)`; kv_h == h is
+// classic MHA). `scratch` must hold t floats. Shared by
+// MultiHeadAttention and TransformerBlock so masking/stability fixes
+// cannot diverge between them (the python side shares
+// nn/attention.attention_core the same way).
 void AttentionHeads(const float *q, const float *k, const float *v,
                     float *ctx, float *scratch, int t, int d, int h,
-                    bool causal) {
+                    bool causal, int kv_h = 0) {
+  if (kv_h <= 0) kv_h = h;
   int hd = d / h;
+  int kv_d = kv_h * hd;
+  int group = h / kv_h;
   float scale = 1.0f / std::sqrt(static_cast<float>(hd));
   for (int head = 0; head < h; ++head) {
     int off = head * hd;
+    int kv_off = (head / group) * hd;
     for (int qi = 0; qi < t; ++qi) {
       const float *qv = q + static_cast<size_t>(qi) * d + off;
       int kmax = causal ? qi + 1 : t;
       float mx = -1e30f;
       for (int ki = 0; ki < kmax; ++ki) {
-        const float *kv = k + static_cast<size_t>(ki) * d + off;
+        const float *kv = k + static_cast<size_t>(ki) * kv_d + kv_off;
         float dot = 0;
         for (int e = 0; e < hd; ++e) dot += qv[e] * kv[e];
         scratch[ki] = dot * scale;
@@ -590,7 +597,7 @@ void AttentionHeads(const float *q, const float *k, const float *v,
       std::fill(cv, cv + hd, 0.0f);
       for (int ki = 0; ki < kmax; ++ki) {
         float p = scratch[ki] / sum;
-        const float *vv = v + static_cast<size_t>(ki) * d + off;
+        const float *vv = v + static_cast<size_t>(ki) * kv_d + kv_off;
         for (int e = 0; e < hd; ++e) cv[e] += p * vv[e];
       }
     }
@@ -599,26 +606,31 @@ void AttentionHeads(const float *q, const float *k, const float *v,
 
 struct MultiHeadAttention : Unit {
   // inference twin of veles_tpu/nn/attention.py (B, T, D) contract:
-  // heads are contiguous hd-slices of the feature axis
+  // heads are contiguous hd-slices of the feature axis; n_kv_heads <
+  // n_heads is GQA (wk/wv are (d, kv_d))
   int n_heads = 4;
+  int n_kv_heads = 0;  // 0 = n_heads
   bool causal = false;
 
   void Run(const Tensor &in, Tensor *out) override {
     const NpyArray *wq = Param("wq"), *wk = Param("wk"),
                    *wv = Param("wv"), *wo = Param("wo");
     int batch = in.shape[0], t = in.shape[1], d = in.shape[2];
+    int kv_h = n_kv_heads > 0 ? n_kv_heads : n_heads;
+    int kv_d = (d / n_heads) * kv_h;
     out->Resize({batch, t, d});
     size_t plane = static_cast<size_t>(t) * d;
+    size_t kv_plane = static_cast<size_t>(t) * kv_d;
     ParallelFor(batch, [&](int lo, int hi) {
-      std::vector<float> q(plane), k(plane), v(plane), ctx(plane),
-          s(t);
+      std::vector<float> q(plane), k(kv_plane), v(kv_plane),
+          ctx(plane), s(t);
       for (int b = lo; b < hi; ++b) {
         const float *x = in.data.data() + b * plane;
         MatMulRM(x, wq->data.data(), q.data(), t, d, d);
-        MatMulRM(x, wk->data.data(), k.data(), t, d, d);
-        MatMulRM(x, wv->data.data(), v.data(), t, d, d);
+        MatMulRM(x, wk->data.data(), k.data(), t, d, kv_d);
+        MatMulRM(x, wv->data.data(), v.data(), t, d, kv_d);
         AttentionHeads(q.data(), k.data(), v.data(), ctx.data(),
-                       s.data(), t, d, n_heads, causal);
+                       s.data(), t, d, n_heads, causal, kv_h);
         MatMulRM(ctx.data(), wo->data.data(),
                  out->data.data() + b * plane, t, d, d);
       }
@@ -655,8 +667,10 @@ void RopeRotate(float *plane, int t, int d, int h,
 
 struct TransformerBlock : Unit {
   // inference twin of veles_tpu/nn/transformer.py: pre-LN residual
-  // block — h = x + Wo·attn(LN1 x); y = h + W2·gelu(W1·LN2 h)
+  // block — h = x + Wo·attn(LN1 x); y = h + W2·gelu(W1·LN2 h);
+  // n_kv_heads < n_heads is GQA (wk/wv are (d, kv_d))
   int n_heads = 4;
+  int n_kv_heads = 0;  // 0 = n_heads
   bool causal = true;
   bool rope = false;
 
@@ -692,25 +706,28 @@ struct TransformerBlock : Unit {
     int batch = in.shape[0], t = in.shape[1], d = in.shape[2];
     int f = w1->shape[1];
     int h = n_heads;
+    int kv_h = n_kv_heads > 0 ? n_kv_heads : h;
+    int kv_d = (d / h) * kv_h;
     *out = in;                         // residual accumulator
     size_t plane = static_cast<size_t>(t) * d;
+    size_t kv_plane = static_cast<size_t>(t) * kv_d;
     ParallelFor(batch, [&](int lo, int hi) {
-      std::vector<float> ln(plane), q(plane), k(plane), v(plane),
-          ctx(plane), proj(plane), s(t), hbuf(f);
+      std::vector<float> ln(plane), q(plane), k(kv_plane),
+          v(kv_plane), ctx(plane), proj(plane), s(t), hbuf(f);
       for (int b = lo; b < hi; ++b) {
         float *xb = out->data.data() + b * plane;
         // attention sub-block
         LayerNorm(xb, g1->data.data(), bb1->data.data(), ln.data(), t,
                   d);
         MatMulRM(ln.data(), wq->data.data(), q.data(), t, d, d);
-        MatMulRM(ln.data(), wk->data.data(), k.data(), t, d, d);
-        MatMulRM(ln.data(), wv->data.data(), v.data(), t, d, d);
+        MatMulRM(ln.data(), wk->data.data(), k.data(), t, d, kv_d);
+        MatMulRM(ln.data(), wv->data.data(), v.data(), t, d, kv_d);
         if (rope) {
           RopeRotate(q.data(), t, d, h);
-          RopeRotate(k.data(), t, d, h);
+          RopeRotate(k.data(), t, kv_d, kv_h);
         }
         AttentionHeads(q.data(), k.data(), v.data(), ctx.data(),
-                       s.data(), t, d, h, causal);
+                       s.data(), t, d, h, causal, kv_h);
         MatMulRM(ctx.data(), wo->data.data(), proj.data(), t, d, d);
         for (size_t i = 0; i < plane; ++i) xb[i] += proj[i];
         // FFN sub-block
@@ -1047,12 +1064,14 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
   if (type == "multi_head_attention") {
     auto u = std::make_unique<MultiHeadAttention>();
     if (cfg.Has("n_heads")) u->n_heads = cfg["n_heads"].AsInt();
+    if (cfg.Has("n_kv_heads")) u->n_kv_heads = cfg["n_kv_heads"].AsInt();
     if (cfg.Has("causal")) u->causal = cfg["causal"].AsBool();
     return u;
   }
   if (type == "transformer_block") {
     auto u = std::make_unique<TransformerBlock>();
     if (cfg.Has("n_heads")) u->n_heads = cfg["n_heads"].AsInt();
+    if (cfg.Has("n_kv_heads")) u->n_kv_heads = cfg["n_kv_heads"].AsInt();
     if (cfg.Has("causal")) u->causal = cfg["causal"].AsBool();
     if (cfg.Has("rope")) u->rope = cfg["rope"].AsBool();
     return u;

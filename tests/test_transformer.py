@@ -306,3 +306,100 @@ def test_cached_generation_heterogeneous_heads(tmp_path):
     assert all(0 <= t < 8 for t in toks)
     # greedy decode is deterministic: same prompt, same continuation
     assert toks == sampling.generate(wf, [0, 1, 2], 6, temperature=0)
+
+
+def test_gqa_oracle_agreement():
+    """Grouped-query attention (n_kv_heads < n_heads): jax apply vs the
+    numpy oracle, plus the shrunken wk/wv shapes."""
+    prev = vt.root.common.engine.compute_dtype
+    vt.root.common.engine.compute_dtype = "float32"
+    try:
+        wf = vt.Workflow(name="gqa")
+        u = nn.TransformerBlock(wf, n_heads=4, n_kv_heads=2,
+                                ffn_hidden=16, causal=True)
+        x = numpy.random.RandomState(1).randn(3, 8, 16).astype("float32")
+        u.input = Array(x)
+        u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        assert u.params_np()["wk"].shape == (16, 8)   # kv_d = 2 * 4
+        assert u.params_np()["wv"].shape == (16, 8)
+        u.xla_run()
+        y = numpy.asarray(u.output.map_read())
+        y_np = u.numpy_apply(u.params_np(), x)
+        numpy.testing.assert_allclose(y, y_np, rtol=1e-4, atol=1e-4)
+    finally:
+        vt.root.common.engine.compute_dtype = prev
+
+
+def test_gqa_equals_mha_with_shared_heads():
+    """Semantic pin: a GQA block must equal an MHA block whose K/V
+    weight columns are the GQA columns tiled per query-head group —
+    kv-head sharing IS column tiling."""
+    prev = vt.root.common.engine.compute_dtype
+    vt.root.common.engine.compute_dtype = "float32"
+    try:
+        wf = vt.Workflow(name="gqa-pin")
+        d, h, kvh = 16, 4, 2
+        hd, g = d // h, h // kvh
+        gqa = nn.TransformerBlock(wf, n_heads=h, n_kv_heads=kvh,
+                                  ffn_hidden=16, causal=True,
+                                  name="gq")
+        mha = nn.TransformerBlock(wf, n_heads=h, ffn_hidden=16,
+                                  causal=True, name="mh")
+        x = numpy.random.RandomState(2).randn(2, 6, d).astype("float32")
+        for u in (gqa, mha):
+            u.input = Array(x)
+            u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        pg = gqa.params_np()
+        pm = dict(pg)
+        for key in ("wk", "wv"):
+            cols = [pg[key][:, (q // g) * hd:(q // g + 1) * hd]
+                    for q in range(h)]
+            pm[key] = numpy.concatenate(cols, axis=1)
+        y_gqa = gqa.numpy_apply(pg, x)
+        y_mha = mha.numpy_apply(pm, x)
+        numpy.testing.assert_allclose(y_gqa, y_mha, rtol=1e-5,
+                                      atol=1e-5)
+    finally:
+        vt.root.common.engine.compute_dtype = prev
+
+
+def test_gqa_generation_matches_naive():
+    """GQA end to end: a 4-head/2-kv-head rope LM trains, and the
+    KV-cached sampler (whose caches hold the UNREPEATED kv heads —
+    half an MHA cache here) reproduces the re-forward oracle exactly
+    under greedy decoding."""
+    from veles_tpu.loader import TextFileLoader
+    from veles_tpu.nn import sampling
+    from conftest import import_model
+    lm = import_model("char_lm")
+    import tempfile, os as _os
+    with tempfile.TemporaryDirectory() as td:
+        p = _os.path.join(td, "c.txt")
+        with open(p, "w") as f:
+            f.write("the quick brown fox jumps over the lazy dog. " * 30)
+        prng.seed_all(11)
+        loader = TextFileLoader(None, files=[p], seq_len=16,
+                                minibatch_size=8, name="gqa-text")
+        vocab = loader.vocab_size if hasattr(loader, "vocab_size") else 64
+        wf = nn.StandardWorkflow(
+            name="gqa-lm",
+            layers=[{"type": "embedding", "vocab_size": 64, "dim": 24,
+                     "solver": "adam", "learning_rate": 0.01},
+                    {"type": "transformer_block", "n_heads": 4,
+                     "n_kv_heads": 2, "ffn_hidden": 48, "causal": True,
+                     "rope": True, "solver": "adam",
+                     "learning_rate": 0.01, "name": "g0"},
+                    {"type": "transformer_block", "n_heads": 4,
+                     "n_kv_heads": 1, "ffn_hidden": 48, "causal": True,
+                     "rope": True, "solver": "adam",
+                     "learning_rate": 0.01, "name": "g1"},   # MQA
+                    {"type": "lm_head", "vocab_size": 64,
+                     "solver": "adam", "learning_rate": 0.01}],
+            loader_unit=loader, loss_function="softmax_seq",
+            decision_config=dict(max_epochs=2, fail_iterations=50))
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        wf.run()
+        prompt = [1, 2, 3, 4]
+        naive = lm.generate_naive(wf, prompt, 8, temperature=0)
+        cached = sampling.generate(wf, prompt, 8, temperature=0)
+        assert naive == cached, (naive, cached)

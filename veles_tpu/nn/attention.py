@@ -49,9 +49,16 @@ class MultiHeadAttention(ForwardBase):
     PARAMETERIZED = True
     hide_from_registry = False
 
-    def __init__(self, workflow, n_heads=4, causal=False, **kwargs):
+    def __init__(self, workflow, n_heads=4, causal=False,
+                 n_kv_heads=None, **kwargs):
         super().__init__(workflow, **kwargs)
         self.n_heads = int(n_heads)
+        #: grouped-query attention (n_kv_heads < n_heads): K/V heads
+        #: shared across query-head groups; None = classic MHA
+        self.n_kv_heads = int(n_kv_heads) if n_kv_heads else self.n_heads
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError("n_heads %d not divisible by n_kv_heads %d"
+                             % (self.n_heads, self.n_kv_heads))
         self.causal = causal
         self.mesh = None          # set at initialize from the device
         self.weights_stddev = kwargs.get("weights_stddev", None)
@@ -68,9 +75,11 @@ class MultiHeadAttention(ForwardBase):
                              (d, self.n_heads))
         stddev = self.weights_stddev or (1.0 / numpy.sqrt(d))
         dtype = root.common.engine.precision_type
+        kv_d = (d // self.n_heads) * self.n_kv_heads
         params = {}
-        for k in ("wq", "wk", "wv", "wo"):
-            w = numpy.zeros((d, d), dtype=dtype)
+        for k, cols in (("wq", d), ("wk", kv_d), ("wv", kv_d),
+                        ("wo", d)):
+            w = numpy.zeros((d, cols), dtype=dtype)
             prng.get("%s.%s" % (self.name, k)).fill_normal(w, stddev)
             params[k] = Array(w, name="%s.%s" % (self.name, k))
         return params
@@ -85,32 +94,39 @@ class MultiHeadAttention(ForwardBase):
             self.mesh = mesh
         return None
 
-    def _split_heads(self, x):
-        b, t, d = x.shape
-        return x.reshape(b, t, self.n_heads, d // self.n_heads)
-
     def apply(self, params, x, *, train=False, rng=None):
         import jax.numpy as jnp
         from ..ops import matmul_precision
         prec = matmul_precision()
         b, t, d = x.shape
-        q = self._split_heads(jnp.dot(x, params["wq"], precision=prec))
-        k = self._split_heads(jnp.dot(x, params["wk"], precision=prec))
-        v = self._split_heads(jnp.dot(x, params["wv"], precision=prec))
+        h = self.n_heads
+        kv = getattr(self, "n_kv_heads", h)   # absent in old snapshots
+        hd = d // h
+        q = jnp.dot(x, params["wq"], precision=prec).reshape(b, t, h, hd)
+        k = jnp.dot(x, params["wk"],
+                    precision=prec).reshape(b, t, kv, hd)
+        v = jnp.dot(x, params["wv"],
+                    precision=prec).reshape(b, t, kv, hd)
+        if kv != h:
+            k = jnp.repeat(k, h // kv, axis=2)
+            v = jnp.repeat(v, h // kv, axis=2)
         o = attention_core(q, k, v, causal=self.causal, mesh=self.mesh,
-                           n_heads=self.n_heads)
+                           n_heads=h)
         o = o.reshape(b, t, d)
         return jnp.dot(o, params["wo"], precision=prec)
 
     def numpy_apply(self, params, x):
         b, t, d = x.shape
         h = self.n_heads
+        kv = getattr(self, "n_kv_heads", h)
         hd = d // h
 
-        def split(m):
-            return (x @ m).reshape(b, t, h, hd)
-        q, k, v = split(params["wq"]), split(params["wk"]), \
-            split(params["wv"])
+        q = (x @ params["wq"]).reshape(b, t, h, hd)
+        k = (x @ params["wk"]).reshape(b, t, kv, hd)
+        v = (x @ params["wv"]).reshape(b, t, kv, hd)
+        if kv != h:
+            k = numpy.repeat(k, h // kv, axis=2)
+            v = numpy.repeat(v, h // kv, axis=2)
         s = numpy.einsum("bqhd,bkhd->bhqk", q, k) / numpy.sqrt(hd)
         if self.causal:
             mask = numpy.tril(numpy.ones((t, t), bool))

@@ -81,18 +81,22 @@ def _block_prefill(block, p, x, cache_k, cache_v):
     prec = matmul_precision()
     b, t, d = x.shape
     h = block.n_heads
-
-    def heads(m):
-        return m.reshape(b, t, h, d // h)
+    kv = getattr(block, "n_kv_heads", h)
+    hd = d // h
 
     a_in = _layernorm(jnp, x, p["ln1_g"], p["ln1_b"])
-    q = heads(jnp.dot(a_in, p["wq"], precision=prec))
-    k = heads(jnp.dot(a_in, p["wk"], precision=prec))
-    v = heads(jnp.dot(a_in, p["wv"], precision=prec))
+    q = jnp.dot(a_in, p["wq"], precision=prec).reshape(b, t, h, hd)
+    k = jnp.dot(a_in, p["wk"], precision=prec).reshape(b, t, kv, hd)
+    v = jnp.dot(a_in, p["wv"], precision=prec).reshape(b, t, kv, hd)
     if block.rope:
         q, k = _rope(jnp, q), _rope(jnp, k)
+    # the cache stores the UNREPEATED kv heads — with GQA it is
+    # n_heads/n_kv_heads times smaller than an MHA cache
     cache_k = cache_k.at[:, :t].set(k)
     cache_v = cache_v.at[:, :t].set(v)
+    if kv != h:
+        k = jnp.repeat(k, h // kv, axis=2)
+        v = jnp.repeat(v, h // kv, axis=2)
     o = attention_core(q, k, v, causal=True, mesh=None,
                        n_heads=h).reshape(b, t, d)
     x = x + jnp.dot(o, p["wo"], precision=prec)
@@ -110,29 +114,31 @@ def _block_step(block, p, x_t, cache_k, cache_v, pos):
     prec = matmul_precision()
     b, _, d = x_t.shape
     h = block.n_heads
+    kv = getattr(block, "n_kv_heads", h)
+    g = h // kv
     hd = d // h
 
-    def heads(m):
-        return m.reshape(b, 1, h, hd)
-
     a_in = _layernorm(jnp, x_t, p["ln1_g"], p["ln1_b"])
-    q = heads(jnp.dot(a_in, p["wq"], precision=prec))
-    k = heads(jnp.dot(a_in, p["wk"], precision=prec))
-    v = heads(jnp.dot(a_in, p["wv"], precision=prec))
+    q = jnp.dot(a_in, p["wq"], precision=prec).reshape(b, 1, h, hd)
+    k = jnp.dot(a_in, p["wk"], precision=prec).reshape(b, 1, kv, hd)
+    v = jnp.dot(a_in, p["wv"], precision=prec).reshape(b, 1, kv, hd)
     if block.rope:
         q, k = _rope_at(jnp, q, pos), _rope_at(jnp, k, pos)
     cache_k = jnp.asarray(cache_k).at[:, pos].set(k[:, 0])
     cache_v = jnp.asarray(cache_v).at[:, pos].set(v[:, 0])
     t_max = cache_k.shape[1]
     # single-row attention over the cache; scores/softmax in f32 like
-    # attention_reference so the step matches the full-window forward
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+    # attention_reference so the step matches the full-window forward.
+    # GQA reads the unrepeated cache through a (kv, group) view of the
+    # query heads — no (B, T, H, Dh) materialization.
+    q5 = q.reshape(b, 1, kv, g, hd).astype(jnp.float32)
+    s = jnp.einsum("bqkgd,btkd->bkgqt", q5,
                    cache_k.astype(jnp.float32)) / numpy.sqrt(hd)
-    valid = (jnp.arange(t_max) <= pos)[None, None, None, :]
+    valid = (jnp.arange(t_max) <= pos)[None, None, None, None, :]
     s = jnp.where(valid, s, -1e30)
     w = jnp.exp(s - s.max(axis=-1, keepdims=True))
     w = w / w.sum(axis=-1, keepdims=True)
-    o = jnp.einsum("bhqk,bkhd->bqhd", w,
+    o = jnp.einsum("bkgqt,btkd->bqkgd", w,
                    cache_v.astype(jnp.float32)).astype(x_t.dtype)
     o = o.reshape(b, 1, d)
     x_t = x_t + jnp.dot(o, p["wo"], precision=prec)
@@ -191,12 +197,14 @@ def _build_sampler(wf, t_p, n_new, temperature):
         x = embed(params, prompt_ids, 0)       # (B, T_p, D)
         caches = []
         for blk in blocks:
-            # each block's OWN head count: the layers config allows
+            # each block's OWN head counts: the layers config allows
             # heterogeneous n_heads per block, and a cache shaped from
-            # blocks[0] trace-fails with an opaque reshape error
-            bh = blk.n_heads
-            ck = jnp.zeros((b, t_max, bh, d // bh), x.dtype)
-            cv = jnp.zeros((b, t_max, bh, d // bh), x.dtype)
+            # blocks[0] trace-fails with an opaque reshape error. With
+            # GQA the cache holds the unrepeated n_kv_heads rows.
+            bkv = getattr(blk, "n_kv_heads", blk.n_heads)
+            hd = d // blk.n_heads
+            ck = jnp.zeros((b, t_max, bkv, hd), x.dtype)
+            cv = jnp.zeros((b, t_max, bkv, hd), x.dtype)
             x, ck, cv = _block_prefill(blk, params[blk.name], x, ck, cv)
             caches.append((ck, cv))
         key, sub = jax.random.split(key)

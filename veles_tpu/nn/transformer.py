@@ -74,9 +74,16 @@ class TransformerBlock(ForwardBase):
                    "ln1_g", "ln1_b", "ln2_g", "ln2_b")
 
     def __init__(self, workflow, n_heads=4, ffn_hidden=0, causal=True,
-                 rope=False, **kwargs):
+                 rope=False, n_kv_heads=None, **kwargs):
         super().__init__(workflow, **kwargs)
         self.n_heads = int(n_heads)
+        #: grouped-query attention: n_kv_heads < n_heads shares each K/V
+        #: head across n_heads/n_kv_heads query heads — the KV cache
+        #: (and wk/wv) shrink by that factor; None = classic MHA
+        self.n_kv_heads = int(n_kv_heads) if n_kv_heads else self.n_heads
+        if self.n_heads % self.n_kv_heads:
+            raise ValueError("n_heads %d not divisible by n_kv_heads %d"
+                             % (self.n_heads, self.n_kv_heads))
         self.ffn_hidden = int(ffn_hidden)
         self.causal = causal
         #: rotary position embedding on q/k — position information with
@@ -105,10 +112,11 @@ class TransformerBlock(ForwardBase):
 
         ones = numpy.ones((d,), dtype=dtype)
         zeros = numpy.zeros((d,), dtype=dtype)
+        kv_d = (d // self.n_heads) * self.n_kv_heads
         return {
             "wq": mk("wq", (d, d), stddev),
-            "wk": mk("wk", (d, d), stddev),
-            "wv": mk("wv", (d, d), stddev),
+            "wk": mk("wk", (d, kv_d), stddev),
+            "wv": mk("wv", (d, kv_d), stddev),
             "wo": mk("wo", (d, d), stddev),
             "w1": mk("w1", (d, f), stddev),
             "b1": Array(numpy.zeros((f,), dtype=dtype),
@@ -137,16 +145,23 @@ class TransformerBlock(ForwardBase):
         prec = matmul_precision()
         b, t, d = x.shape
         h = self.n_heads
-
-        def heads(m):
-            return m.reshape(b, t, h, d // h)
+        kv = getattr(self, "n_kv_heads", h)   # absent in old snapshots
+        hd = d // h
 
         a_in = _layernorm(jnp, x, params["ln1_g"], params["ln1_b"])
-        q = heads(jnp.dot(a_in, params["wq"], precision=prec))
-        k = heads(jnp.dot(a_in, params["wk"], precision=prec))
-        v = heads(jnp.dot(a_in, params["wv"], precision=prec))
+        q = jnp.dot(a_in, params["wq"],
+                    precision=prec).reshape(b, t, h, hd)
+        k = jnp.dot(a_in, params["wk"],
+                    precision=prec).reshape(b, t, kv, hd)
+        v = jnp.dot(a_in, params["wv"],
+                    precision=prec).reshape(b, t, kv, hd)
         if getattr(self, "rope", False):   # absent in pre-rope exports
             q, k = _rope(jnp, q), _rope(jnp, k)
+        if kv != h:
+            # GQA: share each KV head across h/kv query heads (XLA
+            # fuses the broadcast into the attention dots)
+            k = jnp.repeat(k, h // kv, axis=2)
+            v = jnp.repeat(v, h // kv, axis=2)
         o = attention_core(q, k, v, causal=self.causal, mesh=self.mesh,
                            n_heads=h).reshape(b, t, d)
         x = x + jnp.dot(o, params["wo"], precision=prec)
@@ -160,16 +175,18 @@ class TransformerBlock(ForwardBase):
         x = numpy.asarray(x, dtype=numpy.float32)
         b, t, d = x.shape
         h = self.n_heads
+        kv = getattr(self, "n_kv_heads", h)
         hd = d // h
         a_in = _layernorm(numpy, x, params["ln1_g"], params["ln1_b"])
 
-        def heads(m):
-            return (a_in @ m).reshape(b, t, h, hd)
-
-        q, k, v = heads(params["wq"]), heads(params["wk"]), \
-            heads(params["wv"])
+        q = (a_in @ params["wq"]).reshape(b, t, h, hd)
+        k = (a_in @ params["wk"]).reshape(b, t, kv, hd)
+        v = (a_in @ params["wv"]).reshape(b, t, kv, hd)
         if getattr(self, "rope", False):   # absent in pre-rope exports
             q, k = _rope(numpy, q), _rope(numpy, k)
+        if kv != h:
+            k = numpy.repeat(k, h // kv, axis=2)
+            v = numpy.repeat(v, h // kv, axis=2)
         s = numpy.einsum("bqhd,bkhd->bhqk", q, k) / numpy.sqrt(hd)
         if self.causal:
             mask = numpy.tril(numpy.ones((t, t), bool))
