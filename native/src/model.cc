@@ -568,7 +568,7 @@ void MatMulRM(const float *x, const float *w, float *y, int n, int k,
 // nn/attention.attention_core the same way).
 void AttentionHeads(const float *q, const float *k, const float *v,
                     float *ctx, float *scratch, int t, int d, int h,
-                    bool causal, int kv_h = 0) {
+                    bool causal, int kv_h = 0, int window = 0) {
   if (kv_h <= 0) kv_h = h;
   int hd = d / h;
   int kv_d = kv_h * hd;
@@ -580,8 +580,10 @@ void AttentionHeads(const float *q, const float *k, const float *v,
     for (int qi = 0; qi < t; ++qi) {
       const float *qv = q + static_cast<size_t>(qi) * d + off;
       int kmax = causal ? qi + 1 : t;
+      // sliding window (python twin: q - k < window, causal only)
+      int kmin = window > 0 ? std::max(0, qi - window + 1) : 0;
       float mx = -1e30f;
-      for (int ki = 0; ki < kmax; ++ki) {
+      for (int ki = kmin; ki < kmax; ++ki) {
         const float *kv = k + static_cast<size_t>(ki) * kv_d + kv_off;
         float dot = 0;
         for (int e = 0; e < hd; ++e) dot += qv[e] * kv[e];
@@ -589,13 +591,13 @@ void AttentionHeads(const float *q, const float *k, const float *v,
         mx = std::max(mx, scratch[ki]);
       }
       float sum = 0;
-      for (int ki = 0; ki < kmax; ++ki) {
+      for (int ki = kmin; ki < kmax; ++ki) {
         scratch[ki] = std::exp(scratch[ki] - mx);
         sum += scratch[ki];
       }
       float *cv = ctx + static_cast<size_t>(qi) * d + off;
       std::fill(cv, cv + hd, 0.0f);
-      for (int ki = 0; ki < kmax; ++ki) {
+      for (int ki = kmin; ki < kmax; ++ki) {
         float p = scratch[ki] / sum;
         const float *vv = v + static_cast<size_t>(ki) * kv_d + kv_off;
         for (int e = 0; e < hd; ++e) cv[e] += p * vv[e];
@@ -671,6 +673,7 @@ struct TransformerBlock : Unit {
   // n_kv_heads < n_heads is GQA (wk/wv are (d, kv_d))
   int n_heads = 4;
   int n_kv_heads = 0;  // 0 = n_heads
+  int window = 0;      // sliding-window span; 0 = full attention
   bool causal = true;
   bool rope = false;
 
@@ -727,7 +730,7 @@ struct TransformerBlock : Unit {
           RopeRotate(k.data(), t, kv_d, kv_h);
         }
         AttentionHeads(q.data(), k.data(), v.data(), ctx.data(),
-                       s.data(), t, d, h, causal, kv_h);
+                       s.data(), t, d, h, causal, kv_h, window);
         MatMulRM(ctx.data(), wo->data.data(), proj.data(), t, d, d);
         for (size_t i = 0; i < plane; ++i) xb[i] += proj[i];
         // FFN sub-block
@@ -1072,6 +1075,7 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
     auto u = std::make_unique<TransformerBlock>();
     if (cfg.Has("n_heads")) u->n_heads = cfg["n_heads"].AsInt();
     if (cfg.Has("n_kv_heads")) u->n_kv_heads = cfg["n_kv_heads"].AsInt();
+    if (cfg.Has("window")) u->window = cfg["window"].AsInt();
     if (cfg.Has("causal")) u->causal = cfg["causal"].AsBool();
     if (cfg.Has("rope")) u->rope = cfg["rope"].AsBool();
     return u;

@@ -237,6 +237,9 @@ def transformer_pkg(tmp_path_factory):
             {"type": "transformer_block", "n_heads": 4,
              "n_kv_heads": 2, "ffn_hidden": 16, "causal": True,
              "rope": True},      # GQA: C++ AttentionHeads kv mapping
+            {"type": "transformer_block", "n_heads": 2,
+             "ffn_hidden": 16, "causal": True,
+             "window": 3},       # sliding window: C++ kmin horizon
             {"type": "mean_pool"},
             {"type": "softmax", "output_sample_shape": 3},
         ],

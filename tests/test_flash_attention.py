@@ -128,3 +128,58 @@ def test_pallas_bwd_matches_jnp_bwd(causal):
         jax.clear_caches()
     for a, b in zip(g_pallas, g_jnp):
         numpy.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("window", [128, 200, 256])
+def test_windowed_forward_matches_reference(window):
+    """Sliding window: flash (with dead-block skipping) vs the windowed
+    reference mask. Windows chosen to hit block-aligned (128), block-
+    straddling (200), and multi-block (256) horizons at bq=bk=128."""
+    q, k, v = qkv(t=512, seed=3)
+    o = flash_attention(q, k, v, causal=True, window=window)
+    ref = attention_reference(q, k, v, causal=True, window=window)
+    numpy.testing.assert_allclose(numpy.asarray(o), numpy.asarray(ref),
+                                  rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("pallas_bwd", [True, False])
+def test_windowed_grads_match_reference(pallas_bwd):
+    """Window masking through BOTH backwards (pallas kernels and the
+    jnp blockwise fallback) vs autodiff of the windowed reference."""
+    prev = vt.root.common.engine.get("flash_attention_pallas_bwd", True)
+    vt.root.common.engine.flash_attention_pallas_bwd = pallas_bwd
+    try:
+        q, k, v = qkv(b=1, t=256, h=2, d=32, seed=4)
+        win = 160
+
+        def loss_fl(q, k, v):
+            return (flash_attention(q, k, v, causal=True,
+                                    window=win) ** 2).sum()
+
+        def loss_ref(q, k, v):
+            return (attention_reference(q, k, v, causal=True,
+                                        window=win) ** 2).sum()
+
+        g_fl = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_fl, g_ref):
+            numpy.testing.assert_allclose(numpy.asarray(a),
+                                          numpy.asarray(b),
+                                          rtol=2e-4, atol=2e-4)
+    finally:
+        vt.root.common.engine.flash_attention_pallas_bwd = prev
+
+
+def test_window_requires_causal():
+    q, k, v = qkv(t=256)
+    with pytest.raises(ValueError, match="causal"):
+        flash_attention(q, k, v, causal=False, window=64)
+
+
+def test_window_covering_everything_equals_full():
+    """window >= T degenerates to full causal attention exactly."""
+    q, k, v = qkv(t=256, seed=5)
+    o_w = flash_attention(q, k, v, causal=True, window=4096)
+    o_f = flash_attention(q, k, v, causal=True)
+    numpy.testing.assert_allclose(numpy.asarray(o_w),
+                                  numpy.asarray(o_f), rtol=1e-6)

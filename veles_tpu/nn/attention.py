@@ -20,17 +20,25 @@ from .. import prng
 from .nn_units import ForwardBase, GradientDescentBase, matches
 
 
-def attention_core(q, k, v, *, causal=False, mesh=None, n_heads=1):
+def attention_core(q, k, v, *, causal=False, mesh=None, n_heads=1,
+                   window=None):
     """The per-shape attention chooser, shared by MultiHeadAttention and
     TransformerBlock. q/k/v: (B, T, H, Dh) → (B, T, H, Dh).
     sequence-mesh → ring/Ulysses; long T on TPU → Pallas flash; else the
     fused XLA reference (crossover: engine.flash_attention_min_t,
-    docs/perf.md)."""
+    docs/perf.md). ``window``: sliding-window span (causal only; the
+    flash path skips dead blocks — O(T·window) compute; sequence-mesh
+    paths do not support it yet and refuse)."""
     from ..ops import flash_attention as fa
     from ..parallel.ring_attention import (ring_attention,
                                            attention_reference)
     t, hd = q.shape[1], q.shape[-1]
     if mesh is not None:
+        if window:
+            raise ValueError(
+                "sliding-window attention is not supported on a "
+                "'sequence' mesh axis yet — drop the axis or the "
+                "window")
         scheme = root.common.engine.sequence_parallel
         n_seq = mesh.shape["sequence"]
         if scheme == "ulysses" and n_heads % n_seq == 0:
@@ -38,8 +46,9 @@ def attention_core(q, k, v, *, causal=False, mesh=None, n_heads=1):
             return ulysses_attention(q, k, v, mesh, causal=causal)
         return ring_attention(q, k, v, mesh, causal=causal)
     if fa.choose_flash(t, hd):
-        return fa.flash_attention(q, k, v, causal=causal)
-    return attention_reference(q, k, v, causal=causal)
+        return fa.flash_attention(q, k, v, causal=causal,
+                                  window=window)
+    return attention_reference(q, k, v, causal=causal, window=window)
 
 
 class MultiHeadAttention(ForwardBase):

@@ -97,8 +97,9 @@ def _block_prefill(block, p, x, cache_k, cache_v):
     if kv != h:
         k = jnp.repeat(k, h // kv, axis=2)
         v = jnp.repeat(v, h // kv, axis=2)
-    o = attention_core(q, k, v, causal=True, mesh=None,
-                       n_heads=h).reshape(b, t, d)
+    o = attention_core(q, k, v, causal=True, mesh=None, n_heads=h,
+                       window=getattr(block, "window", None)
+                       ).reshape(b, t, d)
     x = x + jnp.dot(o, p["wo"], precision=prec)
     f_in = _layernorm(jnp, x, p["ln2_g"], p["ln2_b"])
     hmid = _gelu(jnp, jnp.dot(f_in, p["w1"], precision=prec) + p["b1"])
@@ -134,7 +135,12 @@ def _block_step(block, p, x_t, cache_k, cache_v, pos):
     q5 = q.reshape(b, 1, kv, g, hd).astype(jnp.float32)
     s = jnp.einsum("bqkgd,btkd->bkgqt", q5,
                    cache_k.astype(jnp.float32)) / numpy.sqrt(hd)
-    valid = (jnp.arange(t_max) <= pos)[None, None, None, None, :]
+    valid = jnp.arange(t_max) <= pos
+    win = getattr(block, "window", None)
+    if win:
+        # sliding window: only the last `win` cached rows are visible
+        valid = valid & (jnp.arange(t_max) > pos - win)
+    valid = valid[None, None, None, None, :]
     s = jnp.where(valid, s, -1e30)
     w = jnp.exp(s - s.max(axis=-1, keepdims=True))
     w = w / w.sum(axis=-1, keepdims=True)

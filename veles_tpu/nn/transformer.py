@@ -74,9 +74,17 @@ class TransformerBlock(ForwardBase):
                    "ln1_g", "ln1_b", "ln2_g", "ln2_b")
 
     def __init__(self, workflow, n_heads=4, ffn_hidden=0, causal=True,
-                 rope=False, n_kv_heads=None, **kwargs):
+                 rope=False, n_kv_heads=None, window=None, **kwargs):
         super().__init__(workflow, **kwargs)
         self.n_heads = int(n_heads)
+        #: sliding-window attention span (self + window-1 predecessors,
+        #: Mistral convention); unset = full attention. Causal only.
+        #: The attribute only exists when set, so full-attention
+        #: exports carry no null config key.
+        if window:
+            if not causal:
+                raise ValueError("window requires causal=True")
+            self.window = int(window)
         #: grouped-query attention: n_kv_heads < n_heads shares each K/V
         #: head across n_heads/n_kv_heads query heads — the KV cache
         #: (and wk/wv) shrink by that factor; None = classic MHA
@@ -163,7 +171,9 @@ class TransformerBlock(ForwardBase):
             k = jnp.repeat(k, h // kv, axis=2)
             v = jnp.repeat(v, h // kv, axis=2)
         o = attention_core(q, k, v, causal=self.causal, mesh=self.mesh,
-                           n_heads=h).reshape(b, t, d)
+                           n_heads=h,
+                           window=getattr(self, "window", None)
+                           ).reshape(b, t, d)
         x = x + jnp.dot(o, params["wo"], precision=prec)
         f_in = _layernorm(jnp, x, params["ln2_g"], params["ln2_b"])
         hmid = _gelu(jnp, jnp.dot(f_in, params["w1"], precision=prec)
@@ -189,7 +199,11 @@ class TransformerBlock(ForwardBase):
             v = numpy.repeat(v, h // kv, axis=2)
         s = numpy.einsum("bqhd,bkhd->bhqk", q, k) / numpy.sqrt(hd)
         if self.causal:
-            mask = numpy.tril(numpy.ones((t, t), bool))
+            rel = numpy.arange(t)[:, None] - numpy.arange(t)[None, :]
+            mask = rel >= 0
+            win = getattr(self, "window", None)
+            if win:
+                mask = mask & (rel < win)
             s = numpy.where(mask[None, None], s, -1e30)
         s = s - s.max(axis=-1, keepdims=True)
         p = numpy.exp(s)

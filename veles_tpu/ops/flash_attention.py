@@ -34,8 +34,46 @@ LANE = 128
 NEG_INF = -1e30
 
 
+def _mask_scores(s, q_start, k_start, block_q: int, block_k: int,
+                 causal: bool, window: int):
+    """The one copy of the score mask all three kernels share:
+    causal (k <= q) and, when ``window`` > 0, sliding-window
+    (q - k < window: each query attends to itself plus window-1
+    predecessors — the Mistral convention)."""
+    if not causal and not window:
+        return s
+    q_pos = q_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    k_pos = k_start + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    keep = None
+    if causal:
+        keep = q_pos >= k_pos
+    if window:
+        in_win = q_pos - k_pos < window
+        keep = in_win if keep is None else keep & in_win
+    return jnp.where(keep, s, NEG_INF)
+
+
+def _block_live(q_start, k_start, block_q: int, block_k: int,
+                causal: bool, window: int):
+    """Whether a (q-block, k-block) pair holds ANY unmasked score —
+    the block-skip predicate paired with _mask_scores. Causal kills
+    blocks strictly above the diagonal; a window kills blocks entirely
+    behind every query row's horizon."""
+    live = True
+    if causal:
+        live = k_start <= q_start + block_q - 1
+    if window:
+        live = jnp.logical_and(
+            live, k_start + block_k - 1 >
+            q_start - window)  # newest k in block within oldest q's win
+    return live
+
+
 def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
-            scale: float, causal: bool, block_q: int, block_k: int):
+            scale: float, causal: bool, block_q: int, block_k: int,
+            window: int):
     from jax.experimental import pallas as pl
 
     qi, ki = pl.program_id(1), pl.program_id(2)
@@ -56,12 +94,8 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk)
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _mask_scores(s, q_start, k_start, block_q, block_k,
+                         causal, window)
         m_prev = m_scr[:, :1]                       # (bq, 1)
         m_cur = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_cur)             # (bq, 1)
@@ -74,9 +108,11 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        # skip K/V blocks strictly above the causal diagonal
-        pl.when(k_start <= q_start + block_q - 1)(_step)
+    if causal or window:
+        # skip K/V blocks with no unmasked scores (above the causal
+        # diagonal / behind the window horizon)
+        pl.when(_block_live(q_start, k_start, block_q, block_k,
+                            causal, window))(_step)
     else:
         _step()
 
@@ -90,7 +126,7 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
 
 
 def _fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
-                block_k: int, interpret: bool):
+                block_k: int, interpret: bool, window: int = 0):
     """q, k, v: (G, T, D) with D == LANE; → (o (G, T, D),
     lse (G, 8, T) sublane-padded — callers use ``lse[:, 0, :]``)."""
     from jax.experimental import pallas as pl
@@ -98,7 +134,8 @@ def _fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
     g, t, d = q.shape
     grid = (g, t // block_q, t // block_k)
     kernel = functools.partial(_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
+                               block_q=block_q, block_k=block_k,
+                               window=window)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -135,7 +172,7 @@ def _fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
     )(q, k, v)
 
 
-def _bwd_blockwise(causal, scale, block_k, res, do):
+def _bwd_blockwise(causal, scale, block_k, window, res, do):
     """Blockwise recompute backward (no (T, T) materialization)."""
     q, k, v, o, lse = res
     g, t, d = q.shape
@@ -151,10 +188,14 @@ def _bwd_blockwise(causal, scale, block_k, res, do):
         vs = jax.lax.dynamic_slice_in_dim(v, j * block_k, block_k, 1)
         ksf = ks.astype(jnp.float32)
         s = jnp.einsum("gqd,gkd->gqk", qf, ksf) * scale
-        if causal:
+        if causal or window:
             k_pos = j * block_k + jnp.arange(block_k)
-            s = jnp.where(q_pos[None, :, None] >= k_pos[None, None, :],
-                          s, NEG_INF)
+            rel = q_pos[None, :, None] - k_pos[None, None, :]
+            keep = rel >= 0 if causal else True
+            if window:
+                in_win = rel < window
+                keep = in_win if keep is True else keep & in_win
+            s = jnp.where(keep, s, NEG_INF)
         p = jnp.exp(s - lse[..., None])                     # (G, T, bk)
         dv = jnp.einsum("gqk,gqd->gkd", p, dof)
         dp = jnp.einsum("gqd,gkd->gqk", dof, vs.astype(jnp.float32))
@@ -173,7 +214,7 @@ def _bwd_blockwise(causal, scale, block_k, res, do):
 def _bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *,
                     scale: float, causal: bool, block_q: int,
-                    block_k: int):
+                    block_k: int, window: int):
     from jax.experimental import pallas as pl
 
     ki, qi = pl.program_id(1), pl.program_id(2)
@@ -196,12 +237,8 @@ def _bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (bq, bk)
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _mask_scores(s, q_start, k_start, block_q, block_k,
+                         causal, window)
         p = jnp.exp(s - lse)               # (bq, bk) f32
         # dv_j += p^T do_i    (contract the bq axis)
         dv_acc[:] = dv_acc[:] + jax.lax.dot_general(
@@ -216,10 +253,12 @@ def _bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        # q blocks entirely above the diagonal contribute nothing to
-        # this k block
-        pl.when(q_start + block_q - 1 >= k_start)(_step)
+    if causal or window:
+        # same liveness predicate as the forward, from the k block's
+        # perspective (q/k roles swap in the grid, the set of live
+        # (q, k) pairs does not)
+        pl.when(_block_live(q_start, k_start, block_q, block_k,
+                            causal, window))(_step)
     else:
         _step()
 
@@ -231,7 +270,7 @@ def _bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
 
 def _bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
                    dq_ref, dq_acc, *, scale: float, causal: bool,
-                   block_q: int, block_k: int):
+                   block_q: int, block_k: int, window: int):
     from jax.experimental import pallas as pl
 
     qi, ki = pl.program_id(1), pl.program_id(2)
@@ -253,12 +292,8 @@ def _bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = q_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            k_pos = k_start + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        s = _mask_scores(s, q_start, k_start, block_q, block_k,
+                         causal, window)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -269,8 +304,9 @@ def _bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
-    if causal:
-        pl.when(k_start <= q_start + block_q - 1)(_step)
+    if causal or window:
+        pl.when(_block_live(q_start, k_start, block_q, block_k,
+                            causal, window))(_step)
     else:
         _step()
 
@@ -280,7 +316,8 @@ def _bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
 
 
 def _bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
-                block_q: int, block_k: int, interpret: bool):
+                block_q: int, block_k: int, interpret: bool,
+                window: int = 0):
     """Pallas twin of ``_bwd_blockwise``: same math, VMEM-resident
     blockwise recompute. delta = rowsum(do*o) is O(T·D) and computed
     outside; lse/delta ride in the forward's (G, 8, T) sublane-padded
@@ -292,7 +329,7 @@ def _bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
     pad8 = jnp.broadcast_to(delta[:, None, :], (g, 8, t))
     lse8 = jnp.broadcast_to(lse[:, None, :], (g, 8, t))
     common = dict(scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k)
+                  block_k=block_k, window=window)
     qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0),
@@ -357,25 +394,28 @@ def _use_pallas_bwd() -> bool:
                                        True))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, causal, scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
+           window):
     o, _ = _fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                       interpret)
+                       interpret, window)
     return o
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               window):
     o, lse = _fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                         interpret)
+                         interpret, window)
     return o, (q, k, v, o, lse[:, 0, :])
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+def _flash_bwd(causal, scale, block_q, block_k, interpret, window,
+               res, do):
     if _use_pallas_bwd():
         q, k, v, o, lse = res
         return _bwd_pallas(q, k, v, o, lse, do, causal, scale,
-                           block_q, block_k, interpret)
-    return _bwd_blockwise(causal, scale, block_k, res, do)
+                           block_q, block_k, interpret, window)
+    return _bwd_blockwise(causal, scale, block_k, window, res, do)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -419,12 +459,16 @@ def choose_flash(t: int, d: int) -> bool:
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None, block_q: int = 128,
                     block_k: int = 128,
-                    interpret: Optional[bool] = None):
+                    interpret: Optional[bool] = None,
+                    window: Optional[int] = None):
     """(B, T, H, D) × 3 → (B, T, H, D), differentiable.
 
     Falls back is the caller's job — check ``supported(T, D)`` first.
     ``interpret`` defaults to True off-TPU so tests exercise the same
-    kernel on the CPU backend.
+    kernel on the CPU backend. ``window=W`` restricts each query to
+    itself plus W-1 predecessors (sliding-window / Mistral convention;
+    requires ``causal``): compute AND the blockwise backward drop the
+    dead blocks, so long-T cost scales O(T·W) instead of O(T²).
     """
     b, t, h, d = q.shape
     if scale is None:
@@ -432,6 +476,13 @@ def flash_attention(q, k, v, causal: bool = False,
     if not supported(t, d, block_q, block_k):
         raise ValueError("flash_attention: T=%d D=%d not supported with "
                          "blocks (%d, %d)" % (t, d, block_q, block_k))
+    window = int(window or 0)
+    if window < 0:
+        raise ValueError("window must be >= 1 (or None)")
+    if window and not causal:
+        raise ValueError("sliding-window attention requires causal=True")
+    if window >= t:
+        window = 0          # a window covering everything is no window
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
@@ -444,6 +495,6 @@ def flash_attention(q, k, v, causal: bool = False,
         return xt
 
     o = _flash(fold(q), fold(k), fold(v), causal, float(scale),
-               block_q, block_k, interpret)
+               block_q, block_k, interpret, window)
     o = o[..., :d].reshape(b, h, t, d)
     return jnp.moveaxis(o, 1, 2)

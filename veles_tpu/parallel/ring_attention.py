@@ -75,15 +75,23 @@ def ring_attention(q, k, v, mesh, axis: str = "sequence",
 
 
 def attention_reference(q, k, v, causal: bool = False,
-                        scale: Optional[float] = None):
-    """Single-device exact attention — the oracle for ring_attention."""
+                        scale: Optional[float] = None,
+                        window: Optional[int] = None):
+    """Single-device exact attention — the oracle for ring_attention
+    and the flash kernel. ``window=W``: each query sees itself plus
+    W-1 predecessors (requires causal)."""
     import jax.numpy as jnp
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if window and not causal:
+        raise ValueError("sliding-window attention requires causal=True")
     if causal:
         tq, tk = s.shape[-2], s.shape[-1]
-        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        rel = jnp.arange(tq)[:, None] - jnp.arange(tk)[None, :]
+        mask = rel >= 0
+        if window:
+            mask = mask & (rel < window)
         s = jnp.where(mask[None, None], s, -1e30)
     p = jnp.exp(s - s.max(axis=-1, keepdims=True))
     p = p / p.sum(axis=-1, keepdims=True)
