@@ -184,6 +184,25 @@ def sec_attn(bench, dev, n):
                 print("  attn t=%d train=%s %s: %s"
                       % (t, train, name, row["variants"][name]),
                       flush=True)
+            if not train:
+                # sliding-window flash: dead-block skipping should make
+                # cost ~O(T*W) — the long-T payoff of the window feature
+                for w in (t // 4, t // 8):
+                    def wcore(q, k, v, causal=True, w=w):
+                        return flash_attention(q, k, v, causal=True,
+                                               window=w)
+                    name = "flash_win%d" % w
+                    try:
+                        dt = ba.time_fn(wrap(wcore), q, k, v)
+                        row["variants"][name] = {
+                            "ms": round(dt * 1e3, 2),
+                            "tflops_full_equiv": round(
+                                flops / dt / 1e12, 2)}
+                    except Exception as e:    # noqa: BLE001
+                        row["variants"][name] = {"error": str(e)[-300:]}
+                    print("  attn t=%d %s: %s"
+                          % (t, name, row["variants"][name]),
+                          flush=True)
             if train:
                 # pallas-bwd (default) vs jnp blockwise bwd, same
                 # 128x128 forward — the new backward's own A/B
