@@ -267,3 +267,40 @@ def test_expert_parallel_matches_replicated():
                                       base["train_err"], atol=0.01)
         numpy.testing.assert_allclose(r["weights"], base["weights"],
                                       rtol=2e-3, atol=2e-4)
+
+
+def test_sp_windowed_matches_1dev():
+    """Sliding-window attention composes with the 'sequence' axis: a
+    windowed TransformerBlock under {'sequence': 4} (ring path,
+    shortened rotation scan) matches the 1-device windowed run."""
+    def run(mesh_axes):
+        prng.seed_all(555)
+        loader = SeqLoader(None, minibatch_size=32, name="seq-win")
+        wf = nn.StandardWorkflow(
+            name="sp-win",
+            layers=[
+                {"type": "transformer_block", "n_heads": 2,
+                 "ffn_hidden": 16, "causal": True, "window": 5},
+                {"type": "mean_pool"},
+                {"type": "softmax", "output_sample_shape": 2},
+            ],
+            loader_unit=loader, loss_function="softmax",
+            decision_config=dict(max_epochs=3, fail_iterations=100),
+        )
+        wf.initialize(device=vt.XLADevice(mesh_axes=mesh_axes))
+        wf.run()
+        import jax
+        return {
+            "train_err": numpy.asarray(wf.decision.epoch_metrics[TRAIN]),
+            "wq": numpy.asarray(jax.device_get(
+                wf.train_step.params[wf.forwards[0].name]["wq"])),
+            "mesh_engaged": wf.forwards[0].mesh is not None,
+        }
+
+    r1 = run({"data": 1})
+    r4 = run({"sequence": 4})
+    assert not r1["mesh_engaged"] and r4["mesh_engaged"]
+    numpy.testing.assert_allclose(r4["train_err"], r1["train_err"],
+                                  atol=0.02)
+    numpy.testing.assert_allclose(r4["wq"], r1["wq"], rtol=5e-3,
+                                  atol=5e-4)

@@ -210,3 +210,68 @@ def test_ulysses_flash_inner_matches_reference():
     numpy.testing.assert_allclose(numpy.asarray(out),
                                   numpy.asarray(ref),
                                   rtol=2e-4, atol=2e-5)
+
+
+def test_ring_attention_windowed_matches_reference():
+    """Ring + sliding window: masked AND ring-shortened (the scan runs
+    ceil((W-1+Tl)/Tl) rotations, not n) vs the windowed reference.
+    Windows chosen to need 1, 2, and all ring hops at Tl = 8."""
+    import jax.numpy as jnp
+    rng = numpy.random.RandomState(7)
+    b, t, h, d = 1, 32, 2, 4
+    q = jnp.asarray(rng.randn(b, t, h, d).astype(numpy.float32))
+    k = jnp.asarray(rng.randn(b, t, h, d).astype(numpy.float32))
+    v = jnp.asarray(rng.randn(b, t, h, d).astype(numpy.float32))
+    mesh = seq_mesh(4)
+    for win in (4, 8, 13, 31):
+        out = ring_attention(q, k, v, mesh, causal=True, window=win)
+        ref = attention_reference(q, k, v, causal=True, window=win)
+        numpy.testing.assert_allclose(
+            numpy.asarray(out), numpy.asarray(ref), rtol=2e-4,
+            atol=2e-5, err_msg="window=%d" % win)
+
+
+def test_ring_attention_windowed_differentiable():
+    import jax
+    import jax.numpy as jnp
+    rng = numpy.random.RandomState(8)
+    q = jnp.asarray(rng.randn(1, 16, 2, 4).astype(numpy.float32))
+    mesh = seq_mesh(4)
+
+    def loss_ring(q):
+        return (ring_attention(q, q, q, mesh, causal=True,
+                               window=6) ** 2).sum()
+
+    def loss_ref(q):
+        from veles_tpu.parallel.ring_attention import attention_reference
+        return (attention_reference(q, q, q, causal=True,
+                                    window=6) ** 2).sum()
+
+    g1 = jax.grad(loss_ring)(q)
+    g2 = jax.grad(loss_ref)(q)
+    numpy.testing.assert_allclose(numpy.asarray(g1), numpy.asarray(g2),
+                                  rtol=5e-4, atol=5e-4)
+
+
+def test_ulysses_windowed_matches_reference():
+    import jax.numpy as jnp
+    from veles_tpu.parallel.ulysses import ulysses_attention
+    rng = numpy.random.RandomState(9)
+    b, t, h, d = 1, 32, 4, 8
+    q = jnp.asarray(rng.randn(b, t, h, d).astype(numpy.float32))
+    k = jnp.asarray(rng.randn(b, t, h, d).astype(numpy.float32))
+    v = jnp.asarray(rng.randn(b, t, h, d).astype(numpy.float32))
+    mesh = seq_mesh(4)
+    out = ulysses_attention(q, k, v, mesh, causal=True, window=9)
+    ref = attention_reference(q, k, v, causal=True, window=9)
+    numpy.testing.assert_allclose(numpy.asarray(out),
+                                  numpy.asarray(ref), rtol=2e-4,
+                                  atol=2e-5)
+
+
+def test_ring_window_requires_causal():
+    import jax.numpy as jnp
+    import pytest as _pytest
+    q = jnp.zeros((1, 16, 2, 4), jnp.float32)
+    with _pytest.raises(ValueError, match="causal"):
+        ring_attention(q, q, q, seq_mesh(4), causal=False, window=4)

@@ -26,25 +26,23 @@ def attention_core(q, k, v, *, causal=False, mesh=None, n_heads=1,
     TransformerBlock. q/k/v: (B, T, H, Dh) → (B, T, H, Dh).
     sequence-mesh → ring/Ulysses; long T on TPU → Pallas flash; else the
     fused XLA reference (crossover: engine.flash_attention_min_t,
-    docs/perf.md). ``window``: sliding-window span (causal only; the
-    flash path skips dead blocks — O(T·window) compute; sequence-mesh
-    paths do not support it yet and refuse)."""
+    docs/perf.md). ``window``: sliding-window span (causal only). The
+    flash path skips dead blocks (O(T·window) compute); the ring path
+    additionally SHORTENS the rotation scan to the blocks the window
+    can reach; Ulysses passes the window to its inner attention."""
     from ..ops import flash_attention as fa
     from ..parallel.ring_attention import (ring_attention,
                                            attention_reference)
     t, hd = q.shape[1], q.shape[-1]
     if mesh is not None:
-        if window:
-            raise ValueError(
-                "sliding-window attention is not supported on a "
-                "'sequence' mesh axis yet — drop the axis or the "
-                "window")
         scheme = root.common.engine.sequence_parallel
         n_seq = mesh.shape["sequence"]
         if scheme == "ulysses" and n_heads % n_seq == 0:
             from ..parallel.ulysses import ulysses_attention
-            return ulysses_attention(q, k, v, mesh, causal=causal)
-        return ring_attention(q, k, v, mesh, causal=causal)
+            return ulysses_attention(q, k, v, mesh, causal=causal,
+                                     window=window)
+        return ring_attention(q, k, v, mesh, causal=causal,
+                              window=window)
     if fa.choose_flash(t, hd):
         return fa.flash_attention(q, k, v, causal=causal,
                                   window=window)

@@ -17,9 +17,17 @@ from typing import Optional
 
 
 def ring_attention(q, k, v, mesh, axis: str = "sequence",
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   window: Optional[int] = None):
     """q, k, v: (B, T, H, D) GLOBAL arrays (or already sharded); returns
-    (B, T, H, D) attention output, sequence axis sharded over ``axis``."""
+    (B, T, H, D) attention output, sequence axis sharded over ``axis``.
+
+    ``window=W`` (causal only): each query sees itself plus W-1
+    predecessors. Beyond the mask, the ring itself shortens — a device
+    only ever needs K/V blocks reaching W-1 positions behind its
+    oldest query, so the rotation scan runs ``min(n, ceil((W-1+Tl)/Tl))``
+    steps instead of ``n``: fewer ppermutes over ICI and fewer masked
+    einsums, the point of windowed attention at ring scale."""
     import jax
     import jax.numpy as jnp
     from jax import shard_map
@@ -27,6 +35,9 @@ def ring_attention(q, k, v, mesh, axis: str = "sequence",
 
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
+    window = int(window or 0)
+    if window and not causal:
+        raise ValueError("sliding-window attention requires causal=True")
     n = mesh.shape[axis]
     # carry the batch sharding through: without 'data' in the specs a
     # dp x sp mesh would all-gather the batch and compute it redundantly
@@ -37,6 +48,9 @@ def ring_attention(q, k, v, mesh, axis: str = "sequence",
         my = jax.lax.axis_index(axis)
         tl = q_blk.shape[1]
         q_pos = my * tl + jnp.arange(tl)
+        # uniform across devices (SPMD): the step count bound comes
+        # from the worst case (oldest query row of a block)
+        steps = n if not window else min(n, (window + tl - 2) // tl + 1)
 
         def body(carry, i):
             o, m, l, kb, vb = carry
@@ -45,7 +59,10 @@ def ring_attention(q, k, v, mesh, axis: str = "sequence",
             s = s.astype(jnp.float32)
             if causal:
                 k_pos = src * tl + jnp.arange(tl)
-                mask = q_pos[:, None] >= k_pos[None, :]
+                rel = q_pos[:, None] - k_pos[None, :]
+                mask = rel >= 0
+                if window:
+                    mask = mask & (rel < window)
                 s = jnp.where(mask[None, None], s, -1e30)
             m_new = jnp.maximum(m, s.max(axis=-1))
             alpha = jnp.exp(m - m_new)
@@ -64,7 +81,7 @@ def ring_attention(q, k, v, mesh, axis: str = "sequence",
         m0 = jnp.full((b, h, tl_), -jnp.inf, dtype=jnp.float32)
         l0 = jnp.zeros((b, h, tl_), dtype=jnp.float32)
         (o, m, l, _, _), _ = jax.lax.scan(
-            body, (o0, m0, l0, k_blk, v_blk), jnp.arange(n))
+            body, (o0, m0, l0, k_blk, v_blk), jnp.arange(steps))
         denom = l.transpose(0, 2, 1)[..., None]
         return (o / jnp.maximum(denom, 1e-30)).astype(q_blk.dtype)
 

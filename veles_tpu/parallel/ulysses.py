@@ -23,7 +23,8 @@ from typing import Optional
 
 def ulysses_attention(q, k, v, mesh, axis: str = "sequence",
                       causal: bool = False,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None,
+                      window: Optional[int] = None):
     """q, k, v: (B, T, H, D) global arrays; returns (B, T, H, D) with the
     sequence axis sharded over ``axis``."""
     import jax
@@ -54,10 +55,10 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sequence",
         from ..ops import flash_attention as fa
         if fa.choose_flash(t, hd):
             o = fa.flash_attention(qh, kh, vh, causal=causal,
-                                   scale=scale)
+                                   scale=scale, window=window)
         else:
             o = attention_reference(qh, kh, vh, causal=causal,
-                                    scale=scale)
+                                    scale=scale, window=window)
         # (B, T, H/n, D) → all-to-all back → (B, T/n, H, D)
         return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2,
                                   tiled=True)
