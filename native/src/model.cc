@@ -676,12 +676,23 @@ struct TransformerBlock : Unit {
   int window = 0;      // sliding-window span; 0 = full attention
   bool causal = true;
   bool rope = false;
+  bool rms = false;     // norm="rms": no centering, no bias
+  bool swiglu = false;  // ffn="swiglu": W2*(silu(W1 x) . W3 x)
 
+  // b == nullptr selects RMSNorm (no centering, no bias) — the twin of
+  // transformer.py block_norm
   static void LayerNorm(const float *x, const float *g, const float *b,
                         float *y, int n, int d) {
     for (int r = 0; r < n; ++r) {
       const float *xr = x + static_cast<size_t>(r) * d;
       float *yr = y + static_cast<size_t>(r) * d;
+      if (b == nullptr) {
+        float ms = 0;
+        for (int i = 0; i < d; ++i) ms += xr[i] * xr[i];
+        float inv = 1.0f / std::sqrt(ms / d + 1e-5f);
+        for (int i = 0; i < d; ++i) yr[i] = xr[i] * inv * g[i];
+        continue;
+      }
       float mu = 0;
       for (int i = 0; i < d; ++i) mu += xr[i];
       mu /= d;
@@ -694,6 +705,8 @@ struct TransformerBlock : Unit {
     }
   }
 
+  static float Silu(float x) { return x / (1.0f + std::exp(-x)); }
+
   static float Gelu(float x) {
     const float c = 0.7978845608028654f;  // sqrt(2/pi)
     return 0.5f * x * (1.0f + std::tanh(c * (x + 0.044715f * x * x * x)));
@@ -704,6 +717,7 @@ struct TransformerBlock : Unit {
                    *wv = Param("wv"), *wo = Param("wo"),
                    *w1 = Param("w1"), *b1 = Param("b1"),
                    *w2 = Param("w2"), *b2 = Param("b2"),
+                   *w3 = Param("w3"),
                    *g1 = Param("ln1_g"), *bb1 = Param("ln1_b"),
                    *g2 = Param("ln2_g"), *bb2 = Param("ln2_b");
     int batch = in.shape[0], t = in.shape[1], d = in.shape[2];
@@ -720,8 +734,8 @@ struct TransformerBlock : Unit {
       for (int b = lo; b < hi; ++b) {
         float *xb = out->data.data() + b * plane;
         // attention sub-block
-        LayerNorm(xb, g1->data.data(), bb1->data.data(), ln.data(), t,
-                  d);
+        LayerNorm(xb, g1->data.data(),
+                  rms ? nullptr : bb1->data.data(), ln.data(), t, d);
         MatMulRM(ln.data(), wq->data.data(), q.data(), t, d, d);
         MatMulRM(ln.data(), wk->data.data(), k.data(), t, d, kv_d);
         MatMulRM(ln.data(), wv->data.data(), v.data(), t, d, kv_d);
@@ -733,22 +747,35 @@ struct TransformerBlock : Unit {
                        s.data(), t, d, h, causal, kv_h, window);
         MatMulRM(ctx.data(), wo->data.data(), proj.data(), t, d, d);
         for (size_t i = 0; i < plane; ++i) xb[i] += proj[i];
-        // FFN sub-block
-        LayerNorm(xb, g2->data.data(), bb2->data.data(), ln.data(), t,
-                  d);
+        // FFN sub-block (gelu: W2*gelu(W1 x + b1) + b2; swiglu:
+        // W2*(silu(W1 x) . W3 x), no biases — transformer.py block_ffn)
+        LayerNorm(xb, g2->data.data(),
+                  rms ? nullptr : bb2->data.data(), ln.data(), t, d);
+        std::vector<float> gbuf(swiglu ? f : 0);
         for (int r = 0; r < t; ++r) {
           const float *xr = ln.data() + static_cast<size_t>(r) * d;
-          for (int j = 0; j < f; ++j) hbuf[j] = b1->data[j];
+          for (int j = 0; j < f; ++j)
+            hbuf[j] = swiglu ? 0.0f : b1->data[j];
+          if (swiglu) std::fill(gbuf.begin(), gbuf.end(), 0.0f);
           for (int i = 0; i < d; ++i) {
             float xv = xr[i];
             if (xv == 0.0f) continue;
             const float *row = w1->data.data() +
                                static_cast<size_t>(i) * f;
             for (int j = 0; j < f; ++j) hbuf[j] += xv * row[j];
+            if (swiglu) {
+              const float *row3 = w3->data.data() +
+                                  static_cast<size_t>(i) * f;
+              for (int j = 0; j < f; ++j) gbuf[j] += xv * row3[j];
+            }
           }
-          for (int j = 0; j < f; ++j) hbuf[j] = Gelu(hbuf[j]);
+          if (swiglu)
+            for (int j = 0; j < f; ++j) hbuf[j] = Silu(hbuf[j]) * gbuf[j];
+          else
+            for (int j = 0; j < f; ++j) hbuf[j] = Gelu(hbuf[j]);
           float *yr = xb + static_cast<size_t>(r) * d;
-          for (int i = 0; i < d; ++i) yr[i] += b2->data[i];
+          if (!swiglu)
+            for (int i = 0; i < d; ++i) yr[i] += b2->data[i];
           for (int j = 0; j < f; ++j) {
             float hv = hbuf[j];
             if (hv == 0.0f) continue;
@@ -1078,6 +1105,8 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
     if (cfg.Has("window")) u->window = cfg["window"].AsInt();
     if (cfg.Has("causal")) u->causal = cfg["causal"].AsBool();
     if (cfg.Has("rope")) u->rope = cfg["rope"].AsBool();
+    if (cfg.Has("norm")) u->rms = cfg["norm"].AsString() == "rms";
+    if (cfg.Has("ffn")) u->swiglu = cfg["ffn"].AsString() == "swiglu";
     return u;
   }
   if (type == "mean_pool") return std::make_unique<MeanPool>();

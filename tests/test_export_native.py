@@ -240,6 +240,9 @@ def transformer_pkg(tmp_path_factory):
             {"type": "transformer_block", "n_heads": 2,
              "ffn_hidden": 16, "causal": True,
              "window": 3},       # sliding window: C++ kmin horizon
+            {"type": "transformer_block", "n_heads": 2,
+             "ffn_hidden": 16, "causal": True, "norm": "rms",
+             "ffn": "swiglu"},   # llama-style: C++ rms/silu-gate twin
             {"type": "mean_pool"},
             {"type": "softmax", "output_sample_shape": 3},
         ],

@@ -472,3 +472,74 @@ def test_windowed_generation_matches_naive():
         naive = lm.generate_naive(wf, prompt, 10, temperature=0)
         cached = sampling.generate(wf, prompt, 10, temperature=0)
         assert naive == cached, (naive, cached)
+
+
+def test_rms_swiglu_oracle_agreement():
+    """llama-style block options (norm='rms', ffn='swiglu'): jax apply
+    vs numpy oracle; param census drops biases and gains w3."""
+    prev = vt.root.common.engine.compute_dtype
+    vt.root.common.engine.compute_dtype = "float32"
+    try:
+        wf = vt.Workflow(name="llam")
+        u = nn.TransformerBlock(wf, n_heads=2, ffn_hidden=24,
+                                causal=True, norm="rms", ffn="swiglu")
+        x = numpy.random.RandomState(6).randn(2, 8, 12).astype(
+            "float32")
+        u.input = Array(x)
+        u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        p = u.params_np()
+        assert "w3" in p and "b1" not in p and "b2" not in p
+        assert "ln1_b" not in p and "ln2_b" not in p
+        u.xla_run()
+        y = numpy.asarray(u.output.map_read())
+        y_np = u.numpy_apply(p, x)
+        numpy.testing.assert_allclose(y, y_np, rtol=1e-4, atol=1e-4)
+    finally:
+        vt.root.common.engine.compute_dtype = prev
+
+
+def test_block_option_validation():
+    wf = vt.Workflow(name="bad-opts")
+    with pytest.raises(ValueError, match="norm"):
+        nn.TransformerBlock(wf, norm="batch")
+    with pytest.raises(ValueError, match="ffn"):
+        nn.TransformerBlock(wf, ffn="relu")
+
+
+def test_llama_style_lm_trains_and_generates():
+    """The modern-LM composition in one stack: RMSNorm + SwiGLU + GQA +
+    RoPE + sliding window. Trains through StandardWorkflow; the
+    KV-cached sampler (which shares block_norm/block_ffn with the
+    trained forward) reproduces the re-forward oracle exactly."""
+    from veles_tpu.loader import TextFileLoader
+    from veles_tpu.nn import sampling
+    from conftest import import_model
+    lm = import_model("char_lm")
+    import tempfile, os as _os
+    with tempfile.TemporaryDirectory() as td:
+        p = _os.path.join(td, "c.txt")
+        with open(p, "w") as f:
+            f.write("to be or not to be that is the question " * 40)
+        prng.seed_all(21)
+        loader = TextFileLoader(None, files=[p], seq_len=16,
+                                minibatch_size=8, name="llama-text")
+        wf = nn.StandardWorkflow(
+            name="llama-lm",
+            layers=[{"type": "embedding", "vocab_size": 64, "dim": 24,
+                     "solver": "adam", "learning_rate": 0.01},
+                    {"type": "transformer_block", "n_heads": 4,
+                     "n_kv_heads": 2, "ffn_hidden": 64, "causal": True,
+                     "rope": True, "norm": "rms", "ffn": "swiglu",
+                     "window": 8, "solver": "adam",
+                     "learning_rate": 0.01, "name": "L0"},
+                    {"type": "lm_head", "vocab_size": 64,
+                     "solver": "adam", "learning_rate": 0.01}],
+            loader_unit=loader, loss_function="softmax_seq",
+            decision_config=dict(max_epochs=3, fail_iterations=50))
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        wf.run()
+        hist = wf.decision.epoch_metrics
+        prompt = [2, 3, 4, 5]
+        naive = lm.generate_naive(wf, prompt, 10, temperature=0)
+        cached = sampling.generate(wf, prompt, 10, temperature=0)
+        assert naive == cached, (naive, cached)

@@ -70,7 +70,8 @@ _EXPORT_KEYS = (
     "output_sample_shape", "n_kernels", "n_channels", "kx", "ky",
     "sliding", "padding", "include_bias", "factor", "alpha", "beta",
     "n", "k", "hidden_size", "return_sequences", "forget_bias",
-    "n_heads", "n_kv_heads", "window", "causal", "dropout_ratio",
+    "n_heads", "n_kv_heads", "window", "norm", "ffn", "causal",
+    "dropout_ratio",
     "n_experts", "hidden", "top_k", "capacity_factor", "ffn_hidden",
     "rope", "vocab_size", "dim",
 )

@@ -22,7 +22,8 @@ import numpy
 
 from ..error import VelesError
 from .transformer import (Embedding, LMHead, PositionalEmbedding,
-                          TransformerBlock, _gelu, _layernorm, _rope)
+                          TransformerBlock, _rope, block_ffn,
+                          block_norm)
 
 
 def _rope_at(np_mod, x, pos, base=10000.0):
@@ -84,7 +85,7 @@ def _block_prefill(block, p, x, cache_k, cache_v):
     kv = getattr(block, "n_kv_heads", h)
     hd = d // h
 
-    a_in = _layernorm(jnp, x, p["ln1_g"], p["ln1_b"])
+    a_in = block_norm(jnp, block, p, x, "ln1")
     q = jnp.dot(a_in, p["wq"], precision=prec).reshape(b, t, h, hd)
     k = jnp.dot(a_in, p["wk"], precision=prec).reshape(b, t, kv, hd)
     v = jnp.dot(a_in, p["wv"], precision=prec).reshape(b, t, kv, hd)
@@ -101,9 +102,8 @@ def _block_prefill(block, p, x, cache_k, cache_v):
                        window=getattr(block, "window", None)
                        ).reshape(b, t, d)
     x = x + jnp.dot(o, p["wo"], precision=prec)
-    f_in = _layernorm(jnp, x, p["ln2_g"], p["ln2_b"])
-    hmid = _gelu(jnp, jnp.dot(f_in, p["w1"], precision=prec) + p["b1"])
-    return x + jnp.dot(hmid, p["w2"], precision=prec) + p["b2"], \
+    f_in = block_norm(jnp, block, p, x, "ln2")
+    return x + block_ffn(jnp, block, p, f_in, prec), \
         cache_k, cache_v
 
 
@@ -119,7 +119,7 @@ def _block_step(block, p, x_t, cache_k, cache_v, pos):
     g = h // kv
     hd = d // h
 
-    a_in = _layernorm(jnp, x_t, p["ln1_g"], p["ln1_b"])
+    a_in = block_norm(jnp, block, p, x_t, "ln1")
     q = jnp.dot(a_in, p["wq"], precision=prec).reshape(b, 1, h, hd)
     k = jnp.dot(a_in, p["wk"], precision=prec).reshape(b, 1, kv, hd)
     v = jnp.dot(a_in, p["wv"], precision=prec).reshape(b, 1, kv, hd)
@@ -148,9 +148,8 @@ def _block_step(block, p, x_t, cache_k, cache_v, pos):
                    cache_v.astype(jnp.float32)).astype(x_t.dtype)
     o = o.reshape(b, 1, d)
     x_t = x_t + jnp.dot(o, p["wo"], precision=prec)
-    f_in = _layernorm(jnp, x_t, p["ln2_g"], p["ln2_b"])
-    hmid = _gelu(jnp, jnp.dot(f_in, p["w1"], precision=prec) + p["b1"])
-    return x_t + jnp.dot(hmid, p["w2"], precision=prec) + p["b2"], \
+    f_in = block_norm(jnp, block, p, x_t, "ln2")
+    return x_t + block_ffn(jnp, block, p, f_in, prec), \
         cache_k, cache_v
 
 

@@ -40,6 +40,42 @@ def _gelu(np_mod, x):
     return 0.5 * x * (1.0 + np_mod.tanh(c * (x + 0.044715 * x ** 3)))
 
 
+def _rmsnorm(np_mod, x, g, eps=1e-5):
+    return x / np_mod.sqrt((x ** 2).mean(axis=-1, keepdims=True)
+                           + eps) * g
+
+
+def _silu(np_mod, x):
+    return x / (1.0 + np_mod.exp(-x))
+
+
+def block_norm(np_mod, block, p, x, which: str):
+    """The block's normalization sub-layer (``which``: "ln1"/"ln2") —
+    one definition shared by training (apply/numpy_apply) and the
+    KV-cached sampler so the two cannot drift. norm="rms" drops the
+    mean-centering and the bias (llama convention)."""
+    if getattr(block, "norm", "layer") == "rms":
+        return _rmsnorm(np_mod, x, p[which + "_g"])
+    return _layernorm(np_mod, x, p[which + "_g"], p[which + "_b"])
+
+
+def block_ffn(np_mod, block, p, x, prec=None):
+    """The block's FFN sub-layer, shared the same way. ffn="swiglu":
+    W2·(silu(W1 x) ⊙ W3 x), no biases (llama convention); default
+    GELU: W2·gelu(W1 x + b1) + b2."""
+    if np_mod is numpy:
+        def dot(a, b):
+            return a @ b
+    else:
+        def dot(a, b):
+            return np_mod.dot(a, b, precision=prec)
+    if getattr(block, "ffn", "gelu") == "swiglu":
+        return dot(_silu(np_mod, dot(x, p["w1"])) * dot(x, p["w3"]),
+                   p["w2"])
+    return dot(_gelu(np_mod, dot(x, p["w1"]) + p["b1"]),
+               p["w2"]) + p["b2"]
+
+
 def _rope(np_mod, x, base=10000.0):
     """Rotary position embedding on (B, T, H, Dh), HALF-SPLIT pairing
     (GPT-NeoX convention: feature j rotates with j+half — NOT the
@@ -71,12 +107,22 @@ class TransformerBlock(ForwardBase):
     PARAMETERIZED = True
     hide_from_registry = False
     PARAM_NAMES = ("wq", "wk", "wv", "wo", "w1", "b1", "w2", "b2",
-                   "ln1_g", "ln1_b", "ln2_g", "ln2_b")
+                   "w3", "ln1_g", "ln1_b", "ln2_g", "ln2_b")
 
     def __init__(self, workflow, n_heads=4, ffn_hidden=0, causal=True,
-                 rope=False, n_kv_heads=None, window=None, **kwargs):
+                 rope=False, n_kv_heads=None, window=None,
+                 norm="layer", ffn="gelu", **kwargs):
         super().__init__(workflow, **kwargs)
         self.n_heads = int(n_heads)
+        #: "layer" (GPT: centered, with bias) | "rms" (llama: scale
+        #: only); "gelu" (W1+b1 → gelu → W2+b2) | "swiglu" (llama:
+        #: W2·(silu(W1 x) ⊙ W3 x), no biases)
+        if norm not in ("layer", "rms"):
+            raise ValueError("norm must be 'layer' or 'rms'")
+        if ffn not in ("gelu", "swiglu"):
+            raise ValueError("ffn must be 'gelu' or 'swiglu'")
+        self.norm = norm
+        self.ffn = ffn
         #: sliding-window attention span (self + window-1 predecessors,
         #: Mistral convention); unset = full attention. Causal only.
         #: The attribute only exists when set, so full-attention
@@ -121,21 +167,28 @@ class TransformerBlock(ForwardBase):
         ones = numpy.ones((d,), dtype=dtype)
         zeros = numpy.zeros((d,), dtype=dtype)
         kv_d = (d // self.n_heads) * self.n_kv_heads
-        return {
+        params = {
             "wq": mk("wq", (d, d), stddev),
             "wk": mk("wk", (d, kv_d), stddev),
             "wv": mk("wv", (d, kv_d), stddev),
             "wo": mk("wo", (d, d), stddev),
             "w1": mk("w1", (d, f), stddev),
-            "b1": Array(numpy.zeros((f,), dtype=dtype),
-                        name=self.name + ".b1"),
             "w2": mk("w2", (f, d), 1.0 / numpy.sqrt(f)),
-            "b2": Array(zeros.copy(), name=self.name + ".b2"),
             "ln1_g": Array(ones.copy(), name=self.name + ".ln1_g"),
-            "ln1_b": Array(zeros.copy(), name=self.name + ".ln1_b"),
             "ln2_g": Array(ones.copy(), name=self.name + ".ln2_g"),
-            "ln2_b": Array(zeros.copy(), name=self.name + ".ln2_b"),
         }
+        if self.ffn == "swiglu":
+            params["w3"] = mk("w3", (d, f), stddev)
+        else:
+            params["b1"] = Array(numpy.zeros((f,), dtype=dtype),
+                                 name=self.name + ".b1")
+            params["b2"] = Array(zeros.copy(), name=self.name + ".b2")
+        if self.norm == "layer":
+            params["ln1_b"] = Array(zeros.copy(),
+                                    name=self.name + ".ln1_b")
+            params["ln2_b"] = Array(zeros.copy(),
+                                    name=self.name + ".ln2_b")
+        return params
 
     def initialize(self, device=None, **kwargs):
         res = super().initialize(device=device, **kwargs)
@@ -156,7 +209,7 @@ class TransformerBlock(ForwardBase):
         kv = getattr(self, "n_kv_heads", h)   # absent in old snapshots
         hd = d // h
 
-        a_in = _layernorm(jnp, x, params["ln1_g"], params["ln1_b"])
+        a_in = block_norm(jnp, self, params, x, "ln1")
         q = jnp.dot(a_in, params["wq"],
                     precision=prec).reshape(b, t, h, hd)
         k = jnp.dot(a_in, params["wk"],
@@ -175,11 +228,8 @@ class TransformerBlock(ForwardBase):
                            window=getattr(self, "window", None)
                            ).reshape(b, t, d)
         x = x + jnp.dot(o, params["wo"], precision=prec)
-        f_in = _layernorm(jnp, x, params["ln2_g"], params["ln2_b"])
-        hmid = _gelu(jnp, jnp.dot(f_in, params["w1"], precision=prec)
-                     + params["b1"])
-        return x + jnp.dot(hmid, params["w2"], precision=prec) \
-            + params["b2"]
+        f_in = block_norm(jnp, self, params, x, "ln2")
+        return x + block_ffn(jnp, self, params, f_in, prec)
 
     def numpy_apply(self, params, x):
         x = numpy.asarray(x, dtype=numpy.float32)
@@ -187,7 +237,7 @@ class TransformerBlock(ForwardBase):
         h = self.n_heads
         kv = getattr(self, "n_kv_heads", h)
         hd = d // h
-        a_in = _layernorm(numpy, x, params["ln1_g"], params["ln1_b"])
+        a_in = block_norm(numpy, self, params, x, "ln1")
 
         q = (a_in @ params["wq"]).reshape(b, t, h, hd)
         k = (a_in @ params["wk"]).reshape(b, t, kv, hd)
@@ -210,9 +260,8 @@ class TransformerBlock(ForwardBase):
         p /= p.sum(axis=-1, keepdims=True)
         o = numpy.einsum("bhqk,bkhd->bqhd", p, v).reshape(b, t, d)
         x = x + o @ params["wo"]
-        f_in = _layernorm(numpy, x, params["ln2_g"], params["ln2_b"])
-        hmid = _gelu(numpy, f_in @ params["w1"] + params["b1"])
-        return (x + hmid @ params["w2"] + params["b2"]).astype(
+        f_in = block_norm(numpy, self, params, x, "ln2")
+        return (x + block_ffn(numpy, self, params, f_in)).astype(
             numpy.float32)
 
 
