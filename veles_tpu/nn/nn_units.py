@@ -59,7 +59,7 @@ class ForwardBase(AcceleratedUnit):
     GD_KEYS = ("learning_rate", "learning_rate_bias", "weights_decay",
                "weight_decay", "weights_decay_bias", "gradient_moment",
                "momentum", "gradient_clip", "gradient_clip_norm",
-               "solver", "beta1", "beta2", "epsilon")
+               "solver", "beta1", "beta2", "epsilon", "rho")
 
     def __init__(self, workflow, **kwargs) -> None:
         #: hyper-parameters for the matched GD unit, captured from the
@@ -182,14 +182,18 @@ class GradientDescentBase(AcceleratedUnit):
         #: element-wise Znicz semantic)
         self.gradient_clip_norm = kwargs.get("gradient_clip_norm", 0.0)
         #: per-layer update rule: "sgd" (Znicz semantics) | "adam" |
-        #: "adagrad" — routed from the layer dict like the lr knobs
+        #: "adamw" (decoupled weight decay) | "adagrad" | "rmsprop" |
+        #: "adadelta" — routed from the layer dict like the lr knobs
         self.solver = kwargs.get("solver", "sgd")
         self.beta1 = kwargs.get("beta1", 0.9)
         self.beta2 = kwargs.get("beta2", 0.999)
         self.epsilon = kwargs.get("epsilon", 1e-8)
-        if self.solver not in ("sgd", "adam", "adagrad"):
-            raise Bug("unknown solver %r (sgd | adam | adagrad)"
-                      % self.solver)
+        #: rmsprop/adadelta accumulator decay
+        self.rho = kwargs.get("rho", 0.95)
+        if self.solver not in ("sgd", "adam", "adamw", "adagrad",
+                               "rmsprop", "adadelta"):
+            raise Bug("unknown solver %r (sgd | adam | adamw | adagrad "
+                      "| rmsprop | adadelta)" % self.solver)
 
     # -- pure update rule ----------------------------------------------------
     def init_state(self, params: Dict[str, Any]) -> Dict[str, Any]:
@@ -198,11 +202,17 @@ class GradientDescentBase(AcceleratedUnit):
         import jax
         import jax.numpy as jnp
         zeros = jax.tree_util.tree_map(lambda p: p * 0, params)
-        if self.solver == "adam":
-            return {"m": zeros, "v": jax.tree_util.tree_map(
-                lambda p: p * 0, params), "t": jnp.zeros((), jnp.int32)}
-        if self.solver == "adagrad":
+
+        def fresh():
+            return jax.tree_util.tree_map(lambda p: p * 0, params)
+
+        if self.solver in ("adam", "adamw"):
+            return {"m": zeros, "v": fresh(),
+                    "t": jnp.zeros((), jnp.int32)}
+        if self.solver in ("adagrad", "rmsprop"):
             return {"a": zeros}
+        if self.solver == "adadelta":
+            return {"a": zeros, "d": fresh()}
         return zeros
 
     def update(self, params: Dict[str, Any], grads: Dict[str, Any],
@@ -252,17 +262,33 @@ class GradientDescentBase(AcceleratedUnit):
                 g = jnp.clip(g, -self.gradient_clip, self.gradient_clip)
             return lr, g + wd * p
 
-        if self.solver == "adam":
+        if self.solver in ("adam", "adamw"):
+            # adamw: DECOUPLED weight decay (p -= lr*wd*p outside the
+            # moments) — knobs() folds wd into g, so for adamw the raw
+            # gradient goes through the moments and decay applies after
+            decoupled = self.solver == "adamw"
             t = state["t"] + 1
             new_m, new_v, new_params = {}, {}, {}
             for k, p in params.items():
-                lr, g = knobs(k, p, grads[k])
+                if decoupled:
+                    g = grads[k]
+                    if self.gradient_clip:
+                        g = jnp.clip(g, -self.gradient_clip,
+                                     self.gradient_clip)
+                    lr = (self.learning_rate_bias if k == "bias"
+                          else self.learning_rate) * lr_scale
+                    wd = (self.weight_decay_bias if k == "bias"
+                          else self.weight_decay)
+                else:
+                    lr, g = knobs(k, p, grads[k])
                 m = self.beta1 * state["m"][k] + (1 - self.beta1) * g
                 v = self.beta2 * state["v"][k] + (1 - self.beta2) * g * g
                 mhat = m / (1 - self.beta1 ** t.astype(m.dtype))
                 vhat = v / (1 - self.beta2 ** t.astype(v.dtype))
-                new_params[k] = p - lr * mhat / (jnp.sqrt(vhat)
-                                                 + self.epsilon)
+                step = lr * mhat / (jnp.sqrt(vhat) + self.epsilon)
+                if decoupled:
+                    step = step + lr * wd * p
+                new_params[k] = p - step
                 new_m[k], new_v[k] = m, v
             return new_params, {"m": new_m, "v": new_v, "t": t}
         if self.solver == "adagrad":
@@ -273,6 +299,28 @@ class GradientDescentBase(AcceleratedUnit):
                 new_params[k] = p - lr * g / (jnp.sqrt(a) + self.epsilon)
                 new_a[k] = a
             return new_params, {"a": new_a}
+        if self.solver == "rmsprop":
+            new_a, new_params = {}, {}
+            for k, p in params.items():
+                lr, g = knobs(k, p, grads[k])
+                a = self.rho * state["a"][k] + (1 - self.rho) * g * g
+                new_params[k] = p - lr * g / (jnp.sqrt(a) + self.epsilon)
+                new_a[k] = a
+            return new_params, {"a": new_a}
+        if self.solver == "adadelta":
+            # Zeiler 2012: unit-correcting running deltas; the
+            # learning_rate knob scales the final step (1.0 = paper)
+            new_a, new_d, new_params = {}, {}, {}
+            for k, p in params.items():
+                lr, g = knobs(k, p, grads[k])
+                a = self.rho * state["a"][k] + (1 - self.rho) * g * g
+                delta = (jnp.sqrt(state["d"][k] + self.epsilon)
+                         / jnp.sqrt(a + self.epsilon)) * g
+                new_params[k] = p - lr * delta
+                new_d[k] = (self.rho * state["d"][k]
+                            + (1 - self.rho) * delta * delta)
+                new_a[k] = a
+            return new_params, {"a": new_a, "d": new_d}
         new_params, new_state = {}, {}
         for k, p in params.items():
             lr, g = knobs(k, p, grads[k])
