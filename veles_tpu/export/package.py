@@ -86,7 +86,10 @@ def _unit_entry(fwd, pkg_dir: str) -> Dict[str, Any]:
                 val = list(val)
             cfg[key] = val
     params = {}
-    for pname, arr in fwd.param_arrays().items():
+    # export_param_arrays merges LoRA deltas into dense weights, so
+    # packages (and the C++ runtime) never see adapters
+    arrays = getattr(fwd, "export_param_arrays", fwd.param_arrays)()
+    for pname, arr in arrays.items():
         fname = "%s_%s.npy" % (fwd.name, pname)
         numpy.save(os.path.join(pkg_dir, fname),
                    numpy.ascontiguousarray(arr.map_read()))

@@ -86,9 +86,11 @@ class All2All(ForwardBase):
         return a
 
     def apply(self, params, x, *, train=False, rng=None):
-        return self.activation(self._linear(params, x))
+        return self.activation(self._linear(
+            self.merged_params(params), x))
 
     def numpy_apply(self, params, x):
+        params = self.merged_params(params)
         x2 = x.reshape(len(x), -1).astype(numpy.float32)
         y = x2 @ params["weights"]
         if "bias" in params:
@@ -152,7 +154,7 @@ class All2AllSoftmax(All2All):
     def logits(self, params, x):
         """Pre-softmax activations — the evaluator consumes these for a
         numerically-stable fused softmax-cross-entropy."""
-        return self._linear(params, x)
+        return self._linear(self.merged_params(params), x)
 
 
 @matches(All2All)

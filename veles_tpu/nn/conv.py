@@ -95,10 +95,12 @@ class Conv(ForwardBase):
         return a
 
     def apply(self, params, x, *, train=False, rng=None):
-        return self.activation(self._conv(params, x))
+        return self.activation(self._conv(
+            self.merged_params(params), x))
 
     def numpy_apply(self, params, x):
         """Host oracle: direct im2col convolution."""
+        params = self.merged_params(params)
         b, h, w, c = x.shape
         (pt, pb), (pl, pr) = self._pad_hw()
         xp = numpy.pad(x, ((0, 0), (pt, pb), (pl, pr), (0, 0)))

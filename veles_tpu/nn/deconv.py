@@ -60,6 +60,7 @@ class Deconv(ForwardBase):
         import jax.numpy as jnp
         from ..ops import matmul_precision
         from ..ops.precision import promote_operands
+        params = self.merged_params(params)
         left, top, right, bottom = self.padding
         sx, sy = self.sliding
         # conv_transpose pads the dilated input directly; transposed-conv
@@ -84,6 +85,7 @@ class Deconv(ForwardBase):
 
     def numpy_apply(self, params, x):
         """Oracle: scatter-add of kernel stamps."""
+        params = self.merged_params(params)
         b, h, w, c_in = x.shape
         _, oh, ow, c_out = self.output_shape_for(x.shape)
         left, top, right, bottom = self.padding

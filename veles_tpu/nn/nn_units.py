@@ -66,6 +66,19 @@ class ForwardBase(AcceleratedUnit):
         #: layer config before Unit.__init__ would discard them
         self.gd_config = {k: kwargs.pop(k) for k in list(kwargs)
                           if k in self.GD_KEYS}
+        #: LoRA fine-tuning (parameter-efficient transfer learning —
+        #: beyond the reference, whose transfer story was snapshot
+        #: resume + retrain): rank r adds W_eff = W + A·B·(alpha/r)
+        #: low-rank deltas to every LORA_TARGET weight; base params
+        #: freeze by default (freeze_base=False trains both). Units
+        #: whose apply routes through merged_params support it
+        #: (All2All/Conv families); see LORA_TARGETS.
+        self.lora_rank = int(kwargs.pop("lora_rank", 0) or 0)
+        self.lora_alpha = float(kwargs.pop("lora_alpha",
+                                           self.lora_rank or 1))
+        self.freeze_base = bool(kwargs.pop("freeze_base",
+                                           self.lora_rank > 0))
+        self._lora_names = ()
         super().__init__(workflow, **kwargs)
         self.view_group = "WORKER"
         self.input: Optional[Array] = None
@@ -84,13 +97,80 @@ class ForwardBase(AcceleratedUnit):
 
     #: parameter attribute names (subclasses with other params override)
     PARAM_NAMES = ("weights", "bias")
+    #: weight keys eligible for LoRA deltas (only units whose apply
+    #: calls merged_params honor them)
+    LORA_TARGETS = ("weights",)
 
     def param_arrays(self) -> Dict[str, Array]:
         out = {}
-        for k in self.PARAM_NAMES:
+        for k in self.PARAM_NAMES + getattr(self, "_lora_names", ()):
             arr = getattr(self, k, None)
             if isinstance(arr, Array) and arr:
                 out[k] = arr
+        return out
+
+    def _create_lora_params(self) -> None:
+        """A (fan_in, r) ~ N(0, 1/sqrt(fan_in)) and B (r, fan_out) = 0
+        per LORA_TARGET — the standard init (delta starts at zero, so a
+        lora_rank!=0 model is exactly the base model at step 0)."""
+        if not self.lora_rank or self._lora_names:
+            return
+        names = []
+        for k in self.LORA_TARGETS:
+            arr = getattr(self, k, None)
+            if not (isinstance(arr, Array) and arr) or arr.mem.ndim < 2:
+                continue
+            w = arr.mem
+            fin = int(numpy.prod(w.shape[:-1]))
+            fout = int(w.shape[-1])
+            a = numpy.zeros((fin, self.lora_rank), w.dtype)
+            prng.get("%s.%s_lora_a" % (self.name, k)).fill_normal(
+                a, 1.0 / numpy.sqrt(fin))
+            b = numpy.zeros((self.lora_rank, fout), w.dtype)
+            setattr(self, k + "_lora_a",
+                    Array(a, name="%s.%s_lora_a" % (self.name, k)))
+            setattr(self, k + "_lora_b",
+                    Array(b, name="%s.%s_lora_b" % (self.name, k)))
+            names += [k + "_lora_a", k + "_lora_b"]
+        self._lora_names = tuple(names)
+
+    def merged_params(self, params):
+        """W_eff = W + A·B·(alpha/r) for every LoRA'd weight — called at
+        the top of supporting applies; identity without LoRA. Traced
+        inside the step, so the merge fuses into the consuming matmul."""
+        if not getattr(self, "lora_rank", 0):
+            return params
+        out = dict(params)
+        scale = self.lora_alpha / self.lora_rank
+        for k in self.LORA_TARGETS:
+            if k + "_lora_a" not in params or k not in params:
+                continue
+            w = params[k]
+            delta = (params[k + "_lora_a"] @ params[k + "_lora_b"]
+                     ) * scale
+            out[k] = w + delta.reshape(w.shape).astype(w.dtype)
+        return out
+
+    def export_param_arrays(self) -> Dict[str, Array]:
+        """param_arrays with LoRA deltas MERGED into the base weights —
+        exports/serving see a plain dense model (the C++ runtime needs
+        no adapter concept)."""
+        arrays = self.param_arrays()
+        if not getattr(self, "lora_rank", 0) or not self._lora_names:
+            return arrays
+        scale = self.lora_alpha / self.lora_rank
+        out = {}
+        for k, v in arrays.items():
+            if k.endswith(("_lora_a", "_lora_b")):
+                continue
+            if k + "_lora_a" in arrays:
+                w = numpy.array(v.map_read())
+                a = numpy.asarray(arrays[k + "_lora_a"].map_read())
+                b = numpy.asarray(arrays[k + "_lora_b"].map_read())
+                w = w + ((a @ b) * scale).reshape(w.shape).astype(w.dtype)
+                out[k] = Array(w, name="%s.%s(merged)" % (self.name, k))
+            else:
+                out[k] = v
         return out
 
     # -- the pure function ---------------------------------------------------
@@ -119,6 +199,8 @@ class ForwardBase(AcceleratedUnit):
             rng = prng.get(self.name)
             for k, v in self.create_params(rng).items():
                 setattr(self, k, v)
+        if self.PARAMETERIZED:
+            self._create_lora_params()
         if self.input is not None and self.input:
             shape = self.output_shape_for(self.input.shape)
             if self.output.mem is None or self.output.shape != shape:
@@ -195,6 +277,30 @@ class GradientDescentBase(AcceleratedUnit):
             raise Bug("unknown solver %r (sgd | adam | adamw | adagrad "
                       "| rmsprop | adadelta)" % self.solver)
 
+    def extend_state(self, state, params):
+        """Grow a RESTORED optimizer state to cover params it lacks
+        state for (e.g. resuming a base snapshot into a lora_rank
+        config: the adapters need fresh zero state). Walks a fresh
+        init_state; restored leaves win wherever present."""
+        fresh = self.init_state(params)
+
+        def merge(f, s):
+            if isinstance(f, dict):
+                return {k: (merge(v, s[k])
+                            if isinstance(s, dict) and k in s else v)
+                        for k, v in f.items()}
+            return s
+
+        return merge(fresh, state)
+
+    def _frozen(self, k: str) -> bool:
+        """freeze_base (LoRA fine-tuning): every key except the adapter
+        pairs is held fixed — zero step AND zero weight decay."""
+        fwd = getattr(self, "forward", None)
+        return (fwd is not None
+                and getattr(fwd, "freeze_base", False)
+                and not k.endswith(("_lora_a", "_lora_b")))
+
     # -- pure update rule ----------------------------------------------------
     def init_state(self, params: Dict[str, Any]) -> Dict[str, Any]:
         """Optimizer state pytree (momentum / Adam moments / AdaGrad
@@ -254,6 +360,9 @@ class GradientDescentBase(AcceleratedUnit):
                     lambda g: (g * factor).astype(g.dtype), grads)
 
         def knobs(k, p, g):
+            if self._frozen(k):
+                # freeze_base (LoRA): no step, no decay drift
+                return 0.0, g * 0
             lr = (self.learning_rate_bias if k == "bias"
                   else self.learning_rate) * lr_scale
             wd = (self.weight_decay_bias if k == "bias"
@@ -279,6 +388,8 @@ class GradientDescentBase(AcceleratedUnit):
                           else self.learning_rate) * lr_scale
                     wd = (self.weight_decay_bias if k == "bias"
                           else self.weight_decay)
+                    if self._frozen(k):
+                        lr, wd, g = 0.0, 0.0, g * 0
                 else:
                     lr, g = knobs(k, p, grads[k])
                 m = self.beta1 * state["m"][k] + (1 - self.beta1) * g

@@ -864,6 +864,13 @@ class TrainStep(AcceleratedUnit):
                      f.param_arrays().items()}
             for f in self.forwards if f.PARAMETERIZED}
         self.opt_state = {k: v for k, v in sd["opt_state"].items()}
+        # a restored state may not cover every current param (resuming
+        # a base snapshot into a lora_rank config): grow it with fresh
+        # zero state for the new keys; restored leaves win
+        for name, p in self.params.items():
+            if name in self.opt_state and name in self._gd_for:
+                self.opt_state[name] = self._gd_for[name].extend_state(
+                    self.opt_state[name], p)
         if self._pp is not None:
             # restack the per-layer snapshot into the pipeline block;
             # scalar leaves (shared counters) take the first layer's
