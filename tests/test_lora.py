@@ -151,3 +151,22 @@ def test_lora_export_merges_dense(tmp_path):
     out = run_package(pkg, batch)
     numpy.testing.assert_allclose(out.reshape(truth.shape), truth,
                                   rtol=2e-3, atol=2e-4)
+
+
+def test_lora_on_unsupported_unit_refuses():
+    """lora_rank on a unit with no LORA_TARGET weights must refuse
+    loudly — a silent pass would freeze the whole layer (freeze_base
+    defaults on) while training nothing."""
+    import pytest
+    from veles_tpu.error import VelesError
+    loader = BlobsLoader(None, minibatch_size=25, name="badlora-l")
+    wf = nn.StandardWorkflow(
+        name="badlora",
+        layers=[{"type": "multi_head_attention", "n_heads": 2,
+                 "lora_rank": 4},
+                {"type": "mean_pool"},
+                {"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=1))
+    with pytest.raises(VelesError, match="LORA_TARGET"):
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))

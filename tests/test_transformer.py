@@ -569,3 +569,12 @@ def test_rope_base_changes_rotation_and_roundtrips():
         assert u2.rope_base == 500000.0
     finally:
         vt.root.common.engine.compute_dtype = prev
+
+
+def test_negative_window_refused():
+    """A negative window (config typo) must refuse at construction —
+    on the reference path an all-false mask would silently degenerate
+    to uniform attention over every position including the future."""
+    wf = vt.Workflow(name="negw")
+    with pytest.raises(ValueError, match="positive"):
+        nn.TransformerBlock(wf, n_heads=2, causal=True, window=-64)

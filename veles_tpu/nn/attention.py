@@ -20,6 +20,25 @@ from .. import prng
 from .nn_units import ForwardBase, GradientDescentBase, matches
 
 
+def expand_kv(np_mod, x, n_heads: int):
+    """(B, T, KV, Dh) → (B, T, H, Dh): share each KV head across
+    H/KV query-head groups (GQA). Expressed as broadcast+reshape, NOT
+    repeat, so XLA lowers a broadcast it can fuse into the consuming
+    dot on the reference path. Honest caveat for the flash path: the
+    Pallas kernel takes concrete folded operands, so there (and in its
+    custom-vjp residuals) the expansion IS materialized — GQA's
+    training-memory saving needs a group-aware kernel, which this
+    kernel does not have; the *serving* cache saving is real
+    (sampling._block_step reads the unrepeated cache)."""
+    b, t, kv, hd = x.shape
+    g = n_heads // kv
+    if g == 1:
+        return x
+    return np_mod.broadcast_to(
+        x[:, :, :, None, :], (b, t, kv, g, hd)).reshape(
+        b, t, n_heads, hd)
+
+
 def attention_core(q, k, v, *, causal=False, mesh=None, n_heads=1,
                    window=None):
     """The per-shape attention chooser, shared by MultiHeadAttention and
@@ -114,9 +133,8 @@ class MultiHeadAttention(ForwardBase):
                     precision=prec).reshape(b, t, kv, hd)
         v = jnp.dot(x, params["wv"],
                     precision=prec).reshape(b, t, kv, hd)
-        if kv != h:
-            k = jnp.repeat(k, h // kv, axis=2)
-            v = jnp.repeat(v, h // kv, axis=2)
+        k = expand_kv(jnp, k, h)
+        v = expand_kv(jnp, v, h)
         o = attention_core(q, k, v, causal=self.causal, mesh=self.mesh,
                            n_heads=h)
         o = o.reshape(b, t, d)
@@ -131,9 +149,8 @@ class MultiHeadAttention(ForwardBase):
         q = (x @ params["wq"]).reshape(b, t, h, hd)
         k = (x @ params["wk"]).reshape(b, t, kv, hd)
         v = (x @ params["wv"]).reshape(b, t, kv, hd)
-        if kv != h:
-            k = numpy.repeat(k, h // kv, axis=2)
-            v = numpy.repeat(v, h // kv, axis=2)
+        k = expand_kv(numpy, k, h)
+        v = expand_kv(numpy, v, h)
         s = numpy.einsum("bqhd,bkhd->bhqk", q, k) / numpy.sqrt(hd)
         if self.causal:
             mask = numpy.tril(numpy.ones((t, t), bool))

@@ -132,6 +132,15 @@ class ForwardBase(AcceleratedUnit):
             setattr(self, k + "_lora_b",
                     Array(b, name="%s.%s_lora_b" % (self.name, k)))
             names += [k + "_lora_a", k + "_lora_b"]
+        if not names:
+            # a silent pass would freeze the whole layer (freeze_base
+            # defaults True) while training nothing
+            from ..error import VelesError
+            raise VelesError(
+                "lora_rank=%d on %s (%s): no LORA_TARGET weights to "
+                "adapt — LoRA supports the All2All/Conv/Deconv "
+                "families; drop the knob from this layer"
+                % (self.lora_rank, self.name, type(self).__name__))
         self._lora_names = tuple(names)
 
     def merged_params(self, params):
@@ -359,17 +368,21 @@ class GradientDescentBase(AcceleratedUnit):
                 grads = jax.tree_util.tree_map(
                     lambda g: (g * factor).astype(g.dtype), grads)
 
-        def knobs(k, p, g):
+        def knobs(k, g):
+            """Per-key hyper-parameters: (lr, wd, clipped grad). The ONE
+            place lr/decay/clip/freeze routing lives — every solver
+            folds wd into its own rule (coupled: g + wd*p; adamw:
+            decoupled step)."""
             if self._frozen(k):
                 # freeze_base (LoRA): no step, no decay drift
-                return 0.0, g * 0
+                return 0.0, 0.0, g * 0
             lr = (self.learning_rate_bias if k == "bias"
                   else self.learning_rate) * lr_scale
             wd = (self.weight_decay_bias if k == "bias"
                   else self.weight_decay)
             if self.gradient_clip:
                 g = jnp.clip(g, -self.gradient_clip, self.gradient_clip)
-            return lr, g + wd * p
+            return lr, wd, g
 
         if self.solver in ("adam", "adamw"):
             # adamw: DECOUPLED weight decay (p -= lr*wd*p outside the
@@ -379,19 +392,9 @@ class GradientDescentBase(AcceleratedUnit):
             t = state["t"] + 1
             new_m, new_v, new_params = {}, {}, {}
             for k, p in params.items():
-                if decoupled:
-                    g = grads[k]
-                    if self.gradient_clip:
-                        g = jnp.clip(g, -self.gradient_clip,
-                                     self.gradient_clip)
-                    lr = (self.learning_rate_bias if k == "bias"
-                          else self.learning_rate) * lr_scale
-                    wd = (self.weight_decay_bias if k == "bias"
-                          else self.weight_decay)
-                    if self._frozen(k):
-                        lr, wd, g = 0.0, 0.0, g * 0
-                else:
-                    lr, g = knobs(k, p, grads[k])
+                lr, wd, g = knobs(k, grads[k])
+                if not decoupled:
+                    g = g + wd * p
                 m = self.beta1 * state["m"][k] + (1 - self.beta1) * g
                 v = self.beta2 * state["v"][k] + (1 - self.beta2) * g * g
                 mhat = m / (1 - self.beta1 ** t.astype(m.dtype))
@@ -405,7 +408,8 @@ class GradientDescentBase(AcceleratedUnit):
         if self.solver == "adagrad":
             new_a, new_params = {}, {}
             for k, p in params.items():
-                lr, g = knobs(k, p, grads[k])
+                lr, wd, g = knobs(k, grads[k])
+                g = g + wd * p
                 a = state["a"][k] + g * g
                 new_params[k] = p - lr * g / (jnp.sqrt(a) + self.epsilon)
                 new_a[k] = a
@@ -413,7 +417,8 @@ class GradientDescentBase(AcceleratedUnit):
         if self.solver == "rmsprop":
             new_a, new_params = {}, {}
             for k, p in params.items():
-                lr, g = knobs(k, p, grads[k])
+                lr, wd, g = knobs(k, grads[k])
+                g = g + wd * p
                 a = self.rho * state["a"][k] + (1 - self.rho) * g * g
                 new_params[k] = p - lr * g / (jnp.sqrt(a) + self.epsilon)
                 new_a[k] = a
@@ -423,7 +428,8 @@ class GradientDescentBase(AcceleratedUnit):
             # learning_rate knob scales the final step (1.0 = paper)
             new_a, new_d, new_params = {}, {}, {}
             for k, p in params.items():
-                lr, g = knobs(k, p, grads[k])
+                lr, wd, g = knobs(k, grads[k])
+                g = g + wd * p
                 a = self.rho * state["a"][k] + (1 - self.rho) * g * g
                 delta = (jnp.sqrt(state["d"][k] + self.epsilon)
                          / jnp.sqrt(a + self.epsilon)) * g
@@ -434,8 +440,8 @@ class GradientDescentBase(AcceleratedUnit):
             return new_params, {"a": new_a, "d": new_d}
         new_params, new_state = {}, {}
         for k, p in params.items():
-            lr, g = knobs(k, p, grads[k])
-            delta = lr * g + self.momentum * state[k]
+            lr, wd, g = knobs(k, grads[k])
+            delta = lr * (g + wd * p) + self.momentum * state[k]
             new_params[k] = p - delta
             new_state[k] = delta
         return new_params, new_state

@@ -96,9 +96,9 @@ def _block_prefill(block, p, x, cache_k, cache_v):
     # n_heads/n_kv_heads times smaller than an MHA cache
     cache_k = cache_k.at[:, :t].set(k)
     cache_v = cache_v.at[:, :t].set(v)
-    if kv != h:
-        k = jnp.repeat(k, h // kv, axis=2)
-        v = jnp.repeat(v, h // kv, axis=2)
+    from .attention import expand_kv
+    k = expand_kv(jnp, k, h)
+    v = expand_kv(jnp, v, h)
     o = attention_core(q, k, v, causal=True, mesh=None, n_heads=h,
                        window=getattr(block, "window", None)
                        ).reshape(b, t, d)

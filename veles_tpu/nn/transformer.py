@@ -128,7 +128,10 @@ class TransformerBlock(ForwardBase):
         #: Mistral convention); unset = full attention. Causal only.
         #: The attribute only exists when set, so full-attention
         #: exports carry no null config key.
-        if window:
+        if window is not None:
+            if int(window) < 1:
+                raise ValueError("window must be a positive span, got "
+                                 "%r" % (window,))
             if not causal:
                 raise ValueError("window requires causal=True")
             self.window = int(window)
@@ -224,11 +227,9 @@ class TransformerBlock(ForwardBase):
         if getattr(self, "rope", False):   # absent in pre-rope exports
             base = getattr(self, 'rope_base', 10000.0)
             q, k = _rope(jnp, q, base), _rope(jnp, k, base)
-        if kv != h:
-            # GQA: share each KV head across h/kv query heads (XLA
-            # fuses the broadcast into the attention dots)
-            k = jnp.repeat(k, h // kv, axis=2)
-            v = jnp.repeat(v, h // kv, axis=2)
+        from .attention import expand_kv
+        k = expand_kv(jnp, k, h)
+        v = expand_kv(jnp, v, h)
         o = attention_core(q, k, v, causal=self.causal, mesh=self.mesh,
                            n_heads=h,
                            window=getattr(self, "window", None)
@@ -251,9 +252,9 @@ class TransformerBlock(ForwardBase):
         if getattr(self, "rope", False):   # absent in pre-rope exports
             base = getattr(self, 'rope_base', 10000.0)
             q, k = _rope(numpy, q, base), _rope(numpy, k, base)
-        if kv != h:
-            k = numpy.repeat(k, h // kv, axis=2)
-            v = numpy.repeat(v, h // kv, axis=2)
+        from .attention import expand_kv
+        k = expand_kv(numpy, k, h)
+        v = expand_kv(numpy, v, h)
         s = numpy.einsum("bqhd,bkhd->bhqk", q, k) / numpy.sqrt(hd)
         if self.causal:
             rel = numpy.arange(t)[:, None] - numpy.arange(t)[None, :]

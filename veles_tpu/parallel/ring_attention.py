@@ -36,6 +36,8 @@ def ring_attention(q, k, v, mesh, axis: str = "sequence",
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     window = int(window or 0)
+    if window < 0:
+        raise ValueError("window must be >= 1 (or None)")
     if window and not causal:
         raise ValueError("sliding-window attention requires causal=True")
     n = mesh.shape[axis]
@@ -101,6 +103,8 @@ def attention_reference(q, k, v, causal: bool = False,
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if window is not None and int(window) < 0:
+        raise ValueError("window must be >= 1 (or None)")
     if window and not causal:
         raise ValueError("sliding-window attention requires causal=True")
     if causal:
