@@ -30,6 +30,8 @@ def expand_kv(np_mod, x, n_heads: int):
     training-memory saving needs a group-aware kernel, which this
     kernel does not have; the *serving* cache saving is real
     (sampling._block_step reads the unrepeated cache)."""
+    if np_mod is None:
+        import jax.numpy as np_mod
     b, t, kv, hd = x.shape
     g = n_heads // kv
     if g == 1:
@@ -42,18 +44,24 @@ def expand_kv(np_mod, x, n_heads: int):
 def attention_core(q, k, v, *, causal=False, mesh=None, n_heads=1,
                    window=None):
     """The per-shape attention chooser, shared by MultiHeadAttention and
-    TransformerBlock. q/k/v: (B, T, H, Dh) → (B, T, H, Dh).
+    TransformerBlock. q: (B, T, H, Dh); k/v may carry FEWER heads (GQA
+    — H must divide by their count) → (B, T, H, Dh).
     sequence-mesh → ring/Ulysses; long T on TPU → Pallas flash; else the
     fused XLA reference (crossover: engine.flash_attention_min_t,
     docs/perf.md). ``window``: sliding-window span (causal only). The
-    flash path skips dead blocks (O(T·window) compute); the ring path
-    additionally SHORTENS the rotation scan to the blocks the window
-    can reach; Ulysses passes the window to its inner attention."""
+    flash path skips dead blocks (O(T·window) compute) and consumes
+    GROUPED k/v natively (index-map head remapping — no expanded
+    operands or residuals); the other paths expand via broadcast. The
+    ring path additionally SHORTENS the rotation scan to the blocks
+    the window can reach; Ulysses passes the window to its inner
+    attention."""
     from ..ops import flash_attention as fa
     from ..parallel.ring_attention import (ring_attention,
                                            attention_reference)
     t, hd = q.shape[1], q.shape[-1]
+    h = q.shape[2]
     if mesh is not None:
+        k, v = expand_kv(None, k, h), expand_kv(None, v, h)
         scheme = root.common.engine.sequence_parallel
         n_seq = mesh.shape["sequence"]
         if scheme == "ulysses" and n_heads % n_seq == 0:
@@ -65,7 +73,9 @@ def attention_core(q, k, v, *, causal=False, mesh=None, n_heads=1,
     if fa.choose_flash(t, hd):
         return fa.flash_attention(q, k, v, causal=causal,
                                   window=window)
-    return attention_reference(q, k, v, causal=causal, window=window)
+    return attention_reference(q, expand_kv(None, k, h),
+                               expand_kv(None, v, h), causal=causal,
+                               window=window)
 
 
 class MultiHeadAttention(ForwardBase):
@@ -133,8 +143,6 @@ class MultiHeadAttention(ForwardBase):
                     precision=prec).reshape(b, t, kv, hd)
         v = jnp.dot(x, params["wv"],
                     precision=prec).reshape(b, t, kv, hd)
-        k = expand_kv(jnp, k, h)
-        v = expand_kv(jnp, v, h)
         o = attention_core(q, k, v, causal=self.causal, mesh=self.mesh,
                            n_heads=h)
         o = o.reshape(b, t, d)

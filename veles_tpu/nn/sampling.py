@@ -96,9 +96,6 @@ def _block_prefill(block, p, x, cache_k, cache_v):
     # n_heads/n_kv_heads times smaller than an MHA cache
     cache_k = cache_k.at[:, :t].set(k)
     cache_v = cache_v.at[:, :t].set(v)
-    from .attention import expand_kv
-    k = expand_kv(jnp, k, h)
-    v = expand_kv(jnp, v, h)
     o = attention_core(q, k, v, causal=True, mesh=None, n_heads=h,
                        window=getattr(block, "window", None)
                        ).reshape(b, t, d)

@@ -227,9 +227,6 @@ class TransformerBlock(ForwardBase):
         if getattr(self, "rope", False):   # absent in pre-rope exports
             base = getattr(self, 'rope_base', 10000.0)
             q, k = _rope(jnp, q, base), _rope(jnp, k, base)
-        from .attention import expand_kv
-        k = expand_kv(jnp, k, h)
-        v = expand_kv(jnp, v, h)
         o = attention_core(q, k, v, causal=self.causal, mesh=self.mesh,
                            n_heads=h,
                            window=getattr(self, "window", None)

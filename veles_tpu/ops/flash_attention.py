@@ -125,13 +125,28 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
             (m_scr[:, :1] + jnp.log(l))[:, 0][None, :], lse_ref.shape[1:])
 
 
+def _kv_fold_of(h: int, kv: int):
+    """Map a folded (batch*h) q-grid index to the folded (batch*kv)
+    K/V row its query head reads — the GQA head-group mapping expressed
+    as a BlockSpec index transform, so grouped K/V are NEVER expanded
+    in the kernel operands (query head qh reads kv head qh // (h//kv))."""
+    group = h // kv
+
+    def kv_of(g):
+        return (g // h) * kv + (g % h) // group
+    return kv_of
+
+
 def _fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
-                block_k: int, interpret: bool, window: int = 0):
-    """q, k, v: (G, T, D) with D == LANE; → (o (G, T, D),
-    lse (G, 8, T) sublane-padded — callers use ``lse[:, 0, :]``)."""
+                block_k: int, interpret: bool, window: int = 0,
+                h: int = 1, kv: int = 1):
+    """q: (B*h, T, D); k/v: (B*kv, T, D) with D == LANE (kv == h is
+    MHA) → (o (B*h, T, D), lse (B*h, 8, T) sublane-padded — callers
+    use ``lse[:, 0, :]``)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     g, t, d = q.shape
+    kv_of = _kv_fold_of(h, kv)
     grid = (g, t // block_q, t // block_k)
     kernel = functools.partial(_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
@@ -142,9 +157,11 @@ def _fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (kv_of(b), j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (kv_of(b), j, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
@@ -173,9 +190,23 @@ def _fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
 
 
 def _bwd_blockwise(causal, scale, block_k, window, res, do):
-    """Blockwise recompute backward (no (T, T) materialization)."""
+    """Blockwise recompute backward (no (T, T) materialization).
+    Grouped (GQA) k/v with fewer rows than q are expanded per block for
+    the recompute and the dk/dv contributions summed back per group."""
     q, k, v, o, lse = res
     g, t, d = q.shape
+    gk = k.shape[0]
+    if gk != g:
+        group = g // gk
+        kx = jnp.broadcast_to(k[:, None], (gk, group, t, d)
+                              ).reshape(g, t, d)
+        vx = jnp.broadcast_to(v[:, None], (gk, group, t, d)
+                              ).reshape(g, t, d)
+        dq, dk, dv = _bwd_blockwise(causal, scale, block_k, window,
+                                    (q, kx, vx, o, lse), do)
+        dk = dk.reshape(gk, group, t, d).sum(1).astype(k.dtype)
+        dv = dv.reshape(gk, group, t, d).sum(1).astype(v.dtype)
+        return dq, dk, dv
     nk = t // block_k
     delta = (do.astype(jnp.float32) * o.astype(jnp.float32)
              ).sum(-1)                                      # (G, T)
@@ -214,12 +245,16 @@ def _bwd_blockwise(causal, scale, block_k, window, res, do):
 def _bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *,
                     scale: float, causal: bool, block_q: int,
-                    block_k: int, window: int):
+                    block_k: int, window: int, n_q_blocks: int = 0):
     from jax.experimental import pallas as pl
 
-    ki, qi = pl.program_id(1), pl.program_id(2)
+    ki, j = pl.program_id(1), pl.program_id(2)
+    # grouped (GQA) grids fold (query-head-in-group, q-block) into the
+    # sequential dim: j = qh * n_q_blocks + qi. n_q_blocks=0 → MHA (j
+    # IS the q-block index).
+    qi = j % n_q_blocks if n_q_blocks else j
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -262,7 +297,7 @@ def _bwd_dkv_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
     else:
         _step()
 
-    @pl.when(qi == pl.num_programs(2) - 1)
+    @pl.when(j == pl.num_programs(2) - 1)
     def _finish():
         dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
@@ -317,28 +352,44 @@ def _bwd_dq_kernel(q_ref, do_ref, k_ref, v_ref, lse_ref, delta_ref,
 
 def _bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
                 block_q: int, block_k: int, interpret: bool,
-                window: int = 0):
+                window: int = 0, h: int = 1, kv: int = 1):
     """Pallas twin of ``_bwd_blockwise``: same math, VMEM-resident
     blockwise recompute. delta = rowsum(do*o) is O(T·D) and computed
     outside; lse/delta ride in the forward's (G, 8, T) sublane-padded
-    layout."""
+    layout. GQA (kv < h): k/v stay grouped (B*kv rows); the dq grid
+    remaps K/V reads per query head, and the dk/dv grid runs over the
+    GROUPED rows with (query-head-in-group, q-block) folded into its
+    sequential dimension — each kv head's gradient accumulates the
+    contributions of all h/kv query heads with no expanded operands
+    and no racy parallel writes."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     g, t, d = q.shape
+    gk = k.shape[0]
+    group = h // kv
+    nq, nk = t // block_q, t // block_k
+    kv_of = _kv_fold_of(h, kv)
+
+    def q_of(b, j):
+        # dkv grid: b indexes grouped K/V rows; j = qh * nq + qi
+        return (b // kv) * h + (b % kv) * group + j // nq
+
     delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
     pad8 = jnp.broadcast_to(delta[:, None, :], (g, 8, t))
     lse8 = jnp.broadcast_to(lse[:, None, :], (g, 8, t))
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, window=window)
-    qspec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0),
+    qspec = pl.BlockSpec((1, block_q, d),
+                         lambda b, i, j: (q_of(b, j), j % nq, 0),
                          memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM)
-    row_q = pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, j),
+    row_q = pl.BlockSpec((1, 8, block_q),
+                         lambda b, i, j: (q_of(b, j), 0, j % nq),
                          memory_space=pltpu.VMEM)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, **common),
-        grid=(g, t // block_k, t // block_q),
+        functools.partial(_bwd_dkv_kernel, n_q_blocks=nq, **common),
+        grid=(gk, nk, nq * group),
         in_specs=[qspec, qspec, kspec, kspec, row_q, row_q],
         out_specs=[
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0),
@@ -347,8 +398,8 @@ def _bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((g, t, d), k.dtype),
-            jax.ShapeDtypeStruct((g, t, d), v.dtype),
+            jax.ShapeDtypeStruct((gk, t, d), k.dtype),
+            jax.ShapeDtypeStruct((gk, t, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -360,15 +411,17 @@ def _bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
     )(q, do, k, v, lse8, pad8)
     dq, = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
-        grid=(g, t // block_q, t // block_k),
+        grid=(g, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (kv_of(b), j, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (kv_of(b), j, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 8, block_q), lambda b, i, j: (b, 0, i),
                          memory_space=pltpu.VMEM),
@@ -394,27 +447,30 @@ def _use_pallas_bwd() -> bool:
                                        True))
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
-           window):
+           window, h, kv):
     o, _ = _fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                       interpret, window)
+                       interpret, window, h, kv)
     return o
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-               window):
+               window, h, kv):
     o, lse = _fwd_pallas(q, k, v, causal, scale, block_q, block_k,
-                         interpret, window)
+                         interpret, window, h, kv)
+    # residuals keep the GROUPED k/v — the GQA memory saving holds
+    # through the backward
     return o, (q, k, v, o, lse[:, 0, :])
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, window,
-               res, do):
+               h, kv, res, do):
     if _use_pallas_bwd():
         q, k, v, o, lse = res
         return _bwd_pallas(q, k, v, o, lse, do, causal, scale,
-                           block_q, block_k, interpret, window)
+                           block_q, block_k, interpret, window, h, kv)
     return _bwd_blockwise(causal, scale, block_k, window, res, do)
 
 
@@ -471,6 +527,11 @@ def flash_attention(q, k, v, causal: bool = False,
     dead blocks, so long-T cost scales O(T·W) instead of O(T²).
     """
     b, t, h, d = q.shape
+    kv = k.shape[2]
+    if v.shape[2] != kv or h % kv:
+        raise ValueError(
+            "k/v head counts must match and divide q heads: q has %d, "
+            "k %d, v %d" % (h, kv, v.shape[2]))
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     if not supported(t, d, block_q, block_k):
@@ -489,12 +550,13 @@ def flash_attention(q, k, v, causal: bool = False,
     d_pad = ((d + LANE - 1) // LANE) * LANE  # next lane-group multiple
 
     def fold(x):
-        xt = jnp.moveaxis(x, 2, 1).reshape(b * h, t, d)
+        heads = x.shape[2]
+        xt = jnp.moveaxis(x, 2, 1).reshape(b * heads, t, d)
         if d < d_pad:
             xt = jnp.pad(xt, ((0, 0), (0, 0), (0, d_pad - d)))
         return xt
 
     o = _flash(fold(q), fold(k), fold(v), causal, float(scale),
-               block_q, block_k, interpret, window)
+               block_q, block_k, interpret, window, h, kv)
     o = o[..., :d].reshape(b, h, t, d)
     return jnp.moveaxis(o, 1, 2)
