@@ -185,6 +185,31 @@ def sec_attn(bench, dev, n):
                       % (t, train, name, row["variants"][name]),
                       flush=True)
             if not train:
+                # GQA A/B: grouped k/v (index-map remapping) vs the
+                # same attention on pre-expanded K/V — the grouped
+                # kernel reads each kv block once per group instead of
+                # re-reading an expanded copy
+                kv = 2
+                kg = jnp.asarray(numpy.random.RandomState(1).randn(
+                    b, t, kv, d), jnp.bfloat16)
+                vg = jnp.asarray(numpy.random.RandomState(2).randn(
+                    b, t, kv, d), jnp.bfloat16)
+                kx = jnp.repeat(kg, h // kv, axis=2)
+                vx = jnp.repeat(vg, h // kv, axis=2)
+                for name, args in (("flash_gqa_kv2", (q, kg, vg)),
+                                   ("flash_gqa_expanded", (q, kx, vx))):
+                    try:
+                        fn = jax.jit(lambda q, k, v: flash_attention(
+                            q, k, v, causal=True))
+                        dt = ba.time_fn(fn, *args)
+                        row["variants"][name] = {
+                            "ms": round(dt * 1e3, 2),
+                            "tflops": round(flops / dt / 1e12, 2)}
+                    except Exception as e:    # noqa: BLE001
+                        row["variants"][name] = {"error": str(e)[-300:]}
+                    print("  attn t=%d %s: %s"
+                          % (t, name, row["variants"][name]),
+                          flush=True)
                 # sliding-window flash: dead-block skipping should make
                 # cost ~O(T*W) — the long-T payoff of the window feature
                 for w in (t // 4, t // 8):
