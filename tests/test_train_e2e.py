@@ -357,3 +357,68 @@ def test_bf16_dataset_storage_converges():
         root.common.engine.dataset_dtype = None
     assert wf.decision.best_metric is not None
     assert wf.decision.best_metric < 0.06, wf.decision.epoch_metrics
+
+
+def test_grad_accumulation_matches_direct_step():
+    """grad_accumulation=G: G sequential chunk backwards + ONE update
+    from the valid-weighted mean gradient must reproduce the direct
+    full-minibatch step (no dropout in this net, so the only
+    difference is reduction order)."""
+    import jax
+    from veles_tpu import prng
+
+    def run(ga):
+        prng.seed_all(321)
+        loader = BlobsLoader(None, minibatch_size=50, name="blobs-ga")
+        wf = nn.StandardWorkflow(
+            name="ga-%d" % ga,
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 16},
+                    {"type": "softmax", "output_sample_shape": 3}],
+            loader_unit=loader, loss_function="softmax",
+            decision_config=dict(max_epochs=6, fail_iterations=50),
+            grad_accumulation=ga)
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        assert wf.train_step.grad_accumulation == ga
+        wf.run()
+        return (numpy.asarray(wf.decision.epoch_metrics[VALID]),
+                numpy.asarray(jax.device_get(
+                    wf.train_step.params["a2a0"]["weights"])
+                    if "a2a0" in wf.train_step.params else
+                    jax.device_get(list(
+                        wf.train_step.params.values())[0]["weights"])))
+
+    e1, w1 = run(1)
+    e2, w2 = run(5)
+    numpy.testing.assert_allclose(e2, e1, atol=0.025)
+    numpy.testing.assert_allclose(w2, w1, rtol=2e-3, atol=2e-4)
+
+
+def test_grad_accumulation_refuses_pipeline():
+    from veles_tpu import prng
+    prng.seed_all(5)
+    loader = BlobsLoader(None, minibatch_size=48, name="blobs-gap")
+    wf = nn.StandardWorkflow(
+        name="ga-pp",
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 16,
+                 "name": "b%d" % i} for i in range(4)]
+        + [{"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=1), grad_accumulation=2)
+    with pytest.raises(vt.Bug, match="grad_accumulation"):
+        wf.initialize(device=vt.XLADevice(mesh_axes={"pipeline": 4}))
+
+
+def test_grad_accumulation_composes_with_data_axis():
+    from veles_tpu import prng
+    prng.seed_all(77)
+    loader = BlobsLoader(None, minibatch_size=48, name="blobs-gad")
+    wf = nn.StandardWorkflow(
+        name="ga-dp",
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 16},
+                {"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=loader, loss_function="softmax",
+        decision_config=dict(max_epochs=6, fail_iterations=50),
+        grad_accumulation=2)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 2}))
+    wf.run()
+    assert wf.decision.best_metric < 0.06, wf.decision.epoch_metrics

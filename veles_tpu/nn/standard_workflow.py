@@ -93,7 +93,7 @@ class StandardWorkflow(AcceleratedWorkflow):
                  steps_per_dispatch: int = 16,
                  epochs_per_dispatch: int = 1, target_mode: str = None,
                  pipeline_microbatches: Optional[int] = None,
-                 remat: bool = False,
+                 remat: bool = False, grad_accumulation: int = 1,
                  mcdnnic_topology: str = None,
                  mcdnnic_parameters: Optional[Dict[str, Any]] = None,
                  **kwargs):
@@ -102,6 +102,7 @@ class StandardWorkflow(AcceleratedWorkflow):
         self._target_mode = target_mode
         self._pipeline_microbatches = pipeline_microbatches
         self._remat = remat
+        self._grad_accumulation = grad_accumulation
         super().__init__(workflow, **kwargs)
         if mcdnnic_topology:
             if layers:
@@ -166,7 +167,8 @@ class StandardWorkflow(AcceleratedWorkflow):
             steps_per_dispatch=self._steps_per_dispatch,
             epochs_per_dispatch=self._epochs_per_dispatch,
             pipeline_microbatches=self._pipeline_microbatches,
-            remat=self._remat)
+            remat=self._remat,
+            grad_accumulation=self._grad_accumulation)
         self.decision.loader = self.loader
         self.decision.step_unit = self.train_step
         if self._epochs_per_dispatch > 1 and self.loader is not None:

@@ -44,7 +44,8 @@ class TrainStep(AcceleratedUnit):
                  target_mode: str = "labels", steps_per_dispatch: int = 16,
                  epochs_per_dispatch: int = 1,
                  pipeline_microbatches: Optional[int] = None,
-                 remat: bool = False, **kwargs):
+                 remat: bool = False, grad_accumulation: int = 1,
+                 **kwargs):
         super().__init__(workflow, **kwargs)
         self.view_group = "TRAINER"
         self.forwards = list(forwards)
@@ -56,6 +57,12 @@ class TrainStep(AcceleratedUnit):
         #: bookkeeping stays per-epoch (drain_epoch_blocks); early-stop
         #: granularity coarsens to the block (documented trade).
         self.epochs_per_dispatch = max(1, int(epochs_per_dispatch))
+        #: G > 1: each optimizer step back-propagates G sequential
+        #: minibatch chunks (activation memory / G) and applies ONE
+        #: update from their weighted-mean gradient — the large-
+        #: effective-batch lever when activations, not params, bound
+        #: HBM (see _train_step_accum_fn)
+        self.grad_accumulation = max(1, int(grad_accumulation))
         if loader is not None:
             # fused consumption: host minibatch fill skipped; K minibatches
             # scanned per dispatch (must be set before loader.initialize)
@@ -175,6 +182,22 @@ class TrainStep(AcceleratedUnit):
             self.target_mode = ("targets" if has_t is not None and has_t
                                 else "input")
         self._setup_pipeline()
+        if self.grad_accumulation > 1:
+            if self._pp is not None or self._pp_hetero is not None:
+                raise Bug("grad_accumulation does not compose with a "
+                          "'pipeline' mesh axis (both re-chunk the "
+                          "minibatch); drop one")
+            mb = self.loader.max_minibatch_size
+            if mb % self.grad_accumulation:
+                raise Bug("minibatch size %d not divisible into %d "
+                          "gradient-accumulation chunks"
+                          % (mb, self.grad_accumulation))
+            if isinstance(self.device, XLADevice):
+                n_data = dict(self.device.mesh.shape).get("data", 1)
+                if (mb // self.grad_accumulation) % n_data:
+                    raise Bug("accumulation chunk size %d not divisible "
+                              "by data-axis size %d"
+                              % (mb // self.grad_accumulation, n_data))
         self._setup_shardings()
         return None
 
@@ -360,6 +383,11 @@ class TrainStep(AcceleratedUnit):
             p[key] = p[key] * m.astype(p[key].dtype)
             self.params[unit_name] = p
 
+    @property
+    def _step_impl(self):
+        return (self._train_step_accum_fn if self.grad_accumulation > 1
+                else self._train_step_fn)
+
     # -- pure functions -------------------------------------------------------
     def _apply_chain(self, units, params, x, train: bool, rng, base: int):
         """Apply a replicated run of forwards (``base`` offsets the
@@ -512,8 +540,24 @@ class TrainStep(AcceleratedUnit):
 
         (loss, out), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             params)
-        import jax.numpy as jnp
         valid = mask.sum() > 0  # all-padded plan rows must not decay params
+        new_params, new_opt = self._apply_updates(params, grads,
+                                                  opt_state, lr_scale,
+                                                  valid)
+        metrics = self.evaluator.metrics_fn(out, tgt, mask)
+        metrics["sum_loss"] = loss * self.evaluator.sum_loss_weight(
+            out, mask)
+        accum = jax.tree_util.tree_map(
+            lambda a, m: a + m, accum,
+            {k: metrics[k] for k in accum})
+        return new_params, new_opt, accum, loss
+
+    def _apply_updates(self, params, grads, opt_state, lr_scale, valid):
+        """One copy of the optimizer application (per-unit GD rules,
+        all-padded-row gating, sparsity masks), shared by the direct
+        and gradient-accumulating steps."""
+        import jax
+        import jax.numpy as jnp
         new_params, new_opt = {}, {}
         for name, p in params.items():
             gd = self._gd_for[name]
@@ -531,13 +575,78 @@ class TrainStep(AcceleratedUnit):
                     # scan carry structure would change
                     new_params[name][k] = (new_params[name][k]
                                            * m.astype(new_params[name][k].dtype))
-        metrics = self.evaluator.metrics_fn(out, tgt, mask)
-        metrics["sum_loss"] = loss * self.evaluator.sum_loss_weight(
-            out, mask)
-        accum = jax.tree_util.tree_map(
-            lambda a, m: a + m, accum,
-            {k: metrics[k] for k in accum})
-        return new_params, new_opt, accum, loss
+        return new_params, new_opt
+
+    def _train_step_accum_fn(self, params, opt_state, accum, dataset,
+                             labels, targets, indices, mask, lr_scale,
+                             rng):
+        """Gradient accumulation (``grad_accumulation=G``): the
+        minibatch splits into G sequential chunks; the forward/backward
+        runs per chunk (activation memory ∝ mb/G) and ONE optimizer
+        step applies the valid-count-weighted mean of the chunk
+        gradients — exactly the full-minibatch gradient up to reduction
+        order (chunk losses are valid-masked means, so chunk grads are
+        recombined with w_c/Σw weights). Dropout streams fold per
+        chunk, so rng-using nets match the direct step only in
+        distribution."""
+        import jax
+        import jax.numpy as jnp
+        ga = self.grad_accumulation
+        batch = self._gather(dataset, indices)
+        aug = getattr(self.loader, "device_augment_fn", None)
+        if aug is not None:
+            batch = aug(batch, jax.random.fold_in(rng, 0x417))
+        tgt = self._target_for(batch, labels, targets, indices)
+        if self.mixed_precision:
+            batch = self._amp_cast(batch)
+        mb = batch.shape[0]
+
+        def chunk(x):
+            return x.reshape((ga, mb // ga) + x.shape[1:])
+
+        total = jnp.maximum(mask.sum().astype(jnp.float32), 1.0)
+
+        def body(carry, xs):
+            g_sum, l_sum, a = carry
+            b_i, t_i, m_i, ci = xs
+
+            def loss_fn(p):
+                if self.mixed_precision:
+                    p = self._amp_cast(p)
+                chunk_rng = jax.random.fold_in(rng, ci)
+                if self.remat:
+                    out = jax.checkpoint(
+                        lambda pp, bb: self._forward_pure(
+                            pp, bb, True, chunk_rng))(p, b_i)
+                else:
+                    out = self._forward_pure(p, b_i, True, chunk_rng)
+                return self.evaluator.loss(out, t_i, m_i), out
+
+            (loss, out), g = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            w = m_i.sum().astype(jnp.float32)
+            g_sum = jax.tree_util.tree_map(
+                lambda s, gg: s + gg.astype(jnp.float32) * w, g_sum, g)
+            metrics = self.evaluator.metrics_fn(out, t_i, m_i)
+            metrics["sum_loss"] = loss * self.evaluator.sum_loss_weight(
+                out, m_i)
+            a = jax.tree_util.tree_map(
+                lambda av, m: av + m, a, {k: metrics[k] for k in a})
+            return (g_sum, l_sum + loss * w, a), None
+
+        zero_g = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (g_sum, l_sum, accum), _ = jax.lax.scan(
+            body, (zero_g, jnp.float32(0.0), accum),
+            (chunk(batch), chunk(tgt), chunk(mask),
+             jnp.arange(ga)))
+        grads = jax.tree_util.tree_map(
+            lambda s, p: (s / total).astype(p.dtype), g_sum, params)
+        valid = mask.sum() > 0
+        new_params, new_opt = self._apply_updates(params, grads,
+                                                  opt_state, lr_scale,
+                                                  valid)
+        return new_params, new_opt, accum, l_sum / total
 
     def _train_plan_fn(self, params, opt_state, accum, dataset, labels,
                        targets, idx_plan, mask_plan, lr_scale, rng):
@@ -551,7 +660,7 @@ class TrainStep(AcceleratedUnit):
             p, o, a = carry
             idx, msk, i = xs
             step_rng = jax.random.fold_in(rng, i)
-            p, o, a, loss = self._train_step_fn(
+            p, o, a, loss = self._step_impl(
                 p, o, a, dataset, labels, targets, idx, msk, lr_scale,
                 step_rng)
             return (p, o, a), loss
@@ -778,7 +887,7 @@ class TrainStep(AcceleratedUnit):
         if cls == TRAIN and not self.evaluation_mode:
             fn = self.jit("train",
                           self._train_plan_fn if planned
-                          else self._train_step_fn,
+                          else self._step_impl,
                           donate_argnums=(0, 1, 2))
             self.params, self.opt_state, self._accum[cls], self.last_loss \
                 = fn(self.params, self.opt_state, accum, dataset, labels,
