@@ -59,16 +59,27 @@ class EvaluatorSoftmax(EvaluatorBase):
     hide_from_registry = False
 
     def __init__(self, workflow, n_classes=None, compute_confusion=False,
-                 **kwargs):
+                 label_smoothing=0.0, **kwargs):
         super().__init__(workflow, **kwargs)
         self.n_classes = n_classes
         self.compute_confusion = compute_confusion
+        #: eps > 0 mixes the one-hot target with the uniform
+        #: distribution (Szegedy et al.): CE against
+        #: (1-eps)*onehot + eps/V — the classic overconfidence
+        #: regularizer
+        self.label_smoothing = float(label_smoothing)
+        if not 0.0 <= self.label_smoothing < 1.0:
+            raise ValueError("label_smoothing must be in [0, 1)")
 
     def loss(self, logits, labels, mask):
         import jax
         import jax.numpy as jnp
         logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logp, labels[:, None], axis=1)[:, 0]
+        eps = getattr(self, "label_smoothing", 0.0)
+        if eps:
+            # CE vs (1-eps)·onehot + (eps/V)·uniform
+            nll = (1.0 - eps) * nll + eps * (-logp.mean(axis=-1))
         return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
 
     def metrics_fn(self, logits, labels, mask):
@@ -89,6 +100,9 @@ class EvaluatorSoftmax(EvaluatorBase):
         z = z - z.max(axis=1, keepdims=True)
         logp = z - numpy.log(numpy.exp(z).sum(axis=1, keepdims=True))
         nll = -logp[numpy.arange(len(labels)), labels]
+        eps = getattr(self, "label_smoothing", 0.0)
+        if eps:
+            nll = (1.0 - eps) * nll + eps * (-logp.mean(axis=1))
         return float((nll * mask).sum() / max(mask.sum(), 1))
 
 

@@ -94,6 +94,7 @@ class StandardWorkflow(AcceleratedWorkflow):
                  epochs_per_dispatch: int = 1, target_mode: str = None,
                  pipeline_microbatches: Optional[int] = None,
                  remat: bool = False, grad_accumulation: int = 1,
+                 evaluator_config: Optional[Dict[str, Any]] = None,
                  mcdnnic_topology: str = None,
                  mcdnnic_parameters: Optional[Dict[str, Any]] = None,
                  **kwargs):
@@ -103,6 +104,7 @@ class StandardWorkflow(AcceleratedWorkflow):
         self._pipeline_microbatches = pipeline_microbatches
         self._remat = remat
         self._grad_accumulation = grad_accumulation
+        self._evaluator_config = dict(evaluator_config or {})
         super().__init__(workflow, **kwargs)
         if mcdnnic_topology:
             if layers:
@@ -144,7 +146,8 @@ class StandardWorkflow(AcceleratedWorkflow):
         if self.forwards and hasattr(self.forwards[-1], "neurons_number"):
             n_classes = self.forwards[-1].neurons_number
         if self.loss_function == "softmax":
-            self.evaluator = EvaluatorSoftmax(self, n_classes=n_classes)
+            self.evaluator = EvaluatorSoftmax(self, n_classes=n_classes,
+                                              **self._evaluator_config)
             self.decision = DecisionGD(self, **decision_config)
             target_mode = "labels"
         elif self.loss_function == "softmax_seq":
