@@ -922,11 +922,14 @@ class TrainStep(AcceleratedUnit):
         Arrays (so snapshots and host-side units observe trained weights).
         Host copies, not buffer refs: the step donates its param buffers on
         the next dispatch, which would leave the Arrays dangling."""
-        import jax
+        from ..parallel.distributed import fetch_global
         from ..parallel.sharding import PP_BLOCK
         pp_names = self._pp["names"] if self._pp is not None else []
-        stacked = (jax.device_get(self.params[PP_BLOCK])
-                   if pp_names else {})
+        # fetch_global, not device_get: fsdp/tensor params on a multi-
+        # process mesh span non-addressable devices and must all-gather
+        # (every rank reaches here — see fetch_global's collective note)
+        host = fetch_global(self.params)
+        stacked = host.get(PP_BLOCK, {}) if pp_names else {}
         for f in self.forwards:
             if not f.PARAMETERIZED:
                 continue
@@ -936,12 +939,15 @@ class TrainStep(AcceleratedUnit):
                 for k in arrays:
                     arrays[k].reset(numpy.array(stacked[k][i]))
                 continue
-            for k, v in self.params.get(f.name, {}).items():
-                arrays[k].reset(numpy.array(jax.device_get(v)))
+            for k, v in host.get(f.name, {}).items():
+                arrays[k].reset(numpy.array(v))
 
     def stop(self) -> None:
         if self.params:
-            self.sync_params_to_arrays()
+            # workflow stop fires on every rank in the same order
+            from ..parallel.distributed import lockstep
+            with lockstep():
+                self.sync_params_to_arrays()
 
     # -- checkpoint protocol -------------------------------------------------
     def on_snapshot(self) -> None:
@@ -950,7 +956,8 @@ class TrainStep(AcceleratedUnit):
 
     def state_dict(self):
         import jax
-        opt = jax.device_get(self.opt_state)
+        from ..parallel.distributed import fetch_global
+        opt = fetch_global(self.opt_state)
         if self._pp is not None:
             # snapshots stay per-layer so a checkpoint moves freely
             # between pipeline topologies (resume-with-different-mesh

@@ -72,6 +72,67 @@ def is_coordinator() -> bool:
     return jax.process_index() == 0
 
 
+_lockstep_depth = 0
+
+
+@contextmanager
+def lockstep():
+    """Marks a region every process is guaranteed to enter in the same
+    order (the snapshot plane: collection runs on EVERY rank, only the
+    coordinator writes). fetch_global only all-gathers inside such a
+    region — a rank-local caller would otherwise block forever in the
+    collective while the other ranks are elsewhere."""
+    global _lockstep_depth
+    _lockstep_depth += 1
+    try:
+        yield
+    finally:
+        _lockstep_depth -= 1
+
+
+def fetch_global(tree):
+    """Host (numpy) copy of a pytree that may contain cross-process
+    sharded ``jax.Array``s (fsdp/tensor params on a multi-host mesh).
+
+    Fully-addressable or fully-replicated leaves take the plain
+    ``device_get`` path; anything else all-gathers its shards. The
+    gather is a COLLECTIVE — it is only legal inside a lockstep()
+    region (the reference made the same all-participate/master-writes
+    split in its generate/apply protocol, veles/distributable.py:222);
+    a coordinator-only caller (pickling, package export) gets the old
+    loud RuntimeError instead of a silent distributed hang."""
+    import jax
+
+    def one(x):
+        if not isinstance(x, jax.Array) or x.is_fully_addressable \
+                or x.sharding.is_fully_replicated:
+            return jax.device_get(x)
+        if not _lockstep_depth:
+            raise RuntimeError(
+                "fetching a cross-process sharded array outside a "
+                "lockstep region would deadlock the all-gather: every "
+                "rank must participate. Route through the snapshot "
+                "plane (Snapshotter/collect_state) or wrap the call in "
+                "parallel.distributed.lockstep() on ALL ranks.")
+        from jax.experimental import multihost_utils
+        return multihost_utils.process_allgather(x, tiled=True)
+    return jax.tree_util.tree_map(one, tree)
+
+
+def agree(want: bool) -> bool:
+    """Coordinator-agreed boolean: every process returns rank 0's value.
+    Used for nondeterministic snapshot gates (wall-clock intervals) so
+    the collectives inside state collection fire in lockstep; no-op on
+    a single process."""
+    import jax
+    if jax.process_count() == 1:
+        return bool(want)
+    import numpy
+    from jax.experimental import multihost_utils
+    return bool(multihost_utils.broadcast_one_to_all(
+        numpy.int32(bool(want))))
+
+
 def verify_checksums(workflow) -> None:
     """All hosts must run the same workflow code — the reference refused
     mismatched slaves at handshake (veles/server.py:478-529). Gathers the

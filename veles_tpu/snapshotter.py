@@ -95,17 +95,21 @@ if _snappy_module() is not None:
 def collect_state(workflow) -> Dict[str, Any]:
     """{unit name → state_dict} for every stateful unit + prng streams."""
     from . import prng
+    from .parallel.distributed import lockstep
     state: Dict[str, Any] = {"__units__": {}, "__prng__": {}, "__meta__": {
         "time": time.time(), "checksum": workflow.checksum()}}
-    for unit in workflow:
-        # pre-pass: owners of device-side state flush it to host Arrays
-        hook = getattr(unit, "on_snapshot", None)
-        if callable(hook):
-            hook()
-    for unit in workflow:
-        sd = unit.state_dict() if hasattr(unit, "state_dict") else None
-        if sd:
-            state["__units__"][unit.name] = sd
+    with lockstep():
+        # every rank runs collection in the same order, so the
+        # cross-process shard gathers inside (fetch_global) are legal
+        for unit in workflow:
+            # pre-pass: owners of device-side state flush to host Arrays
+            hook = getattr(unit, "on_snapshot", None)
+            if callable(hook):
+                hook()
+        for unit in workflow:
+            sd = unit.state_dict() if hasattr(unit, "state_dict") else None
+            if sd:
+                state["__units__"][unit.name] = sd
     with prng._lock:
         for key, gen in prng._generators.items():
             if key in prng._ephemeral:
@@ -184,10 +188,15 @@ class Snapshotter(Unit):
             return
         if self.interval > 1 and self._runs % self.interval:
             return
-        now = time.time()
-        if self.time_interval and now - self._last_time < self.time_interval:
-            return
-        self._last_time = now
+        if self.time_interval:
+            # wall-clock gates are nondeterministic across processes;
+            # state collection contains collectives (fetch_global), so
+            # rank 0's decision is broadcast and every rank obeys it
+            from .parallel.distributed import agree
+            want = time.time() - self._last_time >= self.time_interval
+            if not agree(want):
+                return
+            self._last_time = time.time()
         self.export()
 
     def _is_writer(self) -> bool:
@@ -198,6 +207,10 @@ class Snapshotter(Unit):
             return True
 
     def export(self) -> str:
+        # EVERY rank collects — collection all-gathers cross-process
+        # sharded params (fetch_global collectives must fire in
+        # lockstep); only the coordinator touches the filesystem
+        state = collect_state(self.workflow)
         if not self._is_writer():
             return ""
         os.makedirs(self.directory, exist_ok=True)
@@ -207,7 +220,6 @@ class Snapshotter(Unit):
             self.prefix, suffix, time.strftime("%Y%m%d_%H%M%S"),
             self._runs, ext)
         path = os.path.join(self.directory, fname)
-        state = collect_state(self.workflow)
         tmp = path + ".tmp"
         with opener(tmp, "wb") as fout:
             pickle.dump(state, fout, protocol=pickle.HIGHEST_PROTOCOL)
@@ -263,9 +275,9 @@ class SnapshotterToDB(Snapshotter):
         return os.path.join(self.directory, "snapshots.sqlite3")
 
     def export(self) -> str:
+        state = collect_state(self.workflow)   # all ranks: collectives
         if not self._is_writer():
             return ""
-        state = collect_state(self.workflow)
         blob = gzip.compress(pickle.dumps(
             state, protocol=pickle.HIGHEST_PROTOCOL))
         dsn = self._resolve_dsn()
