@@ -12,6 +12,27 @@ __version__ = "0.1.0"
 
 import os as _os
 
+_chips = _os.environ.get("TPU_VISIBLE_CHIPS")
+if _chips:
+    # mesh_slice_placement contract honored on the host platform too:
+    # a trial child placed on a d-chip slice materializes exactly d
+    # virtual CPU devices, however the CPU backend ends up selected
+    # (env pin here, or --backend cpu later) — so slice-placement
+    # correctness is CI-testable without multi-chip hardware
+    # (parallel/trials.py). Harmless on a real TPU host, where the
+    # runtime consumes TPU_VISIBLE_CHIPS natively and the CPU client
+    # is never the training backend. The forced-host-device-count flag
+    # would fight the setting — strip it before jax initializes.
+    _flags = _os.environ.get("XLA_FLAGS", "")
+    _os.environ["XLA_FLAGS"] = " ".join(
+        t for t in _flags.split()
+        if "xla_force_host_platform_device_count" not in t)
+    import jax as _jax
+
+    _jax.config.update(
+        "jax_num_cpu_devices",
+        len([c for c in _chips.split(",") if c.strip() != ""]))
+
 if _os.environ.get("JAX_PLATFORMS", "").lower() in ("cpu", "cpu,"):
     # Honor a host-platform pin in EVERY process, including subprocesses
     # the framework spawns (genetics candidates, ensemble members,

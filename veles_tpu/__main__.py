@@ -131,6 +131,24 @@ def _run_meta(launcher: Launcher, module, args) -> int:
         or (args.ensemble_train and args.ensemble_workers > 1
             and args.ensemble_member is None))
     device = None if subprocess_candidates else launcher.make_device()
+    placement = None
+    if args.trial_devices:
+        # each worker slot trains on its own disjoint chip slice; on a
+        # CPU host the package init materializes the slice width as
+        # virtual devices, so the same flag is CI-testable
+        placement_used = (
+            (args.optimize and args.optimize_workers > 1)
+            or (args.ensemble_train and args.ensemble_workers > 1
+                and args.ensemble_member is None))
+        if not placement_used:
+            raise VelesError(
+                "--trial-devices places WORKER-POOL trials on chip "
+                "slices; it needs --optimize-workers or "
+                "--ensemble-workers > 1 (serial/inline candidates run "
+                "on the parent's device set)")
+        from .parallel.trials import mesh_slice_placement
+        placement = mesh_slice_placement(
+            devices_per_trial=args.trial_devices)
     if args.optimize:
         from .genetics import GeneticsOptimizer
         size, _, gens = args.optimize.partition(":")
@@ -147,6 +165,7 @@ def _run_meta(launcher: Launcher, module, args) -> int:
             size=int(size), generations=int(gens or 3),
             device=device, subprocess_mode=args.optimize_subprocess,
             n_workers=args.optimize_workers,
+            placement=placement,
             crossover=args.optimize_crossover,
             selection=args.optimize_selection,
             extra_argv=extra).run()
@@ -175,7 +194,7 @@ def _run_meta(launcher: Launcher, module, args) -> int:
                 train_ratio=float(ratio or 1.0), device=device,
                 base_seed=args.random_seed,
                 out_file=args.ensemble_file,
-                n_workers=args.ensemble_workers,
+                n_workers=args.ensemble_workers, placement=placement,
                 model_path=args.model, extra_argv=extra).run()
     else:
         from .ensemble import EnsembleTester

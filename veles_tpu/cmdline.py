@@ -93,6 +93,11 @@ def make_parser() -> argparse.ArgumentParser:
                         "the trial scheduler (implies subprocess "
                         "isolation; each worker slot gets its own "
                         "device placement)")
+    p.add_argument("--trial-devices", type=int, default=0, metavar="D",
+                   help="place each --optimize/--ensemble worker trial "
+                        "on its own disjoint D-chip slice "
+                        "(mesh_slice_placement via TPU_VISIBLE_CHIPS); "
+                        "0 = private single CPU device per slot")
     p.add_argument("--optimize-crossover", default="uniform",
                    choices=("uniform", "arithmetic", "geometric",
                             "pointed"),

@@ -158,6 +158,16 @@ class GeneticsOptimizer(Logger):
                       dict(zip((t[0] for t in self.tuneables),
                                values)), fit)
             fits.append(fit)
+        if fits and all(f == -float("inf") for f in fits):
+            # a whole generation failing is a config/placement error
+            # (e.g. a chip slice past the host's last chip), not N
+            # independent divergences — degrading the search silently
+            # would report a "successful" GA that explored nothing
+            from ..error import VelesError
+            raise VelesError(
+                "every candidate in the generation failed — check "
+                "worker placement (--trial-devices × workers vs the "
+                "host's chips) and the first failure above")
         return fits
 
     def _evaluate(self, chromo, index) -> float:

@@ -187,6 +187,13 @@ class TrialScheduler(Logger):
 
     def run(self, trials: Sequence[Trial]) -> List[TrialResult]:
         trials = list(trials)
+        # placement misconfiguration (e.g. a slice past the host's last
+        # chip) is a caller error and must raise BEFORE any trial runs,
+        # not surface as N per-trial "failures"; only slots that can
+        # ever be taken are validated (returned slots re-enter at the
+        # queue tail, so indices ≥ the worker count never circulate)
+        for s in range(min(self.n_workers, len(trials))):
+            self.placement(s)
         results: List[Optional[TrialResult]] = [None] * len(trials)
         slots: Queue = Queue()
         for s in range(self.n_workers):
