@@ -166,6 +166,9 @@ class GraphicsServer(PlotSink, Logger):
         out_dir = out_dir or os.path.join(
             root.common.dirs.cache, "plots")
         os.makedirs(out_dir, exist_ok=True)
+        #: resolved plot directory — the launcher's status beacon reads
+        #: it to inline the latest renders into the drill-down gallery
+        self.out_dir = out_dir
         log = open(os.path.join(out_dir, "client.log"), "ab")
         # run from the package's parent so `-m veles_tpu.graphics` resolves
         # regardless of the caller's cwd/sys.path setup

@@ -93,7 +93,13 @@ class Launcher(Logger):
             from .graphics import GraphicsServer
             self.graphics_server = GraphicsServer()
             workflow.graphics = self.graphics_server
-            self.graphics_server.launch_client(out_dir=self._plots_dir)
+            # per-run default dir: a shared cache/plots would let the
+            # newest-by-mtime gallery pick up a CONCURRENT run's PNGs
+            # and misattribute them on the drill-down page
+            plots_dir = self._plots_dir or os.path.join(
+                root.common.dirs.cache, "plots",
+                "%s@%d" % (getattr(workflow, "name", "wf"), os.getpid()))
+            self.graphics_server.launch_client(out_dir=plots_dir)
         workflow.initialize(device=self.device)
         distributed.verify_checksums(workflow)
         self._arm_failure_hooks(workflow)
@@ -263,7 +269,7 @@ class Launcher(Logger):
                         break
             except Exception:
                 metric = None
-        return {
+        payload = {
             "id": "%s@%d" % (getattr(wf, "name", "?"), os.getpid()),
             "name": getattr(wf, "name", "?"),
             "device": getattr(self.device, "name", None),
@@ -273,6 +279,73 @@ class Launcher(Logger):
                             if self._start_time else 0.0),
             "stopped": self.stopped,
         }
+        # drill-down detail (reference: the web/ app's per-master pages
+        # served unit tables and event/log views, veles/web_status.py:
+        # 66-111): per-unit timing, recent event spans, and the latest
+        # rendered plots ride the same stateless beacon
+        try:
+            payload["units"] = [
+                {"name": n, "cls": c, "runs": r, "time_s": round(t, 4)}
+                for t, n, c, r in sorted(
+                    ((u.timers.get("run", 0.0), u.name,
+                      type(u).__name__, u.run_count) for u in wf),
+                    reverse=True)[:40]]
+        except Exception:       # a half-built workflow must not kill
+            pass                # the beacon thread
+        from .logger import events
+        payload["events"] = [
+            {"name": e.get("name"), "type": e.get("type"),
+             "time": e.get("time"), "who": e.get("who")}
+            for e in events()[-60:]]
+        plots = self._plot_payload()
+        if plots is not None:
+            payload["plots"] = plots
+        return payload
+
+    def _plot_payload(self, max_plots: int = 6,
+                      max_bytes: int = 150_000):
+        """Newest rendered plot PNGs, inlined base64 so the dashboard
+        works across hosts (the reference backed its gallery with
+        Mongo-stored blobs for the same reason). Returns None when the
+        plot set is unchanged since the last beacon — the key is then
+        omitted and the server carries the previous gallery forward,
+        so steady-state ticks don't re-ship megabytes of identical
+        PNGs."""
+        import base64
+        import glob as _glob
+
+        def mtime(p):
+            # the renderer rewrites files concurrently: a vanished path
+            # must not kill the beacon thread via the sort key
+            try:
+                return os.path.getmtime(p)
+            except OSError:
+                return 0.0
+
+        gs = self.graphics_server
+        out_dir = getattr(gs, "out_dir", None) if gs is not None else None
+        if not out_dir or not os.path.isdir(out_dir):
+            pngs = []
+        else:
+            pngs = sorted(_glob.glob(os.path.join(out_dir, "*.png")),
+                          key=mtime, reverse=True)[:max_plots]
+        signature = tuple((p, mtime(p)) for p in pngs)
+        if signature == getattr(self, "_plot_signature", None):
+            return None
+        self._plot_signature = signature
+        out = []
+        for p in pngs:
+            try:
+                if os.path.getsize(p) > max_bytes:
+                    continue
+                with open(p, "rb") as fin:
+                    out.append({
+                        "name": os.path.basename(p),
+                        "png_b64": base64.b64encode(
+                            fin.read()).decode()})
+            except OSError:
+                continue
+        return out
 
     # -- reporting -----------------------------------------------------------
     def write_results(self, results: Dict[str, Any], path: str) -> None:

@@ -59,7 +59,11 @@ async function tick() {
   while (t.rows.length > 1) t.deleteRow(1);
   for (const [id, s] of Object.entries(all)) {
     const row = t.insertRow();
-    for (const v of [id, s.name, s.device, s.epoch, s.metric])
+    const a = document.createElement('a');
+    a.href = 'run.html?id=' + encodeURIComponent(id);
+    a.textContent = id;
+    row.insertCell().appendChild(a);
+    for (const v of [s.name, s.device, s.epoch, s.metric])
       row.insertCell().textContent = v ?? '';
     row.insertCell().innerHTML = spark(s._history);
     for (const v of [s.elapsed_sec,
@@ -68,6 +72,86 @@ async function tick() {
   }
 }
 tick(); setInterval(tick, 2000);
+</script></body></html>"""
+
+_RUN_PAGE = """<!doctype html>
+<html><head><title>veles_tpu run</title><style>
+body { font-family: sans-serif; margin: 2em; }
+table { border-collapse: collapse; margin-bottom: 1.5em; }
+td, th { border: 1px solid #999; padding: 3px 8px; }
+th { background: #eee; }
+h3 { margin-bottom: 0.3em; }
+img { border: 1px solid #ccc; margin: 4px; max-width: 420px; }
+#chart path { fill: none; stroke: #36c; stroke-width: 1.5; }
+</style></head><body>
+<p><a href="/">&larr; all workflows</a></p>
+<h2 id="hdr">run</h2>
+<table id="summary"></table>
+<h3>metric history</h3><div id="chart"></div>
+<h3>units (by run time)</h3>
+<table id="units"><tr><th>unit</th><th>class</th><th>runs</th>
+<th>time&nbsp;s</th></tr></table>
+<h3>recent events</h3>
+<table id="events"><tr><th>time</th><th>who</th><th>event</th>
+<th>type</th></tr></table>
+<h3>plots</h3><div id="plots"></div>
+<script>
+function chart(points) {
+  // the index page's sparkline role at drill-down size (the
+  // reference's d3 time-series panel, dependency-free)
+  if (!points || points.length < 2) return '';
+  const w = 560, h = 160;
+  const lo = Math.min(...points), hi = Math.max(...points);
+  const span = (hi - lo) || 1;
+  const step = w / (points.length - 1);
+  const d = points.map((p, i) =>
+    (i ? 'L' : 'M') + (i * step).toFixed(1) + ',' +
+    (h - 6 - (p - lo) / span * (h - 12)).toFixed(1)).join(' ');
+  return '<svg width="' + w + '" height="' + h + '"><path d="' + d +
+         '"/></svg><div>last: ' + points[points.length - 1] +
+         ' &middot; min: ' + lo + ' &middot; max: ' + hi + '</div>';
+}
+async function tick() {
+  const id = new URLSearchParams(location.search).get('id');
+  document.getElementById('hdr').textContent = id;
+  const r = await fetch('run.json?id=' + encodeURIComponent(id));
+  if (r.status !== 200) return;
+  const s = await r.json();
+  const sm = document.getElementById('summary');
+  while (sm.rows.length) sm.deleteRow(0);
+  for (const k of ['name', 'device', 'epoch', 'metric', 'elapsed_sec',
+                   'stopped']) {
+    const row = sm.insertRow();
+    row.insertCell().textContent = k;
+    row.insertCell().textContent = s[k] ?? '';
+  }
+  document.getElementById('chart').innerHTML = chart(s._history);
+  const ut = document.getElementById('units');
+  while (ut.rows.length > 1) ut.deleteRow(1);
+  for (const u of (s.units || [])) {
+    const row = ut.insertRow();
+    for (const v of [u.name, u.cls, u.runs, u.time_s])
+      row.insertCell().textContent = v ?? '';
+  }
+  const et = document.getElementById('events');
+  while (et.rows.length > 1) et.deleteRow(1);
+  for (const e of (s.events || []).slice().reverse()) {
+    const row = et.insertRow();
+    row.insertCell().textContent =
+      new Date(e.time * 1000).toLocaleTimeString();
+    for (const v of [e.who, e.name, e.type])
+      row.insertCell().textContent = v ?? '';
+  }
+  const pl = document.getElementById('plots');
+  pl.textContent = '';
+  for (const p of (s.plots || [])) {
+    const img = document.createElement('img');
+    img.src = 'data:image/png;base64,' + p.png_b64;
+    img.title = p.name;
+    pl.appendChild(img);
+  }
+}
+tick(); setInterval(tick, 3000);
 </script></body></html>"""
 
 #: metric samples retained per workflow for the dashboard sparkline
@@ -89,10 +173,23 @@ class WebStatusServer(Logger):
                 server.debug("http: " + fmt, *args)
 
             def do_GET(self):
-                if self.path in ("/", "/index.html"):
+                from urllib.parse import parse_qs, urlsplit
+                parts = urlsplit(self.path)
+                if parts.path in ("/", "/index.html"):
                     bytes_reply(self, 200, _PAGE.encode(), "text/html")
-                elif self.path == "/status.json":
+                elif parts.path == "/status.json":
                     json_reply(self, 200, server.snapshot())
+                elif parts.path == "/run.html":
+                    bytes_reply(self, 200, _RUN_PAGE.encode(),
+                                "text/html")
+                elif parts.path == "/run.json":
+                    wid = parse_qs(parts.query).get("id", [""])[0]
+                    entry = server.entry(wid)
+                    if entry is None:
+                        json_reply(self, 404,
+                                   {"error": "unknown id %r" % wid})
+                    else:
+                        json_reply(self, 200, entry)
                 else:
                     self.send_error(404)
 
@@ -115,18 +212,33 @@ class WebStatusServer(Logger):
     # -- state --------------------------------------------------------------
     def update(self, wid: str, payload: Dict[str, Any]) -> None:
         import math
-        payload = {
-            # a non-finite float ANYWHERE in the stored payload would
-            # serialize as bare Infinity/NaN — invalid JSON that makes
-            # the browser's JSON.parse throw on every poll, freezing
-            # the dashboard for every workflow until the entry goes
-            # stale; keep the information as a string instead
-            k: (repr(v) if isinstance(v, float) and not math.isfinite(v)
-                else v)
-            for k, v in payload.items()}
+
+        def finite(v):
+            # a non-finite float ANYWHERE in the stored payload — now
+            # including nested drill-down rows like units[].time_s —
+            # would serialize as bare Infinity/NaN — invalid JSON that
+            # makes the browser's JSON.parse throw on every poll,
+            # freezing the page until the entry goes stale; keep the
+            # information as a string instead
+            if isinstance(v, float) and not math.isfinite(v):
+                return repr(v)
+            if isinstance(v, dict):
+                return {k: finite(x) for k, x in v.items()}
+            if isinstance(v, (list, tuple)):
+                return [finite(x) for x in v]
+            return v
+
+        payload = {k: finite(v) for k, v in payload.items()}
         payload["_received"] = time.time()
         with self._lock:
             prev = self._statuses.get(wid)
+            # a beacon that OMITS a detail key is declaring it
+            # unchanged (the launcher skips re-shipping an identical
+            # plot gallery every tick) — carry the previous value
+            if prev:
+                for k in self.DETAIL_KEYS:
+                    if k not in payload and k in prev:
+                        payload[k] = prev[k]
             # metric history accumulates SERVER-side so the beacon
             # stays a stateless one-shot POST (reference behavior)
             history = list(prev.get("_history", ())) if prev else []
@@ -140,13 +252,29 @@ class WebStatusServer(Logger):
             payload["_history"] = history[-HISTORY_LEN:]
             self._statuses[wid] = payload
 
+    #: heavyweight drill-down keys the index page never renders — the
+    #: 2s poll must not re-ship every run's plot gallery each tick
+    DETAIL_KEYS = ("units", "events", "plots")
+
     def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Summary view (index page): drill-down payload stripped."""
         now = time.time()
         with self._lock:
             self._statuses = {
                 k: v for k, v in self._statuses.items()
                 if now - v["_received"] < self.stale_after}
-            return dict(self._statuses)
+            return {k: {kk: vv for kk, vv in v.items()
+                        if kk not in self.DETAIL_KEYS}
+                    for k, v in self._statuses.items()}
+
+    def entry(self, wid: str) -> Optional[Dict[str, Any]]:
+        """Full stored beacon for one run (drill-down page)."""
+        now = time.time()
+        with self._lock:
+            v = self._statuses.get(wid)
+            if v is None or now - v["_received"] >= self.stale_after:
+                return None
+            return dict(v)
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "WebStatusServer":
