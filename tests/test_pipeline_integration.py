@@ -282,3 +282,27 @@ def test_pipeline_with_epoch_block():
     assert d.epoch_number == 4
     assert d.best_metric is not None and d.best_metric < 0.35, \
         d.epoch_metrics
+
+
+def test_pipeline_with_fsdp_axis():
+    """pp x fsdp composed mesh (ZeRO-3 over the stacked block): the
+    stage axis shards over 'pipeline' AND the largest free param axis
+    shards over 'fsdp'; training still tracks the plain run."""
+    import jax
+    wf = _run({"pipeline": 2, "fsdp": 2}, epochs=4)
+    step = wf.train_step
+    assert step._pp is not None
+    spec = step.params[PP_BLOCK]["weights"].sharding.spec
+    assert spec[0] == "pipeline" and "fsdp" in tuple(spec), spec
+    assert not step.params[PP_BLOCK]["weights"] \
+        .sharding.is_fully_replicated
+    assert wf.decision.best_metric < 0.15
+    # optimizer state inherited the composed sharding (ZeRO's point):
+    # the buffer with the WEIGHTS' shape carries the weights' spec
+    # (bias buffers legitimately stay ('pipeline', None))
+    w_shape = step.params[PP_BLOCK]["weights"].shape
+    bufs = [b for b in jax.tree_util.tree_leaves(step.opt_state[PP_BLOCK])
+            if getattr(b, "shape", None) == w_shape]
+    assert bufs, "no weights-shaped optimizer buffer"
+    for b in bufs:
+        assert b.sharding.spec == spec, b.sharding

@@ -512,6 +512,56 @@ def choose_flash(t: int, d: int) -> bool:
     return jax.default_backend() == "tpu" and t >= min_t
 
 
+def _prepare(q, k, v, scale, block_q, block_k, interpret, caller):
+    """Shared prologue for the public entry points: validation, scale
+    default, interpret default, and the head-fold + lane-pad of the
+    operands. Returns (q3, k3, v3, scale, interpret, b, t, h, kv, d)."""
+    b, t, h, d = q.shape
+    kv = k.shape[2]
+    if v.shape[2] != kv or h % kv:
+        raise ValueError(
+            "k/v head counts must match and divide q heads: q has %d, "
+            "k %d, v %d" % (h, kv, v.shape[2]))
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if not supported(t, d, block_q, block_k):
+        raise ValueError("%s: T=%d D=%d not supported with blocks "
+                         "(%d, %d)" % (caller, t, d, block_q, block_k))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    d_pad = ((d + LANE - 1) // LANE) * LANE
+
+    def fold(x):
+        heads = x.shape[2]
+        xt = jnp.moveaxis(x, 2, 1).reshape(b * heads, t, d)
+        if d < d_pad:
+            xt = jnp.pad(xt, ((0, 0), (0, 0), (0, d_pad - d)))
+        return xt
+
+    return (fold(q), fold(k), fold(v), float(scale), interpret,
+            b, t, h, kv, d)
+
+
+def flash_attention_fwd_lse(q, k, v, causal: bool = False,
+                            scale: Optional[float] = None,
+                            block_q: int = 128, block_k: int = 128,
+                            interpret: Optional[bool] = None):
+    """FORWARD-ONLY flash returning ``(o, lse)`` with lse ``(B, T, H)``
+    (log-sum-exp of the scaled scores per query row). No custom VJP —
+    the caller owns differentiation: ring attention merges per-block
+    partials by lse and defines the blockwise ring backward itself
+    (parallel/ring_attention.py). Same folding/padding/support rules
+    as :func:`flash_attention`."""
+    q3, k3, v3, scale, interpret, b, t, h, kv, d = _prepare(
+        q, k, v, scale, block_q, block_k, interpret,
+        "flash_attention_fwd_lse")
+    o, lse = _fwd_pallas(q3, k3, v3, causal, scale, block_q, block_k,
+                         interpret, 0, h, kv)
+    o = jnp.moveaxis(o[..., :d].reshape(b, h, t, d), 1, 2)
+    lse = jnp.moveaxis(lse[:, 0, :].reshape(b, h, t), 1, 2)  # (B,T,H)
+    return o, lse
+
+
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None, block_q: int = 128,
                     block_k: int = 128,
@@ -526,37 +576,17 @@ def flash_attention(q, k, v, causal: bool = False,
     requires ``causal``): compute AND the blockwise backward drop the
     dead blocks, so long-T cost scales O(T·W) instead of O(T²).
     """
-    b, t, h, d = q.shape
-    kv = k.shape[2]
-    if v.shape[2] != kv or h % kv:
-        raise ValueError(
-            "k/v head counts must match and divide q heads: q has %d, "
-            "k %d, v %d" % (h, kv, v.shape[2]))
-    if scale is None:
-        scale = 1.0 / (d ** 0.5)
-    if not supported(t, d, block_q, block_k):
-        raise ValueError("flash_attention: T=%d D=%d not supported with "
-                         "blocks (%d, %d)" % (t, d, block_q, block_k))
     window = int(window or 0)
     if window < 0:
         raise ValueError("window must be >= 1 (or None)")
     if window and not causal:
         raise ValueError("sliding-window attention requires causal=True")
+    q3, k3, v3, scale, interpret, b, t, h, kv, d = _prepare(
+        q, k, v, scale, block_q, block_k, interpret, "flash_attention")
     if window >= t:
         window = 0          # a window covering everything is no window
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
 
-    d_pad = ((d + LANE - 1) // LANE) * LANE  # next lane-group multiple
-
-    def fold(x):
-        heads = x.shape[2]
-        xt = jnp.moveaxis(x, 2, 1).reshape(b * heads, t, d)
-        if d < d_pad:
-            xt = jnp.pad(xt, ((0, 0), (0, 0), (0, d_pad - d)))
-        return xt
-
-    o = _flash(fold(q), fold(k), fold(v), causal, float(scale),
+    o = _flash(q3, k3, v3, causal, scale,
                block_q, block_k, interpret, window, h, kv)
     o = o[..., :d].reshape(b, h, t, d)
     return jnp.moveaxis(o, 1, 2)

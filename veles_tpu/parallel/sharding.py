@@ -69,7 +69,9 @@ PP_BLOCK = "__pp_block__"
 
 def _pp_block_spec(name: str, shape, mesh) -> tuple:
     """Stacked pipeline block: leading layer axis over 'pipeline', output
-    features over 'tensor' when divisible (biases replicate per stage)."""
+    features over 'tensor' when divisible (biases replicate per stage),
+    and the largest remaining divisible axis over 'fsdp' — ZeRO-3
+    composes with the stage stacking exactly like with flat params."""
     sizes = dict(mesh.shape)
     spec = [None] * len(shape)
     spec[0] = "pipeline"
@@ -77,6 +79,13 @@ def _pp_block_spec(name: str, shape, mesh) -> tuple:
     if name not in ("bias",) and tp > 1 and len(shape) >= 3 \
             and shape[-1] % tp == 0:
         spec[-1] = "tensor"
+    fsdp = sizes.get("fsdp", 1)
+    if name not in ("bias",) and fsdp > 1:
+        order = sorted(range(1, len(shape)), key=lambda i: -shape[i])
+        for i in order:
+            if spec[i] is None and shape[i] % fsdp == 0:
+                spec[i] = "fsdp"
+                break
     return tuple(spec)
 
 
