@@ -355,13 +355,28 @@ def _bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
                 window: int = 0, h: int = 1, kv: int = 1):
     """Pallas twin of ``_bwd_blockwise``: same math, VMEM-resident
     blockwise recompute. delta = rowsum(do*o) is O(T·D) and computed
-    outside; lse/delta ride in the forward's (G, 8, T) sublane-padded
-    layout. GQA (kv < h): k/v stay grouped (B*kv rows); the dq grid
-    remaps K/V reads per query head, and the dk/dv grid runs over the
-    GROUPED rows with (query-head-in-group, q-block) folded into its
-    sequential dimension — each kv head's gradient accumulates the
-    contributions of all h/kv query heads with no expanded operands
-    and no racy parallel writes."""
+    outside the kernels."""
+    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
+    return _bwd_pallas_core(q, k, v, lse, delta, do, causal, scale,
+                            block_q, block_k, interpret, window, h, kv)
+
+
+def _bwd_pallas_core(q, k, v, lse, delta, do, causal: bool,
+                     scale: float, block_q: int, block_k: int,
+                     interpret: bool, window: int = 0, h: int = 1,
+                     kv: int = 1, out_dtype=None):
+    """The kernel pair behind the backward, against a CALLER-SUPPLIED
+    normalizer: ``p = exp(s − lse)`` with ``lse``/``delta`` (G, T)
+    computed over whatever attention the caller ran (the full T here;
+    the GLOBAL ring softmax in parallel/ring_attention.py — that is
+    what makes these kernels reusable per ring step). lse/delta ride
+    in the forward's (G, 8, T) sublane-padded layout. GQA (kv < h):
+    k/v stay grouped (B*kv rows); the dq grid remaps K/V reads per
+    query head, and the dk/dv grid runs over the GROUPED rows with
+    (query-head-in-group, q-block) folded into its sequential
+    dimension — each kv head's gradient accumulates the contributions
+    of all h/kv query heads with no expanded operands and no racy
+    parallel writes."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     g, t, d = q.shape
@@ -374,7 +389,6 @@ def _bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
         # dkv grid: b indexes grouped K/V rows; j = qh * nq + qi
         return (b // kv) * h + (b % kv) * group + j // nq
 
-    delta = (do.astype(jnp.float32) * o.astype(jnp.float32)).sum(-1)
     pad8 = jnp.broadcast_to(delta[:, None, :], (g, 8, t))
     lse8 = jnp.broadcast_to(lse[:, None, :], (g, 8, t))
     common = dict(scale=scale, causal=causal, block_q=block_q,
@@ -398,8 +412,8 @@ def _bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((gk, t, d), k.dtype),
-            jax.ShapeDtypeStruct((gk, t, d), v.dtype),
+            jax.ShapeDtypeStruct((gk, t, d), out_dtype or k.dtype),
+            jax.ShapeDtypeStruct((gk, t, d), out_dtype or v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -432,7 +446,7 @@ def _bwd_pallas(q, k, v, o, lse, do, causal: bool, scale: float,
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_shape=[jax.ShapeDtypeStruct((g, t, d), q.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((g, t, d), out_dtype or q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -560,6 +574,43 @@ def flash_attention_fwd_lse(q, k, v, causal: bool = False,
     o = jnp.moveaxis(o[..., :d].reshape(b, h, t, d), 1, 2)
     lse = jnp.moveaxis(lse[:, 0, :].reshape(b, h, t), 1, 2)  # (B,T,H)
     return o, lse
+
+
+def flash_attention_bwd_lse(q, k, v, lse, delta, do,
+                            causal: bool = False,
+                            scale: Optional[float] = None,
+                            block_q: int = 128, block_k: int = 128,
+                            interpret: Optional[bool] = None):
+    """Blockwise flash BACKWARD against an external (global) softmax
+    normalizer: ``(dq, dk, dv)`` contributions of this K/V block set,
+    with ``p = exp(s − lse)``. ``lse``/``delta = rowsum(do·o)`` are
+    (B, T, H), computed by the caller over the FULL attention — ring
+    attention's per-step backward engine (the global lse makes each
+    block's probabilities exact regardless of which blocks this call
+    sees). VMEM-resident kernels; no (T, T) materialization."""
+    q3, k3, v3, scale, interpret, b, t, h, kv, d = _prepare(
+        q, k, v, scale, block_q, block_k, interpret,
+        "flash_attention_bwd_lse")
+
+    def fold_g(x):      # (B, T, H) → (B*H, T)
+        return jnp.moveaxis(x, -1, 1).reshape(b * h, t)
+
+    d_pad = q3.shape[-1]
+    do3 = jnp.moveaxis(do, 2, 1).reshape(b * h, t, d)
+    if d < d_pad:
+        do3 = jnp.pad(do3, ((0, 0), (0, 0), (0, d_pad - d)))
+    # f32 outputs: these are PARTIAL contributions the ring sums across
+    # steps — rounding each partial to bf16 before the f32 accumulation
+    # would grow error O(ring size) over the einsum engine
+    dq, dk, dv = _bwd_pallas_core(
+        q3, k3, v3, fold_g(lse).astype(jnp.float32),
+        fold_g(delta).astype(jnp.float32), do3, causal, scale,
+        block_q, block_k, interpret, 0, h, kv, out_dtype=jnp.float32)
+
+    def unfold(x, heads):
+        return jnp.moveaxis(x[..., :d].reshape(b, heads, t, d), 1, 2)
+
+    return unfold(dq, h), unfold(dk, kv), unfold(dv, kv)
 
 
 def flash_attention(q, k, v, causal: bool = False,

@@ -133,7 +133,8 @@ def _ring_local(q_blk, k_blk, v_blk, *, axis, n, causal, scale,
 
     def bwd(res, do):
         return _ring_bwd_impl(res, do, axis=axis, n=n, causal=causal,
-                              scale=scale, window=window)
+                              scale=scale, window=window,
+                              use_flash=use_flash)
 
     ring.defvjp(fwd, bwd)
     return ring(q_blk, k_blk, v_blk)
@@ -232,11 +233,16 @@ def _ring_fwd_impl(q_blk, k_blk, v_blk, *, axis, n, causal, scale,
     return out, lse
 
 
-def _ring_bwd_impl(res, do, *, axis, n, causal, scale, window):
+def _ring_bwd_impl(res, do, *, axis, n, causal, scale, window,
+                   use_flash=False):
     """Blockwise ring backward (flash-attention bwd math at ring
     scale): p recomputed per step from the global lse; dq accumulates
     locally; dk/dv accumulators rotate WITH their K/V blocks and are
-    fast-forwarded home after the (possibly window-shortened) scan."""
+    fast-forwarded home after the (possibly window-shortened) scan.
+    ``use_flash`` runs each step's recompute through the Pallas bwd
+    kernel pair (``flash_attention_bwd_lse`` — VMEM-resident, no
+    (Tl, Tl) score materialization), same peeled-diagonal structure as
+    the forward."""
     import jax
     import jax.numpy as jnp
 
@@ -252,41 +258,69 @@ def _ring_bwd_impl(res, do, *, axis, n, causal, scale, window):
     delta = (dof * o.astype(jnp.float32)).sum(-1)        # (B, Tl, H)
     delta_bh = delta.transpose(0, 2, 1)                  # (B, H, Tl)
 
+    if use_flash:
+        from ..ops.flash_attention import flash_attention_bwd_lse
+        lse_bth = jnp.moveaxis(lse, 1, -1)               # (B, Tl, H)
+
+        def step_grads(kb, vb, diag, src):
+            # diagonal step: static causal mask in the kernel; behind
+            # blocks unmasked; a wrapped future block's contribution
+            # is zeroed by the liveness weight (like the forward). The
+            # kernels emit f32 partials — see flash_attention_bwd_lse.
+            dqi, dki, dvi = flash_attention_bwd_lse(
+                q_blk, kb, vb, lse_bth, delta, do,
+                causal=bool(causal) and diag, scale=scale)
+            if causal and not diag:
+                live = src < my
+                dqi = jnp.where(live, dqi, 0)
+                dki = jnp.where(live, dki, 0)
+                dvi = jnp.where(live, dvi, 0)
+            return dqi, dki, dvi
+    else:
+        def step_grads(kb, vb, diag, src):
+            s = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                           kb.astype(jnp.float32)) * scale
+            if causal:
+                k_pos = src * tl + jnp.arange(tl)
+                rel = q_pos[:, None] - k_pos[None, :]
+                mask = rel >= 0
+                if window:
+                    mask = mask & (rel < window)
+                s = jnp.where(mask[None, None], s, -jnp.inf)
+            # probabilities against the GLOBAL normalizer; fully masked
+            # rows/blocks (incl. wrapped future ones) give exp(-inf)=0
+            p = jnp.exp(s - lse[..., :, None])
+            dvi = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
+            dp = jnp.einsum("bqhd,bkhd->bhqk", dof,
+                            vb.astype(jnp.float32))
+            ds = p * (dp - delta_bh[..., None]) * scale
+            dqi = jnp.einsum("bhqk,bkhd->bqhd", ds,
+                             kb.astype(jnp.float32))
+            dki = jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
+            return dqi, dki, dvi
+
+    def rotate(*xs):
+        return tuple(jax.lax.ppermute(x, axis, perm) for x in xs)
+
+    # step 0 peeled (the flash engine needs its causal mask static);
+    # accumulators then rotate WITH their K/V blocks each step
+    dq, dkb, dvb = step_grads(k_blk, v_blk, True, my)
+    kb, vb, dkb, dvb = rotate(k_blk, v_blk, dkb, dvb)
+
     def body(carry, i):
         dq, kb, vb, dkb, dvb = carry
         src = (my - i) % n
-        s = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                       kb.astype(jnp.float32)) * scale
-        if causal:
-            k_pos = src * tl + jnp.arange(tl)
-            rel = q_pos[:, None] - k_pos[None, :]
-            mask = rel >= 0
-            if window:
-                mask = mask & (rel < window)
-            s = jnp.where(mask[None, None], s, -jnp.inf)
-        # softmax probabilities against the GLOBAL normalizer; fully
-        # masked rows/blocks (incl. wrapped future blocks) give exp(-inf)
-        p = jnp.exp(s - lse[..., :, None])   # (B,H,Tq,1) vs s (B,H,Tq,Tk)
-        dvb = dvb + jnp.einsum("bhqk,bqhd->bkhd", p, dof)
-        dp = jnp.einsum("bqhd,bkhd->bhqk", dof,
-                        vb.astype(jnp.float32))
-        ds = p * (dp - delta_bh[..., None]) * scale
-        dq = dq + jnp.einsum("bhqk,bkhd->bqhd", ds,
-                             kb.astype(jnp.float32))
-        dkb = dkb + jnp.einsum("bhqk,bqhd->bkhd", ds, qf)
-        kb = jax.lax.ppermute(kb, axis, perm)
-        vb = jax.lax.ppermute(vb, axis, perm)
-        dkb = jax.lax.ppermute(dkb, axis, perm)
-        dvb = jax.lax.ppermute(dvb, axis, perm)
+        dqi, dki, dvi = step_grads(kb, vb, False, src)
+        dq, dkb, dvb = dq + dqi, dkb + dki, dvb + dvi
+        kb, vb, dkb, dvb = rotate(kb, vb, dkb, dvb)
         return (dq, kb, vb, dkb, dvb), None
 
-    dq0 = jnp.zeros((b, tl, h, d), jnp.float32)
-    z = jnp.zeros((b, tl, h, d), jnp.float32)
-    (dq, _, _, dkb, dvb), _ = jax.lax.scan(
-        body, (dq0, k_blk, v_blk, z, z), jnp.arange(steps))
+    if steps > 1:
+        (dq, _, _, dkb, dvb), _ = jax.lax.scan(
+            body, (dq, kb, vb, dkb, dvb), jnp.arange(1, steps))
     # after `steps` hops the accumulators sit `steps` devices ahead of
-    # home; one shifted ppermute completes the ring in a single
-    # collective (dead far blocks contributed exact zeros)
+    # home; one shifted ppermute completes the (window-shortened) ring
+    # in a single collective (dead far blocks contributed exact zeros)
     home = (n - steps) % n
     if home:
         shift = [(j, (j + home) % n) for j in range(n)]
