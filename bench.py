@@ -163,6 +163,10 @@ def bench_mnist(dev, n_chips, smoke=False, h=None):
         "epochs_per_dispatch": h,
         "smoke": bool(smoke),
         "data": "real" if datasets.mnist_is_real() else "synthetic",
+        # which train-segment engine actually ran (a silent eligibility
+        # fallback must never wear the fused-kernel method tag)
+        "fused_fc_active": bool(getattr(wf.train_step,
+                                        "_fused_fc_active", False)),
     }
 
 

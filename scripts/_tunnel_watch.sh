@@ -9,8 +9,8 @@ cd /root/repo
 while true; do
   missing=$(python3 - <<'PY'
 import json, os
-order = ("ae_amp ae_fp32 ae_amp_remat lm attn generation profile "
-         "mnist mnist_mb1000 mnist_h_sweep").split()
+order = ("mnist_fused ae_amp ae_fp32 ae_amp_remat lm attn generation "
+         "profile mnist mnist_mb1000 mnist_h_sweep").split()
 done_keys = set()
 p = "docs/chip_r03.json"
 if os.path.exists(p):

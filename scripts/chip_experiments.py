@@ -54,6 +54,33 @@ def sec_mnist(bench, dev, n):
     return bench.bench_mnist(dev, n, smoke=_on_cpu(dev))  # h=8 blocks
 
 
+def sec_mnist_fused(bench, dev, n):
+    """Round-4 lever: the whole-epoch Pallas SGD kernel
+    (ops/fused_fc.py, engine.fused_fc_scan) vs the h=8 scan headline.
+    Same config, same whole-epoch semantics (eval segments + train);
+    distinct method tag — never comparable to the scan-mode anchors."""
+    import jax
+    from veles_tpu.config import root as vt_root
+    prev = vt_root.common.engine.get("fused_fc_scan", False)
+    # "force": the bench A/B carries its own method tag, so the
+    # TPU bf16-policy parity gate must not silently fall back
+    vt_root.common.engine.fused_fc_scan = "force"
+    try:
+        jax.clear_caches()
+        out = bench.bench_mnist(dev, n, smoke=_on_cpu(dev))
+        if not out.get("fused_fc_active") and not _on_cpu(dev):
+            # scan-path numbers must never wear the fused tag
+            raise RuntimeError(
+                "fused_fc_scan did not engage (eligibility fallback) — "
+                "refusing to record a scan measurement under the "
+                "fused method tag")
+        out["method"] = "median_of_3x10s_h8_fusedkernel"
+        return out
+    finally:
+        vt_root.common.engine.fused_fc_scan = prev
+        jax.clear_caches()
+
+
 def sec_mnist_h_sweep(bench, dev, n):
     """Dispatch-amortization knee: h=1 (plan mode — comparable to the
     stored 1.52M 'median_of_3x10s' anchor) and h=32 (4x the headline's
@@ -307,7 +334,8 @@ def sec_profile(bench, dev, n):
     return {"trace_dir": prof_dir}
 
 
-SECTIONS = [("mnist", sec_mnist), ("mnist_h_sweep", sec_mnist_h_sweep),
+SECTIONS = [("mnist", sec_mnist), ("mnist_fused", sec_mnist_fused),
+            ("mnist_h_sweep", sec_mnist_h_sweep),
             ("mnist_mb1000", sec_mnist_mb1000),
             ("ae_amp", sec_ae_amp),
             ("ae_fp32", sec_ae_fp32), ("ae_amp_remat", sec_ae_amp_remat),

@@ -1,0 +1,135 @@
+"""Whole-epoch fused FC SGD kernel (ops/fused_fc.py): kernel↔oracle
+equivalence, TrainStep fast-path trajectory parity vs the general scan
+path, and strict eligibility gating."""
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn, prng
+from veles_tpu.config import root
+from veles_tpu.loader import FullBatchLoader, TRAIN, VALID
+
+
+def test_kernel_matches_oracle():
+    import jax.numpy as jnp
+    from veles_tpu.ops.fused_fc import (fused_fc_oracle,
+                                        fused_fc_sgd_epoch)
+    rng = numpy.random.RandomState(0)
+    fin, hid, nout, n, mb = 20, 12, 3, 60, 10
+    w1 = jnp.asarray(rng.randn(fin, hid) * 0.1, jnp.float32)
+    b1 = jnp.asarray(rng.randn(hid) * 0.01, jnp.float32)
+    w2 = jnp.asarray(rng.randn(hid, nout) * 0.1, jnp.float32)
+    b2 = jnp.zeros((nout,), jnp.float32)
+    ds = jnp.asarray(rng.rand(n, fin), jnp.float32)
+    lb = jnp.asarray(rng.randint(0, nout, n), jnp.int32)
+    plan = jnp.asarray(rng.permutation(n).reshape(-1, mb), jnp.int32)
+    for a, b in ((1.0, 1.0), (1.7159, 0.6666)):
+        out_k = fused_fc_sgd_epoch(w1, b1, w2, b2, ds, lb, plan, 0.05,
+                                   act_a=a, act_b=b)
+        out_o = fused_fc_oracle(w1, b1, w2, b2, ds, lb, plan, 0.05,
+                                act_a=a, act_b=b)
+        for name, kk, oo in zip(("w1", "b1", "w2", "b2", "loss", "err"),
+                                out_k, out_o):
+            numpy.testing.assert_allclose(
+                numpy.asarray(kk), numpy.asarray(oo), rtol=2e-5,
+                atol=2e-6, err_msg="%s (A=%s)" % (name, a))
+
+
+class Blobs(FullBatchLoader):
+    hide_from_registry = True
+
+    def load_data(self):
+        rng = numpy.random.RandomState(9)
+        n_per, d, k = 50, 16, 3
+        centers = rng.randn(k, d) * 2.5
+        x = numpy.concatenate(
+            [centers[c] + rng.randn(n_per, d) for c in range(k)])
+        y = numpy.concatenate([numpy.full(n_per, c) for c in range(k)])
+        perm = rng.permutation(len(x))
+        self.create_originals(x[perm].astype(numpy.float32),
+                              y[perm].astype(numpy.int32))
+        self.class_lengths = [0, 30, 120]
+
+
+def _run(fused, epochs=4, solver="sgd", mb=20):
+    prev = root.common.engine.get("fused_fc_scan", False)
+    root.common.engine.fused_fc_scan = fused
+    try:
+        prng.seed_all(777)
+        wf = nn.StandardWorkflow(
+            name="ffc-%s" % fused,
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 8,
+                     "learning_rate": 0.05, "solver": solver},
+                    {"type": "softmax", "output_sample_shape": 3,
+                     "learning_rate": 0.05, "solver": solver}],
+            loader_unit=Blobs(None, minibatch_size=mb, name="bl"),
+            loss_function="softmax",
+            decision_config=dict(max_epochs=epochs,
+                                 fail_iterations=100),
+            epochs_per_dispatch=2)
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        wf.run()
+        return wf
+    finally:
+        root.common.engine.fused_fc_scan = prev
+
+
+def test_workflow_trajectory_parity():
+    """engine.fused_fc_scan must reproduce the general epoch-block
+    path: identical per-epoch valid metrics and matching final
+    weights (same seed → same shuffle plans → same SGD math)."""
+    import jax
+    wf_g = _run(False)
+    wf_f = _run(True)
+    assert wf_f.train_step._fused_fc is not None
+    assert wf_f.train_step._fused_fc_active
+    assert wf_g.train_step._fused_fc is None
+    ev_g = numpy.asarray(wf_g.decision.epoch_metrics[VALID])
+    ev_f = numpy.asarray(wf_f.decision.epoch_metrics[VALID])
+    numpy.testing.assert_allclose(ev_f, ev_g, atol=1e-6)
+    tr_g = numpy.asarray(wf_g.decision.epoch_metrics[TRAIN])
+    tr_f = numpy.asarray(wf_f.decision.epoch_metrics[TRAIN])
+    numpy.testing.assert_allclose(tr_f, tr_g, atol=1e-5)
+    names = sorted(wf_g.train_step.params)
+    assert names == sorted(wf_f.train_step.params) and len(names) == 2
+    for name in names:
+        wg = jax.device_get(wf_g.train_step.params[name]["weights"])
+        wf_ = jax.device_get(wf_f.train_step.params[name]["weights"])
+        numpy.testing.assert_allclose(wf_, wg, rtol=2e-4, atol=2e-5)
+
+
+def test_eligibility_rejects_adam():
+    wf = _run(True, epochs=2, solver="adam")
+    assert wf.train_step._fused_fc is None          # fell back loudly
+    assert wf.decision.best_metric is not None
+
+
+def test_eligibility_rejects_partial_batches():
+    """mb that does not divide the train length leaves padded plan
+    rows — the kernel path must yield to the masked general path."""
+    wf = _run(True, epochs=2, mb=25)    # 120 % 25 != 0
+    assert wf.train_step._fused_fc is not None
+    assert not wf.train_step._fused_fc_active
+    assert wf.decision.best_metric is not None
+
+
+def test_eligibility_rejects_freeze_base():
+    """Frozen layers must not be updated by the unconditional kernel."""
+    prev = root.common.engine.get("fused_fc_scan", False)
+    root.common.engine.fused_fc_scan = True
+    try:
+        prng.seed_all(3)
+        wf = nn.StandardWorkflow(
+            name="ffc-frozen",
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 8,
+                     "learning_rate": 0.05, "freeze_base": True},
+                    {"type": "softmax", "output_sample_shape": 3,
+                     "learning_rate": 0.05}],
+            loader_unit=Blobs(None, minibatch_size=20, name="bl2"),
+            loss_function="softmax",
+            decision_config=dict(max_epochs=1, fail_iterations=100),
+            epochs_per_dispatch=2)
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        assert wf.train_step._fused_fc is None
+    finally:
+        root.common.engine.fused_fc_scan = prev

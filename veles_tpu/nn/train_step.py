@@ -116,6 +116,9 @@ class TrainStep(AcceleratedUnit):
         self._param_masks_np: Dict[Any, numpy.ndarray] = {}
         self._accum: Dict[int, Any] = {}
         self._zero_accum = None
+        #: ops/fused_fc.py whole-epoch kernel plan (engine.fused_fc_scan
+        #: + strict eligibility, _setup_fused_fc); None = general path
+        self._fused_fc = None
         #: (stacked device accums, H) from the last block dispatch —
         #: converted to per-epoch dicts lazily in drain_epoch_blocks
         self._block_metrics = None
@@ -199,7 +202,95 @@ class TrainStep(AcceleratedUnit):
                               "by data-axis size %d"
                               % (mb // self.grad_accumulation, n_data))
         self._setup_shardings()
+        self._setup_fused_fc()
         return None
+
+    def _setup_fused_fc(self) -> None:
+        """Opt-in whole-epoch Pallas fast path
+        (``root.common.engine.fused_fc_scan``, ops/fused_fc.py): the
+        sequential-SGD-bound FC configs (the MNIST-784 headline) run
+        each epoch's K optimizer steps as ONE kernel with VMEM-resident
+        weights. Strict eligibility — anything outside the proven
+        envelope silently keeps the general scan path (and logs why)."""
+        from ..config import root
+        self._fused_fc = None
+        flag = root.common.engine.get("fused_fc_scan", False)
+        if not flag:
+            return
+
+        def reject(why):
+            self.info("fused_fc_scan requested but ineligible: %s", why)
+
+        # the kernel computes in f32; the general path's matmuls follow
+        # the compute_dtype policy — on TPU the default bfloat16 policy
+        # means one bf16 MXU pass (Precision.DEFAULT), so the two paths
+        # would not be trajectory-exact there. On CPU DEFAULT is full
+        # f32 and parity holds. "force" opts out of the parity claim
+        # (bench A/Bs carry their own method tag instead)
+        import jax
+        if flag != "force" and jax.default_backend() == "tpu" \
+                and str(root.common.engine.get(
+                    "compute_dtype", "bfloat16")) not in ("float32",
+                                                          "f32"):
+            return reject("TPU compute_dtype policy is bfloat16 — the "
+                          "f32 kernel would not be trajectory-exact "
+                          "vs the bf16-pass scan path (set "
+                          "compute_dtype=float32 or fused_fc_scan="
+                          "'force' to opt out of the parity claim)")
+
+        from .all2all import All2AllSoftmax, All2AllTanh
+        fs = [f for f in self.forwards if f.PARAMETERIZED]
+        if (len(self.forwards) != 2 or len(fs) != 2
+                or type(fs[0]) is not All2AllTanh
+                or type(fs[1]) is not All2AllSoftmax):
+            return reject("needs exactly [all2all_tanh, softmax]")
+        if not isinstance(self.evaluator, EvaluatorSoftmax) \
+                or getattr(self.evaluator, "label_smoothing", 0.0) \
+                or getattr(self.evaluator, "compute_confusion", False):
+            return reject("needs plain softmax-CE evaluator")
+        if self.mixed_precision or self.remat \
+                or self.grad_accumulation > 1:
+            return reject("amp/remat/grad-accumulation not fused")
+        if self._pp is not None or self._pp_hetero is not None:
+            return reject("pipeline mesh not fused")
+        if isinstance(self.device, XLADevice) \
+                and self.device.mesh.devices.size != 1:
+            return reject("single-device only (the kernel owns the "
+                          "whole update; no psum inside)")
+        if self.param_masks:
+            return reject("sparsity masks not fused")
+        lrs = set()
+        for f in fs:
+            if set(self.params[f.name]) != {"weights", "bias"}:
+                return reject("%s params beyond weights+bias (LoRA?)"
+                              % f.name)
+            if getattr(f, "freeze_base", False):
+                return reject("%s is frozen (freeze_base) — the "
+                              "kernel updates unconditionally" % f.name)
+            gd = self._gd_for[f.name]
+            if gd.solver != "sgd" or gd.momentum or gd.weight_decay \
+                    or gd.weight_decay_bias or gd.gradient_clip \
+                    or gd.gradient_clip_norm:
+                return reject("%s: fused path is plain SGD only"
+                              % f.name)
+            lrs.update({float(gd.learning_rate),
+                        float(gd.learning_rate_bias)})
+        if len(lrs) != 1:
+            return reject("per-layer/bias learning rates differ")
+        if getattr(self.loader, "device_augment_fn", None) is not None:
+            return reject("device-side augmentation not fused")
+        if self.target_mode != "labels":
+            return reject("labels targets only")
+        ds = self.loader.original_data
+        if ds is None or ds.mem.ndim != 2:
+            return reject("flat (N, features) dataset only")
+        self._fused_fc = {
+            "lr": lrs.pop(),
+            "act_a": float(fs[0].A), "act_b": float(fs[0].B),
+            "names": (fs[0].name, fs[1].name),
+        }
+        self.info("fused_fc_scan engaged: whole-epoch Pallas SGD "
+                  "kernel (%s → %s)", fs[0].name, fs[1].name)
 
     def _setup_pipeline(self) -> None:
         """{"pipeline": N} mesh axis: stage-group the forward chain and
@@ -788,6 +879,32 @@ class TrainStep(AcceleratedUnit):
                     targets, per_epoch[key + "_idx"],
                     per_epoch[key + "_mask"])
                 outs[cls] = acc
+            if getattr(self, "_fused_fc_active", False):
+                # whole-epoch Pallas SGD kernel (ops/fused_fc.py):
+                # weights stay VMEM-resident for all K steps. Plain-SGD
+                # momentum state is inert (eligibility enforces
+                # momentum == 0), so opt_state passes through.
+                import jax.numpy as jnp
+                from ..ops.fused_fc import fused_fc_sgd_epoch
+                ff = self._fused_fc
+                n1, n2 = ff["names"]
+                plan = per_epoch["c%d_idx" % TRAIN]
+                w1, b1, w2, b2, loss_sum, err = fused_fc_sgd_epoch(
+                    p[n1]["weights"], p[n1]["bias"],
+                    p[n2]["weights"], p[n2]["bias"],
+                    dataset, labels, plan,
+                    per_epoch["lr"] * ff["lr"],
+                    act_a=ff["act_a"], act_b=ff["act_b"])
+                p = dict(p)
+                p[n1] = {"weights": w1, "bias": b1}
+                p[n2] = {"weights": w2, "bias": b2}
+                n = jnp.float32(plan.shape[0] * plan.shape[1])
+                outs[TRAIN] = {"n_samples": n, "sum_loss": loss_sum,
+                               "n_err": err}
+                # the general path reports the LAST batch's mean loss;
+                # the kernel returns the epoch sum — report the epoch
+                # mean (same scale, logging-only)
+                return (p, o), (outs, loss_sum / n)
             p, o, acc_tr, loss = self._train_plan_fn(
                 p, o, self._make_zero_accum(), dataset, labels, targets,
                 per_epoch["c%d_idx" % TRAIN],
@@ -845,11 +962,23 @@ class TrainStep(AcceleratedUnit):
         xs["lr"] = _np.asarray(scales, dtype=_np.float32)
         keys = frozenset(xs)
 
+        # fused kernel assumes whole minibatches: any padded plan row
+        # (partial tail batch) falls back to the masked general path.
+        # The flag keys the jit cache — flipping it must not reuse the
+        # other variant's trace.
+        self._fused_fc_active = (
+            self._fused_fc is not None
+            and all(float(m.map_read()[:h].min()) >= 1.0
+                    for cls, (i_, m) in loader.block_plans.items()
+                    if cls == TRAIN))
+
         def fn(params, opt_state, dataset, labels, targets, xs, rng):
             return self._epoch_block_fn(params, opt_state, dataset,
                                         labels, targets, keys, xs, rng)
 
-        jitted = self.jit("epoch_block", fn, donate_argnums=(0, 1))
+        jitted = self.jit(
+            "epoch_block_fused" if self._fused_fc_active
+            else "epoch_block", fn, donate_argnums=(0, 1))
         self.params, self.opt_state, stacked, self.last_loss = jitted(
             self.params, self.opt_state, dataset, labels, targets, xs,
             self._rng.jax_key())
