@@ -43,7 +43,10 @@ def main(argv=None):
     fail = False
     for n_blocks, dim, prompt_len in ((2, 64, 24), (4, 128, 24)):
         prng.seed_all(7)
-        wf = lm.build_workflow(epochs=1, minibatch_size=64,
+        # the speculative A/B (big config only) needs a trained
+        # target for a meaningful draft-acceptance rate
+        wf = lm.build_workflow(epochs=6 if n_blocks >= 4 else 1,
+                               minibatch_size=64,
                                n_blocks=n_blocks, dim=dim,
                                n_train=256, n_valid=64)
         wf.initialize(device=vt.Device_for(args.device))
@@ -70,6 +73,26 @@ def main(argv=None):
             "speedup": round(t_naive / t_cached, 2),
             "platform": wf.device.platform,
         }
+        if n_blocks >= 4:
+            # speculative decoding over the big target: a 1-block
+            # draft of the same vocab proposes gamma tokens per
+            # big-model dispatch (nn/speculative.py); exact-greedy
+            # equivalence is asserted, speed recorded
+            from veles_tpu.nn.speculative import generate_speculative
+            prng.seed_all(11)
+            draft = lm.build_workflow(epochs=6, minibatch_size=64,
+                                      n_blocks=1, dim=dim // 2,
+                                      n_train=256, n_valid=64)
+            draft.initialize(device=vt.Device_for(args.device))
+            draft.run()
+            spec_out, stats = generate_speculative(
+                wf, draft, prompt, args.n_new, gamma=4)   # warmup
+            assert spec_out == cached_out, "speculative parity broke"
+            (_, stats), t_spec = time_once(lambda: generate_speculative(
+                wf, draft, prompt, args.n_new, gamma=4))
+            row["spec_tok_s"] = round(args.n_new / t_spec, 1)
+            row["spec_vs_cached"] = round(t_cached / t_spec, 2)
+            row["spec_acceptance"] = round(stats["acceptance"], 3)
         results.append(row)
         print(json.dumps(row))
     # the gate: cached must win at the largest config

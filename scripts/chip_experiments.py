@@ -296,10 +296,16 @@ def sec_generation(bench, dev, n):
     rows = []
     for n_blocks, dim, n_new in ((2, 64, 96), (4, 256, 128)):
         prng.seed_all(7)
-        wf = lm.build_workflow(epochs=1, minibatch_size=64,
+        # the big config trains briefly so the speculative A/B below
+        # measures a REAL acceptance rate (draft agreement with random
+        # weights is meaningless); throughput itself is weight-blind
+        wf = lm.build_workflow(epochs=6 if n_blocks >= 4 else 1,
+                               minibatch_size=64,
                                n_blocks=n_blocks, dim=dim,
                                n_train=256, n_valid=64)
         wf.initialize(device=dev)
+        if n_blocks >= 4:
+            wf.run()
         prompt = list(lm.make_corpus(numpy.random.RandomState(3), 24))
         sampling.generate(wf, prompt, n_new, temperature=0)  # compile
         t0 = time.time()
@@ -313,6 +319,32 @@ def sec_generation(bench, dev, n):
         print("  gen %dx%d: %s tok/s" % (n_blocks, dim,
                                          rows[-1]["cached_tok_s"]),
               flush=True)
+        if n_blocks >= 4:
+            # speculative decoding on chip: tokens per TARGET dispatch
+            # is the whole point at tunnel latencies (one big-model
+            # dispatch per ~gamma tokens); parity asserted
+            from veles_tpu.nn.speculative import generate_speculative
+            prng.seed_all(11)
+            draft = lm.build_workflow(epochs=6, minibatch_size=64,
+                                      n_blocks=1, dim=dim // 4,
+                                      n_train=256, n_valid=64)
+            draft.initialize(device=dev)
+            draft.run()
+            spec, stats = generate_speculative(wf, draft, prompt,
+                                               n_new, gamma=4)
+            assert spec == out, "speculative parity broke on chip"
+            t0 = time.time()
+            for _ in range(reps):
+                _, stats = generate_speculative(wf, draft, prompt,
+                                                n_new, gamma=4)
+            dt = (time.time() - t0) / reps
+            rows.append({"n_blocks": n_blocks, "dim": dim,
+                         "n_new": n_new, "gamma": 4,
+                         "spec_tok_s": round(n_new / dt, 1),
+                         "acceptance": round(stats["acceptance"], 3)})
+            print("  spec %dx%d: %s tok/s acc=%s"
+                  % (n_blocks, dim, rows[-1]["spec_tok_s"],
+                     rows[-1]["acceptance"]), flush=True)
     return rows
 
 

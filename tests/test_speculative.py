@@ -1,0 +1,68 @@
+"""Speculative decoding (nn/speculative.py): greedy output must be
+IDENTICAL to the target model's own cached greedy decode, across
+acceptance regimes; stats sane; misuse rejected."""
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import prng
+from veles_tpu.error import VelesError
+from veles_tpu.nn.speculative import generate_speculative
+
+from conftest import import_model
+
+
+@pytest.fixture(scope="module")
+def lms():
+    lm = import_model("char_lm")
+    prng.seed_all(4321)
+    target = lm.build_workflow(epochs=3, minibatch_size=64, n_blocks=2,
+                               dim=32, n_train=512, n_valid=128)
+    target.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    target.run()
+    prng.seed_all(99)
+    draft = lm.build_workflow(epochs=2, minibatch_size=64, n_blocks=1,
+                              dim=16, n_train=512, n_valid=128)
+    draft.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    draft.run()
+    return lm, target, draft
+
+
+def test_speculative_matches_target_greedy(lms):
+    lm, target, draft = lms
+    rng = numpy.random.RandomState(5)
+    prompt = list(lm.make_corpus(rng, lm.SEQ_LEN // 2))
+    want = lm.generate(target, prompt, 24, temperature=0)
+    for gamma in (1, 3, 4):
+        got, stats = generate_speculative(target, draft, prompt, 24,
+                                          gamma=gamma)
+        assert got == want, (gamma, got, want)
+        assert stats["rounds"] >= 1
+        assert 0.0 <= stats["acceptance"] <= 1.0
+
+
+def test_speculative_self_draft_accepts_everything(lms):
+    """Draft == target: every draft token verifies, so rounds ~
+    n_new/gamma and acceptance == 1 — the accept plumbing's sharpest
+    self-check (output still exactly greedy)."""
+    lm, target, _ = lms
+    rng = numpy.random.RandomState(6)
+    prompt = list(lm.make_corpus(rng, lm.SEQ_LEN // 2))
+    want = lm.generate(target, prompt, 20, temperature=0)
+    got, stats = generate_speculative(target, target, prompt, 20,
+                                      gamma=4)
+    assert got == want
+    assert stats["acceptance"] == 1.0
+    assert stats["rounds"] <= (20 // 4) + 1, stats
+
+
+def test_speculative_rejects_batch(lms):
+    lm, target, draft = lms
+    with pytest.raises(VelesError, match="single-sequence"):
+        generate_speculative(target, draft, [[1, 2], [3, 4]], 8)
+
+
+def test_speculative_rejects_bad_gamma(lms):
+    lm, target, draft = lms
+    with pytest.raises(ValueError, match="gamma"):
+        generate_speculative(target, draft, [1, 2, 3], 8, gamma=0)

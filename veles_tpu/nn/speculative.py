@@ -1,0 +1,288 @@
+"""Speculative decoding — draft-model lookahead, target-model verify.
+
+Serving-path accelerator on top of the KV-cached sampler
+(nn/sampling.py): a small DRAFT model autoregressively proposes
+``gamma`` tokens (cheap single-row steps), then the TARGET model scores
+all of them in ONE cached multi-position forward — one big-model
+dispatch per ~``gamma`` tokens instead of per token. Greedy-exact: the
+emitted sequence is IDENTICAL to the target model's own greedy decode
+(accept-prefix rule; the first mismatch position emits the target's
+argmax instead), so speed never changes results. Beyond the reference
+(whose inference story was the libVeles chain executor; SURVEY.md §2.8
+names no autoregressive serving at all).
+
+Cache discipline: rejected positions leave stale K/V rows behind; every
+read masks strictly by the current position and every write overwrites
+from the accepted head, so stale rows are never observed. When ALL
+gamma draft tokens are accepted the round emits exactly those gamma
+tokens (no bonus token): the bonus's K/V would be missing from the
+draft cache and poison later reads — correctness over one extra token.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy
+
+from ..error import VelesError
+from .sampling import _block_step, split_stack
+from .transformer import block_ffn, block_norm
+
+
+def _rope_span(np_mod, x, pos0, base=10000.0):
+    """RoPE for CONSECUTIVE positions pos0..pos0+g-1: x (B, g, H, Dh),
+    pos0 traced scalar. Same half-split pairing as transformer._rope."""
+    g = x.shape[1]
+    hd = x.shape[-1]
+    half = hd // 2
+    inv = np_mod.asarray(
+        (base ** (-numpy.arange(half, dtype="float32") / half)))
+    pos = pos0.astype("float32") + np_mod.arange(g, dtype="float32")
+    ang = pos[:, None] * inv[None, :]              # (g, half)
+    cos = np_mod.cos(ang)[None, :, None, :]
+    sin = np_mod.sin(ang)[None, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:2 * half]
+    rot1 = x1 * cos - x2 * sin
+    rot2 = x1 * sin + x2 * cos
+    if 2 * half == hd:
+        return np_mod.concatenate([rot1, rot2], axis=-1)
+    return np_mod.concatenate([rot1, rot2, x[..., 2 * half:]], axis=-1)
+
+
+def _block_span(block, p, x, cache_k, cache_v, pos0):
+    """Multi-position incremental pass: x (B, g, D) are the tokens at
+    positions pos0..pos0+g-1 (traced pos0); K/V land in those cache
+    rows and attention reads the cache causally by GLOBAL position —
+    the g-wide generalization of sampling._block_step (g=1 reduces to
+    it)."""
+    import jax
+    import jax.numpy as jnp
+    from ..ops import matmul_precision
+    prec = matmul_precision()
+    b, g, d = x.shape
+    h = block.n_heads
+    kv = getattr(block, "n_kv_heads", h)
+    grp = h // kv
+    hd = d // h
+
+    a_in = block_norm(jnp, block, p, x, "ln1")
+    q = jnp.dot(a_in, p["wq"], precision=prec).reshape(b, g, h, hd)
+    k = jnp.dot(a_in, p["wk"], precision=prec).reshape(b, g, kv, hd)
+    v = jnp.dot(a_in, p["wv"], precision=prec).reshape(b, g, kv, hd)
+    if block.rope:
+        base = getattr(block, "rope_base", 10000.0)
+        q = _rope_span(jnp, q, pos0, base)
+        k = _rope_span(jnp, k, pos0, base)
+    cache_k = jax.lax.dynamic_update_slice(
+        jnp.asarray(cache_k), k, (0, pos0, 0, 0))
+    cache_v = jax.lax.dynamic_update_slice(
+        jnp.asarray(cache_v), v, (0, pos0, 0, 0))
+    t_max = cache_k.shape[1]
+    q5 = q.reshape(b, g, kv, grp, hd).astype(jnp.float32)
+    s = jnp.einsum("bqkgd,btkd->bkgqt", q5,
+                   cache_k.astype(jnp.float32)) / numpy.sqrt(hd)
+    # causal by global position: row j sees cache rows <= pos0 + j
+    t_idx = jnp.arange(t_max)[None, :]
+    q_idx = pos0 + jnp.arange(g)[:, None]
+    valid = t_idx <= q_idx                          # (g, t_max)
+    win = getattr(block, "window", None)
+    if win:
+        valid = valid & (t_idx > q_idx - win)
+    s = jnp.where(valid[None, None, None, :, :], s, -1e30)
+    w = jnp.exp(s - s.max(axis=-1, keepdims=True))
+    w = w / w.sum(axis=-1, keepdims=True)
+    o = jnp.einsum("bkgqt,btkd->bqkgd", w,
+                   cache_v.astype(jnp.float32)).astype(x.dtype)
+    o = o.reshape(b, g, d)
+    x = x + jnp.dot(o, p["wo"], precision=prec)
+    f_in = block_norm(jnp, block, p, x, "ln2")
+    return x + block_ffn(jnp, block, p, f_in, prec), cache_k, cache_v
+
+
+def _embed_at(stack, params, ids, pos0):
+    """Token+positional embedding at positions pos0..pos0+g-1."""
+    import jax.numpy as jnp
+    stem, pos_emb = stack["stem"], stack["pos_emb"]
+    x = jnp.take(params[stem.name]["table"], ids.astype(jnp.int32),
+                 axis=0, mode="clip")
+    if pos_emb is not None:
+        idx = pos0 + jnp.arange(ids.shape[-1])
+        x = x + jnp.take(params[pos_emb.name]["table"], idx, axis=0,
+                         mode="clip")[None]
+    return x
+
+
+def _head_logits(stack, params, x):
+    import jax.numpy as jnp
+    from ..ops import matmul_precision
+    head = stack["head"]
+    return (jnp.dot(x, params[head.name]["weights"],
+                    precision=matmul_precision())
+            + params[head.name]["bias"])
+
+
+def _prefill(stack, params, prompt_ids):
+    """Full-window prefill of one model's caches; returns (caches,
+    greedy next token)."""
+    import jax.numpy as jnp
+    from .sampling import _block_prefill
+    x = _embed_at(stack, params, prompt_ids, 0)
+    caches = []
+    d = stack["stem"].dim
+    b, t_p = prompt_ids.shape
+    for blk in stack["blocks"]:
+        bkv = getattr(blk, "n_kv_heads", blk.n_heads)
+        hd = d // blk.n_heads
+        ck = jnp.zeros((b, stack["t_max"], bkv, hd), x.dtype)
+        cv = jnp.zeros((b, stack["t_max"], bkv, hd), x.dtype)
+        x, ck, cv = _block_prefill(blk, params[blk.name], x, ck, cv)
+        caches.append((ck, cv))
+    tok = jnp.argmax(_head_logits(stack, params, x[:, -1]),
+                     axis=-1).astype(jnp.int32)
+    return tuple(caches), tok[0]
+
+
+def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma):
+    """Compile-once greedy speculative decoder for one (prompt length,
+    n_new, gamma) shape. Whole generation = ONE device program
+    (while_loop over rounds); params of BOTH models are arguments."""
+    import jax
+    import jax.numpy as jnp
+
+    tgt = split_stack(list(wf_target.forwards))
+    drf = split_stack(list(wf_draft.forwards))
+    t_max = t_p + int(n_new) + int(gamma) + 1
+    tgt["t_max"] = drf["t_max"] = t_max
+    for st, which in ((tgt, "target"), (drf, "draft")):
+        pe = st["pos_emb"]
+        if pe is not None and \
+                pe.param_arrays()["table"].shape[0] < t_max:
+            raise VelesError(
+                "%s PositionalEmbedding table (%d) is shorter than the "
+                "%d positions speculation can reach"
+                % (which, pe.param_arrays()["table"].shape[0], t_max))
+    n_buf = int(n_new) + int(gamma) + 1
+
+    def draft_propose(params_d, caches, tok, pos0):
+        """gamma single-row draft steps: returns proposed tokens (g,)
+        and the draft caches advanced over rows pos0..pos0+g-1."""
+        def step(carry, j):
+            tok, caches, = carry[0], carry[1]
+            x_t = _embed_at(drf, params_d, tok[None, None],
+                            pos0 + j)[:, :1]
+            new_caches = []
+            for blk, (ck, cv) in zip(drf["blocks"], caches):
+                x_t, ck, cv = _block_step(blk, params_d[blk.name], x_t,
+                                          ck, cv, pos0 + j)
+                new_caches.append((ck, cv))
+            nxt = jnp.argmax(_head_logits(drf, params_d, x_t[:, 0]),
+                             axis=-1).astype(jnp.int32)[0]
+            return (nxt, tuple(new_caches)), nxt
+
+        (_, caches), d_toks = jax.lax.scan(
+            step, (tok, caches), jnp.arange(gamma))
+        return d_toks, caches
+
+    def target_verify(params_t, caches, window_toks, pos0):
+        """One multi-position cached forward over the gamma window;
+        returns greedy argmax (g,) at each position and the advanced
+        caches."""
+        x = _embed_at(tgt, params_t, window_toks[None, :], pos0)
+        new_caches = []
+        for blk, (ck, cv) in zip(tgt["blocks"], caches):
+            x, ck, cv = _block_span(blk, params_t[blk.name], x, ck, cv,
+                                    pos0)
+            new_caches.append((ck, cv))
+        t_arg = jnp.argmax(_head_logits(tgt, params_t, x[0]),
+                           axis=-1).astype(jnp.int32)       # (g,)
+        return t_arg, tuple(new_caches)
+
+    @jax.jit
+    def run(params_t, params_d, prompt_ids):
+        caches_t, first = _prefill(tgt, params_t, prompt_ids)
+        caches_d, _ = _prefill(drf, params_d, prompt_ids)
+        buf = jnp.zeros((n_buf,), jnp.int32)
+        buf = buf.at[0].set(first)
+        ar = jnp.arange(gamma)
+
+        def cond(carry):
+            return carry[0] < n_new
+
+        def body(carry):
+            count, pos, tok, buf, caches_t, caches_d, rounds, acc = carry
+            d_toks, caches_d = draft_propose(params_d, caches_d, tok,
+                                             pos)
+            window = jnp.concatenate([tok[None], d_toks[:-1]])
+            t_arg, caches_t = target_verify(params_t, caches_t, window,
+                                            pos)
+            match = d_toks == t_arg                       # (g,)
+            # a = length of the accepted prefix of draft tokens
+            a = jnp.argmin(match) + gamma * match.all()
+            a = jnp.minimum(a, gamma)
+            # emitted tokens: d1..d_a then (a < gamma) the target's
+            # correction t_{a+1}; all-accepted rounds emit exactly the
+            # gamma draft tokens (no bonus — cache discipline, above)
+            out_vec = jnp.where(ar < a, d_toks,
+                                jnp.where(ar == a, t_arg, 0))
+            n_emit = jnp.minimum(a + 1, gamma)
+            new_tok = jnp.where(a < gamma, t_arg[jnp.minimum(a,
+                                                             gamma - 1)],
+                                d_toks[gamma - 1])
+            buf = jax.lax.dynamic_update_slice(buf, out_vec, (count,))
+            return (count + n_emit, pos + n_emit, new_tok, buf,
+                    caches_t, caches_d, rounds + 1, acc + a)
+
+        count0 = jnp.int32(1)          # `first` is already emitted
+        pos0 = jnp.int32(t_p)
+        carry = (count0, pos0, first, buf, caches_t, caches_d,
+                 jnp.int32(0), jnp.int32(0))
+        count, _, _, buf, _, _, rounds, acc = jax.lax.while_loop(
+            cond, body, carry)
+        return buf[:n_new], rounds, acc
+
+    return run
+
+
+def generate_speculative(wf_target, wf_draft, prompt, n_new,
+                         gamma: int = 4) -> Tuple[List[int],
+                                                  Dict[str, float]]:
+    """Greedy decode of ``n_new`` tokens with draft-model speculation.
+    Returns ``(tokens, stats)`` where tokens are IDENTICAL to
+    ``sampling.generate(wf_target, prompt, n_new, temperature=0)`` and
+    stats carries ``rounds`` and the mean ``acceptance`` per round.
+
+    Single-sequence only (accepted counts diverge per row; batched
+    speculation needs per-row positions — out of scope)."""
+    import jax.numpy as jnp
+    if int(gamma) < 1:
+        raise ValueError("gamma must be >= 1")
+    prompt = numpy.asarray(prompt, dtype=numpy.int32)
+    if prompt.ndim != 1:
+        raise VelesError("speculative decoding is single-sequence; "
+                         "got a batch")
+    t_p = len(prompt)
+    cache = getattr(wf_target, "_spec_cache", None)
+    if cache is None:
+        cache = wf_target._spec_cache = {}
+    # the DRAFT workflow rides in the cache value and is identity-
+    # compared: an id()-keyed entry would survive the draft's death and
+    # misfire on address reuse with a different architecture
+    key = (t_p, int(n_new), int(gamma))
+    entry = cache.get(key)
+    if entry is None or entry[0] is not wf_draft:
+        entry = cache[key] = (wf_draft, _build_spec_sampler(
+            wf_target, wf_draft, t_p, int(n_new), int(gamma)))
+    run = entry[1]
+
+    def params_of(wf):
+        return {f.name: {k: v.device_view()
+                         for k, v in f.param_arrays().items()}
+                for f in wf.forwards if f.PARAMETERIZED}
+
+    toks, rounds, acc = run(params_of(wf_target), params_of(wf_draft),
+                            jnp.asarray(prompt[None, :]))
+    rounds = max(int(rounds), 1)
+    return ([int(t) for t in numpy.asarray(toks)],
+            {"rounds": rounds,
+             "acceptance": float(acc) / (rounds * int(gamma))})

@@ -230,8 +230,8 @@ class TrainStep(AcceleratedUnit):
         import jax
         if flag != "force" and jax.default_backend() == "tpu" \
                 and str(root.common.engine.get(
-                    "compute_dtype", "bfloat16")) not in ("float32",
-                                                          "f32"):
+                    "compute_dtype", "bfloat16")) in ("bfloat16",
+                                                      "bf16"):
             return reject("TPU compute_dtype policy is bfloat16 — the "
                           "f32 kernel would not be trajectory-exact "
                           "vs the bf16-pass scan path (set "
