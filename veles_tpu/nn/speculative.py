@@ -124,7 +124,7 @@ def _head_logits(stack, params, x):
 
 def _prefill(stack, params, prompt_ids):
     """Full-window prefill of one model's caches; returns (caches,
-    greedy next token)."""
+    next-token logits after the prompt)."""
     import jax.numpy as jnp
     from .sampling import _block_prefill
     x = _embed_at(stack, params, prompt_ids, 0)
@@ -138,17 +138,53 @@ def _prefill(stack, params, prompt_ids):
         cv = jnp.zeros((b, stack["t_max"], bkv, hd), x.dtype)
         x, ck, cv = _block_prefill(blk, params[blk.name], x, ck, cv)
         caches.append((ck, cv))
-    tok = jnp.argmax(_head_logits(stack, params, x[:, -1]),
-                     axis=-1).astype(jnp.int32)
-    return tuple(caches), tok[0]
+    return tuple(caches), _head_logits(stack, params, x[:, -1])[0]
 
 
-def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma):
-    """Compile-once greedy speculative decoder for one (prompt length,
-    n_new, gamma) shape. Whole generation = ONE device program
-    (while_loop over rounds); params of BOTH models are arguments."""
+def _stochastic_accept(key, pt, pd, d_toks):
+    """Rejection-sampling accept rule (Leviathan et al.): token j is
+    kept with probability ``min(1, p_t/p_d)`` evaluated at the drafted
+    token; the first rejected position resamples from the residual
+    ``normalize(max(p_t − p_d, 0))``. Returns ``(a, fix)`` — accepted
+    prefix length and the replacement token for position ``a``. Pure
+    function of (key, pt (g, V), pd (g, V), d_toks (g,)) so the
+    distributional guarantee is Monte-Carlo-testable in isolation: the
+    marginal of the NEXT emitted token is exactly p_t."""
     import jax
     import jax.numpy as jnp
+    g = d_toks.shape[0]
+    ar = jnp.arange(g)
+    k_u, k_r = jax.random.split(key)
+    u = jax.random.uniform(k_u, (g,), jnp.float32)
+    p_t_d = pt[ar, d_toks]
+    p_d_d = pd[ar, d_toks]
+    # u < p_t/p_d, written multiplicatively: robust when p_d == 0
+    acc = u * p_d_d < p_t_d
+    a = jnp.minimum(jnp.argmin(acc) + g * acc.all(), g)
+    row = jnp.minimum(a, g - 1)
+    resid = jnp.maximum(pt[row] - pd[row], 0.0)
+    # p_t == p_d pointwise leaves an empty residual, but then the
+    # accept test never fails at that row with probability 1; the
+    # fallback keeps the (measure-zero) branch well-defined
+    resid = jnp.where(resid.sum() > 0, resid, pt[row])
+    fix = jax.random.categorical(
+        k_r, jnp.log(jnp.maximum(resid, 1e-30))).astype(jnp.int32)
+    return a, fix
+
+
+def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma,
+                        temperature=0.0):
+    """Compile-once speculative decoder for one (prompt length, n_new,
+    gamma, temperature) shape. Whole generation = ONE device program
+    (while_loop over rounds); params of BOTH models are arguments.
+    ``temperature <= 0``: greedy, output bit-identical to the target's
+    own greedy decode. ``temperature > 0``: rejection-sampling
+    speculation — every emitted token is marginally distributed as the
+    target's softmax at that temperature (_stochastic_accept)."""
+    import jax
+    import jax.numpy as jnp
+    greedy = temperature <= 0
+    tau = float(temperature) if not greedy else 1.0
 
     tgt = split_stack(list(wf_target.forwards))
     drf = split_stack(list(wf_draft.forwards))
@@ -164,11 +200,12 @@ def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma):
                 % (which, pe.param_arrays()["table"].shape[0], t_max))
     n_buf = int(n_new) + int(gamma) + 1
 
-    def draft_propose(params_d, caches, tok, pos0):
-        """gamma single-row draft steps: returns proposed tokens (g,)
-        and the draft caches advanced over rows pos0..pos0+g-1."""
+    def draft_propose(params_d, caches, tok, pos0, key):
+        """gamma single-row draft steps: returns proposed tokens (g,),
+        the draft's softmax rows (g, V) (stochastic mode), and the
+        draft caches advanced over rows pos0..pos0+g-1."""
         def step(carry, j):
-            tok, caches, = carry[0], carry[1]
+            tok, caches = carry[0], carry[1]
             x_t = _embed_at(drf, params_d, tok[None, None],
                             pos0 + j)[:, :1]
             new_caches = []
@@ -176,17 +213,24 @@ def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma):
                 x_t, ck, cv = _block_step(blk, params_d[blk.name], x_t,
                                           ck, cv, pos0 + j)
                 new_caches.append((ck, cv))
-            nxt = jnp.argmax(_head_logits(drf, params_d, x_t[:, 0]),
-                             axis=-1).astype(jnp.int32)[0]
-            return (nxt, tuple(new_caches)), nxt
+            logits = _head_logits(drf, params_d, x_t[:, 0])[0] / tau
+            if greedy:
+                nxt = jnp.argmax(logits).astype(jnp.int32)
+                probs = jnp.zeros_like(logits)
+            else:
+                nxt = jax.random.categorical(
+                    jax.random.fold_in(key, j), logits).astype(
+                        jnp.int32)
+                probs = jax.nn.softmax(logits)
+            return (nxt, tuple(new_caches)), (nxt, probs)
 
-        (_, caches), d_toks = jax.lax.scan(
+        (_, caches), (d_toks, pd) = jax.lax.scan(
             step, (tok, caches), jnp.arange(gamma))
-        return d_toks, caches
+        return d_toks, pd, caches
 
     def target_verify(params_t, caches, window_toks, pos0):
         """One multi-position cached forward over the gamma window;
-        returns greedy argmax (g,) at each position and the advanced
+        returns per-position logits/tau (g, V) and the advanced
         caches."""
         x = _embed_at(tgt, params_t, window_toks[None, :], pos0)
         new_caches = []
@@ -194,14 +238,18 @@ def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma):
             x, ck, cv = _block_span(blk, params_t[blk.name], x, ck, cv,
                                     pos0)
             new_caches.append((ck, cv))
-        t_arg = jnp.argmax(_head_logits(tgt, params_t, x[0]),
-                           axis=-1).astype(jnp.int32)       # (g,)
-        return t_arg, tuple(new_caches)
+        return _head_logits(tgt, params_t, x[0]) / tau, tuple(new_caches)
 
     @jax.jit
-    def run(params_t, params_d, prompt_ids):
-        caches_t, first = _prefill(tgt, params_t, prompt_ids)
+    def run(params_t, params_d, prompt_ids, key):
+        caches_t, first_logits = _prefill(tgt, params_t, prompt_ids)
         caches_d, _ = _prefill(drf, params_d, prompt_ids)
+        key, sub = jax.random.split(key)
+        if greedy:
+            first = jnp.argmax(first_logits).astype(jnp.int32)
+        else:
+            first = jax.random.categorical(
+                sub, first_logits / tau).astype(jnp.int32)
         buf = jnp.zeros((n_buf,), jnp.int32)
         buf = buf.at[0].set(first)
         ar = jnp.arange(gamma)
@@ -210,34 +258,40 @@ def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma):
             return carry[0] < n_new
 
         def body(carry):
-            count, pos, tok, buf, caches_t, caches_d, rounds, acc = carry
-            d_toks, caches_d = draft_propose(params_d, caches_d, tok,
-                                             pos)
+            (count, pos, tok, buf, caches_t, caches_d, rounds, acc,
+             key) = carry
+            key, k_d, k_a = jax.random.split(key, 3)
+            d_toks, pd, caches_d = draft_propose(params_d, caches_d,
+                                                 tok, pos, k_d)
             window = jnp.concatenate([tok[None], d_toks[:-1]])
-            t_arg, caches_t = target_verify(params_t, caches_t, window,
-                                            pos)
-            match = d_toks == t_arg                       # (g,)
-            # a = length of the accepted prefix of draft tokens
-            a = jnp.argmin(match) + gamma * match.all()
-            a = jnp.minimum(a, gamma)
-            # emitted tokens: d1..d_a then (a < gamma) the target's
-            # correction t_{a+1}; all-accepted rounds emit exactly the
-            # gamma draft tokens (no bonus — cache discipline, above)
+            t_logits, caches_t = target_verify(params_t, caches_t,
+                                               window, pos)
+            if greedy:
+                t_arg = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+                match = d_toks == t_arg                   # (g,)
+                # a = length of the accepted prefix of draft tokens
+                a = jnp.minimum(
+                    jnp.argmin(match) + gamma * match.all(), gamma)
+                fix = t_arg[jnp.minimum(a, gamma - 1)]
+            else:
+                a, fix = _stochastic_accept(
+                    k_a, jax.nn.softmax(t_logits, axis=-1), pd, d_toks)
+            # emitted tokens: d1..d_a then (a < gamma) the correction/
+            # resample; all-accepted rounds emit exactly the gamma
+            # draft tokens (no bonus — cache discipline, above)
             out_vec = jnp.where(ar < a, d_toks,
-                                jnp.where(ar == a, t_arg, 0))
+                                jnp.where(ar == a, fix, 0))
             n_emit = jnp.minimum(a + 1, gamma)
-            new_tok = jnp.where(a < gamma, t_arg[jnp.minimum(a,
-                                                             gamma - 1)],
-                                d_toks[gamma - 1])
+            new_tok = jnp.where(a < gamma, fix, d_toks[gamma - 1])
             buf = jax.lax.dynamic_update_slice(buf, out_vec, (count,))
             return (count + n_emit, pos + n_emit, new_tok, buf,
-                    caches_t, caches_d, rounds + 1, acc + a)
+                    caches_t, caches_d, rounds + 1, acc + a, key)
 
         count0 = jnp.int32(1)          # `first` is already emitted
         pos0 = jnp.int32(t_p)
         carry = (count0, pos0, first, buf, caches_t, caches_d,
-                 jnp.int32(0), jnp.int32(0))
-        count, _, _, buf, _, _, rounds, acc = jax.lax.while_loop(
+                 jnp.int32(0), jnp.int32(0), key)
+        count, _, _, buf, _, _, rounds, acc, _ = jax.lax.while_loop(
             cond, body, carry)
         return buf[:n_new], rounds, acc
 
@@ -245,15 +299,23 @@ def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma):
 
 
 def generate_speculative(wf_target, wf_draft, prompt, n_new,
-                         gamma: int = 4) -> Tuple[List[int],
-                                                  Dict[str, float]]:
-    """Greedy decode of ``n_new`` tokens with draft-model speculation.
-    Returns ``(tokens, stats)`` where tokens are IDENTICAL to
-    ``sampling.generate(wf_target, prompt, n_new, temperature=0)`` and
-    stats carries ``rounds`` and the mean ``acceptance`` per round.
+                         gamma: int = 4, temperature: float = 0.0,
+                         seed: int = 0) -> Tuple[List[int],
+                                                 Dict[str, float]]:
+    """Decode ``n_new`` tokens with draft-model speculation. Returns
+    ``(tokens, stats)``; stats carries ``rounds`` and the mean
+    ``acceptance`` per round.
+
+    ``temperature <= 0``: greedy — tokens IDENTICAL to
+    ``sampling.generate(wf_target, prompt, n_new, temperature=0)``.
+    ``temperature > 0``: rejection-sampling speculation — every token
+    is marginally distributed exactly as the target's softmax sample
+    at that temperature (``_stochastic_accept``), regardless of draft
+    quality (a bad draft only costs speed).
 
     Single-sequence only (accepted counts diverge per row; batched
     speculation needs per-row positions — out of scope)."""
+    import jax
     import jax.numpy as jnp
     if int(gamma) < 1:
         raise ValueError("gamma must be >= 1")
@@ -268,11 +330,12 @@ def generate_speculative(wf_target, wf_draft, prompt, n_new,
     # the DRAFT workflow rides in the cache value and is identity-
     # compared: an id()-keyed entry would survive the draft's death and
     # misfire on address reuse with a different architecture
-    key = (t_p, int(n_new), int(gamma))
+    key = (t_p, int(n_new), int(gamma), float(temperature))
     entry = cache.get(key)
     if entry is None or entry[0] is not wf_draft:
         entry = cache[key] = (wf_draft, _build_spec_sampler(
-            wf_target, wf_draft, t_p, int(n_new), int(gamma)))
+            wf_target, wf_draft, t_p, int(n_new), int(gamma),
+            float(temperature)))
     run = entry[1]
 
     def params_of(wf):
@@ -281,7 +344,8 @@ def generate_speculative(wf_target, wf_draft, prompt, n_new,
                 for f in wf.forwards if f.PARAMETERIZED}
 
     toks, rounds, acc = run(params_of(wf_target), params_of(wf_draft),
-                            jnp.asarray(prompt[None, :]))
+                            jnp.asarray(prompt[None, :]),
+                            jax.random.PRNGKey(seed))
     rounds = max(int(rounds), 1)
     return ([int(t) for t in numpy.asarray(toks)],
             {"rounds": rounds,
