@@ -240,10 +240,11 @@ class TrainStep(AcceleratedUnit):
 
         from .all2all import All2AllSoftmax, All2AllTanh
         fs = [f for f in self.forwards if f.PARAMETERIZED]
-        if (len(self.forwards) != 2 or len(fs) != 2
-                or type(fs[0]) is not All2AllTanh
-                or type(fs[1]) is not All2AllSoftmax):
-            return reject("needs exactly [all2all_tanh, softmax]")
+        if (len(self.forwards) != len(fs) or len(fs) < 2
+                or any(type(f) is not All2AllTanh for f in fs[:-1])
+                or type(fs[-1]) is not All2AllSoftmax):
+            return reject("needs an [all2all_tanh ... all2all_tanh, "
+                          "softmax] chain")
         if not isinstance(self.evaluator, EvaluatorSoftmax) \
                 or getattr(self.evaluator, "label_smoothing", 0.0) \
                 or getattr(self.evaluator, "compute_confusion", False):
@@ -259,7 +260,7 @@ class TrainStep(AcceleratedUnit):
                           "whole update; no psum inside)")
         if self.param_masks:
             return reject("sparsity masks not fused")
-        lrs = set()
+        knobs = set()
         for f in fs:
             if set(self.params[f.name]) != {"weights", "bias"}:
                 return reject("%s params beyond weights+bias (LoRA?)"
@@ -268,29 +269,60 @@ class TrainStep(AcceleratedUnit):
                 return reject("%s is frozen (freeze_base) — the "
                               "kernel updates unconditionally" % f.name)
             gd = self._gd_for[f.name]
-            if gd.solver != "sgd" or gd.momentum or gd.weight_decay \
-                    or gd.weight_decay_bias or gd.gradient_clip \
+            if gd.solver != "sgd" or gd.gradient_clip \
                     or gd.gradient_clip_norm:
-                return reject("%s: fused path is plain SGD only"
+                return reject("%s: fused path is Znicz SGD only "
+                              "(momentum/decay ok; no clipping)"
                               % f.name)
-            lrs.update({float(gd.learning_rate),
-                        float(gd.learning_rate_bias)})
-        if len(lrs) != 1:
-            return reject("per-layer/bias learning rates differ")
+            knobs.add((float(gd.learning_rate),
+                       float(gd.learning_rate_bias),
+                       float(gd.weight_decay),
+                       float(gd.weight_decay_bias),
+                       float(gd.momentum)))
+        if len(knobs) != 1:
+            return reject("per-layer SGD knobs differ (uniform "
+                          "lr/decay/momentum required)")
+        lr, lr_bias, wd, wd_bias, momentum = knobs.pop()
+        if lr <= 0:
+            return reject("non-positive learning rate")
         if getattr(self.loader, "device_augment_fn", None) is not None:
             return reject("device-side augmentation not fused")
         if self.target_mode != "labels":
             return reject("labels targets only")
+        # VMEM budget: the kernel holds weights + biases + the delta
+        # recurrence (×2) plus a minibatch block resident; an oversized
+        # chain must FALL BACK, not die in an opaque Mosaic allocation
+        # error inside the jitted epoch block
+        def padded(n, m=128):
+            return ((n + m - 1) // m) * m
+
+        state_bytes = 0
+        mb = self.loader.max_minibatch_size
+        for f in fs:
+            w = self.params[f.name]["weights"]
+            state_bytes += 2 * 4 * (padded(w.shape[0])
+                                    * padded(w.shape[1])
+                                    + 8 * padded(w.shape[1]))
+        x_bytes = 4 * padded(mb, 8) * padded(
+            int(numpy.prod(self.params[fs[0].name]["weights"]
+                           .shape[:1])))
+        budget = 12 * 2 ** 20          # leave headroom in ~16 MiB VMEM
+        if state_bytes + 3 * x_bytes > budget:
+            return reject("VMEM budget: ~%.1f MiB state + batch "
+                          "exceeds the %.0f MiB kernel budget"
+                          % ((state_bytes + 3 * x_bytes) / 2 ** 20,
+                             budget / 2 ** 20))
         ds = self.loader.original_data
         if ds is None or ds.mem.ndim != 2:
             return reject("flat (N, features) dataset only")
         self._fused_fc = {
-            "lr": lrs.pop(),
+            "lr": lr, "lr_bias_ratio": lr_bias / lr,
+            "wd": wd, "wd_bias": wd_bias, "momentum": momentum,
             "act_a": float(fs[0].A), "act_b": float(fs[0].B),
-            "names": (fs[0].name, fs[1].name),
+            "names": tuple(f.name for f in fs),
         }
         self.info("fused_fc_scan engaged: whole-epoch Pallas SGD "
-                  "kernel (%s → %s)", fs[0].name, fs[1].name)
+                  "kernel (%s)", " → ".join(f.name for f in fs))
 
     def _setup_pipeline(self) -> None:
         """{"pipeline": N} mesh axis: stage-group the forward chain and
@@ -881,23 +913,29 @@ class TrainStep(AcceleratedUnit):
                 outs[cls] = acc
             if getattr(self, "_fused_fc_active", False):
                 # whole-epoch Pallas SGD kernel (ops/fused_fc.py):
-                # weights stay VMEM-resident for all K steps. Plain-SGD
-                # momentum state is inert (eligibility enforces
-                # momentum == 0), so opt_state passes through.
+                # weights AND the SGD delta recurrence stay VMEM-
+                # resident for all K steps; both are returned so
+                # opt_state continues the identical trajectory.
                 import jax.numpy as jnp
                 from ..ops.fused_fc import fused_fc_sgd_epoch
                 ff = self._fused_fc
-                n1, n2 = ff["names"]
+                names = ff["names"]
                 plan = per_epoch["c%d_idx" % TRAIN]
-                w1, b1, w2, b2, loss_sum, err = fused_fc_sgd_epoch(
-                    p[n1]["weights"], p[n1]["bias"],
-                    p[n2]["weights"], p[n2]["bias"],
+                ws, bs, vws, vbs, loss_sum, err = fused_fc_sgd_epoch(
+                    [p[n]["weights"] for n in names],
+                    [p[n]["bias"] for n in names],
+                    [o[n]["weights"] for n in names],
+                    [o[n]["bias"] for n in names],
                     dataset, labels, plan,
                     per_epoch["lr"] * ff["lr"],
-                    act_a=ff["act_a"], act_b=ff["act_b"])
-                p = dict(p)
-                p[n1] = {"weights": w1, "bias": b1}
-                p[n2] = {"weights": w2, "bias": b2}
+                    act_a=ff["act_a"], act_b=ff["act_b"],
+                    lr_bias_ratio=ff["lr_bias_ratio"],
+                    wd=ff["wd"], wd_bias=ff["wd_bias"],
+                    momentum=ff["momentum"])
+                p, o = dict(p), dict(o)
+                for i2, n2 in enumerate(names):
+                    p[n2] = {"weights": ws[i2], "bias": bs[i2]}
+                    o[n2] = {"weights": vws[i2], "bias": vbs[i2]}
                 n = jnp.float32(plan.shape[0] * plan.shape[1])
                 outs[TRAIN] = {"n_samples": n, "sum_loss": loss_sum,
                                "n_err": err}

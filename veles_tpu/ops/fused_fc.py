@@ -6,24 +6,30 @@ SGD-bound, not FLOP-bound: `docs/perf.md` measures the per-step cost at
 ~36 µs — the TPU `lax.scan` step floor for these shapes, dominated by
 per-step weight round trips through HBM and loop overhead, with the MXU
 under 1 % busy. This kernel runs an ENTIRE epoch of SGD steps as ONE
-Pallas grid with the weights resident in VMEM scratch for all K steps:
-no HBM weight traffic between steps, no scan-step machinery — the only
-per-step HBM reads are the minibatch block (pipelined by Mosaic's
-double buffering) while forward, backward and update run back-to-back
-on the same core-resident parameters.
+Pallas grid with the weights (and momentum state) resident in VMEM
+scratch for all K steps: no HBM weight traffic between steps, no
+scan-step machinery — the only per-step HBM reads are the minibatch
+block (pipelined by Mosaic's double buffering) while forward, backward
+and update run back-to-back on the same core-resident parameters.
 
-Scope (checked by ``fused_fc_eligible``): exactly two dense layers
-(tanh hidden, softmax + cross-entropy head), plain SGD, whole
-minibatches. The TPU-first point is the *shape* of the solution — the
-reference could never fuse its per-unit OpenCL dispatch chain
-(`veles/znicz/all2all.py` + `gd.py` kernels) into one residency-
-preserving program; on TPU one kernel IS the epoch.
+Scope (checked by ``TrainStep._setup_fused_fc``): a chain of dense
+tanh layers ending in a softmax + cross-entropy head, Znicz SGD with
+momentum and coupled L2 weight decay, whole minibatches. The TPU-first
+point is the *shape* of the solution — the reference could never fuse
+its per-unit OpenCL dispatch chain (`veles/znicz/all2all.py` +
+`gd.py` kernels) into one residency-preserving program; on TPU one
+kernel IS the epoch.
+
+Update rule, exactly the general path's (nn_units.py GradientDescent):
+``delta = lr·(g + wd·p) + mu·delta_prev; p -= delta`` — the delta
+recurrence (with lr folded in, like the scan path's opt_state) rides
+in VMEM and is returned, so resuming or switching engines mid-training
+continues the identical trajectory.
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Optional
+from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -43,31 +49,51 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, pads)
 
 
-def _kernel(lr_ref, x_ref, y_ref, w1_ref, b1_ref, w2_ref, b2_ref,
-            w1o_ref, b1o_ref, w2o_ref, b2o_ref, acc_ref,
-            w1_s, b1_s, w2_s, b2_s, acc_s, *,
-            mb: int, nout: int, steps: int,
-            act_a: float = 1.0, act_b: float = 1.0):
-    """One grid step = one SGD minibatch step, weights in VMEM scratch.
+def _kernel(refs, *, n_layers: int, mb: int, nout: int, steps: int,
+            act_a: float, act_b: float, lr_bias_ratio: float,
+            wd: float, wd_bias: float, momentum: float):
+    """One grid step = one SGD minibatch step, all state in VMEM.
 
-    acc layout: [0, 0] = summed CE loss, [0, 1] = error count — both
-    over the REAL (unpadded) rows of the epoch.
+    refs layout (built by fused_fc_sgd_epoch):
+      [lr, x, y,
+       w_0..w_{L-1}, b_0.., vw_0.., vb_0..,          (inputs)
+       wo_0.., bo_0.., vwo_0.., vbo_0.., acc,        (outputs)
+       ws_0.., bs_0.., vws_0.., vbs_0.., acc_s]      (scratch)
+    acc[0, 0] = summed CE loss, acc[0, 1] = error count — over the
+    REAL (unpadded) rows of the epoch.
     """
     from jax.experimental import pallas as pl
+
+    L = n_layers
+    it = iter(refs)
+    lr_ref, x_ref, y_ref = next(it), next(it), next(it)
+    w_in = [next(it) for _ in range(L)]
+    b_in = [next(it) for _ in range(L)]
+    vw_in = [next(it) for _ in range(L)]
+    vb_in = [next(it) for _ in range(L)]
+    w_out = [next(it) for _ in range(L)]
+    b_out = [next(it) for _ in range(L)]
+    vw_out = [next(it) for _ in range(L)]
+    vb_out = [next(it) for _ in range(L)]
+    acc_ref = next(it)
+    w_s = [next(it) for _ in range(L)]
+    b_s = [next(it) for _ in range(L)]
+    vw_s = [next(it) for _ in range(L)]
+    vb_s = [next(it) for _ in range(L)]
+    acc_s = next(it)
 
     i = pl.program_id(0)
 
     @pl.when(i == 0)
     def _load():
-        w1_s[:] = w1_ref[:]
-        b1_s[:] = b1_ref[:]
-        w2_s[:] = w2_ref[:]
-        b2_s[:] = b2_ref[:]
+        for dst, src in zip(w_s + b_s + vw_s + vb_s,
+                            w_in + b_in + vw_in + vb_in):
+            dst[:] = src[:]
         acc_s[:] = jnp.zeros_like(acc_s)
 
     x = x_ref[0]                       # (mb_p, fin_p) f32
     y = y_ref[0]                       # (mb_p, nout_p) one-hot, pad=0
-    mb_p, _ = x.shape
+    mb_p = x.shape[0]
     nout_p = y.shape[1]
     lr = lr_ref[0, 0]
 
@@ -79,15 +105,22 @@ def _kernel(lr_ref, x_ref, y_ref, w1_ref, b1_ref, w2_ref, b2_ref,
     lane = jax.lax.broadcasted_iota(jnp.int32, (mb_p, nout_p), 1)
     lane_bias = jnp.where(lane < nout, 0.0, NEG)
 
-    h_pre = jax.lax.dot_general(
-        x, w1_s[:], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) + b1_s[:1, :]
-    # Znicz LeCun-scaled tanh: y = A*tanh(B*a) (all2all.py A, B);
-    # A = B = 1 degrades to the plain tanh
-    h = act_a * jnp.tanh(act_b * h_pre)                    # (mb_p, hid_p)
-    logits = jax.lax.dot_general(
-        h, w2_s[:], (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32) + b2_s[:1, :] + lane_bias
+    def dot(a, bmat):
+        return jax.lax.dot_general(
+            a, bmat, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    # forward: tanh chain, logits head; acts[li] is layer li's INPUT
+    # (so acts[li] for li >= 1 is also layer li-1's tanh output — the
+    # backward reads both roles from the one list)
+    acts = [x]
+    h = x
+    for li in range(L - 1):
+        pre = dot(h, w_s[li][:]) + b_s[li][:1, :]
+        # Znicz LeCun-scaled tanh: y = A*tanh(B*a) (all2all.py A, B)
+        h = act_a * jnp.tanh(act_b * pre)
+        acts.append(h)
+    logits = dot(h, w_s[L - 1][:]) + b_s[L - 1][:1, :] + lane_bias
 
     m = logits.max(axis=1, keepdims=True)
     e = jnp.exp(logits - m)
@@ -96,9 +129,8 @@ def _kernel(lr_ref, x_ref, y_ref, w1_ref, b1_ref, w2_ref, b2_ref,
     logp = logits - m - jnp.log(s)
 
     # metrics over real rows (y is all-zero on pad rows already).
-    # Error rule must MATCH EvaluatorSoftmax exactly: strict argmax
-    # with ties resolved to the LOWEST class index (jnp.argmax) — a
-    # probability-tolerance rule would disagree on tied logits.
+    # Error rule MATCHES EvaluatorSoftmax: strict argmax, ties to the
+    # LOWEST class index (jnp.argmax).
     loss = -(y * logp).sum()
     is_max = logits >= logits.max(axis=1, keepdims=True)
     big = jnp.int32(nout_p)
@@ -113,162 +145,220 @@ def _kernel(lr_ref, x_ref, y_ref, w1_ref, b1_ref, w2_ref, b2_ref,
         (r0 == 0) & (c0 == 0), loss,
         jnp.where((r0 == 0) & (c0 == 1), err, 0.0))
 
-    # backward (mean CE over the real minibatch) + in-place SGD
-    dlog = (p - y) * rmask / mb                            # (mb_p, nout_p)
-    dw2 = jax.lax.dot_general(
-        h, dlog, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                # (hid_p, nout_p)
-    db2 = dlog.sum(axis=0, keepdims=True)
-    dh = jax.lax.dot_general(
-        dlog, w2_s[:], (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)                # (mb_p, hid_p)
-    # dh/da of A*tanh(B*a) expressed in h: A*B - (B/A)*h^2
-    dpre = dh * (act_a * act_b - (act_b / act_a) * h * h)
-    dw1 = jax.lax.dot_general(
-        x, dpre, (((0,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32)                # (fin_p, hid_p)
-    db1 = dpre.sum(axis=0, keepdims=True)
+    # backward (mean CE over the real minibatch), then the Znicz SGD
+    # delta recurrence, all in-place on the VMEM state
+    d_out = (p - y) * rmask / mb                  # d loss / d logits
 
-    w1_s[:] = w1_s[:] - lr * dw1
-    w2_s[:] = w2_s[:] - lr * dw2
-    b1_s[:] = b1_s[:] - lr * jnp.broadcast_to(db1, b1_s.shape)
-    b2_s[:] = b2_s[:] - lr * jnp.broadcast_to(db2, b2_s.shape)
+    def tdot(a, bmat, contract_rows):
+        # contract_rows: a^T @ b (rows) vs a @ b^T (cols)
+        dims = (((0,), (0,)), ((), ())) if contract_rows \
+            else (((1,), (1,)), ((), ()))
+        return jax.lax.dot_general(a, bmat, dims,
+                                   preferred_element_type=jnp.float32)
+
+    for li in range(L - 1, -1, -1):
+        a_in = acts[li]
+        dw = tdot(a_in, d_out, True)              # (in_p, out_p)
+        db = d_out.sum(axis=0, keepdims=True)
+        if li > 0:
+            d_h = tdot(d_out, w_s[li][:], False)  # (mb_p, in_p)
+            hh = acts[li]                         # layer li-1's tanh out
+            # dh/da of A*tanh(B*a) expressed in h: A*B - (B/A)*h^2
+            d_out = d_h * (act_a * act_b - (act_b / act_a) * hh * hh)
+        dlt_w = lr * (dw + wd * w_s[li][:]) + momentum * vw_s[li][:]
+        dlt_b = (lr * lr_bias_ratio
+                 * (jnp.broadcast_to(db, b_s[li].shape)
+                    + wd_bias * b_s[li][:])
+                 + momentum * vb_s[li][:])
+        w_s[li][:] = w_s[li][:] - dlt_w
+        b_s[li][:] = b_s[li][:] - dlt_b
+        vw_s[li][:] = dlt_w
+        vb_s[li][:] = dlt_b
 
     @pl.when(i == steps - 1)
     def _store():
-        w1o_ref[:] = w1_s[:]
-        b1o_ref[:] = b1_s[:]
-        w2o_ref[:] = w2_s[:]
-        b2o_ref[:] = b2_s[:]
+        for dst, src in zip(w_out + b_out + vw_out + vb_out,
+                            w_s + b_s + vw_s + vb_s):
+            dst[:] = src[:]
         acc_ref[:] = acc_s[:]
 
 
-def fused_fc_sgd_epoch(w1, b1, w2, b2, dataset, labels, plan, lr,
+def fused_fc_sgd_epoch(weights: Sequence, biases: Sequence,
+                       vel_w: Sequence, vel_b: Sequence,
+                       dataset, labels, plan, lr,
                        n_classes: Optional[int] = None,
                        act_a: float = 1.0, act_b: float = 1.0,
+                       lr_bias_ratio: float = 1.0,
+                       wd: float = 0.0, wd_bias: float = 0.0,
+                       momentum: float = 0.0,
                        interpret: Optional[bool] = None):
-    """One SGD epoch of ``x→tanh(x·W1+b1)→softmax(h·W2+b2)`` with CE
-    loss, executed as a single Pallas program with VMEM-resident
-    weights.
+    """One SGD epoch of an L-layer tanh chain + softmax-CE head as a
+    single Pallas program with VMEM-resident weights AND momentum
+    state.
 
-    - w1 (fin, hid), b1 (hid,), w2 (hid, nout), b2 (nout,) — f32
-    - dataset (N, fin) f32, labels (N,) int32
+    - weights[i] (d_i, d_{i+1}), biases[i] (d_{i+1},) — f32
+    - vel_w/vel_b: the delta recurrence state (same shapes; the scan
+      path's SGD opt_state). Pass zeros for a fresh run.
+    - dataset (N, d_0) f32, labels (N,) int32
     - plan (K, mb) int32: the epoch's shuffled minibatch indices (same
-      contract as TrainStep's plan serving — trajectory parity with the
-      per-step path needs the same plan)
-    - lr: scalar learning rate
+      contract as TrainStep's plan serving)
+    - lr: scalar learning rate for weights (traced OK — per-epoch
+      schedules); the bias lr is ``lr * lr_bias_ratio`` (static
+      ratio, so schedules scale both together like the scan path)
 
-    Returns ``(w1', b1', w2', b2', loss_sum, err_count)`` — loss summed
-    and errors counted over the whole epoch (the caller derives means).
+    Returns ``(weights', biases', vel_w', vel_b', loss_sum,
+    err_count)``.
+
+    Note: the epoch-sized gather+pad below costs ~2× the minibatch-
+    stream HBM traffic (~224 MB ≈ 0.6 ms/epoch at HBM speed for the
+    MNIST headline vs a ~20 ms epoch) — the contiguous input stream it
+    buys Mosaic's pipeline is worth far more than a scalar-prefetch
+    redesign.
     """
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    L = len(weights)
+    assert len(biases) == len(vel_w) == len(vel_b) == L and L >= 1
     k_steps, mb = plan.shape
-    fin, hid = w1.shape
-    nout = w2.shape[1] if n_classes is None else int(n_classes)
+    nout = weights[-1].shape[1] if n_classes is None else int(n_classes)
 
     f32 = jnp.float32
-    # epoch-sized gather+pad: ~2× the minibatch-stream HBM traffic and
-    # a (K, mb_p, fin_p) intermediate. Measured against the headline:
-    # ~224 MB write + re-read per epoch ≈ 0.6 ms at HBM speed vs a
-    # ~20 ms epoch — the contiguous input stream it buys Mosaic's
-    # pipeline is worth far more than a scalar-prefetch redesign
-    xg = dataset.astype(f32)[plan]                  # (K, mb, fin)
+    xg = dataset.astype(f32)[plan]                  # (K, mb, d0)
     yg = jax.nn.one_hot(labels[plan], nout, dtype=f32)
-    xg = _pad_to(_pad_to(xg, 1, SUB), 2, LANE)      # (K, mb_p, fin_p)
+    xg = _pad_to(_pad_to(xg, 1, SUB), 2, LANE)      # (K, mb_p, d0_p)
     yg = _pad_to(_pad_to(yg, 1, SUB), 2, LANE)
     mb_p, fin_p = xg.shape[1], xg.shape[2]
     nout_p = yg.shape[2]
 
-    w1p = _pad_to(_pad_to(w1.astype(f32), 0, LANE), 1, LANE)
-    w2p = _pad_to(_pad_to(w2.astype(f32), 0, LANE), 1, LANE)
-    hid_p = w1p.shape[1]
-    b1p = jnp.broadcast_to(_pad_to(b1.astype(f32)[None, :], 1, LANE),
-                           (SUB, hid_p))
-    b2p = jnp.broadcast_to(_pad_to(b2.astype(f32)[None, :], 1, LANE),
-                           (SUB, nout_p))
+    wp = [_pad_to(_pad_to(w.astype(f32), 0, LANE), 1, LANE)
+          for w in weights]
+    vwp = [_pad_to(_pad_to(v.astype(f32), 0, LANE), 1, LANE)
+           for v in vel_w]
+    bp, vbp = [], []
+    for b, v in zip(biases, vel_b):
+        row = _pad_to(b.astype(f32)[None, :], 1, LANE)
+        bp.append(jnp.broadcast_to(row, (SUB, row.shape[1])))
+        vrow = _pad_to(v.astype(f32)[None, :], 1, LANE)
+        vbp.append(jnp.broadcast_to(vrow, (SUB, vrow.shape[1])))
     lr2 = jnp.full((1, 1), lr, f32)
 
-    kernel = functools.partial(_kernel, mb=mb, nout=nout,
-                               steps=k_steps, act_a=float(act_a),
-                               act_b=float(act_b))
+    def kernel(*refs):
+        _kernel(refs, n_layers=L, mb=mb, nout=nout, steps=k_steps,
+                act_a=float(act_a), act_b=float(act_b),
+                lr_bias_ratio=float(lr_bias_ratio), wd=float(wd),
+                wd_bias=float(wd_bias), momentum=float(momentum))
+
     vm = pltpu.VMEM
-    fix = lambda *shape: pl.BlockSpec(shape, lambda i: (0,) * len(shape),  # noqa: E731
-                                      memory_space=vm)
-    w1o, b1o, w2o, b2o, acc = pl.pallas_call(
+
+    def fix(shape):
+        return pl.BlockSpec(shape, lambda i: (0,) * len(shape),
+                            memory_space=vm)
+
+    mat_specs = [fix(w.shape) for w in wp]
+    bias_specs = [fix(b.shape) for b in bp]
+    in_specs = ([pl.BlockSpec((1, 1), lambda i: (0, 0),
+                              memory_space=pltpu.SMEM),
+                 pl.BlockSpec((1, mb_p, fin_p), lambda i: (i, 0, 0),
+                              memory_space=vm),
+                 pl.BlockSpec((1, mb_p, nout_p), lambda i: (i, 0, 0),
+                              memory_space=vm)]
+                + mat_specs + bias_specs + mat_specs + bias_specs)
+    out_specs = (mat_specs + bias_specs + mat_specs + bias_specs
+                 + [fix((SUB, LANE))])
+    out_shape = ([jax.ShapeDtypeStruct(w.shape, f32) for w in wp]
+                 + [jax.ShapeDtypeStruct(b.shape, f32) for b in bp]
+                 + [jax.ShapeDtypeStruct(w.shape, f32) for w in wp]
+                 + [jax.ShapeDtypeStruct(b.shape, f32) for b in bp]
+                 + [jax.ShapeDtypeStruct((SUB, LANE), f32)])
+    scratch = ([pltpu.VMEM(w.shape, f32) for w in wp]
+               + [pltpu.VMEM(b.shape, f32) for b in bp]
+               + [pltpu.VMEM(w.shape, f32) for w in wp]
+               + [pltpu.VMEM(b.shape, f32) for b in bp]
+               + [pltpu.VMEM((SUB, LANE), f32)])
+    outs = pl.pallas_call(
         kernel,
         grid=(k_steps,),
-        in_specs=[
-            pl.BlockSpec((1, 1), lambda i: (0, 0),
-                         memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, mb_p, fin_p), lambda i: (i, 0, 0),
-                         memory_space=vm),
-            pl.BlockSpec((1, mb_p, nout_p), lambda i: (i, 0, 0),
-                         memory_space=vm),
-            fix(fin_p, hid_p), fix(SUB, hid_p),
-            fix(hid_p, nout_p), fix(SUB, nout_p),
-        ],
-        out_specs=[fix(fin_p, hid_p), fix(SUB, hid_p),
-                   fix(hid_p, nout_p), fix(SUB, nout_p),
-                   fix(SUB, LANE)],
-        out_shape=[
-            jax.ShapeDtypeStruct((fin_p, hid_p), f32),
-            jax.ShapeDtypeStruct((SUB, hid_p), f32),
-            jax.ShapeDtypeStruct((hid_p, nout_p), f32),
-            jax.ShapeDtypeStruct((SUB, nout_p), f32),
-            jax.ShapeDtypeStruct((SUB, LANE), f32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((fin_p, hid_p), f32),
-            pltpu.VMEM((SUB, hid_p), f32),
-            pltpu.VMEM((hid_p, nout_p), f32),
-            pltpu.VMEM((SUB, nout_p), f32),
-            pltpu.VMEM((SUB, LANE), f32),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
         # one sequential dimension: every step reads+writes the same
         # VMEM-resident weights
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
-    )(lr2, xg, yg, w1p, b1p, w2p, b2p)
+    )(lr2, xg, yg, *wp, *bp, *vwp, *vbp)
 
-    return (w1o[:fin, :hid], b1o[0, :hid], w2o[:hid, :nout],
-            b2o[0, :nout], acc[0, 0], acc[0, 1])
+    w_o = outs[:L]
+    b_o = outs[L:2 * L]
+    vw_o = outs[2 * L:3 * L]
+    vb_o = outs[3 * L:4 * L]
+    acc = outs[4 * L]
+    dims = [w.shape for w in weights]
+    w_f = [w_o[i][:dims[i][0], :dims[i][1]] for i in range(L)]
+    b_f = [b_o[i][0, :dims[i][1]] for i in range(L)]
+    vw_f = [vw_o[i][:dims[i][0], :dims[i][1]] for i in range(L)]
+    vb_f = [vb_o[i][0, :dims[i][1]] for i in range(L)]
+    return w_f, b_f, vw_f, vb_f, acc[0, 0], acc[0, 1]
 
 
-def fused_fc_oracle(w1, b1, w2, b2, dataset, labels, plan, lr,
-                    n_classes: Optional[int] = None,
-                    act_a: float = 1.0, act_b: float = 1.0):
+def fused_fc_oracle(weights, biases, vel_w, vel_b, dataset, labels,
+                    plan, lr, n_classes: Optional[int] = None,
+                    act_a: float = 1.0, act_b: float = 1.0,
+                    lr_bias_ratio: float = 1.0, wd: float = 0.0,
+                    wd_bias: float = 0.0, momentum: float = 0.0):
     """jnp reference (lax.scan of per-step SGD) — the equivalence
-    oracle for the kernel; same plan, same math, per-step HBM weights."""
-    nout = w2.shape[1] if n_classes is None else int(n_classes)
+    oracle for the kernel; same plan, same math, per-step HBM
+    weights."""
+    L = len(weights)
+    nout = weights[-1].shape[1] if n_classes is None else int(n_classes)
     mb = plan.shape[1]
+    lr_bias = lr * lr_bias_ratio
     f32 = jnp.float32
 
     def step(carry, idx):
-        w1, b1, w2, b2, loss, err = carry
+        ws, bs, vws, vbs, loss, err = carry
         x = dataset.astype(f32)[idx]
         y = jax.nn.one_hot(labels[idx], nout, dtype=f32)
-        h = act_a * jnp.tanh(act_b * (x @ w1 + b1))
-        logits = h @ w2 + b2
+        acts = [x]
+        h = x
+        for li in range(L - 1):
+            h = act_a * jnp.tanh(act_b * (h @ ws[li] + bs[li]))
+            acts.append(h)
+        logits = h @ ws[L - 1] + bs[L - 1]
         logp = jax.nn.log_softmax(logits)
         p = jnp.exp(logp)
         loss = loss - (y * logp).sum()
         err = err + (jnp.argmax(logits, 1) != labels[idx]).sum()
-        dlog = (p - y) / mb
-        dw2 = h.T @ dlog
-        db2 = dlog.sum(0)
-        dh = dlog @ w2.T
-        dpre = dh * (act_a * act_b - (act_b / act_a) * h * h)
-        dw1 = x.T @ dpre
-        db1 = dpre.sum(0)
-        return (w1 - lr * dw1, b1 - lr * db1,
-                w2 - lr * dw2, b2 - lr * db2, loss, err), None
+        d_out = (p - y) / mb
+        n_ws, n_bs, n_vws, n_vbs = list(ws), list(bs), list(vws), \
+            list(vbs)
+        for li in range(L - 1, -1, -1):
+            dw = acts[li].T @ d_out
+            db = d_out.sum(0)
+            if li > 0:
+                d_h = d_out @ ws[li].T
+                hh = acts[li]
+                d_out = d_h * (act_a * act_b
+                               - (act_b / act_a) * hh * hh)
+            dlt_w = lr * (dw + wd * ws[li]) + momentum * vws[li]
+            dlt_b = lr_bias * (db + wd_bias * bs[li]) \
+                + momentum * vbs[li]
+            n_ws[li] = ws[li] - dlt_w
+            n_bs[li] = bs[li] - dlt_b
+            n_vws[li] = dlt_w
+            n_vbs[li] = dlt_b
+        return (tuple(n_ws), tuple(n_bs), tuple(n_vws), tuple(n_vbs),
+                loss, err), None
 
-    init = (w1.astype(f32), b1.astype(f32), w2.astype(f32),
-            b2.astype(f32), jnp.float32(0.0), jnp.int32(0))
-    (w1, b1, w2, b2, loss, err), _ = jax.lax.scan(step, init, plan)
-    return w1, b1, w2, b2, loss, err.astype(f32)
+    init = (tuple(w.astype(f32) for w in weights),
+            tuple(b.astype(f32) for b in biases),
+            tuple(v.astype(f32) for v in vel_w),
+            tuple(v.astype(f32) for v in vel_b),
+            jnp.float32(0.0), jnp.int32(0))
+    (ws, bs, vws, vbs, loss, err), _ = jax.lax.scan(step, init, plan)
+    return (list(ws), list(bs), list(vws), list(vbs), loss,
+            err.astype(f32))
