@@ -1,0 +1,95 @@
+"""Beam-search decoding (nn/beam.py): beam=1 IS greedy, reported
+scores are true model log-probabilities, wider beams never score
+worse, eos freezing works."""
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import prng
+from veles_tpu.error import VelesError
+from veles_tpu.nn.beam import beam_generate
+
+from conftest import import_model
+
+
+@pytest.fixture(scope="module")
+def lm_wf():
+    lm = import_model("char_lm")
+    prng.seed_all(4321)
+    wf = lm.build_workflow(epochs=3, minibatch_size=64, n_blocks=2,
+                           dim=32, n_train=512, n_valid=128)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    return lm, wf
+
+
+def _score(lm, wf, prompt, toks):
+    """Teacher-forced total log-prob of `toks` after `prompt`, via the
+    units' own numpy oracles — independent of the beam machinery."""
+    seq = numpy.asarray(list(prompt) + list(toks),
+                        dtype=numpy.int32)[None, :]
+    x = seq
+    for f in wf.forwards:
+        x = f.numpy_apply(f.params_np(), x)
+    logits = x[0].astype(numpy.float64)          # (T, V)
+    z = logits - logits.max(axis=1, keepdims=True)
+    logp = z - numpy.log(numpy.exp(z).sum(axis=1, keepdims=True))
+    t_p = len(prompt)
+    return sum(logp[t_p - 1 + i, toks[i]] for i in range(len(toks)))
+
+
+def test_beam_one_is_greedy(lm_wf):
+    lm, wf = lm_wf
+    rng = numpy.random.RandomState(5)
+    prompt = list(lm.make_corpus(rng, lm.SEQ_LEN // 2))
+    want = lm.generate(wf, prompt, 16, temperature=0)
+    got, stats = beam_generate(wf, prompt, 16, beam=1)
+    assert got == want
+    assert len(stats["beams"]) == 1
+
+
+def test_beam_scores_are_true_logprobs_and_monotone(lm_wf):
+    """The reported score of every hypothesis equals its teacher-
+    forced log-probability under the model, and the beam-4 best is at
+    least as probable as the greedy continuation."""
+    lm, wf = lm_wf
+    rng = numpy.random.RandomState(6)
+    prompt = list(lm.make_corpus(rng, lm.SEQ_LEN // 2))
+    got1, s1 = beam_generate(wf, prompt, 12, beam=1)
+    got4, s4 = beam_generate(wf, prompt, 12, beam=4)
+    for toks, score in zip(s4["beams"], s4["scores"]):
+        true = _score(lm, wf, prompt, toks)
+        numpy.testing.assert_allclose(score, true, rtol=2e-4,
+                                      atol=2e-3)
+    assert s4["scores"][0] >= s1["scores"][0] - 1e-5
+    assert sorted(s4["scores"], reverse=True) == s4["scores"]
+    assert all(0 <= t < lm.VOCAB for t in got4)
+
+
+def test_beam_eos_freezes_hypotheses(lm_wf):
+    """With an eos token, finished hypotheses stop accumulating score
+    and report finished=True; length_penalty re-ranks by per-token
+    score."""
+    lm, wf = lm_wf
+    rng = numpy.random.RandomState(7)
+    prompt = list(lm.make_corpus(rng, lm.SEQ_LEN // 2))
+    # pick the model's first greedy token as "eos" so at least one
+    # hypothesis finishes immediately
+    greedy = lm.generate(wf, prompt, 8, temperature=0)
+    eos = greedy[0]
+    got, stats = beam_generate(wf, prompt, 8, beam=4, eos_id=eos,
+                               length_penalty=0.6)
+    assert any(stats["finished"]), stats
+    fin = stats["finished"].index(True)
+    toks = stats["beams"][fin]
+    hit = toks.index(eos)
+    # after eos, a frozen hypothesis only repeats eos (zero-cost)
+    assert all(t == eos for t in toks[hit:])
+
+
+def test_beam_rejects_bad_args(lm_wf):
+    lm, wf = lm_wf
+    with pytest.raises(ValueError, match="beam"):
+        beam_generate(wf, [1, 2], 4, beam=0)
+    with pytest.raises(VelesError, match="single"):
+        beam_generate(wf, [[1], [2]], 4)

@@ -1,0 +1,145 @@
+"""Beam-search decoding over the KV-cached sampler machinery.
+
+Completes the decoding family (sampling.generate: greedy/temperature;
+speculative.generate_speculative: draft-accelerated) with width-W
+maximum-likelihood search: W hypotheses advance in lockstep sharing a
+batched KV cache; each step expands W×V continuations, keeps the top W
+by total log-probability, and REORDERS the caches by surviving parent
+(a batch-axis gather — the TPU-friendly formulation; no per-hypothesis
+python state). Beyond the reference, whose inference story had no
+autoregressive decoding at all (SURVEY.md §2.8).
+
+``eos_id``: a finished hypothesis is frozen — its only continuation is
+``eos_id`` at zero cost, so its score stays fixed while others keep
+extending; ranking at the end uses an optional GNMT-style length
+normalization (score / n_tokens**alpha).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy
+
+from ..error import VelesError
+from .sampling import _block_step, split_stack
+from .speculative import _embed_at, _head_logits, _prefill
+
+
+def _build_beam(wf, t_p, n_new, beam, eos_id):
+    import jax
+    import jax.numpy as jnp
+
+    stack = split_stack(list(wf.forwards))
+    t_max = t_p + int(n_new) + 1
+    stack["t_max"] = t_max
+    pe = stack["pos_emb"]
+    if pe is not None and pe.param_arrays()["table"].shape[0] < t_max:
+        raise VelesError(
+            "beam search to %d positions exceeds the trained "
+            "PositionalEmbedding table (%d rows)"
+            % (t_max, pe.param_arrays()["table"].shape[0]))
+    eos = -1 if eos_id is None else int(eos_id)
+
+    @jax.jit
+    def run(params, prompt_ids):
+        # prefill ONCE (batch 1), then tile the caches across the beam
+        caches1, logits0 = _prefill(stack, params, prompt_ids)
+        caches = tuple(
+            (jnp.repeat(ck, beam, axis=0), jnp.repeat(cv, beam, axis=0))
+            for ck, cv in caches1)
+        logp0 = jax.nn.log_softmax(logits0.astype(jnp.float32))
+        v = logp0.shape[-1]
+        # first expansion from the SINGLE prefix: top-beam distinct
+        # tokens (expanding identical rows would duplicate hypotheses)
+        top0, tok0 = jax.lax.top_k(logp0, beam)
+        scores = top0                               # (beam,)
+        toks = jnp.zeros((beam, n_new), jnp.int32)
+        toks = toks.at[:, 0].set(tok0)
+        finished = (tok0 == eos)
+
+        def step(carry, i):
+            toks, scores, finished, caches = carry
+            pos = t_p + i
+            cur = toks[jnp.arange(beam), i]         # (beam,)
+            x_t = _embed_at(stack, params, cur[:, None], pos)
+            new_caches = []
+            for blk, (ck, cv) in zip(stack["blocks"], caches):
+                x_t, ck, cv = _block_step(blk, params[blk.name], x_t,
+                                          ck, cv, pos)
+                new_caches.append((ck, cv))
+            logits = _head_logits(stack, params, x_t[:, 0])
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            # a finished hypothesis only "continues" with eos at zero
+            # cost — its score freezes, everything else is impossible
+            if eos >= 0:
+                frozen = jnp.full((v,), -jnp.inf).at[eos].set(0.0)
+                logp = jnp.where(finished[:, None], frozen[None, :],
+                                 logp)
+            joint = scores[:, None] + logp          # (beam, V)
+            flat, idx = jax.lax.top_k(joint.reshape(-1), beam)
+            parent = idx // v
+            tok = (idx % v).astype(jnp.int32)
+            toks = toks[parent].at[:, i + 1].set(tok)
+            finished = finished[parent] | (tok == eos)
+            caches = tuple((ck[parent], cv[parent])
+                           for ck, cv in new_caches)
+            return (toks, flat, finished, caches), None
+
+        (toks, scores, finished, _), _ = jax.lax.scan(
+            step, (toks, scores, finished, caches),
+            jnp.arange(n_new - 1))
+        return toks, scores, finished
+
+    return run
+
+
+def beam_generate(wf, prompt, n_new, beam: int = 4,
+                  eos_id: Optional[int] = None,
+                  length_penalty: float = 0.0
+                  ) -> Tuple[List[int], Dict[str, object]]:
+    """Width-``beam`` search for the most probable ``n_new``-token
+    continuation of ``prompt``. Returns ``(best_tokens, stats)`` with
+    stats carrying every hypothesis (``beams``: token lists) and its
+    total log-probability (``scores``). ``beam=1`` IS greedy decoding
+    (CI-asserted vs sampling.generate). ``length_penalty=a`` ranks by the
+    GNMT-style normalization ``score / n_tokens**a`` (only meaningful
+    with ``eos_id``, where hypothesis lengths differ)."""
+    import jax.numpy as jnp
+    if int(beam) < 1:
+        raise ValueError("beam must be >= 1")
+    if int(n_new) < 1:
+        raise ValueError("n_new must be >= 1")
+    prompt = numpy.asarray(prompt, dtype=numpy.int32)
+    if prompt.ndim != 1:
+        raise VelesError("beam search decodes a single prompt")
+    t_p = len(prompt)
+    cache = getattr(wf, "_beam_cache", None)
+    if cache is None:
+        cache = wf._beam_cache = {}
+    key = (t_p, int(n_new), int(beam),
+           -1 if eos_id is None else int(eos_id))
+    run = cache.get(key)
+    if run is None:
+        run = cache[key] = _build_beam(wf, t_p, int(n_new), int(beam),
+                                       eos_id)
+    params = {f.name: {k: v.device_view()
+                       for k, v in f.param_arrays().items()}
+              for f in wf.forwards if f.PARAMETERIZED}
+    toks, scores, finished = run(params, jnp.asarray(prompt[None, :]))
+    toks = numpy.asarray(toks)
+    scores = numpy.asarray(scores, dtype=numpy.float64)
+    lengths = numpy.full(len(scores), toks.shape[1], dtype=numpy.float64)
+    if eos_id is not None:
+        for bi in range(len(scores)):
+            hits = numpy.where(toks[bi] == int(eos_id))[0]
+            if hits.size:
+                lengths[bi] = hits[0] + 1
+    ranked = (scores / lengths ** float(length_penalty)
+              if length_penalty else scores)
+    order = numpy.argsort(-ranked)
+    best = int(order[0])
+    return ([int(t) for t in toks[best]],
+            {"beams": [[int(t) for t in toks[i]] for i in order],
+             "scores": [float(scores[i]) for i in order],
+             "finished": [bool(finished[i]) for i in order]})
