@@ -345,6 +345,21 @@ def sec_generation(bench, dev, n):
             print("  spec %dx%d: %s tok/s acc=%s"
                   % (n_blocks, dim, rows[-1]["spec_tok_s"],
                      rows[-1]["acceptance"]), flush=True)
+            # beam=4 on chip: 4 hypotheses ride the batch axis, so the
+            # per-token cost is ~one batched step — the number says
+            # what width-4 search costs vs greedy on this hardware
+            from veles_tpu.nn.beam import beam_generate
+            beam_generate(wf, prompt, n_new, beam=4)      # compile
+            t0 = time.time()
+            for _ in range(reps):
+                beam_generate(wf, prompt, n_new, beam=4)
+            dt = (time.time() - t0) / reps
+            rows.append({"n_blocks": n_blocks, "dim": dim,
+                         "n_new": n_new, "beam": 4,
+                         "beam_tok_s": round(n_new / dt, 1)})
+            print("  beam %dx%d: %s tok/s"
+                  % (n_blocks, dim, rows[-1]["beam_tok_s"]),
+                  flush=True)
     return rows
 
 

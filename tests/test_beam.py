@@ -61,7 +61,10 @@ def test_beam_scores_are_true_logprobs_and_monotone(lm_wf):
         true = _score(lm, wf, prompt, toks)
         numpy.testing.assert_allclose(score, true, rtol=2e-4,
                                       atol=2e-3)
-    assert s4["scores"][0] >= s1["scores"][0] - 1e-5
+    # NOT a beam-search invariant (width-4 CAN prune the greedy path),
+    # but a large loss would mean broken scoring; wide tolerance keeps
+    # this a sanity check, not a tie-break-sensitive gate
+    assert s4["scores"][0] >= s1["scores"][0] - 0.5
     assert sorted(s4["scores"], reverse=True) == s4["scores"]
     assert all(0 <= t < lm.VOCAB for t in got4)
 

@@ -22,7 +22,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy
 
 from ..error import VelesError
-from .sampling import _block_step, split_stack
+from .sampling import _block_step, params_of, split_stack
 from .speculative import _embed_at, _head_logits, _prefill
 
 
@@ -31,14 +31,19 @@ def _build_beam(wf, t_p, n_new, beam, eos_id):
     import jax.numpy as jnp
 
     stack = split_stack(list(wf.forwards))
-    t_max = t_p + int(n_new) + 1
-    stack["t_max"] = t_max
+    # prefill fills rows 0..t_p-1 and the scan's last step embeds at
+    # position t_p + n_new - 2 — rows beyond t_p + n_new - 1 would be
+    # dead weight tiled across the beam AND make the positional-table
+    # guard stricter than sampling.generate's for the same length
+    t_max = t_p + max(int(n_new) - 1, 0)
+    stack["t_max"] = max(t_max, t_p)
     pe = stack["pos_emb"]
-    if pe is not None and pe.param_arrays()["table"].shape[0] < t_max:
+    if pe is not None and \
+            pe.param_arrays()["table"].shape[0] < stack["t_max"]:
         raise VelesError(
             "beam search to %d positions exceeds the trained "
             "PositionalEmbedding table (%d rows)"
-            % (t_max, pe.param_arrays()["table"].shape[0]))
+            % (stack["t_max"], pe.param_arrays()["table"].shape[0]))
     eos = -1 if eos_id is None else int(eos_id)
 
     @jax.jit
@@ -123,10 +128,8 @@ def beam_generate(wf, prompt, n_new, beam: int = 4,
     if run is None:
         run = cache[key] = _build_beam(wf, t_p, int(n_new), int(beam),
                                        eos_id)
-    params = {f.name: {k: v.device_view()
-                       for k, v in f.param_arrays().items()}
-              for f in wf.forwards if f.PARAMETERIZED}
-    toks, scores, finished = run(params, jnp.asarray(prompt[None, :]))
+    toks, scores, finished = run(params_of(wf),
+                                 jnp.asarray(prompt[None, :]))
     toks = numpy.asarray(toks)
     scores = numpy.asarray(scores, dtype=numpy.float64)
     lengths = numpy.full(len(scores), toks.shape[1], dtype=numpy.float64)

@@ -26,6 +26,14 @@ from .transformer import (Embedding, LMHead, PositionalEmbedding,
                           block_norm)
 
 
+def params_of(wf):
+    """The device-side parameter pytree of a workflow's forwards — the
+    ONE copy of the extraction every decoding entry point shares."""
+    return {f.name: {k: v.device_view()
+                     for k, v in f.param_arrays().items()}
+            for f in wf.forwards if f.PARAMETERIZED}
+
+
 def _rope_at(np_mod, x, pos, base=10000.0):
     """RoPE for a SINGLE position: x (B, 1, H, Dh), pos scalar (traced
     ok). Same half-split pairing as transformer._rope."""
@@ -262,9 +270,7 @@ def generate(wf, prompt, n_new, temperature=1.0, seed=0):
     run = cache.get(key)
     if run is None:
         run = cache[key] = _build_sampler(wf, t_p, n_new, temperature)
-    params = {f.name: {k: v.device_view()
-                       for k, v in f.param_arrays().items()}
-              for f in wf.forwards if f.PARAMETERIZED}
+    params = params_of(wf)
     toks = numpy.asarray(
         run(params, jnp.asarray(prompt), jax.random.PRNGKey(seed)))
     if not batched:

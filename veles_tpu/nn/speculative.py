@@ -338,11 +338,7 @@ def generate_speculative(wf_target, wf_draft, prompt, n_new,
             float(temperature)))
     run = entry[1]
 
-    def params_of(wf):
-        return {f.name: {k: v.device_view()
-                         for k, v in f.param_arrays().items()}
-                for f in wf.forwards if f.PARAMETERIZED}
-
+    from .sampling import params_of
     toks, rounds, acc = run(params_of(wf_target), params_of(wf_draft),
                             jnp.asarray(prompt[None, :]),
                             jax.random.PRNGKey(seed))
