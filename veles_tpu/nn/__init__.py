@@ -37,6 +37,8 @@ from .nn_plotting import Weights2D, KohonenHits  # noqa
 from .attention import MultiHeadAttention, attention_core  # noqa
 from .moe import MoEFFN  # noqa
 from . import sampling  # noqa
+from . import speculative  # noqa
+from . import beam  # noqa
 from .transformer import (TransformerBlock, MeanPool,  # noqa
                           PositionalEmbedding, Embedding, LMHead)
 from .evaluator import EvaluatorSoftmaxSeq  # noqa
