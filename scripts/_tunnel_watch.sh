@@ -9,7 +9,8 @@ cd /root/repo
 while true; do
   missing=$(python3 - <<'PY'
 import json, os
-order = ("mnist_fused ae_amp ae_fp32 ae_amp_remat lm attn generation "
+order = ("pallas_compile mnist_fused ae_amp ae_fp32 ae_amp_remat lm "
+         "attn generation "
          "profile mnist mnist_mb1000 mnist_h_sweep").split()
 done_keys = set()
 p = "docs/chip_r03.json"

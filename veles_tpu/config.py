@@ -187,6 +187,13 @@ def _default_root() -> Config:
             # resume/relaunch skips the 20-40 s first-compile. "" = off.
             "compilation_cache": os.path.expanduser(
                 "~/.veles_tpu/cache/xla"),
+            # per-device Pallas block-shape DB (ops/autotune.py — the
+            # build's port of the reference's measured-per-device GEMM
+            # block sizes, veles/backends.py:623-731). "auto" = reuse
+            # persisted winners, sweep-and-persist on first use of an
+            # unseen (device_kind, shape) on a real TPU; "reuse" =
+            # lookup only; False = hard-coded defaults
+            "kernel_autotune": "auto",
         },
         "mesh": {
             # logical mesh axes reserved up front (SURVEY.md §5.7/§5.8):

@@ -1,0 +1,221 @@
+"""Per-device kernel block-shape database — measure → persist → reuse.
+
+Reference parity: the reference benchmarks GEMM block sizes per device
+on first use and persists them keyed by device name
+(`veles/backends.py:623-731` ``_find_optimal_bs_vo`` →
+``devices/device_infos.json``), so every later run starts tuned. Here
+XLA owns GEMM tuning, but the build's OWN Pallas kernel —
+``ops/flash_attention.py`` — has ``block_q``/``block_k`` knobs the
+compiler does not pick. This module ports the measure-and-persist
+capability to it:
+
+- first use of a (device_kind, shape-class) with no recorded entry runs
+  a BOUNDED forward-timing sweep over divisor-compatible block pairs,
+  persists the winner, and returns it;
+- every later use (any process, any day) is a dict lookup.
+
+Two DB layers, user overriding shipped (mirroring the reference's
+in-repo ``device_infos.json`` + user cache):
+
+- shipped: ``veles_tpu/devices/kernel_tuning.json`` (committed; the
+  chip measurement batch seeds it — ``scripts/chip_experiments.py``),
+- user:    ``root.common.dirs.cache / kernel_tuning.json`` (atomic
+  writes; where first-use sweeps land).
+
+``fused_fc`` deliberately has no entry here: its only tunable is
+epochs-per-dispatch ``h`` (whole minibatches ARE its blocks), measured
+by the chip batch's h-sweep, not a per-call shape knob.
+
+Config: ``root.common.engine.kernel_autotune`` —
+``"auto"`` (default: lookup, sweep on miss when a real TPU backend is
+up), ``"reuse"`` (lookup only), ``False`` (hard-coded defaults).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, Optional, Sequence, Tuple
+
+DEFAULT_BLOCKS = (128, 128)
+#: bounded candidate census (the reference swept a fixed census too,
+#: veles/backends.py:692); filtered per call to divisors of T
+CANDIDATES = ((128, 128), (256, 128), (512, 128), (256, 256),
+              (512, 512))
+SHIPPED = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "devices", "kernel_tuning.json")
+
+#: per-process memo: key → blocks (or None after a failed sweep so a
+#: bad environment costs one attempt, not one per trace)
+_memo: dict = {}
+
+
+def _user_path() -> str:
+    from ..config import root
+    return os.path.join(root.common.dirs.cache, "kernel_tuning.json")
+
+
+def _read(path: str) -> dict:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _device_db(device_kind: str) -> dict:
+    """Merged view for one device_kind, user layer winning."""
+    merged = dict(_read(SHIPPED).get(device_kind, {}))
+    merged.update(_read(_user_path()).get(device_kind, {}))
+    return merged
+
+
+def current_device_kind() -> str:
+    import jax
+    try:
+        return str(jax.devices()[0].device_kind)
+    except Exception:            # noqa: BLE001 — backend init failure
+        return "unknown"
+
+
+def flash_key(t: int, d: int, causal: bool, window: int = 0) -> str:
+    mode = "causal" if causal else "full"
+    if window:
+        mode += "_win"
+    return "flash_t%d_d%d_%s" % (t, d, mode)
+
+
+def lookup(key: str, device_kind: Optional[str] = None) -> Optional[dict]:
+    kind = device_kind or current_device_kind()
+    return _device_db(kind).get(key)
+
+
+def record(key: str, entry: dict, device_kind: Optional[str] = None,
+           shipped: bool = False) -> None:
+    """Persist ``entry`` under (device_kind, key). ``shipped=True``
+    additionally updates the committed in-repo DB — chip measurement
+    batches only, so the repo ships what was actually measured."""
+    kind = device_kind or current_device_kind()
+    entry = dict(entry, ts=time.strftime("%Y-%m-%d %H:%M:%S"))
+    for path in ([_user_path(), SHIPPED] if shipped else [_user_path()]):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        db = _read(path)
+        db.setdefault(kind, {})[key] = entry
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(db, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+    _memo[(kind, key)] = (entry["block_q"], entry["block_k"])
+
+
+def candidates_for(t: int, d: int) -> Tuple[Tuple[int, int], ...]:
+    from .flash_attention import supported
+    out = tuple((bq, bk) for bq, bk in CANDIDATES
+                if supported(t, d, bq, bk))
+    return out or ((min(t, 128), min(t, 128)),)
+
+
+def _time_flash(t: int, d: int, causal: bool,
+                blocks: Tuple[int, int]) -> float:
+    """Forward-mode timing probe on synthetic bf16 operands (b=1, h=1 —
+    the grid repeats per head/batch, so the per-block ranking
+    transfers); returns seconds per call."""
+    import jax
+    import jax.numpy as jnp
+    import numpy
+    from .flash_attention import flash_attention
+    rng = numpy.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(1, t, 1, d), jnp.bfloat16)
+               for _ in range(3))
+    fn = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=blocks[0], block_k=blocks[1],
+        interpret=False))
+    jax.block_until_ready(fn(q, k, v))          # compile
+    t0 = time.time()
+    for _ in range(4):
+        out = fn(q, k, v)
+    jax.block_until_ready(out)
+    return (time.time() - t0) / 4
+
+
+def sweep_flash(t: int, d: int, causal: bool = True,
+                device_kind: Optional[str] = None,
+                measure: Optional[Callable] = None,
+                cands: Optional[Sequence[Tuple[int, int]]] = None,
+                persist: bool = True) -> Tuple[int, int]:
+    """Bounded block sweep for one shape class; persists and returns the
+    winner. ``measure(t, d, causal, blocks) -> seconds`` is injectable
+    (tests use a fake device_kind + fake measure to prove
+    persist/reuse without a chip)."""
+    measure = measure or _time_flash
+    best, best_dt = None, None
+    rows = {}
+    for blocks in (cands or candidates_for(t, d)):
+        try:
+            dt = measure(t, d, causal, blocks)
+        except Exception:        # noqa: BLE001 — candidate didn't lower
+            continue
+        rows["%dx%d" % blocks] = round(dt * 1e3, 3)
+        if best_dt is None or dt < best_dt:
+            best, best_dt = blocks, dt
+    if best is None:
+        raise RuntimeError("flash autotune: no candidate ran for "
+                           "t=%d d=%d" % (t, d))
+    if persist:
+        record(flash_key(t, d, causal),
+               {"block_q": best[0], "block_k": best[1],
+                "ms": round(best_dt * 1e3, 3), "sweep_ms": rows,
+                "mode": "fwd_inline_sweep"},
+               device_kind=device_kind)
+    return best
+
+
+def flash_blocks(t: int, d: int, causal: bool = True, window: int = 0,
+                 device_kind: Optional[str] = None) -> Tuple[int, int]:
+    """THE policy lookup ``flash_attention`` resolves its default
+    blocks through. Lookup is a memoized dict read (safe at trace
+    time); a first-use sweep only fires in ``"auto"`` mode on a real
+    TPU backend — its timing probes are independent eager programs, so
+    running them while an outer jit traces is legal."""
+    from ..config import root
+    mode = root.common.engine.get("kernel_autotune", "auto")
+    if not mode:
+        return DEFAULT_BLOCKS
+    kind = device_kind or current_device_kind()
+    key = flash_key(t, d, causal, window)
+    memo_key = (kind, key)
+    if memo_key in _memo:
+        return _memo[memo_key] or DEFAULT_BLOCKS
+    hit = lookup(key, kind)
+    if hit is not None:
+        blocks = (int(hit["block_q"]), int(hit["block_k"]))
+        _memo[memo_key] = blocks
+        return blocks
+    import jax
+    if mode != "auto" or jax.default_backend() != "tpu" or window:
+        # windowed shapes reuse the causal entry's ranking if present,
+        # else defaults — no dedicated sweep for every window size.
+        # Misses are deliberately NOT memoized here: a later record()
+        # or a mode switch back to "auto" must be able to change the
+        # answer within the process.
+        if window:
+            base = lookup(flash_key(t, d, causal), kind)
+            if base is not None:
+                blocks = (int(base["block_q"]), int(base["block_k"]))
+                _memo[memo_key] = blocks
+                return blocks
+        return DEFAULT_BLOCKS
+    try:
+        blocks = sweep_flash(t, d, causal, device_kind=kind)
+    except Exception:            # noqa: BLE001 — never fail the model;
+        # a failed sweep IS memoized: retrying it every trace would
+        # re-pay the compile storm each time
+        _memo[memo_key] = None
+        return DEFAULT_BLOCKS
+    _memo[memo_key] = blocks
+    return blocks
+
+
+def clear_memo() -> None:
+    _memo.clear()

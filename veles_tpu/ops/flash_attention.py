@@ -526,12 +526,22 @@ def choose_flash(t: int, d: int) -> bool:
     return jax.default_backend() == "tpu" and t >= min_t
 
 
-def _prepare(q, k, v, scale, block_q, block_k, interpret, caller):
+def _prepare(q, k, v, scale, block_q, block_k, interpret, caller,
+             causal=False, window=0):
     """Shared prologue for the public entry points: validation, scale
-    default, interpret default, and the head-fold + lane-pad of the
-    operands. Returns (q3, k3, v3, scale, interpret, b, t, h, kv, d)."""
+    default, interpret default, block resolution (``None`` blocks go
+    through the per-device autotune DB — ``ops/autotune.py``, the
+    build's port of the reference's measured-per-device block sizes,
+    `veles/backends.py:623-731`), and the head-fold + lane-pad of the
+    operands. Returns (q3, k3, v3, scale, interpret, b, t, h, kv, d,
+    block_q, block_k)."""
     b, t, h, d = q.shape
     kv = k.shape[2]
+    if block_q is None or block_k is None:
+        from .autotune import flash_blocks
+        abq, abk = flash_blocks(t, d, causal=causal, window=window)
+        block_q = abq if block_q is None else block_q
+        block_k = abk if block_k is None else block_k
     if v.shape[2] != kv or h % kv:
         raise ValueError(
             "k/v head counts must match and divide q heads: q has %d, "
@@ -553,12 +563,13 @@ def _prepare(q, k, v, scale, block_q, block_k, interpret, caller):
         return xt
 
     return (fold(q), fold(k), fold(v), float(scale), interpret,
-            b, t, h, kv, d)
+            b, t, h, kv, d, block_q, block_k)
 
 
 def flash_attention_fwd_lse(q, k, v, causal: bool = False,
                             scale: Optional[float] = None,
-                            block_q: int = 128, block_k: int = 128,
+                            block_q: Optional[int] = None,
+                            block_k: Optional[int] = None,
                             interpret: Optional[bool] = None):
     """FORWARD-ONLY flash returning ``(o, lse)`` with lse ``(B, T, H)``
     (log-sum-exp of the scaled scores per query row). No custom VJP —
@@ -566,9 +577,9 @@ def flash_attention_fwd_lse(q, k, v, causal: bool = False,
     partials by lse and defines the blockwise ring backward itself
     (parallel/ring_attention.py). Same folding/padding/support rules
     as :func:`flash_attention`."""
-    q3, k3, v3, scale, interpret, b, t, h, kv, d = _prepare(
-        q, k, v, scale, block_q, block_k, interpret,
-        "flash_attention_fwd_lse")
+    q3, k3, v3, scale, interpret, b, t, h, kv, d, block_q, block_k = \
+        _prepare(q, k, v, scale, block_q, block_k, interpret,
+                 "flash_attention_fwd_lse", causal=causal)
     o, lse = _fwd_pallas(q3, k3, v3, causal, scale, block_q, block_k,
                          interpret, 0, h, kv)
     o = jnp.moveaxis(o[..., :d].reshape(b, h, t, d), 1, 2)
@@ -579,7 +590,8 @@ def flash_attention_fwd_lse(q, k, v, causal: bool = False,
 def flash_attention_bwd_lse(q, k, v, lse, delta, do,
                             causal: bool = False,
                             scale: Optional[float] = None,
-                            block_q: int = 128, block_k: int = 128,
+                            block_q: Optional[int] = None,
+                            block_k: Optional[int] = None,
                             interpret: Optional[bool] = None):
     """Blockwise flash BACKWARD against an external (global) softmax
     normalizer: ``(dq, dk, dv)`` contributions of this K/V block set,
@@ -588,9 +600,9 @@ def flash_attention_bwd_lse(q, k, v, lse, delta, do,
     attention's per-step backward engine (the global lse makes each
     block's probabilities exact regardless of which blocks this call
     sees). VMEM-resident kernels; no (T, T) materialization."""
-    q3, k3, v3, scale, interpret, b, t, h, kv, d = _prepare(
-        q, k, v, scale, block_q, block_k, interpret,
-        "flash_attention_bwd_lse")
+    q3, k3, v3, scale, interpret, b, t, h, kv, d, block_q, block_k = \
+        _prepare(q, k, v, scale, block_q, block_k, interpret,
+                 "flash_attention_bwd_lse", causal=causal)
 
     def fold_g(x):      # (B, T, H) → (B*H, T)
         return jnp.moveaxis(x, -1, 1).reshape(b * h, t)
@@ -614,8 +626,9 @@ def flash_attention_bwd_lse(q, k, v, lse, delta, do,
 
 
 def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128,
+                    scale: Optional[float] = None,
+                    block_q: Optional[int] = None,
+                    block_k: Optional[int] = None,
                     interpret: Optional[bool] = None,
                     window: Optional[int] = None):
     """(B, T, H, D) × 3 → (B, T, H, D), differentiable.
@@ -632,10 +645,11 @@ def flash_attention(q, k, v, causal: bool = False,
         raise ValueError("window must be >= 1 (or None)")
     if window and not causal:
         raise ValueError("sliding-window attention requires causal=True")
-    q3, k3, v3, scale, interpret, b, t, h, kv, d = _prepare(
-        q, k, v, scale, block_q, block_k, interpret, "flash_attention")
-    if window >= t:
+    if window >= q.shape[1]:
         window = 0          # a window covering everything is no window
+    q3, k3, v3, scale, interpret, b, t, h, kv, d, block_q, block_k = \
+        _prepare(q, k, v, scale, block_q, block_k, interpret,
+                 "flash_attention", causal=causal, window=window)
 
     o = _flash(q3, k3, v3, causal, scale,
                block_q, block_k, interpret, window, h, kv)
