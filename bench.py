@@ -412,13 +412,14 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         baselines[method] = {"value": sps, "ts": time.time()}
         with open(base_path, "w") as f:
             json.dump({"baselines": baselines}, f)
-    elif base is None:
-        base = sps      # host/smoke run: never becomes the baseline
+    # base stays None for host/smoke runs: a smoke has no baseline
+    # ratio, and reporting 1.0 would read as "on target" (VERDICT r4
+    # weak #9) — vs_baseline is null until a real chip anchor exists
     return {
         "metric": "mnist784_train_samples_per_sec_per_chip",
         "value": round(sps, 1),
         "unit": "samples/sec/chip",
-        "vs_baseline": round(sps / base, 3),
+        "vs_baseline": None if base is None else round(sps / base, 3),
         "rebaselined": rebaselined,
         "window": method,
         "smoke": smoke,

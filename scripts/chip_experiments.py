@@ -336,8 +336,15 @@ def sec_attn(bench, dev, n):
         results = _attn_measure(bench, dev, n)
     finally:
         vt_root.common.engine.kernel_autotune = prev_tune
-    _attn_seed(results, dev)
+    try:
+        _attn_seed(results, dev)
+    except Exception as e:            # noqa: BLE001 — seeding is
+        # best-effort; the measured sweep must be returned regardless
+        print("  autotune seeding skipped: %s" % e, flush=True)
     return results
+
+
+ATTN_SWEEP_H, ATTN_SWEEP_D = 8, 64   # shared by measure AND DB seeding
 
 
 def _attn_measure(bench, dev, n):
@@ -350,7 +357,7 @@ def _attn_measure(bench, dev, n):
     results = []
     # (T, B) pairs from docs/perf.md so old and new numbers compare
     for t, b in ((2048, 16), (8192, 1)):
-        h, d = 8, 64
+        h, d = ATTN_SWEEP_H, ATTN_SWEEP_D
         import numpy
         rng = numpy.random.RandomState(0)
         q, k, v = (jnp.asarray(rng.randn(b, t, h, d), jnp.bfloat16)
@@ -487,10 +494,12 @@ def _attn_seed(results, dev):
     # flash calls stop using the hard-coded 128x128 default on this
     # device_kind. Train-mode winners take precedence (training is the
     # dominant consumer); shipped=True commits the in-repo DB too.
+    # Best-effort by design: the sweep behind `results` cost hours of
+    # tunnel compiles — a seeding IOError must never discard it.
     if not _on_cpu(dev):
         import re
         from veles_tpu.ops import autotune
-        d_swept = 64
+        d_swept = ATTN_SWEEP_D
         for t in sorted({r["t"] for r in results}):
             best = {}              # train_mode -> (ms, bq, bk)
             for r in results:
@@ -508,13 +517,18 @@ def _attn_seed(results, dev):
             if pick is None:
                 continue
             ms, bq, bk = pick
-            autotune.record(
-                autotune.flash_key(t, d_swept, True),
-                {"block_q": bq, "block_k": bk, "ms": ms,
-                 "mode": "train_sweep" if True in best else "fwd_sweep"},
-                shipped=True)
-            print("  autotune seeded t=%d d=%d -> %dx%d (%.2f ms)"
-                  % (t, d_swept, bq, bk, ms), flush=True)
+            try:
+                autotune.record(
+                    autotune.flash_key(t, d_swept, True),
+                    {"block_q": bq, "block_k": bk, "ms": ms,
+                     "mode": ("train_sweep" if True in best
+                              else "fwd_sweep")},
+                    shipped=True)
+                print("  autotune seeded t=%d d=%d -> %dx%d (%.2f ms)"
+                      % (t, d_swept, bq, bk, ms), flush=True)
+            except Exception as e:        # noqa: BLE001
+                print("  autotune seeding failed for t=%d: %s"
+                      % (t, e), flush=True)
 
 
 def sec_generation(bench, dev, n):

@@ -36,7 +36,8 @@ def test_sweep_persists_and_reuses(tuned_env):
         # (256, 128) is the planted winner
         return 1.0 if blocks != (256, 128) else 0.25
 
-    best = autotune.sweep_flash(2048, 64, True, measure=fake_measure)
+    best = autotune.sweep_flash(2048, 64, True, measure=fake_measure,
+                                check_bwd=lambda *a: True)
     assert best == (256, 128)
     assert len(calls) == len(autotune.candidates_for(2048, 64))
 
@@ -52,6 +53,53 @@ def test_sweep_persists_and_reuses(tuned_env):
     # ... even in a "fresh process" (memo cleared → file read)
     autotune.clear_memo()
     assert autotune.flash_blocks(2048, 64, causal=True) == (256, 128)
+
+
+def test_sweep_rejects_backward_incompatible_winner(tuned_env):
+    """The fastest forward whose backward does NOT lower must yield to
+    the next candidate (the bwd working set is larger than the fwd's)."""
+    def fake_measure(t, d, causal, blocks):
+        return 0.25 if blocks == (512, 512) else \
+            (0.5 if blocks == (256, 128) else 1.0)
+
+    best = autotune.sweep_flash(
+        2048, 64, True, measure=fake_measure,
+        check_bwd=lambda t, d, c, blocks: blocks != (512, 512))
+    assert best == (256, 128)
+    entry = autotune.lookup(autotune.flash_key(2048, 64, True))
+    assert entry["sweep_ms"]["512x512"] == "bwd_compile_failed"
+
+
+def test_default_blocks_skip_bwd_check(tuned_env):
+    """(128, 128) is the known-safe production default — the sweep
+    must not spend a backward compile validating it."""
+    def fake_measure(t, d, causal, blocks):
+        return 0.1 if blocks == autotune.DEFAULT_BLOCKS else 1.0
+
+    def boom(*a):
+        raise AssertionError("bwd check ran for the default blocks")
+
+    assert autotune.sweep_flash(2048, 64, True, measure=fake_measure,
+                                check_bwd=boom) == (128, 128)
+
+
+def test_multihost_reads_shipped_only(tuned_env, monkeypatch):
+    """Multi-host processes must trace identical blocks: only the
+    committed shipped layer is consulted, never the per-host user DB,
+    and no sweep fires."""
+    import jax
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # user layer has a winner — must be IGNORED under multihost
+    autotune.record(autotune.flash_key(2048, 64, True),
+                    {"block_q": 512, "block_k": 512, "ms": 0.1})
+    autotune.clear_memo()
+    assert autotune.flash_blocks(2048, 64) == autotune.DEFAULT_BLOCKS
+    autotune.clear_memo()
+    shipped = {"faketpu-v0": {"flash_t2048_d64_causal":
+                              {"block_q": 256, "block_k": 128}}}
+    with open(autotune.SHIPPED, "w") as f:
+        json.dump(shipped, f)
+    assert autotune.flash_blocks(2048, 64) == (256, 128)
 
 
 def test_miss_off_tpu_returns_defaults(tuned_env):

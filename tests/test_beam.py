@@ -96,3 +96,11 @@ def test_beam_rejects_bad_args(lm_wf):
         beam_generate(wf, [1, 2], 4, beam=0)
     with pytest.raises(VelesError, match="single"):
         beam_generate(wf, [[1], [2]], 4)
+
+
+def test_beam_rejects_beam_wider_than_vocab(lm_wf):
+    lm, wf = lm_wf
+    from veles_tpu.nn.sampling import split_stack
+    vocab = split_stack(list(wf.forwards))["head"].vocab_size
+    with pytest.raises(ValueError, match="vocab"):
+        beam_generate(wf, [1, 2], 4, beam=vocab + 1)

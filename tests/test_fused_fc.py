@@ -249,3 +249,31 @@ def test_eligibility_rejects_vmem_oversized_chain():
         assert wf.train_step._fused_fc is None
     finally:
         root.common.engine.fused_fc_scan = prev
+
+
+def test_eligibility_rejects_per_layer_act_scales():
+    """A per-instance (A, B) override on one tanh layer must fall back:
+    the kernel bakes ONE scaling for the whole chain (ADVICE r4)."""
+    from veles_tpu.nn.all2all import All2AllTanh
+    prev = root.common.engine.get("fused_fc_scan", False)
+    root.common.engine.fused_fc_scan = True
+    try:
+        prng.seed_all(7)
+        wf = nn.StandardWorkflow(
+            name="ffc-actscale",
+            layers=[{"type": "all2all_tanh", "output_sample_shape": 12,
+                     "learning_rate": 0.05},
+                    {"type": "all2all_tanh", "output_sample_shape": 8,
+                     "learning_rate": 0.05},
+                    {"type": "softmax", "output_sample_shape": 3,
+                     "learning_rate": 0.05}],
+            loader_unit=Blobs(None, minibatch_size=20, name="blact"),
+            loss_function="softmax",
+            decision_config=dict(max_epochs=1, fail_iterations=100),
+            epochs_per_dispatch=2)
+        tanhs = [f for f in wf.forwards if isinstance(f, All2AllTanh)]
+        tanhs[1].A = 1.0            # instance override shadows class A
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        assert wf.train_step._fused_fc is None
+    finally:
+        root.common.engine.fused_fc_scan = prev

@@ -24,6 +24,17 @@ if _chips:
     # is never the training backend. The forced-host-device-count flag
     # would fight the setting — strip it before jax initializes.
     _flags = _os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" in _flags:
+        # the user set BOTH knobs: dropping their flag silently (and
+        # exporting the stripped XLA_FLAGS to every child) would be a
+        # mystery device-count change — say so (ADVICE r4)
+        import warnings as _warnings
+        _warnings.warn(
+            "TPU_VISIBLE_CHIPS overrides xla_force_host_platform_"
+            "device_count: stripping the flag from XLA_FLAGS (the "
+            "slice-placement contract owns the CPU device count; "
+            "unset TPU_VISIBLE_CHIPS to keep your flag)",
+            RuntimeWarning, stacklevel=2)
     _os.environ["XLA_FLAGS"] = " ".join(
         t for t in _flags.split()
         if "xla_force_host_platform_device_count" not in t)

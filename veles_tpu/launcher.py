@@ -310,9 +310,16 @@ class Launcher(Logger):
         plot set is unchanged since the last beacon — the key is then
         omitted and the server carries the previous gallery forward,
         so steady-state ticks don't re-ship megabytes of identical
-        PNGs."""
+        PNGs. Every REFRESH_EVERY-th beacon re-ships regardless: the
+        signature lives launcher-side, so a restarted web-status server
+        (carried-forward state lost) would otherwise show an empty
+        gallery until some plot file changed (ADVICE r4)."""
         import base64
         import glob as _glob
+
+        REFRESH_EVERY = 10
+        self._plot_beacons = getattr(self, "_plot_beacons", -1) + 1
+        force = self._plot_beacons % REFRESH_EVERY == 0
 
         def mtime(p):
             # the renderer rewrites files concurrently: a vanished path
@@ -330,7 +337,8 @@ class Launcher(Logger):
             pngs = sorted(_glob.glob(os.path.join(out_dir, "*.png")),
                           key=mtime, reverse=True)[:max_plots]
         signature = tuple((p, mtime(p)) for p in pngs)
-        if signature == getattr(self, "_plot_signature", None):
+        if not force and \
+                signature == getattr(self, "_plot_signature", None):
             return None
         self._plot_signature = signature
         out = []

@@ -113,6 +113,12 @@ def beam_generate(wf, prompt, n_new, beam: int = 4,
     import jax.numpy as jnp
     if int(beam) < 1:
         raise ValueError("beam must be >= 1")
+    # beam > V would hit jax.lax.top_k(logp0, beam) with an opaque
+    # in-jit shape error; fail at the API boundary instead (ADVICE r4)
+    vocab = int(split_stack(list(wf.forwards))["head"].vocab_size)
+    if int(beam) > vocab:
+        raise ValueError("beam=%d exceeds the head's vocab size %d"
+                         % (int(beam), vocab))
     if int(n_new) < 1:
         raise ValueError("n_new must be >= 1")
     prompt = numpy.asarray(prompt, dtype=numpy.int32)

@@ -282,6 +282,14 @@ class TrainStep(AcceleratedUnit):
         if len(knobs) != 1:
             return reject("per-layer SGD knobs differ (uniform "
                           "lr/decay/momentum required)")
+        # the kernel bakes ONE (A, B) tanh scaling for the whole chain
+        # (fused_fc._kernel act_a/act_b) — a per-layer override would
+        # silently diverge from the scan trajectory while still
+        # claiming parity (ADVICE r4)
+        acts = {(float(f.A), float(f.B)) for f in fs[:-1]}
+        if len(acts) > 1:
+            return reject("per-layer tanh (A, B) scales differ "
+                          "(uniform activation required)")
         lr, lr_bias, wd, wd_bias, momentum = knobs.pop()
         if lr <= 0:
             return reject("non-positive learning rate")

@@ -95,17 +95,23 @@ def record(key: str, entry: dict, device_kind: Optional[str] = None,
            shipped: bool = False) -> None:
     """Persist ``entry`` under (device_kind, key). ``shipped=True``
     additionally updates the committed in-repo DB — chip measurement
-    batches only, so the repo ships what was actually measured."""
+    batches only, so the repo ships what was actually measured.
+    The read→merge→write is serialized through an flock'd sidecar so
+    concurrent sweeps in processes sharing one cache dir cannot drop
+    each other's entries."""
+    import fcntl
     kind = device_kind or current_device_kind()
     entry = dict(entry, ts=time.strftime("%Y-%m-%d %H:%M:%S"))
     for path in ([_user_path(), SHIPPED] if shipped else [_user_path()]):
         os.makedirs(os.path.dirname(path), exist_ok=True)
-        db = _read(path)
-        db.setdefault(kind, {})[key] = entry
-        tmp = path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(db, f, indent=1, sort_keys=True)
-        os.replace(tmp, path)
+        with open(path + ".lock", "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            db = _read(path)
+            db.setdefault(kind, {})[key] = entry
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(db, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
     _memo[(kind, key)] = (entry["block_q"], entry["block_k"])
 
 
@@ -139,26 +145,61 @@ def _time_flash(t: int, d: int, causal: bool,
     return (time.time() - t0) / 4
 
 
+def _bwd_compiles(t: int, d: int, causal: bool,
+                  blocks: Tuple[int, int]) -> bool:
+    """Whether the custom-VJP backward pair LOWERS at these blocks.
+    The sweep times only the forward, but _prepare feeds its winner to
+    the backward kernels too — whose VMEM working set is larger (q/do/
+    k/v blocks + dk/dv accumulators resident), so a forward-fine
+    (512, 512) can be a backward Mosaic OOM. Compile-only: no timing."""
+    import jax
+    import jax.numpy as jnp
+    import numpy
+    from .flash_attention import flash_attention
+    rng = numpy.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(1, t, 1, d), jnp.bfloat16)
+               for _ in range(3))
+    try:
+        jax.jit(jax.grad(
+            lambda q, k, v: flash_attention(
+                q, k, v, causal=causal, block_q=blocks[0],
+                block_k=blocks[1],
+                interpret=False).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))).lower(q, k, v).compile()
+        return True
+    except Exception:            # noqa: BLE001 — lowering/VMEM failure
+        return False
+
+
 def sweep_flash(t: int, d: int, causal: bool = True,
                 device_kind: Optional[str] = None,
                 measure: Optional[Callable] = None,
                 cands: Optional[Sequence[Tuple[int, int]]] = None,
-                persist: bool = True) -> Tuple[int, int]:
+                persist: bool = True,
+                check_bwd: Optional[Callable] = None) -> Tuple[int, int]:
     """Bounded block sweep for one shape class; persists and returns the
-    winner. ``measure(t, d, causal, blocks) -> seconds`` is injectable
-    (tests use a fake device_kind + fake measure to prove
-    persist/reuse without a chip)."""
+    winner — the fastest forward whose BACKWARD also compiles
+    (``_bwd_compiles``). ``measure(t, d, causal, blocks) -> seconds``
+    and ``check_bwd(t, d, causal, blocks) -> bool`` are injectable
+    (tests use a fake device_kind + fakes to prove persist/reuse
+    without a chip)."""
     measure = measure or _time_flash
-    best, best_dt = None, None
+    check_bwd = check_bwd or _bwd_compiles
     rows = {}
+    timed = []
     for blocks in (cands or candidates_for(t, d)):
         try:
             dt = measure(t, d, causal, blocks)
         except Exception:        # noqa: BLE001 — candidate didn't lower
             continue
         rows["%dx%d" % blocks] = round(dt * 1e3, 3)
-        if best_dt is None or dt < best_dt:
+        timed.append((dt, blocks))
+    best = best_dt = None
+    for dt, blocks in sorted(timed):
+        if blocks == DEFAULT_BLOCKS or check_bwd(t, d, causal, blocks):
             best, best_dt = blocks, dt
+            break
+        rows["%dx%d" % blocks] = "bwd_compile_failed"
     if best is None:
         raise RuntimeError("flash autotune: no candidate ran for "
                            "t=%d d=%d" % (t, d))
@@ -187,12 +228,25 @@ def flash_blocks(t: int, d: int, causal: bool = True, window: int = 0,
     memo_key = (kind, key)
     if memo_key in _memo:
         return _memo[memo_key] or DEFAULT_BLOCKS
+    import jax
+    multihost = jax.process_count() > 1
+    if multihost:
+        # every process of an SPMD program must trace IDENTICAL block
+        # shapes or the jobs' executables diverge and hang at the first
+        # collective — so multi-host reads ONLY the shipped (committed,
+        # host-identical) DB layer and never sweeps: per-host sweeps
+        # could pick different near-tied winners, and per-host user DBs
+        # can differ
+        hit = _read(SHIPPED).get(kind, {}).get(key)
+        blocks = DEFAULT_BLOCKS if hit is None else (
+            int(hit["block_q"]), int(hit["block_k"]))
+        _memo[memo_key] = blocks
+        return blocks
     hit = lookup(key, kind)
     if hit is not None:
         blocks = (int(hit["block_q"]), int(hit["block_k"]))
         _memo[memo_key] = blocks
         return blocks
-    import jax
     if mode != "auto" or jax.default_backend() != "tpu" or window:
         # windowed shapes reuse the causal entry's ranking if present,
         # else defaults — no dedicated sweep for every window size.
