@@ -1,0 +1,206 @@
+"""GenerationAPI (restful_api.py): the generation stack served over
+REST with micro-batched concurrent requests — greedy/sample/
+speculative/beam end-to-end, answers identical to solo decodes
+(reference equivalent: veles/restful_api.py:78 serving one forward per
+request; here the serving batch axis carries whole decodes)."""
+import json
+import threading
+import urllib.request
+import urllib.error
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import prng
+
+from conftest import import_model
+
+
+def _post(url, payload, timeout=60.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def served():
+    lm = import_model("char_lm")
+    prng.seed_all(777)
+    target = lm.build_workflow(epochs=2, minibatch_size=64, n_blocks=2,
+                               dim=32, n_train=256, n_valid=64)
+    target.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    target.run()
+    prng.seed_all(778)
+    draft = lm.build_workflow(epochs=1, minibatch_size=64, n_blocks=1,
+                              dim=16, n_train=256, n_valid=64)
+    draft.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    draft.run()
+    api = vt.GenerationAPI(target, draft=draft, port=0,
+                           batch_window=0.25, name="genapi")
+    api.initialize()
+    url = "http://127.0.0.1:%d/generate" % api.port
+    yield lm, target, draft, api, url
+    api.stop()
+
+
+def _prompt(lm, seed, length=12):
+    return [int(t) for t in
+            lm.make_corpus(numpy.random.RandomState(seed), length)]
+
+
+def test_greedy_roundtrip_matches_solo(served):
+    lm, target, draft, api, url = served
+    p = _prompt(lm, 1)
+    code, out = _post(url, {"prompt": p, "n_new": 12})
+    assert code == 200, out
+    assert out["tokens"] == lm.generate(target, p, 12, temperature=0)
+
+
+def test_concurrent_requests_micro_batch(served):
+    """Simultaneous same-shape greedy requests coalesce into ONE
+    batched decode, and every answer equals its solo decode."""
+    lm, target, draft, api, url = served
+    prompts = [_prompt(lm, s) for s in (2, 3, 4, 5)]
+    # warm the (batch=4, t_p, n_new) executable so the timed window
+    # isn't a compile
+    from veles_tpu.nn import sampling
+    sampling.generate(target, prompts, 10, temperature=0)
+    results = {}
+    barrier = threading.Barrier(len(prompts))
+
+    def fire(i):
+        barrier.wait()
+        results[i] = _post(url, {"prompt": prompts[i], "n_new": 10})
+
+    before = api.batches_run
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    for i, p in enumerate(prompts):
+        code, out = results[i]
+        assert code == 200, out
+        assert out["tokens"] == lm.generate(target, p, 10,
+                                            temperature=0)
+    assert api.max_batch >= 2          # coalescing actually happened
+    assert api.batches_run - before < len(prompts)
+
+
+def test_speculative_served(served):
+    lm, target, draft, api, url = served
+    p = _prompt(lm, 6)
+    code, out = _post(url, {"prompt": p, "n_new": 10,
+                            "mode": "speculative", "gamma": 3})
+    assert code == 200, out
+    assert out["tokens"] == lm.generate(target, p, 10, temperature=0)
+    assert 0.0 <= out["acceptance"] <= 1.0
+    assert out["rounds"] >= 1
+
+
+def test_beam_served(served):
+    lm, target, draft, api, url = served
+    from veles_tpu.nn.beam import beam_generate
+    p = _prompt(lm, 7)
+    code, out = _post(url, {"prompt": p, "n_new": 8, "mode": "beam",
+                            "beam": 3})
+    assert code == 200, out
+    want, _ = beam_generate(target, p, 8, beam=3)
+    assert out["tokens"] == want
+    assert len(out["scores"]) == 3
+
+
+def test_sample_mode_seeded(served):
+    lm, target, draft, api, url = served
+    p = _prompt(lm, 8)
+    code, out = _post(url, {"prompt": p, "n_new": 10, "mode": "sample",
+                            "temperature": 0.8, "seed": 42})
+    assert code == 200, out
+    from veles_tpu.nn import sampling
+    assert out["tokens"] == sampling.generate(target, p, 10,
+                                              temperature=0.8, seed=42)
+
+
+def test_bad_requests_rejected(served):
+    lm, target, draft, api, url = served
+    for payload, frag in (
+            ({"prompt": [], "n_new": 4}, "prompt"),
+            ({"prompt": [1, "x"], "n_new": 4}, "prompt"),
+            ({"prompt": [1, 2], "n_new": 0}, "n_new"),
+            ({"prompt": [1, 2], "n_new": 4, "mode": "magic"}, "mode"),
+            ({"prompt": [1, 2], "n_new": 4, "mode": "sample"},
+             "temperature"),
+            ({"prompt": [1, 2], "n_new": 4, "gamma": 0}, "gamma"),
+            ({"prompt": [1, 2], "n_new": 4, "temperature": None,
+              "mode": "sample"}, "non-numeric"),
+            ({"prompt": [1, 2], "n_new": 4, "seed": {}}, "non-numeric"),
+    ):
+        code, out = _post(url, payload)
+        assert code == 400, (payload, out)
+        assert frag in out["error"], (payload, out)
+
+
+def test_decoder_shape_errors_are_client_faults(served):
+    """ValueError raised by the decoder on a parsed request (beam
+    wider than vocab; generation beyond the positional table) must
+    come back 400, not 500."""
+    lm, target, draft, api, url = served
+    p = _prompt(lm, 9)
+    code, out = _post(url, {"prompt": p, "n_new": 4, "mode": "beam",
+                            "beam": 10_000})
+    assert code == 400, (code, out)
+    assert "vocab" in out["error"]
+
+
+def test_concurrent_stochastic_requests_stay_seed_deterministic(served):
+    """Two simultaneous mode=sample requests with the same seed must
+    each get their SOLO decode (stochastic requests never coalesce —
+    batch-shaped PRNG streams would make answers depend on who else
+    arrived)."""
+    lm, target, draft, api, url = served
+    from veles_tpu.nn import sampling
+    p1, p2 = _prompt(lm, 21), _prompt(lm, 22)
+    want = {0: sampling.generate(target, p1, 8, temperature=0.7,
+                                 seed=5),
+            1: sampling.generate(target, p2, 8, temperature=0.7,
+                                 seed=5)}
+    results = {}
+    barrier = threading.Barrier(2)
+
+    def fire(i, p):
+        barrier.wait()
+        results[i] = _post(url, {"prompt": p, "n_new": 8,
+                                 "mode": "sample", "temperature": 0.7,
+                                 "seed": 5})
+
+    threads = [threading.Thread(target=fire, args=(i, p))
+               for i, p in ((0, p1), (1, p2))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90)
+    for i in (0, 1):
+        code, out = results[i]
+        assert code == 200, out
+        assert out["tokens"] == want[i]
+
+
+def test_speculative_without_draft_rejected(served):
+    lm, target, draft, api, url = served
+    api2 = vt.GenerationAPI(target, draft=None, port=0, name="nodraft")
+    api2.initialize()
+    try:
+        code, out = _post(
+            "http://127.0.0.1:%d/generate" % api2.port,
+            {"prompt": [1, 2], "n_new": 4, "mode": "speculative"})
+        assert code == 400
+        assert "draft" in out["error"]
+    finally:
+        api2.stop()

@@ -82,7 +82,7 @@ from .plotter import Plotter, PlotSink                # noqa: F401
 from .plotting_units import (AccumulatingPlotter, MatrixPlotter,
                              ImagePlotter, Histogram, MultiHistogram,
                              TableMaxMin, StepStats)  # noqa: F401
-from .restful_api import RESTfulAPI                   # noqa: F401
+from .restful_api import GenerationAPI, RESTfulAPI    # noqa: F401
 from .publishing import Publisher                     # noqa: F401
 from .interaction import Shell                        # noqa: F401
 from .json_encoders import NumpyJSONEncoder           # noqa: F401

@@ -122,9 +122,9 @@ def _head_logits(stack, params, x):
             + params[head.name]["bias"])
 
 
-def _prefill(stack, params, prompt_ids):
-    """Full-window prefill of one model's caches; returns (caches,
-    next-token logits after the prompt)."""
+def _prefill_batch(stack, params, prompt_ids):
+    """Full-window prefill of one model's caches for a (B, T_p) prompt
+    batch; returns (caches, next-token logits (B, V))."""
     import jax.numpy as jnp
     from .sampling import _block_prefill
     x = _embed_at(stack, params, prompt_ids, 0)
@@ -138,7 +138,13 @@ def _prefill(stack, params, prompt_ids):
         cv = jnp.zeros((b, stack["t_max"], bkv, hd), x.dtype)
         x, ck, cv = _block_prefill(blk, params[blk.name], x, ck, cv)
         caches.append((ck, cv))
-    return tuple(caches), _head_logits(stack, params, x[:, -1])[0]
+    return tuple(caches), _head_logits(stack, params, x[:, -1])
+
+
+def _prefill(stack, params, prompt_ids):
+    """Single-sequence view of :func:`_prefill_batch` (row-0 logits)."""
+    caches, logits = _prefill_batch(stack, params, prompt_ids)
+    return caches, logits[0]
 
 
 def _stochastic_accept(key, pt, pd, d_toks):
@@ -172,20 +178,9 @@ def _stochastic_accept(key, pt, pd, d_toks):
     return a, fix
 
 
-def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma,
-                        temperature=0.0):
-    """Compile-once speculative decoder for one (prompt length, n_new,
-    gamma, temperature) shape. Whole generation = ONE device program
-    (while_loop over rounds); params of BOTH models are arguments.
-    ``temperature <= 0``: greedy, output bit-identical to the target's
-    own greedy decode. ``temperature > 0``: rejection-sampling
-    speculation — every emitted token is marginally distributed as the
-    target's softmax at that temperature (_stochastic_accept)."""
-    import jax
-    import jax.numpy as jnp
-    greedy = temperature <= 0
-    tau = float(temperature) if not greedy else 1.0
-
+def _spec_stacks(wf_target, wf_draft, t_p, n_new, gamma):
+    """Shared stack construction + positional-table validation for the
+    single-sequence and batched builders."""
     tgt = split_stack(list(wf_target.forwards))
     drf = split_stack(list(wf_draft.forwards))
     t_max = t_p + int(n_new) + int(gamma) + 1
@@ -198,7 +193,16 @@ def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma,
                 "%s PositionalEmbedding table (%d) is shorter than the "
                 "%d positions speculation can reach"
                 % (which, pe.param_arrays()["table"].shape[0], t_max))
-    n_buf = int(n_new) + int(gamma) + 1
+    return tgt, drf
+
+
+def _make_round_fns(tgt, drf, gamma, greedy, tau):
+    """The two halves of one speculation round, shared by the
+    single-sequence and batched (vmapped per row) programs. Both
+    operate on batch-1 operands: the batched path lifts each row's
+    caches to a singleton batch axis inside ``jax.vmap``."""
+    import jax
+    import jax.numpy as jnp
 
     def draft_propose(params_d, caches, tok, pos0, key):
         """gamma single-row draft steps: returns proposed tokens (g,),
@@ -240,6 +244,54 @@ def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma,
             new_caches.append((ck, cv))
         return _head_logits(tgt, params_t, x[0]) / tau, tuple(new_caches)
 
+    ar = jnp.arange(gamma)
+
+    def accept_emit(k_a, t_logits, pd, d_toks):
+        """Accept rule + emission arithmetic for one round — the ONE
+        copy both the solo and batched programs run, so their
+        bit-identity (the batched CI gate) cannot drift. Returns
+        ``(a, out_vec, n_emit, new_tok)``: accepted-prefix length, the
+        gamma-wide emission vector (d1..d_a then the correction), how
+        many tokens this round emits, and the next round's seed token.
+        All-accepted rounds emit exactly the gamma draft tokens (no
+        bonus — cache discipline, module docstring)."""
+        if greedy:
+            t_arg = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+            match = d_toks == t_arg                   # (g,)
+            # a = length of the accepted prefix of draft tokens
+            a = jnp.minimum(
+                jnp.argmin(match) + gamma * match.all(), gamma)
+            fix = t_arg[jnp.minimum(a, gamma - 1)]
+        else:
+            a, fix = _stochastic_accept(
+                k_a, jax.nn.softmax(t_logits, axis=-1), pd, d_toks)
+        out_vec = jnp.where(ar < a, d_toks,
+                            jnp.where(ar == a, fix, 0))
+        n_emit = jnp.minimum(a + 1, gamma)
+        new_tok = jnp.where(a < gamma, fix, d_toks[gamma - 1])
+        return a, out_vec, n_emit, new_tok
+
+    return draft_propose, target_verify, accept_emit
+
+
+def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma,
+                        temperature=0.0):
+    """Compile-once speculative decoder for one (prompt length, n_new,
+    gamma, temperature) shape. Whole generation = ONE device program
+    (while_loop over rounds); params of BOTH models are arguments.
+    ``temperature <= 0``: greedy, output bit-identical to the target's
+    own greedy decode. ``temperature > 0``: rejection-sampling
+    speculation — every emitted token is marginally distributed as the
+    target's softmax at that temperature (_stochastic_accept)."""
+    import jax
+    import jax.numpy as jnp
+    greedy = temperature <= 0
+    tau = float(temperature) if not greedy else 1.0
+    tgt, drf = _spec_stacks(wf_target, wf_draft, t_p, n_new, gamma)
+    n_buf = int(n_new) + int(gamma) + 1
+    draft_propose, target_verify, accept_emit = _make_round_fns(
+        tgt, drf, gamma, greedy, tau)
+
     @jax.jit
     def run(params_t, params_d, prompt_ids, key):
         caches_t, first_logits = _prefill(tgt, params_t, prompt_ids)
@@ -252,7 +304,6 @@ def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma,
                 sub, first_logits / tau).astype(jnp.int32)
         buf = jnp.zeros((n_buf,), jnp.int32)
         buf = buf.at[0].set(first)
-        ar = jnp.arange(gamma)
 
         def cond(carry):
             return carry[0] < n_new
@@ -266,23 +317,8 @@ def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma,
             window = jnp.concatenate([tok[None], d_toks[:-1]])
             t_logits, caches_t = target_verify(params_t, caches_t,
                                                window, pos)
-            if greedy:
-                t_arg = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
-                match = d_toks == t_arg                   # (g,)
-                # a = length of the accepted prefix of draft tokens
-                a = jnp.minimum(
-                    jnp.argmin(match) + gamma * match.all(), gamma)
-                fix = t_arg[jnp.minimum(a, gamma - 1)]
-            else:
-                a, fix = _stochastic_accept(
-                    k_a, jax.nn.softmax(t_logits, axis=-1), pd, d_toks)
-            # emitted tokens: d1..d_a then (a < gamma) the correction/
-            # resample; all-accepted rounds emit exactly the gamma
-            # draft tokens (no bonus — cache discipline, above)
-            out_vec = jnp.where(ar < a, d_toks,
-                                jnp.where(ar == a, fix, 0))
-            n_emit = jnp.minimum(a + 1, gamma)
-            new_tok = jnp.where(a < gamma, fix, d_toks[gamma - 1])
+            a, out_vec, n_emit, new_tok = accept_emit(k_a, t_logits,
+                                                      pd, d_toks)
             buf = jax.lax.dynamic_update_slice(buf, out_vec, (count,))
             return (count + n_emit, pos + n_emit, new_tok, buf,
                     caches_t, caches_d, rounds + 1, acc + a, key)
@@ -294,6 +330,91 @@ def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma,
         count, _, _, buf, _, _, rounds, acc, _ = jax.lax.while_loop(
             cond, body, carry)
         return buf[:n_new], rounds, acc
+
+    return run
+
+
+def _build_spec_sampler_batch(wf_target, wf_draft, t_p, n_new, gamma,
+                              temperature=0.0):
+    """Batched speculative decoder: B prompts decode concurrently with
+    PER-ROW accept-length divergence — each row carries its own
+    position/count/token and the round body is ``jax.vmap`` of the
+    single-row round, so rows advance by their own accepted lengths
+    while sharing every model dispatch. The loop runs until every row
+    has its n_new tokens; finished rows keep riding the batch (uniform
+    shapes) but are masked: they emit nothing, their position is
+    frozen, and their spurious buffer writes land in the scratch tail
+    beyond n_new (n_buf = n_new + gamma + 1 guarantees the clamped
+    write start ≥ n_new). Greedy mode: every row is bit-identical to
+    its own solo decode — vmap makes rows independent by construction
+    (CI-asserted)."""
+    import jax
+    import jax.numpy as jnp
+    greedy = temperature <= 0
+    tau = float(temperature) if not greedy else 1.0
+    tgt, drf = _spec_stacks(wf_target, wf_draft, t_p, n_new, gamma)
+    n_buf = int(n_new) + int(gamma) + 1
+    draft_propose, target_verify, accept_emit = _make_round_fns(
+        tgt, drf, gamma, greedy, tau)
+
+    def lift(cs):
+        return tuple((ck[None], cv[None]) for ck, cv in cs)
+
+    def unlift(cs):
+        return tuple((ck[0], cv[0]) for ck, cv in cs)
+
+    @jax.jit
+    def run(params_t, params_d, prompt_ids, keys):
+        """prompt_ids (B, t_p); keys (B, 2) — one PRNG stream per row."""
+        caches_t, first_logits = _prefill_batch(tgt, params_t,
+                                                prompt_ids)
+        caches_d, _ = _prefill_batch(drf, params_d, prompt_ids)
+        bsz = prompt_ids.shape[0]
+        if greedy:
+            first = jnp.argmax(first_logits, axis=-1).astype(jnp.int32)
+        else:
+            def first_sample(k, logits):
+                return jax.random.categorical(
+                    jax.random.fold_in(k, -1),
+                    logits / tau).astype(jnp.int32)
+            first = jax.vmap(first_sample)(keys, first_logits)
+        buf = jnp.zeros((bsz, n_buf), jnp.int32).at[:, 0].set(first)
+
+        def row_round(count, pos, tok, buf, ct, cd, rounds, acc, key):
+            key, k_d, k_a = jax.random.split(key, 3)
+            d_toks, pd, cd1 = draft_propose(params_d, lift(cd), tok,
+                                            pos, k_d)
+            window = jnp.concatenate([tok[None], d_toks[:-1]])
+            t_logits, ct1 = target_verify(params_t, lift(ct), window,
+                                          pos)
+            a, out_vec, n_emit, new_tok = accept_emit(k_a, t_logits,
+                                                      pd, d_toks)
+            # finished rows stay in the batch (uniform shapes) but are
+            # masked: no emission, frozen position/token; their buffer
+            # write lands in the scratch tail beyond n_new
+            done = count >= n_new
+            n_emit = jnp.where(done, 0, n_emit)
+            new_tok = jnp.where(done, tok, new_tok)
+            buf = jax.lax.dynamic_update_slice(buf, out_vec, (count,))
+            return (count + n_emit, pos + n_emit, new_tok, buf,
+                    unlift(ct1), unlift(cd1),
+                    rounds + jnp.where(done, 0, 1),
+                    acc + jnp.where(done, 0, a), key)
+
+        def cond(carry):
+            return jnp.any(carry[0] < n_new)
+
+        def body(carry):
+            return jax.vmap(row_round)(*carry)
+
+        carry = (jnp.full((bsz,), 1, jnp.int32),
+                 jnp.full((bsz,), t_p, jnp.int32),
+                 first, buf, caches_t, caches_d,
+                 jnp.zeros((bsz,), jnp.int32),
+                 jnp.zeros((bsz,), jnp.int32), keys)
+        count, _, _, buf, _, _, rounds, acc, _ = jax.lax.while_loop(
+            cond, body, carry)
+        return buf[:, :n_new], rounds, acc
 
     return run
 
@@ -313,36 +434,64 @@ def generate_speculative(wf_target, wf_draft, prompt, n_new,
     at that temperature (``_stochastic_accept``), regardless of draft
     quality (a bad draft only costs speed).
 
-    Single-sequence only (accepted counts diverge per row; batched
-    speculation needs per-row positions — out of scope)."""
+    ``prompt`` may be a flat id list (returns a flat token list) or a
+    batch of B EQUAL-LENGTH prompts (returns B lists): rows then
+    decode concurrently with per-row accept-length divergence
+    (``_build_spec_sampler_batch``) — in greedy mode each row is
+    bit-identical to its own solo decode. Batched stats carry per-row
+    ``acceptance``/``rounds`` lists plus their means."""
     import jax
     import jax.numpy as jnp
     if int(gamma) < 1:
         raise ValueError("gamma must be >= 1")
-    prompt = numpy.asarray(prompt, dtype=numpy.int32)
-    if prompt.ndim != 1:
-        raise VelesError("speculative decoding is single-sequence; "
-                         "got a batch")
-    t_p = len(prompt)
+    try:
+        prompt = numpy.asarray(prompt, dtype=numpy.int32)
+    except ValueError as e:
+        raise VelesError(
+            "batched speculation needs EQUAL-length prompts (pad or "
+            "group by length): %s" % e) from e
+    if prompt.ndim not in (1, 2):
+        raise VelesError("prompt must be a flat id list or a (B, T_p) "
+                         "batch")
+    batched = prompt.ndim == 2
+    t_p = prompt.shape[-1]
+    bsz = prompt.shape[0] if batched else 1
     cache = getattr(wf_target, "_spec_cache", None)
     if cache is None:
         cache = wf_target._spec_cache = {}
     # the DRAFT workflow rides in the cache value and is identity-
     # compared: an id()-keyed entry would survive the draft's death and
     # misfire on address reuse with a different architecture
-    key = (t_p, int(n_new), int(gamma), float(temperature))
+    key = (t_p, int(n_new), int(gamma), float(temperature),
+           bsz if batched else None)
     entry = cache.get(key)
     if entry is None or entry[0] is not wf_draft:
-        entry = cache[key] = (wf_draft, _build_spec_sampler(
+        builder = _build_spec_sampler_batch if batched \
+            else _build_spec_sampler
+        entry = cache[key] = (wf_draft, builder(
             wf_target, wf_draft, t_p, int(n_new), int(gamma),
             float(temperature)))
     run = entry[1]
 
     from .sampling import params_of
+    if not batched:
+        toks, rounds, acc = run(
+            params_of(wf_target), params_of(wf_draft),
+            jnp.asarray(prompt[None, :]), jax.random.PRNGKey(seed))
+        rounds = max(int(rounds), 1)
+        return ([int(t) for t in numpy.asarray(toks)],
+                {"rounds": rounds,
+                 "acceptance": float(acc) / (rounds * int(gamma))})
+    keys = jax.vmap(jax.random.fold_in, (None, 0))(
+        jax.random.PRNGKey(seed), jnp.arange(bsz))
     toks, rounds, acc = run(params_of(wf_target), params_of(wf_draft),
-                            jnp.asarray(prompt[None, :]),
-                            jax.random.PRNGKey(seed))
-    rounds = max(int(rounds), 1)
-    return ([int(t) for t in numpy.asarray(toks)],
-            {"rounds": rounds,
-             "acceptance": float(acc) / (rounds * int(gamma))})
+                            jnp.asarray(prompt), keys)
+    toks = numpy.asarray(toks)
+    rounds = numpy.maximum(numpy.asarray(rounds), 1)
+    acc = numpy.asarray(acc, dtype=numpy.float64)
+    per_row_acc = (acc / (rounds * int(gamma))).tolist()
+    return ([[int(t) for t in row] for row in toks],
+            {"rounds": [int(r) for r in rounds],
+             "acceptance": per_row_acc,
+             "mean_rounds": float(numpy.mean(rounds)),
+             "mean_acceptance": float(numpy.mean(per_row_acc))})
