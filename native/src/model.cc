@@ -1,9 +1,14 @@
 // Package loader + forward executor — the libVeles equivalent
 // (libVeles/src/workflow_loader.cc, workflow.cc, unit_factory.cc):
-// UnitFactory keyed by the package's unit "type" strings, buffer ping-pong
-// between units (the reference ran a MemoryOptimizer arena,
-// libVeles/src/memory_optimizer.h:43; two reusable buffers suffice for a
-// chain), std::thread batch splitting for the hot matmul/conv loops.
+// UnitFactory keyed by the package's unit "type" strings, std::thread
+// batch splitting for the hot matmul/conv loops. Execution covers
+// arbitrary DAGs (fan-in via "inputs" lists in contents.json, e.g.
+// input_joiner) with liveness-based buffer assignment — the reference's
+// MemoryOptimizer story (libVeles/src/memory_optimizer.h:43-52): each
+// unit's output buffer is refcounted by its consumers and returns to a
+// reuse pool the moment its last consumer has run, so peak memory is
+// the live set, not the unit count. Chain packages (no "inputs" keys)
+// degenerate to exactly two pooled buffers — the old ping-pong.
 
 #include "../include/veles_infer.h"
 
@@ -66,10 +71,20 @@ inline float Sigmoid(float v) { return 1.0f / (1.0f + std::exp(-v)); }
 
 struct Unit {
   std::string name, type;
+  // producer names ("@input" = the model input); empty = the previous
+  // unit in package order (chain packages predate the "inputs" key)
+  std::vector<std::string> inputs;
   std::map<std::string, NpyArray> params;
 
   virtual ~Unit() = default;
   virtual void Run(const Tensor &in, Tensor *out) = 0;
+  virtual void RunMulti(const std::vector<const Tensor *> &ins,
+                        Tensor *out) {
+    if (ins.size() != 1)
+      throw std::runtime_error(name + ": unit takes one input, got " +
+                               std::to_string(ins.size()));
+    Run(*ins[0], out);
+  }
 
   const NpyArray *Param(const std::string &key) const {
     auto it = params.find(key);
@@ -861,6 +876,38 @@ struct LMHead : Unit {
   }
 };
 
+struct InputJoiner : Unit {
+  // fan-in concat along features (veles_tpu/input_joiner.py: each
+  // input flattened to (batch, -1), joined on axis 1) — the unit that
+  // makes DAG execution observable from a package
+  void Run(const Tensor &in, Tensor *out) override {
+    RunMulti({&in}, out);
+  }
+  void RunMulti(const std::vector<const Tensor *> &ins,
+                Tensor *out) override {
+    if (ins.empty())
+      throw std::runtime_error(name + ": no inputs to join");
+    int batch = ins[0]->shape[0];
+    if (batch <= 0)  // size()/batch below would be a SIGFPE, not catchable
+      throw std::runtime_error(name + ": empty batch");
+    size_t width = 0;
+    for (const Tensor *t : ins) {
+      if (t->shape.empty() || t->shape[0] != batch)
+        throw std::runtime_error(name + ": input batch mismatch");
+      width += t->size() / static_cast<size_t>(batch);
+    }
+    out->Resize({batch, static_cast<int>(width)});
+    size_t off = 0;
+    for (const Tensor *t : ins) {
+      size_t w = t->size() / static_cast<size_t>(batch);
+      for (int b = 0; b < batch; ++b)
+        std::memcpy(out->data.data() + b * width + off,
+                    t->data.data() + b * w, w * sizeof(float));
+      off += w;
+    }
+  }
+};
+
 struct MeanPool : Unit {
   void Run(const Tensor &in, Tensor *out) override {
     int batch = in.shape[0], t = in.shape[1];
@@ -1113,6 +1160,7 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
     return u;
   }
   if (type == "mean_pool") return std::make_unique<MeanPool>();
+  if (type == "input_joiner") return std::make_unique<InputJoiner>();
   if (type == "pos_embedding") return std::make_unique<PosEmbedding>();
   if (type == "embedding") return std::make_unique<Embedding>();
   if (type == "lm_head") return std::make_unique<LMHead>();
@@ -1130,6 +1178,90 @@ std::unique_ptr<Unit> MakeUnit(const std::string &type, const Json &cfg) {
     return u;
   }
   throw std::runtime_error("unit factory: unsupported type " + type);
+}
+
+// ---------------------------------------------------------------------------
+// DAG executor with liveness-based buffer assignment (the reference's
+// MemoryOptimizer capability, libVeles/src/memory_optimizer.h:43-52)
+
+// Resolve each unit's producer indices (-1 = model input). Validates
+// topological order: every named input must be a PRECEDING unit.
+std::vector<std::vector<int>> ResolveGraph(
+    const std::vector<std::unique_ptr<Unit>> &units) {
+  std::vector<std::vector<int>> in_idx(units.size());
+  std::map<std::string, int> by_name;
+  for (size_t i = 0; i < units.size(); ++i) {
+    const Unit &u = *units[i];
+    if (u.inputs.empty()) {
+      in_idx[i].push_back(static_cast<int>(i) - 1);  // chain default
+    } else {
+      for (const std::string &nm : u.inputs) {
+        if (nm == "@input") {
+          in_idx[i].push_back(-1);
+          continue;
+        }
+        auto it = by_name.find(nm);
+        if (it == by_name.end())
+          throw std::runtime_error(
+              "unit " + u.name + ": input '" + nm +
+              "' is not a preceding unit (package units must be "
+              "topologically ordered)");
+        in_idx[i].push_back(it->second);
+      }
+    }
+    by_name[u.name] = static_cast<int>(i);
+  }
+  return in_idx;
+}
+
+// Run the whole graph; consumes `input`, fills `*final_out` with the
+// LAST unit's output. Buffers are refcounted by consumer count and
+// recycled through a pool the moment their last consumer ran.
+void ExecuteGraph(const std::vector<std::unique_ptr<Unit>> &units,
+                  Tensor input, Tensor *final_out) {
+  size_t n = units.size();
+  if (n == 0) {
+    *final_out = std::move(input);
+    return;
+  }
+  std::vector<std::vector<int>> in_idx = ResolveGraph(units);
+  std::vector<int> refs(n, 0);
+  int input_refs = 0;
+  for (size_t i = 0; i < n; ++i)
+    for (int j : in_idx[i]) {
+      if (j < 0)
+        ++input_refs;
+      else
+        ++refs[j];
+    }
+  refs[n - 1] += 1;  // the final output must survive the loop
+  std::vector<std::unique_ptr<Tensor>> pool;
+  std::vector<std::unique_ptr<Tensor>> live(n);
+  for (size_t i = 0; i < n; ++i) {
+    std::vector<const Tensor *> ins;
+    for (int j : in_idx[i])
+      ins.push_back(j < 0 ? &input : live[static_cast<size_t>(j)].get());
+    std::unique_ptr<Tensor> out;
+    if (pool.empty()) {
+      out = std::make_unique<Tensor>();
+    } else {
+      out = std::move(pool.back());
+      pool.pop_back();
+    }
+    units[i]->RunMulti(ins, out.get());
+    live[i] = std::move(out);
+    for (int j : in_idx[i]) {
+      if (j < 0) {
+        if (--input_refs == 0) {
+          input.data.clear();
+          input.data.shrink_to_fit();
+        }
+      } else if (--refs[static_cast<size_t>(j)] == 0) {
+        pool.push_back(std::move(live[static_cast<size_t>(j)]));
+      }
+    }
+  }
+  *final_out = std::move(*live[n - 1]);
 }
 
 }  // namespace
@@ -1160,26 +1292,34 @@ vi_model *vi_load(const char *package_dir) {
     veles::Json contents = veles::Json::Parse(ss.str());
 
     auto model = std::make_unique<vi_model>();
+    // v2 added per-unit "inputs" (DAG fan-in); a NEWER format may
+    // change semantics this reader cannot guess — refuse, don't garble
+    if (contents.Has("format_version") &&
+        contents["format_version"].AsInt() > 2)
+      throw std::runtime_error(
+          "package format v" +
+          std::to_string(contents["format_version"].AsInt()) +
+          " is newer than this runtime (v2)");
     model->input_shape = contents["input_shape"].AsIntVector();
     for (const auto &uj : contents["units"].arr) {
       auto unit = veles::MakeUnit(uj["type"].AsString(), uj["config"]);
       unit->name = uj["name"].AsString();
       unit->type = uj["type"].AsString();
+      if (uj.Has("inputs"))
+        for (const auto &nm : uj["inputs"].arr)
+          unit->inputs.push_back(nm.AsString());
       for (const auto &kv : uj["params"].obj)
         unit->params[kv.first] =
             veles::LoadNpy(dir + "/" + kv.second.AsString());
       model->units.push_back(std::move(unit));
     }
-    // probe output size with batch 1
-    veles::Tensor probe, next;
+    // probe output size with batch 1 (also validates the graph order)
+    veles::Tensor probe, result;
     std::vector<int> shape = model->input_shape;
     shape[0] = 1;
     probe.Resize(shape);
-    for (auto &u : model->units) {
-      u->Run(probe, &next);
-      std::swap(probe, next);
-    }
-    model->output_size = probe.size();
+    veles::ExecuteGraph(model->units, std::move(probe), &result);
+    model->output_size = result.size();
     return model.release();
   } catch (const std::exception &e) {
     veles::g_error = e.what();
@@ -1208,16 +1348,14 @@ const char *vi_unit_type(const vi_model *m, size_t idx) {
 
 int vi_run(vi_model *m, const float *in, size_t batch, float *out) {
   try {
-    veles::Tensor cur, next;
+    if (batch == 0) throw std::runtime_error("vi_run: empty batch");
+    veles::Tensor cur, result;
     std::vector<int> shape = m->input_shape;
     shape[0] = static_cast<int>(batch);
     cur.Resize(shape);
     std::memcpy(cur.data.data(), in, sizeof(float) * cur.size());
-    for (auto &u : m->units) {
-      u->Run(cur, &next);
-      std::swap(cur, next);
-    }
-    std::memcpy(out, cur.data.data(), sizeof(float) * cur.size());
+    veles::ExecuteGraph(m->units, std::move(cur), &result);
+    std::memcpy(out, result.data.data(), sizeof(float) * result.size());
     return 0;
   } catch (const std::exception &e) {
     veles::g_error = e.what();

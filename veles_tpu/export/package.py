@@ -26,7 +26,10 @@ import numpy
 
 from ..error import VelesError
 
-FORMAT_VERSION = 1
+#: v2: per-unit "inputs" producer lists (DAG topologies). A v1 chain
+#: reader would silently execute a fan-in package as a chain, so DAG
+#: packages MUST carry the bumped version and readers MUST check it.
+FORMAT_VERSION = 2
 
 
 def _write_zip(pkg_dir: str, path: str) -> None:
@@ -77,7 +80,8 @@ _EXPORT_KEYS = (
 )
 
 
-def _unit_entry(fwd, pkg_dir: str) -> Dict[str, Any]:
+def _unit_entry(fwd, pkg_dir: str,
+                inputs: Optional[List[str]] = None) -> Dict[str, Any]:
     cfg = {}
     for key in _EXPORT_KEYS:
         if hasattr(fwd, key):
@@ -87,26 +91,63 @@ def _unit_entry(fwd, pkg_dir: str) -> Dict[str, Any]:
             cfg[key] = val
     params = {}
     # export_param_arrays merges LoRA deltas into dense weights, so
-    # packages (and the C++ runtime) never see adapters
-    arrays = getattr(fwd, "export_param_arrays", fwd.param_arrays)()
+    # packages (and the C++ runtime) never see adapters. Parameter-free
+    # units (InputJoiner) export an empty params map.
+    arrays = getattr(fwd, "export_param_arrays",
+                     getattr(fwd, "param_arrays", dict))()
     for pname, arr in arrays.items():
         fname = "%s_%s.npy" % (fwd.name, pname)
         numpy.save(os.path.join(pkg_dir, fname),
                    numpy.ascontiguousarray(arr.map_read()))
         params[pname] = fname
-    return {"name": fwd.name, "type": fwd.MAPPING, "config": cfg,
-            "params": params}
+    entry = {"name": fwd.name, "type": fwd.MAPPING, "config": cfg,
+             "params": params}
+    if inputs is not None:
+        entry["inputs"] = list(inputs)
+    return entry
+
+
+def _graph_inputs(units, graph) -> List[List[str]]:
+    """Producer-name lists per unit: the explicit DAG when given, else
+    the chain (first unit reads "@input", each next the previous).
+    Validates names against package order (the executors require
+    topological order)."""
+    if graph is None:
+        return [["@input"] if i == 0 else [units[i - 1].name]
+                for i in range(len(units))]
+    seen = set()
+    out = []
+    for unit, ins in zip(units, graph):
+        for nm in ins:
+            if nm != "@input" and nm not in seen:
+                raise VelesError(
+                    "graph: unit %s input %r is not a preceding unit "
+                    "(export order must be topological)"
+                    % (unit.name, nm))
+        seen.add(unit.name)
+        out.append(list(ins))
+    return out
 
 
 def package_export(workflow, path: str,
                    input_shape: Optional[List[int]] = None,
-                   with_stablehlo: bool = True) -> str:
-    """Export the workflow's forward chain (reference:
-    Workflow.package_export, veles/workflow.py:868)."""
+                   with_stablehlo: bool = True,
+                   graph: Optional[List[List[str]]] = None) -> str:
+    """Export the workflow's forward graph (reference:
+    Workflow.package_export, veles/workflow.py:868).
+
+    ``graph``: optional explicit DAG — per forward unit, the list of
+    its producer names ("@input" = the workflow input), enabling
+    fan-in topologies (InputJoiner) beyond the default chain. Units
+    must be listed in topological order (the C++ executor refuses
+    forward references, native/src/model.cc ResolveGraph)."""
     forwards = getattr(workflow, "forwards", None)
     if not forwards:
         raise VelesError("workflow %s has no forward chain to export"
                          % workflow.name)
+    if graph is not None and len(graph) != len(forwards):
+        raise VelesError("graph needs one producer list per forward "
+                         "(%d != %d)" % (len(graph), len(forwards)))
     step = getattr(workflow, "train_step", None)
     if step is not None and step.params:
         step.sync_params_to_arrays()
@@ -121,7 +162,9 @@ def package_export(workflow, path: str,
             raise VelesError("cannot infer input shape; pass input_shape")
         input_shape = list(first.input.shape)
 
-    units = [_unit_entry(f, pkg_dir) for f in forwards]
+    in_names = _graph_inputs(forwards, graph)
+    units = [_unit_entry(f, pkg_dir, inputs=ins)
+             for f, ins in zip(forwards, in_names)]
     contents = {
         "format_version": FORMAT_VERSION,
         "workflow": workflow.name,
@@ -133,7 +176,7 @@ def package_export(workflow, path: str,
     if with_stablehlo:
         try:
             contents["stablehlo"] = _export_stablehlo(
-                forwards, input_shape, pkg_dir)
+                forwards, input_shape, pkg_dir, in_names)
         except Exception as e:  # noqa: BLE001 - optional artifact
             workflow.warning("stablehlo export skipped: %s", e)
     with open(os.path.join(pkg_dir, "contents.json"), "w") as fout:
@@ -146,20 +189,29 @@ def package_export(workflow, path: str,
     return pkg_dir
 
 
-def _export_stablehlo(forwards, input_shape, pkg_dir: str) -> str:
+def _export_stablehlo(forwards, input_shape, pkg_dir: str,
+                      in_names) -> str:
     """Serialize the composed forward as a portable XLA program
-    (the TPU-era replacement for shipping kernels: jax.export)."""
+    (the TPU-era replacement for shipping kernels: jax.export).
+    Walks the DAG: each unit reads its named producers' outputs."""
     import jax
     import jax.numpy as jnp
     from jax import export as jexport
 
-    params = [{k: v.device_view() for k, v in f.param_arrays().items()}
+    params = [{k: v.device_view()
+               for k, v in getattr(f, "param_arrays", dict)().items()}
               for f in forwards]
 
     def fwd(params, x):
-        for f, p in zip(forwards, params):
-            x = f.apply(p, x, train=False)
-        return x
+        env = {"@input": x}
+        for f, p, ins in zip(forwards, params, in_names):
+            xs = [env[nm] for nm in ins]
+            if getattr(f, "MAPPING", "") == "input_joiner":
+                out = f.apply(*xs)          # param-free fan-in concat
+            else:
+                out = f.apply(p, *xs, train=False)
+            env[f.name] = out
+        return out
 
     x_spec = jax.ShapeDtypeStruct(tuple(input_shape), jnp.float32)
     exported = jexport.export(jax.jit(fwd))(
@@ -188,6 +240,12 @@ def package_import(path: str) -> Dict[str, Any]:
     try:
         with open(os.path.join(path, "contents.json")) as fin:
             contents = json.load(fin)
+        version = int(contents.get("format_version", 1))
+        if version > FORMAT_VERSION:
+            raise VelesError(
+                "package format v%d is newer than this reader (v%d) — "
+                "refusing to guess its semantics"
+                % (version, FORMAT_VERSION))
         params: Dict[str, Dict[str, numpy.ndarray]] = {}
         for unit in contents["units"]:
             params[unit["name"]] = {
@@ -215,6 +273,8 @@ def run_package(path_or_pkg, batch: numpy.ndarray) -> numpy.ndarray:
     pkg = (package_import(path_or_pkg) if isinstance(path_or_pkg, str)
            else path_or_pkg)
     x = numpy.asarray(batch, dtype=numpy.float32)
+    env = {"@input": x}
+    prev = "@input"
     for unit in pkg["contents"]["units"]:
         cls = UnitRegistry.mapping[unit["type"]]
         obj = cls.__new__(cls)
@@ -224,5 +284,11 @@ def run_package(path_or_pkg, batch: numpy.ndarray) -> numpy.ndarray:
             setattr(obj, k, v)
         # minimal attrs some numpy_apply impls expect
         obj.name = unit["name"]
-        x = obj.numpy_apply(pkg["params"][unit["name"]], x)
+        # DAG-aware: "inputs" names preceding units ("@input" = the
+        # batch); absent = chain (previous unit) — old packages
+        ins = unit.get("inputs") or [prev]
+        xs = [env[nm] for nm in ins]
+        x = obj.numpy_apply(pkg["params"][unit["name"]], *xs)
+        env[unit["name"]] = x
+        prev = unit["name"]
     return x

@@ -41,6 +41,16 @@ class InputJoiner(AcceleratedUnit):
         return jnp.concatenate(
             [x.reshape(x.shape[0], -1) for x in xs], axis=1)
 
+    def numpy_apply(self, params, *xs):
+        """Package-executor twin of :meth:`apply` (export/package.py
+        run_package oracle; params is empty — the joiner is
+        parameter-free)."""
+        return numpy.concatenate(
+            [numpy.asarray(x).reshape(len(x), -1) for x in xs], axis=1)
+
+    def param_arrays(self):
+        return {}
+
     def xla_run(self) -> None:
         fn = self.jit("join", self.apply)
         self.output.assign_devmem(
