@@ -64,9 +64,12 @@ class CharLMLoader(FullBatchLoaderMSE):
 
 def build_workflow(epochs=10, minibatch_size=64, lr=0.003, n_blocks=2,
                    dim=32, n_train=1536, n_valid=256, text_file=None,
-                   seq_len=SEQ_LEN):
+                   seq_len=SEQ_LEN, arch="transformer"):
     """``text_file``: train on a real text file via TextFileLoader
-    (vocab sized to the corpus) instead of the generated grammar."""
+    (vocab sized to the corpus) instead of the generated grammar.
+    ``arch``: "transformer" (RoPE blocks) or "lstm" (stacked
+    return-sequences LSTMs — the recurrent family on the same LM
+    surface, so the rnn stack gets the same real-data quality gate)."""
     if text_file:
         from veles_tpu.loader import TextFileLoader
         # one cheap scan for the vocabulary (embedding/head sizes need
@@ -87,12 +90,22 @@ def build_workflow(epochs=10, minibatch_size=64, lr=0.003, n_blocks=2,
                               minibatch_size=minibatch_size,
                               name="chars")
         vocab = VOCAB
+    if arch not in ("transformer", "lstm"):
+        raise ValueError("arch must be 'transformer' or 'lstm', got %r"
+                         % (arch,))
+    if arch == "lstm":
+        body = [{"type": "lstm", "hidden_size": dim,
+                 "return_sequences": True, "solver": "adam",
+                 "learning_rate": lr, "name": "lstm%d" % i}
+                for i in range(n_blocks)]
+    else:
+        body = [{"type": "transformer_block", "n_heads": 4,
+                 "ffn_hidden": 2 * dim, "causal": True, "rope": True,
+                 "solver": "adam", "learning_rate": lr,
+                 "name": "blk%d" % i} for i in range(n_blocks)]
     layers = ([{"type": "embedding", "vocab_size": vocab, "dim": dim,
                 "solver": "adam", "learning_rate": lr}]
-              + [{"type": "transformer_block", "n_heads": 4,
-                  "ffn_hidden": 2 * dim, "causal": True, "rope": True,
-                  "solver": "adam", "learning_rate": lr,
-                  "name": "blk%d" % i} for i in range(n_blocks)]
+              + body
               + [{"type": "lm_head", "vocab_size": vocab,
                   "solver": "adam", "learning_rate": lr}])
     wf = nn.StandardWorkflow(

@@ -887,6 +887,8 @@ struct InputJoiner : Unit {
                 Tensor *out) override {
     if (ins.empty())
       throw std::runtime_error(name + ": no inputs to join");
+    if (ins[0]->shape.empty())  // validate BEFORE reading shape[0]
+      throw std::runtime_error(name + ": rank-0 input");
     int batch = ins[0]->shape[0];
     if (batch <= 0)  // size()/batch below would be a SIGFPE, not catchable
       throw std::runtime_error(name + ": empty batch");

@@ -211,28 +211,19 @@ def test_diabetes_regression_real_data_anchor():
     assert loader.class_lengths[1] == 89
 
 
-def test_char_lm_real_text_anchor(tmp_path):
-    """Attention-family anchor on REAL text (VERDICT r3 weak #8: no
-    attention stack had a real-data gate): a 2-block char transformer
-    trained on CPython's own pydoc topics (real English prose shipped
-    in every interpreter — deterministic in-image bytes) must beat
-    0.48 held-out next-char error AND the trigram-argmax baseline on
-    the SAME leak-free tail split — TextFileLoader's default
-    validation_ratio is 0.1, so the baseline trains on the first 90%
-    of chars and scores on the last 10% exactly like the model
-    (measured 2026-07-31: model 0.428, trigram ~0.57)."""
+def _pydoc_corpus_and_trigram(tmp_path):
+    """The ONE copy of the LM anchors' corpus + baseline convention
+    (transformer AND lstm gates must stay on the identical split):
+    CPython's pydoc topics, 120k chars, and the trigram-argmax error
+    on the SAME leak-free tail split the model sees —
+    TextFileLoader's default validation_ratio is 0.1, so the baseline
+    trains on the first 90% of chars and scores on the last 10%
+    exactly like the model. Returns (corpus_path, tri_err)."""
     from collections import Counter, defaultdict
-    from conftest import import_model
-    lm = import_model("char_lm")
-
     import pydoc_data.topics as topics
     text = "".join(v for _, v in sorted(topics.topics.items()))[:120_000]
     path = tmp_path / "pydoc_corpus.txt"
     path.write_text(text)
-
-    # MATCH the loader's split: TextFileLoader validation_ratio
-    # defaults to 0.1 (tail of the corpus) — the baseline must score
-    # on the same held-out region, not a wider tail
     cut = int(len(text) * 0.9)
     train, valid = text[:cut], text[cut:]
     tri = defaultdict(Counter)
@@ -240,7 +231,19 @@ def test_char_lm_real_text_anchor(tmp_path):
         tri[a + b][c] += 1
     hits = sum(1 for a, b, c in zip(valid, valid[1:], valid[2:])
                if tri[a + b] and tri[a + b].most_common(1)[0][0] == c)
-    tri_err = 1.0 - hits / (len(valid) - 2)
+    return path, 1.0 - hits / (len(valid) - 2)
+
+
+def test_char_lm_real_text_anchor(tmp_path):
+    """Attention-family anchor on REAL text (VERDICT r3 weak #8: no
+    attention stack had a real-data gate): a 2-block char transformer
+    trained on CPython's own pydoc topics (real English prose shipped
+    in every interpreter — deterministic in-image bytes) must beat
+    0.48 held-out next-char error AND the trigram-argmax baseline on
+    the same split (measured 2026-07-31: model 0.428, trigram ~0.57)."""
+    from conftest import import_model
+    lm = import_model("char_lm")
+    path, tri_err = _pydoc_corpus_and_trigram(tmp_path)
 
     prng.seed_all(11)
     wf = lm.build_workflow(epochs=24, minibatch_size=64, n_blocks=2,
@@ -250,3 +253,25 @@ def test_char_lm_real_text_anchor(tmp_path):
     res = wf.gather_results()
     assert res["best_err"] <= 0.48, res
     assert res["best_err"] < tri_err - 0.05, (res["best_err"], tri_err)
+
+
+def test_char_lstm_real_text_anchor(tmp_path):
+    """Recurrent-family anchor on REAL text (VERDICT r4 item 7: the
+    LSTM/RNN family was the last without a non-synthetic quality
+    gate): a 2-layer char-LSTM on the same CPython pydoc corpus and
+    leak-free 90/10 tail split as the transformer anchor must beat
+    0.51 held-out next-char error AND the trigram-argmax baseline by
+    >= 4 points (measured 2026-08-01: model 0.469 @ 40 epochs,
+    trigram 0.5653)."""
+    from conftest import import_model
+    lm = import_model("char_lm")
+    path, tri_err = _pydoc_corpus_and_trigram(tmp_path)
+
+    prng.seed_all(13)
+    wf = lm.build_workflow(epochs=40, minibatch_size=64, n_blocks=2,
+                           dim=64, text_file=str(path), arch="lstm")
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    res = wf.gather_results()
+    assert res["best_err"] <= 0.51, res
+    assert res["best_err"] < tri_err - 0.04, (res["best_err"], tri_err)

