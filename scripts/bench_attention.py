@@ -92,8 +92,8 @@ def main():
             results.append(r)
             print(json.dumps(r))
     if backend == "tpu":
-        from veles_tpu.config import root
-        min_t = int(root.common.engine.flash_attention_min_t or 0)
+        from veles_tpu.ops.autotune import resolved_min_t
+        min_t = resolved_min_t(64)
         # the regression gate applies where the framework actually
         # CHOOSES flash (T >= min_t); below the crossover the fused XLA
         # reference is the chosen path and flash merely must stay sane

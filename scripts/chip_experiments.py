@@ -500,6 +500,7 @@ def _attn_seed(results, dev):
         import re
         from veles_tpu.ops import autotune
         d_swept = ATTN_SWEEP_D
+        crossover = {}          # t -> flash beat fused (train-preferred)
         for t in sorted({r["t"] for r in results}):
             best = {}              # train_mode -> (ms, bq, bk)
             for r in results:
@@ -517,6 +518,15 @@ def _attn_seed(results, dev):
             if pick is None:
                 continue
             ms, bq, bk = pick
+            # flash-vs-fused verdict at this T, same mode as the pick
+            mode_rows = [r for r in results if r["t"] == t
+                         and r["train"] == (True in best)]
+            fused = min((r["variants"].get("fused_xla", {}).get("ms")
+                         for r in mode_rows
+                         if r["variants"].get("fused_xla", {}).get("ms")
+                         is not None), default=None)
+            if fused is not None:
+                crossover[t] = ms < fused
             try:
                 autotune.record(
                     autotune.flash_key(t, d_swept, True),
@@ -529,6 +539,33 @@ def _attn_seed(results, dev):
             except Exception as e:        # noqa: BLE001
                 print("  autotune seeding failed for t=%d: %s"
                       % (t, e), flush=True)
+        # persist the MEASURED flash-vs-fused crossover: the smallest
+        # swept T where tuned flash beat the fused-XLA reference AND no
+        # larger swept T measured a loss — 't >= min_t' routes every
+        # longer length to flash, so a win below a measured loss must
+        # not open the gate over that loss (the r3 0.62x-at-2048 regime
+        # gets re-gated by measurement, not by a hand-set constant).
+        # choose_flash's "auto" mode reads this.
+        losses = [t for t, won in crossover.items() if not won]
+        floor = max(losses) if losses else -1
+        wins = sorted(t for t, won in crossover.items()
+                      if won and t > floor)
+        if crossover:
+            min_t = wins[0] if wins else autotune.NEVER
+            try:
+                autotune.record(
+                    autotune.min_t_key(d_swept),
+                    {"min_t": min_t,
+                     "mode": "attn_sweep_crossover",
+                     "swept": {str(t): bool(w)
+                               for t, w in sorted(crossover.items())}},
+                    shipped=True)
+                print("  autotune seeded flash_min_t d=%d -> %s"
+                      % (d_swept,
+                         "never" if min_t == autotune.NEVER else min_t),
+                      flush=True)
+            except Exception as e:        # noqa: BLE001
+                print("  min_t seeding failed: %s" % e, flush=True)
 
 
 def sec_generation(bench, dev, n):
@@ -606,6 +643,38 @@ def sec_generation(bench, dev, n):
             print("  beam %dx%d: %s tok/s"
                   % (n_blocks, dim, rows[-1]["beam_tok_s"]),
                   flush=True)
+            # batched serving throughput (r5): 8 prompts ride ONE
+            # batched cached decode and ONE batched speculative decode
+            # — total tok/s vs the single-row numbers above quantifies
+            # the GenerationAPI micro-batch win on this chip
+            prompts8 = [list(lm.make_corpus(
+                numpy.random.RandomState(100 + i), 24))
+                for i in range(8)]
+            sampling.generate(wf, prompts8, n_new, temperature=0)
+            t0 = time.time()
+            for _ in range(reps):
+                sampling.generate(wf, prompts8, n_new, temperature=0)
+            dt = (time.time() - t0) / reps
+            rows.append({"n_blocks": n_blocks, "dim": dim,
+                         "n_new": n_new, "batch": 8,
+                         "cached_tok_s_total": round(8 * n_new / dt, 1)})
+            print("  gen batch8 %dx%d: %s tok/s total"
+                  % (n_blocks, dim, rows[-1]["cached_tok_s_total"]),
+                  flush=True)
+            generate_speculative(wf, draft, prompts8, n_new, gamma=4)
+            t0 = time.time()
+            for _ in range(reps):
+                _, bstats = generate_speculative(wf, draft, prompts8,
+                                                 n_new, gamma=4)
+            dt = (time.time() - t0) / reps
+            rows.append({"n_blocks": n_blocks, "dim": dim,
+                         "n_new": n_new, "batch": 8, "gamma": 4,
+                         "spec_tok_s_total": round(8 * n_new / dt, 1),
+                         "mean_acceptance": round(
+                             bstats["mean_acceptance"], 3)})
+            print("  spec batch8 %dx%d: %s tok/s total acc=%s"
+                  % (n_blocks, dim, rows[-1]["spec_tok_s_total"],
+                     rows[-1]["mean_acceptance"]), flush=True)
     return rows
 
 

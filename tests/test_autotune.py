@@ -162,3 +162,100 @@ def test_flash_attention_resolves_db_blocks(tuned_env, monkeypatch):
     assert seen["blocks"] == (256, 128)
     ref = attention_reference(q, k, v, causal=True)
     assert float(jnp.max(jnp.abs(o - ref))) < 2e-3
+
+
+def test_flash_min_t_lookup(tuned_env):
+    assert autotune.flash_min_t(64) == 4096      # default until swept
+    autotune.record(autotune.min_t_key(64), {"min_t": 2048})
+    assert autotune.flash_min_t(64) == 2048
+
+
+def test_choose_flash_auto_reads_measured_crossover(tuned_env,
+                                                    monkeypatch):
+    import jax
+    from veles_tpu.ops import flash_attention as fa
+    monkeypatch.setattr(root.common.engine, "flash_attention", True,
+                        raising=False)
+    monkeypatch.setattr(root.common.engine, "flash_attention_min_t",
+                        "auto", raising=False)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    autotune.record(autotune.min_t_key(64), {"min_t": 1024})
+    assert fa.choose_flash(1024, 64)
+    assert not fa.choose_flash(512, 64)
+    # an explicit int still pins the gate over the DB
+    monkeypatch.setattr(root.common.engine, "flash_attention_min_t",
+                        256, raising=False)
+    assert fa.choose_flash(512, 64)
+
+
+def test_attn_seed_derives_blocks_and_min_t(tuned_env):
+    """The chip attn sweep's seeding: block winners per T (train mode
+    preferred) AND the measured flash-vs-fused crossover land in the
+    DB so production gates update by measurement."""
+    import importlib.util
+    import os as _os
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "ce", _os.path.join(repo, "scripts", "chip_experiments.py"))
+    ce = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ce)
+    results = [
+        # t=2048: tuned flash (2.0) LOSES to fused (1.0) in train mode
+        {"t": 2048, "b": 16, "train": True, "variants": {
+            "fused_xla": {"ms": 1.0}, "flash_128x128": {"ms": 3.0},
+            "flash_256x128": {"ms": 2.0}}},
+        # t=8192: tuned flash (7.0) WINS vs fused (10.0)
+        {"t": 8192, "b": 1, "train": True, "variants": {
+            "fused_xla": {"ms": 10.0}, "flash_512x512": {"ms": 7.0}}},
+    ]
+
+    class Dev:
+        platform = "tpu"
+
+    ce._attn_seed(results, Dev())
+    assert autotune.flash_blocks(2048, 64) == (256, 128)
+    assert autotune.flash_blocks(8192, 64) == (512, 512)
+    assert autotune.flash_min_t(64) == 8192
+    entry = autotune.lookup(autotune.min_t_key(64))
+    assert entry["swept"] == {"2048": False, "8192": True}
+
+
+def test_flash_min_t_multihost_reads_shipped_only(tuned_env,
+                                                  monkeypatch):
+    """Same invariant as block lookup: under multi-host every process
+    must resolve the same gate, so per-host user caches are ignored."""
+    import jax
+    autotune.record(autotune.min_t_key(64), {"min_t": 1024})  # user
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    autotune.clear_memo()
+    assert autotune.flash_min_t(64) == 4096      # shipped empty
+    shipped = {"faketpu-v0": {"flash_min_t_d64": {"min_t": 2048}}}
+    with open(autotune.SHIPPED, "w") as f:
+        json.dump(shipped, f)
+    autotune.clear_memo()
+    assert autotune.flash_min_t(64) == 2048
+
+
+def test_attn_seed_min_t_respects_losses_above_wins(tuned_env):
+    """A win at a SMALL T below a measured loss at a larger T must not
+    open the `t >= min_t` gate over the loss: min_t only opens above
+    the largest losing length."""
+    import importlib.util
+    import os as _os
+    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "ce", _os.path.join(repo, "scripts", "chip_experiments.py"))
+    ce = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ce)
+    results = [
+        {"t": 2048, "b": 16, "train": True, "variants": {
+            "fused_xla": {"ms": 3.0}, "flash_128x128": {"ms": 2.0}}},
+        {"t": 8192, "b": 1, "train": True, "variants": {
+            "fused_xla": {"ms": 5.0}, "flash_128x128": {"ms": 9.0}}},
+    ]
+
+    class Dev:
+        platform = "tpu"
+
+    ce._attn_seed(results, Dev())
+    assert autotune.flash_min_t(64) == autotune.NEVER

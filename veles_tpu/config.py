@@ -172,11 +172,15 @@ def _default_root() -> Config:
             # (slow — test harness use only)
             "flash_attention": True,
             # below this sequence length the fused-XLA reference wins on
-            # the MXU (measured on v5e: naive 4.7 vs flash 2.9 TFLOP/s
-            # at T=2048; flash 12.6x faster at T=8192 where naive's
-            # (T,T) scores saturate HBM — docs/perf.md); "force" mode
-            # ignores the threshold
-            "flash_attention_min_t": 4096,
+            # the MXU. "auto" (default) = the per-device MEASURED
+            # crossover from the chip attn sweep (ops/autotune.py
+            # flash_min_t; falls back to the v5e-measured 4096 — naive
+            # 4.7 vs flash 2.9 TFLOP/s at T=2048, flash 12.6x at
+            # T=8192 where naive's (T,T) scores saturate HBM,
+            # docs/perf.md — until a sweep has run on this
+            # device_kind); an int pins it; "force" engine mode
+            # ignores the threshold entirely
+            "flash_attention_min_t": "auto",
             # long-context scheme over the 'sequence' mesh axis:
             # "ring" (K/V rotation, memory-flat in T) or "ulysses"
             # (all-to-all head re-sharding; needs heads % n_seq == 0)

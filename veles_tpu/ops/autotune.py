@@ -112,7 +112,56 @@ def record(key: str, entry: dict, device_kind: Optional[str] = None,
             with open(tmp, "w") as f:
                 json.dump(db, f, indent=1, sort_keys=True)
             os.replace(tmp, path)
-    _memo[(kind, key)] = (entry["block_q"], entry["block_k"])
+    if "block_q" in entry:
+        _memo[(kind, key)] = (entry["block_q"], entry["block_k"])
+    elif "min_t" in entry:          # refresh the crossover memo too
+        _memo[(kind, key, "min_t")] = int(entry["min_t"])
+
+
+#: sentinel "flash never won a swept length on this device" — keeps
+#: the fused-XLA reference in charge without disabling the config knob
+NEVER = 1 << 30
+
+
+def min_t_key(d: int) -> str:
+    return "flash_min_t_d%d" % d
+
+
+def flash_min_t(d: int, device_kind: Optional[str] = None,
+                default: int = 4096) -> int:
+    """The measured flash-vs-fused crossover length for this
+    device_kind (seeded by the chip attn sweep — the reference
+    persisted measured per-device decisions the same way,
+    `veles/backends.py:623-731`); ``default`` (the v5e-measured 4096,
+    docs/perf.md) until a sweep has run here. Memoized (this runs per
+    attention layer per trace), and under multi-host it reads ONLY the
+    shipped layer — same invariant as ``flash_blocks``: every SPMD
+    process must resolve the same gate or traced programs diverge."""
+    kind = device_kind or current_device_kind()
+    key = min_t_key(d)
+    memo_key = (kind, key, "min_t")
+    if memo_key in _memo:
+        return _memo[memo_key]
+    import jax
+    if jax.process_count() > 1:
+        hit = _read(SHIPPED).get(kind, {}).get(key)
+    else:
+        hit = lookup(key, kind)
+    val = default if hit is None else int(hit["min_t"])
+    _memo[memo_key] = val
+    return val
+
+
+def resolved_min_t(d: int, device_kind: Optional[str] = None) -> int:
+    """The ONE resolution of ``engine.flash_attention_min_t`` shared by
+    the production gate (``choose_flash``) and the bench gate
+    (scripts/bench_attention.py): ``"auto"``/None → the measured
+    per-device crossover, an int pins it."""
+    from ..config import root
+    cfg = root.common.engine.get("flash_attention_min_t", "auto")
+    if cfg in (None, "auto"):
+        return flash_min_t(d, device_kind)
+    return int(cfg or 0)
 
 
 def candidates_for(t: int, d: int) -> Tuple[Tuple[int, int], ...]:

@@ -522,8 +522,13 @@ def choose_flash(t: int, d: int) -> bool:
         return False
     if cfg == "force":
         return True
-    min_t = int(root.common.engine.get("flash_attention_min_t", 0) or 0)
-    return jax.default_backend() == "tpu" and t >= min_t
+    if jax.default_backend() != "tpu":
+        return False          # before any DB read — off-TPU never flash
+    # per-device measured crossover (seeded by the chip attn sweep;
+    # v5e-measured 4096 until then); one resolver shared with the
+    # bench gate
+    from .autotune import resolved_min_t
+    return t >= resolved_min_t(d)
 
 
 def _prepare(q, k, v, scale, block_q, block_k, interpret, caller,
