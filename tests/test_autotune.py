@@ -188,17 +188,27 @@ def test_choose_flash_auto_reads_measured_crossover(tuned_env,
     assert fa.choose_flash(512, 64)
 
 
+def _load_chip_experiments():
+    """scripts/ is not a package; the seeding tests import the chip
+    batch module by path (one copy of the boilerplate)."""
+    import importlib.util
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "ce", os.path.join(repo, "scripts", "chip_experiments.py"))
+    ce = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ce)
+    return ce
+
+
+class _TpuDev:
+    platform = "tpu"
+
+
 def test_attn_seed_derives_blocks_and_min_t(tuned_env):
     """The chip attn sweep's seeding: block winners per T (train mode
     preferred) AND the measured flash-vs-fused crossover land in the
     DB so production gates update by measurement."""
-    import importlib.util
-    import os as _os
-    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
-    spec = importlib.util.spec_from_file_location(
-        "ce", _os.path.join(repo, "scripts", "chip_experiments.py"))
-    ce = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(ce)
+    ce = _load_chip_experiments()
     results = [
         # t=2048: tuned flash (2.0) LOSES to fused (1.0) in train mode
         {"t": 2048, "b": 16, "train": True, "variants": {
@@ -209,10 +219,7 @@ def test_attn_seed_derives_blocks_and_min_t(tuned_env):
             "fused_xla": {"ms": 10.0}, "flash_512x512": {"ms": 7.0}}},
     ]
 
-    class Dev:
-        platform = "tpu"
-
-    ce._attn_seed(results, Dev())
+    ce._attn_seed(results, _TpuDev())
     assert autotune.flash_blocks(2048, 64) == (256, 128)
     assert autotune.flash_blocks(8192, 64) == (512, 512)
     assert autotune.flash_min_t(64) == 8192
@@ -240,13 +247,7 @@ def test_attn_seed_min_t_respects_losses_above_wins(tuned_env):
     """A win at a SMALL T below a measured loss at a larger T must not
     open the `t >= min_t` gate over the loss: min_t only opens above
     the largest losing length."""
-    import importlib.util
-    import os as _os
-    repo = _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__)))
-    spec = importlib.util.spec_from_file_location(
-        "ce", _os.path.join(repo, "scripts", "chip_experiments.py"))
-    ce = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(ce)
+    ce = _load_chip_experiments()
     results = [
         {"t": 2048, "b": 16, "train": True, "variants": {
             "fused_xla": {"ms": 3.0}, "flash_128x128": {"ms": 2.0}}},
@@ -254,8 +255,5 @@ def test_attn_seed_min_t_respects_losses_above_wins(tuned_env):
             "fused_xla": {"ms": 5.0}, "flash_128x128": {"ms": 9.0}}},
     ]
 
-    class Dev:
-        platform = "tpu"
-
-    ce._attn_seed(results, Dev())
+    ce._attn_seed(results, _TpuDev())
     assert autotune.flash_min_t(64) == autotune.NEVER

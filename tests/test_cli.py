@@ -239,3 +239,114 @@ def test_cli_optimize_workers_with_trial_devices(tmp_path):
     assert res["evaluations"] == 2
     # children actually trained on their slices, not silently failed
     assert res["best_fitness"] > -0.75, res
+
+
+_SERVE_LM_MODEL = textwrap.dedent("""
+    import numpy
+    from veles_tpu import nn
+    from veles_tpu.loader import FullBatchLoaderMSE
+
+    class L(FullBatchLoaderMSE):
+        hide_from_registry = True
+        def load_data(self):
+            rng = numpy.random.RandomState(0)
+            stream = rng.randint(0, 8, 64 * 16 + 1).astype(
+                numpy.int32)
+            self.create_originals(
+                stream[:-1].reshape(64, 16), None,
+                targets=stream[1:].reshape(64, 16))
+            self.class_lengths = [0, 16, 48]
+
+    def build_workflow():
+        return nn.StandardWorkflow(
+            name="srv-lm-%(tag)s",
+            layers=[{"type": "embedding", "vocab_size": 8,
+                     "dim": %(dim)d},
+                    {"type": "transformer_block", "n_heads": 2,
+                     "ffn_hidden": %(ffn)d, "causal": True,
+                     "rope": True}] * %(blocks)d
+                   + [{"type": "lm_head", "vocab_size": 8}],
+            loader_unit=L(None, minibatch_size=16, name="l"),
+            loss_function="softmax_seq",
+            decision_config=dict(max_epochs=1))
+""")
+
+
+def _serve_and_post(argv, payload, tmp_path):
+    """Start `python -m veles_tpu ... --serve-generate 0`, learn the
+    port from the scriptable SERVING line (no bind-then-close port
+    race), POST once, SIGINT, return (response, stdout, returncode)."""
+    import json as _json
+    import signal
+    import time
+    import urllib.request
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "veles_tpu", *argv,
+         "--serve-generate", "0", "--backend", "cpu"],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        text=True, env=env)
+    try:
+        port = None
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                raise AssertionError(
+                    "server died:\n" + proc.communicate()[0][-3000:])
+            if line.startswith("SERVING port="):
+                port = int(line.strip().split("=", 1)[1])
+                break
+        assert port is not None, "no SERVING line before deadline"
+        req = urllib.request.Request(
+            "http://127.0.0.1:%d/generate" % port,
+            data=_json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            out = _json.loads(r.read())
+        proc.send_signal(signal.SIGINT)
+        stdout, _ = proc.communicate(timeout=60)
+        return out, stdout, proc.returncode
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.communicate()
+
+
+def test_cli_serve_generate(tmp_path):
+    """--serve-generate: the CLI face of GenerationAPI — train briefly,
+    serve, answer a greedy request over real HTTP, stop on SIGINT."""
+    model = tmp_path / "lm_model.py"
+    model.write_text(_SERVE_LM_MODEL
+                     % {"tag": "t", "dim": 16, "ffn": 32, "blocks": 1})
+    out, stdout, rc = _serve_and_post(
+        [str(model)], {"prompt": [1, 2, 3], "n_new": 5}, tmp_path)
+    assert len(out["tokens"]) == 5, out
+    assert rc == 0, stdout[-2000:]
+
+
+def test_cli_serve_generate_with_draft(tmp_path):
+    """--serve-draft wires a second model so mode=speculative works
+    end-to-end from the CLI (without it, speculative is a 400)."""
+    target = tmp_path / "target.py"
+    target.write_text(_SERVE_LM_MODEL
+                      % {"tag": "tg", "dim": 16, "ffn": 32,
+                         "blocks": 2})
+    draft = tmp_path / "draft.py"
+    draft.write_text(_SERVE_LM_MODEL
+                     % {"tag": "dr", "dim": 8, "ffn": 16, "blocks": 1})
+    out, stdout, rc = _serve_and_post(
+        [str(target), "--serve-draft", str(draft)],
+        {"prompt": [1, 2, 3], "n_new": 6, "mode": "speculative",
+         "gamma": 2}, tmp_path)
+    assert len(out["tokens"]) == 6, out
+    assert 0.0 <= out["acceptance"] <= 1.0, out
+    assert rc == 0, stdout[-2000:]
+
+
+def test_cli_serve_generate_rejects_non_lm(tiny_model):
+    r = run_cli(tiny_model, "--serve-generate", "0")
+    assert r.returncode != 0
+    # split_stack's reason, raised at startup — not a 500 per request
+    assert "cached sampling supports" in (r.stderr + r.stdout)

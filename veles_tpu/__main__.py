@@ -224,6 +224,40 @@ def _drive(launcher: Launcher, workflow, args):
     if args.dry_run:
         launcher.info("dry run: initialize OK (%d units)", len(workflow))
         return None
+    if args.serve_generate is not None:
+        # serve the (optionally snapshot-restored) model instead of
+        # training: the CLI face of GenerationAPI. Validate the stack
+        # NOW so a non-LM workflow fails with the split_stack reason,
+        # not a 500 on the first request.
+        from .nn.sampling import split_stack
+        from .restful_api import GenerationAPI
+        split_stack(list(workflow.forwards))
+        draft = None
+        if args.serve_draft:
+            draft_mod = import_file_as_module(args.serve_draft)
+            draft = draft_mod.build_workflow()
+            draft.initialize(device=launcher.device)
+            if args.serve_draft_snapshot:
+                from .snapshotter import resume as snap_resume
+                snap_resume(draft, args.serve_draft_snapshot)
+            split_stack(list(draft.forwards))
+        api = GenerationAPI(workflow, draft=draft,
+                            port=args.serve_generate,
+                            name="serve_generate")
+        api.initialize()
+        launcher.info("generation serving on "
+                      "http://127.0.0.1:%d/generate — Ctrl-C to stop",
+                      api.port)
+        print("SERVING port=%d" % api.port, flush=True)  # scriptable
+        import time as _time
+        try:
+            while True:
+                _time.sleep(1.0)
+        except KeyboardInterrupt:
+            launcher.info("serving stopped")
+        finally:
+            api.stop()
+        return None
     results = launcher.run()
     if args.timings:
         launcher.print_stats()

@@ -65,6 +65,21 @@ def make_parser() -> argparse.ArgumentParser:
                         "(see python -m veles_tpu.web_status)")
     p.add_argument("--status-interval", type=float, default=10.0,
                    help="beacon period in seconds")
+    p.add_argument("--serve-generate", type=int, default=None,
+                   metavar="PORT",
+                   help="after initialize (and optional --snapshot "
+                        "resume), serve the workflow's generation stack "
+                        "over HTTP instead of training (GenerationAPI: "
+                        "greedy/sample/beam, micro-batched; + "
+                        "speculative when --serve-draft is given); "
+                        "0 picks an ephemeral port; Ctrl-C stops")
+    p.add_argument("--serve-draft", default=None, metavar="MODEL_PY",
+                   help="draft model .py for mode=speculative under "
+                        "--serve-generate (its build_workflow() is "
+                        "initialized on the same backend)")
+    p.add_argument("--serve-draft-snapshot", default=None,
+                   help="snapshot to restore the --serve-draft model "
+                        "from before serving")
     # multi-host (replaces master/slave -l/-m, veles/launcher.py:193-267)
     p.add_argument("--coordinator", default=None,
                    help="host:port of the jax distributed coordinator")
