@@ -288,16 +288,29 @@ def _serve_and_post(argv, payload, tmp_path):
         cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True, env=env)
     try:
+        import select
         port = None
         deadline = time.time() + 180
-        while time.time() < deadline:
-            line = proc.stdout.readline()
-            if not line:
+        buf = ""
+        while time.time() < deadline and port is None:
+            # select makes the deadline REAL: a wedged server that
+            # prints nothing must fail the test, not hang readline()
+            ready, _, _ = select.select([proc.stdout], [], [], 1.0)
+            if not ready:
+                if proc.poll() is not None:
+                    raise AssertionError(
+                        "server died:\n" + proc.communicate()[0][-3000:])
+                continue
+            chunk = os.read(proc.stdout.fileno(), 4096).decode(
+                errors="replace")
+            if not chunk:
                 raise AssertionError(
                     "server died:\n" + proc.communicate()[0][-3000:])
-            if line.startswith("SERVING port="):
-                port = int(line.strip().split("=", 1)[1])
-                break
+            buf += chunk
+            for line in buf.splitlines():
+                if line.startswith("SERVING port="):
+                    port = int(line.strip().split("=", 1)[1])
+                    break
         assert port is not None, "no SERVING line before deadline"
         req = urllib.request.Request(
             "http://127.0.0.1:%d/generate" % port,
@@ -350,3 +363,10 @@ def test_cli_serve_generate_rejects_non_lm(tiny_model):
     assert r.returncode != 0
     # split_stack's reason, raised at startup — not a 500 per request
     assert "cached sampling supports" in (r.stderr + r.stdout)
+
+
+def test_cli_serve_draft_snapshot_requires_draft(tiny_model):
+    r = run_cli(tiny_model, "--serve-generate", "0",
+                "--serve-draft-snapshot", "x.pickle.gz")
+    assert r.returncode != 0
+    assert "--serve-draft" in (r.stderr + r.stdout)

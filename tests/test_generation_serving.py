@@ -204,3 +204,30 @@ def test_speculative_without_draft_rejected(served):
         assert "draft" in out["error"]
     finally:
         api2.stop()
+
+
+def test_eos_id_truncates_and_does_not_fragment_batch(served):
+    """eos_id stops output at the first stop token (inclusive) without
+    entering the batch key — two requests differing only in eos_id may
+    share one decode, each truncated to its own stop."""
+    lm, target, draft, api, url = served
+    p = _prompt(lm, 30)
+    code, full = _post(url, {"prompt": p, "n_new": 12})
+    assert code == 200
+    eos = full["tokens"][4]          # a token known to appear at idx 4
+    code, cut = _post(url, {"prompt": p, "n_new": 12, "eos_id": eos})
+    assert code == 200
+    first = full["tokens"].index(eos)
+    assert cut["tokens"] == full["tokens"][:first + 1]
+    assert cut["tokens"][-1] == eos
+    # same key => eos requests batch with non-eos ones
+    assert api._batch_key({"mode": "greedy", "prompt": p, "n_new": 12,
+                           "temperature": 0.0, "gamma": 4, "seed": 0,
+                           "eos_id": eos}) == \
+        api._batch_key({"mode": "greedy", "prompt": p, "n_new": 12,
+                        "temperature": 0.0, "gamma": 4, "seed": 0,
+                        "eos_id": None})
+    for bad in ("x", True):
+        code, out = _post(url, {"prompt": p, "n_new": 4,
+                                "eos_id": bad})
+        assert code == 400 and "eos_id" in out["error"], (bad, out)

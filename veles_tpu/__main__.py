@@ -231,6 +231,11 @@ def _drive(launcher: Launcher, workflow, args):
         # not a 500 on the first request.
         from .nn.sampling import split_stack
         from .restful_api import GenerationAPI
+        if args.serve_draft_snapshot and not args.serve_draft:
+            # fail fast: a dangling snapshot flag would otherwise
+            # surface only as 400s on every speculative request
+            raise VelesError("--serve-draft-snapshot needs "
+                             "--serve-draft")
         split_stack(list(workflow.forwards))
         draft = None
         if args.serve_draft:

@@ -242,9 +242,15 @@ class GenerationAPI(Unit):
             temperature = 0.0
         elif mode == "sample" and temperature <= 0:
             raise ValueError("mode=sample needs temperature > 0")
+        eos_id = body.get("eos_id")
+        if eos_id is not None and (isinstance(eos_id, bool)
+                                   or not isinstance(eos_id, int)):
+            # bool IS an int in python — JSON true/false must not pass
+            # as token ids 1/0
+            raise ValueError("'eos_id' must be an int token id")
         req = {"prompt": [int(t) for t in prompt], "n_new": n_new,
                "mode": mode, "temperature": temperature, "seed": seed,
-               "gamma": gamma, "beam": beam}
+               "gamma": gamma, "beam": beam, "eos_id": eos_id}
         if req["gamma"] < 1:
             raise ValueError("'gamma' must be >= 1")
         if req["beam"] < 1:
@@ -273,6 +279,21 @@ class GenerationAPI(Unit):
                 req.get("_solo"))
 
     # -- worker --------------------------------------------------------------
+    @staticmethod
+    def _trim_eos(tokens, eos_id):
+        """Host-side stop-token truncation (through the first eos_id,
+        inclusive): the decode itself runs the requested n_new — fixed
+        shapes keep the compiled program shared — so per-request eos
+        never fragments a batch and costs nothing device-side."""
+        if eos_id is None:
+            return list(tokens)
+        out = []
+        for t in tokens:
+            out.append(t)
+            if t == eos_id:
+                break
+        return out
+
     def _serve_group(self, reqs, tickets) -> None:
         from .nn import beam as beam_mod
         from .nn import sampling
@@ -280,11 +301,12 @@ class GenerationAPI(Unit):
         mode = reqs[0]["mode"]
         try:
             if mode == "beam":
-                # single-sequence search; stays per-request
+                # single-sequence search; stays per-request (beam has
+                # NATIVE eos handling — frozen hypotheses)
                 for req, ticket in zip(reqs, tickets):
                     toks, stats = beam_mod.beam_generate(
                         self.workflow, req["prompt"], req["n_new"],
-                        beam=req["beam"])
+                        beam=req["beam"], eos_id=req["eos_id"])
                     ticket.result = {"tokens": [int(t) for t in toks],
                                      "scores": [float(s) for s in
                                                 stats["scores"]]}
@@ -297,9 +319,10 @@ class GenerationAPI(Unit):
                     reqs[0]["n_new"], gamma=reqs[0]["gamma"],
                     temperature=reqs[0]["temperature"],
                     seed=reqs[0]["seed"])
-                for i, ticket in enumerate(tickets):
+                for i, (req, ticket) in enumerate(zip(reqs, tickets)):
                     ticket.result = {
-                        "tokens": rows[i],
+                        "tokens": self._trim_eos(rows[i],
+                                                 req["eos_id"]),
                         "acceptance": stats["acceptance"][i],
                         "rounds": stats["rounds"][i],
                         "batched_with": len(reqs) - 1}
@@ -309,9 +332,10 @@ class GenerationAPI(Unit):
                 self.workflow, prompts, reqs[0]["n_new"],
                 temperature=reqs[0]["temperature"],
                 seed=reqs[0]["seed"])
-            for i, ticket in enumerate(tickets):
-                ticket.result = {"tokens": rows[i],
-                                 "batched_with": len(reqs) - 1}
+            for i, (req, ticket) in enumerate(zip(reqs, tickets)):
+                ticket.result = {
+                    "tokens": self._trim_eos(rows[i], req["eos_id"]),
+                    "batched_with": len(reqs) - 1}
                 ticket.event.set()
         except Exception as e:        # noqa: BLE001 — answer, don't die
             # decoder-raised ValueError/VelesError on a parsed request
