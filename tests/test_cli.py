@@ -307,7 +307,10 @@ def _serve_and_post(argv, payload, tmp_path):
                 raise AssertionError(
                     "server died:\n" + proc.communicate()[0][-3000:])
             buf += chunk
-            for line in buf.splitlines():
+            # only parse COMPLETE lines — os.read can split mid-line,
+            # and 'SERVING port=80' from 'port=8080' must not parse
+            *lines, buf = buf.split("\n")
+            for line in lines:
                 if line.startswith("SERVING port="):
                     port = int(line.strip().split("=", 1)[1])
                     break

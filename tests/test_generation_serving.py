@@ -231,3 +231,26 @@ def test_eos_id_truncates_and_does_not_fragment_batch(served):
         code, out = _post(url, {"prompt": p, "n_new": 4,
                                 "eos_id": bad})
         assert code == 400 and "eos_id" in out["error"], (bad, out)
+
+
+def test_generation_from_sharded_training_mesh():
+    """Serving a model trained on a data x tensor mesh: the decoders
+    must accept tp-sharded params (a real user path: train sharded,
+    then serve the same in-memory workflow)."""
+    lm = import_model("char_lm")
+    prng.seed_all(31)
+    wf = lm.build_workflow(epochs=1, minibatch_size=64, n_blocks=2,
+                           dim=32, n_train=256, n_valid=64)
+    wf.initialize(device=vt.XLADevice(
+        mesh_axes={"data": 2, "tensor": 2}))
+    wf.run()
+    w = wf.train_step.params["blk0"]["wq"]
+    assert "tensor" in w.sharding.spec        # really sharded
+    p = [int(t) for t in
+         lm.make_corpus(numpy.random.RandomState(0), 12)]
+    from veles_tpu.nn import sampling
+    from veles_tpu.nn.beam import beam_generate
+    toks = sampling.generate(wf, p, 8, temperature=0)
+    assert len(toks) == 8
+    best, stats = beam_generate(wf, p, 6, beam=2)
+    assert len(best) == 6 and len(stats["scores"]) == 2

@@ -23,7 +23,14 @@ from .logger import setup_logging
 
 
 def main(argv=None) -> int:
-    args = make_parser().parse_args(argv)
+    parser = make_parser()
+    args = parser.parse_args(argv)
+    if args.serve_draft_snapshot and not args.serve_draft:
+        # argv-detectable misuse fails BEFORE any (possibly minutes-
+        # long) initialize/restore — and regardless of --serve-generate
+        parser.error("--serve-draft-snapshot needs --serve-draft")
+    if args.serve_draft and args.serve_generate is None:
+        parser.error("--serve-draft needs --serve-generate")
     level = (logging.WARNING, logging.INFO,
              logging.DEBUG)[min(args.verbose, 2)]
     setup_logging(level=level, tracefile=args.trace_file)
@@ -231,11 +238,6 @@ def _drive(launcher: Launcher, workflow, args):
         # not a 500 on the first request.
         from .nn.sampling import split_stack
         from .restful_api import GenerationAPI
-        if args.serve_draft_snapshot and not args.serve_draft:
-            # fail fast: a dangling snapshot flag would otherwise
-            # surface only as 400s on every speculative request
-            raise VelesError("--serve-draft-snapshot needs "
-                             "--serve-draft")
         split_stack(list(workflow.forwards))
         draft = None
         if args.serve_draft:
