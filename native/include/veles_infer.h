@@ -30,6 +30,16 @@ size_t vi_output_size(const vi_model *m);
  * batch*vi_output_size floats. Returns 0 on success. */
 int vi_run(vi_model *m, const float *in, size_t batch, float *out);
 
+/* KV-cached greedy decoding for an LM package (embedding →
+ * [pos_embedding] → transformer_block* → lm_head): prompt = t_p token
+ * ids (as floats), out_tokens must hold n_new floats. Any prompt
+ * length >= 1; RoPE models generate open-endedly, pos_embedding models
+ * up to their table length. Each new token costs ONE cached step (the
+ * --generate sliding-window re-forward costs a full window). Returns 0
+ * on success. */
+int vi_generate(vi_model *m, const float *prompt, size_t t_p,
+                int n_new, float *out_tokens);
+
 /* Number of units in the chain. */
 size_t vi_unit_count(const vi_model *m);
 

@@ -97,23 +97,57 @@ int Generate(int n_new, const char *pkg, const char *prompt_path,
   return 0;
 }
 
+int GenerateCached(int n_new, const char *pkg, const char *prompt_path,
+                   const char *out_path) {
+  // KV-cached greedy decoding (vi_generate): any prompt length, one
+  // cached step per new token — the native twin of the python cached
+  // sampler, vs --generate's fixed-window sliding re-forward
+  vi_model *model = vi_load(pkg);
+  if (!model) {
+    std::fprintf(stderr, "load failed: %s\n", vi_last_error());
+    return 1;
+  }
+  veles::NpyArray prompt = veles::LoadNpy(prompt_path);
+  std::vector<float> generated(static_cast<size_t>(n_new));
+  if (vi_generate(model, prompt.data.data(), prompt.size(), n_new,
+                  generated.data())) {
+    std::fprintf(stderr, "generate failed: %s\n", vi_last_error());
+    vi_free(model);
+    return 1;
+  }
+  std::vector<int> shape = {n_new};
+  SaveNpyF32(out_path, shape, generated.data(), generated.size());
+  std::fprintf(stderr,
+               "OK: generated %d tokens (cached, prompt %zu)\n",
+               n_new, prompt.size());
+  vi_free(model);
+  return 0;
+}
+
 }  // namespace
 
 int main(int argc, char **argv) {
-  if (argc == 6 && std::strcmp(argv[1], "--generate") == 0) {
+  bool cached = argc == 6 &&
+                std::strcmp(argv[1], "--generate-cached") == 0;
+  if (argc == 6 &&
+      (cached || std::strcmp(argv[1], "--generate") == 0)) {
     int n_new = std::atoi(argv[2]);
     if (n_new <= 0) {
       std::fprintf(stderr, "--generate needs a positive token count\n");
       return 2;
     }
-    return Generate(n_new, argv[3], argv[4], argv[5]);
+    return cached ? GenerateCached(n_new, argv[3], argv[4], argv[5])
+                  : Generate(n_new, argv[3], argv[4], argv[5]);
   }
   if (argc != 4) {
     std::fprintf(stderr,
                  "usage: %s <package_dir> <input.npy> <output.npy>\n"
                  "       %s --generate N <package_dir> <prompt.npy> "
-                 "<out.npy>\n",
-                 argv[0], argv[0]);
+                 "<out.npy>   (sliding full-window re-forward)\n"
+                 "       %s --generate-cached N <package_dir> "
+                 "<prompt.npy> <out.npy>   (KV-cached; any prompt "
+                 "length)\n",
+                 argv[0], argv[0], argv[0]);
     return 2;
   }
   vi_model *model = vi_load(argv[1]);

@@ -573,18 +573,23 @@ void MatMulRM(const float *x, const float *w, float *y, int n, int k,
   }
 }
 
-// Per-head scaled-dot-product attention over one sequence: q/ctx are
-// (t, d) planes with h heads as contiguous hd slices; k/v are
-// (t, kv_h*hd) planes with kv_h heads (GQA twin of the python units:
-// query head `head` reads KV head `head / (h / kv_h)`; kv_h == h is
-// classic MHA). `scratch` must hold t floats. Shared by
-// MultiHeadAttention and TransformerBlock so masking/stability fixes
-// cannot diverge between them (the python side shares
-// nn/attention.attention_core the same way).
+// Per-head scaled-dot-product attention: q/ctx are (t_q, d) planes
+// with h heads as contiguous hd slices; k/v are (t, kv_h*hd) planes
+// with kv_h heads (GQA twin of the python units: query head `head`
+// reads KV head `head / (h / kv_h)`; kv_h == h is classic MHA).
+// `scratch` must hold t floats. `t_q`/`q_pos0` generalize to the
+// CACHED-decode case: the q plane holds t_q rows at GLOBAL positions
+// q_pos0..q_pos0+t_q-1 attending over t cache rows (defaults t_q = t,
+// q_pos0 = 0 — the full-window case). ONE implementation shared by
+// MultiHeadAttention, TransformerBlock::Run and the KV-cached
+// TransformerBlock::Step so masking/stability fixes cannot diverge
+// (the python side shares nn/attention.attention_core the same way).
 void AttentionHeads(const float *q, const float *k, const float *v,
                     float *ctx, float *scratch, int t, int d, int h,
-                    bool causal, int kv_h = 0, int window = 0) {
+                    bool causal, int kv_h = 0, int window = 0,
+                    int t_q = -1, int q_pos0 = 0) {
   if (kv_h <= 0) kv_h = h;
+  if (t_q < 0) t_q = t;
   int hd = d / h;
   int kv_d = kv_h * hd;
   int group = h / kv_h;
@@ -592,11 +597,12 @@ void AttentionHeads(const float *q, const float *k, const float *v,
   for (int head = 0; head < h; ++head) {
     int off = head * hd;
     int kv_off = (head / group) * hd;
-    for (int qi = 0; qi < t; ++qi) {
+    for (int qi = 0; qi < t_q; ++qi) {
       const float *qv = q + static_cast<size_t>(qi) * d + off;
-      int kmax = causal ? qi + 1 : t;
+      int qpos = q_pos0 + qi;
+      int kmax = causal ? std::min(qpos + 1, t) : t;
       // sliding window (python twin: q - k < window, causal only)
-      int kmin = window > 0 ? std::max(0, qi - window + 1) : 0;
+      int kmin = window > 0 ? std::max(0, qpos - window + 1) : 0;
       float mx = -1e30f;
       for (int ki = kmin; ki < kmax; ++ki) {
         const float *kv = k + static_cast<size_t>(ki) * kv_d + kv_off;
@@ -657,22 +663,25 @@ struct MultiHeadAttention : Unit {
 
 // rotary position embedding on a (t, d) plane with heads as contiguous
 // hd slices (transformer.py _rope twin): HALF-SPLIT pairing (GPT-NeoX
-// convention, feature j rotates with j+half — not interleaved even/odd)
+// convention, feature j rotates with j+half — not interleaved even/odd).
+// `pos0` offsets the rows' global positions (row r sits at pos0 + r) —
+// the cached decode rotates single rows at their true position.
 void RopeRotate(float *plane, int t, int d, int h,
-                float base = 10000.0f) {
+                float base = 10000.0f, int pos0 = 0) {
   int hd = d / h;
   int half = hd / 2;
   std::vector<float> inv(half), cosv(half), sinv(half);
   for (int j = 0; j < half; ++j)   // position-independent: hoist pow
     inv[j] = std::pow(base, -static_cast<float>(j) / half);
-  for (int pos = 0; pos < t; ++pos) {
+  for (int r = 0; r < t; ++r) {
+    int pos = pos0 + r;
     for (int j = 0; j < half; ++j) {
       float ang = pos * inv[j];
       cosv[j] = std::cos(ang);
       sinv[j] = std::sin(ang);
     }
     for (int head = 0; head < h; ++head) {
-      float *x = plane + static_cast<size_t>(pos) * d + head * hd;
+      float *x = plane + static_cast<size_t>(r) * d + head * hd;
       for (int j = 0; j < half; ++j) {
         float a = x[j], b = x[half + j];
         x[j] = a * cosv[j] - b * sinv[j];
@@ -768,40 +777,119 @@ struct TransformerBlock : Unit {
         LayerNorm(xb, g2->data.data(),
                   rms ? nullptr : bb2->data.data(), ln.data(), t, d);
         std::vector<float> gbuf(swiglu ? f : 0);
-        for (int r = 0; r < t; ++r) {
-          const float *xr = ln.data() + static_cast<size_t>(r) * d;
-          for (int j = 0; j < f; ++j)
-            hbuf[j] = swiglu ? 0.0f : b1->data[j];
-          if (swiglu) std::fill(gbuf.begin(), gbuf.end(), 0.0f);
-          for (int i = 0; i < d; ++i) {
-            float xv = xr[i];
-            if (xv == 0.0f) continue;
-            const float *row = w1->data.data() +
-                               static_cast<size_t>(i) * f;
-            for (int j = 0; j < f; ++j) hbuf[j] += xv * row[j];
-            if (swiglu) {
-              const float *row3 = w3->data.data() +
-                                  static_cast<size_t>(i) * f;
-              for (int j = 0; j < f; ++j) gbuf[j] += xv * row3[j];
-            }
-          }
-          if (swiglu)
-            for (int j = 0; j < f; ++j) hbuf[j] = Silu(hbuf[j]) * gbuf[j];
-          else
-            for (int j = 0; j < f; ++j) hbuf[j] = Gelu(hbuf[j]);
-          float *yr = xb + static_cast<size_t>(r) * d;
-          if (!swiglu)
-            for (int i = 0; i < d; ++i) yr[i] += b2->data[i];
-          for (int j = 0; j < f; ++j) {
-            float hv = hbuf[j];
-            if (hv == 0.0f) continue;
-            const float *row = w2->data.data() +
-                               static_cast<size_t>(j) * d;
-            for (int i = 0; i < d; ++i) yr[i] += hv * row[i];
-          }
-        }
+        for (int r = 0; r < t; ++r)
+          FfnRow(ln.data() + static_cast<size_t>(r) * d,
+                 xb + static_cast<size_t>(r) * d,
+                 hbuf.data(), gbuf.data(), w1, b1, w2, b2, w3, d, f);
       }
     });
+  }
+
+  // FFN for ONE normalized row, ACCUMULATED into the residual `yr`
+  // (hbuf: f floats scratch; gbuf: f floats, swiglu only). The one
+  // copy Run and the cached Step share.
+  void FfnRow(const float *xr, float *yr, float *hbuf, float *gbuf,
+              const NpyArray *w1, const NpyArray *b1,
+              const NpyArray *w2, const NpyArray *b2,
+              const NpyArray *w3, int d, int f) const {
+    for (int j = 0; j < f; ++j)
+      hbuf[j] = swiglu ? 0.0f : b1->data[j];
+    if (swiglu) std::fill(gbuf, gbuf + f, 0.0f);
+    for (int i = 0; i < d; ++i) {
+      float xv = xr[i];
+      if (xv == 0.0f) continue;
+      const float *row = w1->data.data() + static_cast<size_t>(i) * f;
+      for (int j = 0; j < f; ++j) hbuf[j] += xv * row[j];
+      if (swiglu) {
+        const float *row3 = w3->data.data() +
+                            static_cast<size_t>(i) * f;
+        for (int j = 0; j < f; ++j) gbuf[j] += xv * row3[j];
+      }
+    }
+    if (swiglu)
+      for (int j = 0; j < f; ++j) hbuf[j] = Silu(hbuf[j]) * gbuf[j];
+    else
+      for (int j = 0; j < f; ++j) hbuf[j] = Gelu(hbuf[j]);
+    if (!swiglu)
+      for (int i = 0; i < d; ++i) yr[i] += b2->data[i];
+    for (int j = 0; j < f; ++j) {
+      float hv = hbuf[j];
+      if (hv == 0.0f) continue;
+      const float *row = w2->data.data() + static_cast<size_t>(j) * d;
+      for (int i = 0; i < d; ++i) yr[i] += hv * row[i];
+    }
+  }
+
+  // Per-decode state for the cached path: params resolved ONCE and
+  // scratch allocated ONCE — Step runs per token per block, so
+  // map lookups and heap allocations inside it would dominate the
+  // very dispatch cost the cache removes.
+  struct StepState {
+    const NpyArray *wq, *wk, *wv, *wo, *w1, *b1, *w2, *b2, *w3;
+    const NpyArray *g1, *bb1, *g2, *bb2;
+    std::vector<float> ln, q, ctx, proj, s, hbuf, gbuf;
+    int d, f, h, kv_h, kv_d;
+  };
+
+  StepState PrepareStep(int t_max) const {
+    StepState st;
+    st.wq = Param("wq");
+    st.wk = Param("wk");
+    st.wv = Param("wv");
+    st.wo = Param("wo");
+    st.w1 = Param("w1");
+    st.b1 = Param("b1");
+    st.w2 = Param("w2");
+    st.b2 = Param("b2");
+    st.w3 = Param("w3");
+    st.g1 = Param("ln1_g");
+    st.bb1 = Param("ln1_b");
+    st.g2 = Param("ln2_g");
+    st.bb2 = Param("ln2_b");
+    st.d = st.wq->shape[0];
+    st.f = st.w1->shape[1];
+    st.h = n_heads;
+    st.kv_h = n_kv_heads > 0 ? n_kv_heads : st.h;
+    st.kv_d = (st.d / st.h) * st.kv_h;
+    st.ln.resize(st.d);
+    st.q.resize(st.d);
+    st.ctx.resize(st.d);
+    st.proj.resize(st.d);
+    st.s.resize(t_max);
+    st.hbuf.resize(st.f);
+    st.gbuf.resize(swiglu ? st.f : 0);
+    return st;
+  }
+
+  // One KV-cached token step: x is this token's (d,) residual stream
+  // at GLOBAL position pos; ck/cv are (t_max, kv_d) caches whose rows
+  // < pos are filled — row pos is written here, attention reads rows
+  // 0..pos (window-clipped) through the SAME AttentionHeads as Run.
+  // The incremental twin of veles_tpu/nn/sampling._block_step.
+  void Step(StepState &st, float *x, std::vector<float> &ck,
+            std::vector<float> &cv, int pos) {
+    int d = st.d;
+    LayerNorm(x, st.g1->data.data(),
+              rms ? nullptr : st.bb1->data.data(), st.ln.data(), 1, d);
+    MatMulRM(st.ln.data(), st.wq->data.data(), st.q.data(), 1, d, d);
+    float *krow = ck.data() + static_cast<size_t>(pos) * st.kv_d;
+    float *vrow = cv.data() + static_cast<size_t>(pos) * st.kv_d;
+    MatMulRM(st.ln.data(), st.wk->data.data(), krow, 1, d, st.kv_d);
+    MatMulRM(st.ln.data(), st.wv->data.data(), vrow, 1, d, st.kv_d);
+    if (rope) {
+      RopeRotate(st.q.data(), 1, d, st.h, rope_base, pos);
+      RopeRotate(krow, 1, st.kv_d, st.kv_h, rope_base, pos);
+    }
+    AttentionHeads(st.q.data(), ck.data(), cv.data(), st.ctx.data(),
+                   st.s.data(), pos + 1, d, st.h, causal, st.kv_h,
+                   window, /*t_q=*/1, /*q_pos0=*/pos);
+    MatMulRM(st.ctx.data(), st.wo->data.data(), st.proj.data(), 1, d,
+             d);
+    for (int i = 0; i < d; ++i) x[i] += st.proj[i];
+    LayerNorm(x, st.g2->data.data(),
+              rms ? nullptr : st.bb2->data.data(), st.ln.data(), 1, d);
+    FfnRow(st.ln.data(), x, st.hbuf.data(), st.gbuf.data(), st.w1,
+           st.b1, st.w2, st.b2, st.w3, d, st.f);
   }
 };
 
@@ -1346,6 +1434,111 @@ const char *vi_unit_name(const vi_model *m, size_t idx) {
 
 const char *vi_unit_type(const vi_model *m, size_t idx) {
   return m->units[idx]->type.c_str();
+}
+
+int vi_generate(vi_model *m, const float *prompt, size_t t_p,
+                int n_new, float *out_tokens) {
+  // KV-cached greedy decoding with no Python: prefill fills each
+  // block's (t_max, kv_d) caches one token at a time, then every new
+  // token costs ONE cached step — the native twin of
+  // veles_tpu/nn/sampling.generate (the --generate sliding-window
+  // re-forward path stays for fixed-window PosEmbedding serving).
+  try {
+    if (t_p == 0) throw std::runtime_error("vi_generate: empty prompt");
+    if (n_new <= 0)
+      throw std::runtime_error("vi_generate: n_new must be >= 1");
+    veles::Unit *stem = nullptr, *pe = nullptr, *head = nullptr;
+    std::vector<veles::TransformerBlock *> blocks;
+    for (auto &u : m->units) {
+      if (u->type == "embedding" && stem == nullptr)
+        stem = u.get();
+      else if (u->type == "pos_embedding" && pe == nullptr)
+        pe = u.get();
+      else if (u->type == "transformer_block")
+        blocks.push_back(static_cast<veles::TransformerBlock *>(u.get()));
+      else if (u->type == "lm_head" && head == nullptr)
+        head = u.get();
+      else
+        throw std::runtime_error(
+            "cached generation supports embedding → [pos_embedding] → "
+            "transformer_block* → lm_head chains; found " + u->type);
+    }
+    if (!stem || !head || blocks.empty())
+      throw std::runtime_error(
+          "cached generation: not a generation stack (stem/blocks/"
+          "head missing)");
+    for (auto *blk : blocks)
+      if (!blk->causal)
+        throw std::runtime_error(
+            "cached generation requires causal blocks: one-token "
+            "prefill can never let prompt positions see later tokens "
+            "(block " + blk->name + " has causal=false — use vi_run)");
+    const veles::NpyArray *table = stem->Param("table");
+    int vocab = table->shape[0], d = table->shape[1];
+    int t_max = static_cast<int>(t_p) + n_new;
+    const veles::NpyArray *ptab = pe ? pe->Param("table") : nullptr;
+    // highest position ever STEPPED is t_max - 2 (the final generated
+    // token is emitted, never fed back), so t_max - 1 table rows
+    // suffice — one row fewer than the python scan, which burns a
+    // wasted final step (sampling.py _build_sampler)
+    if (ptab && ptab->shape[0] < t_max - 1)
+      throw std::runtime_error(
+          "generation to " + std::to_string(t_max - 1) + " positions "
+          "exceeds the pos_embedding table (" +
+          std::to_string(ptab->shape[0]) + " rows); RoPE models "
+          "generate open-endedly");
+    const veles::NpyArray *hw = head->Param("weights");
+    const veles::NpyArray *hb = head->Param("bias");
+    int hv = hw->shape[1];
+    std::vector<std::vector<float>> ck(blocks.size()), cv(blocks.size());
+    std::vector<veles::TransformerBlock::StepState> st;
+    st.reserve(blocks.size());
+    for (size_t b = 0; b < blocks.size(); ++b) {
+      auto *blk = blocks[b];
+      int kv_h = blk->n_kv_heads > 0 ? blk->n_kv_heads : blk->n_heads;
+      size_t kv_d = static_cast<size_t>(d / blk->n_heads) * kv_h;
+      ck[b].assign(static_cast<size_t>(t_max) * kv_d, 0.0f);
+      cv[b].assign(static_cast<size_t>(t_max) * kv_d, 0.0f);
+      st.push_back(blk->PrepareStep(t_max));
+    }
+    std::vector<float> x(d), logits(hv);
+    auto step_all = [&](float tok_id, int pos,
+                        bool want_logits) -> int {
+      int ti = static_cast<int>(std::lround(tok_id));
+      ti = std::max(0, std::min(vocab - 1, ti));   // clip like Run
+      const float *row = table->data.data() +
+                         static_cast<size_t>(ti) * d;
+      std::copy(row, row + d, x.begin());
+      if (ptab)
+        for (int i = 0; i < d; ++i)
+          x[i] += ptab->data[static_cast<size_t>(pos) *
+                             ptab->shape[1] + i];
+      for (size_t b = 0; b < blocks.size(); ++b)
+        blocks[b]->Step(st[b], x.data(), ck[b], cv[b], pos);
+      if (!want_logits) return -1;
+      veles::MatMulRM(x.data(), hw->data.data(), logits.data(),
+                      1, d, hv);
+      if (hb)
+        for (int c = 0; c < hv; ++c) logits[c] += hb->data[c];
+      int best = 0;
+      for (int c = 1; c < hv; ++c)
+        if (logits[c] > logits[best]) best = c;
+      return best;
+    };
+    int next = -1;
+    for (size_t i = 0; i < t_p; ++i)
+      next = step_all(prompt[i], static_cast<int>(i), i + 1 == t_p);
+    for (int j = 0; j < n_new; ++j) {
+      out_tokens[j] = static_cast<float>(next);
+      if (j + 1 < n_new)
+        next = step_all(out_tokens[j],
+                        static_cast<int>(t_p) + j, true);
+    }
+    return 0;
+  } catch (const std::exception &e) {
+    veles::g_error = e.what();
+    return 1;
+  }
 }
 
 int vi_run(vi_model *m, const float *in, size_t batch, float *out) {

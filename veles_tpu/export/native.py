@@ -55,6 +55,11 @@ def load_library():
                            ctypes.POINTER(ctypes.c_float),
                            ctypes.c_size_t,
                            ctypes.POINTER(ctypes.c_float)]
+    lib.vi_generate.restype = ctypes.c_int
+    lib.vi_generate.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_float),
+                                ctypes.c_size_t, ctypes.c_int,
+                                ctypes.POINTER(ctypes.c_float)]
     lib.vi_last_error.restype = ctypes.c_char_p
     lib.vi_free.argtypes = [ctypes.c_void_p]
     _lib = lib
@@ -89,6 +94,24 @@ class NativeModel:
             raise VelesError("native run failed: %s" %
                              self._lib.vi_last_error().decode())
         return out
+
+    def generate(self, prompt, n_new: int) -> list:
+        """KV-cached greedy decoding through the C++ engine
+        (vi_generate): any prompt length, one cached step per new
+        token — the native twin of ``nn.sampling.generate`` at
+        temperature 0."""
+        p = numpy.ascontiguousarray(
+            numpy.asarray(prompt).ravel(), dtype=numpy.float32)
+        out = numpy.empty(int(n_new), dtype=numpy.float32)
+        rc = self._lib.vi_generate(
+            self._handle,
+            p.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            p.size, int(n_new),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+        if rc:
+            raise VelesError("native generate failed: %s" %
+                             self._lib.vi_last_error().decode())
+        return [int(t) for t in out]
 
     def close(self) -> None:
         if self._handle:
