@@ -10,7 +10,7 @@ while true; do
   missing=$(python3 - <<'PY'
 import json, os
 order = ("pallas_compile mnist_fused ae_amp ae_fp32 ae_amp_remat lm "
-         "attn generation "
+         "attn_2048 attn_8192 generation "
          "profile mnist mnist_mb1000 mnist_h_sweep").split()
 done_keys = set()
 p = "docs/chip_r03.json"

@@ -326,14 +326,14 @@ def sec_lm(bench, dev, n):
     return bench.bench_lm(dev, n)
 
 
-def sec_attn(bench, dev, n):
+def sec_attn(bench, dev, n, pairs=None):
     from veles_tpu.config import root as vt_root
     # lookup-only while measuring: a first-use autotune sweep firing
     # inside a timed variant would corrupt the A/B it feeds
     prev_tune = vt_root.common.engine.get("kernel_autotune", "auto")
     vt_root.common.engine.kernel_autotune = "reuse"
     try:
-        results = _attn_measure(bench, dev, n)
+        results = _attn_measure(bench, dev, n, pairs=pairs)
     finally:
         vt_root.common.engine.kernel_autotune = prev_tune
     try:
@@ -344,10 +344,24 @@ def sec_attn(bench, dev, n):
     return results
 
 
+def sec_attn_2048(bench, dev, n):
+    """Half the attn sweep per section (~20 tunnel compiles each, not
+    ~40): a mid-section relay wedge costs one length's measurements,
+    not both — and the T=2048 crossover regime (the r3 0.62x result)
+    lands first. Each half seeds its own DB entries, and
+    _attn_seed's per-T crossover floor only ever OPENS the gate above
+    a measured loss, so half-seeded state is safe."""
+    return sec_attn(bench, dev, n, pairs=((2048, 16),))
+
+
+def sec_attn_8192(bench, dev, n):
+    return sec_attn(bench, dev, n, pairs=((8192, 1),))
+
+
 ATTN_SWEEP_H, ATTN_SWEEP_D = 8, 64   # shared by measure AND DB seeding
 
 
-def _attn_measure(bench, dev, n):
+def _attn_measure(bench, dev, n, pairs=None):
     import jax.numpy as jnp
     import bench_attention as ba
     from veles_tpu.config import root as vt_root
@@ -356,7 +370,7 @@ def _attn_measure(bench, dev, n):
     import jax
     results = []
     # (T, B) pairs from docs/perf.md so old and new numbers compare
-    for t, b in ((2048, 16), (8192, 1)):
+    for t, b in (pairs or ((2048, 16), (8192, 1))):
         h, d = ATTN_SWEEP_H, ATTN_SWEEP_D
         import numpy
         rng = numpy.random.RandomState(0)
@@ -545,10 +559,17 @@ def _attn_seed(results, dev):
         # longer length to flash, so a win below a measured loss must
         # not open the gate over that loss (the r3 0.62x-at-2048 regime
         # gets re-gated by measurement, not by a hand-set constant).
-        # choose_flash's "auto" mode reads this.
-        losses = [t for t, won in crossover.items() if not won]
+        # choose_flash's "auto" mode reads this. MERGE with any
+        # previously recorded verdicts first: the split attn_2048/
+        # attn_8192 sections each see one length, and a later section
+        # must refine the entry, not overwrite the other's data.
+        merged = dict(crossover)
+        prev = autotune.lookup(autotune.min_t_key(d_swept))
+        for tk, won in (prev or {}).get("swept", {}).items():
+            merged.setdefault(int(tk), bool(won))
+        losses = [t for t, won in merged.items() if not won]
         floor = max(losses) if losses else -1
-        wins = sorted(t for t, won in crossover.items()
+        wins = sorted(t for t, won in merged.items()
                       if won and t > floor)
         if crossover:
             min_t = wins[0] if wins else autotune.NEVER
@@ -558,7 +579,7 @@ def _attn_seed(results, dev):
                     {"min_t": min_t,
                      "mode": "attn_sweep_crossover",
                      "swept": {str(t): bool(w)
-                               for t, w in sorted(crossover.items())}},
+                               for t, w in sorted(merged.items())}},
                     shipped=True)
                 print("  autotune seeded flash_min_t d=%d -> %s"
                       % (d_swept,
@@ -702,7 +723,8 @@ SECTIONS = [("pallas_compile", sec_pallas_compile),
             ("mnist_mb1000", sec_mnist_mb1000),
             ("ae_amp", sec_ae_amp),
             ("ae_fp32", sec_ae_fp32), ("ae_amp_remat", sec_ae_amp_remat),
-            ("lm", sec_lm), ("attn", sec_attn),
+            ("lm", sec_lm),
+            ("attn_2048", sec_attn_2048), ("attn_8192", sec_attn_8192),
             ("generation", sec_generation), ("profile", sec_profile)]
 
 
@@ -737,6 +759,9 @@ def main():
                      "device_kind": str(getattr(jax.devices()[0],
                                                 "device_kind", "?"))})
     by_name = dict(SECTIONS)
+    # manual alias outside the default batch: the split halves cover
+    # both lengths, so the full sweep must not run twice by default
+    by_name["attn"] = sec_attn
     for name in want:
         fn = by_name.get(name)
         if fn is None:

@@ -257,3 +257,22 @@ def test_attn_seed_min_t_respects_losses_above_wins(tuned_env):
 
     ce._attn_seed(results, _TpuDev())
     assert autotune.flash_min_t(64) == autotune.NEVER
+
+
+def test_attn_seed_split_sections_merge_crossover(tuned_env):
+    """The split attn_2048/attn_8192 chip sections each seed one
+    length; the second must REFINE the persisted crossover with the
+    first's verdicts, not overwrite them."""
+    ce = _load_chip_experiments()
+    r2048_loss = [{"t": 2048, "b": 16, "train": True, "variants": {
+        "fused_xla": {"ms": 1.0}, "flash_128x128": {"ms": 2.0}}}]
+    r8192_win = [{"t": 8192, "b": 1, "train": True, "variants": {
+        "fused_xla": {"ms": 10.0}, "flash_512x512": {"ms": 7.0}}}]
+    ce._attn_seed(r2048_loss, _TpuDev())
+    assert autotune.flash_min_t(64) == autotune.NEVER
+    autotune.clear_memo()
+    ce._attn_seed(r8192_win, _TpuDev())
+    # merged view: loss@2048 + win@8192 -> gate opens at 8192
+    assert autotune.flash_min_t(64) == 8192
+    entry = autotune.lookup(autotune.min_t_key(64))
+    assert entry["swept"] == {"2048": False, "8192": True}
