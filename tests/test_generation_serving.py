@@ -254,3 +254,24 @@ def test_generation_from_sharded_training_mesh():
     assert len(toks) == 8
     best, stats = beam_generate(wf, p, 6, beam=2)
     assert len(best) == 6 and len(stats["scores"]) == 2
+
+
+def test_stats_endpoint(served):
+    lm, target, draft, api, url = served
+    # self-contained: issue one request so the counters are non-zero
+    # even when this test runs in isolation
+    code, _ = _post(url, {"prompt": _prompt(lm, 40), "n_new": 4})
+    assert code == 200
+    with urllib.request.urlopen(url + "/stats", timeout=30) as r:
+        stats = json.loads(r.read())
+    assert stats["requests_served"] >= 1
+    assert stats["batches_run"] >= 1
+    assert stats["speculative_enabled"] is True
+    assert "beam" in stats["modes"]
+    # unknown GET paths 404
+    try:
+        urllib.request.urlopen(
+            "http://127.0.0.1:%d/nope" % api.port, timeout=30)
+        raise AssertionError("expected 404")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404

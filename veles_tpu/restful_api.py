@@ -387,6 +387,20 @@ class GenerationAPI(Unit):
             def log_message(self, fmt, *args):
                 api.debug("http: " + fmt, *args)
 
+            def do_GET(self):
+                # ops surface: the micro-batcher's effectiveness is
+                # observable (beacon/web-status philosophy)
+                if self.path != api.path + "/stats":
+                    self.send_error(404)
+                    return
+                json_reply(self, 200, {
+                    "requests_served": api.requests_served,
+                    "batches_run": api.batches_run,
+                    "max_batch": api.max_batch,
+                    "queue_depth": len(api._queue),
+                    "speculative_enabled": api.draft is not None,
+                    "modes": list(api.MODES)})
+
             def do_POST(self):
                 if self.path != api.path:
                     self.send_error(404)
