@@ -106,7 +106,8 @@ def sec_pallas_compile(bench, dev, n):
             entry.update(fn())
             entry["tol_rel"] = tol
             entry["numerics_ok"] = entry["rel_diff"] <= tol
-            entry["ok"] = bool(entry["numerics_ok"])
+            entry["ok"] = (bool(entry["numerics_ok"])
+                           and entry.get("default_precision_ok", True))
         except Exception as e:                # noqa: BLE001
             import traceback
             traceback.print_exc()
@@ -204,13 +205,30 @@ def sec_pallas_compile(bench, dev, n):
             ksteps, mb)
         kw = dict(act_a=1.7159, act_b=0.6666, momentum=0.9, wd=0.0005,
                   lr_bias_ratio=2.0)
+        # gate at matched 'highest' dot precision on both sides: an
+        # algorithm-identity check with bf16 MXU rounding excluded.
+        # (Measured 2026-08-02: at default precision the kernel tracks
+        # the default oracle at ~2.6e-3 over the 12-step epoch — pure
+        # bf16 multiply noise, docs/fused_fc_precision_probe.json.)
         run = functools.partial(ff.fused_fc_sgd_epoch, interpret=interp,
-                                **kw)
+                                precision="highest", **kw)
         got, info = compile_run(run, ws, bs, vws, vbs, data, labels,
                                 plan, 0.1)
-        want = ff.fused_fc_oracle(ws, bs, vws, vbs, data, labels,
-                                  plan, 0.1, **kw)
+        oracle = jax.jit(functools.partial(ff.fused_fc_oracle, **kw))
+        with jax.default_matmul_precision("highest"):
+            want = oracle(ws, bs, vws, vbs, data, labels, plan, 0.1)
         info["rel_diff"] = rel_diff(got, want)
+        # the production-default path (what training actually runs):
+        # vs a default oracle both sides do single-pass bf16 MXU
+        # multiplies, so the expected drift is bf16 rounding (~2.6e-3
+        # measured over this 12-step epoch) — gated LOOSELY so a gross
+        # precision-plumbing regression still fails the section
+        got_d = ff.fused_fc_sgd_epoch(ws, bs, vws, vbs, data, labels,
+                                      plan, 0.1, interpret=interp, **kw)
+        want_d = oracle(ws, bs, vws, vbs, data, labels, plan, 0.1)
+        dd = rel_diff(got_d, want_d)
+        info["rel_diff_default_precision"] = dd
+        info["default_precision_ok"] = dd <= 0.05
         return info
 
     record("flash_fwd", flash_fwd, tol=0.02)
@@ -702,7 +720,8 @@ def sec_generation(bench, dev, n):
 def sec_profile(bench, dev, n):
     import jax
     from imagenet_ae import build_bench_workflow
-    prof_dir = os.path.join(REPO, "docs", "profiles", "r03_ae")
+    rel_dir = os.path.join("docs", "profiles", "r03_ae")
+    prof_dir = os.path.join(REPO, rel_dir)
     os.makedirs(prof_dir, exist_ok=True)
     with bench.mixed_precision_on():
         wf = build_bench_workflow(image_size=128, minibatch_size=64,
@@ -714,7 +733,7 @@ def sec_profile(bench, dev, n):
         with jax.profiler.trace(prof_dir):
             run_epoch()
             bench.host_sync(wf.train_step)
-    return {"trace_dir": prof_dir}
+    return {"trace_dir": rel_dir}
 
 
 SECTIONS = [("pallas_compile", sec_pallas_compile),

@@ -1,0 +1,98 @@
+"""Diagnose the fused-FC chip numerics gap (chip_r03 pallas_compile:
+fused_fc_scan rel_diff 2.6e-3 > tol 1e-3).
+
+Hypothesis: the Pallas kernel's dots carry preferred_element_type=f32
+(Mosaic lowers to exact-f32 multiplies), while the jnp oracle's `@`
+uses XLA DEFAULT precision = single-pass bf16 MXU multiplies.  If so,
+the ORACLE is the noisy side and rel_diff ~ bf16 rounding compounded
+over the 12-step momentum-SGD epoch.
+
+Probe matrix (all on the real chip):
+  A. ksteps=1  kernel vs oracle(DEFAULT)    — per-step gap
+  B. ksteps=1  kernel vs oracle(HIGHEST)    — gap with an exact oracle
+  C. ksteps=12 kernel vs oracle(HIGHEST)    — full-epoch gap, exact oracle
+  D. ksteps=12 oracle(HIGHEST) vs oracle(DEFAULT) — oracle's own bf16 drift
+
+Expected under the hypothesis: B,C tiny (<=1e-5); A,D ~1e-3.
+"""
+import functools
+import json
+import os
+import sys
+
+import numpy
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax
+import jax.numpy as jnp
+
+from veles_tpu.ops import fused_fc as ff
+
+
+def rel_diff(got, want):
+    worst = 0.0
+    for g, w in zip(jax.tree_util.tree_leaves(got),
+                    jax.tree_util.tree_leaves(want)):
+        g = jnp.asarray(g, jnp.float32)
+        w = jnp.asarray(w, jnp.float32)
+        scale = float(jnp.max(jnp.abs(w))) or 1.0
+        worst = max(worst, float(jnp.max(jnp.abs(g - w))) / scale)
+    return worst
+
+
+def make_problem(ksteps, mb=100, d0=784, hid=128, nout=10):
+    r = numpy.random.RandomState(3)
+    ws = [jnp.asarray(r.randn(d0, hid) * 0.05, jnp.float32),
+          jnp.asarray(r.randn(hid, nout) * 0.05, jnp.float32)]
+    bs = [jnp.zeros((hid,), jnp.float32), jnp.zeros((nout,), jnp.float32)]
+    vws = [jnp.zeros_like(w) for w in ws]
+    vbs = [jnp.zeros_like(x) for x in bs]
+    data = jnp.asarray(r.randn(ksteps * mb, d0), jnp.float32)
+    labels = jnp.asarray(r.randint(0, nout, ksteps * mb), jnp.int32)
+    plan = jnp.arange(ksteps * mb, dtype=jnp.int32).reshape(ksteps, mb)
+    return ws, bs, vws, vbs, data, labels, plan
+
+
+KW = dict(act_a=1.7159, act_b=0.6666, momentum=0.9, wd=0.0005,
+          lr_bias_ratio=2.0)
+
+
+def main():
+    dev = jax.devices()[0]
+    print("device:", dev.platform, getattr(dev, "device_kind", "?"))
+    out = {"device": str(getattr(dev, "device_kind", dev.platform))}
+
+    for ksteps in (1, 12):
+        args = make_problem(ksteps)
+        kern = ff.fused_fc_sgd_epoch(*args, 0.1, **KW)
+        kern_hi = ff.fused_fc_sgd_epoch(*args, 0.1, precision="highest",
+                                        **KW)
+        jax.block_until_ready((kern, kern_hi))
+        # both oracles jitted identically — only the precision context
+        # differs (an eager-vs-jit mismatch would otherwise fold XLA
+        # fusion/reordering noise into the precision comparison)
+        orc_def = jax.jit(functools.partial(
+            ff.fused_fc_oracle, **KW))(*args, 0.1)
+        with jax.default_matmul_precision("highest"):
+            orc_hi = jax.jit(functools.partial(
+                ff.fused_fc_oracle, **KW))(*args, 0.1)
+        jax.block_until_ready((orc_def, orc_hi))
+        row = {
+            "kernel_vs_oracle_default": rel_diff(kern, orc_def),
+            "kernel_vs_oracle_highest": rel_diff(kern, orc_hi),
+            "kernel_highest_vs_oracle_highest": rel_diff(kern_hi, orc_hi),
+            "oracle_highest_vs_default": rel_diff(orc_hi, orc_def),
+        }
+        out["ksteps_%d" % ksteps] = row
+        print("ksteps=%d: %s" % (ksteps, row), flush=True)
+
+    path = os.path.join(REPO, "docs", "fused_fc_precision_probe.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+    print("saved", path)
+
+
+if __name__ == "__main__":
+    main()

@@ -107,6 +107,51 @@ def test_miss_off_tpu_returns_defaults(tuned_env):
     assert autotune.flash_blocks(4096, 64) == autotune.DEFAULT_BLOCKS
 
 
+def test_nearest_length_fallback(tuned_env):
+    """An untuned T inherits the measured winner from the nearest
+    tuned length of the same (d, mode) class — the v5e sweep showed
+    the block preference transfers across lengths while the 128×128
+    default LOSES to fused XLA near the crossover."""
+    autotune.record(autotune.flash_key(2048, 64, True),
+                    {"block_q": 512, "block_k": 512, "ms": 0.5})
+    autotune.record(autotune.flash_key(8192, 64, True),
+                    {"block_q": 256, "block_k": 256, "ms": 0.4})
+    autotune.clear_memo()
+    # 3072 is nearer 2048 → 512×512; 6144 is nearer 8192 → 256×256
+    assert autotune.flash_blocks(3072, 64) == (512, 512)
+    assert autotune.flash_blocks(6144, 64) == (256, 256)
+    # different mode (full) has no entries → defaults
+    assert autotune.flash_blocks(3072, 64,
+                                 causal=False) == autotune.DEFAULT_BLOCKS
+
+
+def test_nearest_length_fallback_respects_divisibility(tuned_env):
+    # nearest entry's blocks must divide the new T; otherwise defaults
+    autotune.record(autotune.flash_key(2048, 64, True),
+                    {"block_q": 512, "block_k": 512, "ms": 0.5})
+    autotune.clear_memo()
+    assert autotune.flash_blocks(1280, 64) == autotune.DEFAULT_BLOCKS
+
+
+def test_nearest_length_fallback_multihost_shipped_only(tuned_env,
+                                                        monkeypatch):
+    """Multi-host nearest-length fallback reads ONLY the shipped layer
+    (host-identical), never the per-host user DB."""
+    import jax
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    # user layer nearest entry must be IGNORED under multihost
+    autotune.record(autotune.flash_key(2048, 64, True),
+                    {"block_q": 512, "block_k": 512, "ms": 0.1})
+    autotune.clear_memo()
+    assert autotune.flash_blocks(4096, 64) == autotune.DEFAULT_BLOCKS
+    autotune.clear_memo()
+    shipped = {"faketpu-v0": {"flash_t8192_d64_causal":
+                              {"block_q": 256, "block_k": 256}}}
+    with open(autotune.SHIPPED, "w") as f:
+        json.dump(shipped, f)
+    assert autotune.flash_blocks(4096, 64) == (256, 256)
+
+
 def test_windowed_reuses_causal_entry(tuned_env):
     autotune.record(autotune.flash_key(2048, 64, True),
                     {"block_q": 512, "block_k": 128, "ms": 0.5})

@@ -261,6 +261,42 @@ def sweep_flash(t: int, d: int, causal: bool = True,
     return best
 
 
+def _nearest_blocks(t: int, d: int, causal: bool, kind: str,
+                    shipped_only: bool) -> Optional[Tuple[int, int]]:
+    """Measured winner from the nearest tuned length of the same
+    (d, mode) class whose blocks divide this ``t``. Rationale
+    (measured, docs/perf.md attn sweep): the per-device block
+    preference is set by MXU-pipeline fill, which transfers across
+    lengths — on v5e 512×512 won at BOTH 2048 and 8192, while the
+    128×128 DEFAULT_BLOCKS lost to fused XLA at 2048. Without this,
+    an untuned T between swept lengths would pair the measured
+    ``flash_min_t`` gate with the unmeasured default blocks — the
+    exact combination the sweep showed regressing."""
+    db = (_read(SHIPPED).get(kind, {}) if shipped_only
+          else _device_db(kind))
+    pref = "flash_t"
+    suf = "_d%d_%s" % (d, "causal" if causal else "full")
+    from .flash_attention import supported
+    best = None
+    for key, entry in db.items():
+        if not (key.startswith(pref) and key.endswith(suf)):
+            continue
+        try:
+            t_entry = int(key[len(pref):-len(suf)])
+        except ValueError:
+            continue
+        try:
+            bq, bk = int(entry["block_q"]), int(entry["block_k"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        if not supported(t, d, bq, bk):
+            continue
+        dist = abs(t_entry - t)
+        if best is None or dist < best[0]:
+            best = (dist, (bq, bk))
+    return best[1] if best else None
+
+
 def flash_blocks(t: int, d: int, causal: bool = True, window: int = 0,
                  device_kind: Optional[str] = None) -> Tuple[int, int]:
     """THE policy lookup ``flash_attention`` resolves its default
@@ -287,8 +323,15 @@ def flash_blocks(t: int, d: int, causal: bool = True, window: int = 0,
         # could pick different near-tied winners, and per-host user DBs
         # can differ
         hit = _read(SHIPPED).get(kind, {}).get(key)
-        blocks = DEFAULT_BLOCKS if hit is None else (
-            int(hit["block_q"]), int(hit["block_k"]))
+        if hit is not None:
+            blocks = (int(hit["block_q"]), int(hit["block_k"]))
+        else:
+            # shipped-layer nearest-length fallback: deterministic and
+            # host-identical, so SPMD processes still trace the same
+            # shapes
+            blocks = (_nearest_blocks(t, d, causal, kind,
+                                      shipped_only=True)
+                      or DEFAULT_BLOCKS)
         _memo[memo_key] = blocks
         return blocks
     hit = lookup(key, kind)
@@ -308,14 +351,22 @@ def flash_blocks(t: int, d: int, causal: bool = True, window: int = 0,
                 blocks = (int(base["block_q"]), int(base["block_k"]))
                 _memo[memo_key] = blocks
                 return blocks
-        return DEFAULT_BLOCKS
+        # NOT memoized, same as the DEFAULT_BLOCKS miss below: a later
+        # record() of a nearer length or a switch back to "auto" must
+        # be able to change the answer within the process
+        return (_nearest_blocks(t, d, causal, kind, shipped_only=False)
+                or DEFAULT_BLOCKS)
     try:
         blocks = sweep_flash(t, d, causal, device_kind=kind)
     except Exception:            # noqa: BLE001 — never fail the model;
-        # a failed sweep IS memoized: retrying it every trace would
-        # re-pay the compile storm each time
-        _memo[memo_key] = None
-        return DEFAULT_BLOCKS
+        # a failed sweep IS memoized (retrying it every trace would
+        # re-pay the compile storm each time) — but as the nearest
+        # tuned length's measured winner when one exists, not the
+        # unmeasured defaults
+        fallback = _nearest_blocks(t, d, causal, kind,
+                                   shipped_only=False)
+        _memo[memo_key] = fallback   # None → DEFAULT_BLOCKS on re-read
+        return fallback or DEFAULT_BLOCKS
     _memo[memo_key] = blocks
     return blocks
 

@@ -51,7 +51,8 @@ def _pad_to(x, axis, mult):
 
 def _kernel(refs, *, n_layers: int, mb: int, nout: int, steps: int,
             act_a: float, act_b: float, lr_bias_ratio: float,
-            wd: float, wd_bias: float, momentum: float):
+            wd: float, wd_bias: float, momentum: float,
+            precision=None):
     """One grid step = one SGD minibatch step, all state in VMEM.
 
     refs layout (built by fused_fc_sgd_epoch):
@@ -108,7 +109,7 @@ def _kernel(refs, *, n_layers: int, mb: int, nout: int, steps: int,
     def dot(a, bmat):
         return jax.lax.dot_general(
             a, bmat, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+            preferred_element_type=jnp.float32, precision=precision)
 
     # forward: tanh chain, logits head; acts[li] is layer li's INPUT
     # (so acts[li] for li >= 1 is also layer li-1's tanh output — the
@@ -154,7 +155,8 @@ def _kernel(refs, *, n_layers: int, mb: int, nout: int, steps: int,
         dims = (((0,), (0,)), ((), ())) if contract_rows \
             else (((1,), (1,)), ((), ()))
         return jax.lax.dot_general(a, bmat, dims,
-                                   preferred_element_type=jnp.float32)
+                                   preferred_element_type=jnp.float32,
+                                   precision=precision)
 
     for li in range(L - 1, -1, -1):
         a_in = acts[li]
@@ -191,7 +193,8 @@ def fused_fc_sgd_epoch(weights: Sequence, biases: Sequence,
                        lr_bias_ratio: float = 1.0,
                        wd: float = 0.0, wd_bias: float = 0.0,
                        momentum: float = 0.0,
-                       interpret: Optional[bool] = None):
+                       interpret: Optional[bool] = None,
+                       precision: Optional[str] = None):
     """One SGD epoch of an L-layer tanh chain + softmax-CE head as a
     single Pallas program with VMEM-resident weights AND momentum
     state.
@@ -205,6 +208,15 @@ def fused_fc_sgd_epoch(weights: Sequence, biases: Sequence,
     - lr: scalar learning rate for weights (traced OK — per-epoch
       schedules); the bias lr is ``lr * lr_bias_ratio`` (static
       ratio, so schedules scale both together like the scan path)
+    - precision: dot precision for every matmul in the kernel. None
+      (default) = the backend default — single-pass bf16 multiplies on
+      the MXU, matching the scan path's own default-precision dots.
+      'highest' = exact f32 multiplies; used by the chip parity gate to
+      compare the kernel against an equally-exact oracle so algorithm
+      bugs aren't hidden under (or mistaken for) bf16 rounding
+      (measured on TPU v5 lite: default-vs-f32 drift is ~1.2e-3 after
+      one step, ~2.6e-3 after a 12-step momentum epoch —
+      docs/fused_fc_precision_probe.json)
 
     Returns ``(weights', biases', vel_w', vel_b', loss_sum,
     err_count)``.
@@ -249,7 +261,8 @@ def fused_fc_sgd_epoch(weights: Sequence, biases: Sequence,
         _kernel(refs, n_layers=L, mb=mb, nout=nout, steps=k_steps,
                 act_a=float(act_a), act_b=float(act_b),
                 lr_bias_ratio=float(lr_bias_ratio), wd=float(wd),
-                wd_bias=float(wd_bias), momentum=float(momentum))
+                wd_bias=float(wd_bias), momentum=float(momentum),
+                precision=precision)
 
     vm = pltpu.VMEM
 
