@@ -205,7 +205,7 @@ def measured_tflops(epoch_counts, durations, epoch_flops,
          for e, d in zip(epoch_counts, durations)]) / 1e12
 
 
-def bench_conv_ae(dev, n_chips):
+def bench_conv_ae(dev, n_chips, minibatch_size=64):
     from veles_tpu.config import root as vt_root
     with mixed_precision_on():
         # bf16 dataset storage: halves HBM residency AND the one-time
@@ -214,14 +214,16 @@ def bench_conv_ae(dev, n_chips):
         prev_ds = vt_root.common.engine.get("dataset_dtype", None)
         vt_root.common.engine.dataset_dtype = "bfloat16"
         try:
-            return _bench_conv_ae_inner(dev, n_chips)
+            return _bench_conv_ae_inner(dev, n_chips,
+                                        minibatch_size=minibatch_size)
         finally:
             vt_root.common.engine.dataset_dtype = prev_ds
 
 
-def _bench_conv_ae_inner(dev, n_chips):
+def _bench_conv_ae_inner(dev, n_chips, minibatch_size=64):
     from imagenet_ae import build_bench_workflow
-    wf = build_bench_workflow(image_size=128, minibatch_size=64,
+    wf = build_bench_workflow(image_size=128,
+                              minibatch_size=minibatch_size,
                               n_train=1024, n_valid=128)
     wf.initialize(device=dev)
     fwd_flops = model_flops_per_sample(wf)
@@ -250,7 +252,7 @@ def _bench_conv_ae_inner(dev, n_chips):
         "mfu": tflops / n_chips / (peak / 1e12),
         "peak_bf16_tflops_assumed": peak / 1e12,
         "fwd_gflops_per_sample": fwd_flops / 1e9,
-        "image_size": 128, "minibatch": 64, "plan_steps":
+        "image_size": 128, "minibatch": minibatch_size, "plan_steps":
             wf.loader.plan_steps,
         "compute_dtype": str(root.common.engine.compute_dtype),
         "mixed_precision": bool(wf.train_step.mixed_precision),
@@ -262,17 +264,22 @@ def _bench_conv_ae_inner(dev, n_chips):
 LM_BLOCK_EPOCHS = 4
 
 
-def bench_lm(dev, n_chips):
+def bench_lm(dev, n_chips, cfg_overrides=None,
+             epochs_per_dispatch=None):
     """Transformer-LM training throughput (tokens/sec/chip) — the
     modern-workload surface: embedding → RoPE blocks → per-token CE,
-    under mixed precision with 4 whole epochs per dispatch."""
+    under mixed precision with 4 whole epochs per dispatch.
+    ``cfg_overrides`` parameterizes framework-ceiling extras (bigger
+    model/sequence rows carry their own config in the result and are
+    never compared to the default row)."""
     from char_lm import build_bench_workflow
     with mixed_precision_on():
         cfg = dict(seq_len=512, dim=512, n_blocks=6, ffn_hidden=2048,
                    n_heads=8, vocab=256, minibatch_size=16,
                    n_train=1024, n_valid=128)
-        wf = build_bench_workflow(epochs_per_dispatch=LM_BLOCK_EPOCHS,
-                                  **cfg)
+        cfg.update(cfg_overrides or {})
+        h = epochs_per_dispatch or LM_BLOCK_EPOCHS
+        wf = build_bench_workflow(epochs_per_dispatch=h, **cfg)
         wf.initialize(device=dev)
         # analytic model FLOPs per token (matmul weights x2, embedding
         # gather excluded, + the attention T-term per block), x3 train
@@ -302,7 +309,7 @@ def bench_lm(dev, n_chips):
             "mfu": tflops / n_chips / (peak / 1e12),
             "config": {k: cfg[k] for k in ("seq_len", "dim", "n_blocks",
                                            "minibatch_size")},
-            "epochs_per_dispatch": LM_BLOCK_EPOCHS,
+            "epochs_per_dispatch": h,
             "mixed_precision": True,
             "data": "synthetic",
         }

@@ -340,8 +340,34 @@ def sec_ae_amp_remat(bench, dev, n):
     return out
 
 
+def sec_ae_mb256(bench, dev, n):
+    """Framework-ceiling EXTRA for the conv-AE (its own key, like
+    mnist_mb1000): the method-tagged mb=64 row measured 11.9 % MFU
+    under AMP — HBM-bound with per-step buffers too small to hide
+    latencies. mb=256 quadruples every conv's spatial batch at the
+    same model: what the stack reaches when the config lets the MXU
+    work. Never compared against the mb=64 method tag."""
+    return bench.bench_conv_ae(dev, n, minibatch_size=256)
+
+
 def sec_lm(bench, dev, n):
     return bench.bench_lm(dev, n)
+
+
+def sec_lm_big(bench, dev, n):
+    """Framework-ceiling EXTRA for the LM (its own key): dim=1024 /
+    8 blocks / T=2048 / mb=4 — 4x the matmul width and a sequence
+    long enough (>= the measured min_t crossover) that attention runs
+    the autotuned flash kernel inside a full training step, on-chip.
+    The default lm row (dim=512, T=512) stays the comparable anchor."""
+    if _on_cpu(dev):
+        # a dim-1024 T-2048 epoch on a host core is a multi-minute
+        # stall; the wiring is proven by the default lm row's smoke
+        return {"skipped": "cpu debug run"}
+    cfg = dict(seq_len=2048, dim=1024, n_blocks=8, ffn_hidden=4096,
+               n_heads=16, minibatch_size=4, n_train=256, n_valid=32)
+    return bench.bench_lm(dev, n, cfg_overrides=cfg,
+                          epochs_per_dispatch=2)
 
 
 def sec_attn(bench, dev, n, pairs=None):
@@ -742,7 +768,8 @@ SECTIONS = [("pallas_compile", sec_pallas_compile),
             ("mnist_mb1000", sec_mnist_mb1000),
             ("ae_amp", sec_ae_amp),
             ("ae_fp32", sec_ae_fp32), ("ae_amp_remat", sec_ae_amp_remat),
-            ("lm", sec_lm),
+            ("ae_mb256", sec_ae_mb256),
+            ("lm", sec_lm), ("lm_big", sec_lm_big),
             ("attn_2048", sec_attn_2048), ("attn_8192", sec_attn_8192),
             ("generation", sec_generation), ("profile", sec_profile)]
 

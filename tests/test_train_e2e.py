@@ -249,6 +249,46 @@ def test_epoch_block_matches_classic():
                                       rtol=2e-3, atol=2e-4)
 
 
+def test_plan_height_clamps_to_class_ceiling():
+    """The plan height is static and fully scanned, so rows past the
+    class ceiling are mask-zero dead compute. A large minibatch
+    (ceil(600/200)=3 < the default 16 steps) must clamp plan_steps at
+    initialize — and the clamped run must trace the SAME trajectory as
+    an explicit steps_per_dispatch=3 config (the clamp removes only
+    dead rows). Found on chip: the mb=256 conv-AE burned 12/16 plan
+    rows masked, quadrupling the work per served sample."""
+    import jax
+    from veles_tpu import prng
+
+    def run(steps):
+        prng.seed_all(123)
+        loader = BlobsLoader(None, minibatch_size=200, name="blobs-big")
+        wf = nn.StandardWorkflow(
+            name="clamp-%s" % steps,
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 16},
+                {"type": "softmax", "output_sample_shape": 3},
+            ],
+            loader_unit=loader, loss_function="softmax",
+            decision_config=dict(max_epochs=6, fail_iterations=50),
+            steps_per_dispatch=steps,
+        )
+        wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+        assert wf.loader.plan_steps == 3        # clamped (or explicit)
+        wf.run()
+        return {
+            "valid": numpy.asarray(wf.decision.epoch_metrics[VALID]),
+            "w": numpy.asarray(jax.device_get(
+                wf.train_step.params[wf.forwards[0].name]["weights"])),
+        }
+
+    clamped = run(16)       # default-style config, clamp kicks in
+    explicit = run(3)       # exactly-sized plan, no dead rows either
+    numpy.testing.assert_array_equal(clamped["valid"],
+                                     explicit["valid"])
+    numpy.testing.assert_array_equal(clamped["w"], explicit["w"])
+
+
 def test_epoch_block_with_data_axis():
     """Block dispatch composes with data parallelism: plans shard over
     the minibatch axis, trajectory still converges."""

@@ -158,6 +158,18 @@ class Loader(Unit):
         self.shuffle()
         self.create_minibatch_data()
         n = self.max_minibatch_size
+        if self.plan_steps > 1:
+            # the plan height is STATIC: the fused consumer scans every
+            # row, and rows past a class/epoch boundary are mask-zero
+            # DEAD COMPUTE. Clamp to the tallest per-class height so a
+            # large minibatch cannot silently burn most of the dispatch
+            # on masked rows (measured: the mb=256 conv-AE at the
+            # default 16-step plan spent 12/16 rows masked — 4x the
+            # work per served sample of the mb=64 config)
+            tallest = max((self.plan_rows_for(c) for c in range(3)
+                           if self.class_lengths[c]), default=1)
+            if tallest < self.plan_steps:
+                self.plan_steps = tallest
         k = self.plan_steps
         if k > 1 and not self.fused:
             from ..error import Bug

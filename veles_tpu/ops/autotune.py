@@ -40,9 +40,12 @@ from typing import Callable, Optional, Sequence, Tuple
 
 DEFAULT_BLOCKS = (128, 128)
 #: bounded candidate census (the reference swept a fixed census too,
-#: veles/backends.py:692); filtered per call to divisors of T
+#: veles/backends.py:692); filtered per call to divisors of T. The
+#: 1024-wide pairs exist because 512×512 won every r5 sweep length —
+#: the knee hadn't been reached; ``sweep_flash``'s backward-compile
+#: check rejects them wherever the bwd working set overflows VMEM
 CANDIDATES = ((128, 128), (256, 128), (512, 128), (256, 256),
-              (512, 512))
+              (512, 512), (1024, 512), (1024, 1024))
 SHIPPED = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "devices", "kernel_tuning.json")
 
