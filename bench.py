@@ -41,12 +41,9 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.join(os.path.dirname(
     os.path.abspath(__file__)), "models"))
 
-#: nominal dense bf16 peak FLOP/s per chip by device kind (public numbers;
-#: substring-matched against jax device_kind, first hit wins)
-PEAK_BF16 = [
-    ("v6", 918e12), ("v5p", 459e12), ("v5", 197e12),
-    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
-]
+# the nominal dense bf16 peak table lives in the telemetry subsystem
+# (veles_tpu/telemetry/cost.py PEAK_BF16) — ONE copy for bench, the
+# CostModel and the docs; peak_bf16_flops() below delegates to it.
 
 
 def host_sync(step):
@@ -128,6 +125,90 @@ def model_flops_per_sample(wf):
     return total
 
 
+def _counters_before(step=None):
+    """Snapshot of the telemetry counters (and the step's per-program
+    dispatch counts), taken right before a bench section's measurement
+    windows."""
+    from veles_tpu.telemetry.counters import counters
+    return {"counters": counters.snapshot(),
+            "key_counts": dict(getattr(step, "_dispatch_counts", {}))
+            if step is not None else {}}
+
+
+def _section_counters(before, step=None, seconds=None, smoke=False,
+                      n_chips=1, epochs=None):
+    """The deterministic accounting record every bench section carries:
+    ``{flops, bytes, dispatches, compiles}`` for the measurement
+    window, from the telemetry counter deltas plus the CostModel's
+    per-program costs (``TrainStep.cost_report`` —
+    ``Compiled.cost_analysis`` with the analytic Pallas fallback
+    merged). Each program's dispatches are billed at that program's
+    own cost (classic mode mixes 'train' and 'eval' dispatches in one
+    window — a flat per-dispatch rate would inflate the eval share).
+
+    Raw window totals scale with how many epochs the time-boxed
+    windows fit, so the gate (``bench.py gate``) reads only the
+    NORMALIZED fields — ``dispatches_per_epoch`` (``epochs`` = the
+    section's run_epoch call count), ``flops_per_dispatch``,
+    ``bytes_per_dispatch``, steady-state ``compiles`` (0 whatever the
+    window length), ``dispatches_per_token`` — which are invariants of
+    the program, not the wall clock. ``smoke`` skips the cost
+    re-lowers (extra CPU compiles the smoke's time box cannot
+    afford); counters still land."""
+    from veles_tpu.telemetry.counters import counters
+    delta = counters.delta(before["counters"])
+    out = {
+        "dispatches": int(delta.get("veles_dispatches_total", 0)),
+        "compiles": int(delta.get("veles_compiles_total", 0)),
+        "h2d_bytes": int(delta.get("veles_h2d_bytes_total", 0)),
+        "d2h_bytes": int(delta.get("veles_d2h_bytes_total", 0)),
+    }
+    if epochs:
+        out["epochs"] = int(epochs)
+        out["dispatches_per_epoch"] = out["dispatches"] / epochs
+    decode_toks = delta.get("veles_decode_tokens_total", 0)
+    if decode_toks:
+        out["dispatches_per_token"] = (
+            delta.get("veles_decode_dispatches_total", 0) / decode_toks)
+    if step is None or smoke:
+        return out
+    try:
+        rep = step.cost_report()
+    except Exception as e:            # noqa: BLE001 — accounting must
+        out["cost_error"] = str(e)    # never take the section down
+        return out
+    if not rep:
+        return out
+    counts_now = dict(getattr(step, "_dispatch_counts", {}))
+    flops = bytes_ = 0.0
+    key_counts = {}
+    for key, cost in rep["costs"].items():
+        n = counts_now.get(key, 0) - before["key_counts"].get(key, 0)
+        if n <= 0:
+            continue
+        key_counts[key] = n
+        flops += cost.flops * n
+        bytes_ += cost.bytes_accessed * n
+    primary = rep["cost"]
+    n_prog = sum(key_counts.values())
+    out["flops"] = flops
+    out["bytes"] = bytes_
+    out["program_dispatches"] = key_counts
+    out["flops_per_dispatch"] = flops / n_prog if n_prog else 0.0
+    out["bytes_per_dispatch"] = bytes_ / n_prog if n_prog else 0.0
+    out["peak_memory_bytes"] = primary.peak_memory
+    out["cost_source"] = primary.source
+    out["program"] = rep["key"]
+    if seconds and flops:
+        # measured MFU from the framework's own cost accounting — the
+        # CostModel numerator over the chip's nominal peak, NOT a
+        # hand-derived number in docs (docs/observability.md)
+        from veles_tpu.telemetry.cost import Cost
+        out["mfu_telemetry"] = Cost(flops, bytes_).mfu(
+            seconds, n_chips=n_chips)
+    return out
+
+
 BLOCK_EPOCHS = 8
 
 
@@ -152,7 +233,8 @@ def bench_mnist(dev, n_chips, smoke=False, h=None):
     run_epoch = epoch_runner(wf)
     run_epoch()                  # warmup: compile + first placement
     host_sync(wf.train_step)
-    rates, _, _ = measure_windows(
+    before = _counters_before(wf.train_step)
+    rates, eps, durs = measure_windows(
         run_epoch, lambda: host_sync(wf.train_step),
         n_windows=1 if smoke else 3, secs=3.0 if smoke else 10.0,
         min_epochs=1 if smoke else 2)
@@ -167,6 +249,10 @@ def bench_mnist(dev, n_chips, smoke=False, h=None):
         # fallback must never wear the fused-kernel method tag)
         "fused_fc_active": bool(getattr(wf.train_step,
                                         "_fused_fc_active", False)),
+        "counters": _section_counters(before, wf.train_step,
+                                      seconds=sum(durs), smoke=smoke,
+                                      n_chips=n_chips,
+                                      epochs=sum(eps)),
     }
 
 
@@ -188,10 +274,8 @@ def mixed_precision_on():
 
 
 def peak_bf16_flops():
-    import jax
-    kind = getattr(jax.devices()[0], "device_kind", "unknown")
-    return next((p for key, p in PEAK_BF16
-                 if key in str(kind).lower()), 275e12)
+    from veles_tpu.telemetry.cost import peak_bf16_flops as _peak
+    return _peak()      # detects the device kind itself, gracefully
 
 
 def measured_tflops(epoch_counts, durations, epoch_flops,
@@ -234,6 +318,7 @@ def _bench_conv_ae_inner(dev, n_chips, minibatch_size=64):
     run_epoch = epoch_runner(wf)
     run_epoch()
     host_sync(wf.train_step)
+    before = _counters_before(wf.train_step)
     rates, epochs, durs = measure_windows(
         run_epoch, lambda: host_sync(wf.train_step))
     tflops = measured_tflops(epochs, durs, epoch_flops)
@@ -258,6 +343,10 @@ def _bench_conv_ae_inner(dev, n_chips, minibatch_size=64):
         "mixed_precision": bool(wf.train_step.mixed_precision),
         "dataset_dtype": str(wf.loader.original_data.mem.dtype),
         "data": "synthetic",
+        "counters": _section_counters(before, wf.train_step,
+                                      seconds=sum(durs),
+                                      n_chips=n_chips,
+                                      epochs=sum(epochs)),
     }
 
 
@@ -293,6 +382,7 @@ def bench_lm(dev, n_chips, cfg_overrides=None,
         run_epoch = epoch_runner(wf)
         run_epoch()
         host_sync(wf.train_step)
+        before = _counters_before(wf.train_step)
         rates, epochs, durs = measure_windows(
             run_epoch, lambda: host_sync(wf.train_step))
         # each run_epoch call = one BLOCK of 4 whole epochs
@@ -312,6 +402,10 @@ def bench_lm(dev, n_chips, cfg_overrides=None,
             "epochs_per_dispatch": h,
             "mixed_precision": True,
             "data": "synthetic",
+            "counters": _section_counters(before, wf.train_step,
+                                          seconds=sum(durs),
+                                          n_chips=n_chips,
+                                          epochs=sum(epochs)),
         }
 
 
@@ -436,6 +530,9 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         "sync": "host_fetch",
         "platform": platform,
         "device_kind": device_kind,
+        # deterministic accounting for the headline window (telemetry
+        # counters + CostModel): what `bench.py gate` compares
+        "counters": mnist.get("counters", {}),
         "extras": [ae, lm],
     }
 
@@ -539,6 +636,63 @@ def _cpu_fallback(reason):
     print(json.dumps(out))
 
 
+def gate_docs(baseline_doc, current_doc):
+    """Counter-based perf gate between two BENCH_*.json documents:
+    compares the deterministic ``counters`` records (headline +
+    extras matched by metric name) and returns failure strings (empty
+    = pass). This is the gate that stays meaningful when the relay is
+    noisy: an extra dispatch per token or an unexpected recompile
+    fails exactly, no matter what wall-clock did. Sections without
+    counters (legacy baselines, skipped extras) are ignored —
+    the gate can only tighten as baselines regenerate."""
+    from veles_tpu.telemetry import gate_counters
+    pairs = [("headline", baseline_doc.get("counters") or {},
+              current_doc.get("counters") or {})]
+    base_extras = {e.get("metric"): e
+                   for e in baseline_doc.get("extras", [])
+                   if isinstance(e, dict)}
+    for extra in current_doc.get("extras", []):
+        if not isinstance(extra, dict):
+            continue
+        base = base_extras.get(extra.get("metric"))
+        if base is None:
+            continue
+        pairs.append((extra.get("metric"),
+                      base.get("counters") or {},
+                      extra.get("counters") or {}))
+    failures = []
+    for name, base_c, cur_c in pairs:
+        if not base_c or not cur_c:
+            continue
+        # decode sections carry dispatches_per_token; >1 means the
+        # scan degenerated to per-token dispatch (the round-5 finding)
+        ceiling = (1.0 if "dispatches_per_token" in cur_c else None)
+        for failure in gate_counters(
+                cur_c, base_c, max_dispatches_per_token=ceiling):
+            failures.append("%s: %s" % (name, failure))
+    return failures
+
+
+def _gate_main(argv):
+    """``python bench.py gate BASELINE.json CURRENT.json`` — exit 1 on
+    any counter regression."""
+    if len(argv) != 2:
+        print("usage: bench.py gate BASELINE.json CURRENT.json",
+              file=sys.stderr)
+        return 2
+    with open(argv[0]) as f:
+        baseline = json.load(f)
+    with open(argv[1]) as f:
+        current = json.load(f)
+    failures = gate_docs(baseline, current)
+    for failure in failures:
+        print("GATE FAIL %s" % failure, file=sys.stderr)
+    if failures:
+        return 1
+    print("counter gate OK (%s vs %s)" % (argv[1], argv[0]))
+    return 0
+
+
 def main():
     """Parent: NEVER initializes jax outside the pinned-CPU fallback.
     The whole accelerator path runs in a killable child under a hard
@@ -619,4 +773,6 @@ def main():
 
 
 if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "gate":
+        sys.exit(_gate_main(sys.argv[2:]))
     main()

@@ -23,6 +23,12 @@ from .logger import setup_logging
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "trace":
+        # telemetry subcommand family (no model/workflow involved):
+        #   veles-tpu trace export RUN.jsonl TRACE.json
+        return _trace_cli(argv[1:])
     parser = make_parser()
     args = parser.parse_args(argv)
     if args.serve_draft_snapshot and not args.serve_draft:
@@ -34,6 +40,13 @@ def main(argv=None) -> int:
     level = (logging.WARNING, logging.INFO,
              logging.DEBUG)[min(args.verbose, 2)]
     setup_logging(level=level, tracefile=args.trace_file)
+    if args.trace_file:
+        # telemetry spans stream into the same JSONL file as the logger
+        # events (span records carry name+ts+dur, events name+time —
+        # `trace export` picks out the spans); one --trace-file, one
+        # observability stream
+        from .telemetry.spans import recorder
+        recorder.set_sink(args.trace_file)
     if args.debug:
         from .logger import enable_debug
         enable_debug(args.debug)
@@ -112,6 +125,32 @@ def main(argv=None) -> int:
     raise VelesError(
         "%s defines neither build_workflow() nor run(load, main)"
         % args.model)
+
+
+def _trace_cli(argv) -> int:
+    """``veles-tpu trace export RUN.jsonl TRACE.json`` — convert a
+    span JSONL stream (--trace-file output, or a
+    telemetry.spans.recorder.to_jsonl dump) into Chrome trace_event
+    JSON viewable in Perfetto / chrome://tracing."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu trace",
+        description="telemetry trace tools (veles_tpu/telemetry/)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    exp = sub.add_parser(
+        "export", help="span JSONL -> Chrome trace_event JSON")
+    exp.add_argument("jsonl", help="span JSONL (from --trace-file)")
+    exp.add_argument("out", help="trace_event JSON to write")
+    args = parser.parse_args(argv)
+    from .telemetry import chrome_trace
+    try:
+        n = chrome_trace.export(args.jsonl, args.out)
+    except (OSError, ValueError) as e:
+        print("trace export failed: %s" % e, file=sys.stderr)
+        return 1
+    print("exported %d spans -> %s (open in Perfetto: "
+          "https://ui.perfetto.dev)" % (n, args.out))
+    return 0
 
 
 def _materialize(args) -> None:

@@ -26,6 +26,18 @@ from .units import Unit
 from .workflow import Workflow
 
 
+def _abstract_shapes(args):
+    """Pytree of ShapeDtypeStructs mirroring ``args`` (non-array leaves
+    pass through — jit treats them as static-compatible values)."""
+    import jax
+
+    def leaf(a):
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+        return a
+    return jax.tree_util.tree_map(leaf, args)
+
+
 class AcceleratedUnit(Unit):
     """Compute unit with device dispatch (reference:
     veles/accelerated_units.py:130)."""
@@ -36,6 +48,15 @@ class AcceleratedUnit(Unit):
         super().__init__(workflow, **kwargs)
         self.device: Optional[Device] = None
         self._jit_cache: Dict[str, Any] = {}
+        #: raw fn + jit kwargs per key — program_cost() re-lowers from
+        #: these (the jitted callable hides its Compiled objects)
+        self._jit_fns: Dict[str, Any] = {}
+        #: abstract arg shapes of the LAST dispatch per key (donated
+        #: buffers die at dispatch, so cost analysis lowers on shapes)
+        self._jit_arg_shapes: Dict[str, Any] = {}
+        #: dispatches per jit key — lets cost accounting bill each
+        #: program (train vs eval vs epoch_block) at its OWN cost
+        self._dispatch_counts: Dict[str, int] = {}
 
     # -- lifecycle ----------------------------------------------------------
     def initialize(self, device: Optional[Device] = None, **kwargs):
@@ -80,16 +101,86 @@ class AcceleratedUnit(Unit):
         """Cache a jitted callable per unit (the reference cached built
         kernels per device, veles/accelerated_units.py:605-673; XLA's own
         compilation cache does the heavy lifting — this only avoids
-        re-tracing)."""
+        re-tracing).
+
+        The returned callable is telemetry-instrumented: every call
+        counts one ``veles_dispatches_total``; a call that grows the
+        jit's trace cache counts one ``veles_compiles_total`` (the
+        counter the bench gate reads — recompiles are a deterministic
+        regression signal the wall-clock medians cannot see); lookups
+        served from the per-unit cache count
+        ``veles_jit_cache_hits_total``."""
         cached = self._jit_cache.get(key)
         if cached is None:
             import jax
-            cached = self._jit_cache[key] = jax.jit(fn, **jit_kwargs)
+            from .telemetry.counters import inc
+            jitted = jax.jit(fn, **jit_kwargs)
+            self._jit_fns[key] = (fn, dict(jit_kwargs))
+            unit = self
+
+            def dispatch(*args, **kwargs):
+                unit._dispatch_counts[key] = \
+                    unit._dispatch_counts.get(key, 0) + 1
+                try:
+                    before = jitted._cache_size()
+                except AttributeError:       # non-pjit backends
+                    before = None
+                out = jitted(*args, **kwargs)
+                inc("veles_dispatches_total")
+                if before is None:
+                    # no cache introspection: capture shapes per call
+                    unit._jit_arg_shapes[key] = _abstract_shapes(args)
+                elif jitted._cache_size() > before:
+                    inc("veles_compiles_total")
+                    # shapes only change on retrace, and a retrace IS a
+                    # cache growth — capturing here keeps the hot path
+                    # free of the per-call pytree walk
+                    unit._jit_arg_shapes[key] = _abstract_shapes(args)
+                return out
+
+            dispatch._jitted = jitted
+            cached = self._jit_cache[key] = dispatch
+        else:
+            from .telemetry.counters import inc
+            inc("veles_jit_cache_hits_total")
         return cached
+
+    def program_cost(self, key: str):
+        """FLOPs/bytes/peak-memory of the LAST program dispatched under
+        ``key``, via ``Compiled.cost_analysis()`` on a re-lower at the
+        recorded arg shapes (in-process, so XLA's compilation cache
+        absorbs most of the cost). Returns a telemetry ``Cost`` or None
+        when nothing has been dispatched under ``key``. On-demand only
+        (bench sections, tests) — never on the hot path."""
+        entry = self._jit_fns.get(key)
+        shapes = self._jit_arg_shapes.get(key)
+        if entry is None or shapes is None:
+            return None
+        import jax
+        from .telemetry.cost import (collecting_kernel_costs,
+                                     cost_of_compiled)
+        fn, jit_kwargs = entry
+        # donation changes buffer reuse, not the cost model; dropping it
+        # lets the lowering accept abstract args without aliasing checks
+        jit_kwargs = {k: v for k, v in jit_kwargs.items()
+                      if k != "donate_argnums"}
+        # the re-lower re-traces fn, so Pallas kernels (opaque to the
+        # HLO cost model) note their analytic costs into the collector
+        # — body-once, the same convention cost_analysis uses for
+        # scan/while bodies
+        with collecting_kernel_costs() as notes:
+            compiled = jax.jit(fn, **jit_kwargs).lower(*shapes).compile()
+        cost = cost_of_compiled(compiled)
+        for kernel_cost in notes:
+            cost = cost + kernel_cost
+        return cost
 
     def __getstate__(self):
         d = dict(self.__dict__)
         d["_jit_cache"] = {}
+        d["_jit_fns"] = {}
+        d["_jit_arg_shapes"] = {}
+        d["_dispatch_counts"] = {}
         d["device"] = None
         return d
 

@@ -204,7 +204,12 @@ def _default_root() -> Config:
             # data, fsdp, tensor, sequence, expert, pipeline
             "axes": {"data": -1},    # -1 = all remaining devices
         },
-        "trace": {"run": False, "timings": False},
+        # trace.spans: telemetry span recording — honored centrally by
+        # the recorder, so it covers Unit.run, workflow.run/initialize,
+        # the train step and the decoders (veles_tpu/telemetry/
+        # spans.py — in-memory ring + optional --trace-file JSONL; a
+        # deque append per span, cheap enough to stay on by default)
+        "trace": {"run": False, "timings": False, "spans": True},
         "disable": {"plotting": bool(os.environ.get("VELES_TPU_TEST"))},
         "random_seed": 1234,
     })

@@ -169,6 +169,11 @@ class Loader(Unit):
             tallest = max((self.plan_rows_for(c) for c in range(3)
                            if self.class_lengths[c]), default=1)
             if tallest < self.plan_steps:
+                # say so: a silently overridden steps_per_dispatch is a
+                # mystery to whoever configured it (ADVICE)
+                self.info("%s: plan_steps clamped %d -> %d (tallest "
+                          "class plan)", self.name, self.plan_steps,
+                          tallest)
                 self.plan_steps = tallest
         k = self.plan_steps
         if k > 1 and not self.fused:

@@ -26,6 +26,25 @@ from .transformer import (Embedding, LMHead, PositionalEmbedding,
                           block_norm)
 
 
+def _count_decode_dispatches(program):
+    """Decorator applied DIRECTLY over ``jax.jit`` at every decode
+    program definition (here and nn/speculative.py): each invocation
+    of the jitted program counts one ``veles_decode_dispatches_total``.
+    The counter sits at the device-program boundary, not the public
+    generate() entry, so a decode restructured into a host loop of
+    per-token jitted steps reads as n_new dispatches — the round-5
+    dispatch-count regression lock measures, it does not assert. Any
+    new jitted decode program MUST wear this decorator."""
+    import functools
+    from ..telemetry.counters import inc
+
+    @functools.wraps(program)
+    def counted(*args, **kwargs):
+        inc("veles_decode_dispatches_total")
+        return program(*args, **kwargs)
+    return counted
+
+
 def params_of(wf):
     """The device-side parameter pytree of a workflow's forwards — the
     ONE copy of the extraction every decoding entry point shares."""
@@ -203,6 +222,7 @@ def _build_sampler(wf, t_p, n_new, temperature):
         return (jnp.dot(x_last, params[head.name]["weights"],
                         precision=prec) + params[head.name]["bias"])
 
+    @_count_decode_dispatches
     @jax.jit
     def run(params, prompt_ids, key):
         b = prompt_ids.shape[0]
@@ -271,8 +291,18 @@ def generate(wf, prompt, n_new, temperature=1.0, seed=0):
     if run is None:
         run = cache[key] = _build_sampler(wf, t_p, n_new, temperature)
     params = params_of(wf)
-    toks = numpy.asarray(
-        run(params, jnp.asarray(prompt), jax.random.PRNGKey(seed)))
+    from ..telemetry.counters import inc
+    from ..telemetry.spans import span
+    with span("decode.cached", batch=int(prompt.shape[0]),
+              n_new=int(n_new)):
+        # prefill + scan is ONE device program, so this whole decode
+        # must cost exactly one decode dispatch (the round-5
+        # regression lock). The counter rides the PROGRAM wrapper, not
+        # this call site: a restructure that invokes the program per
+        # token shows up as n_new dispatches, not a hand-asserted 1.
+        toks = numpy.asarray(
+            run(params, jnp.asarray(prompt), jax.random.PRNGKey(seed)))
+    inc("veles_decode_tokens_total", int(n_new) * int(prompt.shape[0]))
     if not batched:
         return [int(t) for t in toks[:, 0]]
     return [[int(t) for t in toks[:, i]] for i in range(toks.shape[1])]

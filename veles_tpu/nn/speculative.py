@@ -292,6 +292,9 @@ def _build_spec_sampler(wf_target, wf_draft, t_p, n_new, gamma,
     draft_propose, target_verify, accept_emit = _make_round_fns(
         tgt, drf, gamma, greedy, tau)
 
+    from .sampling import _count_decode_dispatches
+
+    @_count_decode_dispatches
     @jax.jit
     def run(params_t, params_d, prompt_ids, key):
         caches_t, first_logits = _prefill(tgt, params_t, prompt_ids)
@@ -363,6 +366,9 @@ def _build_spec_sampler_batch(wf_target, wf_draft, t_p, n_new, gamma,
     def unlift(cs):
         return tuple((ck[0], cv[0]) for ck, cv in cs)
 
+    from .sampling import _count_decode_dispatches
+
+    @_count_decode_dispatches
     @jax.jit
     def run(params_t, params_d, prompt_ids, keys):
         """prompt_ids (B, t_p); keys (B, 2) — one PRNG stream per row."""
@@ -474,18 +480,32 @@ def generate_speculative(wf_target, wf_draft, prompt, n_new,
     run = entry[1]
 
     from .sampling import params_of
+    from ..telemetry.counters import inc
+    from ..telemetry.spans import span
     if not batched:
-        toks, rounds, acc = run(
-            params_of(wf_target), params_of(wf_draft),
-            jnp.asarray(prompt[None, :]), jax.random.PRNGKey(seed))
+        with span("decode.speculative", batch=1, n_new=int(n_new),
+                  gamma=int(gamma)):
+            # the whole speculation loop (draft proposes, target
+            # verifies, lax.while on device) is ONE program — its
+            # dispatch is counted by the _count_decode_dispatches
+            # wrapper per invocation, so the round-5 dispatch-count
+            # story is measured, not hand-derived
+            toks, rounds, acc = run(
+                params_of(wf_target), params_of(wf_draft),
+                jnp.asarray(prompt[None, :]), jax.random.PRNGKey(seed))
+        inc("veles_decode_tokens_total", int(n_new))
         rounds = max(int(rounds), 1)
         return ([int(t) for t in numpy.asarray(toks)],
                 {"rounds": rounds,
                  "acceptance": float(acc) / (rounds * int(gamma))})
     keys = jax.vmap(jax.random.fold_in, (None, 0))(
         jax.random.PRNGKey(seed), jnp.arange(bsz))
-    toks, rounds, acc = run(params_of(wf_target), params_of(wf_draft),
-                            jnp.asarray(prompt), keys)
+    with span("decode.speculative", batch=bsz, n_new=int(n_new),
+              gamma=int(gamma)):
+        toks, rounds, acc = run(params_of(wf_target),
+                                params_of(wf_draft),
+                                jnp.asarray(prompt), keys)
+    inc("veles_decode_tokens_total", int(n_new) * bsz)
     toks = numpy.asarray(toks)
     rounds = numpy.maximum(numpy.asarray(rounds), 1)
     acc = numpy.asarray(acc, dtype=numpy.float64)

@@ -300,26 +300,18 @@ class TrainStep(AcceleratedUnit):
         # VMEM budget: the kernel holds weights + biases + the delta
         # recurrence (×2) plus a minibatch block resident; an oversized
         # chain must FALL BACK, not die in an opaque Mosaic allocation
-        # error inside the jitted epoch block
-        def padded(n, m=128):
-            return ((n + m - 1) // m) * m
-
-        state_bytes = 0
+        # error inside the jitted epoch block. The residency estimate
+        # is the kernel owner's (ops.fused_fc.analytic_cost
+        # peak_memory) — ONE formula for the gate and the cost model
+        from ..ops.fused_fc import analytic_cost as _ff_cost
         mb = self.loader.max_minibatch_size
-        for f in fs:
-            w = self.params[f.name]["weights"]
-            state_bytes += 2 * 4 * (padded(w.shape[0])
-                                    * padded(w.shape[1])
-                                    + 8 * padded(w.shape[1]))
-        x_bytes = 4 * padded(mb, 8) * padded(
-            int(numpy.prod(self.params[fs[0].name]["weights"]
-                           .shape[:1])))
+        peak = _ff_cost([self.params[f.name]["weights"].shape
+                         for f in fs], mb, steps=1).peak_memory
         budget = 12 * 2 ** 20          # leave headroom in ~16 MiB VMEM
-        if state_bytes + 3 * x_bytes > budget:
+        if peak > budget:
             return reject("VMEM budget: ~%.1f MiB state + batch "
                           "exceeds the %.0f MiB kernel budget"
-                          % ((state_bytes + 3 * x_bytes) / 2 ** 20,
-                             budget / 2 ** 20))
+                          % (peak / 2 ** 20, budget / 2 ** 20))
         ds = self.loader.original_data
         if ds is None or ds.mem.ndim != 2:
             return reject("flat (N, features) dataset only")
@@ -989,6 +981,8 @@ class TrainStep(AcceleratedUnit):
     def _run_epoch_block(self) -> None:
         import jax
         import numpy as _np
+        from ..telemetry.counters import inc
+        from ..telemetry.spans import span
         loader = self.loader
         dataset, labels, targets, _, _ = self._inputs()
         sh = self._shardings
@@ -1009,16 +1003,19 @@ class TrainStep(AcceleratedUnit):
                 # length, reuse the device copies across blocks
                 cached = self._eval_plan_dev.get((cls, h))
                 if cached is None:
-                    cached = (jax.device_put(idx.map_read()[:h], plan_sh),
-                              jax.device_put(mask.map_read()[:h],
-                                             plan_sh))
+                    idx_h = idx.map_read()[:h]
+                    mask_h = mask.map_read()[:h]
+                    inc("veles_h2d_bytes_total",
+                        idx_h.nbytes + mask_h.nbytes)
+                    cached = (jax.device_put(idx_h, plan_sh),
+                              jax.device_put(mask_h, plan_sh))
                     self._eval_plan_dev[(cls, h)] = cached
                 xs["c%d_idx" % cls], xs["c%d_mask" % cls] = cached
                 continue
-            xs["c%d_idx" % cls] = jax.device_put(
-                idx.map_read()[:h], plan_sh)
-            xs["c%d_mask" % cls] = jax.device_put(
-                mask.map_read()[:h], plan_sh)
+            idx_h, mask_h = idx.map_read()[:h], mask.map_read()[:h]
+            inc("veles_h2d_bytes_total", idx_h.nbytes + mask_h.nbytes)
+            xs["c%d_idx" % cls] = jax.device_put(idx_h, plan_sh)
+            xs["c%d_mask" % cls] = jax.device_put(mask_h, plan_sh)
         # per-epoch LR scales from the schedule, host-evaluated exactly
         # as the classic loop would have (epoch k trains at schedule(k))
         lr_adjust = getattr(self.workflow, "lr_adjust", None)
@@ -1048,9 +1045,11 @@ class TrainStep(AcceleratedUnit):
         jitted = self.jit(
             "epoch_block_fused" if self._fused_fc_active
             else "epoch_block", fn, donate_argnums=(0, 1))
-        self.params, self.opt_state, stacked, self.last_loss = jitted(
-            self.params, self.opt_state, dataset, labels, targets, xs,
-            self._rng.jax_key())
+        with span("train_step.epoch_block", unit=self.name, epochs=h,
+                  fused_fc=bool(self._fused_fc_active)):
+            self.params, self.opt_state, stacked, self.last_loss = \
+                jitted(self.params, self.opt_state, dataset, labels,
+                       targets, xs, self._rng.jax_key())
         # stays on device until the Decision drains: the host must NOT
         # block here, or consecutive blocks lose their async overlap
         self._block_metrics = (stacked, h)
@@ -1060,14 +1059,54 @@ class TrainStep(AcceleratedUnit):
         a block dispatch, one entry in the classic per-epoch mode."""
         if self._block_metrics is not None:
             import jax
+            from ..telemetry.counters import inc
             stacked, h = self._block_metrics
             self._block_metrics = None
             host = jax.device_get(stacked)
+            inc("veles_d2h_bytes_total",
+                sum(a.nbytes for a in jax.tree_util.tree_leaves(host)))
             return [
                 {cls: {k: float(v[e]) for k, v in acc.items()}
                  for cls, acc in host.items()}
                 for e in range(h)]
         return [self.drain_epoch_metrics()]
+
+    def cost_report(self):
+        """Telemetry cost of every program this unit has dispatched
+        (``AcceleratedUnit.program_cost`` per jit key), with the
+        analytic fused-FC cost merged in when the Pallas kernel is
+        active — the custom call is opaque to XLA's HLO cost model, so
+        the kernel's FLOPs/bytes come from ``ops.fused_fc.
+        analytic_cost``. Returns ``{"key", "cost", "costs"}`` (primary
+        key + its cost, plus per-key costs so sections that mix
+        programs — classic mode runs 'train' AND 'eval' per epoch —
+        bill each dispatch at its own program's cost) or None before
+        the first dispatch. This is what bench.py's measured-MFU rows
+        read."""
+        costs = {}
+        for key in ("epoch_block_fused", "epoch_block", "train",
+                    "eval"):
+            if key not in self._jit_arg_shapes:
+                continue
+            cost = self.program_cost(key)
+            if cost is None:
+                continue
+            if key == "epoch_block_fused" and self._fused_fc is not None:
+                from ..ops import fused_fc as _ff
+                names = self._fused_fc["names"]
+                shapes = [self.params[n]["weights"].shape
+                          for n in names]
+                loader = self.loader
+                h = loader.block_length or loader.block_epochs
+                per_epoch = _ff.analytic_cost(
+                    shapes, loader.max_minibatch_size,
+                    loader.plan_steps)
+                cost = cost + per_epoch.scaled(h)
+            costs[key] = cost
+        if not costs:
+            return None
+        primary = next(iter(costs))
+        return {"key": primary, "cost": costs[primary], "costs": costs}
 
     def xla_run(self) -> None:
         import jax
@@ -1107,10 +1146,16 @@ class TrainStep(AcceleratedUnit):
     # -- epoch drain (Decision pulls these) ----------------------------------
     def drain_epoch_metrics(self) -> Dict[int, Dict[str, float]]:
         import jax
+        from ..telemetry.counters import inc
         out = {}
+        drained = 0
         for cls, accum in self._accum.items():
             host = jax.device_get(accum)
+            drained += sum(a.nbytes
+                           for a in jax.tree_util.tree_leaves(host))
             out[cls] = {k: float(v) for k, v in host.items()}
+        if drained:
+            inc("veles_d2h_bytes_total", drained)
         self._accum.clear()
         return out
 

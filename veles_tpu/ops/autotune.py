@@ -114,6 +114,7 @@ def record(key: str, entry: dict, device_kind: Optional[str] = None,
             tmp = path + ".tmp"
             with open(tmp, "w") as f:
                 json.dump(db, f, indent=1, sort_keys=True)
+                f.write("\n")       # POSIX text file: end with newline
             os.replace(tmp, path)
     if "block_q" in entry:
         _memo[(kind, key)] = (entry["block_q"], entry["block_k"])
@@ -270,9 +271,11 @@ def _nearest_blocks(t: int, d: int, causal: bool, kind: str,
     (d, mode) class whose blocks divide this ``t``. Rationale
     (measured, docs/perf.md attn sweep): the per-device block
     preference is set by MXU-pipeline fill, which transfers across
-    lengths — on v5e 512×512 won at BOTH 2048 and 8192, while the
-    128×128 DEFAULT_BLOCKS lost to fused XLA at 2048. Without this,
-    an untuned T between swept lengths would pair the measured
+    lengths — the committed v5e winners are 1024×1024 at BOTH 2048
+    and 8192 (devices/kernel_tuning.json, round-5 extended census;
+    512×512 is the runner-up throughout), while the 128×128
+    DEFAULT_BLOCKS lost to fused XLA at 2048. Without this, an
+    untuned T between swept lengths would pair the measured
     ``flash_min_t`` gate with the unmeasured default blocks — the
     exact combination the sweep showed regressing."""
     db = (_read(SHIPPED).get(kind, {}) if shipped_only
@@ -298,6 +301,29 @@ def _nearest_blocks(t: int, d: int, causal: bool, kind: str,
         if best is None or dist < best[0]:
             best = (dist, (bq, bk))
     return best[1] if best else None
+
+
+def _check_inherited(t: int, d: int, causal: bool,
+                     blocks: Tuple[int, int], kind: str
+                     ) -> Tuple[int, int]:
+    """First use of a length-INHERITED winner at this ``t``: confirm
+    the custom-VJP pair actually LOWERS (mirroring ``sweep_flash``'s
+    ``_bwd_compiles`` gate, which only ran at the swept lengths) and
+    fall back to DEFAULT_BLOCKS instead of erroring inside the model's
+    jitted step. TPU-only: off-TPU the kernel runs in interpret mode
+    where there is no Mosaic lowering to fail (and tests drive
+    inheritance with fake device kinds). The verdict is memoized per
+    (kind, t, blocks) so the compile probe costs once, not per trace."""
+    if blocks == DEFAULT_BLOCKS:
+        return blocks
+    import jax
+    if jax.default_backend() != "tpu":
+        return blocks
+    memo_key = (kind, "inherit_ok", t, d, causal, blocks)
+    ok = _memo.get(memo_key)
+    if ok is None:
+        ok = _memo[memo_key] = _bwd_compiles(t, d, causal, blocks)
+    return blocks if ok else DEFAULT_BLOCKS
 
 
 def flash_blocks(t: int, d: int, causal: bool = True, window: int = 0,
@@ -331,10 +357,12 @@ def flash_blocks(t: int, d: int, causal: bool = True, window: int = 0,
         else:
             # shipped-layer nearest-length fallback: deterministic and
             # host-identical, so SPMD processes still trace the same
-            # shapes
-            blocks = (_nearest_blocks(t, d, causal, kind,
-                                      shipped_only=True)
-                      or DEFAULT_BLOCKS)
+            # shapes (the compile probe is host-identical too — same
+            # kernel code on the same device kind)
+            inherited = _nearest_blocks(t, d, causal, kind,
+                                        shipped_only=True)
+            blocks = (_check_inherited(t, d, causal, inherited, kind)
+                      if inherited else DEFAULT_BLOCKS)
         _memo[memo_key] = blocks
         return blocks
     hit = lookup(key, kind)
@@ -357,17 +385,24 @@ def flash_blocks(t: int, d: int, causal: bool = True, window: int = 0,
         # NOT memoized, same as the DEFAULT_BLOCKS miss below: a later
         # record() of a nearer length or a switch back to "auto" must
         # be able to change the answer within the process
-        return (_nearest_blocks(t, d, causal, kind, shipped_only=False)
-                or DEFAULT_BLOCKS)
+        inherited = _nearest_blocks(t, d, causal, kind,
+                                    shipped_only=False)
+        if inherited is None:
+            return DEFAULT_BLOCKS
+        return _check_inherited(t, d, causal, inherited, kind)
     try:
         blocks = sweep_flash(t, d, causal, device_kind=kind)
     except Exception:            # noqa: BLE001 — never fail the model;
         # a failed sweep IS memoized (retrying it every trace would
         # re-pay the compile storm each time) — but as the nearest
-        # tuned length's measured winner when one exists, not the
-        # unmeasured defaults
+        # tuned length's measured winner when one exists (compile-
+        # checked at THIS t), not the unmeasured defaults
         fallback = _nearest_blocks(t, d, causal, kind,
                                    shipped_only=False)
+        if fallback is not None:
+            fallback = _check_inherited(t, d, causal, fallback, kind)
+            if fallback == DEFAULT_BLOCKS:
+                fallback = None  # store the miss, not a fake winner
         _memo[memo_key] = fallback   # None → DEFAULT_BLOCKS on re-read
         return fallback or DEFAULT_BLOCKS
     _memo[memo_key] = blocks

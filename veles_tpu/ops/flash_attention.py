@@ -462,16 +462,16 @@ def _use_pallas_bwd() -> bool:
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
 def _flash(q, k, v, causal, scale, block_q, block_k, interpret,
-           window, h, kv):
+           window, h, kv, d_logical):
     o, _ = _fwd_pallas(q, k, v, causal, scale, block_q, block_k,
                        interpret, window, h, kv)
     return o
 
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
-               window, h, kv):
+               window, h, kv, d_logical):
     o, lse = _fwd_pallas(q, k, v, causal, scale, block_q, block_k,
                          interpret, window, h, kv)
     # residuals keep the GROUPED k/v — the GQA memory saving holds
@@ -480,7 +480,17 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, window,
-               h, kv, res, do):
+               h, kv, d_logical, res, do):
+    q = res[0]
+    # trace-time analytic note for the backward pair (standard 2.5×
+    # the forward: blockwise recompute + 4 gradient matmuls), billed
+    # at the LOGICAL head dim (``d_logical`` rides the nondiff args:
+    # the folded residual is lane-padded, and model FLOPs count the
+    # useful dim, matching the forward note)
+    from ..telemetry.cost import note_kernel_cost
+    note_kernel_cost(analytic_cost(
+        q.shape[0] // h, q.shape[1], h, d_logical, causal,
+        window).scaled(2.5))
     if _use_pallas_bwd():
         q, k, v, o, lse = res
         return _bwd_pallas(q, k, v, o, lse, do, causal, scale,
@@ -529,6 +539,34 @@ def choose_flash(t: int, d: int) -> bool:
     # bench gate
     from .autotune import resolved_min_t
     return t >= resolved_min_t(d)
+
+
+def analytic_cost(b: int, t: int, h: int, d: int, causal: bool = False,
+                  window: int = 0, train: bool = False,
+                  dtype_bytes: int = 2):
+    """Telemetry fallback cost of one flash-attention call
+    (veles_tpu/telemetry/cost.py): the Pallas custom call is opaque to
+    XLA's HLO cost model, so the kernel's owner publishes the standard
+    analytic model instead. FLOPs: 2·T·T_ctx·D per head for QK^T plus
+    the same for PV (T_ctx = T/2 causal, min(T, W) windowed); training
+    adds the blockwise backward at the standard 2.5× forward
+    (recompute + 4 gradient matmuls). Bytes: the HBM traffic floor —
+    q/k/v read + o written (+lse), ×3 round trips under training."""
+    from ..telemetry.cost import Cost
+    t_ctx = float(t)
+    if window:
+        t_ctx = min(t_ctx, float(window))
+    elif causal:
+        t_ctx = t / 2.0
+    fwd = 4.0 * b * h * t * t_ctx * d
+    flops = fwd * 3.5 if train else fwd
+    io = b * h * t * d * dtype_bytes
+    lse = b * h * t * 4
+    bytes_accessed = (4 * io + lse) * (3 if train else 1)
+    # VMEM working set: ~5 f32 (block, D_padded) tiles per grid step
+    d_pad = ((d + LANE - 1) // LANE) * LANE
+    peak = 5.0 * 128 * d_pad * 4
+    return Cost(flops, bytes_accessed, peak, source="analytic")
 
 
 def _prepare(q, k, v, scale, block_q, block_k, interpret, caller,
@@ -655,8 +693,17 @@ def flash_attention(q, k, v, causal: bool = False,
     q3, k3, v3, scale, interpret, b, t, h, kv, d, block_q, block_k = \
         _prepare(q, k, v, scale, block_q, block_k, interpret,
                  "flash_attention", causal=causal, window=window)
-
+    # trace-time events (run once per trace, not per execution): the
+    # counter records that a program containing this kernel was
+    # (re)built — recompile churn shows up here first — and the
+    # analytic forward cost lands in any active kernel-cost collector
+    # (AcceleratedUnit.program_cost: the custom call is opaque to
+    # XLA's cost model, so the kernel reports itself)
+    from ..telemetry.counters import inc
+    from ..telemetry.cost import note_kernel_cost
+    inc("veles_flash_attention_traces_total")
+    note_kernel_cost(analytic_cost(b, t, h, d, causal, window))
     o = _flash(q3, k3, v3, causal, scale,
-               block_q, block_k, interpret, window, h, kv)
+               block_q, block_k, interpret, window, h, kv, d)
     o = o[..., :d].reshape(b, h, t, d)
     return jnp.moveaxis(o, 1, 2)

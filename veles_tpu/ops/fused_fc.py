@@ -185,6 +185,33 @@ def _kernel(refs, *, n_layers: int, mb: int, nout: int, steps: int,
         acc_ref[:] = acc_s[:]
 
 
+def analytic_cost(layer_shapes: Sequence, mb: int, steps: int):
+    """Telemetry fallback cost of ONE fused epoch
+    (veles_tpu/telemetry/cost.py): the Pallas custom call is opaque to
+    XLA's HLO cost model, so the kernel's owner publishes the analytic
+    model. ``layer_shapes``: (n_in, n_out) per dense layer. FLOPs per
+    SGD step: forward 2·mb·Σ(in·out), backward 2× forward (dW and dx
+    matmuls), plus the delta-recurrence update (~4 per parameter).
+    Bytes: the minibatch stream is the only per-step HBM traffic (the
+    residency-preserving point of the kernel); weights+momentum cross
+    HBM exactly twice per epoch (load, store)."""
+    from ..telemetry.cost import Cost
+    mm = sum(int(i) * int(o) for i, o in layer_shapes)
+    params = mm + sum(int(o) for _, o in layer_shapes)
+    flops = steps * (3 * 2 * mb * mm + 4 * params)
+    d0 = int(layer_shapes[0][0])
+    stream = steps * mb * (d0 + 1) * 4            # f32 batch + labels
+    bytes_accessed = stream + 2 * 2 * params * 4  # w+momentum, in+out
+
+    def padded(n, m=LANE):
+        return ((n + m - 1) // m) * m
+    state = sum(2 * 4 * (padded(i) * padded(o) + SUB * padded(o))
+                for i, o in layer_shapes)
+    x_bytes = 4 * padded(mb, SUB) * padded(d0)
+    return Cost(flops, bytes_accessed, state + 3 * x_bytes,
+                source="analytic")
+
+
 def fused_fc_sgd_epoch(weights: Sequence, biases: Sequence,
                        vel_w: Sequence, vel_b: Sequence,
                        dataset, labels, plan, lr,

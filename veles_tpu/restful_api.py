@@ -21,7 +21,8 @@ from typing import Any, Dict, Optional
 
 import numpy
 
-from ._http import HTTPService, json_reply, read_json_object
+from ._http import (HTTPService, bytes_reply, json_reply,
+                    read_json_object)
 from .error import VelesError
 from .units import Unit
 
@@ -75,6 +76,17 @@ class RESTfulAPI(Unit):
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, fmt, *args):  # route into our logger
                 api.debug("http: " + fmt, *args)
+
+            def do_GET(self):
+                if self.path != "/metrics":
+                    self.send_error(404)
+                    return
+                from .telemetry.counters import (METRICS_CONTENT_TYPE,
+                                                 metrics_text)
+                text = metrics_text({"veles_rest_requests_served":
+                                     api.requests_served})
+                bytes_reply(self, 200, text.encode(),
+                            METRICS_CONTENT_TYPE)
 
             def do_POST(self):
                 if self.path != api.path:
@@ -388,8 +400,27 @@ class GenerationAPI(Unit):
                 api.debug("http: " + fmt, *args)
 
             def do_GET(self):
-                # ops surface: the micro-batcher's effectiveness is
-                # observable (beacon/web-status philosophy)
+                if self.path == "/metrics":
+                    # Prometheus scrape surface (telemetry counters —
+                    # the structured successor of the /stats dict; the
+                    # decode dispatch/token counters land here from
+                    # nn/sampling.py + nn/speculative.py), plus this
+                    # unit's serving gauges
+                    from .telemetry.counters import (
+                        METRICS_CONTENT_TYPE, metrics_text)
+                    text = metrics_text({
+                        "veles_generate_requests_served":
+                            api.requests_served,
+                        "veles_generate_batches_run": api.batches_run,
+                        "veles_generate_max_batch": api.max_batch,
+                        "veles_generate_queue_depth": len(api._queue),
+                    })
+                    bytes_reply(self, 200, text.encode(),
+                                METRICS_CONTENT_TYPE)
+                    return
+                # legacy ops surface: the micro-batcher's effectiveness
+                # as one JSON dict (predates /metrics; kept for
+                # dashboards that already read it)
                 if self.path != api.path + "/stats":
                     self.send_error(404)
                     return

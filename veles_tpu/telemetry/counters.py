@@ -1,0 +1,156 @@
+"""Process-global, thread-safe performance counter registry.
+
+The deterministic backbone of the telemetry subsystem: counters count
+*events the framework itself causes* — device dispatches, XLA
+compiles, jit-cache hits, host↔device bytes, serving retries — so a
+perf gate on them is exact regardless of relay weather (wall-clock
+through the shared TPU tunnel swings 7.6× between windows,
+docs/perf.md). The HTTP services render :func:`prometheus_text` at
+``/metrics`` (web_status.py, restful_api.py).
+
+Naming follows the Prometheus convention: ``veles_<what>_total`` for
+monotonic counters, snake_case, unit suffix where applicable
+(``_bytes_total``). The registry is flat name → float; callers use the
+module-level :func:`inc` / :func:`snapshot` / :func:`delta` helpers on
+the singleton :data:`counters`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+#: canonical counter names with HELP strings (also the /metrics HELP
+#: lines). Ad-hoc names are allowed, but instrumented code sticks to
+#: these so dashboards and gates agree on spelling.
+DESCRIPTIONS = {
+    "veles_dispatches_total":
+        "Jitted device program executions (one per jitted call)",
+    "veles_compiles_total":
+        "XLA compilations observed (jit cache misses at call time)",
+    "veles_jit_cache_hits_total":
+        "Unit-level jit lookups served from the per-unit cache",
+    "veles_h2d_bytes_total":
+        "Bytes explicitly transferred host to device",
+    "veles_d2h_bytes_total":
+        "Bytes explicitly fetched device to host",
+    "veles_unit_runs_total":
+        "Unit.run invocations through the workflow scheduler",
+    "veles_decode_tokens_total":
+        "Tokens emitted by the generation stack",
+    "veles_decode_dispatches_total":
+        "Device dispatches spent producing those tokens",
+    "veles_flash_attention_traces_total":
+        "Programs (re)built containing the flash-attention kernel",
+    "veles_spans_total":
+        "Telemetry spans recorded",
+}
+
+
+def describe_counter(name: str) -> str:
+    return DESCRIPTIONS.get(name, "veles_tpu counter")
+
+
+class CounterRegistry:
+    """Flat, thread-safe name → value map of monotonic counters."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._values: Dict[str, float] = {}
+
+    def inc(self, name: str, value: float = 1) -> float:
+        """Add ``value`` (default 1) to ``name``; returns the new total."""
+        with self._lock:
+            new = self._values.get(name, 0) + value
+            self._values[name] = new
+            return new
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._values.get(name, 0)
+
+    def snapshot(self) -> Dict[str, float]:
+        """Point-in-time copy of every counter."""
+        with self._lock:
+            return dict(self._values)
+
+    def delta(self, before: Dict[str, float],
+              names: Optional[tuple] = None) -> Dict[str, float]:
+        """Per-counter growth since a :meth:`snapshot`; zero-growth
+        counters are omitted so span records stay small."""
+        now = self.snapshot()
+        keys = names if names is not None else now.keys()
+        out = {}
+        for k in keys:
+            d = now.get(k, 0) - before.get(k, 0)
+            if d:
+                out[k] = d
+        return out
+
+    def reset(self) -> None:
+        """Zero everything — tests and bench section boundaries only
+        (production counters are monotonic for the life of the
+        process, as Prometheus scraping expects)."""
+        with self._lock:
+            self._values.clear()
+
+    def prometheus_text(self) -> str:
+        """Prometheus exposition format (text/plain; version 0.0.4).
+        One snapshot renders the whole page — names and values from
+        the same instant."""
+        lines = []
+        for name, val in sorted(self.snapshot().items()):
+            lines.append("# HELP %s %s" % (name, describe_counter(name)))
+            lines.append("# TYPE %s counter" % name)
+            # integral counters print without a trailing .0 (scrapers
+            # accept both; humans diff these files)
+            lines.append("%s %s" % (
+                name, int(val) if float(val).is_integer() else val))
+        return "\n".join(lines) + "\n"
+
+
+#: THE process-global registry every instrumented call site uses.
+counters = CounterRegistry()
+
+
+#: Content-Type every /metrics endpoint replies with
+METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
+def metrics_text(gauges: Optional[dict] = None) -> str:
+    """The full /metrics page: the counter registry plus the caller's
+    service gauges — THE one renderer behind every /metrics endpoint
+    (web_status, RESTfulAPI, GenerationAPI), so format changes happen
+    in one place. ``gauges``: name → value (or (value, help) tuple)."""
+    text = counters.prometheus_text()
+    for name, val in (gauges or {}).items():
+        help_text = None
+        if isinstance(val, tuple):
+            val, help_text = val
+        text += gauge_text(name, val, help_text)
+    return text
+
+
+def gauge_text(name: str, value, help_text: Optional[str] = None) -> str:
+    """One Prometheus gauge in exposition format — the shared renderer
+    for the ad-hoc service gauges every /metrics endpoint appends after
+    :func:`prometheus_text` (web_status, RESTfulAPI, GenerationAPI)."""
+    lines = []
+    if help_text:
+        lines.append("# HELP %s %s" % (name, help_text))
+    lines.append("# TYPE %s gauge" % name)
+    val = float(value)
+    lines.append("%s %s" % (name, int(val) if val.is_integer() else val))
+    return "\n".join(lines) + "\n"
+
+
+def inc(name: str, value: float = 1) -> float:
+    return counters.inc(name, value)
+
+
+def snapshot() -> Dict[str, float]:
+    return counters.snapshot()
+
+
+def prometheus_text() -> str:
+    return counters.prometheus_text()

@@ -1,0 +1,243 @@
+"""Unit-level spans: nested timing intervals with counter deltas.
+
+A span brackets one piece of framework work — a unit's ``run``, a
+workflow's scheduler pass, one fused train-step dispatch — and records,
+besides wall time, the *deterministic* accounting for that interval:
+how many device programs were dispatched inside it, how many compiles
+happened, how many bytes crossed the host↔device boundary (deltas of
+:mod:`veles_tpu.telemetry.counters`). Nesting is tracked per thread so
+the JSONL stream reconstructs the call tree, and
+:mod:`~veles_tpu.telemetry.chrome_trace` converts it to Chrome
+``trace_event`` JSON for Perfetto.
+
+Usage::
+
+    with span("unit.run", unit="loader"):
+        ...
+    @spanned("decode")
+    def decode(...): ...
+
+The recorder keeps an in-memory ring (cheap: one deque append per
+span) and optionally streams JSONL to a file (``set_sink`` — wired to
+``--trace-file`` by the CLI). Span records are plain dicts::
+
+    {"name": ..., "ts": ..., "dur": ..., "depth": ..., "parent": ...,
+     "sid": ..., "tid": ..., "counters": {...}, ...attrs}
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import itertools
+import json
+import os
+import threading
+import time
+from typing import Any, Deque, Dict, Iterable, List, Optional
+
+from .counters import counters
+
+#: counters whose per-span deltas ride in every span record; the rest
+#: of the registry is process-global only (a span that moved no bytes
+#: carries no counter keys at all)
+SPAN_COUNTERS = ("veles_dispatches_total", "veles_compiles_total",
+                 "veles_h2d_bytes_total", "veles_d2h_bytes_total")
+
+_ids = itertools.count(1)
+
+
+def _enabled() -> bool:
+    """THE span on/off switch (``root.common.trace.spans``), honored
+    centrally by the recorder so every instrumented site — Unit.run,
+    workflow.run/initialize, the train step, the decoders — obeys one
+    knob."""
+    try:
+        from ..config import root
+        return bool(root.common.trace.get("spans", True))
+    except Exception:            # noqa: BLE001 — config not importable
+        return True              # (tests importing spans standalone)
+
+
+class _Frame:
+    __slots__ = ("name", "sid", "t0", "before", "attrs", "disabled")
+
+    def __init__(self, name, sid, t0, before, attrs, disabled=False):
+        self.name, self.sid, self.t0 = name, sid, t0
+        self.before, self.attrs = before, attrs
+        self.disabled = disabled
+
+
+class SpanRecorder:
+    """Ring of completed span records + optional JSONL file sink."""
+
+    def __init__(self, maxlen: int = 65536) -> None:
+        self._lock = threading.Lock()
+        self._ring: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=maxlen)
+        self._file = None
+        self._path: Optional[str] = None
+        self._tls = threading.local()
+
+    # -- sink ----------------------------------------------------------------
+    def set_sink(self, path: Optional[str]) -> None:
+        """Stream completed spans as JSON lines to ``path`` (append);
+        None closes the sink. The in-memory ring keeps recording either
+        way."""
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+                self._path = None
+            if path:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                # LINE buffered: each record reaches the fd whole at
+                # its newline, so another handle appending to the same
+                # file (the logger's event sink shares --trace-file)
+                # can never interleave mid-JSON-line
+                self._file = open(path, "a", buffering=1)
+                self._path = path
+
+    @property
+    def sink_path(self) -> Optional[str]:
+        return self._path
+
+    # -- span lifecycle ------------------------------------------------------
+    def _stack(self) -> List[_Frame]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def begin(self, name: str, **attrs: Any) -> _Frame:
+        if not _enabled():
+            # disabled: hand back an inert frame (attrs writes land in
+            # a discarded dict) — no stack push, no counter snapshot
+            return _Frame(name, 0, 0.0, {}, attrs, disabled=True)
+        frame = _Frame(name, next(_ids), time.time(),
+                       counters.snapshot(), attrs)
+        self._stack().append(frame)
+        return frame
+
+    def end(self, frame: _Frame) -> Dict[str, Any]:
+        if frame.disabled:
+            return {}
+        stack = self._stack()
+        # pop through to our frame: a leaked child (generator never
+        # closed, exception path) must not corrupt later nesting
+        while stack and stack[-1] is not frame:
+            stack.pop()
+        if stack:
+            stack.pop()
+        rec: Dict[str, Any] = {
+            "name": frame.name,
+            "ts": frame.t0,
+            "dur": time.time() - frame.t0,
+            "depth": len(stack),
+            "parent": stack[-1].sid if stack else None,
+            "sid": frame.sid,
+            "tid": threading.get_ident(),
+        }
+        delta = counters.delta(frame.before, SPAN_COUNTERS)
+        if delta:
+            rec["counters"] = delta
+        rec.update(frame.attrs)
+        counters.inc("veles_spans_total")
+        with self._lock:
+            self._ring.append(rec)
+            if self._file is not None:
+                self._file.write(json.dumps(rec, default=str) + "\n")
+        return rec
+
+    # -- introspection -------------------------------------------------------
+    def records(self, name: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            recs = list(self._ring)
+        if name is not None:
+            recs = [r for r in recs if r["name"] == name]
+        return recs
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def to_jsonl(self, path: str) -> int:
+        """Dump the ring as JSON lines; returns the record count."""
+        recs = self.records()
+        with open(path, "w") as f:
+            for rec in recs:
+                f.write(json.dumps(rec, default=str) + "\n")
+        return len(recs)
+
+
+#: THE process-global recorder (mirrors counters.counters).
+recorder = SpanRecorder()
+
+
+class span:
+    """``with span("name", key=val): ...`` — records one span on the
+    global recorder. Re-entrant and thread-safe; exceptions still close
+    the span (flagged ``error=True``)."""
+
+    def __init__(self, name: str, **attrs: Any) -> None:
+        self._name, self._attrs = name, attrs
+        self.record: Optional[Dict[str, Any]] = None
+
+    def __enter__(self) -> "span":
+        self._frame = recorder.begin(self._name, **self._attrs)
+        return self
+
+    def __exit__(self, exc_type, *exc: Any) -> None:
+        if exc_type is not None:
+            self._frame.attrs["error"] = True
+        self.record = recorder.end(self._frame)
+
+
+def spanned(name: Optional[str] = None, **attrs: Any):
+    """Decorator form: ``@spanned("phase")`` or bare ``@spanned()``
+    (span named after the function)."""
+    def deco(fn):
+        span_name = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*args: Any, **kwargs: Any):
+            with span(span_name, **attrs):
+                return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def read_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Load span records back from a JSONL file (skips lines that are
+    not span records, so a file shared with logger events loads too)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and "name" in rec and "ts" in rec:
+                out.append(rec)
+    return out
+
+
+def tree(records: Iterable[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Reconstruct nesting: returns root records with ``children``
+    lists attached (records are shallow-copied; input order kept)."""
+    by_sid: Dict[Any, Dict[str, Any]] = {}
+    roots: List[Dict[str, Any]] = []
+    for rec in records:
+        node = dict(rec)
+        node["children"] = []
+        by_sid[node.get("sid")] = node
+    for node in by_sid.values():
+        parent = by_sid.get(node.get("parent"))
+        if parent is not None and parent is not node:
+            parent["children"].append(node)
+        else:
+            roots.append(node)
+    return roots

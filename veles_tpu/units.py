@@ -167,7 +167,15 @@ class Unit(Logger, metaclass=UnitRegistry):
             t0 = time.time()
             if root.common.trace.run:
                 self.debug("running %s", self.name)
-            self.run()
+            from .telemetry.counters import inc
+            from .telemetry.spans import span
+            inc("veles_unit_runs_total")
+            # telemetry span: nesting + per-run dispatch/transfer
+            # counter deltas. The root.common.trace.spans switch is
+            # honored centrally by the recorder — one knob, every site
+            with span("unit.run", unit=self.name,
+                      cls=type(self).__name__):
+                self.run()
             self.timers["run"] += time.time() - t0
             self.run_count += 1
         # stable name order: keeps the scheduler deterministic across runs

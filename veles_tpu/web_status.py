@@ -190,6 +190,19 @@ class WebStatusServer(Logger):
                                    {"error": "unknown id %r" % wid})
                     else:
                         json_reply(self, 200, entry)
+                elif parts.path == "/metrics":
+                    # Prometheus scrape surface: the process-global
+                    # telemetry counters (deterministic accounting —
+                    # veles_tpu/telemetry/counters.py), plus one gauge
+                    # per tracked workflow so scrapers see liveness
+                    from .telemetry.counters import (
+                        METRICS_CONTENT_TYPE, metrics_text)
+                    text = metrics_text({
+                        "veles_status_workflows":
+                            (len(server.snapshot()),
+                             "Workflows currently reporting")})
+                    bytes_reply(self, 200, text.encode(),
+                                METRICS_CONTENT_TYPE)
                 else:
                     self.send_error(404)
 

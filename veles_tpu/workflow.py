@@ -95,7 +95,9 @@ class Workflow(Unit):
         """Initialize units in dependency order; a unit returning True is
         re-queued until the set stops shrinking
         (reference: veles/workflow.py:303-336)."""
-        with SpanTimer(self, "workflow.initialize", workflow=self.name):
+        from .telemetry.spans import span
+        with SpanTimer(self, "workflow.initialize", workflow=self.name), \
+                span("workflow.initialize", workflow=self.name):
             pending = self.units_in_dependency_order()
             while pending:
                 again: List[Unit] = []
@@ -123,6 +125,8 @@ class Workflow(Unit):
             u._reset_fired()
         t0 = time.time()
         self.event("workflow.run", "begin", workflow=self.name)
+        from .telemetry.spans import recorder
+        _span_frame = recorder.begin("workflow.run", workflow=self.name)
         queue = collections.deque([self.start_point])
         steps = 0
         try:
@@ -141,6 +145,8 @@ class Workflow(Unit):
             # run_count is incremented by Unit.process when nested; a bare
             # top-level run() tracks time only (no double counting)
             self._run_time += time.time() - t0
+            _span_frame.attrs["steps"] = steps
+            recorder.end(_span_frame)
             self.event("workflow.run", "end", workflow=self.name, steps=steps)
 
     def on_workflow_finished(self) -> None:
