@@ -673,9 +673,36 @@ def gate_docs(baseline_doc, current_doc):
     return failures
 
 
+def gate_resilience():
+    """``resilience`` gate section: the fault/retry/shed counters must
+    be REGISTERED (HELP strings exist) and show zero leakage in a clean
+    process — firing every registered injection point with no fault
+    spec armed must be a no-op. A chaos run (VELES_FAULTS set) skips
+    the zero check: counting faults is then the whole point."""
+    from veles_tpu.resilience import RESILIENCE_COUNTERS, faults
+    from veles_tpu.telemetry.counters import DESCRIPTIONS, counters
+    failures = []
+    for name in RESILIENCE_COUNTERS:
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "resilience: counter %s not registered in "
+                "telemetry DESCRIPTIONS" % name)
+    if faults.plane.active():
+        return failures
+    for point in faults.list_points():
+        faults.fire(point)
+    for name in RESILIENCE_COUNTERS:
+        value = counters.get(name)
+        if value:
+            failures.append(
+                "resilience: %s = %s in a clean run — a fault/retry/"
+                "shed path fired with no fault spec set" % (name, value))
+    return failures
+
+
 def _gate_main(argv):
     """``python bench.py gate BASELINE.json CURRENT.json`` — exit 1 on
-    any counter regression."""
+    any counter regression or resilience-counter leakage."""
     if len(argv) != 2:
         print("usage: bench.py gate BASELINE.json CURRENT.json",
               file=sys.stderr)
@@ -684,12 +711,13 @@ def _gate_main(argv):
         baseline = json.load(f)
     with open(argv[1]) as f:
         current = json.load(f)
-    failures = gate_docs(baseline, current)
+    failures = gate_docs(baseline, current) + gate_resilience()
     for failure in failures:
         print("GATE FAIL %s" % failure, file=sys.stderr)
     if failures:
         return 1
-    print("counter gate OK (%s vs %s)" % (argv[1], argv[0]))
+    print("counter gate OK (%s vs %s; resilience counters clean)"
+          % (argv[1], argv[0]))
     return 0
 
 

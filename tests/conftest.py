@@ -25,6 +25,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running e2e tests, excluded from tier-1 via "
+        "-m 'not slow'")
+
+
 def import_model(name):
     """Import models/<name>.py as a module (models/ is not a package —
     mirrors the reference's import_file machinery, veles/import_file.py).

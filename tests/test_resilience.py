@@ -1,0 +1,658 @@
+"""Resilience subsystem (veles_tpu/resilience/): deterministic fault
+injection, retry/backoff math, crash-safe checkpoint chain, health
+endpoints and 503 load shedding — plus the end-to-end chaos round-trip
+the ISSUE's acceptance criterion names (snapshot-write crash +
+corrupted file → resume equals an uninterrupted run).
+
+Budget discipline: retry math runs on a fake clock (no real sleeps);
+the only real sleeps are <= 0.05s fault delays.
+"""
+import gzip
+import json
+import os
+import pickle
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.error
+import urllib.request
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.config import root
+from veles_tpu.error import VelesError
+from veles_tpu.resilience import (checkpoint_chain, faults, health,
+                                  retry, RESILIENCE_COUNTERS)
+from veles_tpu.telemetry.counters import DESCRIPTIONS, counters
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+        self.sleeps = []
+
+    def time(self):
+        return self.t
+
+    def sleep(self, d):
+        self.sleeps.append(d)
+        self.t += d
+
+
+# ---------------------------------------------------------------------------
+# retry policy math
+# ---------------------------------------------------------------------------
+
+def _failing(n, exc=OSError):
+    """A callable that fails n times, then returns the attempt count."""
+    calls = {"n": 0}
+
+    def fn():
+        calls["n"] += 1
+        if calls["n"] <= n:
+            raise exc("boom %d" % calls["n"])
+        return calls["n"]
+    return fn
+
+
+def test_backoff_sequence_and_cap():
+    fc = FakeClock()
+    policy = retry.RetryPolicy(max_attempts=5, base_delay=0.1,
+                               max_delay=0.4, jitter=False,
+                               sleep=fc.sleep, clock=fc.time)
+    before = counters.get("veles_retries_total")
+    assert policy.call(_failing(4)) == 5
+    # exponential doubling capped at max_delay
+    assert fc.sleeps == pytest.approx([0.1, 0.2, 0.4, 0.4])
+    assert counters.get("veles_retries_total") - before == 4
+
+
+def test_exhaustion_reraises_original():
+    fc = FakeClock()
+    policy = retry.RetryPolicy(max_attempts=3, base_delay=0.1,
+                               jitter=False, sleep=fc.sleep,
+                               clock=fc.time)
+    with pytest.raises(OSError, match="boom 3"):
+        policy.call(_failing(10))
+    assert len(fc.sleeps) == 2          # retries = attempts - 1
+
+
+def test_jitter_bounds():
+    fc = FakeClock()
+    rolls = iter([0.0, 0.5, 0.999] * 10)
+    policy = retry.RetryPolicy(max_attempts=4, base_delay=0.2,
+                               max_delay=10.0, jitter=True,
+                               sleep=fc.sleep, clock=fc.time,
+                               rng=lambda: next(rolls))
+    # full jitter: delay = raw * u, u ∈ [0, 1)
+    assert policy.backoff(1) == pytest.approx(0.2 * 0.0)
+    assert policy.backoff(2) == pytest.approx(0.4 * 0.5)
+    assert policy.backoff(3) == pytest.approx(0.8 * 0.999)
+    for attempt in range(1, 5):
+        raw = min(10.0, 0.2 * 2 ** (attempt - 1))
+        d = policy.backoff(attempt)
+        assert 0.0 <= d < raw + 1e-12
+
+
+def test_deadline_cutoff_with_fake_clock():
+    fc = FakeClock()
+    policy = retry.RetryPolicy(max_attempts=50, base_delay=0.4,
+                               max_delay=0.4, deadline=1.0,
+                               jitter=False, sleep=fc.sleep,
+                               clock=fc.time)
+    with pytest.raises(OSError):
+        policy.call(_failing(100))
+    # 0.4 + 0.4 slept; a third retry would land at 1.2 > deadline 1.0,
+    # so the policy re-raises instead of sleeping past the budget
+    assert fc.sleeps == pytest.approx([0.4, 0.4])
+    assert fc.t <= 1.0
+
+
+def test_non_retryable_raises_immediately():
+    fc = FakeClock()
+    policy = retry.RetryPolicy(max_attempts=5, retryable=(OSError,),
+                               jitter=False, sleep=fc.sleep,
+                               clock=fc.time)
+    with pytest.raises(ValueError):
+        policy.call(_failing(3, exc=ValueError))
+    assert fc.sleeps == []
+
+
+def test_retry_if_predicate():
+    fc = FakeClock()
+    policy = retry.RetryPolicy(
+        max_attempts=5, base_delay=0.1, jitter=False, sleep=fc.sleep,
+        clock=fc.time,
+        retry_if=lambda e: "retryable" in str(e))
+    with pytest.raises(OSError, match="fatal"):
+        policy.call(_failing(3, exc=lambda m: OSError("fatal")))
+    assert fc.sleeps == []
+
+
+def test_decorator_and_attempts_context_manager():
+    fc = FakeClock()
+    policy = retry.RetryPolicy(max_attempts=4, base_delay=0.1,
+                               jitter=False, sleep=fc.sleep,
+                               clock=fc.time)
+
+    fn = policy(_failing(2))
+    assert fn() == 3
+
+    # context-manager loop form
+    state = {"n": 0}
+    for attempt in policy.attempts():
+        with attempt:
+            state["n"] += 1
+            if state["n"] <= 2:
+                raise OSError("cm boom")
+    assert state["n"] == 3
+
+
+def test_attempts_exhaustion_propagates():
+    fc = FakeClock()
+    policy = retry.RetryPolicy(max_attempts=2, base_delay=0.1,
+                               jitter=False, sleep=fc.sleep,
+                               clock=fc.time)
+    with pytest.raises(OSError):
+        for attempt in policy.attempts():
+            with attempt:
+                raise OSError("always")
+
+
+# ---------------------------------------------------------------------------
+# fault spec parsing + the injection plane
+# ---------------------------------------------------------------------------
+
+def test_parse_spec_fields():
+    parsed = faults.parse_spec(
+        "snapshot.write:crash:after=1,times=2;download:raise:p=0.5;"
+        "dispatch:delay:delay=0.01")
+    assert [f.point for f in parsed] == ["snapshot.write", "download",
+                                         "dispatch"]
+    crash, rais, delay = parsed
+    assert (crash.action, crash.after, crash.times) == ("crash", 1, 2)
+    assert (rais.action, rais.p) == ("raise", 0.5)
+    assert (delay.action, delay.delay) == ("delay", 0.01)
+    assert faults.parse_spec("") == []
+    assert faults.parse_spec("  ;  ") == []
+
+
+@pytest.mark.parametrize("bad", [
+    "nonsense",                       # no action
+    "no.such.point:raise",            # unregistered point
+    "dispatch:explode",               # unknown action
+    "dispatch:raise:frequency=2",     # unknown param
+    "dispatch:raise:p=lots",          # unparseable value
+    "dispatch:raise:p=1.5",           # probability out of range
+])
+def test_parse_spec_rejects(bad):
+    with pytest.raises(VelesError):
+        faults.parse_spec(bad)
+
+
+def test_fire_env_spec_counts_and_exhausts(monkeypatch):
+    monkeypatch.setenv("VELES_FAULTS", "loader.batch:raise:times=1")
+    before = counters.get("veles_faults_injected_total")
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("loader.batch")
+    # times=1 exhausted: the second hit passes through
+    assert faults.fire("loader.batch") is None
+    assert counters.get("veles_faults_injected_total") - before == 1
+
+
+def test_fire_after_skips_first_hits(monkeypatch):
+    monkeypatch.setenv("VELES_FAULTS", "download:raise:after=2")
+    assert faults.fire("download") is None
+    assert faults.fire("download") is None
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("download")
+
+
+def test_fire_corrupt_returns_fault(monkeypatch):
+    monkeypatch.setenv("VELES_FAULTS", "snapshot.write:corrupt")
+    fault = faults.fire("snapshot.write")
+    assert fault is not None
+    blob = b"hello world"
+    damaged = fault.corrupt(blob)
+    assert damaged != blob and len(damaged) == len(blob)
+
+
+def test_clean_process_zero_leakage(monkeypatch):
+    """The bench gate's resilience contract: with no spec set, firing
+    every registered point is a no-op and the counters are untouched."""
+    monkeypatch.delenv("VELES_FAULTS", raising=False)
+    for name in RESILIENCE_COUNTERS:
+        assert name in DESCRIPTIONS
+    before = counters.get("veles_faults_injected_total")
+    for point in faults.list_points():
+        assert faults.fire(point) is None
+    assert counters.get("veles_faults_injected_total") == before
+
+
+def test_probability_is_seeded_deterministic(monkeypatch):
+    from veles_tpu import prng
+    monkeypatch.setenv("VELES_FAULTS", "dispatch:raise:p=0.5")
+    prng.seed_all(123)
+    faults.plane.configure()
+
+    def trace(n=20):
+        out = []
+        for _ in range(n):
+            try:
+                faults.fire("dispatch")
+                out.append(0)
+            except faults.FaultInjected:
+                out.append(1)
+        return out
+
+    first = trace()
+    prng.seed_all(123)
+    faults.plane.configure()
+    assert trace() == first
+    assert 0 < sum(first) < 20      # p=0.5 actually mixes
+
+
+# ---------------------------------------------------------------------------
+# checkpoint chain
+# ---------------------------------------------------------------------------
+
+def _write_snap(directory, name, state, mtime=None):
+    path = os.path.join(directory, name)
+    tmp = path + ".tmp"
+    with gzip.open(tmp, "wb") as fout:
+        fout.write(pickle.dumps(state))
+    checkpoint_chain.commit_file(tmp, path)
+    checkpoint_chain.write_manifest(path)
+    if mtime is not None:
+        os.utime(path, (mtime, mtime))
+    return path
+
+
+def _flip_byte(path, offset=None):
+    with open(path, "rb") as fin:
+        raw = bytearray(fin.read())
+    i = len(raw) // 2 if offset is None else offset
+    raw[i] ^= 0xFF
+    with open(path, "wb") as fout:
+        fout.write(raw)
+
+
+def test_chain_orders_newest_first(tmp_path):
+    t0 = time.time() - 100
+    for i in range(3):
+        _write_snap(str(tmp_path), "wf_%d.pickle.gz" % i,
+                    {"i": i}, mtime=t0 + i)
+    paths = checkpoint_chain.chain(str(tmp_path), "wf")
+    assert [os.path.basename(p) for p in paths] == [
+        "wf_2.pickle.gz", "wf_1.pickle.gz", "wf_0.pickle.gz"]
+
+
+def test_restore_walks_past_corrupt_files(tmp_path):
+    t0 = time.time() - 100
+    for i in range(3):
+        _write_snap(str(tmp_path), "wf_%d.pickle.gz" % i,
+                    {"i": i}, mtime=t0 + i)
+    newest = os.path.join(str(tmp_path), "wf_2.pickle.gz")
+    _flip_byte(newest)
+    before = counters.get("veles_snapshots_quarantined_total")
+    path, state = checkpoint_chain.load_latest(str(tmp_path), "wf")
+    assert os.path.basename(path) == "wf_1.pickle.gz"
+    assert state == {"i": 1}
+    assert os.path.exists(newest + ".corrupt")
+    assert not os.path.exists(newest)
+    assert counters.get("veles_snapshots_quarantined_total") - before == 1
+    # quarantined files never rejoin the chain
+    assert newest not in checkpoint_chain.chain(str(tmp_path), "wf")
+
+
+def test_all_corrupt_returns_none(tmp_path):
+    p = _write_snap(str(tmp_path), "wf_only.pickle.gz", {"x": 1})
+    _flip_byte(p)
+    assert checkpoint_chain.load_latest(str(tmp_path), "wf") is None
+
+
+def test_truncated_snapshot_raises_clear_veles_error(tmp_path):
+    """Satellite: load_snapshot on a truncated file raises a VelesError
+    naming the file, not a bare pickle/EOF error."""
+    path = os.path.join(str(tmp_path), "wf_t.pickle.gz")
+    with gzip.open(path, "wb") as fout:
+        fout.write(pickle.dumps({"big": list(range(10000))}))
+    with open(path, "rb") as fin:
+        raw = fin.read()
+    with open(path, "wb") as fout:
+        fout.write(raw[:len(raw) // 2])
+    with pytest.raises(VelesError, match="truncated or corrupt"):
+        vt.load_snapshot(path)
+
+
+def test_verify_states(tmp_path):
+    path = _write_snap(str(tmp_path), "wf_v.pickle.gz", {"x": 1})
+    assert checkpoint_chain.verify(path) is True
+    os.unlink(checkpoint_chain.manifest_path(path))
+    assert checkpoint_chain.verify(path) is None    # legacy: loadable
+    assert vt.load_snapshot(path) == {"x": 1}
+
+
+def test_prune_bounded_retention(tmp_path):
+    t0 = time.time() - 100
+    for i in range(5):
+        _write_snap(str(tmp_path), "wf_%d.pickle.gz" % i,
+                    {"i": i}, mtime=t0 + i)
+    removed = checkpoint_chain.prune(str(tmp_path), "wf", keep_last=2)
+    assert len(removed) == 6            # 3 snapshots + 3 manifests
+    left = checkpoint_chain.chain(str(tmp_path), "wf")
+    assert [os.path.basename(p) for p in left] == [
+        "wf_4.pickle.gz", "wf_3.pickle.gz"]
+    assert not os.path.exists(
+        checkpoint_chain.manifest_path(
+            os.path.join(str(tmp_path), "wf_0.pickle.gz")))
+
+
+def test_snapshotter_writes_manifest_atomic_link_and_prunes(tmp_path):
+    wf = vt.Workflow(None, name="w")
+    snap = vt.Snapshotter(wf, prefix="s", directory=str(tmp_path),
+                          keep_last=2)
+    paths = []
+    for i in range(3):
+        snap._runs = i + 1
+        paths.append(snap.export())
+        os.utime(paths[-1], (time.time() - 10 + i,) * 2)
+    assert checkpoint_chain.verify(paths[-1]) is True
+    link = os.path.join(str(tmp_path), "s_current.pickle.gz")
+    assert os.path.islink(link)
+    assert os.readlink(link) == os.path.basename(paths[-1])
+    # keep_last=2 pruned the oldest export (+ its manifest)
+    assert not os.path.exists(paths[0])
+    assert os.path.exists(paths[1]) and os.path.exists(paths[2])
+    # the chain restores through the snapshotter's own artifacts
+    assert checkpoint_chain.load_latest(str(tmp_path), "s") is not None
+
+
+def test_snapshotter_corrupt_injection_falls_back(tmp_path, monkeypatch):
+    wf = vt.Workflow(None, name="w")
+    snap = vt.Snapshotter(wf, prefix="c", directory=str(tmp_path))
+    snap._runs = 1
+    good = snap.export()
+    os.utime(good, (time.time() - 10,) * 2)
+    monkeypatch.setenv("VELES_FAULTS", "snapshot.write:corrupt:times=1")
+    snap._runs = 2
+    bad = snap.export()
+    monkeypatch.delenv("VELES_FAULTS")
+    assert checkpoint_chain.verify(bad) is False
+    path, _state = checkpoint_chain.load_latest(str(tmp_path), "c")
+    assert path == good
+    assert os.path.exists(bad + ".corrupt")
+
+
+# ---------------------------------------------------------------------------
+# watchdog telemetry (satellite)
+# ---------------------------------------------------------------------------
+
+def test_step_watchdog_trip_is_counted():
+    from veles_tpu.parallel.distributed import step_watchdog
+    history = [1e-4] * 8
+    before = counters.get("veles_watchdog_trips_total")
+    with step_watchdog("span_name", history=history):
+        time.sleep(0.02)                # far beyond mean+3σ of 0.1ms
+    assert counters.get("veles_watchdog_trips_total") - before == 1
+    assert len(history) == 9            # mean+3σ history still appended
+
+    # a normal step under the threshold does not trip
+    history2 = [0.05] * 8
+    before = counters.get("veles_watchdog_trips_total")
+    with step_watchdog("span_name", history=history2):
+        pass
+    assert counters.get("veles_watchdog_trips_total") == before
+
+
+# ---------------------------------------------------------------------------
+# health endpoints + load shedding
+# ---------------------------------------------------------------------------
+
+def _get(url, timeout=10):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _post(url, payload, timeout=10):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_heartbeat_registry_staleness():
+    reg = health.HeartbeatRegistry()
+    reg.beat("fast", timeout=1000.0)
+    reg.beat("slow", timeout=0.0)       # immediately stale
+    status = reg.status()
+    assert status["fast"]["healthy"] is True
+    assert status["slow"]["healthy"] is False
+    assert reg.healthy() is False
+    reg.unregister("slow")
+    assert reg.healthy() is True
+
+
+def test_workflow_run_beats_then_unregisters():
+    """The scheduler loop reports liveness while running and drops the
+    beat on completion — only a truly wedged loop ages out."""
+    wf = vt.Workflow(None, name="hb_wf")
+    wf.initialize()
+    wf.run()
+    assert "workflow.hb_wf" not in health.heartbeats.status()
+
+
+def test_web_status_health_endpoints():
+    from veles_tpu.web_status import WebStatusServer
+    server = WebStatusServer(port=0).start()
+    try:
+        base = "http://127.0.0.1:%d" % server.port
+        code, _, body = _get(base + "/healthz")
+        assert code == 200 and body["status"] == "ok"
+        code, _, body = _get(base + "/readyz")
+        assert code == 200
+        assert "components" in body
+    finally:
+        server.stop()
+
+
+def test_generation_api_queue_bound_sheds_503_retry_after():
+    wf = vt.Workflow(None, name="w")
+    api = vt.GenerationAPI(wf, port=0, max_queue=0, name="shed_g")
+    api.initialize()
+    try:
+        url = "http://127.0.0.1:%d" % api.port
+        before = counters.get("veles_shed_requests_total")
+        code, headers, body = _post(url + "/generate",
+                                    {"prompt": [1, 2, 3], "n_new": 4})
+        assert code == 503
+        assert int(headers.get("Retry-After")) >= 1
+        assert "queue full" in body["error"]
+        assert counters.get("veles_shed_requests_total") - before == 1
+        # health endpoints ride the same port
+        code, _, body = _get(url + "/healthz")
+        assert code == 200
+        code, _, body = _get(url + "/readyz")
+        assert code == 200 and body["components"]["serve.shed_g"] is True
+    finally:
+        api.stop()
+
+
+def test_generation_api_injected_fault_sheds_never_raises(monkeypatch):
+    wf = vt.Workflow(None, name="w")
+    api = vt.GenerationAPI(wf, port=0, max_queue=0, name="fault_g")
+    api.initialize()
+    try:
+        url = "http://127.0.0.1:%d/generate" % api.port
+        monkeypatch.setenv("VELES_FAULTS", "serve.request:raise:times=1")
+        shed_before = counters.get("veles_shed_requests_total")
+        fault_before = counters.get("veles_faults_injected_total")
+        code, headers, body = _post(url, {"prompt": [1], "n_new": 1})
+        assert code == 503
+        assert headers.get("Retry-After") is not None
+        assert "injected fault" in body["error"]
+        # matching telemetry deltas: one fault fired, one request shed
+        assert counters.get("veles_faults_injected_total") \
+            - fault_before == 1
+        assert counters.get("veles_shed_requests_total") \
+            - shed_before == 1
+    finally:
+        api.stop()
+
+
+def test_restful_api_pending_bound_sheds(monkeypatch):
+    from veles_tpu.loader.stream import RestfulLoader
+    wf = vt.Workflow(None, name="w")
+    loader = RestfulLoader(wf, sample_shape=(4,), name="rl")
+    api = vt.RESTfulAPI(wf, loader=loader, port=0, max_pending=0,
+                        name="shed_r")
+    api.initialize()
+    try:
+        url = "http://127.0.0.1:%d/api" % api.port
+        before = counters.get("veles_shed_requests_total")
+        code, headers, body = _post(url, {"input": [1, 2, 3, 4]})
+        assert code == 503
+        assert headers.get("Retry-After") is not None
+        assert counters.get("veles_shed_requests_total") - before == 1
+    finally:
+        api.stop()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end chaos round-trip (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+CHAOS_MODEL = textwrap.dedent("""
+    import numpy
+    import veles_tpu as vt
+    from veles_tpu import nn
+    from veles_tpu.loader import FullBatchLoader
+
+    class L(FullBatchLoader):
+        hide_from_registry = True
+        def load_data(self):
+            rng = numpy.random.RandomState(3)
+            centers = rng.randn(3, 8) * 3
+            y = rng.randint(0, 3, 300).astype(numpy.int32)
+            x = (centers[y] + rng.randn(300, 8)).astype(numpy.float32)
+            self.create_originals(x, y)
+            self.class_lengths = [0, 60, 240]
+
+    def build_workflow():
+        snap = vt.Snapshotter(None, prefix="chaos")
+        return nn.StandardWorkflow(
+            name="chaos",
+            layers=[
+                {"type": "all2all_tanh", "output_sample_shape": 8},
+                {"type": "softmax", "output_sample_shape": 3},
+            ],
+            loader_unit=L(None, minibatch_size=24, name="l"),
+            loss_function="softmax",
+            decision_config=dict(max_epochs=4, fail_iterations=100),
+            snapshotter_unit=snap)
+""")
+
+
+def _run_cli(model, snapdir, *argv, env_extra=None, timeout=300):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.pop("VELES_FAULTS", None)
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, "-m", "veles_tpu", str(model),
+         "--snapshot-dir", str(snapdir), "--backend", "cpu",
+         "--random-seed", "11", "-v", *argv],
+        cwd=REPO, capture_output=True, text=True, timeout=timeout,
+        env=env)
+
+
+def _state_tree_of(snapdir, prefix="chaos"):
+    found = checkpoint_chain.load_latest(str(snapdir), prefix)
+    assert found is not None, "no valid snapshot in %s" % snapdir
+    return found[1]
+
+
+def _assert_trees_equal(a, b, path="root"):
+    assert type(a) is type(b), (path, type(a), type(b))
+    if isinstance(a, dict):
+        assert sorted(a) == sorted(b), (path, sorted(a), sorted(b))
+        for k in a:
+            _assert_trees_equal(a[k], b[k], "%s.%s" % (path, k))
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b), path
+        for i, (x, y) in enumerate(zip(a, b)):
+            _assert_trees_equal(x, y, "%s[%d]" % (path, i))
+    elif isinstance(a, numpy.ndarray):
+        numpy.testing.assert_array_equal(a, b, err_msg=path)
+    elif isinstance(a, float):
+        assert a == pytest.approx(b), path
+    else:
+        assert a == b, path
+
+
+@pytest.mark.slow
+def test_chaos_crash_corrupt_resume_equals_clean_run(tmp_path):
+    """ISSUE acceptance: a run that (1) crashes on a snapshot write and
+    (2) finds its newest surviving snapshot corrupted must resume from
+    the newest VALID snapshot and converge to the SAME state tree as an
+    uninterrupted run."""
+    model = tmp_path / "chaos_model.py"
+    model.write_text(CHAOS_MODEL)
+    chaos_dir = tmp_path / "chaos_snaps"
+    clean_dir = tmp_path / "clean_snaps"
+    chaos_dir.mkdir()
+    clean_dir.mkdir()
+
+    # 1. crash injected at the THIRD snapshot write (epochs 1-2 commit,
+    # the process dies with the fault exit code mid-epoch-3-export)
+    r = _run_cli(model, chaos_dir, env_extra={
+        "VELES_FAULTS": "snapshot.write:crash:after=2,times=1"})
+    assert r.returncode == 42, (r.returncode, r.stderr[-2000:])
+    survivors = checkpoint_chain.chain(str(chaos_dir), "chaos")
+    # two valid snapshots must exist, so corrupting the newest still
+    # leaves the chain a valid fallback
+    assert len(survivors) >= 2, r.stderr[-2000:]
+
+    # 2. bitrot the newest survivor — restore must quarantine it and
+    # fall back, not crash or silently load garbage
+    _flip_byte(survivors[0])
+
+    # 3. relaunch with no faults: auto-resume, complete the job
+    r2 = _run_cli(model, chaos_dir)
+    assert r2.returncode == 0, r2.stderr[-2000:]
+    assert "auto-resumed" in r2.stderr
+    assert os.path.exists(survivors[0] + ".corrupt")
+
+    # 4. uninterrupted reference run, same seed
+    r3 = _run_cli(model, clean_dir)
+    assert r3.returncode == 0, r3.stderr[-2000:]
+
+    resumed = _state_tree_of(chaos_dir)
+    clean = _state_tree_of(clean_dir)
+    _assert_trees_equal(resumed["__units__"], clean["__units__"])
+    _assert_trees_equal(resumed["__prng__"], clean["__prng__"])
+
+
+def test_faults_list_cli():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run([sys.executable, "-m", "veles_tpu", "faults",
+                        "list"], capture_output=True, text=True,
+                       timeout=120, cwd=REPO, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    for point in ("snapshot.write", "loader.batch", "serve.request",
+                  "dispatch", "download", "distributed.init",
+                  "snapshot.load"):
+        assert point in r.stdout

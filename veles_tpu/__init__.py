@@ -83,6 +83,9 @@ from .plotting_units import (AccumulatingPlotter, MatrixPlotter,
                              ImagePlotter, Histogram, MultiHistogram,
                              TableMaxMin, StepStats)  # noqa: F401
 from .restful_api import GenerationAPI, RESTfulAPI    # noqa: F401
+from . import resilience                              # noqa: F401
+from .resilience import (RetryPolicy, FaultInjected,
+                         SnapshotCorruptError)        # noqa: F401
 from .publishing import Publisher                     # noqa: F401
 from .interaction import Shell                        # noqa: F401
 from .json_encoders import NumpyJSONEncoder           # noqa: F401

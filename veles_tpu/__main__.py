@@ -29,6 +29,10 @@ def main(argv=None) -> int:
         # telemetry subcommand family (no model/workflow involved):
         #   veles-tpu trace export RUN.jsonl TRACE.json
         return _trace_cli(argv[1:])
+    if argv and argv[0] == "faults":
+        # resilience subcommand family:
+        #   veles-tpu faults list
+        return _faults_cli(argv[1:])
     parser = make_parser()
     args = parser.parse_args(argv)
     if args.serve_draft_snapshot and not args.serve_draft:
@@ -150,6 +154,28 @@ def _trace_cli(argv) -> int:
         return 1
     print("exported %d spans -> %s (open in Perfetto: "
           "https://ui.perfetto.dev)" % (n, args.out))
+    return 0
+
+
+def _faults_cli(argv) -> int:
+    """``veles-tpu faults list`` — print the registered fault-injection
+    points of the resilience plane (veles_tpu/resilience/faults.py) and
+    the spec that is currently armed, if any."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu faults",
+        description="deterministic fault-injection plane "
+                    "(docs/resilience.md)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("list", help="print registered injection points")
+    parser.parse_args(argv)
+    from .resilience import faults
+    print("registered injection points (arm via VELES_FAULTS or "
+          "root.common.resilience.faults):")
+    for name, desc in sorted(faults.list_points().items()):
+        print("  %-17s %s" % (name, desc))
+    spec = faults.plane.current_spec()
+    print("active spec: %s" % (spec or "(none)"))
     return 0
 
 

@@ -210,6 +210,20 @@ def _default_root() -> Config:
         # spans.py — in-memory ring + optional --trace-file JSONL; a
         # deque append per span, cheap enough to stay on by default)
         "trace": {"run": False, "timings": False, "spans": True},
+        # resilience subsystem (veles_tpu/resilience/, docs/resilience.md)
+        "resilience": {
+            # fault-injection spec (point:action[:k=v,...];...);
+            # the VELES_FAULTS env var overrides this key
+            "faults": "",
+            # default RetryPolicy knobs (exponential backoff + jitter)
+            "retry": {"max_attempts": 4, "base_delay": 0.5,
+                      "max_delay": 30.0},
+            "keep_last": 0,           # snapshot retention; 0 = keep all
+            "download_timeout": 60.0,  # socket timeout per HTTP attempt
+            "max_pending": 64,        # RESTfulAPI in-flight bound
+            "max_queue": 256,         # GenerationAPI queue bound
+            "heartbeat_timeout": 300.0,
+        },
         "disable": {"plotting": bool(os.environ.get("VELES_TPU_TEST"))},
         "random_seed": 1234,
     })

@@ -4,19 +4,33 @@ Equivalent of the reference's veles/downloader.py:56-131 (Downloader
 unit): link it before a loader; at initialize it ensures ``files`` exist
 under ``directory``, downloading ``url`` (http(s)/file) and unpacking
 archives (tar.*, zip) when they do not. Skips entirely when the files are
-already present (idempotent re-runs)."""
+already present (idempotent re-runs).
+
+Resilience (the reference did one bare ``urlopen`` with no timeout):
+every attempt carries an explicit socket timeout, attempts are retried
+under a :class:`~veles_tpu.resilience.retry.RetryPolicy` (exponential
+backoff + jitter), an interrupted transfer resumes its ``.part`` file
+via a Range request, a size-mismatched ``.part`` is deleted (stale
+partials never survive), and an optional ``sha256`` kwarg verifies the
+finished download before it is committed. The ``download`` fault point
+fires before each attempt (inside the retry loop, so injected faults
+exercise the retry path)."""
 
 from __future__ import annotations
 
 import os
 import shutil
 import tarfile
+import urllib.error
 import urllib.request
 import zipfile
-from typing import Sequence
+from typing import Optional, Sequence
 
 from .config import root
 from .error import VelesError
+from .resilience.checkpoint_chain import file_sha256
+from .resilience.faults import fire as fire_fault
+from .resilience.retry import RetryPolicy, TransientError
 from .units import Unit
 
 
@@ -24,12 +38,26 @@ class Downloader(Unit):
     MAPPING = "downloader"
 
     def __init__(self, workflow, url: str = "", directory: str = None,
-                 files: Sequence[str] = (), **kwargs) -> None:
+                 files: Sequence[str] = (), timeout: float = None,
+                 sha256: Optional[str] = None,
+                 retry: Optional[RetryPolicy] = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
         self.url = url
         self.directory = directory or root.common.dirs.datasets
         self.files = list(files)
+        self.timeout = float(timeout if timeout is not None
+                             else root.common.resilience.get(
+                                 "download_timeout", 60.0) or 60.0)
+        #: expected hex digest of the downloaded archive; verified
+        #: before the .part file is committed
+        self.sha256 = sha256.lower() if sha256 else None
+        # timeouts/resets/5xx retry; a 4xx is the caller's mistake and
+        # must fail immediately, not after the whole backoff budget
+        self.retry = retry or RetryPolicy(
+            name=self.name + ".download",
+            retry_if=lambda e: not (isinstance(e, urllib.error.HTTPError)
+                                    and e.code < 500))
 
     def _have_all(self) -> bool:
         return all(os.path.exists(os.path.join(self.directory, f))
@@ -50,16 +78,99 @@ class Downloader(Unit):
         local = os.path.join(self.directory, os.path.basename(self.url))
         if not os.path.exists(local):
             self.info("downloading %s → %s", self.url, local)
-            tmp = local + ".part"
-            with urllib.request.urlopen(self.url) as rin, \
-                    open(tmp, "wb") as fout:
-                shutil.copyfileobj(rin, fout)
-            os.replace(tmp, local)
+            self.retry.call(self._fetch_once, local)
+            self._commit(local)
         self._unpack(local)
         if self.files and not self._have_all():
             raise VelesError("%s: %s still missing after download"
                              % (self.name, self.files))
         return None
+
+    # -- one retried attempt --------------------------------------------------
+    @staticmethod
+    def _discard(*paths: str) -> None:
+        for p in paths:
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+    def _fetch_once(self, local: str) -> None:
+        fire_fault("download")
+        tmp = local + ".part"
+        meta = tmp + ".meta"        # resume validator (ETag/Last-Mod)
+        offset = os.path.getsize(tmp) if os.path.exists(tmp) else 0
+        validator = None
+        if offset:
+            try:
+                with open(meta) as fin:
+                    validator = fin.read().strip() or None
+            except OSError:
+                validator = None
+            if validator is None:
+                # resuming without a validator could stitch bytes from
+                # two VERSIONS of the resource into one file — restart
+                self._discard(tmp)
+                offset = 0
+        headers = {}
+        if offset:
+            headers["Range"] = "bytes=%d-" % offset
+            # If-Range: the server sends 206 only if the resource is
+            # unchanged; otherwise a fresh 200 body replaces the .part
+            headers["If-Range"] = validator
+        req = urllib.request.Request(self.url, headers=headers)
+        try:
+            rin = urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            if e.code == 416:
+                # complete-or-bogus .part (range not satisfiable):
+                # clear it so the retried attempt starts clean
+                self._discard(tmp, meta)
+                raise TransientError(
+                    "%s: HTTP 416 resuming at byte %d — stale .part "
+                    "deleted" % (self.name, offset)) from e
+            raise
+        with rin:
+            status = getattr(rin, "status", 200)
+            if offset and status != 206:
+                offset = 0          # changed/no-range: restart from 0
+            if status != 206:
+                val = (rin.headers.get("ETag")
+                       or rin.headers.get("Last-Modified"))
+                if val:
+                    with open(meta, "w") as fout:
+                        fout.write(val)
+                else:
+                    self._discard(meta)
+            expected = rin.headers.get("Content-Length")
+            expected = (int(expected) + offset
+                        if expected is not None else None)
+            with open(tmp, "ab" if offset else "wb") as fout:
+                shutil.copyfileobj(rin, fout)
+        size = os.path.getsize(tmp)
+        if expected is not None and size != expected:
+            # stale/truncated partial: delete it so the retried attempt
+            # starts clean instead of resuming garbage
+            self._discard(tmp, meta)
+            raise TransientError(
+                "%s: got %d bytes, expected %d — stale .part deleted"
+                % (self.name, size, expected))
+
+    def _commit(self, local: str) -> None:
+        """Verify (when a digest was declared) and atomically publish
+        the finished ``.part`` file."""
+        tmp = local + ".part"
+        if self.sha256:
+            digest = file_sha256(tmp)
+            if digest != self.sha256:
+                self._discard(tmp, tmp + ".meta")
+                raise VelesError(
+                    "%s: SHA-256 mismatch for %s (got %s, want %s) — "
+                    "stale .part deleted; the source changed or the "
+                    "pinned digest is wrong" % (self.name, self.url,
+                                                digest, self.sha256))
+        os.replace(tmp, local)
+        self._discard(tmp + ".meta")
 
     def _unpack(self, path: str) -> None:
         if tarfile.is_tarfile(path):

@@ -380,16 +380,27 @@ class ForgeServer(Logger):
 # ---------------------------------------------------------------------------
 
 class ForgeClient(Logger):
-    """Talks to a ForgeServer (reference: veles/forge/forge_client.py:91)."""
+    """Talks to a ForgeServer (reference: veles/forge/forge_client.py:91).
+    Every HTTP call runs under a RetryPolicy — the hub is a remote
+    service; timeouts/resets/5xx back off and retry, 4xx (the caller's
+    mistake) fail immediately."""
 
-    def __init__(self, base_url: str) -> None:
+    def __init__(self, base_url: str, retry=None) -> None:
         super().__init__()
         self.base_url = base_url.rstrip("/")
+        from .resilience.retry import RetryPolicy
+        import urllib.error
+        self.retry = retry or RetryPolicy(
+            name="forge.client", max_attempts=3, base_delay=0.5,
+            retry_if=lambda e: not (isinstance(e, urllib.error.HTTPError)
+                                    and e.code < 500))
 
     def _get_json(self, path: str) -> Any:
-        with urllib.request.urlopen(self.base_url + path,
-                                    timeout=30) as resp:
-            return json.loads(resp.read())
+        def get():
+            with urllib.request.urlopen(self.base_url + path,
+                                        timeout=30) as resp:
+                return json.loads(resp.read())
+        return self.retry.call(get)
 
     def list(self) -> List[Dict[str, Any]]:
         return self._get_json("/service?query=list")
@@ -406,9 +417,14 @@ class ForgeClient(Logger):
             url += "&version=" + urllib.parse.quote(version)
         os.makedirs(dest_dir, exist_ok=True)
         tar_path = os.path.join(dest_dir, name + ".tar.gz")
-        with urllib.request.urlopen(url, timeout=60) as resp, \
-                open(tar_path, "wb") as fout:
-            shutil.copyfileobj(resp, fout)
+
+        def download():
+            # "wb" every attempt: a retried transfer restarts clean
+            # instead of appending to a truncated body
+            with urllib.request.urlopen(url, timeout=60) as resp, \
+                    open(tar_path, "wb") as fout:
+                shutil.copyfileobj(resp, fout)
+        self.retry.call(download)
         manifest = extract_package(tar_path, dest_dir)
         os.unlink(tar_path)
         self.info("fetched %s %s → %s", manifest["name"],
@@ -424,8 +440,10 @@ class ForgeClient(Logger):
                              "email": email}).encode(),
             headers={"Content-Type": "application/json"})
         try:
-            with urllib.request.urlopen(req, timeout=30) as resp:
-                return json.loads(resp.read())["token"]
+            def post():
+                with urllib.request.urlopen(req, timeout=30) as resp:
+                    return json.loads(resp.read())["token"]
+            return self.retry.call(post)
         except urllib.error.HTTPError as e:
             raise VelesError("registration rejected (%d): %s" %
                              (e.code, e.read().decode(errors="replace")))
@@ -439,8 +457,10 @@ class ForgeClient(Logger):
             urllib.parse.quote(token), data=blob,
             headers={"Content-Type": "application/gzip"})
         try:
-            with urllib.request.urlopen(req, timeout=60) as resp:
-                return json.loads(resp.read())
+            def post():
+                with urllib.request.urlopen(req, timeout=60) as resp:
+                    return json.loads(resp.read())
+            return self.retry.call(post)
         except urllib.error.HTTPError as e:
             raise VelesError("upload rejected (%d): %s" %
                              (e.code, e.read().decode(errors="replace")))

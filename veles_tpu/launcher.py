@@ -127,12 +127,15 @@ class Launcher(Logger):
     def _arm_failure_hooks(self, workflow) -> None:
         """Production wiring of the failure story (SURVEY.md §5.3): every
         TrainStep dispatch runs under the hang watchdog (the reference's
-        job-timeout dropper, veles/server.py:619-635, as a local monitor)
-        and, when --slave-death-probability is set, rolls the
-        fault-injection die after each dispatch (veles/client.py:303-307)."""
+        job-timeout dropper, veles/server.py:619-635, as a local monitor),
+        passes the ``dispatch`` fault-injection point, beats the health
+        registry, and — when --slave-death-probability is set — rolls the
+        legacy fault-injection die (veles/client.py:303-307)."""
         step = getattr(workflow, "train_step", None)
         if step is None or getattr(step, "_failure_hooks_armed", False):
             return
+        from .resilience.faults import fire as fire_fault
+        from .resilience.health import heartbeats
         death_p = float(
             root.common.get("slave_death_probability", 0.0) or 0.0)
         timeout = float(root.common.get("job_timeout", 0.0) or 0.0)
@@ -140,9 +143,11 @@ class Launcher(Logger):
         inner_run = step.run
 
         def armed_run():
+            fire_fault("dispatch")
             with distributed.step_watchdog(
                     step.name, timeout=timeout, history=self.step_history):
                 inner_run()
+            heartbeats.beat("train_step")
             if death_p > 0:
                 distributed.fault_injection(death_p)
         step.run = armed_run
@@ -203,7 +208,9 @@ class Launcher(Logger):
         self.info("resumed from %s", snapshot_path)
 
     def run(self) -> Dict[str, Any]:
+        from .resilience.health import heartbeats
         self._start_time = time.time()
+        heartbeats.beat("launcher")
         self.event("launcher.work", "begin")
         profiling = False
         if self._profile_dir:
@@ -242,6 +249,11 @@ class Launcher(Logger):
             if self.status_reporter is not None:
                 self.status_reporter.send(self._status_payload())
                 self.status_reporter.stop()
+            # the run is over (completed OR raised) — these beats are
+            # not hangs; leaving them registered would age into a false
+            # /healthz failure on any long-lived process
+            heartbeats.unregister("launcher")
+            heartbeats.unregister("train_step")
         elapsed = time.time() - self._start_time
         self.info("elapsed: %.1fs", elapsed)
         results = self.workflow.gather_results()

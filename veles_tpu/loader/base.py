@@ -246,6 +246,8 @@ class Loader(Unit):
 
     # -- the serving loop ----------------------------------------------------
     def run(self) -> None:
+        from ..resilience.faults import fire as fire_fault
+        fire_fault("loader.batch")
         if self.block_epochs > 1:
             self.serve_epoch_block()
         elif self.plan_steps > 1:

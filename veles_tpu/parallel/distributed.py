@@ -12,13 +12,15 @@ Capability mapping (SURVEY.md §5.3):
   checkpoint restart (restore_latest) — the reference itself called
   snapshots the disaster-recovery story
 - hang detection (mean+3σ timeout)→ step_watchdog context manager
+  (trips counted in veles_watchdog_trips_total)
 - --slave-death-probability       → fault_injection() preserved as a
-  testing flag that kills the process with the same semantics
+  testing flag that kills the process with the same semantics, now
+  routed through the resilience fault plane (veles_tpu/resilience/
+  faults.py, which generalizes it to named injection points)
 """
 
 from __future__ import annotations
 
-import glob
 import os
 import time
 from contextlib import contextmanager
@@ -36,7 +38,9 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
                          process_id: Optional[int] = None) -> None:
     """Join the multi-host job. No-op on single host. Arguments default to
     the standard env vars the TPU runtime provides; explicit values mirror
-    the reference's -m/--master-address & node-index flags."""
+    the reference's -m/--master-address & node-index flags. The
+    coordinator join is retried with backoff — process 0's GRPC server
+    races the other processes' dial on every real pod launch."""
     global _initialized
     if _initialized:
         return
@@ -52,10 +56,20 @@ def initialize_multihost(coordinator_address: Optional[str] = None,
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:
         pass
-    try:
+    from ..resilience.retry import RetryPolicy
+
+    def join() -> None:
+        from ..resilience.faults import fire as fire_fault
+        fire_fault("distributed.init")   # inside the retried callable:
+        # an injected raise exercises exactly the path a slow
+        # coordinator does
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes, process_id=process_id)
+
+    try:
+        RetryPolicy(name="distributed.init", base_delay=1.0,
+                    max_delay=10.0, retryable=(Exception,)).call(join)
         _initialized = True
     except Exception as e:
         raise DistributedCommunicationError(
@@ -169,35 +183,33 @@ def step_watchdog(name: str = "step", timeout: float = 0.0,
             mean, std = numpy.mean(history), numpy.std(history)
             threshold = max(mean + 3 * std, timeout)
             if dt > threshold:
+                from ..telemetry.counters import inc
+                inc("veles_watchdog_trips_total")
                 Logger().warning(
-                    "%s took %.2fs (mean %.2fs + 3σ %.2fs) — possible hang",
-                    name, dt, mean, 3 * std)
+                    "watchdog trip on span %r: %.2fs (mean %.2fs + "
+                    "3σ %.2fs) — possible hang", name, dt, mean, 3 * std)
         history.append(dt)
 
 
 def fault_injection(probability: Optional[float] = None) -> None:
     """Randomly kill this process — the reference's
     --slave-death-probability fault-injection flag
-    (veles/client.py:303-307,438-442) for testing recovery paths."""
+    (veles/client.py:303-307,438-442) for testing recovery paths.
+    Subsumed by the resilience fault plane (a ``dispatch:crash:p=...``
+    spec is the general form); kept as the CLI-flag fast path with
+    identical die-roll semantics."""
     from .. import prng
     p = probability if probability is not None else float(
         root.common.get("slave_death_probability", 0.0) or 0.0)
     if p > 0 and prng.get("fault_injection", ephemeral=True).rand() < p:
-        Logger().warning("fault injection: terminating process")
-        os._exit(42)
+        from ..resilience.faults import inject_crash
+        inject_crash("slave_death_probability=%g" % p)
 
 
 def restore_latest(workflow, directory: str, prefix: str = "wf") -> bool:
-    """Elastic recovery: resume from the newest snapshot if one exists
-    (preemption/restart path). Returns True if restored."""
-    from ..snapshotter import resume
-    pattern = os.path.join(directory, "%s*_current.pickle*" % prefix)
-    candidates = sorted(glob.glob(pattern), key=os.path.getmtime)
-    if not candidates:
-        candidates = sorted(
-            glob.glob(os.path.join(directory, "%s*.pickle*" % prefix)),
-            key=os.path.getmtime)
-    if not candidates:
-        return False
-    resume(workflow, candidates[-1])
-    return True
+    """Elastic recovery: resume from the newest VALID snapshot if one
+    exists (preemption/restart path) — the chain walk verifies
+    checksums and quarantines corrupt files on the way
+    (resilience/checkpoint_chain.py). Returns True if restored."""
+    from ..resilience.checkpoint_chain import restore_latest as walk
+    return walk(workflow, directory, prefix) is not None

@@ -1,0 +1,248 @@
+"""Deterministic fault-injection plane.
+
+The reference shipped chaos testing as a first-class flag
+(``--slave-death-probability``, veles/client.py:303-307: each slave
+rolls a die after every job and kills itself) because its recovery
+story — job re-serving, checkpoint restart — was only trusted once it
+was exercised. This build generalizes that one kill switch into a
+plane of **named injection points** that any spec can arm:
+
+    point:action[:key=value[,key=value...]][;next clause...]
+
+e.g. ``VELES_FAULTS="snapshot.write:crash:after=1,times=1;download:raise:p=0.5"``
+
+Actions:
+- ``raise``   — raise :class:`FaultInjected` at the point;
+- ``crash``   — ``os._exit(42)`` (the reference's slave-death exit code);
+- ``delay``   — sleep ``delay`` seconds (default 0.05) and continue;
+- ``corrupt`` — return the :class:`Fault` so the call site damages its
+  payload via :meth:`Fault.corrupt` (only points that write/read bytes
+  honor it; others treat it as a no-op).
+
+Params: ``p`` (fire probability, default 1 — the die is rolled on the
+PRNG-seeded ``faults`` stream, so a seeded run injects the same faults
+every time), ``after`` (skip the first N hits), ``times`` (fire at
+most N times), ``delay`` (seconds, for action=delay).
+
+The spec comes from the ``VELES_FAULTS`` env var (wins) or
+``root.common.resilience.faults``. With neither set, every
+:func:`fire` is a no-op and the fault counters stay at zero — asserted
+by ``python bench.py gate``'s resilience section. Every fired fault
+increments ``veles_faults_injected_total``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..config import root
+from ..error import VelesError
+from ..logger import Logger
+from ..telemetry.counters import inc
+
+
+class FaultInjected(VelesError):
+    """Raised by an armed injection point (action=raise)."""
+
+
+#: exit code of action=crash — the reference's fault-injection death
+#: code (veles/client.py:438-442), kept so recovery tests recognize it
+CRASH_EXIT_CODE = 42
+
+ACTIONS = ("raise", "crash", "delay", "corrupt")
+
+#: name → description of every registered injection point
+#: (``veles_tpu faults list`` prints this table)
+POINTS: Dict[str, str] = {}
+
+
+def register_point(name: str, description: str) -> None:
+    """Declare an injection point so specs can reference it (typos in a
+    spec fail at parse, not silently never fire)."""
+    POINTS[name] = description
+
+
+def list_points() -> Dict[str, str]:
+    return dict(POINTS)
+
+
+for _name, _desc in (
+    ("snapshot.write", "Snapshotter.export, before the state file is "
+                       "committed (corrupt: damage the written bytes)"),
+    ("snapshot.load", "load_snapshot, before a snapshot file is read"),
+    ("loader.batch", "Loader.run, before a minibatch is served"),
+    ("dispatch", "the launcher-armed train-step dispatch"),
+    ("download", "Downloader fetch, before each HTTP attempt"),
+    ("serve.request", "REST/generation request intake (raise is shed "
+                      "as 503 + Retry-After, never a crash)"),
+    ("distributed.init", "initialize_multihost, inside the retried "
+                         "coordinator join"),
+):
+    register_point(_name, _desc)
+
+
+class Fault:
+    """One armed clause of a fault spec."""
+
+    def __init__(self, point: str, action: str, p: float = 1.0,
+                 after: int = 0, times: Optional[int] = None,
+                 delay: float = 0.05) -> None:
+        if point not in POINTS:
+            raise VelesError(
+                "unknown fault injection point %r (registered: %s)"
+                % (point, ", ".join(sorted(POINTS))))
+        if action not in ACTIONS:
+            raise VelesError("unknown fault action %r (one of %s)"
+                             % (action, "/".join(ACTIONS)))
+        if not 0.0 <= p <= 1.0:
+            raise VelesError("fault probability p=%r outside [0, 1]" % p)
+        self.point = point
+        self.action = action
+        self.p = float(p)
+        self.after = int(after)
+        self.times = None if times is None else int(times)
+        self.delay = float(delay)
+        self.hits = 0
+        self.fired = 0
+
+    def consider(self) -> bool:
+        """Roll this clause once; True when it fires now."""
+        self.hits += 1
+        if self.hits <= self.after:
+            return False
+        if self.times is not None and self.fired >= self.times:
+            return False
+        if self.p < 1.0:
+            from .. import prng
+            if prng.get("faults", ephemeral=True).rand() >= self.p:
+                return False
+        self.fired += 1
+        return True
+
+    @staticmethod
+    def corrupt(data: bytes) -> bytes:
+        """Deterministically damage a payload: flip the middle byte —
+        enough to break any checksum/codec without changing length."""
+        if not data:
+            return b"\x00"
+        i = len(data) // 2
+        return data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+
+    def __repr__(self) -> str:
+        return ("<Fault %s:%s p=%g after=%d times=%s fired=%d/%d>"
+                % (self.point, self.action, self.p, self.after,
+                   self.times, self.fired, self.hits))
+
+
+def parse_spec(text: str) -> List[Fault]:
+    """Parse a fault spec string into armed clauses (see module doc for
+    the grammar). Empty/whitespace text parses to no faults."""
+    faults: List[Fault] = []
+    for clause in filter(None, (c.strip() for c in (text or "").split(";"))):
+        parts = clause.split(":")
+        if len(parts) < 2:
+            raise VelesError(
+                "fault clause %r is not point:action[:k=v,...]" % clause)
+        kwargs: Dict[str, float] = {}
+        if len(parts) > 2 and parts[2].strip():
+            for kv in parts[2].split(","):
+                key, sep, val = kv.partition("=")
+                key = key.strip()
+                if not sep or key not in ("p", "after", "times", "delay"):
+                    raise VelesError(
+                        "fault param %r in %r is not one of "
+                        "p/after/times/delay=value" % (kv, clause))
+                try:
+                    kwargs[key] = (float(val) if key in ("p", "delay")
+                                   else int(val))
+                except ValueError as e:
+                    raise VelesError("bad fault param %r: %s" % (kv, e))
+        faults.append(Fault(parts[0].strip(), parts[1].strip(), **kwargs))
+    return faults
+
+
+class FaultPlane(Logger):
+    """The process-global injection plane: resolves the active spec
+    (env > config), keeps per-clause counters, and runs every armed
+    clause when an instrumented call site hits :meth:`fire`."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._lock = threading.Lock()
+        self._spec_text: Optional[str] = None
+        self._faults: Dict[str, List[Fault]] = {}
+
+    def current_spec(self) -> str:
+        """The spec string that would be active right now."""
+        env = os.environ.get("VELES_FAULTS")
+        if env is not None:
+            return env
+        return str(root.common.resilience.get("faults", "") or "")
+
+    def configure(self, spec: Optional[str] = None) -> None:
+        """(Re)arm from ``spec`` (or the env/config resolution). Clause
+        counters reset — tests and chaos drivers call this directly."""
+        text = self.current_spec() if spec is None else spec
+        with self._lock:
+            self._spec_text = text
+            self._faults = {}
+            for fault in parse_spec(text):
+                self._faults.setdefault(fault.point, []).append(fault)
+
+    def _refresh(self) -> None:
+        # env/config may change between fires (tests monkeypatch
+        # VELES_FAULTS); a changed spec re-arms, an unchanged one is a
+        # string compare
+        if self.current_spec() != self._spec_text:
+            self.configure()
+
+    def active(self) -> bool:
+        self._refresh()
+        return bool(self._faults)
+
+    def fire(self, point: str, **ctx) -> Optional[Fault]:
+        """Run the injection point. Raises/exits/sleeps per the armed
+        clauses; returns the :class:`Fault` when an armed clause says
+        ``corrupt`` (the call site applies :meth:`Fault.corrupt`), else
+        None. With no spec set this is a dict miss — cheap enough for
+        per-batch call sites."""
+        self._refresh()
+        clauses = self._faults.get(point)
+        if not clauses:
+            return None
+        corrupting = None
+        for fault in clauses:
+            with self._lock:
+                fires = fault.consider()
+            if not fires:
+                continue
+            inc("veles_faults_injected_total")
+            self.warning("fault injected at %s: %s (hit %d)%s", point,
+                         fault.action, fault.hits,
+                         (" %s" % (ctx,)) if ctx else "")
+            if fault.action == "raise":
+                raise FaultInjected("injected fault at %s" % point)
+            if fault.action == "crash":
+                os._exit(CRASH_EXIT_CODE)
+            if fault.action == "delay":
+                time.sleep(fault.delay)
+            elif fault.action == "corrupt":
+                corrupting = fault
+        return corrupting
+
+
+#: THE process-global plane every instrumented call site uses
+plane = FaultPlane()
+fire = plane.fire
+
+
+def inject_crash(reason: str) -> None:
+    """The legacy ``--slave-death-probability`` kill switch routed
+    through the plane: counted like any fired fault, same exit code
+    (reference: veles/client.py:438-442)."""
+    inc("veles_faults_injected_total")
+    Logger().warning("fault injection: terminating process (%s)", reason)
+    os._exit(CRASH_EXIT_CODE)

@@ -1,0 +1,160 @@
+"""Health plane: heartbeat registry, readiness marks, load shedding.
+
+Generalizes ``parallel/distributed.py``'s ``step_watchdog`` (one
+context manager around one dispatch) into a process-wide registry that
+every long-running loop reports into — ``Workflow.run`` beats per
+scheduler step, the serving worker loops beat per wakeup, the launcher
+beats around the run. ``/healthz`` (liveness: every registered
+heartbeat younger than its timeout) and ``/readyz`` (readiness marks
+flipped by service initialize/stop) are served by ``web_status`` and
+both serving APIs via :func:`handle_health`.
+
+Load shedding: bounded serving queues reply **503 + Retry-After**
+through :func:`shed` instead of growing unboundedly — every shed is
+counted in ``veles_shed_requests_total``. The reference's tornado/
+twisted services simply queued until memory ran out; under the
+north-star's traffic that is an outage, not a queue.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from ..config import root
+from ..telemetry.counters import inc
+
+
+def _default_timeout() -> float:
+    return float(root.common.resilience.get("heartbeat_timeout", 300.0)
+                 or 300.0)
+
+
+class HeartbeatRegistry:
+    """Thread-safe name → last-beat map with per-entry timeouts."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._beats: Dict[str, Dict[str, Any]] = {}
+
+    def beat(self, name: str, timeout: Optional[float] = None) -> None:
+        now = time.time()
+        with self._lock:
+            entry = self._beats.get(name)
+            if entry is None:
+                entry = self._beats[name] = {
+                    "first": now, "beats": 0,
+                    "timeout": _default_timeout()}
+            entry["last"] = now
+            entry["beats"] += 1
+            if timeout is not None:
+                entry["timeout"] = float(timeout)
+
+    def unregister(self, name: str) -> None:
+        with self._lock:
+            self._beats.pop(name, None)
+
+    def status(self) -> Dict[str, Dict[str, Any]]:
+        now = time.time()
+        with self._lock:
+            out = {}
+            for name, entry in self._beats.items():
+                age = now - entry["last"]
+                out[name] = {
+                    "age_sec": round(age, 3),
+                    "timeout_sec": entry["timeout"],
+                    "beats": entry["beats"],
+                    "healthy": age < entry["timeout"],
+                }
+            return out
+
+    def healthy(self) -> bool:
+        return all(v["healthy"] for v in self.status().values())
+
+    def clear(self) -> None:
+        """Tests only — production registries live with the process."""
+        with self._lock:
+            self._beats.clear()
+
+
+#: THE process-global registry (one process = one liveness surface)
+heartbeats = HeartbeatRegistry()
+
+_ready_lock = threading.Lock()
+_ready: Dict[str, bool] = {}
+
+
+def mark_ready(name: str) -> None:
+    with _ready_lock:
+        _ready[name] = True
+
+
+def mark_unready(name: str) -> None:
+    with _ready_lock:
+        _ready[name] = False
+
+
+def forget(name: str) -> None:
+    """Deliberate shutdown: drop the readiness mark AND the heartbeat —
+    a service stopped on purpose must not pin /readyz at 503 or age
+    into an /healthz failure."""
+    with _ready_lock:
+        _ready.pop(name, None)
+    heartbeats.unregister(name)
+
+
+def readiness() -> Dict[str, bool]:
+    with _ready_lock:
+        return dict(_ready)
+
+
+def healthz() -> Tuple[int, Dict[str, Any]]:
+    """(status code, payload) for a liveness probe: 200 while every
+    registered heartbeat is younger than its timeout (a process with no
+    registered heartbeats is alive by definition — it answered)."""
+    status = heartbeats.status()
+    ok = all(v["healthy"] for v in status.values())
+    return (200 if ok else 503), {
+        "status": "ok" if ok else "unhealthy", "heartbeats": status}
+
+
+def readyz() -> Tuple[int, Dict[str, Any]]:
+    """(status code, payload) for a readiness probe: 200 once every
+    component that declared itself is marked ready."""
+    marks = readiness()
+    ok = all(marks.values()) if marks else True
+    return (200 if ok else 503), {
+        "status": "ok" if ok else "not ready", "components": marks}
+
+
+def handle_health(handler, path: str) -> bool:
+    """Route ``/healthz`` + ``/readyz`` on a stdlib HTTP handler; True
+    when the path was one of them (reply already sent)."""
+    if path == "/healthz":
+        code, payload = healthz()
+    elif path == "/readyz":
+        code, payload = readyz()
+    else:
+        return False
+    from .._http import json_reply
+    json_reply(handler, code, payload)
+    return True
+
+
+def shed(handler, retry_after: float = 1.0,
+         reason: str = "overloaded") -> None:
+    """Reply 503 with a ``Retry-After`` header — the load-shedding
+    answer a bounded queue gives instead of growing. Counted."""
+    inc("veles_shed_requests_total")
+    data = json.dumps({"error": reason,
+                       "retry_after": retry_after}).encode()
+    handler.send_response(503)
+    handler.send_header("Retry-After",
+                        str(max(1, int(math.ceil(retry_after)))))
+    handler.send_header("Content-Type", "application/json")
+    handler.send_header("Content-Length", str(len(data)))
+    handler.end_headers()
+    handler.wfile.write(data)

@@ -23,7 +23,10 @@ import numpy
 
 from ._http import (HTTPService, bytes_reply, json_reply,
                     read_json_object)
+from .config import root
 from .error import VelesError
+from .resilience import health
+from .resilience.faults import FaultInjected, fire as fire_fault
 from .units import Unit
 
 
@@ -51,13 +54,20 @@ class RESTfulAPI(Unit):
 
     def __init__(self, workflow, loader=None, port: int = 0,
                  path: str = "/api", request_timeout: float = 60.0,
-                 **kwargs) -> None:
+                 max_pending: int = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
         self.loader = loader
         self.port = port
         self.path = path
         self.request_timeout = request_timeout
+        #: in-flight bound: requests beyond it are SHED (503 +
+        #: Retry-After) instead of queueing without limit
+        self.max_pending = int(max_pending if max_pending is not None
+                               else root.common.resilience.get(
+                                   "max_pending", 64) or 64)
+        self._pending = 0
+        self._pending_lock = threading.Lock()
         #: forward output to answer from (link_attrs from the last forward)
         self.input = None
         self._service: Optional[HTTPService] = None
@@ -78,13 +88,16 @@ class RESTfulAPI(Unit):
                 api.debug("http: " + fmt, *args)
 
             def do_GET(self):
+                if health.handle_health(self, self.path):
+                    return
                 if self.path != "/metrics":
                     self.send_error(404)
                     return
                 from .telemetry.counters import (METRICS_CONTENT_TYPE,
                                                  metrics_text)
-                text = metrics_text({"veles_rest_requests_served":
-                                     api.requests_served})
+                text = metrics_text({
+                    "veles_rest_requests_served": api.requests_served,
+                    "veles_rest_pending": api._pending})
                 bytes_reply(self, 200, text.encode(),
                             METRICS_CONTENT_TYPE)
 
@@ -92,6 +105,28 @@ class RESTfulAPI(Unit):
                 if self.path != api.path:
                     self.send_error(404)
                     return
+                try:
+                    fire_fault("serve.request")
+                except FaultInjected as e:
+                    # an injected serving fault DEGRADES (shed +
+                    # Retry-After, counted), never crashes the handler
+                    health.shed(self, retry_after=1.0, reason=str(e))
+                    return
+                with api._pending_lock:
+                    if api._pending >= api.max_pending:
+                        health.shed(
+                            self, retry_after=1.0,
+                            reason="%d requests in flight (bound %d)"
+                            % (api._pending, api.max_pending))
+                        return
+                    api._pending += 1
+                try:
+                    self._serve()
+                finally:
+                    with api._pending_lock:
+                        api._pending -= 1
+
+            def _serve(self):
                 try:
                     body = read_json_object(self)
                     # the LOADER owns its wire format (image loaders
@@ -131,12 +166,17 @@ class RESTfulAPI(Unit):
                                     self.name + ".http")
         self.port = self._service.port
         self._service.start_serving()
+        health.mark_ready("rest.%s" % self.name)
+        health.heartbeats.beat("rest.%s" % self.name)
         self.info("%s: REST API on http://127.0.0.1:%d%s", self.name,
                   self.port, self.path)
         return None
 
     # -- graph side ---------------------------------------------------------
     def run(self) -> None:
+        # the serving loop's liveness beat: a stuck forward stops this
+        # aging and /healthz flips unhealthy
+        health.heartbeats.beat("rest.%s" % self.name)
         tickets = list(getattr(self.loader, "current_tickets", ()))
         real = [(i, t) for i, t in enumerate(tickets)
                 if isinstance(t, _Ticket)]
@@ -166,6 +206,7 @@ class RESTfulAPI(Unit):
                 ticket.event.set()
 
     def stop(self) -> None:
+        health.forget("rest.%s" % self.name)
         if self._service is not None:
             self._service.stop_serving()
             self._service = None
@@ -203,7 +244,8 @@ class GenerationAPI(Unit):
     def __init__(self, workflow, draft=None, port: int = 0,
                  path: str = "/generate", max_new: int = 512,
                  batch_window: float = 0.02,
-                 request_timeout: float = 120.0, **kwargs) -> None:
+                 request_timeout: float = 120.0,
+                 max_queue: int = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
         #: the TARGET model workflow is the unit's own workflow; an
@@ -212,6 +254,11 @@ class GenerationAPI(Unit):
         self.port = port
         self.path = path
         self.max_new = int(max_new)
+        #: queue bound: requests arriving beyond it are SHED (503 +
+        #: Retry-After) instead of growing the queue unboundedly
+        self.max_queue = int(max_queue if max_queue is not None
+                             else root.common.resilience.get(
+                                 "max_queue", 256) or 256)
         self.batch_window = float(batch_window)
         self.request_timeout = float(request_timeout)
         self._service: Optional[HTTPService] = None
@@ -362,10 +409,26 @@ class GenerationAPI(Unit):
                     ticket.event.set()
 
     def _worker_loop(self) -> None:
+        hb_name = "serve.%s" % self.name
+        try:
+            self._worker_iterations(hb_name)
+        finally:
+            # the worker's own exit drops its beat — a late beat after
+            # stop()'s forget() must not leave an entry that ages into
+            # a permanent /healthz failure
+            health.heartbeats.unregister(hb_name)
+
+    def _worker_iterations(self, hb_name: str) -> None:
         while True:
+            if not self._closing:
+                health.heartbeats.beat(hb_name)
             with self._cv:
                 while not self._queue and not self._closing:
-                    self._cv.wait()
+                    # bounded wait so the idle worker still beats the
+                    # health registry (liveness, not just progress)
+                    self._cv.wait(timeout=10.0)
+                    if not self._closing:
+                        health.heartbeats.beat(hb_name)
                 if self._closing and not self._queue:
                     return
             # coalesce: let near-simultaneous requests join the batch
@@ -400,6 +463,8 @@ class GenerationAPI(Unit):
                 api.debug("http: " + fmt, *args)
 
             def do_GET(self):
+                if health.handle_health(self, self.path):
+                    return
                 if self.path == "/metrics":
                     # Prometheus scrape surface (telemetry counters —
                     # the structured successor of the /stats dict; the
@@ -414,6 +479,7 @@ class GenerationAPI(Unit):
                         "veles_generate_batches_run": api.batches_run,
                         "veles_generate_max_batch": api.max_batch,
                         "veles_generate_queue_depth": len(api._queue),
+                        "veles_generate_queue_bound": api.max_queue,
                     })
                     bytes_reply(self, 200, text.encode(),
                                 METRICS_CONTENT_TYPE)
@@ -437,6 +503,13 @@ class GenerationAPI(Unit):
                     self.send_error(404)
                     return
                 try:
+                    fire_fault("serve.request")
+                except FaultInjected as e:
+                    # injected serving faults DEGRADE (shed + Retry-
+                    # After, counted), never escape as a traceback
+                    health.shed(self, retry_after=1.0, reason=str(e))
+                    return
+                try:
                     req = api._parse(read_json_object(self))
                 except (ValueError, KeyError) as e:
                     json_reply(self, 400, {"error":
@@ -445,8 +518,14 @@ class GenerationAPI(Unit):
                 ticket = _Ticket()
                 with api._cv:
                     if api._closing:
-                        json_reply(self, 503,
-                                   {"error": "server shutting down"})
+                        health.shed(self, retry_after=5.0,
+                                    reason="server shutting down")
+                        return
+                    if len(api._queue) >= api.max_queue:
+                        health.shed(
+                            self, retry_after=1.0,
+                            reason="generation queue full (%d/%d)"
+                            % (len(api._queue), api.max_queue))
                         return
                     api._queue.append((req, ticket))
                     api._cv.notify()
@@ -469,6 +548,7 @@ class GenerationAPI(Unit):
                                     self.name + ".http")
         self.port = self._service.port
         self._service.start_serving()
+        health.mark_ready("serve.%s" % self.name)
         self.info("%s: generation API on http://127.0.0.1:%d%s "
                   "(modes: %s%s)", self.name, self.port, self.path,
                   "/".join(self.MODES),
@@ -489,3 +569,6 @@ class GenerationAPI(Unit):
         if self._worker is not None:
             self._worker.join(timeout=5)
             self._worker = None
+        # after the worker is down — its beats must not re-register a
+        # heartbeat that would age out on a long-lived process
+        health.forget("serve.%s" % self.name)

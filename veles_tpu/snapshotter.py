@@ -164,7 +164,8 @@ class Snapshotter(Unit):
 
     def __init__(self, workflow, prefix: str = "wf", directory: str = None,
                  compression: str = "gz", interval: int = 1,
-                 time_interval: float = 0.0, **kwargs):
+                 time_interval: float = 0.0, keep_last: int = None,
+                 **kwargs):
         super().__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
         self.prefix = prefix
@@ -175,6 +176,11 @@ class Snapshotter(Unit):
         self.compression = compression
         self.interval = interval
         self.time_interval = time_interval
+        #: bounded retention: prune the chain to this many snapshots
+        #: after each export (0 = keep everything)
+        self.keep_last = int(keep_last if keep_last is not None
+                             else root.common.resilience.get(
+                                 "keep_last", 0) or 0)
         self.skip = Bool(False)
         self.suffix = ""            # e.g. current best metric, set by owner
         self.destination: Optional[str] = None
@@ -207,12 +213,20 @@ class Snapshotter(Unit):
             return True
 
     def export(self) -> str:
+        from .resilience import checkpoint_chain as chain_mod
+        from .resilience.faults import fire as fire_fault
         # EVERY rank collects — collection all-gathers cross-process
         # sharded params (fetch_global collectives must fire in
         # lockstep); only the coordinator touches the filesystem
         state = collect_state(self.workflow)
         if not self._is_writer():
             return ""
+        # injection BEFORE the commit: a crash here must leave the
+        # previous snapshot intact (the crash-safety contract the chaos
+        # test drives); a corrupt instruction damages the bytes on disk
+        # while the manifest keeps the pristine digest — simulated
+        # bitrot that verify() catches at restore
+        fault = fire_fault("snapshot.write")
         os.makedirs(self.directory, exist_ok=True)
         opener, ext = CODECS[self.compression]
         suffix = ("_" + self.suffix) if self.suffix else ""
@@ -223,21 +237,44 @@ class Snapshotter(Unit):
         tmp = path + ".tmp"
         with opener(tmp, "wb") as fout:
             pickle.dump(state, fout, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, path)
-        # "_current" symlink (reference: veles/snapshotter.py:404-409)
-        link = os.path.join(self.directory, "%s_current.pickle%s" %
-                            (self.prefix, ext))
-        try:
-            if os.path.islink(link) or os.path.exists(link):
-                os.unlink(link)
-            os.symlink(fname, link)
-        except OSError:
-            pass
+        digest = chain_mod.file_sha256(tmp)
+        if fault is not None:
+            with open(tmp, "rb") as fin:
+                raw = fin.read()
+            with open(tmp, "wb") as fout:
+                fout.write(fault.corrupt(raw))
+        # fsync'd rename: after this the snapshot is durably on disk
+        # under its final name or not at all
+        chain_mod.commit_file(tmp, path)
+        chain_mod.write_manifest(
+            path, sha256=digest, prefix=self.prefix, runs=self._runs,
+            created=time.time(), checksum=state["__meta__"]["checksum"])
+        self._update_current_link(fname, ext)
+        if self.keep_last:
+            chain_mod.prune(self.directory, self.prefix, self.keep_last)
         self.destination = path
         size = os.path.getsize(path)
         self.info("snapshot → %s (%.1f KiB)", path, size / 1024)
         self.event("snapshot", "single", path=path, bytes=size)
         return path
+
+    def _update_current_link(self, fname: str, ext: str) -> None:
+        """Atomically repoint the ``_current`` symlink (reference:
+        veles/snapshotter.py:404-409): symlink under a temp name +
+        ``os.replace`` — a crash mid-export can't leave the link
+        dangling or missing."""
+        link = os.path.join(self.directory, "%s_current.pickle%s" %
+                            (self.prefix, ext))
+        tmp_link = link + ".tmp"
+        try:
+            try:
+                os.unlink(tmp_link)
+            except OSError:
+                pass
+            os.symlink(fname, tmp_link)
+            os.replace(tmp_link, link)
+        except OSError:
+            pass
 
     def stop(self) -> None:
         """Forced snapshot on workflow stop
@@ -281,18 +318,28 @@ class SnapshotterToDB(Snapshotter):
         blob = gzip.compress(pickle.dumps(
             state, protocol=pickle.HIGHEST_PROTOCOL))
         dsn = self._resolve_dsn()
-        con = sqlite3.connect(dsn)
-        try:
-            con.execute(self.SCHEMA)
-            cur = con.execute(
-                "INSERT INTO snapshots (prefix, suffix, created, runs, "
-                "checksum, state) VALUES (?, ?, ?, ?, ?, ?)",
-                (self.prefix, self.suffix, time.time(), self._runs,
-                 state["__meta__"]["checksum"], blob))
-            con.commit()
-            rowid = cur.lastrowid
-        finally:
-            con.close()
+
+        def insert() -> int:
+            con = sqlite3.connect(dsn)
+            try:
+                con.execute(self.SCHEMA)
+                cur = con.execute(
+                    "INSERT INTO snapshots (prefix, suffix, created, "
+                    "runs, checksum, state) VALUES (?, ?, ?, ?, ?, ?)",
+                    (self.prefix, self.suffix, time.time(), self._runs,
+                     state["__meta__"]["checksum"], blob))
+                con.commit()
+                return cur.lastrowid
+            finally:
+                con.close()
+
+        # a concurrently-read store returns SQLITE_BUSY as
+        # OperationalError; losing the checkpoint to a transient lock
+        # would be the exact disaster snapshots exist to prevent
+        from .resilience.retry import RetryPolicy
+        rowid = RetryPolicy(
+            name=self.name + ".db_export", base_delay=0.1, max_delay=2.0,
+            retryable=(sqlite3.OperationalError,)).call(insert)
         self.destination = "sqlite://%s#%d" % (dsn, rowid)
         self.info("snapshot → %s (%.1f KiB)", self.destination,
                   len(blob) / 1024)
@@ -325,9 +372,34 @@ def _load_sqlite(path: str) -> Dict[str, Any]:
 def load_snapshot(path: str) -> Dict[str, Any]:
     """Read a snapshot state tree; path may be a ``_current`` symlink,
     or a ``sqlite://FILE[#ID]`` DSN (reference: --snapshot FILE|odbc://,
-    veles/__main__.py:539-589)."""
+    veles/__main__.py:539-589). When a sidecar manifest exists the
+    file's SHA-256 is verified first; mismatches and truncated/corrupt
+    files raise :class:`~veles_tpu.resilience.checkpoint_chain.
+    SnapshotCorruptError` (a VelesError), never a bare pickle/codec
+    error."""
+    from .resilience.checkpoint_chain import SnapshotCorruptError, verify
+    from .resilience.faults import fire as fire_fault
+    fire_fault("snapshot.load")
     if path.startswith("sqlite://") or path.endswith(".sqlite3"):
         return _load_sqlite(path)
+    if verify(path) is False:
+        raise SnapshotCorruptError(
+            "snapshot %s fails its manifest SHA-256 — the file is "
+            "corrupt (bitrot or a torn write); quarantine it or resume "
+            "from an older snapshot (restore_latest does both)" % path)
+    try:
+        return _read_state(path)
+    except FileNotFoundError:
+        raise
+    except (pickle.UnpicklingError, EOFError, OSError, ValueError,
+            lzma.LZMAError) as exc:
+        raise SnapshotCorruptError(
+            "snapshot %s is truncated or corrupt (%s: %s)"
+            % (path, type(exc).__name__, exc)) from exc
+
+
+def _read_state(path: str) -> Dict[str, Any]:
+    """Codec resolution (by extension, then magic-byte sniff) + load."""
     for codec, (opener, ext) in CODECS.items():
         if path.endswith(".pickle" + ext) and ext:
             with opener(path, "rb") as fin:

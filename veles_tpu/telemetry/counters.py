@@ -44,6 +44,19 @@ DESCRIPTIONS = {
         "Programs (re)built containing the flash-attention kernel",
     "veles_spans_total":
         "Telemetry spans recorded",
+    # resilience subsystem (veles_tpu/resilience/): these exist so
+    # chaos runs are countable; bench.py's gate asserts they read 0 in
+    # clean (no fault spec) runs
+    "veles_faults_injected_total":
+        "Faults fired by the deterministic injection plane",
+    "veles_retries_total":
+        "Operations retried by a RetryPolicy (backoff performed)",
+    "veles_shed_requests_total":
+        "Serving requests shed with 503 + Retry-After",
+    "veles_watchdog_trips_total":
+        "step_watchdog threshold trips (possible hangs)",
+    "veles_snapshots_quarantined_total":
+        "Corrupt snapshots renamed *.corrupt during chain restore",
 }
 
 

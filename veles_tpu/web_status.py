@@ -190,6 +190,12 @@ class WebStatusServer(Logger):
                                    {"error": "unknown id %r" % wid})
                     else:
                         json_reply(self, 200, entry)
+                elif parts.path in ("/healthz", "/readyz"):
+                    # liveness/readiness probes (resilience/health.py):
+                    # heartbeat ages and readiness marks as JSON, 503
+                    # when stale/unready
+                    from .resilience.health import handle_health
+                    handle_health(self, parts.path)
                 elif parts.path == "/metrics":
                     # Prometheus scrape surface: the process-global
                     # telemetry counters (deterministic accounting —

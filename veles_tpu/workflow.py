@@ -125,12 +125,17 @@ class Workflow(Unit):
             u._reset_fired()
         t0 = time.time()
         self.event("workflow.run", "begin", workflow=self.name)
+        from .resilience.health import heartbeats
         from .telemetry.spans import recorder
         _span_frame = recorder.begin("workflow.run", workflow=self.name)
+        _hb_name = "workflow.%s" % self.name
         queue = collections.deque([self.start_point])
         steps = 0
         try:
             while queue and not bool(self.stopped):
+                # liveness: a wedged unit (hung collective, stuck I/O)
+                # shows as this heartbeat aging out on /healthz
+                heartbeats.beat(_hb_name)
                 unit = queue.popleft()
                 for downstream in unit.process():
                     if bool(self.stopped):
@@ -142,6 +147,9 @@ class Workflow(Unit):
                     raise Bug("workflow %s exceeded max_steps=%d" %
                               (self.name, self._max_steps))
         finally:
+            # a COMPLETED (or cleanly crashed) run is not a hang: drop
+            # the beat so only a truly wedged loop ages out on /healthz
+            heartbeats.unregister(_hb_name)
             # run_count is incremented by Unit.process when nested; a bare
             # top-level run() tracks time only (no double counting)
             self._run_time += time.time() - t0
