@@ -533,7 +533,28 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # deterministic accounting for the headline window (telemetry
         # counters + CostModel): what `bench.py gate` compares
         "counters": mnist.get("counters", {}),
+        # overlap engine accounting (veles_tpu/overlap/): in the
+        # default overlap-OFF bench these MUST be zero — the gate
+        # fails if side-plane counters leaked into the serial path
+        "overlap": _overlap_section(),
         "extras": [ae, lm],
+    }
+
+
+def _overlap_section():
+    """{enabled, sideplane_tasks, prefetch_hits, stall_seconds} for
+    this bench process — absolute counter reads, since the whole bench
+    is one process and the counters start at zero."""
+    from veles_tpu.config import root as vt_root
+    from veles_tpu.telemetry.counters import counters
+    return {
+        "enabled": bool(vt_root.common.overlap.get("enabled", False)),
+        "sideplane_tasks": int(
+            counters.get("veles_sideplane_tasks_total")),
+        "prefetch_hits": int(counters.get("veles_prefetch_hits_total")),
+        "stall_seconds": round(
+            counters.get("veles_sideplane_stall_seconds_total")
+            + counters.get("veles_prefetch_stall_seconds_total"), 6),
     }
 
 
@@ -700,9 +721,98 @@ def gate_resilience():
     return failures
 
 
+def gate_overlap(baseline_doc=None, current_doc=None):
+    """``overlap`` gate section: (1) the side-plane/prefetch counters
+    must be registered; (2) an overlap-OFF bench document must carry
+    ZERO side-plane activity — async machinery leaking into the serial
+    path is a determinism bug; (3) stall_seconds may not regress
+    between two overlap-ON documents; (4) live proof that the
+    overlapped configuration stalls LESS than the serial one (the
+    whole point of the engine)."""
+    from veles_tpu.overlap import OVERLAP_COUNTERS
+    from veles_tpu.telemetry.counters import DESCRIPTIONS
+    failures = []
+    for name in OVERLAP_COUNTERS:
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "overlap: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc), ("current", current_doc)):
+        sec = (doc or {}).get("overlap")
+        if not sec or sec.get("enabled"):
+            continue
+        for key in ("sideplane_tasks", "prefetch_hits"):
+            if sec.get(key):
+                failures.append(
+                    "overlap: %s doc has %s=%s with overlap OFF — "
+                    "side-plane work leaked into the serial path"
+                    % (tag, key, sec[key]))
+    base_sec = (baseline_doc or {}).get("overlap") or {}
+    cur_sec = (current_doc or {}).get("overlap") or {}
+    if base_sec.get("enabled") and cur_sec.get("enabled"):
+        base_stall = base_sec.get("stall_seconds")
+        cur_stall = cur_sec.get("stall_seconds")
+        # 1.5x + 100ms: stall is wall-clock, leave jitter headroom —
+        # a real regression (lost overlap) is a many-x move
+        if (base_stall is not None and cur_stall is not None
+                and cur_stall > base_stall * 1.5 + 0.1):
+            failures.append(
+                "overlap: stall_seconds regressed %.3f -> %.3f"
+                % (base_stall, cur_stall))
+    return failures + _overlap_stall_proof()
+
+
+def _overlap_stall_proof():
+    """Measure the same producer/consumer pair serially and through
+    the Prefetcher; the overlapped configuration must report lower
+    stall_seconds. Consumer work (6 ms) > producer work (3 ms), so in
+    steady state the staged batch is always ready: serial stall ≈
+    N x 3 ms, overlapped ≈ one initial miss — a 10x+ margin over
+    scheduler jitter."""
+    import time as _t
+    from veles_tpu.overlap import Prefetcher
+    from veles_tpu.telemetry.counters import counters
+    n, produce_s, consume_s = 24, 0.003, 0.006
+
+    def batches():
+        for i in range(n):
+            _t.sleep(produce_s)     # the host-side gather being hidden
+            yield i
+
+    serial_stall = 0.0
+    it = batches()
+    for _ in range(n):
+        t0 = _t.time()
+        next(it)
+        serial_stall += _t.time() - t0
+        _t.sleep(consume_s)         # the device step
+    before = counters.snapshot()
+    try:
+        with Prefetcher(batches(), depth=4, name="bench.overlap") as pf:
+            for _ in range(n):
+                pf.get(timeout=30)
+                _t.sleep(consume_s)
+    except TimeoutError as e:
+        # a wedged producer is a gate FAILURE line, not a traceback
+        return ["overlap: stall proof prefetcher wedged (%s)" % e]
+    delta = counters.delta(before)
+    overlapped_stall = delta.get("veles_prefetch_stall_seconds_total",
+                                 0.0)
+    failures = []
+    if not delta.get("veles_prefetch_hits_total"):
+        failures.append("overlap: prefetcher served no hits in the "
+                        "stall proof")
+    if overlapped_stall >= serial_stall:
+        failures.append(
+            "overlap: prefetch did not reduce stall (serial %.4fs vs "
+            "overlapped %.4fs)" % (serial_stall, overlapped_stall))
+    return failures
+
+
 def _gate_main(argv):
     """``python bench.py gate BASELINE.json CURRENT.json`` — exit 1 on
-    any counter regression or resilience-counter leakage."""
+    any counter regression, resilience-counter leakage, or overlap
+    stall regression/leakage."""
     if len(argv) != 2:
         print("usage: bench.py gate BASELINE.json CURRENT.json",
               file=sys.stderr)
@@ -711,13 +821,14 @@ def _gate_main(argv):
         baseline = json.load(f)
     with open(argv[1]) as f:
         current = json.load(f)
-    failures = gate_docs(baseline, current) + gate_resilience()
+    failures = (gate_docs(baseline, current) + gate_resilience()
+                + gate_overlap(baseline, current))
     for failure in failures:
         print("GATE FAIL %s" % failure, file=sys.stderr)
     if failures:
         return 1
-    print("counter gate OK (%s vs %s; resilience counters clean)"
-          % (argv[1], argv[0]))
+    print("counter gate OK (%s vs %s; resilience counters clean, "
+          "overlap stall proof passed)" % (argv[1], argv[0]))
     return 0
 
 

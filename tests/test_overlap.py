@@ -1,0 +1,542 @@
+"""Overlap engine (veles_tpu/overlap/, docs/overlap.md).
+
+The contract under test: overlapping host I/O with device compute —
+async side-plane for side-effect units, non-blocking checkpoints,
+data-plane prefetch — changes WHEN host work happens, never WHAT is
+computed. Train results are bit-identical with overlap on vs. off;
+lane FIFO and drain barriers preserve the checkpoint chain's
+crash-safety invariants; no thread outlives its owner.
+"""
+import glob
+import os
+import threading
+import time
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn, prng
+from veles_tpu.config import root
+from veles_tpu.loader import FullBatchLoader
+from veles_tpu.overlap import (OVERLAP_COUNTERS, Prefetcher, SidePlane,
+                               SidePlaneError)
+from veles_tpu.resilience import checkpoint_chain, faults
+from veles_tpu.snapshotter import collect_state
+from veles_tpu.telemetry.counters import DESCRIPTIONS, counters
+from veles_tpu.units import Unit
+from veles_tpu.workflow import Workflow
+
+
+def fresh_prng(seed=1234):
+    with prng._lock:
+        prng._generators.clear()
+    prng.seed_all(seed)
+
+
+@pytest.fixture(autouse=True)
+def _overlap_off_after():
+    """Every test leaves the engine the way tier-1 expects it: off."""
+    yield
+    root.common.overlap.enabled = False
+    root.common.overlap.async_snapshots = False
+    root.common.overlap.prefetch_depth = 0
+
+
+def assert_trees_equal(a, b, path="root"):
+    assert type(a) is type(b), (path, type(a), type(b))
+    if isinstance(a, dict):
+        assert sorted(a) == sorted(b), (path, sorted(a), sorted(b))
+        for k in a:
+            assert_trees_equal(a[k], b[k], "%s.%s" % (path, k))
+    elif isinstance(a, (list, tuple)):
+        assert len(a) == len(b), path
+        for i, (x, y) in enumerate(zip(a, b)):
+            assert_trees_equal(x, y, "%s[%d]" % (path, i))
+    elif isinstance(a, numpy.ndarray):
+        numpy.testing.assert_array_equal(a, b, err_msg=path)
+    else:
+        assert a == b, (path, a, b)
+
+
+# ---------------------------------------------------------------------------
+# side-plane executor
+# ---------------------------------------------------------------------------
+
+def test_lane_fifo_ordering_under_concurrency():
+    """Tasks in one lane run FIFO even while several lanes execute
+    concurrently; drain is a true barrier."""
+    sp = SidePlane(name="fifo", queue_depth=8)
+    seen = {"a": [], "b": [], "c": []}
+    try:
+        for i in range(60):
+            lane = "abc"[i % 3]
+            # uneven task durations shuffle cross-lane completion order
+            # — per-lane order must survive anyway
+            def task(lane=lane, i=i):
+                if i % 7 == 0:
+                    time.sleep(0.002)
+                seen[lane].append(i)
+            sp.submit(lane, task)
+        sp.drain()
+        for lane in "abc":
+            assert seen[lane] == sorted(seen[lane]), lane
+            assert len(seen[lane]) == 20
+    finally:
+        sp.shutdown()
+    assert not any(t.name.startswith("fifo:")
+                   for t in threading.enumerate())
+
+
+def test_sideplane_backpressure_counts_stall():
+    """A full lane blocks the submitter (bounded memory) and the wait
+    is counted in the stall counter."""
+    sp = SidePlane(name="bp", queue_depth=1)
+    before = counters.get("veles_sideplane_stall_seconds_total")
+    try:
+        for _ in range(6):
+            sp.submit("slow", time.sleep, 0.01)
+        sp.drain()
+    finally:
+        sp.shutdown()
+    assert counters.get("veles_sideplane_stall_seconds_total") > before
+
+
+def test_sideplane_errors_route_to_drain_and_counters():
+    sp = SidePlane(name="err", queue_depth=4)
+    before = counters.get("veles_sideplane_errors_total")
+    try:
+        sp.submit("x", lambda: 1 / 0)
+        sp.submit("x", lambda: None)     # lane keeps running after error
+        with pytest.raises(SidePlaneError) as excinfo:
+            sp.drain()
+        assert isinstance(excinfo.value.errors[0], ZeroDivisionError)
+        assert counters.get("veles_sideplane_errors_total") == before + 1
+        # errors were popped: the next drain is clean
+        assert sp.drain() == []
+    finally:
+        sp.shutdown()
+
+
+def test_sideplane_chaos_delay_survives_drain(monkeypatch):
+    """Satellite: the sideplane.task fault point can delay lane
+    workers; drain still barriers and FIFO holds."""
+    monkeypatch.setenv("VELES_FAULTS", "sideplane.task:delay:delay=0.01")
+    faults.plane.configure()
+    sp = SidePlane(name="chaos", queue_depth=4)
+    out = []
+    try:
+        for i in range(5):
+            sp.submit("l", out.append, i)
+        sp.drain()
+        assert out == list(range(5))
+    finally:
+        sp.shutdown()
+        monkeypatch.delenv("VELES_FAULTS")
+        faults.plane.configure()
+
+
+def test_overlap_counters_registered():
+    for name in OVERLAP_COUNTERS:
+        assert name in DESCRIPTIONS, name
+    for point in ("sideplane.task", "prefetch.batch"):
+        assert point in faults.list_points(), point
+
+
+# ---------------------------------------------------------------------------
+# prefetcher
+# ---------------------------------------------------------------------------
+
+def test_prefetcher_backpressure():
+    """The producer never runs more than ``depth`` batches ahead."""
+    produced = []
+
+    def gen():
+        for i in range(50):
+            produced.append(i)
+            yield i
+
+    with Prefetcher(gen(), depth=3, name="bp") as pf:
+        time.sleep(0.1)                 # producer runs free…
+        # …but depth + the one item in flight bound its lead
+        assert len(produced) <= 3 + 1, produced
+        assert [pf.get(timeout=10) for _ in range(50)] == list(range(50))
+        with pytest.raises(StopIteration):
+            pf.get(timeout=10)
+
+
+def test_prefetcher_shutdown_without_orphan_threads():
+    """close() while the producer is BLOCKED on a full queue must still
+    join the thread."""
+    def gen():
+        i = 0
+        while True:
+            yield i
+            i += 1
+
+    pf = Prefetcher(gen(), depth=2, name="orphan")
+    assert pf.get(timeout=10) == 0
+    time.sleep(0.05)                    # producer now stuck in put()
+    pf.close()
+    assert pf.closed
+    assert not any(t.name.startswith("prefetch:orphan")
+                   for t in threading.enumerate())
+
+
+def test_prefetcher_get_timeout_raises_timeout_error():
+    """A wedged producer fails the consumer loudly (TimeoutError, not
+    a leaked queue.Empty), and the wait still lands in the stall
+    counter."""
+    def gen():
+        # close() cannot interrupt a producer blocked INSIDE its own
+        # source (only one blocked on the queue) — keep the wedge
+        # short so the daemon thread dies with the test, not 30s later
+        time.sleep(1.0)
+        yield 0
+
+    before = counters.get("veles_prefetch_stall_seconds_total")
+    pf = Prefetcher(gen(), depth=2, name="wedge")
+    with pytest.raises(TimeoutError):
+        pf.get(timeout=0.05)
+    pf.close()
+    assert counters.get("veles_prefetch_stall_seconds_total") > before
+
+
+def test_prefetcher_producer_error_surfaces_at_get():
+    def gen():
+        yield 1
+        raise RuntimeError("producer died")
+
+    with Prefetcher(gen(), depth=2, name="err") as pf:
+        assert pf.get(timeout=10) == 1
+        with pytest.raises(RuntimeError, match="producer died"):
+            pf.get(timeout=10)
+        with pytest.raises(RuntimeError):   # stays broken, never hangs
+            pf.get(timeout=10)
+
+
+def test_prefetch_fault_point_chaos(monkeypatch):
+    monkeypatch.setenv("VELES_FAULTS", "prefetch.batch:raise:after=2")
+    faults.plane.configure()
+    try:
+        with Prefetcher(iter(range(10)), depth=2, name="chaos") as pf:
+            assert pf.get(timeout=10) == 0
+            assert pf.get(timeout=10) == 1
+            with pytest.raises(faults.FaultInjected):
+                for _ in range(8):
+                    pf.get(timeout=10)
+    finally:
+        monkeypatch.delenv("VELES_FAULTS")
+        faults.plane.configure()
+
+
+# ---------------------------------------------------------------------------
+# loader prefetch: bit-identical serving
+# ---------------------------------------------------------------------------
+
+class ServingLoader(FullBatchLoader):
+    hide_from_registry = True
+
+    def load_data(self):
+        rng = numpy.random.RandomState(0)
+        self.create_originals(rng.rand(105, 4).astype(numpy.float32),
+                              rng.randint(0, 3, 105).astype(numpy.int32))
+        self.class_lengths = [0, 25, 80]
+
+
+def _serve_trace(depth, steps=18):
+    """Same name + same seed ⇒ the serial and prefetched runs consume
+    identical PRNG streams; the trace captures everything a training
+    consumer could observe."""
+    fresh_prng(7)
+    loader = ServingLoader(None, minibatch_size=20, name="serve",
+                           prefetch_depth=depth)
+    loader.initialize()
+    trace = []
+    for _ in range(steps):
+        loader.run()
+        trace.append((numpy.array(loader.minibatch_data.mem),
+                      numpy.array(loader.minibatch_labels.mem),
+                      numpy.array(loader.minibatch_indices.mem),
+                      loader.minibatch_offset, loader.minibatch_class,
+                      loader.minibatch_size, bool(loader.epoch_ended),
+                      bool(loader.train_ended)))
+    state = loader.state_dict()
+    loader.stop()
+    return trace, state
+
+
+def test_loader_prefetch_bit_identical_serving():
+    serial_trace, serial_state = _serve_trace(0)
+    over_trace, over_state = _serve_trace(3)
+    assert_trees_equal(serial_trace, over_trace)
+    assert_trees_equal(serial_state, over_state)
+    assert counters.get("veles_prefetch_hits_total") > 0
+    # THIS loader's producers are all joined (other tests' short-lived
+    # daemon threads may still be winding down — scope the assert)
+    assert not any(t.name.startswith("prefetch:serve")
+                   for t in threading.enumerate())
+
+
+def test_loader_prefetch_resume_desync_falls_back():
+    """A mid-epoch restore invalidates staged batches; serving must
+    continue correctly (inline fallback + re-arm), not serve stale
+    data."""
+    serial_trace, _ = _serve_trace(0, steps=12)
+    fresh_prng(7)
+    loader = ServingLoader(None, minibatch_size=20, name="serve",
+                           prefetch_depth=2)
+    loader.initialize()
+    for _ in range(4):
+        loader.run()
+    mid_state = loader.state_dict()
+    for _ in range(2):
+        loader.run()
+    loader.load_state_dict(mid_state)       # rewind 2 minibatches
+    loader.run()
+    numpy.testing.assert_array_equal(
+        loader.minibatch_data.mem, serial_trace[4][0])
+    loader.stop()
+
+
+# ---------------------------------------------------------------------------
+# workflow: side-effect offload + end-to-end bit-identical state tree
+# ---------------------------------------------------------------------------
+
+class SideFx(Unit):
+    hide_from_registry = True
+    side_effect_only = True
+
+    def __init__(self, workflow, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.threads = []
+
+    def run(self):
+        self.threads.append(threading.get_ident())
+
+
+def _fx_workflow():
+    wf = Workflow(None, name="fxwf")
+    fx = SideFx(wf, name="fx")
+    fx.link_from(wf.start_point)
+    wf.end_point.link_from(fx)
+    wf.initialize()
+    return wf, fx
+
+
+def test_side_effect_unit_runs_off_thread_with_overlap_on():
+    root.common.overlap.enabled = True
+    wf, fx = _fx_workflow()
+    wf.run()
+    # drained at EndPoint/run end: the task completed before run()
+    # returned, on a side-plane worker, with timers/counters kept
+    assert fx.threads and fx.threads[0] != threading.get_ident()
+    assert fx.run_count == 1
+
+
+def test_side_effect_unit_runs_inline_with_overlap_off():
+    root.common.overlap.enabled = False
+    wf, fx = _fx_workflow()
+    wf.run()
+    assert fx.threads == [threading.get_ident()]
+
+
+def test_side_effect_task_error_surfaces_from_run():
+    class Boom(SideFx):
+        hide_from_registry = True
+
+        def run(self):
+            raise RuntimeError("async boom")
+
+    root.common.overlap.enabled = True
+    wf = Workflow(None, name="boomwf")
+    fx = Boom(wf, name="boom")
+    fx.link_from(wf.start_point)
+    wf.end_point.link_from(fx)
+    wf.initialize()
+    with pytest.raises(SidePlaneError):
+        wf.run()
+
+
+class TrainLoader(FullBatchLoader):
+    hide_from_registry = True
+
+    def load_data(self):
+        rng = numpy.random.RandomState(5)
+        self.create_originals(rng.rand(240, 8).astype(numpy.float32),
+                              rng.randint(0, 3, 240).astype(numpy.int32))
+        self.class_lengths = [0, 40, 200]
+
+
+def _train(tmpdir, overlap):
+    fresh_prng()
+    root.common.overlap.enabled = overlap
+    root.common.overlap.async_snapshots = overlap
+    if overlap:
+        root.common.overlap.prefetch_depth = 2
+    snap = vt.Snapshotter(None, prefix="ov", directory=str(tmpdir),
+                          interval=1)
+    wf = nn.StandardWorkflow(
+        name="ov-wf",
+        layers=[{"type": "all2all_tanh", "output_sample_shape": 8},
+                {"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=TrainLoader(None, minibatch_size=20, name="tiny"),
+        loss_function="softmax",
+        decision_config=dict(max_epochs=3, fail_iterations=99),
+        snapshotter_unit=snap)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    state = collect_state(wf)
+    root.common.overlap.enabled = False
+    root.common.overlap.async_snapshots = False
+    root.common.overlap.prefetch_depth = 0
+    return wf, state
+
+
+def test_train_state_tree_bit_identical_overlap_on_off(tmp_path):
+    """ISSUE acceptance: async snapshotting + side-plane + prefetch
+    enabled produces a state tree bit-identical to the fully serial
+    run — parameters, optimizer state, loader position, PRNG streams,
+    decision bests, everything."""
+    serial_dir = tmp_path / "serial"
+    over_dir = tmp_path / "overlap"
+    serial_dir.mkdir()
+    over_dir.mkdir()
+    _, serial_state = _train(serial_dir, overlap=False)
+    wf, over_state = _train(over_dir, overlap=True)
+    assert wf[wf.units[0].name] is not None  # workflow intact
+    assert_trees_equal(serial_state["__units__"],
+                       over_state["__units__"])
+    assert_trees_equal(serial_state["__prng__"], over_state["__prng__"])
+    # the async chain is complete and loads to the same tree
+    found = checkpoint_chain.load_latest(str(over_dir), "ov")
+    assert found is not None
+    assert_trees_equal(found[1]["__units__"], over_state["__units__"])
+    # one snapshot per epoch + the forced one on stop, all verified
+    snaps = checkpoint_chain.chain(str(over_dir), "ov")
+    assert len(snaps) == len(checkpoint_chain.chain(str(serial_dir),
+                                                    "ov"))
+    for path in snaps:
+        assert checkpoint_chain.verify(path) is True, path
+
+
+# ---------------------------------------------------------------------------
+# non-blocking checkpoints: crash/corrupt mid-commit
+# ---------------------------------------------------------------------------
+
+def _snap_workflow(tmpdir, async_mode):
+    fresh_prng()
+    snap = vt.Snapshotter(None, prefix="nb", directory=str(tmpdir),
+                          interval=1, async_mode=async_mode)
+    wf = nn.StandardWorkflow(
+        name="nb-wf",
+        layers=[{"type": "softmax", "output_sample_shape": 3}],
+        loader_unit=TrainLoader(None, minibatch_size=40, name="nb-l"),
+        loss_function="softmax",
+        decision_config=dict(max_epochs=2, fail_iterations=99),
+        snapshotter_unit=snap)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    return wf, snap
+
+
+def _interrupted_chain(tmp_path, async_mode, monkeypatch, tag):
+    """Run 2 epochs with the SECOND snapshot commit dying mid-write;
+    returns (snapshot dir, state restored by restore_latest)."""
+    d = tmp_path / tag
+    d.mkdir()
+    wf, snap = _snap_workflow(d, async_mode)
+    monkeypatch.setenv("VELES_FAULTS",
+                       "snapshot.write:raise:after=1")
+    faults.plane.configure()
+    try:
+        if async_mode:
+            wf.run()                    # error lands at the drain…
+    except SidePlaneError:
+        pass
+    if not async_mode:
+        try:
+            wf.run()                    # …or inline at the 2nd export
+        except faults.FaultInjected:
+            pass
+    monkeypatch.delenv("VELES_FAULTS")
+    faults.plane.configure()
+    # exactly the first commit survived; no torn final file
+    chain = checkpoint_chain.chain(str(d), "nb")
+    assert len(chain) == 1, chain
+    assert checkpoint_chain.verify(chain[0]) is True
+    fresh2 = _snap_workflow(tmp_path / (tag + "_r"), False)[0]
+    restored = checkpoint_chain.restore_latest(fresh2, str(d), "nb")
+    assert restored == chain[0]
+    return d, collect_state(fresh2)
+
+
+def test_async_snapshot_crash_mid_commit_restores_like_sync(
+        tmp_path, monkeypatch):
+    """ISSUE acceptance: a crash between state collection and commit
+    must leave the previous snapshot intact, and ``restore_latest``
+    must restore EXACTLY what the sync path would have."""
+    _, sync_state = _interrupted_chain(tmp_path, False, monkeypatch,
+                                       "sync")
+    _, async_state = _interrupted_chain(tmp_path, True, monkeypatch,
+                                        "async")
+    assert_trees_equal(sync_state["__units__"],
+                       async_state["__units__"])
+
+
+def test_async_stop_commit_failure_surfaces_from_run(tmp_path,
+                                                     monkeypatch):
+    """A failed async commit — including the forced stop-time one —
+    must surface from Workflow.run like a sync export failure would,
+    not vanish into a silently-drained lane (even with the side-plane
+    off: async_mode works standalone)."""
+    d = tmp_path / "stopfail"
+    d.mkdir()
+    wf, snap = _snap_workflow(d, True)
+    monkeypatch.setenv("VELES_FAULTS", "snapshot.write:raise")
+    faults.plane.configure()
+    try:
+        with pytest.raises(SidePlaneError) as excinfo:
+            wf.run()
+        assert isinstance(excinfo.value.errors[0], faults.FaultInjected)
+    finally:
+        monkeypatch.delenv("VELES_FAULTS")
+        faults.plane.configure()
+
+
+def test_async_snapshot_corrupt_commit_quarantines(tmp_path,
+                                                   monkeypatch):
+    """Bitrot injected into an ASYNC commit is caught at restore: the
+    damaged newest snapshot is quarantined and the chain falls back."""
+    d = tmp_path / "rot"
+    d.mkdir()
+    wf, snap = _snap_workflow(d, True)
+    wf.run()
+    chain = checkpoint_chain.chain(str(d), "nb")
+    assert len(chain) >= 2
+    monkeypatch.setenv("VELES_FAULTS", "snapshot.write:corrupt:times=1")
+    faults.plane.configure()
+    snap.export()
+    snap.drain()
+    monkeypatch.delenv("VELES_FAULTS")
+    faults.plane.configure()
+    newest = checkpoint_chain.chain(str(d), "nb")[0]
+    assert checkpoint_chain.verify(newest) is False
+    fresh2 = _snap_workflow(tmp_path / "rot_r", False)[0]
+    restored = checkpoint_chain.restore_latest(fresh2, str(d), "nb")
+    assert restored is not None and restored != newest
+    assert os.path.exists(newest + ".corrupt")
+
+
+def test_async_commit_order_is_fifo(tmp_path):
+    """Checkpoint-lane ordering: N queued commits land newest-last, and
+    the _current symlink points at the final one."""
+    d = tmp_path / "order"
+    d.mkdir()
+    wf, snap = _snap_workflow(d, True)
+    wf.run()
+    snaps = sorted(glob.glob(str(d / "nb_*.pickle.gz")))
+    assert len(snaps) >= 2
+    mtimes = [os.path.getmtime(p) for p in snaps]
+    assert mtimes == sorted(mtimes)
+    cur = d / "nb_current.pickle.gz"
+    assert os.path.realpath(cur) == os.path.realpath(snaps[-1])

@@ -40,9 +40,17 @@ if _chips:
         if "xla_force_host_platform_device_count" not in t)
     import jax as _jax
 
-    _jax.config.update(
-        "jax_num_cpu_devices",
-        len([c for c in _chips.split(",") if c.strip() != ""]))
+    _n_chips = len([c for c in _chips.split(",") if c.strip() != ""])
+    try:
+        _jax.config.update("jax_num_cpu_devices", _n_chips)
+    except AttributeError:
+        # jax 0.4.x has no jax_num_cpu_devices; the XLA flag is the
+        # same knob, and jax reads XLA_FLAGS at first backend init,
+        # which cannot have happened yet at package import
+        _os.environ["XLA_FLAGS"] = (
+            _os.environ["XLA_FLAGS"]
+            + " --xla_force_host_platform_device_count=%d" % _n_chips
+        ).strip()
 
 if _os.environ.get("JAX_PLATFORMS", "").lower() in ("cpu", "cpu,"):
     # Honor a host-platform pin in EVERY process, including subprocesses
@@ -83,6 +91,8 @@ from .plotting_units import (AccumulatingPlotter, MatrixPlotter,
                              ImagePlotter, Histogram, MultiHistogram,
                              TableMaxMin, StepStats)  # noqa: F401
 from .restful_api import GenerationAPI, RESTfulAPI    # noqa: F401
+from . import overlap                                 # noqa: F401
+from .overlap import Prefetcher, SidePlane            # noqa: F401
 from . import resilience                              # noqa: F401
 from .resilience import (RetryPolicy, FaultInjected,
                          SnapshotCorruptError)        # noqa: F401

@@ -14,7 +14,8 @@ from __future__ import annotations
 import logging
 import sys
 
-from .cmdline import apply_config_overrides, make_parser, parse_mesh
+from .cmdline import (apply_config_overrides, make_parser, parse_args,
+                      parse_mesh)
 from .config import root
 from .error import VelesError
 from .import_file import import_file_as_module
@@ -34,7 +35,9 @@ def main(argv=None) -> int:
         #   veles-tpu faults list
         return _faults_cli(argv[1:])
     parser = make_parser()
-    args = parser.parse_args(argv)
+    # intermixed parsing: config overrides (positionals) may appear
+    # between/after flags — see cmdline.parse_args
+    args = parse_args(parser, argv)
     if args.serve_draft_snapshot and not args.serve_draft:
         # argv-detectable misuse fails BEFORE any (possibly minutes-
         # long) initialize/restore — and regardless of --serve-generate
@@ -79,6 +82,13 @@ def main(argv=None) -> int:
         root.common.job_timeout = args.job_timeout
     if args.snapshot_dir:
         root.common.dirs.snapshots = args.snapshot_dir
+    if args.overlap:
+        # the overlap engine (veles_tpu/overlap/): async side-plane +
+        # non-blocking checkpoints; prefetch depth rides its own flag
+        root.common.overlap.enabled = True
+        root.common.overlap.async_snapshots = True
+    if args.prefetch_depth is not None:
+        root.common.overlap.prefetch_depth = int(args.prefetch_depth)
     if args.timings:
         root.common.trace.timings = True
     if args.dump_config:

@@ -87,6 +87,20 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--slave-death-probability", type=float, default=0.0,
                    help="fault injection for recovery testing")
+    # overlap engine (veles_tpu/overlap/, docs/overlap.md)
+    p.add_argument("--overlap", action="store_true",
+                   help="overlap host I/O with device compute: "
+                        "side-effect units (plotters/publishers/image "
+                        "savers) run on an async side-plane, "
+                        "snapshots commit+fsync on a checkpoint lane, "
+                        "loaders prefetch the next batch. Results are "
+                        "bit-identical with or without it")
+    p.add_argument("--prefetch-depth", type=int, default=None,
+                   metavar="N",
+                   help="stage up to N minibatches ahead on a "
+                        "background thread (loader data plane; "
+                        "implies nothing about --overlap — the two "
+                        "compose)")
     p.add_argument("--profile-dir", default=None,
                    help="capture a jax/XPlane profiler trace of the run "
                         "into this directory (view with tensorboard or "
@@ -138,6 +152,23 @@ def make_parser() -> argparse.ArgumentParser:
                         "entry to --result-file — the unit a parallel "
                         "ensemble worker executes")
     return p
+
+
+def parse_args(parser: argparse.ArgumentParser, argv):
+    """Parse accepting SPLIT positional groups: real invocations (and
+    the child commands the trial scheduler builds) routinely interleave
+    ``root.x.y=value`` overrides with optionals —
+    ``model.py --optimize 3:1 root.lr=0.1 --backend cpu`` — which
+    plain ``parse_args`` rejects ("unrecognized arguments"): argparse
+    commits the whole positional pattern to the FIRST positional run
+    it meets. ``parse_intermixed_args`` (two-pass: optionals first,
+    then the collected positionals as one run) accepts them; the
+    fallback covers parser shapes intermixed parsing refuses (it
+    forbids some nargs forms), where the classic behavior is kept."""
+    try:
+        return parser.parse_intermixed_args(argv)
+    except TypeError:
+        return parser.parse_args(argv)
 
 
 def split_child_argv(extra):

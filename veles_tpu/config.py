@@ -224,6 +224,17 @@ def _default_root() -> Config:
             "max_queue": 256,         # GenerationAPI queue bound
             "heartbeat_timeout": 300.0,
         },
+        # overlap engine (veles_tpu/overlap/, docs/overlap.md): async
+        # side-plane for side-effect units, non-blocking checkpoints,
+        # data-plane prefetch. Off by default — identical results
+        # either way (locked by tests/test_overlap.py), enabling only
+        # changes WHEN host I/O happens
+        "overlap": {
+            "enabled": False,
+            "queue_depth": 64,        # per-lane bounded queue (backpressure)
+            "async_snapshots": False,  # Snapshotter default async_mode
+            "prefetch_depth": 0,       # Loader default prefetch depth
+        },
         "disable": {"plotting": bool(os.environ.get("VELES_TPU_TEST"))},
         "random_seed": 1234,
     })

@@ -26,6 +26,7 @@ import struct
 import subprocess
 import sys
 import tempfile
+import threading
 from typing import Any, Dict, List, Optional
 
 import numpy
@@ -102,6 +103,9 @@ class GraphicsServer(PlotSink, Logger):
         PlotSink.__init__(self)
         Logger.__init__(self)
         self._zmq_socket = None
+        # plotters may publish from concurrent side-plane lanes
+        # (overlap engine); zmq sockets are not thread-safe
+        self._pub_lock = threading.Lock()
         self._client: Optional[subprocess.Popen] = None
         self.endpoint: Optional[str] = None
         if root.common.disable.plotting:
@@ -138,9 +142,10 @@ class GraphicsServer(PlotSink, Logger):
         super().publish(snapshot)
         if self._zmq_socket is not None:
             try:
-                self._zmq_socket.send(
-                    pack_snapshot(snapshot),
-                    flags=getattr(__import__("zmq"), "NOBLOCK", 1))
+                with self._pub_lock:
+                    self._zmq_socket.send(
+                        pack_snapshot(snapshot),
+                        flags=getattr(__import__("zmq"), "NOBLOCK", 1))
             except Exception as e:      # PUB drops are fine; never stall
                 self.debug("snapshot drop: %s", e)
 

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional
 
 import numpy
 
+from ..config import root
 from ..error import NoMoreJobs
 from ..memory import Array
 from ..mutable import Bool
@@ -35,10 +36,29 @@ class Loader(Unit):
     hide_from_registry = True
 
     def __init__(self, workflow, minibatch_size=100, shuffle_limit=None,
-                 shard_dataset=False, **kwargs):
+                 shard_dataset=False, prefetch_depth=None, **kwargs):
         super().__init__(workflow, **kwargs)
         self.view_group = "LOADER"
         self.max_minibatch_size = int(minibatch_size)
+        #: data-plane prefetch (overlap engine, veles_tpu/overlap/
+        #: prefetch.py): with depth N > 0 the pure per-batch gather
+        #: (``fetch_batch``) for up to N upcoming minibatches runs on a
+        #: background thread while the current step computes. The
+        #: serving state machine — offsets, epoch flags, PRNG shuffles
+        #: — stays on the main thread, so results are bit-identical
+        #: with prefetch on or off (the producer walks a frozen copy of
+        #: this epoch's index order and never crosses an epoch
+        #: boundary). Host-fill path only; fused/plan modes already
+        #: overlap via async dispatch (their host work is index
+        #: bookkeeping, not sample gathering).
+        if prefetch_depth is None:
+            prefetch_depth = root.common.overlap.get(
+                "prefetch_depth", 0) or 0
+        self.prefetch_depth = int(prefetch_depth)
+        self._prefetcher = None
+        #: None = not probed yet; False = this loader has no pure
+        #: fetch_batch (custom fill) — prefetch silently falls back
+        self._prefetch_supported: Optional[bool] = None
         #: shard the device-resident dataset over the mesh 'data' axis
         #: instead of replicating it on every chip: HBM per chip scales
         #: 1/n with the axis (GSPMD turns the in-step gather into the
@@ -110,6 +130,23 @@ class Loader(Unit):
     def fill_minibatch(self) -> None:
         """Copy samples minibatch_indices → minibatch_data/labels."""
         raise NotImplementedError
+
+    # -- prefetch seam (overlap engine) --------------------------------------
+    def fetch_batch(self, idx, size):
+        """PURE gather of one minibatch: given an index row, return
+        {name → ndarray} for the ``minibatch_<name>`` arrays — or None
+        when this loader cannot gather outside its own state (custom
+        fill/augmentation). Must be thread-safe (runs on the prefetch
+        producer thread) and must not touch serving state or PRNG.
+        Subclasses with a pure fill implement it (FullBatchLoader)."""
+        return None
+
+    def apply_batch(self, batch) -> None:
+        """Install a :meth:`fetch_batch` result into the minibatch
+        arrays (main thread — the one place prefetch writes shared
+        state)."""
+        for name, arr in batch.items():
+            getattr(self, "minibatch_" + name).map_invalidate()[...] = arr
 
     # -- derived geometry ----------------------------------------------------
     @property
@@ -266,19 +303,33 @@ class Loader(Unit):
         self.train_ended <<= False
         self.test_ended <<= False
 
+    def _geometry_for(self, offset):
+        """(class, valid size) of the minibatch at ``offset`` — pure
+        read of the epoch geometry, shared by the serial server and
+        the prefetch producer (ONE copy of the walk rule: the two
+        paths must never disagree on what batch lives at an offset)."""
+        cls = self.class_of_offset(offset)
+        return cls, min(self.max_minibatch_size,
+                        self.class_end_offsets[cls] - offset)
+
     def _next_geometry(self):
         """(offset, class, valid_size) of the next minibatch."""
         offset = self._global_offset
-        cls = self.class_of_offset(offset)
-        size = min(self.max_minibatch_size,
-                   self.class_end_offsets[cls] - offset)
+        cls, size = self._geometry_for(offset)
         return offset, cls, size
 
-    def _fill_row(self, idx_row, mask_row, offset, size) -> None:
-        idx_row[:size] = self._shuffled_indices[offset:offset + size]
+    def _fill_row(self, idx_row, mask_row, offset, size,
+                  indices=None) -> None:
+        """Write one index row (tail-padded with the last valid index)
+        and optionally its validity mask. ``indices`` defaults to the
+        live shuffle order; the prefetch producer passes its frozen
+        per-epoch copy — same pad rule, one implementation."""
+        src = self._shuffled_indices if indices is None else indices
+        idx_row[:size] = src[offset:offset + size]
         idx_row[size:] = idx_row[size - 1] if size else 0
-        mask_row[:size] = 1.0
-        mask_row[size:] = 0.0
+        if mask_row is not None:
+            mask_row[:size] = 1.0
+            mask_row[size:] = 0.0
 
     def _advance(self, cls, size) -> None:
         """Move the global offset and update flags
@@ -305,8 +356,88 @@ class Loader(Unit):
         self._fill_row(self.minibatch_indices.map_invalidate(),
                        self.minibatch_mask.map_invalidate(), offset, size)
         if not self.fused:
-            self.fill_minibatch()
+            if self.prefetch_depth > 0:
+                self._fill_prefetched(offset)
+            else:
+                self.fill_minibatch()
         self._advance(cls, size)
+
+    # -- prefetch machinery (overlap engine, docs/overlap.md) ----------------
+    def _epoch_batches(self, start, indices, total):
+        """Generator the prefetch producer runs: walk THIS epoch's
+        remaining geometry over a frozen index copy, gathering each
+        batch with the pure :meth:`fetch_batch`. Geometry and pad rule
+        come from the same ``_geometry_for``/``_fill_row`` the serial
+        server uses (class_lengths are stable within an epoch). No
+        serving state, no PRNG — the main thread replays the identical
+        geometry, so prefetch changes when the gather happens, never
+        its content."""
+        offset = start
+        while offset < total:
+            cls, size = self._geometry_for(offset)
+            idx = numpy.empty(self.max_minibatch_size, numpy.int32)
+            self._fill_row(idx, None, offset, size, indices=indices)
+            yield {"offset": offset,
+                   "batch": self.fetch_batch(idx, size),
+                   "last": offset + size >= total}
+            offset += size
+
+    def _arm_prefetcher(self):
+        """Start a producer for the CURRENT epoch from the CURRENT
+        offset (re-armed each epoch — the producer must see the
+        post-shuffle order, and must never shuffle itself)."""
+        if self._prefetch_supported is False:
+            return None
+        from ..overlap.prefetch import Prefetcher
+        self._prefetcher = Prefetcher(
+            self._epoch_batches(
+                self._global_offset,
+                numpy.array(self._shuffled_indices),
+                self.total_samples),
+            depth=self.prefetch_depth,
+            name="%s.epoch%d" % (self.name, self.epoch_number))
+        return self._prefetcher
+
+    def _fill_prefetched(self, offset) -> None:
+        """The prefetching variant of ``fill_minibatch()``: install the
+        staged batch, or fall back inline when the loader has no pure
+        gather or the stream desynced (e.g. mid-epoch resume)."""
+        pf = self._prefetcher or self._arm_prefetcher()
+        if pf is None:
+            self.fill_minibatch()
+            return
+        try:
+            rec = pf.get()
+        except StopIteration:
+            rec = None
+        if rec is not None and rec["batch"] is None:
+            # probed unsupported: this loader customizes its fill —
+            # permanent inline fallback, said once
+            self._prefetch_supported = False
+            self._close_prefetcher()
+            self.info("%s: fetch_batch not supported — prefetch_depth="
+                      "%d falls back to inline fill", self.name,
+                      self.prefetch_depth)
+            self.fill_minibatch()
+            return
+        if rec is None or rec["offset"] != offset:
+            self._close_prefetcher()
+            self.fill_minibatch()
+            return
+        self._prefetch_supported = True
+        self.apply_batch(rec["batch"])
+        if rec["last"]:
+            # epoch exhausted: the next epoch re-arms AFTER the main
+            # thread's shuffle (in _begin_serving order)
+            self._close_prefetcher()
+
+    def _close_prefetcher(self) -> None:
+        if self._prefetcher is not None:
+            self._prefetcher.close()
+            self._prefetcher = None
+
+    def stop(self) -> None:
+        self._close_prefetcher()
 
     def serve_plan(self) -> None:
         """Serve up to plan_steps minibatches of ONE sample class as a
@@ -408,6 +539,10 @@ class Loader(Unit):
         }
 
     def load_state_dict(self, sd) -> None:
+        # a restored position invalidates anything staged ahead; the
+        # desync guard in _fill_prefetched would catch it, but closing
+        # now avoids serving a whole stale epoch into the fallback path
+        self._close_prefetcher()
         self.epoch_number = sd["epoch_number"]
         self._global_offset = sd["global_offset"]
         if "class_lengths" in sd:

@@ -104,6 +104,21 @@ class FullBatchLoader(Loader):
             labels = self.minibatch_labels.map_invalidate()
             labels[...] = self.original_labels.mem[idx]
 
+    def fetch_batch(self, idx, size):
+        """Pure mirror of :meth:`fill_minibatch` for the overlap
+        prefetcher: fancy indexing copies, so the producer thread never
+        aliases shared arrays. A subclass that customizes the fill
+        (augmentation) opts out automatically — the mirror would
+        silently skip its work."""
+        if type(self).fill_minibatch not in (
+                FullBatchLoader.fill_minibatch,
+                FullBatchLoaderMSE.fill_minibatch):
+            return None
+        out = {"data": self.original_data.mem[idx]}
+        if self.original_labels:
+            out["labels"] = self.original_labels.mem[idx]
+        return out
+
     # -- device-resident dataset for fused steps ----------------------------
     def dataset_device_views(self):
         """(data, labels) device arrays for in-step gather (the
@@ -161,3 +176,13 @@ class FullBatchLoaderMSE(FullBatchLoader, LoaderMSE):
                     self.original_labels.mem[idx]]
             else:
                 t[...] = self.original_targets.mem[idx]
+
+    def fetch_batch(self, idx, size):
+        out = super().fetch_batch(idx, size)
+        if out is not None and self.original_targets:
+            if self.targets_by_label:
+                out["targets"] = self.original_targets.mem[
+                    self.original_labels.mem[idx]]
+            else:
+                out["targets"] = self.original_targets.mem[idx]
+        return out

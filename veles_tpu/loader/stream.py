@@ -258,4 +258,5 @@ class ZeroMQLoader(StreamLoader):
         sock.close(0)
 
     def stop(self) -> None:
+        super().stop()          # Loader.stop closes any prefetcher
         self._closed.set()

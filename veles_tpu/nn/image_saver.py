@@ -30,6 +30,12 @@ class ImageSaver(Unit):
 
     MAPPING = "image_saver"
     hide_from_registry = False
+    # NOT side_effect_only: run() reads the loader's per-minibatch
+    # buffers (input/labels/output), which the next scheduler step
+    # overwrites IN PLACE — a deferred side-plane run would pair
+    # data/labels/predictions from different minibatches (or read a
+    # buffer mid-overwrite). Offload-safe units must read state that
+    # is stable across steps (docs/overlap.md).
 
     def __init__(self, workflow, out_dir: Optional[str] = None,
                  limit: int = 64, only_wrong: bool = True,

@@ -1,3 +1,13 @@
 """Low-level op helpers shared by compute units."""
 
 from .precision import matmul_precision  # noqa: F401
+
+
+def compiler_params(pltpu):
+    """Mosaic compiler-params dataclass across jax versions:
+    ``pltpu.CompilerParams`` (new) was ``pltpu.TPUCompilerParams`` on
+    jax 0.4.x — same fields, renamed class. ONE copy for every Pallas
+    kernel in this package (the shard_map analogue lives in
+    parallel/compat.py)."""
+    return (getattr(pltpu, "CompilerParams", None)
+            or pltpu.TPUCompilerParams)

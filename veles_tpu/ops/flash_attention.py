@@ -34,6 +34,9 @@ LANE = 128
 NEG_INF = -1e30
 
 
+from . import compiler_params as _compiler_params
+
+
 def _mask_scores(s, q_start, k_start, block_q: int, block_k: int,
                  causal: bool, window: int):
     """The one copy of the score mask all three kernels share:
@@ -183,7 +186,7 @@ def _fwd_pallas(q, k, v, causal: bool, scale: float, block_q: int,
         # carry no state between steps, so Mosaic may parallelize /
         # pipeline them; only the K/V dim accumulates in scratch and
         # must stay sequential ("arbitrary")
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
@@ -419,7 +422,7 @@ def _bwd_pallas_core(q, k, v, lse, delta, do, causal: bool,
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, do, k, v, lse8, pad8)
@@ -448,7 +451,7 @@ def _bwd_pallas_core(q, k, v, lse, delta, do, causal: bool,
         ],
         out_shape=[jax.ShapeDtypeStruct((g, t, d), out_dtype or q.dtype)],
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu)(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, do, k, v, lse8, pad8)

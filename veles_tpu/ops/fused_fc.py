@@ -39,6 +39,9 @@ SUB = 8
 NEG = -1e30
 
 
+from . import compiler_params as _compiler_params
+
+
 def _pad_to(x, axis, mult):
     size = x.shape[axis]
     want = ((size + mult - 1) // mult) * mult
@@ -327,7 +330,7 @@ def fused_fc_sgd_epoch(weights: Sequence, biases: Sequence,
         scratch_shapes=scratch,
         # one sequential dimension: every step reads+writes the same
         # VMEM-resident weights
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_compiler_params(pltpu)(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
     )(lr2, xg, yg, *wp, *bp, *vwp, *vbp)

@@ -1,0 +1,243 @@
+"""Side-plane executor: bounded worker pool with named ordered lanes.
+
+The reference VELES dispatched every unit onto a thread pool per
+minibatch (veles/workflow.py:351-364 → veles/units.py:782); the TPU
+port deliberately serialized the scheduler for determinism
+(veles_tpu/workflow.py). That left all host I/O — snapshot fsyncs,
+plotter/publisher rendering, web-status pushes — *inline* with the
+jitted step: the device idles while Python writes files. This module
+restores the overlap for work that is **side-effect only** (nothing
+the compute path reads back), without touching the deterministic
+scheduler:
+
+- **lanes**: tasks submitted to one named lane run FIFO on that
+  lane's worker thread (commit ordering — the checkpoint chain's
+  crash-safety invariant); distinct lanes run concurrently;
+- **backpressure**: each lane's queue is bounded
+  (``root.common.overlap.queue_depth``); a full lane blocks the
+  submitter, and the blocked time is counted in
+  ``veles_sideplane_stall_seconds_total``;
+- **drain barriers**: :meth:`SidePlane.drain` blocks until every
+  queued task completed — the Workflow drains at EndPoint and before
+  ``gather_results`` so results/snapshots are never read half-written;
+- **error routing**: a task that raises is counted
+  (``veles_sideplane_errors_total``), logged, marks
+  ``sideplane.<lane>`` unready in the resilience health plane, and is
+  re-raised from the next ``drain()`` — async execution must not
+  swallow what inline execution would have crashed on;
+- **chaos**: every task passes the ``sideplane.task`` fault-injection
+  point (resilience/faults.py), so crash/delay/raise chaos drives the
+  same code path tests assert on.
+
+The process-global plane (:func:`plane`) is what ``Workflow.run`` and
+the async :class:`~veles_tpu.snapshotter.Snapshotter` share; tests
+construct private :class:`SidePlane` instances and ``shutdown()`` them.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..error import VelesError
+from ..logger import Logger
+
+
+class SidePlaneError(VelesError):
+    """A side-plane task raised; carries every captured error in
+    ``.errors`` (the first one is the ``__cause__``)."""
+
+    def __init__(self, message: str, errors: List[BaseException]) -> None:
+        super().__init__(message)
+        self.errors = errors
+
+
+_STOP = object()
+
+
+class _Lane:
+    __slots__ = ("name", "queue", "thread", "errors", "submitted", "done")
+
+    def __init__(self, name: str, depth: int) -> None:
+        self.name = name
+        self.queue: "queue.Queue" = queue.Queue(maxsize=depth)
+        self.thread: Optional[threading.Thread] = None
+        self.errors: List[BaseException] = []
+        self.submitted = 0
+        self.done = 0
+
+
+class SidePlane(Logger):
+    """Named-lane async executor (see module docstring)."""
+
+    def __init__(self, name: str = "sideplane",
+                 queue_depth: Optional[int] = None) -> None:
+        super().__init__()
+        from ..config import root
+        self.name = name
+        self.queue_depth = int(
+            queue_depth if queue_depth is not None
+            else root.common.overlap.get("queue_depth", 64) or 64)
+        self._lock = threading.Lock()
+        self._lanes: Dict[str, _Lane] = {}
+        self._closed = False
+
+    # -- lane plumbing ------------------------------------------------------
+    def _lane(self, name: str) -> _Lane:
+        with self._lock:
+            if self._closed:
+                raise SidePlaneError(
+                    "%s is shut down" % self.name, [])
+            lane = self._lanes.get(name)
+            if lane is None:
+                lane = self._lanes[name] = _Lane(name, self.queue_depth)
+                lane.thread = threading.Thread(
+                    target=self._worker, args=(lane,), daemon=True,
+                    name="%s:%s" % (self.name, name))
+                lane.thread.start()
+            return lane
+
+    def _worker(self, lane: _Lane) -> None:
+        from ..resilience.faults import fire as fire_fault
+        from ..resilience.health import heartbeats, mark_unready
+        from ..telemetry.counters import inc
+        hb = "%s.%s" % (self.name, lane.name)
+        while True:
+            item = lane.queue.get()
+            if item is _STOP:
+                lane.queue.task_done()
+                return
+            fn, args, kwargs = item
+            try:
+                inc("veles_sideplane_tasks_total")
+                # chaos hook: crash/delay/raise the side-plane here so
+                # tests prove drain + lane ordering survive
+                fire_fault("sideplane.task", lane=lane.name)
+                fn(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — routed, not lost
+                inc("veles_sideplane_errors_total")
+                with self._lock:
+                    lane.errors.append(e)
+                mark_unready(hb)
+                self.warning("side-plane task failed on lane %r: %s: %s",
+                             lane.name, type(e).__name__, e)
+            finally:
+                # liveness: a wedged lane (hung fsync, stuck socket)
+                # shows as this beat aging out on /healthz
+                heartbeats.beat(hb)
+                lane.done += 1
+                lane.queue.task_done()
+
+    # -- public surface -----------------------------------------------------
+    def submit(self, lane: str, fn: Callable[..., Any],
+               *args: Any, **kwargs: Any) -> None:
+        """Enqueue ``fn(*args, **kwargs)`` on ``lane`` (FIFO within the
+        lane). Blocks when the lane queue is full — backpressure, not
+        unbounded growth; the blocked time lands in
+        ``veles_sideplane_stall_seconds_total``."""
+        from ..telemetry.counters import inc
+        entry = self._lane(lane)
+        item = (fn, args, kwargs)
+        try:
+            entry.queue.put_nowait(item)
+        except queue.Full:
+            t0 = time.time()
+            entry.queue.put(item)
+            inc("veles_sideplane_stall_seconds_total", time.time() - t0)
+        entry.submitted += 1
+
+    def drain(self, lane: Optional[str] = None,
+              raise_errors: bool = True) -> List[BaseException]:
+        """Barrier: block until every task queued so far (on ``lane``,
+        or on all lanes) has completed. Waiting time is counted as
+        stall. Captured task errors are popped and — unless
+        ``raise_errors=False`` — re-raised as :class:`SidePlaneError`;
+        the lanes' unready marks are cleared either way (the errors
+        have been delivered to the caller)."""
+        from ..resilience.health import forget
+        from ..telemetry.counters import inc
+        with self._lock:
+            lanes = ([self._lanes[lane]] if lane in self._lanes else []
+                     ) if lane is not None else list(self._lanes.values())
+        t0 = time.time()
+        for entry in lanes:
+            entry.queue.join()
+        stalled = time.time() - t0
+        if stalled > 0:
+            inc("veles_sideplane_stall_seconds_total", stalled)
+        errors: List[BaseException] = []
+        with self._lock:
+            for entry in lanes:
+                errors.extend(entry.errors)
+                entry.errors = []
+        for entry in lanes:
+            forget("%s.%s" % (self.name, entry.name))
+        if errors and raise_errors:
+            raise SidePlaneError(
+                "%d side-plane task(s) failed (first: %s: %s)"
+                % (len(errors), type(errors[0]).__name__, errors[0]),
+                errors) from errors[0]
+        return errors
+
+    def depth(self, lane: str) -> int:
+        with self._lock:
+            entry = self._lanes.get(lane)
+        return entry.queue.qsize() if entry is not None else 0
+
+    def stats(self) -> Dict[str, Dict[str, Any]]:
+        """Per-lane {depth, submitted, done, errors} — the queue-depth
+        gauge surface (web_status /metrics renders it)."""
+        with self._lock:
+            return {name: {"depth": lane.queue.qsize(),
+                           "submitted": lane.submitted,
+                           "done": lane.done,
+                           "errors": len(lane.errors)}
+                    for name, lane in self._lanes.items()}
+
+    def shutdown(self, timeout: float = 10.0) -> None:
+        """Stop every lane worker and join its thread — after this
+        returns no side-plane thread of this instance is alive (tests
+        assert exactly that). Queued tasks run to completion first."""
+        from ..resilience.health import forget
+        with self._lock:
+            self._closed = True
+            lanes = list(self._lanes.values())
+            self._lanes = {}
+        for entry in lanes:
+            entry.queue.put(_STOP)
+        for entry in lanes:
+            if entry.thread is not None:
+                entry.thread.join(timeout=timeout)
+            forget("%s.%s" % (self.name, entry.name))
+        with self._lock:
+            self._closed = False
+
+    def __enter__(self) -> "SidePlane":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.shutdown()
+
+
+_plane: Optional[SidePlane] = None
+_plane_lock = threading.Lock()
+
+
+def plane() -> SidePlane:
+    """THE process-global side plane (mirrors counters.counters /
+    faults.plane): Workflow.run and the async Snapshotter share it so
+    lane ordering holds across subsystems."""
+    global _plane
+    with _plane_lock:
+        if _plane is None:
+            _plane = SidePlane()
+        return _plane
+
+
+def enabled() -> bool:
+    """One switch for the whole overlap engine:
+    ``root.common.overlap.enabled`` (CLI: ``--overlap``)."""
+    from ..config import root
+    return bool(root.common.overlap.get("enabled", False))

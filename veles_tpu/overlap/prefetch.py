@@ -1,0 +1,189 @@
+"""Data-plane prefetcher: stage the NEXT batch while the current step runs.
+
+Keeping the accelerator fed means the host must be *ahead* of the
+device: while step k computes, the host should already be gathering —
+and optionally ``jax.device_put``-staging — batch k+1 (the standard
+TPU input-pipeline recipe; cf. PAPERS.md on host/device overlap at
+scale). :class:`Prefetcher` wraps any batch producer (an iterator, or
+a callable returning successive batches) with an N-deep background
+queue:
+
+- **depth**: at most ``depth`` staged batches exist at once; a full
+  queue blocks the *producer thread* (backpressure — memory stays
+  bounded), never the consumer;
+- **device staging**: ``device_put=True`` runs ``jax.device_put`` over
+  each batch (pytree) in the background thread, so the h2d transfer
+  overlaps compute too;
+- **accounting**: ``veles_prefetch_batches_total`` (staged),
+  ``veles_prefetch_hits_total`` (consumer found a batch ready),
+  ``veles_prefetch_misses_total`` + ``veles_prefetch_stall_seconds_total``
+  (consumer had to wait — the stall the overlap engine exists to
+  remove);
+- **chaos**: every produced batch passes the ``prefetch.batch``
+  fault-injection point; a raised fault surfaces at the consumer's
+  ``get()``, exactly where an inline loader error would;
+- **clean shutdown**: :meth:`close` stops the worker and joins it —
+  no orphan threads (tests assert), even when the producer is blocked
+  on a full queue.
+
+Determinism: the producer runs the *same* code in the same order as
+the inline path — prefetching changes when work happens, never what is
+computed. ``Loader`` integrates this via ``prefetch_depth`` (see
+loader/base.py): the serving state machine (offsets, flags, PRNG
+shuffles) stays on the main thread, and only the pure per-batch gather
+(``fetch_batch``) runs ahead, one epoch at a time.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional, Union
+
+from ..logger import Logger
+
+_END = object()
+
+
+class _Error:
+    __slots__ = ("exc",)
+
+    def __init__(self, exc: BaseException) -> None:
+        self.exc = exc
+
+
+class Prefetcher(Logger):
+    """N-deep background staging queue over a batch producer."""
+
+    def __init__(self, source: Union[Iterable, Callable[[], Any]],
+                 depth: int = 2, device_put: bool = False,
+                 sharding: Any = None, name: str = "prefetch") -> None:
+        super().__init__()
+        if depth < 1:
+            raise ValueError("prefetch depth must be >= 1, got %d" % depth)
+        self.name = name
+        self.depth = int(depth)
+        self.device_put = bool(device_put)
+        self.sharding = sharding
+        if callable(source) and not hasattr(source, "__next__"):
+            def _gen():
+                while True:
+                    yield source()
+            self._it: Iterator = _gen()
+        else:
+            self._it = iter(source)
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._producer, daemon=True, name="prefetch:" + name)
+        self._thread.start()
+
+    # -- producer side ------------------------------------------------------
+    def _stage(self, item: Any) -> Any:
+        if not self.device_put:
+            return item
+        import jax
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, self.sharding), item)
+
+    def _put(self, item: Any) -> bool:
+        """Bounded put that stays responsive to close(); True = stored."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _producer(self) -> None:
+        from ..resilience.faults import fire as fire_fault
+        from ..telemetry.counters import inc
+        while not self._stop.is_set():
+            try:
+                item = next(self._it)
+                fire_fault("prefetch.batch", prefetcher=self.name)
+                item = self._stage(item)
+            except StopIteration:
+                self._put(_END)
+                return
+            except BaseException as e:  # noqa: BLE001 — delivered at get()
+                self._put(_Error(e))
+                return
+            inc("veles_prefetch_batches_total")
+            if not self._put(item):
+                return
+
+    # -- consumer side ------------------------------------------------------
+    def get(self, timeout: Optional[float] = None) -> Any:
+        """Next staged batch. Raises ``StopIteration`` when the source
+        is exhausted, the producer's exception if it died, or
+        ``TimeoutError`` when ``timeout`` elapses with nothing staged
+        (a wedged producer must fail callers loudly, not leak a bare
+        ``queue.Empty``). A batch already waiting is a *hit*; an empty
+        queue is a *miss* and the wait — timed out or not — is counted
+        as prefetch stall."""
+        from ..telemetry.counters import inc
+        if self._q.empty():
+            inc("veles_prefetch_misses_total")
+            t0 = time.time()
+            try:
+                item = self._q.get(timeout=timeout)
+            except queue.Empty:
+                inc("veles_prefetch_stall_seconds_total",
+                    time.time() - t0)
+                raise TimeoutError(
+                    "prefetcher %s produced nothing in %.1fs (producer "
+                    "wedged or starved)" % (self.name, timeout)) \
+                    from None
+            inc("veles_prefetch_stall_seconds_total", time.time() - t0)
+        else:
+            inc("veles_prefetch_hits_total")
+            item = self._q.get_nowait()
+        if item is _END:
+            self._q.put(_END)       # stay exhausted for later calls
+            raise StopIteration
+        if isinstance(item, _Error):
+            self._q.put(item)       # stay broken for later calls
+            raise item.exc
+        return item
+
+    def __iter__(self) -> "Prefetcher":
+        return self
+
+    def __next__(self) -> Any:
+        return self.get()
+
+    @property
+    def ready(self) -> int:
+        """Staged batches waiting right now (the queue-depth gauge)."""
+        return self._q.qsize()
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop the producer and join its thread. Idempotent; safe to
+        call with the producer blocked on a full queue (the bounded put
+        polls the stop flag). After this returns the worker thread is
+        dead — no orphans."""
+        self._stop.set()
+        # unblock a producer sitting in put(): make room
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():      # pragma: no cover - defensive
+            self.warning("prefetch worker %s did not stop in %.1fs",
+                         self.name, timeout)
+
+    @property
+    def closed(self) -> bool:
+        return self._stop.is_set() and not self._thread.is_alive()
+
+    def __enter__(self) -> "Prefetcher":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.close()

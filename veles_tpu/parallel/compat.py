@@ -1,0 +1,25 @@
+"""jax API compatibility shims for the parallel subsystem.
+
+One function for now: ``shard_map`` moved twice across jax releases —
+``jax.experimental.shard_map.shard_map`` (0.4.x, replication checking
+via ``check_rep=``) became top-level ``jax.shard_map`` (varying-
+manual-axes checking via ``check_vma=``). Every call site in this
+package wants the check OFF (the schedules mix replicated and
+per-device values by construction), so the shim resolves both the
+import location and the kwarg spelling in one place.
+"""
+
+from __future__ import annotations
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """``shard_map(fn, ...)`` with replication/VMA checking disabled,
+    on whichever jax API this environment ships."""
+    try:
+        from jax import shard_map            # jax >= 0.6: check_vma
+    except ImportError:
+        from jax.experimental.shard_map import shard_map  # 0.4.x
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, check_vma=False)

@@ -83,8 +83,9 @@ def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, xs: Any,
     """
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .compat import shard_map_compat
 
     if batch_spec is None:
         batch_spec = P()
@@ -111,10 +112,9 @@ def gpipe(fn: Callable[[Any, Any], Any], stage_params: Any, xs: Any,
 
     params_spec = jax.tree_util.tree_map(
         lambda _: P(axis), stage_params)
-    fn_sharded = shard_map(
+    fn_sharded = shard_map_compat(
         local, mesh=mesh,
-        in_specs=(params_spec, batch_spec), out_specs=batch_spec,
-        check_vma=False)
+        in_specs=(params_spec, batch_spec), out_specs=batch_spec)
     return fn_sharded(stage_params, xs)
 
 
@@ -152,8 +152,9 @@ def gpipe_hetero(stage_fns: List[Callable[[Any, Any], Any]],
     import numpy as np
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .compat import shard_map_compat
 
     if batch_spec is None:
         batch_spec = P()
@@ -198,10 +199,9 @@ def gpipe_hetero(stage_fns: List[Callable[[Any, Any], Any]],
             lambda y: y[:sizes[n]].reshape(shapes[n]), shapes[n])
 
     params_spec = jax.tree_util.tree_map(lambda _: P(), stage_params)
-    fn_sharded = shard_map(
+    fn_sharded = shard_map_compat(
         local, mesh=mesh,
-        in_specs=(params_spec, batch_spec), out_specs=batch_spec,
-        check_vma=False)
+        in_specs=(params_spec, batch_spec), out_specs=batch_spec)
     return fn_sharded(stage_params, xs)
 
 

@@ -61,8 +61,9 @@ def ring_attention(q, k, v, mesh, axis: str = "sequence",
     the einsum engine."""
     import jax
     import jax.numpy as jnp
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .compat import shard_map_compat
 
     if scale is None:
         scale = 1.0 / (q.shape[-1] ** 0.5)
@@ -102,8 +103,8 @@ def ring_attention(q, k, v, mesh, axis: str = "sequence",
                     scale=float(scale), window=window,
                     use_flash=bool(use_flash))
     spec = P(batch_axis, axis, None, None)
-    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_vma=False)
+    fn = shard_map_compat(local, mesh=mesh,
+                          in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)
 
 

@@ -28,8 +28,9 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sequence",
     """q, k, v: (B, T, H, D) global arrays; returns (B, T, H, D) with the
     sequence axis sharded over ``axis``."""
     import jax
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .compat import shard_map_compat
     from .ring_attention import attention_reference
 
     n = mesh.shape[axis]
@@ -64,6 +65,6 @@ def ulysses_attention(q, k, v, mesh, axis: str = "sequence",
                                   tiled=True)
 
     spec = P(batch_axis, axis, None, None)
-    fn = shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_vma=False)
+    fn = shard_map_compat(local, mesh=mesh,
+                          in_specs=(spec, spec, spec), out_specs=spec)
     return fn(q, k, v)

@@ -51,6 +51,9 @@ class Plotter(Unit):
 
     hide_from_registry = True
     KIND = "none"
+    #: plot emission is pure output (snapshot → sink/renderer); with
+    #: the overlap engine on, the scheduler moves it off the step loop
+    side_effect_only = True
 
     def __init__(self, workflow, **kwargs) -> None:
         self.redraw_interval: float = kwargs.pop("redraw_interval", 0.1)

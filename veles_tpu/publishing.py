@@ -346,6 +346,10 @@ class Publisher(Unit):
 
     MAPPING = "publisher"
     hide_from_registry = False
+    #: report rendering/upload is pure output; with the overlap engine
+    #: on it runs on the side-plane (gather_results drains first, so
+    #: ``reports`` is always complete when read)
+    side_effect_only = True
 
     def __init__(self, workflow, backends=("markdown",),
                  out_dir: Optional[str] = None,

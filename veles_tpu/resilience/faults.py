@@ -80,6 +80,13 @@ for _name, _desc in (
                       "as 503 + Retry-After, never a crash)"),
     ("distributed.init", "initialize_multihost, inside the retried "
                          "coordinator join"),
+    # overlap subsystem (veles_tpu/overlap/): chaos for the async
+    # side-plane — crash/delay a lane worker or the prefetch producer
+    # and prove drain barriers + checkpoint-lane ordering survive
+    ("sideplane.task", "side-plane lane worker, before each offloaded "
+                       "task executes (overlap/executor.py)"),
+    ("prefetch.batch", "prefetch producer, before each staged batch "
+                       "(overlap/prefetch.py)"),
 ):
     register_point(_name, _desc)
 

@@ -165,10 +165,20 @@ class Snapshotter(Unit):
     def __init__(self, workflow, prefix: str = "wf", directory: str = None,
                  compression: str = "gz", interval: int = 1,
                  time_interval: float = 0.0, keep_last: int = None,
-                 **kwargs):
+                 async_mode: bool = None, **kwargs):
         super().__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
         self.prefix = prefix
+        #: non-blocking checkpoints (overlap engine, docs/overlap.md):
+        #: collect_state stays on the main thread (the deterministic
+        #: device→host copy, with its collectives), but the serialize+
+        #: fsync+hash commit runs on the side-plane's ``checkpoint``
+        #: lane. Lane FIFO preserves the chain's commit order; a crash
+        #: mid-commit leaves only a ``*.tmp`` the restore walk ignores,
+        #: so restore_latest behaves exactly like the sync path.
+        self.async_mode = bool(
+            root.common.overlap.get("async_snapshots", False)
+            if async_mode is None else async_mode)
         self.directory = directory or root.common.dirs.snapshots
         if compression not in CODECS:
             raise ValueError("compression %r not in %s" %
@@ -213,14 +223,40 @@ class Snapshotter(Unit):
             return True
 
     def export(self) -> str:
-        from .resilience import checkpoint_chain as chain_mod
-        from .resilience.faults import fire as fire_fault
         # EVERY rank collects — collection all-gathers cross-process
         # sharded params (fetch_global collectives must fire in
-        # lockstep); only the coordinator touches the filesystem
+        # lockstep); only the coordinator touches the filesystem. In
+        # async mode this device→host copy is the ONLY part that runs
+        # on the main thread — the state tree is frozen here, so later
+        # training steps cannot leak into the written snapshot.
         state = collect_state(self.workflow)
         if not self._is_writer():
             return ""
+        opener, ext = CODECS[self.compression]
+        suffix = ("_" + self.suffix) if self.suffix else ""
+        fname = "%s%s_%s_%04d.pickle%s" % (
+            self.prefix, suffix, time.strftime("%Y%m%d_%H%M%S"),
+            self._runs, ext)
+        path = os.path.join(self.directory, fname)
+        if self.async_mode:
+            from .overlap import plane
+            # one named lane = FIFO commits: snapshot k is durable
+            # before snapshot k+1 starts, the chain's ordering invariant
+            plane().submit("checkpoint", self._commit,
+                           state, path, fname, ext, opener,
+                           self._runs)
+            self.destination = path
+            return path
+        self._commit(state, path, fname, ext, opener, self._runs)
+        return path
+
+    def _commit(self, state, path: str, fname: str, ext: str, opener,
+                runs: int) -> None:
+        """Serialize + fsync + hash + manifest + symlink + prune — the
+        blocking half of export(). Runs inline (sync mode) or on the
+        side-plane's ``checkpoint`` lane (async mode)."""
+        from .resilience import checkpoint_chain as chain_mod
+        from .resilience.faults import fire as fire_fault
         # injection BEFORE the commit: a crash here must leave the
         # previous snapshot intact (the crash-safety contract the chaos
         # test drives); a corrupt instruction damages the bytes on disk
@@ -228,12 +264,6 @@ class Snapshotter(Unit):
         # bitrot that verify() catches at restore
         fault = fire_fault("snapshot.write")
         os.makedirs(self.directory, exist_ok=True)
-        opener, ext = CODECS[self.compression]
-        suffix = ("_" + self.suffix) if self.suffix else ""
-        fname = "%s%s_%s_%04d.pickle%s" % (
-            self.prefix, suffix, time.strftime("%Y%m%d_%H%M%S"),
-            self._runs, ext)
-        path = os.path.join(self.directory, fname)
         tmp = path + ".tmp"
         with opener(tmp, "wb") as fout:
             pickle.dump(state, fout, protocol=pickle.HIGHEST_PROTOCOL)
@@ -247,7 +277,7 @@ class Snapshotter(Unit):
         # under its final name or not at all
         chain_mod.commit_file(tmp, path)
         chain_mod.write_manifest(
-            path, sha256=digest, prefix=self.prefix, runs=self._runs,
+            path, sha256=digest, prefix=self.prefix, runs=runs,
             created=time.time(), checksum=state["__meta__"]["checksum"])
         self._update_current_link(fname, ext)
         if self.keep_last:
@@ -256,7 +286,14 @@ class Snapshotter(Unit):
         size = os.path.getsize(path)
         self.info("snapshot → %s (%.1f KiB)", path, size / 1024)
         self.event("snapshot", "single", path=path, bytes=size)
-        return path
+
+    def drain(self, raise_errors: bool = True):
+        """Barrier on the ``checkpoint`` lane: returns once every
+        queued async commit is durably on disk (no-op in sync mode)."""
+        if not self.async_mode:
+            return []
+        from .overlap import plane
+        return plane().drain("checkpoint", raise_errors=raise_errors)
 
     def _update_current_link(self, fname: str, ext: str) -> None:
         """Atomically repoint the ``_current`` symlink (reference:
@@ -278,9 +315,22 @@ class Snapshotter(Unit):
 
     def stop(self) -> None:
         """Forced snapshot on workflow stop
-        (reference: veles/snapshotter.py:175-179)."""
+        (reference: veles/snapshotter.py:175-179). In async mode the
+        checkpoint lane is drained afterwards — stop keeps the sync
+        path's guarantee that the forced snapshot is durable when it
+        returns. A failed commit must not vanish just because stop
+        cannot raise mid-shutdown: errors route to the owning
+        workflow's final drain barrier (which re-raises), exactly
+        where a sync-mode export failure would have surfaced."""
         if self._runs and not bool(self.skip):
             self.export()
+            errors = self.drain(raise_errors=False)
+            for exc in errors:
+                self.warning("async snapshot commit failed: %s: %s",
+                             type(exc).__name__, exc)
+            stash = getattr(self.workflow, "_side_errors", None)
+            if errors and stash is not None:
+                stash.extend(errors)
 
     def get_metric_values(self) -> Dict[str, Any]:
         return {"snapshot": self.destination}

@@ -57,6 +57,23 @@ DESCRIPTIONS = {
         "step_watchdog threshold trips (possible hangs)",
     "veles_snapshots_quarantined_total":
         "Corrupt snapshots renamed *.corrupt during chain restore",
+    # overlap subsystem (veles_tpu/overlap/): bench.py's gate asserts
+    # the side-plane/prefetch counters read 0 in overlap-off runs
+    "veles_sideplane_tasks_total":
+        "Tasks executed by side-plane lane workers",
+    "veles_sideplane_errors_total":
+        "Side-plane tasks that raised (routed to drain + health)",
+    "veles_sideplane_stall_seconds_total":
+        "Seconds the main thread blocked on side-plane backpressure "
+        "or drain barriers",
+    "veles_prefetch_batches_total":
+        "Batches staged ahead by the data-plane prefetcher",
+    "veles_prefetch_hits_total":
+        "Prefetcher gets served without waiting (batch was ready)",
+    "veles_prefetch_misses_total":
+        "Prefetcher gets that had to wait for the producer",
+    "veles_prefetch_stall_seconds_total":
+        "Seconds consumers waited on the prefetch queue",
 }
 
 

@@ -54,6 +54,16 @@ class Unit(Logger, metaclass=UnitRegistry):
 
     hide_from_registry = True
 
+    #: a unit whose ``run`` only EMITS (plots, reports, saved images,
+    #: status pushes) and is never read back by the compute path may
+    #: declare True: with the overlap engine on (root.common.overlap.
+    #: enabled, docs/overlap.md) the scheduler dispatches its run to
+    #: the async side-plane instead of blocking the step loop. Gate
+    #: evaluation and downstream propagation stay inline either way —
+    #: only the run body moves off-thread, so scheduling decisions are
+    #: bit-identical with overlap on or off.
+    side_effect_only = False
+
     def __init__(self, workflow, **kwargs) -> None:
         super().__init__()
         self.name: str = kwargs.pop("name", type(self).__name__)
@@ -158,28 +168,40 @@ class Unit(Logger, metaclass=UnitRegistry):
         for k in self.links_from:
             self.links_from[k] = False
 
-    def process(self) -> Iterable["Unit"]:
+    def process(self, side_plane=None) -> Iterable["Unit"]:
         """Run (honoring gates) and yield downstream units to notify.
-        Called by the Workflow scheduler."""
+        Called by the Workflow scheduler. When a side plane is given
+        and this unit is ``side_effect_only``, the run body executes
+        on the unit's own FIFO lane instead of inline — the scheduler
+        keeps walking the graph while the I/O happens."""
         if bool(self.gate_block):
             return ()
         if not bool(self.gate_skip):
-            t0 = time.time()
-            if root.common.trace.run:
-                self.debug("running %s", self.name)
-            from .telemetry.counters import inc
-            from .telemetry.spans import span
-            inc("veles_unit_runs_total")
-            # telemetry span: nesting + per-run dispatch/transfer
-            # counter deltas. The root.common.trace.spans switch is
-            # honored centrally by the recorder — one knob, every site
-            with span("unit.run", unit=self.name,
-                      cls=type(self).__name__):
-                self.run()
-            self.timers["run"] += time.time() - t0
-            self.run_count += 1
+            if side_plane is not None and self.side_effect_only:
+                side_plane.submit("unit." + self.name, self._timed_run)
+            else:
+                self._timed_run()
         # stable name order: keeps the scheduler deterministic across runs
         return tuple(sorted(self.links_to, key=lambda u: u.name))
+
+    def _timed_run(self) -> None:
+        """The instrumented run body process() executes inline or the
+        side-plane lane executes async (spans nest per thread, so the
+        instrumentation is identical either way)."""
+        t0 = time.time()
+        if root.common.trace.run:
+            self.debug("running %s", self.name)
+        from .telemetry.counters import inc
+        from .telemetry.spans import span
+        inc("veles_unit_runs_total")
+        # telemetry span: nesting + per-run dispatch/transfer
+        # counter deltas. The root.common.trace.spans switch is
+        # honored centrally by the recorder — one knob, every site
+        with span("unit.run", unit=self.name,
+                  cls=type(self).__name__):
+            self.run()
+        self.timers["run"] += time.time() - t0
+        self.run_count += 1
 
     def __repr__(self) -> str:
         return "<%s %r>" % (type(self).__name__, self.name)

@@ -203,10 +203,22 @@ class WebStatusServer(Logger):
                     # per tracked workflow so scrapers see liveness
                     from .telemetry.counters import (
                         METRICS_CONTENT_TYPE, metrics_text)
-                    text = metrics_text({
+                    gauges = {
                         "veles_status_workflows":
                             (len(server.snapshot()),
-                             "Workflows currently reporting")})
+                             "Workflows currently reporting")}
+                    # overlap engine: per-lane queue depth of the
+                    # process-global side plane (0 lanes when the
+                    # engine is off — no gauge rows at all)
+                    import re as _re
+                    from . import overlap as _overlap
+                    for lane, st in sorted(
+                            _overlap.plane().stats().items()):
+                        safe = _re.sub(r"[^A-Za-z0-9_]", "_", lane)
+                        gauges["veles_sideplane_queue_depth_" + safe] = (
+                            st["depth"],
+                            "Tasks queued on side-plane lane " + lane)
+                    text = metrics_text(gauges)
                     bytes_reply(self, 200, text.encode(),
                                 METRICS_CONTENT_TYPE)
                 else:

@@ -45,6 +45,13 @@ class Workflow(Unit):
         self.end_point = EndPoint(self)
         self._run_time = 0.0
         self._max_steps = kwargs.get("max_steps", None)  # safety valve
+        #: the async side-plane of the overlap engine (veles_tpu/
+        #: overlap/): attached by run() when root.common.overlap.
+        #: enabled; None = fully serial (the default)
+        self.side_plane = None
+        #: task errors captured by intermediate drain barriers (the
+        #: EndPoint drain cannot raise mid-stop) — re-raised by run()
+        self._side_errors: List[BaseException] = []
 
     # -- container protocol -------------------------------------------------
     def add_ref(self, unit: Unit) -> None:
@@ -129,6 +136,11 @@ class Workflow(Unit):
         from .telemetry.spans import recorder
         _span_frame = recorder.begin("workflow.run", workflow=self.name)
         _hb_name = "workflow.%s" % self.name
+        # overlap engine: side_effect_only units (plotters,
+        # publishers) run on the async side-plane instead of stalling
+        # the step loop; scheduling itself stays serial + deterministic
+        from . import overlap
+        self.side_plane = overlap.plane() if overlap.enabled() else None
         queue = collections.deque([self.start_point])
         steps = 0
         try:
@@ -137,7 +149,7 @@ class Workflow(Unit):
                 # shows as this heartbeat aging out on /healthz
                 heartbeats.beat(_hb_name)
                 unit = queue.popleft()
-                for downstream in unit.process():
+                for downstream in unit.process(side_plane=self.side_plane):
                     if bool(self.stopped):
                         break
                     if downstream.open_gate(unit):
@@ -146,7 +158,30 @@ class Workflow(Unit):
                 if self._max_steps is not None and steps > self._max_steps:
                     raise Bug("workflow %s exceeded max_steps=%d" %
                               (self.name, self._max_steps))
+            # final drain barrier: every offloaded run and queued
+            # checkpoint commit lands before run() returns, and a task
+            # error surfaces HERE — exactly where the serial scheduler
+            # would have crashed. Errors stashed by intermediate drains
+            # (EndPoint, an async Snapshotter.stop — which works even
+            # with the side-plane off) are re-raised too.
+            errors = list(self._side_errors)
+            if self.side_plane is not None:
+                errors += self.side_plane.drain(raise_errors=False)
+            self._side_errors = []
+            if errors:
+                from .overlap import SidePlaneError
+                raise SidePlaneError(
+                    "%d side-plane task(s) failed during %s "
+                    "(first: %s: %s)"
+                    % (len(errors), self.name,
+                       type(errors[0]).__name__, errors[0]),
+                    errors) from errors[0]
         finally:
+            if self.side_plane is not None:
+                # on the exception path too, nothing may stay in
+                # flight past run() — but don't mask the original
+                # error with a side-task one
+                self.side_plane.drain(raise_errors=False)
             # a COMPLETED (or cleanly crashed) run is not a hang: drop
             # the beat so only a truly wedged loop ages out on /healthz
             heartbeats.unregister(_hb_name)
@@ -159,6 +194,14 @@ class Workflow(Unit):
 
     def on_workflow_finished(self) -> None:
         """Called by EndPoint (reference: veles/workflow.py:377-401)."""
+        if self.side_plane is not None:
+            # drain barrier at EndPoint: offloaded plot/publish runs
+            # finish before units are stopped (a forced Snapshotter
+            # export on stop must queue AFTER everything it follows).
+            # Raising here would wedge the stop sequence — errors are
+            # stashed for run()'s final barrier instead.
+            self._side_errors.extend(
+                self.side_plane.drain(raise_errors=False))
         self.stopped <<= True
         for u in self._units:
             u.stop()
@@ -170,6 +213,10 @@ class Workflow(Unit):
     def gather_results(self) -> Dict[str, Any]:
         """Harvest metrics from units exposing ``get_metric_values``
         (reference: IResultProvider, veles/workflow.py:827-849)."""
+        if self.side_plane is not None:
+            # barrier: results (publisher paths, snapshot destinations)
+            # must never be read while a side task is still writing them
+            self.side_plane.drain(raise_errors=False)
         results: Dict[str, Any] = {}
         for u in self._units:
             getter = getattr(u, "get_metric_values", None)
