@@ -537,6 +537,11 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # default overlap-OFF bench these MUST be zero — the gate
         # fails if side-plane counters leaked into the serial path
         "overlap": _overlap_section(),
+        # model-health accounting (veles_tpu/telemetry/tensormon.py):
+        # in the default monitoring-OFF bench the sample/NaN counters
+        # MUST be zero — taps leaking into an unmonitored step would
+        # break the bit-identical-off contract
+        "tensormon": _tensormon_section(),
         "extras": [ae, lm],
     }
 
@@ -555,6 +560,24 @@ def _overlap_section():
         "stall_seconds": round(
             counters.get("veles_sideplane_stall_seconds_total")
             + counters.get("veles_prefetch_stall_seconds_total"), 6),
+    }
+
+
+def _tensormon_section():
+    """{enabled, samples, nan_total, blackbox_dumps, recorder_events}
+    for this bench process — absolute counter reads, like the overlap
+    section (one process, counters start at zero)."""
+    from veles_tpu.config import root as vt_root
+    from veles_tpu.telemetry.counters import counters
+    from veles_tpu.telemetry.recorder import flight
+    return {
+        "enabled": bool(
+            vt_root.common.telemetry.tensormon.get("enabled", False)),
+        "samples": int(counters.get("veles_tensormon_samples_total")),
+        "nan_total": int(counters.get("veles_model_nan_total")),
+        "blackbox_dumps": int(
+            counters.get("veles_blackbox_dumps_total")),
+        "recorder_events": int(flight.stats()["recorded"]),
     }
 
 
@@ -809,10 +832,82 @@ def _overlap_stall_proof():
     return failures
 
 
+def gate_tensormon(baseline_doc=None, current_doc=None):
+    """``tensormon`` gate section: (1) the model-health counters must
+    be registered; (2) a monitoring-OFF bench document must carry ZERO
+    tensormon samples/NaN detections — taps leaking into an
+    unmonitored step would break the bit-identical-off contract;
+    (3) live proof that the flight recorder's per-event overhead stays
+    under budget (it sits on the span-close and counter hot paths)."""
+    from veles_tpu.telemetry import TENSORMON_COUNTERS
+    from veles_tpu.telemetry.counters import DESCRIPTIONS
+    failures = []
+    for name in TENSORMON_COUNTERS:
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "tensormon: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc),
+                     ("current", current_doc)):
+        sec = (doc or {}).get("tensormon")
+        if not sec or sec.get("enabled"):
+            continue
+        for key in ("samples", "nan_total"):
+            if sec.get(key):
+                failures.append(
+                    "tensormon: %s doc has %s=%s with monitoring OFF "
+                    "— taps leaked into the unmonitored step"
+                    % (tag, key, sec[key]))
+    return failures + _recorder_overhead_proof()
+
+
+def _recorder_overhead_proof():
+    """Fill a private full-capacity flight-recorder ring and check the
+    per-event cost: 4096 small-dict appends must land well under 1 s
+    (~244 µs/event — a deque append measures ~1 µs, so the budget
+    carries >100x scheduler-jitter margin). Ring semantics checked
+    too: capacity respected, newest events win."""
+    import time as _t
+    from veles_tpu.config import root as vt_root
+    from veles_tpu.telemetry.recorder import FlightRecorder
+    n = 4096
+    # follow_config=True: measure the SHIPPED per-event path (enabled
+    # + capacity lookups included), not a cheaper private variant
+    rec = FlightRecorder(capacity=n, follow_config=True)
+    if not rec.enabled():
+        return []            # recorder disabled by config: no budget
+    prev_cap = vt_root.common.telemetry.recorder.get("capacity", n)
+    vt_root.common.telemetry.recorder.capacity = n
+    try:
+        t0 = _t.time()
+        for i in range(n + 8):
+            rec.note("bench", i=i)
+        elapsed = _t.time() - t0
+    finally:
+        vt_root.common.telemetry.recorder.capacity = prev_cap
+    failures = []
+    stats = rec.stats()
+    if stats["buffered"] != n:
+        failures.append(
+            "tensormon: recorder ring holds %d events at capacity %d"
+            % (stats["buffered"], n))
+    recs = rec.records()
+    if not recs or recs[-1].get("i") != n + 7:
+        failures.append(
+            "tensormon: recorder ring did not keep the newest events")
+    if elapsed > 1.0:
+        failures.append(
+            "tensormon: recorder overhead %.3fs for %d events exceeds "
+            "the 1.0s budget (%.1f us/event)"
+            % (elapsed, n + 8, 1e6 * elapsed / (n + 8)))
+    return failures
+
+
 def _gate_main(argv):
     """``python bench.py gate BASELINE.json CURRENT.json`` — exit 1 on
-    any counter regression, resilience-counter leakage, or overlap
-    stall regression/leakage."""
+    any counter regression, resilience-counter leakage, overlap stall
+    regression/leakage, tensormon-off leakage or recorder overhead
+    overrun."""
     if len(argv) != 2:
         print("usage: bench.py gate BASELINE.json CURRENT.json",
               file=sys.stderr)
@@ -822,13 +917,15 @@ def _gate_main(argv):
     with open(argv[1]) as f:
         current = json.load(f)
     failures = (gate_docs(baseline, current) + gate_resilience()
-                + gate_overlap(baseline, current))
+                + gate_overlap(baseline, current)
+                + gate_tensormon(baseline, current))
     for failure in failures:
         print("GATE FAIL %s" % failure, file=sys.stderr)
     if failures:
         return 1
     print("counter gate OK (%s vs %s; resilience counters clean, "
-          "overlap stall proof passed)" % (argv[1], argv[0]))
+          "overlap stall proof passed, tensormon clean, recorder "
+          "overhead in budget)" % (argv[1], argv[0]))
     return 0
 
 

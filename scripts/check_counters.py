@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Static counter-registration pass.
+
+Every ``veles_*`` counter the tree increments (``inc("veles_...")`` /
+``counters.inc("veles_...")``) or reads (``counters.get("veles_...")``)
+must be registered with a HELP string in
+``veles_tpu/telemetry/counters.py::DESCRIPTIONS`` — an unregistered
+name still counts, but renders on ``/metrics`` with the generic HELP
+and silently escapes the bench gate's zero-leakage sections. This
+script fails (exit 1) on any used-but-unregistered name, so the drift
+is caught at CI time instead of on a dashboard.
+
+No imports of the package (and no jax): the registry is read by
+AST-parsing counters.py, the usages by regexing the tree — runs in
+milliseconds anywhere.
+
+Usage: ``python scripts/check_counters.py`` (from any cwd);
+wired into tier-1 via tests/test_tensormon.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+COUNTERS_PY = os.path.join(REPO, "veles_tpu", "telemetry",
+                           "counters.py")
+
+#: literal counter-name usages: inc("veles_x") — the module helper,
+#: the registry method (matches after the dot) AND import aliases
+#: ending in `inc` like recorder.py's `_counter_inc(` — plus
+#: counters.get("veles_x") (bench gate sections). Dynamically-built
+#: names cannot be checked statically and are out of scope.
+USE_RE = re.compile(
+    r"""\b[A-Za-z_]*inc\(\s*["'](veles_[a-z0-9_]+)["']"""
+    r"""|\bcounters\.get\(\s*["'](veles_[a-z0-9_]+)["']""")
+
+#: directories scanned for usages (tests may inc ad-hoc names on
+#: purpose and are excluded)
+SCAN = ("veles_tpu", "scripts", "bench.py")
+
+
+def registered_counters(path: str = COUNTERS_PY) -> set:
+    """Keys of the DESCRIPTIONS dict, read via AST (no import)."""
+    with open(path) as fin:
+        tree = ast.parse(fin.read())
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(getattr(t, "id", None) == "DESCRIPTIONS"
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            break
+        return {key.value for key in node.value.keys
+                if isinstance(key, ast.Constant)}
+    raise SystemExit("DESCRIPTIONS dict literal not found in %s" % path)
+
+
+def used_counters(repo: str = REPO):
+    """{counter name: first use site} over the scanned tree."""
+    uses = {}
+    this_file = os.path.abspath(__file__)
+    paths = []
+    for entry in SCAN:
+        full = os.path.join(repo, entry)
+        if os.path.isfile(full):
+            paths.append(full)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            paths.extend(os.path.join(dirpath, f)
+                         for f in sorted(filenames)
+                         if f.endswith(".py"))
+    for path in paths:
+        if os.path.abspath(path) == this_file:
+            continue
+        with open(path, errors="replace") as fin:
+            for lineno, line in enumerate(fin, 1):
+                for match in USE_RE.finditer(line):
+                    name = match.group(1) or match.group(2)
+                    uses.setdefault(
+                        name, "%s:%d"
+                        % (os.path.relpath(path, repo), lineno))
+    return uses
+
+
+def find_unregistered():
+    """[(name, first use site)] for every used-but-unregistered
+    counter — the list main() fails on."""
+    known = registered_counters()
+    return sorted((name, site) for name, site in used_counters().items()
+                  if name not in known)
+
+
+def main(argv=None) -> int:
+    missing = find_unregistered()
+    for name, site in missing:
+        print("UNREGISTERED counter %s (first use: %s)" % (name, site),
+              file=sys.stderr)
+    if missing:
+        print("%d counter(s) used but not registered in "
+              "telemetry/counters.py DESCRIPTIONS" % len(missing),
+              file=sys.stderr)
+        return 1
+    print("counter registration OK (%d registered, %d distinct names "
+          "used)" % (len(registered_counters()), len(used_counters())))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

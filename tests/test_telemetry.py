@@ -277,6 +277,36 @@ def test_trace_export_cli_rejects_empty_input(tmp_path):
                  str(tmp_path / "o.json")]) == 1
 
 
+def test_trace_export_tolerates_truncated_lines(tmp_path, caplog):
+    """A --trace-file killed mid-append ends in half a JSON record;
+    `trace export` must skip the bad line with a counted warning and
+    export the readable spans instead of raising on json.loads."""
+    import logging
+    spans.recorder.clear()
+    with spans.span("kept_a"):
+        pass
+    with spans.span("kept_b"):
+        pass
+    jsonl = tmp_path / "torn.jsonl"
+    assert spans.recorder.to_jsonl(str(jsonl)) == 2
+    with open(jsonl, "a") as f:
+        f.write('{"name": "torn", "ts": 123.0, "du')   # mid-write cut
+    with caplog.at_level(logging.WARNING,
+                         logger="veles_tpu.telemetry"):
+        recs = spans.read_jsonl(str(jsonl))
+    assert [r["name"] for r in recs] == ["kept_a", "kept_b"]
+    assert any("skipped 1 malformed" in rec.message
+               for rec in caplog.records)
+    out = tmp_path / "trace.json"
+    from veles_tpu.__main__ import main
+    assert main(["trace", "export", str(jsonl), str(out)]) == 0
+    with open(out) as f:
+        doc = json.load(f)
+    assert chrome_trace.validate(doc) == []
+    names = [e["name"] for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert names == ["kept_a", "kept_b"]
+
+
 def test_chrome_trace_validator_catches_violations():
     assert chrome_trace.validate([]) != []
     assert chrome_trace.validate({"traceEvents": "nope"}) != []

@@ -34,6 +34,11 @@ def main(argv=None) -> int:
         # resilience subcommand family:
         #   veles-tpu faults list
         return _faults_cli(argv[1:])
+    if argv and argv[0] == "blackbox":
+        # flight-recorder subcommand family (telemetry/recorder.py):
+        #   veles-tpu blackbox dump [--out PATH]
+        #   veles-tpu blackbox inspect BLACKBOX.jsonl
+        return _blackbox_cli(argv[1:])
     parser = make_parser()
     # intermixed parsing: config overrides (positionals) may appear
     # between/after flags — see cmdline.parse_args
@@ -82,6 +87,14 @@ def main(argv=None) -> int:
         root.common.job_timeout = args.job_timeout
     if args.snapshot_dir:
         root.common.dirs.snapshots = args.snapshot_dir
+    if args.tensormon or args.nan_policy:
+        # model-health taps (telemetry/tensormon.py): --nan-policy
+        # implies monitoring — a sentinel with no taps would be inert
+        root.common.telemetry.tensormon.enabled = True
+        if args.nan_policy:
+            root.common.telemetry.tensormon.nan_policy = args.nan_policy
+    if args.blackbox:
+        root.common.telemetry.recorder.autodump = True
     if args.overlap:
         # the overlap engine (veles_tpu/overlap/): async side-plane +
         # non-blocking checkpoints; prefetch depth rides its own flag
@@ -186,6 +199,59 @@ def _faults_cli(argv) -> int:
         print("  %-17s %s" % (name, desc))
     spec = faults.plane.current_spec()
     print("active spec: %s" % (spec or "(none)"))
+    return 0
+
+
+def _blackbox_cli(argv) -> int:
+    """``veles-tpu blackbox dump|inspect`` — write the current
+    process's flight-recorder ring to a black-box file, or summarize
+    one written by a crash/watchdog/SIGTERM/NaN-sentinel dump
+    (veles_tpu/telemetry/recorder.py)."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu blackbox",
+        description="flight-recorder black box "
+                    "(docs/observability.md)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    dmp = sub.add_parser("dump", help="dump this process's ring")
+    dmp.add_argument("--out", default=None,
+                     help="output path (default: blackbox-<ts>.jsonl "
+                          "in the snapshot directory)")
+    dmp.add_argument("--reason", default="cli dump")
+    ins = sub.add_parser(
+        "inspect", help="summarize a blackbox-*.jsonl dump")
+    ins.add_argument("path")
+    ins.add_argument("--tail", type=int, default=10, metavar="N",
+                     help="also print the last N events")
+    args = parser.parse_args(argv)
+    from .telemetry.recorder import flight, inspect, read_blackbox
+    if args.cmd == "dump":
+        try:
+            path = flight.dump(args.reason, path=args.out)
+        except OSError as e:
+            print("blackbox dump failed: %s" % e, file=sys.stderr)
+            return 1
+        print("black box -> %s (%d events)"
+              % (path, flight.stats()["buffered"]))
+        return 0
+    try:
+        summary = inspect(args.path)
+    except OSError as e:
+        print("blackbox inspect failed: %s" % e, file=sys.stderr)
+        return 1
+    print("black box %s" % summary["path"])
+    print("  reason:  %s" % summary["reason"])
+    print("  pid:     %s" % summary["pid"])
+    print("  events:  %d over %.3fs"
+          % (summary["events"], summary["span_seconds"]))
+    for kind, count in sorted(summary["by_kind"].items(),
+                              key=lambda kv: -kv[1]):
+        print("  %-12s %d" % (kind, count))
+    if args.tail > 0:
+        _, events = read_blackbox(args.path)
+        for rec in events[-args.tail:]:
+            label = rec.get("name") or rec.get("counter") or ""
+            print("  tail: %-10s %s" % (rec.get("kind", "?"), label))
     return 0
 
 

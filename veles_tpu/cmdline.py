@@ -45,6 +45,27 @@ def make_parser() -> argparse.ArgumentParser:
                    help="print per-unit timing table at exit")
     p.add_argument("--trace-file", default=None,
                    help="append event spans as JSON lines here")
+    # model-health observability (veles_tpu/telemetry/tensormon.py +
+    # recorder.py, docs/observability.md "Model health")
+    p.add_argument("--tensormon", action="store_true",
+                   help="in-graph tensor taps on the fused train step "
+                        "(grad norms, update ratios, NaN/Inf counts, "
+                        "activation saturation) — accumulated on "
+                        "device, drained with the epoch metrics, "
+                        "served as veles_model_* gauges on /metrics")
+    p.add_argument("--nan-policy", default=None,
+                   choices=("warn", "halt", "snapshot_and_halt"),
+                   help="NaN sentinel policy (implies --tensormon): "
+                        "warn logs and counts; halt marks health "
+                        "unready and raises ModelHealthError; "
+                        "snapshot_and_halt first commits a forensic "
+                        "snapshot through the checkpoint chain")
+    p.add_argument("--blackbox", action="store_true",
+                   help="arm flight-recorder autodump: unhandled "
+                        "workflow crashes, watchdog trips and SIGTERM "
+                        "write blackbox-<ts>.jsonl next to the "
+                        "snapshots (read with `veles-tpu blackbox "
+                        "inspect`)")
     p.add_argument("--force-numpy", action="store_true")
     p.add_argument("--mixed-precision", action="store_true",
                    help="bf16 activation/param storage in the fused "

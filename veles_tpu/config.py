@@ -210,6 +210,40 @@ def _default_root() -> Config:
         # spans.py — in-memory ring + optional --trace-file JSONL; a
         # deque append per span, cheap enough to stay on by default)
         "trace": {"run": False, "timings": False, "spans": True},
+        # model-health observability (veles_tpu/telemetry/tensormon.py
+        # + recorder.py, docs/observability.md "Model health")
+        "telemetry": {
+            # in-graph tensor-statistics taps on the fused train step.
+            # OFF by default: the off path is bit-identical to a build
+            # without the feature (locked by tests/test_tensormon.py)
+            "tensormon": {
+                "enabled": False,
+                # host-side observation cadence: process every Nth
+                # drained sample (the device accumulators always ride
+                # the existing per-epoch metric drain — zero extra
+                # host syncs either way); NaN detection runs on every
+                # sample regardless
+                "every": 1,
+                # NaN/Inf sentinel: warn | halt | snapshot_and_halt
+                "nan_policy": "warn",
+                # |activation| at/above this counts as saturated
+                "sat_threshold": 6.0,
+            },
+            # flight recorder (crash black box): bounded in-memory ring
+            # subscribed to span closes, alarm-counter increments,
+            # logger events, health transitions and tensormon samples
+            "recorder": {
+                "enabled": True,
+                "capacity": 4096,
+                # dump blackbox-<ts>.jsonl on unhandled Workflow.run
+                # exceptions / watchdog trips / SIGTERM (the NaN
+                # sentinel's halt policies always dump)
+                "autodump": False,
+                # additionally record any single counter increment of
+                # at least this value (0 = alarm counters only)
+                "counter_threshold": 0,
+            },
+        },
         # resilience subsystem (veles_tpu/resilience/, docs/resilience.md)
         "resilience": {
             # fault-injection spec (point:action[:k=v,...];...);

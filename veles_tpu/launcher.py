@@ -209,6 +209,12 @@ class Launcher(Logger):
 
     def run(self) -> Dict[str, Any]:
         from .resilience.health import heartbeats
+        from .telemetry.recorder import flight
+        # preemption forensics: a SIGTERM (the k8s/preemption kill)
+        # dumps the flight recorder before the previous disposition
+        # runs — only when autodump is armed (crash_dump gates itself)
+        if flight.autodump_enabled():
+            flight.install_sigterm()
         self._start_time = time.time()
         heartbeats.beat("launcher")
         self.event("launcher.work", "begin")

@@ -25,6 +25,16 @@ _event_file = None
 _event_path: Optional[str] = None
 _file_handler: Optional[logging.FileHandler] = None
 
+#: event observers installed by the flight recorder
+#: (telemetry/recorder.py): called with each record AFTER the event
+#: lock is released; exceptions swallowed.
+_event_hooks = []
+
+
+def add_event_hook(fn) -> None:
+    if fn not in _event_hooks:
+        _event_hooks.append(fn)
+
 
 def setup_logging(level: int = logging.INFO, logfile: Optional[str] = None,
                   tracefile: Optional[str] = None) -> None:
@@ -115,6 +125,11 @@ class Logger:
             if _event_file is not None:
                 _event_file.write(json.dumps(rec, default=str) + "\n")
                 _event_file.flush()
+        for hook in _event_hooks:
+            try:
+                hook(rec)
+            except Exception:       # noqa: BLE001 — observers only
+                pass
 
 
 class SpanTimer:

@@ -119,6 +119,12 @@ class TrainStep(AcceleratedUnit):
         #: ops/fused_fc.py whole-epoch kernel plan (engine.fused_fc_scan
         #: + strict eligibility, _setup_fused_fc); None = general path
         self._fused_fc = None
+        #: tensormon plan (telemetry/tensormon.py, resolved at
+        #: initialize from root.common.telemetry.tensormon): None = no
+        #: taps — the step traces EXACTLY as a build without the
+        #: feature (bit-identical state trees, same dispatch count,
+        #: locked by tests/test_tensormon.py)
+        self._tensormon = None
         #: (stacked device accums, H) from the last block dispatch —
         #: converted to per-epoch dicts lazily in drain_epoch_blocks
         self._block_metrics = None
@@ -179,6 +185,12 @@ class TrainStep(AcceleratedUnit):
         # Config.get treats auto-vivified empty nodes as unset
         self.mixed_precision = bool(
             root.common.engine.get("mixed_precision", False))
+        # model-health taps (telemetry/tensormon.py): resolved ONCE
+        # here — the flag keys what the jitted step traces, so a
+        # mid-run config flip must not desync the jit cache
+        from ..telemetry import tensormon
+        self._tensormon = tensormon.settings() if tensormon.enabled() \
+            else None
         if self.target_mode == "auto":
             # resolvable only now: the loader's load_data has run
             has_t = getattr(self.loader, "original_targets", None)
@@ -252,6 +264,10 @@ class TrainStep(AcceleratedUnit):
         if self.mixed_precision or self.remat \
                 or self.grad_accumulation > 1:
             return reject("amp/remat/grad-accumulation not fused")
+        if self._tensormon is not None:
+            return reject("tensormon taps are not computed by the "
+                          "fused kernel (disable telemetry.tensormon "
+                          "or fused_fc_scan)")
         if self._pp is not None or self._pp_hetero is not None:
             return reject("pipeline mesh not fused")
         if isinstance(self.device, XLADevice) \
@@ -693,6 +709,14 @@ class TrainStep(AcceleratedUnit):
         metrics = self.evaluator.metrics_fn(out, tgt, mask)
         metrics["sum_loss"] = loss * self.evaluator.sum_loss_weight(
             out, mask)
+        if self._tensormon is not None:
+            # auxiliary tensor taps (telemetry/tensormon.py): pure
+            # scalars over values this step already computed — extra
+            # accumulator outputs, zero extra dispatches or host syncs
+            from ..telemetry import tensormon
+            metrics.update(tensormon.step_stats(
+                params, new_params, grads, loss, out,
+                self._tensormon["sat_threshold"]))
         accum = jax.tree_util.tree_map(
             lambda a, m: a + m, accum,
             {k: metrics[k] for k in accum})
@@ -738,6 +762,13 @@ class TrainStep(AcceleratedUnit):
         import jax
         import jax.numpy as jnp
         ga = self.grad_accumulation
+        # the monitor's aux entries accumulate from the FINAL aggregate
+        # (mean gradient + the one applied update), not per chunk —
+        # split them out so the chunk scan carries the classic key set
+        mon_zero = {k: v for k, v in accum.items()
+                    if k.startswith("mon_")}
+        accum = {k: v for k, v in accum.items()
+                 if not k.startswith("mon_")}
         batch = self._gather(dataset, indices)
         aug = getattr(self.loader, "device_augment_fn", None)
         if aug is not None:
@@ -792,6 +823,13 @@ class TrainStep(AcceleratedUnit):
         new_params, new_opt = self._apply_updates(params, grads,
                                                   opt_state, lr_scale,
                                                   valid)
+        if mon_zero:
+            from ..telemetry import tensormon
+            stats = tensormon.step_stats(
+                params, new_params, grads, l_sum / total, None,
+                self._tensormon["sat_threshold"])
+            accum = dict(accum)
+            accum.update({k: mon_zero[k] + stats[k] for k in mon_zero})
         return new_params, new_opt, accum, l_sum / total
 
     def _train_plan_fn(self, params, opt_state, accum, dataset, labels,
@@ -846,7 +884,10 @@ class TrainStep(AcceleratedUnit):
         accum, _ = jax.lax.scan(body, accum, (idx_plan, mask_plan))
         return accum
 
-    def _make_zero_accum(self):
+    def _make_zero_accum(self, mon: bool = False):
+        """``mon=True`` (train contexts with tensormon enabled) adds
+        the monitor's auxiliary accumulator entries — eval accums and
+        monitoring-off runs carry exactly the classic key set."""
         import jax.numpy as jnp
         from .evaluator import EvaluatorSoftmaxSeq
         zeros = {"n_samples": jnp.zeros((), jnp.float32),
@@ -856,6 +897,9 @@ class TrainStep(AcceleratedUnit):
             zeros["n_err"] = jnp.zeros((), jnp.float32)
         else:
             zeros["sum_sq"] = jnp.zeros((), jnp.float32)
+        if mon and self._tensormon is not None:
+            from ..telemetry import tensormon
+            zeros.update(tensormon.zero_stats(sorted(self.params)))
         return zeros
 
     # -- execution -----------------------------------------------------------
@@ -967,7 +1011,8 @@ class TrainStep(AcceleratedUnit):
                 # mean (same scale, logging-only)
                 return (p, o), (outs, loss_sum / n)
             p, o, acc_tr, loss = self._train_plan_fn(
-                p, o, self._make_zero_accum(), dataset, labels, targets,
+                p, o, self._make_zero_accum(mon=True), dataset, labels,
+                targets,
                 per_epoch["c%d_idx" % TRAIN],
                 per_epoch["c%d_mask" % TRAIN],
                 per_epoch["lr"], e_rng)
@@ -1056,7 +1101,13 @@ class TrainStep(AcceleratedUnit):
 
     def drain_epoch_blocks(self) -> List[Dict[int, Dict[str, float]]]:
         """Per-epoch metric dicts since the last drain: H entries after
-        a block dispatch, one entry in the classic per-epoch mode."""
+        a block dispatch, one entry in the classic per-epoch mode.
+        When tensormon is on, the monitor's auxiliary entries ride this
+        SAME drain (zero extra host syncs), are stripped before the
+        Decision sees the dicts, and the NaN sentinel may raise
+        :class:`~veles_tpu.telemetry.tensormon.ModelHealthError` here —
+        on the scheduler path, exactly where a crashed dispatch would
+        have surfaced."""
         if self._block_metrics is not None:
             import jax
             from ..telemetry.counters import inc
@@ -1065,11 +1116,17 @@ class TrainStep(AcceleratedUnit):
             host = jax.device_get(stacked)
             inc("veles_d2h_bytes_total",
                 sum(a.nbytes for a in jax.tree_util.tree_leaves(host)))
-            return [
+            entries = [
                 {cls: {k: float(v[e]) for k, v in acc.items()}
                  for cls, acc in host.items()}
                 for e in range(h)]
-        return [self.drain_epoch_metrics()]
+        else:
+            entries = [self.drain_epoch_metrics()]
+        if self._tensormon is not None:
+            from ..telemetry import tensormon
+            for mon in tensormon.extract_mon(entries, TRAIN):
+                tensormon.monitor.observe(self, mon)
+        return entries
 
     def cost_report(self):
         """Telemetry cost of every program this unit has dispatched
@@ -1118,7 +1175,8 @@ class TrainStep(AcceleratedUnit):
         accum = self._accum.get(cls)
         if accum is None:
             # fresh zeros per class: accum buffers are donated to the step
-            accum = self._accum[cls] = self._make_zero_accum()
+            accum = self._accum[cls] = self._make_zero_accum(
+                mon=(cls == TRAIN and not self.evaluation_mode))
         dataset, labels, targets, indices, mask = self._inputs()
         planned = self.loader.plan_steps > 1
         if cls == TRAIN and not self.evaluation_mode:

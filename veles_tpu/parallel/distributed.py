@@ -188,6 +188,15 @@ def step_watchdog(name: str = "step", timeout: float = 0.0,
                 Logger().warning(
                     "watchdog trip on span %r: %.2fs (mean %.2fs + "
                     "3σ %.2fs) — possible hang", name, dt, mean, 3 * std)
+                # a trip is a pre-crash signal: capture the flight
+                # recorder's last-seconds window while the process is
+                # still alive (autodump-gated, never raises)
+                from ..telemetry.recorder import flight
+                flight.note("watchdog.trip", span=name,
+                            seconds=round(dt, 3),
+                            threshold=round(float(threshold), 3))
+                flight.crash_dump("watchdog trip on %r (%.2fs)"
+                                  % (name, dt))
         history.append(dt)
 
 

@@ -87,6 +87,12 @@ for _name, _desc in (
                        "task executes (overlap/executor.py)"),
     ("prefetch.batch", "prefetch producer, before each staged batch "
                        "(overlap/prefetch.py)"),
+    # model-health observability (telemetry/recorder.py): chaos for
+    # the crash black box itself — raise/crash while dumping, or
+    # corrupt the written blackbox-*.jsonl bytes
+    ("recorder.dump", "FlightRecorder.dump, before the black-box "
+                      "file is written (corrupt: damage the dump "
+                      "bytes)"),
 ):
     register_point(_name, _desc)
 

@@ -38,8 +38,26 @@ from typing import Any, Dict, List, Optional
 
 from .counters import (counters, describe_counter, inc,          # noqa: F401
                        prometheus_text, snapshot)
+# the flight-recorder MODULE must import before the span-recorder
+# INSTANCE below: loading a submodule binds the package attribute
+# ``recorder`` to the module; the next line deliberately rebinds it to
+# the SpanRecorder instance (the long-standing export). Import the
+# flight recorder by full path: veles_tpu.telemetry.recorder
+from .recorder import FlightRecorder, flight                      # noqa: F401
 from .spans import span, spanned, SpanRecorder, recorder          # noqa: F401
 from .cost import Cost, CostModel, peak_bf16_flops                # noqa: F401
+from .tensormon import (ModelHealthError, TensorMonitor,          # noqa: F401
+                        monitor)
+
+#: every counter the model-health plane increments — registered with
+#: HELP strings in counters.DESCRIPTIONS and asserted zero in
+#: monitoring-off runs by ``python bench.py gate``'s tensormon section
+TENSORMON_COUNTERS = (
+    "veles_tensormon_samples_total",
+    "veles_model_nan_total",
+    "veles_model_health_errors_total",
+    "veles_blackbox_dumps_total",
+)
 
 #: default gate rules: counter key → max allowed current/baseline
 #: ratio; 1.0 means "may not grow at all". Only WINDOW-INDEPENDENT

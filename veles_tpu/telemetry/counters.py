@@ -74,11 +74,35 @@ DESCRIPTIONS = {
         "Prefetcher gets that had to wait for the producer",
     "veles_prefetch_stall_seconds_total":
         "Seconds consumers waited on the prefetch queue",
+    # model-health observability (telemetry/tensormon.py +
+    # telemetry/recorder.py): bench.py's gate asserts the sample/NaN
+    # counters read 0 in tensormon-off runs
+    "veles_tensormon_samples_total":
+        "Tensor-statistics samples drained from the jitted train step",
+    "veles_model_nan_total":
+        "Non-finite (NaN/Inf) values detected in gradients, loss or "
+        "activations by the tensormon taps",
+    "veles_model_health_errors_total":
+        "ModelHealthError raised by the NaN sentinel (halt policies)",
+    "veles_blackbox_dumps_total":
+        "Flight-recorder black-box dumps written",
 }
 
 
 def describe_counter(name: str) -> str:
     return DESCRIPTIONS.get(name, "veles_tpu counter")
+
+
+#: increment observers installed by the flight recorder
+#: (telemetry/recorder.py): called as ``hook(name, value, new_total)``
+#: AFTER the registry lock is released, exceptions swallowed — an
+#: observer can never deadlock or take an instrumented call site down.
+_inc_hooks = []
+
+
+def add_inc_hook(fn) -> None:
+    if fn not in _inc_hooks:
+        _inc_hooks.append(fn)
 
 
 class CounterRegistry:
@@ -93,7 +117,12 @@ class CounterRegistry:
         with self._lock:
             new = self._values.get(name, 0) + value
             self._values[name] = new
-            return new
+        for hook in _inc_hooks:
+            try:
+                hook(name, value, new)
+            except Exception:       # noqa: BLE001 — observers only
+                pass
+        return new
 
     def get(self, name: str) -> float:
         with self._lock:

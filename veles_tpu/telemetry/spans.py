@@ -46,6 +46,16 @@ SPAN_COUNTERS = ("veles_dispatches_total", "veles_compiles_total",
 
 _ids = itertools.count(1)
 
+#: span-close observers installed by the flight recorder
+#: (telemetry/recorder.py): called with the completed record AFTER the
+#: ring lock is released; exceptions swallowed.
+_close_hooks = []
+
+
+def add_close_hook(fn) -> None:
+    if fn not in _close_hooks:
+        _close_hooks.append(fn)
+
 
 def _enabled() -> bool:
     """THE span on/off switch (``root.common.trace.spans``), honored
@@ -147,6 +157,11 @@ class SpanRecorder:
             self._ring.append(rec)
             if self._file is not None:
                 self._file.write(json.dumps(rec, default=str) + "\n")
+        for hook in _close_hooks:
+            try:
+                hook(rec)
+            except Exception:       # noqa: BLE001 — observers only
+                pass
         return rec
 
     # -- introspection -------------------------------------------------------
@@ -209,8 +224,12 @@ def spanned(name: Optional[str] = None, **attrs: Any):
 
 def read_jsonl(path: str) -> List[Dict[str, Any]]:
     """Load span records back from a JSONL file (skips lines that are
-    not span records, so a file shared with logger events loads too)."""
+    not span records, so a file shared with logger events loads too).
+    Lines that fail to parse at all — a mid-write-truncated tail, a
+    torn append — are skipped with ONE counted warning instead of
+    raising: a partially-written trace must still export."""
     out = []
+    bad = 0
     with open(path) as f:
         for line in f:
             line = line.strip()
@@ -219,9 +238,15 @@ def read_jsonl(path: str) -> List[Dict[str, Any]]:
             try:
                 rec = json.loads(line)
             except ValueError:
+                bad += 1
                 continue
             if isinstance(rec, dict) and "name" in rec and "ts" in rec:
                 out.append(rec)
+    if bad:
+        import logging
+        logging.getLogger("veles_tpu.telemetry").warning(
+            "skipped %d malformed JSONL line(s) in %s (empty or "
+            "mid-write truncated records)", bad, path)
     return out
 
 

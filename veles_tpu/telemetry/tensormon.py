@@ -1,0 +1,364 @@
+"""In-graph model-health monitoring: tensor taps + NaN sentinel.
+
+The host-side telemetry (counters/spans/cost) sees what the framework
+*does*; this module sees what the model *computes* — exploding
+gradients, NaN/Inf poisoning, saturated activations — without a single
+extra per-step host sync. Following the compiler-first discipline
+(PAPERS.md "Compiler-First State Space Duality"): the statistics are
+pure jax scalars computed as auxiliary outputs of the EXISTING jitted
+train step (``nn/train_step.py`` merges them into the metric
+accumulators it already scans on device), and they reach the host by
+riding the per-epoch metric drain that happens anyway. With
+``root.common.telemetry.tensormon.enabled = False`` (the default) the
+step function is bit-identical and the dispatch count unchanged —
+locked by ``tests/test_tensormon.py``.
+
+Per drained sample the monitor derives:
+
+- global gradient L2 norm (per-step RMS over the drained window);
+- per-layer weight norms and update/weight ratios (the classic
+  learning-rate sanity signal);
+- NaN/Inf counts over gradients, loss and head activations;
+- activation saturation fraction (``|x| >= sat_threshold``).
+
+These stream to the span/trace file (``tensormon.sample`` spans — so
+Perfetto timelines carry model health), the flight recorder
+(:mod:`~veles_tpu.telemetry.recorder`), and ``web_status`` ``/metrics``
+as ``veles_model_*`` gauges; NaN detections increment
+``veles_model_nan_total``.
+
+The **NaN sentinel** (``root.common.telemetry.tensormon.nan_policy``)
+bridges into the resilience plane on detection:
+
+- ``warn``              — log + count, training continues;
+- ``halt``              — mark ``model_health`` unready (/readyz 503),
+  dump the flight recorder, raise :class:`ModelHealthError`;
+- ``snapshot_and_halt`` — additionally force a Snapshotter commit
+  through the crash-safe checkpoint chain first, so the poisoned state
+  is on disk for forensics.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..config import root
+from ..error import VelesError
+from .counters import inc
+
+
+class ModelHealthError(VelesError):
+    """Raised by the NaN sentinel (policy ``halt`` /
+    ``snapshot_and_halt``) when non-finite values are detected inside
+    the train step."""
+
+
+#: accepted nan_policy values
+POLICIES = ("warn", "halt", "snapshot_and_halt")
+
+#: key prefix of the monitor's auxiliary accumulator entries — the
+#: train step creates/merges them only when monitoring is enabled and
+#: strips them back out of the drained metrics before the Decision
+#: sees them
+MON_PREFIX = "mon_"
+
+
+def enabled() -> bool:
+    """THE tensormon on/off switch
+    (``root.common.telemetry.tensormon.enabled``, default False)."""
+    try:
+        return bool(root.common.telemetry.tensormon.get("enabled",
+                                                        False))
+    except Exception:        # noqa: BLE001 — config not importable
+        return False
+
+
+def settings() -> Dict[str, Any]:
+    """Resolved monitoring knobs (validated); raises on a bad policy so
+    a typo'd config fails at initialize, not at the first NaN."""
+    node = root.common.telemetry.tensormon
+    policy = str(node.get("nan_policy", "warn") or "warn")
+    if policy not in POLICIES:
+        raise VelesError(
+            "root.common.telemetry.tensormon.nan_policy %r is not one "
+            "of %s" % (policy, "/".join(POLICIES)))
+    sat = node.get("sat_threshold", 6.0)
+    return {
+        "every": max(1, int(node.get("every", 1) or 1)),
+        "policy": policy,
+        # no `or`-coercion: an explicit 0 threshold (count everything
+        # as saturated — a wiring check) must survive
+        "sat_threshold": float(6.0 if sat is None else sat),
+    }
+
+
+# -- the pure (traced) side ----------------------------------------------------
+
+def zero_stats(layer_names) -> Dict[str, Any]:
+    """Zero accumulator entries matching :func:`step_stats`'s keys —
+    what ``TrainStep._make_zero_accum`` merges in when monitoring is
+    on. All float32 scalars, all sum-accumulable."""
+    import jax.numpy as jnp
+
+    def z():
+        return jnp.zeros((), jnp.float32)
+
+    out = {"mon_steps": z(), "mon_nan": z(), "mon_grad_sq": z(),
+           "mon_sat": z(), "mon_act_n": z()}
+    for name in sorted(layer_names):
+        out["mon_wsq/%s" % name] = z()
+        out["mon_usq/%s" % name] = z()
+    return out
+
+
+def step_stats(params, new_params, grads, loss, out=None,
+               sat_threshold: float = 6.0) -> Dict[str, Any]:
+    """Pure jax tensor statistics for ONE optimizer step — auxiliary
+    outputs of the fused train step, accumulated on device by the same
+    scan that carries the loss metrics. ``out`` is the head activation
+    tensor when available (the gradient-accumulation path passes None:
+    its chunk outputs live inside the scan; saturation reads 0 there).
+    Sums only, so the uniform ``a + m`` accumulator merge applies."""
+    import jax
+    import jax.numpy as jnp
+    f32 = jnp.float32
+
+    def sumsq(tree):
+        leaves = jax.tree_util.tree_leaves(tree)
+        if not leaves:
+            return jnp.zeros((), f32)
+        return sum(jnp.sum(jnp.square(leaf.astype(f32)))
+                   for leaf in leaves)
+
+    def nonfinite(tree):
+        leaves = jax.tree_util.tree_leaves(tree)
+        if not leaves:
+            return jnp.zeros((), f32)
+        return sum(jnp.sum((~jnp.isfinite(leaf.astype(f32))).astype(f32))
+                   for leaf in leaves)
+
+    loss32 = jnp.asarray(loss, f32)
+    stats = {
+        "mon_steps": jnp.ones((), f32),
+        "mon_grad_sq": sumsq(grads),
+        "mon_nan": nonfinite(grads)
+        + (~jnp.isfinite(loss32)).astype(f32),
+    }
+    if out is not None:
+        a = jnp.abs(out.astype(f32))
+        stats["mon_sat"] = jnp.sum((a >= sat_threshold).astype(f32))
+        stats["mon_act_n"] = jnp.asarray(float(out.size), f32)
+        stats["mon_nan"] = stats["mon_nan"] + nonfinite(out)
+    else:
+        stats["mon_sat"] = jnp.zeros((), f32)
+        stats["mon_act_n"] = jnp.zeros((), f32)
+    for name in sorted(params):
+        stats["mon_wsq/%s" % name] = sumsq(new_params[name])
+        upd = jax.tree_util.tree_map(
+            lambda new, old: new.astype(f32) - old.astype(f32),
+            new_params[name], params[name])
+        stats["mon_usq/%s" % name] = sumsq(upd)
+    return stats
+
+
+# -- the host side -------------------------------------------------------------
+
+def _safe(name: str) -> str:
+    return re.sub(r"[^A-Za-z0-9_]", "_", name)
+
+
+class TensorMonitor:
+    """Host-side consumer of drained monitor accumulators: derives the
+    human/Prometheus-facing statistics, runs the NaN sentinel, and
+    feeds spans + flight recorder. One process-global instance
+    (:data:`monitor`)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._samples = 0
+        self._last: Dict[str, Any] = {}
+        self._layers: Dict[str, Dict[str, float]] = {}
+
+    # -- observation ---------------------------------------------------------
+    def observe(self, step_unit, mon: Dict[str, float]) -> None:
+        """Process one drained sample (host floats keyed ``mon_*``).
+        May raise :class:`ModelHealthError` per the sentinel policy —
+        callers sit on the scheduler path, exactly where a crashed
+        dispatch would have surfaced."""
+        cfg = getattr(step_unit, "_tensormon", None) or {}
+        every = max(1, int(cfg.get("every", 1)))
+        steps = max(float(mon.get("mon_steps", 0.0)), 1.0)
+        nan = float(mon.get("mon_nan", 0.0))
+        act_n = float(mon.get("mon_act_n", 0.0))
+        summary = {
+            "grad_norm": math.sqrt(
+                max(float(mon.get("mon_grad_sq", 0.0)), 0.0) / steps),
+            "nan": nan,
+            "act_saturation": (float(mon.get("mon_sat", 0.0)) / act_n
+                               if act_n else 0.0),
+            "steps": steps,
+        }
+        layers: Dict[str, Dict[str, float]] = {}
+        for key, val in mon.items():
+            if not key.startswith("mon_wsq/"):
+                continue
+            name = key[len("mon_wsq/"):]
+            wnorm = math.sqrt(max(float(val), 0.0) / steps)
+            unorm = math.sqrt(
+                max(float(mon.get("mon_usq/%s" % name, 0.0)), 0.0)
+                / steps)
+            layers[name] = {
+                "weight_norm": wnorm,
+                "update_ratio": (unorm / wnorm) if wnorm else 0.0,
+            }
+        with self._lock:
+            self._samples += 1
+            n = self._samples
+            self._last = dict(summary)
+            self._layers = layers
+        inc("veles_tensormon_samples_total")
+        if n % every == 0:
+            # zero-duration span: the sample lands in the span ring and
+            # the --trace-file stream, so Perfetto timelines carry
+            # model health next to the dispatch spans
+            from .spans import span
+            attrs = {k: round(v, 6) if isinstance(v, float) else v
+                     for k, v in summary.items()}
+            with span("tensormon.sample", **attrs):
+                pass
+            from .recorder import flight
+            flight.note("tensormon", **summary)
+        if nan > 0:
+            inc("veles_model_nan_total", nan)
+            self._sentinel(step_unit, cfg, summary)
+
+    # -- sentinel ------------------------------------------------------------
+    def _sentinel(self, step_unit, cfg: Dict[str, Any],
+                  summary: Dict[str, Any]) -> None:
+        import logging
+        policy = str(cfg.get("policy", "warn"))
+        log = logging.getLogger("veles_tpu.telemetry")
+        from .recorder import flight
+        flight.note("tensormon.nan", policy=policy, **summary)
+        log.warning(
+            "tensormon: %d non-finite value(s) in the train step "
+            "(grad_norm=%s, policy=%s)", int(summary["nan"]),
+            summary["grad_norm"], policy)
+        if policy == "warn":
+            return
+        # halt policies: the model is poisoned — readiness drops first
+        # so load balancers stop routing, then the black box and (for
+        # snapshot_and_halt) the forensic checkpoint land on disk,
+        # then the typed error unwinds the scheduler
+        from ..resilience.health import mark_unready
+        mark_unready("model_health")
+        inc("veles_model_health_errors_total")
+        snap_path: Optional[str] = None
+        if policy == "snapshot_and_halt":
+            snap = self._find_snapshotter(step_unit)
+            if snap is None:
+                log.warning("tensormon: snapshot_and_halt but the "
+                            "workflow has no Snapshotter unit — "
+                            "halting without a forensic checkpoint")
+            else:
+                try:
+                    path = snap.export()
+                    # async mode: export() only ENQUEUES the commit —
+                    # surface a failed commit instead of pointing the
+                    # operator at a file that was never written
+                    errors = snap.drain(raise_errors=False)
+                    if errors:
+                        raise errors[0]
+                    snap_path = path
+                except Exception as e:    # noqa: BLE001 — still halt
+                    log.warning("tensormon: forensic snapshot failed "
+                                "(%s: %s)", type(e).__name__, e)
+        try:
+            dump_path = flight.dump(
+                "nan sentinel: %d non-finite value(s), policy=%s"
+                % (int(summary["nan"]), policy))
+        except Exception:        # noqa: BLE001 — never mask the halt
+            dump_path = None
+        raise ModelHealthError(
+            "non-finite values detected in the train step (%d NaN/Inf; "
+            "grad_norm=%s). Model health is unready; %s%s"
+            % (int(summary["nan"]), summary["grad_norm"],
+               ("forensic snapshot: %s; " % snap_path) if snap_path
+               else "",
+               ("black box: %s" % dump_path) if dump_path
+               else "no black box written"))
+
+    @staticmethod
+    def _find_snapshotter(step_unit):
+        from ..snapshotter import Snapshotter
+        wf = getattr(step_unit, "workflow", None)
+        snap = getattr(wf, "snapshotter", None)
+        if isinstance(snap, Snapshotter):
+            return snap
+        for unit in getattr(wf, "units", []) or []:
+            if isinstance(unit, Snapshotter):
+                return unit
+        return None
+
+    # -- export --------------------------------------------------------------
+    def gauges(self) -> Dict[str, Any]:
+        """``/metrics`` gauge rows (name → (value, help)); empty until
+        the first sample so monitoring-off processes render no
+        ``veles_model_*`` rows at all."""
+        with self._lock:
+            last = dict(self._last)
+            layers = {k: dict(v) for k, v in self._layers.items()}
+        if not last:
+            return {}
+        out = {
+            "veles_model_grad_norm": (
+                last["grad_norm"],
+                "Global gradient L2 norm (per-step RMS, last sample)"),
+            "veles_model_act_saturation": (
+                last["act_saturation"],
+                "Fraction of head activations at/above sat_threshold"),
+        }
+        for name, vals in sorted(layers.items()):
+            safe = _safe(name)
+            out["veles_model_weight_norm_" + safe] = (
+                vals["weight_norm"],
+                "Weight L2 norm of layer " + name)
+            out["veles_model_update_ratio_" + safe] = (
+                vals["update_ratio"],
+                "Update/weight norm ratio of layer " + name)
+        return out
+
+    def last_sample(self) -> Dict[str, Any]:
+        with self._lock:
+            return dict(self._last)
+
+    def reset(self) -> None:
+        """Tests only."""
+        with self._lock:
+            self._samples = 0
+            self._last = {}
+            self._layers = {}
+
+
+#: THE process-global monitor (mirrors counters.counters)
+monitor = TensorMonitor()
+
+
+def extract_mon(entries: List[Dict[int, Dict[str, float]]],
+                train_cls: int) -> List[Dict[str, float]]:
+    """Pop ``mon_*`` keys out of drained per-epoch metric dicts (in
+    place) and return them as one sample per epoch — the Decision must
+    never see the monitor's auxiliary accumulators."""
+    samples: List[Dict[str, float]] = []
+    for entry in entries:
+        metrics = entry.get(train_cls)
+        if not metrics:
+            continue
+        mon = {k: metrics.pop(k) for k in list(metrics)
+               if k.startswith(MON_PREFIX)}
+        if mon:
+            samples.append(mon)
+    return samples

@@ -218,6 +218,12 @@ class WebStatusServer(Logger):
                         gauges["veles_sideplane_queue_depth_" + safe] = (
                             st["depth"],
                             "Tasks queued on side-plane lane " + lane)
+                    # model-health gauges (telemetry/tensormon.py):
+                    # grad norm, per-layer update ratios, activation
+                    # saturation — empty until the first drained
+                    # sample, so monitoring-off runs render no rows
+                    from .telemetry.tensormon import monitor as _tm
+                    gauges.update(_tm.gauges())
                     text = metrics_text(gauges)
                     bytes_reply(self, 200, text.encode(),
                                 METRICS_CONTENT_TYPE)

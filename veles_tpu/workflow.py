@@ -176,6 +176,20 @@ class Workflow(Unit):
                     % (len(errors), self.name,
                        type(errors[0]).__name__, errors[0]),
                     errors) from errors[0]
+        except Exception as exc:
+            # crash black box (telemetry/recorder.py): the ring holds
+            # the final seconds of spans/events/alarm counters —
+            # crash_dump honors the autodump knob and never raises,
+            # so the original exception always propagates. The NaN
+            # sentinel dumps before raising ModelHealthError; a second
+            # dump here would land on the same <ts>_<pid> name and
+            # overwrite the sentinel's header reason
+            from .telemetry.recorder import flight
+            from .telemetry.tensormon import ModelHealthError
+            if not isinstance(exc, ModelHealthError):
+                flight.crash_dump("workflow.run %s: %s: %s" % (
+                    self.name, type(exc).__name__, exc))
+            raise
         finally:
             if self.side_plane is not None:
                 # on the exception path too, nothing may stay in
