@@ -542,6 +542,10 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # MUST be zero — taps leaking into an unmonitored step would
         # break the bit-identical-off contract
         "tensormon": _tensormon_section(),
+        # continuous-batching serving accounting (veles_tpu/serving/):
+        # the bench never serves, so every serving counter MUST read
+        # zero here — the gate fails on leakage
+        "serving": _serving_section(),
         "extras": [ae, lm],
     }
 
@@ -560,6 +564,27 @@ def _overlap_section():
         "stall_seconds": round(
             counters.get("veles_sideplane_stall_seconds_total")
             + counters.get("veles_prefetch_stall_seconds_total"), 6),
+    }
+
+
+def _serving_section():
+    """{engine, admitted, tokens, decode_dispatches, prefill_dispatches,
+    expired} for this bench process — absolute counter reads (one
+    process, counters start at zero). The bench itself never serves, so
+    a non-zero read here means serving-engine work leaked into a
+    training measurement — ``bench.py gate`` fails on it."""
+    from veles_tpu.config import root as vt_root
+    from veles_tpu.telemetry.counters import counters
+    return {
+        "engine": str(vt_root.common.serving.get("engine",
+                                                 "continuous")),
+        "admitted": int(counters.get("veles_serving_admitted_total")),
+        "tokens": int(counters.get("veles_serving_tokens_total")),
+        "decode_dispatches": int(
+            counters.get("veles_serving_decode_dispatches_total")),
+        "prefill_dispatches": int(
+            counters.get("veles_serving_prefill_dispatches_total")),
+        "expired": int(counters.get("veles_serving_expired_total")),
     }
 
 
@@ -832,6 +857,153 @@ def _overlap_stall_proof():
     return failures
 
 
+def gate_serving(baseline_doc=None, current_doc=None):
+    """``serving`` gate section: (1) the continuous-batching counters
+    must be registered; (2) bench documents must carry ZERO serving
+    activity — the bench never serves, so a non-zero count means
+    engine work leaked into a training measurement; (3) the clean gate
+    process itself must read zero before the proof; (4) live proof
+    that continuous batching strictly beats the window-coalescing
+    baseline on tokens/sec under a mixed-length concurrent load, with
+    greedy AND sampled rows id-exact vs their solo decodes and jit
+    programs bounded by len(buckets)+1."""
+    from veles_tpu.serving import SERVING_COUNTERS
+    from veles_tpu.telemetry.counters import DESCRIPTIONS, counters
+    failures = []
+    for name in SERVING_COUNTERS:
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "serving: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc),
+                     ("current", current_doc)):
+        sec = (doc or {}).get("serving")
+        if not sec:
+            continue
+        for key in ("admitted", "tokens", "decode_dispatches"):
+            if sec.get(key):
+                failures.append(
+                    "serving: %s doc has %s=%s — serving-engine work "
+                    "leaked into a non-serving bench run"
+                    % (tag, key, sec[key]))
+    # the zero check must precede the live proof (which serves for
+    # real and legitimately moves every one of these counters)
+    for name in SERVING_COUNTERS:
+        value = counters.get(name)
+        if value:
+            failures.append(
+                "serving: %s = %s before any serving ran in this "
+                "process" % (name, value))
+    return failures + _serving_throughput_proof()
+
+
+def _serving_throughput_proof():
+    """Serve the same mixed-length concurrent load through the
+    window-coalescing baseline (the shipped batch_window worker
+    semantics: coalesce 20 ms, group by exact shape key, one batched
+    decode per group — mixed lengths degrade every group to a solo
+    decode) and through the continuous-batching engine (slot-pool
+    admission at chunk boundaries). Continuous must strictly win on
+    tokens/sec, every row must be id-exact vs its solo decode (greedy
+    AND sampled — the per-slot PRNG contract), and the engine may
+    build at most len(buckets)+1 jitted programs. Runs on the CPU
+    backend unless the caller pinned JAX_PLATFORMS."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import statistics as _stats
+    import time as _t
+    import numpy
+    import char_lm
+    import veles_tpu as vt
+    from veles_tpu import prng
+    from veles_tpu.nn import sampling
+    from veles_tpu.serving import ContinuousEngine
+    from veles_tpu.serving.engine import make_request
+
+    prng.seed_all(4242)
+    wf = char_lm.build_workflow(epochs=1, minibatch_size=32,
+                                n_blocks=1, dim=32, n_train=64,
+                                n_valid=32)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    # the mixed-length load the window coalescer is worst at: distinct
+    # (prompt length, n_new) shapes never share a batch key, so every
+    # request decodes solo; half the rows are stochastic
+    lengths = [5, 9, 14, 7, 12, 16, 6, 11, 13, 8, 15, 10, 5, 12, 9, 14]
+    n_news = [8, 12, 6, 10]
+    rng = numpy.random.RandomState(17)
+    reqs = []
+    for i, t_p in enumerate(lengths):
+        prompt = [int(t) for t in rng.randint(0, char_lm.VOCAB, t_p)]
+        reqs.append(make_request(
+            prompt, n_news[i % len(n_news)],
+            temperature=0.7 if i % 2 else 0.0, seed=100 + i))
+    total_tokens = sum(r["n_new"] for r in reqs)
+    failures = []
+    engine = ContinuousEngine(wf, max_slots=8, buckets=(8, 16),
+                              max_context=32, decode_block=8,
+                              name="bench.serving")
+    engine.start()
+    try:
+        # solo pass: warms every bucket program + the decode step AND
+        # yields the id-exactness reference
+        solo = [engine.serve([r])[0] for r in reqs]
+        # window-baseline warmup: one compile per distinct shape key
+        groups = {}
+        for r in reqs:
+            key = (len(r["prompt"]), r["n_new"], r["temperature"],
+                   r["seed"])
+            groups.setdefault(key, []).append(r)
+
+        def run_window_baseline():
+            _t.sleep(0.02)          # the shipped batch_window
+            out = []
+            for group in groups.values():
+                prompts = [g["prompt"] for g in group]
+                rows = sampling.generate(
+                    wf, prompts if len(prompts) > 1 else prompts[0],
+                    group[0]["n_new"],
+                    temperature=group[0]["temperature"],
+                    seed=group[0]["seed"])
+                out.extend(rows if len(prompts) > 1 else [rows])
+            return out
+
+        run_window_baseline()       # warm the per-shape executables
+        base_times, cont_times = [], []
+        for _ in range(3):
+            t0 = _t.time()
+            run_window_baseline()
+            base_times.append(_t.time() - t0)
+            t0 = _t.time()
+            conc = engine.serve(list(reqs))
+            cont_times.append(_t.time() - t0)
+        for i, (a, b) in enumerate(zip(solo, conc)):
+            if a != b:
+                failures.append(
+                    "serving: request %d (temp %.1f) not id-exact vs "
+                    "its solo decode under concurrent load"
+                    % (i, reqs[i]["temperature"]))
+                break
+        bound = len(engine.buckets) + 1
+        if engine.programs_built > bound:
+            failures.append(
+                "serving: engine built %d jitted programs, bound is "
+                "len(buckets)+1 = %d" % (engine.programs_built, bound))
+        base_tps = total_tokens / _stats.median(base_times)
+        cont_tps = total_tokens / _stats.median(cont_times)
+        if cont_tps <= base_tps:
+            failures.append(
+                "serving: continuous batching did not beat the window "
+                "baseline (%.0f vs %.0f tokens/sec)"
+                % (cont_tps, base_tps))
+        else:
+            print("serving proof: continuous %.0f tokens/sec vs "
+                  "window-coalescing %.0f (%.2fx), %d programs"
+                  % (cont_tps, base_tps, cont_tps / base_tps,
+                     engine.programs_built))
+    finally:
+        engine.stop()
+    return failures
+
+
 def gate_tensormon(baseline_doc=None, current_doc=None):
     """``tensormon`` gate section: (1) the model-health counters must
     be registered; (2) a monitoring-OFF bench document must carry ZERO
@@ -906,8 +1078,9 @@ def _recorder_overhead_proof():
 def _gate_main(argv):
     """``python bench.py gate BASELINE.json CURRENT.json`` — exit 1 on
     any counter regression, resilience-counter leakage, overlap stall
-    regression/leakage, tensormon-off leakage or recorder overhead
-    overrun."""
+    regression/leakage, tensormon-off leakage, recorder overhead
+    overrun, serving-counter leakage or a continuous-batching engine
+    that fails to beat the window-coalescing baseline."""
     if len(argv) != 2:
         print("usage: bench.py gate BASELINE.json CURRENT.json",
               file=sys.stderr)
@@ -918,14 +1091,16 @@ def _gate_main(argv):
         current = json.load(f)
     failures = (gate_docs(baseline, current) + gate_resilience()
                 + gate_overlap(baseline, current)
-                + gate_tensormon(baseline, current))
+                + gate_tensormon(baseline, current)
+                + gate_serving(baseline, current))
     for failure in failures:
         print("GATE FAIL %s" % failure, file=sys.stderr)
     if failures:
         return 1
     print("counter gate OK (%s vs %s; resilience counters clean, "
           "overlap stall proof passed, tensormon clean, recorder "
-          "overhead in budget)" % (argv[1], argv[0]))
+          "overhead in budget, serving counters clean + continuous "
+          "batching beats the window baseline)" % (argv[1], argv[0]))
     return 0
 
 

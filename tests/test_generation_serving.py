@@ -2,7 +2,12 @@
 REST with micro-batched concurrent requests — greedy/sample/
 speculative/beam end-to-end, answers identical to solo decodes
 (reference equivalent: veles/restful_api.py:78 serving one forward per
-request; here the serving batch axis carries whole decodes)."""
+request; here the serving batch axis carries whole decodes).
+
+This file pins ``engine="window"`` — it exercises the legacy
+shape-keyed coalescing worker (still the path for speculative/beam and
+for requests the slot pool cannot hold). The continuous-batching plane
+has its own suite in tests/test_serving_engine.py."""
 import json
 import threading
 import urllib.request
@@ -42,7 +47,8 @@ def served():
     draft.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
     draft.run()
     api = vt.GenerationAPI(target, draft=draft, port=0,
-                           batch_window=0.25, name="genapi")
+                           batch_window=0.25, engine="window",
+                           name="genapi")
     api.initialize()
     url = "http://127.0.0.1:%d/generate" % api.port
     yield lm, target, draft, api, url
@@ -159,11 +165,13 @@ def test_decoder_shape_errors_are_client_faults(served):
     assert "vocab" in out["error"]
 
 
-def test_concurrent_stochastic_requests_stay_seed_deterministic(served):
-    """Two simultaneous mode=sample requests with the same seed must
-    each get their SOLO decode (stochastic requests never coalesce —
-    batch-shaped PRNG streams would make answers depend on who else
-    arrived)."""
+def test_concurrent_stochastic_requests_coalesce_and_stay_seeded(served):
+    """Two simultaneous same-shape mode=sample requests COALESCE into
+    one batched decode (sampling.generate draws per-row PRNG streams,
+    so a row's noise is a pure function of its own seed) and each
+    still gets exactly its SOLO decode — the determinism contract the
+    old _solo singleton tag existed to protect, now held by
+    construction."""
     lm, target, draft, api, url = served
     from veles_tpu.nn import sampling
     p1, p2 = _prompt(lm, 21), _prompt(lm, 22)
@@ -171,6 +179,16 @@ def test_concurrent_stochastic_requests_stay_seed_deterministic(served):
                                  seed=5),
             1: sampling.generate(target, p2, 8, temperature=0.7,
                                  seed=5)}
+    # warm the (batch=2, t_p, n_new, temp) executable so the timed
+    # window isn't a compile
+    sampling.generate(target, [p1, p2], 8, temperature=0.7, seed=5)
+    # same shape key now that _solo is gone for mode=sample
+    assert api._batch_key(
+        {"mode": "sample", "prompt": p1, "n_new": 8,
+         "temperature": 0.7, "gamma": 4, "seed": 5}) == \
+        api._batch_key(
+        {"mode": "sample", "prompt": p2, "n_new": 8,
+         "temperature": 0.7, "gamma": 4, "seed": 5})
     results = {}
     barrier = threading.Barrier(2)
 
@@ -180,6 +198,7 @@ def test_concurrent_stochastic_requests_stay_seed_deterministic(served):
                                  "mode": "sample", "temperature": 0.7,
                                  "seed": 5})
 
+    before = api.batches_run
     threads = [threading.Thread(target=fire, args=(i, p))
                for i, p in ((0, p1), (1, p2))]
     for t in threads:
@@ -190,6 +209,21 @@ def test_concurrent_stochastic_requests_stay_seed_deterministic(served):
         code, out = results[i]
         assert code == 200, out
         assert out["tokens"] == want[i]
+    # the pair rode fewer batches than requests — coalescing happened
+    assert api.batches_run - before < 2
+
+
+def test_stochastic_speculative_still_runs_solo(served):
+    """generate_speculative's stochastic accept path draws
+    batch-shaped noise, so temperature>0 speculative requests keep the
+    _solo singleton tag — only mode=sample lost it."""
+    lm, target, draft, api, url = served
+    p = _prompt(lm, 23)
+    base = {"mode": "speculative", "prompt": p, "n_new": 8,
+            "temperature": 0.7, "gamma": 3, "seed": 5}
+    k1 = api._batch_key(api._parse(dict(base, prompt=list(p))))
+    k2 = api._batch_key(api._parse(dict(base, prompt=list(p))))
+    assert k1 != k2          # unique _solo per stochastic-spec request
 
 
 def test_speculative_without_draft_rejected(served):

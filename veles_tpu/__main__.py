@@ -49,6 +49,17 @@ def main(argv=None) -> int:
         parser.error("--serve-draft-snapshot needs --serve-draft")
     if args.serve_draft and args.serve_generate is None:
         parser.error("--serve-draft needs --serve-generate")
+    # serving knobs land in the config tree; GenerationAPI (and any
+    # programmatic ContinuousEngine) reads root.common.serving.*
+    from .config import root as _root
+    if args.serve_engine:
+        _root.common.serving.engine = args.serve_engine
+    if args.serve_slots is not None:
+        _root.common.serving.max_slots = args.serve_slots
+    if args.serve_buckets is not None:
+        _root.common.serving.buckets = args.serve_buckets
+    if args.serve_max_context is not None:
+        _root.common.serving.max_context = args.serve_max_context
     level = (logging.WARNING, logging.INFO,
              logging.DEBUG)[min(args.verbose, 2)]
     setup_logging(level=level, tracefile=args.trace_file)

@@ -10,15 +10,20 @@ from http.server import ThreadingHTTPServer
 from typing import Any, Dict, Optional
 
 
-def json_reply(handler, code: int, payload: Any) -> None:
+def json_reply(handler, code: int, payload: Any,
+               headers: Optional[Dict[str, str]] = None) -> None:
     data = json.dumps(payload).encode()
-    bytes_reply(handler, code, data, "application/json")
+    bytes_reply(handler, code, data, "application/json",
+                headers=headers)
 
 
-def bytes_reply(handler, code: int, data: bytes, ctype: str) -> None:
+def bytes_reply(handler, code: int, data: bytes, ctype: str,
+                headers: Optional[Dict[str, str]] = None) -> None:
     handler.send_response(code)
     handler.send_header("Content-Type", ctype)
     handler.send_header("Content-Length", str(len(data)))
+    for name, value in (headers or {}).items():
+        handler.send_header(name, value)
     handler.end_headers()
     handler.wfile.write(data)
 

@@ -94,6 +94,26 @@ def make_parser() -> argparse.ArgumentParser:
                         "greedy/sample/beam, micro-batched; + "
                         "speculative when --serve-draft is given); "
                         "0 picks an ephemeral port; Ctrl-C stops")
+    p.add_argument("--serve-engine", default=None,
+                   choices=("continuous", "window"),
+                   help="decode plane under --serve-generate: "
+                        "'continuous' (default) runs the slot-pool "
+                        "continuous-batching engine (greedy/sample "
+                        "requests share one fixed-shape decode step, "
+                        "admitted/retired per iteration); 'window' "
+                        "keeps the legacy shape-keyed micro-batcher")
+    p.add_argument("--serve-slots", type=int, default=None, metavar="N",
+                   help="KV-cache slot rows of the continuous-batching "
+                        "pool (root.common.serving.max_slots)")
+    p.add_argument("--serve-buckets", default=None, metavar="L1,L2,...",
+                   help="prefill pad-to lengths; the serving jit cache "
+                        "is bounded by len(buckets)+1 programs "
+                        "(root.common.serving.buckets)")
+    p.add_argument("--serve-max-context", type=int, default=None,
+                   metavar="T",
+                   help="per-slot KV capacity; requests need "
+                        "len(prompt)+n_new <= T to ride the slot pool "
+                        "(root.common.serving.max_context)")
     p.add_argument("--serve-draft", default=None, metavar="MODEL_PY",
                    help="draft model .py for mode=speculative under "
                         "--serve-generate (its build_workflow() is "

@@ -258,6 +258,27 @@ def _default_root() -> Config:
             "max_queue": 256,         # GenerationAPI queue bound
             "heartbeat_timeout": 300.0,
         },
+        # continuous-batching serving engine (veles_tpu/serving/,
+        # docs/services.md "Continuous batching"): GenerationAPI's
+        # decode plane — a persistent max_slots-row KV-cache pool with
+        # iteration-level scheduling. "window" falls back to the
+        # legacy shape-keyed coalescing worker.
+        "serving": {
+            "engine": "continuous",
+            # KV-cache slot rows decoded by the one fixed-shape step
+            "max_slots": 8,
+            # prefill pad-to lengths: jit cache is bounded by
+            # len(buckets)+1 programs, not by distinct prompt lengths
+            "buckets": [16, 32, 64, 128],
+            # per-row KV capacity; admission requires
+            # len(prompt) + n_new <= max_context (else the request
+            # falls back to the window path)
+            "max_context": 640,
+            # decode steps fused per dispatch (lax.scan): 1 = pure
+            # per-token scheduling; larger amortizes dispatch overhead
+            # at the cost of up to N-1 wasted row-steps per retirement
+            "decode_block": 1,
+        },
         # overlap engine (veles_tpu/overlap/, docs/overlap.md): async
         # side-plane for side-effect units, non-blocking checkpoints,
         # data-plane prefetch. Off by default — identical results

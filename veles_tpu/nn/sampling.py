@@ -212,11 +212,20 @@ def _build_sampler(wf, t_p, n_new, temperature):
             x = x + jnp.take(table, idx, axis=0, mode="clip")[None]
         return x
 
-    def sample(logits, key):
+    def sample(logits, keys):
+        """``logits`` (B, V), ``keys`` (B, 2): every row draws from its
+        OWN key, so a row's token depends only on (its seed, its
+        prompt) — never on batch size or on which strangers share the
+        dispatch. This is what lets the serving planes coalesce
+        ``mode=sample`` requests without breaking the same-request →
+        same-tokens contract (for B=1 the bits match the old
+        single-key path exactly: categorical noise of shape (1, V) and
+        (V,) draw the same stream)."""
         if greedy:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            key, logits / temperature, axis=-1).astype(jnp.int32)
+        return jax.vmap(
+            lambda k, row: jax.random.categorical(k, row / temperature)
+        )(keys, logits).astype(jnp.int32)
 
     def head_logits(params, x_last):
         return (jnp.dot(x_last, params[head.name]["weights"],
@@ -224,7 +233,7 @@ def _build_sampler(wf, t_p, n_new, temperature):
 
     @_count_decode_dispatches
     @jax.jit
-    def run(params, prompt_ids, key):
+    def run(params, prompt_ids, keys):
         b = prompt_ids.shape[0]
         x = embed(params, prompt_ids, 0)       # (B, T_p, D)
         caches = []
@@ -239,11 +248,12 @@ def _build_sampler(wf, t_p, n_new, temperature):
             cv = jnp.zeros((b, t_max, bkv, hd), x.dtype)
             x, ck, cv = _block_prefill(blk, params[blk.name], x, ck, cv)
             caches.append((ck, cv))
-        key, sub = jax.random.split(key)
-        first = sample(head_logits(params, x[:, -1]), sub)   # (B,)
+        # keys (B, 2): one independent stream per row (see sample)
+        keys, subs = _split_rows(keys)
+        first = sample(head_logits(params, x[:, -1]), subs)   # (B,)
 
         def step(carry, i):
-            tok, caches, key = carry
+            tok, caches, keys = carry
             pos = t_p + i
             x_t = embed(params, tok[:, None], pos)   # (B, 1, D)
             new_caches = []
@@ -251,15 +261,44 @@ def _build_sampler(wf, t_p, n_new, temperature):
                 x_t, ck, cv = _block_step(blk, params[blk.name], x_t,
                                           ck, cv, pos)
                 new_caches.append((ck, cv))
-            key, sub = jax.random.split(key)
-            nxt = sample(head_logits(params, x_t[:, 0]), sub)
-            return (nxt, tuple(new_caches), key), tok
+            keys, subs = _split_rows(keys)
+            nxt = sample(head_logits(params, x_t[:, 0]), subs)
+            return (nxt, tuple(new_caches), keys), tok
 
         (_, _, _), toks = jax.lax.scan(
-            step, (first, tuple(caches), key), jnp.arange(n_new))
+            step, (first, tuple(caches), keys), jnp.arange(n_new))
         return toks                                  # (n_new, B)
 
     return run
+
+
+def _split_rows(keys):
+    """Advance a batch of per-row PRNG streams one step: ``keys``
+    (B, 2) → (new carries (B, 2), subkeys (B, 2)). Row r's stream is
+    exactly what ``split`` would produce from that row's key alone, so
+    decode outputs are invariant to batch composition."""
+    import jax
+    out = jax.vmap(jax.random.split)(keys)      # (B, 2, 2)
+    return out[:, 0], out[:, 1]
+
+
+def _row_keys(seed, batch):
+    """(B, 2) per-row PRNG keys from ``seed``: an int seeds every row
+    identically (same request → same tokens whatever the batch), a
+    sequence of B ints gives each row its own stream. Each row's key
+    is exactly ``jax.random.PRNGKey(seed_row)`` — any int a solo
+    decode accepted before (negative, 64-bit) still works and maps to
+    the same key."""
+    import jax
+    import jax.numpy as jnp
+    seeds = numpy.asarray(seed)
+    if seeds.ndim == 0:
+        seeds = numpy.broadcast_to(seeds, (batch,))
+    elif seeds.shape != (batch,):
+        raise VelesError("seed must be an int or a sequence of %d ints,"
+                         " got shape %s" % (batch, seeds.shape))
+    return jnp.asarray(numpy.stack(
+        [numpy.asarray(jax.random.PRNGKey(int(s))) for s in seeds]))
 
 
 def generate(wf, prompt, n_new, temperature=1.0, seed=0):
@@ -269,9 +308,11 @@ def generate(wf, prompt, n_new, temperature=1.0, seed=0):
     returns B lists; the whole batch decodes in the same single
     dispatch). Prefill warms the caches in one full-window pass;
     generation is one ``lax.scan``. ``temperature <= 0`` = greedy.
-    Compiled programs cache per (batch, prompt length, n_new,
-    temperature)."""
-    import jax
+    ``seed`` is an int (every row draws the same per-row stream — a
+    request's tokens never depend on who shares the batch) or a
+    sequence of B ints giving each row its own stream. Compiled
+    programs cache per (batch, prompt length, n_new, temperature)."""
+    import jax  # noqa: F401 — backend init before key construction
     import jax.numpy as jnp
     try:
         prompt = numpy.asarray(prompt, dtype=numpy.int32)
@@ -301,7 +342,8 @@ def generate(wf, prompt, n_new, temperature=1.0, seed=0):
         # this call site: a restructure that invokes the program per
         # token shows up as n_new dispatches, not a hand-asserted 1.
         toks = numpy.asarray(
-            run(params, jnp.asarray(prompt), jax.random.PRNGKey(seed)))
+            run(params, jnp.asarray(prompt),
+                _row_keys(seed, prompt.shape[0])))
     inc("veles_decode_tokens_total", int(n_new) * int(prompt.shape[0]))
     if not batched:
         return [int(t) for t in toks[:, 0]]

@@ -78,6 +78,10 @@ for _name, _desc in (
     ("download", "Downloader fetch, before each HTTP attempt"),
     ("serve.request", "REST/generation request intake (raise is shed "
                       "as 503 + Retry-After, never a crash)"),
+    ("serve.decode_step", "continuous-batching engine, before each "
+                          "pooled decode step (raise sheds the "
+                          "in-flight rows 503 + Retry-After; the "
+                          "slot pool stays consistent)"),
     ("distributed.init", "initialize_multihost, inside the retried "
                          "coordinator join"),
     # overlap subsystem (veles_tpu/overlap/): chaos for the async

@@ -16,6 +16,7 @@ output and sets the event.
 from __future__ import annotations
 
 import threading
+import time
 from http.server import BaseHTTPRequestHandler
 from typing import Any, Dict, Optional
 
@@ -27,17 +28,9 @@ from .config import root
 from .error import VelesError
 from .resilience import health
 from .resilience.faults import FaultInjected, fire as fire_fault
+from .serving.scheduler import (Ticket as _Ticket, shed_expired,
+                                split_expired)
 from .units import Unit
-
-
-class _Ticket:
-    __slots__ = ("event", "result", "error", "code")
-
-    def __init__(self) -> None:
-        self.event = threading.Event()
-        self.result: Any = None
-        self.error: Optional[str] = None
-        self.code: int = 500          # error reply code when error set
 
 
 class RESTfulAPI(Unit):
@@ -219,21 +212,34 @@ class GenerationAPI(Unit):
     ``temperature``, ``gamma``, ``beam``, ``seed``) →
     ``{"tokens": [...]}`` plus decode stats.
 
-    The serving half of VERDICT r4 item 4 (reference equivalent:
+    Two decode planes serve the queue (reference equivalent:
     `veles/restful_api.py:78` + `veles/loader/restful.py:52`, which
-    served one forward per request): concurrent requests that share a
-    shape key (prompt length, n_new, mode, knobs) are MICRO-BATCHED —
-    a worker thread coalesces the queue for ``batch_window`` seconds
-    and runs one batched decode (``sampling.generate`` /
-    ``generate_speculative`` batch rows) instead of B sequential
-    programs, so serving throughput rides the batch axis exactly like
-    training. Greedy rows are bit-identical to solo decodes (the
-    batched decoders' CI gate), so batching never changes answers.
-    ``beam`` requests stay per-request (single-sequence search).
+    served one forward per request):
+
+    - ``engine="continuous"`` (default): greedy and sample requests
+      ride the continuous-batching engine (``veles_tpu/serving/``) — a
+      persistent ``max_slots``-row KV-cache pool with ONE fixed-shape
+      jitted decode step, prefill padded to ``buckets`` (jit cache
+      bounded by len(buckets)+1 programs), iteration-level admission
+      into free slots and per-row retirement at ``eos_id`` / own
+      ``n_new``. Per-slot PRNG streams keep every row id-exact vs its
+      solo decode, so batching never changes answers — stochastic
+      decodes included. Requests the pool cannot hold (prompt longer
+      than the largest bucket, context overflow) fall back to the
+      window worker below.
+    - ``engine="window"``: the legacy micro-batcher — a worker thread
+      coalesces the queue for ``batch_window`` seconds and batches
+      requests sharing an exact shape key into one
+      ``sampling.generate`` / ``generate_speculative`` call.
+      ``speculative`` and ``beam`` requests always take this path.
+
+    A ticket older than its ``request_timeout`` deadline is answered
+    503 + Retry-After by whichever plane dequeues it — it never sits
+    in the queue past its useful life.
 
     Standalone service unit: not part of the Repeater loop — the
     device program IS the generation; ``initialize`` starts the HTTP
-    service + worker, ``stop`` drains them.
+    service + worker(s), ``stop`` drains them.
     """
 
     MAPPING = "generation_api"
@@ -245,7 +251,10 @@ class GenerationAPI(Unit):
                  path: str = "/generate", max_new: int = 512,
                  batch_window: float = 0.02,
                  request_timeout: float = 120.0,
-                 max_queue: int = None, **kwargs) -> None:
+                 max_queue: int = None, engine: str = None,
+                 max_slots: int = None, buckets=None,
+                 max_context: int = None,
+                 decode_block: int = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
         #: the TARGET model workflow is the unit's own workflow; an
@@ -261,6 +270,23 @@ class GenerationAPI(Unit):
                                  "max_queue", 256) or 256)
         self.batch_window = float(batch_window)
         self.request_timeout = float(request_timeout)
+        # continuous-batching knobs (root.common.serving.* defaults —
+        # see veles_tpu/serving/ and docs/services.md)
+        serving_cfg = root.common.serving
+        self.engine_kind = str(engine or serving_cfg.get(
+            "engine", "continuous"))
+        self.max_slots = int(max_slots if max_slots is not None
+                             else serving_cfg.get("max_slots", 8))
+        self.buckets = (buckets if buckets is not None
+                        else serving_cfg.get("buckets",
+                                             [16, 32, 64, 128]))
+        self.max_context = int(
+            max_context if max_context is not None
+            else serving_cfg.get("max_context", 640))
+        self.decode_block = int(
+            decode_block if decode_block is not None
+            else serving_cfg.get("decode_block", 1))
+        self._engine = None
         self._service: Optional[HTTPService] = None
         self._queue: list = []
         self._cv = threading.Condition()
@@ -314,12 +340,16 @@ class GenerationAPI(Unit):
             raise ValueError("'gamma' must be >= 1")
         if req["beam"] < 1:
             raise ValueError("'beam' must be >= 1")
-        if req["temperature"] > 0:
-            # stochastic decodes are NEVER coalesced: batched rows draw
-            # noise from batch-shaped PRNG streams, so a request's
-            # tokens would depend on which strangers arrived with it —
-            # seed determinism (same request → same answer) wins over
-            # batching here. A unique tag gives each its own "group".
+        if req["temperature"] > 0 and mode == "speculative":
+            # stochastic SPECULATIVE decodes are never coalesced: the
+            # rejection-sampling accept path draws batch-shaped noise,
+            # so a request's tokens would depend on which strangers
+            # arrived with it — seed determinism wins over batching
+            # there. mode=sample HAS no such dependence any more:
+            # sampling.generate draws per-row PRNG streams (a row's
+            # noise is a pure function of its own seed), so sample
+            # requests sharing a shape key batch exactly like greedy,
+            # id-exact vs their solo decodes.
             with self._cv:
                 self._uniq += 1
                 req["_solo"] = self._uniq
@@ -327,12 +357,12 @@ class GenerationAPI(Unit):
 
     @staticmethod
     def _batch_key(req):
-        """Requests sharing this key ride one batched decode — only
-        DETERMINISTIC decodes (greedy / speculative at temperature 0)
-        coalesce, and those are bit-identical to their solo decodes by
-        the batched decoders' CI gates, so batching never changes
-        answers. Stochastic requests carry a unique _solo tag (see
-        _parse) and always form singleton groups."""
+        """Requests sharing this key ride one batched decode — greedy,
+        temperature-0 speculative AND mode=sample (per-row PRNG
+        streams in sampling.generate make every row bit-identical to
+        its solo decode, so batching never changes answers). Only
+        stochastic speculative requests carry a unique _solo tag (see
+        _parse) and form singleton groups."""
         return (req["mode"], len(req["prompt"]), req["n_new"],
                 req["temperature"], req["gamma"], req["seed"],
                 req.get("_solo"))
@@ -437,6 +467,13 @@ class GenerationAPI(Unit):
                 _time.sleep(self.batch_window)
             with self._cv:
                 pending, self._queue = self._queue, []
+            # request_timeout holds while QUEUED, not just while
+            # decoding: a ticket past its deadline is answered 503 +
+            # Retry-After now, instead of burning a decode nobody is
+            # waiting for (its handler would time out mid-batch) —
+            # the same expiry answer the continuous engine gives
+            pending, expired = split_expired(pending)
+            shed_expired(expired)
             groups: Dict[Any, list] = {}
             for req, ticket in pending:
                 groups.setdefault(self._batch_key(req),
@@ -445,9 +482,10 @@ class GenerationAPI(Unit):
                 reqs = [r for r, _ in group]
                 tickets = [t for _, t in group]
                 self._serve_group(reqs, tickets)
-                self.batches_run += 1
-                self.max_batch = max(self.max_batch, len(reqs))
-                self.requests_served += len(reqs)
+                with self._cv:
+                    self.batches_run += 1
+                    self.max_batch = max(self.max_batch, len(reqs))
+                    self.requests_served += len(reqs)
 
     # -- lifecycle -----------------------------------------------------------
     def initialize(self, **kwargs):
@@ -456,6 +494,27 @@ class GenerationAPI(Unit):
             return res
         if self._service is not None:
             return None
+        if self.engine_kind == "continuous" and self._engine is None:
+            from .serving import ContinuousEngine
+            try:
+                self._engine = ContinuousEngine(
+                    self.workflow, max_slots=self.max_slots,
+                    buckets=self.buckets,
+                    max_context=self.max_context,
+                    decode_block=self.decode_block,
+                    name=self.name).start()
+            except VelesError as e:
+                # a stack the slot pool cannot serve (non-LM workflow)
+                # degrades to the window worker — same answers, just no
+                # in-flight batching. Knob-geometry mistakes (bucket >
+                # max_context, max_slots < 1) raise ValueError and
+                # PROPAGATE: the operator asked for continuous batching
+                # and must not silently get the per-shape-compiling
+                # worker instead.
+                self.warning("%s: continuous batching unavailable "
+                             "(%s); serving via the window worker",
+                             self.name, e)
+                self._engine = None
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -473,14 +532,31 @@ class GenerationAPI(Unit):
                     # unit's serving gauges
                     from .telemetry.counters import (
                         METRICS_CONTENT_TYPE, metrics_text)
-                    text = metrics_text({
+                    gauges = {
                         "veles_generate_requests_served":
                             api.requests_served,
                         "veles_generate_batches_run": api.batches_run,
                         "veles_generate_max_batch": api.max_batch,
                         "veles_generate_queue_depth": len(api._queue),
                         "veles_generate_queue_bound": api.max_queue,
-                    })
+                    }
+                    engine = api._engine   # stop() may null it mid-GET
+                    if engine is not None:
+                        # continuous-batching occupancy (the gauges an
+                        # operator sizes max_slots/buckets with; the
+                        # web_status surface serves the same names
+                        # suffixed _<engine-name> — this port has ONE
+                        # engine, so no suffix)
+                        st = engine.stats()
+                        gauges.update({
+                            "veles_serving_slots": st["slots"],
+                            "veles_serving_slots_busy":
+                                st["slots_busy"],
+                            "veles_serving_queue_depth":
+                                st["queue_depth"],
+                            "veles_serving_programs": st["programs"],
+                        })
+                    text = metrics_text(gauges)
                     bytes_reply(self, 200, text.encode(),
                                 METRICS_CONTENT_TYPE)
                     return
@@ -490,13 +566,19 @@ class GenerationAPI(Unit):
                 if self.path != api.path + "/stats":
                     self.send_error(404)
                     return
-                json_reply(self, 200, {
+                engine = api._engine       # stop() may null it mid-GET
+                stats = {
                     "requests_served": api.requests_served,
                     "batches_run": api.batches_run,
                     "max_batch": api.max_batch,
                     "queue_depth": len(api._queue),
                     "speculative_enabled": api.draft is not None,
-                    "modes": list(api.MODES)})
+                    "engine": ("continuous" if engine is not None
+                               else "window"),
+                    "modes": list(api.MODES)}
+                if engine is not None:
+                    stats["continuous"] = engine.stats()
+                json_reply(self, 200, stats)
 
             def do_POST(self):
                 if self.path != api.path:
@@ -515,27 +597,79 @@ class GenerationAPI(Unit):
                     json_reply(self, 400, {"error":
                                            "bad request: %s" % e})
                     return
-                ticket = _Ticket()
-                with api._cv:
+                ticket = _Ticket(
+                    deadline=time.time() + api.request_timeout)
+                engine = api._engine
+                via_engine = (engine is not None
+                              and req["mode"] in ("greedy", "sample")
+                              and engine.accepts(req) is None)
+                if via_engine:
+                    # the continuous-batching plane: admitted into a
+                    # KV-cache slot at the next step boundary; a full
+                    # queue sheds exactly like the window plane
                     if api._closing:
                         health.shed(self, retry_after=5.0,
                                     reason="server shutting down")
                         return
-                    if len(api._queue) >= api.max_queue:
-                        health.shed(
-                            self, retry_after=1.0,
-                            reason="generation queue full (%d/%d)"
-                            % (len(api._queue), api.max_queue))
+                    if not engine.submit(req, ticket,
+                                         max_queue=api.max_queue,
+                                         checked=True):
+                        # False means queue bound OR a closing engine
+                        # (stop() racing this handler) — the shutdown
+                        # answer must match the api._closing path above
+                        if engine.closing:
+                            health.shed(self, retry_after=5.0,
+                                        reason="server shutting down")
+                        else:
+                            health.shed(
+                                self, retry_after=1.0,
+                                reason="generation queue full (%d/%d)"
+                                % (engine.scheduler.queue_depth(),
+                                   api.max_queue))
                         return
-                    api._queue.append((req, ticket))
-                    api._cv.notify()
-                if not ticket.event.wait(api.request_timeout):
+                else:
+                    with api._cv:
+                        if api._closing:
+                            health.shed(self, retry_after=5.0,
+                                        reason="server shutting down")
+                            return
+                        if len(api._queue) >= api.max_queue:
+                            health.shed(
+                                self, retry_after=1.0,
+                                reason="generation queue full (%d/%d)"
+                                % (len(api._queue), api.max_queue))
+                            return
+                        api._queue.append((req, ticket))
+                        api._cv.notify()
+                # slack past the deadline: the queue-side expiry
+                # (503 + Retry-After, counted) should win the race
+                # against this handler's own last-resort 504
+                if not ticket.event.wait(api.request_timeout + 1.0):
                     json_reply(self, 504,
                                {"error": "generation timed out"})
                     return
+                if via_engine and not (ticket.error is not None
+                                       and ticket.code == 503):
+                    # the window worker counts requests its batches
+                    # actually decoded — decode errors included, but
+                    # never 503 sheds/expiries (those are answered
+                    # before any batch runs); engine answers are
+                    # tallied here on the same terms so /stats compares
+                    # the planes like for like. Handler threads run
+                    # concurrently — the += must not lose updates
+                    # against them or the worker.
+                    with api._cv:
+                        api.requests_served += 1
                 if ticket.error is not None:
+                    headers = None
+                    retry_after = getattr(ticket, "retry_after", None)
+                    if retry_after:
+                        import math as _math
+                        headers = {"Retry-After": str(max(1, int(
+                            _math.ceil(retry_after))))}
                     json_reply(self, ticket.code,
-                               {"error": ticket.error})
+                               {"error": ticket.error},
+                               headers=headers)
                     return
                 json_reply(self, 200, ticket.result)
 
@@ -569,6 +703,9 @@ class GenerationAPI(Unit):
         if self._worker is not None:
             self._worker.join(timeout=5)
             self._worker = None
+        if self._engine is not None:
+            self._engine.stop()
+            self._engine = None
         # after the worker is down — its beats must not re-register a
         # heartbeat that would age out on a long-lived process
         health.forget("serve.%s" % self.name)

@@ -1,0 +1,88 @@
+"""Continuous-batching serving plane.
+
+The window-coalescing worker in ``restful_api.GenerationAPI`` only
+batches requests that arrive within 20 ms of each other AND share an
+exact shape key — stochastic decodes never batch, every distinct
+prompt length compiles a fresh program, and a batch runs to its
+longest member's ``n_new`` before anyone is answered. This package
+replaces that with iteration-level scheduling over a persistent slot
+pool (the shape-stable cached-decode formulation of PAPERS.md's
+"Compiler-First State Space Duality and Portable O(1) Autoregressive
+Caching for Inference"):
+
+- :mod:`engine` — :class:`~veles_tpu.serving.engine.ContinuousEngine`:
+  ONE fixed-shape jitted decode step over a ``max_slots``-row KV-cache
+  pool (``nn/sampling.py``'s ``_block_prefill``/``_block_step`` cache
+  layout, padded to ``max_context``), prefill padded to a small set of
+  length buckets so the jit cache is bounded by ``len(buckets) + 1``
+  programs — not by distinct prompt lengths;
+- :mod:`scheduler` — :class:`~veles_tpu.serving.scheduler.SlotScheduler`:
+  admits queued requests into free slots at step boundaries, retires a
+  row the moment it emits ``eos_id`` or reaches its own ``n_new``, and
+  answers tickets older than their deadline with 503 + Retry-After
+  instead of letting them rot in the queue.
+
+Per-slot PRNG streams derive each row's noise purely from
+``(seed, request)`` — a request's tokens are independent of which
+strangers share the batch, so ``mode=sample`` batches too (the same
+id-exactness bar the greedy CI gate sets).
+
+Operator guide: docs/services.md "Continuous batching".
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from .scheduler import SlotScheduler, Ticket          # noqa: F401
+from .engine import ContinuousEngine                  # noqa: F401
+
+#: every counter the serving plane increments — registered with HELP
+#: strings in telemetry/counters.py DESCRIPTIONS and asserted zero in
+#: non-serving runs by ``python bench.py gate``'s serving section
+SERVING_COUNTERS = (
+    "veles_serving_admitted_total",
+    "veles_serving_retired_total",
+    "veles_serving_prefill_dispatches_total",
+    "veles_serving_decode_dispatches_total",
+    "veles_serving_tokens_total",
+    "veles_serving_queue_wait_seconds_total",
+    "veles_serving_expired_total",
+)
+
+#: process-global registry of live engines (web_status /metrics renders
+#: one occupancy gauge set per engine, like the side-plane lanes)
+_engines: Dict[str, "ContinuousEngine"] = {}
+_engines_lock = threading.Lock()
+
+
+def register_engine(engine: "ContinuousEngine") -> None:
+    with _engines_lock:
+        _engines[engine.name] = engine
+
+
+def unregister_engine(engine: "ContinuousEngine") -> None:
+    with _engines_lock:
+        if _engines.get(engine.name) is engine:
+            del _engines[engine.name]
+
+
+def engines() -> Dict[str, "ContinuousEngine"]:
+    """name → live engine snapshot (web_status gauge rendering)."""
+    with _engines_lock:
+        return dict(_engines)
+
+
+def parse_buckets(spec) -> tuple:
+    """Prefill bucket lengths from config/CLI: a sequence of ints or a
+    comma-separated string ("16,32,64"); sorted, deduplicated."""
+    if isinstance(spec, str):
+        spec = [s for s in (part.strip() for part in spec.split(","))
+                if s]
+    buckets = sorted({int(b) for b in spec})
+    if not buckets or buckets[0] < 1:
+        from ..error import VelesError
+        raise VelesError("serving buckets must be positive ints, got %r"
+                         % (spec,))
+    return tuple(buckets)

@@ -1,0 +1,567 @@
+"""Continuous-batching decode engine: a persistent slot-pool KV cache
+driven by ONE fixed-shape jitted decode step.
+
+Replaces the window-coalescing serving model (one batched decode per
+exact shape key, everyone rides to the longest member's ``n_new``)
+with iteration-level scheduling:
+
+- the KV cache is a ``max_slots``-row pool, every row padded to
+  ``max_context`` — the decode step's shapes never change, so it
+  compiles exactly once;
+- prefill pads prompts to a small set of length ``buckets`` — the jit
+  cache is bounded by ``len(buckets) + 1`` programs, not by distinct
+  prompt lengths (right-padding is safe under the causal mask: pad
+  K/V rows are invisible to real positions and are overwritten by the
+  decode steps before the read mask ever reaches them);
+- the scheduler admits queued requests into free slots at step
+  boundaries and a row retires the moment it emits ``eos_id`` or
+  reaches its own ``n_new`` — short requests never wait for long
+  co-riders and the chip never idles while the queue is non-empty;
+- each slot carries its own PRNG stream derived purely from the
+  request's ``seed`` (``jax.random.fold_in``-style independence via
+  per-row ``split`` streams), so a request's tokens are id-exact vs
+  its solo decode whatever strangers share the batch — stochastic
+  decodes batch on the same bar the greedy CI gate sets.
+
+The per-block cache layout and math are ``nn/sampling.py``'s
+``_block_prefill`` / ``_block_step`` — the decode step vmaps the very
+same single-row step over the pool, so the engine cannot drift from
+the scan decoder numerically.
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy
+
+from ..error import VelesError
+from ..logger import Logger
+from ..nn.sampling import (_block_prefill, _block_step,
+                           _count_decode_dispatches, _split_rows,
+                           params_of, split_stack)
+from ..resilience import health
+from ..resilience.faults import FaultInjected, fire as fire_fault
+from ..telemetry.counters import inc
+from ..telemetry.spans import span
+
+#: floor for the temperature divisor inside the one shared decode
+#: program (greedy rows carry temperature 0; their categorical lane is
+#: computed-and-discarded, so the clamp only has to keep it finite)
+_TEMP_EPS = 1e-3
+
+
+def make_request(prompt, n_new, temperature=0.0, seed=0, eos_id=None
+                 ) -> Dict:
+    """Normalized request dict (the subset of GenerationAPI's parsed
+    request the engine consumes) — for tests and bench harnesses."""
+    return {"prompt": [int(t) for t in prompt], "n_new": int(n_new),
+            "temperature": float(temperature), "seed": int(seed),
+            "eos_id": eos_id}
+
+
+class ContinuousEngine(Logger):
+    """In-flight batching over a persistent KV-cache slot pool.
+
+    ``wf`` is a generation-capable workflow (``Embedding`` →
+    ``TransformerBlock``×N → ``LMHead``, validated at construction).
+    ``decode_block`` fuses that many decode steps into one dispatch
+    (``lax.scan``) — admission/retirement granularity stays one
+    *chunk*; 1 keeps pure per-token scheduling, larger values amortize
+    dispatch overhead on hosts where it dominates.
+    """
+
+    def __init__(self, wf, max_slots: int = 8,
+                 buckets: Tuple[int, ...] = (16, 32, 64, 128),
+                 max_context: int = 640, decode_block: int = 1,
+                 name: str = "serving") -> None:
+        super().__init__()
+        from .scheduler import SlotScheduler
+        self.wf = wf
+        self.name = name
+        # raises VelesError on anything but a generation stack (a bare
+        # workflow has no forwards at all — same rejection)
+        self.stack = split_stack(list(getattr(wf, "forwards", ()) or ()))
+        self.max_slots = int(max_slots)
+        self.max_context = int(max_context)
+        self.decode_block = max(1, int(decode_block))
+        from . import parse_buckets
+        self.buckets = parse_buckets(buckets)
+        self.scheduler = SlotScheduler(self.max_slots, self.buckets,
+                                       self.max_context)
+        pos_emb = self.stack["pos_emb"]
+        self._table_len = (None if pos_emb is None else
+                           pos_emb.param_arrays()["table"].shape[0])
+        self._progs: Dict = {}
+        self._params = None
+        self._caches = None
+        self._keys = None
+        self._tok = numpy.zeros(self.max_slots, numpy.int32)
+        self._pos = numpy.zeros(self.max_slots, numpy.int32)
+        self._temp = numpy.zeros(self.max_slots, numpy.float32)
+        self._thread: Optional[threading.Thread] = None
+        self._closing = False
+        self.admitted = 0
+        self.retired = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ContinuousEngine":
+        if self._thread is not None:
+            return self
+        self._closing = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=self.name + ".engine")
+        self._thread.start()
+        from . import register_engine
+        register_engine(self)
+        self.info("%s: continuous batching up (slots=%d buckets=%s "
+                  "max_context=%d decode_block=%d)", self.name,
+                  self.max_slots, list(self.buckets), self.max_context,
+                  self.decode_block)
+        return self
+
+    def stop(self) -> None:
+        with self.scheduler.cv:
+            self._closing = True
+            self.scheduler.cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        self.scheduler.drain("server shutting down")
+        self._abort_active("server shutting down", code=503,
+                           retry_after=5.0, count_shed=False)
+        from . import unregister_engine
+        unregister_engine(self)
+
+    # -- intake --------------------------------------------------------------
+    def accepts(self, req: Dict) -> Optional[str]:
+        """None when the slot pool can serve ``req``; otherwise the
+        reason (caller falls back to the window-coalescing path)."""
+        t_p, n_new = len(req["prompt"]), int(req["n_new"])
+        if t_p < 1:
+            return "empty prompt"
+        reason = self.scheduler.reject_reason(t_p, n_new)
+        if reason:
+            return reason
+        if self._table_len is not None and t_p + n_new > self._table_len:
+            return ("generation to %d positions exceeds the trained "
+                    "PositionalEmbedding table (%d rows)"
+                    % (t_p + n_new, self._table_len))
+        if 0 < float(req.get("temperature", 0.0)) < _TEMP_EPS:
+            # the shared decode program clamps the divisor at
+            # _TEMP_EPS; a colder-than-that request would sample from
+            # different logits here than solo sampling.generate does —
+            # route it to the window plane, which divides exactly
+            return ("temperature %g below the engine's %g resolution"
+                    % (req["temperature"], _TEMP_EPS))
+        bucket = self.scheduler.bucket_for(t_p)
+        if self._kernel_straddle(t_p, bucket):
+            # padding to the bucket would flip attention_core's
+            # flash/reference choice vs the exact-length solo prefill
+            # (choose_flash is length-gated) — different kernels drift
+            # in the last bits and break the id-exactness contract, so
+            # such a prompt rides the window plane instead
+            return ("prompt %d pads to bucket %d across the "
+                    "flash-attention crossover" % (t_p, bucket))
+        return None
+
+    def _kernel_straddle(self, t_p: int, bucket: int) -> bool:
+        """True when any block's attention would pick a different
+        kernel for the padded bucket length than for the exact prompt
+        length (see ``ops.flash_attention.choose_flash``)."""
+        if t_p == bucket:
+            return False
+        from ..ops.flash_attention import choose_flash
+        d = self.stack["stem"].dim
+        for blk in self.stack["blocks"]:
+            hd = d // blk.n_heads
+            if choose_flash(bucket, hd) != choose_flash(t_p, hd):
+                return True
+        return False
+
+    def submit(self, req: Dict, ticket,
+               max_queue: Optional[int] = None,
+               checked: bool = False) -> bool:
+        """Enqueue one request; False = queue bound hit (caller
+        sheds). ``ticket`` follows the :class:`scheduler.Ticket`
+        protocol (``fail`` / ``succeed`` / ``deadline``).
+        ``checked=True`` skips :meth:`accepts` — for callers that just
+        routed on its verdict."""
+        if not checked:
+            reason = self.accepts(req)
+            if reason is not None:
+                # direct submits (no API-side accepts() pre-check) get
+                # a clean client-fault answer instead of a 500 at
+                # admission
+                ticket.fail(reason, code=400)
+                return True
+        # the closing check and the enqueue share the scheduler's
+        # condition (an RLock): stop() flips _closing under the same
+        # lock before draining, so a ticket can never slip into the
+        # queue after the drain and strand its handler until 504
+        with self.scheduler.cv:
+            if self._closing:
+                return False
+            return self.scheduler.push(req, ticket, max_queue)
+
+    def serve(self, reqs: List[Dict], timeout: float = 300.0
+              ) -> List[List[int]]:
+        """Synchronous convenience (tests / bench): submit every
+        request, wait, return each token list; raises on any error."""
+        from .scheduler import Ticket
+        tickets = [Ticket() for _ in reqs]
+        for req, ticket in zip(reqs, tickets):
+            if not self.submit(req, ticket):
+                raise VelesError("serving queue full")
+        out = []
+        for req, ticket in zip(reqs, tickets):
+            if not ticket.event.wait(timeout):
+                raise VelesError("serving timed out for %r" % (req,))
+            if ticket.error is not None:
+                raise VelesError("serving failed: %s" % ticket.error)
+            out.append(ticket.result["tokens"])
+        return out
+
+    # -- observability -------------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        return {
+            "slots": self.max_slots,
+            "slots_busy": self.scheduler.busy_count(),
+            "queue_depth": self.scheduler.queue_depth(),
+            "admitted": self.admitted,
+            "retired": self.retired,
+            "programs": len(self._progs),
+        }
+
+    @property
+    def closing(self) -> bool:
+        """True once :meth:`stop` has begun — :meth:`submit` returns
+        False for a closing engine too, and the caller's shed answer
+        should say shutdown, not queue-full."""
+        return self._closing
+
+    @property
+    def programs_built(self) -> int:
+        """Jitted programs this engine ever built — bounded by
+        ``len(buckets) + 1`` (the bucketed prefills + the one decode
+        step), never by distinct prompt lengths."""
+        return len(self._progs)
+
+    # -- worker --------------------------------------------------------------
+    def _loop(self) -> None:
+        hb = "serving.%s" % self.name
+        fail_streak = 0
+        try:
+            while True:
+                with self.scheduler.cv:
+                    while (not self.scheduler._queue
+                           and self.scheduler.busy_count() == 0
+                           and not self._closing):
+                        self.scheduler.cv.wait(timeout=5.0)
+                        if not self._closing:
+                            health.heartbeats.beat(hb)
+                    if self._closing:
+                        return
+                health.heartbeats.beat(hb)
+                try:
+                    self._tick()
+                    fail_streak = 0
+                except Exception:     # noqa: BLE001 — serve, don't die
+                    fail_streak += 1
+                    self.exception("%s: serving tick failed", self.name)
+                    self._abort_active("internal serving error",
+                                       code=500, count_shed=False)
+                    # donated buffers may be gone — rebuild lazily
+                    self._caches = self._keys = self._params = None
+                    # a tick that dies before take_admissions never
+                    # reaches the deadline check there: sweep the queue
+                    # so waiting callers still get their 503 instead of
+                    # hanging to full timeout, and back off instead of
+                    # busy-spinning while the failure persists
+                    from .scheduler import shed_expired
+                    shed_expired(self.scheduler.expire_queued())
+                    if not self._closing:
+                        time.sleep(min(1.0, 0.05 * (2 ** fail_streak)))
+        finally:
+            health.heartbeats.unregister(hb)
+
+    def _tick(self) -> None:
+        """One step boundary: admit into free slots, then run one
+        decode chunk over the pool."""
+        # the param device-view walk (per-array locks) is too heavy to
+        # repeat per decode chunk, but a snapshot held forever would
+        # serve stale weights after a host-side update. Middle ground:
+        # re-read whenever the pool is IDLE (no in-flight rows) — a
+        # param change lands at the next burst boundary, no request
+        # ever decodes on torn half-old/half-new weights, and under
+        # sustained load the walk is never on the per-token path
+        # (weights are frozen while serving, as everywhere in serving).
+        params = self._params
+        if params is None or self.scheduler.busy_count() == 0:
+            params = self._params = params_of(self.wf)
+        self._ensure_pool(params)
+        from .scheduler import shed_expired
+        admissions, expired = self.scheduler.take_admissions()
+        shed_expired(expired)
+        for slot in admissions:
+            try:
+                self._admit(params, slot)
+            except Exception as e:    # noqa: BLE001 — answer, don't die
+                self.scheduler.retire(slot)
+                slot.ticket.fail("%s: %s" % (type(e).__name__, e),
+                                 code=500)
+                # the prefill program DONATES the pool: a dispatch
+                # that died may have consumed the co-tenants' caches
+                # with it, and there is no cheap way to tell. Shed the
+                # in-flight rows (503 + Retry-After) and rebuild the
+                # pool rather than decode on possibly-dead buffers.
+                self.exception("%s: admission failed; resetting the "
+                               "slot pool", self.name)
+                self._abort_active("serving pool reset after a failed "
+                                   "admission", code=503,
+                                   retry_after=1.0)
+                self._caches = self._keys = self._params = None
+                return
+        if self.scheduler.busy_count():
+            try:
+                self._decode(params)
+            except FaultInjected as e:
+                # an injected decode fault DEGRADES: in-flight rows are
+                # shed with Retry-After, the pool stays consistent (the
+                # fault fires before the dispatch)
+                self._abort_active(str(e), code=503, retry_after=1.0)
+
+    def _ensure_pool(self, params) -> None:
+        if self._caches is not None:
+            return
+        import jax.numpy as jnp
+        stem, blocks = self.stack["stem"], self.stack["blocks"]
+        dtype = params[stem.name]["table"].dtype
+        d = stem.dim
+        caches = []
+        for blk in blocks:
+            bkv = getattr(blk, "n_kv_heads", blk.n_heads)
+            hd = d // blk.n_heads
+            caches.append(
+                (jnp.zeros((self.max_slots, self.max_context, bkv, hd),
+                           dtype),
+                 jnp.zeros((self.max_slots, self.max_context, bkv, hd),
+                           dtype)))
+        self._caches = tuple(caches)
+        self._keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
+
+    # -- admission ------------------------------------------------------------
+    def _admit(self, params, slot) -> None:
+        import jax
+        import jax.numpy as jnp
+        t_p, bucket = slot.t_p, slot.bucket
+        ids = numpy.zeros((1, bucket), numpy.int32)
+        ids[0, :t_p] = slot.req["prompt"]
+        prog = self._program("prefill", bucket)
+        seed_key = jax.random.PRNGKey(int(slot.req.get("seed", 0)))
+        wait = max(0.0, time.time() - slot.ticket.enqueued)
+        with span("serving.prefill", bucket=bucket, slot=slot.idx,
+                  t_p=t_p):
+            first, self._keys, self._caches = prog(
+                params, jnp.asarray(ids), numpy.int32(t_p),
+                numpy.int32(slot.idx), numpy.float32(slot.temperature),
+                seed_key, self._keys, self._caches)
+            first = int(first)
+        inc("veles_serving_prefill_dispatches_total")
+        inc("veles_serving_admitted_total")
+        inc("veles_serving_queue_wait_seconds_total", wait)
+        self.admitted += 1
+        self._tok[slot.idx] = first
+        self._pos[slot.idx] = t_p
+        self._temp[slot.idx] = slot.temperature
+        if slot.record(first):
+            self._finish(slot)
+
+    # -- the decode chunk ------------------------------------------------------
+    def _decode(self, params) -> None:
+        import jax.numpy as jnp
+        active = self.scheduler.active()
+        fire_fault("serve.decode_step")
+        with span("serving.decode_step", active=len(active),
+                  chunk=self.decode_block):
+            toks, self._keys, self._caches = self._program("step")(
+                params, jnp.asarray(self._tok), jnp.asarray(self._pos),
+                jnp.asarray(self._temp), self._keys, self._caches)
+            toks = numpy.asarray(toks)          # (decode_block, S)
+        inc("veles_serving_decode_dispatches_total")
+        finished: List = []
+        for h in range(toks.shape[0]):
+            still = [s for s in active if s not in finished]
+            if not still:
+                break
+            for slot in still:
+                token = int(toks[h, slot.idx])
+                self._tok[slot.idx] = token
+                self._pos[slot.idx] += 1
+                if slot.record(token):
+                    finished.append(slot)
+        for slot in finished:
+            self._finish(slot)
+
+    def _finish(self, slot) -> None:
+        """Retire a row the moment it is done: free the slot (the next
+        admission reuses it immediately) and answer the ticket."""
+        inc("veles_serving_retired_total")
+        inc("veles_serving_tokens_total", len(slot.tokens))
+        self.retired += 1
+        # co-resident rows at retirement — the window plane's
+        # batched_with response key, kept so the schema does not
+        # depend on which plane served the request
+        batched_with = max(0, self.scheduler.busy_count() - 1)
+        self._tok[slot.idx] = 0
+        self._pos[slot.idx] = 0
+        self._temp[slot.idx] = 0.0
+        self.scheduler.retire(slot)
+        slot.ticket.succeed({"tokens": list(slot.tokens),
+                             "batched_with": batched_with,
+                             "engine": "continuous"})
+
+    def _abort_active(self, reason: str, code: int = 500,
+                      retry_after: Optional[float] = None,
+                      count_shed: bool = True) -> None:
+        for slot in self.scheduler.active():
+            if count_shed:
+                inc("veles_shed_requests_total")
+            self._tok[slot.idx] = 0
+            self._pos[slot.idx] = 0
+            self._temp[slot.idx] = 0.0
+            self.scheduler.retire(slot)
+            slot.ticket.fail(reason, code=code, retry_after=retry_after)
+
+    # -- jitted programs -------------------------------------------------------
+    def _program(self, kind: str, bucket: Optional[int] = None):
+        key = (kind, bucket)
+        prog = self._progs.get(key)
+        if prog is None:
+            prog = self._progs[key] = (
+                self._build_prefill(bucket) if kind == "prefill"
+                else self._build_decode())
+        return prog
+
+    def _build_prefill(self, bucket: int):
+        """One program per bucket: pad-to-``bucket`` full-window pass
+        through ``_block_prefill`` writing K/V into this slot's pool
+        rows, plus the request's FIRST sampled token (from the last
+        real position's logits) and its private PRNG carry."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops import matmul_precision
+        stack = self.stack
+        stem, pos_emb = stack["stem"], stack["pos_emb"]
+        blocks, head = stack["blocks"], stack["head"]
+        prec = matmul_precision()
+        d = stem.dim
+
+        @_count_decode_dispatches
+        @functools.partial(jax.jit, donate_argnums=(6, 7))
+        def prefill(params, ids, t_p, slot, temp, seed_key, keys,
+                    caches):
+            x = jnp.take(params[stem.name]["table"],
+                         ids.astype(jnp.int32), axis=0, mode="clip")
+            if pos_emb is not None:
+                table = params[pos_emb.name]["table"]
+                x = x + jnp.take(table, jnp.arange(ids.shape[-1]),
+                                 axis=0, mode="clip")[None]
+            new_caches = []
+            for blk, (ck_pool, cv_pool) in zip(blocks, caches):
+                bkv = getattr(blk, "n_kv_heads", blk.n_heads)
+                hd = d // blk.n_heads
+                ck = jnp.zeros((1, bucket, bkv, hd), x.dtype)
+                cv = jnp.zeros((1, bucket, bkv, hd), x.dtype)
+                x, ck, cv = _block_prefill(blk, params[blk.name], x,
+                                           ck, cv)
+                # pad rows land in the pool too; they are causal-masked
+                # for every real position and the decode steps rewrite
+                # position p before the read mask reaches it
+                ck_pool = jax.lax.dynamic_update_slice(
+                    ck_pool, ck, (slot, 0, 0, 0))
+                cv_pool = jax.lax.dynamic_update_slice(
+                    cv_pool, cv, (slot, 0, 0, 0))
+                new_caches.append((ck_pool, cv_pool))
+            x_last = jnp.take(x[0], t_p - 1, axis=0, mode="clip")
+            logits = (jnp.dot(x_last, params[head.name]["weights"],
+                              precision=prec)
+                      + params[head.name]["bias"])
+            k2 = jax.random.split(seed_key)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            samp = jax.random.categorical(
+                k2[1], logits / jnp.maximum(temp, _TEMP_EPS)
+            ).astype(jnp.int32)
+            first = jnp.where(temp > 0, samp, greedy)
+            keys = jax.lax.dynamic_update_slice(keys, k2[0][None],
+                                                (slot, 0))
+            return first, keys, tuple(new_caches)
+
+        return prefill
+
+    def _build_decode(self):
+        """THE decode step: ``decode_block`` scan iterations of the
+        vmapped single-row ``_block_step`` over every slot — one fixed
+        shape, compiled exactly once. Per-row sampling draws from each
+        slot's private key stream, so a row's noise is a pure function
+        of its request's seed (id-exact vs solo decode whatever else
+        rides the pool)."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops import matmul_precision
+        stack = self.stack
+        stem, pos_emb = stack["stem"], stack["pos_emb"]
+        blocks, head = stack["blocks"], stack["head"]
+        prec = matmul_precision()
+
+        def embed_rows(params, tok, pos):
+            x = jnp.take(params[stem.name]["table"],
+                         tok.astype(jnp.int32), axis=0, mode="clip")
+            if pos_emb is not None:
+                x = x + jnp.take(params[pos_emb.name]["table"], pos,
+                                 axis=0, mode="clip")
+            return x                            # (S, D)
+
+        @_count_decode_dispatches
+        @functools.partial(jax.jit, donate_argnums=(4, 5))
+        def step(params, tok, pos, temp, keys, caches):
+            def body(carry, _):
+                tok, pos, keys, caches = carry
+                x = embed_rows(params, tok, pos)
+                new_caches = []
+                for blk, (ck, cv) in zip(blocks, caches):
+                    p = params[blk.name]
+
+                    def row(x_row, ck_row, cv_row, pos_row,
+                            blk=blk, p=p):
+                        y, ck2, cv2 = _block_step(
+                            blk, p, x_row[None, None, :],
+                            ck_row[None], cv_row[None], pos_row)
+                        return y[0, 0], ck2[0], cv2[0]
+
+                    x, ck, cv = jax.vmap(row)(x, ck, cv, pos)
+                    new_caches.append((ck, cv))
+                logits = (jnp.dot(x, params[head.name]["weights"],
+                                  precision=prec)
+                          + params[head.name]["bias"])   # (S, V)
+                # _split_rows IS the id-exactness contract: the same
+                # carry/subkey convention solo and batched generate use
+                keys, subs = _split_rows(keys)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                samp = jax.vmap(jax.random.categorical)(
+                    subs,
+                    logits / jnp.maximum(temp, _TEMP_EPS)[:, None]
+                ).astype(jnp.int32)
+                nxt = jnp.where(temp > 0, samp, greedy)
+                return (nxt, pos + 1, keys,
+                        tuple(new_caches)), nxt
+
+            (tok, pos, keys, caches), toks = jax.lax.scan(
+                body, (tok, pos, keys, caches), None,
+                length=self.decode_block)
+            return toks, keys, caches            # toks (chunk, S)
+
+        return step

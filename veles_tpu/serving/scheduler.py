@@ -1,0 +1,237 @@
+"""Iteration-level scheduler for the continuous-batching engine.
+
+Pure host-side bookkeeping (no jax): a bounded request queue, the
+``max_slots`` slot table, prefill-bucket selection and deadline
+enforcement. The engine calls :meth:`SlotScheduler.take_admissions` at
+every step boundary — queued requests move into free slots the moment
+one opens, so the chip never idles while the queue is non-empty, and a
+ticket older than its deadline is answered 503 + Retry-After instead
+of silently sitting in the queue.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from ..telemetry.counters import inc
+
+
+class Ticket:
+    """One request's rendezvous between an HTTP handler thread and a
+    serving worker (the generation twin of ``restful_api._Ticket``).
+    The worker fills ``result`` (or ``error`` + ``code``) and sets
+    ``event``; ``retry_after`` asks the handler to attach a
+    ``Retry-After`` header (503 shed/expiry answers); ``deadline`` is
+    the absolute wall time after which the request must no longer be
+    served from the queue."""
+
+    __slots__ = ("event", "result", "error", "code", "retry_after",
+                 "deadline", "enqueued")
+
+    def __init__(self, deadline: Optional[float] = None) -> None:
+        self.event = threading.Event()
+        self.result = None
+        self.error: Optional[str] = None
+        self.code: int = 500
+        self.retry_after: Optional[float] = None
+        self.deadline = deadline
+        self.enqueued = time.time()
+
+    def fail(self, error: str, code: int = 500,
+             retry_after: Optional[float] = None) -> None:
+        self.error = error
+        self.code = code
+        self.retry_after = retry_after
+        self.event.set()
+
+    def succeed(self, result) -> None:
+        self.result = result
+        self.event.set()
+
+
+def split_expired(pairs: List[Tuple[Dict, Ticket]],
+                  now: Optional[float] = None
+                  ) -> Tuple[List[Tuple[Dict, Ticket]], List[Ticket]]:
+    """Partition ``(req, ticket)`` pairs into (still live, expired
+    tickets) by deadline — the check every dequeue point applies."""
+    now = time.time() if now is None else now
+    live, expired = [], []
+    for req, ticket in pairs:
+        if ticket.deadline is not None and now > ticket.deadline:
+            expired.append(ticket)
+        else:
+            live.append((req, ticket))
+    return live, expired
+
+
+def shed_expired(tickets: List[Ticket]) -> None:
+    """THE one deadline answer both decode planes give: 503 +
+    Retry-After, counted — a ticket never rots in a queue past its
+    useful life."""
+    for ticket in tickets:
+        inc("veles_serving_expired_total")
+        inc("veles_shed_requests_total")
+        ticket.fail("request expired in serving queue", code=503,
+                    retry_after=1.0)
+
+
+class Slot:
+    """Host state of one occupied KV-cache row."""
+
+    __slots__ = ("idx", "req", "ticket", "t_p", "bucket", "tokens",
+                 "n_new", "eos_id", "temperature")
+
+    def __init__(self, idx: int, req: Dict, ticket: Ticket,
+                 bucket: int) -> None:
+        self.idx = idx
+        self.req = req
+        self.ticket = ticket
+        self.t_p = len(req["prompt"])
+        self.bucket = bucket
+        self.tokens: List[int] = []
+        self.n_new = int(req["n_new"])
+        self.eos_id = req.get("eos_id")
+        self.temperature = float(req.get("temperature", 0.0))
+
+    def record(self, token: int) -> bool:
+        """Append one emitted token; True when the row is finished
+        (its own ``n_new`` reached, or ``eos_id`` emitted — the moment
+        continuous batching frees the slot for the next request,
+        instead of riding out the longest co-tenant)."""
+        self.tokens.append(int(token))
+        if self.eos_id is not None and int(token) == self.eos_id:
+            return True
+        return len(self.tokens) >= self.n_new
+
+
+class SlotScheduler:
+    """Bounded queue + slot table. All methods are thread-safe; the
+    engine's worker waits on :attr:`cv` and the HTTP threads notify it
+    on :meth:`push`."""
+
+    def __init__(self, max_slots: int, buckets: Tuple[int, ...],
+                 max_context: int) -> None:
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.max_slots = int(max_slots)
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        self.max_context = int(max_context)
+        if self.buckets[-1] > self.max_context:
+            raise ValueError(
+                "largest prefill bucket %d exceeds max_context %d"
+                % (self.buckets[-1], self.max_context))
+        self.cv = threading.Condition()
+        self._queue: deque = deque()
+        self._free: List[int] = list(range(self.max_slots))
+        self.slots: List[Optional[Slot]] = [None] * self.max_slots
+
+    # -- admission geometry --------------------------------------------------
+    def bucket_for(self, t_p: int) -> Optional[int]:
+        """Smallest prefill bucket holding a ``t_p``-token prompt (the
+        jit cache stays bounded by len(buckets) prefill programs plus
+        the one decode step, not by distinct prompt lengths)."""
+        for b in self.buckets:
+            if t_p <= b:
+                return b
+        return None
+
+    def reject_reason(self, t_p: int, n_new: int) -> Optional[str]:
+        """None when the request fits the slot pool; otherwise why not
+        (the caller falls back to the window-coalescing path, which
+        compiles per exact shape and has no context ceiling)."""
+        if self.bucket_for(t_p) is None:
+            return ("prompt length %d exceeds the largest serving "
+                    "bucket %d" % (t_p, self.buckets[-1]))
+        if t_p + n_new > self.max_context:
+            return ("prompt %d + n_new %d exceeds max_context %d"
+                    % (t_p, n_new, self.max_context))
+        return None
+
+    # -- queue ----------------------------------------------------------------
+    def push(self, req: Dict, ticket: Ticket,
+             max_queue: Optional[int] = None) -> bool:
+        """Enqueue; False when the bound is hit (caller sheds 503)."""
+        with self.cv:
+            if max_queue is not None and len(self._queue) >= max_queue:
+                return False
+            self._queue.append((req, ticket))
+            self.cv.notify_all()
+        return True
+
+    def queue_depth(self) -> int:
+        with self.cv:
+            return len(self._queue)
+
+    def busy_count(self) -> int:
+        with self.cv:
+            return self.max_slots - len(self._free)
+
+    def expire_queued(self, now: Optional[float] = None) -> List[Ticket]:
+        """Remove every expired ticket from the queue (any position) —
+        the failure-path sweep: when ticks cannot run, deadlines must
+        still be honored instead of callers hanging to their full
+        timeout."""
+        with self.cv:
+            live, expired = split_expired(list(self._queue), now)
+            self._queue = deque(live)
+        return expired
+
+    # -- step-boundary transitions -------------------------------------------
+    def take_admissions(self, now: Optional[float] = None
+                        ) -> Tuple[List[Slot], List[Ticket]]:
+        """Move queued requests into free slots (FIFO), dropping
+        expired tickets. Returns (newly filled slots — the engine
+        prefills each, expired tickets — the engine answers 503)."""
+        now = time.time() if now is None else now
+        admissions: List[Slot] = []
+        expired: List[Ticket] = []
+        with self.cv:
+            while self._queue and self._free:
+                req, ticket = self._queue.popleft()
+                if ticket.deadline is not None and now > ticket.deadline:
+                    expired.append(ticket)
+                    continue
+                idx = self._free.pop(0)
+                slot = Slot(idx, req, ticket,
+                            self.bucket_for(len(req["prompt"])))
+                self.slots[idx] = slot
+                admissions.append(slot)
+            # even with no free slot, purge expired tickets from ANY
+            # queue position — a dead ticket behind a live head must
+            # not rot to its handler's silent 504 while the pool is
+            # full
+            live, exp = split_expired(list(self._queue), now)
+            self._queue = deque(live)
+            expired.extend(exp)
+        return admissions, expired
+
+    def retire(self, slot: Slot) -> None:
+        """Free the row — the very next :meth:`take_admissions` can
+        hand it to a queued request. Idempotent: a slot already retired
+        (e.g. by a shutdown abort racing a wedged worker's late
+        ``_finish``) is left alone, so an index can never enter the
+        free list twice."""
+        with self.cv:
+            if self.slots[slot.idx] is not slot:
+                return
+            self.slots[slot.idx] = None
+            self._free.append(slot.idx)
+            self._free.sort()
+            self.cv.notify_all()
+
+    def active(self) -> List[Slot]:
+        with self.cv:
+            return [s for s in self.slots if s is not None]
+
+    def drain(self, reason: str, code: int = 503,
+              retry_after: Optional[float] = 5.0) -> int:
+        """Fail every queued ticket (shutdown); returns the count."""
+        with self.cv:
+            pending = list(self._queue)
+            self._queue.clear()
+        for _req, ticket in pending:
+            ticket.fail(reason, code=code, retry_after=retry_after)
+        return len(pending)

@@ -74,6 +74,23 @@ DESCRIPTIONS = {
         "Prefetcher gets that had to wait for the producer",
     "veles_prefetch_stall_seconds_total":
         "Seconds consumers waited on the prefetch queue",
+    # continuous-batching serving engine (veles_tpu/serving/):
+    # bench.py's gate asserts these read 0 in non-serving runs
+    "veles_serving_admitted_total":
+        "Requests admitted into continuous-batching KV-cache slots",
+    "veles_serving_retired_total":
+        "Slot rows retired (eos_id emitted or own n_new reached)",
+    "veles_serving_prefill_dispatches_total":
+        "Bucketed prefill programs dispatched by the serving engine",
+    "veles_serving_decode_dispatches_total":
+        "Pooled fixed-shape decode steps dispatched by the serving "
+        "engine",
+    "veles_serving_tokens_total":
+        "Tokens emitted by the continuous-batching engine",
+    "veles_serving_queue_wait_seconds_total":
+        "Seconds requests waited in the serving queue before a slot",
+    "veles_serving_expired_total":
+        "Queued generation requests answered 503 past their deadline",
     # model-health observability (telemetry/tensormon.py +
     # telemetry/recorder.py): bench.py's gate asserts the sample/NaN
     # counters read 0 in tensormon-off runs

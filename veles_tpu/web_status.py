@@ -218,6 +218,25 @@ class WebStatusServer(Logger):
                         gauges["veles_sideplane_queue_depth_" + safe] = (
                             st["depth"],
                             "Tasks queued on side-plane lane " + lane)
+                    # continuous-batching serving engines
+                    # (veles_tpu/serving/): occupancy per live engine
+                    # — slot usage, queue depth, program count (no
+                    # rows at all when nothing serves)
+                    from . import serving as _serving
+                    for ename, engine in sorted(
+                            _serving.engines().items()):
+                        safe = _re.sub(r"[^A-Za-z0-9_]", "_", ename)
+                        st = engine.stats()
+                        for gkey, help_frag in (
+                                ("slots_busy", "busy KV-cache slots"),
+                                ("slots", "total KV-cache slots"),
+                                ("queue_depth", "queued requests"),
+                                ("programs", "jitted programs built")):
+                            gauges["veles_serving_%s_%s"
+                                   % (gkey, safe)] = (
+                                st[gkey],
+                                "Serving engine %s: %s"
+                                % (ename, help_frag))
                     # model-health gauges (telemetry/tensormon.py):
                     # grad norm, per-layer update ratios, activation
                     # saturation — empty until the first drained
