@@ -39,6 +39,14 @@ def main(argv=None) -> int:
         #   veles-tpu blackbox dump [--out PATH]
         #   veles-tpu blackbox inspect BLACKBOX.jsonl
         return _blackbox_cli(argv[1:])
+    if argv and argv[0] == "quantize":
+        # quantization subcommand family (veles_tpu/quant/):
+        #   veles-tpu quantize SNAPSHOT [--out PATH] [--granularity G]
+        return _quantize_cli(argv[1:])
+    if argv and argv[0] == "export":
+        # export subcommand family (veles_tpu/export/):
+        #   veles-tpu export serve-artifact MODEL.py --out DIR [...]
+        return _export_cli(argv[1:])
     parser = make_parser()
     # intermixed parsing: config overrides (positionals) may appear
     # between/after flags — see cmdline.parse_args
@@ -60,6 +68,15 @@ def main(argv=None) -> int:
         _root.common.serving.buckets = args.serve_buckets
     if args.serve_max_context is not None:
         _root.common.serving.max_context = args.serve_max_context
+    if args.serve_artifact:
+        _root.common.serving.artifact = args.serve_artifact
+    # quantization policy (veles_tpu/quant/): the flags arm the config
+    # tree; the serving engine (and any programmatic consumer) reads
+    # root.common.quant.*
+    if args.quant_weights:
+        _root.common.quant.weights = True
+    if args.quant_kv:
+        _root.common.quant.kv = True
     level = (logging.WARNING, logging.INFO,
              logging.DEBUG)[min(args.verbose, 2)]
     setup_logging(level=level, tracefile=args.trace_file)
@@ -263,6 +280,154 @@ def _blackbox_cli(argv) -> int:
         for rec in events[-args.tail:]:
             label = rec.get("name") or rec.get("counter") or ""
             print("  tail: %-10s %s" % (rec.get("kind", "?"), label))
+    return 0
+
+
+def _quantize_cli(argv) -> int:
+    """``veles-tpu quantize SNAPSHOT`` — offline int8 weight
+    quantization of a snapshot (veles_tpu/quant/): eligible 2-D matmul
+    weights become per-channel symmetric int8 with scale sidecars,
+    shrinking their bytes ~4x (whole-file ratio depends on the
+    float-kept share: embeddings, optimizer state). The output is an
+    ordinary snapshot —
+    ``load_snapshot`` dequantizes on read, so --snapshot/resume and
+    serving work unchanged anywhere."""
+    import argparse
+    import os
+    import pickle
+    import time
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu quantize",
+        description="int8 snapshot quantization "
+                    "(docs/services.md 'Quantized serving')")
+    parser.add_argument("snapshot", help="snapshot file to quantize")
+    parser.add_argument("--out", default=None,
+                        help="output path (default: insert .int8 "
+                             "before the .pickle extension)")
+    parser.add_argument("--granularity", default=None,
+                        choices=("per_channel", "per_tensor"),
+                        help="scale granularity (default: "
+                             "root.common.quant.granularity)")
+    args = parser.parse_args(argv)
+    from .error import VelesError
+    from .quant import quantize_state
+    from .resilience import checkpoint_chain as chain_mod
+    from .snapshotter import CODECS, load_snapshot
+    out = args.out
+    if out is None:
+        base = args.snapshot
+        marker = ".pickle"
+        if marker not in base:
+            parser.error("cannot derive --out from %r (no .pickle "
+                         "extension); pass --out" % base)
+        idx = base.rindex(marker)
+        out = base[:idx] + ".int8" + base[idx:]
+    try:
+        state = load_snapshot(args.snapshot)
+        qstate, report = quantize_state(state,
+                                        granularity=args.granularity)
+    except (OSError, VelesError) as e:
+        print("quantize failed: %s" % e, file=sys.stderr)
+        return 1
+    opener = open
+    for _codec, (op, ext) in CODECS.items():
+        if ext and out.endswith(ext):
+            opener = op
+            break
+    tmp = out + ".tmp"
+    with opener(tmp, "wb") as fout:
+        pickle.dump(qstate, fout, protocol=pickle.HIGHEST_PROTOCOL)
+    digest = chain_mod.file_sha256(tmp)
+    chain_mod.commit_file(tmp, out)
+    chain_mod.write_manifest(
+        out, sha256=digest, prefix="quantize", runs=0,
+        created=time.time(),
+        checksum=qstate.get("__meta__", {}).get("checksum", ""))
+    out_size = os.path.getsize(out)
+    try:
+        in_size = os.path.getsize(args.snapshot)
+    except OSError:
+        # non-file sources load_snapshot accepts (sqlite://...) have
+        # no size to compare; the quantized output is still reported
+        in_size = None
+    if in_size is None:
+        print("quantized %d tensor(s) (%s): %s -> %s (%.1f KiB)"
+              % (report["params"],
+                 qstate["__meta__"]["quant"]["granularity"],
+                 args.snapshot, out, out_size / 1024))
+    else:
+        print("quantized %d tensor(s) (%s): %s (%.1f KiB) -> %s (%.1f "
+              "KiB, %.2fx)"
+              % (report["params"],
+                 qstate["__meta__"]["quant"]["granularity"],
+                 args.snapshot, in_size / 1024, out, out_size / 1024,
+                 in_size / max(1, out_size)))
+    return 0
+
+
+def _export_cli(argv) -> int:
+    """``veles-tpu export serve-artifact MODEL.py --out DIR`` — build
+    the model (optionally restore a snapshot) and serialize the
+    continuous engine's per-bucket prefill programs plus its one
+    fixed-shape decode step via ``jax.export`` into a package
+    directory (export/serve_artifact.py). Serve it with
+    ``--serve-artifact DIR``: startup then performs zero jit
+    traces/compiles."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu export",
+        description="AOT inference-artifact export "
+                    "(docs/services.md 'AOT serving artifacts')")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    exp = sub.add_parser(
+        "serve-artifact",
+        help="pre-export the serving engine's decode programs")
+    exp.add_argument("model", help="workflow .py (build_workflow())")
+    exp.add_argument("--out", required=True,
+                     help="artifact package directory to write")
+    exp.add_argument("--snapshot", default=None,
+                     help="restore this snapshot before exporting")
+    exp.add_argument("-b", "--backend", default=None,
+                     help="auto | tpu | cpu (the artifact is lowered "
+                          "for this platform)")
+    exp.add_argument("--serve-slots", type=int, default=None)
+    exp.add_argument("--serve-buckets", default=None,
+                     metavar="L1,L2,...")
+    exp.add_argument("--serve-max-context", type=int, default=None)
+    exp.add_argument("--serve-decode-block", type=int, default=None)
+    exp.add_argument("--quant-weights", action="store_true")
+    exp.add_argument("--quant-kv", action="store_true")
+    args = parser.parse_args(argv)
+    if args.backend in ("cpu", "numpy"):
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    if args.quant_weights:
+        root.common.quant.weights = True
+    if args.quant_kv:
+        root.common.quant.kv = True
+    from . import Device_for
+    from .export.serve_artifact import export_serve_artifact
+    module = import_file_as_module(args.model)
+    if not hasattr(module, "build_workflow"):
+        raise VelesError("%s defines no build_workflow()" % args.model)
+    workflow = module.build_workflow()
+    workflow.initialize(device=Device_for(args.backend or "auto"))
+    if args.snapshot:
+        from .snapshotter import resume as snap_resume
+        snap_resume(workflow, args.snapshot)
+    path = export_serve_artifact(
+        workflow, args.out, max_slots=args.serve_slots,
+        buckets=args.serve_buckets,
+        max_context=args.serve_max_context,
+        decode_block=args.serve_decode_block)
+    import json as _json
+    import os as _os
+    with open(_os.path.join(path, "contents.json")) as fin:
+        serving = _json.load(fin)["serving"]
+    print("serve-artifact -> %s (%d programs: %s; serve with "
+          "--serve-artifact %s)"
+          % (path, len(serving["programs"]),
+             ", ".join(sorted(serving["programs"])), path))
     return 0
 
 

@@ -114,6 +114,27 @@ def make_parser() -> argparse.ArgumentParser:
                    help="per-slot KV capacity; requests need "
                         "len(prompt)+n_new <= T to ride the slot pool "
                         "(root.common.serving.max_context)")
+    p.add_argument("--serve-artifact", default=None, metavar="DIR",
+                   help="AOT serve-artifact package (from `veles-tpu "
+                        "export serve-artifact`): the continuous "
+                        "engine loads its pre-exported prefill/decode "
+                        "programs at initialize — zero jit compiles "
+                        "on the serving path "
+                        "(root.common.serving.artifact); a corrupt or "
+                        "mismatched artifact falls back to live jit "
+                        "with a counted warning")
+    # quantization subsystem (veles_tpu/quant/, docs/services.md
+    # "Quantized serving")
+    p.add_argument("--quant-weights", action="store_true",
+                   help="serve with per-channel symmetric int8 decode "
+                        "matmul weights, dequantized on read inside "
+                        "the serving programs "
+                        "(root.common.quant.weights)")
+    p.add_argument("--quant-kv", action="store_true",
+                   help="store the serving KV-cache slot pool int8 "
+                        "with per-slot scales — half the pool HBM at "
+                        "the same --serve-slots "
+                        "(root.common.quant.kv)")
     p.add_argument("--serve-draft", default=None, metavar="MODEL_PY",
                    help="draft model .py for mode=speculative under "
                         "--serve-generate (its build_workflow() is "

@@ -278,6 +278,28 @@ def _default_root() -> Config:
             # per-token scheduling; larger amortizes dispatch overhead
             # at the cost of up to N-1 wasted row-steps per retirement
             "decode_block": 1,
+            # AOT serving artifact (veles-tpu export serve-artifact):
+            # a package directory whose pre-exported prefill/decode
+            # programs the engine loads at initialize — zero jit
+            # traces/compiles on the serving path. "" = live jit.
+            # A missing/corrupt/mismatched artifact falls back to
+            # live jit with a counted warning, never a crash.
+            "artifact": "",
+        },
+        # quantization subsystem (veles_tpu/quant/, docs/services.md
+        # "Quantized serving"): OFF by default — the off path is
+        # bit-identical to a build without the feature (locked by
+        # tests/test_quant.py)
+        "quant": {
+            # per-channel symmetric int8 decode matmul weights,
+            # dequantized on read inside the serving programs
+            "weights": False,
+            # int8 KV-cache slot pool with per-slot/-position scales
+            # (half the pool HBM at the same max_slots)
+            "kv": False,
+            # weight scale granularity: per_channel (one scale per
+            # output column — the accuracy default) | per_tensor
+            "granularity": "per_channel",
         },
         # overlap engine (veles_tpu/overlap/, docs/overlap.md): async
         # side-plane for side-effect units, non-blocking checkpoints,

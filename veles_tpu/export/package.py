@@ -29,7 +29,25 @@ from ..error import VelesError
 #: v2: per-unit "inputs" producer lists (DAG topologies). A v1 chain
 #: reader would silently execute a fan-in package as a chain, so DAG
 #: packages MUST carry the bumped version and readers MUST check it.
-FORMAT_VERSION = 2
+#: v3: optional per-unit "quant" blocks (int8 tensors + scale sidecar
+#: files, veles_tpu/quant/) and the top-level "serving" block of AOT
+#: serve-artifacts (export/serve_artifact.py). Packages carrying
+#: NEITHER are still written as v2 — every existing reader keeps
+#: working; only files a v2 reader would misinterpret get the bump.
+FORMAT_VERSION = 3
+
+
+def required_format_version(quant: bool = False,
+                            serving: bool = False) -> int:
+    """Lowest format_version whose readers understand the features a
+    package actually carries — the ONLY thing writers may stamp.
+    Stamping FORMAT_VERSION itself would make old readers refuse files
+    they could serve; stamping a literal would let a future feature
+    ride under a version whose readers misread it. Extend the
+    conditions here when bumping FORMAT_VERSION."""
+    if quant or serving:
+        return 3
+    return 2
 
 
 def _write_zip(pkg_dir: str, path: str) -> None:
@@ -81,7 +99,8 @@ _EXPORT_KEYS = (
 
 
 def _unit_entry(fwd, pkg_dir: str,
-                inputs: Optional[List[str]] = None) -> Dict[str, Any]:
+                inputs: Optional[List[str]] = None,
+                quant: Optional[str] = None) -> Dict[str, Any]:
     cfg = {}
     for key in _EXPORT_KEYS:
         if hasattr(fwd, key):
@@ -90,6 +109,7 @@ def _unit_entry(fwd, pkg_dir: str,
                 val = list(val)
             cfg[key] = val
     params = {}
+    quant_meta: Dict[str, Any] = {}
     # export_param_arrays merges LoRA deltas into dense weights, so
     # packages (and the C++ runtime) never see adapters. Parameter-free
     # units (InputJoiner) export an empty params map.
@@ -97,11 +117,39 @@ def _unit_entry(fwd, pkg_dir: str,
                      getattr(fwd, "param_arrays", dict))()
     for pname, arr in arrays.items():
         fname = "%s_%s.npy" % (fwd.name, pname)
-        numpy.save(os.path.join(pkg_dir, fname),
-                   numpy.ascontiguousarray(arr.map_read()))
+        mem = numpy.ascontiguousarray(arr.map_read())
+        if quant is not None:
+            # int8 package plane (veles_tpu/quant/): eligible 2-D
+            # matmul weights ship as int8 .npy plus a scale sidecar;
+            # the import path dequantizes, so every consumer still
+            # sees float tensors — the package is just ~4x smaller
+            from ..quant import quantize_tensor
+            qs = quantize_tensor(pname, mem, quant)
+            if qs is not None:
+                from ..telemetry.counters import inc
+                q, scale = qs
+                sname = "%s_%s__scale.npy" % (fwd.name, pname)
+                numpy.save(os.path.join(pkg_dir, fname),
+                           numpy.asarray(q))
+                numpy.save(os.path.join(pkg_dir, sname),
+                           numpy.asarray(scale))
+                params[pname] = fname
+                quant_meta[pname] = {"scheme": "int8",
+                                     "scale": sname,
+                                     "granularity": quant,
+                                     "dtype": str(mem.dtype)}
+                inc("veles_quant_params_total")
+                inc("veles_quant_bytes_saved_total",
+                    max(0, mem.size * mem.dtype.itemsize
+                        - (int(numpy.asarray(q).size)
+                           + int(numpy.asarray(scale).size) * 4)))
+                continue
+        numpy.save(os.path.join(pkg_dir, fname), mem)
         params[pname] = fname
     entry = {"name": fwd.name, "type": fwd.MAPPING, "config": cfg,
              "params": params}
+    if quant_meta:
+        entry["quant"] = quant_meta
     if inputs is not None:
         entry["inputs"] = list(inputs)
     return entry
@@ -132,7 +180,8 @@ def _graph_inputs(units, graph) -> List[List[str]]:
 def package_export(workflow, path: str,
                    input_shape: Optional[List[int]] = None,
                    with_stablehlo: bool = True,
-                   graph: Optional[List[List[str]]] = None) -> str:
+                   graph: Optional[List[List[str]]] = None,
+                   quant: bool = False) -> str:
     """Export the workflow's forward graph (reference:
     Workflow.package_export, veles/workflow.py:868).
 
@@ -140,7 +189,12 @@ def package_export(workflow, path: str,
     its producer names ("@input" = the workflow input), enabling
     fan-in topologies (InputJoiner) beyond the default chain. Units
     must be listed in topological order (the C++ executor refuses
-    forward references, native/src/model.cc ResolveGraph)."""
+    forward references, native/src/model.cc ResolveGraph).
+
+    ``quant``: store eligible 2-D matmul weights int8 with per-channel
+    scale sidecars (granularity from ``root.common.quant``); the
+    package gains per-unit ``quant`` metadata and format_version 3.
+    Import dequantizes, so consumers are unchanged."""
     forwards = getattr(workflow, "forwards", None)
     if not forwards:
         raise VelesError("workflow %s has no forward chain to export"
@@ -162,17 +216,35 @@ def package_export(workflow, path: str,
             raise VelesError("cannot infer input shape; pass input_shape")
         input_shape = list(first.input.shape)
 
+    granularity = None
+    if quant:
+        from ..quant.weights import granularity_from_config
+        from ..resilience.faults import fire as fire_fault
+        from ..telemetry.counters import inc
+        fire_fault("quant.calibrate")
+        granularity = granularity_from_config()
+        # same tally contract as quantize_params: one calibration pass
+        # per export, each quantized tensor counted in _unit_entry
+        inc("veles_quant_calibrations_total")
     in_names = _graph_inputs(forwards, graph)
-    units = [_unit_entry(f, pkg_dir, inputs=ins)
+    units = [_unit_entry(f, pkg_dir, inputs=ins, quant=granularity)
              for f, ins in zip(forwards, in_names)]
+    quantized = any("quant" in u for u in units)
     contents = {
-        "format_version": FORMAT_VERSION,
+        # plain packages stay v2 (every deployed reader accepts them);
+        # only the quant plane — which a v2 reader would misread as
+        # float tensors — forces the v3 stamp
+        "format_version": required_format_version(quant=quantized),
         "workflow": workflow.name,
         "checksum": workflow.checksum(),
         "input_shape": list(input_shape),
         "input_dtype": "float32",
         "units": units,
     }
+    if quantized:
+        contents["quant"] = {"granularity": granularity,
+                             "params": sum(len(u.get("quant", {}))
+                                           for u in units)}
     if with_stablehlo:
         try:
             contents["stablehlo"] = _export_stablehlo(
@@ -248,9 +320,28 @@ def package_import(path: str) -> Dict[str, Any]:
                 % (version, FORMAT_VERSION))
         params: Dict[str, Dict[str, numpy.ndarray]] = {}
         for unit in contents["units"]:
-            params[unit["name"]] = {
-                pname: numpy.load(os.path.join(path, fname))
-                for pname, fname in unit["params"].items()}
+            quant = unit.get("quant", {})
+            uparams = {}
+            for pname, fname in unit["params"].items():
+                arr = numpy.load(os.path.join(path, fname))
+                meta = quant.get(pname)
+                if meta is not None:
+                    # v3 int8 plane: dequantize on read so every
+                    # consumer (run_package, the C++ loader's python
+                    # oracle) still sees float tensors
+                    if meta.get("scheme") != "int8":
+                        raise VelesError(
+                            "package %s: unknown quant scheme %r for "
+                            "%s.%s" % (path, meta.get("scheme"),
+                                       unit["name"], pname))
+                    scale = numpy.load(
+                        os.path.join(path, meta["scale"]))
+                    from ..ops.precision import dequantize_int8
+                    arr = numpy.asarray(dequantize_int8(
+                        arr, scale, dtype=meta.get("dtype",
+                                                   "float32")))
+                uparams[pname] = arr
+            params[unit["name"]] = uparams
     finally:
         if tmp is not None:
             # arrays are loaded into memory above; the extracted copy

@@ -1,0 +1,193 @@
+"""AOT serving artifacts: pre-exported decode programs in a package.
+
+The cold-start closer of ROADMAP item 3 (reference analog:
+``Workflow.package_export`` → ``libVeles/src/workflow_loader.cc``
+consuming pre-built units instead of re-deriving them): the serving
+engine's whole program surface — one prefill per bucket plus the ONE
+fixed-shape decode step — is serialized through ``jax.export`` into a
+package directory:
+
+    <pkg>/contents.json           format_version 3 with a "serving"
+                                  block: knobs, abstract input
+                                  signature, program file table
+    <pkg>/serve_prefill_<B>.bin   jax.export artifact per bucket
+    <pkg>/serve_decode.bin        the fixed-shape decode step
+
+``ContinuousEngine`` loads the artifact at :meth:`start` and installs
+the deserialized programs straight into its program cache, so serving
+performs ZERO jit traces/compiles (parameters stay runtime arguments
+— the artifact is valid across checkpoints, training between bursts
+included; only shape/knob/quant-policy changes invalidate it, which
+the stamped signature catches at load).
+
+Produce with ``veles-tpu export serve-artifact MODEL.py --out DIR``;
+consume with ``--serve-artifact DIR`` (or
+``root.common.serving.artifact``). A corrupt or mismatched artifact
+falls back to live jit with a counted warning — never an outage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+from ..error import VelesError
+
+#: bumped when the serving-block layout or program calling convention
+#: changes; readers refuse newer artifacts instead of guessing
+ARTIFACT_VERSION = 1
+
+
+def _specs_of(tree):
+    import jax
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), tree)
+
+
+def export_serve_artifact(workflow, path: str,
+                          max_slots: Optional[int] = None,
+                          buckets=None,
+                          max_context: Optional[int] = None,
+                          decode_block: Optional[int] = None,
+                          quant_weights: Optional[bool] = None,
+                          quant_kv: Optional[bool] = None) -> str:
+    """Export the continuous engine's programs for ``workflow`` into
+    the package directory ``path``. Knobs default exactly like
+    ``GenerationAPI`` (``root.common.serving.*`` /
+    ``root.common.quant.*``), so an artifact exported with the same
+    config a server will boot with is guaranteed to match its
+    signature."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+    from ..config import root
+    from ..serving.engine import ContinuousEngine
+
+    serving_cfg = root.common.serving
+    engine = ContinuousEngine(
+        workflow,
+        max_slots=int(max_slots if max_slots is not None
+                      else serving_cfg.get("max_slots", 8)),
+        buckets=(buckets if buckets is not None
+                 else serving_cfg.get("buckets", [16, 32, 64, 128])),
+        max_context=int(max_context if max_context is not None
+                        else serving_cfg.get("max_context", 640)),
+        decode_block=int(decode_block if decode_block is not None
+                         else serving_cfg.get("decode_block", 1)),
+        quant_weights=quant_weights, quant_kv=quant_kv,
+        name="serve_artifact_export")
+    signature = engine.stack_signature()
+    params = engine._prepare_params()
+    engine._ensure_pool(params)
+    params_spec = _specs_of(params)
+    caches_spec = _specs_of(engine._caches)
+    slots = engine.max_slots
+    keys_spec = jax.ShapeDtypeStruct((slots, 2), jnp.uint32)
+    seed_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    f32 = jax.ShapeDtypeStruct((), jnp.float32)
+
+    os.makedirs(path, exist_ok=True)
+    programs: Dict[str, str] = {}
+    for bucket in engine.buckets:
+        exported = jexport.export(engine._build_prefill(bucket))(
+            params_spec,
+            jax.ShapeDtypeStruct((1, bucket), jnp.int32),
+            i32, i32, f32, seed_spec, keys_spec, caches_spec)
+        fname = "serve_prefill_%d.bin" % bucket
+        with open(os.path.join(path, fname), "wb") as fout:
+            fout.write(exported.serialize())
+        programs["prefill_%d" % bucket] = fname
+    exported = jexport.export(engine._build_decode())(
+        params_spec,
+        jax.ShapeDtypeStruct((slots,), jnp.int32),
+        jax.ShapeDtypeStruct((slots,), jnp.int32),
+        jax.ShapeDtypeStruct((slots,), jnp.float32),
+        keys_spec, caches_spec)
+    with open(os.path.join(path, "serve_decode.bin"), "wb") as fout:
+        fout.write(exported.serialize())
+    programs["decode"] = "serve_decode.bin"
+
+    from .package import required_format_version
+    contents = {
+        # the serving block is a v3 feature: v2 readers must refuse
+        # rather than silently ignore the programs they came for
+        "format_version": required_format_version(serving=True),
+        "workflow": workflow.name,
+        "checksum": workflow.checksum(),
+        # program-only package: params stay RUNTIME inputs (the
+        # artifact survives further training), so no unit tensors ride
+        # along — package_import still reads it (empty unit list)
+        "units": [],
+        "serving": {
+            "artifact_version": ARTIFACT_VERSION,
+            "jax_version": jax.__version__,
+            "signature": signature,
+            "programs": programs,
+        },
+    }
+    with open(os.path.join(path, "contents.json"), "w") as fout:
+        json.dump(contents, fout, indent=2)
+    return path
+
+
+def load_serve_programs(path: str, expect_signature: Dict
+                        ) -> Dict[Tuple[str, Optional[int]], object]:
+    """Read an artifact directory and deserialize every program. The
+    stored abstract signature must equal ``expect_signature`` (the
+    loading engine's knobs, quant policy and parameter/pool specs) —
+    shape-committed programs must never run on reinterpreted buffers.
+    Raises :class:`VelesError` on ANY problem; the engine converts
+    that into its counted live-jit fallback."""
+    from jax import export as jexport
+    contents_path = os.path.join(path, "contents.json")
+    try:
+        with open(contents_path) as fin:
+            contents = json.load(fin)
+    except (OSError, ValueError) as e:
+        raise VelesError("serve-artifact %s unreadable: %s"
+                         % (contents_path, e)) from e
+    serving = contents.get("serving")
+    if not isinstance(serving, dict):
+        raise VelesError(
+            "package %s carries no serving block (format_version %s) — "
+            "not a serve-artifact" % (path,
+                                      contents.get("format_version")))
+    version = int(serving.get("artifact_version", 0))
+    if version > ARTIFACT_VERSION:
+        raise VelesError(
+            "serve-artifact version %d is newer than this reader (%d)"
+            % (version, ARTIFACT_VERSION))
+    stored = json.dumps(serving.get("signature"), sort_keys=True)
+    expected = json.dumps(expect_signature, sort_keys=True)
+    if stored != expected:
+        raise VelesError(
+            "serve-artifact %s was exported for a different "
+            "model/knob/quant configuration — re-export it "
+            "(veles-tpu export serve-artifact)" % path)
+    programs: Dict[Tuple[str, Optional[int]], object] = {}
+    for label, fname in serving.get("programs", {}).items():
+        try:
+            with open(os.path.join(path, fname), "rb") as fin:
+                blob = fin.read()
+            exported = jexport.deserialize(bytearray(blob))
+        except Exception as e:      # noqa: BLE001 — one fallback path
+            raise VelesError("serve-artifact program %s corrupt: %s: %s"
+                             % (fname, type(e).__name__, e)) from e
+        if label == "decode":
+            key = ("step", None)
+        elif label.startswith("prefill_"):
+            key = ("prefill", int(label[len("prefill_"):]))
+        else:
+            raise VelesError("serve-artifact %s: unknown program "
+                             "label %r" % (path, label))
+        programs[key] = exported.call
+    want = {("prefill", b)
+            for b in expect_signature.get("buckets", ())}
+    want.add(("step", None))
+    missing = want - set(programs)
+    if missing:
+        raise VelesError("serve-artifact %s is missing programs: %s"
+                         % (path, sorted(missing)))
+    return programs

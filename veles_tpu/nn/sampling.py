@@ -179,6 +179,51 @@ def _block_step(block, p, x_t, cache_k, cache_v, pos):
         cache_k, cache_v
 
 
+def _embed_prompt(stem, pos_emb, params, ids, pos0=0):
+    """(B, T) token ids → (B, T, D): embedding-table gather plus the
+    positional rows ``pos0..pos0+T`` — THE stack entry every prompt
+    consumer shares (the sampler, the serving engine's bucketed
+    prefill, :func:`prompt_logits`). One definition, so a change to
+    how the stack enters (a new pos-emb variant, a promotion tweak)
+    cannot drift between the serving programs and the float reference
+    the quantization gate measures against."""
+    import jax.numpy as jnp
+    x = jnp.take(params[stem.name]["table"], ids.astype(jnp.int32),
+                 axis=0, mode="clip")
+    if pos_emb is not None:
+        idx = pos0 + jnp.arange(ids.shape[-1])
+        x = x + jnp.take(params[pos_emb.name]["table"], idx,
+                         axis=0, mode="clip")[None]
+    return x
+
+
+def _prefill_blocks(blocks, params, x, cache_len, dim):
+    """Run every transformer block's ``_block_prefill`` over fresh
+    zero K/V caches of ``cache_len`` rows → (x, [(ck, cv), ...]) —
+    the shared prompt forward. Each block shapes its OWN cache (the
+    layers config allows heterogeneous n_heads; with GQA the cache
+    holds the unrepeated n_kv_heads rows)."""
+    import jax.numpy as jnp
+    b = x.shape[0]
+    caches = []
+    for blk in blocks:
+        bkv = getattr(blk, "n_kv_heads", blk.n_heads)
+        hd = dim // blk.n_heads
+        ck = jnp.zeros((b, cache_len, bkv, hd), x.dtype)
+        cv = jnp.zeros((b, cache_len, bkv, hd), x.dtype)
+        x, ck, cv = _block_prefill(blk, params[blk.name], x, ck, cv)
+        caches.append((ck, cv))
+    return x, caches
+
+
+def _head_logits(head, params, x_last, prec):
+    """Vocabulary head projection, shared by the same three consumers
+    as :func:`_embed_prompt`."""
+    import jax.numpy as jnp
+    return (jnp.dot(x_last, params[head.name]["weights"],
+                    precision=prec) + params[head.name]["bias"])
+
+
 def _build_sampler(wf, t_p, n_new, temperature):
     """Compile-once generation program for one (prompt length, n_new,
     temperature) shape; params are ARGUMENTS (not baked constants), so
@@ -204,13 +249,7 @@ def _build_sampler(wf, t_p, n_new, temperature):
     greedy = temperature <= 0
 
     def embed(params, ids, pos0):
-        x = jnp.take(params[stem.name]["table"],
-                     ids.astype(jnp.int32), axis=0, mode="clip")
-        if pos_emb is not None:
-            table = params[pos_emb.name]["table"]
-            idx = pos0 + jnp.arange(ids.shape[-1])
-            x = x + jnp.take(table, idx, axis=0, mode="clip")[None]
-        return x
+        return _embed_prompt(stem, pos_emb, params, ids, pos0)
 
     def sample(logits, keys):
         """``logits`` (B, V), ``keys`` (B, 2): every row draws from its
@@ -228,26 +267,13 @@ def _build_sampler(wf, t_p, n_new, temperature):
         )(keys, logits).astype(jnp.int32)
 
     def head_logits(params, x_last):
-        return (jnp.dot(x_last, params[head.name]["weights"],
-                        precision=prec) + params[head.name]["bias"])
+        return _head_logits(head, params, x_last, prec)
 
     @_count_decode_dispatches
     @jax.jit
     def run(params, prompt_ids, keys):
-        b = prompt_ids.shape[0]
         x = embed(params, prompt_ids, 0)       # (B, T_p, D)
-        caches = []
-        for blk in blocks:
-            # each block's OWN head counts: the layers config allows
-            # heterogeneous n_heads per block, and a cache shaped from
-            # blocks[0] trace-fails with an opaque reshape error. With
-            # GQA the cache holds the unrepeated n_kv_heads rows.
-            bkv = getattr(blk, "n_kv_heads", blk.n_heads)
-            hd = d // blk.n_heads
-            ck = jnp.zeros((b, t_max, bkv, hd), x.dtype)
-            cv = jnp.zeros((b, t_max, bkv, hd), x.dtype)
-            x, ck, cv = _block_prefill(blk, params[blk.name], x, ck, cv)
-            caches.append((ck, cv))
+        x, caches = _prefill_blocks(blocks, params, x, t_max, d)
         # keys (B, 2): one independent stream per row (see sample)
         keys, subs = _split_rows(keys)
         first = sample(head_logits(params, x[:, -1]), subs)   # (B,)
@@ -270,6 +296,28 @@ def _build_sampler(wf, t_p, n_new, temperature):
         return toks                                  # (n_new, B)
 
     return run
+
+
+def prompt_logits(wf, prompt, params=None):
+    """Last-position logits for ``prompt`` through the cached-decode
+    prefill path (``_block_prefill`` + head) — the float reference the
+    quantization bench measures its max-logit-delta against. ``params``
+    overrides the workflow's own tree (pass a
+    dequantize(quantize(...)) twin to measure pure quantization
+    error). Eager, host-sized: a measurement helper, not a serving
+    path."""
+    import jax.numpy as jnp
+    from ..ops import matmul_precision
+    stack = split_stack(list(wf.forwards))
+    stem, pos_emb = stack["stem"], stack["pos_emb"]
+    blocks, head = stack["blocks"], stack["head"]
+    prec = matmul_precision()
+    if params is None:
+        params = params_of(wf)
+    ids = jnp.asarray(numpy.asarray(prompt, numpy.int32))[None]
+    x = _embed_prompt(stem, pos_emb, params, ids)
+    x, _ = _prefill_blocks(blocks, params, x, ids.shape[-1], stem.dim)
+    return numpy.asarray(_head_logits(head, params, x[0, -1], prec))
 
 
 def _split_rows(keys):

@@ -31,3 +31,80 @@ def promote_operands(x, w):
     import jax.numpy as jnp
     ct = jnp.promote_types(x.dtype, w.dtype)
     return x.astype(ct), w.astype(ct), ct
+
+
+# -- int8 quantization primitives (veles_tpu/quant/) -----------------------
+#
+# Symmetric linear quantization: q = round(x / s) clipped to [-127, 127],
+# x̂ = q · s, with s = max|x| / 127 over the reduction group. "per_channel"
+# keeps one scale per OUTPUT column of a 2-D weight (axis -1 — the
+# granularity that survives a matmul: column j of W only ever multiplies
+# into output j, so its scale factors out exactly); "per_tensor" keeps one
+# scalar. The same functions trace under jit (dequant-on-read in the
+# serving decode programs) and run eagerly on host arrays (the offline
+# ``veles-tpu quantize`` CLI) — numpy inputs round-trip through jax on
+# CPU, so the two paths cannot disagree on rounding.
+
+#: symmetric int8 clip bound (−128 is unused so +x and −x quantize
+#: symmetrically — the standard inference-quantization convention)
+INT8_QMAX = 127.0
+
+
+def quantize_int8(arr, axis=None):
+    """``arr`` (float) → ``(q int8, scale f32)``. ``axis=None`` = one
+    scalar scale (per-tensor); ``axis=k`` = per-channel scales along
+    that axis (scale keeps ``arr``'s rank with size-1 reduced dims, so
+    ``q * scale`` broadcasts back without bookkeeping). All-zero groups
+    get scale 1 so dequantization never divides by or multiplies with
+    junk."""
+    import jax.numpy as jnp
+    arr = jnp.asarray(arr)
+    if axis is None:
+        red = None
+    else:
+        axis = axis % arr.ndim
+        red = tuple(i for i in range(arr.ndim) if i != axis)
+    amax = jnp.max(jnp.abs(arr.astype(jnp.float32)), axis=red,
+                   keepdims=axis is not None)
+    scale = jnp.where(amax > 0, amax / INT8_QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(arr.astype(jnp.float32) / scale),
+                 -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_int8(q, scale, dtype=None):
+    """``q · scale`` back to float (``dtype`` defaults to the scale's
+    float32). Trace-safe: this is THE dequant-on-read the serving decode
+    programs inline in front of their matmuls — XLA fuses it into the
+    consumer, so int8 is the *storage* format while the MXU still sees
+    its usual float operands."""
+    import jax.numpy as jnp
+    out = jnp.asarray(q).astype(jnp.float32) * jnp.asarray(scale)
+    return out if dtype is None else out.astype(dtype)
+
+
+def quantize_rows_int8(x):
+    """Per-row symmetric int8 for KV-cache tensors: ``x``
+    (..., T, H, Dh) → ``(q int8, scales (..., T) f32)`` — one scale per
+    cached position, amax-reduced over the row's (H, Dh) block. The
+    row is the natural KV group: a decode step writes exactly one new
+    position, so its scale is computed once and never revised, and
+    re-quantizing an untouched row with its own unchanged scale is
+    bit-exact (round(q·s/s) == q)."""
+    import jax.numpy as jnp
+    x = jnp.asarray(x)
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=(-2, -1))
+    scale = jnp.where(amax > 0, amax / INT8_QMAX, 1.0).astype(jnp.float32)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32)
+                           / scale[..., None, None]),
+                 -INT8_QMAX, INT8_QMAX).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_rows_int8(q, scale, dtype=None):
+    """Inverse of :func:`quantize_rows_int8` (scales broadcast back
+    over each position's (H, Dh) block)."""
+    import jax.numpy as jnp
+    out = (jnp.asarray(q).astype(jnp.float32)
+           * jnp.asarray(scale)[..., None, None])
+    return out if dtype is None else out.astype(dtype)

@@ -1,0 +1,57 @@
+"""int8 KV-cache storage for the serving slot pool.
+
+The continuous engine's pool is ``max_slots × max_context`` rows per
+block — HBM-resident for the life of the server, sized for the worst
+case, mostly cold. Storing it int8 with per-slot, per-position scales
+halves that residency vs bf16 (4× vs f32) at the same ``max_slots``:
+
+    float block:  (S, T, H, Dh) ck + cv                 — dtype bytes
+    int8  block:  (S, T, H, Dh) int8 ck + cv
+                  + (S, T) f32 k/v scale sidecars       — ~1 byte + ε
+
+One scale per cached POSITION is the lossless-bookkeeping choice for
+an append-only cache: prefill fixes the scales of the prompt rows in
+one pass, each decode step writes exactly one new row with its own
+fresh scale, and no already-written row is ever re-scaled — so there
+is no error accumulation across steps, only the one-time rounding of
+each row at write time. Dequant-on-read happens inside the jitted
+step (``ops.precision.dequantize_rows_int8``); XLA fuses it into the
+attention reads, so the MXU math — and the masking, and the PRNG —
+is byte-for-byte the float engine's.
+
+The numeric core lives in ``ops/precision.py``; this module owns the
+pool *shapes* so the engine and its tests agree on the layout.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..ops.precision import (dequantize_rows_int8,  # noqa: F401
+                             quantize_rows_int8)
+
+
+def block_pool(max_slots: int, max_context: int, n_kv: int, hd: int,
+               dtype, quantized: bool) -> Tuple:
+    """One transformer block's pool state. Float: ``(ck, cv)``.
+    Quantized: ``(ck_q, cv_q, k_scale, v_scale)`` — int8 payloads plus
+    f32 per-(slot, position) scale sidecars. Zero-initialized
+    throughout: scale 0 dequantizes untouched rows to exact 0.0, the
+    same content the float pool starts with."""
+    import jax.numpy as jnp
+    shape = (max_slots, max_context, n_kv, hd)
+    if not quantized:
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+    return (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+            jnp.zeros((max_slots, max_context), jnp.float32),
+            jnp.zeros((max_slots, max_context), jnp.float32))
+
+
+def pool_nbytes(caches) -> int:
+    """Total bytes of a pool (all blocks, payloads + scale sidecars) —
+    the number the HBM-halving claim is asserted on."""
+    total = 0
+    for block in caches or ():
+        for arr in block:
+            total += arr.size * arr.dtype.itemsize
+    return int(total)

@@ -1,0 +1,290 @@
+"""int8 weight quantization: parameter trees and snapshots.
+
+Two call surfaces, one numeric core (``ops/precision.py``):
+
+- :func:`quantize_params` turns a serving parameter pytree
+  (``nn.sampling.params_of``'s ``{unit: {name: array}}``) into its
+  quantized twin, where every eligible 2-D matmul weight becomes a
+  ``{"q": int8, "scale": f32}`` sub-dict — still a valid pytree, so the
+  jitted decode programs take it as an argument and
+  :func:`dequantize_params` reconstructs float weights INSIDE the
+  trace (dequant-on-read; XLA fuses the ``q·s`` into the consuming
+  matmul).
+- :func:`quantize_state` / :func:`dequantize_state` do the same to a
+  snapshot state tree (the ``veles-tpu quantize <snapshot>`` CLI):
+  eligible arrays in every unit's ``state_dict`` are replaced by a
+  ``{"__quant__": "int8", ...}`` record. ``snapshotter.load_snapshot``
+  dequantizes on read, so a quantized snapshot resumes anywhere a
+  plain one does — at roughly a quarter of the bytes.
+
+Eligibility is structural, not name-listed: 2-D float arrays that are
+not embedding ``table``s (gather sources stay exact — their rows ARE
+the activations) and clear the ``min_elements`` floor. Biases, norm
+gains and PRNG state are 1-D and never touched.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy
+
+from ..config import root
+from ..ops.precision import dequantize_int8, quantize_int8
+from ..resilience.faults import fire as fire_fault
+from ..telemetry.counters import inc
+
+#: snapshot-side marker key (a dict wearing it replaces the original
+#: ndarray; readers reconstruct via dequantize_state)
+STATE_MARKER = "__quant__"
+
+GRANULARITIES = ("per_channel", "per_tensor")
+
+#: arrays smaller than this stay float: the scale sidecar + risk beats
+#: the saving on tiny tensors
+MIN_ELEMENTS = 256
+
+
+def granularity_from_config() -> str:
+    g = str(root.common.quant.get("granularity", "per_channel"))
+    if g not in GRANULARITIES:
+        from ..error import VelesError
+        raise VelesError("quant granularity %r not in %s"
+                         % (g, GRANULARITIES))
+    return g
+
+
+def _resolve_granularity(granularity: str = None) -> str:
+    """Default + validate in one place (every public entry point)."""
+    granularity = granularity or granularity_from_config()
+    if granularity not in GRANULARITIES:
+        from ..error import VelesError
+        raise VelesError("quant granularity %r not in %s"
+                         % (granularity, GRANULARITIES))
+    return granularity
+
+
+def _eligible(name: str, arr) -> bool:
+    if getattr(arr, "ndim", 0) != 2 or name == "table":
+        return False
+    if arr.size < MIN_ELEMENTS:
+        return False
+    kind = numpy.dtype(getattr(arr, "dtype", numpy.float32)).kind
+    return kind == "f"
+
+
+def _axis_for(granularity: str):
+    return -1 if granularity == "per_channel" else None
+
+
+def is_quantized_params(params: Dict[str, Dict[str, Any]]) -> bool:
+    """True when ``params`` carries at least one quantized leaf."""
+    for unit in params.values():
+        for val in unit.values():
+            if isinstance(val, dict) and "q" in val:
+                return True
+    return False
+
+
+def _calibrate(units: Dict[str, Any], granularity: str, make_record,
+               eligible=_eligible
+               ) -> Tuple[Dict[str, Any], Dict[str, int]]:
+    """THE calibration walk (amax scan + int8 conversion) shared by
+    the serving-side (:func:`quantize_params`) and snapshot-side
+    (:func:`quantize_state`) quantizers — one eligibility pass, one
+    byte tally, one set of counter increments, so the two surfaces
+    cannot drift. ``granularity`` is already resolved; the
+    ``quant.calibrate`` fault point fires at the head so chaos runs
+    can prove consumers degrade instead of dying when calibration
+    does. Non-dict unit entries ride through untouched."""
+    fire_fault("quant.calibrate")
+    axis = _axis_for(granularity)
+    out: Dict[str, Any] = {}
+    n = before = after = 0
+    for uname, uparams in units.items():
+        if not isinstance(uparams, dict):
+            out[uname] = uparams
+            continue
+        qp = {}
+        for pname, arr in uparams.items():
+            if eligible(pname, arr):
+                q, scale = quantize_int8(arr, axis=axis)
+                qp[pname] = make_record(arr, q, scale)
+                n += 1
+                itemsize = numpy.dtype(str(arr.dtype)).itemsize
+                before += arr.size * itemsize
+                after += q.size + scale.size * 4
+            else:
+                qp[pname] = arr
+        out[uname] = qp
+    inc("veles_quant_calibrations_total")
+    if n:
+        inc("veles_quant_params_total", n)
+        inc("veles_quant_bytes_saved_total", max(0, before - after))
+    return out, {"params": n, "bytes_before": before,
+                 "bytes_after": after}
+
+
+def quantize_tensor(name: str, arr, granularity: str = None):
+    """Single-tensor surface for OTHER package writers
+    (``export/package.py``): ``(q, scale)`` when ``(name, arr)`` is an
+    eligible matmul weight, else ``None`` — eligibility and the axis
+    policy stay defined in exactly one place."""
+    granularity = _resolve_granularity(granularity)
+    if not _eligible(name, arr):
+        return None
+    return quantize_int8(arr, axis=_axis_for(granularity))
+
+
+def quantize_params(params: Dict[str, Dict[str, Any]],
+                    granularity: str = None
+                    ) -> Tuple[Dict[str, Dict[str, Any]], Dict[str, int]]:
+    """Serving parameter pytree → (quantized pytree, report).
+
+    Calibration runs once per parameter refresh via the shared
+    :func:`_calibrate` walk. The report carries
+    ``{"params", "bytes_before", "bytes_after"}``; counters
+    ``veles_quant_params_total`` / ``veles_quant_bytes_saved_total`` /
+    ``veles_quant_calibrations_total`` tally the same numbers."""
+    granularity = _resolve_granularity(granularity)
+    return _calibrate(params, granularity,
+                      lambda arr, q, scale: {"q": q, "scale": scale})
+
+
+def quantize_params_spec(params: Dict[str, Dict[str, Any]],
+                         granularity: str = None
+                         ) -> Dict[str, Dict[str, Any]]:
+    """Abstract twin of :func:`quantize_params`: the (shape, dtype)
+    tree the quantized params WILL have, computed without running the
+    amax calibration — no device work, no counters, no
+    ``quant.calibrate`` fault point. This is what
+    ``ContinuousEngine.stack_signature`` stamps into / checks against
+    AOT serve-artifacts, so a signature compare never pays (or
+    miscounts) a calibration pass."""
+    import jax
+    granularity = _resolve_granularity(granularity)
+    axis = _axis_for(granularity)
+    out: Dict[str, Dict[str, Any]] = {}
+    for uname, uparams in params.items():
+        qp = {}
+        for pname, arr in uparams.items():
+            if _eligible(pname, arr):
+                if axis is None:
+                    sshape = ()              # per-tensor scalar scale
+                else:
+                    ax = axis % arr.ndim     # keepdims amax reduction
+                    sshape = tuple(n if i == ax else 1
+                                   for i, n in enumerate(arr.shape))
+                qp[pname] = {
+                    "q": jax.ShapeDtypeStruct(tuple(arr.shape),
+                                              numpy.int8),
+                    "scale": jax.ShapeDtypeStruct(sshape,
+                                                  numpy.float32),
+                }
+            else:
+                qp[pname] = jax.ShapeDtypeStruct(
+                    tuple(arr.shape), numpy.dtype(str(arr.dtype)))
+        out[uname] = qp
+    return out
+
+
+def dequantize_params(params: Dict[str, Dict[str, Any]], dtype=None
+                      ) -> Dict[str, Dict[str, Any]]:
+    """Reconstruct the float pytree — trace-safe, so the serving
+    programs call it FIRST and the downstream ``_block_prefill`` /
+    ``_block_step`` math is byte-for-byte the code the float path
+    runs (the subsystem cannot drift from the proven decode)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for uname, uparams in params.items():
+        dp = {}
+        for pname, val in uparams.items():
+            if isinstance(val, dict) and "q" in val:
+                dp[pname] = dequantize_int8(val["q"], val["scale"],
+                                            dtype=dtype)
+            else:
+                dp[pname] = val
+        out[uname] = dp
+    return out
+
+
+# -- snapshot surface (veles-tpu quantize) ----------------------------------
+
+def quantize_state(state: Dict[str, Any], granularity: str = None
+                   ) -> Tuple[Dict[str, Any], Dict[str, int]]:
+    """Snapshot state tree → quantized twin (new dict; input is not
+    mutated). Only ``__units__`` entries are touched; PRNG streams and
+    meta ride through untouched. Same :func:`_calibrate` walk as
+    :func:`quantize_params` — only the per-leaf record differs (the
+    snapshot marker carries the source dtype so resume restores it)."""
+    granularity = _resolve_granularity(granularity)
+
+    def record(arr, q, scale):
+        return {
+            STATE_MARKER: "int8",
+            "q": numpy.asarray(q),
+            "scale": numpy.asarray(scale),
+            "dtype": str(arr.dtype),
+            "granularity": granularity,
+        }
+
+    units, report = _calibrate(
+        state.get("__units__", {}), granularity, record,
+        # state trees hold arbitrary pickled values (nested opt-state
+        # dicts, scalars); only real host ndarrays are candidates
+        eligible=lambda pname, arr: isinstance(arr, numpy.ndarray)
+        and _eligible(pname, arr))
+    out = dict(state)
+    out["__units__"] = units
+    meta = dict(out.get("__meta__", {}))
+    meta["quant"] = {"granularity": granularity,
+                     "params": report["params"]}
+    out["__meta__"] = meta
+    return out, report
+
+
+def dequantize_state(state: Dict[str, Any]) -> Dict[str, Any]:
+    """Expand quantized records back to float ndarrays — the pass
+    ``load_snapshot`` applies on every read, so no consumer ever sees
+    a marker. A state tree without markers passes through unchanged
+    (same object; the common case costs a dict walk). Marked records
+    this build cannot read raise :class:`VelesError` — mirroring
+    ``package_import``'s refusal — rather than riding through as raw
+    dicts that blow up far from the cause in ``apply_state``."""
+    from ..error import VelesError
+    units = state.get("__units__")
+    if not isinstance(units, dict):
+        return state
+    changed = False
+    new_units = {}
+    for uname, sd in units.items():
+        if not isinstance(sd, dict):
+            new_units[uname] = sd
+            continue
+        nsd = {}
+        for pname, val in sd.items():
+            if isinstance(val, dict) and STATE_MARKER in val:
+                scheme = val[STATE_MARKER]
+                if scheme != "int8":
+                    raise VelesError(
+                        "snapshot: unknown quant scheme %r for %s.%s "
+                        "— this build reads int8 only (version skew? "
+                        "re-quantize with this veles-tpu)"
+                        % (scheme, uname, pname))
+                if "q" not in val or "scale" not in val:
+                    raise VelesError(
+                        "snapshot: quant record for %s.%s is missing "
+                        "its q/scale tensors — the snapshot is "
+                        "corrupt or was written by a broken quantizer"
+                        % (uname, pname))
+                nsd[pname] = numpy.asarray(dequantize_int8(
+                    val["q"], val["scale"],
+                    dtype=val.get("dtype", "float32")))
+                changed = True
+            else:
+                nsd[pname] = val
+        new_units[uname] = nsd
+    if not changed:
+        return state
+    out = dict(state)
+    out["__units__"] = new_units
+    return out

@@ -97,6 +97,15 @@ for _name, _desc in (
     ("recorder.dump", "FlightRecorder.dump, before the black-box "
                       "file is written (corrupt: damage the dump "
                       "bytes)"),
+    # quantization subsystem (veles_tpu/quant/): chaos for the AOT/
+    # int8 serving plane — a failed artifact load or calibration must
+    # degrade to live-jit / float serving, never crash the API
+    ("artifact.load", "serving engine, before an AOT serve-artifact "
+                      "is deserialized (raise falls back to live jit "
+                      "with a counted warning)"),
+    ("quant.calibrate", "weight quantization scale calibration "
+                        "(quantize_params/quantize_state), before "
+                        "the amax scan"),
 ):
     register_point(_name, _desc)
 

@@ -254,7 +254,9 @@ class GenerationAPI(Unit):
                  max_queue: int = None, engine: str = None,
                  max_slots: int = None, buckets=None,
                  max_context: int = None,
-                 decode_block: int = None, **kwargs) -> None:
+                 decode_block: int = None,
+                 quant_weights: bool = None, quant_kv: bool = None,
+                 artifact: str = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
         #: the TARGET model workflow is the unit's own workflow; an
@@ -286,6 +288,13 @@ class GenerationAPI(Unit):
         self.decode_block = int(
             decode_block if decode_block is not None
             else serving_cfg.get("decode_block", 1))
+        # quantization / AOT-artifact policy (veles_tpu/quant/,
+        # docs/services.md "Quantized serving"): None defers to
+        # root.common.quant.* / root.common.serving.artifact inside
+        # the engine, keeping CLI flags, config and kwargs one policy
+        self.quant_weights = quant_weights
+        self.quant_kv = quant_kv
+        self.artifact = artifact
         self._engine = None
         self._service: Optional[HTTPService] = None
         self._queue: list = []
@@ -502,6 +511,9 @@ class GenerationAPI(Unit):
                     buckets=self.buckets,
                     max_context=self.max_context,
                     decode_block=self.decode_block,
+                    quant_weights=self.quant_weights,
+                    quant_kv=self.quant_kv,
+                    artifact=self.artifact,
                     name=self.name).start()
             except VelesError as e:
                 # a stack the slot pool cannot serve (non-LM workflow)
@@ -555,6 +567,18 @@ class GenerationAPI(Unit):
                             "veles_serving_queue_depth":
                                 st["queue_depth"],
                             "veles_serving_programs": st["programs"],
+                            # quantization/AOT mode gauges (veles_tpu/
+                            # quant/): 1 = the plane is active on this
+                            # engine — dashboards must know whether a
+                            # throughput number is fp or int8, live
+                            # jit or artifact
+                            "veles_serving_artifact_mode":
+                                st["artifact_mode"],
+                            "veles_quant_weights_mode":
+                                st["quant_weights"],
+                            "veles_quant_kv_mode": st["quant_kv"],
+                            "veles_serving_kv_pool_bytes":
+                                st["kv_pool_bytes"],
                         })
                     text = metrics_text(gauges)
                     bytes_reply(self, 200, text.encode(),

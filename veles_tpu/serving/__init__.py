@@ -49,6 +49,7 @@ SERVING_COUNTERS = (
     "veles_serving_tokens_total",
     "veles_serving_queue_wait_seconds_total",
     "veles_serving_expired_total",
+    "veles_serving_compile_seconds_total",
 )
 
 #: process-global registry of live engines (web_status /metrics renders

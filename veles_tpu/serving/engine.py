@@ -27,6 +27,24 @@ The per-block cache layout and math are ``nn/sampling.py``'s
 ``_block_prefill`` / ``_block_step`` — the decode step vmaps the very
 same single-row step over the pool, so the engine cannot drift from
 the scan decoder numerically.
+
+Two optional planes ride the same programs (veles_tpu/quant/,
+docs/services.md "Quantized serving"):
+
+- **int8 weights** (``quant_weights``): the decode matmul weights are
+  stored per-channel int8 and dequantized on read at the head of each
+  program — XLA fuses the ``q·s`` into the consuming matmul, so the
+  block math below the dequant is byte-for-byte the float engine's;
+- **int8 KV cache** (``quant_kv``): the slot pool stores int8 rows
+  with per-slot/-position f32 scales — half the pool HBM at the same
+  ``max_slots``; each position is scaled once at write time, so there
+  is no error accumulation across decode steps;
+- **AOT artifact** (``artifact``): ``veles-tpu export serve-artifact``
+  pre-exports every program via ``jax.export``; the engine
+  deserializes them at :meth:`start`, so serving performs ZERO jit
+  traces/compiles (``veles_compiles_total`` stays flat and
+  ``veles_serving_compile_seconds_total`` reads 0). A corrupt or
+  mismatched artifact falls back to live jit with a counted warning.
 """
 
 from __future__ import annotations
@@ -40,9 +58,10 @@ import numpy
 
 from ..error import VelesError
 from ..logger import Logger
-from ..nn.sampling import (_block_prefill, _block_step,
-                           _count_decode_dispatches, _split_rows,
-                           params_of, split_stack)
+from ..nn.sampling import (_block_step, _count_decode_dispatches,
+                           _embed_prompt, _head_logits,
+                           _prefill_blocks, _split_rows, params_of,
+                           split_stack)
 from ..resilience import health
 from ..resilience.faults import FaultInjected, fire as fire_fault
 from ..telemetry.counters import inc
@@ -52,6 +71,22 @@ from ..telemetry.spans import span
 #: program (greedy rows carry temperature 0; their categorical lane is
 #: computed-and-discarded, so the clamp only has to keep it finite)
 _TEMP_EPS = 1e-3
+
+
+def _same_leaves(a: Dict, b: Dict) -> bool:
+    """True when two ``params_of`` trees carry IDENTICAL array objects.
+    ``device_view()`` returns its cached jax array until a host-side
+    update re-places it, so object identity is the cheap 'weights
+    unchanged' test the quantization cache keys on."""
+    if a.keys() != b.keys():
+        return False
+    for u in a:
+        if a[u].keys() != b[u].keys():
+            return False
+        for k in a[u]:
+            if a[u][k] is not b[u][k]:
+                return False
+    return True
 
 
 def make_request(prompt, n_new, temperature=0.0, seed=0, eos_id=None
@@ -77,11 +112,31 @@ class ContinuousEngine(Logger):
     def __init__(self, wf, max_slots: int = 8,
                  buckets: Tuple[int, ...] = (16, 32, 64, 128),
                  max_context: int = 640, decode_block: int = 1,
+                 quant_weights: Optional[bool] = None,
+                 quant_kv: Optional[bool] = None,
+                 artifact: Optional[str] = None,
                  name: str = "serving") -> None:
         super().__init__()
+        from ..config import root
         from .scheduler import SlotScheduler
         self.wf = wf
         self.name = name
+        # quantization policy (root.common.quant.*, CLI --quant-weights
+        # /--quant-kv); both off = bit-identical to the float engine
+        self.quant_weights = bool(
+            root.common.quant.get("weights", False)
+            if quant_weights is None else quant_weights)
+        self.quant_kv = bool(
+            root.common.quant.get("kv", False)
+            if quant_kv is None else quant_kv)
+        # AOT serving artifact (export/serve_artifact.py): loaded at
+        # start(); empty/None = live jit
+        self.artifact = str(
+            root.common.serving.get("artifact", "")
+            if artifact is None else (artifact or ""))
+        self.artifact_mode = False
+        #: live jit traces this engine paid for (0 in artifact mode)
+        self.compiled_live = 0
         # raises VelesError on anything but a generation stack (a bare
         # workflow has no forwards at all — same rejection)
         self.stack = split_stack(list(getattr(wf, "forwards", ()) or ()))
@@ -97,6 +152,7 @@ class ContinuousEngine(Logger):
                            pos_emb.param_arrays()["table"].shape[0])
         self._progs: Dict = {}
         self._params = None
+        self._quant_cache = None   # (float tree, its calibrated twin)
         self._caches = None
         self._keys = None
         self._tok = numpy.zeros(self.max_slots, numpy.int32)
@@ -111,6 +167,8 @@ class ContinuousEngine(Logger):
     def start(self) -> "ContinuousEngine":
         if self._thread is not None:
             return self
+        if self.artifact and not self.artifact_mode:
+            self._load_artifact()
         self._closing = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=self.name + ".engine")
@@ -227,6 +285,7 @@ class ContinuousEngine(Logger):
 
     # -- observability -------------------------------------------------------
     def stats(self) -> Dict[str, float]:
+        from ..quant import pool_nbytes
         return {
             "slots": self.max_slots,
             "slots_busy": self.scheduler.busy_count(),
@@ -234,6 +293,13 @@ class ContinuousEngine(Logger):
             "admitted": self.admitted,
             "retired": self.retired,
             "programs": len(self._progs),
+            # quantization/AOT plane (veles_tpu/quant/): what the
+            # /metrics mode gauges render on both surfaces
+            "artifact_mode": int(self.artifact_mode),
+            "quant_weights": int(self.quant_weights),
+            "quant_kv": int(self.quant_kv),
+            "compiled_live": self.compiled_live,
+            "kv_pool_bytes": pool_nbytes(self._caches),
         }
 
     @property
@@ -301,7 +367,7 @@ class ContinuousEngine(Logger):
         # (weights are frozen while serving, as everywhere in serving).
         params = self._params
         if params is None or self.scheduler.busy_count() == 0:
-            params = self._params = params_of(self.wf)
+            params = self._params = self._prepare_params()
         self._ensure_pool(params)
         from .scheduler import shed_expired
         admissions, expired = self.scheduler.take_admissions()
@@ -334,24 +400,50 @@ class ContinuousEngine(Logger):
                 # fault fires before the dispatch)
                 self._abort_active(str(e), code=503, retry_after=1.0)
 
+    def _prepare_params(self) -> Dict:
+        """Fresh device-side params for the serving programs: the
+        float tree, or its per-channel int8 twin under
+        ``quant_weights``. Calibration is NOT repeated per idle
+        boundary: ``device_view()`` returns the cached jax array until
+        a host-side update re-places it, so leaf identity against the
+        last-calibrated tree tells exactly when the weights actually
+        changed — unchanged weights reuse the quantized twin (a
+        one-request-at-a-time load would otherwise pay a full amax
+        scan per request that the float engine does not), updated
+        weights get fresh scales at the next burst boundary."""
+        params = params_of(self.wf)
+        if not self.quant_weights:
+            return params
+        cached = self._quant_cache
+        if cached is not None and _same_leaves(cached[0], params):
+            return cached[1]
+        from ..quant import quantize_params
+        qparams, _report = quantize_params(params)
+        self._quant_cache = (params, qparams)
+        return qparams
+
     def _ensure_pool(self, params) -> None:
         if self._caches is not None:
             return
         import jax.numpy as jnp
+        from ..quant import block_pool
         stem, blocks = self.stack["stem"], self.stack["blocks"]
-        dtype = params[stem.name]["table"].dtype
+        dtype = self._pool_dtype(params)
         d = stem.dim
         caches = []
         for blk in blocks:
             bkv = getattr(blk, "n_kv_heads", blk.n_heads)
             hd = d // blk.n_heads
-            caches.append(
-                (jnp.zeros((self.max_slots, self.max_context, bkv, hd),
-                           dtype),
-                 jnp.zeros((self.max_slots, self.max_context, bkv, hd),
-                           dtype)))
+            caches.append(block_pool(self.max_slots, self.max_context,
+                                     bkv, hd, dtype, self.quant_kv))
         self._caches = tuple(caches)
         self._keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
+
+    def _pool_dtype(self, params):
+        """Float dtype of the activation path (the stem table's —
+        also under quant_weights, which never touches ``table``)."""
+        stem = self.stack["stem"]
+        return params[stem.name]["table"].dtype
 
     # -- admission ------------------------------------------------------------
     def _admit(self, params, slot) -> None:
@@ -441,16 +533,129 @@ class ContinuousEngine(Logger):
         key = (kind, bucket)
         prog = self._progs.get(key)
         if prog is None:
-            prog = self._progs[key] = (
-                self._build_prefill(bucket) if kind == "prefill"
-                else self._build_decode())
+            # in artifact mode every program was installed at start();
+            # reaching here means a bucket the artifact does not carry
+            # — impossible once geometry validated, but a live build
+            # is still the correct degradation
+            jitted = (self._build_prefill(bucket) if kind == "prefill"
+                      else self._build_decode())
+            prog = self._progs[key] = self._instrument_live(jitted)
         return prog
+
+    def _instrument_live(self, jitted):
+        """Wrap a live jitted program: every call counts one
+        ``veles_decode_dispatches_total`` (the round-5 regression
+        lock's counter — same contract as
+        ``sampling._count_decode_dispatches``). The first call
+        explicitly lowers+compiles (``jit.lower(...).compile()``, the
+        ``accelerated.cost_of`` pattern) and installs the compiled
+        executable for every later dispatch, so
+        ``veles_serving_compile_seconds_total`` brackets ONLY the
+        trace+compile — the cold-start cost the AOT artifact path
+        exists to delete — never the first dispatch's execution.
+        Engine programs are fixed-shape, so one compile per program is
+        exact, not a heuristic."""
+        box: Dict[str, object] = {}
+
+        def dispatch(*args):
+            inc("veles_decode_dispatches_total")
+            exe = box.get("exe")
+            if exe is None:
+                try:
+                    t0 = time.time()
+                    exe = jitted.lower(*args).compile()
+                except AttributeError:      # non-pjit backends
+                    exe = jitted
+                else:
+                    self.compiled_live += 1
+                    inc("veles_compiles_total")
+                    inc("veles_serving_compile_seconds_total",
+                        time.time() - t0)
+                box["exe"] = exe
+            return exe(*args)
+
+        dispatch._jitted = jitted
+        return dispatch
+
+    # -- AOT artifact (export/serve_artifact.py) ------------------------------
+    def stack_signature(self) -> Dict:
+        """Geometry the exported programs are shape-committed to: the
+        abstract spec of (params tree, pool) plus every serving knob.
+        Export stamps it into the artifact; load refuses on any
+        mismatch — a program traced for different shapes would fail
+        deep inside XLA with an opaque error (or worse, run on
+        reinterpreted buffers). Purely abstract: under
+        ``quant_weights`` the int8 spec comes from
+        ``quantize_params_spec``, so building a signature never runs
+        (or counts) a calibration pass."""
+        import jax
+
+        def spec(tree):
+            return jax.tree_util.tree_map(
+                lambda a: [list(a.shape), str(a.dtype)], tree)
+
+        params = params_of(self.wf)
+        if self.quant_weights:
+            from ..quant import quantize_params_spec
+            sig_params = quantize_params_spec(params)
+        else:
+            sig_params = params
+        stem, blocks = self.stack["stem"], self.stack["blocks"]
+        d = stem.dim
+        pools = []
+        for blk in blocks:
+            bkv = getattr(blk, "n_kv_heads", blk.n_heads)
+            pools.append([bkv, d // blk.n_heads])
+        return {
+            "params": spec(sig_params),
+            "pools": pools,
+            "pool_dtype": str(self._pool_dtype(params)),
+            "max_slots": self.max_slots,
+            "buckets": list(self.buckets),
+            "max_context": self.max_context,
+            "decode_block": self.decode_block,
+            "quant_weights": bool(self.quant_weights),
+            "quant_kv": bool(self.quant_kv),
+        }
+
+    def _load_artifact(self) -> bool:
+        """Install the artifact's pre-exported programs into
+        ``_progs``. Any failure — unreadable package, version/geometry
+        mismatch, corrupt program bytes, injected ``artifact.load``
+        fault — logs a counted warning and leaves the engine on live
+        jit: a bad artifact degrades startup latency, never
+        availability."""
+        from ..export.serve_artifact import load_serve_programs
+        try:
+            fire_fault("artifact.load")
+            programs = load_serve_programs(self.artifact,
+                                           self.stack_signature())
+        except Exception as e:      # noqa: BLE001 — degrade, don't die
+            inc("veles_artifact_load_failures_total")
+            self.warning(
+                "%s: serve-artifact %s unusable (%s: %s); serving via "
+                "live jit", self.name, self.artifact,
+                type(e).__name__, e)
+            return False
+        for key, call in programs.items():
+            self._progs[key] = _count_decode_dispatches(call)
+        self.artifact_mode = True
+        inc("veles_artifact_loads_total")
+        self.info("%s: AOT artifact loaded from %s (%d programs; zero "
+                  "jit compiles on the serving path)", self.name,
+                  self.artifact, len(programs))
+        return True
 
     def _build_prefill(self, bucket: int):
         """One program per bucket: pad-to-``bucket`` full-window pass
         through ``_block_prefill`` writing K/V into this slot's pool
         rows, plus the request's FIRST sampled token (from the last
-        real position's logits) and its private PRNG carry."""
+        real position's logits) and its private PRNG carry. Under
+        ``quant_weights`` the program takes the int8 parameter tree and
+        dequantizes at its head (XLA fuses the ``q·s`` into each
+        consuming matmul); under ``quant_kv`` the computed float rows
+        are quantized once — per-position scales — before the pool
+        write."""
         import jax
         import jax.numpy as jnp
         from ..ops import matmul_precision
@@ -459,37 +664,50 @@ class ContinuousEngine(Logger):
         blocks, head = stack["blocks"], stack["head"]
         prec = matmul_precision()
         d = stem.dim
+        quant_w, quant_kv = self.quant_weights, self.quant_kv
 
-        @_count_decode_dispatches
         @functools.partial(jax.jit, donate_argnums=(6, 7))
         def prefill(params, ids, t_p, slot, temp, seed_key, keys,
                     caches):
-            x = jnp.take(params[stem.name]["table"],
-                         ids.astype(jnp.int32), axis=0, mode="clip")
-            if pos_emb is not None:
-                table = params[pos_emb.name]["table"]
-                x = x + jnp.take(table, jnp.arange(ids.shape[-1]),
-                                 axis=0, mode="clip")[None]
+            if quant_w:
+                # reconstruct in the model's own float dtype (the
+                # never-quantized stem table's — read at trace time),
+                # not a hard f32: a bf16 model's quantized engine must
+                # run the same-dtype matmuls the float engine does
+                from ..quant import dequantize_params
+                params = dequantize_params(
+                    params, dtype=params[stem.name]["table"].dtype)
+            x = _embed_prompt(stem, pos_emb, params, ids)
+            x, blk_caches = _prefill_blocks(blocks, params, x,
+                                            bucket, d)
             new_caches = []
-            for blk, (ck_pool, cv_pool) in zip(blocks, caches):
-                bkv = getattr(blk, "n_kv_heads", blk.n_heads)
-                hd = d // blk.n_heads
-                ck = jnp.zeros((1, bucket, bkv, hd), x.dtype)
-                cv = jnp.zeros((1, bucket, bkv, hd), x.dtype)
-                x, ck, cv = _block_prefill(blk, params[blk.name], x,
-                                           ck, cv)
+            for (ck, cv), pool in zip(blk_caches, caches):
                 # pad rows land in the pool too; they are causal-masked
                 # for every real position and the decode steps rewrite
                 # position p before the read mask reaches it
-                ck_pool = jax.lax.dynamic_update_slice(
-                    ck_pool, ck, (slot, 0, 0, 0))
-                cv_pool = jax.lax.dynamic_update_slice(
-                    cv_pool, cv, (slot, 0, 0, 0))
-                new_caches.append((ck_pool, cv_pool))
+                if quant_kv:
+                    from ..quant import quantize_rows_int8
+                    ckq_pool, cvq_pool, ks_pool, vs_pool = pool
+                    qk, sk = quantize_rows_int8(ck)
+                    qv, sv = quantize_rows_int8(cv)
+                    new_caches.append((
+                        jax.lax.dynamic_update_slice(
+                            ckq_pool, qk, (slot, 0, 0, 0)),
+                        jax.lax.dynamic_update_slice(
+                            cvq_pool, qv, (slot, 0, 0, 0)),
+                        jax.lax.dynamic_update_slice(
+                            ks_pool, sk, (slot, 0)),
+                        jax.lax.dynamic_update_slice(
+                            vs_pool, sv, (slot, 0))))
+                else:
+                    ck_pool, cv_pool = pool
+                    new_caches.append((
+                        jax.lax.dynamic_update_slice(
+                            ck_pool, ck, (slot, 0, 0, 0)),
+                        jax.lax.dynamic_update_slice(
+                            cv_pool, cv, (slot, 0, 0, 0))))
             x_last = jnp.take(x[0], t_p - 1, axis=0, mode="clip")
-            logits = (jnp.dot(x_last, params[head.name]["weights"],
-                              precision=prec)
-                      + params[head.name]["bias"])
+            logits = _head_logits(head, params, x_last, prec)
             k2 = jax.random.split(seed_key)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             samp = jax.random.categorical(
@@ -508,7 +726,12 @@ class ContinuousEngine(Logger):
         shape, compiled exactly once. Per-row sampling draws from each
         slot's private key stream, so a row's noise is a pure function
         of its request's seed (id-exact vs solo decode whatever else
-        rides the pool)."""
+        rides the pool). Under ``quant_kv`` each row dequantizes its
+        int8 cache for the attention read, runs the SAME
+        ``_block_step``, then quantizes only the one newly written
+        position with its own fresh scale — previously written rows
+        are never re-scaled, so there is no error accumulation across
+        steps."""
         import jax
         import jax.numpy as jnp
         from ..ops import matmul_precision
@@ -516,6 +739,7 @@ class ContinuousEngine(Logger):
         stem, pos_emb = stack["stem"], stack["pos_emb"]
         blocks, head = stack["blocks"], stack["head"]
         prec = matmul_precision()
+        quant_w, quant_kv = self.quant_weights, self.quant_kv
 
         def embed_rows(params, tok, pos):
             x = jnp.take(params[stem.name]["table"],
@@ -525,15 +749,55 @@ class ContinuousEngine(Logger):
                                  axis=0, mode="clip")
             return x                            # (S, D)
 
-        @_count_decode_dispatches
         @functools.partial(jax.jit, donate_argnums=(4, 5))
         def step(params, tok, pos, temp, keys, caches):
+            if quant_w:
+                from ..quant import dequantize_params
+                params = dequantize_params(
+                    params, dtype=params[stem.name]["table"].dtype)
+
             def body(carry, _):
                 tok, pos, keys, caches = carry
                 x = embed_rows(params, tok, pos)
                 new_caches = []
-                for blk, (ck, cv) in zip(blocks, caches):
+                for blk, pool in zip(blocks, caches):
                     p = params[blk.name]
+
+                    if quant_kv:
+                        from ..quant import (dequantize_rows_int8,
+                                             quantize_rows_int8)
+
+                        def rowq(x_row, ckq_row, cvq_row, ks_row,
+                                 vs_row, pos_row, blk=blk, p=p):
+                            ck_row = dequantize_rows_int8(
+                                ckq_row, ks_row, dtype=x_row.dtype)
+                            cv_row = dequantize_rows_int8(
+                                cvq_row, vs_row, dtype=x_row.dtype)
+                            y, ck2, cv2 = _block_step(
+                                blk, p, x_row[None, None, :],
+                                ck_row[None], cv_row[None], pos_row)
+                            # quantize ONLY the newly written position
+                            k_new = jnp.take(ck2[0], pos_row, axis=0,
+                                             mode="clip")
+                            v_new = jnp.take(cv2[0], pos_row, axis=0,
+                                             mode="clip")
+                            qk, sk = quantize_rows_int8(k_new[None])
+                            qv, sv = quantize_rows_int8(v_new[None])
+                            return (y[0, 0],
+                                    jax.lax.dynamic_update_slice(
+                                        ckq_row, qk, (pos_row, 0, 0)),
+                                    jax.lax.dynamic_update_slice(
+                                        cvq_row, qv, (pos_row, 0, 0)),
+                                    jax.lax.dynamic_update_slice(
+                                        ks_row, sk, (pos_row,)),
+                                    jax.lax.dynamic_update_slice(
+                                        vs_row, sv, (pos_row,)))
+
+                        ckq, cvq, ks, vs = pool
+                        x, ckq, cvq, ks, vs = jax.vmap(rowq)(
+                            x, ckq, cvq, ks, vs, pos)
+                        new_caches.append((ckq, cvq, ks, vs))
+                        continue
 
                     def row(x_row, ck_row, cv_row, pos_row,
                             blk=blk, p=p):
@@ -542,11 +806,10 @@ class ContinuousEngine(Logger):
                             ck_row[None], cv_row[None], pos_row)
                         return y[0, 0], ck2[0], cv2[0]
 
+                    ck, cv = pool
                     x, ck, cv = jax.vmap(row)(x, ck, cv, pos)
                     new_caches.append((ck, cv))
-                logits = (jnp.dot(x, params[head.name]["weights"],
-                                  precision=prec)
-                          + params[head.name]["bias"])   # (S, V)
+                logits = _head_logits(head, params, x, prec)  # (S, V)
                 # _split_rows IS the id-exactness contract: the same
                 # carry/subkey convention solo and batched generate use
                 keys, subs = _split_rows(keys)

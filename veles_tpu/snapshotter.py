@@ -429,16 +429,20 @@ def load_snapshot(path: str) -> Dict[str, Any]:
     error."""
     from .resilience.checkpoint_chain import SnapshotCorruptError, verify
     from .resilience.faults import fire as fire_fault
+    # int8 snapshots (veles-tpu quantize, veles_tpu/quant/) expand
+    # back to float here — ONE read path, so every consumer (resume,
+    # restore_latest, compare_snapshots) sees ordinary state trees
+    from .quant.weights import dequantize_state
     fire_fault("snapshot.load")
     if path.startswith("sqlite://") or path.endswith(".sqlite3"):
-        return _load_sqlite(path)
+        return dequantize_state(_load_sqlite(path))
     if verify(path) is False:
         raise SnapshotCorruptError(
             "snapshot %s fails its manifest SHA-256 — the file is "
             "corrupt (bitrot or a torn write); quarantine it or resume "
             "from an older snapshot (restore_latest does both)" % path)
     try:
-        return _read_state(path)
+        return dequantize_state(_read_state(path))
     except FileNotFoundError:
         raise
     except (pickle.UnpicklingError, EOFError, OSError, ValueError,

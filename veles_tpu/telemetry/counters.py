@@ -91,6 +91,24 @@ DESCRIPTIONS = {
         "Seconds requests waited in the serving queue before a slot",
     "veles_serving_expired_total":
         "Queued generation requests answered 503 past their deadline",
+    "veles_serving_compile_seconds_total":
+        "Seconds the serving engine spent jit-tracing/compiling its "
+        "live decode/prefill programs (0 in AOT-artifact mode)",
+    # quantization subsystem (veles_tpu/quant/): bench.py's gate
+    # asserts the quant/artifact counters read 0 in quant-off,
+    # artifact-off runs
+    "veles_quant_params_total":
+        "Parameter tensors quantized to int8 (per-channel symmetric)",
+    "veles_quant_bytes_saved_total":
+        "Bytes saved by int8 weight quantization (float minus "
+        "int8+scale storage)",
+    "veles_quant_calibrations_total":
+        "Weight-quantization calibration passes (amax scale scans)",
+    "veles_artifact_loads_total":
+        "AOT serve-artifacts loaded by the serving engine",
+    "veles_artifact_load_failures_total":
+        "AOT serve-artifact loads that failed and fell back to "
+        "live jit (corrupt/mismatched/injected)",
     # model-health observability (telemetry/tensormon.py +
     # telemetry/recorder.py): bench.py's gate asserts the sample/NaN
     # counters read 0 in tensormon-off runs

@@ -231,7 +231,16 @@ class WebStatusServer(Logger):
                                 ("slots_busy", "busy KV-cache slots"),
                                 ("slots", "total KV-cache slots"),
                                 ("queue_depth", "queued requests"),
-                                ("programs", "jitted programs built")):
+                                ("programs", "jitted programs built"),
+                                ("artifact_mode",
+                                 "1 = serving from an AOT artifact "
+                                 "(zero jit compiles)"),
+                                ("quant_weights",
+                                 "1 = int8 weight quantization on"),
+                                ("quant_kv",
+                                 "1 = int8 KV-cache pool on"),
+                                ("kv_pool_bytes",
+                                 "KV-cache pool HBM bytes")):
                             gauges["veles_serving_%s_%s"
                                    % (gkey, safe)] = (
                                 st[gkey],
