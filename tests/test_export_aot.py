@@ -66,7 +66,7 @@ def test_artifact_is_a_v3_package_with_serving_block(served_artifact):
         contents = json.load(fin)
     assert contents["format_version"] == 3
     serving = contents["serving"]
-    assert serving["artifact_version"] == 1
+    assert serving["artifact_version"] == 2
     assert sorted(serving["programs"]) == ["decode", "prefill_16",
                                            "prefill_8"]
     for fname in serving["programs"].values():

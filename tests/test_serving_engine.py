@@ -98,6 +98,22 @@ def test_expired_ticket_purged_even_when_pool_full():
     assert sched.queue_depth() == 1               # t_live kept, FIFO
 
 
+def test_poisoned_head_answered_400_not_crash_loop():
+    """A queued request that fits no bucket (a checked=True submit
+    bypassing accepts(), or a raw push) must be popped and answered
+    400 — not crash take_admissions pre-pop tick after tick while the
+    whole pool starves behind it."""
+    sched = SlotScheduler(2, (8,), 16)
+    bad, good = Ticket(), Ticket()
+    sched.push(make_request([1] * 20, 2), bad)
+    sched.push(make_request([1, 2], 2), good)
+    admitted, expired = sched.take_admissions()
+    assert bad.event.is_set() and bad.code == 400
+    assert "bucket" in bad.error
+    assert len(admitted) == 1          # the pool kept serving
+    assert not expired
+
+
 def test_retire_is_idempotent():
     # a shutdown abort racing a wedged worker's late _finish retires
     # the same slot twice — the free list must not hold an index twice
@@ -339,5 +355,304 @@ def test_web_status_metrics_render_engine_gauges(served):
             text = r.read().decode()
         assert "veles_serving_slots_busy_eng_t" in text
         assert "veles_serving_queue_depth_eng_t" in text
+        # paged-pool occupancy gauges (serving/pages.py) — the rows an
+        # operator sizes pages/page_size with
+        assert "veles_serving_pages_total_eng_t" in text
+        assert "veles_serving_pages_in_use_eng_t" in text
+        assert "veles_serving_page_fragmentation_eng_t" in text
     finally:
         server.stop()
+
+
+# -- the paged pool ------------------------------------------------------------
+
+def test_paged_admission_beats_dense_at_same_hbm():
+    """THE paged-pool win, at the ledger: 16 pages x 8 positions is the
+    HBM a dense pool spends on 128/32 = 4 slots of max_context=32. The
+    paged scheduler admits on each request's OWN footprint, so the same
+    HBM holds 8 concurrent short requests — strictly more than dense
+    ever could."""
+    from veles_tpu.serving.pages import PagePool
+    pool = PagePool(16, 8)
+    sched = SlotScheduler(8, (8,), 32, page_pool=pool)
+    for s in range(8):
+        sched.push(make_request([1, 2, 3, 4], 4, seed=s), Ticket())
+    admitted, expired = sched.take_admissions()
+    assert not expired
+    assert len(admitted) == 8          # dense tops out at 4
+    # each row reserved its own worst case (8 positions = 1 page)
+    assert pool.in_use() == 8
+    for slot in admitted:
+        sched.retire(slot)
+    assert pool.in_use() == 0
+    assert pool.free_count() == 16
+
+
+def test_admission_waits_for_pages_then_proceeds():
+    """Real exhaustion at admission keeps FIFO order and waits for
+    retirements (no shed): pages freed by a retiring row admit the
+    waiting head on the next boundary."""
+    from veles_tpu.serving.pages import PagePool
+    pool = PagePool(2, 8)
+    sched = SlotScheduler(4, (8,), 16, page_pool=pool)
+    t1, t2 = Ticket(), Ticket()
+    sched.push(make_request([1] * 6, 8), t1)     # worst 14 -> 2 pages
+    sched.push(make_request([1] * 6, 8), t2)
+    admitted, _ = sched.take_admissions()
+    assert len(admitted) == 1                    # pool can hold one
+    again, _ = sched.take_admissions()
+    assert not again                             # starved, not shed
+    assert not t2.event.is_set()
+    sched.retire(admitted[0])
+    admitted, _ = sched.take_admissions()
+    assert len(admitted) == 1                    # head admitted now
+    assert pool.in_use() == 2
+
+
+def test_page_alloc_fault_sheds_503_and_ledger_stays_consistent(
+        served, monkeypatch):
+    """Chaos for satellite `serve.page_alloc`: an injected allocation
+    fault sheds the admitting request 503 + Retry-After; the page
+    ledger balances back to empty and the very next request decodes
+    id-exact — recovery, not an outage."""
+    lm, wf, engine = served
+    shed0 = counters.get("veles_shed_requests_total")
+    monkeypatch.setenv("VELES_FAULTS", "serve.page_alloc:raise:times=1")
+    req = make_request(_prompt(lm, 80, 6), 6)
+    ticket = Ticket()
+    assert engine.submit(req, ticket)
+    assert ticket.event.wait(60)
+    assert ticket.error is not None and ticket.code == 503
+    assert ticket.retry_after
+    assert counters.get("veles_shed_requests_total") == shed0 + 1
+    monkeypatch.setenv("VELES_FAULTS", "")
+    from veles_tpu.nn import sampling
+    assert engine.serve([req])[0] == sampling.generate(
+        wf, req["prompt"], req["n_new"], temperature=0)
+    # the ledger balanced: nothing leaked across the shed + recovery
+    assert engine.page_pool.in_use() == 0
+    assert engine.page_pool.free_count() == engine.pages
+
+
+def test_unknown_mode_rejected_400_not_leaked(served):
+    """accepts() must fail CLOSED on a mode string no tick path
+    advances — admitting it would strand the ticket to timeout and
+    leak the slot + its reserved pages forever."""
+    lm, wf, engine = served
+    ticket = Ticket()
+    assert engine.submit(
+        make_request(_prompt(lm, 140, 5), 4, mode="gredy"), ticket)
+    assert ticket.event.wait(30)
+    assert ticket.code == 400
+    assert "mode" in ticket.error
+    assert engine.page_pool.in_use() == 0
+
+
+def test_page_reuse_after_retire_not_poisoned(served):
+    """Pages freed by retired rows are immediately re-issued to new
+    admissions; a page-constrained pool forces heavy reuse across
+    waves, and every wave must stay id-exact — a stale row bleeding
+    through a reused page would show up here."""
+    lm, wf, _ = served
+    engine = ContinuousEngine(wf, max_slots=3, buckets=(8,),
+                              max_context=32, page_size=8, pages=6,
+                              name="eng_tight").start()
+    try:
+        reqs_a = [make_request(_prompt(lm, 90 + i, 5), 6,
+                               temperature=0.6 if i == 1 else 0.0,
+                               seed=90 + i) for i in range(3)]
+        reqs_b = [make_request(_prompt(lm, 95 + i, 6), 7, seed=95 + i)
+                  for i in range(3)]
+        ref_a = [engine.serve([r])[0] for r in reqs_a]
+        for _wave in range(3):
+            engine.serve(list(reqs_b))           # dirty every page
+            assert engine.serve(list(reqs_a)) == ref_a
+        assert engine.page_pool.in_use() == 0
+        assert engine.page_pool.free_count() == engine.pages
+    finally:
+        engine.stop()
+
+
+def test_quant_cache_invalidation_recalibrates(served):
+    """Satellite regression: the int8 twin is cached on device-view
+    leaf IDENTITY, so an in-place device mutation (same jax.Array,
+    new bytes) would serve stale scales forever — an explicit
+    :meth:`invalidate_quant_cache` must force recalibration at the
+    next param refresh, while unchanged weights keep reusing the
+    cached twin."""
+    lm, wf, _ = served
+    engine = ContinuousEngine(wf, max_slots=2, buckets=(8,),
+                              max_context=32, quant_weights=True,
+                              name="eng_q")
+    cal = lambda: counters.get("veles_quant_calibrations_total")  # noqa: E731
+    c0 = cal()
+    p1 = engine._prepare_params()
+    assert cal() == c0 + 1
+    p2 = engine._prepare_params()                # identity-cached
+    assert cal() == c0 + 1
+    assert p2 is p1
+    engine.invalidate_quant_cache()
+    p3 = engine._prepare_params()
+    assert cal() == c0 + 2                       # recalibrated
+    assert p3 is not p1
+    # and through the serving path: idle-boundary refresh reuses the
+    # twin until invalidated
+    engine.start()
+    try:
+        req = make_request(_prompt(lm, 85, 6), 4)
+        engine.serve([req])
+        served_cal = cal()
+        engine.serve([req])
+        assert cal() == served_cal               # cache held
+        engine.invalidate_quant_cache()
+        engine.serve([req])
+        assert cal() == served_cal + 1           # refresh recalibrated
+    finally:
+        engine.stop()
+
+
+# -- speculative + beam on the pool -------------------------------------------
+
+@pytest.fixture(scope="module")
+def pooled(served):
+    """Target + draft + an engine serving ALL four decode modes on one
+    paged pool: 5 slots so greedy + sample + spec + one beam-width-2
+    group can co-tenant a single step boundary."""
+    lm, wf, _ = served
+    prng.seed_all(437)
+    draft = lm.build_workflow(epochs=1, minibatch_size=64, n_blocks=1,
+                              dim=16, n_train=256, n_valid=64)
+    draft.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    draft.run()
+    engine = ContinuousEngine(wf, max_slots=5, buckets=(8, 16),
+                              max_context=48, page_size=8,
+                              spec_gamma=3, beam_width=2,
+                              draft=draft, name="eng_pool").start()
+    yield lm, wf, draft, engine
+    engine.stop()
+
+
+def test_speculative_id_exact_on_pool_vs_solo(pooled):
+    """Pooled speculation (on-device draft/verify rounds over the page
+    tables) emits the same tokens as the host-loop
+    ``generate_speculative`` — greedy AND stochastic — and the greedy
+    rows equal plain greedy decode (the speculation invariant)."""
+    from veles_tpu.nn import sampling
+    from veles_tpu.nn.speculative import generate_speculative
+    lm, wf, draft, engine = pooled
+    for temp, seed in ((0.0, 0), (0.7, 21)):
+        p = _prompt(lm, 100 + seed, 7)
+        req = make_request(p, 9, temperature=temp, seed=seed,
+                           mode="speculative", gamma=3)
+        toks = engine.serve([req])[0]
+        solo, _stats = generate_speculative(
+            wf, draft, p, 9, gamma=3, temperature=temp, seed=seed)
+        assert toks == solo, "temp=%s" % temp
+        if temp == 0.0:
+            assert toks == sampling.generate(wf, p, 9, temperature=0)
+
+
+def test_beam_id_exact_on_pool_vs_solo(pooled):
+    """A pooled beam request (hypothesis rows on the page tables, the
+    group top-k step, page-granular cache reorder) returns exactly
+    ``beam_generate``'s best tokens and hypothesis scores."""
+    from veles_tpu.nn.beam import beam_generate
+    lm, wf, draft, engine = pooled
+    from veles_tpu.serving.scheduler import Ticket as STicket
+    for seed in (110, 111):
+        p = _prompt(lm, seed, 6)
+        req = make_request(p, 8, mode="beam", beam=2)
+        ticket = STicket()
+        assert engine.submit(req, ticket)
+        assert ticket.event.wait(120)
+        assert ticket.error is None, ticket.error
+        solo, stats = beam_generate(wf, p, 8, beam=2)
+        assert ticket.result["tokens"] == [int(t) for t in solo]
+        assert numpy.allclose(ticket.result["scores"],
+                              stats["scores"], atol=1e-4)
+
+
+def test_mixed_mode_cotenancy_all_id_exact(pooled):
+    """The full-stack co-tenancy bar: greedy + sampled + speculative +
+    beam rows sharing ONE step boundary, every answer id-exact vs its
+    own solo baseline — no mode perturbs another's tokens."""
+    from veles_tpu.nn import sampling
+    from veles_tpu.nn.beam import beam_generate
+    from veles_tpu.nn.speculative import generate_speculative
+    from veles_tpu.serving.scheduler import Ticket as STicket
+    lm, wf, draft, engine = pooled
+    pg = _prompt(lm, 120, 6)
+    ps = _prompt(lm, 121, 9)
+    pv = _prompt(lm, 122, 7)
+    pb = _prompt(lm, 123, 5)
+    reqs = [make_request(pg, 8),
+            make_request(ps, 7, temperature=0.8, seed=7, mode="sample"),
+            make_request(pv, 8, mode="speculative", gamma=3),
+            make_request(pb, 6, mode="beam", beam=2)]
+    tickets = [STicket() for _ in reqs]
+    for r, t in zip(reqs, tickets):
+        assert engine.submit(r, t)
+    for t in tickets:
+        assert t.event.wait(180)
+        assert t.error is None, t.error
+    # co-tenancy really happened: the beam pair plus at least two of
+    # the single-row modes shared a step boundary (== 5 when all four
+    # admissions land on the same tick, >= 4 when the first admission
+    # races one boundary ahead)
+    assert engine.peak_slots >= 4
+    assert tickets[0].result["tokens"] == sampling.generate(
+        wf, pg, 8, temperature=0)
+    assert tickets[1].result["tokens"] == sampling.generate(
+        wf, ps, 7, temperature=0.8, seed=7)
+    spec_solo, _ = generate_speculative(wf, draft, pv, 8, gamma=3)
+    assert tickets[2].result["tokens"] == spec_solo
+    beam_solo, _ = beam_generate(wf, pb, 6, beam=2)
+    assert tickets[3].result["tokens"] == [int(t) for t in beam_solo]
+    # mode stats survive the pool: speculation reports its rounds
+    assert tickets[2].result["rounds"] >= 1
+    assert 0.0 <= tickets[2].result["acceptance"] <= 1.0
+
+
+def test_scheduler_reserves_engine_gamma_for_gammaless_requests():
+    """A speculative request that omits ``gamma`` must be page-
+    reserved for the ENGINE's round width, not a literal default —
+    under-reservation would resurrect mid-decode exhaustion for the
+    exact rows the reservation policy promises it cannot happen to."""
+    from veles_tpu.serving.pages import PagePool
+    pool = PagePool(8, 8)
+    sched = SlotScheduler(2, (8,), 40, page_pool=pool, spec_gamma=8)
+    req = {"prompt": [1] * 6, "n_new": 10, "mode": "speculative"}
+    sched.push(req, Ticket())
+    (slot,), _ = sched.take_admissions()
+    # worst = 6 + 10 + 8 + 1 = 25 positions -> 4 pages (a gamma=4
+    # default would reserve only 3)
+    assert len(slot.pages) == 4
+
+
+def test_beam_n_new_1_finishes_at_admission(pooled):
+    """An n_new=1 beam group is answered by its first hypothesis's
+    prefill expansion; the dead sibling rows must not dispatch
+    prefills of their own or leave pages behind."""
+    from veles_tpu.nn.beam import beam_generate
+    lm, wf, draft, engine = pooled
+    p = _prompt(lm, 130, 6)
+    before = counters.get("veles_serving_prefill_dispatches_total")
+    toks = engine.serve([make_request(p, 1, mode="beam", beam=2)])[0]
+    solo, _ = beam_generate(wf, p, 1, beam=2)
+    assert toks == [int(t) for t in solo]
+    assert counters.get("veles_serving_prefill_dispatches_total") \
+        == before + 1
+    assert engine.page_pool.in_use() == 0
+
+
+def test_program_count_bounded_with_spec_and_beam(pooled):
+    """After serving every decode mode, the jit cache holds at most
+    ``programs_bound()`` programs — base prefills + decode step, draft
+    prefills + the spec round, the beam step; a CONSTANT, never a
+    function of traffic."""
+    lm, wf, draft, engine = pooled
+    assert engine.programs_built <= engine.programs_bound()
+    # the base greedy/sample plane alone stays within len(buckets)+1
+    base = [k for k in engine._progs
+            if k[0] in ("prefill", "step")]
+    assert len(base) <= len(engine.buckets) + 1

@@ -68,6 +68,14 @@ def main(argv=None) -> int:
         _root.common.serving.buckets = args.serve_buckets
     if args.serve_max_context is not None:
         _root.common.serving.max_context = args.serve_max_context
+    if args.serve_page_size is not None:
+        _root.common.serving.page_size = args.serve_page_size
+    if args.serve_pages is not None:
+        _root.common.serving.pages = args.serve_pages
+    if args.serve_spec_gamma is not None:
+        _root.common.serving.spec_gamma = args.serve_spec_gamma
+    if args.serve_beam_width is not None:
+        _root.common.serving.beam_width = args.serve_beam_width
     if args.serve_artifact:
         _root.common.serving.artifact = args.serve_artifact
     # quantization policy (veles_tpu/quant/): the flags arm the config
@@ -395,6 +403,8 @@ def _export_cli(argv) -> int:
                      metavar="L1,L2,...")
     exp.add_argument("--serve-max-context", type=int, default=None)
     exp.add_argument("--serve-decode-block", type=int, default=None)
+    exp.add_argument("--serve-page-size", type=int, default=None)
+    exp.add_argument("--serve-pages", type=int, default=None)
     exp.add_argument("--quant-weights", action="store_true")
     exp.add_argument("--quant-kv", action="store_true")
     args = parser.parse_args(argv)
@@ -419,7 +429,8 @@ def _export_cli(argv) -> int:
         workflow, args.out, max_slots=args.serve_slots,
         buckets=args.serve_buckets,
         max_context=args.serve_max_context,
-        decode_block=args.serve_decode_block)
+        decode_block=args.serve_decode_block,
+        page_size=args.serve_page_size, pages=args.serve_pages)
     import json as _json
     import os as _os
     with open(_os.path.join(path, "contents.json")) as fin:

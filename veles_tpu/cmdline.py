@@ -114,6 +114,31 @@ def make_parser() -> argparse.ArgumentParser:
                    help="per-slot KV capacity; requests need "
                         "len(prompt)+n_new <= T to ride the slot pool "
                         "(root.common.serving.max_context)")
+    p.add_argument("--serve-page-size", type=int, default=None,
+                   metavar="P",
+                   help="positions per KV-cache page (a multiple of "
+                        "the decode block); pool HBM is pages x P, "
+                        "not slots x max-context "
+                        "(root.common.serving.page_size)")
+    p.add_argument("--serve-pages", type=int, default=None, metavar="N",
+                   help="usable pages of the paged KV pool; default "
+                        "is dense-equivalent capacity (every slot can "
+                        "hold max-context) — SHRINK it to trade worst-"
+                        "case context reservation for more concurrent "
+                        "slots at the same HBM "
+                        "(root.common.serving.pages)")
+    p.add_argument("--serve-spec-gamma", type=int, default=None,
+                   metavar="G",
+                   help="draft tokens per on-device speculation round; "
+                        "the pool serves mode=speculative requests "
+                        "whose gamma matches this fixed shape "
+                        "(root.common.serving.spec_gamma)")
+    p.add_argument("--serve-beam-width", type=int, default=None,
+                   metavar="W",
+                   help="hypothesis rows per pooled beam request; the "
+                        "pool serves mode=beam requests whose width "
+                        "matches this fixed shape "
+                        "(root.common.serving.beam_width)")
     p.add_argument("--serve-artifact", default=None, metavar="DIR",
                    help="AOT serve-artifact package (from `veles-tpu "
                         "export serve-artifact`): the continuous "

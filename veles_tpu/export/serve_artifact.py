@@ -35,8 +35,12 @@ from typing import Dict, Optional, Tuple
 from ..error import VelesError
 
 #: bumped when the serving-block layout or program calling convention
-#: changes; readers refuse newer artifacts instead of guessing
-ARTIFACT_VERSION = 1
+#: changes; readers refuse newer artifacts instead of guessing.
+#: v2: the paged KV cache — prefill takes the slot's page-table row,
+#: the decode step takes the (slots, pages_per_slot) page tables plus
+#: a per-row advance mask, and the pool buffers are page-shaped; v1
+#: artifacts fail the signature check and fall back to live jit
+ARTIFACT_VERSION = 2
 
 
 def _specs_of(tree):
@@ -50,6 +54,8 @@ def export_serve_artifact(workflow, path: str,
                           buckets=None,
                           max_context: Optional[int] = None,
                           decode_block: Optional[int] = None,
+                          page_size: Optional[int] = None,
+                          pages: Optional[int] = None,
                           quant_weights: Optional[bool] = None,
                           quant_kv: Optional[bool] = None) -> str:
     """Export the continuous engine's programs for ``workflow`` into
@@ -75,6 +81,7 @@ def export_serve_artifact(workflow, path: str,
                         else serving_cfg.get("max_context", 640)),
         decode_block=int(decode_block if decode_block is not None
                          else serving_cfg.get("decode_block", 1)),
+        page_size=page_size, pages=pages,
         quant_weights=quant_weights, quant_kv=quant_kv,
         name="serve_artifact_export")
     signature = engine.stack_signature()
@@ -85,6 +92,11 @@ def export_serve_artifact(workflow, path: str,
     slots = engine.max_slots
     keys_spec = jax.ShapeDtypeStruct((slots, 2), jnp.uint32)
     seed_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    table_row_spec = jax.ShapeDtypeStruct((engine.pages_per_slot,),
+                                          jnp.int32)
+    tables_spec = jax.ShapeDtypeStruct(
+        (slots, engine.pages_per_slot), jnp.int32)
+    svec = jax.ShapeDtypeStruct((slots,), jnp.int32)
     i32 = jax.ShapeDtypeStruct((), jnp.int32)
     f32 = jax.ShapeDtypeStruct((), jnp.float32)
 
@@ -94,17 +106,16 @@ def export_serve_artifact(workflow, path: str,
         exported = jexport.export(engine._build_prefill(bucket))(
             params_spec,
             jax.ShapeDtypeStruct((1, bucket), jnp.int32),
-            i32, i32, f32, seed_spec, keys_spec, caches_spec)
+            i32, i32, f32, seed_spec, table_row_spec, keys_spec,
+            caches_spec)
         fname = "serve_prefill_%d.bin" % bucket
         with open(os.path.join(path, fname), "wb") as fout:
             fout.write(exported.serialize())
         programs["prefill_%d" % bucket] = fname
     exported = jexport.export(engine._build_decode())(
-        params_spec,
-        jax.ShapeDtypeStruct((slots,), jnp.int32),
-        jax.ShapeDtypeStruct((slots,), jnp.int32),
+        params_spec, svec, svec,
         jax.ShapeDtypeStruct((slots,), jnp.float32),
-        keys_spec, caches_spec)
+        svec, tables_spec, keys_spec, caches_spec)
     with open(os.path.join(path, "serve_decode.bin"), "wb") as fout:
         fout.write(exported.serialize())
     programs["decode"] = "serve_decode.bin"

@@ -29,8 +29,9 @@ from .weights import (dequantize_params, dequantize_state,  # noqa: F401
                       is_quantized_params, quantize_params,
                       quantize_params_spec, quantize_state,
                       quantize_tensor, GRANULARITIES)
-from .kv import (block_pool, dequantize_rows_int8,           # noqa: F401
-                 pool_nbytes, quantize_rows_int8)
+from .kv import (block_page_pool, block_pool,                # noqa: F401
+                 dequantize_rows_int8, pool_nbytes,
+                 quantize_rows_int8)
 
 #: every counter the quantization/artifact plane increments —
 #: registered with HELP strings in telemetry/counters.py DESCRIPTIONS

@@ -33,7 +33,8 @@ from ..ops.precision import (dequantize_rows_int8,  # noqa: F401
 
 def block_pool(max_slots: int, max_context: int, n_kv: int, hd: int,
                dtype, quantized: bool) -> Tuple:
-    """One transformer block's pool state. Float: ``(ck, cv)``.
+    """One transformer block's DENSE pool state (the pre-paged layout,
+    kept for tests and offline tooling). Float: ``(ck, cv)``.
     Quantized: ``(ck_q, cv_q, k_scale, v_scale)`` — int8 payloads plus
     f32 per-(slot, position) scale sidecars. Zero-initialized
     throughout: scale 0 dequantizes untouched rows to exact 0.0, the
@@ -45,6 +46,27 @@ def block_pool(max_slots: int, max_context: int, n_kv: int, hd: int,
     return (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
             jnp.zeros((max_slots, max_context), jnp.float32),
             jnp.zeros((max_slots, max_context), jnp.float32))
+
+
+def block_page_pool(pages: int, page_size: int, n_kv: int, hd: int,
+                    dtype, quantized: bool) -> Tuple:
+    """One transformer block's PAGED pool state (serving/pages.py):
+    ``pages`` device rows of ``page_size`` positions each — row 0 is
+    the allocator's sink page. Float: ``(kp, vp)`` shaped
+    ``(pages, page_size, n_kv, hd)``. Quantized:
+    ``(kp_q, vp_q, k_scale, v_scale)`` — int8 payloads plus f32
+    per-page scale sidecars shaped ``(pages, page_size)`` (one scale
+    per cached position, laid out page-wise so a page's payload and
+    its scales travel together through the same gather/scatter
+    indices). Zero-initialized: scale 0 dequantizes untouched rows to
+    exact 0.0, the float pool's starting content."""
+    import jax.numpy as jnp
+    shape = (pages, page_size, n_kv, hd)
+    if not quantized:
+        return (jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+    return (jnp.zeros(shape, jnp.int8), jnp.zeros(shape, jnp.int8),
+            jnp.zeros((pages, page_size), jnp.float32),
+            jnp.zeros((pages, page_size), jnp.float32))
 
 
 def pool_nbytes(caches) -> int:

@@ -82,6 +82,12 @@ for _name, _desc in (
                           "pooled decode step (raise sheds the "
                           "in-flight rows 503 + Retry-After; the "
                           "slot pool stays consistent)"),
+    ("serve.page_alloc", "paged KV-cache allocator, at every page "
+                         "allocation (raise = simulated exhaustion: "
+                         "admission sheds the head request, decode-"
+                         "time growth sheds the growing row — 503 + "
+                         "Retry-After either way; the page ledger "
+                         "stays consistent)"),
     ("distributed.init", "initialize_multihost, inside the retried "
                          "coordinator join"),
     # overlap subsystem (veles_tpu/overlap/): chaos for the async

@@ -255,6 +255,8 @@ class GenerationAPI(Unit):
                  max_slots: int = None, buckets=None,
                  max_context: int = None,
                  decode_block: int = None,
+                 page_size: int = None, pages: int = None,
+                 spec_gamma: int = None, beam_width: int = None,
                  quant_weights: bool = None, quant_kv: bool = None,
                  artifact: str = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
@@ -288,6 +290,13 @@ class GenerationAPI(Unit):
         self.decode_block = int(
             decode_block if decode_block is not None
             else serving_cfg.get("decode_block", 1))
+        # paged-pool + pooled-decode-mode knobs (None defers to
+        # root.common.serving.* inside the engine; see serving/pages.py
+        # and docs/services.md "Paged KV cache")
+        self.page_size = page_size
+        self.pages = pages
+        self.spec_gamma = spec_gamma
+        self.beam_width = beam_width
         # quantization / AOT-artifact policy (veles_tpu/quant/,
         # docs/services.md "Quantized serving"): None defers to
         # root.common.quant.* / root.common.serving.artifact inside
@@ -323,11 +332,22 @@ class GenerationAPI(Unit):
         if mode == "speculative" and self.draft is None:
             raise ValueError("mode=speculative needs a draft model "
                              "configured on the server")
+        # gamma/beam default to the ENGINE's fixed shapes, so a client
+        # that omits them lands on the pooled plane whatever
+        # --serve-spec-gamma/--serve-beam-width the server runs with
+        # (a hard 4 would silently route such requests to the window
+        # worker on any non-default server); without an engine the
+        # window plane serves any width, 4 stays the wire default
+        engine = self._engine
         try:
             temperature = float(body.get("temperature", 0.0))
             seed = int(body.get("seed", 0))
-            gamma = int(body.get("gamma", 4))
-            beam = int(body.get("beam", 4))
+            gamma = int(body.get(
+                "gamma", engine.spec_gamma if engine is not None
+                else 4))
+            beam = int(body.get(
+                "beam", engine.beam_width if engine is not None
+                else 4))
         except (TypeError, ValueError) as e:
             # float(None)/int({}) raise TypeError — it must surface as
             # a 400, not escape the handler as an unanswered traceback
@@ -511,6 +531,10 @@ class GenerationAPI(Unit):
                     buckets=self.buckets,
                     max_context=self.max_context,
                     decode_block=self.decode_block,
+                    page_size=self.page_size, pages=self.pages,
+                    spec_gamma=self.spec_gamma,
+                    beam_width=self.beam_width,
+                    draft=self.draft,
                     quant_weights=self.quant_weights,
                     quant_kv=self.quant_kv,
                     artifact=self.artifact,
@@ -564,9 +588,24 @@ class GenerationAPI(Unit):
                             "veles_serving_slots": st["slots"],
                             "veles_serving_slots_busy":
                                 st["slots_busy"],
+                            "veles_serving_peak_slots":
+                                st["peak_slots"],
                             "veles_serving_queue_depth":
                                 st["queue_depth"],
                             "veles_serving_programs": st["programs"],
+                            # paged-pool occupancy (serving/pages.py):
+                            # the gauges an operator sizes pages/
+                            # page_size with — fragmentation is the
+                            # allocated-but-unoccupied fraction of
+                            # in-use pages (tail-of-page waste)
+                            "veles_serving_pages_total":
+                                st["pages_total"],
+                            "veles_serving_pages_in_use":
+                                st["pages_in_use"],
+                            "veles_serving_page_size":
+                                st["page_size"],
+                            "veles_serving_page_fragmentation":
+                                st["page_fragmentation"],
                             # quantization/AOT mode gauges (veles_tpu/
                             # quant/): 1 = the plane is active on this
                             # engine — dashboards must know whether a
@@ -624,8 +663,12 @@ class GenerationAPI(Unit):
                 ticket = _Ticket(
                     deadline=time.time() + api.request_timeout)
                 engine = api._engine
+                # every decode mode rides the slot pool when the
+                # engine can hold it — speculative needs the pooled
+                # draft + the engine's fixed gamma, beam the engine's
+                # fixed width; anything else (and any geometry the
+                # pool rejects) falls back to the window worker
                 via_engine = (engine is not None
-                              and req["mode"] in ("greedy", "sample")
                               and engine.accepts(req) is None)
                 if via_engine:
                     # the continuous-batching plane: admitted into a

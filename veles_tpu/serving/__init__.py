@@ -50,6 +50,11 @@ SERVING_COUNTERS = (
     "veles_serving_queue_wait_seconds_total",
     "veles_serving_expired_total",
     "veles_serving_compile_seconds_total",
+    "veles_serving_pages_alloc_total",
+    "veles_serving_pages_free_total",
+    "veles_serving_pages_exhausted_total",
+    "veles_serving_spec_rounds_total",
+    "veles_serving_beam_steps_total",
 )
 
 #: process-global registry of live engines (web_status /metrics renders

@@ -1,50 +1,68 @@
-"""Continuous-batching decode engine: a persistent slot-pool KV cache
-driven by ONE fixed-shape jitted decode step.
+"""Continuous-batching decode engine: a paged slot-pool KV cache
+driven by a bounded set of fixed-shape jitted programs.
 
 Replaces the window-coalescing serving model (one batched decode per
 exact shape key, everyone rides to the longest member's ``n_new``)
-with iteration-level scheduling:
+with iteration-level scheduling over a PAGED KV cache (the
+block-table formulation of PAPERS.md's "Compiler-First State Space
+Duality and Portable O(1) Autoregressive Caching for Inference"):
 
-- the KV cache is a ``max_slots``-row pool, every row padded to
-  ``max_context`` — the decode step's shapes never change, so it
-  compiles exactly once;
-- prefill pads prompts to a small set of length ``buckets`` — the jit
-  cache is bounded by ``len(buckets) + 1`` programs, not by distinct
-  prompt lengths (right-padding is safe under the causal mask: pad
-  K/V rows are invisible to real positions and are overwritten by the
-  decode steps before the read mask ever reaches them);
-- the scheduler admits queued requests into free slots at step
-  boundaries and a row retires the moment it emits ``eos_id`` or
-  reaches its own ``n_new`` — short requests never wait for long
-  co-riders and the chip never idles while the queue is non-empty;
+- K/V live in a global pool of fixed-size PAGES (``page_size``
+  positions each, a multiple of ``decode_block``); every slot owns a
+  page-table row — an int32 index array — and the jitted programs
+  gather a slot's logical ``max_context`` cache view through it.
+  Pool HBM is ``pages x page_size``, NOT ``max_slots x max_context``:
+  concurrency is bounded by pages actually reserved, so the same HBM
+  sustains roughly ``max_context / mean(prompt + n_new)`` times more
+  concurrent slots than the dense pool it replaces;
+- admission RESERVES each request's own worst case —
+  ``ceil(max(bucket, prompt + n_new [+ gamma + 1]) / page_size)``
+  pages per row, never ``max_context`` — and frees them the moment
+  the row retires. Reserving up front makes page exhaustion
+  impossible mid-decode in normal operation (the head request waits,
+  FIFO kept, when the allocator cannot hold it); per-tick growth
+  (:meth:`SlotScheduler.grow` via ``_grow_or_shed``) is the
+  accounting safety net, and a row it cannot cover — or an injected
+  ``serve.page_alloc`` fault — is shed 503 + Retry-After while
+  everyone else keeps decoding;
+- the decode step's shapes never change — page tables are data, not
+  shape — so it still compiles exactly once; prefill pads prompts to
+  a small set of length ``buckets``, so the greedy/sample plane holds
+  ``len(buckets) + 1`` programs, never one per prompt length;
+- ALL decode modes ride the pool: ``speculative`` rows advance by
+  on-device draft/verify rounds (a second fixed-shape program sharing
+  the page tables; the draft model's K/V pages ride the same
+  allocator) and ``beam`` requests occupy ``beam_width`` hypothesis
+  rows advanced by a fixed-shape group top-k step whose cache reorder
+  is a page-granular copy. Each mode adds a bounded constant to the
+  program count (:meth:`ContinuousEngine.programs_bound`);
 - each slot carries its own PRNG stream derived purely from the
-  request's ``seed`` (``jax.random.fold_in``-style independence via
-  per-row ``split`` streams), so a request's tokens are id-exact vs
-  its solo decode whatever strangers share the batch — stochastic
-  decodes batch on the same bar the greedy CI gate sets.
+  request's ``seed``, so a request's tokens are id-exact vs its solo
+  decode whatever strangers share the batch — greedy, sampled,
+  speculative and beam rows co-tenant in one pool without changing
+  each other's answers.
 
-The per-block cache layout and math are ``nn/sampling.py``'s
-``_block_prefill`` / ``_block_step`` — the decode step vmaps the very
-same single-row step over the pool, so the engine cannot drift from
-the scan decoder numerically.
+The per-block cache math is ``nn/sampling.py``'s ``_block_prefill`` /
+``_block_step`` (and ``nn/speculative.py``'s ``_block_span`` for the
+verify window) applied to the gathered page view — positions beyond a
+row's pages are causal-masked to exact zeros, so the paged programs
+cannot drift numerically from the dense formulation or the scan
+decoder.
 
 Two optional planes ride the same programs (veles_tpu/quant/,
 docs/services.md "Quantized serving"):
 
-- **int8 weights** (``quant_weights``): the decode matmul weights are
-  stored per-channel int8 and dequantized on read at the head of each
-  program — XLA fuses the ``q·s`` into the consuming matmul, so the
-  block math below the dequant is byte-for-byte the float engine's;
-- **int8 KV cache** (``quant_kv``): the slot pool stores int8 rows
-  with per-slot/-position f32 scales — half the pool HBM at the same
-  ``max_slots``; each position is scaled once at write time, so there
-  is no error accumulation across decode steps;
-- **AOT artifact** (``artifact``): ``veles-tpu export serve-artifact``
-  pre-exports every program via ``jax.export``; the engine
-  deserializes them at :meth:`start`, so serving performs ZERO jit
-  traces/compiles (``veles_compiles_total`` stays flat and
-  ``veles_serving_compile_seconds_total`` reads 0). A corrupt or
-  mismatched artifact falls back to live jit with a counted warning.
+- **int8 weights** (``quant_weights``): decode matmul weights stored
+  per-channel int8, dequantized at the head of each program;
+- **int8 KV cache** (``quant_kv``): the page pool stores int8 payloads
+  with per-page f32 scale sidecars — half the pool HBM at the same
+  page count. Speculative/beam requests ride the window plane when the
+  pool is int8 (their round/step programs are float-pool only);
+- **AOT artifact** (``artifact``): pre-exported prefill/decode
+  programs loaded at :meth:`start` — zero jit compiles on the
+  greedy/sample path. Spec/beam programs always build live (counted).
+  A corrupt or mismatched artifact falls back to live jit with a
+  counted warning.
 """
 
 from __future__ import annotations
@@ -72,12 +90,18 @@ from ..telemetry.spans import span
 #: computed-and-discarded, so the clamp only has to keep it finite)
 _TEMP_EPS = 1e-3
 
+#: slot modes the plain decode step advances
+_STEP_MODES = ("greedy", "sample")
+
 
 def _same_leaves(a: Dict, b: Dict) -> bool:
     """True when two ``params_of`` trees carry IDENTICAL array objects.
     ``device_view()`` returns its cached jax array until a host-side
     update re-places it, so object identity is the cheap 'weights
-    unchanged' test the quantization cache keys on."""
+    unchanged' test the quantization cache keys on. An in-place device
+    mutation that reuses the same ``jax.Array`` is invisible to this
+    test — such mutators must call
+    :meth:`ContinuousEngine.invalidate_quant_cache`."""
     if a.keys() != b.keys():
         return False
     for u in a:
@@ -89,35 +113,43 @@ def _same_leaves(a: Dict, b: Dict) -> bool:
     return True
 
 
-def make_request(prompt, n_new, temperature=0.0, seed=0, eos_id=None
-                 ) -> Dict:
+def make_request(prompt, n_new, temperature=0.0, seed=0, eos_id=None,
+                 mode="greedy", gamma=4, beam=4) -> Dict:
     """Normalized request dict (the subset of GenerationAPI's parsed
     request the engine consumes) — for tests and bench harnesses."""
     return {"prompt": [int(t) for t in prompt], "n_new": int(n_new),
             "temperature": float(temperature), "seed": int(seed),
-            "eos_id": eos_id}
+            "eos_id": eos_id, "mode": str(mode), "gamma": int(gamma),
+            "beam": int(beam)}
 
 
 class ContinuousEngine(Logger):
-    """In-flight batching over a persistent KV-cache slot pool.
+    """In-flight batching over a persistent paged KV-cache pool.
 
     ``wf`` is a generation-capable workflow (``Embedding`` →
-    ``TransformerBlock``×N → ``LMHead``, validated at construction).
-    ``decode_block`` fuses that many decode steps into one dispatch
-    (``lax.scan``) — admission/retirement granularity stays one
-    *chunk*; 1 keeps pure per-token scheduling, larger values amortize
-    dispatch overhead on hosts where it dominates.
+    ``TransformerBlock``×N → ``LMHead``, validated at construction);
+    ``draft`` is an optional smaller workflow of the same shape that
+    enables ``mode=speculative`` on the pool. ``decode_block`` fuses
+    that many decode steps into one dispatch (``lax.scan``);
+    ``page_size`` must be a positive multiple of it so a chunk never
+    outruns its growth check by more than one page.
     """
 
     def __init__(self, wf, max_slots: int = 8,
                  buckets: Tuple[int, ...] = (16, 32, 64, 128),
                  max_context: int = 640, decode_block: int = 1,
+                 page_size: Optional[int] = None,
+                 pages: Optional[int] = None,
+                 spec_gamma: Optional[int] = None,
+                 beam_width: Optional[int] = None,
+                 draft=None,
                  quant_weights: Optional[bool] = None,
                  quant_kv: Optional[bool] = None,
                  artifact: Optional[str] = None,
                  name: str = "serving") -> None:
         super().__init__()
         from ..config import root
+        from .pages import PagePool, pages_for
         from .scheduler import SlotScheduler
         self.wf = wf
         self.name = name
@@ -143,18 +175,75 @@ class ContinuousEngine(Logger):
         self.max_slots = int(max_slots)
         self.max_context = int(max_context)
         self.decode_block = max(1, int(decode_block))
+        serving_cfg = root.common.serving
+        self.page_size = int(
+            serving_cfg.get("page_size", 16)
+            if page_size is None else page_size)
+        if self.page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        if self.page_size % self.decode_block:
+            raise ValueError(
+                "page_size %d must be a multiple of decode_block %d "
+                "(a decode chunk may never outrun its page-growth "
+                "check by more than one page)"
+                % (self.page_size, self.decode_block))
+        #: page-table entries per slot; the gathered view length is
+        #: pages_per_slot * page_size >= max_context
+        self.pages_per_slot = pages_for(self.max_context, self.page_size)
+        cfg_pages = serving_cfg.get("pages", None) \
+            if pages is None else pages
+        #: usable pages; default = dense-equivalent capacity (every
+        #: slot can hold max_context), which operators SHRINK to trade
+        #: worst-case context reservation for more concurrent slots
+        self.pages = int(self.max_slots * self.pages_per_slot
+                         if cfg_pages in (None, 0) else cfg_pages)
+        if self.pages < 1:
+            raise ValueError("pages must be >= 1")
+        self.spec_gamma = int(
+            serving_cfg.get("spec_gamma", 4)
+            if spec_gamma is None else spec_gamma)
+        if self.spec_gamma < 1:
+            raise ValueError("spec_gamma must be >= 1")
+        self.beam_width = int(
+            serving_cfg.get("beam_width", 4)
+            if beam_width is None else beam_width)
+        if self.beam_width < 1:
+            raise ValueError("beam_width must be >= 1")
         from . import parse_buckets
         self.buckets = parse_buckets(buckets)
+        self.page_pool = PagePool(self.pages, self.page_size)
         self.scheduler = SlotScheduler(self.max_slots, self.buckets,
-                                       self.max_context)
+                                       self.max_context,
+                                       page_pool=self.page_pool,
+                                       beam_width=self.beam_width,
+                                       spec_gamma=self.spec_gamma)
+        # the draft workflow enables mode=speculative on the pool; an
+        # unusable draft degrades spec to the window plane, never the
+        # whole engine
+        self.draft = None
+        self.draft_stack = None
+        if draft is not None:
+            try:
+                self.draft_stack = split_stack(
+                    list(getattr(draft, "forwards", ()) or ()))
+                self.draft = draft
+            except VelesError as e:
+                self.warning("%s: draft model unusable for pooled "
+                             "speculation (%s); mode=speculative rides "
+                             "the window plane", name, e)
         pos_emb = self.stack["pos_emb"]
         self._table_len = (None if pos_emb is None else
                            pos_emb.param_arrays()["table"].shape[0])
+        self._beam_G = max(1, self.max_slots // self.beam_width)
         self._progs: Dict = {}
         self._params = None
+        self._draft_params = None
         self._quant_cache = None   # (float tree, its calibrated twin)
         self._caches = None
+        self._draft_caches = None
         self._keys = None
+        self._page_table = numpy.zeros(
+            (self.max_slots, self.pages_per_slot), numpy.int32)
         self._tok = numpy.zeros(self.max_slots, numpy.int32)
         self._pos = numpy.zeros(self.max_slots, numpy.int32)
         self._temp = numpy.zeros(self.max_slots, numpy.float32)
@@ -162,6 +251,7 @@ class ContinuousEngine(Logger):
         self._closing = False
         self.admitted = 0
         self.retired = 0
+        self.peak_slots = 0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ContinuousEngine":
@@ -176,9 +266,13 @@ class ContinuousEngine(Logger):
         from . import register_engine
         register_engine(self)
         self.info("%s: continuous batching up (slots=%d buckets=%s "
-                  "max_context=%d decode_block=%d)", self.name,
-                  self.max_slots, list(self.buckets), self.max_context,
-                  self.decode_block)
+                  "max_context=%d decode_block=%d pages=%dx%d%s%s)",
+                  self.name, self.max_slots, list(self.buckets),
+                  self.max_context, self.decode_block, self.pages,
+                  self.page_size,
+                  " +spec" if self.draft is not None else "",
+                  " +beam" if self.beam_width <= self.max_slots
+                  else "")
         return self
 
     def stop(self) -> None:
@@ -199,16 +293,56 @@ class ContinuousEngine(Logger):
         """None when the slot pool can serve ``req``; otherwise the
         reason (caller falls back to the window-coalescing path)."""
         t_p, n_new = len(req["prompt"]), int(req["n_new"])
+        mode = str(req.get("mode", "greedy"))
+        if mode not in _STEP_MODES + ("speculative", "beam"):
+            # fail CLOSED: an unrecognized mode would admit fine but
+            # no tick path would ever advance it — the slot and its
+            # reserved pages would leak for the life of the process
+            return "unknown decode mode %r" % mode
         if t_p < 1:
             return "empty prompt"
-        reason = self.scheduler.reject_reason(t_p, n_new)
+        if mode == "speculative":
+            if self.draft is None:
+                return "no pooled draft model (speculation rides the "\
+                       "window plane)"
+            if int(req.get("gamma", self.spec_gamma)) != self.spec_gamma:
+                return ("gamma %d differs from the pool's fixed-shape "
+                        "round (spec_gamma=%d)"
+                        % (int(req.get("gamma", 0)), self.spec_gamma))
+            if self.quant_kv:
+                return "int8 KV pool serves greedy/sample only; "\
+                       "speculation rides the window plane"
+        if mode == "beam":
+            if int(req.get("beam", self.beam_width)) != self.beam_width:
+                return ("beam %d differs from the pool's fixed-shape "
+                        "group (beam_width=%d)"
+                        % (int(req.get("beam", 0)), self.beam_width))
+            if self.quant_kv:
+                return "int8 KV pool serves greedy/sample only; beam "\
+                       "rides the window plane"
+            vocab = int(self.stack["head"].vocab_size)
+            if self.beam_width > vocab:
+                return ("beam %d exceeds the head's vocab size %d"
+                        % (self.beam_width, vocab))
+        reason = self.scheduler.reject_reason(
+            t_p, n_new, mode=mode,
+            gamma=int(req.get("gamma", self.spec_gamma)))
         if reason:
             return reason
-        if self._table_len is not None and t_p + n_new > self._table_len:
+        worst = self.scheduler._worst_positions(
+            t_p, n_new, mode, int(req.get("gamma", self.spec_gamma)))
+        if self._table_len is not None and worst > self._table_len:
             return ("generation to %d positions exceeds the trained "
                     "PositionalEmbedding table (%d rows)"
-                    % (t_p + n_new, self._table_len))
-        if 0 < float(req.get("temperature", 0.0)) < _TEMP_EPS:
+                    % (worst, self._table_len))
+        if self.draft is not None and mode == "speculative":
+            dpe = self.draft_stack["pos_emb"]
+            if dpe is not None and \
+                    worst > dpe.param_arrays()["table"].shape[0]:
+                return ("speculation to %d positions exceeds the "
+                        "draft's PositionalEmbedding table" % worst)
+        if mode != "beam" and \
+                0 < float(req.get("temperature", 0.0)) < _TEMP_EPS:
             # the shared decode program clamps the divisor at
             # _TEMP_EPS; a colder-than-that request would sample from
             # different logits here than solo sampling.generate does —
@@ -216,7 +350,7 @@ class ContinuousEngine(Logger):
             return ("temperature %g below the engine's %g resolution"
                     % (req["temperature"], _TEMP_EPS))
         bucket = self.scheduler.bucket_for(t_p)
-        if self._kernel_straddle(t_p, bucket):
+        if self._kernel_straddle(t_p, bucket, self.stack):
             # padding to the bucket would flip attention_core's
             # flash/reference choice vs the exact-length solo prefill
             # (choose_flash is length-gated) — different kernels drift
@@ -224,17 +358,21 @@ class ContinuousEngine(Logger):
             # such a prompt rides the window plane instead
             return ("prompt %d pads to bucket %d across the "
                     "flash-attention crossover" % (t_p, bucket))
+        if mode == "speculative" and self._kernel_straddle(
+                t_p, bucket, self.draft_stack):
+            return ("prompt %d pads to bucket %d across the draft's "
+                    "flash-attention crossover" % (t_p, bucket))
         return None
 
-    def _kernel_straddle(self, t_p: int, bucket: int) -> bool:
+    def _kernel_straddle(self, t_p: int, bucket: int, stack) -> bool:
         """True when any block's attention would pick a different
         kernel for the padded bucket length than for the exact prompt
         length (see ``ops.flash_attention.choose_flash``)."""
         if t_p == bucket:
             return False
         from ..ops.flash_attention import choose_flash
-        d = self.stack["stem"].dim
-        for blk in self.stack["blocks"]:
+        d = stack["stem"].dim
+        for blk in stack["blocks"]:
             hd = d // blk.n_heads
             if choose_flash(bucket, hd) != choose_flash(t_p, hd):
                 return True
@@ -286,20 +424,35 @@ class ContinuousEngine(Logger):
     # -- observability -------------------------------------------------------
     def stats(self) -> Dict[str, float]:
         from ..quant import pool_nbytes
+        in_use = self.page_pool.in_use()
+        occupied = 0
+        for slot in self.scheduler.active():
+            occupied += min(int(self._pos[slot.idx]),
+                            len(slot.pages) * self.page_size)
+        frag = (0.0 if in_use == 0 else
+                max(0.0, 1.0 - occupied / (in_use * self.page_size)))
         return {
             "slots": self.max_slots,
             "slots_busy": self.scheduler.busy_count(),
+            "peak_slots": self.peak_slots,
             "queue_depth": self.scheduler.queue_depth(),
             "admitted": self.admitted,
             "retired": self.retired,
             "programs": len(self._progs),
+            # paged-pool occupancy (serving/pages.py): what an
+            # operator sizes `pages`/`page_size` with
+            "pages_total": self.pages,
+            "pages_in_use": in_use,
+            "page_size": self.page_size,
+            "page_fragmentation": round(frag, 4),
             # quantization/AOT plane (veles_tpu/quant/): what the
             # /metrics mode gauges render on both surfaces
             "artifact_mode": int(self.artifact_mode),
             "quant_weights": int(self.quant_weights),
             "quant_kv": int(self.quant_kv),
             "compiled_live": self.compiled_live,
-            "kv_pool_bytes": pool_nbytes(self._caches),
+            "kv_pool_bytes": pool_nbytes(self._caches)
+            + pool_nbytes(self._draft_caches),
         }
 
     @property
@@ -311,10 +464,36 @@ class ContinuousEngine(Logger):
 
     @property
     def programs_built(self) -> int:
-        """Jitted programs this engine ever built — bounded by
-        ``len(buckets) + 1`` (the bucketed prefills + the one decode
-        step), never by distinct prompt lengths."""
+        """Jitted programs this engine ever built. The greedy/sample
+        plane is bounded by ``len(buckets) + 1``; speculation adds its
+        draft prefills + one round program, beam one step program —
+        see :meth:`programs_bound`."""
         return len(self._progs)
+
+    def programs_bound(self) -> int:
+        """The hard ceiling on :attr:`programs_built`: bucketed
+        prefills + the decode step, plus (draft configured) the draft
+        prefills + the spec round, plus (beam servable) the beam step
+        and the sibling page-copy — a CONSTANT per engine, never a
+        function of traffic."""
+        bound = len(self.buckets) + 1
+        if self.draft is not None:
+            bound += len(self.buckets) + 1
+        if self.beam_width <= self.max_slots:
+            bound += 1 + (1 if self.beam_width > 1 else 0)
+        return bound
+
+    def invalidate_quant_cache(self) -> None:
+        """Drop the calibrated int8 twin (and the cached device view)
+        so the next idle boundary recalibrates from the live weights.
+        The identity-keyed cache in :meth:`_prepare_params` cannot see
+        an IN-PLACE device mutation that reuses the same ``jax.Array``
+        object — any code path that mutates parameters without
+        re-placing them must call this, or quantized serving would
+        keep the stale scales forever."""
+        self._quant_cache = None
+        self._params = None
+        self._draft_params = None
 
     # -- worker --------------------------------------------------------------
     def _loop(self) -> None:
@@ -341,7 +520,7 @@ class ContinuousEngine(Logger):
                     self._abort_active("internal serving error",
                                        code=500, count_shed=False)
                     # donated buffers may be gone — rebuild lazily
-                    self._caches = self._keys = self._params = None
+                    self._reset_pool()
                     # a tick that dies before take_admissions never
                     # reaches the deadline check there: sweep the queue
                     # so waiting callers still get their 503 instead of
@@ -354,9 +533,16 @@ class ContinuousEngine(Logger):
         finally:
             health.heartbeats.unregister(hb)
 
+    def _reset_pool(self) -> None:
+        self._caches = self._draft_caches = self._keys = None
+        self._params = self._draft_params = None
+
+    def _active(self, modes: Tuple[str, ...]) -> List:
+        return [s for s in self.scheduler.active() if s.mode in modes]
+
     def _tick(self) -> None:
-        """One step boundary: admit into free slots, then run one
-        decode chunk over the pool."""
+        """One step boundary: admit into free slots, then advance each
+        decode mode's rows by one fixed-shape dispatch."""
         # the param device-view walk (per-array locks) is too heavy to
         # repeat per decode chunk, but a snapshot held forever would
         # serve stale weights after a host-side update. Middle ground:
@@ -368,15 +554,31 @@ class ContinuousEngine(Logger):
         params = self._params
         if params is None or self.scheduler.busy_count() == 0:
             params = self._params = self._prepare_params()
+            if self.draft is not None:
+                self._draft_params = params_of(self.draft)
         self._ensure_pool(params)
         from .scheduler import shed_expired
         admissions, expired = self.scheduler.take_admissions()
         shed_expired(expired)
         for slot in admissions:
+            if self.scheduler.slots[slot.idx] is not slot:
+                # already retired within this very loop — an n_new=1
+                # beam group is finished (and every hypothesis row
+                # freed) by its FIRST slot's admission; dispatching
+                # prefills for the dead siblings would waste device
+                # work and smear host state over freed rows
+                continue
             try:
                 self._admit(params, slot)
             except Exception as e:    # noqa: BLE001 — answer, don't die
-                self.scheduler.retire(slot)
+                # retire the whole group before answering: sibling
+                # hypothesis rows share this ticket, and leaving them
+                # active would let _abort_active below overwrite the
+                # already-set answer (a torn 500/503 read in the
+                # handler thread)
+                for victim in (slot.group.slots
+                               if slot.group is not None else [slot]):
+                    self._retire_slot(victim)
                 slot.ticket.fail("%s: %s" % (type(e).__name__, e),
                                  code=500)
                 # the prefill program DONATES the pool: a dispatch
@@ -389,16 +591,22 @@ class ContinuousEngine(Logger):
                 self._abort_active("serving pool reset after a failed "
                                    "admission", code=503,
                                    retry_after=1.0)
-                self._caches = self._keys = self._params = None
+                self._reset_pool()
                 return
-        if self.scheduler.busy_count():
-            try:
+        self.peak_slots = max(self.peak_slots,
+                              self.scheduler.busy_count())
+        try:
+            if self._active(_STEP_MODES):
                 self._decode(params)
-            except FaultInjected as e:
-                # an injected decode fault DEGRADES: in-flight rows are
-                # shed with Retry-After, the pool stays consistent (the
-                # fault fires before the dispatch)
-                self._abort_active(str(e), code=503, retry_after=1.0)
+            if self._active(("speculative",)):
+                self._spec_tick(params)
+            if self.scheduler.active_beams():
+                self._beam_tick(params)
+        except FaultInjected as e:
+            # an injected decode fault DEGRADES: in-flight rows are
+            # shed with Retry-After, the pool stays consistent (the
+            # fault fires before the dispatch)
+            self._abort_active(str(e), code=503, retry_after=1.0)
 
     def _prepare_params(self) -> Dict:
         """Fresh device-side params for the serving programs: the
@@ -407,10 +615,11 @@ class ContinuousEngine(Logger):
         boundary: ``device_view()`` returns the cached jax array until
         a host-side update re-places it, so leaf identity against the
         last-calibrated tree tells exactly when the weights actually
-        changed — unchanged weights reuse the quantized twin (a
-        one-request-at-a-time load would otherwise pay a full amax
-        scan per request that the float engine does not), updated
-        weights get fresh scales at the next burst boundary."""
+        changed — unchanged weights reuse the quantized twin, updated
+        weights get fresh scales at the next burst boundary. In-place
+        device mutations (same ``jax.Array`` object, new bytes) are
+        invisible here — their authors must call
+        :meth:`invalidate_quant_cache`."""
         params = params_of(self.wf)
         if not self.quant_weights:
             return params
@@ -426,17 +635,28 @@ class ContinuousEngine(Logger):
         if self._caches is not None:
             return
         import jax.numpy as jnp
-        from ..quant import block_pool
-        stem, blocks = self.stack["stem"], self.stack["blocks"]
+        from ..quant import block_page_pool
+        rows = self.page_pool.device_rows
         dtype = self._pool_dtype(params)
-        d = stem.dim
-        caches = []
-        for blk in blocks:
-            bkv = getattr(blk, "n_kv_heads", blk.n_heads)
-            hd = d // blk.n_heads
-            caches.append(block_pool(self.max_slots, self.max_context,
-                                     bkv, hd, dtype, self.quant_kv))
-        self._caches = tuple(caches)
+
+        def pools(stack, quantized):
+            d = stack["stem"].dim
+            out = []
+            for blk in stack["blocks"]:
+                bkv = getattr(blk, "n_kv_heads", blk.n_heads)
+                hd = d // blk.n_heads
+                out.append(block_page_pool(rows, self.page_size, bkv,
+                                           hd, dtype, quantized))
+            return tuple(out)
+
+        self._caches = pools(self.stack, self.quant_kv)
+        if self.draft is not None and not self.quant_kv:
+            # the draft pool shares the allocator and page tables; it
+            # stays float. Under quant_kv accepts() routes EVERY
+            # speculative request to the window plane, so allocating
+            # it there would be pure dead HBM against the very claim
+            # quant_kv makes
+            self._draft_caches = pools(self.draft_stack, False)
         self._keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
 
     def _pool_dtype(self, params):
@@ -446,42 +666,144 @@ class ContinuousEngine(Logger):
         return params[stem.name]["table"].dtype
 
     # -- admission ------------------------------------------------------------
+    def _refresh_table_row(self, slot) -> None:
+        """Sync the host page-table row with the slot's page list —
+        THE one place the row layout is written (admission, growth and
+        the sibling page-copy all go through here)."""
+        row = self._page_table[slot.idx]
+        row[:] = 0
+        row[:len(slot.pages)] = slot.pages
+
+    def _table_row(self, slot):
+        import jax.numpy as jnp
+        self._refresh_table_row(slot)
+        return jnp.asarray(self._page_table[slot.idx])
+
     def _admit(self, params, slot) -> None:
         import jax
         import jax.numpy as jnp
         t_p, bucket = slot.t_p, slot.bucket
+        group = slot.group
+        if group is not None and slot is not group.slots[0]:
+            # sibling hypothesis rows start as exact copies of the
+            # lead row's prompt cache: ONE page-granular device copy
+            # instead of re-running the full prefill per hypothesis
+            # (the lead admits first — take_admissions fills groups in
+            # order)
+            dst_row = self._table_row(slot)
+            src_row = self._table_row(group.slots[0])
+            self._caches = self._program("pagecopy")(
+                src_row, dst_row, self._caches)
+            self._pos[slot.idx] = t_p
+            self._temp[slot.idx] = slot.temperature
+            return
         ids = numpy.zeros((1, bucket), numpy.int32)
         ids[0, :t_p] = slot.req["prompt"]
+        ids_dev = jnp.asarray(ids)
+        table_row = self._table_row(slot)
         prog = self._program("prefill", bucket)
         seed_key = jax.random.PRNGKey(int(slot.req.get("seed", 0)))
         wait = max(0.0, time.time() - slot.ticket.enqueued)
         with span("serving.prefill", bucket=bucket, slot=slot.idx,
-                  t_p=t_p):
-            first, self._keys, self._caches = prog(
-                params, jnp.asarray(ids), numpy.int32(t_p),
+                  t_p=t_p, mode=slot.mode):
+            first, logits, self._keys, self._caches = prog(
+                params, ids_dev, numpy.int32(t_p),
                 numpy.int32(slot.idx), numpy.float32(slot.temperature),
-                seed_key, self._keys, self._caches)
-            first = int(first)
+                seed_key, table_row, self._keys, self._caches)
         inc("veles_serving_prefill_dispatches_total")
-        inc("veles_serving_admitted_total")
-        inc("veles_serving_queue_wait_seconds_total", wait)
-        self.admitted += 1
-        self._tok[slot.idx] = first
         self._pos[slot.idx] = t_p
         self._temp[slot.idx] = slot.temperature
-        if slot.record(first):
-            self._finish(slot)
+        if slot.mode == "speculative":
+            self._draft_caches = self._program("dprefill", bucket)(
+                self._draft_params, ids_dev, table_row,
+                self._draft_caches)
+            inc("veles_serving_prefill_dispatches_total")
+        if group is None:
+            inc("veles_serving_admitted_total")
+            inc("veles_serving_queue_wait_seconds_total", wait)
+            self.admitted += 1
+            first = int(first)
+            self._tok[slot.idx] = first
+            if slot.record(first):
+                self._finish(slot)
+            return
+        # beam: count the REQUEST once, expand the first top-W
+        # hypotheses from the prefill logits (the same log_softmax +
+        # top_k arithmetic nn/beam.py's first expansion runs)
+        if slot is group.slots[0]:
+            inc("veles_serving_admitted_total")
+            inc("veles_serving_queue_wait_seconds_total", wait)
+            self.admitted += 1
+            logp0 = jax.nn.log_softmax(
+                jnp.asarray(logits).astype(jnp.float32))
+            top0, tok0 = jax.lax.top_k(logp0, self.beam_width)
+            group.cur = numpy.asarray(tok0, numpy.int32)
+            group.scores = numpy.asarray(top0, numpy.float32)
+            eos = slot.eos_id
+            group.finished = (group.cur == (-1 if eos is None
+                                            else int(eos)))
+            group.toks = numpy.zeros(
+                (self.beam_width, slot.n_new), numpy.int32)
+            group.toks[:, 0] = group.cur
+            group.step = 0
+            if slot.n_new == 1:
+                self._finish_beam(group)
+
+    # -- page growth -----------------------------------------------------------
+    def _grow_or_shed(self, slots: List, need_fn) -> List:
+        """Extend each slot's pages to cover ``need_fn(slot)``
+        positions before the next dispatch. Admission reserved every
+        row's own worst case, so this normally allocates NOTHING —
+        it is the accounting safety net: a slot the allocator cannot
+        cover (ledger drift, or an injected ``serve.page_alloc``
+        fault) is SHED — 503 + Retry-After, pages freed, pool stays
+        consistent — while the survivors keep decoding. Returns the
+        surviving slots; their page-table rows are refreshed."""
+        alive: List = []
+        dead = set()
+        for slot in slots:
+            if id(slot) in dead:
+                continue
+            grown = self.scheduler.grow(slot, need_fn(slot))
+            if grown:
+                self._refresh_table_row(slot)
+                alive.append(slot)
+                continue
+            victims = (slot.group.slots if slot.group is not None
+                       else [slot])
+            # ONE shed request however many hypothesis rows it held —
+            # the admitted/retired counters are per request too
+            inc("veles_shed_requests_total")
+            for v in victims:
+                dead.add(id(v))
+                if v in alive:
+                    alive.remove(v)
+                self._retire_slot(v)
+            victims[0].ticket.fail(
+                "serving page pool exhausted mid-decode", code=503,
+                retry_after=1.0)
+        return alive
 
     # -- the decode chunk ------------------------------------------------------
     def _decode(self, params) -> None:
         import jax.numpy as jnp
-        active = self.scheduler.active()
+        active = self._grow_or_shed(
+            self._active(_STEP_MODES),
+            lambda s: min(s.t_p + s.n_new,
+                          int(self._pos[s.idx]) + self.decode_block))
+        if not active:
+            return
+        mask = numpy.zeros(self.max_slots, numpy.int32)
+        for slot in active:
+            mask[slot.idx] = 1
         fire_fault("serve.decode_step")
         with span("serving.decode_step", active=len(active),
                   chunk=self.decode_block):
             toks, self._keys, self._caches = self._program("step")(
                 params, jnp.asarray(self._tok), jnp.asarray(self._pos),
-                jnp.asarray(self._temp), self._keys, self._caches)
+                jnp.asarray(self._temp), jnp.asarray(mask),
+                jnp.asarray(self._page_table), self._keys,
+                self._caches)
             toks = numpy.asarray(toks)          # (decode_block, S)
         inc("veles_serving_decode_dispatches_total")
         finished: List = []
@@ -498,9 +820,136 @@ class ContinuousEngine(Logger):
         for slot in finished:
             self._finish(slot)
 
+    # -- the speculative round -------------------------------------------------
+    def _spec_tick(self, params) -> None:
+        """One on-device draft/verify round for every speculative row:
+        the draft proposes ``spec_gamma`` tokens (a ``lax.scan`` of
+        single-row steps over its paged view), the target verifies the
+        whole window in ONE multi-position pass, and the accept rule
+        emits up to gamma tokens per row — all rows advance by their
+        own accepted lengths inside one fixed-shape dispatch."""
+        import jax.numpy as jnp
+        gamma = self.spec_gamma
+        active = self._grow_or_shed(
+            self._active(("speculative",)),
+            lambda s: min(s.t_p + s.n_new + gamma + 1,
+                          int(self._pos[s.idx]) + gamma))
+        if not active:
+            return
+        smask = numpy.zeros(self.max_slots, numpy.int32)
+        for slot in active:
+            smask[slot.idx] = 1
+        fire_fault("serve.decode_step")
+        with span("serving.spec_round", active=len(active),
+                  gamma=gamma):
+            (out_vec, n_emit, acc, new_tok, self._keys, self._caches,
+             self._draft_caches) = self._program("spec")(
+                params, self._draft_params, jnp.asarray(self._tok),
+                jnp.asarray(self._pos), jnp.asarray(self._temp),
+                jnp.asarray(smask), jnp.asarray(self._page_table),
+                self._keys, self._caches, self._draft_caches)
+            out_vec = numpy.asarray(out_vec)     # (S, gamma)
+            n_emit = numpy.asarray(n_emit)
+            acc = numpy.asarray(acc)
+            new_tok = numpy.asarray(new_tok)
+        inc("veles_serving_decode_dispatches_total")
+        inc("veles_serving_spec_rounds_total", len(active))
+        for slot in active:
+            i = slot.idx
+            emitted = int(n_emit[i])
+            slot.rounds += 1
+            slot.acc += int(acc[i])
+            self._pos[i] += emitted
+            self._tok[i] = int(new_tok[i])
+            done = False
+            for t in out_vec[i, :emitted]:
+                if slot.record(int(t)):
+                    done = True
+                    break
+            if done:
+                self._finish(slot)
+
+    # -- the beam step ---------------------------------------------------------
+    def _beam_tick(self, params) -> None:
+        """One top-k step for every live beam group: each hypothesis
+        row runs the single-row step over its paged view, the group
+        expands W x V continuations, keeps the top W, and REORDERS the
+        caches by surviving parent — a page-granular copy through the
+        page tables, batched across groups in one fixed-shape
+        dispatch. The arithmetic is nn/beam.py's (f32 log_softmax,
+        frozen-eos lanes, flat top_k), so a pooled beam request's
+        tokens equal its solo ``beam_generate`` exactly."""
+        import jax.numpy as jnp
+        groups = self.scheduler.active_beams()
+        hyps = [s for g in groups for s in g.slots]
+        alive_slots = self._grow_or_shed(
+            hyps, lambda s: min(s.t_p + max(s.n_new - 1, 1),
+                                int(self._pos[s.idx]) + 1))
+        groups = [g for g in groups
+                  if all(s in alive_slots for s in g.slots)]
+        if not groups:
+            return
+        G, W, P = self._beam_G, self.beam_width, self.pages_per_slot
+        cur = numpy.zeros((G, W), numpy.int32)
+        pos = numpy.zeros(G, numpy.int32)
+        scores = numpy.full((G, W), -numpy.inf, numpy.float32)
+        finished = numpy.zeros((G, W), bool)
+        eosv = numpy.full(G, -1, numpy.int32)
+        gmask = numpy.zeros(G, numpy.int32)
+        tables_g = numpy.zeros((G, W, P), numpy.int32)
+        for gi, group in enumerate(groups):
+            cur[gi] = group.cur
+            pos[gi] = group.t_p + group.step
+            scores[gi] = group.scores
+            finished[gi] = group.finished
+            eosv[gi] = (-1 if group.slots[0].eos_id is None
+                        else int(group.slots[0].eos_id))
+            gmask[gi] = 1
+            for wi, slot in enumerate(group.slots):
+                tables_g[gi, wi] = self._page_table[slot.idx]
+        fire_fault("serve.decode_step")
+        with span("serving.beam_step", groups=len(groups),
+                  width=W):
+            tok, parent, new_scores, new_fin, self._caches = \
+                self._program("beam")(
+                    params, jnp.asarray(cur), jnp.asarray(pos),
+                    jnp.asarray(scores), jnp.asarray(finished),
+                    jnp.asarray(eosv), jnp.asarray(gmask),
+                    jnp.asarray(tables_g), self._caches)
+            tok = numpy.asarray(tok)
+            parent = numpy.asarray(parent)
+            new_scores = numpy.asarray(new_scores)
+            new_fin = numpy.asarray(new_fin)
+        inc("veles_serving_decode_dispatches_total")
+        inc("veles_serving_beam_steps_total", len(groups))
+        for gi, group in enumerate(groups):
+            i = group.step + 1
+            group.toks = group.toks[parent[gi]].copy()
+            group.toks[:, i] = tok[gi]
+            group.cur = tok[gi].copy()
+            group.scores = new_scores[gi].copy()
+            group.finished = new_fin[gi].copy()
+            group.step = i
+            for slot in group.slots:
+                self._pos[slot.idx] += 1
+            if i >= group.slots[0].n_new - 1:
+                self._finish_beam(group)
+
+    # -- retirement -------------------------------------------------------------
+    def _retire_slot(self, slot) -> None:
+        """Clear a row's host state and free its slot + pages. The
+        page-table row is zeroed so a retired row's stale view can
+        never alias pages the allocator hands to the next admission."""
+        self._tok[slot.idx] = 0
+        self._pos[slot.idx] = 0
+        self._temp[slot.idx] = 0.0
+        self._page_table[slot.idx, :] = 0
+        self.scheduler.retire(slot)
+
     def _finish(self, slot) -> None:
-        """Retire a row the moment it is done: free the slot (the next
-        admission reuses it immediately) and answer the ticket."""
+        """Retire a row the moment it is done: free the slot and its
+        pages (the next admission reuses them immediately) and answer
+        the ticket."""
         inc("veles_serving_retired_total")
         inc("veles_serving_tokens_total", len(slot.tokens))
         self.retired += 1
@@ -508,37 +957,65 @@ class ContinuousEngine(Logger):
         # batched_with response key, kept so the schema does not
         # depend on which plane served the request
         batched_with = max(0, self.scheduler.busy_count() - 1)
-        self._tok[slot.idx] = 0
-        self._pos[slot.idx] = 0
-        self._temp[slot.idx] = 0.0
-        self.scheduler.retire(slot)
-        slot.ticket.succeed({"tokens": list(slot.tokens),
-                             "batched_with": batched_with,
-                             "engine": "continuous"})
+        self._retire_slot(slot)
+        result = {"tokens": list(slot.tokens),
+                  "batched_with": batched_with,
+                  "engine": "continuous"}
+        if slot.mode == "speculative":
+            rounds = max(slot.rounds, 1)
+            result["rounds"] = rounds
+            result["acceptance"] = slot.acc / (rounds * self.spec_gamma)
+        slot.ticket.succeed(result)
+
+    def _finish_beam(self, group) -> None:
+        """Answer a beam request: rank hypotheses exactly like
+        ``beam_generate`` (descending score; eos freezing already
+        shaped the scores) and retire every hypothesis row."""
+        order = numpy.argsort(-group.scores.astype(numpy.float64))
+        best = int(order[0])
+        inc("veles_serving_retired_total")
+        inc("veles_serving_tokens_total", group.toks.shape[1])
+        self.retired += 1
+        for slot in group.slots:
+            self._retire_slot(slot)
+        batched_with = max(0, self.scheduler.busy_count() - 1)
+        group.ticket.succeed({
+            "tokens": [int(t) for t in group.toks[best]],
+            "scores": [float(group.scores[i]) for i in order],
+            "batched_with": batched_with,
+            "engine": "continuous"})
 
     def _abort_active(self, reason: str, code: int = 500,
                       retry_after: Optional[float] = None,
                       count_shed: bool = True) -> None:
+        answered = set()
         for slot in self.scheduler.active():
-            if count_shed:
-                inc("veles_shed_requests_total")
-            self._tok[slot.idx] = 0
-            self._pos[slot.idx] = 0
-            self._temp[slot.idx] = 0.0
-            self.scheduler.retire(slot)
-            slot.ticket.fail(reason, code=code, retry_after=retry_after)
+            self._retire_slot(slot)
+            if id(slot.ticket) not in answered:
+                answered.add(id(slot.ticket))
+                # one shed per REQUEST, not per hypothesis row — kept
+                # like-for-like with admitted/retired accounting
+                if count_shed:
+                    inc("veles_shed_requests_total")
+                slot.ticket.fail(reason, code=code,
+                                 retry_after=retry_after)
 
     # -- jitted programs -------------------------------------------------------
     def _program(self, kind: str, bucket: Optional[int] = None):
         key = (kind, bucket)
         prog = self._progs.get(key)
         if prog is None:
-            # in artifact mode every program was installed at start();
-            # reaching here means a bucket the artifact does not carry
-            # — impossible once geometry validated, but a live build
-            # is still the correct degradation
-            jitted = (self._build_prefill(bucket) if kind == "prefill"
-                      else self._build_decode())
+            # in artifact mode the base-plane programs were installed
+            # at start(); spec/beam/draft programs always build live
+            builders = {"prefill": self._build_prefill,
+                        "dprefill": self._build_draft_prefill,
+                        "step": self._build_decode,
+                        "spec": self._build_spec_round,
+                        "beam": self._build_beam_step,
+                        "pagecopy": self._build_page_copy}
+            jitted = (builders[kind](bucket)
+                      if kind in ("prefill", "dprefill")
+                      else builders[kind]())
             prog = self._progs[key] = self._instrument_live(jitted)
         return prog
 
@@ -580,12 +1057,12 @@ class ContinuousEngine(Logger):
     # -- AOT artifact (export/serve_artifact.py) ------------------------------
     def stack_signature(self) -> Dict:
         """Geometry the exported programs are shape-committed to: the
-        abstract spec of (params tree, pool) plus every serving knob.
-        Export stamps it into the artifact; load refuses on any
-        mismatch — a program traced for different shapes would fail
-        deep inside XLA with an opaque error (or worse, run on
-        reinterpreted buffers). Purely abstract: under
-        ``quant_weights`` the int8 spec comes from
+        abstract spec of (params tree, page pool) plus every serving
+        knob the base-plane programs bake in. Export stamps it into
+        the artifact; load refuses on any mismatch — a program traced
+        for different shapes would fail deep inside XLA with an opaque
+        error (or worse, run on reinterpreted buffers). Purely
+        abstract: under ``quant_weights`` the int8 spec comes from
         ``quantize_params_spec``, so building a signature never runs
         (or counts) a calibration pass."""
         import jax
@@ -614,6 +1091,11 @@ class ContinuousEngine(Logger):
             "buckets": list(self.buckets),
             "max_context": self.max_context,
             "decode_block": self.decode_block,
+            # paged-pool geometry: page tables are now program inputs,
+            # so the page count and size are shape commitments too
+            "page_size": self.page_size,
+            "pages": self.pages,
+            "pages_per_slot": self.pages_per_slot,
             "quant_weights": bool(self.quant_weights),
             "quant_kv": bool(self.quant_kv),
         }
@@ -646,16 +1128,80 @@ class ContinuousEngine(Logger):
                   self.artifact, len(programs))
         return True
 
+    # -- paged gather/scatter helpers (trace-time) ----------------------------
+    def _view(self, payload, table_row):
+        """Gather one slot's logical cache view through its page-table
+        row: (pages, page_size, kv, hd) + (P,) -> (P*page_size, kv,
+        hd). Unallocated entries point at the sink page; its garbage
+        rows sit beyond the causal mask until a write claims them."""
+        import jax.numpy as jnp
+        pages = jnp.take(payload, table_row, axis=0, mode="clip")
+        return pages.reshape((-1,) + payload.shape[2:])
+
+    def _row_targets(self, tables, pos, mask):
+        """Per-slot (page id, in-page offset) for writing position
+        ``pos`` — masked rows are pointed at the sink page, so one
+        batched scatter serves every lane of the fixed-shape step."""
+        import jax.numpy as jnp
+        P = tables.shape[1]
+        pg_idx = jnp.clip(pos // self.page_size, 0, P - 1)
+        pg = jnp.take_along_axis(tables, pg_idx[:, None], axis=1)[:, 0]
+        pg = jnp.where(mask > 0, pg, 0)
+        off = jnp.clip(pos % self.page_size, 0, self.page_size - 1)
+        return pg, off
+
+    def _paged_row_step(self, blk, p, kp, vp):
+        """The vmap'able single-row paged decode body shared by THE
+        decode step and the spec round's draft proposal: gather the
+        row's logical view through its page-table row, advance one
+        position with ``_block_step``, return ``(y, k_new, v_new)`` —
+        only the newly written position's rows, for the batched page
+        scatter. One definition so the gather/write discipline cannot
+        diverge between decode modes."""
+        import jax.numpy as jnp
+
+        def row(x_row, trow, pos_row):
+            ck = self._view(kp, trow)
+            cv = self._view(vp, trow)
+            y, ck2, cv2 = _block_step(blk, p, x_row[None, None, :],
+                                      ck[None], cv[None], pos_row)
+            return (y[0, 0],
+                    jnp.take(ck2[0], pos_row, axis=0, mode="clip"),
+                    jnp.take(cv2[0], pos_row, axis=0, mode="clip"))
+
+        return row
+
+    def _scatter_prompt(self, pool, rows, table_row, bucket, scales=None):
+        """Write a bucket's prefill K or V rows page-wise into the
+        pool: (bucket, kv, hd) padded up to whole pages and scattered
+        at this slot's page ids (a static-length index slice — the
+        program stays fixed-shape). ``scales`` rides along for the
+        int8 pool's per-page sidecar."""
+        import jax.numpy as jnp
+        n_pages = -(-bucket // self.page_size)
+        pad = n_pages * self.page_size - bucket
+        if pad:
+            rows = jnp.pad(rows, ((0, pad),) + ((0, 0),) * (rows.ndim - 1))
+        rows = rows.reshape((n_pages, self.page_size) + rows.shape[1:])
+        pool = pool.at[table_row[:n_pages]].set(rows)
+        if scales is None:
+            return pool
+        if pad:
+            scales = jnp.pad(scales, ((0, pad),))
+        return pool, scales.reshape(n_pages, self.page_size)
+
+    # -- program builders ------------------------------------------------------
     def _build_prefill(self, bucket: int):
         """One program per bucket: pad-to-``bucket`` full-window pass
-        through ``_block_prefill`` writing K/V into this slot's pool
-        rows, plus the request's FIRST sampled token (from the last
-        real position's logits) and its private PRNG carry. Under
-        ``quant_weights`` the program takes the int8 parameter tree and
-        dequantizes at its head (XLA fuses the ``q·s`` into each
-        consuming matmul); under ``quant_kv`` the computed float rows
-        are quantized once — per-position scales — before the pool
-        write."""
+        through ``_block_prefill`` writing K/V page-wise into this
+        slot's pages, plus the request's FIRST sampled token, the
+        last-real-position logits (the beam expansion's input) and the
+        slot's private PRNG carry. Under ``quant_weights`` the program
+        takes the int8 parameter tree and dequantizes at its head
+        (XLA fuses the ``q·s`` into each consuming matmul); under
+        ``quant_kv`` the computed float rows are quantized once —
+        per-position scales in the per-page sidecars — before the
+        pool write."""
         import jax
         import jax.numpy as jnp
         from ..ops import matmul_precision
@@ -666,9 +1212,9 @@ class ContinuousEngine(Logger):
         d = stem.dim
         quant_w, quant_kv = self.quant_weights, self.quant_kv
 
-        @functools.partial(jax.jit, donate_argnums=(6, 7))
-        def prefill(params, ids, t_p, slot, temp, seed_key, keys,
-                    caches):
+        @functools.partial(jax.jit, donate_argnums=(7, 8))
+        def prefill(params, ids, t_p, slot, temp, seed_key, table_row,
+                    keys, caches):
             if quant_w:
                 # reconstruct in the model's own float dtype (the
                 # never-quantized stem table's — read at trace time),
@@ -682,30 +1228,31 @@ class ContinuousEngine(Logger):
                                             bucket, d)
             new_caches = []
             for (ck, cv), pool in zip(blk_caches, caches):
-                # pad rows land in the pool too; they are causal-masked
-                # for every real position and the decode steps rewrite
-                # position p before the read mask reaches it
+                # pad rows land in the pages too; they are causal-
+                # masked for every real position and the decode steps
+                # rewrite position p before the read mask reaches it
                 if quant_kv:
                     from ..quant import quantize_rows_int8
-                    ckq_pool, cvq_pool, ks_pool, vs_pool = pool
+                    kq, vq, ks, vs = pool
                     qk, sk = quantize_rows_int8(ck)
                     qv, sv = quantize_rows_int8(cv)
-                    new_caches.append((
-                        jax.lax.dynamic_update_slice(
-                            ckq_pool, qk, (slot, 0, 0, 0)),
-                        jax.lax.dynamic_update_slice(
-                            cvq_pool, qv, (slot, 0, 0, 0)),
-                        jax.lax.dynamic_update_slice(
-                            ks_pool, sk, (slot, 0)),
-                        jax.lax.dynamic_update_slice(
-                            vs_pool, sv, (slot, 0))))
+                    kq, skp = self._scatter_prompt(kq, qk[0],
+                                                   table_row, bucket,
+                                                   sk[0])
+                    vq, svp = self._scatter_prompt(vq, qv[0],
+                                                   table_row, bucket,
+                                                   sv[0])
+                    n_pages = -(-bucket // self.page_size)
+                    ks = ks.at[table_row[:n_pages]].set(skp)
+                    vs = vs.at[table_row[:n_pages]].set(svp)
+                    new_caches.append((kq, vq, ks, vs))
                 else:
-                    ck_pool, cv_pool = pool
-                    new_caches.append((
-                        jax.lax.dynamic_update_slice(
-                            ck_pool, ck, (slot, 0, 0, 0)),
-                        jax.lax.dynamic_update_slice(
-                            cv_pool, cv, (slot, 0, 0, 0))))
+                    kp, vp = pool
+                    kp = self._scatter_prompt(kp, ck[0], table_row,
+                                              bucket)
+                    vp = self._scatter_prompt(vp, cv[0], table_row,
+                                              bucket)
+                    new_caches.append((kp, vp))
             x_last = jnp.take(x[0], t_p - 1, axis=0, mode="clip")
             logits = _head_logits(head, params, x_last, prec)
             k2 = jax.random.split(seed_key)
@@ -716,22 +1263,52 @@ class ContinuousEngine(Logger):
             first = jnp.where(temp > 0, samp, greedy)
             keys = jax.lax.dynamic_update_slice(keys, k2[0][None],
                                                 (slot, 0))
-            return first, keys, tuple(new_caches)
+            return first, logits, keys, tuple(new_caches)
 
         return prefill
 
+    def _build_draft_prefill(self, bucket: int):
+        """The draft model's prompt pass for a speculative admission:
+        writes the draft's K/V pages through the SAME page-table row
+        the target uses (the slot's pages index both pools), emits
+        nothing."""
+        import jax
+        stack = self.draft_stack
+        stem, pos_emb = stack["stem"], stack["pos_emb"]
+        blocks = stack["blocks"]
+        d = stem.dim
+
+        @functools.partial(jax.jit, donate_argnums=(3,))
+        def dprefill(params_d, ids, table_row, dcaches):
+            x = _embed_prompt(stem, pos_emb, params_d, ids)
+            _x, blk_caches = _prefill_blocks(blocks, params_d, x,
+                                             bucket, d)
+            new_caches = []
+            for (ck, cv), (kp, vp) in zip(blk_caches, dcaches):
+                kp = self._scatter_prompt(kp, ck[0], table_row, bucket)
+                vp = self._scatter_prompt(vp, cv[0], table_row, bucket)
+                new_caches.append((kp, vp))
+            return tuple(new_caches)
+
+        return dprefill
+
     def _build_decode(self):
         """THE decode step: ``decode_block`` scan iterations of the
-        vmapped single-row ``_block_step`` over every slot — one fixed
-        shape, compiled exactly once. Per-row sampling draws from each
-        slot's private key stream, so a row's noise is a pure function
-        of its request's seed (id-exact vs solo decode whatever else
-        rides the pool). Under ``quant_kv`` each row dequantizes its
-        int8 cache for the attention read, runs the SAME
-        ``_block_step``, then quantizes only the one newly written
-        position with its own fresh scale — previously written rows
-        are never re-scaled, so there is no error accumulation across
-        steps."""
+        vmapped single-row ``_block_step`` over every slot's gathered
+        page view — one fixed shape, compiled exactly once; page
+        tables arrive as DATA. The float pool gathers each row's view
+        ONCE per chunk, carries it through the scan (the inner step
+        runs at dense-pool cost), and scatters the pages back in one
+        batched write per block at chunk end (masked rows target the
+        sink page). Per-row sampling draws from each slot's private
+        key stream, advanced ONLY for masked-in rows, so a row's
+        noise is a pure function of its request's seed whatever other
+        modes share the pool. Under ``quant_kv`` each scan iteration
+        dequantizes the row's int8 view for the attention read, runs
+        the SAME ``_block_step``, then quantizes only the one newly
+        written position with its own fresh scale — previously
+        written rows are never re-scaled, so there is no error
+        accumulation across steps."""
         import jax
         import jax.numpy as jnp
         from ..ops import matmul_precision
@@ -749,78 +1326,127 @@ class ContinuousEngine(Logger):
                                  axis=0, mode="clip")
             return x                            # (S, D)
 
-        @functools.partial(jax.jit, donate_argnums=(4, 5))
-        def step(params, tok, pos, temp, keys, caches):
+        @functools.partial(jax.jit, donate_argnums=(6, 7))
+        def step(params, tok, pos, temp, mask, tables, keys, caches):
             if quant_w:
                 from ..quant import dequantize_params
                 params = dequantize_params(
                     params, dtype=params[stem.name]["table"].dtype)
 
-            def body(carry, _):
-                tok, pos, keys, caches = carry
-                x = embed_rows(params, tok, pos)
-                new_caches = []
-                for blk, pool in zip(blocks, caches):
-                    p = params[blk.name]
-
-                    if quant_kv:
-                        from ..quant import (dequantize_rows_int8,
-                                             quantize_rows_int8)
-
-                        def rowq(x_row, ckq_row, cvq_row, ks_row,
-                                 vs_row, pos_row, blk=blk, p=p):
-                            ck_row = dequantize_rows_int8(
-                                ckq_row, ks_row, dtype=x_row.dtype)
-                            cv_row = dequantize_rows_int8(
-                                cvq_row, vs_row, dtype=x_row.dtype)
-                            y, ck2, cv2 = _block_step(
-                                blk, p, x_row[None, None, :],
-                                ck_row[None], cv_row[None], pos_row)
-                            # quantize ONLY the newly written position
-                            k_new = jnp.take(ck2[0], pos_row, axis=0,
-                                             mode="clip")
-                            v_new = jnp.take(cv2[0], pos_row, axis=0,
-                                             mode="clip")
-                            qk, sk = quantize_rows_int8(k_new[None])
-                            qv, sv = quantize_rows_int8(v_new[None])
-                            return (y[0, 0],
-                                    jax.lax.dynamic_update_slice(
-                                        ckq_row, qk, (pos_row, 0, 0)),
-                                    jax.lax.dynamic_update_slice(
-                                        cvq_row, qv, (pos_row, 0, 0)),
-                                    jax.lax.dynamic_update_slice(
-                                        ks_row, sk, (pos_row,)),
-                                    jax.lax.dynamic_update_slice(
-                                        vs_row, sv, (pos_row,)))
-
-                        ckq, cvq, ks, vs = pool
-                        x, ckq, cvq, ks, vs = jax.vmap(rowq)(
-                            x, ckq, cvq, ks, vs, pos)
-                        new_caches.append((ckq, cvq, ks, vs))
-                        continue
-
-                    def row(x_row, ck_row, cv_row, pos_row,
-                            blk=blk, p=p):
-                        y, ck2, cv2 = _block_step(
-                            blk, p, x_row[None, None, :],
-                            ck_row[None], cv_row[None], pos_row)
-                        return y[0, 0], ck2[0], cv2[0]
-
-                    ck, cv = pool
-                    x, ck, cv = jax.vmap(row)(x, ck, cv, pos)
-                    new_caches.append((ck, cv))
+            def sample_next(tok, pos, keys, x):
                 logits = _head_logits(head, params, x, prec)  # (S, V)
                 # _split_rows IS the id-exactness contract: the same
-                # carry/subkey convention solo and batched generate use
-                keys, subs = _split_rows(keys)
+                # carry/subkey convention solo and batched generate
+                # use — advanced only for rows this step owns, so
+                # co-tenant spec rows keep their own stream positions
+                keys2, subs = _split_rows(keys)
+                keys = jnp.where(mask[:, None] > 0, keys2, keys)
                 greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 samp = jax.vmap(jax.random.categorical)(
                     subs,
                     logits / jnp.maximum(temp, _TEMP_EPS)[:, None]
                 ).astype(jnp.int32)
                 nxt = jnp.where(temp > 0, samp, greedy)
-                return (nxt, pos + 1, keys,
-                        tuple(new_caches)), nxt
+                nxt = jnp.where(mask > 0, nxt, tok)
+                return nxt, pos + (mask > 0), keys
+
+            if not quant_kv:
+                # CHUNK-VIEW formulation: gather each row's logical
+                # view ONCE per chunk, carry it through the scan (the
+                # per-iteration math is then exactly the dense pool's
+                # — no gathers on the inner step), and scatter every
+                # page back in one batched write per block at chunk
+                # end. Masked rows' write-back targets the sink, so
+                # co-tenant spec/beam pages are untouchable from here
+                # exactly as with per-step scatters.
+                views = []
+                for kp, vp in caches:
+                    views.append((
+                        jax.vmap(lambda t, kp=kp: self._view(kp, t))(
+                            tables),
+                        jax.vmap(lambda t, vp=vp: self._view(vp, t))(
+                            tables)))         # each (S, T, kv, hd)
+
+                def body(carry, _):
+                    tok, pos, keys, vws = carry
+                    x = embed_rows(params, tok, pos)
+                    new_vws = []
+                    for blk, (ck, cv) in zip(blocks, vws):
+                        p = params[blk.name]
+
+                        def row(x_row, ck_row, cv_row, pos_row,
+                                blk=blk, p=p):
+                            y, ck2, cv2 = _block_step(
+                                blk, p, x_row[None, None, :],
+                                ck_row[None], cv_row[None], pos_row)
+                            return y[0, 0], ck2[0], cv2[0]
+
+                        x, ck, cv = jax.vmap(row)(x, ck, cv, pos)
+                        new_vws.append((ck, cv))
+                    nxt, pos, keys = sample_next(tok, pos, keys, x)
+                    return (nxt, pos, keys, tuple(new_vws)), nxt
+
+                (tok, pos, keys, views), toks = jax.lax.scan(
+                    body, (tok, pos, keys, tuple(views)), None,
+                    length=self.decode_block)
+                wtab = jnp.where(mask[:, None] > 0, tables,
+                                 0).reshape(-1)        # (S*P,)
+                new_caches = []
+                for (kp, vp), (ck, cv) in zip(caches, views):
+                    shape = (wtab.shape[0],
+                             self.page_size) + kp.shape[2:]
+                    kp = kp.at[wtab].set(ck.reshape(shape))
+                    vp = vp.at[wtab].set(cv.reshape(shape))
+                    new_caches.append((kp, vp))
+                return toks, keys, tuple(new_caches)
+
+            # int8 pool: per-step gather/scatter — the read has to
+            # dequantize row-wise anyway, and only the one new
+            # position may be (re)quantized per step (no error
+            # accumulation), so there is no whole-view carry to win
+            def body(carry, _):
+                tok, pos, keys, caches = carry
+                x = embed_rows(params, tok, pos)
+                new_caches = []
+                for blk, pool in zip(blocks, caches):
+                    p = params[blk.name]
+                    from ..quant import (dequantize_rows_int8,
+                                         quantize_rows_int8)
+                    kq, vq, ks, vs = pool
+
+                    def rowq(x_row, trow, pos_row, blk=blk, p=p,
+                             kq=kq, vq=vq, ks=ks, vs=vs):
+                        ck = dequantize_rows_int8(
+                            self._view(kq, trow),
+                            self._view(ks, trow),
+                            dtype=x_row.dtype)
+                        cv = dequantize_rows_int8(
+                            self._view(vq, trow),
+                            self._view(vs, trow),
+                            dtype=x_row.dtype)
+                        y, ck2, cv2 = _block_step(
+                            blk, p, x_row[None, None, :],
+                            ck[None], cv[None], pos_row)
+                        # quantize ONLY the newly written position
+                        k_new = jnp.take(ck2[0], pos_row, axis=0,
+                                         mode="clip")
+                        v_new = jnp.take(cv2[0], pos_row, axis=0,
+                                         mode="clip")
+                        qk, sk = quantize_rows_int8(k_new[None])
+                        qv, sv = quantize_rows_int8(v_new[None])
+                        return (y[0, 0], qk[0], sk[0], qv[0],
+                                sv[0])
+
+                    x, kn, ksn, vn, vsn = jax.vmap(rowq)(
+                        x, tables, pos)
+                    pg, off = self._row_targets(tables, pos, mask)
+                    kq = kq.at[pg, off].set(kn)
+                    vq = vq.at[pg, off].set(vn)
+                    ks = ks.at[pg, off].set(ksn)
+                    vs = vs.at[pg, off].set(vsn)
+                    new_caches.append((kq, vq, ks, vs))
+                nxt, pos, keys = sample_next(tok, pos, keys, x)
+                return (nxt, pos, keys, tuple(new_caches)), nxt
 
             (tok, pos, keys, caches), toks = jax.lax.scan(
                 body, (tok, pos, keys, caches), None,
@@ -828,3 +1454,264 @@ class ContinuousEngine(Logger):
             return toks, keys, caches            # toks (chunk, S)
 
         return step
+
+    def _build_spec_round(self):
+        """ONE fixed-shape speculative round over the pool: the draft
+        proposes ``spec_gamma`` tokens per row (a ``lax.scan`` of
+        single-row steps through the draft's paged view), the target
+        verifies the whole window in one ``_block_span`` pass per row,
+        and ``nn/speculative``'s accept arithmetic (greedy
+        prefix-match or the Leviathan rejection rule — selected
+        per-row by temperature) emits up to gamma tokens. Rejected
+        positions leave stale page rows behind; every read masks
+        strictly by position and the next round overwrites from the
+        accepted head, so stale rows are never observed — the same
+        cache discipline as the solo decoder, which greedy rows
+        therefore match bit-for-bit."""
+        import jax
+        import jax.numpy as jnp
+        from ..nn.speculative import _block_span, _stochastic_accept
+        from ..ops import matmul_precision
+        gamma = self.spec_gamma
+        tgt, drf = self.stack, self.draft_stack
+        prec = matmul_precision()
+        quant_w = self.quant_weights
+
+        def embed_rows(stack, params, tok, pos):
+            x = jnp.take(params[stack["stem"].name]["table"],
+                         tok.astype(jnp.int32), axis=0, mode="clip")
+            pe = stack["pos_emb"]
+            if pe is not None:
+                x = x + jnp.take(params[pe.name]["table"], pos,
+                                 axis=0, mode="clip")
+            return x
+
+        @functools.partial(jax.jit, donate_argnums=(7, 8, 9))
+        def spec_round(params_t, params_d, tok, pos, temp, smask,
+                       tables, keys, caches_t, caches_d):
+            if quant_w:
+                from ..quant import dequantize_params
+                params_t = dequantize_params(
+                    params_t,
+                    dtype=params_t[tgt["stem"].name]["table"].dtype)
+            tau = jnp.where(temp > 0, temp, 1.0)        # (S,)
+            keys2 = jax.vmap(
+                lambda k: jax.random.split(k, 3))(keys)  # (S, 3, 2)
+            k_carry, k_d, k_a = keys2[:, 0], keys2[:, 1], keys2[:, 2]
+            keys = jnp.where(smask[:, None] > 0, k_carry, keys)
+
+            # -- draft proposes gamma tokens ---------------------------------
+            def propose(carry, j):
+                dtok, caches_d = carry
+                x = embed_rows(drf, params_d, dtok, pos + j)
+                new_caches = []
+                for blk, (kp, vp) in zip(drf["blocks"], caches_d):
+                    p = params_d[blk.name]
+                    x, k_new, v_new = jax.vmap(
+                        self._paged_row_step(blk, p, kp, vp))(
+                            x, tables, pos + j)
+                    pg, off = self._row_targets(tables, pos + j, smask)
+                    kp = kp.at[pg, off].set(k_new)
+                    vp = vp.at[pg, off].set(v_new)
+                    new_caches.append((kp, vp))
+                logits = _head_logits(drf["head"], params_d, x, prec) \
+                    / tau[:, None]
+                greedy_t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                samp = jax.vmap(
+                    lambda k, row: jax.random.categorical(
+                        jax.random.fold_in(k, j), row)
+                )(k_d, logits).astype(jnp.int32)
+                nxt = jnp.where(temp > 0, samp, greedy_t)
+                nxt = jnp.where(smask > 0, nxt, dtok)
+                probs = jax.nn.softmax(logits, axis=-1)
+                return (nxt, tuple(new_caches)), (nxt, probs)
+
+            (_, caches_d), (d_toks, pd) = jax.lax.scan(
+                propose, (tok, caches_d), jnp.arange(gamma))
+            d_toks = jnp.moveaxis(d_toks, 0, 1)     # (S, gamma)
+            pd = jnp.moveaxis(pd, 0, 1)             # (S, gamma, V)
+
+            # -- target verifies the window in one pass ----------------------
+            window = jnp.concatenate([tok[:, None], d_toks[:, :-1]],
+                                     axis=1)        # (S, gamma)
+            x = jax.vmap(
+                lambda w, p0: embed_rows(
+                    tgt, params_t, w, p0 + jnp.arange(gamma))
+            )(window, pos)                          # (S, gamma, D)
+            new_caches_t = []
+            for blk, (kp, vp) in zip(tgt["blocks"], caches_t):
+                p = params_t[blk.name]
+
+                def vrow(x_row, trow, pos_row, blk=blk, p=p,
+                         kp=kp, vp=vp):
+                    ck = self._view(kp, trow)
+                    cv = self._view(vp, trow)
+                    y, ck2, cv2 = _block_span(
+                        blk, p, x_row[None], ck[None], cv[None],
+                        pos_row)
+                    news_k = [jnp.take(ck2[0], pos_row + j, axis=0,
+                                       mode="clip")
+                              for j in range(gamma)]
+                    news_v = [jnp.take(cv2[0], pos_row + j, axis=0,
+                                       mode="clip")
+                              for j in range(gamma)]
+                    return (y[0], jnp.stack(news_k), jnp.stack(news_v))
+
+                x, knews, vnews = jax.vmap(vrow)(x, tables, pos)
+                for j in range(gamma):
+                    pg, off = self._row_targets(tables, pos + j, smask)
+                    kp = kp.at[pg, off].set(knews[:, j])
+                    vp = vp.at[pg, off].set(vnews[:, j])
+                new_caches_t.append((kp, vp))
+            caches_t = tuple(new_caches_t)
+            t_logits = _head_logits(tgt["head"], params_t, x, prec) \
+                / tau[:, None, None]                # (S, gamma, V)
+
+            # -- accept + emit (nn/speculative arithmetic) -------------------
+            ar = jnp.arange(gamma)
+
+            def accept(k_a_row, t_row, pd_row, d_row, temp_row):
+                t_arg = jnp.argmax(t_row, axis=-1).astype(jnp.int32)
+                match = d_row == t_arg
+                a_g = jnp.minimum(
+                    jnp.argmin(match) + gamma * match.all(), gamma)
+                fix_g = t_arg[jnp.minimum(a_g, gamma - 1)]
+                a_s, fix_s = _stochastic_accept(
+                    k_a_row, jax.nn.softmax(t_row, axis=-1), pd_row,
+                    d_row)
+                a = jnp.where(temp_row > 0, a_s, a_g)
+                fix = jnp.where(temp_row > 0, fix_s, fix_g)
+                out_vec = jnp.where(ar < a, d_row,
+                                    jnp.where(ar == a, fix, 0))
+                n_emit = jnp.minimum(a + 1, gamma)
+                new_tok = jnp.where(a < gamma, fix, d_row[gamma - 1])
+                return a, out_vec, n_emit, new_tok
+
+            a, out_vec, n_emit, new_tok = jax.vmap(accept)(
+                k_a, t_logits, pd, d_toks, temp)
+            n_emit = jnp.where(smask > 0, n_emit, 0)
+            a = jnp.where(smask > 0, a, 0)
+            new_tok = jnp.where(smask > 0, new_tok, tok)
+            return (out_vec, n_emit, a, new_tok, keys, caches_t,
+                    caches_d)
+
+        return spec_round
+
+    def _build_page_copy(self):
+        """Clone one slot's pages into another slot's pages — the
+        beam sibling admission: every hypothesis row starts as an
+        identical copy of the lead row's prompt cache, so one
+        page-granular device copy replaces ``beam_width - 1``
+        redundant prefill dispatches. Unallocated table entries alias
+        the sink page on both sides (garbage copied to garbage, never
+        read). Beam never serves the int8 pool, so the pools here are
+        always float ``(k, v)`` pairs."""
+        import jax
+        import jax.numpy as jnp
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def pagecopy(src_row, dst_row, caches):
+            new_caches = []
+            for kp, vp in caches:
+                kp = kp.at[dst_row].set(
+                    jnp.take(kp, src_row, axis=0, mode="clip"))
+                vp = vp.at[dst_row].set(
+                    jnp.take(vp, src_row, axis=0, mode="clip"))
+                new_caches.append((kp, vp))
+            return tuple(new_caches)
+
+        return pagecopy
+
+    def _build_beam_step(self):
+        """ONE fixed-shape beam step over every group: each hypothesis
+        runs the single-row step over its paged view; the group-level
+        top-k (f32 log_softmax, frozen-eos lanes, flat ``top_k`` over
+        W·V — ``nn/beam.py``'s exact arithmetic) picks the surviving
+        (parent, token) pairs, and the cache reorder lands as a
+        page-granular copy: every child's pages are rewritten from its
+        parent's updated view through the page tables in one batched
+        scatter. Masked groups read real pages but write the sink."""
+        import jax
+        import jax.numpy as jnp
+        from ..ops import matmul_precision
+        stack = self.stack
+        stem, pos_emb = stack["stem"], stack["pos_emb"]
+        blocks, head = stack["blocks"], stack["head"]
+        prec = matmul_precision()
+        quant_w = self.quant_weights
+        W, P = self.beam_width, self.pages_per_slot
+        page = self.page_size
+
+        @functools.partial(jax.jit, donate_argnums=(8,))
+        def beam_step(params, cur, pos, scores, finished, eosv, gmask,
+                      tables_g, caches):
+            if quant_w:
+                from ..quant import dequantize_params
+                params = dequantize_params(
+                    params, dtype=params[stem.name]["table"].dtype)
+            G = cur.shape[0]
+            flat_tab = tables_g.reshape(G * W, P)
+            flat_cur = cur.reshape(G * W)
+            flat_pos = jnp.repeat(pos, W)
+            x = jnp.take(params[stem.name]["table"],
+                         flat_cur.astype(jnp.int32), axis=0,
+                         mode="clip")
+            if pos_emb is not None:
+                x = x + jnp.take(params[pos_emb.name]["table"],
+                                 flat_pos, axis=0, mode="clip")
+            views = []                      # per block: updated views
+            for blk in blocks:
+                p = params[blk.name]
+                kp, vp = caches[len(views)]
+
+                def row(x_row, trow, pos_row, blk=blk, p=p,
+                        kp=kp, vp=vp):
+                    ck = self._view(kp, trow)
+                    cv = self._view(vp, trow)
+                    y, ck2, cv2 = _block_step(
+                        blk, p, x_row[None, None, :],
+                        ck[None], cv[None], pos_row)
+                    return y[0, 0], ck2[0], cv2[0]
+
+                x, ck_new, cv_new = jax.vmap(row)(x, flat_tab,
+                                                  flat_pos)
+                views.append((ck_new, cv_new))  # (GW, T, kv, hd)
+            logits = _head_logits(head, params, x, prec)   # (GW, V)
+            v = logits.shape[-1]
+            logp = jax.nn.log_softmax(
+                logits.astype(jnp.float32)).reshape(G, W, v)
+
+            def group_topk(logp_g, scores_g, fin_g, eos_g):
+                frozen = jnp.full((v,), -jnp.inf).at[eos_g].set(0.0)
+                logp_g = jnp.where(fin_g[:, None], frozen[None, :],
+                                   logp_g)
+                joint = scores_g[:, None] + logp_g       # (W, V)
+                flat, idx = jax.lax.top_k(joint.reshape(-1), W)
+                parent = idx // v
+                tok = (idx % v).astype(jnp.int32)
+                fin = fin_g[parent] | (tok == eos_g)
+                return tok, parent, flat, fin
+
+            tok, parent, new_scores, new_fin = jax.vmap(group_topk)(
+                logp, scores, finished, eosv)
+            # cache reorder: child pages <- parent's updated view,
+            # page-granular, one batched scatter per block
+            flat_parent = (parent
+                           + (jnp.arange(G) * W)[:, None]).reshape(
+                               G * W)
+            write_tab = jnp.where(
+                gmask.astype(bool)[:, None, None], tables_g, 0
+            ).reshape(G * W * P)
+            new_caches = []
+            for (kp, vp), (ck_new, cv_new) in zip(caches, views):
+                sel_k = jnp.take(ck_new, flat_parent, axis=0,
+                                 mode="clip")
+                sel_v = jnp.take(cv_new, flat_parent, axis=0,
+                                 mode="clip")
+                shape = (G * W * P, page) + sel_k.shape[2:]
+                kp = kp.at[write_tab].set(sel_k.reshape(shape))
+                vp = vp.at[write_tab].set(sel_v.reshape(shape))
+                new_caches.append((kp, vp))
+            return tok, parent, new_scores, new_fin, tuple(new_caches)
+
+        return beam_step

@@ -1,0 +1,91 @@
+"""Fixed-size page allocator for the paged KV-cache slot pool.
+
+The dense slot pool sized every row to ``max_context``, so pool HBM
+was ``max_slots x max_context`` whatever the actual request mix. The
+paged pool (the block-table formulation of PAPERS.md's "Compiler-First
+State Space Duality and Portable O(1) Autoregressive Caching for
+Inference") stores K/V in a global pool of fixed-size pages of
+``page_size`` positions each; every slot owns a *page table* — an
+int32 index array of ``ceil(max_context / page_size)`` entries — and
+the jitted programs gather a slot's logical cache view through it.
+Concurrency is then bounded by PAGES, not by worst-case context:
+admission reserves only the pages a request's own prompt + budget can
+ever touch (``ceil((prompt + n_new [+ gamma + 1]) / page_size)``),
+never ``max_context`` worth.
+
+This module is the pure-host half: the allocator (free list, usage
+accounting, exhaustion counters). Device-side page pools are shaped by
+``quant/kv.py``'s :func:`~veles_tpu.quant.kv.block_page_pool`; the
+jitted gather/scatter lives in ``serving/engine.py``.
+
+Page 0 is the SINK: it is never allocated, and masked/retired rows in
+the fixed-shape programs direct their writes at it (a batched scatter
+needs *some* in-bounds target for every lane). Sink content is
+garbage by design and no live page table ever points at it for a
+position a read mask can reach.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from ..telemetry.counters import inc
+
+
+def pages_for(positions: int, page_size: int) -> int:
+    """Pages needed to hold ``positions`` cache rows (ceil div)."""
+    return max(0, (int(positions) + page_size - 1) // page_size)
+
+
+class PagePool:
+    """Free-list allocator over ``pages`` usable pages (device rows
+    ``1..pages``; row 0 is the sink). Thread-safe; the scheduler
+    allocates at admission, the engine allocates growth at step
+    boundaries and frees at retirement."""
+
+    def __init__(self, pages: int, page_size: int) -> None:
+        if pages < 1:
+            raise ValueError("page pool needs >= 1 usable page")
+        if page_size < 1:
+            raise ValueError("page_size must be >= 1")
+        self.pages = int(pages)
+        self.page_size = int(page_size)
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(1, self.pages + 1))
+
+    @property
+    def device_rows(self) -> int:
+        """Rows the device arrays carry: the usable pages + the sink."""
+        return self.pages + 1
+
+    def free_count(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    def in_use(self) -> int:
+        with self._lock:
+            return self.pages - len(self._free)
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` page ids, or None when the pool cannot satisfy the
+        request (exhaustion — counted; the caller decides between
+        waiting for retirements and shedding 503 + Retry-After)."""
+        n = int(n)
+        if n <= 0:
+            return []
+        with self._lock:
+            if len(self._free) < n:
+                inc("veles_serving_pages_exhausted_total")
+                return None
+            out, self._free = self._free[:n], self._free[n:]
+        inc("veles_serving_pages_alloc_total", n)
+        return out
+
+    def free(self, ids: List[int]) -> None:
+        if not ids:
+            return
+        with self._lock:
+            self._free.extend(int(i) for i in ids)
+            self._free.sort()
+        inc("veles_serving_pages_free_total", len(ids))

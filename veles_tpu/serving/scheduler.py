@@ -1,12 +1,24 @@
 """Iteration-level scheduler for the continuous-batching engine.
 
 Pure host-side bookkeeping (no jax): a bounded request queue, the
-``max_slots`` slot table, prefill-bucket selection and deadline
-enforcement. The engine calls :meth:`SlotScheduler.take_admissions` at
-every step boundary — queued requests move into free slots the moment
-one opens, so the chip never idles while the queue is non-empty, and a
-ticket older than its deadline is answered 503 + Retry-After instead
-of silently sitting in the queue.
+``max_slots`` slot table, the page-pool admission ledger, prefill-
+bucket selection and deadline enforcement. The engine calls
+:meth:`SlotScheduler.take_admissions` at every step boundary — queued
+requests move into free slots the moment one opens AND the page pool
+can hold their prompt, so the chip never idles while the queue is
+non-empty, and a ticket older than its deadline is answered 503 +
+Retry-After instead of silently sitting in the queue.
+
+Since the paged-pool rework, admission is on PAGE availability, not
+raw slot count: a request is admitted when a slot (``beam_width``
+slots for ``mode=beam``) is free and the allocator can RESERVE its
+own worst case — ``ceil(max(bucket, prompt + n_new [+ gamma + 1]) /
+page_size)`` pages per row, never ``max_context`` — so short
+requests pack many-to-a-pool and a row cannot hit exhaustion
+mid-decode in normal operation. Decode-time growth (:meth:`grow`) is
+the engine's accounting safety net; a row it cannot cover (or an
+injected ``serve.page_alloc`` fault) is shed with 503 + Retry-After
+while everyone else keeps decoding.
 """
 
 from __future__ import annotations
@@ -16,7 +28,9 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from ..resilience.faults import FaultInjected, fire as fire_fault
 from ..telemetry.counters import inc
+from .pages import PagePool, pages_for
 
 
 class Ticket:
@@ -78,14 +92,42 @@ def shed_expired(tickets: List[Ticket]) -> None:
                     retry_after=1.0)
 
 
+class BeamGroup:
+    """Host state shared by the ``beam_width`` hypothesis slots of one
+    beam request. The engine fills the search state (current tokens,
+    scores, finished flags) after the prefill expansion and advances
+    it one top-k step per tick; the group retires as a unit."""
+
+    __slots__ = ("req", "ticket", "slots", "live", "cur", "scores",
+                 "finished", "toks", "step", "t_p")
+
+    def __init__(self, req: Dict, ticket: Ticket) -> None:
+        self.req = req
+        self.ticket = ticket
+        self.slots: List["Slot"] = []
+        self.live = 0               # hypothesis slots not yet retired
+        self.cur = None             # (W,) int32 current tokens
+        self.scores = None          # (W,) f64 cumulative log-probs
+        self.finished = None        # (W,) bool — eos frozen
+        self.toks = None            # (W, n_new) emitted token matrix
+        self.step = 0               # decoded positions past the first
+        self.t_p = len(req["prompt"])
+
+
 class Slot:
-    """Host state of one occupied KV-cache row."""
+    """Host state of one occupied KV-cache row. ``pages`` are the page
+    ids this row holds (freed at retirement); ``mode`` selects which
+    fixed-shape program advances it (``greedy``/``sample`` ride the
+    decode step, ``speculative`` the draft/verify round, ``beam`` the
+    group top-k step); ``group`` links beam hypothesis rows."""
 
     __slots__ = ("idx", "req", "ticket", "t_p", "bucket", "tokens",
-                 "n_new", "eos_id", "temperature")
+                 "n_new", "eos_id", "temperature", "mode", "pages",
+                 "group", "rounds", "acc")
 
     def __init__(self, idx: int, req: Dict, ticket: Ticket,
-                 bucket: int) -> None:
+                 bucket: int, pages: Optional[List[int]] = None,
+                 group: Optional[BeamGroup] = None) -> None:
         self.idx = idx
         self.req = req
         self.ticket = ticket
@@ -95,6 +137,11 @@ class Slot:
         self.n_new = int(req["n_new"])
         self.eos_id = req.get("eos_id")
         self.temperature = float(req.get("temperature", 0.0))
+        self.mode = str(req.get("mode", "greedy"))
+        self.pages = list(pages or [])
+        self.group = group
+        self.rounds = 0     # speculative: draft/verify rounds run
+        self.acc = 0        # speculative: total accepted draft tokens
 
     def record(self, token: int) -> bool:
         """Append one emitted token; True when the row is finished
@@ -108,12 +155,15 @@ class Slot:
 
 
 class SlotScheduler:
-    """Bounded queue + slot table. All methods are thread-safe; the
-    engine's worker waits on :attr:`cv` and the HTTP threads notify it
-    on :meth:`push`."""
+    """Bounded queue + slot table + page ledger. All methods are
+    thread-safe; the engine's worker waits on :attr:`cv` and the HTTP
+    threads notify it on :meth:`push`. ``page_pool=None`` keeps the
+    legacy slots-only admission (unit tests of the queue geometry)."""
 
     def __init__(self, max_slots: int, buckets: Tuple[int, ...],
-                 max_context: int) -> None:
+                 max_context: int,
+                 page_pool: Optional[PagePool] = None,
+                 beam_width: int = 4, spec_gamma: int = 4) -> None:
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         self.max_slots = int(max_slots)
@@ -123,6 +173,15 @@ class SlotScheduler:
             raise ValueError(
                 "largest prefill bucket %d exceeds max_context %d"
                 % (self.buckets[-1], self.max_context))
+        self.page_pool = page_pool
+        self.beam_width = max(1, int(beam_width))
+        #: the engine's fixed speculation round width — the default
+        #: for requests that omit ``gamma``, so page reservation uses
+        #: the round size the spec program will actually run
+        self.spec_gamma = max(1, int(spec_gamma))
+        #: concurrent beam groups the fixed-shape beam program holds
+        self.beam_groups = self.max_slots // self.beam_width
+        self._beams_active = 0
         self.cv = threading.Condition()
         self._queue: deque = deque()
         self._free: List[int] = list(range(self.max_slots))
@@ -132,22 +191,49 @@ class SlotScheduler:
     def bucket_for(self, t_p: int) -> Optional[int]:
         """Smallest prefill bucket holding a ``t_p``-token prompt (the
         jit cache stays bounded by len(buckets) prefill programs plus
-        the one decode step, not by distinct prompt lengths)."""
+        the fixed decode/round/beam steps, not by distinct prompt
+        lengths)."""
         for b in self.buckets:
             if t_p <= b:
                 return b
         return None
 
-    def reject_reason(self, t_p: int, n_new: int) -> Optional[str]:
+    def _worst_positions(self, t_p: int, n_new: int, mode: str,
+                         gamma: int) -> int:
+        """Cache positions a request can ever touch — what the page
+        ledger must be able to hold for it to complete."""
+        if mode == "speculative":
+            return t_p + n_new + int(gamma) + 1
+        if mode == "beam":
+            return t_p + max(n_new - 1, 1)
+        return t_p + n_new
+
+    def reject_reason(self, t_p: int, n_new: int, mode: str = "greedy",
+                      gamma: Optional[int] = None) -> Optional[str]:
         """None when the request fits the slot pool; otherwise why not
         (the caller falls back to the window-coalescing path, which
         compiles per exact shape and has no context ceiling)."""
-        if self.bucket_for(t_p) is None:
+        bucket = self.bucket_for(t_p)
+        if bucket is None:
             return ("prompt length %d exceeds the largest serving "
                     "bucket %d" % (t_p, self.buckets[-1]))
-        if t_p + n_new > self.max_context:
-            return ("prompt %d + n_new %d exceeds max_context %d"
-                    % (t_p, n_new, self.max_context))
+        worst = self._worst_positions(
+            t_p, n_new, mode,
+            self.spec_gamma if gamma is None else gamma)
+        if worst > self.max_context:
+            return ("prompt %d + generation window %d exceeds "
+                    "max_context %d (mode=%s)"
+                    % (t_p, worst - t_p, self.max_context, mode))
+        width = self.beam_width if mode == "beam" else 1
+        if width > self.max_slots:
+            return ("beam width %d exceeds the pool's %d slots"
+                    % (width, self.max_slots))
+        if self.page_pool is not None:
+            need = width * pages_for(max(bucket, worst),
+                                     self.page_pool.page_size)
+            if need > self.page_pool.pages:
+                return ("request needs %d pages at worst, the pool "
+                        "holds %d" % (need, self.page_pool.pages))
         return None
 
     # -- queue ----------------------------------------------------------------
@@ -179,27 +265,132 @@ class SlotScheduler:
             self._queue = deque(live)
         return expired
 
+    # -- page ledger -----------------------------------------------------------
+    def _alloc_pages(self, n: int) -> Optional[List[int]]:
+        """Allocation with the ``serve.page_alloc`` fault point armed —
+        the injection surface for page-exhaustion chaos. Raises
+        :class:`FaultInjected` on an injected fault (callers shed);
+        returns None on real exhaustion (admission waits for
+        retirements, growth sheds)."""
+        if self.page_pool is None:
+            return []
+        fire_fault("serve.page_alloc")
+        return self.page_pool.alloc(n)
+
+    def grow(self, slot: Slot, positions: int) -> bool:
+        """Extend ``slot``'s page list to cover ``positions`` cache
+        rows. True when covered (possibly without allocating); False
+        means exhaustion or an injected ``serve.page_alloc`` fault —
+        the engine sheds the row with 503 + Retry-After and frees its
+        pages while the rest of the pool keeps decoding."""
+        if self.page_pool is None:
+            return True
+        need = pages_for(positions, self.page_pool.page_size) \
+            - len(slot.pages)
+        if need <= 0:
+            return True
+        try:
+            got = self._alloc_pages(need)
+        except FaultInjected:
+            return False
+        if got is None:
+            return False
+        slot.pages.extend(got)
+        return True
+
     # -- step-boundary transitions -------------------------------------------
     def take_admissions(self, now: Optional[float] = None
                         ) -> Tuple[List[Slot], List[Ticket]]:
         """Move queued requests into free slots (FIFO), dropping
-        expired tickets. Returns (newly filled slots — the engine
-        prefills each, expired tickets — the engine answers 503)."""
+        expired tickets. Admission is on page availability: the head
+        request waits (keeping FIFO order) while the allocator cannot
+        hold its prompt; an injected ``serve.page_alloc`` fault sheds
+        it 503 + Retry-After instead. ``mode=beam`` requests take
+        ``beam_width`` slots (one per hypothesis) plus one page set
+        per slot. Returns (newly filled slots — the engine prefills
+        each, expired tickets — the engine answers 503)."""
         now = time.time() if now is None else now
         admissions: List[Slot] = []
         expired: List[Ticket] = []
         with self.cv:
-            while self._queue and self._free:
-                req, ticket = self._queue.popleft()
+            while self._queue:
+                req, ticket = self._queue[0]
                 if ticket.deadline is not None and now > ticket.deadline:
+                    self._queue.popleft()
                     expired.append(ticket)
                     continue
-                idx = self._free.pop(0)
-                slot = Slot(idx, req, ticket,
-                            self.bucket_for(len(req["prompt"])))
-                self.slots[idx] = slot
-                admissions.append(slot)
-            # even with no free slot, purge expired tickets from ANY
+                mode = str(req.get("mode", "greedy"))
+                width = self.beam_width if mode == "beam" else 1
+                if len(self._free) < width:
+                    break
+                if mode == "beam" and (
+                        self._beams_active >= max(1, self.beam_groups)):
+                    break
+                bucket = self.bucket_for(len(req["prompt"]))
+                if bucket is None:
+                    # a poisoned head (checked=True submit bypassing
+                    # accepts(), or a raw push) must be answered and
+                    # dropped, not crash-loop every tick pre-pop
+                    self._queue.popleft()
+                    ticket.fail("prompt length %d exceeds the largest "
+                                "serving bucket %d"
+                                % (len(req["prompt"]),
+                                   self.buckets[-1]), code=400)
+                    continue
+                # reserve the request's OWN worst case (prompt +
+                # its n_new, never max_context): admission cost is
+                # the request's actual footprint, so short requests
+                # pack many-to-a-pool, and a row can never hit page
+                # exhaustion mid-decode — growth past this is the
+                # accounting safety net, not the steady state
+                worst = max(bucket, self._worst_positions(
+                    len(req["prompt"]), int(req["n_new"]), mode,
+                    int(req.get("gamma", self.spec_gamma))))
+                per_row = (0 if self.page_pool is None else
+                           pages_for(worst, self.page_pool.page_size))
+                rows_pages: List[List[int]] = []
+                shed = starved = False
+                for _ in range(width):
+                    try:
+                        got = self._alloc_pages(per_row)
+                    except FaultInjected as e:
+                        self._queue.popleft()
+                        for back in rows_pages:
+                            self.page_pool.free(back)
+                        inc("veles_shed_requests_total")
+                        ticket.fail(
+                            "serving page pool exhausted: %s" % e,
+                            code=503, retry_after=1.0)
+                        shed = True
+                        break
+                    if got is None:
+                        # real exhaustion: keep FIFO order and wait
+                        # for retirements to free pages
+                        for back in rows_pages:
+                            self.page_pool.free(back)
+                        starved = True
+                        break
+                    rows_pages.append(got)
+                if shed:
+                    continue
+                if starved:
+                    break
+                self._queue.popleft()
+                group = (BeamGroup(req, ticket) if mode == "beam"
+                         else None)
+                for w in range(width):
+                    idx = self._free.pop(0)
+                    slot = Slot(idx, req, ticket, bucket,
+                                pages=rows_pages[w] if rows_pages
+                                else [], group=group)
+                    self.slots[idx] = slot
+                    if group is not None:
+                        group.slots.append(slot)
+                        group.live += 1
+                    admissions.append(slot)
+                if group is not None:
+                    self._beams_active += 1
+            # even with no admission, purge expired tickets from ANY
             # queue position — a dead ticket behind a live head must
             # not rot to its handler's silent 504 while the pool is
             # full
@@ -210,21 +401,38 @@ class SlotScheduler:
 
     def retire(self, slot: Slot) -> None:
         """Free the row — the very next :meth:`take_admissions` can
-        hand it to a queued request. Idempotent: a slot already retired
-        (e.g. by a shutdown abort racing a wedged worker's late
-        ``_finish``) is left alone, so an index can never enter the
-        free list twice."""
+        hand it (and its pages) to a queued request. Idempotent: a
+        slot already retired (e.g. by a shutdown abort racing a wedged
+        worker's late ``_finish``) is left alone, so an index can
+        never enter the free list twice."""
         with self.cv:
             if self.slots[slot.idx] is not slot:
                 return
             self.slots[slot.idx] = None
             self._free.append(slot.idx)
             self._free.sort()
+            if self.page_pool is not None and slot.pages:
+                self.page_pool.free(slot.pages)
+                slot.pages = []
+            if slot.group is not None:
+                slot.group.live -= 1
+                if slot.group.live == 0:
+                    self._beams_active -= 1
             self.cv.notify_all()
 
     def active(self) -> List[Slot]:
         with self.cv:
             return [s for s in self.slots if s is not None]
+
+    def active_beams(self) -> List[BeamGroup]:
+        """Distinct live beam groups, ordered by their first slot."""
+        with self.cv:
+            seen: List[BeamGroup] = []
+            for s in self.slots:
+                if s is not None and s.group is not None \
+                        and s.group not in seen:
+                    seen.append(s.group)
+            return seen
 
     def drain(self, reason: str, code: int = 503,
               retry_after: Optional[float] = 5.0) -> int:

@@ -91,6 +91,21 @@ DESCRIPTIONS = {
         "Seconds requests waited in the serving queue before a slot",
     "veles_serving_expired_total":
         "Queued generation requests answered 503 past their deadline",
+    "veles_serving_pages_alloc_total":
+        "KV-cache pages allocated from the paged serving pool "
+        "(admission prefills + decode-time growth)",
+    "veles_serving_pages_free_total":
+        "KV-cache pages returned to the paged serving pool at row "
+        "retirement",
+    "veles_serving_pages_exhausted_total":
+        "Page allocations refused by an exhausted pool (admission "
+        "waits; decode-time growth sheds 503 + Retry-After)",
+    "veles_serving_spec_rounds_total":
+        "On-device draft/verify speculation rounds run over slot-pool "
+        "rows",
+    "veles_serving_beam_steps_total":
+        "Fixed-shape beam top-k steps run over slot-pool hypothesis "
+        "groups",
     "veles_serving_compile_seconds_total":
         "Seconds the serving engine spent jit-tracing/compiling its "
         "live decode/prefill programs (0 in AOT-artifact mode)",

@@ -230,8 +230,22 @@ class WebStatusServer(Logger):
                         for gkey, help_frag in (
                                 ("slots_busy", "busy KV-cache slots"),
                                 ("slots", "total KV-cache slots"),
+                                ("peak_slots",
+                                 "peak concurrent busy slots"),
                                 ("queue_depth", "queued requests"),
                                 ("programs", "jitted programs built"),
+                                ("pages_total",
+                                 "usable KV-cache pages in the paged "
+                                 "pool"),
+                                ("pages_in_use",
+                                 "KV-cache pages currently allocated "
+                                 "to live rows"),
+                                ("page_size",
+                                 "positions per KV-cache page"),
+                                ("page_fragmentation",
+                                 "allocated-but-unoccupied fraction "
+                                 "of in-use pages (tail-of-page "
+                                 "waste)"),
                                 ("artifact_mode",
                                  "1 = serving from an AOT artifact "
                                  "(zero jit compiles)"),
