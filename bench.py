@@ -23,6 +23,11 @@ the JSON):
   true sync = fetching a parameter scalar to the host ("host_fetch").
 - windows: median of 3 x 10 s (max recorded as a secondary field; the
   median is the regression-detection number — best-of-N inflates).
+- every section additionally stamps {device_time_s, wall_time_s,
+  mfu_device} from the device-time measurement plane
+  (veles_tpu/telemetry/devtime.py: profiler device-stream self-time,
+  host-sync fallback counted) — `bench.py gate` keys its timing
+  pass/fail on device time, which relay weather cannot swing.
 - MNIST: epochs_per_dispatch=8 — eight whole epochs (valid eval + train,
   600+100 minibatch rows each) fused into ONE device program; host round
   trips dominate that config. AE plan_steps=16 (one epoch per dispatch at
@@ -59,7 +64,14 @@ def host_sync(step):
 def measure_windows(run_epoch, sync, n_windows=3, secs=10.0,
                     min_epochs=2, sync_every=32):
     """Each window: >= secs wall time and >= min_epochs epochs, synced
-    at the end. Returns (per-window samples/sec, epochs, durations).
+    at the end. Returns (per-window samples/sec, epochs, durations,
+    devtimes) — ``devtimes`` is the per-window
+    ``{device_time_s, wall_time_s, source}`` stamp: every window is
+    sync-bracketed (the previous window's trailing sync is this one's
+    leading sync), so its wall duration is the host-sync device-time
+    estimate; the per-section profiler refinement
+    (telemetry/devtime.py) replaces it when device streams are
+    capturable.
 
     ``sync_every`` bounds the number of un-synced dispatches in flight:
     JAX dispatch is async and the wall-clock loop condition measures
@@ -69,7 +81,7 @@ def measure_windows(run_epoch, sync, n_windows=3, secs=10.0,
     enough that even a fresh client's probe hung. Syncing every N
     epochs keeps the backlog bounded at a cost of one device round trip
     per N dispatches, inside the timed window, so rates stay honest."""
-    rates, epoch_counts, durations = [], [], []
+    rates, epoch_counts, durations, devtimes = [], [], [], []
     for _ in range(n_windows):
         t0 = time.time()
         n = epochs = 0
@@ -83,7 +95,9 @@ def measure_windows(run_epoch, sync, n_windows=3, secs=10.0,
         rates.append(n / dt)
         epoch_counts.append(epochs)
         durations.append(dt)
-    return rates, epoch_counts, durations
+        devtimes.append({"device_time_s": dt, "wall_time_s": dt,
+                         "source": "host_sync"})
+    return rates, epoch_counts, durations, devtimes
 
 
 def epoch_runner(wf):
@@ -209,6 +223,69 @@ def _section_counters(before, step=None, seconds=None, smoke=False,
     return out
 
 
+def _section_devtime(run_epoch, sync, epochs, durations, counters_rec,
+                     n_chips=1):
+    """The section's device-time stamp (telemetry/devtime.py):
+    ``{device_time_s, wall_time_s, mfu_device, device_time_per_epoch,
+    source, ...}``.
+
+    One profiler refinement pass (a single ``run_epoch`` call between
+    scalar-fetch syncs) attempts a ``jax.profiler`` capture; when it
+    yields device-stream self-time, the stamp is device time scaled to
+    the median window's epoch count — the relay-immune number the
+    gate compares. When profiling is unavailable (counted
+    ``veles_devtime_fallbacks_total``), the stamp falls back to the
+    sync-bracketed window wall time itself. ``mfu_device`` is the
+    CostModel FLOPs-per-epoch (from the section's counters record)
+    over device-time-per-epoch and the chip's nominal bf16 peak — the
+    MFU the ISSUE-9 roofline targets are stated against."""
+    from veles_tpu.telemetry import devtime as _devtime
+    rec = _devtime.measure(run_epoch, sync)
+    med_eps = statistics.median(epochs)
+    wall_med = statistics.median(durations)
+    if rec["source"] == "profiler":
+        per_epoch = rec["device_time_per_call"]
+        device_s = per_epoch * med_eps
+    else:
+        # the windows are already sync-bracketed: their wall duration
+        # IS the host-sync device-time estimate (upper bound by the
+        # bounded sync round trips inside the window)
+        per_epoch = sum(durations) / max(1, sum(epochs))
+        device_s = wall_med
+    out = {
+        "device_time_s": device_s,
+        "wall_time_s": wall_med,
+        "device_time_per_epoch": per_epoch,
+        "source": rec["source"],
+        "capture_calls": rec["calls"],
+        "mfu_device": None,
+    }
+    if rec["source"] == "profiler" and rec.get("by_stream"):
+        out["by_stream"] = rec["by_stream"]
+    if rec.get("spans"):
+        # device self-time attributed onto the telemetry span names
+        # that closed inside the capture window (the same table
+        # `veles-tpu trace self-time --spans` prints)
+        out["spans"] = {k: round(v["device_time_s"], 6)
+                        for k, v in rec["spans"].items()}
+    flops = (counters_rec or {}).get("flops")
+    n_eps = (counters_rec or {}).get("epochs")
+    if flops and n_eps and per_epoch > 0:
+        out["mfu_device"] = (flops / n_eps) / per_epoch / (
+            peak_bf16_flops() * n_chips)
+    return out
+
+
+def _stamp_devtime(section, devtime_rec):
+    """Copy the stamp contract every bench section carries at its top
+    level — ``{device_time_s, wall_time_s, mfu_device}`` — plus the
+    full record under ``devtime`` (what ``bench.py gate`` reads)."""
+    section["devtime"] = devtime_rec
+    for key in ("device_time_s", "wall_time_s", "mfu_device"):
+        section[key] = devtime_rec[key]
+    return section
+
+
 BLOCK_EPOCHS = 8
 
 
@@ -234,12 +311,17 @@ def bench_mnist(dev, n_chips, smoke=False, h=None):
     run_epoch()                  # warmup: compile + first placement
     host_sync(wf.train_step)
     before = _counters_before(wf.train_step)
-    rates, eps, durs = measure_windows(
+    rates, eps, durs, _wins = measure_windows(
         run_epoch, lambda: host_sync(wf.train_step),
         n_windows=1 if smoke else 3, secs=3.0 if smoke else 10.0,
         min_epochs=1 if smoke else 2)
+    counters_rec = _section_counters(before, wf.train_step,
+                                     seconds=sum(durs), smoke=smoke,
+                                     n_chips=n_chips, epochs=sum(eps))
+    dt = _section_devtime(run_epoch, lambda: host_sync(wf.train_step),
+                          eps, durs, counters_rec, n_chips=n_chips)
     from veles_tpu import datasets
-    return {
+    return _stamp_devtime({
         "samples_per_sec_per_chip": statistics.median(rates) / n_chips,
         "max_window": max(rates) / n_chips,
         "epochs_per_dispatch": h,
@@ -249,11 +331,8 @@ def bench_mnist(dev, n_chips, smoke=False, h=None):
         # fallback must never wear the fused-kernel method tag)
         "fused_fc_active": bool(getattr(wf.train_step,
                                         "_fused_fc_active", False)),
-        "counters": _section_counters(before, wf.train_step,
-                                      seconds=sum(durs), smoke=smoke,
-                                      n_chips=n_chips,
-                                      epochs=sum(eps)),
-    }
+        "counters": counters_rec,
+    }, dt)
 
 
 import contextlib
@@ -319,16 +398,22 @@ def _bench_conv_ae_inner(dev, n_chips, minibatch_size=64):
     run_epoch()
     host_sync(wf.train_step)
     before = _counters_before(wf.train_step)
-    rates, epochs, durs = measure_windows(
+    rates, epochs, durs, _wins = measure_windows(
         run_epoch, lambda: host_sync(wf.train_step))
     tflops = measured_tflops(epochs, durs, epoch_flops)
     peak = peak_bf16_flops()
+    counters_rec = _section_counters(before, wf.train_step,
+                                     seconds=sum(durs),
+                                     n_chips=n_chips,
+                                     epochs=sum(epochs))
+    dt = _section_devtime(run_epoch, lambda: host_sync(wf.train_step),
+                          epochs, durs, counters_rec, n_chips=n_chips)
     from veles_tpu.config import root
     # rates count every served sample; the metric is labeled TRAIN
     # throughput, so scale out the validation passes each epoch carries
     train_frac = loader.class_lengths[2] / (
         loader.class_lengths[1] + loader.class_lengths[2])
-    return {
+    return _stamp_devtime({
         "metric": "imagenet_ae_train_samples_per_sec_per_chip",
         "samples_per_sec_per_chip":
             statistics.median(rates) * train_frac / n_chips,
@@ -343,11 +428,8 @@ def _bench_conv_ae_inner(dev, n_chips, minibatch_size=64):
         "mixed_precision": bool(wf.train_step.mixed_precision),
         "dataset_dtype": str(wf.loader.original_data.mem.dtype),
         "data": "synthetic",
-        "counters": _section_counters(before, wf.train_step,
-                                      seconds=sum(durs),
-                                      n_chips=n_chips,
-                                      epochs=sum(epochs)),
-    }
+        "counters": counters_rec,
+    }, dt)
 
 
 LM_BLOCK_EPOCHS = 4
@@ -383,15 +465,23 @@ def bench_lm(dev, n_chips, cfg_overrides=None,
         run_epoch()
         host_sync(wf.train_step)
         before = _counters_before(wf.train_step)
-        rates, epochs, durs = measure_windows(
+        rates, epochs, durs, _wins = measure_windows(
             run_epoch, lambda: host_sync(wf.train_step))
         # each run_epoch call = one BLOCK of 4 whole epochs
         tflops = measured_tflops(
             epochs, durs, epoch_flops,
             epochs_per_call=wf.loader.block_length or 1)
         peak = peak_bf16_flops()
+        counters_rec = _section_counters(before, wf.train_step,
+                                         seconds=sum(durs),
+                                         n_chips=n_chips,
+                                         epochs=sum(epochs))
+        dt = _section_devtime(run_epoch,
+                              lambda: host_sync(wf.train_step),
+                              epochs, durs, counters_rec,
+                              n_chips=n_chips)
         train_frac = n_tr / (n_tr + n_va)
-        return {
+        return _stamp_devtime({
             "metric": "lm_train_tokens_per_sec_per_chip",
             "tokens_per_sec_per_chip":
                 statistics.median(rates) * t_len * train_frac / n_chips,
@@ -402,11 +492,8 @@ def bench_lm(dev, n_chips, cfg_overrides=None,
             "epochs_per_dispatch": h,
             "mixed_precision": True,
             "data": "synthetic",
-            "counters": _section_counters(before, wf.train_step,
-                                          seconds=sum(durs),
-                                          n_chips=n_chips,
-                                          epochs=sum(epochs)),
-        }
+            "counters": counters_rec,
+        }, dt)
 
 
 #: hard wall-clock ceilings (seconds). The round-2 failure mode: one
@@ -533,6 +620,14 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # deterministic accounting for the headline window (telemetry
         # counters + CostModel): what `bench.py gate` compares
         "counters": mnist.get("counters", {}),
+        # device-time measurement plane (telemetry/devtime.py): the
+        # relay-immune timing record the gate keys its pass/fail on —
+        # wall-clock comparisons survive only as the counted legacy
+        # fallback
+        "devtime": mnist.get("devtime"),
+        "device_time_s": mnist.get("device_time_s"),
+        "wall_time_s": mnist.get("wall_time_s"),
+        "mfu_device": mnist.get("mfu_device"),
         # overlap engine accounting (veles_tpu/overlap/): in the
         # default overlap-OFF bench these MUST be zero — the gate
         # fails if side-plane counters leaked into the serial path
@@ -757,6 +852,25 @@ def _cpu_fallback(reason):
     print(json.dumps(out))
 
 
+def _section_pairs(baseline_doc, current_doc):
+    """(name, baseline section, current section) triples — the
+    headline document itself plus extras matched by metric name —
+    shared by the counter gate and the device-time gate so both walk
+    the same sections."""
+    pairs = [("headline", baseline_doc or {}, current_doc or {})]
+    base_extras = {e.get("metric"): e
+                   for e in (baseline_doc or {}).get("extras", [])
+                   if isinstance(e, dict)}
+    for extra in (current_doc or {}).get("extras", []):
+        if not isinstance(extra, dict):
+            continue
+        base = base_extras.get(extra.get("metric"))
+        if base is None:
+            continue
+        pairs.append((extra.get("metric"), base, extra))
+    return pairs
+
+
 def gate_docs(baseline_doc, current_doc):
     """Counter-based perf gate between two BENCH_*.json documents:
     compares the deterministic ``counters`` records (headline +
@@ -767,22 +881,10 @@ def gate_docs(baseline_doc, current_doc):
     counters (legacy baselines, skipped extras) are ignored —
     the gate can only tighten as baselines regenerate."""
     from veles_tpu.telemetry import gate_counters
-    pairs = [("headline", baseline_doc.get("counters") or {},
-              current_doc.get("counters") or {})]
-    base_extras = {e.get("metric"): e
-                   for e in baseline_doc.get("extras", [])
-                   if isinstance(e, dict)}
-    for extra in current_doc.get("extras", []):
-        if not isinstance(extra, dict):
-            continue
-        base = base_extras.get(extra.get("metric"))
-        if base is None:
-            continue
-        pairs.append((extra.get("metric"),
-                      base.get("counters") or {},
-                      extra.get("counters") or {}))
     failures = []
-    for name, base_c, cur_c in pairs:
+    for name, base, cur in _section_pairs(baseline_doc, current_doc):
+        base_c = base.get("counters") or {}
+        cur_c = cur.get("counters") or {}
         if not base_c or not cur_c:
             continue
         # decode sections carry dispatches_per_token; >1 means the
@@ -791,6 +893,76 @@ def gate_docs(baseline_doc, current_doc):
         for failure in gate_counters(
                 cur_c, base_c, max_dispatches_per_token=ceiling):
             failures.append("%s: %s" % (name, failure))
+    return failures
+
+
+def _section_rate(sec):
+    """The section's primary wall-clock throughput — what the counted
+    LEGACY fallback compares when a document predates the device-time
+    format."""
+    for key in ("samples_per_sec_per_chip", "tokens_per_sec_per_chip",
+                "value"):
+        v = sec.get(key)
+        if isinstance(v, (int, float)):
+            return float(v)
+    return None
+
+
+def _doc_on_cpu(doc):
+    plat = str(doc.get("platform", ""))
+    return doc.get("smoke") or plat in ("cpu", "numpy", "cpu-fallback")
+
+
+def gate_devtime(baseline_doc=None, current_doc=None):
+    """``devtime`` gate section — THE timing gate (ISSUE 9 /
+    ROADMAP 5): (1) the measurement-plane counters must be
+    registered; (2) every section pair is compared on its
+    ``device_time_per_epoch`` with the stated
+    :data:`~veles_tpu.telemetry.devtime.DEVTIME_TOLERANCE` when both
+    sides were profiler-captured on a chip; host-sync-sourced records
+    compare at the loose wall-clock tolerance (the measurement
+    already counted its fallback); (3) on CPU/smoke documents the
+    gate proves the harness invariants instead of timing ratios
+    (fields present, device time positive, wall ≥ device, known
+    source); (4) legacy documents without ``device_time_s`` never
+    crash the gate — their sections compare wall-clock rates with a
+    counted ``veles_bench_legacy_sections_total`` warning."""
+    from veles_tpu.telemetry import devtime as _devtime
+    from veles_tpu.telemetry.counters import DESCRIPTIONS
+    failures = []
+    for name in _devtime.DEVTIME_COUNTERS:
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "devtime: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    on_cpu = (_doc_on_cpu(baseline_doc or {})
+              or _doc_on_cpu(current_doc or {}))
+    for name, base, cur in _section_pairs(baseline_doc, current_doc):
+        base_dt = base.get("devtime")
+        cur_dt = cur.get("devtime")
+        base_rate = _section_rate(base)
+        cur_rate = _section_rate(cur)
+        if (cur_dt is None and cur_rate is None) \
+                or (base_dt is None and base_rate is None):
+            continue      # skipped/pending/error stubs: no timing to
+            # compare and no format claim to enforce
+        smoke = bool(base.get("smoke") or cur.get("smoke"))
+        timing = not (on_cpu or smoke)
+        both_prof = (bool(base_dt) and bool(cur_dt)
+                     and base_dt.get("source") == "profiler"
+                     and cur_dt.get("source") == "profiler")
+        tol = (_devtime.DEVTIME_TOLERANCE if both_prof
+               else _devtime.LEGACY_TOLERANCE)
+        for failure in _devtime.compare_sections(
+                name, base_dt, cur_dt,
+                # rates are only comparable method-to-method: a CPU
+                # smoke against a chip baseline is the vs_baseline=null
+                # rule, not a regression — legacy sections still COUNT
+                # either way
+                base_rate=base_rate if timing else None,
+                cur_rate=cur_rate if timing else None,
+                timing=timing, tolerance=tol):
+            failures.append("devtime: %s" % failure)
     return failures
 
 
@@ -1509,7 +1681,9 @@ def _recorder_overhead_proof():
 
 def _gate_main(argv):
     """``python bench.py gate BASELINE.json CURRENT.json`` — exit 1 on
-    any counter regression, resilience-counter leakage, overlap stall
+    any counter regression, device-time regression beyond the stated
+    tolerance (wall-clock only as the counted legacy fallback),
+    resilience-counter leakage, overlap stall
     regression/leakage, tensormon-off leakage, recorder overhead
     overrun, serving-counter leakage or a continuous-batching engine
     that fails to beat the window-coalescing baseline."""
@@ -1521,7 +1695,9 @@ def _gate_main(argv):
         baseline = json.load(f)
     with open(argv[1]) as f:
         current = json.load(f)
-    failures = (gate_docs(baseline, current) + gate_resilience()
+    failures = (gate_docs(baseline, current)
+                + gate_devtime(baseline, current)
+                + gate_resilience()
                 + gate_overlap(baseline, current)
                 + gate_tensormon(baseline, current)
                 + gate_serving(baseline, current)
@@ -1530,12 +1706,17 @@ def _gate_main(argv):
         print("GATE FAIL %s" % failure, file=sys.stderr)
     if failures:
         return 1
-    print("counter gate OK (%s vs %s; resilience counters clean, "
+    from veles_tpu.telemetry.counters import counters as _counters
+    legacy = int(_counters.get("veles_bench_legacy_sections_total"))
+    print("counter gate OK (%s vs %s; device-time gate passed%s, "
+          "resilience counters clean, "
           "overlap stall proof passed, tensormon clean, recorder "
           "overhead in budget, serving counters clean + continuous "
           "batching beats the window baseline, quant clean + int8 "
           "greedy token-exact + artifact serves with zero compiles)"
-          % (argv[1], argv[0]))
+          % (argv[1], argv[0],
+             " — %d legacy section(s) compared on wall-clock" % legacy
+             if legacy else ""))
     return 0
 
 

@@ -305,7 +305,7 @@ def sec_mnist_mb1000(bench, dev, n):
     run_epoch = bench.epoch_runner(wf)
     run_epoch()
     bench.host_sync(wf.train_step)
-    rates, _, _ = bench.measure_windows(
+    rates, _, _, _ = bench.measure_windows(
         run_epoch, lambda: bench.host_sync(wf.train_step),
         n_windows=1 if _on_cpu(dev) else 3,
         secs=3.0 if _on_cpu(dev) else 10.0)
@@ -324,19 +324,28 @@ def sec_ae_fp32(bench, dev, n):
 
 
 def sec_ae_amp_remat(bench, dev, n):
-    """AMP + activation rematerialization: for an HBM-bound net,
-    recomputing activations in the backward trades cheap MXU FLOPs for
-    the expensive stored-activation traffic — the roofline says that
-    direction is free up to ~3x FLOPs."""
+    """AMP + activation rematerialization + bf16 activation storage
+    END-TO-END (the section default since ISSUE 9): for an HBM-bound
+    net, recomputing activations in the backward trades cheap MXU
+    FLOPs for the expensive stored-activation traffic — the roofline
+    says that direction is free up to ~3x FLOPs — and
+    engine.bf16_activations keeps every interlayer activation that a
+    unit would upcast stored bfloat16 (masters/accumulation stay f32),
+    halving what traffic remains."""
     import imagenet_ae
+    from veles_tpu.config import root as vt_root
     orig = imagenet_ae.build_bench_workflow
     imagenet_ae.build_bench_workflow = \
         lambda **kw: orig(remat=True, **kw)
+    prev_bf16 = vt_root.common.engine.get("bf16_activations", False)
+    vt_root.common.engine.bf16_activations = True
     try:
         out = bench.bench_conv_ae(dev, n)
     finally:
         imagenet_ae.build_bench_workflow = orig
+        vt_root.common.engine.bf16_activations = prev_bf16
     out["remat"] = True
+    out["bf16_activations"] = True
     return out
 
 

@@ -194,7 +194,14 @@ def _trace_cli(argv) -> int:
     """``veles-tpu trace export RUN.jsonl TRACE.json`` — convert a
     span JSONL stream (--trace-file output, or a
     telemetry.spans.recorder.to_jsonl dump) into Chrome trace_event
-    JSON viewable in Perfetto / chrome://tracing."""
+    JSON viewable in Perfetto / chrome://tracing.
+
+    ``veles-tpu trace self-time TRACE.json[.gz]`` — summarize a
+    captured profiler trace (or a ``jax.profiler`` log DIRECTORY)
+    into per-stream device self-time, and — with ``--spans
+    RUN.jsonl`` — per-telemetry-span device self-time: the
+    operator-facing view of the numbers ``bench.py gate``'s
+    device-time sections consume (telemetry/devtime.py)."""
     import argparse
     parser = argparse.ArgumentParser(
         prog="veles_tpu trace",
@@ -204,7 +211,21 @@ def _trace_cli(argv) -> int:
         "export", help="span JSONL -> Chrome trace_event JSON")
     exp.add_argument("jsonl", help="span JSONL (from --trace-file)")
     exp.add_argument("out", help="trace_event JSON to write")
+    st = sub.add_parser(
+        "self-time",
+        help="device self-time summary of a captured trace "
+             "(docs/perf.md 'Device-time measurement plane')")
+    st.add_argument("trace",
+                    help="Chrome trace-event JSON[.gz], or a "
+                         "jax.profiler log directory")
+    st.add_argument("--spans", default=None, metavar="RUN.jsonl",
+                    help="telemetry span JSONL to attribute device "
+                         "time onto (per-span-name table)")
+    st.add_argument("--top", type=int, default=12, metavar="N",
+                    help="print at most N rows per table")
     args = parser.parse_args(argv)
+    if args.cmd == "self-time":
+        return _trace_self_time(args)
     from .telemetry import chrome_trace
     try:
         n = chrome_trace.export(args.jsonl, args.out)
@@ -213,6 +234,47 @@ def _trace_cli(argv) -> int:
         return 1
     print("exported %d spans -> %s (open in Perfetto: "
           "https://ui.perfetto.dev)" % (n, args.out))
+    return 0
+
+
+def _trace_self_time(args) -> int:
+    """Parse the trace-event stream (torn/truncated files are
+    salvaged with a counted warning, like ``spans.read_jsonl``) and
+    print per-stream — and optionally per-span — device self-time."""
+    import os as _os
+    from .telemetry import devtime
+    try:
+        if _os.path.isdir(args.trace):
+            events = devtime.load_profile_dir(args.trace)
+        else:
+            events = devtime.load_trace_events(args.trace)
+    except (OSError, ValueError) as e:
+        print("trace self-time failed: %s" % e, file=sys.stderr)
+        return 1
+    st = devtime.device_self_time(events)
+    print("device self-time: %.6f s over %d device-stream event(s)"
+          % (st["device_time_s"], st["n_events"]))
+    if not st["n_events"]:
+        print("  (no device streams — a host-only capture; bench "
+              "falls back to host-sync timing here)")
+    rows = sorted(st["by_stream"].items(), key=lambda kv: -kv[1])
+    for label, secs in rows[:max(0, args.top)]:
+        print("  %-40s %.6f s" % (label, secs))
+    if args.spans:
+        from .telemetry.spans import read_jsonl
+        try:
+            span_records = read_jsonl(args.spans)
+        except OSError as e:
+            print("trace self-time failed: %s" % e, file=sys.stderr)
+            return 1
+        per = devtime.attribute_spans(events, span_records)
+        print("per-span device self-time (%d span name(s)):"
+              % len(per))
+        rows = sorted(per.items(),
+                      key=lambda kv: -kv[1]["device_time_s"])
+        for name, row in rows[:max(0, args.top)]:
+            print("  %-40s %.6f s over %d span(s)"
+                  % (name, row["device_time_s"], row["spans"]))
     return 0
 
 

@@ -19,6 +19,55 @@ from ..memory import Array
 from .. import prng
 from .nn_units import ForwardBase, GradientDescentBase, matches
 
+#: TPU vector lane width — the minor-most dimension the MXU/VPU tile
+#: over. A conv whose channel dim is not a lane multiple pays partial
+#: tiles on every spatial position.
+LANE = 128
+
+#: pad input channels to the lane multiple only while the extra
+#: zero-channel MACs stay under this factor. The CostModel roofline
+#: argument (telemetry/cost.py): in the layout-bound regime the conv
+#: is NOT FLOP-limited — up to ~1.5× redundant (zero) compute that
+#: buys full-lane tiling is free, while beyond it the padding itself
+#: becomes the new bottleneck (3→128 would be 42× — never).
+PAD_HEADROOM = 1.5
+
+
+def lane_padded_channels(c: int, lane: int = LANE,
+                         headroom: float = PAD_HEADROOM) -> int:
+    """Channel-pad target for a conv operand: the next lane multiple
+    when the FLOP headroom allows it, else ``c`` unchanged (padding
+    not worth it). 96 → 128 (1.33×, pays for itself in full-lane
+    tiles); 3, 64, 130 → unchanged."""
+    c = int(c)
+    if c <= 0:
+        return c
+    want = -(-c // lane) * lane
+    return want if want != c and want <= c * headroom else c
+
+
+def _lane_pad_channels(xx, ww, in_axis: int):
+    """Zero-pad ``xx``'s channel dim (last axis) and ``ww``'s matching
+    input-channel dim to the lane multiple when
+    ``root.common.engine.conv_lane_pad`` is on. Zero channels
+    contribute exact-zero partial products, so the result is
+    unchanged while the MXU tiles land full; autodiff slices the pads
+    back out (pad's transpose), so weight grads keep their true
+    shape. OFF (the default) is byte-for-byte the pre-existing
+    path."""
+    if not root.common.engine.get("conv_lane_pad", False):
+        return xx, ww
+    import jax.numpy as jnp
+    c = xx.shape[-1]
+    cp = lane_padded_channels(c)
+    if cp == c:
+        return xx, ww
+    xpad = [(0, 0)] * xx.ndim
+    xpad[-1] = (0, cp - c)
+    wpad = [(0, 0)] * ww.ndim
+    wpad[in_axis] = (0, cp - c)
+    return jnp.pad(xx, xpad), jnp.pad(ww, wpad)
+
 
 class Conv(ForwardBase):
     """Input (B, H, W, C) → output (B, H', W', n_kernels)."""
@@ -72,6 +121,10 @@ class Conv(ForwardBase):
         from ..ops.precision import promote_operands
         sx, sy = self.sliding
         xx, ww, ct = promote_operands(x, params["weights"])
+        # NHWC/HWIO layout work (ISSUE 9): optional input-channel
+        # padding to the lane width where the roofline says the
+        # layout, not the FLOPs, is the bottleneck
+        xx, ww = _lane_pad_channels(xx, ww, in_axis=2)
         # f32 result only for f32 operands: for bf16 (AMP) the MXU
         # still accumulates f32 in hardware, and requesting an f32
         # RESULT breaks the conv transpose rule (f32 cotangent meets

@@ -68,6 +68,10 @@ class Deconv(ForwardBase):
         # Kernel spatially flipped: conv_transpose cross-correlates, deconv
         # stamps. Precision (not dtype casts) steers the MXU.
         xx, ww, ct = promote_operands(x, params["weights"][::-1, ::-1])
+        # lane-width channel padding (see conv.py): the deconv's
+        # input-channel dim is HWIO axis 2, same as the conv's
+        from .conv import _lane_pad_channels
+        xx, ww = _lane_pad_channels(xx, ww, in_axis=2)
         # see Conv._conv: f32 result only for f32 operands — an f32
         # RESULT on bf16 operands breaks the transpose rule at grad time
         pref = jnp.float32 if ct == jnp.float32 else None

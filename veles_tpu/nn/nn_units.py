@@ -232,7 +232,35 @@ class ForwardBase(AcceleratedUnit):
                                        name="%s.%s" % (self.name, k)))
 
     def xla_run(self) -> None:
+        if getattr(self, "_epilogue_folded", False):
+            # this unit's elementwise work already ran inside the
+            # producing matmul's program (ops/fused_fc.py
+            # install_epilogues) — its separate dispatch is REMOVED,
+            # which is the whole point of the fused epilogue
+            return
         params = {k: v.device_view() for k, v in self.param_arrays().items()}
+        tails = getattr(self, "_epilogue_tails", None)
+        if tails:
+            # fused scale-bias-activation epilogue: the elementwise
+            # tail units fold into THIS matmul's program. EVERY
+            # stage's output array is still assigned (the program
+            # returns each intermediate) — a non-chain consumer
+            # linked to the producer's (or a mid-tail's) output reads
+            # exactly what the unfused path would have written, at
+            # one dispatch instead of 1 + len(tails)
+            def fused(p, x):
+                y = self.apply(p, x, train=False)
+                outs = [y]
+                for t in tails:
+                    y = t.apply({}, y, train=False, rng=None)
+                    outs.append(y)
+                return outs
+            outs = self.jit("apply_epilogue", fused)(
+                params, self.input.device_view())
+            self.output.assign_devmem(outs[0])
+            for t, o in zip(tails, outs[1:]):
+                t.output.assign_devmem(o)
+            return
         fn = self.jit("apply", lambda p, x: self.apply(p, x, train=False))
         self.output.assign_devmem(fn(params, self.input.device_view()))
 

@@ -215,6 +215,7 @@ class StandardWorkflow(AcceleratedWorkflow):
     def extract_forward_workflow(self) -> AcceleratedWorkflow:
         """A plain chained-forward workflow over the same (trained) units."""
         from ..mutable import LinkableAttribute
+        from ..ops.fused_fc import install_epilogues
         wf = AcceleratedWorkflow(name=self.name + ".forward")
         self.train_step.sync_params_to_arrays()
         prev = wf.start_point
@@ -228,6 +229,12 @@ class StandardWorkflow(AcceleratedWorkflow):
             f.link_from(prev)
             prev = f
         wf.end_point.link_from(prev)
+        # standalone chains dispatch one program PER UNIT per batch —
+        # the surface where the fused scale-bias-activation epilogue
+        # (engine.fused_epilogue, ops/fused_fc.py) actually removes
+        # dispatches: elementwise tail units fold into their producing
+        # matmul's program and skip their own
+        install_epilogues(self.forwards)
         return wf
 
     def get_metric_values(self) -> Dict[str, Any]:

@@ -119,6 +119,12 @@ class TrainStep(AcceleratedUnit):
         #: ops/fused_fc.py whole-epoch kernel plan (engine.fused_fc_scan
         #: + strict eligibility, _setup_fused_fc); None = general path
         self._fused_fc = None
+        #: fused scale-bias-activation epilogue plan
+        #: (engine.fused_epilogue, _setup_epilogue); None = unfused
+        self._epilogue = None
+        #: bf16 interlayer activation storage under AMP
+        #: (engine.bf16_activations, resolved at initialize)
+        self._bf16_acts = False
         #: tensormon plan (telemetry/tensormon.py, resolved at
         #: initialize from root.common.telemetry.tensormon): None = no
         #: taps — the step traces EXACTLY as a build without the
@@ -213,9 +219,52 @@ class TrainStep(AcceleratedUnit):
                     raise Bug("accumulation chunk size %d not divisible "
                               "by data-axis size %d"
                               % (mb // self.grad_accumulation, n_data))
+        self._bf16_acts = bool(
+            root.common.engine.get("bf16_activations", False))
+        if self._bf16_acts and not self.mixed_precision:
+            # bf16 ACTIVATION storage only makes sense under AMP: the
+            # masters stay f32 either way, and without the bf16 cast
+            # of params+batch the interlayer casts would just round a
+            # full-precision forward for nothing
+            self.warning("bf16_activations needs "
+                         "engine.mixed_precision — ignored")
+            self._bf16_acts = False
         self._setup_shardings()
         self._setup_fused_fc()
+        self._setup_epilogue()
         return None
+
+    def _setup_epilogue(self) -> None:
+        """Fused scale-bias-activation epilogue plan
+        (``root.common.engine.fused_epilogue``, ops/fused_fc.py): runs
+        of standalone elementwise units (``activation_*`` layers) fold
+        into their producing matmul's consumer inside the traced step
+        — identical ops in identical order, so ON is bit-identical to
+        OFF here; the dispatch win lives on the standalone forward
+        path (install_epilogues). Composes with TensorMonitor taps:
+        the taps read the post-epilogue head output, so monitoring
+        NEVER forces the unfused path (test-locked — a future
+        incompatibility must warn and count, not silently unfuse)."""
+        from ..config import root
+        from ..ops import fused_fc as _ff
+        self._epilogue = None
+        if not root.common.engine.get("fused_epilogue", False):
+            return
+        if self._pp is not None or self._pp_hetero is not None:
+            self.warning("fused_epilogue does not fold across "
+                         "pipeline stage boundaries — running the "
+                         "unfused chain")
+            return
+        plan = _ff.plan_epilogues(self.forwards)
+        if not plan:
+            return
+        self._epilogue = plan
+        self.info("fused epilogue engaged%s: %s",
+                  " (composes with tensormon taps)"
+                  if self._tensormon is not None else "",
+                  "; ".join("%s ← %s" % (p.name,
+                                         "+".join(t.name for t in ts))
+                            for p, ts in plan))
 
     def _setup_fused_fc(self) -> None:
         """Opt-in whole-epoch Pallas fast path
@@ -266,8 +315,11 @@ class TrainStep(AcceleratedUnit):
             return reject("amp/remat/grad-accumulation not fused")
         if self._tensormon is not None:
             return reject("tensormon taps are not computed by the "
-                          "fused kernel (disable telemetry.tensormon "
-                          "or fused_fc_scan)")
+                          "fused kernel — the general scan path keeps "
+                          "the fused scale-bias-activation epilogue "
+                          "(engine.fused_epilogue), so the elementwise "
+                          "tail stays fused there; disable "
+                          "telemetry.tensormon or fused_fc_scan")
         if self._pp is not None or self._pp_hetero is not None:
             return reject("pipeline mesh not fused")
         if isinstance(self.device, XLADevice) \
@@ -555,18 +607,47 @@ class TrainStep(AcceleratedUnit):
         """Apply a replicated run of forwards (``base`` offsets the
         per-layer rng streams); the softmax head yields logits when the
         evaluator fuses the stable cross-entropy. The single copy of
-        the head-handling loop all three forward paths share."""
+        the head-handling loop all three forward paths share.
+
+        Epilogue plan active: each producer's planned elementwise
+        tails apply through ``ops.fused_fc.apply_epilogue`` right
+        after it and are skipped at their own position — the SAME ops
+        in the SAME order (and enumerate indices, hence dropout rng
+        streams, unchanged), so the traced program is bit-identical
+        to the unfused chain. ``bf16_activations``: interlayer
+        activations that left a unit as float32 are stored bfloat16
+        (masters, loss and metric accumulation stay f32 — this knob
+        only changes what lives in HBM between layers)."""
         import jax
+        import jax.numpy as jnp
+        from ..ops.fused_fc import apply_epilogue
         last = self.forwards[-1] if self.forwards else None
         use_logits = (isinstance(last, All2AllSoftmax)
                       and isinstance(self.evaluator, EvaluatorSoftmax))
+        folded = set()
+        prod_tails = {}
+        if self._epilogue:
+            for prod, tails in self._epilogue:
+                prod_tails[id(prod)] = tails
+                folded.update(id(t) for t in tails)
         for i, f in enumerate(units):
+            if id(f) in folded:
+                continue        # applied by its producer's epilogue
             layer_rng = (jax.random.fold_in(rng, base + i)
                          if rng is not None else None)
             p = params.get(f.name, {})
             if f is last and use_logits:
                 return f.logits(p, x)
             x = f.apply(p, x, train=train, rng=layer_rng)
+            tails = prod_tails.get(id(f))
+            if tails:
+                x = apply_epilogue(x, tails, train=train)
+            # the HEAD output feeds the evaluator (which upcasts to
+            # f32 itself) — only INTERLAYER activations store bf16
+            head = f is last or (tails and tails[-1] is last)
+            if self._bf16_acts and not head \
+                    and x.dtype == jnp.float32:
+                x = x.astype(jnp.bfloat16)
         return x
 
     def _forward_pure(self, params, x, train: bool, rng):

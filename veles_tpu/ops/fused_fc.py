@@ -348,6 +348,99 @@ def fused_fc_sgd_epoch(weights: Sequence, biases: Sequence,
     return w_f, b_f, vw_f, vb_f, acc[0, 0], acc[0, 1]
 
 
+# -- fused scale-bias-activation epilogues -----------------------------------
+#
+# The Znicz layer vocabulary allows standalone elementwise units after a
+# matmul-bearing forward (``activation_tanh``/``activation_str``/
+# ``activation_mul`` … — the cifar sample's topology). Inside the fused
+# train step XLA fuses them for free, but on the standalone forward
+# path (inference graphs, ``extract_forward_workflow``) every unit is
+# its OWN jitted program: a [conv, activation] pair costs two device
+# dispatches per minibatch where one consumer-fused program suffices.
+# The epilogue plan folds each run of eligible elementwise tail units
+# into the preceding matmul producer's program — the tail units then
+# skip their dispatch entirely (removed, not renamed: the dispatch
+# counter lock in tests/test_devtime.py). Opt-in via
+# ``root.common.engine.fused_epilogue``; OFF is bit-identical to a
+# build without the feature, ON applies the same ops in the same order
+# inside one program. Composes with TensorMonitor taps: the taps read
+# the post-epilogue head output, so monitoring never forces the
+# unfused path (test-locked).
+
+
+def epilogue_eligible(unit) -> bool:
+    """True for forward units whose whole work is an rng-free,
+    shape-preserving elementwise map — the scale (``activation_mul``)
+    / activation vocabulary. Only these may fold into the producing
+    matmul's program without changing semantics."""
+    from ..nn.activation import ActivationForward
+    return isinstance(unit, ActivationForward)
+
+
+def plan_epilogues(forwards):
+    """``[(producer, [tail units…]), …]`` — each maximal run of
+    eligible elementwise units directly following a parameterized
+    (matmul-bearing) forward, in chain order. Pure planning: no unit
+    state is touched (the train step consumes the plan per trace;
+    :func:`install_epilogues` materializes it for standalone runs)."""
+    plan = []
+    producer = None
+    for f in forwards:
+        if producer is not None and epilogue_eligible(f):
+            if not plan or plan[-1][0] is not producer:
+                plan.append((producer, []))
+            plan[-1][1].append(f)
+            continue
+        producer = f if getattr(f, "PARAMETERIZED", False) else None
+    return plan
+
+
+def apply_epilogue(y, tails, train: bool = False):
+    """Fold the elementwise tail into the matmul consumer: apply each
+    planned tail unit's pure map to ``y`` inside the SAME traced
+    program, in chain order — exactly the ops the unfused path runs,
+    so on/off is bit-identical while the tail units' separate
+    dispatches disappear."""
+    for t in tails:
+        y = t.apply({}, y, train=train, rng=None)
+    return y
+
+
+def install_epilogues(forwards, force: bool = False):
+    """Materialize the epilogue plan on a standalone forward chain:
+    producers get ``_epilogue_tails`` (their ``xla_run`` dispatches
+    ONE program computing matmul + every tail, assigning EVERY
+    stage's output array), tails get ``_epilogue_folded`` (their
+    ``xla_run`` becomes a no-op — the removed dispatches). Gated on
+    ``root.common.engine.fused_epilogue`` unless ``force``; returns
+    the installed plan ``{producer name: [tail names]}`` (empty =
+    nothing folded). Idempotent AND reversible: any previous plan on
+    these units clears first — including each producer's cached
+    ``apply_epilogue`` jitted closure, which would otherwise keep
+    serving a stale tails list — so re-calling with the knob off
+    restores the exact unfused dispatch layout. The numpy oracle path
+    is untouched — tails still run there, keeping the oracle
+    equivalence checks unfused."""
+    from ..config import root
+    for f in forwards:
+        if getattr(f, "_epilogue_tails", None) is not None \
+                or getattr(f, "_epilogue_folded", False):
+            f._epilogue_tails = None
+            f._epilogue_folded = False
+            f._jit_cache.pop("apply_epilogue", None)
+            f._jit_fns.pop("apply_epilogue", None)
+    if not force and not root.common.engine.get("fused_epilogue",
+                                                False):
+        return {}
+    installed = {}
+    for producer, tails in plan_epilogues(forwards):
+        producer._epilogue_tails = list(tails)
+        for t in tails:
+            t._epilogue_folded = True
+        installed[producer.name] = [t.name for t in tails]
+    return installed
+
+
 def fused_fc_oracle(weights, biases, vel_w, vel_b, dataset, labels,
                     plan, lr, n_classes: Optional[int] = None,
                     act_a: float = 1.0, act_b: float = 1.0,

@@ -124,6 +124,19 @@ DESCRIPTIONS = {
     "veles_artifact_load_failures_total":
         "AOT serve-artifact loads that failed and fell back to "
         "live jit (corrupt/mismatched/injected)",
+    # device-time measurement plane (telemetry/devtime.py): how each
+    # bench section's device_time_s was obtained — profiler capture
+    # vs the counted host-sync fallback — and how many gate sections
+    # had to fall back to wall-clock (legacy pre-devtime documents)
+    "veles_devtime_captures_total":
+        "Profiler trace captures that yielded device-stream "
+        "self-time",
+    "veles_devtime_fallbacks_total":
+        "Device-time measurements served by the host-sync wall-clock "
+        "fallback (profiler unavailable or no device streams)",
+    "veles_bench_legacy_sections_total":
+        "Gate sections compared on wall-clock because a legacy bench "
+        "document carries no device_time_s fields",
     # model-health observability (telemetry/tensormon.py +
     # telemetry/recorder.py): bench.py's gate asserts the sample/NaN
     # counters read 0 in tensormon-off runs
