@@ -648,6 +648,12 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # itself lives in `python bench.py quant` / the gate's quant
         # proof (docs/perf.md "Quantized serving").
         "quant": _quant_section(),
+        # elastic training plane (veles_tpu/resilience/elastic.py):
+        # the bench never runs elastic, so the generation/preemption
+        # counters MUST read zero here — generation machinery leaking
+        # into a plain training measurement would mean restores (and
+        # their reshard device_puts) ran inside a perf window
+        "elastic": _elastic_section(),
         "extras": [ae, lm],
     }
 
@@ -732,6 +738,30 @@ def _quant_section():
             counters.get("veles_artifact_loads_total")),
         "artifact_load_failures": int(
             counters.get("veles_artifact_load_failures_total")),
+    }
+
+
+def _elastic_section():
+    """{enabled, generations, preemptions, reshard_seconds,
+    barrier_timeouts, cursor_defaults} for this bench process —
+    absolute counter reads (one process, counters start at zero). The
+    bench never runs elastic, so every count MUST be zero —
+    ``bench.py gate`` fails on leakage and, in elastic documents,
+    bounds the per-handoff reshard time."""
+    from veles_tpu.resilience import elastic as vt_elastic
+    from veles_tpu.telemetry.counters import counters
+    return {
+        "enabled": bool(vt_elastic.enabled()),
+        "generations": int(
+            counters.get("veles_elastic_generations_total")),
+        "preemptions": int(
+            counters.get("veles_elastic_preemptions_total")),
+        "reshard_seconds": round(
+            counters.get("veles_elastic_reshard_seconds_total"), 6),
+        "barrier_timeouts": int(
+            counters.get("veles_elastic_barrier_timeouts_total")),
+        "cursor_defaults": int(
+            counters.get("veles_manifest_cursor_defaults_total")),
     }
 
 
@@ -990,6 +1020,61 @@ def gate_resilience():
             failures.append(
                 "resilience: %s = %s in a clean run — a fault/retry/"
                 "shed path fired with no fault spec set" % (name, value))
+    return failures
+
+
+#: reshard-time budget per elastic generation (seconds): each
+#: generation restores at most once — a fresh job's first generation
+#: restores nothing, but a RESPAWNED worker's first (local) generation
+#: legitimately does, so the budget is per generation, not per
+#: handoff. A restore+reshard is one chain read + device_puts —
+#: minutes would mean the elastic plane re-initializes far more than
+#: it restores
+ELASTIC_RESHARD_BUDGET_S = 60.0
+
+
+def gate_elastic(baseline_doc=None, current_doc=None):
+    """``elastic`` gate section: (1) the generation/preemption/reshard
+    counters must be registered; (2) a non-elastic bench document must
+    carry ZERO elastic activity — generation machinery leaking into a
+    plain run means restores happened inside a perf window; (3) an
+    elastic document's reshard time must stay inside the
+    per-generation budget (each generation restores at most once:
+    its handoff in)."""
+    from veles_tpu.resilience.elastic import ELASTIC_COUNTERS
+    from veles_tpu.telemetry.counters import DESCRIPTIONS
+    failures = []
+    for name in ELASTIC_COUNTERS + (
+            "veles_manifest_cursor_defaults_total",):
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "elastic: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc), ("current", current_doc)):
+        sec = (doc or {}).get("elastic")
+        if not sec:
+            continue
+        if not sec.get("enabled"):
+            for key in ("generations", "preemptions",
+                        "barrier_timeouts", "cursor_defaults"):
+                if sec.get(key):
+                    failures.append(
+                        "elastic: %s doc has %s=%s with elastic OFF — "
+                        "generation machinery leaked into a plain run"
+                        % (tag, key, sec[key]))
+            if sec.get("reshard_seconds"):
+                failures.append(
+                    "elastic: %s doc spent %.3fs resharding with "
+                    "elastic OFF" % (tag, sec["reshard_seconds"]))
+        else:
+            generations = max(1, int(sec.get("generations", 0)))
+            budget = ELASTIC_RESHARD_BUDGET_S * generations
+            spent = float(sec.get("reshard_seconds", 0.0))
+            if spent > budget:
+                failures.append(
+                    "elastic: %s doc reshard_seconds=%.3f exceeds the "
+                    "%.0fs budget for %d generation(s)"
+                    % (tag, spent, budget, generations))
     return failures
 
 
@@ -1698,6 +1783,7 @@ def _gate_main(argv):
     failures = (gate_docs(baseline, current)
                 + gate_devtime(baseline, current)
                 + gate_resilience()
+                + gate_elastic(baseline, current)
                 + gate_overlap(baseline, current)
                 + gate_tensormon(baseline, current)
                 + gate_serving(baseline, current)
@@ -1709,7 +1795,8 @@ def _gate_main(argv):
     from veles_tpu.telemetry.counters import counters as _counters
     legacy = int(_counters.get("veles_bench_legacy_sections_total"))
     print("counter gate OK (%s vs %s; device-time gate passed%s, "
-          "resilience counters clean, "
+          "resilience counters clean, elastic counters clean + "
+          "reshard in budget, "
           "overlap stall proof passed, tensormon clean, recorder "
           "overhead in budget, serving counters clean + continuous "
           "batching beats the window baseline, quant clean + int8 "

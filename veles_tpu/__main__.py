@@ -119,6 +119,10 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
     if args.slave_death_probability:
         root.common.slave_death_probability = args.slave_death_probability
+    if args.elastic:
+        # elastic generation controller (resilience/elastic.py): host
+        # loss ends a generation, not the run
+        root.common.resilience.elastic.enabled = True
     if args.job_timeout:
         root.common.job_timeout = args.job_timeout
     if args.snapshot_dir:
@@ -655,7 +659,9 @@ def _drive(launcher: Launcher, workflow, args):
         finally:
             api.stop()
         return None
-    results = launcher.run()
+    from .resilience import elastic
+    results = (launcher.run_elastic() if elastic.enabled()
+               else launcher.run())
     if args.timings:
         launcher.print_stats()
     if args.result_file:

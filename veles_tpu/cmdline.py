@@ -174,6 +174,18 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--process-id", type=int, default=None)
     p.add_argument("--slave-death-probability", type=float, default=0.0,
                    help="fault injection for recovery testing")
+    p.add_argument("--elastic", action="store_true",
+                   help="preemption-tolerant training: on detected "
+                        "host loss (heartbeat lapse, join failure, or "
+                        "an injected distributed.host_loss fault) the "
+                        "run declares a new generation and resumes "
+                        "from the newest valid checkpoint instead of "
+                        "dying; multi-process survivors exit 43 for "
+                        "the respawn plane "
+                        "(root.common.resilience.elastic.{enabled,"
+                        "min_hosts,generation_timeout,"
+                        "max_generations}; docs/resilience.md "
+                        "'Elastic training')")
     # overlap engine (veles_tpu/overlap/, docs/overlap.md)
     p.add_argument("--overlap", action="store_true",
                    help="overlap host I/O with device compute: "

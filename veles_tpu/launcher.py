@@ -134,16 +134,37 @@ class Launcher(Logger):
         step = getattr(workflow, "train_step", None)
         if step is None or getattr(step, "_failure_hooks_armed", False):
             return
+        from .resilience import elastic
         from .resilience.faults import fire as fire_fault
         from .resilience.health import heartbeats
         death_p = float(
             root.common.get("slave_death_probability", 0.0) or 0.0)
         timeout = float(root.common.get("job_timeout", 0.0) or 0.0)
+        elastic_on = elastic.enabled()
+        host_beat = None
+        if elastic_on:
+            try:
+                import jax
+                host_beat = (elastic.HOST_BEAT_PREFIX
+                             + str(jax.process_index()))
+            except Exception:         # noqa: BLE001 — numpy backend
+                host_beat = elastic.HOST_BEAT_PREFIX + "0"
+        #: run()'s finally unregisters it — a completed run's host beat
+        #: must not age into a false /healthz failure on a process that
+        #: keeps serving
+        self._host_beat = host_beat
         self.step_history = []      # per-dispatch wall times (telemetry)
         inner_run = step.run
 
         def armed_run():
             fire_fault("dispatch")
+            if elastic_on:
+                # elastic plane: this host's liveness beat + one
+                # host-loss probe per dispatch (injected faults and
+                # lapsed host:* heartbeats raise HostLostError, which
+                # ends the generation — resilience/elastic.py)
+                heartbeats.beat(host_beat)
+                elastic.check_hosts()
             with distributed.step_watchdog(
                     step.name, timeout=timeout, history=self.step_history):
                 inner_run()
@@ -196,18 +217,62 @@ class Launcher(Logger):
         decision = getattr(wf, "decision", None)
         if decision is not None:
             decision.complete <<= False
+        #: where the chain lives — the elastic controller logs the
+        #: manifest cursor of this chain at generation handoffs
+        self._last_restore_dir = directory
+        self._last_restore_prefix = prefix
         self.info("auto-resumed from latest snapshot in %s", directory)
         return True
 
     def resume(self, snapshot_path: str) -> None:
+        from .resilience.checkpoint_chain import SnapshotCorruptError
         from .snapshotter import resume
-        resume(self.workflow, snapshot_path)
+        try:
+            resume(self.workflow, snapshot_path)
+        except (FileNotFoundError, SnapshotCorruptError) as e:
+            # elastic rerun idempotency: resuming via the `_current`
+            # link after the previous run quarantined its target (the
+            # link dangles, or points at a not-yet-quarantined corrupt
+            # file) must skip straight to the older valid snapshot in
+            # the same chain instead of killing the relaunch
+            base = os.path.basename(snapshot_path)
+            if "_current.pickle" not in base:
+                raise
+            prefix = base.split("_current.pickle")[0]
+            directory = os.path.dirname(snapshot_path) or "."
+            self.warning(
+                "snapshot link %s is unusable (%s: %s) — falling back "
+                "to the newest valid snapshot of chain %r in %s",
+                snapshot_path, type(e).__name__, e, prefix, directory)
+            from .resilience.checkpoint_chain import (
+                restore_latest as walk)
+            restored = walk(self.workflow, directory, prefix)
+            if restored is None:
+                raise
+            self._last_restore_dir = directory
+            self._last_restore_prefix = prefix
+            snapshot_path = restored   # log the REAL source, not the
+            # dead link — quarantine forensics must name the snapshot
+            # the run actually resumed from
         decision = getattr(self.workflow, "decision", None)
         if decision is not None:
             decision.complete <<= False
         self.info("resumed from %s", snapshot_path)
 
-    def run(self) -> Dict[str, Any]:
+    def run_elastic(self) -> Dict[str, Any]:
+        """Run under the elastic generation controller
+        (resilience/elastic.py): on detected host loss the run resumes
+        from the newest valid checkpoint in a new generation instead
+        of dying — ``--elastic`` /
+        ``root.common.resilience.elastic.enabled``."""
+        from .resilience.elastic import ElasticController
+        return ElasticController(self).run()
+
+    def run(self, keep_services: bool = False) -> Dict[str, Any]:
+        """``keep_services=True`` (elastic generations) defers the
+        plotter/graphics/status teardown to :meth:`finalize_services`
+        — generation 2..N must keep the dashboard and beacon alive,
+        not train against services generation 1's finally killed."""
         from .resilience.health import heartbeats
         from .telemetry.recorder import flight
         # preemption forensics: a SIGTERM (the k8s/preemption kill)
@@ -242,24 +307,15 @@ class Launcher(Logger):
                     self.warning("profiler stop failed: %s", e)
             self.event("launcher.work", "end")
             self.stopped = True
-            from .plotter import Plotter
-            for u in getattr(self.workflow, "units", ()):
-                if isinstance(u, Plotter):
-                    try:
-                        u.finalize()
-                    except Exception as e:
-                        self.warning("final redraw of %s failed: %s",
-                                     u.name, e)
-            if self.graphics_server is not None:
-                self.graphics_server.shutdown()
-            if self.status_reporter is not None:
-                self.status_reporter.send(self._status_payload())
-                self.status_reporter.stop()
+            if not keep_services:
+                self.finalize_services()
             # the run is over (completed OR raised) — these beats are
             # not hangs; leaving them registered would age into a false
             # /healthz failure on any long-lived process
             heartbeats.unregister("launcher")
             heartbeats.unregister("train_step")
+            if getattr(self, "_host_beat", None):
+                heartbeats.unregister(self._host_beat)
         elapsed = time.time() - self._start_time
         self.info("elapsed: %.1fs", elapsed)
         results = self.workflow.gather_results()
@@ -267,6 +323,26 @@ class Launcher(Logger):
         if self.interrupted:
             results["interrupted"] = True
         return results
+
+    def finalize_services(self) -> None:
+        """Final plot redraws, graphics shutdown, last status beacon —
+        the once-per-JOB half of run()'s teardown. Idempotent: the
+        elastic controller calls it after the last generation."""
+        from .plotter import Plotter
+        for u in getattr(self.workflow, "units", ()):
+            if isinstance(u, Plotter):
+                try:
+                    u.finalize()
+                except Exception as e:   # noqa: BLE001 — best effort
+                    self.warning("final redraw of %s failed: %s",
+                                 u.name, e)
+        if self.graphics_server is not None:
+            self.graphics_server.shutdown()
+            self.graphics_server = None
+        if self.status_reporter is not None:
+            self.status_reporter.send(self._status_payload())
+            self.status_reporter.stop()
+            self.status_reporter = None
 
     def stop(self) -> None:
         if self.workflow is not None:

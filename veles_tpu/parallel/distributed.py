@@ -32,6 +32,43 @@ from ..logger import Logger
 
 _initialized = False
 
+#: elastic training generation this process participates in (0 =
+#: non-elastic run). The elastic controller sets it at every
+#: generation declaration — seeded from VELES_ELASTIC_GENERATION in
+#: respawned workers, corrected to the coordinator's agreed index by
+#: survivor_barrier — and Snapshotter._cursor stamps it into every
+#: manifest. Topology changes themselves travel through process
+#: respawn (exit 43 → Supervisor), never an in-process
+#: jax.distributed re-join.
+_generation = 0
+
+
+def generation() -> int:
+    return _generation
+
+
+def set_generation(value: int) -> None:
+    global _generation
+    _generation = int(value)
+
+
+def survivor_barrier(generation: int) -> int:
+    """All surviving processes agree on the coordinator's generation
+    index — the elastic plane's synchronization point before anyone
+    touches the checkpoint chain. A dead peer surfaces here first (the
+    collective raises or times out); the elastic controller converts
+    that into a counted barrier timeout. Pure: returns the agreed
+    index, mutates nothing — adoption of a disagreeing view is the
+    controller's job. No-op (returns ``generation``) on a single
+    process."""
+    import jax
+    if jax.process_count() == 1:
+        return int(generation)
+    import numpy
+    from jax.experimental import multihost_utils
+    return int(multihost_utils.broadcast_one_to_all(
+        numpy.int64(int(generation))))
+
 
 def initialize_multihost(coordinator_address: Optional[str] = None,
                          num_processes: Optional[int] = None,

@@ -32,18 +32,26 @@ from .faults import (FaultInjected, FaultPlane, fire,     # noqa: F401
                      list_points, parse_spec, plane, register_point)
 from .retry import RetryPolicy, TransientError            # noqa: F401
 from .checkpoint_chain import (SnapshotCorruptError,      # noqa: F401
-                               chain, load_latest, prune, quarantine,
+                               chain, cursor_of, latest_cursor,
+                               load_latest, prune, quarantine,
                                restore_latest, verify)
 from .health import (heartbeats, mark_ready,              # noqa: F401
                      mark_unready, shed)
+from .elastic import (ELASTIC_COUNTERS,                   # noqa: F401
+                      ElasticController, GENERATION_EXIT_CODE,
+                      HostLostError, Supervisor, generation_barrier,
+                      predict_step_time, psum_bytes_per_step)
 
 #: every counter this subsystem increments — registered with HELP
 #: strings in telemetry.counters.DESCRIPTIONS and asserted zero in
-#: clean runs by ``python bench.py gate``'s resilience section
+#: clean runs by ``python bench.py gate``'s resilience section (the
+#: elastic generation counters have their own tuple + gate section:
+#: resilience.elastic.ELASTIC_COUNTERS)
 RESILIENCE_COUNTERS = (
     "veles_faults_injected_total",
     "veles_retries_total",
     "veles_shed_requests_total",
     "veles_watchdog_trips_total",
     "veles_snapshots_quarantined_total",
+    "veles_manifest_cursor_defaults_total",
 )

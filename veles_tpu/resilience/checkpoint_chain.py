@@ -117,7 +117,12 @@ def verify(path: str) -> Optional[bool]:
 
 def quarantine(path: str) -> str:
     """Rename a corrupt snapshot (and its sidecar) to ``*.corrupt`` so
-    the chain walk never reconsiders it while the evidence survives."""
+    the chain walk never reconsiders it while the evidence survives.
+    Any ``<prefix>_current`` symlink that pointed at the quarantined
+    file is repointed to the next-newest valid-named snapshot (or
+    removed when none is left) — an elastic rerun that resumes via the
+    link must skip straight to the older valid entry, never trip over
+    a dangling link to evidence."""
     dest = path + CORRUPT_SUFFIX
     os.replace(path, dest)
     man = manifest_path(path)
@@ -125,7 +130,46 @@ def quarantine(path: str) -> str:
         os.replace(man, dest + MANIFEST_SUFFIX)
     inc("veles_snapshots_quarantined_total")
     Logger().warning("quarantined corrupt snapshot %s -> %s", path, dest)
+    _repair_current_links(os.path.dirname(os.path.abspath(path)))
     return dest
+
+
+def _repair_current_links(directory: str) -> None:
+    """Repoint every dangling ``*_current.pickle*`` symlink in
+    ``directory`` at the newest surviving snapshot of its prefix
+    (atomic: temp symlink + ``os.replace``), or remove it when the
+    chain is empty. Idempotent — healthy links are untouched."""
+    for link in glob.glob(os.path.join(directory, "*_current.pickle*")):
+        if link.endswith(".tmp"):
+            # a crash between symlink() and os.replace() in
+            # _update_current_link leaves a *_current.pickle*.tmp —
+            # debris, not a current link; repairing it would mint a
+            # second never-cleaned pseudo-current link
+            continue
+        if not os.path.islink(link) or os.path.exists(link):
+            continue                       # healthy (or not a link)
+        prefix = os.path.basename(link).split("_current.pickle")[0]
+        survivors = chain(directory, prefix)
+        try:
+            if not survivors:
+                os.unlink(link)
+                Logger().warning(
+                    "removed dangling snapshot link %s (chain empty)",
+                    link)
+                continue
+            tmp_link = link + ".tmp"
+            try:
+                os.unlink(tmp_link)
+            except OSError:
+                pass
+            os.symlink(os.path.basename(survivors[0]), tmp_link)
+            os.replace(tmp_link, link)
+            Logger().warning("repointed snapshot link %s -> %s", link,
+                             os.path.basename(survivors[0]))
+        except OSError:
+            # link repair is best-effort: the chain walk never follows
+            # links, so restore still works either way
+            pass
 
 
 def chain(directory: str, prefix: str = "wf") -> List[str]:
@@ -172,6 +216,47 @@ def restore_latest(workflow, directory: str,
     apply_state(workflow, state)
     workflow.restored_from_snapshot = True
     return path
+
+
+#: cursor defaults for manifests written before the elastic plane
+#: (docs/resilience.md "Elastic training"): epoch/step 0, one host
+CURSOR_DEFAULT = {"epoch": 0, "step": 0, "world_size": 1}
+
+
+def cursor_of(path: str) -> Dict[str, int]:
+    """The snapshot's ``{epoch, step, world_size}`` training cursor
+    from its sidecar manifest — where an elastic generation resumes.
+    Legacy manifests (and missing/partial cursors) default the missing
+    fields with a counted warning
+    (``veles_manifest_cursor_defaults_total``), never a crash."""
+    man = read_manifest(path) or {}
+    raw = man.get("cursor")
+    out = dict(CURSOR_DEFAULT)
+    defaulted = []
+    if not isinstance(raw, dict):
+        raw = {}
+    for key in out:
+        try:
+            out[key] = int(raw[key])
+        except (KeyError, TypeError, ValueError):
+            defaulted.append(key)
+    if defaulted:
+        inc("veles_manifest_cursor_defaults_total")
+        Logger().warning(
+            "snapshot %s manifest carries no %s cursor — defaulting "
+            "to %s (pre-elastic manifest, or a torn sidecar)", path,
+            "/".join(defaulted),
+            {k: out[k] for k in defaulted})
+    return out
+
+
+def latest_cursor(directory: str, prefix: str = "wf"):
+    """(path, cursor) of the newest chain entry, or None on an empty
+    chain. Reads only the sidecar — no unpickle, so it is cheap enough
+    for the elastic controller to log at every generation handoff."""
+    for path in chain(directory, prefix):
+        return path, cursor_of(path)
+    return None
 
 
 def prune(directory: str, prefix: str = "wf",

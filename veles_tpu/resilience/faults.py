@@ -90,6 +90,20 @@ for _name, _desc in (
                          "stays consistent)"),
     ("distributed.init", "initialize_multihost, inside the retried "
                          "coordinator join"),
+    # elastic training plane (resilience/elastic.py): chaos for the
+    # generation lifecycle — a raised host_loss simulates a preempted
+    # peer (the survivor declares a new generation), a crash IS the
+    # preemption (the respawn Supervisor rebuilds the job); an armed
+    # generation_barrier exercises the survivor-barrier failure path
+    ("distributed.host_loss", "elastic host-loss probe, per armed "
+                              "train-step dispatch (raise = a peer "
+                              "was preempted -> new generation; "
+                              "crash = this host IS preempted)"),
+    ("distributed.generation_barrier", "elastic survivor barrier, "
+                                       "before the generation's "
+                                       "collective agreement (raise "
+                                       "counts a barrier timeout and "
+                                       "ends the generation)"),
     # overlap subsystem (veles_tpu/overlap/): chaos for the async
     # side-plane — crash/delay a lane worker or the prefetch producer
     # and prove drain barriers + checkpoint-lane ordering survive

@@ -74,6 +74,16 @@ class HeartbeatRegistry:
     def healthy(self) -> bool:
         return all(v["healthy"] for v in self.status().values())
 
+    def stale(self, prefix: str = "") -> list:
+        """Names of entries (matching ``prefix``) whose beat aged past
+        its timeout — the cheap probe hot paths use instead of
+        materializing the full :meth:`status` dict per call."""
+        now = time.time()
+        with self._lock:
+            return [name for name, entry in self._beats.items()
+                    if name.startswith(prefix)
+                    and now - entry["last"] >= entry["timeout"]]
+
     def clear(self) -> None:
         """Tests only — production registries live with the process."""
         with self._lock:

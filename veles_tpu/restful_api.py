@@ -619,6 +619,12 @@ class GenerationAPI(Unit):
                             "veles_serving_kv_pool_bytes":
                                 st["kv_pool_bytes"],
                         })
+                    # elastic training plane (resilience/elastic.py):
+                    # generation/world-size gauges ride this surface
+                    # too (a training host can serve status while
+                    # elastic) — no rows while the plane is off
+                    from .resilience import elastic as _elastic
+                    gauges.update(_elastic.gauges())
                     text = metrics_text(gauges)
                     bytes_reply(self, 200, text.encode(),
                                 METRICS_CONTENT_TYPE)

@@ -238,20 +238,49 @@ class Snapshotter(Unit):
             self.prefix, suffix, time.strftime("%Y%m%d_%H%M%S"),
             self._runs, ext)
         path = os.path.join(self.directory, fname)
+        # the elastic cursor rides the sidecar manifest: where (epoch/
+        # step) and how wide (world_size) this snapshot was taken —
+        # computed HERE on the main thread so an async commit cannot
+        # observe a later epoch's counters
+        cursor = self._cursor()
         if self.async_mode:
             from .overlap import plane
             # one named lane = FIFO commits: snapshot k is durable
             # before snapshot k+1 starts, the chain's ordering invariant
             plane().submit("checkpoint", self._commit,
                            state, path, fname, ext, opener,
-                           self._runs)
+                           self._runs, cursor)
             self.destination = path
             return path
-        self._commit(state, path, fname, ext, opener, self._runs)
+        self._commit(state, path, fname, ext, opener, self._runs, cursor)
         return path
 
+    def _cursor(self) -> Dict[str, int]:
+        """{epoch, step, world_size} at export time — the manifest
+        cursor elastic generations resume against (resilience/
+        checkpoint_chain.cursor_of reads it back, defaulting for
+        pre-cursor manifests)."""
+        wf = self.workflow
+        decision = getattr(wf, "decision", None)
+        step = getattr(wf, "train_step", None)
+        from .parallel import distributed
+        try:
+            world = int(distributed.process_count())
+        except Exception:             # noqa: BLE001 — backend-optional
+            world = 1
+        return {
+            "epoch": int(getattr(decision, "epoch_number", 0) or 0),
+            "step": int(getattr(step, "run_count", 0) or 0),
+            "world_size": world,
+            # informational: which elastic generation wrote this (0 =
+            # non-elastic run); readers default it away, operators and
+            # forensics see it in the sidecar
+            "generation": int(distributed.generation()),
+        }
+
     def _commit(self, state, path: str, fname: str, ext: str, opener,
-                runs: int) -> None:
+                runs: int, cursor: Optional[Dict[str, int]] = None
+                ) -> None:
         """Serialize + fsync + hash + manifest + symlink + prune — the
         blocking half of export(). Runs inline (sync mode) or on the
         side-plane's ``checkpoint`` lane (async mode)."""
@@ -278,7 +307,8 @@ class Snapshotter(Unit):
         chain_mod.commit_file(tmp, path)
         chain_mod.write_manifest(
             path, sha256=digest, prefix=self.prefix, runs=runs,
-            created=time.time(), checksum=state["__meta__"]["checksum"])
+            created=time.time(), checksum=state["__meta__"]["checksum"],
+            cursor=cursor or self._cursor())
         self._update_current_link(fname, ext)
         if self.keep_last:
             chain_mod.prune(self.directory, self.prefix, self.keep_last)

@@ -31,6 +31,48 @@ PEAK_BF16 = [
 DEFAULT_PEAK = 275e12
 
 
+#: assumed aggregate ICI bandwidth per chip, bytes/s (public nominal
+#: numbers, substring-matched like PEAK_BF16; first hit wins). This is
+#: the STATED input of the elastic scaling model
+#: (resilience/elastic.py predict_step_time → SCALING.json): change a
+#: value here and every prediction re-anchors — the point is that the
+#: assumption is written down where one measurement can refute it.
+ICI_BW_BYTES = [
+    ("v6", 3.584e11), ("v5p", 4.8e11), ("v5", 1.6e11),
+    ("v4", 2.4e11), ("v3", 1.4e11), ("v2", 6.4e10),
+]
+#: hosts without a known interconnect (CPU meshes, unknown chips):
+#: loopback-class assumption, stamped as such in the prediction record
+DEFAULT_ICI_BW = 1.0e11
+
+
+def ici_bandwidth_entry(device_kind: Optional[str] = None):
+    """(source label, assumed per-chip ICI bytes/s) for
+    ``device_kind`` — the label names the EXACT assumption used
+    (``ICI_BW_BYTES[<key>]`` on a table hit, ``DEFAULT_ICI_BW``
+    otherwise), so the scaling model's falsifiability record can never
+    misattribute its own input."""
+    if device_kind is None:
+        import jax
+        try:
+            device_kind = str(getattr(jax.devices()[0], "device_kind",
+                                      "unknown"))
+        except Exception:            # noqa: BLE001 — backend init failure
+            device_kind = "unknown"
+    kind = str(device_kind).lower()
+    for key, bw in ICI_BW_BYTES:
+        if key in kind:
+            return "telemetry.cost.ICI_BW_BYTES[%s]" % key, bw
+    return ("telemetry.cost.DEFAULT_ICI_BW (loopback-class "
+            "assumption: %g)" % DEFAULT_ICI_BW), DEFAULT_ICI_BW
+
+
+def ici_bandwidth(device_kind: Optional[str] = None) -> float:
+    """Assumed per-chip ICI bytes/s for ``device_kind`` (default: the
+    first visible jax device) — the scaling model's comm denominator."""
+    return ici_bandwidth_entry(device_kind)[1]
+
+
 def peak_bf16_flops(device_kind: Optional[str] = None) -> float:
     """Nominal dense bf16 peak FLOP/s for ``device_kind`` (default: the
     first visible jax device)."""

@@ -57,6 +57,23 @@ DESCRIPTIONS = {
         "step_watchdog threshold trips (possible hangs)",
     "veles_snapshots_quarantined_total":
         "Corrupt snapshots renamed *.corrupt during chain restore",
+    # elastic training plane (veles_tpu/resilience/elastic.py):
+    # bench.py's gate asserts the generation counters read 0 in
+    # non-elastic runs and bounds the per-handoff reshard time
+    "veles_elastic_generations_total":
+        "Elastic training generations started (first generation "
+        "included)",
+    "veles_elastic_preemptions_total":
+        "Host-loss events that ended a generation (heartbeat lapse, "
+        "join failure, or an injected distributed.host_loss fault)",
+    "veles_elastic_reshard_seconds_total":
+        "Seconds spent restoring + resharding state at elastic "
+        "generation handoffs",
+    "veles_elastic_barrier_timeouts_total":
+        "Elastic survivor barriers that failed or timed out",
+    "veles_manifest_cursor_defaults_total":
+        "Snapshot manifests read without an {epoch, step, world_size} "
+        "cursor (pre-elastic manifests; defaulted, never a crash)",
     # overlap subsystem (veles_tpu/overlap/): bench.py's gate asserts
     # the side-plane/prefetch counters read 0 in overlap-off runs
     "veles_sideplane_tasks_total":

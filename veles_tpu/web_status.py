@@ -266,6 +266,11 @@ class WebStatusServer(Logger):
                     # sample, so monitoring-off runs render no rows
                     from .telemetry.tensormon import monitor as _tm
                     gauges.update(_tm.gauges())
+                    # elastic training plane (resilience/elastic.py):
+                    # generation/world-size/reshard gauges — no rows
+                    # at all while the plane was never enabled
+                    from .resilience import elastic as _elastic
+                    gauges.update(_elastic.gauges())
                     text = metrics_text(gauges)
                     bytes_reply(self, 200, text.encode(),
                                 METRICS_CONTENT_TYPE)
