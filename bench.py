@@ -677,25 +677,42 @@ def _overlap_section():
 
 def _serving_section():
     """{engine, admitted, tokens, decode_dispatches, prefill_dispatches,
-    expired, pages_alloc, pages_total, pages_in_use, sustained_slots}
+    expired, pages_alloc, pages_total, pages_in_use, sustained_slots,
+    histogram_samples, ttft_p50, ttft_p99, tpot_p50, queue_wait_p99}
     for this bench process — absolute counter reads (one process,
     counters start at zero) plus the paged-pool occupancy of any LIVE
-    engine (none during a training bench, so the page stamps read 0).
-    The bench itself never serves, so a non-zero read here means
-    serving-engine work leaked into a training measurement —
+    engine (none during a training bench, so the page stamps read 0)
+    plus the request-plane SLO quantiles from the histogram registry
+    (null + zero samples in a non-serving bench; a serving-mode
+    document carries real p50/p99 TTFT for the gate to regress
+    against). The bench itself never serves, so a non-zero count here
+    means serving-engine work leaked into a training measurement —
     ``bench.py gate`` fails on it."""
     from veles_tpu import serving as vt_serving
     from veles_tpu.config import root as vt_root
-    from veles_tpu.telemetry.counters import counters
+    from veles_tpu.serving import SERVING_HISTOGRAMS
+    from veles_tpu.telemetry.counters import counters, histograms
     pages_total = pages_in_use = sustained = 0
     for _name, engine in sorted(vt_serving.engines().items()):
         st = engine.stats()
         pages_total += int(st["pages_total"])
         pages_in_use += int(st["pages_in_use"])
         sustained = max(sustained, int(st["peak_slots"]))
+
+    def q(name, quant):
+        val = histograms.quantile(name, quant)
+        return None if val is None else round(val, 6)
+
     return {
         "engine": str(vt_root.common.serving.get("engine",
                                                  "continuous")),
+        # False: this document is a TRAINING bench and the gate holds
+        # it to zero serving activity. A serving-mode bench (one that
+        # serves on purpose and stamps real latency quantiles) flips
+        # this True — the gate then SKIPS the leakage checks for the
+        # doc and engages the ttft_p99/queue_wait_p99 regression
+        # comparison instead.
+        "serving_bench": False,
         "admitted": int(counters.get("veles_serving_admitted_total")),
         "tokens": int(counters.get("veles_serving_tokens_total")),
         "decode_dispatches": int(
@@ -708,6 +725,12 @@ def _serving_section():
         "pages_total": pages_total,
         "pages_in_use": pages_in_use,
         "sustained_slots": sustained,
+        "histogram_samples": sum(histograms.count(n)
+                                 for n in SERVING_HISTOGRAMS),
+        "ttft_p50": q("veles_serving_ttft_seconds", 0.5),
+        "ttft_p99": q("veles_serving_ttft_seconds", 0.99),
+        "tpot_p50": q("veles_serving_tpot_seconds", 0.5),
+        "queue_wait_p99": q("veles_serving_queue_wait_seconds", 0.99),
     }
 
 
@@ -1166,31 +1189,61 @@ def _overlap_stall_proof():
     return failures
 
 
+#: max allowed current/baseline ratio for the serving latency
+#: quantiles (ttft_p99, queue_wait_p99) when BOTH documents stamp
+#: them. Generous on purpose: these are wall-clock quantiles on a
+#: shared box (relay weather swings 7.6x, docs/perf.md) — the gate
+#: catches order-of-magnitude SLO collapses, the counter gates catch
+#: program regressions exactly.
+SERVING_LATENCY_TOLERANCE = 2.5
+
+
 def gate_serving(baseline_doc=None, current_doc=None):
     """``serving`` gate section: (1) the continuous-batching counters
-    must be registered; (2) bench documents must carry ZERO serving
-    activity — the bench never serves, so a non-zero count means
-    engine work leaked into a training measurement; (3) the clean gate
-    process itself must read zero before the proof; (4) live proofs:
-    continuous batching strictly beats the window-coalescing baseline
-    on tokens/sec under a mixed-length concurrent load (greedy AND
-    sampled rows id-exact vs their solo decodes, jit programs bounded
-    by len(buckets)+1), the paged pool sustains strictly more
-    concurrent slots than the dense configuration at the same pool
-    HBM, and pooled speculation + beam beat their window-plane
+    AND the request-plane SLO histograms must be registered; (2) bench
+    documents must carry ZERO serving activity — including zero
+    latency-histogram samples — the bench never serves, so a non-zero
+    count means engine work leaked into a training measurement;
+    (3) the clean gate process itself must read zero before the
+    proof; (4) TTFT/queue-wait p99 regression between documents that
+    both stamp them — documents that declare ``serving_bench: true``
+    serve on purpose, skip the leakage checks and are gated on their
+    latency quantiles instead (today's training bench stamps
+    ``serving_bench: false`` + null quantiles and takes the leakage
+    path); (5) live proofs: continuous batching strictly beats
+    the window-coalescing baseline on tokens/sec under a mixed-length
+    concurrent load (greedy AND sampled rows id-exact vs their solo
+    decodes, jit programs bounded by len(buckets)+1), with per-request
+    TTFT/TPOT/queue-wait histograms recorded for every request and
+    quantiles internally consistent, the paged pool sustains strictly
+    more concurrent slots than the dense configuration at the same
+    pool HBM, and pooled speculation + beam beat their window-plane
     baselines on a fresh-shape load with zero new compiles."""
-    from veles_tpu.serving import SERVING_COUNTERS
-    from veles_tpu.telemetry.counters import DESCRIPTIONS, counters
+    from veles_tpu.serving import SERVING_COUNTERS, SERVING_HISTOGRAMS
+    from veles_tpu.telemetry.counters import (DESCRIPTIONS, HISTOGRAMS,
+                                              counters, histograms)
     failures = []
     for name in SERVING_COUNTERS:
         if name not in DESCRIPTIONS:
             failures.append(
                 "serving: counter %s not registered in telemetry "
                 "DESCRIPTIONS" % name)
+    for name in SERVING_HISTOGRAMS:
+        entry = HISTOGRAMS.get(name)
+        if not entry or not entry.get("help") \
+                or not entry.get("buckets"):
+            failures.append(
+                "serving: histogram %s not registered in telemetry "
+                "HISTOGRAMS with help + buckets" % name)
     for tag, doc in (("baseline", baseline_doc),
                      ("current", current_doc)):
         sec = (doc or {}).get("serving")
         if not sec:
+            continue
+        if sec.get("serving_bench"):
+            # a self-declared serving-mode document: serving activity
+            # and latency samples are the MEASUREMENT, not a leak —
+            # the latency regression comparison below is its gate
             continue
         for key in ("admitted", "tokens", "decode_dispatches",
                     "pages_alloc"):
@@ -1199,6 +1252,26 @@ def gate_serving(baseline_doc=None, current_doc=None):
                     "serving: %s doc has %s=%s — serving-engine work "
                     "leaked into a non-serving bench run"
                     % (tag, key, sec[key]))
+        # zero-leakage for the SLO layer too: a non-serving bench must
+        # stamp zero histogram samples (a sample means a Ticket
+        # terminated inside a training measurement)
+        if sec.get("histogram_samples"):
+            failures.append(
+                "serving: %s doc has histogram_samples=%s — latency "
+                "histograms leaked into a non-serving bench run"
+                % (tag, sec["histogram_samples"]))
+    # TTFT/queue-wait SLO regression between docs that BOTH carry
+    # stamps (serving-mode documents; legacy/non-serving stamp null)
+    base_sec = (baseline_doc or {}).get("serving") or {}
+    cur_sec = (current_doc or {}).get("serving") or {}
+    for key in ("ttft_p99", "queue_wait_p99"):
+        base_v, cur_v = base_sec.get(key), cur_sec.get(key)
+        if base_v and cur_v \
+                and cur_v > SERVING_LATENCY_TOLERANCE * base_v:
+            failures.append(
+                "serving: %s regressed %.6fs -> %.6fs (>%.1fx "
+                "tolerance)" % (key, base_v, cur_v,
+                                SERVING_LATENCY_TOLERANCE))
     # the zero check must precede the live proof (which serves for
     # real and legitimately moves every one of these counters)
     for name in SERVING_COUNTERS:
@@ -1207,6 +1280,12 @@ def gate_serving(baseline_doc=None, current_doc=None):
             failures.append(
                 "serving: %s = %s before any serving ran in this "
                 "process" % (name, value))
+    for name in SERVING_HISTOGRAMS:
+        value = histograms.count(name)
+        if value:
+            failures.append(
+                "serving: histogram %s holds %d samples before any "
+                "serving ran in this process" % (name, value))
     return failures + _serving_throughput_proof()
 
 
@@ -1317,6 +1396,42 @@ def _serving_throughput_proof():
                   "window-coalescing %.0f (%.2fx), %d programs"
                   % (cont_tps, base_tps, cont_tps / base_tps,
                      engine.programs_built))
+        # request-plane SLO accounting (the histograms the /metrics
+        # surfaces and `veles-tpu metrics aggregate` quantile from):
+        # every engine-served request must have recorded one TTFT
+        # sample and one queue-wait sample, and the bucket-derived
+        # quantiles must be internally consistent
+        from veles_tpu.telemetry.counters import counters as _ctrs
+        from veles_tpu.telemetry.counters import histograms as _hists
+        served = int(_ctrs.get("veles_serving_admitted_total"))
+        ttft_n = _hists.count("veles_serving_ttft_seconds")
+        wait_n = _hists.count("veles_serving_queue_wait_seconds")
+        if ttft_n != served:
+            failures.append(
+                "serving: %d TTFT histogram samples for %d admitted "
+                "requests — per-request SLO accounting is broken"
+                % (ttft_n, served))
+        if wait_n < served:
+            failures.append(
+                "serving: %d queue-wait samples for %d admitted "
+                "requests" % (wait_n, served))
+        slo = {}
+        for name, label in (("veles_serving_ttft_seconds", "ttft"),
+                            ("veles_serving_tpot_seconds", "tpot"),
+                            ("veles_serving_queue_wait_seconds",
+                             "queue_wait")):
+            p50 = _hists.quantile(name, 0.5)
+            p99 = _hists.quantile(name, 0.99)
+            if p50 is not None and p99 is not None and p50 > p99:
+                failures.append(
+                    "serving: %s p50 %.6f > p99 %.6f — quantile "
+                    "arithmetic is broken" % (label, p50, p99))
+            slo[label] = (p50, p99)
+        print("serving slo: ttft p50=%.4fs p99=%.4fs, tpot "
+              "p50=%.4fs, queue_wait p99=%.4fs over %d requests"
+              % (slo["ttft"][0] or 0.0, slo["ttft"][1] or 0.0,
+                 slo["tpot"][0] or 0.0, slo["queue_wait"][1] or 0.0,
+                 served))
     finally:
         engine.stop()
     failures += _paged_occupancy_proof(wf, reqs)
@@ -1798,7 +1913,8 @@ def _gate_main(argv):
           "resilience counters clean, elastic counters clean + "
           "reshard in budget, "
           "overlap stall proof passed, tensormon clean, recorder "
-          "overhead in budget, serving counters clean + continuous "
+          "overhead in budget, serving counters + SLO histograms "
+          "clean + continuous "
           "batching beats the window baseline, quant clean + int8 "
           "greedy token-exact + artifact serves with zero compiles)"
           % (argv[1], argv[0],

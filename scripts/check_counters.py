@@ -38,6 +38,19 @@ USE_RE = re.compile(
     r"""\b[A-Za-z_]*inc\(\s*["'](veles_[a-z0-9_]+)["']"""
     r"""|\bcounters\.get\(\s*["'](veles_[a-z0-9_]+)["']""")
 
+#: literal histogram-name usages: observe("veles_x") — the module
+#: helper and the registry method — plus the quantile/count/sum reads
+#: through any registry-looking receiver (``histograms.quantile``,
+#: bench.py's ``_hists.count`` alias: a name containing ``hist``).
+#: Every such name must be registered in counters.py HISTOGRAMS with
+#: a HELP string AND bucket bounds — same fail-closed rule as
+#: counters: an unregistered histogram still records (on DEFAULT
+#: buckets) but escapes the gate's zero-leakage section.
+HIST_USE_RE = re.compile(
+    r"""\b[A-Za-z_]*observe\(\s*["'](veles_[a-z0-9_]+)["']"""
+    r"""|\b[A-Za-z_]*[Hh]ist[A-Za-z_]*\.(?:quantile|count|sum)"""
+    r"""\(\s*["'](veles_[a-z0-9_]+)["']""")
+
 #: directories scanned for usages (tests may inc ad-hoc names on
 #: purpose and are excluded)
 SCAN = ("veles_tpu", "scripts", "bench.py")
@@ -60,9 +73,44 @@ def registered_counters(path: str = COUNTERS_PY) -> set:
     raise SystemExit("DESCRIPTIONS dict literal not found in %s" % path)
 
 
-def used_counters(repo: str = REPO):
-    """{counter name: first use site} over the scanned tree."""
-    uses = {}
+def registered_histograms(path: str = COUNTERS_PY) -> dict:
+    """{name: entry-is-complete} from the HISTOGRAMS dict literal,
+    read via AST (no import). An entry is complete when its value is
+    a dict literal carrying non-empty "help" and "buckets" — a
+    histogram registered without bounds would silently fall back to
+    DEFAULT_BUCKETS, exactly the drift this script exists to stop."""
+    with open(path) as fin:
+        tree = ast.parse(fin.read())
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(getattr(t, "id", None) == "HISTOGRAMS"
+                   for t in node.targets):
+            continue
+        if not isinstance(node.value, ast.Dict):
+            break
+        out = {}
+        for key, val in zip(node.value.keys, node.value.values):
+            if not isinstance(key, ast.Constant):
+                continue
+            complete = False
+            if isinstance(val, ast.Dict):
+                fields = {k.value: v for k, v in
+                          zip(val.keys, val.values)
+                          if isinstance(k, ast.Constant)}
+                help_node = fields.get("help")
+                bucket_node = fields.get("buckets")
+                complete = (
+                    help_node is not None and bucket_node is not None
+                    and not (isinstance(bucket_node,
+                                        (ast.Tuple, ast.List))
+                             and not bucket_node.elts))
+            out[key.value] = complete
+        return out
+    raise SystemExit("HISTOGRAMS dict literal not found in %s" % path)
+
+
+def _scan_paths(repo: str = REPO):
     this_file = os.path.abspath(__file__)
     paths = []
     for entry in SCAN:
@@ -75,17 +123,31 @@ def used_counters(repo: str = REPO):
             paths.extend(os.path.join(dirpath, f)
                          for f in sorted(filenames)
                          if f.endswith(".py"))
-    for path in paths:
-        if os.path.abspath(path) == this_file:
-            continue
+    return [p for p in paths if os.path.abspath(p) != this_file]
+
+
+def _used_names(regex, repo: str = REPO):
+    """{name: first use site} for one usage regex over the tree."""
+    uses = {}
+    for path in _scan_paths(repo):
         with open(path, errors="replace") as fin:
             for lineno, line in enumerate(fin, 1):
-                for match in USE_RE.finditer(line):
-                    name = match.group(1) or match.group(2)
+                for match in regex.finditer(line):
+                    name = next(g for g in match.groups() if g)
                     uses.setdefault(
                         name, "%s:%d"
                         % (os.path.relpath(path, repo), lineno))
     return uses
+
+
+def used_counters(repo: str = REPO):
+    """{counter name: first use site} over the scanned tree."""
+    return _used_names(USE_RE, repo)
+
+
+def used_histograms(repo: str = REPO):
+    """{histogram name: first use site} over the scanned tree."""
+    return _used_names(HIST_USE_RE, repo)
 
 
 def find_unregistered():
@@ -96,18 +158,35 @@ def find_unregistered():
                   if name not in known)
 
 
+def find_unregistered_histograms():
+    """[(name, first use site)] for every observed histogram that is
+    missing from HISTOGRAMS or registered without help/buckets."""
+    known = registered_histograms()
+    return sorted((name, site)
+                  for name, site in used_histograms().items()
+                  if not known.get(name, False))
+
+
 def main(argv=None) -> int:
     missing = find_unregistered()
     for name, site in missing:
         print("UNREGISTERED counter %s (first use: %s)" % (name, site),
               file=sys.stderr)
-    if missing:
-        print("%d counter(s) used but not registered in "
-              "telemetry/counters.py DESCRIPTIONS" % len(missing),
-              file=sys.stderr)
+    missing_hist = find_unregistered_histograms()
+    for name, site in missing_hist:
+        print("UNREGISTERED histogram %s (first use: %s) — needs a "
+              "HISTOGRAMS entry with help AND bucket bounds"
+              % (name, site), file=sys.stderr)
+    if missing or missing_hist:
+        print("%d counter(s) / %d histogram(s) used but not "
+              "registered in telemetry/counters.py"
+              % (len(missing), len(missing_hist)), file=sys.stderr)
         return 1
-    print("counter registration OK (%d registered, %d distinct names "
-          "used)" % (len(registered_counters()), len(used_counters())))
+    print("counter registration OK (%d counters registered, %d "
+          "distinct names used; %d histograms registered, %d "
+          "observed)"
+          % (len(registered_counters()), len(used_counters()),
+             len(registered_histograms()), len(used_histograms())))
     return 0
 
 

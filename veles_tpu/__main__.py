@@ -30,6 +30,11 @@ def main(argv=None) -> int:
         # telemetry subcommand family (no model/workflow involved):
         #   veles-tpu trace export RUN.jsonl TRACE.json
         return _trace_cli(argv[1:])
+    if argv and argv[0] == "metrics":
+        # fleet observability subcommand family (telemetry/fleet.py):
+        #   veles-tpu metrics aggregate URL [URL ...]
+        from .telemetry import fleet
+        return fleet.main(argv[1:])
     if argv and argv[0] == "faults":
         # resilience subcommand family:
         #   veles-tpu faults list
@@ -215,6 +220,10 @@ def _trace_cli(argv) -> int:
         "export", help="span JSONL -> Chrome trace_event JSON")
     exp.add_argument("jsonl", help="span JSONL (from --trace-file)")
     exp.add_argument("out", help="trace_event JSON to write")
+    exp.add_argument("--request", default=None, metavar="ID",
+                     help="export only spans tagged with this "
+                          "request_id (one serving request's "
+                          "timeline — no hand-grepping the JSONL)")
     st = sub.add_parser(
         "self-time",
         help="device self-time summary of a captured trace "
@@ -232,12 +241,15 @@ def _trace_cli(argv) -> int:
         return _trace_self_time(args)
     from .telemetry import chrome_trace
     try:
-        n = chrome_trace.export(args.jsonl, args.out)
+        n = chrome_trace.export(args.jsonl, args.out,
+                                request_id=args.request)
     except (OSError, ValueError) as e:
         print("trace export failed: %s" % e, file=sys.stderr)
         return 1
-    print("exported %d spans -> %s (open in Perfetto: "
-          "https://ui.perfetto.dev)" % (n, args.out))
+    print("exported %d spans%s -> %s (open in Perfetto: "
+          "https://ui.perfetto.dev)"
+          % (n, " for request %s" % args.request if args.request
+             else "", args.out))
     return 0
 
 

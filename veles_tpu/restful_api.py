@@ -425,10 +425,10 @@ class GenerationAPI(Unit):
                     toks, stats = beam_mod.beam_generate(
                         self.workflow, req["prompt"], req["n_new"],
                         beam=req["beam"], eos_id=req["eos_id"])
-                    ticket.result = {"tokens": [int(t) for t in toks],
-                                     "scores": [float(s) for s in
-                                                stats["scores"]]}
-                    ticket.event.set()
+                    ticket.succeed(
+                        {"tokens": [int(t) for t in toks],
+                         "scores": [float(s) for s in
+                                    stats["scores"]]})
                 return
             prompts = [req["prompt"] for req in reqs]
             if mode == "speculative":
@@ -438,23 +438,21 @@ class GenerationAPI(Unit):
                     temperature=reqs[0]["temperature"],
                     seed=reqs[0]["seed"])
                 for i, (req, ticket) in enumerate(zip(reqs, tickets)):
-                    ticket.result = {
+                    ticket.succeed({
                         "tokens": self._trim_eos(rows[i],
                                                  req["eos_id"]),
                         "acceptance": stats["acceptance"][i],
                         "rounds": stats["rounds"][i],
-                        "batched_with": len(reqs) - 1}
-                    ticket.event.set()
+                        "batched_with": len(reqs) - 1})
                 return
             rows = sampling.generate(
                 self.workflow, prompts, reqs[0]["n_new"],
                 temperature=reqs[0]["temperature"],
                 seed=reqs[0]["seed"])
             for i, (req, ticket) in enumerate(zip(reqs, tickets)):
-                ticket.result = {
+                ticket.succeed({
                     "tokens": self._trim_eos(rows[i], req["eos_id"]),
-                    "batched_with": len(reqs) - 1}
-                ticket.event.set()
+                    "batched_with": len(reqs) - 1})
         except Exception as e:        # noqa: BLE001 — answer, don't die
             # decoder-raised ValueError/VelesError on a parsed request
             # is the CLIENT's shape problem (beam > vocab, generation
@@ -462,10 +460,8 @@ class GenerationAPI(Unit):
             code = 400 if isinstance(e, (ValueError, VelesError)) \
                 else 500
             for ticket in tickets:
-                if not ticket.event.is_set():
-                    ticket.error = "%s: %s" % (type(e).__name__, e)
-                    ticket.code = code
-                    ticket.event.set()
+                ticket.fail("%s: %s" % (type(e).__name__, e),
+                            code=code)
 
     def _worker_loop(self) -> None:
         hb_name = "serve.%s" % self.name
@@ -503,6 +499,11 @@ class GenerationAPI(Unit):
             # the same expiry answer the continuous engine gives
             pending, expired = split_expired(pending)
             shed_expired(expired)
+            # queue exit is the window plane's admission boundary —
+            # the queue-wait histogram sample for the live tickets
+            # (expired ones above recorded their full wait instead)
+            for _req, _ticket in pending:
+                _ticket.mark_admitted()
             groups: Dict[Any, list] = {}
             for req, ticket in pending:
                 groups.setdefault(self._batch_key(req),
@@ -666,8 +667,12 @@ class GenerationAPI(Unit):
                     json_reply(self, 400, {"error":
                                            "bad request: %s" % e})
                     return
+                # API admission assigns the request's id (threaded
+                # through lifecycle spans, flight events and the
+                # response body by the Ticket itself)
                 ticket = _Ticket(
-                    deadline=time.time() + api.request_timeout)
+                    deadline=time.time() + api.request_timeout,
+                    mode=req.get("mode", "greedy"))
                 engine = api._engine
                 # every decode mode rides the slot pool when the
                 # engine can hold it — speculative needs the pooled

@@ -35,7 +35,9 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
-from .scheduler import SlotScheduler, Ticket          # noqa: F401
+from .scheduler import (SlotScheduler, Ticket,        # noqa: F401
+                        new_request_id,
+                        request_tracing_enabled)
 from .engine import ContinuousEngine                  # noqa: F401
 
 #: every counter the serving plane increments — registered with HELP
@@ -55,6 +57,18 @@ SERVING_COUNTERS = (
     "veles_serving_pages_exhausted_total",
     "veles_serving_spec_rounds_total",
     "veles_serving_beam_steps_total",
+)
+
+#: every latency histogram the request-plane SLO layer records
+#: (serving/scheduler.py Ticket terminal accounting) — registered
+#: with HELP + bucket bounds in telemetry/counters.py HISTOGRAMS and
+#: asserted ZERO samples in non-serving runs by ``python bench.py
+#: gate``'s serving section
+SERVING_HISTOGRAMS = (
+    "veles_serving_queue_wait_seconds",
+    "veles_serving_ttft_seconds",
+    "veles_serving_tpot_seconds",
+    "veles_serving_e2e_seconds",
 )
 
 #: process-global registry of live engines (web_status /metrics renders

@@ -703,9 +703,11 @@ class ContinuousEngine(Logger):
         table_row = self._table_row(slot)
         prog = self._program("prefill", bucket)
         seed_key = jax.random.PRNGKey(int(slot.req.get("seed", 0)))
-        wait = max(0.0, time.time() - slot.ticket.enqueued)
+        wait = max(0.0, (slot.ticket.admitted or time.time())
+                   - slot.ticket.enqueued)
         with span("serving.prefill", bucket=bucket, slot=slot.idx,
-                  t_p=t_p, mode=slot.mode):
+                  t_p=t_p, mode=slot.mode,
+                  request_id=slot.ticket.request_id):
             first, logits, self._keys, self._caches = prog(
                 params, ids_dev, numpy.int32(t_p),
                 numpy.int32(slot.idx), numpy.float32(slot.temperature),
@@ -723,6 +725,11 @@ class ContinuousEngine(Logger):
             inc("veles_serving_queue_wait_seconds_total", wait)
             self.admitted += 1
             first = int(first)
+            # the int() above synced the prefill dispatch: this step
+            # boundary IS prefill-done and first-token time (host-side
+            # stamps only — no device work rides on tracing)
+            slot.ticket.mark_prefill_done()
+            slot.ticket.mark_first_token()
             self._tok[slot.idx] = first
             if slot.record(first):
                 self._finish(slot)
@@ -746,6 +753,10 @@ class ContinuousEngine(Logger):
                 (self.beam_width, slot.n_new), numpy.int32)
             group.toks[:, 0] = group.cur
             group.step = 0
+            # the numpy.asarray(top-k) above synced the expansion:
+            # the group's first hypothesis tokens exist NOW
+            slot.ticket.mark_prefill_done()
+            slot.ticket.mark_first_token()
             if slot.n_new == 1:
                 self._finish_beam(group)
 
@@ -771,17 +782,19 @@ class ContinuousEngine(Logger):
                 continue
             victims = (slot.group.slots if slot.group is not None
                        else [slot])
-            # ONE shed request however many hypothesis rows it held —
-            # the admitted/retired counters are per request too
-            inc("veles_shed_requests_total")
             for v in victims:
                 dead.add(id(v))
                 if v in alive:
                     alive.remove(v)
                 self._retire_slot(v)
-            victims[0].ticket.fail(
-                "serving page pool exhausted mid-decode", code=503,
-                retry_after=1.0)
+            # ONE shed request however many hypothesis rows it held —
+            # the admitted/retired counters are per request too, and
+            # fail()'s first-terminal True keeps a ticket another
+            # sweep already answered from counting twice
+            if victims[0].ticket.fail(
+                    "serving page pool exhausted mid-decode",
+                    code=503, retry_after=1.0):
+                inc("veles_shed_requests_total")
         return alive
 
     # -- the decode chunk ------------------------------------------------------
@@ -950,9 +963,6 @@ class ContinuousEngine(Logger):
         """Retire a row the moment it is done: free the slot and its
         pages (the next admission reuses them immediately) and answer
         the ticket."""
-        inc("veles_serving_retired_total")
-        inc("veles_serving_tokens_total", len(slot.tokens))
-        self.retired += 1
         # co-resident rows at retirement — the window plane's
         # batched_with response key, kept so the schema does not
         # depend on which plane served the request
@@ -965,7 +975,13 @@ class ContinuousEngine(Logger):
             rounds = max(slot.rounds, 1)
             result["rounds"] = rounds
             result["acceptance"] = slot.acc / (rounds * self.spec_gamma)
-        slot.ticket.succeed(result)
+        # count only a first-terminal answer, symmetric with every
+        # shed path: a late _finish racing a stop()-side abort must
+        # not push retired past admitted
+        if slot.ticket.succeed(result):
+            inc("veles_serving_retired_total")
+            inc("veles_serving_tokens_total", len(slot.tokens))
+            self.retired += 1
 
     def _finish_beam(self, group) -> None:
         """Answer a beam request: rank hypotheses exactly like
@@ -973,17 +989,19 @@ class ContinuousEngine(Logger):
         shaped the scores) and retire every hypothesis row."""
         order = numpy.argsort(-group.scores.astype(numpy.float64))
         best = int(order[0])
-        inc("veles_serving_retired_total")
-        inc("veles_serving_tokens_total", group.toks.shape[1])
-        self.retired += 1
         for slot in group.slots:
             self._retire_slot(slot)
         batched_with = max(0, self.scheduler.busy_count() - 1)
-        group.ticket.succeed({
-            "tokens": [int(t) for t in group.toks[best]],
-            "scores": [float(group.scores[i]) for i in order],
-            "batched_with": batched_with,
-            "engine": "continuous"})
+        # gated on first-terminal like _finish: one retirement per
+        # REQUEST, never re-counted by a late tick racing an abort
+        if group.ticket.succeed({
+                "tokens": [int(t) for t in group.toks[best]],
+                "scores": [float(group.scores[i]) for i in order],
+                "batched_with": batched_with,
+                "engine": "continuous"}):
+            inc("veles_serving_retired_total")
+            inc("veles_serving_tokens_total", group.toks.shape[1])
+            self.retired += 1
 
     def _abort_active(self, reason: str, code: int = 500,
                       retry_after: Optional[float] = None,
@@ -994,11 +1012,13 @@ class ContinuousEngine(Logger):
             if id(slot.ticket) not in answered:
                 answered.add(id(slot.ticket))
                 # one shed per REQUEST, not per hypothesis row — kept
-                # like-for-like with admitted/retired accounting
-                if count_shed:
+                # like-for-like with admitted/retired accounting;
+                # count only a first-terminal answer (an already-
+                # answered ticket must not re-count)
+                first = slot.ticket.fail(reason, code=code,
+                                         retry_after=retry_after)
+                if count_shed and first:
                     inc("veles_shed_requests_total")
-                slot.ticket.fail(reason, code=code,
-                                 retry_after=retry_after)
 
     # -- jitted programs -------------------------------------------------------
     def _program(self, kind: str, bucket: Optional[int] = None):

@@ -23,14 +23,41 @@ while everyone else keeps decoding.
 
 from __future__ import annotations
 
+import itertools
+import os
 import threading
 import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from ..resilience.faults import FaultInjected, fire as fire_fault
-from ..telemetry.counters import inc
+from ..telemetry.counters import inc, observe
 from .pages import PagePool, pages_for
+
+_request_ids = itertools.count(1)
+
+
+def new_request_id() -> str:
+    """Process-unique serving request id, assigned at API admission
+    and threaded through the whole Ticket lifecycle (span tags,
+    flight-recorder events, the response body). The pid prefix keeps
+    ids distinct across a fleet of engine replicas whose /metrics a
+    ``veles-tpu metrics aggregate`` merges."""
+    return "req-%d-%d" % (os.getpid(), next(_request_ids))
+
+
+def request_tracing_enabled() -> bool:
+    """THE per-request tracing switch (``root.common.trace.requests``,
+    default on). Gates only the HOST-SIDE span/flight emission at
+    ticket terminal — never device work, so dispatch counts are
+    bit-identical on and off (locked by tests/test_request_tracing.py).
+    The SLO histograms record regardless: p99 TTFT must be answerable
+    on a fleet that runs with tracing off."""
+    try:
+        from ..config import root
+        return bool(root.common.trace.get("requests", True))
+    except Exception:        # noqa: BLE001 — config not importable
+        return True
 
 
 class Ticket:
@@ -40,12 +67,29 @@ class Ticket:
     ``event``; ``retry_after`` asks the handler to attach a
     ``Retry-After`` header (503 shed/expiry answers); ``deadline`` is
     the absolute wall time after which the request must no longer be
-    served from the queue."""
+    served from the queue.
+
+    The ticket is also the request-plane SLO record: it carries a
+    process-unique ``request_id`` and host-side lifecycle timestamps
+    (``enqueued`` → ``admitted`` → ``prefill_done`` → ``first_token``
+    → terminal), stamped by the planes at step boundaries only.
+    :meth:`succeed`/:meth:`fail` are EXACTLY-ONCE: the first terminal
+    call records the per-request histograms (queue wait, TTFT, TPOT,
+    end-to-end — ``telemetry/counters.py`` HISTOGRAMS), emits the
+    request's lifecycle spans tagged with its id, and notes a
+    terminal flight-recorder event; any later call is a no-op
+    returning False — a ticket swept by both the tick path and the
+    failure path can never double-count."""
 
     __slots__ = ("event", "result", "error", "code", "retry_after",
-                 "deadline", "enqueued")
+                 "deadline", "enqueued", "request_id", "mode",
+                 "admitted", "prefill_done", "first_token",
+                 "n_tokens", "outcome", "_terminal_lock")
 
-    def __init__(self, deadline: Optional[float] = None) -> None:
+    def __init__(self, deadline: Optional[float] = None,
+                 request_id: Optional[str] = None,
+                 mode: str = "greedy") -> None:
+        self._terminal_lock = threading.Lock()
         self.event = threading.Event()
         self.result = None
         self.error: Optional[str] = None
@@ -53,17 +97,129 @@ class Ticket:
         self.retry_after: Optional[float] = None
         self.deadline = deadline
         self.enqueued = time.time()
+        self.request_id = request_id or new_request_id()
+        self.mode = str(mode)
+        self.admitted: Optional[float] = None
+        self.prefill_done: Optional[float] = None
+        self.first_token: Optional[float] = None
+        self.n_tokens = 0
+        self.outcome: Optional[str] = None
 
+    # -- lifecycle stamps (host-side, step boundaries only) ------------------
+    def mark_admitted(self) -> None:
+        """Stamp queue exit (slot admission / window-batch pop); first
+        stamp wins — a beam group's sibling slots share one ticket."""
+        if self.admitted is not None:
+            return
+        self.admitted = time.time()
+        if request_tracing_enabled():
+            try:
+                from ..telemetry.recorder import flight
+                flight.note("request", request_id=self.request_id,
+                            phase="admitted", mode=self.mode)
+            except Exception:       # noqa: BLE001 — observers only
+                pass
+
+    def mark_prefill_done(self) -> None:
+        if self.prefill_done is None:
+            self.prefill_done = time.time()
+
+    def mark_first_token(self) -> None:
+        if self.first_token is None:
+            self.first_token = time.time()
+
+    # -- terminal (exactly once) ---------------------------------------------
     def fail(self, error: str, code: int = 500,
-             retry_after: Optional[float] = None) -> None:
-        self.error = error
-        self.code = code
-        self.retry_after = retry_after
-        self.event.set()
+             retry_after: Optional[float] = None,
+             outcome: Optional[str] = None) -> bool:
+        """Answer with an error; True only on the FIRST terminal call
+        (callers count shed/expiry on that True, so a ticket seen by
+        two sweeps is still counted once). The terminal transition is
+        LOCKED, not a bare is_set() check: a wedged tick thread's late
+        sweep racing a stop()-side abort must not double-record the
+        histograms or let both callers count the shed."""
+        with self._terminal_lock:
+            if self.event.is_set():
+                return False
+            self.error = error
+            self.code = code
+            self.retry_after = retry_after
+            self._account(outcome
+                          or ("shed" if code == 503 else "error"))
+            self.event.set()
+        return True
 
-    def succeed(self, result) -> None:
-        self.result = result
-        self.event.set()
+    def succeed(self, result) -> bool:
+        """Answer with a result; True only on the first terminal call.
+        Dict results are stamped with the ``request_id`` so both
+        decode planes answer with the id the trace/flight events
+        carry."""
+        with self._terminal_lock:
+            if self.event.is_set():
+                return False
+            if isinstance(result, dict):
+                result.setdefault("request_id", self.request_id)
+                self.n_tokens = len(result.get("tokens") or ())
+            self.result = result
+            self._account("retired")
+            self.event.set()
+        return True
+
+    def _account(self, outcome: str) -> None:
+        """Terminal SLO accounting — histograms always, span/flight
+        emission under the tracing switch. Never raises: a broken
+        observer must not lose the request's answer. Deliberately
+        runs INSIDE the terminal lock, before ``event.set()``:
+        answered must imply accounted (the bench SLO proof and the
+        tests read the histograms the moment ``serve()`` returns),
+        and the cost is bounded — once per REQUEST at a step
+        boundary (≤ 4 small JSONL lines when a trace sink is open),
+        never on the per-token path."""
+        now = time.time()
+        self.outcome = outcome
+        try:
+            if self.admitted is not None:
+                observe("veles_serving_queue_wait_seconds",
+                        max(0.0, self.admitted - self.enqueued))
+            elif outcome in ("expired", "shed"):
+                # died in the queue: its whole life WAS queue wait
+                observe("veles_serving_queue_wait_seconds",
+                        max(0.0, now - self.enqueued))
+            if self.first_token is not None:
+                observe("veles_serving_ttft_seconds",
+                        max(0.0, self.first_token - self.enqueued))
+                if outcome == "retired" and self.n_tokens > 1:
+                    observe("veles_serving_tpot_seconds",
+                            max(0.0, now - self.first_token)
+                            / (self.n_tokens - 1))
+            if outcome == "retired":
+                observe("veles_serving_e2e_seconds",
+                        max(0.0, now - self.enqueued))
+            if not request_tracing_enabled():
+                return
+            from ..telemetry.recorder import flight
+            from ..telemetry.spans import emit
+            rid = self.request_id
+            if self.admitted is not None:
+                emit("request.queue", self.enqueued,
+                     self.admitted - self.enqueued, request_id=rid)
+                if self.prefill_done is not None:
+                    emit("request.prefill", self.admitted,
+                         self.prefill_done - self.admitted,
+                         request_id=rid)
+            if self.first_token is not None:
+                emit("request.decode", self.first_token,
+                     now - self.first_token, request_id=rid,
+                     tokens=self.n_tokens)
+            emit("request", self.enqueued, now - self.enqueued,
+                 request_id=rid, outcome=outcome, mode=self.mode,
+                 tokens=self.n_tokens)
+            flight.note("request", request_id=rid, phase="done",
+                        outcome=outcome, mode=self.mode,
+                        tokens=self.n_tokens,
+                        dur=round(now - self.enqueued, 6))
+        except Exception:       # noqa: BLE001 — observability only
+            pass
 
 
 def split_expired(pairs: List[Tuple[Dict, Ticket]],
@@ -84,12 +240,16 @@ def split_expired(pairs: List[Tuple[Dict, Ticket]],
 def shed_expired(tickets: List[Ticket]) -> None:
     """THE one deadline answer both decode planes give: 503 +
     Retry-After, counted — a ticket never rots in a queue past its
-    useful life."""
+    useful life. Counting keys off :meth:`Ticket.fail`'s first-
+    terminal True, so a ticket swept by BOTH the tick path and the
+    failure path (a tick dying between ``take_admissions`` and its
+    shed, then the loop's ``expire_queued`` sweep) still counts its
+    expiry — and its queue-wait histogram sample — exactly once."""
     for ticket in tickets:
-        inc("veles_serving_expired_total")
-        inc("veles_shed_requests_total")
-        ticket.fail("request expired in serving queue", code=503,
-                    retry_after=1.0)
+        if ticket.fail("request expired in serving queue", code=503,
+                       retry_after=1.0, outcome="expired"):
+            inc("veles_serving_expired_total")
+            inc("veles_shed_requests_total")
 
 
 class BeamGroup:
@@ -357,10 +517,10 @@ class SlotScheduler:
                         self._queue.popleft()
                         for back in rows_pages:
                             self.page_pool.free(back)
-                        inc("veles_shed_requests_total")
-                        ticket.fail(
-                            "serving page pool exhausted: %s" % e,
-                            code=503, retry_after=1.0)
+                        if ticket.fail(
+                                "serving page pool exhausted: %s" % e,
+                                code=503, retry_after=1.0):
+                            inc("veles_shed_requests_total")
                         shed = True
                         break
                     if got is None:
@@ -376,6 +536,7 @@ class SlotScheduler:
                 if starved:
                     break
                 self._queue.popleft()
+                ticket.mark_admitted()
                 group = (BeamGroup(req, ticket) if mode == "beam"
                          else None)
                 for w in range(width):

@@ -71,12 +71,23 @@ def to_trace_events(records: Iterable[Dict[str, Any]]
     return events
 
 
-def export(jsonl_path: str, out_path: str) -> int:
+def export(jsonl_path: str, out_path: str,
+           request_id: str = None) -> int:
     """Read span JSONL, write a Chrome trace JSON; returns the number
-    of spans exported. Raises ValueError when the input has no spans
-    (an empty trace silently loading as a blank Perfetto page helps
-    nobody)."""
+    of spans exported. ``request_id`` keeps only the spans tagged
+    with that serving request's id (the per-request lifecycle spans
+    the Ticket emits plus any engine span carrying the tag) — one
+    request's timeline without hand-grepping the JSONL. Raises
+    ValueError when the input has no spans (an empty trace silently
+    loading as a blank Perfetto page helps nobody)."""
     records = spans.read_jsonl(jsonl_path)
+    if request_id is not None:
+        records = [r for r in records
+                   if str(r.get("request_id")) == str(request_id)]
+        if not records:
+            raise ValueError(
+                "no span records tagged request_id=%s in %s"
+                % (request_id, jsonl_path))
     if not records:
         raise ValueError("no span records in %s" % jsonl_path)
     doc = {"traceEvents": to_trace_events(records),

@@ -17,8 +17,9 @@ the singleton :data:`counters`.
 
 from __future__ import annotations
 
+import bisect
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 #: canonical counter names with HELP strings (also the /metrics HELP
 #: lines). Ad-hoc names are allowed, but instrumented code sticks to
@@ -166,7 +167,191 @@ DESCRIPTIONS = {
         "ModelHealthError raised by the NaN sentinel (halt policies)",
     "veles_blackbox_dumps_total":
         "Flight-recorder black-box dumps written",
+    # request-plane SLO layer (serving/scheduler.py Ticket accounting
+    # + the metrics_text renderer below)
+    "veles_metrics_name_collisions_total":
+        "Caller-supplied /metrics gauges dropped because their name "
+        "shadowed an already-rendered counter/histogram series "
+        "(duplicate names are invalid Prometheus exposition)",
 }
+
+
+#: canonical histogram names: HELP string + FIXED bucket upper bounds
+#: (seconds). Same registration discipline as DESCRIPTIONS — every
+#: ``observe("veles_*")`` call site must appear here with HELP and
+#: bounds (scripts/check_counters.py fails CI otherwise). Fixed
+#: buckets keep fleet aggregation exact: summing the same bounds
+#: across N /metrics endpoints is lossless, which per-process
+#: quantile sketches would not be.
+HISTOGRAMS = {
+    # request-plane serving SLOs (serving/scheduler.py Ticket
+    # accounting): bench.py's gate asserts ZERO samples in
+    # non-serving runs
+    "veles_serving_queue_wait_seconds": {
+        "help": "Seconds a serving request waited in the queue "
+                "before admission (deadline-shed/expired requests "
+                "record their full wait)",
+        "buckets": (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+    },
+    "veles_serving_ttft_seconds": {
+        "help": "Time to first token: request enqueue to the first "
+                "generated token (prefill output), per request",
+        "buckets": (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+    },
+    "veles_serving_tpot_seconds": {
+        "help": "Time per output token after the first (decode "
+                "steady-state), per retired request",
+        "buckets": (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                    0.05, 0.1, 0.25, 0.5, 1.0),
+    },
+    "veles_serving_e2e_seconds": {
+        "help": "End-to-end serving latency: request enqueue to the "
+                "answered ticket, per retired request",
+        "buckets": (0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                    5.0, 10.0, 30.0, 60.0, 120.0),
+    },
+}
+
+#: bounds for ad-hoc (unregistered) histogram names — they still
+#: record, but check_counters.py fails CI on them, like counters
+DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                   10.0, 60.0)
+
+#: the bucket-derived quantiles metrics_text exposes as gauges
+QUANTILE_GAUGES = ((0.5, "p50"), (0.9, "p90"), (0.99, "p99"))
+
+
+def describe_histogram(name: str) -> str:
+    entry = HISTOGRAMS.get(name)
+    return entry["help"] if entry else "veles_tpu histogram"
+
+
+def histogram_buckets(name: str) -> Tuple[float, ...]:
+    entry = HISTOGRAMS.get(name)
+    return tuple(entry["buckets"]) if entry else DEFAULT_BUCKETS
+
+
+def histogram_quantile(bounds, counts, q: float) -> Optional[float]:
+    """Prometheus ``histogram_quantile`` estimation from fixed
+    buckets: ``counts[i]`` is the NON-cumulative count of bucket
+    ``bounds[i]`` (``counts[-1]`` the +Inf overflow). Linear
+    interpolation inside the winning bucket; values landing in the
+    overflow bucket report the largest finite bound (the histogram
+    cannot see past it). None when the histogram is empty — shared
+    by the live registry and fleet aggregation so both surfaces
+    answer 'what is p99' with the same arithmetic."""
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = q * total
+    cum = 0.0
+    for i, cnt in enumerate(counts):
+        prev = cum
+        cum += cnt
+        if cum >= rank and cnt > 0:
+            if i >= len(bounds):            # +Inf overflow bucket
+                return float(bounds[-1]) if bounds else None
+            lower = float(bounds[i - 1]) if i > 0 else 0.0
+            upper = float(bounds[i])
+            return lower + (upper - lower) * (rank - prev) / cnt
+    return float(bounds[-1]) if bounds else None
+
+
+class HistogramRegistry:
+    """Thread-safe fixed-bucket histograms (the latency twin of
+    :class:`CounterRegistry`): flat name → (bucket counts, sum).
+    Entries appear on first ``observe`` — an idle process renders no
+    histogram rows at all, so non-serving /metrics pages (and the
+    bench gate's zero-leakage sections) stay exactly as before."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        #: name -> per-bucket counts, len(bounds) + 1 (+Inf overflow)
+        self._counts: Dict[str, List[int]] = {}
+        self._sums: Dict[str, float] = {}
+        self._bounds: Dict[str, Tuple[float, ...]] = {}
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample into ``name``'s fixed buckets."""
+        value = float(value)
+        with self._lock:
+            counts = self._counts.get(name)
+            if counts is None:
+                bounds = histogram_buckets(name)
+                self._bounds[name] = bounds
+                counts = self._counts[name] = [0] * (len(bounds) + 1)
+                self._sums[name] = 0.0
+            counts[bisect.bisect_left(self._bounds[name], value)] += 1
+            self._sums[name] += value
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return sum(self._counts.get(name, ()))
+
+    def sum(self, name: str) -> float:
+        with self._lock:
+            return self._sums.get(name, 0.0)
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """{name: {bounds, counts, sum, count}} — one instant."""
+        with self._lock:
+            return {
+                name: {"bounds": self._bounds[name],
+                       "counts": tuple(counts),
+                       "sum": self._sums[name],
+                       "count": sum(counts)}
+                for name, counts in self._counts.items()}
+
+    def quantile(self, name: str, q: float) -> Optional[float]:
+        """Bucket-interpolated quantile; None when no samples."""
+        with self._lock:
+            counts = self._counts.get(name)
+            if counts is None:
+                return None
+            bounds, counts = self._bounds[name], tuple(counts)
+        return histogram_quantile(bounds, counts, q)
+
+    def reset(self) -> None:
+        """Zero everything — tests and bench section boundaries only
+        (same contract as :meth:`CounterRegistry.reset`)."""
+        with self._lock:
+            self._counts.clear()
+            self._sums.clear()
+            self._bounds.clear()
+
+    def prometheus_text(self, snap: Optional[Dict] = None) -> str:
+        """Prometheus histogram exposition: cumulative ``_bucket{le=}``
+        series plus ``_sum``/``_count`` per recorded histogram."""
+        snap = self.snapshot() if snap is None else snap
+        lines = []
+        for name in sorted(snap):
+            h = snap[name]
+            lines.append("# HELP %s %s"
+                         % (name, describe_histogram(name)))
+            lines.append("# TYPE %s histogram" % name)
+            cum = 0
+            for bound, cnt in zip(h["bounds"], h["counts"]):
+                cum += cnt
+                lines.append('%s_bucket{le="%s"} %d'
+                             % (name, format(float(bound), "g"), cum))
+            lines.append('%s_bucket{le="+Inf"} %d'
+                         % (name, h["count"]))
+            s = float(h["sum"])
+            lines.append("%s_sum %s"
+                         % (name, int(s) if s.is_integer() else
+                            round(s, 9)))
+            lines.append("%s_count %d" % (name, h["count"]))
+        return "\n".join(lines) + "\n" if lines else ""
+
+
+#: THE process-global histogram registry (mirrors ``counters``).
+histograms = HistogramRegistry()
+
+
+def observe(name: str, value: float) -> None:
+    histograms.observe(name, value)
 
 
 def describe_counter(name: str) -> str:
@@ -257,16 +442,43 @@ METRICS_CONTENT_TYPE = "text/plain; version=0.0.4"
 
 
 def metrics_text(gauges: Optional[dict] = None) -> str:
-    """The full /metrics page: the counter registry plus the caller's
-    service gauges — THE one renderer behind every /metrics endpoint
-    (web_status, RESTfulAPI, GenerationAPI), so format changes happen
-    in one place. ``gauges``: name → value (or (value, help) tuple)."""
+    """The full /metrics page: the counter registry, the histogram
+    registry (with bucket-derived p50/p90/p99 quantile gauges per
+    recorded histogram), then the caller's service gauges — THE one
+    renderer behind every /metrics endpoint (web_status, RESTfulAPI,
+    GenerationAPI), so format changes happen in one place. ``gauges``:
+    name → value (or (value, help) tuple). A caller gauge whose name
+    shadows an already-rendered series is DROPPED and counted
+    (``veles_metrics_name_collisions_total``) — duplicate metric
+    names are invalid exposition and would break every scraper; the
+    collision counter itself lands on the next scrape (this page's
+    counter section is already snapshotted)."""
     text = counters.prometheus_text()
+    taken = set(counters.snapshot())
+    hsnap = histograms.snapshot()
+    text += histograms.prometheus_text(hsnap)
+    for name in sorted(hsnap):
+        taken.update((name, name + "_bucket", name + "_sum",
+                      name + "_count"))
+        h = hsnap[name]
+        if not h["count"]:
+            continue
+        for q, label in QUANTILE_GAUGES:
+            value = histogram_quantile(h["bounds"], h["counts"], q)
+            gname = "%s_%s" % (name, label)
+            text += gauge_text(
+                gname, round(value, 9),
+                "Bucket-estimated %s of %s" % (label, name))
+            taken.add(gname)
     for name, val in (gauges or {}).items():
+        if name in taken:
+            counters.inc("veles_metrics_name_collisions_total")
+            continue
         help_text = None
         if isinstance(val, tuple):
             val, help_text = val
         text += gauge_text(name, val, help_text)
+        taken.add(name)
     return text
 
 

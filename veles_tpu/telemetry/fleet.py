@@ -1,0 +1,288 @@
+"""Fleet /metrics aggregation: scrape-and-merge over N endpoints.
+
+The ROADMAP-item-1 topology is N engine replicas behind a router; its
+observability substrate is ONE fleet-wide /metrics view — "what is
+p99 TTFT across the fleet", not per replica. This module scrapes the
+Prometheus exposition every veles_tpu HTTP surface renders (the
+shared :func:`~veles_tpu.telemetry.counters.metrics_text` path on
+web_status, RESTfulAPI and GenerationAPI) and merges:
+
+- **counters** are SUMMED (each is a per-process monotonic total);
+- **histogram buckets** are SUMMED per ``le`` bound, ``_sum`` and
+  ``_count`` with them — fixed buckets make this lossless, which is
+  exactly why the registry uses fixed bounds instead of per-process
+  quantile sketches — and the fleet p50/p90/p99 are RECOMPUTED from
+  the merged buckets (never averaged from per-endpoint quantiles,
+  which is statistically meaningless);
+- **gauges** are SUMMED (slots busy, queue depth, pages in use — the
+  fleet totals an admission/spill/drain router decides on); the
+  per-endpoint quantile gauges the endpoints derive from their own
+  buckets are DROPPED (they are recomputed fleet-wide);
+- per-endpoint **up/down status** rides along as
+  ``veles_fleet_endpoint_up{endpoint="..."}`` rows, so a dead
+  replica is visible in the very page that hides its counters.
+
+CLI: ``veles-tpu metrics aggregate URL [URL ...]`` prints the merged
+exposition; ``--json`` prints the structured form. Operator guide:
+docs/observability.md "Request-plane SLOs".
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .counters import (METRICS_CONTENT_TYPE,          # noqa: F401
+                       QUANTILE_GAUGES, describe_counter,
+                       describe_histogram, gauge_text,
+                       histogram_quantile)
+
+#: quantile-gauge suffixes the endpoints derive locally — dropped on
+#: merge and recomputed from the merged buckets
+_QUANTILE_SUFFIXES = tuple("_" + label for _q, label in QUANTILE_GAUGES)
+
+#: one exposition sample line: ``name{labels} value`` or ``name value``
+_SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{([^}]*)\})?\s+(\S+)$")
+
+_LE_RE = re.compile(r'le="([^"]+)"')
+
+
+def parse_metrics_text(text: str) -> Dict[str, Dict]:
+    """Prometheus exposition text → ``{"counters": {name: value},
+    "gauges": {...}, "histograms": {name: {"buckets": {le: cum},
+    "sum": s, "count": n}}}``. ``# TYPE`` lines drive classification;
+    untyped samples land in gauges (safe: summing an unknown series
+    is no worse than dropping it, and the names stay visible).
+    Labeled series other than histogram ``le`` buckets are skipped —
+    the veles surfaces emit none, and guessing how to merge foreign
+    labels would corrupt the page."""
+    types: Dict[str, str] = {}
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    hists: Dict[str, Dict] = {}
+
+    def hist(base: str) -> Dict:
+        return hists.setdefault(
+            base, {"buckets": {}, "sum": 0.0, "count": 0.0})
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("#"):
+            parts = line.split(None, 3)
+            if len(parts) >= 4 and parts[1] == "TYPE":
+                types[parts[2]] = parts[3].strip()
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, labels, raw = m.group(1), m.group(3), m.group(4)
+        try:
+            value = float(raw)
+        except ValueError:
+            continue
+        if name.endswith("_bucket") \
+                and types.get(name[:-7]) == "histogram":
+            le = _LE_RE.search(labels or "")
+            if le:
+                hist(name[:-7])["buckets"][le.group(1)] = value
+            continue
+        if name.endswith("_sum") and types.get(name[:-4]) == "histogram":
+            hist(name[:-4])["sum"] = value
+            continue
+        if name.endswith("_count") \
+                and types.get(name[:-6]) == "histogram":
+            hist(name[:-6])["count"] = value
+            continue
+        if labels:
+            continue
+        if types.get(name) == "counter":
+            counters[name] = value
+        else:
+            gauges[name] = value
+    return {"counters": counters, "gauges": gauges,
+            "histograms": hists}
+
+
+def _le_value(le: str) -> float:
+    return float("inf") if le == "+Inf" else float(le)
+
+
+def _cum_at(buckets: Dict[str, float], bound: float) -> float:
+    """Cumulative count of a histogram at ``bound`` — the largest
+    recorded cumulative count at a bound <= ``bound`` (the step
+    function a cumulative histogram IS), so endpoints with different
+    bucket grids still merge exactly at their common bounds."""
+    best = 0.0
+    for le, cum in buckets.items():
+        if _le_value(le) <= bound:
+            best = max(best, cum)
+    return best
+
+
+def merge(parsed: Sequence[Dict[str, Dict]]) -> Dict[str, Dict]:
+    """Merge N :func:`parse_metrics_text` results into one fleet
+    view: counters and gauges summed, histogram buckets summed per
+    bound (union of bounds, each endpoint evaluated as the step
+    function its cumulative buckets define), sums/counts summed.
+    Per-endpoint quantile gauges are dropped — :func:`quantiles`
+    recomputes them from the merged buckets."""
+    out: Dict[str, Dict] = {"counters": {}, "gauges": {},
+                            "histograms": {}}
+    for p in parsed:
+        for name, val in p.get("counters", {}).items():
+            out["counters"][name] = out["counters"].get(name, 0.0) + val
+        for name, val in p.get("gauges", {}).items():
+            out["gauges"][name] = out["gauges"].get(name, 0.0) + val
+        for name, h in p.get("histograms", {}).items():
+            tgt = out["histograms"].setdefault(
+                name, {"buckets": {}, "sum": 0.0, "count": 0.0})
+            bounds = {le for le in h["buckets"]} \
+                | set(tgt["buckets"])
+            merged = {}
+            for le in bounds:
+                merged[le] = (_cum_at(tgt["buckets"], _le_value(le))
+                              + _cum_at(h["buckets"], _le_value(le)))
+            tgt["buckets"] = merged
+            tgt["sum"] += h["sum"]
+            tgt["count"] += h["count"]
+    # drop the per-endpoint quantile gauges in one pass over the
+    # FINAL histogram name set (they are recomputed fleet-wide)
+    for name in list(out["gauges"]):
+        if any(name == h + s for h in out["histograms"]
+               for s in _QUANTILE_SUFFIXES):
+            del out["gauges"][name]
+    return out
+
+
+def quantiles(hist: Dict, qs=(0.5, 0.9, 0.99)) -> Dict[float, Optional[float]]:
+    """Recompute quantiles from a merged histogram's CUMULATIVE
+    buckets (the exposition form) via the shared
+    :func:`histogram_quantile` arithmetic."""
+    items = sorted(((le, cum) for le, cum in hist["buckets"].items()
+                    if le != "+Inf"),
+                   key=lambda kv: _le_value(kv[0]))
+    bounds = [_le_value(le) for le, _ in items]
+    counts: List[float] = []
+    prev = 0.0
+    for _le, cum in items:
+        counts.append(max(0.0, cum - prev))
+        prev = max(prev, cum)
+    counts.append(max(0.0, float(hist["count"]) - prev))  # +Inf bucket
+    return {q: histogram_quantile(bounds, counts, q) for q in qs}
+
+
+def scrape(url: str, timeout: float = 5.0
+           ) -> Tuple[Optional[str], Optional[str]]:
+    """(body, error) for one /metrics endpoint — exactly one of the
+    two is None. Bare host:port inputs get ``http://`` and
+    ``/metrics`` filled in."""
+    import urllib.error
+    import urllib.request
+    if "://" not in url:
+        url = "http://" + url
+    if not url.rstrip("/").endswith("/metrics"):
+        url = url.rstrip("/") + "/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode("utf-8", "replace"), None
+    except Exception as e:      # noqa: BLE001 — a down replica is data
+        return None, "%s: %s" % (type(e).__name__, e)
+
+
+def aggregate(urls: Sequence[str], timeout: float = 5.0) -> Dict:
+    """Scrape every endpoint and merge the live ones. Returns
+    ``{"endpoints": [{"url", "up", "error"}...], "merged": {...}}`` —
+    a down endpoint contributes its up=0 row and nothing else."""
+    statuses = []
+    parsed = []
+    for url in urls:
+        body, error = scrape(url, timeout=timeout)
+        statuses.append({"url": url, "up": body is not None,
+                         "error": error})
+        if body is not None:
+            parsed.append(parse_metrics_text(body))
+    return {"endpoints": statuses, "merged": merge(parsed)}
+
+
+def render(agg: Dict) -> str:
+    """One fleet-wide exposition page from an :func:`aggregate`
+    result: endpoint status rows, summed counters, merged histograms
+    with RECOMPUTED p50/p90/p99 gauges, summed gauges."""
+    lines = [
+        "# HELP veles_fleet_endpoint_up 1 = endpoint scraped "
+        "successfully, 0 = down",
+        "# TYPE veles_fleet_endpoint_up gauge",
+    ]
+    for ep in agg["endpoints"]:
+        lines.append('veles_fleet_endpoint_up{endpoint="%s"} %d'
+                     % (ep["url"], 1 if ep["up"] else 0))
+    text = "\n".join(lines) + "\n"
+    text += gauge_text("veles_fleet_endpoints", len(agg["endpoints"]),
+                       "Endpoints this aggregation covers")
+    text += gauge_text("veles_fleet_endpoints_up",
+                       sum(1 for ep in agg["endpoints"] if ep["up"]),
+                       "Endpoints that answered the scrape")
+    merged = agg["merged"]
+    for name in sorted(merged["counters"]):
+        val = merged["counters"][name]
+        text += "# HELP %s %s\n# TYPE %s counter\n%s %s\n" % (
+            name, describe_counter(name), name, name,
+            int(val) if float(val).is_integer() else val)
+    for name in sorted(merged["histograms"]):
+        h = merged["histograms"][name]
+        text += "# HELP %s %s\n# TYPE %s histogram\n" % (
+            name, describe_histogram(name), name)
+        for le, cum in sorted(h["buckets"].items(),
+                              key=lambda kv: _le_value(kv[0])):
+            text += '%s_bucket{le="%s"} %d\n' % (name, le, cum)
+        if "+Inf" not in h["buckets"]:
+            text += '%s_bucket{le="+Inf"} %d\n' % (name, h["count"])
+        text += "%s_sum %s\n%s_count %d\n" % (
+            name, round(float(h["sum"]), 9), name, h["count"])
+        if h["count"]:
+            qs = quantiles(h)
+            for q, label in QUANTILE_GAUGES:
+                if qs.get(q) is not None:
+                    text += gauge_text(
+                        "%s_%s" % (name, label), round(qs[q], 9),
+                        "Fleet-recomputed %s of %s" % (label, name))
+    for name in sorted(merged["gauges"]):
+        val = merged["gauges"][name]
+        text += gauge_text(name, val)
+    return text
+
+
+def main(argv) -> int:
+    """``veles-tpu metrics aggregate URL [URL ...]`` driver (wired in
+    veles_tpu/__main__.py). Exit 0 while at least one endpoint
+    answered; 2 when the whole fleet is down (the merged page would
+    be empty — an alert, not a report)."""
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu metrics",
+        description="fleet /metrics tools (telemetry/fleet.py)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    ag = sub.add_parser(
+        "aggregate",
+        help="scrape N /metrics endpoints, print the merged "
+             "exposition (counters/buckets summed, quantiles "
+             "recomputed, per-endpoint up/down rows)")
+    ag.add_argument("urls", nargs="+", metavar="URL",
+                    help="endpoint (http://host:port[/metrics]; bare "
+                         "host:port accepted)")
+    ag.add_argument("--timeout", type=float, default=5.0,
+                    help="per-endpoint scrape timeout, seconds")
+    ag.add_argument("--json", action="store_true",
+                    help="print the structured aggregation instead "
+                         "of exposition text")
+    args = parser.parse_args(argv)
+    agg = aggregate(args.urls, timeout=args.timeout)
+    if args.json:
+        print(json.dumps(agg, indent=2, sort_keys=True))
+    else:
+        print(render(agg), end="")
+    return 0 if any(ep["up"] for ep in agg["endpoints"]) else 2

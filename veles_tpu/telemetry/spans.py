@@ -164,6 +164,41 @@ class SpanRecorder:
                 pass
         return rec
 
+    def emit(self, name: str, ts: float, dur: float,
+             **attrs: Any) -> Dict[str, Any]:
+        """Record an ALREADY-MEASURED interval as a completed span —
+        the retrospective twin of begin/end, for timelines assembled
+        from host timestamps after the fact (the per-request lifecycle
+        spans the serving plane emits at ticket terminal: queue wait,
+        prefill, decode — each tagged ``request_id`` so ``veles-tpu
+        trace export --request ID`` renders one request's timeline).
+        No nesting (depth 0) and no counter deltas: the interval was
+        not bracketed live, so attributing registry deltas to it would
+        be a lie. Honors the ``root.common.trace.spans`` switch."""
+        if not _enabled():
+            return {}
+        rec: Dict[str, Any] = {
+            "name": name,
+            "ts": float(ts),
+            "dur": max(float(dur), 0.0),
+            "depth": 0,
+            "parent": None,
+            "sid": next(_ids),
+            "tid": threading.get_ident(),
+        }
+        rec.update(attrs)
+        counters.inc("veles_spans_total")
+        with self._lock:
+            self._ring.append(rec)
+            if self._file is not None:
+                self._file.write(json.dumps(rec, default=str) + "\n")
+        for hook in _close_hooks:
+            try:
+                hook(rec)
+            except Exception:       # noqa: BLE001 — observers only
+                pass
+        return rec
+
     # -- introspection -------------------------------------------------------
     def records(self, name: Optional[str] = None) -> List[Dict[str, Any]]:
         with self._lock:
@@ -206,6 +241,13 @@ class span:
         if exc_type is not None:
             self._frame.attrs["error"] = True
         self.record = recorder.end(self._frame)
+
+
+def emit(name: str, ts: float, dur: float, **attrs: Any
+         ) -> Dict[str, Any]:
+    """Module-level :meth:`SpanRecorder.emit` on the global recorder
+    (mirrors :class:`span`)."""
+    return recorder.emit(name, ts, dur, **attrs)
 
 
 def spanned(name: Optional[str] = None, **attrs: Any):
