@@ -654,6 +654,11 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # into a plain training measurement would mean restores (and
         # their reshard device_puts) ran inside a perf window
         "elastic": _elastic_section(),
+        # serving fleet router (veles_tpu/serving/router.py): the
+        # bench never routes, so every router counter MUST read zero
+        # here — the gate fails on leakage; the failover/exactly-once
+        # measurement itself is the gate's live fleet proof
+        "fleet": _fleet_section(),
         "extras": [ae, lm],
     }
 
@@ -731,6 +736,30 @@ def _serving_section():
         "ttft_p99": q("veles_serving_ttft_seconds", 0.99),
         "tpot_p50": q("veles_serving_tpot_seconds", 0.5),
         "queue_wait_p99": q("veles_serving_queue_wait_seconds", 0.99),
+    }
+
+
+def _fleet_section():
+    """{requests, attempts, failovers, replica_errors, breaker_opens,
+    duplicate_answers, respawns} for this bench process — absolute
+    counter reads (one process, counters start at zero). The bench
+    never runs a fleet router, so every count MUST be zero —
+    ``bench.py gate`` fails on leakage. The live failover proof (a
+    2-replica fleet under an injected replica kill answering every
+    request exactly once) runs inside ``gate_fleet`` and stamps its
+    failover count there."""
+    from veles_tpu.telemetry.counters import counters
+    return {
+        "requests": int(counters.get("veles_router_requests_total")),
+        "attempts": int(counters.get("veles_router_attempts_total")),
+        "failovers": int(counters.get("veles_router_failovers_total")),
+        "replica_errors": int(
+            counters.get("veles_router_replica_errors_total")),
+        "breaker_opens": int(
+            counters.get("veles_router_breaker_opens_total")),
+        "duplicate_answers": int(
+            counters.get("veles_router_duplicate_answers_total")),
+        "respawns": int(counters.get("veles_router_respawns_total")),
     }
 
 
@@ -1601,6 +1630,217 @@ def _pooled_modes_proof(lm, wf):
     return failures
 
 
+def gate_fleet(baseline_doc=None, current_doc=None):
+    """``fleet`` gate section: (1) every ``veles_router_*`` counter
+    must be registered with a HELP string; (2) bench documents must
+    carry ZERO router activity — the bench never routes, so a
+    non-zero count means fleet machinery leaked into a training
+    measurement; (3) the clean gate process must read zero before the
+    proof; (4) live proof: a 2-replica fleet under an injected
+    ``serve.replica_death`` kill answers every request exactly once —
+    the router opens the breaker, fails the in-flight request over to
+    the survivor, the ReplicaSupervisor respawns the dead replica,
+    and no request is dropped, double-answered or silently 504'd
+    (failover count stamped)."""
+    from veles_tpu.serving import ROUTER_COUNTERS
+    from veles_tpu.telemetry.counters import DESCRIPTIONS, counters
+    failures = []
+    for name in ROUTER_COUNTERS:
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "fleet: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc),
+                     ("current", current_doc)):
+        sec = (doc or {}).get("fleet")
+        if not sec:
+            continue
+        for key in ("requests", "attempts", "failovers",
+                    "replica_errors", "respawns"):
+            if sec.get(key):
+                failures.append(
+                    "fleet: %s doc has %s=%s — router work leaked "
+                    "into a non-fleet bench run" % (tag, key,
+                                                    sec[key]))
+    # the zero check must precede the live proof (which routes for
+    # real and legitimately moves every one of these counters)
+    for name in ROUTER_COUNTERS:
+        value = counters.get(name)
+        if value:
+            failures.append(
+                "fleet: %s = %s before any routing ran in this "
+                "process" % (name, value))
+    return failures + _fleet_failover_proof()
+
+
+def _fleet_failover_proof():
+    """THE chaos drill, live: two in-process GenerationAPI replicas
+    over one tiny LM behind a FleetRouter; ``serve.replica_death`` is
+    armed to kill one replica mid-decode partway through the load.
+    Every request must come back exactly once with the same tokens
+    the solo decode produces (responses keyed by request_id — no
+    duplicates, no silent 504s), the router must record at least one
+    failover + breaker open, and the ReplicaSupervisor must respawn
+    the dead replica (proven by it serving again)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy
+    import char_lm
+    import veles_tpu as vt
+    from veles_tpu import prng
+    from veles_tpu.nn import sampling
+    from veles_tpu.resilience import faults
+    from veles_tpu.serving.router import (FleetRouter,
+                                          ReplicaSupervisor)
+    from veles_tpu.telemetry.counters import counters as _ctrs
+
+    prng.seed_all(5151)
+    wf = char_lm.build_workflow(epochs=1, minibatch_size=32,
+                                n_blocks=1, dim=32, n_train=64,
+                                n_valid=32)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    apis = [vt.GenerationAPI(wf, port=0, engine="continuous",
+                             max_slots=2, buckets=(8,),
+                             max_context=24, name="fleet_bench_%d" % i)
+            for i in range(2)]
+
+    class _Handle:
+        def __init__(self, api):
+            self.api = api
+
+        def poll(self):
+            return (None if self.api._service is not None
+                    else faults.CRASH_EXIT_CODE)
+
+    def spawn(i, _incarnation):
+        apis[i].initialize()
+        return _Handle(apis[i])
+
+    failures = []
+    rng = numpy.random.RandomState(23)
+    prompts = [[int(t) for t in rng.randint(0, char_lm.VOCAB, 5 + i)]
+               for i in range(8)]
+    expected = [sampling.generate(wf, p, 4, temperature=0)
+                for p in prompts]
+    sup = ReplicaSupervisor(spawn, 2, poll_interval=0.1,
+                            name="fleet_bench")
+    saved_spec = os.environ.get("VELES_FAULTS")
+    router = None
+    try:
+        sup.start()
+        router = FleetRouter(
+            ["127.0.0.1:%d" % api.port for api in apis],
+            probe_interval=0.2, failure_threshold=1,
+            retry_budget=2, attempt_timeout=30.0,
+            request_timeout=60.0, name="bench.router").start()
+        import json as _json
+        import urllib.request as _rq
+        url = "http://127.0.0.1:%d/generate" % router.port
+
+        def post(payload):
+            import urllib.error as _er
+            req = _rq.Request(url,
+                              data=_json.dumps(payload).encode(),
+                              headers={"Content-Type":
+                                       "application/json"})
+            try:
+                with _rq.urlopen(req, timeout=90) as r:
+                    return r.status, _json.loads(r.read())
+            except _er.HTTPError as e:
+                # a shed/expiry answer IS data for this proof — the
+                # non-200 branches below must report it as a GATE
+                # FAIL, not crash the gate with a traceback
+                try:
+                    return e.code, _json.loads(e.read() or b"{}")
+                except ValueError:
+                    return e.code, {"error": "replica answered %d"
+                                    % e.code}
+
+        post({"prompt": prompts[0], "n_new": 4})        # warm
+        fo_before = _ctrs.get("veles_router_failovers_total")
+        # the 3rd replica-side request dies mid-decode, exactly once
+        os.environ["VELES_FAULTS"] = \
+            "serve.replica_death:raise:after=2,times=1"
+        answers = {}
+        for i, prompt in enumerate(prompts):
+            status, body = post({"prompt": prompt, "n_new": 4})
+            if status != 200:
+                failures.append(
+                    "fleet: request %d answered %d (%s) — the fleet "
+                    "dropped a request" % (i, status,
+                                           body.get("error")))
+                continue
+            rid = body.get("request_id")
+            if rid in answers:
+                failures.append(
+                    "fleet: request_id %s answered twice" % rid)
+            answers[rid] = body["tokens"]
+            if body["tokens"] != expected[i]:
+                failures.append(
+                    "fleet: request %d tokens differ from the solo "
+                    "decode after failover" % i)
+        if len(answers) != len(prompts):
+            failures.append(
+                "fleet: %d distinct answers for %d requests — "
+                "exactly-once accounting broken"
+                % (len(answers), len(prompts)))
+        failovers = _ctrs.get("veles_router_failovers_total") \
+            - fo_before
+        if failovers < 1:
+            failures.append(
+                "fleet: injected replica death caused no failover "
+                "(the kill never fired, or the router never "
+                "re-routed)")
+        if _ctrs.get("veles_router_breaker_opens_total") < 1:
+            failures.append(
+                "fleet: the dead replica's breaker never opened")
+        os.environ.pop("VELES_FAULTS", None)
+        # the supervisor must respawn the dead replica, and the
+        # respawned replica must actually serve again (wait on the
+        # respawn COUNTER — alive() alone is racy while the dying
+        # replica's teardown is still in flight)
+        rs_before = 0
+        deadline = time.time() + 60
+        while _ctrs.get("veles_router_respawns_total") - rs_before \
+                < 1 and time.time() < deadline:
+            time.sleep(0.1)
+        deadline = time.time() + 30
+        while sup.alive() < 2 and time.time() < deadline:
+            time.sleep(0.1)
+        if sup.alive() < 2:
+            failures.append(
+                "fleet: ReplicaSupervisor did not respawn the dead "
+                "replica within its deadline")
+        respawns = int(_ctrs.get("veles_router_respawns_total"))
+        if respawns < 1:
+            failures.append("fleet: zero respawns counted after an "
+                            "injected replica death")
+        router.probe_all()
+        status, body = post({"prompt": prompts[0], "n_new": 4})
+        if status != 200 or body["tokens"] != expected[0]:
+            failures.append(
+                "fleet: the fleet cannot serve after the respawn "
+                "(%s)" % (body,))
+        if not failures:
+            print("fleet proof: %d requests exactly-once through an "
+                  "injected replica death — %d failover(s), %d "
+                  "breaker open(s), %d respawn(s)"
+                  % (len(prompts), int(failovers),
+                     int(_ctrs.get(
+                         "veles_router_breaker_opens_total")),
+                     respawns))
+    finally:
+        if saved_spec is None:
+            os.environ.pop("VELES_FAULTS", None)
+        else:
+            os.environ["VELES_FAULTS"] = saved_spec
+        if router is not None:
+            router.stop()
+        sup.stop()
+        for api in apis:
+            api.stop()
+    return failures
+
+
 def gate_quant(baseline_doc=None, current_doc=None):
     """``quant`` gate section: (1) the quantization/artifact counters
     must be registered; (2) quant-off bench documents must carry ZERO
@@ -1902,6 +2142,7 @@ def _gate_main(argv):
                 + gate_overlap(baseline, current)
                 + gate_tensormon(baseline, current)
                 + gate_serving(baseline, current)
+                + gate_fleet(baseline, current)
                 + gate_quant(baseline, current))
     for failure in failures:
         print("GATE FAIL %s" % failure, file=sys.stderr)
@@ -1915,8 +2156,10 @@ def _gate_main(argv):
           "overlap stall proof passed, tensormon clean, recorder "
           "overhead in budget, serving counters + SLO histograms "
           "clean + continuous "
-          "batching beats the window baseline, quant clean + int8 "
-          "greedy token-exact + artifact serves with zero compiles)"
+          "batching beats the window baseline, fleet counters clean "
+          "+ 2-replica failover drill exactly-once, quant clean + "
+          "int8 greedy token-exact + artifact serves with zero "
+          "compiles)"
           % (argv[1], argv[0],
              " — %d legacy section(s) compared on wall-clock" % legacy
              if legacy else ""))

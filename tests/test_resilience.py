@@ -468,6 +468,84 @@ def test_web_status_health_endpoints():
         server.stop()
 
 
+def test_readiness_transitions_ready_draining_gone():
+    """The drain lifecycle on the readiness plane: ready → draining
+    (/readyz 503 with status "draining", the component value naming
+    it) → gone (forget(): the mark AND the heartbeat drop, /readyz
+    back to 200) — while /healthz stays 200 the whole way, because a
+    draining process is alive and finishing in-flight work."""
+    name = "svc.drainer"
+    health.mark_ready(name)
+    health.heartbeats.beat(name)
+    try:
+        code, body = health.readyz()
+        assert code == 200 and body["components"][name] is True
+        health.mark_draining(name)
+        code, body = health.readyz()
+        assert code == 503
+        assert body["status"] == "draining"
+        assert body["components"][name] == "draining"
+        # liveness is NOT readiness: the heartbeat is fresh, the
+        # process is alive — /healthz stays green throughout
+        code, body = health.healthz()
+        assert code == 200 and name in body["heartbeats"]
+        # a plainly-unready component alongside a draining one makes
+        # the page "not ready" (draining no longer explains the 503)
+        health.mark_unready("svc.other")
+        code, body = health.readyz()
+        assert code == 503 and body["status"] == "not ready"
+        health.forget("svc.other")
+        # gone: the drain finished — mark and heartbeat both drop
+        health.forget(name)
+        code, body = health.readyz()
+        assert code == 200 and name not in body["components"]
+        assert name not in health.heartbeats.status()
+        assert name not in health.draining()
+    finally:
+        health.forget(name)
+        health.forget("svc.other")
+
+
+def test_mark_ready_clears_draining_state():
+    """A drained service that comes back (respawn) is plainly ready —
+    no stale draining mark survives mark_ready/mark_unready."""
+    name = "svc.back"
+    try:
+        health.mark_draining(name)
+        assert name in health.draining()
+        health.mark_ready(name)
+        assert name not in health.draining()
+        code, body = health.readyz()
+        assert code == 200 and body["components"][name] is True
+        health.mark_draining(name)
+        health.mark_unready(name)
+        # explicitly unready (not draining): the page says so
+        code, body = health.readyz()
+        assert code == 503 and body["status"] == "not ready"
+        assert body["components"][name] is False
+    finally:
+        health.forget(name)
+
+
+def test_shed_body_carries_request_id():
+    """The satellite contract: a shed's response body includes the
+    request_id so a router retry can correlate the 503 with its
+    attempt — here via the bounded-queue shed path."""
+    wf = vt.Workflow(None, name="w")
+    api = vt.GenerationAPI(wf, port=0, max_queue=0, name="rid_g")
+    api.initialize()
+    try:
+        url = "http://127.0.0.1:%d/generate" % api.port
+        code, headers, body = _post(
+            url, {"prompt": [1, 2, 3], "n_new": 4,
+                  "request_id": "req-router-7"})
+        assert code == 503
+        assert body["request_id"] == "req-router-7"
+        assert int(headers.get("Retry-After")) >= 1
+    finally:
+        api.stop()
+
+
 def test_generation_api_queue_bound_sheds_503_retry_after():
     wf = vt.Workflow(None, name="w")
     api = vt.GenerationAPI(wf, port=0, max_queue=0, name="shed_g")

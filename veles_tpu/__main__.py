@@ -35,6 +35,10 @@ def main(argv=None) -> int:
         #   veles-tpu metrics aggregate URL [URL ...]
         from .telemetry import fleet
         return fleet.main(argv[1:])
+    if argv and argv[0] == "route":
+        # serving-fleet front (serving/router.py):
+        #   veles-tpu route URL [URL ...] [--port P] [...]
+        return _route_cli(argv[1:])
     if argv and argv[0] == "faults":
         # resilience subcommand family:
         #   veles-tpu faults list
@@ -83,6 +87,8 @@ def main(argv=None) -> int:
         _root.common.serving.beam_width = args.serve_beam_width
     if args.serve_artifact:
         _root.common.serving.artifact = args.serve_artifact
+    if args.serve_drain_grace is not None:
+        _root.common.serving.drain_grace = args.serve_drain_grace
     # quantization policy (veles_tpu/quant/): the flags arm the config
     # tree; the serving engine (and any programmatic consumer) reads
     # root.common.quant.*
@@ -313,6 +319,100 @@ def _faults_cli(argv) -> int:
         print("  %-17s %s" % (name, desc))
     spec = faults.plane.current_spec()
     print("active spec: %s" % (spec or "(none)"))
+    return 0
+
+
+def _route_cli(argv) -> int:
+    """``veles-tpu route URL [URL ...]`` — run the serving-fleet
+    router (serving/router.py): health-gated admission over the
+    replica roster, per-replica circuit breakers, idempotent failover
+    keyed on request_id, graceful drain on SIGTERM / POST /drain.
+    The roster comes from positional URLs and/or ``--endpoints-file``
+    (plain lines, or the JSON a saved ``GET /roster`` page is — the
+    same file ``veles-tpu metrics aggregate --endpoints-file``
+    consumes, so fleet scraping and routing share one roster)."""
+    import argparse
+    import signal
+    import threading
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu route",
+        description="serving fleet router "
+                    "(docs/services.md 'Serving fleet')")
+    parser.add_argument("endpoints", nargs="*", metavar="URL",
+                        help="replica endpoint (http://host:port; "
+                             "bare host:port accepted)")
+    parser.add_argument("--endpoints-file", default=None,
+                        metavar="FILE",
+                        help="replica roster file: one endpoint per "
+                             "line (# comments), or JSON "
+                             "({\"endpoints\": [...]} / a bare list)")
+    parser.add_argument("--port", type=int, default=0,
+                        help="router port (0 = ephemeral, printed)")
+    parser.add_argument("--path", default="/generate",
+                        help="proxied POST path (default /generate)")
+    parser.add_argument("--probe-interval", type=float, default=None,
+                        metavar="SEC",
+                        help="replica /readyz + /metrics probe period "
+                             "(root.common.router.probe_interval, "
+                             "default 1)")
+    parser.add_argument("--failure-threshold", type=int, default=None,
+                        metavar="N",
+                        help="consecutive attempt failures that open "
+                             "a replica's circuit breaker (default 3)")
+    parser.add_argument("--retry-budget", type=int, default=None,
+                        metavar="N",
+                        help="failover retries per request beyond the "
+                             "first attempt (default 2)")
+    parser.add_argument("--attempt-timeout", type=float, default=None,
+                        metavar="SEC",
+                        help="patience per replica attempt before "
+                             "failing over (default 10)")
+    parser.add_argument("--request-timeout", type=float, default=None,
+                        metavar="SEC",
+                        help="total routing budget per request "
+                             "(default 120)")
+    parser.add_argument("--drain-grace", type=float, default=None,
+                        metavar="SEC",
+                        help="graceful-drain budget on SIGTERM / "
+                             "POST /drain (default 30)")
+    args = parser.parse_args(argv)
+    endpoints = list(args.endpoints)
+    if args.endpoints_file:
+        from .telemetry.fleet import read_endpoints
+        try:
+            endpoints += read_endpoints(args.endpoints_file)
+        except (OSError, ValueError) as e:
+            print("route: bad --endpoints-file: %s" % e,
+                  file=sys.stderr)
+            return 1
+    if not endpoints:
+        parser.error("no replica endpoints (positional URLs and/or "
+                     "--endpoints-file)")
+    from .serving.router import FleetRouter
+    router = FleetRouter(
+        endpoints, port=args.port, path=args.path,
+        probe_interval=args.probe_interval,
+        failure_threshold=args.failure_threshold,
+        retry_budget=args.retry_budget,
+        attempt_timeout=args.attempt_timeout,
+        request_timeout=args.request_timeout).start()
+    print("ROUTING port=%d replicas=%d" % (router.port,
+                                           len(router.replicas)),
+          flush=True)                                   # scriptable
+    term = threading.Event()
+    prev_term = signal.signal(signal.SIGTERM,
+                              lambda _s, _f: term.set())
+    try:
+        while not term.wait(0.2):
+            pass
+        # SIGTERM: stop admission (/readyz flips to draining), finish
+        # in-flight requests, exit 0 — the rolling-restart contract
+        router.drain(grace=args.drain_grace)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+        signal.signal(signal.SIGTERM, prev_term)
     return 0
 
 
@@ -659,17 +759,28 @@ def _drive(launcher: Launcher, workflow, args):
                             name="serve_generate")
         api.initialize()
         launcher.info("generation serving on "
-                      "http://127.0.0.1:%d/generate — Ctrl-C to stop",
-                      api.port)
+                      "http://127.0.0.1:%d/generate — Ctrl-C stops, "
+                      "SIGTERM drains gracefully", api.port)
         print("SERVING port=%d" % api.port, flush=True)  # scriptable
-        import time as _time
+        # SIGTERM = the scheduler's eviction notice: stop admission
+        # (/readyz flips to draining), finish in-flight tickets within
+        # the drain grace, exit 0 — a rolling restart never turns
+        # half-served requests into client errors
+        import signal
+        import threading as _threading
+        term = _threading.Event()
+        prev_term = signal.signal(signal.SIGTERM,
+                                  lambda _s, _f: term.set())
         try:
-            while True:
-                _time.sleep(1.0)
+            while not term.wait(1.0):
+                pass
+            launcher.info("SIGTERM — draining the serving front")
+            api.drain(grace=args.serve_drain_grace)
         except KeyboardInterrupt:
             launcher.info("serving stopped")
         finally:
             api.stop()
+            signal.signal(signal.SIGTERM, prev_term)
         return None
     from .resilience import elastic
     results = (launcher.run_elastic() if elastic.enabled()

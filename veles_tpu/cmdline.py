@@ -93,7 +93,17 @@ def make_parser() -> argparse.ArgumentParser:
                         "over HTTP instead of training (GenerationAPI: "
                         "greedy/sample/beam, micro-batched; + "
                         "speculative when --serve-draft is given); "
-                        "0 picks an ephemeral port; Ctrl-C stops")
+                        "0 picks an ephemeral port; Ctrl-C stops, "
+                        "SIGTERM drains gracefully (/readyz flips to "
+                        "draining, in-flight tickets finish, exit 0)")
+    p.add_argument("--serve-drain-grace", type=float, default=None,
+                   metavar="SEC",
+                   help="graceful-drain budget for SIGTERM / POST "
+                        "/generate/drain: seconds to wait for "
+                        "in-flight requests before aborting the "
+                        "stragglers 503 "
+                        "(root.common.serving.drain_grace, default "
+                        "30)")
     p.add_argument("--serve-engine", default=None,
                    choices=("continuous", "window"),
                    help="decode plane under --serve-generate: "

@@ -35,8 +35,8 @@ from .checkpoint_chain import (SnapshotCorruptError,      # noqa: F401
                                chain, cursor_of, latest_cursor,
                                load_latest, prune, quarantine,
                                restore_latest, verify)
-from .health import (heartbeats, mark_ready,              # noqa: F401
-                     mark_unready, shed)
+from .health import (heartbeats, mark_draining,           # noqa: F401
+                     mark_ready, mark_unready, shed)
 from .elastic import (ELASTIC_COUNTERS,                   # noqa: F401
                       ElasticController, GENERATION_EXIT_CODE,
                       HostLostError, Supervisor, generation_barrier,

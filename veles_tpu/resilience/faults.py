@@ -126,6 +126,22 @@ for _name, _desc in (
     ("quant.calibrate", "weight quantization scale calibration "
                         "(quantize_params/quantize_state), before "
                         "the amax scan"),
+    # serving fleet (serving/router.py + restful_api.GenerationAPI):
+    # chaos for the multi-replica topology — the router must open the
+    # breaker, fail the request over to a survivor, and answer it
+    # exactly once while the Supervisor plane respawns the hole
+    ("router.replica_request", "fleet router, before each proxied "
+                               "replica attempt (raise = the attempt "
+                               "fails like a dead replica: counted, "
+                               "the breaker advances, the request "
+                               "fails over to another replica)"),
+    ("serve.replica_death", "serving replica death mid-decode: fired "
+                            "in the GenerationAPI request path after "
+                            "admission (raise = this replica tears "
+                            "down its HTTP front and aborts in-flight "
+                            "work — the router's view of a crashed "
+                            "replica; crash = the replica process "
+                            "actually exits %d)" % CRASH_EXIT_CODE),
 ):
     register_point(_name, _desc)
 

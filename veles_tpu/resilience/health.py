@@ -95,16 +95,30 @@ heartbeats = HeartbeatRegistry()
 
 _ready_lock = threading.Lock()
 _ready: Dict[str, bool] = {}
+_draining: set = set()
 
 
 def mark_ready(name: str) -> None:
     with _ready_lock:
         _ready[name] = True
+        _draining.discard(name)
 
 
 def mark_unready(name: str) -> None:
     with _ready_lock:
         _ready[name] = False
+        _draining.discard(name)
+
+
+def mark_draining(name: str) -> None:
+    """Graceful-drain readiness: ``/readyz`` flips 503 (a router/LB
+    stops sending NEW work here) while ``/healthz`` stays green — the
+    process is alive and finishing its in-flight tickets, which is
+    exactly the state the payload's ``"draining"`` status names for
+    the operator watching the drain."""
+    with _ready_lock:
+        _ready[name] = False
+        _draining.add(name)
 
 
 def forget(name: str) -> None:
@@ -113,12 +127,19 @@ def forget(name: str) -> None:
     into an /healthz failure."""
     with _ready_lock:
         _ready.pop(name, None)
+        _draining.discard(name)
     heartbeats.unregister(name)
 
 
 def readiness() -> Dict[str, bool]:
     with _ready_lock:
         return dict(_ready)
+
+
+def draining() -> set:
+    """Names currently draining (subset of the not-ready marks)."""
+    with _ready_lock:
+        return set(_draining)
 
 
 def healthz() -> Tuple[int, Dict[str, Any]]:
@@ -133,11 +154,23 @@ def healthz() -> Tuple[int, Dict[str, Any]]:
 
 def readyz() -> Tuple[int, Dict[str, Any]]:
     """(status code, payload) for a readiness probe: 200 once every
-    component that declared itself is marked ready."""
+    component that declared itself is marked ready. A component in
+    graceful drain reports ``"draining"`` in the components map (and
+    flips the page status to ``"draining"`` when every not-ready
+    component is one) — a fleet router distinguishes "spill away and
+    come back" from "never was ready"."""
     marks = readiness()
+    drains = draining()
     ok = all(marks.values()) if marks else True
+    status = "ok"
+    if not ok:
+        not_ready = {n for n, v in marks.items() if not v}
+        status = ("draining" if not_ready and not_ready <= drains
+                  else "not ready")
     return (200 if ok else 503), {
-        "status": "ok" if ok else "not ready", "components": marks}
+        "status": status,
+        "components": {n: ("draining" if n in drains else v)
+                       for n, v in marks.items()}}
 
 
 def handle_health(handler, path: str) -> bool:
@@ -155,12 +188,19 @@ def handle_health(handler, path: str) -> bool:
 
 
 def shed(handler, retry_after: float = 1.0,
-         reason: str = "overloaded") -> None:
+         reason: str = "overloaded",
+         request_id: Optional[str] = None) -> None:
     """Reply 503 with a ``Retry-After`` header — the load-shedding
-    answer a bounded queue gives instead of growing. Counted."""
+    answer a bounded queue gives instead of growing. Counted. A
+    ``request_id`` (the ticket's, or the router-supplied one) rides
+    the body so a fleet router can correlate the shed with the
+    attempt it belongs to — success bodies already carry the id via
+    ``Ticket.succeed``."""
     inc("veles_shed_requests_total")
-    data = json.dumps({"error": reason,
-                       "retry_after": retry_after}).encode()
+    body = {"error": reason, "retry_after": retry_after}
+    if request_id is not None:
+        body["request_id"] = request_id
+    data = json.dumps(body).encode()
     handler.send_response(503)
     handler.send_header("Retry-After",
                         str(max(1, int(math.ceil(retry_after)))))

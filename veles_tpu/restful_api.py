@@ -103,14 +103,18 @@ class RESTfulAPI(Unit):
                 except FaultInjected as e:
                     # an injected serving fault DEGRADES (shed +
                     # Retry-After, counted), never crashes the handler
-                    health.shed(self, retry_after=1.0, reason=str(e))
+                    from .serving.scheduler import new_request_id
+                    health.shed(self, retry_after=1.0, reason=str(e),
+                                request_id=new_request_id())
                     return
                 with api._pending_lock:
                     if api._pending >= api.max_pending:
+                        from .serving.scheduler import new_request_id
                         health.shed(
                             self, retry_after=1.0,
                             reason="%d requests in flight (bound %d)"
-                            % (api._pending, api.max_pending))
+                            % (api._pending, api.max_pending),
+                            request_id=new_request_id())
                         return
                     api._pending += 1
                 try:
@@ -145,12 +149,15 @@ class RESTfulAPI(Unit):
                     self._reply(503, {"error": str(e)})
                     return
                 if not ticket.event.wait(api.request_timeout):
-                    self._reply(504, {"error": "inference timed out"})
+                    self._reply(504, {"error": "inference timed out",
+                                      "request_id": ticket.request_id})
                     return
                 if ticket.error is not None:
-                    self._reply(500, {"error": ticket.error})
+                    self._reply(500, {"error": ticket.error,
+                                      "request_id": ticket.request_id})
                     return
-                self._reply(200, {"result": ticket.result})
+                self._reply(200, {"result": ticket.result,
+                                  "request_id": ticket.request_id})
 
             def _reply(self, code: int, payload: Dict[str, Any]):
                 json_reply(self, code, payload)
@@ -306,10 +313,21 @@ class GenerationAPI(Unit):
         self.artifact = artifact
         self._engine = None
         self._service: Optional[HTTPService] = None
+        #: serializes initialize()/stop(): a supervisor respawning a
+        #: replica whose injected death is still tearing down must
+        #: wait for the teardown, not interleave with it (the old
+        #: stop() would otherwise kill the freshly built engine)
+        self._lifecycle = threading.RLock()
         self._queue: list = []
         self._cv = threading.Condition()
         self._worker: Optional[threading.Thread] = None
         self._closing = False
+        #: graceful drain: admission stopped, in-flight finishing —
+        #: /readyz reports "draining" while /healthz stays green
+        self._draining = False
+        #: requests currently inside do_POST past admission (what a
+        #: drain waits on before tearing the service down)
+        self._inflight = 0
         self._uniq = 0
         self.requests_served = 0
         self.batches_run = 0
@@ -362,9 +380,19 @@ class GenerationAPI(Unit):
             # bool IS an int in python — JSON true/false must not pass
             # as token ids 1/0
             raise ValueError("'eos_id' must be an int token id")
+        # a fleet router retrying a request on another replica sends
+        # ITS id along — the ticket adopts it so every response body
+        # (success, shed, expiry) correlates with the router's attempt
+        request_id = body.get("request_id")
+        if request_id is not None and (
+                not isinstance(request_id, str)
+                or not 1 <= len(request_id) <= 200):
+            raise ValueError("'request_id' must be a non-empty string "
+                             "of at most 200 chars")
         req = {"prompt": [int(t) for t in prompt], "n_new": n_new,
                "mode": mode, "temperature": temperature, "seed": seed,
-               "gamma": gamma, "beam": beam, "eos_id": eos_id}
+               "gamma": gamma, "beam": beam, "eos_id": eos_id,
+               "request_id": request_id}
         if req["gamma"] < 1:
             raise ValueError("'gamma' must be >= 1")
         if req["beam"] < 1:
@@ -519,6 +547,10 @@ class GenerationAPI(Unit):
 
     # -- lifecycle -----------------------------------------------------------
     def initialize(self, **kwargs):
+        with self._lifecycle:
+            return self._initialize_locked(**kwargs)
+
+    def _initialize_locked(self, **kwargs):
         res = super().initialize(**kwargs)
         if res:
             return res
@@ -651,6 +683,20 @@ class GenerationAPI(Unit):
                 json_reply(self, 200, stats)
 
             def do_POST(self):
+                if self.path == api.path + "/drain":
+                    # admin face of the SIGTERM drain: flip /readyz
+                    # to draining, stop admission, reply immediately —
+                    # the drain itself (finish in-flight, tear down)
+                    # runs on its own thread so this handler answers
+                    started = api.begin_drain()
+                    threading.Thread(target=api.drain, daemon=True,
+                                     name=api.name + ".drain").start()
+                    json_reply(self, 200, {
+                        "status": "draining",
+                        "already_draining": not started,
+                        "in_flight": api._inflight,
+                        "queue_depth": len(api._queue)})
+                    return
                 if self.path != api.path:
                     self.send_error(404)
                     return
@@ -658,8 +704,12 @@ class GenerationAPI(Unit):
                     fire_fault("serve.request")
                 except FaultInjected as e:
                     # injected serving faults DEGRADE (shed + Retry-
-                    # After, counted), never escape as a traceback
-                    health.shed(self, retry_after=1.0, reason=str(e))
+                    # After, counted), never escape as a traceback.
+                    # No ticket exists yet — mint an id so even this
+                    # shed is correlatable by a router retry
+                    from .serving.scheduler import new_request_id
+                    health.shed(self, retry_after=1.0, reason=str(e),
+                                request_id=new_request_id())
                     return
                 try:
                     req = api._parse(read_json_object(self))
@@ -669,10 +719,17 @@ class GenerationAPI(Unit):
                     return
                 # API admission assigns the request's id (threaded
                 # through lifecycle spans, flight events and the
-                # response body by the Ticket itself)
+                # response body by the Ticket itself) — unless a fleet
+                # router already assigned one upstream
                 ticket = _Ticket(
                     deadline=time.time() + api.request_timeout,
+                    request_id=req.get("request_id"),
                     mode=req.get("mode", "greedy"))
+                if api._draining:
+                    health.shed(self, retry_after=5.0,
+                                reason="server draining",
+                                request_id=ticket.request_id)
+                    return
                 engine = api._engine
                 # every decode mode rides the slot pool when the
                 # engine can hold it — speculative needs the pooled
@@ -687,7 +744,8 @@ class GenerationAPI(Unit):
                     # queue sheds exactly like the window plane
                     if api._closing:
                         health.shed(self, retry_after=5.0,
-                                    reason="server shutting down")
+                                    reason="server shutting down",
+                                    request_id=ticket.request_id)
                         return
                     if not engine.submit(req, ticket,
                                          max_queue=api.max_queue,
@@ -697,34 +755,66 @@ class GenerationAPI(Unit):
                         # answer must match the api._closing path above
                         if engine.closing:
                             health.shed(self, retry_after=5.0,
-                                        reason="server shutting down")
+                                        reason="server shutting down",
+                                        request_id=ticket.request_id)
                         else:
                             health.shed(
                                 self, retry_after=1.0,
                                 reason="generation queue full (%d/%d)"
                                 % (engine.scheduler.queue_depth(),
-                                   api.max_queue))
+                                   api.max_queue),
+                                request_id=ticket.request_id)
                         return
                 else:
                     with api._cv:
                         if api._closing:
                             health.shed(self, retry_after=5.0,
-                                        reason="server shutting down")
+                                        reason="server shutting down",
+                                        request_id=ticket.request_id)
                             return
                         if len(api._queue) >= api.max_queue:
                             health.shed(
                                 self, retry_after=1.0,
                                 reason="generation queue full (%d/%d)"
-                                % (len(api._queue), api.max_queue))
+                                % (len(api._queue), api.max_queue),
+                                request_id=ticket.request_id)
                             return
                         api._queue.append((req, ticket))
                         api._cv.notify()
+                with api._cv:
+                    api._inflight += 1
+                try:
+                    self._await_and_reply(ticket, via_engine)
+                finally:
+                    with api._cv:
+                        api._inflight -= 1
+                        api._cv.notify_all()
+
+            def _await_and_reply(self, ticket, via_engine):
+                try:
+                    # the replica-death chaos point: the request IS
+                    # in flight (admitted to a plane above) when the
+                    # fault fires — raise tears this replica's HTTP
+                    # front down mid-decode and drops the connection
+                    # without a reply, exactly what a crashed replica
+                    # looks like to the router; crash exits the
+                    # process with the slave-death code
+                    fire_fault("serve.replica_death")
+                except FaultInjected:
+                    api.warning("%s: injected replica death — tearing "
+                                "down the serving front mid-request",
+                                api.name)
+                    threading.Thread(target=api.stop, daemon=True,
+                                     name=api.name + ".death").start()
+                    self.close_connection = True
+                    return      # no reply: the client sees a dead peer
                 # slack past the deadline: the queue-side expiry
                 # (503 + Retry-After, counted) should win the race
                 # against this handler's own last-resort 504
                 if not ticket.event.wait(api.request_timeout + 1.0):
                     json_reply(self, 504,
-                               {"error": "generation timed out"})
+                               {"error": "generation timed out",
+                                "request_id": ticket.request_id})
                     return
                 if via_engine and not (ticket.error is not None
                                        and ticket.code == 503):
@@ -746,12 +836,14 @@ class GenerationAPI(Unit):
                         headers = {"Retry-After": str(max(1, int(
                             _math.ceil(retry_after))))}
                     json_reply(self, ticket.code,
-                               {"error": ticket.error},
+                               ticket.error_payload(),
                                headers=headers)
                     return
                 json_reply(self, 200, ticket.result)
 
         self._closing = False
+        self._draining = False
+        self._inflight = 0
         self._worker = threading.Thread(target=self._worker_loop,
                                         daemon=True,
                                         name=self.name + ".genworker")
@@ -771,19 +863,62 @@ class GenerationAPI(Unit):
     def run(self) -> None:
         """Standalone service: nothing to do per graph pass."""
 
-    def stop(self) -> None:
-        if self._service is not None:
-            self._service.stop_serving()
-            self._service = None
+    # -- graceful drain ------------------------------------------------------
+    def begin_drain(self) -> bool:
+        """Stop admission and flip ``/readyz`` to draining (the load
+        balancer's cue to spill elsewhere) while in-flight tickets
+        keep decoding; ``/healthz`` stays green throughout. True when
+        this call started the drain, False when one was already under
+        way. The actual wait + teardown is :meth:`drain`."""
         with self._cv:
-            self._closing = True
-            self._cv.notify_all()
-        if self._worker is not None:
-            self._worker.join(timeout=5)
-            self._worker = None
-        if self._engine is not None:
-            self._engine.stop()
-            self._engine = None
-        # after the worker is down — its beats must not re-register a
-        # heartbeat that would age out on a long-lived process
-        health.forget("serve.%s" % self.name)
+            if self._draining:
+                return False
+            self._draining = True
+        health.mark_draining("serve.%s" % self.name)
+        self.info("%s: draining — admission stopped, %d in flight",
+                  self.name, self._inflight)
+        return True
+
+    def drain(self, grace: Optional[float] = None) -> bool:
+        """SIGTERM-grade graceful shutdown: :meth:`begin_drain`, wait
+        (up to ``grace`` seconds, default
+        ``root.common.serving.drain_grace`` = 30) for every in-flight
+        request to be answered, then :meth:`stop`. True when the
+        drain emptied in time; False means the grace expired and the
+        remaining tickets were aborted by ``stop()`` (503, counted) —
+        either way the process is safe to exit afterwards."""
+        self.begin_drain()
+        if grace is None:
+            # no falsy-zero rewrite: drain_grace = 0 legitimately
+            # means "abort stragglers immediately"
+            grace = float(root.common.serving.get("drain_grace", 30.0))
+        deadline = time.time() + grace
+        with self._cv:
+            while self._inflight and time.time() < deadline:
+                self._cv.wait(timeout=min(
+                    0.2, max(0.01, deadline - time.time())))
+            drained = self._inflight == 0
+        self.info("%s: drain %s (%d still in flight)", self.name,
+                  "complete" if drained else "grace expired",
+                  self._inflight)
+        self.stop()
+        return drained
+
+    def stop(self) -> None:
+        with self._lifecycle:
+            if self._service is not None:
+                self._service.stop_serving()
+                self._service = None
+            with self._cv:
+                self._closing = True
+                self._cv.notify_all()
+            if self._worker is not None:
+                self._worker.join(timeout=5)
+                self._worker = None
+            if self._engine is not None:
+                self._engine.stop()
+                self._engine = None
+            # after the worker is down — its beats must not
+            # re-register a heartbeat that would age out on a
+            # long-lived process
+            health.forget("serve.%s" % self.name)

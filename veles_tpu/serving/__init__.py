@@ -39,6 +39,8 @@ from .scheduler import (SlotScheduler, Ticket,        # noqa: F401
                         new_request_id,
                         request_tracing_enabled)
 from .engine import ContinuousEngine                  # noqa: F401
+from .router import (CircuitBreaker, FleetRouter,     # noqa: F401
+                     ROUTER_COUNTERS, Replica, ReplicaSupervisor)
 
 #: every counter the serving plane increments — registered with HELP
 #: strings in telemetry/counters.py DESCRIPTIONS and asserted zero in

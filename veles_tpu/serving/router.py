@@ -1,0 +1,921 @@
+"""Fault-tolerant serving fleet: the replica router.
+
+ROADMAP item 1's topology made buildable: N engine replicas (each a
+``GenerationAPI`` front over its own ``ContinuousEngine``) behind ONE
+HTTP router that keeps the fleet answering while individual replicas
+die, drain, or saturate. The reference platform's headline capability
+was surviving scale-out — ~100 nodes under a master that tolerated
+slave death (manualrst_veles_distributed_training.rst:6); this module
+is that story for the serving side, assembled from parts that already
+exist:
+
+- **health-gated admission** — a background probe scrapes every
+  replica's ``/readyz`` and ``/metrics`` (reusing
+  :mod:`~veles_tpu.telemetry.fleet` parsing) and ranks replicas by
+  slot occupancy, so the router spills load away from saturated
+  replicas and never routes to a not-ready (or draining) one;
+- **per-replica circuit breakers** — consecutive attempt failures
+  open the breaker for a backoff interval computed by
+  :class:`~veles_tpu.resilience.retry.RetryPolicy`'s seeded-jitter
+  curve (fleet-wide probe herds decorrelate, seeded runs reproduce);
+  after the interval ONE half-open probe request is allowed through —
+  success closes the breaker, failure re-opens it for longer;
+- **idempotent failover** — every routed request carries a
+  process-unique ``request_id`` (minted here, adopted by the
+  replica's Ticket, echoed in every response body — success, shed
+  and expiry alike); an attempt that dies mid-decode (replica crash,
+  timeout, 5xx) is retried on another replica under a bounded retry
+  budget, and a first-terminal answer latch guarantees EXACTLY-ONCE
+  response accounting: a slow-then-successful first attempt can
+  never double-answer — the late result is dropped and counted
+  (``veles_router_duplicate_answers_total``);
+- **graceful drain** — SIGTERM (wired by the ``veles-tpu route``
+  CLI) and the ``POST /drain`` admin endpoint flip ``/readyz`` to
+  draining, stop admission (503 + Retry-After), finish in-flight
+  requests, then exit — same contract the engine API honors;
+- **supervised respawn** — :class:`ReplicaSupervisor` generalizes
+  the PR 9 elastic ``Supervisor`` spawn/classify/respawn plane from
+  training generations to long-lived serving replicas: training
+  reaps the whole generation when one host dies (survivors are
+  wedged in collectives), a serving fleet respawns ONLY the hole —
+  with seeded backoff — while the router routes around it (AOT
+  serve-artifacts make the respawned replica's cold start cheap).
+
+Retryability policy: connection-level failures (refused, reset,
+timeout, torn response) and every HTTP 5xx fail over; 2xx–4xx are
+the replica's answer and are delivered as-is (a 400 is the client's
+problem on every replica — retrying it is a retry storm, not
+resilience).
+
+Chaos surface: ``router.replica_request`` fires before every proxied
+attempt (raise = the attempt fails like a dead replica);
+``serve.replica_death`` (fired replica-side in the GenerationAPI
+request path) makes a live replica ACTUALLY tear its HTTP front down
+mid-decode. CLI: ``veles-tpu route URL [URL ...]``; operator guide:
+docs/services.md "Serving fleet".
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .._http import (HTTPService, bytes_reply, json_reply,
+                     read_json_object)
+from ..config import root
+from ..error import VelesError
+from ..logger import Logger
+from ..resilience import health
+from ..resilience.faults import FaultInjected, fire as fire_fault
+from ..resilience.retry import RetryPolicy
+from ..telemetry import fleet
+from ..telemetry.counters import (METRICS_CONTENT_TYPE, inc,
+                                  metrics_text)
+from .scheduler import new_request_id
+
+#: every counter the fleet router increments — registered with HELP
+#: strings in telemetry/counters.py DESCRIPTIONS and asserted zero in
+#: non-fleet runs by ``python bench.py gate``'s fleet section
+ROUTER_COUNTERS = (
+    "veles_router_requests_total",
+    "veles_router_attempts_total",
+    "veles_router_failovers_total",
+    "veles_router_replica_errors_total",
+    "veles_router_breaker_opens_total",
+    "veles_router_duplicate_answers_total",
+    "veles_router_respawns_total",
+)
+
+
+def normalize_endpoint(url: str) -> str:
+    """Roster entry → replica base URL: bare ``host:port`` gets
+    ``http://``, trailing slashes and a trailing ``/metrics`` (the
+    scrape-roster spelling) are dropped — so the router and
+    ``veles-tpu metrics aggregate`` accept the same endpoint list."""
+    url = str(url).strip()
+    if "://" not in url:
+        url = "http://" + url
+    url = url.rstrip("/")
+    if url.endswith("/metrics"):
+        url = url[:-len("/metrics")]
+    return url
+
+
+def router_config() -> Dict[str, Any]:
+    """The router knob block ``root.common.router.*`` (CLI flags of
+    ``veles-tpu route`` override per invocation)."""
+    node = root.common.router
+    return {
+        "probe_interval": float(node.get("probe_interval", 1.0) or 1.0),
+        "probe_timeout": float(node.get("probe_timeout", 2.0) or 2.0),
+        "failure_threshold": int(node.get("failure_threshold", 3) or 3),
+        "retry_budget": int(node.get("retry_budget", 2)),
+        "attempt_timeout": float(node.get("attempt_timeout", 10.0)
+                                 or 10.0),
+        "request_timeout": float(node.get("request_timeout", 120.0)
+                                 or 120.0),
+        # no falsy-zero rewrite here: drain_grace = 0 legitimately
+        # means "abort stragglers immediately"
+        "drain_grace": float(node.get("drain_grace", 30.0)),
+    }
+
+
+class CircuitBreaker:
+    """Per-replica failure gate: ``failure_threshold`` consecutive
+    failures open it; while open, :meth:`allow` refuses for a backoff
+    interval riding :meth:`RetryPolicy.backoff`'s seeded-jitter curve
+    (the interval grows with every re-open); after the interval ONE
+    half-open probe is admitted — success closes the breaker and
+    resets the curve, failure re-opens it for longer. Thread-safe;
+    ``clock`` is injectable for deterministic tests."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, failure_threshold: int = 3,
+                 backoff: Optional[RetryPolicy] = None,
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.backoff = backoff if backoff is not None else RetryPolicy(
+            base_delay=0.5, max_delay=30.0, name="breaker")
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.state = self.CLOSED
+        self.failures = 0          # consecutive, resets on success
+        self.trips = 0             # times opened — drives the curve
+        self.open_until = 0.0
+        self._probing = False      # half-open: one probe in flight
+
+    def allow(self) -> bool:
+        """May a request be routed here right now? Claims the single
+        half-open probe slot when it grants one — the caller MUST
+        follow through with an attempt (and settle it), or the slot
+        stays claimed until the next open interval."""
+        with self._lock:
+            if self.state == self.CLOSED:
+                return True
+            if self.state == self.OPEN:
+                if self._clock() < self.open_until:
+                    return False
+                self.state = self.HALF_OPEN
+                self._probing = False
+            if self._probing:
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self.state = self.CLOSED
+            self.failures = 0
+            self.trips = 0
+            self._probing = False
+
+    def record_failure(self) -> bool:
+        """Account one failed attempt; True when THIS failure opened
+        (or re-opened) the breaker — the caller counts the
+        transition."""
+        with self._lock:
+            self.failures += 1
+            if self.state == self.HALF_OPEN or (
+                    self.state == self.CLOSED
+                    and self.failures >= self.failure_threshold):
+                self.state = self.OPEN
+                self.trips += 1
+                # the attempt index is capped so the delay saturates
+                # at max_delay instead of 2**trips overflowing
+                self.open_until = self._clock() + self.backoff.backoff(
+                    min(self.trips, 16))
+                self._probing = False
+                return True
+            if self.state == self.OPEN:
+                self._probing = False
+            return False
+
+
+class Replica:
+    """One roster entry: the endpoint, its breaker, and the latest
+    probe snapshot (readiness + occupancy) the admission ranking
+    reads. Probe fields are written by the router's probe thread and
+    read by handler threads — single-attribute writes, no torn
+    state worth a lock."""
+
+    def __init__(self, url: str,
+                 breaker: Optional[CircuitBreaker] = None) -> None:
+        self.url = normalize_endpoint(url)
+        self.breaker = breaker if breaker is not None \
+            else CircuitBreaker()
+        self.up = False
+        self.ready = False
+        self.draining = False
+        self.slots = 0
+        self.slots_busy = 0
+        self.queue_depth = 0
+        self.probe_error: Optional[str] = None
+        self.last_probe = 0.0
+
+    def occupancy(self) -> float:
+        """Busy fraction of the replica's slot pool (0 when unknown)
+        — the spill ranking's primary key."""
+        return self.slots_busy / self.slots if self.slots else 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "url": self.url, "up": self.up, "ready": self.ready,
+            "draining": self.draining, "slots": self.slots,
+            "slots_busy": self.slots_busy,
+            "queue_depth": self.queue_depth,
+            "occupancy": round(self.occupancy(), 4),
+            "breaker": self.breaker.state,
+            "probe_error": self.probe_error,
+        }
+
+
+class _Answer:
+    """First-terminal answer latch for one routed request — the
+    router-side twin of ``Ticket``'s exactly-once transition: however
+    many attempts eventually complete, exactly one :meth:`offer`
+    wins; every loser is reported False (the caller counts it as a
+    dropped duplicate). The embedded condition doubles as the
+    routing loop's wakeup for attempt settles."""
+
+    def __init__(self) -> None:
+        self.cv = threading.Condition()
+        self.done = False
+        self.status: Optional[int] = None
+        self.body: Optional[Dict] = None
+        self.retry_after: Optional[str] = None
+        self.replica: Optional[Replica] = None
+        self.request_id: Optional[str] = None
+        #: why routing gave up, when ``done`` stays False
+        self.reason: Optional[str] = None
+
+    def offer(self, status: int, body: Dict,
+              retry_after: Optional[str] = None,
+              replica: Optional[Replica] = None) -> bool:
+        with self.cv:
+            first = not self.done
+            if first:
+                self.done = True
+                self.status = int(status)
+                self.body = body
+                self.retry_after = retry_after
+                self.replica = replica
+            self.cv.notify_all()
+            return first
+
+
+class _Attempt:
+    """One proxied attempt's settle state. Breaker/counter accounting
+    happens exactly once per attempt, on the FIRST settle — whether
+    that is the attempt thread reporting its outcome or the routing
+    loop declaring an attempt timeout and moving on (the thread may
+    still land a late answer through the latch afterwards)."""
+
+    def __init__(self, replica: Replica, answered: _Answer) -> None:
+        self.replica = replica
+        self._answered = answered
+        self._lock = threading.Lock()
+        self.settled = False
+        self.failed = False
+        self.reason: Optional[str] = None
+
+    def _settle(self, failed: bool, reason: Optional[str]) -> bool:
+        with self._lock:
+            if self.settled:
+                return False
+            self.settled = True
+            self.failed = failed
+            self.reason = reason
+        if failed:
+            inc("veles_router_replica_errors_total")
+            if self.replica.breaker.record_failure():
+                inc("veles_router_breaker_opens_total")
+        else:
+            self.replica.breaker.record_success()
+        with self._answered.cv:
+            self._answered.cv.notify_all()
+        return True
+
+    def fail(self, reason: str) -> bool:
+        return self._settle(True, reason)
+
+    def succeed(self) -> bool:
+        return self._settle(False, None)
+
+
+class FleetRouter(Logger):
+    """HTTP front fanning a GenerationAPI-compatible surface out over
+    N replica endpoints (module doc has the full story). Surfaces on
+    the router port:
+
+    - ``POST <path>`` (default ``/generate``) — route with failover;
+    - ``GET /healthz`` / ``/readyz`` — the router's own health plane
+      (``/readyz`` flips to draining during a drain);
+    - ``GET /metrics`` — the router's counters + fleet gauges;
+    - ``GET /fleet/metrics`` — live fleet-wide aggregation over the
+      roster (telemetry/fleet.py merge, quantiles recomputed);
+    - ``GET /roster`` — the replica roster as JSON (readiness,
+      occupancy, breaker state); saved to a file it feeds
+      ``veles-tpu metrics aggregate --endpoints-file`` directly;
+    - ``POST /drain`` — graceful drain (also wired to SIGTERM by the
+      CLI).
+    """
+
+    def __init__(self, endpoints: Sequence[str], port: int = 0,
+                 path: str = "/generate",
+                 probe_interval: Optional[float] = None,
+                 probe_timeout: Optional[float] = None,
+                 failure_threshold: Optional[int] = None,
+                 retry_budget: Optional[int] = None,
+                 attempt_timeout: Optional[float] = None,
+                 request_timeout: Optional[float] = None,
+                 name: str = "router") -> None:
+        super().__init__()
+        cfg = router_config()
+        urls = [normalize_endpoint(u) for u in endpoints]
+        if not urls:
+            raise VelesError("a fleet router needs at least one "
+                             "replica endpoint")
+        if len(set(urls)) != len(urls):
+            raise VelesError("duplicate replica endpoints: %s" % urls)
+        self.name = name
+        self.path = path
+        self.port = int(port)
+        self.probe_interval = float(
+            cfg["probe_interval"] if probe_interval is None
+            else probe_interval)
+        self.probe_timeout = float(
+            cfg["probe_timeout"] if probe_timeout is None
+            else probe_timeout)
+        self.retry_budget = max(0, int(
+            cfg["retry_budget"] if retry_budget is None
+            else retry_budget))
+        self.attempt_timeout = float(
+            cfg["attempt_timeout"] if attempt_timeout is None
+            else attempt_timeout)
+        self.request_timeout = float(
+            cfg["request_timeout"] if request_timeout is None
+            else request_timeout)
+        threshold = int(cfg["failure_threshold"]
+                        if failure_threshold is None
+                        else failure_threshold)
+        self.replicas = [
+            Replica(u, CircuitBreaker(failure_threshold=threshold))
+            for u in urls]
+        self._service: Optional[HTTPService] = None
+        self._probe_thread: Optional[threading.Thread] = None
+        self._closing = False
+        self._draining = False
+        self._inflight = 0
+        self._cv = threading.Condition()
+        self._wake = threading.Event()
+        self.requests_routed = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "FleetRouter":
+        if self._service is not None:
+            return self
+        self._closing = False
+        self._draining = False
+        self.probe_all()               # admission state before traffic
+        self._wake.clear()
+        self._probe_thread = threading.Thread(
+            target=self._probe_loop, daemon=True,
+            name=self.name + ".probe")
+        self._probe_thread.start()
+        self._service = HTTPService(self._make_handler(), self.port,
+                                    self.name + ".http")
+        self.port = self._service.port
+        self._service.start_serving()
+        health.mark_ready("router.%s" % self.name)
+        health.heartbeats.beat("router.%s" % self.name)
+        self.info("%s: routing %s on http://127.0.0.1:%d%s "
+                  "(retry budget %d, breaker threshold %d)", self.name,
+                  [r.url for r in self.replicas], self.port, self.path,
+                  self.retry_budget,
+                  self.replicas[0].breaker.failure_threshold)
+        return self
+
+    def stop(self) -> None:
+        self._closing = True
+        self._wake.set()
+        if self._probe_thread is not None:
+            self._probe_thread.join(timeout=5)
+            self._probe_thread = None
+        if self._service is not None:
+            self._service.stop_serving()
+            self._service = None
+        health.forget("router.%s" % self.name)
+
+    # -- graceful drain ------------------------------------------------------
+    def begin_drain(self) -> bool:
+        """Stop admission and flip the router's ``/readyz`` to
+        draining; in-flight requests keep being served. True when
+        this call started the drain."""
+        with self._cv:
+            if self._draining:
+                return False
+            self._draining = True
+        health.mark_draining("router.%s" % self.name)
+        self.info("%s: draining — admission stopped, %d in flight",
+                  self.name, self._inflight)
+        return True
+
+    def drain(self, grace: Optional[float] = None) -> bool:
+        """SIGTERM-grade shutdown: :meth:`begin_drain`, wait up to
+        ``grace`` seconds (default ``root.common.router.drain_grace``
+        = 30) for in-flight requests, then :meth:`stop`. True when
+        the drain emptied in time."""
+        self.begin_drain()
+        if grace is None:
+            grace = router_config()["drain_grace"]
+        deadline = time.time() + grace
+        with self._cv:
+            while self._inflight and time.time() < deadline:
+                self._cv.wait(timeout=min(
+                    0.2, max(0.01, deadline - time.time())))
+            drained = self._inflight == 0
+        self.info("%s: drain %s", self.name,
+                  "complete" if drained else "grace expired")
+        self.stop()
+        return drained
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    # -- health-gated admission ----------------------------------------------
+    def _probe_loop(self) -> None:
+        while not self._closing:
+            if self._wake.wait(timeout=self.probe_interval):
+                return
+            self.probe_all()
+
+    def probe_all(self) -> None:
+        """One probe sweep: every replica's ``/readyz`` (admission
+        gate) + ``/metrics`` (occupancy ranking, parsed by the fleet
+        module), probed CONCURRENTLY so the sweep is bounded by the
+        slowest single replica, not the sum — a hung replica must
+        not stretch everyone else's staleness past
+        ``probe_interval``. Also the router's own liveness beat."""
+        threads = [threading.Thread(target=self._probe, args=(r,),
+                                    daemon=True,
+                                    name=self.name + ".probe1")
+                   for r in self.replicas]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        health.heartbeats.beat("router.%s" % self.name)
+
+    def _probe(self, replica: Replica) -> None:
+        replica.last_probe = time.time()
+        try:
+            req = urllib.request.Request(replica.url + "/readyz")
+            with urllib.request.urlopen(
+                    req, timeout=self.probe_timeout) as r:
+                code, payload = r.status, json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            # 503 IS a readiness answer (not ready / draining)
+            code = e.code
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except ValueError:
+                payload = {}
+        except Exception as e:  # noqa: BLE001 — a down replica is data
+            replica.up = False
+            replica.ready = False
+            replica.draining = False
+            replica.probe_error = "%s: %s" % (type(e).__name__, e)
+            return
+        replica.up = True
+        replica.ready = code == 200
+        replica.draining = payload.get("status") == "draining"
+        replica.probe_error = None
+        body, _err = fleet.scrape(replica.url,
+                                  timeout=self.probe_timeout)
+        if body is not None:
+            gauges = fleet.parse_metrics_text(body)["gauges"]
+            replica.slots = int(gauges.get("veles_serving_slots", 0))
+            replica.slots_busy = int(
+                gauges.get("veles_serving_slots_busy", 0))
+            replica.queue_depth = int(
+                gauges.get("veles_serving_queue_depth",
+                           gauges.get("veles_generate_queue_depth",
+                                      0)))
+
+    def pick(self, exclude: Sequence[Replica] = ()) -> Optional[Replica]:
+        """Least-occupied READY replica whose breaker admits a
+        request — never a not-ready/draining one, never one already
+        tried for this request. Breaker side effects make the order
+        matter: candidates are ranked first, then asked, and the
+        first to grant wins (a granted half-open probe slot is always
+        used)."""
+        ranked = sorted(
+            (r for r in self.replicas
+             if r not in exclude and r.ready),
+            key=lambda r: (r.occupancy(), r.queue_depth, r.url))
+        for replica in ranked:
+            if replica.breaker.allow():
+                return replica
+        return None
+
+    # -- routing -------------------------------------------------------------
+    def _attempt(self, replica: Replica, data: bytes, rid: str,
+                 answered: _Answer, state: _Attempt,
+                 timeout: float) -> None:
+        try:
+            fire_fault("router.replica_request")
+        except FaultInjected as e:
+            state.fail("injected replica failure: %s" % e)
+            return
+        try:
+            req = urllib.request.Request(
+                replica.url + self.path, data=data,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                status = r.status
+                body = json.loads(r.read() or b"{}")
+                retry_after = r.headers.get("Retry-After")
+        except urllib.error.HTTPError as e:
+            status = e.code
+            try:
+                body = json.loads(e.read() or b"{}")
+            except ValueError:
+                body = {"error": "replica answered %d" % e.code}
+            retry_after = e.headers.get("Retry-After")
+        except Exception as e:      # noqa: BLE001 — the failure class
+            # connection refused/reset, timeout, torn response: the
+            # replica is (acting) dead — fail over
+            state.fail("%s: %s" % (type(e).__name__, e))
+            return
+        if status >= 500:
+            state.fail("replica %s answered %d (%s)"
+                       % (replica.url, status,
+                          (body or {}).get("error", "")))
+            return
+        # 2xx–4xx: the replica's answer, deliver as-is (first wins).
+        # Offer BEFORE settling: settle notifies the routing loop,
+        # and a loop that wakes to a settled-but-unanswered attempt
+        # would dispatch a spurious extra attempt
+        first = answered.offer(status, body, retry_after=retry_after,
+                               replica=replica)
+        state.succeed()
+        if not first:
+            inc("veles_router_duplicate_answers_total")
+            self.warning("%s: duplicate answer for %s from %s "
+                         "dropped (an earlier attempt already "
+                         "answered)", self.name, rid, replica.url)
+
+    def route(self, body: Dict) -> _Answer:
+        """Route one parsed request body with health-gated selection,
+        breaker-aware failover and the exactly-once answer latch.
+        Returns the latch — ``done`` False means no replica could
+        answer inside the budget (the HTTP face sheds 503)."""
+        rid = body.get("request_id") or new_request_id()
+        body = dict(body, request_id=rid)
+        data = json.dumps(body).encode()
+        inc("veles_router_requests_total")
+        answered = _Answer()
+        answered.request_id = rid
+        deadline = time.time() + self.request_timeout
+        tried: List[Replica] = []
+        last_reason = "no ready replica"
+        while len(tried) <= self.retry_budget:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                last_reason = ("request budget %.0fs exhausted"
+                               % self.request_timeout)
+                break
+            replica = self.pick(exclude=tried)
+            if replica is None:
+                break
+            if tried:
+                inc("veles_router_failovers_total")
+                self.info("%s: failing %s over to %s (%s)", self.name,
+                          rid, replica.url, last_reason)
+            tried.append(replica)
+            inc("veles_router_attempts_total")
+            state = _Attempt(replica, answered)
+            threading.Thread(
+                target=self._attempt,
+                args=(replica, data, rid, answered, state,
+                      max(0.1, remaining)),
+                daemon=True,
+                name="%s.attempt" % self.name).start()
+            # wait for THIS attempt to settle, anyone to answer, or
+            # the per-attempt patience to run out (the thread keeps
+            # running — a late success still wins the latch first-
+            # come; the loop just stops waiting for it)
+            wait_until = min(deadline,
+                             time.time() + self.attempt_timeout)
+            with answered.cv:
+                while (not answered.done and not state.settled
+                        and time.time() < wait_until):
+                    answered.cv.wait(timeout=min(
+                        0.05, max(0.005, wait_until - time.time())))
+            if answered.done:
+                break
+            if state.settled and state.failed:
+                last_reason = state.reason or "replica failure"
+                continue
+            if not state.settled:
+                if state.fail("attempt timed out after %.1fs on %s"
+                              % (self.attempt_timeout, replica.url)):
+                    last_reason = state.reason or "attempt timeout"
+                continue
+        if not answered.done:
+            answered.reason = last_reason
+        return answered
+
+    # -- surfaces ------------------------------------------------------------
+    def gauges(self) -> Dict[str, Any]:
+        ready = sum(1 for r in self.replicas if r.ready)
+        open_breakers = sum(1 for r in self.replicas
+                            if r.breaker.state != CircuitBreaker.CLOSED)
+        return {
+            "veles_router_replicas":
+                (len(self.replicas), "Replica endpoints this router "
+                                     "fans out over"),
+            "veles_router_replicas_ready":
+                (ready, "Replicas currently admitting (ready, per "
+                        "the last /readyz probe)"),
+            "veles_router_breakers_open":
+                (open_breakers, "Replicas whose circuit breaker is "
+                                "open or half-open"),
+            "veles_router_inflight":
+                (self._inflight, "Requests currently being routed"),
+            "veles_router_draining":
+                (1 if self._draining else 0,
+                 "1 while the router is draining (admission "
+                 "stopped, in-flight finishing)"),
+        }
+
+    def roster(self) -> Dict[str, Any]:
+        """The live replica roster — saved to a file this is directly
+        consumable by ``veles-tpu metrics aggregate
+        --endpoints-file`` (fleet scraping and routing share one
+        roster)."""
+        return {
+            "router": self.name,
+            "path": self.path,
+            "draining": self._draining,
+            "endpoints": [r.snapshot() for r in self.replicas],
+        }
+
+    def _make_handler(self):
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):
+                router.debug("http: " + fmt, *args)
+
+            def do_GET(self):
+                if health.handle_health(self, self.path):
+                    return
+                if self.path == "/metrics":
+                    bytes_reply(self, 200,
+                                metrics_text(router.gauges()).encode(),
+                                METRICS_CONTENT_TYPE)
+                    return
+                if self.path == "/fleet/metrics":
+                    # live fleet-wide aggregation over the roster —
+                    # counters/buckets summed, quantiles recomputed
+                    # (telemetry/fleet.py), scraped on demand
+                    agg = fleet.aggregate(
+                        [r.url for r in router.replicas],
+                        timeout=router.probe_timeout)
+                    bytes_reply(self, 200,
+                                fleet.render(agg).encode(),
+                                METRICS_CONTENT_TYPE)
+                    return
+                if self.path == "/roster":
+                    json_reply(self, 200, router.roster())
+                    return
+                self.send_error(404)
+
+            def do_POST(self):
+                if self.path == "/drain":
+                    started = router.begin_drain()
+                    threading.Thread(target=router.drain,
+                                     daemon=True,
+                                     name=router.name
+                                     + ".drain").start()
+                    json_reply(self, 200, {
+                        "status": "draining",
+                        "already_draining": not started,
+                        "in_flight": router._inflight})
+                    return
+                if self.path != router.path:
+                    self.send_error(404)
+                    return
+                if router._draining or router._closing:
+                    health.shed(self, retry_after=5.0,
+                                reason="router draining",
+                                request_id=new_request_id())
+                    return
+                try:
+                    body = read_json_object(self)
+                except ValueError as e:
+                    json_reply(self, 400,
+                               {"error": "bad request: %s" % e})
+                    return
+                with router._cv:
+                    router._inflight += 1
+                try:
+                    answered = router.route(body)
+                finally:
+                    with router._cv:
+                        router._inflight -= 1
+                        router.requests_routed += 1
+                        router._cv.notify_all()
+                if not answered.done:
+                    health.shed(
+                        self, retry_after=1.0,
+                        reason="no replica could answer: %s"
+                        % getattr(answered, "reason",
+                                  "no ready replica"),
+                        request_id=answered.request_id)
+                    return
+                headers = None
+                if answered.retry_after:
+                    headers = {"Retry-After": str(answered.retry_after)}
+                json_reply(self, answered.status, answered.body,
+                           headers=headers)
+
+        return Handler
+
+
+class ReplicaSupervisor(Logger):
+    """Spawn/classify/respawn plane for long-lived serving replicas —
+    the PR 9 elastic :class:`~veles_tpu.resilience.elastic.Supervisor`
+    generalized from training generations: training reaps the WHOLE
+    generation when one host dies (its survivors are wedged in
+    collectives), a serving fleet respawns ONLY the hole while the
+    router routes around it.
+
+    ``spawn(index, incarnation)`` builds replica ``index``'s process
+    (or in-process stand-in) and returns a handle exposing
+    ``poll() -> Optional[int]`` (None while alive, else the exit
+    code) and, optionally, ``kill()``. Exit classification:
+
+    - ``0`` — a deliberate, drained shutdown: the replica stays down
+      (scaling in is not a failure);
+    - anything else (``faults.CRASH_EXIT_CODE``, a signal, an OOM
+      kill) — a death: the replica is respawned after a
+      :meth:`RetryPolicy.backoff` delay (seeded jitter, growing with
+      consecutive deaths; a replica that comes back and dies again
+      immediately backs off harder), counted in
+      ``veles_router_respawns_total``, up to ``max_respawns`` —
+      after which the supervisor gives up on that index and the
+      router simply keeps routing around it.
+
+    ``clock`` is injectable; :meth:`check` performs one non-blocking
+    sweep so tests drive classification deterministically."""
+
+    def __init__(self, spawn: Callable[[int, int], Any],
+                 n_replicas: int, max_respawns: int = 8,
+                 poll_interval: float = 0.2,
+                 backoff: Optional[RetryPolicy] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 name: str = "fleet") -> None:
+        super().__init__()
+        if n_replicas < 1:
+            raise VelesError("a supervised fleet needs >= 1 replica")
+        self._spawn = spawn
+        self.n_replicas = int(n_replicas)
+        self.max_respawns = int(max_respawns)
+        self.poll_interval = float(poll_interval)
+        self.backoff = backoff if backoff is not None else RetryPolicy(
+            base_delay=0.1, max_delay=5.0, name="respawn")
+        self._clock = clock
+        self.name = name
+        self.handles: List[Any] = [None] * self.n_replicas
+        self.incarnations = [0] * self.n_replicas
+        #: deliberate exits (code 0) — never respawned
+        self.stopped = [False] * self.n_replicas
+        #: respawn budget exhausted — the router routes around it
+        self.given_up = [False] * self.n_replicas
+        #: index -> monotonic time its pending respawn fires
+        self._restart_at: Dict[int, float] = {}
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._closing = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ReplicaSupervisor":
+        with self._lock:
+            for i in range(self.n_replicas):
+                if self.handles[i] is None and not self.stopped[i] \
+                        and not self.given_up[i] \
+                        and i not in self._restart_at:
+                    self._spawn_one(i)
+        self._closing.clear()
+        self._thread = threading.Thread(target=self._watch,
+                                        daemon=True,
+                                        name=self.name + ".supervise")
+        self._thread.start()
+        return self
+
+    def stop(self, kill: bool = False) -> None:
+        self._closing.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if kill:
+            with self._lock:
+                for handle in self.handles:
+                    killer = getattr(handle, "kill", None)
+                    if handle is not None and callable(killer):
+                        try:
+                            killer()
+                        except OSError:
+                            pass
+
+    def _watch(self) -> None:
+        while not self._closing.wait(timeout=self.poll_interval):
+            self.check()
+
+    # -- classify + respawn --------------------------------------------------
+    def _spawn_one(self, i: int) -> None:
+        self.incarnations[i] += 1
+        self._restart_at.pop(i, None)
+        self.handles[i] = self._spawn(i, self.incarnations[i])
+
+    def check(self, now: Optional[float] = None) -> List[str]:
+        """One supervision sweep: classify exits, schedule + perform
+        respawns. Returns human-readable event strings (tests and the
+        CLI log them)."""
+        now = self._clock() if now is None else now
+        events: List[str] = []
+        with self._lock:
+            for i in range(self.n_replicas):
+                handle = self.handles[i]
+                if handle is None:
+                    due = self._restart_at.get(i)
+                    if due is not None and now >= due:
+                        try:
+                            self._spawn_one(i)
+                        except Exception as e:  # noqa: BLE001
+                            # the respawn itself failed (port still
+                            # held, artifact missing): back off and
+                            # try again — the watch thread survives,
+                            # and failed attempts still count toward
+                            # the give-up budget
+                            if self.incarnations[i] > self.max_respawns:
+                                self.given_up[i] = True
+                                events.append(
+                                    "replica %d respawn failed (%s) — "
+                                    "giving up" % (i, e))
+                            else:
+                                self._restart_at[i] = now \
+                                    + self.backoff.backoff(
+                                        min(self.incarnations[i], 16))
+                                events.append(
+                                    "replica %d respawn failed (%s) — "
+                                    "retrying" % (i, e))
+                            self.warning("%s: %s", self.name,
+                                         events[-1])
+                            continue
+                        inc("veles_router_respawns_total")
+                        events.append(
+                            "respawned replica %d (incarnation %d)"
+                            % (i, self.incarnations[i]))
+                        self.info("%s: %s", self.name, events[-1])
+                    continue
+                code = handle.poll()
+                if code is None:
+                    continue
+                self.handles[i] = None
+                if code == 0:
+                    self.stopped[i] = True
+                    events.append("replica %d exited cleanly "
+                                  "(drained)" % i)
+                    self.info("%s: %s", self.name, events[-1])
+                    continue
+                deaths = self.incarnations[i]
+                if deaths > self.max_respawns:
+                    self.given_up[i] = True
+                    events.append(
+                        "replica %d died (exit %s) after %d "
+                        "incarnations — giving up, the router "
+                        "routes around it" % (i, code, deaths))
+                    self.warning("%s: %s", self.name, events[-1])
+                    continue
+                delay = self.backoff.backoff(min(deaths, 16))
+                self._restart_at[i] = now + delay
+                events.append(
+                    "replica %d died (exit %s) — respawn in %.2fs"
+                    % (i, code, delay))
+                self.warning("%s: %s", self.name, events[-1])
+        return events
+
+    def alive(self) -> int:
+        with self._lock:
+            return sum(1 for h in self.handles
+                       if h is not None and h.poll() is None)

@@ -165,6 +165,19 @@ class Ticket:
             self.event.set()
         return True
 
+    def error_payload(self) -> Dict:
+        """THE failure response body both HTTP planes send for a
+        terminal-failed ticket: the error plus this request's id (and
+        the shed's ``retry_after`` hint when one was set), so a fleet
+        router retrying the request can correlate a shed/expiry with
+        the attempt it belongs to — success bodies already carry the
+        id via :meth:`succeed`."""
+        body: Dict = {"error": self.error,
+                      "request_id": self.request_id}
+        if self.retry_after is not None:
+            body["retry_after"] = self.retry_after
+        return body
+
     def _account(self, outcome: str) -> None:
         """Terminal SLO accounting — histograms always, span/flight
         emission under the tracing switch. Never raises: a broken

@@ -173,6 +173,28 @@ DESCRIPTIONS = {
         "Caller-supplied /metrics gauges dropped because their name "
         "shadowed an already-rendered counter/histogram series "
         "(duplicate names are invalid Prometheus exposition)",
+    # serving fleet router (serving/router.py): bench.py's gate
+    # asserts these read 0 in non-fleet runs
+    "veles_router_requests_total":
+        "Requests admitted by the fleet router's HTTP front",
+    "veles_router_attempts_total":
+        "Replica attempts the router proxied (first tries + "
+        "failover retries)",
+    "veles_router_failovers_total":
+        "Requests retried on another replica after a failed attempt "
+        "(crash, timeout, 5xx)",
+    "veles_router_replica_errors_total":
+        "Failed replica attempts the router observed (connection "
+        "errors, timeouts, 5xx answers)",
+    "veles_router_breaker_opens_total":
+        "Circuit-breaker transitions to open (threshold consecutive "
+        "failures, or a failed half-open probe)",
+    "veles_router_duplicate_answers_total":
+        "Late replica answers dropped by the exactly-once latch (a "
+        "slow-then-successful attempt whose request was already "
+        "answered by a failover)",
+    "veles_router_respawns_total":
+        "Dead serving replicas respawned by the ReplicaSupervisor",
 }
 
 

@@ -175,6 +175,45 @@ def quantiles(hist: Dict, qs=(0.5, 0.9, 0.99)) -> Dict[float, Optional[float]]:
     return {q: histogram_quantile(bounds, counts, q) for q in qs}
 
 
+def read_endpoints(path: str) -> List[str]:
+    """Replica roster from a file — the ONE roster format fleet
+    scraping (``veles-tpu metrics aggregate --endpoints-file``) and
+    routing (``veles-tpu route --endpoints-file``) share. Two forms:
+
+    - plain text: one endpoint per line, ``#`` comments and blank
+      lines ignored;
+    - JSON: a bare list of URLs, or an object with an ``"endpoints"``
+      list whose items are URLs or ``{"url": ...}`` dicts — exactly
+      what the router's ``GET /roster`` page is, so discovery output
+      saved to disk feeds both consumers unchanged.
+
+    Raises ValueError on malformed JSON/entries; an empty roster is
+    the caller's error to report."""
+    with open(path) as fin:
+        text = fin.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{") or stripped.startswith("["):
+        doc = json.loads(text)
+        items = doc.get("endpoints", []) if isinstance(doc, dict) \
+            else doc
+        out: List[str] = []
+        for item in items:
+            if isinstance(item, dict):
+                url = item.get("url")
+                if not isinstance(url, str) or not url:
+                    raise ValueError(
+                        "roster entry %r carries no \"url\"" % (item,))
+                out.append(url)
+            elif isinstance(item, str):
+                out.append(item)
+            else:
+                raise ValueError("roster entry %r is neither a URL "
+                                 "string nor a dict" % (item,))
+        return out
+    return [line for raw in text.splitlines()
+            for line in [raw.split("#", 1)[0].strip()] if line]
+
+
 def scrape(url: str, timeout: float = 5.0
            ) -> Tuple[Optional[str], Optional[str]]:
     """(body, error) for one /metrics endpoint — exactly one of the
@@ -271,16 +310,30 @@ def main(argv) -> int:
         help="scrape N /metrics endpoints, print the merged "
              "exposition (counters/buckets summed, quantiles "
              "recomputed, per-endpoint up/down rows)")
-    ag.add_argument("urls", nargs="+", metavar="URL",
+    ag.add_argument("urls", nargs="*", metavar="URL",
                     help="endpoint (http://host:port[/metrics]; bare "
                          "host:port accepted)")
+    ag.add_argument("--endpoints-file", default=None, metavar="FILE",
+                    help="replica roster file shared with the fleet "
+                         "router: one endpoint per line (# comments), "
+                         "or JSON — a bare URL list or the router's "
+                         "GET /roster output saved to disk")
     ag.add_argument("--timeout", type=float, default=5.0,
                     help="per-endpoint scrape timeout, seconds")
     ag.add_argument("--json", action="store_true",
                     help="print the structured aggregation instead "
                          "of exposition text")
     args = parser.parse_args(argv)
-    agg = aggregate(args.urls, timeout=args.timeout)
+    urls = list(args.urls)
+    if args.endpoints_file:
+        try:
+            urls += read_endpoints(args.endpoints_file)
+        except (OSError, ValueError) as e:
+            parser.error("bad --endpoints-file: %s" % e)
+    if not urls:
+        parser.error("no endpoints (positional URLs and/or "
+                     "--endpoints-file)")
+    agg = aggregate(urls, timeout=args.timeout)
     if args.json:
         print(json.dumps(agg, indent=2, sort_keys=True))
     else:
