@@ -659,6 +659,12 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # here — the gate fails on leakage; the failover/exactly-once
         # measurement itself is the gate's live fleet proof
         "fleet": _fleet_section(),
+        # lossless request plane (serving/journal.py + token-level
+        # resume + drain-by-handoff): the bench never journals,
+        # resumes or hands off, so every count MUST be zero here —
+        # the gate fails on leakage; the resumed-decode-cheaper-than-
+        # redo measurement is the gate's live lossless proof
+        "lossless": _lossless_section(),
         "extras": [ae, lm],
     }
 
@@ -760,6 +766,33 @@ def _fleet_section():
         "duplicate_answers": int(
             counters.get("veles_router_duplicate_answers_total")),
         "respawns": int(counters.get("veles_router_respawns_total")),
+    }
+
+
+def _lossless_section():
+    """{journal_appends, journal_replayed, journal_salvaged,
+    journal_compactions, resume_attempts, resume_tokens,
+    handoff_requests} for this bench process — absolute counter reads
+    (one process, counters start at zero). The bench never runs a
+    journaled router, resumes a decode or drains by handoff, so every
+    count MUST be zero — ``bench.py gate`` fails on leakage. The live
+    resumed-decode proof runs inside ``gate_lossless``."""
+    from veles_tpu.telemetry.counters import counters
+    return {
+        "journal_appends": int(
+            counters.get("veles_journal_appends_total")),
+        "journal_replayed": int(
+            counters.get("veles_journal_replayed_total")),
+        "journal_salvaged": int(
+            counters.get("veles_journal_salvaged_total")),
+        "journal_compactions": int(
+            counters.get("veles_journal_compactions_total")),
+        "resume_attempts": int(
+            counters.get("veles_resume_attempts_total")),
+        "resume_tokens": int(
+            counters.get("veles_resume_tokens_total")),
+        "handoff_requests": int(
+            counters.get("veles_handoff_requests_total")),
     }
 
 
@@ -1841,6 +1874,218 @@ def _fleet_failover_proof():
     return failures
 
 
+def gate_lossless(baseline_doc=None, current_doc=None):
+    """``lossless`` gate section: (1) every journal/resume/handoff
+    counter must be registered with a HELP string; (2) bench
+    documents must carry ZERO lossless-plane activity — the bench
+    never journals, resumes or hands off, so a non-zero count means
+    that machinery leaked into a training measurement; (3) live
+    proof: a journaled 2-replica fleet under an injected mid-decode
+    replica death answers the request id-exactly by RESUMING from
+    tokens_done on the survivor, with the resumed decode costing
+    fewer FLOPs (CostModel over the actual compiled programs) than a
+    full redo — and the journal holds zero pending entries once
+    every answer is terminal. Runs AFTER gate_fleet in _gate_main:
+    the fleet proof's dying gasps legitimately move the resume
+    counters, so this gate asserts deltas, not process-absolute
+    zeros."""
+    from veles_tpu.serving import LOSSLESS_COUNTERS
+    from veles_tpu.telemetry.counters import DESCRIPTIONS
+    failures = []
+    for name in LOSSLESS_COUNTERS:
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "lossless: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc),
+                     ("current", current_doc)):
+        sec = (doc or {}).get("lossless")
+        if not sec:
+            continue
+        for key, value in sec.items():
+            if value:
+                failures.append(
+                    "lossless: %s doc has %s=%s — journal/resume/"
+                    "handoff work leaked into a non-fleet bench run"
+                    % (tag, key, value))
+    return failures + _lossless_resume_proof()
+
+
+def _lossless_resume_proof():
+    """THE lossless drill, live: two in-process GenerationAPI
+    replicas behind a JOURNALED FleetRouter; ``serve.replica_death``
+    is armed to kill one replica a few decode ticks into a long
+    request. The dying gasp (503 + resume progress) must make the
+    failover RESUME from tokens_done on the survivor: the answer is
+    token-for-token the solo decode, ``resumed_from`` > 0, the
+    resumed decode's FLOPs (CostModel cost_analysis over the actual
+    compiled prefill/step programs) undercut a full redo's, and the
+    journal ends with zero pending entries (every accepted request
+    reached a terminal record)."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
+    import tempfile
+    import char_lm
+    import veles_tpu as vt
+    from veles_tpu import prng
+    from veles_tpu.nn import sampling
+    from veles_tpu.serving.router import FleetRouter
+    from veles_tpu.telemetry.cost import cost_of_compiled
+    from veles_tpu.telemetry.counters import counters as _ctrs
+
+    prng.seed_all(6161)
+    wf = char_lm.build_workflow(epochs=1, minibatch_size=32,
+                                n_blocks=1, dim=32, n_train=64,
+                                n_valid=32)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    apis = [vt.GenerationAPI(wf, port=0, engine="continuous",
+                             max_slots=2, buckets=(8, 16, 32),
+                             max_context=48, name="lossless_%d" % i)
+            for i in range(2)]
+    for api in apis:
+        api.initialize()
+    failures = []
+    prompt = [1, 5, 3, 2, 4]
+    n_new = 12
+    expected = sampling.generate(wf, prompt, n_new, temperature=0)
+    journal_dir = tempfile.mkdtemp(prefix="veles_journal_gate_")
+    saved_spec = os.environ.get("VELES_FAULTS")
+    router = None
+    try:
+        router = FleetRouter(
+            ["127.0.0.1:%d" % api.port for api in apis],
+            probe_interval=0.2, failure_threshold=1, retry_budget=2,
+            attempt_timeout=60.0, request_timeout=120.0,
+            journal_dir=journal_dir, journal_fsync=False,
+            name="lossless.router").start()
+        import json as _json
+        import urllib.error as _er
+        import urllib.request as _rq
+        url = "http://127.0.0.1:%d/generate" % router.port
+
+        def post(payload, to=url):
+            req = _rq.Request(to,
+                              data=_json.dumps(payload).encode(),
+                              headers={"Content-Type":
+                                       "application/json"})
+            try:
+                with _rq.urlopen(req, timeout=90) as r:
+                    return r.status, _json.loads(r.read())
+            except _er.HTTPError as e:
+                try:
+                    return e.code, _json.loads(e.read() or b"{}")
+                except ValueError:
+                    return e.code, {"error": "replica answered %d"
+                                    % e.code}
+
+        # warm BOTH replicas' programs (incl. the original bucket's
+        # prefill) outside the armed window
+        for api in apis:
+            status, body = post(
+                {"prompt": prompt, "n_new": 4},
+                to="http://127.0.0.1:%d/generate" % api.port)
+            if status != 200:
+                failures.append("lossless: warm-up answered %d (%s)"
+                                % (status, body.get("error")))
+        ra = _ctrs.get("veles_resume_attempts_total")
+        rt = _ctrs.get("veles_resume_tokens_total")
+        ja = _ctrs.get("veles_journal_appends_total")
+        # the in-flight request dies a few decode ticks in: hit 1 is
+        # the request-path site at admission, hits 2+ the engine's
+        # per-tick site — after=4 kills mid-decode deterministically
+        os.environ["VELES_FAULTS"] = \
+            "serve.replica_death:raise:after=4,times=1"
+        status, body = post({"prompt": prompt, "n_new": n_new})
+        os.environ.pop("VELES_FAULTS", None)
+        if status != 200:
+            failures.append(
+                "lossless: resumed request answered %d (%s)"
+                % (status, body.get("error")))
+            return failures
+        k = int(body.get("resumed_from", 0))
+        if k < 1:
+            failures.append(
+                "lossless: the failover never resumed (resumed_from="
+                "%s — the dying gasp carried no progress)" % k)
+        if body.get("tokens") != expected:
+            failures.append(
+                "lossless: resumed tokens differ from the solo "
+                "decode (%s vs %s)" % (body.get("tokens"), expected))
+        if _ctrs.get("veles_resume_attempts_total") - ra < 1:
+            failures.append(
+                "lossless: no resume attempt counted")
+        if _ctrs.get("veles_resume_tokens_total") - rt < k:
+            failures.append(
+                "lossless: resume_tokens counter did not cover the "
+                "carried prefix")
+        if _ctrs.get("veles_journal_appends_total") - ja < 2:
+            failures.append(
+                "lossless: the journal never recorded the request "
+                "(admit + terminal)")
+        # -- resumed decode FLOPs < full redo, over the ACTUAL
+        # compiled programs of the surviving engine ------------------------
+        survivor = [api for api in apis
+                    if api._service is not None]
+        if not survivor or survivor[0]._engine is None:
+            failures.append("lossless: no surviving engine to cost")
+            return failures
+        eng = survivor[0]._engine
+        sched = eng.scheduler
+
+        def flops_of(kind, bucket=None):
+            prog = eng._progs.get((kind, bucket))
+            exe = prog.compiled() if prog is not None else None
+            if exe is None:
+                return None
+            return cost_of_compiled(exe).flops
+
+        step_f = flops_of("step")
+        pre_orig = flops_of("prefill", sched.bucket_for(len(prompt)))
+        pre_res = flops_of("prefill",
+                           sched.bucket_for(len(prompt) + max(k, 1)))
+        if not step_f or pre_res is None:
+            failures.append(
+                "lossless: CostModel could not price the compiled "
+                "serving programs (step=%s prefill=%s)"
+                % (step_f, pre_res))
+        elif k >= 1:
+            # prefill emits the first token of each leg; the rest
+            # ride decode steps (decode_block=1 in this drill)
+            resumed = pre_res + (n_new - k - 1) * step_f
+            redo = (pre_orig if pre_orig is not None
+                    else pre_res) + (n_new - 1) * step_f
+            if resumed >= redo:
+                failures.append(
+                    "lossless: resumed decode cost %.3e flops >= "
+                    "full redo %.3e — resume saved nothing"
+                    % (resumed, redo))
+            else:
+                print("lossless proof: death at token %d of %d -> "
+                      "failover resumed id-exact; resumed cost "
+                      "%.3e flops vs %.3e full redo (%.2fx), "
+                      "journal clean" % (k, n_new, resumed, redo,
+                                         redo / resumed))
+        # every accepted request must have reached a terminal record
+        pending = router.journal.pending()
+        if pending:
+            failures.append(
+                "lossless: %d journal entr%s left pending after all "
+                "answers (%s)" % (len(pending),
+                                  "y" if len(pending) == 1 else "ies",
+                                  [r["request_id"] for r in pending]))
+    finally:
+        if saved_spec is None:
+            os.environ.pop("VELES_FAULTS", None)
+        else:
+            os.environ["VELES_FAULTS"] = saved_spec
+        if router is not None:
+            router.stop()
+        for api in apis:
+            api.stop()
+        shutil.rmtree(journal_dir, ignore_errors=True)
+    return failures
+
+
 def gate_quant(baseline_doc=None, current_doc=None):
     """``quant`` gate section: (1) the quantization/artifact counters
     must be registered; (2) quant-off bench documents must carry ZERO
@@ -2143,6 +2388,10 @@ def _gate_main(argv):
                 + gate_tensormon(baseline, current)
                 + gate_serving(baseline, current)
                 + gate_fleet(baseline, current)
+                # AFTER gate_fleet: its dying-gasp failovers
+                # legitimately move the resume counters, so the
+                # lossless gate asserts deltas, never process zeros
+                + gate_lossless(baseline, current)
                 + gate_quant(baseline, current))
     for failure in failures:
         print("GATE FAIL %s" % failure, file=sys.stderr)
@@ -2157,9 +2406,10 @@ def _gate_main(argv):
           "overhead in budget, serving counters + SLO histograms "
           "clean + continuous "
           "batching beats the window baseline, fleet counters clean "
-          "+ 2-replica failover drill exactly-once, quant clean + "
-          "int8 greedy token-exact + artifact serves with zero "
-          "compiles)"
+          "+ 2-replica failover drill exactly-once, lossless clean "
+          "+ journaled resume id-exact and cheaper than redo, quant "
+          "clean + int8 greedy token-exact + artifact serves with "
+          "zero compiles)"
           % (argv[1], argv[0],
              " — %d legacy section(s) compared on wall-clock" % legacy
              if legacy else ""))

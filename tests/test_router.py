@@ -721,10 +721,12 @@ def test_replica_death_failover_respawn_exactly_once(lm_wf,
 
 
 def test_generation_api_drain_finishes_inflight(lm_wf):
-    """The engine-API side of the drain contract: begin_drain stops
+    """The engine-API side of the LEGACY drain contract
+    (handoff=False — the wait-out-the-grace drain): begin_drain stops
     admission (503 "draining" + request_id) and flips /readyz to
     draining while the in-flight ticket keeps decoding to a 200;
-    drain() then returns True and tears the service down."""
+    drain() then returns True and tears the service down. The
+    default drain-by-handoff path is tests/test_lossless.py's."""
     lm, wf = lm_wf
     api = vt.GenerationAPI(wf, port=0, engine="continuous",
                            max_slots=2, buckets=(8,), max_context=24,
@@ -760,7 +762,7 @@ def test_generation_api_drain_finishes_inflight(lm_wf):
         assert code == 503 and "draining" in body["error"]
         assert "request_id" in body
         assert int(headers.get("Retry-After")) >= 1
-        assert api.drain(grace=60) is True          # in-flight finished
+        assert api.drain(grace=60, handoff=False) is True   # finished
         t.join(timeout=30)
         code, body, _ = results["r"]
         assert code == 200 and len(body["tokens"]) == 12

@@ -89,6 +89,9 @@ def main(argv=None) -> int:
         _root.common.serving.artifact = args.serve_artifact
     if args.serve_drain_grace is not None:
         _root.common.serving.drain_grace = args.serve_drain_grace
+    if args.serve_drain_handoff is not None:
+        _root.common.serving.drain_handoff = \
+            args.serve_drain_handoff == "on"
     # quantization policy (veles_tpu/quant/): the flags arm the config
     # tree; the serving engine (and any programmatic consumer) reads
     # root.common.quant.*
@@ -375,6 +378,15 @@ def _route_cli(argv) -> int:
                         metavar="SEC",
                         help="graceful-drain budget on SIGTERM / "
                              "POST /drain (default 30)")
+    parser.add_argument("--journal", default=None, metavar="DIR",
+                        help="durable request journal directory "
+                             "(docs/services.md 'Lossless request "
+                             "plane'): every accepted request is "
+                             "fsync'd to DIR before dispatch and "
+                             "marked terminal on answer; a restart "
+                             "replays the unanswered tail, so a "
+                             "router SIGKILL loses zero accepted "
+                             "requests")
     args = parser.parse_args(argv)
     endpoints = list(args.endpoints)
     if args.endpoints_file:
@@ -395,7 +407,8 @@ def _route_cli(argv) -> int:
         failure_threshold=args.failure_threshold,
         retry_budget=args.retry_budget,
         attempt_timeout=args.attempt_timeout,
-        request_timeout=args.request_timeout).start()
+        request_timeout=args.request_timeout,
+        journal_dir=args.journal).start()
     print("ROUTING port=%d replicas=%d" % (router.port,
                                            len(router.replicas)),
           flush=True)                                   # scriptable

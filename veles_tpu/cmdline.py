@@ -104,6 +104,15 @@ def make_parser() -> argparse.ArgumentParser:
                         "stragglers 503 "
                         "(root.common.serving.drain_grace, default "
                         "30)")
+    p.add_argument("--serve-drain-handoff", default=None,
+                   choices=("on", "off"),
+                   help="drain-by-handoff (default on): a draining "
+                        "replica settles each in-flight ticket 503 + "
+                        "its emitted-token resume progress at the "
+                        "next step boundary — drain latency is one "
+                        "handoff, not the longest generation; 'off' "
+                        "restores the wait-out-the-grace drain "
+                        "(root.common.serving.drain_handoff)")
     p.add_argument("--serve-engine", default=None,
                    choices=("continuous", "window"),
                    help="decode plane under --serve-generate: "

@@ -137,11 +137,29 @@ for _name, _desc in (
                                "fails over to another replica)"),
     ("serve.replica_death", "serving replica death mid-decode: fired "
                             "in the GenerationAPI request path after "
-                            "admission (raise = this replica tears "
-                            "down its HTTP front and aborts in-flight "
-                            "work — the router's view of a crashed "
-                            "replica; crash = the replica process "
-                            "actually exits %d)" % CRASH_EXIT_CODE),
+                            "admission AND per engine decode tick "
+                            "(raise = this replica tears down its "
+                            "HTTP front and aborts in-flight work "
+                            "with a dying-gasp 503 carrying each "
+                            "ticket's resume progress; crash = the "
+                            "replica process actually exits %d)"
+                            % CRASH_EXIT_CODE),
+    # lossless request plane (serving/journal.py + token-level resume):
+    # chaos for the durability story — a corrupted journal record must
+    # be quarantined with a counted warning at replay (never refuse to
+    # start), and a failed progress snapshot mid-drain must degrade
+    # that one ticket to a plain 503 (no resume), never block the drain
+    ("router.journal", "durable request journal, at every record "
+                       "append and every replay read (corrupt: "
+                       "damage the record bytes — replay salvages "
+                       "the torn entry with a counted warning; "
+                       "raise at append: the admission is shed "
+                       "rather than accepted un-journaled)"),
+    ("serve.handoff", "drain-by-handoff progress snapshot, per "
+                      "in-flight ticket at a draining replica "
+                      "(raise = that ticket's handoff degrades to a "
+                      "plain 503 shed without resume progress; the "
+                      "drain itself always completes)"),
 ):
     register_point(_name, _desc)
 

@@ -61,6 +61,11 @@ class RESTfulAPI(Unit):
                                    "max_pending", 64) or 64)
         self._pending = 0
         self._pending_lock = threading.Lock()
+        #: tickets fed but not yet terminal — what a stop()/drain
+        #: sweep settles via the first-terminal fail() (503 +
+        #: Retry-After + request_id) instead of letting the handlers
+        #: rot to a silent 504
+        self._outstanding: set = set()
         #: forward output to answer from (link_attrs from the last forward)
         self.input = None
         self._service: Optional[HTTPService] = None
@@ -148,13 +153,24 @@ class RESTfulAPI(Unit):
                 except Exception as e:
                     self._reply(503, {"error": str(e)})
                     return
-                if not ticket.event.wait(api.request_timeout):
+                with api._pending_lock:
+                    api._outstanding.add(ticket)
+                try:
+                    settled = ticket.event.wait(api.request_timeout)
+                finally:
+                    with api._pending_lock:
+                        api._outstanding.discard(ticket)
+                if not settled:
                     self._reply(504, {"error": "inference timed out",
                                       "request_id": ticket.request_id})
                     return
                 if ticket.error is not None:
-                    self._reply(500, {"error": ticket.error,
-                                      "request_id": ticket.request_id})
+                    headers = None
+                    if ticket.retry_after:
+                        headers = {"Retry-After": str(max(1, int(
+                            ticket.retry_after)))}
+                    json_reply(self, ticket.code,
+                               ticket.error_payload(), headers=headers)
                     return
                 self._reply(200, {"result": ticket.result,
                                   "request_id": ticket.request_id})
@@ -193,23 +209,37 @@ class RESTfulAPI(Unit):
             # serving wiring links the batched forward output): row i
             # answers ticket i — also when each row is a scalar
             # (ndim==1), where returning the whole vector would leak
-            # every client's result to every client
+            # every client's result to every client. Terminals go
+            # through succeed()/fail() — first-terminal exactly-once,
+            # histograms + flight events recorded — never a bare
+            # result/event poke a shutdown sweep could double-settle.
+            served = 0
             for i, ticket in real:
-                ticket.result = numpy.asarray(out[i]).tolist()
-            self.requests_served += len(real)
+                ticket.mark_admitted()
+                if ticket.succeed(numpy.asarray(out[i]).tolist()):
+                    served += 1
+            self.requests_served += served
         except Exception as e:
             for _, ticket in real:
-                ticket.error = "%s: %s" % (type(e).__name__, e)
+                ticket.mark_admitted()
+                ticket.fail("%s: %s" % (type(e).__name__, e), code=500)
         finally:
             self.loader.current_tickets = []
-            for _, ticket in real:
-                ticket.event.set()
 
     def stop(self) -> None:
         health.forget("rest.%s" % self.name)
         if self._service is not None:
             self._service.stop_serving()
             self._service = None
+        # straggler sweep: every fed-but-unanswered ticket settles
+        # through the first-terminal fail() — 503 + Retry-After +
+        # request_id (error_payload), histograms/flight recorded
+        # exactly once however many stop()/drain sweeps run
+        with self._pending_lock:
+            stragglers = list(self._outstanding)
+        for ticket in stragglers:
+            ticket.fail("server shutting down", code=503,
+                        retry_after=5.0)
 
 
 class GenerationAPI(Unit):
@@ -389,7 +419,28 @@ class GenerationAPI(Unit):
                 or not 1 <= len(request_id) <= 200):
             raise ValueError("'request_id' must be a non-empty string "
                              "of at most 200 chars")
-        req = {"prompt": [int(t) for t in prompt], "n_new": n_new,
+        # token-level failover resume (docs/services.md "Lossless
+        # request plane"): a retry of a died-mid-decode request
+        # carries the tokens already emitted; they fold into the
+        # prompt (re-prefilled in one bucketed pass — never
+        # re-decoded) and n_new is the REMAINING budget. resume_k
+        # tells the engine how far to advance the request's per-slot
+        # PRNG stream so sampled resumes stay id-exact.
+        resume_tokens = body.get("resume_tokens")
+        if resume_tokens is not None:
+            if (not isinstance(resume_tokens, list)
+                    or not all(isinstance(t, int)
+                               and not isinstance(t, bool)
+                               for t in resume_tokens)):
+                raise ValueError("'resume_tokens' must be a list of "
+                                 "int token ids")
+            if mode not in ("greedy", "sample"):
+                raise ValueError(
+                    "resume_tokens serve mode=greedy/sample only "
+                    "(speculative/beam retries restart from scratch)")
+        resume_tokens = [int(t) for t in (resume_tokens or ())]
+        req = {"prompt": [int(t) for t in prompt] + resume_tokens,
+               "n_new": n_new, "resume_k": len(resume_tokens),
                "mode": mode, "temperature": temperature, "seed": seed,
                "gamma": gamma, "beam": beam, "eos_id": eos_id,
                "request_id": request_id}
@@ -572,6 +623,12 @@ class GenerationAPI(Unit):
                     quant_kv=self.quant_kv,
                     artifact=self.artifact,
                     name=self.name).start()
+                # the engine-side serve.replica_death site (fired per
+                # decode tick) settles the in-flight tickets with
+                # resume progress, then this hook tears the HTTP
+                # front down — on its own thread: the tick thread
+                # must not join itself through engine.stop()
+                self._engine.on_death = self._on_replica_death
             except VelesError as e:
                 # a stack the slot pool cannot serve (non-LM workflow)
                 # degrades to the window worker — same answers, just no
@@ -736,8 +793,24 @@ class GenerationAPI(Unit):
                 # draft + the engine's fixed gamma, beam the engine's
                 # fixed width; anything else (and any geometry the
                 # pool rejects) falls back to the window worker
-                via_engine = (engine is not None
-                              and engine.accepts(req) is None)
+                reject = (None if engine is None
+                          else engine.accepts(req))
+                via_engine = engine is not None and reject is None
+                if req.get("resume_k") and not via_engine \
+                        and req["mode"] != "greedy":
+                    # a sampled resume re-enters a per-slot PRNG
+                    # stream only the slot pool owns — the window
+                    # plane cannot honor it id-exactly (greedy is
+                    # deterministic and MAY ride the window plane
+                    # with its folded prompt). 409 tells the router:
+                    # drop the resume, retry this request from
+                    # scratch.
+                    json_reply(self, 409, {
+                        "error": "resume not servable here (%s); "
+                                 "retry without resume_tokens"
+                                 % (reject or "no continuous engine"),
+                        "request_id": ticket.request_id})
+                    return
                 if via_engine:
                     # the continuous-batching plane: admitted into a
                     # KV-cache slot at the next step boundary; a full
@@ -792,13 +865,19 @@ class GenerationAPI(Unit):
 
             def _await_and_reply(self, ticket, via_engine):
                 try:
-                    # the replica-death chaos point: the request IS
-                    # in flight (admitted to a plane above) when the
-                    # fault fires — raise tears this replica's HTTP
-                    # front down mid-decode and drops the connection
-                    # without a reply, exactly what a crashed replica
-                    # looks like to the router; crash exits the
-                    # process with the slave-death code
+                    # the replica-death chaos point, request-path
+                    # site: the request IS in flight (admitted to a
+                    # plane above) when the fault fires — raise tears
+                    # this replica's HTTP front down. The teardown's
+                    # abort settles every in-flight ticket with its
+                    # resume progress, and this handler waits for
+                    # that settle to emit the DYING GASP: a 503 whose
+                    # body carries {resume: {tokens, tokens_done}},
+                    # the record a failover retry continues from. A
+                    # teardown too wedged to settle the ticket drops
+                    # the connection as before (a true SIGKILL — the
+                    # retry re-decodes from scratch); crash exits the
+                    # process with the slave-death code either way.
                     fire_fault("serve.replica_death")
                 except FaultInjected:
                     api.warning("%s: injected replica death — tearing "
@@ -807,7 +886,13 @@ class GenerationAPI(Unit):
                     threading.Thread(target=api.stop, daemon=True,
                                      name=api.name + ".death").start()
                     self.close_connection = True
-                    return      # no reply: the client sees a dead peer
+                    if not ticket.event.wait(10.0) \
+                            or ticket.error is None:
+                        return      # wedged: the client sees a dead peer
+                    json_reply(self, ticket.code,
+                               ticket.error_payload(),
+                               headers={"Retry-After": "1"})
+                    return
                 # slack past the deadline: the queue-side expiry
                 # (503 + Retry-After, counted) should win the race
                 # against this handler's own last-resort 504
@@ -879,15 +964,41 @@ class GenerationAPI(Unit):
                   self.name, self._inflight)
         return True
 
-    def drain(self, grace: Optional[float] = None) -> bool:
-        """SIGTERM-grade graceful shutdown: :meth:`begin_drain`, wait
-        (up to ``grace`` seconds, default
-        ``root.common.serving.drain_grace`` = 30) for every in-flight
-        request to be answered, then :meth:`stop`. True when the
-        drain emptied in time; False means the grace expired and the
-        remaining tickets were aborted by ``stop()`` (503, counted) —
-        either way the process is safe to exit afterwards."""
+    def _on_replica_death(self) -> None:
+        """Engine-tick ``serve.replica_death`` hook: the engine has
+        already settled every in-flight ticket with its resume
+        progress (the dying gasp the waiting handlers reply with);
+        tear the front down on a fresh thread — never the tick
+        thread, which ``engine.stop()`` would join into itself."""
+        threading.Thread(target=self.stop, daemon=True,
+                         name=self.name + ".death").start()
+
+    def drain(self, grace: Optional[float] = None,
+              handoff: Optional[bool] = None) -> bool:
+        """SIGTERM-grade graceful shutdown: :meth:`begin_drain`, then
+        — with ``handoff`` (default
+        ``root.common.serving.drain_handoff`` = True) — the engine
+        HANDS BACK every in-flight request at the next step boundary:
+        each ticket settles 503 + Retry-After with its emitted-token
+        prefix attached, so a fleet router re-dispatches it elsewhere
+        with ``resume_tokens`` and the drain's latency is bounded by
+        a step boundary plus the handlers' replies — never by the
+        longest co-tenant generation. Window-plane stragglers (and
+        ``handoff=False`` drains) wait out up to ``grace`` seconds
+        (default ``root.common.serving.drain_grace`` = 30) before
+        ``stop()`` aborts them through the same first-terminal
+        ``fail()`` path (503 + resume progress, counted once). True
+        when nothing was still in flight at teardown."""
         self.begin_drain()
+        if handoff is None:
+            handoff = bool(root.common.serving.get("drain_handoff",
+                                                   True))
+        if handoff and self._engine is not None:
+            handed = self._engine.handoff()
+            if handed:
+                self.info("%s: drain handed %d in-flight request(s) "
+                          "back with resume progress", self.name,
+                          handed)
         if grace is None:
             # no falsy-zero rewrite: drain_grace = 0 legitimately
             # means "abort stragglers immediately"

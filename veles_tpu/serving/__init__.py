@@ -38,9 +38,26 @@ from typing import Dict
 from .scheduler import (SlotScheduler, Ticket,        # noqa: F401
                         new_request_id,
                         request_tracing_enabled)
-from .engine import ContinuousEngine                  # noqa: F401
+from .engine import (ContinuousEngine,                # noqa: F401
+                     advanced_prng_key, fold_resume)
+from .journal import RequestJournal                   # noqa: F401
 from .router import (CircuitBreaker, FleetRouter,     # noqa: F401
                      ROUTER_COUNTERS, Replica, ReplicaSupervisor)
+
+#: every counter the lossless request plane increments (durable
+#: journal + token-level failover resume + drain-by-handoff) —
+#: registered with HELP strings in telemetry/counters.py DESCRIPTIONS
+#: and asserted zero in non-fleet runs by ``python bench.py gate``'s
+#: lossless section
+LOSSLESS_COUNTERS = (
+    "veles_journal_appends_total",
+    "veles_journal_replayed_total",
+    "veles_journal_salvaged_total",
+    "veles_journal_compactions_total",
+    "veles_resume_attempts_total",
+    "veles_resume_tokens_total",
+    "veles_handoff_requests_total",
+)
 
 #: every counter the serving plane increments — registered with HELP
 #: strings in telemetry/counters.py DESCRIPTIONS and asserted zero in

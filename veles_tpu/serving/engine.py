@@ -90,8 +90,45 @@ from ..telemetry.spans import span
 #: computed-and-discarded, so the clamp only has to keep it finite)
 _TEMP_EPS = 1e-3
 
-#: slot modes the plain decode step advances
-_STEP_MODES = ("greedy", "sample")
+#: slot modes the plain decode step advances — also the only modes
+#: that RESUME (scheduler.RESUME_MODES is the single source: their
+#: per-slot PRNG stream advances exactly one split per emitted token,
+#: so a retry can re-enter the stream mid-decode)
+from .scheduler import RESUME_MODES as _STEP_MODES  # noqa: E402
+
+#: jitted split-chain advance (built on first use): a 900-token
+#: resume must cost ONE dispatch on the tick thread, not 900
+#: host-loop split round-trips stalling every co-tenant decode
+_advance_key_jit = None
+
+
+def advanced_prng_key(seed: int, steps: int):
+    """The per-slot PRNG carry after ``steps`` emitted tokens: every
+    emitted token consumed exactly one ``jax.random.split`` of the
+    slot's stream (``_split_rows`` batched, ``split(seed_key)`` at
+    prefill — same carry-in-[0] convention), so the carry is a pure
+    function of ``(seed, tokens emitted)``. A resumed prefill seeded
+    with this key samples its first token from the SAME subkey the
+    uninterrupted run would have used at that position — the
+    token-level failover resume's id-exactness hinges on this one
+    function. Computed as one jitted ``fori_loop`` dispatch (steps is
+    a traced argument, so every resume depth shares one program)."""
+    import jax
+    key = jax.random.PRNGKey(int(seed))
+    steps = int(steps)
+    if steps <= 0:
+        return key
+    global _advance_key_jit
+    if _advance_key_jit is None:
+        import jax.numpy as jnp
+
+        def advance(k, n):
+            return jax.lax.fori_loop(
+                0, n, lambda _i, kk: jax.random.split(kk)[0], k)
+
+        _advance_key_jit = (jax.jit(advance), jnp)
+    fn, jnp = _advance_key_jit
+    return fn(key, jnp.int32(steps))
 
 
 def _same_leaves(a: Dict, b: Dict) -> bool:
@@ -121,6 +158,29 @@ def make_request(prompt, n_new, temperature=0.0, seed=0, eos_id=None,
             "temperature": float(temperature), "seed": int(seed),
             "eos_id": eos_id, "mode": str(mode), "gamma": int(gamma),
             "beam": int(beam)}
+
+
+def fold_resume(req: Dict, resume_tokens) -> Dict:
+    """Fold a failover retry's already-emitted tokens into an engine
+    request: they become prompt suffix (the resumed prefill
+    re-prefills them — one bucketed pass, never a re-decode),
+    ``n_new`` drops to the REMAINING budget, and ``resume_k`` records
+    how many stream positions the per-slot PRNG must advance before
+    the first new token. ``req`` is the ORIGINAL request (full
+    ``n_new``); the wire form a router sends — ``resume_tokens`` +
+    remaining ``n_new`` — is what GenerationAPI's parse folds the
+    same way."""
+    resume = [int(t) for t in resume_tokens]
+    if not resume:
+        return dict(req, resume_k=0)
+    remaining = int(req["n_new"]) - len(resume)
+    if remaining < 1:
+        raise ValueError(
+            "resume_tokens (%d) leave no remaining n_new (%d)"
+            % (len(resume), int(req["n_new"])))
+    return dict(req,
+                prompt=[int(t) for t in req["prompt"]] + resume,
+                n_new=remaining, resume_k=len(resume))
 
 
 class ContinuousEngine(Logger):
@@ -249,6 +309,14 @@ class ContinuousEngine(Logger):
         self._temp = numpy.zeros(self.max_slots, numpy.float32)
         self._thread: Optional[threading.Thread] = None
         self._closing = False
+        #: pending drain-by-handoff: (reason, done event, count box) —
+        #: consumed by the tick thread at the next step boundary
+        self._handoff: Optional[Tuple] = None
+        #: replica-death hook (set by GenerationAPI): called when an
+        #: injected ``serve.replica_death`` fires mid-decode, AFTER
+        #: the in-flight tickets are settled with their resume
+        #: progress — the dying gasp a failover retry continues from
+        self.on_death = None
         self.admitted = 0
         self.retired = 0
         self.peak_slots = 0
@@ -282,6 +350,12 @@ class ContinuousEngine(Logger):
         if self._thread is not None:
             self._thread.join(timeout=10)
             self._thread = None
+        # a handoff the loop never consumed (stop racing a drain):
+        # release its waiter — the abort below settles the tickets
+        # (with progress) through the same first-terminal path
+        pending_handoff, self._handoff = self._handoff, None
+        if pending_handoff is not None:
+            pending_handoff[1].set()
         self.scheduler.drain("server shutting down")
         self._abort_active("server shutting down", code=503,
                            retry_after=5.0, count_shed=False)
@@ -301,6 +375,13 @@ class ContinuousEngine(Logger):
             return "unknown decode mode %r" % mode
         if t_p < 1:
             return "empty prompt"
+        if int(req.get("resume_k", 0) or 0) and mode not in _STEP_MODES:
+            # resume re-enters the per-slot PRNG stream mid-decode —
+            # a contract only the plain decode step owns (docs/
+            # services.md "Lossless request plane": window-plane,
+            # speculative and beam requests retry from scratch)
+            return ("token-level resume serves greedy/sample only "
+                    "(mode=%s retries from scratch)" % mode)
         if mode == "speculative":
             if self.draft is None:
                 return "no pooled draft model (speculation rides the "\
@@ -504,6 +585,7 @@ class ContinuousEngine(Logger):
                 with self.scheduler.cv:
                     while (not self.scheduler._queue
                            and self.scheduler.busy_count() == 0
+                           and self._handoff is None
                            and not self._closing):
                         self.scheduler.cv.wait(timeout=5.0)
                         if not self._closing:
@@ -543,6 +625,37 @@ class ContinuousEngine(Logger):
     def _tick(self) -> None:
         """One step boundary: admit into free slots, then advance each
         decode mode's rows by one fixed-shape dispatch."""
+        pending_handoff = self._handoff
+        if pending_handoff is not None:
+            # drain-by-handoff runs ON the tick thread so the
+            # progress snapshot can never race a decode dispatch
+            self._handoff = None
+            reason, done, box = pending_handoff
+            try:
+                box["count"] = self._do_handoff(reason)
+            finally:
+                done.set()
+            return
+        if self.scheduler.busy_count():
+            try:
+                # the mid-decode replica-death chaos site: `after=N`
+                # kills this replica N in-flight ticks into its load,
+                # deterministically — the settled tickets carry their
+                # emitted-token prefix, so the router's failover
+                # RESUMES from tokens_done instead of re-decoding
+                fire_fault("serve.replica_death")
+            except FaultInjected:
+                self.warning("%s: injected replica death mid-decode — "
+                             "settling in-flight tickets with resume "
+                             "progress and tearing the front down",
+                             self.name)
+                self._abort_active(
+                    "replica died mid-decode", code=503,
+                    retry_after=1.0, count_shed=False)
+                death = self.on_death
+                if death is not None:
+                    death()
+                return
         # the param device-view walk (per-array locks) is too heavy to
         # repeat per decode chunk, but a snapshot held forever would
         # serve stale weights after a host-side update. Middle ground:
@@ -702,7 +815,18 @@ class ContinuousEngine(Logger):
         ids_dev = jnp.asarray(ids)
         table_row = self._table_row(slot)
         prog = self._program("prefill", bucket)
-        seed_key = jax.random.PRNGKey(int(slot.req.get("seed", 0)))
+        resume_k = int(slot.req.get("resume_k", 0) or 0)
+        # a resumed request's prompt already carries its emitted-token
+        # prefix (fold_resume); the PRNG carry must re-enter the
+        # stream at the resumed position — one host-side split per
+        # token already emitted, so the resumed decode's noise is
+        # bit-identical to the uninterrupted run's
+        seed_key = (advanced_prng_key(slot.req.get("seed", 0), resume_k)
+                    if resume_k
+                    else jax.random.PRNGKey(int(slot.req.get("seed",
+                                                             0))))
+        if resume_k and group is None:
+            inc("veles_resume_tokens_total", resume_k)
         wait = max(0.0, (slot.ticket.admitted or time.time())
                    - slot.ticket.enqueued)
         with span("serving.prefill", bucket=bucket, slot=slot.idx,
@@ -791,6 +915,8 @@ class ContinuousEngine(Logger):
             # the admitted/retired counters are per request too, and
             # fail()'s first-terminal True keeps a ticket another
             # sweep already answered from counting twice
+            if slot.mode in _STEP_MODES:
+                victims[0].ticket.set_progress(victims[0].tokens)
             if victims[0].ticket.fail(
                     "serving page pool exhausted mid-decode",
                     code=503, retry_after=1.0):
@@ -1008,6 +1134,13 @@ class ContinuousEngine(Logger):
                       count_shed: bool = True) -> None:
         answered = set()
         for slot in self.scheduler.active():
+            # aborted rows hand their emitted-token prefix back on the
+            # ticket BEFORE the terminal: the failure answer then
+            # carries {resume: ...} and a failover retry re-enters the
+            # decode at tokens_done instead of token 0 (plain decode
+            # modes only — spec/beam retries restart from scratch)
+            if slot.mode in _STEP_MODES and slot.tokens:
+                slot.ticket.set_progress(slot.tokens)
             self._retire_slot(slot)
             if id(slot.ticket) not in answered:
                 answered.add(id(slot.ticket))
@@ -1019,6 +1152,72 @@ class ContinuousEngine(Logger):
                                          retry_after=retry_after)
                 if count_shed and first:
                     inc("veles_shed_requests_total")
+
+    # -- drain-by-handoff ------------------------------------------------------
+    def handoff(self, reason: str = "server draining; request handed "
+                                    "off with resume progress",
+                timeout: float = 30.0) -> int:
+        """Hand every in-flight request back to its caller: at the
+        NEXT step boundary each active ticket is settled 503 +
+        Retry-After with its emitted-token prefix attached
+        (``error_payload()`` then carries ``resume``), so a fleet
+        router re-dispatches it elsewhere with ``resume_tokens`` and
+        the drain's latency is bounded by one step boundary — never
+        by the longest co-tenant generation. Queued (not yet
+        admitted) tickets are shed the same 503 without progress.
+        Runs on the tick thread (a progress snapshot can never race a
+        decode dispatch); returns the number of requests handed back
+        with progress. Safe on an idle or closing engine (0)."""
+        done = threading.Event()
+        box = {"count": 0}
+        with self.scheduler.cv:
+            if self._closing or self._thread is None:
+                return 0
+            self._handoff = (reason, done, box)
+            self.scheduler.cv.notify_all()
+        if not done.wait(timeout):
+            self.warning("%s: handoff timed out after %.1fs (tick "
+                         "thread wedged?); the drain proceeds to the "
+                         "abort path", self.name, timeout)
+        return box["count"]
+
+    def _do_handoff(self, reason: str) -> int:
+        """The tick-thread half of :meth:`handoff`. The ``serve.handoff``
+        fault point fires once per in-flight ticket: an injected raise
+        degrades THAT ticket to a plain 503 shed (no resume progress —
+        its retry re-decodes from scratch), never blocks the drain."""
+        handed = 0
+        answered = set()
+        for slot in self.scheduler.active():
+            ticket = slot.ticket
+            if id(ticket) not in answered:
+                answered.add(id(ticket))
+                snapshot_ok = True
+                try:
+                    fire_fault("serve.handoff")
+                except FaultInjected as e:
+                    snapshot_ok = False
+                    self.warning(
+                        "%s: progress snapshot failed mid-drain for "
+                        "%s (%s) — handing off without resume",
+                        self.name, ticket.request_id, e)
+                if snapshot_ok and slot.mode in _STEP_MODES:
+                    ticket.set_progress(slot.tokens)
+                if ticket.fail(reason, code=503, retry_after=1.0,
+                               outcome="handoff"):
+                    if ticket.progress:
+                        handed += 1
+                        inc("veles_handoff_requests_total")
+                    else:
+                        inc("veles_shed_requests_total")
+            # every hypothesis/co-tenant row of the ticket retires
+            self._retire_slot(slot)
+        # queued-but-unadmitted tickets leave with the same answer
+        # (no progress — nothing was decoded for them yet)
+        shed = self.scheduler.drain(reason, code=503, retry_after=1.0)
+        if shed:
+            inc("veles_shed_requests_total", shed)
+        return handed
 
     # -- jitted programs -------------------------------------------------------
     def _program(self, kind: str, bucket: Optional[int] = None):
@@ -1072,6 +1271,10 @@ class ContinuousEngine(Logger):
             return exe(*args)
 
         dispatch._jitted = jitted
+        # the compiled executable, once built — bench's lossless gate
+        # reads Compiled.cost_analysis() off it to prove a resumed
+        # decode costs fewer FLOPs than a full redo
+        dispatch.compiled = lambda: box.get("exe")
         return dispatch
 
     # -- AOT artifact (export/serve_artifact.py) ------------------------------

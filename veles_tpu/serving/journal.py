@@ -1,0 +1,282 @@
+"""Durable request journal: the router's write-ahead log.
+
+The fleet router (PR 12) answered every request *exactly once* — but
+only while the router process lived: its admission state was pure
+memory, so a router SIGKILL lost every queued and in-flight request
+outright. This module is the missing durability layer, built on the
+same disk discipline as :mod:`~veles_tpu.resilience.checkpoint_chain`:
+
+- **append before dispatch**: every admitted request is appended as
+  one JSONL record ``{op: "admit", request_id, enqueued_at, body}``
+  — flushed and ``fsync``'d — BEFORE the first replica attempt, so
+  an accepted request exists on disk or was never acknowledged;
+- **terminal on answer**: the answer (success and shed alike)
+  appends ``{op: "done", request_id, status, outcome}``; a request
+  with an ``admit`` but no ``done`` is by definition unanswered;
+- **per-record hash**: each record carries a truncated SHA-256 of
+  its own payload, so a torn append (power cut mid-line) or bitrot
+  is detected per record — :meth:`RequestJournal.replay` quarantines
+  such records with a counted warning
+  (``veles_journal_salvaged_total``), mirroring the
+  ``spans.read_jsonl`` salvage rule: a damaged journal degrades,
+  it never refuses to start;
+- **rotation + compaction**: past ``rotate_every`` appends the live
+  (unanswered) entries are rewritten into a fresh segment with the
+  checkpoint chain's tmp → ``fsync`` → ``os.replace`` commit and a
+  SHA-256 sidecar manifest, and the old segments are deleted — the
+  journal's size is bounded by the in-flight window, not by
+  traffic history.
+
+Replay contract (``veles-tpu route --journal DIR``): on restart the
+router loads :meth:`pending` — unanswered admits, deduplicated by
+``request_id`` (idempotent however many times a crash-loop re-ran),
+ordered by ``enqueued_at`` — re-dispatches each one, and sheds the
+ones already past their deadline with a terminal 503 record carrying
+the id. Chaos surface: the ``router.journal`` fault point fires at
+every append and every replay read (``corrupt`` damages the record
+bytes; ``raise`` at append refuses the admission rather than accept
+it un-journaled).
+"""
+
+from __future__ import annotations
+
+import glob
+import hashlib
+import json
+import os
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..logger import Logger
+from ..resilience.checkpoint_chain import commit_file, write_manifest
+from ..resilience.faults import fire as fire_fault
+from ..telemetry.counters import inc
+
+#: journal segment naming: journal-<seq>.jsonl, replayed in seq order
+SEGMENT_PREFIX = "journal-"
+SEGMENT_SUFFIX = ".jsonl"
+
+
+def _record_hash(rec: Dict[str, Any]) -> str:
+    """Truncated SHA-256 of the record's canonical JSON (without the
+    hash field itself) — 12 hex chars detect torn writes and bitrot
+    per record without doubling the journal's size."""
+    body = {k: v for k, v in rec.items() if k != "h"}
+    payload = json.dumps(body, sort_keys=True).encode()
+    return hashlib.sha256(payload).hexdigest()[:12]
+
+
+def _parse_record(line: str) -> Optional[Dict[str, Any]]:
+    """One journal line → record, or None when the line is torn,
+    non-JSON, not a journal record, or fails its own hash."""
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        return None
+    if not isinstance(rec, dict) or "op" not in rec \
+            or "request_id" not in rec:
+        return None
+    if rec.get("h") != _record_hash(rec):
+        return None
+    return rec
+
+
+class RequestJournal(Logger):
+    """Write-ahead request log over a directory of JSONL segments.
+    Thread-safe: the router's handler threads append concurrently.
+    ``fsync=False`` trades the power-cut guarantee for speed (tests,
+    tmpfs); the default is durable."""
+
+    def __init__(self, directory: str, rotate_every: int = 4096,
+                 fsync: bool = True, name: str = "journal") -> None:
+        super().__init__()
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.rotate_every = max(16, int(rotate_every))
+        self.fsync = bool(fsync)
+        self.name = name
+        self._lock = threading.Lock()
+        self._fh = None
+        self._appended = 0          # records in the ACTIVE segment
+        segs = self.segments()
+        self._seq = (self._seg_seq(segs[-1]) if segs else 0)
+
+    # -- segment bookkeeping -------------------------------------------------
+    def segments(self) -> List[str]:
+        """Journal segment paths, oldest first (seq order)."""
+        out = []
+        for path in glob.glob(os.path.join(
+                self.directory, SEGMENT_PREFIX + "*" + SEGMENT_SUFFIX)):
+            if path.endswith(".tmp"):
+                continue
+            out.append(path)
+        return sorted(out, key=self._seg_seq)
+
+    @staticmethod
+    def _seg_seq(path: str) -> int:
+        base = os.path.basename(path)
+        try:
+            return int(base[len(SEGMENT_PREFIX):-len(SEGMENT_SUFFIX)])
+        except ValueError:
+            return 0
+
+    def _active_path(self) -> str:
+        return os.path.join(self.directory, "%s%06d%s"
+                            % (SEGMENT_PREFIX, self._seq,
+                               SEGMENT_SUFFIX))
+
+    def _open_locked(self):
+        if self._fh is None:
+            self._fh = open(self._active_path(), "a")
+        return self._fh
+
+    # -- append (the durability boundary) ------------------------------------
+    def append(self, op: str, request_id: str, **fields: Any) -> None:
+        """Durably append one record. Raises
+        :class:`~veles_tpu.resilience.faults.FaultInjected` when an
+        armed ``router.journal`` clause says ``raise`` (the caller
+        sheds the admission rather than accept it un-journaled); an
+        armed ``corrupt`` clause damages the written bytes — replay's
+        salvage pass is the proof that does not kill the journal."""
+        rec = dict(fields, op=str(op), request_id=str(request_id))
+        rec["h"] = _record_hash(rec)
+        data = (json.dumps(rec, sort_keys=True) + "\n").encode()
+        corrupting = fire_fault("router.journal")
+        if corrupting is not None:
+            data = corrupting.corrupt(data)
+        with self._lock:
+            fh = self._open_locked()
+            fh.write(data.decode("utf-8", "replace"))
+            fh.flush()
+            if self.fsync:
+                os.fsync(fh.fileno())
+            self._appended += 1
+            rotate = self._appended >= self.rotate_every
+        inc("veles_journal_appends_total")
+        if rotate:
+            # the record above is already durable: a compaction
+            # failure (disk full, injected replay-side fault) must
+            # not convert this ACCEPTED append into a caller-visible
+            # refusal — the next rotation threshold retries it
+            try:
+                self.compact()
+            except Exception as e:  # noqa: BLE001 — append is durable
+                self.warning("%s: rotation compaction failed (%s: "
+                             "%s); the journal keeps appending to "
+                             "the current segment", self.name,
+                             type(e).__name__, e)
+
+    def admit(self, request_id: str, body: Dict[str, Any],
+              enqueued_at: float) -> None:
+        """Journal an accepted request BEFORE its first dispatch."""
+        self.append("admit", request_id, body=body,
+                    enqueued_at=float(enqueued_at))
+
+    def done(self, request_id: str, status: int,
+             outcome: str = "answered") -> None:
+        """Journal the answer (success and shed alike) — the record
+        that makes replay idempotent by ``request_id``."""
+        self.append("done", request_id, status=int(status),
+                    outcome=str(outcome))
+
+    # -- read back -----------------------------------------------------------
+    def replay(self) -> Tuple[Dict[str, Dict[str, Any]],
+                              Dict[str, Dict[str, Any]]]:
+        """Read every segment oldest→newest into
+        ``(admits, terminals)`` keyed by ``request_id`` (idempotent:
+        duplicate admits of one id collapse to the first). Torn or
+        corrupt records — including injected ``router.journal``
+        corruption — are quarantined with ONE counted warning
+        (``veles_journal_salvaged_total``), never a refused start."""
+        admits: Dict[str, Dict[str, Any]] = {}
+        terminals: Dict[str, Dict[str, Any]] = {}
+        bad = 0
+        for path in self.segments():
+            try:
+                with open(path, errors="replace") as fin:
+                    lines = fin.readlines()
+            except OSError as e:
+                bad += 1
+                self.warning("%s: segment %s unreadable (%s)",
+                             self.name, path, e)
+                continue
+            for line in lines:
+                line = line.strip()
+                if not line:
+                    continue
+                corrupting = fire_fault("router.journal")
+                if corrupting is not None:
+                    line = corrupting.corrupt(
+                        line.encode()).decode("utf-8", "replace")
+                rec = _parse_record(line)
+                if rec is None:
+                    bad += 1
+                    continue
+                rid = rec["request_id"]
+                if rec["op"] == "admit":
+                    admits.setdefault(rid, rec)
+                elif rec["op"] == "done":
+                    terminals[rid] = rec
+        if bad:
+            inc("veles_journal_salvaged_total", bad)
+            self.warning(
+                "%s: quarantined %d torn/corrupt journal record(s) in "
+                "%s (mid-write truncation or bitrot; the survivors "
+                "replay normally)", self.name, bad, self.directory)
+        return admits, terminals
+
+    def pending(self) -> List[Dict[str, Any]]:
+        """Unanswered admits, ordered by ``enqueued_at`` — what a
+        restarted router must re-dispatch (or shed past-deadline,
+        with the id)."""
+        admits, terminals = self.replay()
+        live = [rec for rid, rec in admits.items()
+                if rid not in terminals]
+        return sorted(live, key=lambda r: (r.get("enqueued_at", 0.0),
+                                           r["request_id"]))
+
+    def pending_count(self) -> int:
+        return len(self.pending())
+
+    # -- rotation ------------------------------------------------------------
+    def compact(self) -> int:
+        """Rewrite the live (unanswered) entries into a fresh segment
+        with the checkpoint chain's atomic commit + SHA-256 sidecar
+        manifest, then delete every older segment (and sidecar). The
+        journal's footprint is the in-flight window, not history.
+        Returns the number of live entries kept."""
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None
+            old = self.segments()
+            live = self.pending()
+            self._seq += 1
+            path = self._active_path()
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fout:
+                for rec in live:
+                    fout.write(json.dumps(rec, sort_keys=True) + "\n")
+                fout.flush()
+                os.fsync(fout.fileno())
+            commit_file(tmp, path)
+            write_manifest(path, prefix="journal", entries=len(live))
+            for victim in old:
+                for f in (victim, victim + ".manifest.json"):
+                    try:
+                        os.unlink(f)
+                    except OSError:
+                        pass
+            self._appended = len(live)
+        inc("veles_journal_compactions_total")
+        self.info("%s: compacted -> %s (%d live entr%s, %d old "
+                  "segment(s) dropped)", self.name,
+                  os.path.basename(path), len(live),
+                  "y" if len(live) == 1 else "ies", len(old))
+        return len(live)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.close()
+                self._fh = None

@@ -76,6 +76,11 @@ from ..resilience.retry import RetryPolicy
 from ..telemetry import fleet
 from ..telemetry.counters import (METRICS_CONTENT_TYPE, inc,
                                   metrics_text)
+from .journal import RequestJournal
+#: RESUME_MODES: the single source (scheduler.py) of which decode
+#: modes' emitted-token prefix a failover retry can resume —
+#: everything else retries from scratch
+from .scheduler import RESUME_MODES as _RESUMABLE_MODES
 from .scheduler import new_request_id
 
 #: every counter the fleet router increments — registered with HELP
@@ -122,6 +127,9 @@ def router_config() -> Dict[str, Any]:
         # no falsy-zero rewrite here: drain_grace = 0 legitimately
         # means "abort stragglers immediately"
         "drain_grace": float(node.get("drain_grace", 30.0)),
+        # durable request journal (serving/journal.py): empty = the
+        # PR 12 memory-only admission plane
+        "journal": str(node.get("journal", "") or ""),
     }
 
 
@@ -283,19 +291,27 @@ class _Attempt:
         self.settled = False
         self.failed = False
         self.reason: Optional[str] = None
+        #: a failed attempt's {tokens, tokens_done} resume record (a
+        #: 5xx dying gasp / drain handoff) — the routing loop folds it
+        #: into the next attempt's resume_tokens
+        self.resume_payload: Optional[Dict] = None
+        #: the replica answered 409 to a resume attempt: drop the
+        #: accumulated prefix and retry from scratch
+        self.drop_resume = False
 
-    def _settle(self, failed: bool, reason: Optional[str]) -> bool:
+    def _settle(self, failed: bool, reason: Optional[str],
+                benign: bool = False) -> bool:
         with self._lock:
             if self.settled:
                 return False
             self.settled = True
             self.failed = failed
             self.reason = reason
-        if failed:
+        if failed and not benign:
             inc("veles_router_replica_errors_total")
             if self.replica.breaker.record_failure():
                 inc("veles_router_breaker_opens_total")
-        else:
+        elif not failed:
             self.replica.breaker.record_success()
         with self._answered.cv:
             self._answered.cv.notify_all()
@@ -303,6 +319,13 @@ class _Attempt:
 
     def fail(self, reason: str) -> bool:
         return self._settle(True, reason)
+
+    def fail_benign(self, reason: str) -> bool:
+        """Settle as failed WITHOUT breaker/error accounting — for a
+        healthy answer that merely refuses this attempt's shape (a
+        409 resume rejection is the replica being honest, not the
+        replica being dead)."""
+        return self._settle(True, reason, benign=True)
 
     def succeed(self) -> bool:
         return self._settle(False, None)
@@ -334,6 +357,8 @@ class FleetRouter(Logger):
                  retry_budget: Optional[int] = None,
                  attempt_timeout: Optional[float] = None,
                  request_timeout: Optional[float] = None,
+                 journal_dir: Optional[str] = None,
+                 journal_fsync: bool = True,
                  name: str = "router") -> None:
         super().__init__()
         cfg = router_config()
@@ -369,12 +394,26 @@ class FleetRouter(Logger):
             for u in urls]
         self._service: Optional[HTTPService] = None
         self._probe_thread: Optional[threading.Thread] = None
+        self._replay_thread: Optional[threading.Thread] = None
         self._closing = False
         self._draining = False
         self._inflight = 0
         self._cv = threading.Condition()
         self._wake = threading.Event()
         self.requests_routed = 0
+        # durable request journal (serving/journal.py): every
+        # accepted request is on disk before its first dispatch and
+        # marked terminal on answer — a router SIGKILL loses zero
+        # accepted requests (start() replays the unanswered tail)
+        jdir = (cfg["journal"] if journal_dir is None
+                else (journal_dir or ""))
+        self.journal: Optional[RequestJournal] = (
+            RequestJournal(jdir, fsync=journal_fsync,
+                           name=name + ".journal") if jdir else None)
+        #: admits minus terminals since start (plus the replay
+        #: backlog) — the journal-pending gauge without re-reading
+        #: the segments on every /metrics scrape
+        self._journal_outstanding = 0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "FleetRouter":
@@ -395,10 +434,18 @@ class FleetRouter(Logger):
         health.mark_ready("router.%s" % self.name)
         health.heartbeats.beat("router.%s" % self.name)
         self.info("%s: routing %s on http://127.0.0.1:%d%s "
-                  "(retry budget %d, breaker threshold %d)", self.name,
+                  "(retry budget %d, breaker threshold %d%s)",
+                  self.name,
                   [r.url for r in self.replicas], self.port, self.path,
                   self.retry_budget,
-                  self.replicas[0].breaker.failure_threshold)
+                  self.replicas[0].breaker.failure_threshold,
+                  ", journal %s" % self.journal.directory
+                  if self.journal else "")
+        if self.journal is not None:
+            self._replay_thread = threading.Thread(
+                target=self._replay_journal, daemon=True,
+                name=self.name + ".replay")
+            self._replay_thread.start()
         return self
 
     def stop(self) -> None:
@@ -407,10 +454,76 @@ class FleetRouter(Logger):
         if self._probe_thread is not None:
             self._probe_thread.join(timeout=5)
             self._probe_thread = None
+        if self._replay_thread is not None:
+            self._replay_thread.join(timeout=10)
+            self._replay_thread = None
         if self._service is not None:
             self._service.stop_serving()
             self._service = None
+        if self.journal is not None:
+            self.journal.close()
         health.forget("router.%s" % self.name)
+
+    # -- journal replay ------------------------------------------------------
+    def _replay_journal(self) -> None:
+        """Re-dispatch every journaled-but-unanswered request from
+        before the restart: ordered by ``enqueued_at``, idempotent by
+        ``request_id`` (the journal's terminal records dedupe
+        however many crash-loops re-ran), expired entries shed with
+        a terminal 503 record carrying the id. Torn records were
+        already quarantined (counted) by the journal's salvage pass —
+        a damaged journal degrades, it never refuses to start."""
+        try:
+            pending = self.journal.pending()
+        except Exception:       # noqa: BLE001 — degrade, don't die
+            self.exception("%s: journal replay scan failed; serving "
+                           "new traffic only", self.name)
+            return
+        if not pending:
+            return
+        with self._cv:
+            self._journal_outstanding += len(pending)
+        self.info("%s: replaying %d journaled request(s) from before "
+                  "the restart", self.name, len(pending))
+        for rec in pending:
+            if self._closing or self._draining:
+                return          # still journaled — the next start retries
+            rid = rec["request_id"]
+            body = rec.get("body")
+            enqueued = float(rec.get("enqueued_at", 0.0) or 0.0)
+            if not isinstance(body, dict):
+                self.journal.done(rid, 400, "unreplayable")
+                with self._cv:
+                    self._journal_outstanding -= 1
+                continue
+            if time.time() > enqueued + self.request_timeout:
+                # past its useful life: the shed a live router would
+                # have answered, recorded with the id
+                inc("veles_shed_requests_total")
+                self.journal.done(rid, 503, "expired")
+                self.warning("%s: journaled request %s expired before "
+                             "replay (enqueued %.0fs ago)", self.name,
+                             rid, time.time() - enqueued)
+                with self._cv:
+                    self._journal_outstanding -= 1
+                continue
+            inc("veles_journal_replayed_total")
+            try:
+                answered = self.route(dict(body, request_id=rid))
+                status = answered.status if answered.done else 503
+                outcome = ("replayed" if answered.done
+                           else "unanswered: %s"
+                           % (answered.reason or ""))
+                self.journal.done(rid, int(status), outcome)
+            except Exception:   # noqa: BLE001 — replay must survive
+                # one poisonous entry must not abandon the rest of
+                # the backlog; it stays pending for the next start
+                self.exception("%s: replay of %s failed; continuing "
+                               "with the remaining backlog",
+                               self.name, rid)
+                continue
+            with self._cv:
+                self._journal_outstanding -= 1
 
     # -- graceful drain ------------------------------------------------------
     def begin_drain(self) -> bool:
@@ -528,7 +641,8 @@ class FleetRouter(Logger):
     # -- routing -------------------------------------------------------------
     def _attempt(self, replica: Replica, data: bytes, rid: str,
                  answered: _Answer, state: _Attempt,
-                 timeout: float) -> None:
+                 timeout: float, prefix: Sequence[int] = (),
+                 base_k: int = 0) -> None:
         try:
             fire_fault("router.replica_request")
         except FaultInjected as e:
@@ -551,14 +665,55 @@ class FleetRouter(Logger):
             retry_after = e.headers.get("Retry-After")
         except Exception as e:      # noqa: BLE001 — the failure class
             # connection refused/reset, timeout, torn response: the
-            # replica is (acting) dead — fail over
+            # replica is (acting) dead — fail over (from scratch: a
+            # dropped connection carries no progress)
             state.fail("%s: %s" % (type(e).__name__, e))
             return
         if status >= 500:
+            # a dying gasp / drain handoff 503 carries the attempt's
+            # emitted-token prefix — the routing loop folds it into
+            # the NEXT attempt's resume_tokens so the failover
+            # re-enters the decode at tokens_done, not token 0.
+            # Validated ELEMENT-wise here: a garbage gasp from a
+            # misbehaving replica must degrade to a from-scratch
+            # retry, never throw inside route()/the replay thread
+            resume = (body or {}).get("resume")
+            if isinstance(resume, dict) \
+                    and isinstance(resume.get("tokens"), list):
+                try:
+                    state.resume_payload = {
+                        "tokens": [int(t) for t in resume["tokens"]]}
+                except (TypeError, ValueError):
+                    pass
             state.fail("replica %s answered %d (%s)"
                        % (replica.url, status,
                           (body or {}).get("error", "")))
             return
+        if status == 409 and prefix:
+            # the replica cannot honor this resume (no continuous
+            # engine, geometry overflow): drop the prefix, the loop
+            # retries from scratch — a 409 is an answer about the
+            # RESUME, not about the replica's health, so it neither
+            # advances the breaker nor burns the replica's roster
+            # slot (the loop re-admits it for the scratch retry)
+            state.drop_resume = True
+            state.fail_benign("replica %s cannot resume (%s)"
+                              % (replica.url,
+                                 (body or {}).get("error", "")))
+            return
+        if status == 200 and (prefix or base_k) \
+                and isinstance(body.get("tokens"), list):
+            # stitch the resumed answer: the replica decoded only the
+            # remaining budget — prepend the prefix, then drop the
+            # first base_k tokens (a CLIENT-supplied resume base is
+            # the client's own context: they asked for the remaining
+            # n_new, not a re-delivery of what they already hold; a
+            # dropped-and-redone base is sliced off the full redo the
+            # same way, id-exact for seeded modes)
+            stitched = [int(t) for t in prefix] + body["tokens"]
+            body = dict(body, tokens=stitched[base_k:])
+            if len(prefix) > base_k:
+                body["resumed_from"] = len(prefix)
         # 2xx–4xx: the replica's answer, deliver as-is (first wins).
         # Offer BEFORE settling: settle notifies the routing loop,
         # and a loop that wakes to a settled-but-unanswered attempt
@@ -575,11 +730,35 @@ class FleetRouter(Logger):
     def route(self, body: Dict) -> _Answer:
         """Route one parsed request body with health-gated selection,
         breaker-aware failover and the exactly-once answer latch.
-        Returns the latch — ``done`` False means no replica could
-        answer inside the budget (the HTTP face sheds 503)."""
+        A failed attempt whose answer carried resume progress (a
+        dying gasp, a drain handoff) makes the next attempt a
+        token-level RESUME: ``resume_tokens`` + the remaining
+        ``n_new`` ride the retry body, and the final answer is
+        stitched back to the full sequence. Returns the latch —
+        ``done`` False means no replica could answer inside the
+        budget (the HTTP face sheds 503)."""
         rid = body.get("request_id") or new_request_id()
         body = dict(body, request_id=rid)
-        data = json.dumps(body).encode()
+        mode = str(body.get("mode", "greedy"))
+        resumable = mode in _RESUMABLE_MODES
+        # total generation budget: a client/replayed body may itself
+        # carry a resume prefix (its n_new is then the REMAINING
+        # budget). Unparsable resume/n_new disables router-side
+        # resume handling entirely — the body forwards as-is and the
+        # replica answers the 400
+        prefix: List[int] = []
+        total_new = None
+        try:
+            prefix = [int(t) for t in
+                      (body.get("resume_tokens") or ())]
+            total_new = int(body.get("n_new", 16)) + len(prefix)
+        except (TypeError, ValueError):
+            prefix = []
+        else:
+            body.pop("resume_tokens", None)
+        #: the CLIENT's own resume base: sliced off the final answer
+        #: (they asked for the remaining n_new, not a re-delivery)
+        base_k = len(prefix)
         inc("veles_router_requests_total")
         answered = _Answer()
         answered.request_id = rid
@@ -597,15 +776,27 @@ class FleetRouter(Logger):
                 break
             if tried:
                 inc("veles_router_failovers_total")
-                self.info("%s: failing %s over to %s (%s)", self.name,
-                          rid, replica.url, last_reason)
+                self.info("%s: failing %s over to %s (%s)%s",
+                          self.name, rid, replica.url, last_reason,
+                          " resuming at token %d" % len(prefix)
+                          if prefix else "")
             tried.append(replica)
             inc("veles_router_attempts_total")
+            attempt_body = dict(body)
+            if total_new is not None:
+                # n_new is recomputed from the TOTAL budget every
+                # attempt: a dropped prefix (409) must widen the
+                # retry back to a full redo, never deliver short
+                attempt_body["n_new"] = total_new - len(prefix)
+                if prefix:
+                    attempt_body["resume_tokens"] = list(prefix)
+                    inc("veles_resume_attempts_total")
+            data = json.dumps(attempt_body).encode()
             state = _Attempt(replica, answered)
             threading.Thread(
                 target=self._attempt,
                 args=(replica, data, rid, answered, state,
-                      max(0.1, remaining)),
+                      max(0.1, remaining), tuple(prefix), base_k),
                 daemon=True,
                 name="%s.attempt" % self.name).start()
             # wait for THIS attempt to settle, anyone to answer, or
@@ -623,6 +814,20 @@ class FleetRouter(Logger):
                 break
             if state.settled and state.failed:
                 last_reason = state.reason or "replica failure"
+                if state.drop_resume:
+                    # the 409 replica is healthy — give its roster
+                    # slot back so the from-scratch retry may land
+                    # on it again
+                    prefix = []
+                    if replica in tried:
+                        tried.remove(replica)
+                elif resumable and total_new is not None \
+                        and state.resume_payload is not None:
+                    gained = [int(t) for t in
+                              state.resume_payload["tokens"]]
+                    if gained and len(prefix) + len(gained) \
+                            < total_new:
+                        prefix = prefix + gained
                 continue
             if not state.settled:
                 if state.fail("attempt timed out after %.1fs on %s"
@@ -638,7 +843,7 @@ class FleetRouter(Logger):
         ready = sum(1 for r in self.replicas if r.ready)
         open_breakers = sum(1 for r in self.replicas
                             if r.breaker.state != CircuitBreaker.CLOSED)
-        return {
+        gauges = {
             "veles_router_replicas":
                 (len(self.replicas), "Replica endpoints this router "
                                      "fans out over"),
@@ -655,6 +860,14 @@ class FleetRouter(Logger):
                  "1 while the router is draining (admission "
                  "stopped, in-flight finishing)"),
         }
+        if self.journal is not None:
+            gauges["veles_router_journal_pending"] = (
+                max(0, self._journal_outstanding),
+                "Journaled requests admitted but not yet terminal "
+                "(in flight or awaiting replay)")
+            gauges["veles_router_journal_enabled"] = (
+                1, "1 when the durable request journal is on")
+        return gauges
 
     def roster(self) -> Dict[str, Any]:
         """The live replica roster — saved to a file this is directly
@@ -725,6 +938,29 @@ class FleetRouter(Logger):
                     json_reply(self, 400,
                                {"error": "bad request: %s" % e})
                     return
+                # the durability boundary: the request exists in the
+                # journal BEFORE its first dispatch, so a router
+                # SIGKILL after this line loses nothing — restart
+                # replays it. An injected append failure refuses the
+                # admission (shed, with the id) rather than accept a
+                # request durability cannot cover.
+                rid = body.get("request_id") or new_request_id()
+                body = dict(body, request_id=rid)
+                if router.journal is not None:
+                    try:
+                        router.journal.admit(rid, body, time.time())
+                    except Exception as e:  # noqa: BLE001 — fail closed
+                        # durability contract: cannot journal ⇒ do
+                        # not accept — an injected append fault and a
+                        # real I/O error (ENOSPC, read-only dir)
+                        # shed alike, never acknowledge un-journaled
+                        health.shed(self, retry_after=1.0,
+                                    reason="request journal "
+                                           "unavailable: %s" % e,
+                                    request_id=rid)
+                        return
+                    with router._cv:
+                        router._journal_outstanding += 1
                 with router._cv:
                     router._inflight += 1
                 try:
@@ -734,6 +970,32 @@ class FleetRouter(Logger):
                         router._inflight -= 1
                         router.requests_routed += 1
                         router._cv.notify_all()
+                # the answer — success and shed alike — is terminal:
+                # replay must never re-run it. (A route that RAISED
+                # never reaches this line: the entry stays pending
+                # and the next start replays it, idempotent by id.)
+                if router.journal is not None:
+                    try:
+                        router.journal.done(
+                            rid,
+                            int(answered.status) if answered.done
+                            else 503,
+                            "answered" if answered.done
+                            else "unanswered")
+                        with router._cv:
+                            router._journal_outstanding -= 1
+                    except Exception as e:  # noqa: BLE001
+                        # a failed terminal append (injected fault,
+                        # full disk) must NOT drop the answer we
+                        # already computed — the client still gets
+                        # its reply below; the entry stays pending
+                        # (and counted in the gauge) so a restart
+                        # re-runs it idempotently by id
+                        router.warning(
+                            "%s: journal terminal for %s failed "
+                            "(%s: %s); the entry stays pending — a "
+                            "restart replays it idempotently",
+                            router.name, rid, type(e).__name__, e)
                 if not answered.done:
                     health.shed(
                         self, retry_after=1.0,

@@ -36,6 +36,13 @@ from .pages import PagePool, pages_for
 
 _request_ids = itertools.count(1)
 
+#: THE decode modes whose emitted-token prefix a failover retry can
+#: resume: their per-slot PRNG stream advances exactly one split per
+#: emitted token, so a resumed prefill re-enters it mid-decode.
+#: Single source of truth — the engine's step plane, the router's
+#: fold logic and Ticket.set_progress all import this tuple.
+RESUME_MODES = ("greedy", "sample")
+
 
 def new_request_id() -> str:
     """Process-unique serving request id, assigned at API admission
@@ -84,7 +91,7 @@ class Ticket:
     __slots__ = ("event", "result", "error", "code", "retry_after",
                  "deadline", "enqueued", "request_id", "mode",
                  "admitted", "prefill_done", "first_token",
-                 "n_tokens", "outcome", "_terminal_lock")
+                 "n_tokens", "outcome", "progress", "_terminal_lock")
 
     def __init__(self, deadline: Optional[float] = None,
                  request_id: Optional[str] = None,
@@ -104,6 +111,9 @@ class Ticket:
         self.first_token: Optional[float] = None
         self.n_tokens = 0
         self.outcome: Optional[str] = None
+        #: tokens emitted before a mid-decode failure/handoff — the
+        #: token-level resume record a failover retry continues from
+        self.progress: Optional[List[int]] = None
 
     # -- lifecycle stamps (host-side, step boundaries only) ------------------
     def mark_admitted(self) -> None:
@@ -127,6 +137,20 @@ class Ticket:
     def mark_first_token(self) -> None:
         if self.first_token is None:
             self.first_token = time.time()
+
+    def set_progress(self, tokens) -> None:
+        """Attach the emitted-token prefix BEFORE a terminal
+        :meth:`fail` — the failure answer then carries
+        ``{resume: {tokens, tokens_done}}`` so a router retry can
+        continue the decode from ``tokens_done`` instead of token 0.
+        Only the plain decode modes resume (greedy/sample own a
+        per-slot PRNG stream a resumed prefill can re-enter exactly;
+        speculative/beam and the window plane retry from scratch), so
+        other modes never attach progress. No-op after terminal."""
+        if self.mode not in RESUME_MODES:
+            return
+        if not self.event.is_set():
+            self.progress = [int(t) for t in tokens]
 
     # -- terminal (exactly once) ---------------------------------------------
     def fail(self, error: str, code: int = 500,
@@ -176,6 +200,13 @@ class Ticket:
                       "request_id": self.request_id}
         if self.retry_after is not None:
             body["retry_after"] = self.retry_after
+        if self.progress:
+            # the token-level resume record: this ATTEMPT's emitted
+            # tokens (a resumed attempt reports only its own new
+            # tokens — the router accumulates prefixes across
+            # attempts), continuing the same per-slot PRNG stream
+            body["resume"] = {"tokens": list(self.progress),
+                              "tokens_done": len(self.progress)}
         return body
 
     def _account(self, outcome: str) -> None:
@@ -610,10 +641,14 @@ class SlotScheduler:
 
     def drain(self, reason: str, code: int = 503,
               retry_after: Optional[float] = 5.0) -> int:
-        """Fail every queued ticket (shutdown); returns the count."""
+        """Fail every queued ticket (shutdown / drain-by-handoff);
+        returns the number of FIRST-terminal settles — a ticket some
+        other sweep already answered is popped but never re-counted."""
         with self.cv:
             pending = list(self._queue)
             self._queue.clear()
+        settled = 0
         for _req, ticket in pending:
-            ticket.fail(reason, code=code, retry_after=retry_after)
-        return len(pending)
+            if ticket.fail(reason, code=code, retry_after=retry_after):
+                settled += 1
+        return settled

@@ -195,6 +195,32 @@ DESCRIPTIONS = {
         "answered by a failover)",
     "veles_router_respawns_total":
         "Dead serving replicas respawned by the ReplicaSupervisor",
+    # lossless request plane (serving/journal.py + token-level
+    # failover resume + drain-by-handoff): bench.py's gate asserts
+    # these read 0 in non-fleet runs
+    "veles_journal_appends_total":
+        "Records durably appended to the router's request journal "
+        "(admissions + terminals, fsync'd before dispatch/reply)",
+    "veles_journal_replayed_total":
+        "Journaled requests re-dispatched by a restarted router "
+        "(admitted before a crash, unanswered at restart)",
+    "veles_journal_salvaged_total":
+        "Torn or corrupt journal records quarantined with a warning "
+        "at replay (mid-write truncation, bitrot, injected "
+        "router.journal corruption) — never a refused start",
+    "veles_journal_compactions_total":
+        "Journal rotations that rewrote the live (unanswered) "
+        "entries into a fresh fsync'd segment and dropped the rest",
+    "veles_resume_attempts_total":
+        "Failover attempts dispatched with resume_tokens (the retry "
+        "continues from tokens_done instead of re-decoding)",
+    "veles_resume_tokens_total":
+        "Tokens carried into a resumed decode instead of being "
+        "re-decoded (the failover savings, summed over resumes)",
+    "veles_handoff_requests_total":
+        "In-flight requests a draining replica handed back with "
+        "progress (503 + resume) instead of aborting or riding out "
+        "the full generation",
 }
 
 
