@@ -665,6 +665,13 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # the gate fails on leakage; the resumed-decode-cheaper-than-
         # redo measurement is the gate's live lossless proof
         "lossless": _lossless_section(),
+        # fleet tracing (telemetry/spans.py ring pulls + fleet.py
+        # assembly): the bench never serves, pulls or merges, so the
+        # request/route span count and the pull/rotation/merge
+        # counters MUST be zero here — the gate fails on leakage;
+        # the one-merged-trace-across-a-replica-death measurement is
+        # the gate's live tracing proof
+        "tracing": _tracing_section(),
         "extras": [ae, lm],
     }
 
@@ -793,6 +800,32 @@ def _lossless_section():
             counters.get("veles_resume_tokens_total")),
         "handoff_requests": int(
             counters.get("veles_handoff_requests_total")),
+    }
+
+
+def _tracing_section():
+    """{requests_traced, request_spans, span_pulls, rotations,
+    fleet_merges} for this bench process — absolute reads (one
+    process, counters start at zero). The bench never serves or
+    routes, so the request-plane span count in the ring and every
+    tracing counter MUST be zero — ``bench.py gate`` fails on
+    leakage (``requests_traced`` is the config switch, information
+    not leakage)."""
+    from veles_tpu.config import root as vt_root
+    from veles_tpu.telemetry.counters import counters
+    from veles_tpu.telemetry.spans import recorder as span_recorder
+    request_spans = sum(
+        1 for r in span_recorder.records()
+        if str(r.get("name", "")).startswith(("request", "route.")))
+    return {
+        "requests_traced": bool(
+            vt_root.common.trace.get("requests", True)),
+        "request_spans": int(request_spans),
+        "span_pulls": int(
+            counters.get("veles_trace_span_pulls_total")),
+        "rotations": int(counters.get("veles_trace_rotations_total")),
+        "fleet_merges": int(
+            counters.get("veles_trace_fleet_merges_total")),
     }
 
 
@@ -2086,6 +2119,274 @@ def _lossless_resume_proof():
     return failures
 
 
+def gate_tracing(baseline_doc=None, current_doc=None):
+    """``tracing`` gate section: (1) the fleet-tracing counters must
+    be registered; (2) bench documents must carry ZERO tracing-plane
+    activity — the bench never serves, pulls a span ring or merges a
+    fleet trace, so request/route spans or pull/rotation/merge counts
+    in a training measurement mean the plane leaked; (3) live proof:
+    decode dispatch counts are bit-identical tracing on/off THROUGH
+    THE ROUTER PATH (the PR 11 per-process lock extended to the
+    fleet), with tracing off appending zero request-plane spans to
+    the ring; and a journaled 2-replica fleet under an injected
+    mid-decode replica death yields ONE merged Chrome trace where the
+    router's route.request/route.attempt spans and both replicas'
+    request spans carry the same trace_id, with the resume attempt's
+    tokens_done visible. Runs AFTER gate_fleet/gate_lossless in
+    _gate_main (their drills legitimately emit request spans), so
+    doc-leakage is asserted on the DOCUMENTS, never process-absolute
+    span counts."""
+    from veles_tpu.telemetry import TRACE_COUNTERS
+    from veles_tpu.telemetry.counters import DESCRIPTIONS
+    failures = []
+    for name in TRACE_COUNTERS:
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "tracing: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc),
+                     ("current", current_doc)):
+        sec = (doc or {}).get("tracing")
+        if not sec:
+            continue
+        if ((doc or {}).get("serving") or {}).get("serving_bench"):
+            # a serving-mode bench document SERVES on purpose — its
+            # request spans are the measurement, not a leak (the
+            # same skip gate_serving applies to its leakage keys)
+            continue
+        for key in ("request_spans", "span_pulls", "rotations",
+                    "fleet_merges"):
+            if sec.get(key):
+                failures.append(
+                    "tracing: %s doc has %s=%s — request-plane "
+                    "tracing leaked into a non-serving bench run"
+                    % (tag, key, sec[key]))
+    return failures + _fleet_trace_proof()
+
+
+def _fleet_trace_proof():
+    """THE fleet-tracing drill, live: two in-process GenerationAPI
+    replicas behind a JOURNALED FleetRouter. First the dispatch lock:
+    the same sequential load routed with tracing ON and OFF must move
+    the decode/prefill dispatch counters identically (tracing is
+    host-side stamps, never device work — now proven through the
+    router too) and tracing OFF must append zero request/route spans
+    to the ring. Then the merge: ``serve.replica_death`` kills one
+    replica mid-decode; the answer must be id-exact with
+    ``resumed_from >= 1``, and pulling /trace/spans from the router +
+    the survivor and assembling with ``--request <trace_id>``
+    semantics must yield ONE valid Chrome trace carrying
+    route.request, >= 2 route.attempt spans (the resume attempt's
+    tokens_done >= 1), and both replicas' request spans — every
+    event under the same trace_id — with the journal left clean."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import shutil
+    import tempfile
+    import char_lm
+    import veles_tpu as vt
+    from veles_tpu import prng
+    from veles_tpu.config import root as vt_root
+    from veles_tpu.nn import sampling
+    from veles_tpu.serving.router import FleetRouter
+    from veles_tpu.telemetry import fleet as vt_fleet
+    from veles_tpu.telemetry.counters import counters as _ctrs
+    from veles_tpu.telemetry.spans import recorder as span_recorder
+
+    prng.seed_all(7171)
+    wf = char_lm.build_workflow(epochs=1, minibatch_size=32,
+                                n_blocks=1, dim=32, n_train=64,
+                                n_valid=32)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    apis = [vt.GenerationAPI(wf, port=0, engine="continuous",
+                             max_slots=2, buckets=(8, 16, 32),
+                             max_context=48, name="trace_bench_%d" % i)
+            for i in range(2)]
+    for api in apis:
+        api.initialize()
+    failures = []
+    prompt = [1, 5, 3, 2, 4]
+    n_new = 12
+    expected = sampling.generate(wf, prompt, n_new, temperature=0)
+    journal_dir = tempfile.mkdtemp(prefix="veles_trace_gate_")
+    saved_spec = os.environ.get("VELES_FAULTS")
+    prev_traced = vt_root.common.trace.get("requests", True)
+    router = None
+    try:
+        router = FleetRouter(
+            ["127.0.0.1:%d" % api.port for api in apis],
+            probe_interval=0.2, failure_threshold=1, retry_budget=2,
+            attempt_timeout=60.0, request_timeout=120.0,
+            journal_dir=journal_dir, journal_fsync=False,
+            name="trace.router").start()
+        import json as _json
+        import urllib.error as _er
+        import urllib.request as _rq
+        url = "http://127.0.0.1:%d/generate" % router.port
+
+        def post(payload, to=url):
+            req = _rq.Request(to,
+                              data=_json.dumps(payload).encode(),
+                              headers={"Content-Type":
+                                       "application/json"})
+            try:
+                with _rq.urlopen(req, timeout=90) as r:
+                    return r.status, _json.loads(r.read())
+            except _er.HTTPError as e:
+                try:
+                    return e.code, _json.loads(e.read() or b"{}")
+                except ValueError:
+                    return e.code, {"error": "replica answered %d"
+                                    % e.code}
+
+        # warm BOTH replicas' programs outside any measured window
+        for api in apis:
+            status, body = post(
+                {"prompt": prompt, "n_new": 4},
+                to="http://127.0.0.1:%d/generate" % api.port)
+            if status != 200:
+                failures.append("tracing: warm-up answered %d (%s)"
+                                % (status, body.get("error")))
+
+        # -- dispatch lock, router path: tracing on == tracing off ----
+        keys = ("veles_serving_decode_dispatches_total",
+                "veles_serving_prefill_dispatches_total",
+                "veles_decode_dispatches_total")
+
+        def load():
+            outs = []
+            for _ in range(3):
+                status, body = post({"prompt": prompt, "n_new": 4})
+                outs.append((status, body.get("tokens")))
+            return outs
+
+        def measured(fn):
+            before = {k: _ctrs.get(k) for k in keys}
+            out = fn()
+            return out, {k: _ctrs.get(k) - before[k] for k in keys}
+
+        vt_root.common.trace.requests = True
+        out_on, d_on = measured(load)
+        ring_cursor = span_recorder.cursor()
+        vt_root.common.trace.requests = False
+        out_off, d_off = measured(load)
+        off_spans, _ = span_recorder.records_since(ring_cursor)
+        off_leak = [r["name"] for r in off_spans
+                    if str(r.get("name", "")).startswith(
+                        ("request", "route."))]
+        vt_root.common.trace.requests = True
+        if out_on != out_off:
+            failures.append(
+                "tracing: answers differ tracing on vs off through "
+                "the router (%s vs %s)" % (out_on, out_off))
+        if d_on != d_off:
+            failures.append(
+                "tracing: dispatch counts differ tracing on vs off "
+                "through the router path (%s vs %s) — tracing moved "
+                "device work" % (d_on, d_off))
+        if off_leak:
+            failures.append(
+                "tracing: %d request-plane span(s) %s appended to "
+                "the ring with root.common.trace.requests OFF"
+                % (len(off_leak), sorted(set(off_leak))))
+
+        # -- the merged-trace drill: death mid-decode -> ONE trace ----
+        merges = _ctrs.get("veles_trace_fleet_merges_total")
+        pulls = _ctrs.get("veles_trace_span_pulls_total")
+        os.environ["VELES_FAULTS"] = \
+            "serve.replica_death:raise:after=4,times=1"
+        status, body = post({"prompt": prompt, "n_new": n_new})
+        os.environ.pop("VELES_FAULTS", None)
+        if status != 200:
+            failures.append("tracing: death-drill request answered "
+                            "%d (%s)" % (status, body.get("error")))
+            return failures
+        if body.get("tokens") != expected:
+            failures.append("tracing: resumed tokens differ from the "
+                            "solo decode")
+        if int(body.get("resumed_from", 0)) < 1:
+            failures.append("tracing: the failover never resumed — "
+                            "no tokens_done to show in the trace")
+        tid = body.get("trace_id")
+        if not tid:
+            failures.append("tracing: the router's answer carries no "
+                            "trace_id")
+            return failures
+        endpoints = ["127.0.0.1:%d" % router.port] + \
+            ["127.0.0.1:%d" % api.port for api in apis
+             if api._service is not None]
+        try:
+            doc, summary = vt_fleet.trace_fleet(endpoints,
+                                                request=tid)
+        except ValueError as e:
+            failures.append("tracing: fleet trace assembly failed "
+                            "(%s)" % e)
+            return failures
+        # (assemble_fleet_trace already schema-validated the doc —
+        # an invalid merge raises and lands in the branch above)
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        names = [e["name"] for e in evs]
+        if "route.request" not in names:
+            failures.append("tracing: merged trace lacks the "
+                            "route.request root span")
+        attempts = [e for e in evs if e["name"] == "route.attempt"]
+        if len(attempts) < 2:
+            failures.append(
+                "tracing: merged trace holds %d route.attempt "
+                "span(s); the failover needs >= 2" % len(attempts))
+        if not any(int(e["args"].get("tokens_done", 0)) >= 1
+                   for e in attempts):
+            failures.append(
+                "tracing: no route.attempt span shows the resume's "
+                "tokens_done")
+        req_spans = [e for e in evs if e["name"] == "request"]
+        span_attempts = {int(e["args"].get("attempt", 0))
+                         for e in req_spans}
+        if not {1, 2} <= span_attempts:
+            failures.append(
+                "tracing: merged trace lacks both replicas' request "
+                "spans (attempts seen: %s)" % sorted(span_attempts))
+        wrong = [e["name"] for e in evs
+                 if e["args"].get("trace_id") not in (None, tid)]
+        if wrong:
+            failures.append(
+                "tracing: merged trace carries foreign trace_ids on "
+                "%s" % sorted(set(wrong)))
+        if all("trace_id" not in e["args"] for e in evs):
+            failures.append("tracing: no event in the merged trace "
+                            "is tagged with the trace_id")
+        if _ctrs.get("veles_trace_fleet_merges_total") - merges < 1:
+            failures.append("tracing: the merge was never counted")
+        if _ctrs.get("veles_trace_span_pulls_total") - pulls \
+                < len(endpoints):
+            failures.append("tracing: fewer span pulls counted than "
+                            "endpoints pulled")
+        pending = router.journal.pending()
+        if pending:
+            failures.append(
+                "tracing: %d journal entr%s left pending after the "
+                "drill" % (len(pending),
+                           "y" if len(pending) == 1 else "ies"))
+        if not failures:
+            print("tracing proof: router-path dispatches identical "
+                  "tracing on/off; death at token %d of %d -> ONE "
+                  "merged trace (%d spans, %d lane(s)) under "
+                  "trace_id %s with the resume visible"
+                  % (int(body.get("resumed_from", 0)), n_new,
+                     summary["spans"], summary["processes"], tid))
+    finally:
+        if saved_spec is None:
+            os.environ.pop("VELES_FAULTS", None)
+        else:
+            os.environ["VELES_FAULTS"] = saved_spec
+        vt_root.common.trace.requests = prev_traced
+        if router is not None:
+            router.stop()
+        for api in apis:
+            api.stop()
+        shutil.rmtree(journal_dir, ignore_errors=True)
+    return failures
+
+
 def gate_quant(baseline_doc=None, current_doc=None):
     """``quant`` gate section: (1) the quantization/artifact counters
     must be registered; (2) quant-off bench documents must carry ZERO
@@ -2392,6 +2693,10 @@ def _gate_main(argv):
                 # legitimately move the resume counters, so the
                 # lossless gate asserts deltas, never process zeros
                 + gate_lossless(baseline, current)
+                # AFTER the fleet/lossless drills: their request
+                # spans legitimately live in the ring, so the tracing
+                # gate asserts doc leakage + its own live proof
+                + gate_tracing(baseline, current)
                 + gate_quant(baseline, current))
     for failure in failures:
         print("GATE FAIL %s" % failure, file=sys.stderr)
@@ -2407,7 +2712,9 @@ def _gate_main(argv):
           "clean + continuous "
           "batching beats the window baseline, fleet counters clean "
           "+ 2-replica failover drill exactly-once, lossless clean "
-          "+ journaled resume id-exact and cheaper than redo, quant "
+          "+ journaled resume id-exact and cheaper than redo, "
+          "tracing clean + router-path dispatch lock + one merged "
+          "fleet trace across a replica death, quant "
           "clean + int8 greedy token-exact + artifact serves with "
           "zero compiles)"
           % (argv[1], argv[0],

@@ -55,6 +55,16 @@ HIST_USE_RE = re.compile(
 #: purpose and are excluded)
 SCAN = ("veles_tpu", "scripts", "bench.py")
 
+#: the operator-facing registry mirror: every REGISTERED veles_*
+#: counter/histogram must have a row here (the --docs pass)
+DOCS_MD = os.path.join(REPO, "docs", "observability.md")
+
+#: a veles_* name as the docs spell it — either literal, or with ONE
+#: brace group (`veles_journal_{appends,replayed}_total`), which the
+#: docs pass expands so prose families count as documented
+DOC_NAME_RE = re.compile(
+    r"veles_[a-z0-9_]*(?:\{[a-z0-9_,]+\}[a-z0-9_]*)?")
+
 
 def registered_counters(path: str = COUNTERS_PY) -> set:
     """Keys of the DESCRIPTIONS dict, read via AST (no import)."""
@@ -167,7 +177,42 @@ def find_unregistered_histograms():
                   if not known.get(name, False))
 
 
+def documented_names(path: str = DOCS_MD) -> set:
+    """Every veles_* name docs/observability.md mentions, brace
+    families (`veles_resume_{attempts,tokens}_total`) expanded."""
+    with open(path, errors="replace") as fin:
+        text = fin.read()
+    out = set()
+    for token in DOC_NAME_RE.findall(text):
+        if "{" in token:
+            head, rest = token.split("{", 1)
+            group, tail = rest.split("}", 1)
+            for part in group.split(","):
+                out.add(head + part + tail)
+        else:
+            out.add(token)
+    return out
+
+
+def find_undocumented(path: str = DOCS_MD):
+    """[(name, kind)] for every REGISTERED counter/histogram that
+    docs/observability.md never mentions — the --docs pass (a
+    registered metric an operator cannot look up is observability
+    debt; this catches the drift at CI time, like the registration
+    pass catches unregistered names)."""
+    docs = documented_names(path)
+    missing = [(name, "counter")
+               for name in sorted(registered_counters())
+               if name not in docs]
+    missing += [(name, "histogram")
+                for name in sorted(registered_histograms())
+                if name not in docs]
+    return missing
+
+
 def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    check_docs = "--docs" in argv
     missing = find_unregistered()
     for name, site in missing:
         print("UNREGISTERED counter %s (first use: %s)" % (name, site),
@@ -177,16 +222,25 @@ def main(argv=None) -> int:
         print("UNREGISTERED histogram %s (first use: %s) — needs a "
               "HISTOGRAMS entry with help AND bucket bounds"
               % (name, site), file=sys.stderr)
-    if missing or missing_hist:
+    undocumented = find_undocumented() if check_docs else []
+    for name, kind in undocumented:
+        print("UNDOCUMENTED %s %s — registered in telemetry/"
+              "counters.py but missing from docs/observability.md"
+              % (kind, name), file=sys.stderr)
+    if missing or missing_hist or undocumented:
         print("%d counter(s) / %d histogram(s) used but not "
-              "registered in telemetry/counters.py"
-              % (len(missing), len(missing_hist)), file=sys.stderr)
+              "registered in telemetry/counters.py%s"
+              % (len(missing), len(missing_hist),
+                 "; %d registered name(s) undocumented"
+                 % len(undocumented) if undocumented else ""),
+              file=sys.stderr)
         return 1
     print("counter registration OK (%d counters registered, %d "
           "distinct names used; %d histograms registered, %d "
-          "observed)"
+          "observed%s)"
           % (len(registered_counters()), len(used_counters()),
-             len(registered_histograms()), len(used_histograms())))
+             len(registered_histograms()), len(used_histograms()),
+             "; all documented" if check_docs else ""))
     return 0
 
 

@@ -1,0 +1,677 @@
+"""Fleet-wide distributed tracing (ISSUE 14): trace propagation,
+router spans, cross-process timeline assembly.
+
+The contract under test: (1) the router mints a ``trace_id`` per
+accepted request (request_id family) and forwards it — with the
+1-based ``attempt`` number — on every attempt; the replica Ticket
+adopts both and every request lifecycle span / flight event / journal
+record carries them; (2) the router's own decisions are spans —
+``route.request`` (root), ``route.attempt`` (endpoint, outcome,
+status, resume ``tokens_done``), ``route.backoff`` (scheduled breaker
+hold), ``route.probe`` (half-open recovery try), ``route.replay``
+(journal tail) — gated by ``root.common.trace.requests`` exactly like
+the replica spans; (3) every request-plane HTTP surface serves its
+bounded span ring at ``GET /trace/spans?since=CURSOR`` (JSONL, torn
+reads salvage per record), and ``veles-tpu trace fleet`` pulls
+router + replicas, estimates per-process clock offsets by bracketing
+alignment (route.attempt ⊇ replica request, matched on
+trace_id+attempt), and merges ONE Chrome trace with one lane per
+process; (4) the JSONL ``--trace-file`` rotates past
+``root.common.trace.rotate_bytes`` with a counted rotation; (5) a
+black-box dump filters by request (``blackbox inspect --request``);
+(6) ``scripts/check_counters.py --docs`` fails on any registered
+counter/histogram missing from docs/observability.md.
+
+THE acceptance drill: a journaled 2-replica fleet with an injected
+mid-decode replica death yields, via the ``trace fleet`` CLI, a
+single Chrome trace containing the router's route.request/
+route.attempt spans and BOTH replicas' request spans under one
+trace_id, the resume attempt's tokens_done visible — with decode
+dispatch counts proven bit-identical tracing on/off THROUGH THE
+ROUTER (the PR 11 lock extended to the fleet path).
+
+Budget discipline: everything above the drill is jax-free (fake HTTP
+replicas, fake span payloads, fake clocks); the drill shares one tiny
+char_lm workflow.
+"""
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+import veles_tpu as vt
+from veles_tpu.config import root
+from veles_tpu.serving.router import CircuitBreaker, FleetRouter
+from veles_tpu.serving.scheduler import Ticket, new_trace_id
+from veles_tpu.resilience.retry import RetryPolicy
+from veles_tpu.telemetry import fleet
+from veles_tpu.telemetry.counters import counters
+from veles_tpu.telemetry.spans import (pull_payload,
+                                       recorder as span_recorder)
+
+from conftest import import_model
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _post(url, payload, timeout=120.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+def _new_spans(cursor, *name_prefixes):
+    recs, nxt = span_recorder.records_since(cursor)
+    if name_prefixes:
+        recs = [r for r in recs
+                if str(r.get("name", "")).startswith(name_prefixes)]
+    return recs, nxt
+
+
+# -- trace_id propagation (no jax, no HTTP) -----------------------------------
+
+def test_new_trace_id_family_and_ticket_defaults():
+    tid = new_trace_id()
+    assert tid.startswith("trace-%d-" % os.getpid())
+    t = Ticket()
+    # no router involved: the request's own id IS its trace
+    assert t.trace_id == t.request_id
+    assert t.attempt == 1
+    t2 = Ticket(trace_id="trace-9-9", attempt=3)
+    assert t2.trace_id == "trace-9-9" and t2.attempt == 3
+
+
+def test_ticket_lifecycle_spans_carry_trace_id_and_attempt():
+    from veles_tpu.telemetry.recorder import flight
+    prev = root.common.trace.get("requests", True)
+    root.common.trace.requests = True
+    cursor = span_recorder.cursor()
+    try:
+        t = Ticket(trace_id="trace-7-7", attempt=2, mode="greedy")
+        t.mark_admitted()
+        t.mark_prefill_done()
+        t.mark_first_token()
+        assert t.succeed({"tokens": [1, 2, 3]})
+    finally:
+        root.common.trace.requests = prev
+    recs, _ = _new_spans(cursor, "request")
+    mine = [r for r in recs if r.get("request_id") == t.request_id]
+    names = {r["name"] for r in mine}
+    assert {"request", "request.queue", "request.prefill",
+            "request.decode"} <= names
+    for r in mine:
+        assert r["trace_id"] == "trace-7-7"
+        assert r["attempt"] == 2
+    done = [r for r in flight.records(kind="request")
+            if r.get("request_id") == t.request_id]
+    assert done
+    assert all(r.get("trace_id") == "trace-7-7"
+               and r.get("attempt") == 2 for r in done)
+
+
+def test_generation_api_parse_adopts_trace_and_attempt():
+    wf = vt.Workflow(name="parse_wf")
+    api = vt.GenerationAPI(wf, engine="window", name="parse_api")
+    req = api._parse({"prompt": [1, 2], "n_new": 2,
+                      "trace_id": "trace-1-5", "attempt": 2,
+                      "request_id": "req-1-5"})
+    assert req["trace_id"] == "trace-1-5" and req["attempt"] == 2
+    with pytest.raises(ValueError):
+        api._parse({"prompt": [1], "n_new": 2, "trace_id": ""})
+    with pytest.raises(ValueError):
+        api._parse({"prompt": [1], "n_new": 2, "attempt": 0})
+    with pytest.raises(ValueError):
+        api._parse({"prompt": [1], "n_new": 2, "attempt": True})
+
+
+def test_journal_records_carry_trace_id(tmp_path):
+    from veles_tpu.serving.journal import RequestJournal
+    j = RequestJournal(str(tmp_path), fsync=False)
+    j.admit("req-a", {"prompt": [1], "trace_id": "trace-a"}, 100.0,
+            trace_id="trace-a")
+    j.done("req-a", 200, trace_id="trace-a", attempts=2)
+    admits, terminals = j.replay()
+    assert admits["req-a"]["trace_id"] == "trace-a"
+    assert terminals["req-a"]["trace_id"] == "trace-a"
+    assert terminals["req-a"]["attempts"] == 2
+
+
+# -- span ring: cursor pulls, rotation (no jax) -------------------------------
+
+def test_span_ring_cursor_and_pull_payload():
+    from veles_tpu.telemetry.spans import emit
+    cursor = span_recorder.cursor()
+    pulls = counters.get("veles_trace_span_pulls_total")
+    emit("t.alpha", 100.0, 0.5, request_id="req-c-1")
+    emit("t.beta", 101.0, 0.5, request_id="req-c-2")
+    recs, nxt = span_recorder.records_since(cursor)
+    assert [r["name"] for r in recs] == ["t.alpha", "t.beta"]
+    assert nxt == recs[-1]["seq"]
+    # incremental: the new cursor yields nothing until more appends
+    assert span_recorder.records_since(nxt)[0] == []
+    payload = pull_payload(cursor, name="unit")
+    assert counters.get("veles_trace_span_pulls_total") - pulls == 1
+    parsed = fleet.parse_span_payload(payload)
+    assert parsed["header"]["pid"] == os.getpid()
+    assert parsed["header"]["name"] == "unit"
+    assert parsed["header"]["cursor"] == nxt
+    assert [r["name"] for r in parsed["spans"]] \
+        == ["t.alpha", "t.beta"]
+
+
+def test_torn_span_payload_salvages_with_warning(caplog):
+    from veles_tpu.telemetry.spans import emit
+    cursor = span_recorder.cursor()
+    for i in range(4):
+        emit("t.torn", 100.0 + i, 0.1, i=i)
+    payload = pull_payload(cursor)
+    # cut mid-way through the LAST record: the complete prefix lives
+    torn = payload[:payload.rindex('{"')] + '{"name": "t.to'
+    import logging
+    with caplog.at_level(logging.WARNING, "veles_tpu.telemetry"):
+        parsed = fleet.parse_span_payload(torn)
+    assert parsed["bad"] == 1
+    assert [r["i"] for r in parsed["spans"]] == [0, 1, 2]
+    assert any("torn" in rec.message or "skipped" in rec.message
+               for rec in caplog.records)
+
+
+def test_trace_file_rotation_counted(tmp_path):
+    from veles_tpu.telemetry.spans import emit
+    prev = root.common.trace.get("rotate_bytes", None)
+    root.common.trace.rotate_bytes = 1500
+    path = str(tmp_path / "run.jsonl")
+    rotations = counters.get("veles_trace_rotations_total")
+    try:
+        span_recorder.set_sink(path)
+        for i in range(40):
+            emit("t.rot", 100.0 + i, 0.1, i=i,
+                 padding="x" * 40)
+    finally:
+        span_recorder.set_sink(None)
+        if prev is None:
+            root.common.trace.rotate_bytes = 64 << 20
+        else:
+            root.common.trace.rotate_bytes = prev
+    assert counters.get("veles_trace_rotations_total") - rotations >= 1
+    assert os.path.exists(path + ".1")       # segment-drop pattern
+    assert os.path.getsize(path) < 3000      # bounded, not history
+
+
+# -- /trace/spans HTTP endpoint (router; no replicas needed) ------------------
+
+def test_router_serves_trace_spans_endpoint():
+    from veles_tpu.telemetry.spans import emit
+    router = FleetRouter(["127.0.0.1:9"], probe_interval=5.0,
+                         probe_timeout=0.2,
+                         name="spans_ep_router").start()
+    try:
+        emit("t.http", 100.0, 0.5, request_id="req-h-1")
+        base = "http://127.0.0.1:%d" % router.port
+        with urllib.request.urlopen(base + "/trace/spans?since=0",
+                                    timeout=10) as r:
+            assert r.status == 200
+            assert "ndjson" in r.headers.get("Content-Type", "")
+            parsed = fleet.parse_span_payload(
+                r.read().decode())
+        assert parsed["header"]["name"].startswith("router.")
+        assert any(s["name"] == "t.http" for s in parsed["spans"])
+        cursor = parsed["header"]["cursor"]
+        # incremental pull from the returned cursor is empty
+        with urllib.request.urlopen(
+                base + "/trace/spans?since=%d" % cursor,
+                timeout=10) as r:
+            parsed2 = fleet.parse_span_payload(r.read().decode())
+        assert parsed2["spans"] == []
+        # a bad cursor is a 400, not a traceback
+        try:
+            urllib.request.urlopen(base + "/trace/spans?since=xyz",
+                                   timeout=10)
+            assert False, "expected 400"
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+    finally:
+        router.stop()
+
+
+# -- clock-offset estimation on fake clocks -----------------------------------
+
+def _fake_payload(url, pid, name, spans):
+    for i, rec in enumerate(spans, 1):
+        rec.setdefault("seq", i)
+        rec.setdefault("dur", 0.0)
+    return {"url": url, "spans": spans, "bad": 0,
+            "header": {"kind": "spans.header", "pid": pid,
+                       "name": name, "cursor": len(spans),
+                       "wall": 0.0}}
+
+
+def test_offset_estimation_recovers_known_skew_within_bound():
+    skew = 3.7          # replica clock AHEAD of the router's
+    router_spans = [
+        {"name": "route.request", "ts": 99.9, "dur": 6.0,
+         "trace_id": "t1", "request_id": "r1"},
+        {"name": "route.attempt", "ts": 100.0, "dur": 2.0,
+         "trace_id": "t1", "attempt": 1, "endpoint": "http://a"},
+        {"name": "route.attempt", "ts": 103.0, "dur": 2.4,
+         "trace_id": "t1", "attempt": 2, "endpoint": "http://b"},
+    ]
+    replica_spans = [
+        {"name": "request", "ts": 100.4 + skew, "dur": 1.4,
+         "trace_id": "t1", "attempt": 1, "request_id": "r1"},
+        {"name": "request", "ts": 103.5 + skew, "dur": 1.6,
+         "trace_id": "t1", "attempt": 2, "request_id": "r1"},
+    ]
+    payloads = [_fake_payload("router", 11, "router.r",
+                              router_spans),
+                _fake_payload("replica", 22, "serve.a",
+                              replica_spans)]
+    procs = fleet._group_processes(payloads)
+    offsets = fleet.estimate_offsets(procs)
+    assert offsets[11]["reference"] is True
+    est = offsets[22]
+    assert est["pairs"] == 2
+    # within the bracketing-span bound: the estimate can only be as
+    # tight as the attempt-minus-request slack
+    assert abs(est["offset"] - skew) <= est["bound"] / 2 + 1e-9
+    # assembly shifts the replica lane onto the router clock
+    doc, summary = fleet.assemble_fleet_trace(payloads)
+    assert summary["processes"] == 2
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    req = [e for e in evs if e["name"] == "request"
+           and e["args"].get("attempt") == 1][0]
+    att = [e for e in evs if e["name"] == "route.attempt"
+           and e["args"].get("attempt") == 1][0]
+    # corrected: the replica request event sits INSIDE its bracket
+    assert att["ts"] - 1e3 <= req["ts"] \
+        <= att["ts"] + att["dur"] + 1e3      # µs, 1ms slack
+    lanes = {e["pid"] for e in evs}
+    assert len(lanes) == 2                   # one lane per process
+
+
+def test_assembly_dedupes_shared_ring_and_filters_request():
+    spans = [
+        {"name": "route.request", "ts": 10.0, "dur": 1.0, "seq": 1,
+         "trace_id": "tA", "request_id": "rA"},
+        {"name": "request", "ts": 10.2, "dur": 0.5, "seq": 2,
+         "trace_id": "tA", "attempt": 1, "request_id": "rA"},
+        {"name": "request", "ts": 12.0, "dur": 0.5, "seq": 3,
+         "trace_id": "tB", "attempt": 1, "request_id": "rB"},
+    ]
+    # an in-process fleet: three endpoints, ONE process-global ring
+    payloads = [_fake_payload(u, 33, u, [dict(s) for s in spans])
+                for u in ("router", "rep0", "rep1")]
+    doc, summary = fleet.assemble_fleet_trace(payloads, request="rA")
+    assert summary["processes"] == 1         # deduped to one lane
+    assert summary["trace_ids"] == ["tA"]
+    evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+    assert len(evs) == 2                     # rB filtered out, no dups
+    assert {e["args"].get("trace_id") for e in evs} == {"tA"}
+    with pytest.raises(ValueError):
+        fleet.assemble_fleet_trace(payloads, request="nope")
+
+
+# -- router spans over fake replicas (no jax) ---------------------------------
+
+def _fake_replica(state=None):
+    state = dict({"dead": False, "bodies": []}, **(state or {}))
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if self.path == "/readyz":
+                body = json.dumps({"status": "ok"}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self.send_error(404)
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            req = json.loads(self.rfile.read(n) or b"{}")
+            state["bodies"].append(req)
+            if state["dead"]:
+                self.close_connection = True
+                return
+            body = json.dumps(
+                {"tokens": [1, 2, 3],
+                 "request_id": req.get("request_id")}).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, state
+
+
+@pytest.fixture
+def fake_fleet():
+    a_srv, a = _fake_replica()
+    b_srv, b = _fake_replica()
+    router = None
+    try:
+        router = FleetRouter(
+            ["127.0.0.1:%d" % a_srv.server_port,
+             "127.0.0.1:%d" % b_srv.server_port],
+            probe_interval=0.1, probe_timeout=2.0,
+            failure_threshold=1, retry_budget=2,
+            attempt_timeout=5.0, request_timeout=20.0,
+            name="trace_router").start()
+        yield router, (a_srv, a), (b_srv, b)
+    finally:
+        if router is not None:
+            router.stop()
+        a_srv.shutdown()
+        b_srv.shutdown()
+
+
+def test_router_emits_route_spans_on_failover(fake_fleet):
+    router, (a_srv, a), (b_srv, b) = fake_fleet
+    # pin a deterministic fast breaker on the dying replica
+    policy = RetryPolicy(base_delay=0.2, max_delay=0.4, jitter=False,
+                         name="t")
+    for rep in router.replicas:
+        rep.breaker = CircuitBreaker(failure_threshold=1,
+                                     backoff=policy)
+    # kill whichever replica the ranking picks FIRST (both idle →
+    # URL sort), so attempt 1 deterministically dies and fails over
+    first = min(r.url for r in router.replicas)
+    dead = a if first.endswith(str(a_srv.server_port)) else b
+    live = b if dead is a else a
+    dead["dead"] = True
+    url = "http://127.0.0.1:%d/generate" % router.port
+    cursor = span_recorder.cursor()
+    code, body = _post(url, {"prompt": [1], "n_new": 2})
+    assert code == 200
+    tid = body.get("trace_id")
+    assert tid and tid.startswith("trace-")
+    # both replicas saw the SAME trace_id with climbing attempts
+    routed = dead["bodies"] + live["bodies"]
+    assert all(r.get("trace_id") == tid for r in routed)
+    assert sorted(r.get("attempt") for r in routed) == [1, 2]
+    recs, cursor = _new_spans(cursor, "route.")
+    by_name = {}
+    for r in recs:
+        by_name.setdefault(r["name"], []).append(r)
+    root_span = by_name["route.request"][-1]
+    assert root_span["trace_id"] == tid
+    assert root_span["outcome"] == "answered"
+    assert root_span["status"] == 200
+    assert root_span["attempts"] == 2
+    attempts = [r for r in by_name["route.attempt"]
+                if r["trace_id"] == tid]
+    assert len(attempts) == 2
+    assert attempts[0]["outcome"] == "failed"
+    assert attempts[1]["outcome"] == "answered"
+    assert attempts[1]["status"] == 200
+    # the failure opened the breaker -> the scheduled hold is a span
+    backoffs = [r for r in by_name.get("route.backoff", ())
+                if r["trace_id"] == tid]
+    assert backoffs and backoffs[0]["dur"] > 0
+    # half-open after the hold: the next attempt doubles as the probe
+    time.sleep(0.45)
+    code, _ = _post(url, {"prompt": [1], "n_new": 2})
+    assert code == 200
+    probes, cursor = _new_spans(cursor, "route.probe")
+    assert probes and probes[0]["outcome"] == "failed"
+    # route.request spans parent the whole timeline on one clock
+    assert root_span["ts"] <= attempts[0]["ts"]
+    assert root_span["ts"] + root_span["dur"] \
+        >= attempts[1]["ts"] + attempts[1]["dur"] - 1e-3
+
+
+def test_router_spans_gated_by_trace_requests(fake_fleet):
+    router, (a_srv, a), (b_srv, b) = fake_fleet
+    url = "http://127.0.0.1:%d/generate" % router.port
+    prev = root.common.trace.get("requests", True)
+    cursor = span_recorder.cursor()
+    try:
+        root.common.trace.requests = False
+        code, body = _post(url, {"prompt": [1], "n_new": 2})
+        assert code == 200
+        assert body.get("trace_id")       # propagation stays on
+    finally:
+        root.common.trace.requests = prev
+    leaked, _ = _new_spans(cursor, "route.", "request")
+    assert leaked == []
+
+
+def test_route_replay_span_covers_journal_tail(tmp_path, fake_fleet):
+    router, (a_srv, a), (b_srv, b) = fake_fleet
+    router.stop()
+    from veles_tpu.serving.journal import RequestJournal
+    jdir = str(tmp_path / "journal")
+    j = RequestJournal(jdir, fsync=False)
+    j.admit("req-replay-1", {"prompt": [1], "n_new": 2,
+                             "trace_id": "trace-rp-1"},
+            time.time(), trace_id="trace-rp-1")
+    j.close()
+    cursor = span_recorder.cursor()
+    router2 = FleetRouter(
+        ["127.0.0.1:%d" % a_srv.server_port],
+        probe_interval=0.1, failure_threshold=2, retry_budget=1,
+        attempt_timeout=5.0, request_timeout=20.0,
+        journal_dir=jdir, journal_fsync=False,
+        name="replay_router").start()
+    try:
+        deadline = time.time() + 15
+        while j.__class__(jdir, fsync=False).pending_count() \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        replays, _ = _new_spans(cursor, "route.replay")
+        assert replays and replays[-1]["replayed"] == 1
+        # the replayed request routed under its ORIGINAL trace_id
+        routed, _ = _new_spans(cursor, "route.request")
+        mine = [r for r in routed
+                if r.get("trace_id") == "trace-rp-1"]
+        assert mine and mine[0]["request_id"] == "req-replay-1"
+    finally:
+        router2.stop()
+
+
+# -- blackbox --request filtering ---------------------------------------------
+
+def test_blackbox_inspect_filters_by_request(tmp_path):
+    from veles_tpu.telemetry.recorder import flight, inspect
+    flight.note("request", request_id="req-bb-1",
+                trace_id="trace-bb-1", attempt=1, phase="admitted")
+    flight.note("request", request_id="req-bb-1",
+                trace_id="trace-bb-1", attempt=1, phase="done",
+                outcome="shed")
+    flight.note("request", request_id="req-bb-2",
+                trace_id="trace-bb-2", attempt=1, phase="done")
+    path = str(tmp_path / "bb.jsonl")
+    flight.dump("test", path=path)
+    full = inspect(path)
+    one = inspect(path, request="trace-bb-1")
+    assert one["events"] == 2
+    assert one["events_total"] == full["events"]
+    assert one["request"] == "trace-bb-1"
+    # by request_id too
+    assert inspect(path, request="req-bb-2")["events"] == 1
+    from veles_tpu.__main__ import main
+    assert main(["blackbox", "inspect", path,
+                 "--request", "trace-bb-1"]) == 0
+
+
+# -- check_counters --docs pass ------------------------------------------------
+
+def test_check_counters_docs_pass_green_and_fails_on_drift(tmp_path):
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    try:
+        import check_counters
+    finally:
+        sys.path.pop(0)
+    # the shipped docs cover every registered name (tier-1 hook for
+    # the --docs pass, like test_tensormon hooks the base pass)
+    assert check_counters.main(["--docs"]) == 0
+    # and the pass actually bites: a doc missing one registered name
+    with open(check_counters.DOCS_MD, errors="replace") as fin:
+        text = fin.read()
+    crippled = tmp_path / "observability.md"
+    crippled.write_text(
+        text.replace("veles_trace_fleet_merges_total", "veles_gone"))
+    missing = check_counters.find_undocumented(str(crippled))
+    assert ("veles_trace_fleet_merges_total", "counter") in missing
+    # brace families in prose count as documented
+    docs = check_counters.documented_names()
+    assert "veles_journal_appends_total" in docs
+
+
+# -- THE acceptance drill: one merged trace across a replica death ------------
+
+@pytest.fixture(scope="module")
+def lm_wf():
+    lm = import_model("char_lm")
+    from veles_tpu import prng
+    prng.seed_all(2026)
+    wf = lm.build_workflow(epochs=1, minibatch_size=32, n_blocks=1,
+                           dim=32, n_train=64, n_valid=32)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    return lm, wf
+
+
+def test_fleet_trace_cli_one_merged_trace_across_death(
+        lm_wf, tmp_path, monkeypatch):
+    """The acceptance criterion end to end: journaled 2-replica
+    fleet, injected mid-decode replica death, `veles-tpu trace fleet
+    --request ID` → ONE Chrome trace with the router's
+    route.request/route.attempt spans and both replicas' request
+    spans under the same trace_id, the resume attempt's tokens_done
+    visible — and decode dispatch counts bit-identical tracing
+    on/off through the router path."""
+    from veles_tpu.nn import sampling
+    lm, wf = lm_wf
+    prompt = [1, 5, 3, 2, 4]
+    n_new = 12
+    solo = sampling.generate(wf, prompt, n_new, temperature=0)
+    apis = [vt.GenerationAPI(wf, port=0, engine="continuous",
+                             max_slots=2, buckets=(8, 16, 32),
+                             max_context=48,
+                             name="trace_drill_%d" % i)
+            for i in range(2)]
+    for api in apis:
+        api.initialize()
+    jdir = str(tmp_path / "journal")
+    router = None
+    prev = root.common.trace.get("requests", True)
+    try:
+        router = FleetRouter(
+            ["127.0.0.1:%d" % api.port for api in apis],
+            probe_interval=0.2, failure_threshold=1, retry_budget=2,
+            attempt_timeout=60.0, request_timeout=120.0,
+            journal_dir=jdir, journal_fsync=False,
+            name="drill_router").start()
+        url = "http://127.0.0.1:%d/generate" % router.port
+        # warm both replicas' programs outside any measured window
+        for api in apis:
+            code, _b = _post(
+                "http://127.0.0.1:%d/generate" % api.port,
+                {"prompt": prompt, "n_new": 4})
+            assert code == 200
+
+        # -- dispatch lock, extended to the router path --------------
+        keys = ("veles_serving_decode_dispatches_total",
+                "veles_serving_prefill_dispatches_total",
+                "veles_decode_dispatches_total")
+
+        def load():
+            out = []
+            for _ in range(3):
+                code, body = _post(url, {"prompt": prompt,
+                                         "n_new": 4})
+                out.append((code, tuple(body.get("tokens") or ())))
+            return out
+
+        def measured():
+            before = {k: counters.get(k) for k in keys}
+            out = load()
+            return out, {k: counters.get(k) - before[k]
+                         for k in keys}
+
+        root.common.trace.requests = True
+        out_on, d_on = measured()
+        cursor = span_recorder.cursor()
+        root.common.trace.requests = False
+        out_off, d_off = measured()
+        leaked, _ = _new_spans(cursor, "request", "route.")
+        root.common.trace.requests = True
+        assert out_on == out_off
+        assert d_on == d_off, (d_on, d_off)
+        assert leaked == []
+
+        # -- the death drill ----------------------------------------
+        monkeypatch.setenv(
+            "VELES_FAULTS",
+            "serve.replica_death:raise:after=4,times=1")
+        code, body = _post(url, {"prompt": prompt, "n_new": n_new})
+        monkeypatch.delenv("VELES_FAULTS")
+        assert code == 200, body
+        assert body["tokens"] == solo          # id-exact across death
+        assert int(body.get("resumed_from", 0)) >= 1
+        tid = body["trace_id"]
+        rid = body["request_id"]
+
+        # roster file (the saved GET /roster page) + router URL =
+        # the documented trace fleet invocation
+        roster = tmp_path / "roster.json"
+        with urllib.request.urlopen(
+                "http://127.0.0.1:%d/roster" % router.port,
+                timeout=10) as r:
+            roster.write_text(r.read().decode())
+        out_path = str(tmp_path / "fleet_trace.json")
+        from veles_tpu.__main__ import main
+        rc = main(["trace", "fleet",
+                   "127.0.0.1:%d" % router.port,
+                   "--endpoints-file", str(roster),
+                   "--request", rid,          # request_id resolves
+                   "--out", out_path])        # to its trace_id
+        assert rc == 0
+        with open(out_path) as fin:
+            doc = json.load(fin)
+        from veles_tpu.telemetry import chrome_trace
+        assert chrome_trace.validate(doc) == []
+        evs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        names = [e["name"] for e in evs]
+        assert "route.request" in names
+        attempts = [e for e in evs if e["name"] == "route.attempt"]
+        assert len(attempts) >= 2              # death + failover
+        # the resume attempt's tokens_done is visible in the trace
+        assert any(int(e["args"].get("tokens_done", 0)) >= 1
+                   for e in attempts)
+        req_spans = [e for e in evs if e["name"] == "request"]
+        span_attempts = {int(e["args"].get("attempt", 0))
+                         for e in req_spans}
+        assert {1, 2} <= span_attempts         # BOTH replicas' spans
+        # every event belongs to the one trace
+        tagged = [e for e in evs if "trace_id" in e["args"]]
+        assert tagged
+        assert {e["args"]["trace_id"] for e in tagged} == {tid}
+        # the journal's records carry the same trace
+        admits, terminals = router.journal.replay()
+        assert admits[rid]["trace_id"] == tid
+        assert terminals[rid]["trace_id"] == tid
+        assert terminals[rid]["attempts"] >= 2
+        assert router.journal.pending() == []
+    finally:
+        root.common.trace.requests = prev
+        if router is not None:
+            router.stop()
+        for api in apis:
+            api.stop()

@@ -233,6 +233,30 @@ def _trace_cli(argv) -> int:
                      help="export only spans tagged with this "
                           "request_id (one serving request's "
                           "timeline — no hand-grepping the JSONL)")
+    fl = sub.add_parser(
+        "fleet",
+        help="pull span rings from a router + its replicas "
+             "(GET /trace/spans), align clocks, merge into ONE "
+             "Chrome trace — one lane per process "
+             "(docs/observability.md 'Fleet tracing')")
+    fl.add_argument("urls", nargs="*", metavar="URL",
+                    help="endpoint serving /trace/spans (the router "
+                         "and/or replicas; bare host:port accepted)")
+    fl.add_argument("--endpoints-file", default=None, metavar="FILE",
+                    help="replica roster file — same format as "
+                         "`route`/`metrics aggregate` (plain lines, "
+                         "or a saved GET /roster page); the router's "
+                         "own URL still goes in positionally")
+    fl.add_argument("--out", required=True, metavar="TRACE.json",
+                    help="merged Chrome trace to write (open in "
+                         "Perfetto)")
+    fl.add_argument("--request", default=None, metavar="ID",
+                    help="keep one request's story only: a "
+                         "request_id or trace_id — the whole fleet "
+                         "trace of that request (queue, attempts, "
+                         "backoff, resume) across every process")
+    fl.add_argument("--timeout", type=float, default=5.0,
+                    help="per-endpoint pull timeout, seconds")
     st = sub.add_parser(
         "self-time",
         help="device self-time summary of a captured trace "
@@ -246,6 +270,8 @@ def _trace_cli(argv) -> int:
     st.add_argument("--top", type=int, default=12, metavar="N",
                     help="print at most N rows per table")
     args = parser.parse_args(argv)
+    if args.cmd == "fleet":
+        return _trace_fleet(args)
     if args.cmd == "self-time":
         return _trace_self_time(args)
     from .telemetry import chrome_trace
@@ -259,6 +285,63 @@ def _trace_cli(argv) -> int:
           "https://ui.perfetto.dev)"
           % (n, " for request %s" % args.request if args.request
              else "", args.out))
+    return 0
+
+
+def _trace_fleet(args) -> int:
+    """``veles-tpu trace fleet URL... --out trace.json`` — pull the
+    span ring of every listed process (router + replicas), estimate
+    per-process clock offsets by bracketing alignment
+    (``route.attempt`` spans contain the replica ``request`` spans
+    they proxied — telemetry/fleet.py), and write ONE merged Chrome
+    trace with one lane per process. With ``--request ID`` the trace
+    is a single request's full cross-fleet story."""
+    import json as _json
+    from .telemetry import fleet as _fleet
+    urls = list(args.urls)
+    if args.endpoints_file:
+        from .telemetry.fleet import read_endpoints
+        try:
+            urls += read_endpoints(args.endpoints_file)
+        except (OSError, ValueError) as e:
+            print("trace fleet: bad --endpoints-file: %s" % e,
+                  file=sys.stderr)
+            return 1
+    if not urls:
+        print("trace fleet: no endpoints (positional URLs and/or "
+              "--endpoints-file)", file=sys.stderr)
+        return 1
+    try:
+        doc, summary = _fleet.trace_fleet(
+            urls, request=args.request, timeout=args.timeout)
+    except ValueError as e:
+        print("trace fleet failed: %s" % e, file=sys.stderr)
+        return 1
+    with open(args.out, "w") as fout:
+        _json.dump(doc, fout)
+    down = [s for s in summary.get("endpoints", ())
+            if not s["up"]]
+    print("fleet trace: %d span(s) over %d process lane(s)%s -> %s "
+          "(open in Perfetto: https://ui.perfetto.dev)"
+          % (summary["spans"], summary["processes"],
+             " for %s" % "/".join(summary.get("trace_ids", ()))
+             if args.request else "", args.out))
+    for key, info in sorted(summary["offsets"].items(),
+                            key=lambda kv: str(kv[0])):
+        pid = info.get("pid", key)
+        if info.get("reference"):
+            print("  pid %-8s reference clock (the router's)" % pid)
+        elif info["pairs"]:
+            print("  pid %-8s offset %+0.6fs over %d bracketing "
+                  "pair(s), +/-%.6fs" % (pid, info["offset"],
+                                         info["pairs"],
+                                         info["bound"] or 0.0))
+        else:
+            print("  pid %-8s no bracketing pair — own clock "
+                  "(offset unknown)" % pid)
+    for s in down:
+        print("  down: %s (%s)" % (s["url"], s["error"]),
+              file=sys.stderr)
     return 0
 
 
@@ -450,8 +533,14 @@ def _blackbox_cli(argv) -> int:
     ins.add_argument("path")
     ins.add_argument("--tail", type=int, default=10, metavar="N",
                      help="also print the last N events")
+    ins.add_argument("--request", default=None, metavar="ID",
+                     help="only events tagged with this request_id "
+                          "or trace_id — cross-reference a crashed "
+                          "replica's black box against a fleet "
+                          "trace (`trace fleet --request`)")
     args = parser.parse_args(argv)
-    from .telemetry.recorder import flight, inspect, read_blackbox
+    from .telemetry.recorder import (flight, inspect, matches_request,
+                                     read_blackbox)
     if args.cmd == "dump":
         try:
             path = flight.dump(args.reason, path=args.out)
@@ -462,13 +551,17 @@ def _blackbox_cli(argv) -> int:
               % (path, flight.stats()["buffered"]))
         return 0
     try:
-        summary = inspect(args.path)
+        summary = inspect(args.path, request=args.request)
     except OSError as e:
         print("blackbox inspect failed: %s" % e, file=sys.stderr)
         return 1
     print("black box %s" % summary["path"])
     print("  reason:  %s" % summary["reason"])
     print("  pid:     %s" % summary["pid"])
+    if args.request:
+        print("  request: %s (%d of %d events)"
+              % (args.request, summary["events"],
+                 summary["events_total"]))
     print("  events:  %d over %.3fs"
           % (summary["events"], summary["span_seconds"]))
     for kind, count in sorted(summary["by_kind"].items(),
@@ -476,9 +569,18 @@ def _blackbox_cli(argv) -> int:
         print("  %-12s %d" % (kind, count))
     if args.tail > 0:
         _, events = read_blackbox(args.path)
+        if args.request:
+            events = [e for e in events
+                      if matches_request(e, args.request)]
         for rec in events[-args.tail:]:
             label = rec.get("name") or rec.get("counter") or ""
-            print("  tail: %-10s %s" % (rec.get("kind", "?"), label))
+            extra = ""
+            if rec.get("request_id"):
+                extra = " %s attempt=%s %s" % (
+                    rec.get("request_id"), rec.get("attempt", "?"),
+                    rec.get("phase") or rec.get("outcome") or "")
+            print("  tail: %-10s %s%s" % (rec.get("kind", "?"),
+                                          label, extra))
     return 0
 
 

@@ -28,6 +28,32 @@ def bytes_reply(handler, code: int, data: bytes, ctype: str,
     handler.wfile.write(data)
 
 
+def handle_trace_spans(handler, path: str, name: str = "") -> bool:
+    """Serve ``GET /trace/spans[?since=CURSOR]`` — the span-ring pull
+    every request-plane HTTP surface exposes (router, GenerationAPI,
+    RESTfulAPI), so ``veles-tpu trace fleet`` assembles a cross-
+    process timeline without any replica needing ``--trace-file``.
+    Returns True when the path was handled (mirrors
+    ``health.handle_health``). The body is JSONL (header line + one
+    line per span) so a torn read salvages per record."""
+    if path.split("?", 1)[0] != "/trace/spans":
+        return False
+    since = 0
+    if "?" in path:
+        from urllib.parse import parse_qs
+        try:
+            since = int(parse_qs(path.split("?", 1)[1]
+                                 ).get("since", ["0"])[0])
+        except (TypeError, ValueError):
+            json_reply(handler, 400,
+                       {"error": "since must be an integer cursor"})
+            return True
+    from .telemetry.spans import pull_payload
+    bytes_reply(handler, 200, pull_payload(since, name=name).encode(),
+                "application/x-ndjson")
+    return True
+
+
 def read_json_object(handler) -> Dict[str, Any]:
     """Parse the request body as a JSON *object*; raises ValueError on
     malformed JSON and on valid-JSON non-objects (lists, strings, …) so

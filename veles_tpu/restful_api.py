@@ -22,8 +22,8 @@ from typing import Any, Dict, Optional
 
 import numpy
 
-from ._http import (HTTPService, bytes_reply, json_reply,
-                    read_json_object)
+from ._http import (HTTPService, bytes_reply, handle_trace_spans,
+                    json_reply, read_json_object)
 from .config import root
 from .error import VelesError
 from .resilience import health
@@ -87,6 +87,9 @@ class RESTfulAPI(Unit):
 
             def do_GET(self):
                 if health.handle_health(self, self.path):
+                    return
+                if handle_trace_spans(self, self.path,
+                                      name="rest.%s" % api.name):
                     return
                 if self.path != "/metrics":
                     self.send_error(404)
@@ -419,6 +422,21 @@ class GenerationAPI(Unit):
                 or not 1 <= len(request_id) <= 200):
             raise ValueError("'request_id' must be a non-empty string "
                              "of at most 200 chars")
+        # fleet tracing (docs/observability.md "Fleet tracing"): the
+        # router also forwards the trace_id it minted at admission and
+        # the 1-based attempt number — the ticket adopts both, so this
+        # replica's request spans and flight events stitch into the
+        # router's route.attempt bracket in a merged fleet trace
+        trace_id = body.get("trace_id")
+        if trace_id is not None and (
+                not isinstance(trace_id, str)
+                or not 1 <= len(trace_id) <= 200):
+            raise ValueError("'trace_id' must be a non-empty string "
+                             "of at most 200 chars")
+        attempt = body.get("attempt", 1)
+        if isinstance(attempt, bool) or not isinstance(attempt, int) \
+                or attempt < 1:
+            raise ValueError("'attempt' must be an int >= 1")
         # token-level failover resume (docs/services.md "Lossless
         # request plane"): a retry of a died-mid-decode request
         # carries the tokens already emitted; they fold into the
@@ -443,7 +461,8 @@ class GenerationAPI(Unit):
                "n_new": n_new, "resume_k": len(resume_tokens),
                "mode": mode, "temperature": temperature, "seed": seed,
                "gamma": gamma, "beam": beam, "eos_id": eos_id,
-               "request_id": request_id}
+               "request_id": request_id, "trace_id": trace_id,
+               "attempt": attempt}
         if req["gamma"] < 1:
             raise ValueError("'gamma' must be >= 1")
         if req["beam"] < 1:
@@ -650,6 +669,9 @@ class GenerationAPI(Unit):
             def do_GET(self):
                 if health.handle_health(self, self.path):
                     return
+                if handle_trace_spans(self, self.path,
+                                      name="serve.%s" % api.name):
+                    return
                 if self.path == "/metrics":
                     # Prometheus scrape surface (telemetry counters —
                     # the structured successor of the /stats dict; the
@@ -781,7 +803,9 @@ class GenerationAPI(Unit):
                 ticket = _Ticket(
                     deadline=time.time() + api.request_timeout,
                     request_id=req.get("request_id"),
-                    mode=req.get("mode", "greedy"))
+                    mode=req.get("mode", "greedy"),
+                    trace_id=req.get("trace_id"),
+                    attempt=req.get("attempt", 1))
                 if api._draining:
                     health.shed(self, retry_after=5.0,
                                 reason="server draining",

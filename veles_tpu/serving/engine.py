@@ -831,7 +831,9 @@ class ContinuousEngine(Logger):
                    - slot.ticket.enqueued)
         with span("serving.prefill", bucket=bucket, slot=slot.idx,
                   t_p=t_p, mode=slot.mode,
-                  request_id=slot.ticket.request_id):
+                  request_id=slot.ticket.request_id,
+                  trace_id=slot.ticket.trace_id,
+                  attempt=slot.ticket.attempt):
             first, logits, self._keys, self._caches = prog(
                 params, ids_dev, numpy.int32(t_p),
                 numpy.int32(slot.idx), numpy.float32(slot.temperature),

@@ -167,17 +167,35 @@ class RequestJournal(Logger):
                              type(e).__name__, e)
 
     def admit(self, request_id: str, body: Dict[str, Any],
-              enqueued_at: float) -> None:
-        """Journal an accepted request BEFORE its first dispatch."""
-        self.append("admit", request_id, body=body,
-                    enqueued_at=float(enqueued_at))
+              enqueued_at: float,
+              trace_id: Optional[str] = None) -> None:
+        """Journal an accepted request BEFORE its first dispatch.
+        ``trace_id`` (the fleet tracing key the router mints at the
+        same admission) rides the record top-level — a journal dump
+        cross-references a merged fleet trace without digging through
+        each record's body."""
+        fields: Dict[str, Any] = {"body": body,
+                                  "enqueued_at": float(enqueued_at)}
+        if trace_id:
+            fields["trace_id"] = str(trace_id)
+        self.append("admit", request_id, **fields)
 
     def done(self, request_id: str, status: int,
-             outcome: str = "answered") -> None:
+             outcome: str = "answered",
+             trace_id: Optional[str] = None,
+             attempts: Optional[int] = None) -> None:
         """Journal the answer (success and shed alike) — the record
-        that makes replay idempotent by ``request_id``."""
-        self.append("done", request_id, status=int(status),
-                    outcome=str(outcome))
+        that makes replay idempotent by ``request_id``. ``trace_id``
+        and ``attempts`` (how many replica tries the answer took)
+        carry the fleet-tracing correlation into the terminal record
+        too."""
+        fields: Dict[str, Any] = {"status": int(status),
+                                  "outcome": str(outcome)}
+        if trace_id:
+            fields["trace_id"] = str(trace_id)
+        if attempts is not None:
+            fields["attempts"] = int(attempts)
+        self.append("done", request_id, **fields)
 
     # -- read back -----------------------------------------------------------
     def replay(self) -> Tuple[Dict[str, Dict[str, Any]],

@@ -63,10 +63,11 @@ import time
 import urllib.error
 import urllib.request
 from http.server import BaseHTTPRequestHandler
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import (Any, Callable, Dict, List, Optional, Sequence,
+                    Tuple)
 
-from .._http import (HTTPService, bytes_reply, json_reply,
-                     read_json_object)
+from .._http import (HTTPService, bytes_reply, handle_trace_spans,
+                     json_reply, read_json_object)
 from ..config import root
 from ..error import VelesError
 from ..logger import Logger
@@ -81,7 +82,9 @@ from .journal import RequestJournal
 #: modes' emitted-token prefix a failover retry can resume —
 #: everything else retries from scratch
 from .scheduler import RESUME_MODES as _RESUMABLE_MODES
-from .scheduler import new_request_id
+from .scheduler import (new_request_id, new_trace_id,
+                        request_tracing_enabled)
+from ..telemetry.spans import emit as emit_span
 
 #: every counter the fleet router increments — registered with HELP
 #: strings in telemetry/counters.py DESCRIPTIONS and asserted zero in
@@ -259,6 +262,10 @@ class _Answer:
         self.retry_after: Optional[str] = None
         self.replica: Optional[Replica] = None
         self.request_id: Optional[str] = None
+        self.trace_id: Optional[str] = None
+        #: replica attempts the routing loop dispatched for this
+        #: request — stamped into the journal's terminal record
+        self.attempts: int = 0
         #: why routing gave up, when ``done`` stays False
         self.reason: Optional[str] = None
 
@@ -485,14 +492,32 @@ class FleetRouter(Logger):
             self._journal_outstanding += len(pending)
         self.info("%s: replaying %d journaled request(s) from before "
                   "the restart", self.name, len(pending))
+        t_replay = time.time()
+        replayed = shed = 0
+        try:
+            replayed, shed = self._replay_pending(pending)
+        finally:
+            if request_tracing_enabled():
+                # the journal-tail replay as one timeline event: a
+                # restarted router's first seconds explain themselves
+                emit_span("route.replay", t_replay,
+                          time.time() - t_replay,
+                          pending=len(pending), replayed=replayed,
+                          shed=shed)
+
+    def _replay_pending(self, pending) -> Tuple[int, int]:
+        replayed = shed = 0
         for rec in pending:
             if self._closing or self._draining:
-                return          # still journaled — the next start retries
+                # still journaled — the next start retries
+                return replayed, shed
             rid = rec["request_id"]
+            tid = rec.get("trace_id")
             body = rec.get("body")
             enqueued = float(rec.get("enqueued_at", 0.0) or 0.0)
             if not isinstance(body, dict):
-                self.journal.done(rid, 400, "unreplayable")
+                self.journal.done(rid, 400, "unreplayable",
+                                  trace_id=tid)
                 with self._cv:
                     self._journal_outstanding -= 1
                 continue
@@ -500,7 +525,8 @@ class FleetRouter(Logger):
                 # past its useful life: the shed a live router would
                 # have answered, recorded with the id
                 inc("veles_shed_requests_total")
-                self.journal.done(rid, 503, "expired")
+                self.journal.done(rid, 503, "expired", trace_id=tid)
+                shed += 1
                 self.warning("%s: journaled request %s expired before "
                              "replay (enqueued %.0fs ago)", self.name,
                              rid, time.time() - enqueued)
@@ -509,12 +535,18 @@ class FleetRouter(Logger):
                 continue
             inc("veles_journal_replayed_total")
             try:
+                # the replayed body resumes under its ORIGINAL
+                # trace_id (the admit record's) — one trace tells the
+                # whole story across the router restart
                 answered = self.route(dict(body, request_id=rid))
                 status = answered.status if answered.done else 503
                 outcome = ("replayed" if answered.done
                            else "unanswered: %s"
                            % (answered.reason or ""))
-                self.journal.done(rid, int(status), outcome)
+                self.journal.done(rid, int(status), outcome,
+                                  trace_id=tid,
+                                  attempts=answered.attempts)
+                replayed += 1
             except Exception:   # noqa: BLE001 — replay must survive
                 # one poisonous entry must not abandon the rest of
                 # the backlog; it stays pending for the next start
@@ -524,6 +556,7 @@ class FleetRouter(Logger):
                 continue
             with self._cv:
                 self._journal_outstanding -= 1
+        return replayed, shed
 
     # -- graceful drain ------------------------------------------------------
     def begin_drain(self) -> bool:
@@ -736,11 +769,27 @@ class FleetRouter(Logger):
         ``n_new`` ride the retry body, and the final answer is
         stitched back to the full sequence. Returns the latch —
         ``done`` False means no replica could answer inside the
-        budget (the HTTP face sheds 503)."""
+        budget (the HTTP face sheds 503).
+
+        Tracing: the router mints a ``trace_id`` at admission (or
+        adopts the caller's) and forwards it — with the 1-based
+        ``attempt`` number — in every attempt body, so every
+        replica-side span and flight event of this request carries
+        the fleet-wide key. The routing decisions themselves become
+        spans (gated by ``root.common.trace.requests``, like the
+        replica lifecycle spans): ``route.request`` brackets the
+        whole route, ``route.attempt`` each replica try (endpoint,
+        outcome, status, ``tokens_done`` carried into a resume),
+        ``route.probe`` a half-open breaker's recovery attempt, and
+        ``route.backoff`` the open interval a failure scheduled —
+        failover/breaker/resume decisions are timeline events, not
+        just counter increments."""
         rid = body.get("request_id") or new_request_id()
-        body = dict(body, request_id=rid)
+        tid = body.get("trace_id") or new_trace_id()
+        body = dict(body, request_id=rid, trace_id=tid)
         mode = str(body.get("mode", "greedy"))
         resumable = mode in _RESUMABLE_MODES
+        trace_on = request_tracing_enabled()
         # total generation budget: a client/replayed body may itself
         # carry a resume prefix (its n_new is then the REMAINING
         # budget). Unparsable resume/n_new disables router-side
@@ -762,8 +811,11 @@ class FleetRouter(Logger):
         inc("veles_router_requests_total")
         answered = _Answer()
         answered.request_id = rid
-        deadline = time.time() + self.request_timeout
+        answered.trace_id = tid
+        t_req = time.time()
+        deadline = t_req + self.request_timeout
         tried: List[Replica] = []
+        n_attempts = 0
         last_reason = "no ready replica"
         while len(tried) <= self.retry_budget:
             remaining = deadline - time.time()
@@ -774,6 +826,11 @@ class FleetRouter(Logger):
             replica = self.pick(exclude=tried)
             if replica is None:
                 break
+            # a granted half-open slot IS the breaker's recovery
+            # probe — this attempt doubles as it (route.probe span)
+            probing = replica.breaker.state \
+                == CircuitBreaker.HALF_OPEN
+            trips_before = replica.breaker.trips
             if tried:
                 inc("veles_router_failovers_total")
                 self.info("%s: failing %s over to %s (%s)%s",
@@ -782,7 +839,9 @@ class FleetRouter(Logger):
                           if prefix else "")
             tried.append(replica)
             inc("veles_router_attempts_total")
-            attempt_body = dict(body)
+            n_attempts += 1
+            tokens_done = len(prefix)
+            attempt_body = dict(body, attempt=n_attempts)
             if total_new is not None:
                 # n_new is recomputed from the TOTAL budget every
                 # attempt: a dropped prefix (409) must widen the
@@ -793,6 +852,7 @@ class FleetRouter(Logger):
                     inc("veles_resume_attempts_total")
             data = json.dumps(attempt_body).encode()
             state = _Attempt(replica, answered)
+            t_att = time.time()
             threading.Thread(
                 target=self._attempt,
                 args=(replica, data, rid, answered, state,
@@ -810,6 +870,16 @@ class FleetRouter(Logger):
                         and time.time() < wait_until):
                     answered.cv.wait(timeout=min(
                         0.05, max(0.005, wait_until - time.time())))
+            # declare the timeout BEFORE emitting the attempt span,
+            # so the span reads the outcome the loop acted on
+            if not answered.done and not state.settled:
+                if state.fail("attempt timed out after %.1fs on %s"
+                              % (self.attempt_timeout, replica.url)):
+                    last_reason = state.reason or "attempt timeout"
+            if trace_on:
+                self._note_attempt(replica, state, answered, rid,
+                                   tid, n_attempts, t_att,
+                                   tokens_done, probing, trips_before)
             if answered.done:
                 break
             if state.settled and state.failed:
@@ -829,14 +899,80 @@ class FleetRouter(Logger):
                             < total_new:
                         prefix = prefix + gained
                 continue
-            if not state.settled:
-                if state.fail("attempt timed out after %.1fs on %s"
-                              % (self.attempt_timeout, replica.url)):
-                    last_reason = state.reason or "attempt timeout"
-                continue
+        answered.attempts = n_attempts
         if not answered.done:
             answered.reason = last_reason
+        if trace_on:
+            now = time.time()
+            tags: Dict[str, Any] = {
+                "request_id": rid, "trace_id": tid, "mode": mode,
+                "attempts": n_attempts,
+                "outcome": ("answered" if answered.done
+                            else "unanswered")}
+            if answered.done:
+                tags["status"] = int(answered.status)
+            else:
+                tags["reason"] = last_reason
+            # the ROOT span of the fleet trace: one lane-topping
+            # bracket per routed request, on the router's clock
+            emit_span("route.request", t_req, now - t_req, **tags)
         return answered
+
+    def _note_attempt(self, replica: Replica, state: _Attempt,
+                      answered: _Answer, rid: str, tid: str,
+                      attempt_no: int, t0: float, tokens_done: int,
+                      probing: bool, trips_before: int) -> None:
+        """Retrospective span emission for one settled-or-abandoned
+        attempt: ``route.attempt`` always (endpoint, outcome, http
+        status when this replica answered, the resume prefix length
+        carried in), ``route.probe`` when the attempt was a
+        half-open breaker probe, and ``route.backoff`` when THIS
+        failure opened the breaker (the span covers the scheduled
+        open interval, so the failover gap is a visible timeline
+        event). Never raises — observability only."""
+        try:
+            now = time.time()
+            if answered.done and answered.replica is replica:
+                outcome: str = "answered"
+                status: Optional[int] = answered.status
+            elif state.settled and state.failed:
+                outcome, status = "failed", None
+            elif state.settled:
+                # settled-success without winning the latch: succeed()
+                # runs only after offer(), which sets done+replica
+                # together — so this replica cannot be the winner
+                # here; its answer was the dropped duplicate
+                outcome, status = "duplicate", None
+            else:
+                # still running when the loop moved on (late answers
+                # may yet win the latch)
+                outcome, status = "pending", None
+            tags: Dict[str, Any] = {
+                "endpoint": replica.url, "attempt": attempt_no,
+                "request_id": rid, "trace_id": tid,
+                "tokens_done": tokens_done, "outcome": outcome}
+            if status is not None:
+                tags["status"] = int(status)
+            if state.reason:
+                tags["reason"] = state.reason
+            emit_span("route.attempt", t0, now - t0, **tags)
+            if probing:
+                emit_span("route.probe", t0, now - t0,
+                          endpoint=replica.url, attempt=attempt_no,
+                          request_id=rid, trace_id=tid,
+                          outcome=outcome)
+            breaker = replica.breaker
+            if breaker.trips > trips_before \
+                    and breaker.state == CircuitBreaker.OPEN:
+                # the scheduled open interval, emitted at open time:
+                # an interval on this host's wall clock equal to the
+                # breaker's monotonic hold
+                hold = max(0.0, breaker.open_until - breaker._clock())
+                emit_span("route.backoff", now, hold,
+                          endpoint=replica.url, request_id=rid,
+                          trace_id=tid, trips=breaker.trips)
+        except Exception:       # noqa: BLE001 — observability only
+            pass
 
     # -- surfaces ------------------------------------------------------------
     def gauges(self) -> Dict[str, Any]:
@@ -890,6 +1026,9 @@ class FleetRouter(Logger):
 
             def do_GET(self):
                 if health.handle_health(self, self.path):
+                    return
+                if handle_trace_spans(self, self.path,
+                                      name="router.%s" % router.name):
                     return
                 if self.path == "/metrics":
                     bytes_reply(self, 200,
@@ -945,10 +1084,15 @@ class FleetRouter(Logger):
                 # admission (shed, with the id) rather than accept a
                 # request durability cannot cover.
                 rid = body.get("request_id") or new_request_id()
-                body = dict(body, request_id=rid)
+                # the trace_id is minted HERE, with the request_id,
+                # so the journal's admit record carries it and a
+                # replayed request resumes under its original trace
+                tid = body.get("trace_id") or new_trace_id()
+                body = dict(body, request_id=rid, trace_id=tid)
                 if router.journal is not None:
                     try:
-                        router.journal.admit(rid, body, time.time())
+                        router.journal.admit(rid, body, time.time(),
+                                             trace_id=tid)
                     except Exception as e:  # noqa: BLE001 — fail closed
                         # durability contract: cannot journal ⇒ do
                         # not accept — an injected append fault and a
@@ -981,7 +1125,9 @@ class FleetRouter(Logger):
                             int(answered.status) if answered.done
                             else 503,
                             "answered" if answered.done
-                            else "unanswered")
+                            else "unanswered",
+                            trace_id=tid,
+                            attempts=answered.attempts)
                         with router._cv:
                             router._journal_outstanding -= 1
                     except Exception as e:  # noqa: BLE001
@@ -1007,7 +1153,14 @@ class FleetRouter(Logger):
                 headers = None
                 if answered.retry_after:
                     headers = {"Retry-After": str(answered.retry_after)}
-                json_reply(self, answered.status, answered.body,
+                reply = answered.body
+                if isinstance(reply, dict):
+                    # the client learns the fleet trace key with its
+                    # answer — `veles-tpu trace fleet --request` takes
+                    # either this or the request_id
+                    reply = dict(reply)
+                    reply.setdefault("trace_id", tid)
+                json_reply(self, answered.status, reply,
                            headers=headers)
 
         return Handler

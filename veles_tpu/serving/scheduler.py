@@ -53,6 +53,20 @@ def new_request_id() -> str:
     return "req-%d-%d" % (os.getpid(), next(_request_ids))
 
 
+def new_trace_id() -> str:
+    """Process-unique fleet trace id — the request_id family's
+    naming (pid prefix, shared counter) applied to the CROSS-process
+    correlation key: the fleet router mints one per accepted request
+    and forwards it with every attempt, so however many replicas (and
+    retries) serve the request, every span, flight-recorder event and
+    journal record of its story carries the same ``trace_id`` — what
+    ``veles-tpu trace fleet --request ID`` assembles a timeline
+    from. A request that never crosses a router gets its own
+    request_id as its trace_id (Ticket default), so single-replica
+    traces need no router to exist."""
+    return "trace-%d-%d" % (os.getpid(), next(_request_ids))
+
+
 def request_tracing_enabled() -> bool:
     """THE per-request tracing switch (``root.common.trace.requests``,
     default on). Gates only the HOST-SIDE span/flight emission at
@@ -89,13 +103,16 @@ class Ticket:
     failure path can never double-count."""
 
     __slots__ = ("event", "result", "error", "code", "retry_after",
-                 "deadline", "enqueued", "request_id", "mode",
+                 "deadline", "enqueued", "request_id", "trace_id",
+                 "attempt", "mode",
                  "admitted", "prefill_done", "first_token",
                  "n_tokens", "outcome", "progress", "_terminal_lock")
 
     def __init__(self, deadline: Optional[float] = None,
                  request_id: Optional[str] = None,
-                 mode: str = "greedy") -> None:
+                 mode: str = "greedy",
+                 trace_id: Optional[str] = None,
+                 attempt: int = 1) -> None:
         self._terminal_lock = threading.Lock()
         self.event = threading.Event()
         self.result = None
@@ -105,6 +122,14 @@ class Ticket:
         self.deadline = deadline
         self.enqueued = time.time()
         self.request_id = request_id or new_request_id()
+        #: fleet-wide correlation key: adopted from the router's body
+        #: when one arrives, else the request's own id — every
+        #: lifecycle span/flight event carries it, so a single
+        #: replica's trace joins a fleet trace seamlessly
+        self.trace_id = trace_id or self.request_id
+        #: which routing attempt this ticket serves (1-based; the
+        #: router numbers retries, a direct request is attempt 1)
+        self.attempt = max(1, int(attempt or 1))
         self.mode = str(mode)
         self.admitted: Optional[float] = None
         self.prefill_done: Optional[float] = None
@@ -126,6 +151,8 @@ class Ticket:
             try:
                 from ..telemetry.recorder import flight
                 flight.note("request", request_id=self.request_id,
+                            trace_id=self.trace_id,
+                            attempt=self.attempt,
                             phase="admitted", mode=self.mode)
             except Exception:       # noqa: BLE001 — observers only
                 pass
@@ -244,21 +271,28 @@ class Ticket:
             from ..telemetry.recorder import flight
             from ..telemetry.spans import emit
             rid = self.request_id
+            # every lifecycle span carries the fleet correlation pair
+            # — trace_id + attempt — so a cross-process assembly
+            # (veles-tpu trace fleet) stitches this replica's leg of
+            # the request into the router's route.attempt bracket
+            tags = {"request_id": rid, "trace_id": self.trace_id,
+                    "attempt": self.attempt}
             if self.admitted is not None:
                 emit("request.queue", self.enqueued,
-                     self.admitted - self.enqueued, request_id=rid)
+                     self.admitted - self.enqueued, **tags)
                 if self.prefill_done is not None:
                     emit("request.prefill", self.admitted,
-                         self.prefill_done - self.admitted,
-                         request_id=rid)
+                         self.prefill_done - self.admitted, **tags)
             if self.first_token is not None:
                 emit("request.decode", self.first_token,
-                     now - self.first_token, request_id=rid,
-                     tokens=self.n_tokens)
+                     now - self.first_token, tokens=self.n_tokens,
+                     **tags)
             emit("request", self.enqueued, now - self.enqueued,
-                 request_id=rid, outcome=outcome, mode=self.mode,
-                 tokens=self.n_tokens)
-            flight.note("request", request_id=rid, phase="done",
+                 outcome=outcome, mode=self.mode,
+                 tokens=self.n_tokens, **tags)
+            flight.note("request", request_id=rid,
+                        trace_id=self.trace_id, attempt=self.attempt,
+                        phase="done",
                         outcome=outcome, mode=self.mode,
                         tokens=self.n_tokens,
                         dur=round(now - self.enqueued, 6))

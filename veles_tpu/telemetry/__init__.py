@@ -59,6 +59,16 @@ TENSORMON_COUNTERS = (
     "veles_blackbox_dumps_total",
 )
 
+#: every counter the fleet-tracing plane increments (span-ring pulls,
+#: trace-file rotations, cross-process merges) — registered with HELP
+#: strings in counters.DESCRIPTIONS and asserted zero in non-fleet
+#: runs by ``python bench.py gate``'s tracing section
+TRACE_COUNTERS = (
+    "veles_trace_rotations_total",
+    "veles_trace_span_pulls_total",
+    "veles_trace_fleet_merges_total",
+)
+
 #: default gate rules: counter key → max allowed current/baseline
 #: ratio; 1.0 means "may not grow at all". Only WINDOW-INDEPENDENT
 #: quantities are gated: bench windows are time-boxed, so raw deltas

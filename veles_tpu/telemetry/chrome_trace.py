@@ -22,31 +22,23 @@ from . import spans
 PHASES = ("X", "C", "M")
 
 
-def to_trace_events(records: Iterable[Dict[str, Any]]
-                    ) -> List[Dict[str, Any]]:
-    """Span records (spans.py dicts) → trace_event list. Timestamps
-    become microseconds relative to the earliest span so Perfetto's
-    timeline starts at ~0 instead of the unix epoch."""
-    recs = [r for r in records if "ts" in r and "name" in r]
-    if not recs:
-        return []
-    t0 = min(float(r["ts"]) for r in recs)
-    pid = os.getpid()
-    events: List[Dict[str, Any]] = [{
-        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
-        "args": {"name": "veles_tpu"},
-    }]
-    # counter tracks plot RUNNING TOTALS: each span record carries the
-    # counter's delta over that span; Perfetto wants the cumulative
-    # series, so accumulate in record order (the recorder ring appends
-    # at span end — chronological in end time). Top-level spans only:
-    # a nested span's delta is already inside its ancestors' deltas,
-    # so summing every depth would multiply-count.
+def _lane_events(recs: List[Dict[str, Any]], pid: int, t0: float
+                 ) -> List[Dict[str, Any]]:
+    """One process lane's data events: every span record becomes a
+    complete ("X") event on lane ``pid``, timestamps µs relative to
+    ``t0`` (epoch seconds). Counter ("C") tracks plot RUNNING TOTALS:
+    each span record carries the counter's delta over that span;
+    Perfetto wants the cumulative series, so accumulate in record
+    order (the recorder ring appends at span end — chronological in
+    end time). Top-level spans only: a nested span's delta is already
+    inside its ancestors' deltas, so summing every depth would
+    multiply-count."""
+    events: List[Dict[str, Any]] = []
     running: Dict[str, float] = {}
     for rec in recs:
         args = {k: v for k, v in rec.items()
                 if k not in ("name", "ts", "dur", "tid", "sid",
-                             "parent", "depth")}
+                             "seq", "parent", "depth")}
         ev = {
             "name": str(rec["name"]),
             "cat": str(rec.get("cat", "veles")),
@@ -71,6 +63,56 @@ def to_trace_events(records: Iterable[Dict[str, Any]]
     return events
 
 
+def to_trace_events(records: Iterable[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+    """Span records (spans.py dicts) → trace_event list. Timestamps
+    become microseconds relative to the earliest span so Perfetto's
+    timeline starts at ~0 instead of the unix epoch."""
+    recs = [r for r in records if "ts" in r and "name" in r]
+    if not recs:
+        return []
+    t0 = min(float(r["ts"]) for r in recs)
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "veles_tpu"},
+    }]
+    events += _lane_events(recs, pid, t0)
+    return events
+
+
+def fleet_trace_events(processes: List[Dict[str, Any]]
+                       ) -> List[Dict[str, Any]]:
+    """Multi-process trace assembly: ``processes`` is a list of
+    ``{"name": lane label, "records": [span records]}`` whose
+    timestamps are ALREADY on one common clock (the fleet assembler
+    in telemetry/fleet.py subtracts each process's estimated offset
+    first). Each process gets its own Perfetto lane (pid 1..N with a
+    ``process_name`` metadata row — real pids may collide across
+    hosts, so lanes are reindexed), timestamps relative to the
+    earliest span anywhere, so the router's route.* spans and every
+    replica's request spans line up on one timeline."""
+    all_ts = [float(r["ts"]) for p in processes
+              for r in p.get("records", ())
+              if "ts" in r and "name" in r]
+    if not all_ts:
+        return []
+    t0 = min(all_ts)
+    events: List[Dict[str, Any]] = []
+    for lane, proc in enumerate(processes, start=1):
+        recs = [r for r in proc.get("records", ())
+                if "ts" in r and "name" in r]
+        if not recs:
+            continue
+        events.append({
+            "name": "process_name", "ph": "M", "pid": lane, "tid": 0,
+            "args": {"name": str(proc.get("name") or
+                                 "process %d" % lane)},
+        })
+        events += _lane_events(recs, lane, t0)
+    return events
+
+
 def export(jsonl_path: str, out_path: str,
            request_id: str = None) -> int:
     """Read span JSONL, write a Chrome trace JSON; returns the number
@@ -82,8 +124,11 @@ def export(jsonl_path: str, out_path: str,
     loading as a blank Perfetto page helps nobody)."""
     records = spans.read_jsonl(jsonl_path)
     if request_id is not None:
+        # the shared correlation predicate: request_id OR trace_id —
+        # one flag serves both "this replica's request" and "this
+        # fleet trace's local spans", agreeing with blackbox inspect
         records = [r for r in records
-                   if str(r.get("request_id")) == str(request_id)]
+                   if spans.matches_request(r, request_id)]
         if not records:
             raise ValueError(
                 "no span records tagged request_id=%s in %s"

@@ -221,6 +221,19 @@ DESCRIPTIONS = {
         "In-flight requests a draining replica handed back with "
         "progress (503 + resume) instead of aborting or riding out "
         "the full generation",
+    # fleet-wide distributed tracing (telemetry/spans.py ring pulls +
+    # telemetry/fleet.py cross-process assembly): bench.py's gate
+    # asserts these read 0 in non-fleet runs
+    "veles_trace_rotations_total":
+        "JSONL --trace-file rotations (the sink grew past "
+        "root.common.trace.rotate_bytes; the previous segment is "
+        "kept as <path>.1, older ones dropped)",
+    "veles_trace_span_pulls_total":
+        "Span-ring pulls served over GET /trace/spans (router + "
+        "serving APIs; the fleet trace assembler's read path)",
+    "veles_trace_fleet_merges_total":
+        "Cross-process fleet traces assembled (span pulls merged "
+        "onto one clock, one Chrome-trace lane per process)",
 }
 
 

@@ -36,7 +36,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from .counters import (METRICS_CONTENT_TYPE,          # noqa: F401
                        QUANTILE_GAUGES, describe_counter,
                        describe_histogram, gauge_text,
-                       histogram_quantile)
+                       histogram_quantile, inc)
 
 #: quantile-gauge suffixes the endpoints derive locally — dropped on
 #: merge and recomputed from the merged buckets
@@ -293,6 +293,330 @@ def render(agg: Dict) -> str:
         val = merged["gauges"][name]
         text += gauge_text(name, val)
     return text
+
+
+# -- fleet-wide distributed tracing (span pulls + timeline assembly) ----------
+#
+# The trace twin of the /metrics aggregation above: every request-
+# plane HTTP surface serves its bounded span ring at GET
+# /trace/spans?since=CURSOR (telemetry/spans.pull_payload — JSONL, a
+# header line + one line per span), and `veles-tpu trace fleet` pulls
+# the router's + every replica's rings, estimates per-process clock
+# offsets by BRACKETING alignment — each router route.attempt span
+# must contain, in true time, the replica `request` span carrying the
+# same (trace_id, attempt) — and merges everything into ONE Chrome
+# trace with one lane per process. The offset technique is
+# devtime.attribute_spans' window alignment reapplied host-to-host;
+# like there, it is an approximation: the estimate is only as tight
+# as the attempt-minus-request slack (network + HTTP framing time),
+# stated in docs/observability.md "Fleet tracing".
+
+def _base_url(url: str) -> str:
+    url = str(url).strip()
+    if "://" not in url:
+        url = "http://" + url
+    url = url.rstrip("/")
+    if url.endswith("/metrics"):
+        url = url[:-len("/metrics")]
+    return url
+
+
+def scrape_spans(url: str, since: int = 0, timeout: float = 5.0
+                 ) -> Tuple[Optional[str], Optional[str]]:
+    """(body, error) for one ``/trace/spans`` endpoint — exactly one
+    of the two is None (the :func:`scrape` contract, for span
+    rings)."""
+    import urllib.request
+    full = "%s/trace/spans?since=%d" % (_base_url(url), int(since))
+    try:
+        with urllib.request.urlopen(full, timeout=timeout) as r:
+            return r.read().decode("utf-8", "replace"), None
+    except Exception as e:      # noqa: BLE001 — a down replica is data
+        return None, "%s: %s" % (type(e).__name__, e)
+
+
+def parse_span_payload(text: str) -> Dict:
+    """One ``/trace/spans`` JSONL body → ``{"header": {...} | None,
+    "spans": [...], "bad": n}``. Torn lines — a response truncated
+    mid-record by a dying replica or a cut connection — are skipped
+    with ONE counted warning (the ``spans.read_jsonl`` salvage rule):
+    the complete prefix still assembles."""
+    import logging
+    header: Optional[Dict] = None
+    spans: List[Dict] = []
+    bad = 0
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            bad += 1
+            continue
+        if not isinstance(rec, dict):
+            bad += 1
+            continue
+        if rec.get("kind") == "spans.header":
+            if header is None:
+                header = rec
+            continue
+        # sanitize HERE, the one remote-data entry point: every
+        # consumer downstream (grouping sort, bracketing pairs, lane
+        # conversion) does float arithmetic on ts/dur, and a corrupt
+        # record from a damaged ring must quarantine like a torn
+        # line, never crash the assembler with a TypeError
+        ts = rec.get("ts")
+        dur = rec.get("dur", 0.0)
+        if "name" not in rec \
+                or not isinstance(ts, (int, float)) \
+                or isinstance(ts, bool):
+            bad += 1
+            continue
+        if not isinstance(dur, (int, float)) or isinstance(dur, bool):
+            rec = dict(rec, dur=0.0)
+        try:
+            tid = int(rec.get("tid", 0))
+        except (TypeError, ValueError):
+            tid = 0
+        if rec.get("tid", 0) != tid:
+            rec = dict(rec, tid=tid)
+        spans.append(rec)
+    if bad:
+        logging.getLogger("veles_tpu.telemetry").warning(
+            "skipped %d torn/malformed line(s) in a /trace/spans "
+            "payload (truncated mid-record; the complete prefix "
+            "still assembles)", bad)
+    return {"header": header, "spans": spans, "bad": bad}
+
+
+def _group_processes(payloads: Sequence[Dict]) -> Dict:
+    """Payloads (``{"url", "header", "spans"}``) → per-PROCESS span
+    sets keyed by the header's ``instance`` token (falling back to
+    the bare pid for payloads from builds without one — pids are
+    per-host, so two hosts CAN hold distinct processes with one
+    pid): ``{key: {"pid", "names", "spans"}}``, deduplicated within
+    a process by the records' pull cursor — an in-process fleet
+    (N replicas + router sharing one python process, the test/bench
+    topology) pulls the SAME process-global ring through every
+    endpoint, and triple-counting it would triple every lane."""
+    procs: Dict = {}
+    for payload in payloads:
+        header = payload.get("header")
+        if header is None:
+            # a payload whose header line was torn away still merges
+            # — keyed by its URL so two headerless SOURCES never
+            # coalesce into one lane (their seq counters both start
+            # at 1 and would cross-dedup each other's spans)
+            header = {}
+            key = "headerless:%s" % payload.get("url")
+            pid = 0
+        else:
+            try:
+                pid = int(header.get("pid", 0) or 0)
+            except (TypeError, ValueError):
+                # a damaged header quarantines like a torn record —
+                # it must not crash the merge of healthy endpoints
+                pid = 0
+            key = header.get("instance") or pid
+        entry = procs.setdefault(key, {"pid": pid, "names": [],
+                                       "seen": {}, "spans": []})
+        name = str(header.get("name") or payload.get("url") or "")
+        if name and name not in entry["names"]:
+            entry["names"].append(name)
+        for rec in payload.get("spans", ()):
+            dedup = (rec.get("seq"), rec.get("sid"), rec.get("ts"))
+            if dedup in entry["seen"]:
+                continue
+            entry["seen"][dedup] = True
+            entry["spans"].append(rec)
+    for entry in procs.values():
+        entry.pop("seen")
+        entry["spans"].sort(key=lambda r: float(r.get("ts", 0.0)))
+    return procs
+
+
+def _bracket_pairs(attempts: Sequence[Dict], requests: Sequence[Dict]
+                   ) -> List[Tuple[float, float]]:
+    """Offset-bound intervals ``[lo, hi]`` (replica_clock −
+    router_clock, seconds) from (route.attempt, request) span pairs
+    sharing (trace_id, attempt): in true time the attempt brackets
+    the replica's request span, so ``R_end − A_end ≤ offset ≤
+    R_start − A_start``."""
+    by_key = {}
+    for a in attempts:
+        key = (a.get("trace_id"), a.get("attempt"))
+        if None not in key:
+            by_key.setdefault(key, a)
+    out: List[Tuple[float, float]] = []
+    for r in requests:
+        a = by_key.get((r.get("trace_id"), r.get("attempt")))
+        if a is None:
+            continue
+        a0, a1 = float(a["ts"]), float(a["ts"]) + float(
+            a.get("dur", 0.0))
+        r0, r1 = float(r["ts"]), float(r["ts"]) + float(
+            r.get("dur", 0.0))
+        lo, hi = r1 - a1, r0 - a0
+        if lo <= hi:
+            out.append((lo, hi))
+    return out
+
+
+def estimate_offsets(procs: Dict) -> Dict:
+    """Per-process clock offsets onto the ROUTER's clock, keyed like
+    ``procs``: ``{key: {"pid", "offset": seconds, "pairs": n,
+    "bound": slack}}``. The reference process is the one emitting
+    ``route.attempt`` spans (offset 0 by definition); every other
+    process's offset is the midpoint of the intersected bracketing
+    intervals (median of midpoints when noise empties the
+    intersection), ``bound`` the final interval's width — the stated
+    uncertainty of the estimate. A process with no bracketing pair
+    keeps offset 0 with ``pairs: 0`` (assembled on its own clock,
+    flagged in the CLI summary)."""
+    ref_key = None
+    for key, entry in sorted(procs.items(), key=lambda kv: str(kv[0])):
+        if any(r.get("name") == "route.attempt"
+               for r in entry["spans"]):
+            ref_key = key
+            break
+    if ref_key is None and procs:
+        ref_key = sorted(procs, key=str)[0]
+    out: Dict = {}
+    attempts = [r for r in procs.get(ref_key, {}).get("spans", ())
+                if r.get("name") == "route.attempt"] \
+        if ref_key is not None else []
+    for key, entry in procs.items():
+        pid = entry.get("pid", 0)
+        if key == ref_key:
+            out[key] = {"pid": pid, "offset": 0.0, "pairs": 0,
+                        "bound": 0.0, "reference": True}
+            continue
+        requests = [r for r in entry["spans"]
+                    if r.get("name") == "request"]
+        pairs = _bracket_pairs(attempts, requests)
+        if not pairs:
+            out[key] = {"pid": pid, "offset": 0.0, "pairs": 0,
+                        "bound": None}
+            continue
+        lo = max(p[0] for p in pairs)
+        hi = min(p[1] for p in pairs)
+        if lo <= hi:
+            offset, bound = (lo + hi) / 2.0, hi - lo
+        else:
+            # noisy pairs emptied the intersection: fall back to the
+            # median of per-pair midpoints
+            mids = sorted((a + b) / 2.0 for a, b in pairs)
+            offset = mids[len(mids) // 2]
+            bound = max(b - a for a, b in pairs)
+        out[key] = {"pid": pid, "offset": offset,
+                    "pairs": len(pairs), "bound": bound}
+    return out
+
+
+def assemble_fleet_trace(payloads: Sequence[Dict],
+                         request: Optional[str] = None
+                         ) -> Tuple[Dict, Dict]:
+    """Merge N parsed ``/trace/spans`` payloads into ONE Chrome trace
+    document: spans deduplicated per process, each process's clock
+    shifted onto the router's by the bracketing estimate, one
+    Perfetto lane per process. ``request`` keeps only one request's
+    story — every span whose ``trace_id`` matches (resolving a
+    request_id to its trace first), so the timeline reads: queue at
+    the router, attempt 1, replica death, backoff, attempt 2 with
+    resume, first token, terminal. Returns ``(trace document,
+    summary)``; raises ValueError when nothing survives (an empty
+    Perfetto page helps nobody). Counted
+    ``veles_trace_fleet_merges_total``."""
+    from . import chrome_trace
+    procs = _group_processes(payloads)
+    offsets = estimate_offsets(procs)
+    if request is not None:
+        from .spans import matches_request
+        tids = {str(r.get("trace_id"))
+                for entry in procs.values() for r in entry["spans"]
+                if matches_request(r, request)
+                and r.get("trace_id") is not None}
+        if not tids:
+            raise ValueError(
+                "no span tagged request_id/trace_id %s in any pulled "
+                "ring" % request)
+    processes = []
+    total = 0
+    for key in sorted(procs,
+                      key=lambda p: (not offsets[p].get("reference"),
+                                     str(p))):
+        entry = procs[key]
+        off = offsets[key]["offset"]
+        recs = []
+        for rec in entry["spans"]:
+            if request is not None \
+                    and str(rec.get("trace_id")) not in tids \
+                    and str(rec.get("request_id")) != str(request):
+                continue
+            out = dict(rec, ts=float(rec["ts"]) - off)
+            if off:
+                out["clock_offset_s"] = round(off, 6)
+            recs.append(out)
+        if not recs:
+            # a process the --request filter emptied renders no lane
+            # — and must not inflate the summary's lane count either
+            continue
+        total += len(recs)
+        processes.append({
+            "name": "%s (pid %d)" % ("+".join(entry["names"])
+                                     or "process", entry["pid"]),
+            "records": recs,
+        })
+    if not total:
+        raise ValueError("no spans to assemble (empty rings%s)"
+                         % (", or nothing tagged %s" % request
+                            if request else ""))
+    doc = {"traceEvents": chrome_trace.fleet_trace_events(processes),
+           "displayTimeUnit": "ms"}
+    errors = chrome_trace.validate(doc)
+    if errors:        # assembler bug, not user input — fail loudly
+        raise ValueError("invalid fleet trace produced: %s"
+                         % errors[:3])
+    inc("veles_trace_fleet_merges_total")
+    summary = {
+        "processes": len(processes),
+        "spans": total,
+        "offsets": {key: dict(offsets[key],
+                              offset=round(offsets[key]["offset"], 6))
+                    for key in offsets},
+    }
+    if request is not None:
+        summary["trace_ids"] = sorted(tids)
+    return doc, summary
+
+
+def trace_fleet(urls: Sequence[str], request: Optional[str] = None,
+                since: int = 0, timeout: float = 5.0
+                ) -> Tuple[Dict, Dict]:
+    """Pull every endpoint's span ring and assemble the fleet trace
+    (``veles-tpu trace fleet`` driver). Down endpoints degrade to
+    up=0 rows in the summary — the merge runs over whoever answered;
+    raises ValueError when NOBODY did."""
+    payloads = []
+    statuses = []
+    for url in urls:
+        body, error = scrape_spans(url, since=since, timeout=timeout)
+        statuses.append({"url": url, "up": body is not None,
+                         "error": error})
+        if body is None:
+            continue
+        parsed = parse_span_payload(body)
+        parsed["url"] = url
+        payloads.append(parsed)
+    if not payloads:
+        raise ValueError(
+            "no /trace/spans endpoint answered (%s)"
+            % "; ".join("%s: %s" % (s["url"], s["error"])
+                        for s in statuses))
+    doc, summary = assemble_fleet_trace(payloads, request=request)
+    summary["endpoints"] = statuses
+    return doc, summary
 
 
 def main(argv) -> int:

@@ -53,6 +53,11 @@ from ..config import root
 from .counters import add_inc_hook as _add_inc_hook
 from .counters import inc as _counter_inc
 from .spans import add_close_hook as _add_close_hook
+# the ONE request-correlation predicate (spans.py owns it), re-
+# exported here because `blackbox inspect --request` is its flight-
+# recorder face: a crashed replica's dump cross-references a merged
+# fleet trace by either request_id or trace_id
+from .spans import matches_request                    # noqa: F401
 
 #: default ring capacity (events)
 DEFAULT_CAPACITY = 4096
@@ -271,17 +276,24 @@ def read_blackbox(path: str) -> Tuple[Optional[Dict[str, Any]],
     return header, events
 
 
-def inspect(path: str) -> Dict[str, Any]:
+def inspect(path: str, request: Optional[str] = None
+            ) -> Dict[str, Any]:
     """Summary of a black-box dump: reason, event count, per-kind
     counts, covered time range — what ``veles-tpu blackbox inspect``
-    prints."""
+    prints. ``request`` narrows the view to one request's events
+    (request_id or trace_id — ``blackbox inspect --request ID``): the
+    crashed replica's last seconds for exactly the request a fleet
+    trace says died there."""
     header, events = read_blackbox(path)
+    total = len(events)
+    if request is not None:
+        events = [e for e in events if matches_request(e, request)]
     by_kind: Dict[str, int] = {}
     for rec in events:
         kind = str(rec.get("kind", "?"))
         by_kind[kind] = by_kind.get(kind, 0) + 1
     times = [r["t"] for r in events if isinstance(r.get("t"), (int, float))]
-    return {
+    out = {
         "path": path,
         "reason": (header or {}).get("reason"),
         "dumped_at": (header or {}).get("t"),
@@ -291,6 +303,10 @@ def inspect(path: str) -> Dict[str, Any]:
         "span_seconds": (round(max(times) - min(times), 3)
                          if len(times) > 1 else 0.0),
     }
+    if request is not None:
+        out["request"] = str(request)
+        out["events_total"] = total
+    return out
 
 
 # -- subscriptions ------------------------------------------------------------

@@ -34,7 +34,7 @@ import json
 import os
 import threading
 import time
-from typing import Any, Deque, Dict, Iterable, List, Optional
+from typing import Any, Deque, Dict, Iterable, List, Optional, Tuple
 
 from .counters import counters
 
@@ -57,16 +57,44 @@ def add_close_hook(fn) -> None:
         _close_hooks.append(fn)
 
 
+#: cached config NODE (not values): the auto-vivified
+#: root.common.trace node is stable, so caching it keeps the per-span
+#: knob lookups to one dict get while config writes stay immediately
+#: visible (same discipline as telemetry/recorder.py)
+_cfg_node = None
+
+
+def _cfg(name: str, default):
+    global _cfg_node
+    try:
+        if _cfg_node is None:
+            from ..config import root
+            _cfg_node = root.common.trace
+        return _cfg_node.get(name, default)
+    except Exception:            # noqa: BLE001 — config not importable
+        return default           # (tests importing spans standalone)
+
+
+def _cfg_int(name: str, default: int) -> int:
+    """Integer config knob, malformed values degraded to the default:
+    these lookups sit on the span APPEND path, where an operator's
+    ``span_ring = "64k"`` must not turn every instrumented ``with
+    span(...)`` exit in the tree into a ValueError."""
+    value = _cfg(name, default)
+    if value is None:
+        return default
+    try:
+        return int(value)            # 0 stays 0 — "disabled" knobs
+    except (TypeError, ValueError):
+        return default
+
+
 def _enabled() -> bool:
     """THE span on/off switch (``root.common.trace.spans``), honored
     centrally by the recorder so every instrumented site — Unit.run,
     workflow.run/initialize, the train step, the decoders — obeys one
     knob."""
-    try:
-        from ..config import root
-        return bool(root.common.trace.get("spans", True))
-    except Exception:            # noqa: BLE001 — config not importable
-        return True              # (tests importing spans standalone)
+    return bool(_cfg("spans", True))
 
 
 class _Frame:
@@ -79,15 +107,32 @@ class _Frame:
 
 
 class SpanRecorder:
-    """Ring of completed span records + optional JSONL file sink."""
+    """Ring of completed span records + optional JSONL file sink.
 
-    def __init__(self, maxlen: int = 65536) -> None:
+    The ring is the span plane's bounded black box (the span twin of
+    the flight recorder's 4096-event discipline): long-running
+    serving replicas keep their recent spans pullable over
+    ``GET /trace/spans?since=CURSOR`` without ever needing a
+    ``--trace-file``. Every appended record carries a process-
+    monotonic ``seq`` — the pull cursor — and the ring's capacity
+    follows ``root.common.trace.span_ring`` (default 65536)."""
+
+    def __init__(self, maxlen: int = 65536,
+                 follow_config: bool = False) -> None:
         self._lock = threading.Lock()
         self._ring: Deque[Dict[str, Any]] = collections.deque(
             maxlen=maxlen)
         self._file = None
         self._path: Optional[str] = None
         self._tls = threading.local()
+        #: process-monotonic append sequence — the /trace/spans cursor
+        self._seq = 0
+        #: bytes appended to the current sink file (rotation ledger)
+        self._sink_bytes = 0
+        #: True only on the process-global instance: the ring tracks
+        #: the root.common.trace.span_ring capacity knob (explicit
+        #: capacities — tests — stay fixed)
+        self._follow_config = follow_config
 
     # -- sink ----------------------------------------------------------------
     def set_sink(self, path: Optional[str]) -> None:
@@ -107,6 +152,10 @@ class SpanRecorder:
                 # can never interleave mid-JSON-line
                 self._file = open(path, "a", buffering=1)
                 self._path = path
+                try:
+                    self._sink_bytes = os.path.getsize(path)
+                except OSError:
+                    self._sink_bytes = 0
 
     @property
     def sink_path(self) -> Optional[str]:
@@ -152,17 +201,86 @@ class SpanRecorder:
         if delta:
             rec["counters"] = delta
         rec.update(frame.attrs)
+        self._append(rec)
+        return rec
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        """Shared tail of :meth:`end`/:meth:`emit`: stamp the pull
+        cursor, honor the ring-capacity knob, append, stream to the
+        sink (rotating past ``root.common.trace.rotate_bytes``), then
+        run the close hooks outside the lock."""
         counters.inc("veles_spans_total")
+        rotated = False
         with self._lock:
+            if self._follow_config:
+                # honor a changed span_ring knob (the global instance
+                # is built at import, before any config lands)
+                want = _cfg_int("span_ring", self._ring.maxlen)
+                if want > 0 and want != self._ring.maxlen:
+                    self._ring = collections.deque(self._ring,
+                                                   maxlen=want)
+            self._seq += 1
+            rec["seq"] = self._seq
             self._ring.append(rec)
             if self._file is not None:
-                self._file.write(json.dumps(rec, default=str) + "\n")
+                line = json.dumps(rec, default=str) + "\n"
+                self._file.write(line)
+                # BYTE ledger (set_sink/rotation reseed it from
+                # getsize): json.dumps ASCII-escapes by default, but
+                # default=str stringifies arbitrary attrs — count
+                # encoded bytes, not code points
+                self._sink_bytes += len(line.encode("utf-8"))
+                rotated = self._maybe_rotate_locked()
+        if rotated:
+            counters.inc("veles_trace_rotations_total")
         for hook in _close_hooks:
             try:
                 hook(rec)
             except Exception:       # noqa: BLE001 — observers only
                 pass
-        return rec
+
+    def _maybe_rotate_locked(self) -> bool:
+        """Rotate the JSONL sink once it grows past
+        ``root.common.trace.rotate_bytes`` (default 64 MiB; 0
+        disables): the full segment moves to ``<path>.1`` — dropping
+        the previous ``.1``, the journal's segment-drop pattern — and
+        a fresh file opens at ``<path>``, so a long-running serving
+        process's trace file is bounded by ~2x the knob instead of
+        growing with traffic history. Counted
+        ``veles_trace_rotations_total`` (by the caller, outside the
+        lock). A sink another writer still appends to (the logger's
+        event handle shares ``--trace-file``) keeps following the
+        rotated-out segment until its next reopen — documented in
+        docs/observability.md."""
+        limit = _cfg_int("rotate_bytes", 64 << 20)
+        if limit <= 0 or self._sink_bytes < limit \
+                or self._file is None or self._path is None:
+            return False
+        try:
+            self._file.close()
+            os.replace(self._path, self._path + ".1")
+            self._file = open(self._path, "a", buffering=1)
+            self._sink_bytes = 0
+            return True
+        except OSError:
+            # a failed rotation must not kill span recording: reopen
+            # the (possibly still-present) sink and keep appending
+            try:
+                self._file = open(self._path, "a", buffering=1)
+                self._sink_bytes = os.path.getsize(self._path)
+            except OSError as e:
+                # double failure (disk gone, permissions flipped):
+                # the sink is DEAD — say so and stop reporting it as
+                # active, instead of silently dropping every span
+                import logging
+                logging.getLogger("veles_tpu.telemetry").warning(
+                    "trace sink %s lost during rotation (%s: %s) — "
+                    "span file streaming stops; the in-memory ring "
+                    "keeps recording", self._path,
+                    type(e).__name__, e)
+                self._file = None
+                self._path = None
+            return False
 
     def emit(self, name: str, ts: float, dur: float,
              **attrs: Any) -> Dict[str, Any]:
@@ -187,16 +305,7 @@ class SpanRecorder:
             "tid": threading.get_ident(),
         }
         rec.update(attrs)
-        counters.inc("veles_spans_total")
-        with self._lock:
-            self._ring.append(rec)
-            if self._file is not None:
-                self._file.write(json.dumps(rec, default=str) + "\n")
-        for hook in _close_hooks:
-            try:
-                hook(rec)
-            except Exception:       # noqa: BLE001 — observers only
-                pass
+        self._append(rec)
         return rec
 
     # -- introspection -------------------------------------------------------
@@ -206,6 +315,35 @@ class SpanRecorder:
         if name is not None:
             recs = [r for r in recs if r["name"] == name]
         return recs
+
+    def cursor(self) -> int:
+        """The current pull cursor (the newest record's seq) without
+        copying any records — for callers that only want a position
+        to pull *from* later."""
+        with self._lock:
+            return self._seq
+
+    def records_since(self, cursor: int
+                      ) -> Tuple[List[Dict[str, Any]], int]:
+        """(records appended after ``cursor``, the new cursor) — the
+        incremental read behind ``GET /trace/spans?since=CURSOR``. A
+        cursor older than the ring's tail silently skips the evicted
+        records (bounded ring, same contract as the flight
+        recorder); cursor 0 returns everything still buffered."""
+        cursor = int(cursor)
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            # seq climbs with ring order: walk from the newest end
+            # and stop at the cursor, so an incremental pull near
+            # the tip never scans the whole 65536-record ring under
+            # the lock the append path shares
+            for rec in reversed(self._ring):
+                if int(rec.get("seq", 0)) <= cursor:
+                    break
+                out.append(rec)
+            nxt = self._seq
+        out.reverse()
+        return out, nxt
 
     def clear(self) -> None:
         with self._lock:
@@ -221,7 +359,36 @@ class SpanRecorder:
 
 
 #: THE process-global recorder (mirrors counters.counters).
-recorder = SpanRecorder()
+recorder = SpanRecorder(follow_config=True)
+
+#: process-unique instance token for the /trace/spans header: pids
+#: are per-HOST, so a multi-host fleet can hold two distinct
+#: processes with one pid — the fleet assembler groups on this token
+#: (falling back to pid for payloads from older builds) so they
+#: never merge into one lane or steal each other's clock offset
+import uuid as _uuid                                    # noqa: E402
+
+instance_id = _uuid.uuid4().hex[:12]
+
+
+def pull_payload(since: int = 0, name: str = "") -> str:
+    """The ``GET /trace/spans?since=CURSOR`` response body: one JSONL
+    header line identifying the process (pid, service name, the new
+    cursor, this host's wall clock at render time) followed by one
+    line per span record appended after ``since``. JSONL on purpose —
+    a response torn mid-record (dead replica, truncated read)
+    salvages line by line exactly like :func:`read_jsonl`, instead of
+    one torn JSON document losing everything. Served by the router
+    and both serving APIs; consumed by ``veles-tpu trace fleet``
+    (telemetry/fleet.py). Counted ``veles_trace_span_pulls_total``."""
+    recs, cursor = recorder.records_since(since)
+    header = {"kind": "spans.header", "pid": os.getpid(),
+              "instance": instance_id,
+              "name": str(name or ""), "cursor": cursor,
+              "wall": time.time(), "spans": len(recs)}
+    counters.inc("veles_trace_span_pulls_total")
+    return "\n".join(json.dumps(r, default=str)
+                     for r in [header] + recs) + "\n"
 
 
 class span:
@@ -241,6 +408,18 @@ class span:
         if exc_type is not None:
             self._frame.attrs["error"] = True
         self.record = recorder.end(self._frame)
+
+
+def matches_request(record: Dict[str, Any], request: str) -> bool:
+    """Does a span record / flight event belong to one serving
+    request? Matches the ``request_id`` OR the fleet ``trace_id`` tag
+    — THE one correlation predicate ``trace export --request``,
+    ``trace fleet --request`` and ``blackbox inspect --request``
+    share, so the three views can never disagree on which records
+    tell a request's story."""
+    rid = str(request)
+    return str(record.get("request_id")) == rid \
+        or str(record.get("trace_id")) == rid
 
 
 def emit(name: str, ts: float, dur: float, **attrs: Any
