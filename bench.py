@@ -672,6 +672,12 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # the one-merged-trace-across-a-replica-death measurement is
         # the gate's live tracing proof
         "tracing": _tracing_section(),
+        # prefix-sharing request plane (serving/pages.py PrefixCache
+        # + engine adoption/COW/eviction): the bench never serves, so
+        # every prefix counter MUST read zero here — the gate fails
+        # on leakage; the share-ratio FLOP-reduction, stream-TTFT and
+        # chunk-stall measurements are gate_prefix's live proof
+        "prefix": _prefix_section(),
         "extras": [ae, lm],
     }
 
@@ -749,6 +755,28 @@ def _serving_section():
         "ttft_p99": q("veles_serving_ttft_seconds", 0.99),
         "tpot_p50": q("veles_serving_tpot_seconds", 0.5),
         "queue_wait_p99": q("veles_serving_queue_wait_seconds", 0.99),
+    }
+
+
+def _prefix_section():
+    """{hits, misses, shared_pages, cow_copies, evictions} for this
+    bench process — absolute counter reads (one process, counters
+    start at zero). The bench never serves, so every count MUST be
+    zero — ``bench.py gate`` fails on leakage. The live prefix proof
+    (share-ratio-bounded prefill-FLOP reduction over the actual
+    compiled programs, streamed TTFT < full-response latency, chunked
+    prefill bounding the in-flight decode stall) runs inside
+    ``gate_prefix``."""
+    from veles_tpu.telemetry.counters import counters
+    return {
+        "hits": int(counters.get("veles_prefix_hits_total")),
+        "misses": int(counters.get("veles_prefix_misses_total")),
+        "shared_pages": int(
+            counters.get("veles_prefix_shared_pages_total")),
+        "cow_copies": int(
+            counters.get("veles_prefix_cow_copies_total")),
+        "evictions": int(
+            counters.get("veles_prefix_evictions_total")),
     }
 
 
@@ -2387,6 +2415,308 @@ def _fleet_trace_proof():
     return failures
 
 
+#: chunk-overhead allowance for the share-ratio FLOP bound: a chunked
+#: suffix pass re-reads the whole gathered page view per chunk and
+#: pads its final chunk, so the measured prefill-FLOP reduction is
+#: required to reach share_ratio x this factor, not share_ratio
+#: itself (the stamps print both numbers)
+PREFIX_SHARE_TOLERANCE = 0.75
+
+
+def gate_prefix(baseline_doc=None, current_doc=None):
+    """``prefix`` gate section: (1) every prefix-sharing counter must
+    be registered with a HELP string; (2) bench documents must carry
+    ZERO prefix-plane activity — the bench never serves, so
+    hits/COW/evictions in a training measurement mean the sharing
+    machinery leaked; (3) live proof (:func:`_prefix_sharing_proof`):
+    a 16-request shared-prefix load under prefix_cache=on shows a
+    prefill-FLOP reduction >= share_ratio x PREFIX_SHARE_TOLERANCE
+    (CostModel over the ACTUAL compiled prefill/chunk programs),
+    id-exact vs the prefix-off engine; a streamed response's first
+    token arrives strictly before the full buffered response; and
+    chunked prefill bounds the per-tick in-flight decode stall below
+    the monolithic prefill's. Runs AFTER the fleet/lossless/tracing
+    drills in _gate_main (their serving legitimately moves shared
+    counters), so leakage is asserted on the DOCUMENTS only."""
+    from veles_tpu.serving import PREFIX_COUNTERS
+    from veles_tpu.telemetry.counters import DESCRIPTIONS
+    failures = []
+    for name in PREFIX_COUNTERS:
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "prefix: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc),
+                     ("current", current_doc)):
+        sec = (doc or {}).get("prefix")
+        if not sec:
+            continue
+        if ((doc or {}).get("serving") or {}).get("serving_bench"):
+            continue        # a serving-mode bench shares on purpose
+        for key, value in sec.items():
+            if value:
+                failures.append(
+                    "prefix: %s doc has %s=%s — prefix-sharing work "
+                    "leaked into a non-serving bench run"
+                    % (tag, key, value))
+    return failures + _prefix_sharing_proof()
+
+
+def _prefix_sharing_proof():
+    """THE prefix/chunk/stream drill, live on this process's CPU (or
+    chip) backend. One small char_lm stack serves three measurements:
+
+    1. **share-ratio FLOP bound** — 16 requests sharing a 48-token
+       prefix (4-token unique tails) served by a prefix-OFF and a
+       prefix-ON engine; each engine's prefill FLOPs are priced as
+       sum(CostModel(compiled program) x dispatches) over its ACTUAL
+       programs (``ContinuousEngine.prog_calls``), answers asserted
+       id-exact, and the ON engine's reduction must reach
+       share_ratio x PREFIX_SHARE_TOLERANCE;
+    2. **chunk stall bound** — a long-prompt admission lands while a
+       decode is in flight on each engine; the monolithic engine's
+       ``prefill_stall_max`` (seconds of prefill work in a tick with
+       co-tenants) must exceed the chunked engine's — chunked prefill
+       bounds in-flight TPOT jitter, measured;
+    3. **streamed TTFT** — the same request POSTed ``stream=true``
+       and buffered against a live GenerationAPI: the first SSE token
+       event must arrive strictly before the buffered response
+       completes, with the TTFT/TPOT p50/p99 histogram quantiles
+       stamped alongside."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import urllib.request
+    import char_lm
+    import veles_tpu as vt
+    from veles_tpu import prng
+    from veles_tpu.nn import sampling
+    from veles_tpu.serving import ContinuousEngine
+    from veles_tpu.serving.engine import make_request
+    from veles_tpu.serving.scheduler import Ticket
+    from veles_tpu.telemetry.cost import cost_of_compiled
+    from veles_tpu.telemetry.counters import counters as _ctrs
+    from veles_tpu.telemetry.counters import histograms as _hists
+
+    prng.seed_all(5151)
+    wf = char_lm.build_workflow(epochs=1, minibatch_size=32,
+                                n_blocks=2, dim=32, n_train=64,
+                                n_valid=32)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    failures = []
+    rng = __import__("numpy").random.RandomState(9)
+    shared = [int(t) for t in char_lm.make_corpus(rng, 48)]
+    reqs = []
+    for i in range(16):
+        tail = [int(t) for t in char_lm.make_corpus(
+            __import__("numpy").random.RandomState(200 + i), 4)]
+        reqs.append(make_request(
+            shared + tail, 8,
+            temperature=0.8 if i % 2 else 0.0,
+            seed=300 + i, mode="sample" if i % 2 else "greedy"))
+
+    def prefill_flops(engine):
+        total = 0.0
+        for key, calls in engine.prog_calls.items():
+            if key[0] not in ("prefill", "pchunk", "dprefill"):
+                continue
+            prog = engine._progs.get(key)
+            exe = prog.compiled() if prog is not None else None
+            if exe is None:
+                return None
+            total += cost_of_compiled(exe).flops * calls
+        return total
+
+    def run_load(engine):
+        out = engine.serve([dict(reqs[0])])
+        out += engine.serve([dict(r) for r in reqs[1:]])
+        return out
+
+    def stall_drill(engine):
+        """Long-prompt admission mid-decode; returns the engine's
+        worst per-tick prefill stall with co-tenants in flight.
+        BOTH prompt shapes are served (and so compiled) solo first
+        and the gauge reset, so the measured stall is prefill
+        EXECUTION — the steady-state number — never the one-time XLA
+        compile a warm production engine would not pay."""
+        long_prompt = [int(t) for t in char_lm.make_corpus(
+            __import__("numpy").random.RandomState(77), 200)]
+        engine.serve([make_request([1, 5, 3, 2], 2, seed=7),
+                      make_request(long_prompt, 2, seed=8)])
+        engine.prefill_stall_max = engine.prefill_stall_last = 0.0
+        inflight = Ticket()
+        assert engine.submit(make_request([1, 5, 3, 2], 64, seed=7),
+                             inflight)
+        deadline = time.time() + 30
+        while engine.scheduler.busy_count() == 0 \
+                and time.time() < deadline:
+            time.sleep(0.005)
+        time.sleep(0.05)        # decoding under way
+        long = Ticket()
+        assert engine.submit(make_request(long_prompt, 4, seed=8),
+                             long)
+        long.event.wait(60)
+        inflight.event.wait(60)
+        return engine.prefill_stall_max
+
+    hits0 = _ctrs.get("veles_prefix_hits_total")
+    # two geometries: the FLOP phase keeps the logical view short
+    # (the chunk pass attends over the whole gathered view, so a
+    # stall-drill-sized max_context would bill every chunk for dead
+    # masked keys); the stall phase needs the big bucket
+    geometry = dict(max_slots=4, buckets=(64,), max_context=96,
+                    page_size=8, decode_block=1)
+    stall_geo = dict(max_slots=4, buckets=(64, 256), max_context=288,
+                     page_size=8, decode_block=1)
+    # constructed INSIDE the try: a later constructor failing must
+    # not leak earlier engines' tick threads into the rest of the
+    # gate run (they would keep mutating shared counters)
+    engines = []
+    api = None
+    try:
+        e_off = ContinuousEngine(wf, name="prefix_off",
+                                 prefix_cache=False,
+                                 prefill_chunk=0, **geometry).start()
+        engines.append(e_off)
+        e_on = ContinuousEngine(wf, name="prefix_on",
+                                prefix_cache=True,
+                                prefill_chunk=8, **geometry).start()
+        engines.append(e_on)
+        s_off = ContinuousEngine(wf, name="stall_off",
+                                 prefix_cache=False,
+                                 prefill_chunk=0, **stall_geo).start()
+        engines.append(s_off)
+        s_on = ContinuousEngine(wf, name="stall_on",
+                                prefix_cache=False,
+                                prefill_chunk=8, **stall_geo).start()
+        engines.append(s_on)
+        out_off = run_load(e_off)
+        out_on = run_load(e_on)
+        if out_off != out_on:
+            failures.append(
+                "prefix: prefix-cache ON answers differ from OFF — "
+                "id-exactness under sharing is broken")
+        hits = _ctrs.get("veles_prefix_hits_total") - hits0
+        if hits < 15:
+            failures.append(
+                "prefix: only %d/15 shared-prefix admissions hit the "
+                "cache" % hits)
+        flops_off = prefill_flops(e_off)
+        flops_on = prefill_flops(e_on)
+        if not flops_off or flops_on is None:
+            failures.append(
+                "prefix: CostModel could not price the compiled "
+                "prefill programs (off=%s on=%s)"
+                % (flops_off, flops_on))
+        else:
+            total_pos = sum(len(r["prompt"]) for r in reqs)
+            share_ratio = (len(reqs) - 1) * len(shared) / total_pos
+            reduction = 1.0 - flops_on / flops_off
+            required = share_ratio * PREFIX_SHARE_TOLERANCE
+            if reduction < required:
+                failures.append(
+                    "prefix: prefill-FLOP reduction %.3f below the "
+                    "share-ratio bound %.3f (share_ratio %.3f x "
+                    "tolerance %.2f; %.3e -> %.3e flops)"
+                    % (reduction, required, share_ratio,
+                       PREFIX_SHARE_TOLERANCE, flops_off, flops_on))
+            else:
+                print("prefix proof: 16-request shared-prefix load -> "
+                      "prefill %.3e flops (off) vs %.3e (on), "
+                      "reduction %.1f%% >= bound %.1f%% "
+                      "(share ratio %.1f%%), %d cache hits, id-exact"
+                      % (flops_off, flops_on, reduction * 100,
+                         required * 100, share_ratio * 100, hits))
+        # -- chunk stall bound (min-of-2 per engine: scheduler noise
+        # must not flip a genuine 256-row vs 8-row execution contrast)
+        stall_off = min(stall_drill(s_off), stall_drill(s_off))
+        stall_on = min(stall_drill(s_on), stall_drill(s_on))
+        if stall_off <= 0:
+            failures.append(
+                "prefix: monolithic stall drill recorded no co-tenant "
+                "prefill stall (harness broken?)")
+        elif stall_on >= stall_off:
+            failures.append(
+                "prefix: chunked prefill stall %.4fs does not undercut "
+                "the monolithic prefill's %.4fs — chunking is not "
+                "bounding in-flight decode stalls"
+                % (stall_on, stall_off))
+        else:
+            print("prefix proof: per-tick decode stall %.4fs "
+                  "(monolithic 256-token prefill) -> %.4fs (8-token "
+                  "chunks), %.1fx smaller"
+                  % (stall_off, stall_on, stall_off / max(stall_on,
+                                                          1e-9)))
+        # -- streamed TTFT < full-response latency ----------------------------
+        api = vt.GenerationAPI(wf, port=0, engine="continuous",
+                               max_slots=2, buckets=(8, 16),
+                               max_context=64, decode_block=1,
+                               prefix_cache=True, prefill_chunk=8,
+                               name="prefix_stream")
+        api.initialize()
+        url = "http://127.0.0.1:%d/generate" % api.port
+        payload = {"prompt": [1, 5, 3, 2, 4], "n_new": 24}
+
+        def post(body):
+            req = urllib.request.Request(
+                url, data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            return urllib.request.urlopen(req, timeout=60)
+
+        post(dict(payload, n_new=4)).read()      # warm the programs
+        t0 = time.time()
+        post(payload).read()
+        full_latency = time.time() - t0
+        t0 = time.time()
+        t_first = None
+        toks = []
+        final = {}
+        with post(dict(payload, stream=True)) as r:
+            for line in r:
+                line = line.strip()
+                if not line.startswith(b"data:"):
+                    continue
+                ev = json.loads(line[5:])
+                if ev.get("done"):
+                    final = ev
+                elif ev.get("tokens"):
+                    if t_first is None:
+                        t_first = time.time() - t0
+                    toks += ev["tokens"]
+        expected = sampling.generate(wf, payload["prompt"], 24,
+                                     temperature=0)
+        if toks != expected or final.get("tokens") != expected:
+            failures.append(
+                "prefix: streamed tokens differ from the solo decode")
+        if t_first is None or t_first >= full_latency:
+            failures.append(
+                "prefix: streamed TTFT %s not below the full-response "
+                "latency %.4fs" % (t_first, full_latency))
+        else:
+            def q(name, quant):
+                val = _hists.quantile(name, quant)
+                return -1.0 if val is None else val
+            print("prefix proof: streamed TTFT %.4fs < full response "
+                  "%.4fs (%.1fx); ttft p50/p99 %.4f/%.4fs, tpot "
+                  "p50/p99 %.4f/%.4fs"
+                  % (t_first, full_latency, full_latency / t_first,
+                     q("veles_serving_ttft_seconds", 0.5),
+                     q("veles_serving_ttft_seconds", 0.99),
+                     q("veles_serving_tpot_seconds", 0.5),
+                     q("veles_serving_tpot_seconds", 0.99)))
+    finally:
+        for engine in engines:
+            engine.stop()
+        if api is not None:
+            api.stop()
+    for engine in engines:
+        ledger = engine.page_pool.ledger()
+        if ledger:
+            failures.append(
+                "prefix: %s page refcount ledger did not balance "
+                "after the drill (%d entries left)"
+                % (engine.name, len(ledger)))
+    return failures
+
+
 def gate_quant(baseline_doc=None, current_doc=None):
     """``quant`` gate section: (1) the quantization/artifact counters
     must be registered; (2) quant-off bench documents must carry ZERO
@@ -2697,6 +3027,10 @@ def _gate_main(argv):
                 # spans legitimately live in the ring, so the tracing
                 # gate asserts doc leakage + its own live proof
                 + gate_tracing(baseline, current)
+                # AFTER every serving drill: prefix leakage is a
+                # DOCUMENT assertion + its own live share/stream/
+                # stall proof
+                + gate_prefix(baseline, current)
                 + gate_quant(baseline, current))
     for failure in failures:
         print("GATE FAIL %s" % failure, file=sys.stderr)
@@ -2714,7 +3048,9 @@ def _gate_main(argv):
           "+ 2-replica failover drill exactly-once, lossless clean "
           "+ journaled resume id-exact and cheaper than redo, "
           "tracing clean + router-path dispatch lock + one merged "
-          "fleet trace across a replica death, quant "
+          "fleet trace across a replica death, prefix clean + "
+          "share-ratio FLOP bound + streamed TTFT + chunk stall "
+          "bound, quant "
           "clean + int8 greedy token-exact + artifact serves with "
           "zero compiles)"
           % (argv[1], argv[0],

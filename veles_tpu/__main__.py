@@ -87,6 +87,13 @@ def main(argv=None) -> int:
         _root.common.serving.beam_width = args.serve_beam_width
     if args.serve_artifact:
         _root.common.serving.artifact = args.serve_artifact
+    if args.serve_prefix_cache is not None:
+        _root.common.serving.prefix_cache = \
+            args.serve_prefix_cache == "on"
+    if args.serve_prefill_chunk is not None:
+        _root.common.serving.prefill_chunk = args.serve_prefill_chunk
+    if args.serve_stream is not None:
+        _root.common.serving.stream = args.serve_stream == "on"
     if args.serve_drain_grace is not None:
         _root.common.serving.drain_grace = args.serve_drain_grace
     if args.serve_drain_handoff is not None:

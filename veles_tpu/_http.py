@@ -54,6 +54,27 @@ def handle_trace_spans(handler, path: str, name: str = "") -> bool:
     return True
 
 
+def sse_headers(handler) -> None:
+    """Commit a 200 ``text/event-stream`` response (token streaming —
+    the GenerationAPI's stream reply and the FleetRouter's stream
+    proxy share this framing, so the wire protocol cannot drift
+    between them)."""
+    handler.send_response(200)
+    handler.send_header("Content-Type", "text/event-stream")
+    handler.send_header("Cache-Control", "no-store")
+    handler.end_headers()
+    handler.close_connection = True
+
+
+def sse_event(handler, payload: Any) -> None:
+    """Write one ``data: <json>`` SSE event and flush. Write errors
+    (the CLIENT went away) propagate — callers distinguish them from
+    upstream failures."""
+    handler.wfile.write(b"data: " + json.dumps(payload).encode()
+                        + b"\n\n")
+    handler.wfile.flush()
+
+
 def read_json_object(handler) -> Dict[str, Any]:
     """Parse the request body as a JSON *object*; raises ValueError on
     malformed JSON and on valid-JSON non-objects (lists, strings, …) so

@@ -158,6 +158,29 @@ def make_parser() -> argparse.ArgumentParser:
                         "pool serves mode=beam requests whose width "
                         "matches this fixed shape "
                         "(root.common.serving.beam_width)")
+    p.add_argument("--serve-prefix-cache", default=None,
+                   choices=("on", "off"),
+                   help="prefix-sharing paged KV cache: a radix index "
+                        "over page-size token blocks lets admissions "
+                        "adopt a shared prompt prefix's pages "
+                        "read-only and prefill only the suffix "
+                        "(root.common.serving.prefix_cache; "
+                        "greedy/sample on the float pool; answers "
+                        "bit-identical on or off)")
+    p.add_argument("--serve-prefill-chunk", type=int, default=None,
+                   metavar="C",
+                   help="prefill admissions in C-token chunks "
+                        "co-scheduled with the decode tick instead of "
+                        "one monolithic bucketed pass — bounds the "
+                        "per-tick decode stall a long admission "
+                        "causes (root.common.serving.prefill_chunk; "
+                        "0 = monolithic)")
+    p.add_argument("--serve-stream", default=None,
+                   choices=("on", "off"),
+                   help="honor stream=true requests with SSE "
+                        "token-streaming responses (default on; "
+                        "root.common.serving.stream — off answers "
+                        "them buffered)")
     p.add_argument("--serve-artifact", default=None, metavar="DIR",
                    help="AOT serve-artifact package (from `veles-tpu "
                         "export serve-artifact`): the continuous "

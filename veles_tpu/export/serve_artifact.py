@@ -39,8 +39,13 @@ from ..error import VelesError
 #: v2: the paged KV cache — prefill takes the slot's page-table row,
 #: the decode step takes the (slots, pages_per_slot) page tables plus
 #: a per-row advance mask, and the pool buffers are page-shaped; v1
-#: artifacts fail the signature check and fall back to live jit
-ARTIFACT_VERSION = 2
+#: artifacts fail the signature check and fall back to live jit.
+#: v3: the prefix-sharing request plane — the decode step takes a
+#: per-slot shared-page count whose write-back masks adopted prefix
+#: pages to the sink (signature also stamps the prefix_cache /
+#: prefill_chunk knobs); v2 artifacts fail the signature check and
+#: fall back to live jit
+ARTIFACT_VERSION = 3
 
 
 def _specs_of(tree):
@@ -115,7 +120,7 @@ def export_serve_artifact(workflow, path: str,
     exported = jexport.export(engine._build_decode())(
         params_spec, svec, svec,
         jax.ShapeDtypeStruct((slots,), jnp.float32),
-        svec, tables_spec, keys_spec, caches_spec)
+        svec, tables_spec, svec, keys_spec, caches_spec)
     with open(os.path.join(path, "serve_decode.bin"), "wb") as fout:
         fout.write(exported.serialize())
     programs["decode"] = "serve_decode.bin"

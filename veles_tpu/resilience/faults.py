@@ -155,6 +155,17 @@ for _name, _desc in (
                        "the torn entry with a counted warning; "
                        "raise at append: the admission is shed "
                        "rather than accepted un-journaled)"),
+    ("serve.prefix_match", "prefix-cache radix walk at admission "
+                           "(raise = injected index loss, corrupt = "
+                           "injected index rot: both degrade to a "
+                           "shorter/empty match and a full prefill — "
+                           "token equality is the match authority, "
+                           "so answers are never wrong)"),
+    ("serve.prefill_chunk", "chunked prefill, before each chunk "
+                            "dispatch (raise = that admission is "
+                            "shed 503 + Retry-After with a resume "
+                            "payload while co-tenant decodes keep "
+                            "running)"),
     ("serve.handoff", "drain-by-handoff progress snapshot, per "
                       "in-flight ticket at a draining replica "
                       "(raise = that ticket's handoff degrades to a "

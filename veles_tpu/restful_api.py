@@ -298,7 +298,9 @@ class GenerationAPI(Unit):
                  page_size: int = None, pages: int = None,
                  spec_gamma: int = None, beam_width: int = None,
                  quant_weights: bool = None, quant_kv: bool = None,
-                 artifact: str = None, **kwargs) -> None:
+                 artifact: str = None,
+                 prefix_cache: bool = None,
+                 prefill_chunk: int = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
         #: the TARGET model workflow is the unit's own workflow; an
@@ -344,6 +346,13 @@ class GenerationAPI(Unit):
         self.quant_weights = quant_weights
         self.quant_kv = quant_kv
         self.artifact = artifact
+        # heavy-traffic request plane (docs/services.md "Prefix
+        # sharing & streaming"): None defers to
+        # root.common.serving.{prefix_cache,prefill_chunk} inside the
+        # engine; streaming is per-request (``stream=true``), gated by
+        # root.common.serving.stream
+        self.prefix_cache = prefix_cache
+        self.prefill_chunk = prefill_chunk
         self._engine = None
         self._service: Optional[HTTPService] = None
         #: serializes initialize()/stop(): a supervisor respawning a
@@ -457,12 +466,22 @@ class GenerationAPI(Unit):
                     "resume_tokens serve mode=greedy/sample only "
                     "(speculative/beam retries restart from scratch)")
         resume_tokens = [int(t) for t in (resume_tokens or ())]
+        # token streaming (docs/services.md "Prefix sharing &
+        # streaming"): stream=true answers with SSE events at step
+        # boundaries instead of one buffered body. The knob
+        # root.common.serving.stream (default on) can force buffered
+        # answers fleet-wide without clients changing their requests.
+        stream = body.get("stream", False)
+        if not isinstance(stream, bool):
+            raise ValueError("'stream' must be a boolean")
+        if stream and not bool(root.common.serving.get("stream", True)):
+            stream = False
         req = {"prompt": [int(t) for t in prompt] + resume_tokens,
                "n_new": n_new, "resume_k": len(resume_tokens),
                "mode": mode, "temperature": temperature, "seed": seed,
                "gamma": gamma, "beam": beam, "eos_id": eos_id,
                "request_id": request_id, "trace_id": trace_id,
-               "attempt": attempt}
+               "attempt": attempt, "stream": stream}
         if req["gamma"] < 1:
             raise ValueError("'gamma' must be >= 1")
         if req["beam"] < 1:
@@ -641,6 +660,8 @@ class GenerationAPI(Unit):
                     quant_weights=self.quant_weights,
                     quant_kv=self.quant_kv,
                     artifact=self.artifact,
+                    prefix_cache=self.prefix_cache,
+                    prefill_chunk=self.prefill_chunk,
                     name=self.name).start()
                 # the engine-side serve.replica_death site (fired per
                 # decode tick) settles the in-flight tickets with
@@ -730,6 +751,18 @@ class GenerationAPI(Unit):
                             "veles_quant_kv_mode": st["quant_kv"],
                             "veles_serving_kv_pool_bytes":
                                 st["kv_pool_bytes"],
+                            # prefix sharing & chunked prefill
+                            # (docs/services.md "Prefix sharing &
+                            # streaming"): index occupancy and the
+                            # per-tick decode stall chunking bounds
+                            "veles_prefix_cache_enabled":
+                                st["prefix_cache"],
+                            "veles_prefix_cached_blocks":
+                                st["prefix_blocks"],
+                            "veles_serving_prefilling":
+                                st["prefilling"],
+                            "veles_serving_prefill_stall_seconds":
+                                st["prefill_stall_seconds"],
                         })
                     # elastic training plane (resilience/elastic.py):
                     # generation/world-size gauges ride this surface
@@ -805,7 +838,8 @@ class GenerationAPI(Unit):
                     request_id=req.get("request_id"),
                     mode=req.get("mode", "greedy"),
                     trace_id=req.get("trace_id"),
-                    attempt=req.get("attempt", 1))
+                    attempt=req.get("attempt", 1),
+                    stream=bool(req.get("stream")))
                 if api._draining:
                     health.shed(self, retry_after=5.0,
                                 reason="server draining",
@@ -881,7 +915,10 @@ class GenerationAPI(Unit):
                 with api._cv:
                     api._inflight += 1
                 try:
-                    self._await_and_reply(ticket, via_engine)
+                    if ticket.stream:
+                        self._stream_reply(ticket, via_engine)
+                    else:
+                        self._await_and_reply(ticket, via_engine)
                 finally:
                     with api._cv:
                         api._inflight -= 1
@@ -949,6 +986,94 @@ class GenerationAPI(Unit):
                                headers=headers)
                     return
                 json_reply(self, 200, ticket.result)
+
+            def _stream_reply(self, ticket, via_engine):
+                """``stream=true``: chunked-transfer SSE — one
+                ``data: {tokens, i}`` event per step boundary (the
+                engine pushes at chunk ends; window-plane requests
+                burst once at completion) and a terminal
+                ``data: {done: true, ...}`` event carrying the full
+                result (success) or ``error_payload()`` (failure —
+                resume progress included, so a router proxying this
+                stream re-streams only the remainder after a replica
+                death)."""
+                import queue as _q
+                try:
+                    # the replica-death chaos point, request-path
+                    # site — same contract as the buffered path: the
+                    # teardown's abort settles the ticket with resume
+                    # progress, and the gasp goes out as the only
+                    # (terminal) event of the stream
+                    fire_fault("serve.replica_death")
+                except FaultInjected:
+                    api.warning("%s: injected replica death — tearing "
+                                "down the serving front mid-request",
+                                api.name)
+                    threading.Thread(target=api.stop, daemon=True,
+                                     name=api.name + ".death").start()
+                    self.close_connection = True
+                    if not ticket.event.wait(10.0) \
+                            or ticket.error is None:
+                        return
+                    json_reply(self, ticket.code,
+                               ticket.error_payload(),
+                               headers={"Retry-After": "1"})
+                    return
+                from ._http import sse_event, sse_headers
+                sse_headers(self)
+
+                def event(payload):
+                    sse_event(self, payload)
+
+                sent = 0
+                deadline = time.time() + api.request_timeout + 1.0
+                try:
+                    while True:
+                        budget = deadline - time.time()
+                        if budget <= 0:
+                            event({"done": True, "code": 504,
+                                   "error": "generation timed out",
+                                   "request_id": ticket.request_id})
+                            return
+                        try:
+                            item = ticket.next_stream_item(
+                                timeout=min(budget, 2.0))
+                        except _q.Empty:
+                            continue
+                        if item is None:
+                            break
+                        event({"tokens": item, "i": sent,
+                               "request_id": ticket.request_id})
+                        sent += len(item)
+                    # /stats parity with the buffered path: count
+                    # every via-engine terminal the batch actually
+                    # decoded — decode errors included, never 503
+                    # sheds/expiries
+                    if via_engine and not (ticket.error is not None
+                                           and ticket.code == 503):
+                        with api._cv:
+                            api.requests_served += 1
+                    if ticket.error is not None:
+                        event(dict(ticket.error_payload(),
+                                   done=True, code=ticket.code))
+                        return
+                    result = ticket.result if isinstance(
+                        ticket.result, dict) else {
+                            "tokens": list(ticket.result or ())}
+                    # window-plane (and early-retired) tokens the
+                    # step-boundary pushes never covered burst out
+                    # before the terminal event
+                    tail = list(result.get("tokens") or ())[sent:]
+                    if tail:
+                        event({"tokens": tail, "i": sent,
+                               "request_id": ticket.request_id})
+                    event(dict(result, done=True))
+                except (BrokenPipeError, ConnectionResetError,
+                        OSError):
+                    # client went away mid-stream: the decode settles
+                    # the ticket on its own; nothing to answer
+                    api.debug("%s: streaming client disconnected "
+                              "(%s)", api.name, ticket.request_id)
 
         self._closing = False
         self._draining = False

@@ -40,6 +40,7 @@ from .scheduler import (SlotScheduler, Ticket,        # noqa: F401
                         request_tracing_enabled)
 from .engine import (ContinuousEngine,                # noqa: F401
                      advanced_prng_key, fold_resume)
+from .pages import PagePool, PrefixCache              # noqa: F401
 from .journal import RequestJournal                   # noqa: F401
 from .router import (CircuitBreaker, FleetRouter,     # noqa: F401
                      ROUTER_COUNTERS, Replica, ReplicaSupervisor)
@@ -57,6 +58,19 @@ LOSSLESS_COUNTERS = (
     "veles_resume_attempts_total",
     "veles_resume_tokens_total",
     "veles_handoff_requests_total",
+)
+
+#: every counter the prefix-sharing request plane increments (radix
+#: prefix cache + copy-on-write + LRU eviction over the page pool) —
+#: registered with HELP strings in telemetry/counters.py DESCRIPTIONS
+#: and asserted zero in non-serving runs by ``python bench.py gate``'s
+#: prefix section
+PREFIX_COUNTERS = (
+    "veles_prefix_hits_total",
+    "veles_prefix_misses_total",
+    "veles_prefix_shared_pages_total",
+    "veles_prefix_cow_copies_total",
+    "veles_prefix_evictions_total",
 )
 
 #: every counter the serving plane increments — registered with HELP

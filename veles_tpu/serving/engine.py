@@ -63,6 +63,35 @@ docs/services.md "Quantized serving"):
   greedy/sample path. Spec/beam programs always build live (counted).
   A corrupt or mismatched artifact falls back to live jit with a
   counted warning.
+
+The heavy-traffic request plane (docs/services.md "Prefix sharing &
+streaming") adds three latency features on top, all greedy/sample +
+float-pool only:
+
+- **prefix sharing** (``prefix_cache``): a radix-tree index over
+  ``page_size``-token blocks (:class:`~veles_tpu.serving.pages.
+  PrefixCache`) maps shared prompt prefixes to refcounted pages;
+  admission adopts matched pages READ-ONLY into the new slot's page
+  table (pages are data, so THE decode step still compiles once) and
+  prefills only the unmatched suffix — a shared system prompt costs
+  its pages and its prefill FLOPs once across the whole pool. The
+  first write that must land inside a shared page (a full-prompt
+  match re-computing its last position) copies that page first
+  (copy-on-write, counted); the decode step's write-back masks every
+  shared page to the sink, so a writer can never mutate one. LRU
+  leaves evict under allocator pressure;
+- **chunked prefill** (``prefill_chunk``): long admissions prefill in
+  fixed-size chunks co-scheduled with the decode tick — one chunk per
+  tick per admitting row — instead of one monolithic bucketed pass,
+  so a long admission stops stalling in-flight decodes (the
+  ``prefill_stall`` gauge measures the residual per-tick stall). The
+  chunk program reproduces ``attention_reference``'s exact arithmetic
+  over the gathered page view, so chunked (and prefix-matched) rows
+  stay bit-identical to the monolithic path;
+- **token streaming**: rows whose ticket carries ``stream=True`` push
+  emitted tokens at every step boundary (``Ticket.push_tokens``);
+  the GenerationAPI drains them onto the wire as SSE events, so TTFT
+  becomes a client-visible measurement.
 """
 
 from __future__ import annotations
@@ -206,10 +235,12 @@ class ContinuousEngine(Logger):
                  quant_weights: Optional[bool] = None,
                  quant_kv: Optional[bool] = None,
                  artifact: Optional[str] = None,
+                 prefix_cache: Optional[bool] = None,
+                 prefill_chunk: Optional[int] = None,
                  name: str = "serving") -> None:
         super().__init__()
         from ..config import root
-        from .pages import PagePool, pages_for
+        from .pages import PagePool, PrefixCache, pages_for
         from .scheduler import SlotScheduler
         self.wf = wf
         self.name = name
@@ -272,6 +303,38 @@ class ContinuousEngine(Logger):
         from . import parse_buckets
         self.buckets = parse_buckets(buckets)
         self.page_pool = PagePool(self.pages, self.page_size)
+        # heavy-traffic request plane knobs (root.common.serving.*,
+        # CLI --serve-prefix-cache/--serve-prefill-chunk); both off =
+        # bit-identical to the monolithic-prefill engine (test-locked)
+        want_prefix = bool(
+            serving_cfg.get("prefix_cache", False)
+            if prefix_cache is None else prefix_cache)
+        self.prefill_chunk = int(
+            serving_cfg.get("prefill_chunk", 0)
+            if prefill_chunk is None else prefill_chunk)
+        if self.prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0 (0 = "
+                             "monolithic bucketed prefill)")
+        if (want_prefix or self.prefill_chunk) and self.quant_kv:
+            # the chunk/suffix program writes float rows and the COW
+            # copy moves float pages — the int8 pool keeps the
+            # monolithic plane (same answers, no sharing)
+            self.warning("%s: prefix sharing / chunked prefill serve "
+                         "the float pool only; int8 KV keeps the "
+                         "monolithic prefill plane", name)
+            want_prefix = False
+            self.prefill_chunk = 0
+        #: effective chunk width (tokens per prefill-chunk dispatch):
+        #: the knob, or one page when only prefix sharing needs the
+        #: suffix program
+        self._chunk = self.prefill_chunk or self.page_size
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.page_pool, self.page_size)
+            if want_prefix else None)
+        if self.prefix_cache is not None:
+            # allocator pressure reclaims cached prefixes LRU-first
+            # before any admission is refused or shed
+            self.page_pool.evictor = self.prefix_cache.evict
         self.scheduler = SlotScheduler(self.max_slots, self.buckets,
                                        self.max_context,
                                        page_pool=self.page_pool,
@@ -307,6 +370,11 @@ class ContinuousEngine(Logger):
         self._tok = numpy.zeros(self.max_slots, numpy.int32)
         self._pos = numpy.zeros(self.max_slots, numpy.int32)
         self._temp = numpy.zeros(self.max_slots, numpy.float32)
+        #: per-slot count of leading READ-ONLY page-table entries
+        #: (prefix-cache adoptions) — a decode-step input: the chunk
+        #: write-back masks those pages to the sink, making "a writer
+        #: never mutates a shared page" structural, not behavioral
+        self._shared = numpy.zeros(self.max_slots, numpy.int32)
         self._thread: Optional[threading.Thread] = None
         self._closing = False
         #: pending drain-by-handoff: (reason, done event, count box) —
@@ -320,6 +388,20 @@ class ContinuousEngine(Logger):
         self.admitted = 0
         self.retired = 0
         self.peak_slots = 0
+        #: per-program dispatch tally keyed like ``_progs`` — what the
+        #: bench prefix gate multiplies CostModel program costs by to
+        #: price a load's actual prefill FLOPs
+        self.prog_calls: Dict = {}
+        #: chunked-prefill stall gauges: seconds of prefill work in
+        #: the most recent tick that had co-tenant decodes in flight,
+        #: and the worst such tick — THE "bounded TPOT jitter" number
+        #: (veles_serving_prefill_stall_seconds on /metrics)
+        self.prefill_stall_last = 0.0
+        self.prefill_stall_max = 0.0
+        #: requests that adopted at least one shared prefix block /
+        #: chunk dispatches run (bench + stats surface)
+        self.prefix_requests = 0
+        self.chunk_dispatches = 0
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ContinuousEngine":
@@ -359,6 +441,11 @@ class ContinuousEngine(Logger):
         self.scheduler.drain("server shutting down")
         self._abort_active("server shutting down", code=503,
                            retry_after=5.0, count_shed=False)
+        if self.prefix_cache is not None:
+            # release the index's page references — with every slot
+            # retired above, the refcount ledger must balance to zero
+            # (the poisoning regression test closes the loop)
+            self.prefix_cache.clear()
         from . import unregister_engine
         unregister_engine(self)
 
@@ -506,12 +593,30 @@ class ContinuousEngine(Logger):
     def stats(self) -> Dict[str, float]:
         from ..quant import pool_nbytes
         in_use = self.page_pool.in_use()
-        occupied = 0
+        # occupancy per DISTINCT page: a page shared by N slots (or by
+        # a slot and the prefix index) holds its positions once, so
+        # the fragmentation gauge cannot go negative — or read as
+        # phantom HBM — under prefix sharing (satellite fix; in_use
+        # already counts shared pages once)
+        occ: Dict[int, int] = {}
+        prefilling = 0
         for slot in self.scheduler.active():
-            occupied += min(int(self._pos[slot.idx]),
-                            len(slot.pages) * self.page_size)
+            pos = int(self._pos[slot.idx])
+            if slot.prefilled is not None:
+                prefilling += 1
+            for j, page in enumerate(slot.pages):
+                filled = max(0, min(pos - j * self.page_size,
+                                    self.page_size))
+                if filled:
+                    occ[page] = max(occ.get(page, 0), filled)
+        if self.prefix_cache is not None:
+            for page in self.prefix_cache.cached_pages():
+                occ[page] = self.page_size   # cached blocks are full
+        occupied = sum(occ.values())
         frag = (0.0 if in_use == 0 else
                 max(0.0, 1.0 - occupied / (in_use * self.page_size)))
+        prefix_blocks = (0 if self.prefix_cache is None
+                         else self.prefix_cache.stats()["blocks"])
         return {
             "slots": self.max_slots,
             "slots_busy": self.scheduler.busy_count(),
@@ -526,6 +631,19 @@ class ContinuousEngine(Logger):
             "pages_in_use": in_use,
             "page_size": self.page_size,
             "page_fragmentation": round(frag, 4),
+            # heavy-traffic request plane (docs/services.md "Prefix
+            # sharing & streaming"): index occupancy, chunked-prefill
+            # progress and the per-tick decode stall the chunking
+            # exists to bound
+            "prefix_cache": int(self.prefix_cache is not None),
+            "prefix_blocks": prefix_blocks,
+            "prefix_requests": self.prefix_requests,
+            "prefill_chunk": self._chunk if (
+                self.prefill_chunk or self.prefix_cache is not None)
+            else 0,
+            "chunk_dispatches": self.chunk_dispatches,
+            "prefilling": prefilling,
+            "prefill_stall_seconds": round(self.prefill_stall_max, 6),
             # quantization/AOT plane (veles_tpu/quant/): what the
             # /metrics mode gauges render on both surfaces
             "artifact_mode": int(self.artifact_mode),
@@ -560,9 +678,15 @@ class ContinuousEngine(Logger):
         bound = len(self.buckets) + 1
         if self.draft is not None:
             bound += len(self.buckets) + 1
+        has_pagecopy = False
         if self.beam_width <= self.max_slots:
-            bound += 1 + (1 if self.beam_width > 1 else 0)
-        return bound
+            bound += 1
+            has_pagecopy = self.beam_width > 1
+        if self.prefix_cache is not None or self.prefill_chunk:
+            bound += 1               # the ONE prefill-chunk program
+            if self.prefix_cache is not None:
+                has_pagecopy = True  # COW copies ride pagecopy
+        return bound + (1 if has_pagecopy else 0)
 
     def invalidate_quant_cache(self) -> None:
         """Drop the calibrated int8 twin (and the cached device view)
@@ -671,6 +795,11 @@ class ContinuousEngine(Logger):
                 self._draft_params = params_of(self.draft)
         self._ensure_pool(params)
         from .scheduler import shed_expired
+        # co-tenants in flight BEFORE this tick's admissions: only
+        # their decode latency can be stalled by prefill work, so the
+        # chunked-prefill stall gauge measures exactly that window
+        had_inflight = self.scheduler.busy_count() > 0
+        t_prefill = time.time()
         admissions, expired = self.scheduler.take_admissions()
         shed_expired(expired)
         for slot in admissions:
@@ -708,8 +837,17 @@ class ContinuousEngine(Logger):
                 return
         self.peak_slots = max(self.peak_slots,
                               self.scheduler.busy_count())
+        # _prefill_tick handles its own serve.prefill_chunk fault
+        # internally (sheds ONLY the faulted row, co-tenants keep
+        # decoding) — no blanket abort may wrap it, or one injected
+        # chunk fault would shed the whole pool
+        prefill_work = bool(admissions) | self._prefill_tick(params)
+        if prefill_work and had_inflight:
+            self.prefill_stall_last = time.time() - t_prefill
+            self.prefill_stall_max = max(self.prefill_stall_max,
+                                         self.prefill_stall_last)
         try:
-            if self._active(_STEP_MODES):
+            if self._decodable():
                 self._decode(params)
             if self._active(("speculative",)):
                 self._spec_tick(params)
@@ -810,6 +948,12 @@ class ContinuousEngine(Logger):
             self._pos[slot.idx] = t_p
             self._temp[slot.idx] = slot.temperature
             return
+        if group is None and slot.mode in _STEP_MODES \
+                and self._admit_chunked(slot):
+            # prefix adoption / chunked prefill: the suffix prefills
+            # chunk-by-chunk across ticks (_prefill_tick), co-scheduled
+            # with the decode step instead of stalling it
+            return
         ids = numpy.zeros((1, bucket), numpy.int32)
         ids[0, :t_p] = slot.req["prompt"]
         ids_dev = jnp.asarray(ids)
@@ -857,7 +1001,11 @@ class ContinuousEngine(Logger):
             slot.ticket.mark_prefill_done()
             slot.ticket.mark_first_token()
             self._tok[slot.idx] = first
-            if slot.record(first):
+            if slot.mode in _STEP_MODES:
+                self._prefix_insert(slot)
+            done = slot.record(first)
+            slot.ticket.push_tokens([first])
+            if done:
                 self._finish(slot)
             return
         # beam: count the REQUEST once, expand the first top-W
@@ -885,6 +1033,208 @@ class ContinuousEngine(Logger):
             slot.ticket.mark_first_token()
             if slot.n_new == 1:
                 self._finish_beam(group)
+
+    # -- prefix sharing + chunked prefill -------------------------------------
+    def _chunk_kernel_safe(self, bucket: int) -> bool:
+        """True when the monolithic bucketed prefill would use the
+        REFERENCE attention kernel for every block at this bucket —
+        the chunk/suffix program always computes reference arithmetic
+        over the gathered page view, so chunking (and adopting pages
+        a chunked/reference prefill wrote) is only id-exact when the
+        monolithic path would have picked the same kernel. Above the
+        flash crossover the request simply rides the monolithic
+        plane (same answers, no sharing)."""
+        from ..ops.flash_attention import choose_flash
+        d = self.stack["stem"].dim
+        for blk in self.stack["blocks"]:
+            if choose_flash(bucket, d // blk.n_heads):
+                return False
+        return True
+
+    def _admit_chunked(self, slot) -> bool:
+        """Prefix-cache adoption + chunked-prefill start for one plain
+        decode-mode admission (already holding its worst-case page
+        reservation). True when the slot now prefills chunk-by-chunk
+        across ticks; False = the caller runs the monolithic bucketed
+        prefill exactly as before."""
+        if (self.prefix_cache is None and not self.prefill_chunk) \
+                or self.quant_kv:
+            return False
+        if not self._chunk_kernel_safe(slot.bucket):
+            return False
+        t_p = slot.t_p
+        P = self.page_size
+        matched: List[int] = []
+        if self.prefix_cache is not None:
+            try:
+                # raise = injected index loss, corrupt = injected
+                # index rot: both DEGRADE to a shorter/empty match and
+                # a full prefill — the token comparison inside match()
+                # is the authority, so a corrupted index can never
+                # adopt wrong pages
+                corrupting = fire_fault("serve.prefix_match")
+                matched = self.prefix_cache.match(slot.req["prompt"],
+                                                  corrupt=corrupting)
+            except FaultInjected as e:
+                self.warning("%s: injected prefix-match fault (%s) — "
+                             "degrading to a full prefill",
+                             self.name, e)
+                matched = []
+            if matched:
+                inc("veles_prefix_hits_total")
+                self.prefix_requests += 1
+            elif t_p // P:
+                inc("veles_prefix_misses_total")
+        if not matched and not self.prefill_chunk:
+            return False
+        # at least one token must prefill (the suffix pass emits the
+        # first token's logits), so a FULL-prompt match re-computes
+        # its last position — into a COPY of the last shared page
+        # (copy-on-write), never into the shared page itself
+        start = min(len(matched) * P, t_p - 1)
+        k_full = start // P
+        cow_src = matched[k_full] if len(matched) * P > start else None
+        give_back: List[int] = []
+        for i in range(k_full):
+            give_back.append(slot.pages[i])
+            slot.pages[i] = matched[i]
+        slot.shared = k_full
+        self._shared[slot.idx] = k_full
+        if k_full:
+            inc("veles_prefix_shared_pages_total", k_full)
+        if cow_src is not None:
+            fresh = self.page_pool.alloc(1)
+            if fresh:
+                import jax.numpy as jnp
+                src = numpy.zeros(self.pages_per_slot, numpy.int32)
+                dst = numpy.zeros(self.pages_per_slot, numpy.int32)
+                src[0], dst[0] = cow_src, fresh[0]
+                self._caches = self._program("pagecopy")(
+                    jnp.asarray(src), jnp.asarray(dst), self._caches)
+                give_back.append(slot.pages[k_full])
+                slot.pages[k_full] = fresh[0]
+                inc("veles_prefix_cow_copies_total")
+            else:
+                # no page to copy into: shorten the match to the block
+                # boundary — the whole last block re-prefills
+                start = k_full * P
+            self.page_pool.free([cow_src])   # drop the match's ref
+        self.page_pool.free(give_back)
+        resume_k = int(slot.req.get("resume_k", 0) or 0)
+        if resume_k:
+            inc("veles_resume_tokens_total", resume_k)
+        wait = max(0.0, (slot.ticket.admitted or time.time())
+                   - slot.ticket.enqueued)
+        inc("veles_serving_admitted_total")
+        inc("veles_serving_queue_wait_seconds_total", wait)
+        self.admitted += 1
+        slot.prefilled = start
+        self._pos[slot.idx] = start
+        self._temp[slot.idx] = slot.temperature
+        return True
+
+    def _prefix_insert(self, slot) -> None:
+        """Cache a freshly prefilled prompt's FULL blocks so the next
+        admission shares them. The slot's pages stay immutable for
+        those positions (decode writes land at >= t_p, the write-back
+        masks shared entries), so the index's reference outlives the
+        slot safely. Skipped above the flash crossover: pages a flash
+        prefill wrote must not seed reference-kernel suffixes."""
+        if self.prefix_cache is None or slot.group is not None \
+                or slot.mode not in _STEP_MODES:
+            return
+        if not self._chunk_kernel_safe(slot.bucket):
+            return
+        n_blocks = slot.t_p // self.page_size
+        if n_blocks:
+            self.prefix_cache.insert(
+                slot.req["prompt"][:n_blocks * self.page_size],
+                slot.pages[:n_blocks])
+
+    def _prefill_tick(self, params) -> bool:
+        """Advance every chunk-prefilling row by ONE chunk — the
+        co-scheduling half of chunked prefill: admissions interleave
+        with the decode step at ``prefill_chunk`` granularity instead
+        of stalling it for a monolithic bucketed pass. Returns True
+        when any chunk dispatched. The ``serve.prefill_chunk`` fault
+        fires per chunk: an injected raise sheds THAT row 503 +
+        Retry-After with a resume payload while co-tenants keep
+        decoding."""
+        import jax
+        import jax.numpy as jnp
+        pending = [s for s in self._active(_STEP_MODES)
+                   if s.prefilled is not None]
+        work = False
+        for slot in pending:
+            if self.scheduler.slots[slot.idx] is not slot:
+                continue
+            try:
+                fire_fault("serve.prefill_chunk")
+            except FaultInjected as e:
+                # shed with a resume payload: nothing was emitted yet,
+                # so the payload is the (possibly empty) progress — a
+                # router retry redoes the prefill elsewhere
+                slot.ticket.set_progress(slot.tokens)
+                self._retire_slot(slot)
+                if slot.ticket.fail(
+                        "injected prefill-chunk fault: %s" % e,
+                        code=503, retry_after=1.0):
+                    inc("veles_shed_requests_total")
+                continue
+            t_p = slot.t_p
+            p0 = int(slot.prefilled)
+            C = self._chunk
+            final = p0 + C >= t_p
+            ids = numpy.zeros(C, numpy.int32)
+            seg = slot.req["prompt"][p0:p0 + C]
+            ids[:len(seg)] = seg
+            resume_k = int(slot.req.get("resume_k", 0) or 0)
+            # the PRNG carry matters only at the final chunk (it
+            # samples the first token); resumed requests re-enter
+            # their stream exactly like the monolithic prefill
+            seed_key = (advanced_prng_key(slot.req.get("seed", 0),
+                                          resume_k)
+                        if final and resume_k
+                        else jax.random.PRNGKey(
+                            int(slot.req.get("seed", 0))))
+            table_row = self._table_row(slot)
+            with span("serving.prefill_chunk", slot=slot.idx, p0=p0,
+                      chunk=C, t_p=t_p, final=int(final),
+                      request_id=slot.ticket.request_id,
+                      trace_id=slot.ticket.trace_id):
+                first, self._keys, self._caches = \
+                    self._program("pchunk")(
+                        params, jnp.asarray(ids), numpy.int32(p0),
+                        numpy.int32(t_p), numpy.int32(slot.idx),
+                        numpy.float32(slot.temperature), seed_key,
+                        table_row, numpy.int32(1 if final else 0),
+                        self._keys, self._caches)
+            inc("veles_serving_prefill_dispatches_total")
+            self.chunk_dispatches += 1
+            work = True
+            if not final:
+                slot.prefilled = p0 + C
+                self._pos[slot.idx] = min(p0 + C, t_p)
+                continue
+            slot.prefilled = None
+            self._pos[slot.idx] = t_p
+            first = int(first)          # syncs the chunk dispatch
+            slot.ticket.mark_prefill_done()
+            slot.ticket.mark_first_token()
+            self._tok[slot.idx] = first
+            self._prefix_insert(slot)
+            done = slot.record(first)
+            slot.ticket.push_tokens([first])
+            if done:
+                self._finish(slot)
+        return work
+
+    def _decodable(self) -> List:
+        """Plain decode-mode rows whose prefill is complete — the rows
+        THE decode step advances (chunk-prefilling rows join at their
+        final chunk's step boundary)."""
+        return [s for s in self._active(_STEP_MODES)
+                if s.prefilled is None]
 
     # -- page growth -----------------------------------------------------------
     def _grow_or_shed(self, slots: List, need_fn) -> List:
@@ -929,7 +1279,7 @@ class ContinuousEngine(Logger):
     def _decode(self, params) -> None:
         import jax.numpy as jnp
         active = self._grow_or_shed(
-            self._active(_STEP_MODES),
+            self._decodable(),
             lambda s: min(s.t_p + s.n_new,
                           int(self._pos[s.idx]) + self.decode_block))
         if not active:
@@ -937,13 +1287,15 @@ class ContinuousEngine(Logger):
         mask = numpy.zeros(self.max_slots, numpy.int32)
         for slot in active:
             mask[slot.idx] = 1
+        base_len = {id(s): len(s.tokens) for s in active}
         fire_fault("serve.decode_step")
         with span("serving.decode_step", active=len(active),
                   chunk=self.decode_block):
             toks, self._keys, self._caches = self._program("step")(
                 params, jnp.asarray(self._tok), jnp.asarray(self._pos),
                 jnp.asarray(self._temp), jnp.asarray(mask),
-                jnp.asarray(self._page_table), self._keys,
+                jnp.asarray(self._page_table),
+                jnp.asarray(self._shared), self._keys,
                 self._caches)
             toks = numpy.asarray(toks)          # (decode_block, S)
         inc("veles_serving_decode_dispatches_total")
@@ -958,6 +1310,11 @@ class ContinuousEngine(Logger):
                 self._pos[slot.idx] += 1
                 if slot.record(token):
                     finished.append(slot)
+        for slot in active:
+            # streaming rows hand this chunk's tokens to their drain
+            # loop at the step boundary — before _finish's terminal
+            # sentinel, so the wire order is tokens-then-done
+            slot.ticket.push_tokens(slot.tokens[base_len[id(slot)]:])
         for slot in finished:
             self._finish(slot)
 
@@ -1003,10 +1360,12 @@ class ContinuousEngine(Logger):
             self._pos[i] += emitted
             self._tok[i] = int(new_tok[i])
             done = False
+            base = len(slot.tokens)
             for t in out_vec[i, :emitted]:
                 if slot.record(int(t)):
                     done = True
                     break
+            slot.ticket.push_tokens(slot.tokens[base:])
             if done:
                 self._finish(slot)
 
@@ -1084,6 +1443,7 @@ class ContinuousEngine(Logger):
         self._tok[slot.idx] = 0
         self._pos[slot.idx] = 0
         self._temp[slot.idx] = 0.0
+        self._shared[slot.idx] = 0
         self._page_table[slot.idx, :] = 0
         self.scheduler.retire(slot)
 
@@ -1233,14 +1593,16 @@ class ContinuousEngine(Logger):
                         "step": self._build_decode,
                         "spec": self._build_spec_round,
                         "beam": self._build_beam_step,
+                        "pchunk": self._build_prefill_chunk,
                         "pagecopy": self._build_page_copy}
             jitted = (builders[kind](bucket)
                       if kind in ("prefill", "dprefill")
                       else builders[kind]())
-            prog = self._progs[key] = self._instrument_live(jitted)
+            prog = self._progs[key] = self._instrument_live(jitted,
+                                                            key)
         return prog
 
-    def _instrument_live(self, jitted):
+    def _instrument_live(self, jitted, key=None):
         """Wrap a live jitted program: every call counts one
         ``veles_decode_dispatches_total`` (the round-5 regression
         lock's counter — same contract as
@@ -1257,6 +1619,10 @@ class ContinuousEngine(Logger):
 
         def dispatch(*args):
             inc("veles_decode_dispatches_total")
+            if key is not None:
+                # per-program tally: the bench prefix gate prices a
+                # load's prefill FLOPs as sum(cost(program) x calls)
+                self.prog_calls[key] = self.prog_calls.get(key, 0) + 1
             exe = box.get("exe")
             if exe is None:
                 try:
@@ -1323,6 +1689,12 @@ class ContinuousEngine(Logger):
             "pages_per_slot": self.pages_per_slot,
             "quant_weights": bool(self.quant_weights),
             "quant_kv": bool(self.quant_kv),
+            # the request plane's shape commitments: the decode step
+            # takes the per-slot shared-page mask since v3, and the
+            # chunk width shapes the (live-built) suffix program — an
+            # artifact exported under other knobs refuses cleanly
+            "prefix_cache": self.prefix_cache is not None,
+            "prefill_chunk": int(self.prefill_chunk),
         }
 
     def _load_artifact(self) -> bool:
@@ -1551,8 +1923,9 @@ class ContinuousEngine(Logger):
                                  axis=0, mode="clip")
             return x                            # (S, D)
 
-        @functools.partial(jax.jit, donate_argnums=(6, 7))
-        def step(params, tok, pos, temp, mask, tables, keys, caches):
+        @functools.partial(jax.jit, donate_argnums=(7, 8))
+        def step(params, tok, pos, temp, mask, tables, shared, keys,
+                 caches):
             if quant_w:
                 from ..quant import dequantize_params
                 params = dequantize_params(
@@ -1614,8 +1987,14 @@ class ContinuousEngine(Logger):
                 (tok, pos, keys, views), toks = jax.lax.scan(
                     body, (tok, pos, keys, tuple(views)), None,
                     length=self.decode_block)
-                wtab = jnp.where(mask[:, None] > 0, tables,
-                                 0).reshape(-1)        # (S*P,)
+                # write-back targets: masked rows AND each row's
+                # leading SHARED (prefix-adopted) pages go to the sink
+                # — a shared page is structurally read-only here, so a
+                # retired (or live) writer can never mutate one
+                keep = (mask[:, None] > 0) & (
+                    jnp.arange(tables.shape[1])[None, :]
+                    >= shared[:, None])
+                wtab = jnp.where(keep, tables, 0).reshape(-1)  # (S*P,)
                 new_caches = []
                 for (kp, vp), (ck, cv) in zip(caches, views):
                     shape = (wtab.shape[0],
@@ -1821,6 +2200,120 @@ class ContinuousEngine(Logger):
                     caches_d)
 
         return spec_round
+
+    def _build_prefill_chunk(self):
+        """ONE fixed-shape suffix/chunk prefill shared by prefix-cache
+        adoption and chunked prefill: ``_chunk`` prompt tokens at
+        positions ``p0..p0+C-1`` for a single slot, attending over the
+        slot's gathered page view (adopted prefix K/V included).
+
+        Id-exactness is arithmetic, not luck: the attention reproduces
+        ``attention_reference``'s EXACT op order — einsum in the model
+        dtype, f32 cast then ``* scale``, -1e30 mask, ``exp(s-max)``
+        softmax, value product with weights cast back to the model
+        dtype — so a chunked (or prefix-matched) prompt's layer
+        outputs are bit-identical to the monolithic bucketed pass
+        (masked view positions contribute EXACT zeros whatever the
+        padded length; ``_chunk_kernel_safe`` keeps flash-crossover
+        buckets on the monolithic plane). Chunk K/V rows scatter
+        per-position through the page table (positions beyond the
+        table target the sink; pad positions past ``t_p`` are
+        rewritten by the decode step before any read mask reaches
+        them). The FINAL chunk samples the request's first token with
+        the bucketed prefill's exact seed-key convention and installs
+        the slot's PRNG carry; non-final chunks leave ``keys``
+        untouched."""
+        import jax
+        import jax.numpy as jnp
+        from ..nn.attention import expand_kv
+        from ..nn.speculative import _rope_span
+        from ..nn.transformer import block_ffn, block_norm
+        from ..ops import matmul_precision
+        stack = self.stack
+        stem, pos_emb = stack["stem"], stack["pos_emb"]
+        blocks, head = stack["blocks"], stack["head"]
+        prec = matmul_precision()
+        d = stem.dim
+        C = self._chunk
+        P = self.page_size
+        quant_w = self.quant_weights
+
+        @functools.partial(jax.jit, donate_argnums=(9, 10))
+        def pchunk(params, ids, p0, t_p, slot, temp, seed_key,
+                   table_row, final, keys, caches):
+            if quant_w:
+                from ..quant import dequantize_params
+                params = dequantize_params(
+                    params, dtype=params[stem.name]["table"].dtype)
+            x = _embed_prompt(stem, pos_emb, params, ids[None],
+                              pos0=p0)                 # (1, C, D)
+            pos_idx = p0 + jnp.arange(C)
+            pg = jnp.take(table_row, pos_idx // P, mode="fill",
+                          fill_value=0)
+            off = pos_idx % P
+            new_caches = []
+            for blk, (kp, vp) in zip(blocks, caches):
+                p = params[blk.name]
+                h = blk.n_heads
+                kv = getattr(blk, "n_kv_heads", h)
+                hd = d // h
+                a_in = block_norm(jnp, blk, p, x, "ln1")
+                q = jnp.dot(a_in, p["wq"],
+                            precision=prec).reshape(1, C, h, hd)
+                k = jnp.dot(a_in, p["wk"],
+                            precision=prec).reshape(1, C, kv, hd)
+                v = jnp.dot(a_in, p["wv"],
+                            precision=prec).reshape(1, C, kv, hd)
+                if blk.rope:
+                    base = getattr(blk, "rope_base", 10000.0)
+                    q = _rope_span(jnp, q, p0, base)
+                    k = _rope_span(jnp, k, p0, base)
+                # gathered view + C zero rows: dynamic_update_slice
+                # then never clamp-shifts over real rows, and the
+                # extra keys sit behind the causal mask as exact zeros
+                ck = self._view(kp, table_row)
+                cv = self._view(vp, table_row)
+                zpad = jnp.zeros((C,) + ck.shape[1:], ck.dtype)
+                ck = jax.lax.dynamic_update_slice(
+                    jnp.concatenate([ck, zpad]), k[0], (p0, 0, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    jnp.concatenate([cv, zpad]), v[0], (p0, 0, 0))
+                k_full = expand_kv(jnp, ck[None], h)
+                v_full = expand_kv(jnp, cv[None], h)
+                scale = 1.0 / (hd ** 0.5)
+                s = jnp.einsum("bqhd,bkhd->bhqk", q,
+                               k_full).astype(jnp.float32) * scale
+                t_idx = jnp.arange(k_full.shape[1])[None, :]
+                q_idx = pos_idx[:, None]
+                valid = t_idx <= q_idx
+                win = getattr(blk, "window", None)
+                if win:
+                    valid = valid & (t_idx > q_idx - win)
+                s = jnp.where(valid[None, None], s, -1e30)
+                w = jnp.exp(s - s.max(axis=-1, keepdims=True))
+                w = w / w.sum(axis=-1, keepdims=True)
+                o = jnp.einsum("bhqk,bkhd->bqhd", w.astype(q.dtype),
+                               v_full).reshape(1, C, d)
+                x = x + jnp.dot(o, p["wo"], precision=prec)
+                f_in = block_norm(jnp, blk, p, x, "ln2")
+                x = x + block_ffn(jnp, blk, p, f_in, prec)
+                kp = kp.at[pg, off].set(k[0])
+                vp = vp.at[pg, off].set(v[0])
+                new_caches.append((kp, vp))
+            x_last = jnp.take(x[0], t_p - 1 - p0, axis=0, mode="clip")
+            logits = _head_logits(head, params, x_last, prec)
+            k2 = jax.random.split(seed_key)
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            samp = jax.random.categorical(
+                k2[1], logits / jnp.maximum(temp, _TEMP_EPS)
+            ).astype(jnp.int32)
+            first = jnp.where(temp > 0, samp, greedy)
+            upd = jax.lax.dynamic_update_slice(keys, k2[0][None],
+                                               (slot, 0))
+            keys = jnp.where(final > 0, upd, keys)
+            return first, keys, tuple(new_caches)
+
+        return pchunk
 
     def _build_page_copy(self):
         """Clone one slot's pages into another slot's pages — the

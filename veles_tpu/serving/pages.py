@@ -1,4 +1,5 @@
-"""Fixed-size page allocator for the paged KV-cache slot pool.
+"""Fixed-size page allocator + prefix-sharing index for the paged
+KV-cache slot pool.
 
 The dense slot pool sized every row to ``max_context``, so pool HBM
 was ``max_slots x max_context`` whatever the actual request mix. The
@@ -13,10 +14,21 @@ admission reserves only the pages a request's own prompt + budget can
 ever touch (``ceil((prompt + n_new [+ gamma + 1]) / page_size)``),
 never ``max_context`` worth.
 
-This module is the pure-host half: the allocator (free list, usage
-accounting, exhaustion counters). Device-side page pools are shaped by
-``quant/kv.py``'s :func:`~veles_tpu.quant.kv.block_page_pool`; the
-jitted gather/scatter lives in ``serving/engine.py``.
+Pages are REFCOUNTED: prefix sharing (:class:`PrefixCache`) lets many
+slots — and the cache index itself — hold the same physical page, so
+:meth:`PagePool.free` releases one reference and a page returns to
+the free list only when its last holder lets go. ``in_use`` counts a
+shared page ONCE, however many slots adopted it (the fleet /metrics
+aggregation reads these gauges; double-counting a shared system
+prompt would report phantom HBM).
+
+This module is the pure-host half: the allocator (free list, refcount
+ledger, usage accounting, exhaustion counters) and the prefix index (a
+radix tree over ``page_size``-token blocks mapping shared prompt
+prefixes to pages, LRU-evicted under allocator pressure). Device-side
+page pools are shaped by ``quant/kv.py``'s
+:func:`~veles_tpu.quant.kv.block_page_pool`; the jitted gather/scatter
+lives in ``serving/engine.py``.
 
 Page 0 is the SINK: it is never allocated, and masked/retired rows in
 the fixed-shape programs direct their writes at it (a batched scatter
@@ -28,7 +40,7 @@ position a read mask can reach.
 from __future__ import annotations
 
 import threading
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 from ..telemetry.counters import inc
 
@@ -39,10 +51,11 @@ def pages_for(positions: int, page_size: int) -> int:
 
 
 class PagePool:
-    """Free-list allocator over ``pages`` usable pages (device rows
-    ``1..pages``; row 0 is the sink). Thread-safe; the scheduler
-    allocates at admission, the engine allocates growth at step
-    boundaries and frees at retirement."""
+    """Refcounted free-list allocator over ``pages`` usable pages
+    (device rows ``1..pages``; row 0 is the sink). Thread-safe; the
+    scheduler allocates at admission, the engine allocates growth at
+    step boundaries and frees at retirement; the prefix cache and
+    adopting slots :meth:`share` pages they did not allocate."""
 
     def __init__(self, pages: int, page_size: int) -> None:
         if pages < 1:
@@ -53,6 +66,14 @@ class PagePool:
         self.page_size = int(page_size)
         self._lock = threading.Lock()
         self._free: List[int] = list(range(1, self.pages + 1))
+        #: page id -> holders (slots + the prefix index); a page is in
+        #: the free list iff it has no entry here
+        self._rc: Dict[int, int] = {}
+        #: pressure valve: called OUTSIDE the pool lock with the page
+        #: shortfall when :meth:`alloc` cannot satisfy a request; the
+        #: engine points it at :meth:`PrefixCache.evict` so cached
+        #: prefixes are reclaimed LRU-first before anyone is refused
+        self.evictor: Optional[Callable[[int], int]] = None
 
     @property
     def device_rows(self) -> int:
@@ -64,28 +85,311 @@ class PagePool:
             return len(self._free)
 
     def in_use(self) -> int:
+        """Pages with at least one holder — a SHARED page counts once,
+        not per adopting slot (satellite fix: the fragmentation gauge
+        and fleet ``pages_in_use`` aggregation stay truthful under
+        prefix sharing)."""
         with self._lock:
             return self.pages - len(self._free)
 
+    def refcount(self, page: int) -> int:
+        with self._lock:
+            return self._rc.get(int(page), 0)
+
+    def ledger(self) -> Dict[int, int]:
+        """Snapshot of the refcount ledger (poisoning/balance tests:
+        after all slots retire and the prefix cache clears, this must
+        be empty and ``in_use()`` zero)."""
+        with self._lock:
+            return dict(self._rc)
+
     def alloc(self, n: int) -> Optional[List[int]]:
-        """``n`` page ids, or None when the pool cannot satisfy the
-        request (exhaustion — counted; the caller decides between
-        waiting for retirements and shedding 503 + Retry-After)."""
+        """``n`` page ids (each with refcount 1), or None when the
+        pool cannot satisfy the request (exhaustion — counted; the
+        caller decides between waiting for retirements and shedding
+        503 + Retry-After). Under pressure the :attr:`evictor` is
+        asked ONCE to release cached-prefix pages before refusing."""
         n = int(n)
         if n <= 0:
             return []
-        with self._lock:
-            if len(self._free) < n:
-                inc("veles_serving_pages_exhausted_total")
-                return None
-            out, self._free = self._free[:n], self._free[n:]
+        evicted = False
+        while True:
+            with self._lock:
+                if len(self._free) >= n:
+                    out, self._free = self._free[:n], self._free[n:]
+                    for page in out:
+                        self._rc[page] = 1
+                    break
+                shortfall = n - len(self._free)
+            if self.evictor is not None and not evicted:
+                # outside the lock: the evictor frees pages through
+                # free(), which takes the lock itself
+                evicted = True
+                try:
+                    self.evictor(shortfall)
+                except Exception:   # noqa: BLE001 — pressure valve only
+                    pass
+                continue
+            inc("veles_serving_pages_exhausted_total")
+            return None
         inc("veles_serving_pages_alloc_total", n)
         return out
 
-    def free(self, ids: List[int]) -> None:
+    def share(self, page: int) -> int:
+        """Take one more reference on an allocated page (prefix
+        adoption / cache insertion). Raises on a page nobody holds —
+        sharing a freed page would alias the next admission's data,
+        the exact poisoning the refcount ledger exists to prevent."""
+        page = int(page)
+        with self._lock:
+            rc = self._rc.get(page)
+            if rc is None:
+                raise ValueError(
+                    "page %d is not allocated — cannot share" % page)
+            self._rc[page] = rc + 1
+            return rc + 1
+
+    def free(self, ids: Sequence[int]) -> None:
+        """Release one reference per page; pages whose LAST reference
+        dropped return to the free list (counted — the alloc/free
+        counters balance against ``in_use``, not against raw
+        share/release traffic)."""
         if not ids:
             return
+        released = 0
         with self._lock:
-            self._free.extend(int(i) for i in ids)
+            for i in ids:
+                page = int(i)
+                rc = self._rc.get(page)
+                if rc is None:
+                    # double free — tolerated like the idempotent slot
+                    # retire (shutdown sweeps may race), never counted
+                    continue
+                if rc > 1:
+                    self._rc[page] = rc - 1
+                    continue
+                del self._rc[page]
+                self._free.append(page)
+                released += 1
             self._free.sort()
-        inc("veles_serving_pages_free_total", len(ids))
+        if released:
+            inc("veles_serving_pages_free_total", released)
+
+
+class _PrefixNode:
+    """One cached ``page_size``-token block: the exact tokens (THE
+    match key — hashes pick the dict slot, token equality decides, so
+    a corrupted index can only degrade to a miss, never to wrong
+    tokens), the physical page holding its K/V rows, and the LRU
+    stamp."""
+
+    __slots__ = ("tokens", "page", "children", "parent", "last_use")
+
+    def __init__(self, tokens: Tuple[int, ...], page: int,
+                 parent: Optional["_PrefixNode"]) -> None:
+        self.tokens = tokens
+        self.page = page
+        self.children: Dict[Tuple[int, ...], "_PrefixNode"] = {}
+        self.parent = parent
+        self.last_use = 0
+
+
+class PrefixCache:
+    """Radix tree over hashed token blocks (block = ``page_size``
+    tokens) mapping shared prompt prefixes to refcounted pages.
+
+    Admission walks the tree over a prompt's full blocks; every
+    matched node's page is :meth:`PagePool.share`-adopted into the new
+    slot's page table, so the slot's prefill covers only the unmatched
+    suffix — a 2k-token system prompt shared by the whole pool costs
+    its pages and its prefill FLOPs once. After a prefill completes,
+    the slot's own full blocks are :meth:`insert`-ed so the NEXT
+    admission shares them.
+
+    The tree holds its own page references (a retired writer's prefix
+    outlives it), released by LRU leaf eviction under allocator
+    pressure (:meth:`evict` — wired as :attr:`PagePool.evictor`) or
+    :meth:`clear`. All mutation happens on the engine's tick thread;
+    the lock exists for the /metrics stats reads."""
+
+    def __init__(self, pool: PagePool, page_size: int,
+                 max_blocks: Optional[int] = None) -> None:
+        self.pool = pool
+        self.page_size = int(page_size)
+        #: soft block budget: insertions past it evict LRU leaves
+        #: first (0/None = bounded only by allocator pressure)
+        self.max_blocks = int(max_blocks or 0)
+        self._lock = threading.Lock()
+        self._root = _PrefixNode((), 0, None)
+        self._clock = 0
+        self._blocks = 0
+
+    def _blocks_of(self, tokens: Sequence[int]) -> List[Tuple[int, ...]]:
+        p = self.page_size
+        n = len(tokens) // p
+        return [tuple(int(t) for t in tokens[i * p:(i + 1) * p])
+                for i in range(n)]
+
+    def match(self, tokens: Sequence[int],
+              corrupt=None) -> List[int]:
+        """Walk the tree over ``tokens``' full blocks; returns the
+        matched pages IN ORDER, each with a reference already taken
+        for the caller (the adopting slot owns them like its own
+        allocations — :meth:`PagePool.free` at retirement releases).
+
+        ``corrupt`` is the armed ``serve.prefix_match`` fault: when
+        set, every candidate block key is damaged before the equality
+        check — a corrupted index DEGRADES to a shorter (or empty)
+        match and a full prefill, never to wrong tokens, because the
+        token comparison is the authority, not the hash."""
+        matched: List[int] = []
+        with self._lock:
+            node = self._root
+            self._clock += 1
+            for block in self._blocks_of(tokens):
+                key = block
+                if corrupt is not None:
+                    # damage the LOOKUP key the way a rotten index
+                    # entry would: the tokens no longer compare equal,
+                    # so the walk stops and the suffix prefills fully
+                    raw = bytearray()
+                    for t in block:
+                        raw += int(t).to_bytes(8, "little", signed=True)
+                    raw = corrupt.corrupt(bytes(raw))
+                    key = tuple(
+                        int.from_bytes(raw[i:i + 8], "little",
+                                       signed=True)
+                        for i in range(0, len(raw) - len(raw) % 8, 8))
+                child = node.children.get(key)
+                if child is None or child.tokens != block:
+                    break
+                child.last_use = self._clock
+                self.pool.share(child.page)
+                matched.append(child.page)
+                node = child
+        return matched
+
+    def insert(self, tokens: Sequence[int],
+               pages: Sequence[int]) -> int:
+        """Record ``tokens``' full blocks, backed by the slot's
+        ``pages`` (parallel lists: block i lives in ``pages[i]``).
+        Blocks already present are only LRU-touched (the tree keeps
+        its existing page — two identical prefills must not hold two
+        copies); new nodes take their own reference on the slot's
+        page, which therefore survives the slot's retirement. Returns
+        the number of NEW blocks cached."""
+        blocks = self._blocks_of(tokens)
+        added = 0
+        with self._lock:
+            self._clock += 1
+            node = self._root
+            for i, block in enumerate(blocks):
+                if i >= len(pages):
+                    break
+                child = node.children.get(block)
+                if child is None:
+                    try:
+                        self.pool.share(int(pages[i]))
+                    except ValueError:
+                        break          # page already gone — stop here
+                    child = _PrefixNode(block, int(pages[i]), node)
+                    node.children[block] = child
+                    self._blocks += 1
+                    added += 1
+                child.last_use = self._clock
+                node = child
+        if self.max_blocks and self._blocks > self.max_blocks:
+            self.evict(0, over_budget=True)
+        return added
+
+    def _leaves(self) -> List[_PrefixNode]:
+        out: List[_PrefixNode] = []
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            kids = list(node.children.values())
+            if not kids and node is not self._root:
+                out.append(node)
+            stack.extend(kids)
+        return out
+
+    def evict(self, need_pages: int, over_budget: bool = False) -> int:
+        """Drop least-recently-used LEAF blocks (a block with cached
+        children anchors their prefix and is never dropped first)
+        until ``need_pages`` pages actually returned to the free list
+        — or, with ``over_budget``, until the soft block budget holds.
+        ONE tree walk seeds a heap of leaves; evicting a leaf can
+        only promote its parent, which is pushed as it becomes
+        childless — so reclaiming k pages is O(blocks + k log blocks),
+        never a re-walk per drop on the allocator-pressure path an
+        admission is waiting on. Counted per dropped block. Returns
+        pages actually freed."""
+        import heapq
+        freed = 0
+        dropped = 0
+        with self._lock:
+            heap = [(n.last_use, i, n)
+                    for i, n in enumerate(self._leaves())]
+            heapq.heapify(heap)
+            tie = len(heap)
+            while heap:
+                if over_budget:
+                    if not self.max_blocks \
+                            or self._blocks <= self.max_blocks:
+                        break
+                elif freed >= need_pages:
+                    break
+                _, _, victim = heapq.heappop(heap)
+                parent = victim.parent
+                if victim.children or parent is None \
+                        or parent.children.get(victim.tokens) \
+                        is not victim:
+                    continue           # stale heap entry
+                parent.children.pop(victim.tokens, None)
+                self._blocks -= 1
+                dropped += 1
+                before = self.pool.free_count()
+                self.pool.free([victim.page])
+                freed += self.pool.free_count() - before
+                if parent is not self._root and not parent.children:
+                    heapq.heappush(heap, (parent.last_use, tie,
+                                          parent))
+                    tie += 1
+        if dropped:
+            inc("veles_prefix_evictions_total", dropped)
+        return freed
+
+    def clear(self) -> None:
+        """Release every cached block's page reference (engine stop /
+        ledger-balance tests)."""
+        with self._lock:
+            stack = [self._root]
+            pages: List[int] = []
+            while stack:
+                node = stack.pop()
+                kids = list(node.children.values())
+                stack.extend(kids)
+                if node is not self._root:
+                    pages.append(node.page)
+            self._root = _PrefixNode((), 0, None)
+            self._blocks = 0
+        self.pool.free(pages)
+
+    def cached_pages(self) -> List[int]:
+        """Every page the index currently references (full blocks by
+        construction) — the engine's fragmentation gauge stamps them
+        fully occupied."""
+        with self._lock:
+            out: List[int] = []
+            stack = [self._root]
+            while stack:
+                node = stack.pop()
+                stack.extend(node.children.values())
+                if node is not self._root:
+                    out.append(node.page)
+            return out
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"blocks": self._blocks,
+                    "pages": self._blocks}

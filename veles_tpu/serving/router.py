@@ -100,6 +100,27 @@ ROUTER_COUNTERS = (
 )
 
 
+def _resume_budget(body: Dict) -> Tuple[List[int], Optional[int]]:
+    """Parse a request body's client-supplied resume prefix and TOTAL
+    generation budget (``n_new`` is the REMAINING budget when a
+    prefix rides along), popping ``resume_tokens`` from the body —
+    the retry loops recompute both per attempt so a dropped prefix
+    (409) widens the retry back to a full redo, never delivers
+    short. Unparsable resume/n_new disables router-side resume
+    handling entirely (empty prefix, None budget): the body forwards
+    as-is and the replica answers the 400. SINGLE SOURCE for
+    :meth:`FleetRouter.route` and :meth:`FleetRouter.route_stream` —
+    this arithmetic was review-hardened once and two copies must not
+    drift."""
+    try:
+        prefix = [int(t) for t in (body.get("resume_tokens") or ())]
+        total_new = int(body.get("n_new", 16)) + len(prefix)
+    except (TypeError, ValueError):
+        return [], None
+    body.pop("resume_tokens", None)
+    return prefix, total_new
+
+
 def normalize_endpoint(url: str) -> str:
     """Roster entry → replica base URL: bare ``host:port`` gets
     ``http://``, trailing slashes and a trailing ``/metrics`` (the
@@ -537,8 +558,13 @@ class FleetRouter(Logger):
             try:
                 # the replayed body resumes under its ORIGINAL
                 # trace_id (the admit record's) — one trace tells the
-                # whole story across the router restart
-                answered = self.route(dict(body, request_id=rid))
+                # whole story across the router restart. A journaled
+                # stream=true request replays BUFFERED: its client is
+                # gone, so replay only completes the work and records
+                # the terminal — there is nobody to stream to.
+                body = dict(body, request_id=rid)
+                body.pop("stream", None)
+                answered = self.route(body)
                 status = answered.status if answered.done else 503
                 outcome = ("replayed" if answered.done
                            else "unanswered: %s"
@@ -792,19 +818,8 @@ class FleetRouter(Logger):
         trace_on = request_tracing_enabled()
         # total generation budget: a client/replayed body may itself
         # carry a resume prefix (its n_new is then the REMAINING
-        # budget). Unparsable resume/n_new disables router-side
-        # resume handling entirely — the body forwards as-is and the
-        # replica answers the 400
-        prefix: List[int] = []
-        total_new = None
-        try:
-            prefix = [int(t) for t in
-                      (body.get("resume_tokens") or ())]
-            total_new = int(body.get("n_new", 16)) + len(prefix)
-        except (TypeError, ValueError):
-            prefix = []
-        else:
-            body.pop("resume_tokens", None)
+        # budget) — _resume_budget pops it, shared with route_stream
+        prefix, total_new = _resume_budget(body)
         #: the CLIENT's own resume base: sliced off the final answer
         #: (they asked for the remaining n_new, not a re-delivery)
         base_k = len(prefix)
@@ -917,6 +932,353 @@ class FleetRouter(Logger):
             # bracket per routed request, on the router's clock
             emit_span("route.request", t_req, now - t_req, **tags)
         return answered
+
+    # -- streaming proxy ------------------------------------------------------
+    class _ClientGone(Exception):
+        """The CLIENT's socket died mid-stream. Distinct from replica
+        failures on purpose: a closed browser tab must neither advance
+        a healthy replica's circuit breaker nor trigger failover
+        re-decodes — the routing loop just stops."""
+
+    @staticmethod
+    def _sse_events(resp):
+        """Parse an SSE byte stream into JSON event dicts (lines the
+        replica's ``data:`` framing carries; torn/non-JSON lines are
+        skipped — the stream's health is judged by its terminal
+        event, not by cosmetic damage)."""
+        for line in resp:
+            line = line.strip()
+            if not line.startswith(b"data:"):
+                continue
+            try:
+                ev = json.loads(line[5:].strip())
+            except ValueError:
+                continue
+            if isinstance(ev, dict):
+                yield ev
+
+    def route_stream(self, body: Dict, handler) -> Tuple[int, str, int]:
+        """Proxy one ``stream=true`` request: SSE events pipe from the
+        serving replica to the client AS THEY ARRIVE; an attempt that
+        dies mid-stream (replica crash, 5xx gasp, torn stream) fails
+        over with ``resume_tokens`` = everything already forwarded, so
+        the retry RE-STREAMS ONLY THE REMAINDER — the client's wire
+        sees every token exactly once and one terminal event. A 409
+        resume refusal drops the prefix and retries from scratch,
+        skipping tokens the client already holds. Attempts are
+        SEQUENTIAL (events already on the client's wire bind the
+        stream to one replica at a time — no hedging; the buffered
+        path keeps its latch-raced attempts). Returns
+        ``(status, outcome, attempts)`` for the journal's terminal
+        record. Response headers commit lazily: a request no replica
+        could even start is shed as plain JSON 503."""
+        rid = body["request_id"]
+        tid = body["trace_id"]
+        mode = str(body.get("mode", "greedy"))
+        resumable = mode in _RESUMABLE_MODES
+        trace_on = request_tracing_enabled()
+        body = dict(body)
+        prefix, total_new = _resume_budget(body)
+        base_k = len(prefix)
+        inc("veles_router_requests_total")
+        t_req = time.time()
+        deadline = t_req + self.request_timeout
+        state = {"headers": False, "sent": 0}
+
+        def event(payload):
+            from .._http import sse_event, sse_headers
+            try:
+                if not state["headers"]:
+                    sse_headers(handler)
+                    state["headers"] = True
+                sse_event(handler, payload)
+            except (BrokenPipeError, ConnectionResetError,
+                    OSError) as e:
+                # client-write failure, NOT a replica failure
+                raise FleetRouter._ClientGone(str(e)) from e
+
+        def emit_gap(full_toks):
+            """Keep the client's INCREMENTAL wire complete: forward
+            any absolute positions of ``full_toks`` it has not seen
+            as one token event (tokens a dying replica decoded but
+            never streamed arrive via its gasp; a buffered-200
+            replica delivers everything this way)."""
+            gap = [int(t) for t in full_toks[base_k + state["sent"]:]]
+            if gap:
+                event({"tokens": gap, "i": state["sent"],
+                       "request_id": rid, "trace_id": tid})
+                state["sent"] += len(gap)
+
+        def finish(status, outcome, n_attempts, tags=None):
+            if trace_on:
+                t: Dict[str, Any] = {
+                    "request_id": rid, "trace_id": tid, "mode": mode,
+                    "attempts": n_attempts, "outcome": outcome,
+                    "stream": 1}
+                t.update(tags or {})
+                if outcome == "answered":
+                    t["status"] = int(status)
+                emit_span("route.request", t_req,
+                          time.time() - t_req, **t)
+            return int(status), outcome, n_attempts
+
+        tried: List[Replica] = []
+        n_attempts = 0
+        last_reason = "no ready replica"
+        while len(tried) <= self.retry_budget:
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                last_reason = ("request budget %.0fs exhausted"
+                               % self.request_timeout)
+                break
+            replica = self.pick(exclude=tried)
+            if replica is None:
+                break
+            if tried:
+                inc("veles_router_failovers_total")
+                self.info("%s: failing stream %s over to %s (%s)%s",
+                          self.name, rid, replica.url, last_reason,
+                          " resuming at token %d" % len(prefix)
+                          if prefix else "")
+            tried.append(replica)
+            inc("veles_router_attempts_total")
+            n_attempts += 1
+            t_att = time.time()
+            attempt_body = dict(body, attempt=n_attempts, stream=True)
+            if total_new is not None:
+                attempt_body["n_new"] = total_new - len(prefix)
+                if prefix:
+                    attempt_body["resume_tokens"] = list(prefix)
+                    inc("veles_resume_attempts_total")
+            attempt_tokens: List[int] = []
+            failed_reason = None
+            drop_resume = False
+            done_event = None
+            delivered = None      # (status, body) for a 4xx pass-through
+            try:
+                fire_fault("router.replica_request")
+                req = urllib.request.Request(
+                    replica.url + self.path,
+                    data=json.dumps(attempt_body).encode(),
+                    headers={"Content-Type": "application/json"})
+                # the SOCKET timeout is per blocking read: a steadily
+                # streaming replica never trips it, a wedged one
+                # (accepted the connection, sends nothing) fails
+                # after attempt_timeout so healthy replicas still get
+                # tried inside the request budget — the buffered
+                # path's per-attempt patience, stream-shaped
+                resp = urllib.request.urlopen(
+                    req, timeout=max(0.1, min(self.attempt_timeout,
+                                              remaining)))
+            except FaultInjected as e:
+                failed_reason = "injected replica failure: %s" % e
+            except urllib.error.HTTPError as e:
+                status = e.code
+                try:
+                    err_body = json.loads(e.read() or b"{}")
+                except ValueError:
+                    err_body = {"error": "replica answered %d"
+                                % status}
+                if status == 409 and prefix:
+                    drop_resume = True
+                    failed_reason = ("replica %s cannot resume (%s)"
+                                     % (replica.url,
+                                        err_body.get("error", "")))
+                elif status >= 500:
+                    gasp = (err_body or {}).get("resume")
+                    if resumable and isinstance(gasp, dict) \
+                            and isinstance(gasp.get("tokens"), list):
+                        try:
+                            attempt_tokens = [int(t) for t in
+                                              gasp["tokens"]]
+                        except (TypeError, ValueError):
+                            attempt_tokens = []
+                    failed_reason = ("replica %s answered %d (%s)"
+                                     % (replica.url, status,
+                                        err_body.get("error", "")))
+                else:
+                    delivered = (status, err_body)
+            except Exception as e:  # noqa: BLE001 — the failure class
+                failed_reason = "%s: %s" % (type(e).__name__, e)
+            else:
+                # `with resp`: the upstream socket closes on EVERY
+                # exit — terminal break, mid-stream failure, client
+                # gone — never left to GC (one leaked fd per attempt
+                # would EMFILE a long-lived router)
+                with resp:
+                    ctype = resp.headers.get("Content-Type", "")
+                    if "event-stream" not in ctype:
+                        # buffered 200 (replica streams disabled): one
+                        # burst + terminal, stitched like the latch
+                        # path
+                        try:
+                            full = json.loads(resp.read() or b"{}")
+                        except ValueError:
+                            full = {}
+                        if isinstance(full.get("tokens"), list):
+                            attempt_tokens = [int(t) for t in
+                                              full["tokens"]]
+                            done_event = dict(full, done=True)
+                        else:
+                            failed_reason = (
+                                "replica %s answered a bodyless 200"
+                                % replica.url)
+                    else:
+                        try:
+                            for ev in self._sse_events(resp):
+                                if ev.get("done"):
+                                    done_event = ev
+                                    break
+                                toks = ev.get("tokens")
+                                if not isinstance(toks, list):
+                                    continue
+                                abs_start = len(prefix) \
+                                    + len(attempt_tokens)
+                                attempt_tokens.extend(int(t)
+                                                      for t in toks)
+                                # forward only what the client has
+                                # not seen (a scratch retry after a
+                                # dropped resume re-emits the whole
+                                # sequence)
+                                skip = (base_k + state["sent"]) \
+                                    - abs_start
+                                out = [int(t)
+                                       for t in toks[max(0, skip):]]
+                                if out:
+                                    event({"tokens": out,
+                                           "i": state["sent"],
+                                           "request_id": rid,
+                                           "trace_id": tid})
+                                    state["sent"] += len(out)
+                        except FleetRouter._ClientGone as e:
+                            # the CLIENT died, not the replica: no
+                            # breaker advance, no failover re-decode —
+                            # just stop (the replica settles its
+                            # ticket on its own)
+                            self.debug("%s: streaming client for %s "
+                                       "disconnected (%s)", self.name,
+                                       rid, e)
+                            return finish(
+                                499, "client disconnected mid-stream",
+                                n_attempts)
+                        except Exception as e:  # noqa: BLE001
+                            failed_reason = (
+                                "stream from %s died: %s: %s"
+                                % (replica.url, type(e).__name__, e))
+                        if done_event is None \
+                                and failed_reason is None:
+                            failed_reason = (
+                                "stream from %s ended without a "
+                                "terminal event" % replica.url)
+            if done_event is not None and failed_reason is None \
+                    and done_event.get("error") is not None:
+                # the replica's dying gasp arrived AS the terminal
+                # stream event: a failed attempt whose resume record
+                # covers everything it decoded
+                gasp = done_event.get("resume")
+                if resumable and isinstance(gasp, dict) \
+                        and isinstance(gasp.get("tokens"), list):
+                    try:
+                        gained = [int(t) for t in gasp["tokens"]]
+                        if len(gained) >= len(attempt_tokens):
+                            attempt_tokens = gained
+                    except (TypeError, ValueError):
+                        pass
+                failed_reason = ("replica %s failed mid-stream (%s)"
+                                 % (replica.url,
+                                    done_event.get("error")))
+                done_event = None
+            if trace_on:
+                try:
+                    emit_span(
+                        "route.attempt", t_att, time.time() - t_att,
+                        endpoint=replica.url, attempt=n_attempts,
+                        request_id=rid, trace_id=tid, stream=1,
+                        tokens_done=len(prefix),
+                        outcome=("answered" if done_event is not None
+                                 or delivered is not None
+                                 else "failed"),
+                        **({"reason": failed_reason}
+                           if failed_reason else {}))
+                except Exception:   # noqa: BLE001 — observability only
+                    pass
+            if delivered is not None:
+                # 2xx–4xx non-stream answers are the replica's word
+                replica.breaker.record_success()
+                status, err_body = delivered
+                try:
+                    if state["headers"]:
+                        event(dict(err_body, done=True, code=status))
+                    else:
+                        json_reply(handler, status, err_body)
+                except (FleetRouter._ClientGone, BrokenPipeError,
+                        ConnectionResetError, OSError):
+                    pass        # the answer existed; client left
+                return finish(status, "answered", n_attempts)
+            if done_event is not None:
+                replica.breaker.record_success()
+                full_toks = prefix + attempt_tokens
+                final = dict(done_event)
+                final["tokens"] = full_toks[base_k:]
+                final.setdefault("request_id", rid)
+                final.setdefault("trace_id", tid)
+                if len(prefix) > base_k:
+                    final["resumed_from"] = len(prefix)
+                try:
+                    # complete the incremental wire first (tokens a
+                    # buffered-200 replica or a tail-in-done-only
+                    # stream never sent as token events), THEN the
+                    # authoritative terminal
+                    emit_gap(full_toks)
+                    event(final)
+                except FleetRouter._ClientGone:
+                    self.debug("%s: streaming client for %s went "
+                               "away before the terminal event",
+                               self.name, rid)
+                return finish(200, "answered", n_attempts)
+            # failed attempt: breaker + resume accounting, then retry
+            last_reason = failed_reason or "replica failure"
+            if drop_resume:
+                prefix = []
+                if replica in tried:
+                    tried.remove(replica)
+            else:
+                inc("veles_router_replica_errors_total")
+                if replica.breaker.record_failure():
+                    inc("veles_router_breaker_opens_total")
+                if resumable and total_new is not None \
+                        and attempt_tokens \
+                        and len(prefix) + len(attempt_tokens) \
+                        < total_new:
+                    # a gasp may carry tokens the stream never
+                    # delivered — forward them BEFORE resuming past
+                    # them, so the client's incremental wire has no
+                    # hole (the retry decodes only the remainder)
+                    try:
+                        emit_gap(prefix + attempt_tokens)
+                    except FleetRouter._ClientGone as e:
+                        self.debug("%s: streaming client for %s "
+                                   "disconnected (%s)", self.name,
+                                   rid, e)
+                        return finish(
+                            499, "client disconnected mid-stream",
+                            n_attempts)
+                    prefix = prefix + attempt_tokens
+        # nobody could answer
+        if state["headers"]:
+            try:
+                event({"done": True, "code": 503,
+                       "error": "no replica could answer: %s"
+                                % last_reason,
+                       "request_id": rid, "retry_after": 1.0})
+            except FleetRouter._ClientGone:
+                pass
+            return finish(503, "unanswered: %s" % last_reason,
+                          n_attempts)
+        health.shed(handler, retry_after=1.0,
+                    reason="no replica could answer: %s" % last_reason,
+                    request_id=rid)
+        return finish(503, "unanswered: %s" % last_reason, n_attempts)
 
     def _note_attempt(self, replica: Replica, state: _Attempt,
                       answered: _Answer, rid: str, tid: str,
@@ -1077,6 +1439,15 @@ class FleetRouter(Logger):
                     json_reply(self, 400,
                                {"error": "bad request: %s" % e})
                     return
+                if not isinstance(body.get("stream", False), bool):
+                    # the replica's _parse would answer this 400 —
+                    # the router must not coerce a truthy non-bool
+                    # ("false", 1) into an SSE stream the replica
+                    # would have refused
+                    json_reply(self, 400,
+                               {"error": "bad request: 'stream' "
+                                         "must be a boolean"})
+                    return
                 # the durability boundary: the request exists in the
                 # journal BEFORE its first dispatch, so a router
                 # SIGKILL after this line loses nothing — restart
@@ -1105,6 +1476,35 @@ class FleetRouter(Logger):
                         return
                     with router._cv:
                         router._journal_outstanding += 1
+                if body.get("stream"):
+                    # streaming proxy: events pipe through as they
+                    # arrive, mid-stream failover resumes from the
+                    # forwarded prefix; the journal terminal mirrors
+                    # the buffered path's
+                    with router._cv:
+                        router._inflight += 1
+                    try:
+                        status, outcome, attempts = \
+                            router.route_stream(body, self)
+                    finally:
+                        with router._cv:
+                            router._inflight -= 1
+                            router.requests_routed += 1
+                            router._cv.notify_all()
+                    if router.journal is not None:
+                        try:
+                            router.journal.done(rid, int(status),
+                                                outcome, trace_id=tid,
+                                                attempts=attempts)
+                            with router._cv:
+                                router._journal_outstanding -= 1
+                        except Exception as e:  # noqa: BLE001
+                            router.warning(
+                                "%s: journal terminal for %s failed "
+                                "(%s: %s); the entry stays pending — "
+                                "a restart replays it idempotently",
+                                router.name, rid, type(e).__name__, e)
+                    return
                 with router._cv:
                     router._inflight += 1
                 try:

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import itertools
 import os
+import queue as _queue_mod
 import threading
 import time
 from collections import deque
@@ -106,13 +107,15 @@ class Ticket:
                  "deadline", "enqueued", "request_id", "trace_id",
                  "attempt", "mode",
                  "admitted", "prefill_done", "first_token",
-                 "n_tokens", "outcome", "progress", "_terminal_lock")
+                 "n_tokens", "outcome", "progress", "_terminal_lock",
+                 "stream", "_stream_q")
 
     def __init__(self, deadline: Optional[float] = None,
                  request_id: Optional[str] = None,
                  mode: str = "greedy",
                  trace_id: Optional[str] = None,
-                 attempt: int = 1) -> None:
+                 attempt: int = 1,
+                 stream: bool = False) -> None:
         self._terminal_lock = threading.Lock()
         self.event = threading.Event()
         self.result = None
@@ -139,6 +142,14 @@ class Ticket:
         #: tokens emitted before a mid-decode failure/handoff — the
         #: token-level resume record a failover retry continues from
         self.progress: Optional[List[int]] = None
+        #: token streaming (``stream=true`` requests): the serving
+        #: plane pushes emitted tokens at step boundaries and the HTTP
+        #: handler drains them onto the wire as SSE events; terminal
+        #: settles enqueue a ``None`` sentinel so the drain loop ends
+        #: the moment the answer exists
+        self.stream = bool(stream)
+        self._stream_q: Optional["_queue_mod.SimpleQueue"] = (
+            _queue_mod.SimpleQueue() if self.stream else None)
 
     # -- lifecycle stamps (host-side, step boundaries only) ------------------
     def mark_admitted(self) -> None:
@@ -179,6 +190,29 @@ class Ticket:
         if not self.event.is_set():
             self.progress = [int(t) for t in tokens]
 
+    # -- token streaming ------------------------------------------------------
+    def push_tokens(self, tokens) -> None:
+        """Hand freshly emitted tokens to the streaming drain loop (a
+        no-op for buffered tickets). Stamps first-token time: the
+        moment a token enters this queue it is one queue hop from the
+        client's socket, so the TTFT histogram now measures a real
+        client-visible first token — not an internal prefill sync a
+        buffered response would sit on for the whole generation."""
+        if self._stream_q is None:
+            return
+        toks = [int(t) for t in tokens]
+        if not toks:
+            return
+        self.mark_first_token()
+        self._stream_q.put(toks)
+
+    def next_stream_item(self, timeout: float):
+        """Blocking drain step for the HTTP streaming handler: a token
+        list, the ``None`` terminal sentinel, or raises
+        ``queue.Empty`` on timeout."""
+        assert self._stream_q is not None
+        return self._stream_q.get(timeout=timeout)
+
     # -- terminal (exactly once) ---------------------------------------------
     def fail(self, error: str, code: int = 500,
              retry_after: Optional[float] = None,
@@ -198,6 +232,8 @@ class Ticket:
             self._account(outcome
                           or ("shed" if code == 503 else "error"))
             self.event.set()
+            if self._stream_q is not None:
+                self._stream_q.put(None)
         return True
 
     def succeed(self, result) -> bool:
@@ -214,6 +250,8 @@ class Ticket:
             self.result = result
             self._account("retired")
             self.event.set()
+            if self._stream_q is not None:
+                self._stream_q.put(None)
         return True
 
     def error_payload(self) -> Dict:
@@ -227,7 +265,7 @@ class Ticket:
                       "request_id": self.request_id}
         if self.retry_after is not None:
             body["retry_after"] = self.retry_after
-        if self.progress:
+        if self.progress is not None:
             # the token-level resume record: this ATTEMPT's emitted
             # tokens (a resumed attempt reports only its own new
             # tokens — the router accumulates prefixes across
@@ -361,7 +399,7 @@ class Slot:
 
     __slots__ = ("idx", "req", "ticket", "t_p", "bucket", "tokens",
                  "n_new", "eos_id", "temperature", "mode", "pages",
-                 "group", "rounds", "acc")
+                 "group", "rounds", "acc", "prefilled", "shared")
 
     def __init__(self, idx: int, req: Dict, ticket: Ticket,
                  bucket: int, pages: Optional[List[int]] = None,
@@ -380,6 +418,15 @@ class Slot:
         self.group = group
         self.rounds = 0     # speculative: draft/verify rounds run
         self.acc = 0        # speculative: total accepted draft tokens
+        #: chunked prefill cursor: positions already written, or None
+        #: once the prompt is fully prefilled (monolithic prefills
+        #: never set it) — rows with a cursor are excluded from the
+        #: decode step until their final chunk lands
+        self.prefilled: Optional[int] = None
+        #: leading page-table entries adopted READ-ONLY from the
+        #: prefix cache — the decode step's write-back masks them to
+        #: the sink, so a writer can never mutate a shared page
+        self.shared = 0
 
     def record(self, token: int) -> bool:
         """Append one emitted token; True when the row is finished

@@ -221,6 +221,26 @@ DESCRIPTIONS = {
         "In-flight requests a draining replica handed back with "
         "progress (503 + resume) instead of aborting or riding out "
         "the full generation",
+    # prefix-sharing paged KV cache (serving/pages.py PrefixCache +
+    # engine adoption/COW): bench.py's gate asserts these read 0 in
+    # non-serving runs
+    "veles_prefix_hits_total":
+        "Admissions that adopted at least one shared prefix block "
+        "from the radix prefix cache (prefill covers only the "
+        "unmatched suffix)",
+    "veles_prefix_misses_total":
+        "Prefix-eligible admissions (>= 1 full token block) that "
+        "matched nothing in the prefix cache and prefilled fully",
+    "veles_prefix_shared_pages_total":
+        "KV-cache pages adopted READ-ONLY into admitting slots from "
+        "the prefix cache (each adoption takes one refcount share)",
+    "veles_prefix_cow_copies_total":
+        "Copy-on-write page copies: a write had to land inside a "
+        "shared page (full-prompt match re-computing its last "
+        "position), so its content moved to a private page first",
+    "veles_prefix_evictions_total":
+        "Prefix-cache blocks dropped by LRU leaf eviction (allocator "
+        "pressure or the soft block budget)",
     # fleet-wide distributed tracing (telemetry/spans.py ring pulls +
     # telemetry/fleet.py cross-process assembly): bench.py's gate
     # asserts these read 0 in non-fleet runs

@@ -245,7 +245,18 @@ class WebStatusServer(Logger):
                                 ("page_fragmentation",
                                  "allocated-but-unoccupied fraction "
                                  "of in-use pages (tail-of-page "
-                                 "waste)"),
+                                 "waste; shared pages counted once)"),
+                                ("prefix_cache",
+                                 "1 = prefix-sharing page cache on"),
+                                ("prefix_blocks",
+                                 "token blocks held by the prefix "
+                                 "cache"),
+                                ("prefilling",
+                                 "rows mid chunked prefill"),
+                                ("prefill_stall_seconds",
+                                 "worst per-tick decode stall from "
+                                 "prefill work (chunked prefill "
+                                 "bounds this)"),
                                 ("artifact_mode",
                                  "1 = serving from an AOT artifact "
                                  "(zero jit compiles)"),
