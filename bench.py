@@ -678,6 +678,13 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # on leakage; the share-ratio FLOP-reduction, stream-TTFT and
         # chunk-stall measurements are gate_prefix's live proof
         "prefix": _prefix_section(),
+        # O(1)-state serving lane (serving/recurrent.py + the radix
+        # StateCache): the bench never serves the recurrent slot
+        # pool, so every checkpoint/restore counter MUST read zero
+        # here — the gate fails on leakage; the flat-state-bytes,
+        # scan-vs-recurrent id-exactness and slots-at-equal-HBM
+        # measurements are gate_o1state's live proof
+        "o1state": _o1state_section(),
         "extras": [ae, lm],
     }
 
@@ -777,6 +784,30 @@ def _prefix_section():
             counters.get("veles_prefix_cow_copies_total")),
         "evictions": int(
             counters.get("veles_prefix_evictions_total")),
+    }
+
+
+def _o1state_section():
+    """{checkpoints, restores, restored_tokens, rescans, evictions}
+    for this bench process — absolute counter reads (one process,
+    counters start at zero). The bench never serves the O(1)-state
+    recurrent lane, so every count MUST be zero — ``bench.py gate``
+    fails on leakage. The live proof (decode state bytes FLAT vs
+    token count, pooled scan-prefill + recurrent-decode id-exact vs
+    the solo sampler, >= 4x slots at equal HBM vs the paged
+    transformer pool) runs inside ``gate_o1state``."""
+    from veles_tpu.telemetry.counters import counters
+    return {
+        "checkpoints": int(
+            counters.get("veles_o1_state_checkpoints_total")),
+        "restores": int(
+            counters.get("veles_o1_state_restores_total")),
+        "restored_tokens": int(
+            counters.get("veles_o1_state_restored_tokens_total")),
+        "rescans": int(
+            counters.get("veles_o1_state_rescans_total")),
+        "evictions": int(
+            counters.get("veles_o1_state_evictions_total")),
     }
 
 
@@ -2924,6 +2955,162 @@ def _quant_serving_proof():
     return failures, metrics
 
 
+#: the O(1)-state lane's reason to exist: per-slot recurrent state
+#: must undercut the paged transformer's per-slot KV allotment (same
+#: geometry) by at least this factor — the slots-at-equal-HBM
+#: headline the gate stamps
+O1_HBM_MULTIPLIER = 4.0
+
+
+def gate_o1state(baseline_doc=None, current_doc=None):
+    """``o1state`` gate section: (1) every O(1)-state lane counter
+    must be registered with a HELP string; (2) bench documents must
+    carry ZERO state-checkpoint activity — the bench never serves the
+    recurrent lane, so checkpoints/restores in a training measurement
+    mean the lane leaked; (3) live proof (:func:`_o1state_proof`):
+    a recurrent char_lm stack pool-serves id-exact vs the solo
+    sampler (greedy AND sampled — the scan-prefill ↔ recurrent-decode
+    duality), decode state bytes stay FLAT whatever the token count
+    (pageless pool), and per-slot state undercuts the paged
+    transformer's per-slot KV allotment by >= O1_HBM_MULTIPLIER x at
+    the same geometry."""
+    from veles_tpu.serving import O1_COUNTERS
+    from veles_tpu.telemetry.counters import DESCRIPTIONS
+    failures = []
+    for name in O1_COUNTERS:
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "o1state: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc),
+                     ("current", current_doc)):
+        sec = (doc or {}).get("o1state")
+        if not sec:
+            continue
+        if ((doc or {}).get("serving") or {}).get("serving_bench"):
+            continue      # a serving-mode bench checkpoints on purpose
+        for key, value in sec.items():
+            if value:
+                failures.append(
+                    "o1state: %s doc has %s=%s — O(1)-state serving "
+                    "work leaked into a non-serving bench run"
+                    % (tag, key, value))
+    proof_failures, metrics = _o1state_proof()
+    if metrics:
+        print("o1state proof: pooled scan/recurrent id-exact "
+              "(greedy+sampled), state pool %d bytes at 4 and %d "
+              "tokens (flat, 0 pages), %.1fx slots at equal HBM "
+              "(kv %d vs state %d bytes/slot)"
+              % (metrics["pool_bytes"], metrics["long_tokens"],
+                 metrics["hbm_multiplier"], metrics["kv_per_slot"],
+                 metrics["state_per_slot"]))
+    return failures + proof_failures
+
+
+def _o1state_proof():
+    """THE O(1)-state drill, live on this process's backend. One tiny
+    recurrent (LSTM) char_lm stack plus a transformer twin at the
+    same geometry prove the lane's three claims:
+
+    1. **scan ↔ recurrence id-exact** — the pooled engine (chunked
+       scan prefill + fixed-shape recurrent decode over interleaved
+       slots) answers token-identical to the private solo sampler,
+       greedy AND sampled.
+    2. **flat decode state** — the state pool's byte count is
+       identical after a 4-token and a 44-token decode: per-slot
+       state is fixed, no page table, nothing grows with context.
+    3. **slots at equal HBM** — per-slot state bytes undercut the
+       paged transformer's per-slot KV allotment by >=
+       O1_HBM_MULTIPLIER x, so the same memory holds that many more
+       concurrent decodes.
+
+    Returns (failures, metrics) so the caller can gate and stamp."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy
+    import jax
+    import char_lm
+    import veles_tpu as vt
+    from veles_tpu import prng
+    from veles_tpu.serving import RecurrentEngine, generate_recurrent
+    from veles_tpu.serving.engine import ContinuousEngine, make_request
+
+    failures = []
+    prng.seed_all(616)
+    wf = char_lm.build_workflow(epochs=1, minibatch_size=32,
+                                n_blocks=1, dim=32, n_train=64,
+                                n_valid=32, arch="lstm")
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    prng.seed_all(617)
+    twf = char_lm.build_workflow(epochs=1, minibatch_size=32,
+                                 n_blocks=1, dim=32, n_train=64,
+                                 n_valid=32)
+    twf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    prompt = [int(t) for t in
+              char_lm.make_corpus(numpy.random.RandomState(9), 12)]
+
+    # 1. pooled == solo, greedy AND sampled (the duality lock, over
+    # the exact programs the engine serves with)
+    loads = [("greedy", 0.0, 0), ("sample", 0.9, 33)]
+    solo = {m: [generate_recurrent(wf, prompt, 10, temperature=t,
+                                   seed=s + i, mode=m)
+                for i in range(3)]
+            for m, t, s in loads}
+    eng = RecurrentEngine(wf, max_slots=3, max_context=64,
+                          page_size=8, name="bench_o1state").start()
+    try:
+        for m, t, s in loads:
+            out = eng.serve([make_request(prompt, 10, temperature=t,
+                                          seed=s + i, mode=m)
+                             for i in range(3)])
+            if out != solo[m]:
+                failures.append(
+                    "o1state: pooled %s serve diverged from the solo "
+                    "scan/recurrent sampler" % m)
+        # 2. flat decode state bytes: same pool before/after a 11x
+        # longer decode, and never a page
+        eng.serve([make_request(prompt, 4)])
+        short_bytes = int(eng.stats()["kv_pool_bytes"])
+        eng.serve([make_request(prompt, 44)])
+        st = eng.stats()
+        if not (short_bytes == int(st["kv_pool_bytes"]) > 0):
+            failures.append(
+                "o1state: decode state pool moved with token count "
+                "(%s bytes at 4 tokens vs %s at 44)"
+                % (short_bytes, st["kv_pool_bytes"]))
+        if st["pages_total"]:
+            failures.append(
+                "o1state: recurrent engine reports %d KV pages — "
+                "the lane must be pageless" % st["pages_total"])
+    finally:
+        eng.stop()
+
+    # 3. slots at equal HBM: the paged twin's pool is built (never
+    # compiled, never started) just to weigh its per-slot KV rows
+    paged = ContinuousEngine(twf, max_slots=3, buckets=(16, 32, 64),
+                             max_context=64, page_size=8,
+                             name="bench_o1state_paged")
+    paged._ensure_pool(paged._prepare_params())
+    kv_per_slot = sum(
+        int(leaf.nbytes)
+        for leaf in jax.tree_util.tree_leaves(paged._caches)
+    ) // paged.max_slots
+    state_per_slot = int(eng.state_bytes_per_slot())
+    mult = kv_per_slot / state_per_slot
+    if mult < O1_HBM_MULTIPLIER:
+        failures.append(
+            "o1state: equal-HBM multiplier %.2f under the %.0fx bar "
+            "(kv %d vs state %d bytes/slot)"
+            % (mult, O1_HBM_MULTIPLIER, kv_per_slot, state_per_slot))
+    metrics = {
+        "pool_bytes": short_bytes,
+        "long_tokens": 44,
+        "hbm_multiplier": round(mult, 2),
+        "kv_per_slot": int(kv_per_slot),
+        "state_per_slot": state_per_slot,
+    }
+    return failures, metrics
+
+
 def gate_tensormon(baseline_doc=None, current_doc=None):
     """``tensormon`` gate section: (1) the model-health counters must
     be registered; (2) a monitoring-OFF bench document must carry ZERO
@@ -3031,7 +3218,11 @@ def _gate_main(argv):
                 # DOCUMENT assertion + its own live share/stream/
                 # stall proof
                 + gate_prefix(baseline, current)
-                + gate_quant(baseline, current))
+                + gate_quant(baseline, current)
+                # the O(1)-state drill serves its own private pool,
+                # so like the others it runs after the doc-leakage
+                # assertions above
+                + gate_o1state(baseline, current))
     for failure in failures:
         print("GATE FAIL %s" % failure, file=sys.stderr)
     if failures:
@@ -3052,7 +3243,8 @@ def _gate_main(argv):
           "share-ratio FLOP bound + streamed TTFT + chunk stall "
           "bound, quant "
           "clean + int8 greedy token-exact + artifact serves with "
-          "zero compiles)"
+          "zero compiles, o1state clean + pooled scan/recurrent "
+          "id-exact + flat state bytes + equal-HBM slot multiplier)"
           % (argv[1], argv[0],
              " — %d legacy section(s) compared on wall-clock" % legacy
              if legacy else ""))

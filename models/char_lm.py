@@ -67,9 +67,11 @@ def build_workflow(epochs=10, minibatch_size=64, lr=0.003, n_blocks=2,
                    seq_len=SEQ_LEN, arch="transformer"):
     """``text_file``: train on a real text file via TextFileLoader
     (vocab sized to the corpus) instead of the generated grammar.
-    ``arch``: "transformer" (RoPE blocks) or "lstm" (stacked
-    return-sequences LSTMs — the recurrent family on the same LM
-    surface, so the rnn stack gets the same real-data quality gate)."""
+    ``arch``: "transformer" (RoPE blocks), "lstm" (stacked
+    return-sequences LSTMs) or "ssm" (gated linear-attention SSD
+    blocks) — the recurrent families ride the same LM surface, so they
+    get the same real-data quality gate AND the O(1)-state serving
+    lane end-to-end."""
     if text_file:
         from veles_tpu.loader import TextFileLoader
         # one cheap scan for the vocabulary (embedding/head sizes need
@@ -90,13 +92,17 @@ def build_workflow(epochs=10, minibatch_size=64, lr=0.003, n_blocks=2,
                               minibatch_size=minibatch_size,
                               name="chars")
         vocab = VOCAB
-    if arch not in ("transformer", "lstm"):
-        raise ValueError("arch must be 'transformer' or 'lstm', got %r"
-                         % (arch,))
+    if arch not in ("transformer", "lstm", "ssm"):
+        raise ValueError("arch must be 'transformer', 'lstm' or "
+                         "'ssm', got %r" % (arch,))
     if arch == "lstm":
         body = [{"type": "lstm", "hidden_size": dim,
                  "return_sequences": True, "solver": "adam",
                  "learning_rate": lr, "name": "lstm%d" % i}
+                for i in range(n_blocks)]
+    elif arch == "ssm":
+        body = [{"type": "ssm_block", "n_heads": 4, "solver": "adam",
+                 "learning_rate": lr, "name": "ssm%d" % i}
                 for i in range(n_blocks)]
     else:
         body = [{"type": "transformer_block", "n_heads": 4,

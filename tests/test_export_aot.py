@@ -66,10 +66,9 @@ def test_artifact_is_a_v3_package_with_serving_block(served_artifact):
         contents = json.load(fin)
     assert contents["format_version"] == 3
     serving = contents["serving"]
-    # v3: the decode step takes the per-slot shared-page mask (prefix
-    # sharing) — v2 artifacts refuse at the signature check and fall
-    # back to live jit
-    assert serving["artifact_version"] == 3
+    # v4: the O(1)-state lane's rscan/rstep labels joined the format;
+    # paged artifacts are unchanged, so this one still reads back
+    assert serving["artifact_version"] == 4
     assert sorted(serving["programs"]) == ["decode", "prefill_16",
                                            "prefill_8"]
     for fname in serving["programs"].values():

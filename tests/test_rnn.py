@@ -1,0 +1,235 @@
+"""Recurrent-unit gradients and the scan ↔ recurrence identity.
+
+Two locks for the O(1)-state lane (ISSUE 16):
+
+1. **numeric gradients** — GDLSTM/GDRNN (and GDSSMBlock) backward is
+   plain autodiff through the scan (``GradientDescentBase.
+   compute_grads`` = ``jax.vjp``); a finite-difference directional
+   derivative of the scalar loss ``sum(apply(params, x) * E)`` must
+   agree with the analytic gradient for every parameter tensor AND
+   the input cotangent. This is the BPTT correctness anchor — the
+   reference's numeric-vs-analytic gradient drill, adapted.
+
+2. **scan-vs-recurrent equivalence** — the serving duality: jitted
+   ``scan_state`` (prefill mode) against a host loop of the jitted
+   ``step_state`` (decode mode) must agree BIT-EXACTLY on outputs and
+   final state, because both are the same step body (`lax.scan` of it
+   vs single applications). Any tolerance here would let the serving
+   lane's modes drift; equality is asserted with ``==``, not
+   allclose. Padded scans (``length=``) must carry bit-identical
+   state to the unpadded scan — that is what makes fixed-width chunk
+   prefill id-exact.
+"""
+import functools
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import nn
+from veles_tpu.memory import Array
+
+
+@pytest.fixture(autouse=True)
+def f32_compute():
+    prev = vt.root.common.engine.compute_dtype
+    vt.root.common.engine.compute_dtype = "float32"
+    yield
+    vt.root.common.engine.compute_dtype = prev
+
+
+def _built_unit(unit_cls, input_shape, seed=11, **kwargs):
+    wf = vt.Workflow(name="t")
+    u = unit_cls(wf, **kwargs)
+    rng = numpy.random.RandomState(seed)
+    x = rng.randn(*input_shape).astype(numpy.float32)
+    u.input = Array(x, name="x")
+    u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    return wf, u, x
+
+
+# -- numeric vs analytic gradients (satellite: BPTT anchor) --------------------
+
+def _fd_directional_check(unit_cls, input_shape, rtol=2e-2, **kwargs):
+    """Central finite difference of loss(p) = sum(apply(p, x) * E)
+    along a random unit direction per tensor vs the analytic vjp."""
+    import jax.numpy as jnp
+    wf, fwd, x = _built_unit(unit_cls, input_shape, **kwargs)
+    fwd.xla_run()
+    rng = numpy.random.RandomState(7)
+    e_out = rng.randn(*numpy.asarray(fwd.output.map_read()).shape) \
+        .astype(numpy.float32)
+    gd = nn.nn_units.MATCHING[unit_cls](wf, learning_rate=0.0)
+    gd.forward = fwd
+    gd.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    xgrad, pgrads = gd.compute_grads(jnp.asarray(e_out))
+    params = {k: numpy.asarray(v.map_read(), numpy.float32)
+              for k, v in fwd.param_arrays().items()}
+
+    def loss(p, xx):
+        y = numpy.asarray(
+            fwd.apply({k: jnp.asarray(v) for k, v in p.items()},
+                      jnp.asarray(xx), train=True))
+        return float((y.astype(numpy.float64)
+                      * e_out.astype(numpy.float64)).sum())
+
+    eps = 1e-2
+    checked = 0
+    for k, p in params.items():
+        d = rng.randn(*p.shape).astype(numpy.float32)
+        d /= max(numpy.linalg.norm(d), 1e-12)
+        hi = dict(params)
+        lo = dict(params)
+        hi[k] = p + eps * d
+        lo[k] = p - eps * d
+        fd = (loss(hi, x) - loss(lo, x)) / (2 * eps)
+        an = float((numpy.asarray(pgrads[k], numpy.float64) * d).sum())
+        scale = max(abs(fd), abs(an), 1e-3)
+        assert abs(fd - an) <= rtol * scale, \
+            "%s.%s: fd=%g analytic=%g" % (unit_cls.__name__, k, fd, an)
+        checked += 1
+    assert checked == len(params)
+    # and the input cotangent (err_input feeds the previous layer)
+    d = rng.randn(*x.shape).astype(numpy.float32)
+    d /= numpy.linalg.norm(d)
+    fd = (loss(params, x + eps * d) - loss(params, x - eps * d)) \
+        / (2 * eps)
+    an = float((numpy.asarray(xgrad, numpy.float64) * d).sum())
+    scale = max(abs(fd), abs(an), 1e-3)
+    assert abs(fd - an) <= rtol * scale, \
+        "%s err_input: fd=%g analytic=%g" % (unit_cls.__name__, fd, an)
+
+
+def test_gdlstm_numeric_gradient():
+    _fd_directional_check(nn.LSTM, (3, 6, 5), hidden_size=4,
+                          return_sequences=True)
+
+
+def test_gdlstm_numeric_gradient_last_state():
+    _fd_directional_check(nn.LSTM, (2, 5, 4), hidden_size=3)
+
+
+def test_gdrnn_numeric_gradient():
+    _fd_directional_check(nn.RNN, (3, 6, 5), hidden_size=4,
+                          return_sequences=True)
+
+
+def test_gdssm_numeric_gradient():
+    _fd_directional_check(nn.SSMBlock, (2, 6, 8), n_heads=2)
+
+
+def test_gd_units_registered():
+    """The workflow builder resolves backward units through MATCHING —
+    every recurrent forward must have its GD mate registered."""
+    assert nn.nn_units.MATCHING[nn.LSTM] is nn.GDLSTM
+    assert nn.nn_units.MATCHING[nn.RNN] is nn.GDRNN
+    assert nn.nn_units.MATCHING[nn.SSMBlock] is nn.GDSSMBlock
+
+
+# -- scan ↔ recurrence bit-identity (the serving duality lock) -----------------
+
+def _params_of(u):
+    import jax.numpy as jnp
+    return {k: jnp.asarray(numpy.asarray(v.map_read()))
+            for k, v in u.param_arrays().items()}
+
+
+def _bit_identity_check(unit_cls, input_shape, **kwargs):
+    import jax
+    import jax.numpy as jnp
+    wf, u, x = _built_unit(unit_cls, input_shape, **kwargs)
+    params = _params_of(u)
+    b, t, _ = x.shape
+    st0 = u.init_state(b, jnp.float32)
+
+    scan = jax.jit(functools.partial(u.scan_state))
+    step = jax.jit(u.step_state)
+    ys_scan, st_scan = scan(params, jnp.asarray(x), st0)
+    st = st0
+    ys_loop = []
+    for i in range(t):
+        y, st = step(params, jnp.asarray(x[:, i, :]), st)
+        ys_loop.append(numpy.asarray(y))
+    ys_loop = numpy.stack(ys_loop, axis=1)
+    # EXACT equality — the two modes are the same compiled step body
+    assert (numpy.asarray(ys_scan) == ys_loop).all(), \
+        "%s scan vs recurrent outputs differ" % unit_cls.__name__
+    for k in st_scan:
+        assert (numpy.asarray(st_scan[k])
+                == numpy.asarray(st[k])).all(), \
+            "%s final state %r differs" % (unit_cls.__name__, k)
+    return u, params, x, st0, scan
+
+
+def test_lstm_scan_vs_recurrent_bit_identity():
+    _bit_identity_check(nn.LSTM, (2, 9, 5), hidden_size=4,
+                        return_sequences=True)
+
+
+def test_rnn_scan_vs_recurrent_bit_identity():
+    _bit_identity_check(nn.RNN, (2, 7, 5), hidden_size=4,
+                        return_sequences=True)
+
+
+def test_ssm_scan_vs_recurrent_bit_identity():
+    _bit_identity_check(nn.SSMBlock, (2, 9, 8), n_heads=4)
+
+
+def test_padded_scan_state_bit_identical():
+    """length= masking: an (B, T_pad) scan over garbage tail tokens
+    must carry EXACTLY the state of the unpadded scan — the chunked
+    prefill's correctness hinges on this."""
+    import jax
+    import jax.numpy as jnp
+    for unit_cls, kwargs, d in ((nn.LSTM,
+                                 {"hidden_size": 4,
+                                  "return_sequences": True}, 5),
+                                (nn.SSMBlock, {"n_heads": 2}, 8)):
+        wf, u, x = _built_unit(unit_cls, (2, 8, d), **kwargs)
+        params = _params_of(u)
+        st0 = u.init_state(2, jnp.float32)
+        scan = jax.jit(functools.partial(u.scan_state))
+        n_real = 5
+        _, st_ref = scan(params, jnp.asarray(x[:, :n_real, :]), st0)
+        # garbage tail: huge values would poison state if the mask
+        # leaked
+        x_pad = x.copy()
+        x_pad[:, n_real:, :] = 1e6
+        _, st_pad = scan(params, jnp.asarray(x_pad), st0,
+                         jnp.int32(n_real))
+        for k in st_ref:
+            assert (numpy.asarray(st_ref[k])
+                    == numpy.asarray(st_pad[k])).all(), \
+                "%s padded state %r differs" % (unit_cls.__name__, k)
+
+
+def test_state_shapes_match_init_state():
+    import jax.numpy as jnp
+    for unit_cls, kwargs, d in ((nn.LSTM, {"hidden_size": 6}, 5),
+                                (nn.RNN, {"hidden_size": 6}, 5),
+                                (nn.SSMBlock, {"n_heads": 2}, 8)):
+        wf, u, x = _built_unit(unit_cls, (3, 4, d), **kwargs)
+        st = u.init_state(3, jnp.float32)
+        shapes = u.state_shapes(3)
+        assert set(st) == set(shapes)
+        for k in st:
+            assert tuple(st[k].shape) == tuple(shapes[k])
+
+
+def test_ssm_oracle():
+    """XLA scan path vs the numpy oracle (run_both analog, kept here
+    with the rest of the recurrent family)."""
+    wf, u, x = _built_unit(nn.SSMBlock, (2, 6, 8), n_heads=2)
+    u.xla_run()
+    y_xla = numpy.asarray(u.output.map_read(), numpy.float32)
+    y_np = u.numpy_apply(u.params_np(), x).astype(numpy.float32)
+    numpy.testing.assert_allclose(y_xla, y_np, rtol=1e-4, atol=1e-5)
+
+
+def test_ssm_rejects_bad_heads():
+    from veles_tpu.error import VelesError
+    wf = vt.Workflow(name="t")
+    u = nn.SSMBlock(wf, n_heads=3)
+    u.input = Array(numpy.zeros((2, 4, 8), numpy.float32), name="x")
+    with pytest.raises(VelesError):
+        u.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))

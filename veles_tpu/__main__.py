@@ -92,6 +92,9 @@ def main(argv=None) -> int:
             args.serve_prefix_cache == "on"
     if args.serve_prefill_chunk is not None:
         _root.common.serving.prefill_chunk = args.serve_prefill_chunk
+    if args.serve_state_cache is not None:
+        _root.common.serving.state_cache = \
+            args.serve_state_cache == "on"
     if args.serve_stream is not None:
         _root.common.serving.stream = args.serve_stream == "on"
     if args.serve_drain_grace is not None:

@@ -114,13 +114,17 @@ def make_parser() -> argparse.ArgumentParser:
                         "restores the wait-out-the-grace drain "
                         "(root.common.serving.drain_handoff)")
     p.add_argument("--serve-engine", default=None,
-                   choices=("continuous", "window"),
+                   choices=("continuous", "recurrent", "window"),
                    help="decode plane under --serve-generate: "
                         "'continuous' (default) runs the slot-pool "
                         "continuous-batching engine (greedy/sample "
                         "requests share one fixed-shape decode step, "
-                        "admitted/retired per iteration); 'window' "
-                        "keeps the legacy shape-keyed micro-batcher")
+                        "admitted/retired per iteration; recurrent "
+                        "LM stacks auto-route to the O(1)-state "
+                        "pool); 'recurrent' pins the O(1)-state pool "
+                        "(fixed per-slot state, pageless admission); "
+                        "'window' keeps the legacy shape-keyed "
+                        "micro-batcher")
     p.add_argument("--serve-slots", type=int, default=None, metavar="N",
                    help="KV-cache slot rows of the continuous-batching "
                         "pool (root.common.serving.max_slots)")
@@ -175,6 +179,16 @@ def make_parser() -> argparse.ArgumentParser:
                         "per-tick decode stall a long admission "
                         "causes (root.common.serving.prefill_chunk; "
                         "0 = monolithic)")
+    p.add_argument("--serve-state-cache", default=None,
+                   choices=("on", "off"),
+                   help="state-checkpoint prefix cache of the O(1)-"
+                        "state lane: prefill snapshots the recurrent "
+                        "state every page-size tokens into a radix "
+                        "index; a same-prefix admission adopts the "
+                        "deepest snapshot copy-on-write and scans "
+                        "only the suffix "
+                        "(root.common.serving.state_cache; answers "
+                        "bit-identical on or off)")
     p.add_argument("--serve-stream", default=None,
                    choices=("on", "off"),
                    help="honor stream=true requests with SSE "

@@ -261,8 +261,15 @@ def _default_root() -> Config:
         # continuous-batching serving engine (veles_tpu/serving/,
         # docs/services.md "Continuous batching"): GenerationAPI's
         # decode plane — a persistent max_slots-row KV-cache pool with
-        # iteration-level scheduling. "window" falls back to the
-        # legacy shape-keyed coalescing worker.
+        # iteration-level scheduling. "recurrent" pins the O(1)-state
+        # slot pool (serving/recurrent.py — fixed per-slot recurrent
+        # state instead of a page table; "continuous" auto-falls-back
+        # to it for Embedding→LSTM/SSM→LMHead stacks). "window" falls
+        # back to the legacy shape-keyed coalescing worker. The O(1)
+        # lane's own knobs ride this block too: state_cache (bool,
+        # default False — the state-checkpoint prefix cache) and
+        # state_cache_blocks (soft LRU budget, 0/None = unbounded);
+        # page_size doubles as its checkpoint interval.
         "serving": {
             "engine": "continuous",
             # KV-cache slot rows decoded by the one fixed-shape step

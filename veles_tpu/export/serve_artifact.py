@@ -44,8 +44,13 @@ from ..error import VelesError
 #: per-slot shared-page count whose write-back masks adopted prefix
 #: pages to the sink (signature also stamps the prefix_cache /
 #: prefill_chunk knobs); v2 artifacts fail the signature check and
-#: fall back to live jit
-ARTIFACT_VERSION = 3
+#: fall back to live jit.
+#: v4: the O(1)-state serving lane — recurrent stacks export the
+#: chunk-scan ("rscan") and recurrent decode ("rstep") programs whose
+#: pool is per-slot STATE tensors instead of paged KV (signature kind
+#: "recurrent" stamps the state leaf shapes); paged artifacts are
+#: unchanged, so v3 paged artifacts still load
+ARTIFACT_VERSION = 4
 
 
 def _specs_of(tree):
@@ -76,19 +81,32 @@ def export_serve_artifact(workflow, path: str,
     from ..serving.engine import ContinuousEngine
 
     serving_cfg = root.common.serving
-    engine = ContinuousEngine(
-        workflow,
-        max_slots=int(max_slots if max_slots is not None
-                      else serving_cfg.get("max_slots", 8)),
-        buckets=(buckets if buckets is not None
-                 else serving_cfg.get("buckets", [16, 32, 64, 128])),
-        max_context=int(max_context if max_context is not None
-                        else serving_cfg.get("max_context", 640)),
-        decode_block=int(decode_block if decode_block is not None
-                         else serving_cfg.get("decode_block", 1)),
-        page_size=page_size, pages=pages,
-        quant_weights=quant_weights, quant_kv=quant_kv,
-        name="serve_artifact_export")
+    knobs = {
+        "max_slots": int(max_slots if max_slots is not None
+                         else serving_cfg.get("max_slots", 8)),
+        "max_context": int(max_context if max_context is not None
+                           else serving_cfg.get("max_context", 640)),
+        "decode_block": int(decode_block if decode_block is not None
+                            else serving_cfg.get("decode_block", 1)),
+    }
+    try:
+        engine = ContinuousEngine(
+            workflow,
+            buckets=(buckets if buckets is not None
+                     else serving_cfg.get("buckets",
+                                          [16, 32, 64, 128])),
+            page_size=page_size, pages=pages,
+            quant_weights=quant_weights, quant_kv=quant_kv,
+            name="serve_artifact_export", **knobs)
+    except VelesError:
+        # not a transformer LM chain — a recurrent stack (Embedding →
+        # LSTM/SSM → LMHead) exports the O(1)-state lane's two
+        # programs instead, same fallback order as GenerationAPI
+        from ..serving.recurrent import RecurrentEngine
+        return _export_recurrent(
+            RecurrentEngine(workflow, page_size=page_size,
+                            name="serve_artifact_export", **knobs),
+            workflow, path)
     signature = engine.stack_signature()
     params = engine._prepare_params()
     engine._ensure_pool(params)
@@ -135,6 +153,63 @@ def export_serve_artifact(workflow, path: str,
         # program-only package: params stay RUNTIME inputs (the
         # artifact survives further training), so no unit tensors ride
         # along — package_import still reads it (empty unit list)
+        "units": [],
+        "serving": {
+            "artifact_version": ARTIFACT_VERSION,
+            "jax_version": jax.__version__,
+            "signature": signature,
+            "programs": programs,
+        },
+    }
+    with open(os.path.join(path, "contents.json"), "w") as fout:
+        json.dump(contents, fout, indent=2)
+    return path
+
+
+def _export_recurrent(engine, workflow, path: str) -> str:
+    """Export the O(1)-state lane's program pair: the ``page_size``-
+    token chunk scan (``rscan``) and the recurrent decode step
+    (``rstep``). The pool inputs are the engine's per-slot state
+    pytree — fixed shapes whatever the context, which is exactly why
+    this artifact stays valid for any prompt length."""
+    import jax
+    import jax.numpy as jnp
+    from jax import export as jexport
+    signature = engine.stack_signature()
+    from ..nn.sampling import params_of
+    params = params_of(workflow)
+    engine._ensure_pool(params)
+    params_spec = _specs_of(params)
+    states_spec = _specs_of(engine._states)
+    slots = engine.max_slots
+    keys_spec = jax.ShapeDtypeStruct((slots, 2), jnp.uint32)
+    seed_spec = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    svec = jax.ShapeDtypeStruct((slots,), jnp.int32)
+    i32 = jax.ShapeDtypeStruct((), jnp.int32)
+    f32 = jax.ShapeDtypeStruct((), jnp.float32)
+
+    os.makedirs(path, exist_ok=True)
+    programs: Dict[str, str] = {}
+    exported = jexport.export(engine._build_scan_chunk())(
+        params_spec,
+        jax.ShapeDtypeStruct((engine.page_size,), jnp.int32),
+        i32, i32, f32, seed_spec, i32, keys_spec, states_spec)
+    with open(os.path.join(path, "serve_rscan.bin"), "wb") as fout:
+        fout.write(exported.serialize())
+    programs["rscan"] = "serve_rscan.bin"
+    exported = jexport.export(engine._build_decode())(
+        params_spec, svec,
+        jax.ShapeDtypeStruct((slots,), jnp.float32),
+        svec, keys_spec, states_spec)
+    with open(os.path.join(path, "serve_rstep.bin"), "wb") as fout:
+        fout.write(exported.serialize())
+    programs["rstep"] = "serve_rstep.bin"
+
+    from .package import required_format_version
+    contents = {
+        "format_version": required_format_version(serving=True),
+        "workflow": workflow.name,
+        "checksum": workflow.checksum(),
         "units": [],
         "serving": {
             "artifact_version": ARTIFACT_VERSION,
@@ -195,13 +270,21 @@ def load_serve_programs(path: str, expect_signature: Dict
             key = ("step", None)
         elif label.startswith("prefill_"):
             key = ("prefill", int(label[len("prefill_"):]))
+        elif label == "rscan":
+            # O(1)-state lane (v4): the chunked prefill scan
+            key = ("scan", None)
+        elif label == "rstep":
+            key = ("step", None)
         else:
             raise VelesError("serve-artifact %s: unknown program "
                              "label %r" % (path, label))
         programs[key] = exported.call
-    want = {("prefill", b)
-            for b in expect_signature.get("buckets", ())}
-    want.add(("step", None))
+    if expect_signature.get("kind") == "recurrent":
+        want = {("scan", None), ("step", None)}
+    else:
+        want = {("prefill", b)
+                for b in expect_signature.get("buckets", ())}
+        want.add(("step", None))
     missing = want - set(programs)
     if missing:
         raise VelesError("serve-artifact %s is missing programs: %s"

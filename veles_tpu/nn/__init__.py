@@ -26,7 +26,8 @@ from .evaluator import EvaluatorSoftmax, EvaluatorMSE  # noqa
 from .decision import DecisionGD, DecisionMSE  # noqa
 from .lr_adjust import (LearningRateAdjust, step_exp, inv,  # noqa
                         exp_decay, warmup_cosine)
-from .rnn import LSTM, RNN  # noqa
+from .rnn import LSTM, RNN, GDLSTM, GDRNN  # noqa
+from .ssm import SSMBlock, GDSSMBlock  # noqa
 from .kohonen import KohonenForward, KohonenTrainer  # noqa
 from .rbm import RBM, RBMTrainer  # noqa
 from .cutter import Cutter  # noqa

@@ -57,20 +57,50 @@ class LSTM(ForwardBase):
         return {"weights": Array(w, name=self.name + ".weights"),
                 "bias": Array(b, name=self.name + ".bias")}
 
+    # -- recurrent protocol (shared with nn/ssm.py — the O(1)-state
+    # serving lane's uniform surface: serving/recurrent.py drives any
+    # unit exposing init_state/step_state/scan_state) ----------------------
+    def state_shapes(self, batch: int) -> Dict[str, tuple]:
+        return {"h": (batch, self.hidden_size),
+                "c": (batch, self.hidden_size)}
+
+    def init_state(self, batch: int, dtype) -> Dict:
+        import jax.numpy as jnp
+        return {k: jnp.zeros(shape, dtype)
+                for k, shape in self.state_shapes(batch).items()}
+
+    def step_state(self, params, x_t, state):
+        (h, c), y = self._step(params, (state["h"], state["c"]), x_t)
+        return y, {"h": h, "c": c}
+
+    def scan_state(self, params, x, state, length=None):
+        from .ssm import recurrent_scan
+        return recurrent_scan(self, params, x, state, length)
+
     # gate order: i, f, g, o
     def _step(self, params, carry, x_t):
-        import jax
         import jax.numpy as jnp
         from ..ops import matmul_precision
+        from .ssm import stable_sigmoid
         h_prev, c_prev = carry
-        z = jnp.dot(jnp.concatenate([x_t, h_prev], axis=-1),
-                    params["weights"],
-                    precision=matmul_precision()) + params["bias"]
+        # the gate GEMM is written SPLIT (x@Wx + h@Wh), not as
+        # dot(concat([x, h]), W): inside a lax.scan XLA rewrites the
+        # concat form into the split form anyway (to hoist x@Wx out of
+        # the loop), which re-associates the K-dim accumulation and
+        # breaks bit-identity against the standalone step program. The
+        # split form compiles to the same accumulation chains in both
+        # modes — the serving lane's scan ↔ recurrence id-exactness
+        # (tests/test_rnn.py) depends on this; stable_sigmoid likewise
+        d = x_t.shape[-1]
+        prec = matmul_precision()
+        z = (jnp.dot(x_t, params["weights"][:d], precision=prec)
+             + jnp.dot(h_prev, params["weights"][d:], precision=prec)
+             + params["bias"])
         i, f, g, o = jnp.split(z, 4, axis=-1)
-        i = jax.nn.sigmoid(i)
-        f = jax.nn.sigmoid(f + self.forget_bias)
+        i = stable_sigmoid(i)
+        f = stable_sigmoid(f + self.forget_bias)
         g = jnp.tanh(g)
-        o = jax.nn.sigmoid(o)
+        o = stable_sigmoid(o)
         c = f * c_prev + i * g
         h = o * jnp.tanh(c)
         return (h, c), h
@@ -139,20 +169,43 @@ class RNN(ForwardBase):
                 "bias": Array(numpy.zeros((h,), dtype=dtype),
                               name=self.name + ".bias")}
 
+    # -- recurrent protocol (see LSTM above / nn/ssm.py) ----------------------
+    def state_shapes(self, batch: int) -> Dict[str, tuple]:
+        return {"h": (batch, self.hidden_size)}
+
+    def init_state(self, batch: int, dtype) -> Dict:
+        import jax.numpy as jnp
+        return {"h": jnp.zeros((batch, self.hidden_size), dtype)}
+
+    def _step(self, params, h, x_t):
+        import jax.numpy as jnp
+        from ..ops import matmul_precision
+        # split GEMM for scan ↔ step bit-identity — see LSTM._step
+        d = x_t.shape[-1]
+        prec = matmul_precision()
+        z = (jnp.dot(x_t, params["weights"][:d], precision=prec)
+             + jnp.dot(h, params["weights"][d:], precision=prec)
+             + params["bias"])
+        h_new = jnp.tanh(z)
+        return h_new, h_new
+
+    def step_state(self, params, x_t, state):
+        h, y = self._step(params, state["h"], x_t)
+        return y, {"h": h}
+
+    def scan_state(self, params, x, state, length=None):
+        from .ssm import recurrent_scan
+        return recurrent_scan(self, params, x, state, length)
+
     def apply(self, params, x, *, train=False, rng=None):
         import jax
         import jax.numpy as jnp
-        from ..ops import matmul_precision
         b = x.shape[0]
         h0 = jnp.zeros((b, self.hidden_size), dtype=x.dtype)
         xs = jnp.swapaxes(x, 0, 1)
 
         def body(h, x_t):
-            z = jnp.dot(jnp.concatenate([x_t, h], axis=-1),
-                        params["weights"],
-                        precision=matmul_precision()) + params["bias"]
-            h_new = jnp.tanh(z)
-            return h_new, h_new
+            return self._step(params, h, x_t)
         h_last, hs = jax.lax.scan(body, h0, xs)
         if self.return_sequences:
             return jnp.swapaxes(hs, 0, 1)

@@ -1,0 +1,227 @@
+"""SSD/linear-attention block with scan ↔ recurrence duality.
+
+The O(1)-state model lane (PAPERS.md "Compiler-First State Space
+Duality and Portable O(1) Autoregressive Caching for Inference"): the
+same weights run as a chunked parallel scan for training/prefill and
+as a constant-state per-token recurrence for decode. The duality here
+is COMPILER-FIRST — there is exactly ONE per-token step body
+(:meth:`SSMBlock.step_state`); "scan mode" is ``jax.lax.scan`` of that
+body and "recurrent mode" is a single application of it, so the two
+modes cannot drift numerically: bit-identity is structural, not a
+tolerance. (A chunked-quadratic reformulation would be faster on long
+prefills but is NOT bit-exact against the recurrence — this repo's
+serving plane stakes id-exactness on every path, so it is deliberately
+not offered.)
+
+Per head ``h`` with head dim ``e`` the state is an ``e x e`` matrix
+``S`` updated by a learned scalar decay ``a_h = sigmoid(a_log_h)``::
+
+    S_t = a_h * S_{t-1} + k_t ⊗ v_t          # (e, e) outer product
+    y_t = (q_t · S_t) / sqrt(e)              # linear-attention read
+    out = (concat_h y_t * sigmoid(x_t W_g)) W_o
+    x_t ← x_t + out                          # residual, shape-preserving
+
+so a decode step touches ``heads x e x e`` state floats per slot —
+O(1) in sequence length, vs the transformer's O(context) KV rows.
+
+The uniform recurrent protocol (``init_state`` / ``step_state`` /
+``scan_state``) is shared with ``nn/rnn.py``'s LSTM/RNN, which is what
+lets ``serving/recurrent.py`` host either family on the same
+fixed-shape slot programs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy
+
+from ..config import root
+from ..error import VelesError
+from ..memory import Array
+from .. import prng
+from .nn_units import ForwardBase, GradientDescentBase, matches
+
+
+def stable_sigmoid(v):
+    """``sigmoid`` written out as ``0.5 * (tanh(v/2) + 1)``. XLA
+    expands ``lax.logistic`` differently depending on the surrounding
+    fusion (observed on CPU: a sigmoid*tanh product drifts ~1 ULP
+    between a ``lax.scan`` body and the identical standalone step
+    program), which would break the serving lane's scan ↔ recurrence
+    bit-identity. The explicit tanh form compiles to the same chain in
+    every program; every recurrent-unit gate goes through here."""
+    import jax.numpy as jnp
+    return 0.5 * (jnp.tanh(0.5 * v) + 1.0)
+
+
+def mask_keep(keep, new, old):
+    """``where(keep, new, old)`` with ``keep`` broadcast over state
+    leaves: a scalar applies to the whole leaf, a ``(B,)`` row mask
+    broadcasts over each leaf's trailing dims. Masked-OUT positions
+    keep the old state BIT-UNTOUCHED — padding a sequence can never
+    perturb the carried state, which is what makes the serving lane's
+    fixed-width chunk scan id-exact vs the unpadded recurrence."""
+    import jax.numpy as jnp
+    k = jnp.asarray(keep)
+    if k.ndim:
+        k = k.reshape(k.shape + (1,) * (new.ndim - k.ndim))
+    return jnp.where(k, new, old)
+
+
+def recurrent_scan(unit, params, x, state, length=None):
+    """``jax.lax.scan`` of ``unit.step_state`` over time — THE shared
+    scan-mode driver for every recurrent unit (SSMBlock, LSTM, RNN).
+    ``x`` is (B, T, D); ``length`` (scalar or (B,) int) masks the
+    state update for positions ``t >= length`` so fixed-shape padded
+    scans carry exactly the state the unpadded sequence would.
+    Returns ``(ys (B, T, H_out), final state)``."""
+    import jax
+    import jax.numpy as jnp
+    xs = jnp.swapaxes(x, 0, 1)                  # (T, B, D)
+    idx = jnp.arange(x.shape[1])
+
+    def body(st, inp):
+        x_t, t = inp
+        y, st2 = unit.step_state(params, x_t, st)
+        if length is not None:
+            keep = t < length
+            st2 = jax.tree_util.tree_map(
+                lambda new, old: mask_keep(keep, new, old), st2, st)
+        return st2, y
+
+    state, ys = jax.lax.scan(body, state, (xs, idx))
+    return jnp.swapaxes(ys, 0, 1), state
+
+
+class SSMBlock(ForwardBase):
+    """Gated linear-attention (SSD) block: input (B, T, D) → output
+    (B, T, D), residual. ``n_heads`` must divide D; each head carries
+    an (D/n_heads)² state matrix with its own learned scalar decay."""
+
+    MAPPING = "ssm_block"
+    PARAMETERIZED = True
+    hide_from_registry = False
+    PARAM_NAMES = ("wq", "wk", "wv", "wg", "wo", "a_log")
+    LORA_TARGETS = ()
+
+    def __init__(self, workflow, n_heads=4, decay_min=0.6,
+                 decay_max=0.95, **kwargs):
+        super().__init__(workflow, **kwargs)
+        self.n_heads = int(n_heads)
+        if self.n_heads < 1:
+            raise VelesError("ssm_block needs n_heads >= 1")
+        #: decay init range: heads start spread over [decay_min,
+        #: decay_max] so short- and long-memory heads coexist at step 0
+        self.decay_min = float(decay_min)
+        self.decay_max = float(decay_max)
+        self.weights_stddev = kwargs.get("weights_stddev", None)
+
+    def output_shape_for(self, input_shape):
+        return tuple(input_shape)
+
+    # -- params ---------------------------------------------------------------
+    def create_params(self, rng: prng.RandomGenerator) -> Dict[str, Array]:
+        d = int(self.input.shape[-1])
+        if d % self.n_heads:
+            raise VelesError(
+                "ssm_block dim %d not divisible by n_heads %d"
+                % (d, self.n_heads))
+        self.dim = d
+        dtype = root.common.engine.precision_type
+        stddev = self.weights_stddev or (1.0 / numpy.sqrt(d))
+        out: Dict[str, Array] = {}
+        for k in ("wq", "wk", "wv", "wg", "wo"):
+            w = numpy.zeros((d, d), dtype=dtype)
+            prng.get("%s.%s" % (self.name, k)).fill_normal(w, stddev)
+            out[k] = Array(w, name="%s.%s" % (self.name, k))
+        # a_h = sigmoid(a_log_h) spread over the decay range — a
+        # DETERMINISTIC init (like forget_bias): the decay spectrum is
+        # a design choice, not noise
+        a = numpy.linspace(self.decay_min, self.decay_max,
+                           self.n_heads).astype(numpy.float64)
+        a = numpy.clip(a, 1e-4, 1.0 - 1e-4)
+        a_log = numpy.log(a / (1.0 - a)).astype(dtype)
+        out["a_log"] = Array(a_log, name=self.name + ".a_log")
+        return out
+
+    # -- recurrent protocol ---------------------------------------------------
+    def state_shapes(self, batch: int) -> Dict[str, tuple]:
+        """Abstract per-batch state geometry (the serving lane's slot
+        pool and the artifact signature are shaped from this)."""
+        d = getattr(self, "dim", None)
+        if d is None:
+            arrays = self.param_arrays()
+            d = (arrays["wq"].shape[0] if "wq" in arrays
+                 else self.input.shape[-1])
+        d = int(d)
+        hd = d // self.n_heads
+        return {"s": (batch, self.n_heads, hd, hd)}
+
+    def init_state(self, batch: int, dtype) -> Dict:
+        import jax.numpy as jnp
+        return {k: jnp.zeros(shape, dtype)
+                for k, shape in self.state_shapes(batch).items()}
+
+    def step_state(self, params, x_t, state):
+        """ONE token for every row: ``x_t`` (B, D), state ``{"s": (B,
+        H, e, e)}`` → (y_t (B, D), new state). This body IS both
+        modes — scan-mode prefill is ``lax.scan`` of it, recurrent-
+        mode decode is a single application."""
+        import jax.numpy as jnp
+        from ..ops import matmul_precision
+        prec = matmul_precision()
+        b, d = x_t.shape
+        h = self.n_heads
+        hd = d // h
+        q = jnp.dot(x_t, params["wq"], precision=prec).reshape(b, h, hd)
+        k = jnp.dot(x_t, params["wk"], precision=prec).reshape(b, h, hd)
+        v = jnp.dot(x_t, params["wv"], precision=prec).reshape(b, h, hd)
+        a = stable_sigmoid(params["a_log"]).astype(x_t.dtype)   # (H,)
+        s = (a[None, :, None, None] * state["s"]
+             + k[..., :, None] * v[..., None, :])
+        y = jnp.einsum("bhd,bhde->bhe", q, s,
+                       precision=prec) * (1.0 / numpy.sqrt(hd))
+        gate = stable_sigmoid(
+            jnp.dot(x_t, params["wg"], precision=prec))
+        out = jnp.dot(y.reshape(b, d).astype(x_t.dtype) * gate,
+                      params["wo"], precision=prec)
+        return x_t + out, {"s": s}
+
+    def scan_state(self, params, x, state, length=None):
+        return recurrent_scan(self, params, x, state, length)
+
+    # -- the pure function ----------------------------------------------------
+    def apply(self, params, x, *, train=False, rng=None):
+        state = self.init_state(x.shape[0], x.dtype)
+        ys, _ = self.scan_state(params, x, state)
+        return ys
+
+    def numpy_apply(self, params, x):
+        def sig(v):
+            return 1.0 / (1.0 + numpy.exp(-v))
+        b, t, d = x.shape
+        h = self.n_heads
+        hd = d // h
+        a = sig(numpy.asarray(params["a_log"],
+                              numpy.float32))           # (H,)
+        s = numpy.zeros((b, h, hd, hd), numpy.float32)
+        ys = numpy.zeros((b, t, d), numpy.float32)
+        for step in range(t):
+            x_t = x[:, step, :].astype(numpy.float32)
+            q = (x_t @ params["wq"]).reshape(b, h, hd)
+            k = (x_t @ params["wk"]).reshape(b, h, hd)
+            v = (x_t @ params["wv"]).reshape(b, h, hd)
+            s = (a[None, :, None, None] * s
+                 + k[..., :, None] * v[..., None, :])
+            y = numpy.einsum("bhd,bhde->bhe", q, s) \
+                / numpy.sqrt(hd)
+            gate = sig(x_t @ params["wg"])
+            ys[:, step, :] = x_t + (y.reshape(b, d) * gate) \
+                @ params["wo"]
+        return ys
+
+
+@matches(SSMBlock)
+class GDSSMBlock(GradientDescentBase):
+    MAPPING = "gd_ssm_block"

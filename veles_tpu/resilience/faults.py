@@ -171,6 +171,24 @@ for _name, _desc in (
                       "(raise = that ticket's handoff degrades to a "
                       "plain 503 shed without resume progress; the "
                       "drain itself always completes)"),
+    # O(1)-state serving lane (serving/recurrent.py): chaos for the
+    # state-checkpoint prefix cache — a lost/rotten checkpoint must
+    # cost a re-scan, never a wrong state
+    ("serve.state_restore", "O(1)-state checkpoint lookup at "
+                            "admission (raise = injected checkpoint "
+                            "loss: degrades to a full re-scan from "
+                            "zeros, counted; corrupt = injected "
+                            "index rot: degrades to a shorter/empty "
+                            "match — token equality is the match "
+                            "authority, so adopted state is never "
+                            "wrong)"),
+    ("serve.state_checkpoint", "O(1)-state block-boundary snapshot "
+                               "insert after prefill (raise = the "
+                               "scanned prompt is NOT cached with a "
+                               "counted warning — the request is "
+                               "already answered from live state, so "
+                               "only future same-prefix admissions "
+                               "pay a re-scan)"),
 ):
     register_point(_name, _desc)
 

@@ -300,7 +300,8 @@ class GenerationAPI(Unit):
                  quant_weights: bool = None, quant_kv: bool = None,
                  artifact: str = None,
                  prefix_cache: bool = None,
-                 prefill_chunk: int = None, **kwargs) -> None:
+                 prefill_chunk: int = None,
+                 state_cache: bool = None, **kwargs) -> None:
         super().__init__(workflow, **kwargs)
         self.view_group = "SERVICE"
         #: the TARGET model workflow is the unit's own workflow; an
@@ -353,6 +354,10 @@ class GenerationAPI(Unit):
         # root.common.serving.stream
         self.prefix_cache = prefix_cache
         self.prefill_chunk = prefill_chunk
+        # O(1)-state lane knob (docs/services.md "O(1)-state
+        # serving"): None defers to root.common.serving.state_cache
+        # inside the RecurrentEngine
+        self.state_cache = state_cache
         self._engine = None
         self._service: Optional[HTTPService] = None
         #: serializes initialize()/stop(): a supervisor respawning a
@@ -635,6 +640,22 @@ class GenerationAPI(Unit):
                     self.requests_served += len(reqs)
 
     # -- lifecycle -----------------------------------------------------------
+    def _build_recurrent_engine(self):
+        """Start the O(1)-state slot pool (serving/recurrent.py) for
+        this API's workflow — raises :class:`VelesError` when the
+        stack is not a recurrent LM chain (callers degrade)."""
+        from .serving import RecurrentEngine
+        engine = RecurrentEngine(
+            self.workflow, max_slots=self.max_slots,
+            max_context=self.max_context,
+            decode_block=self.decode_block,
+            page_size=self.page_size,
+            state_cache=self.state_cache,
+            artifact=self.artifact,
+            name=self.name).start()
+        engine.on_death = self._on_replica_death
+        return engine
+
     def initialize(self, **kwargs):
         with self._lifecycle:
             return self._initialize_locked(**kwargs)
@@ -645,6 +666,18 @@ class GenerationAPI(Unit):
             return res
         if self._service is not None:
             return None
+        if self.engine_kind == "recurrent" and self._engine is None:
+            # operator pinned the O(1)-state lane: a non-recurrent
+            # stack degrades to the window worker (same answers, no
+            # in-flight batching) exactly like the continuous path's
+            # VelesError degrade; geometry ValueErrors still propagate
+            try:
+                self._engine = self._build_recurrent_engine()
+            except VelesError as e:
+                self.warning("%s: O(1)-state serving unavailable "
+                             "(%s); serving via the window worker",
+                             self.name, e)
+                self._engine = None
         if self.engine_kind == "continuous" and self._engine is None:
             from .serving import ContinuousEngine
             try:
@@ -670,17 +703,24 @@ class GenerationAPI(Unit):
                 # must not join itself through engine.stop()
                 self._engine.on_death = self._on_replica_death
             except VelesError as e:
-                # a stack the slot pool cannot serve (non-LM workflow)
-                # degrades to the window worker — same answers, just no
-                # in-flight batching. Knob-geometry mistakes (bucket >
-                # max_context, max_slots < 1) raise ValueError and
-                # PROPAGATE: the operator asked for continuous batching
-                # and must not silently get the per-shape-compiling
-                # worker instead.
-                self.warning("%s: continuous batching unavailable "
-                             "(%s); serving via the window worker",
-                             self.name, e)
-                self._engine = None
+                # a stack the paged pool cannot serve may still be a
+                # recurrent LM (Embedding → LSTM/SSM → LMHead): try
+                # the O(1)-state slot pool before degrading to the
+                # window worker — same request plane, pageless slots.
+                # Knob-geometry mistakes (bucket > max_context,
+                # max_slots < 1) raise ValueError and PROPAGATE: the
+                # operator asked for slot-pool batching and must not
+                # silently get the per-shape-compiling worker instead.
+                try:
+                    self._engine = self._build_recurrent_engine()
+                    self.info("%s: recurrent stack (paged pool said: "
+                              "%s); serving via the O(1)-state slot "
+                              "pool", self.name, e)
+                except VelesError:
+                    self.warning("%s: continuous batching unavailable "
+                                 "(%s); serving via the window worker",
+                                 self.name, e)
+                    self._engine = None
         api = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -726,19 +766,6 @@ class GenerationAPI(Unit):
                             "veles_serving_queue_depth":
                                 st["queue_depth"],
                             "veles_serving_programs": st["programs"],
-                            # paged-pool occupancy (serving/pages.py):
-                            # the gauges an operator sizes pages/
-                            # page_size with — fragmentation is the
-                            # allocated-but-unoccupied fraction of
-                            # in-use pages (tail-of-page waste)
-                            "veles_serving_pages_total":
-                                st["pages_total"],
-                            "veles_serving_pages_in_use":
-                                st["pages_in_use"],
-                            "veles_serving_page_size":
-                                st["page_size"],
-                            "veles_serving_page_fragmentation":
-                                st["page_fragmentation"],
                             # quantization/AOT mode gauges (veles_tpu/
                             # quant/): 1 = the plane is active on this
                             # engine — dashboards must know whether a
@@ -764,6 +791,41 @@ class GenerationAPI(Unit):
                             "veles_serving_prefill_stall_seconds":
                                 st["prefill_stall_seconds"],
                         })
+                        if st.get("slot_kind", "paged") != "state":
+                            # paged-pool occupancy (serving/pages.py):
+                            # the gauges an operator sizes pages/
+                            # page_size with — fragmentation is the
+                            # allocated-but-unoccupied fraction of
+                            # in-use pages (tail-of-page waste).
+                            # Rendered ONLY for paged engines: a
+                            # pageless O(1)-state replica must never
+                            # put zero rows into the fleet's page math
+                            gauges.update({
+                                "veles_serving_pages_total":
+                                    st["pages_total"],
+                                "veles_serving_pages_in_use":
+                                    st["pages_in_use"],
+                                "veles_serving_page_size":
+                                    st["page_size"],
+                                "veles_serving_page_fragmentation":
+                                    st["page_fragmentation"],
+                            })
+                        else:
+                            # O(1)-state lane occupancy (serving/
+                            # recurrent.py): per-slot state HBM is
+                            # CONSTANT in sequence length — the
+                            # gauges an operator sizes max_slots and
+                            # the state-cache budget with
+                            gauges.update({
+                                "veles_o1_state_bytes_per_slot":
+                                    st["state_bytes_per_slot"],
+                                "veles_o1_state_cache_blocks":
+                                    st["state_cache_blocks"],
+                                "veles_o1_state_cache_bytes":
+                                    st["state_cache_bytes"],
+                                "veles_o1_checkpoint_interval":
+                                    st["page_size"],
+                            })
                     # elastic training plane (resilience/elastic.py):
                     # generation/world-size gauges ride this surface
                     # too (a training host can serve status while

@@ -40,7 +40,10 @@ from .scheduler import (SlotScheduler, Ticket,        # noqa: F401
                         request_tracing_enabled)
 from .engine import (ContinuousEngine,                # noqa: F401
                      advanced_prng_key, fold_resume)
-from .pages import PagePool, PrefixCache              # noqa: F401
+from .pages import PagePool, PrefixCache, StateCache  # noqa: F401
+from .recurrent import (RecurrentEngine,               # noqa: F401
+                        generate_recurrent,
+                        split_recurrent_stack)
 from .journal import RequestJournal                   # noqa: F401
 from .router import (CircuitBreaker, FleetRouter,     # noqa: F401
                      ROUTER_COUNTERS, Replica, ReplicaSupervisor)
@@ -90,6 +93,19 @@ SERVING_COUNTERS = (
     "veles_serving_pages_exhausted_total",
     "veles_serving_spec_rounds_total",
     "veles_serving_beam_steps_total",
+)
+
+#: every counter the O(1)-state serving lane increments (recurrent
+#: slot pool + state-checkpoint prefix cache, serving/recurrent.py) —
+#: registered with HELP strings in telemetry/counters.py DESCRIPTIONS
+#: and asserted zero in non-recurrent runs by ``python bench.py
+#: gate``'s o1state section
+O1_COUNTERS = (
+    "veles_o1_state_checkpoints_total",
+    "veles_o1_state_restores_total",
+    "veles_o1_state_restored_tokens_total",
+    "veles_o1_state_rescans_total",
+    "veles_o1_state_evictions_total",
 )
 
 #: every latency histogram the request-plane SLO layer records

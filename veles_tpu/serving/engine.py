@@ -625,6 +625,12 @@ class ContinuousEngine(Logger):
             "admitted": self.admitted,
             "retired": self.retired,
             "programs": len(self._progs),
+            # slot-kind discriminator: "paged" rows page a KV pool;
+            # the O(1) lane (serving/recurrent.py) reports "state" and
+            # the /metrics renderers emit veles_serving_pages_* rows
+            # ONLY for paged engines, so fleet page math never mixes
+            # kinds
+            "slot_kind": "paged",
             # paged-pool occupancy (serving/pages.py): what an
             # operator sizes `pages`/`page_size` with
             "pages_total": self.pages,

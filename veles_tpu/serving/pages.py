@@ -393,3 +393,208 @@ class PrefixCache:
         with self._lock:
             return {"blocks": self._blocks,
                     "pages": self._blocks}
+
+
+class _StateNode:
+    """One checkpointed ``page_size``-token block of a recurrent
+    prompt: the exact tokens (the match key — token equality is the
+    authority, same degrade-to-miss contract as :class:`_PrefixNode`)
+    and the HOST snapshot of the recurrent state pytree as it stood
+    AFTER this block was scanned."""
+
+    __slots__ = ("tokens", "state", "nbytes", "children", "parent",
+                 "last_use")
+
+    def __init__(self, tokens: Tuple[int, ...], state, nbytes: int,
+                 parent: Optional["_StateNode"]) -> None:
+        self.tokens = tokens
+        self.state = state
+        self.nbytes = int(nbytes)
+        self.children: Dict[Tuple[int, ...], "_StateNode"] = {}
+        self.parent = parent
+        self.last_use = 0
+
+
+class StateCache:
+    """Prefix cache for the O(1)-state lane: a radix tree over
+    ``page_size``-token blocks whose payload is a STATE SNAPSHOT, not
+    a page.
+
+    A transformer prefix is a range of KV rows, so :class:`PrefixCache`
+    shares pages. A recurrent prefix is fully summarized by the state
+    vector after its last token, so this tree stores one host-side
+    snapshot of the state pytree per block boundary. Admission calls
+    :meth:`match` with the prompt: the deepest matched node's snapshot
+    is adopted COPY-ON-WRITE — the caller uploads it into its slot's
+    state rows and never mutates the host copy — and the slot's scan
+    covers only the unmatched suffix. After prefill the slot's own
+    block-boundary snapshots are :meth:`insert`-ed so the next
+    admission with the same prefix skips the re-scan.
+
+    Snapshots are plain host pytrees (dict of numpy arrays) and own no
+    pool pages — eviction is purely the soft ``max_blocks`` budget,
+    LRU leaves first (counted as ``veles_o1_state_evictions_total``).
+    All mutation happens on the engine's tick thread; the lock exists
+    for the /metrics stats reads."""
+
+    def __init__(self, page_size: int,
+                 max_blocks: Optional[int] = None) -> None:
+        self.page_size = int(page_size)
+        self.max_blocks = int(max_blocks or 0)
+        self._lock = threading.Lock()
+        self._root = _StateNode((), None, 0, None)
+        self._clock = 0
+        self._blocks = 0
+        self._bytes = 0
+
+    def _blocks_of(self, tokens: Sequence[int]) -> List[Tuple[int, ...]]:
+        p = self.page_size
+        n = len(tokens) // p
+        return [tuple(int(t) for t in tokens[i * p:(i + 1) * p])
+                for i in range(n)]
+
+    @staticmethod
+    def _snapshot_bytes(state) -> int:
+        total = 0
+        stack = [state]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, dict):
+                stack.extend(node.values())
+            elif isinstance(node, (list, tuple)):
+                stack.extend(node)
+            else:
+                total += int(getattr(node, "nbytes", 0))
+        return total
+
+    def match(self, tokens: Sequence[int], corrupt=None):
+        """Walk the tree over ``tokens``' full blocks; returns
+        ``(n_tokens_matched, snapshot)`` for the DEEPEST matched node
+        (``(0, None)`` on a miss). Unlike the paged cache there is
+        nothing per-block to adopt — the last boundary's snapshot
+        subsumes all of them.
+
+        ``corrupt`` is the armed ``serve.state_restore`` fault acting
+        on the index: every candidate block key is damaged before the
+        equality check, so a rotten index DEGRADES to a shorter (or
+        empty) match and a longer re-scan — never to a wrong state,
+        because token equality is the authority."""
+        best = None
+        depth = 0
+        with self._lock:
+            node = self._root
+            self._clock += 1
+            for block in self._blocks_of(tokens):
+                key = block
+                if corrupt is not None:
+                    raw = bytearray()
+                    for t in block:
+                        raw += int(t).to_bytes(8, "little", signed=True)
+                    raw = corrupt.corrupt(bytes(raw))
+                    key = tuple(
+                        int.from_bytes(raw[i:i + 8], "little",
+                                       signed=True)
+                        for i in range(0, len(raw) - len(raw) % 8, 8))
+                child = node.children.get(key)
+                if child is None or child.tokens != block:
+                    break
+                child.last_use = self._clock
+                best = child.state
+                depth += self.page_size
+                node = child
+        return depth, best
+
+    def insert(self, tokens: Sequence[int], snapshots) -> int:
+        """Record ``tokens``' full blocks with their block-boundary
+        ``snapshots`` (parallel lists: ``snapshots[i]`` is the state
+        after block i's last token — host pytrees the caller no longer
+        mutates). Blocks already present are only LRU-touched (first
+        writer wins; two identical prefills carry bit-identical states
+        anyway, the scan is deterministic). A ``None`` snapshot marks
+        a block the caller did NOT re-scan (it was adopted from this
+        cache): the existing node is touched, but if eviction dropped
+        it meanwhile the walk stops — a node without a real snapshot
+        must never exist. Returns NEW blocks cached."""
+        blocks = self._blocks_of(tokens)
+        added = 0
+        with self._lock:
+            self._clock += 1
+            node = self._root
+            for i, block in enumerate(blocks):
+                if i >= len(snapshots):
+                    break
+                child = node.children.get(block)
+                if child is None:
+                    if snapshots[i] is None:
+                        break
+                    nbytes = self._snapshot_bytes(snapshots[i])
+                    child = _StateNode(block, snapshots[i], nbytes,
+                                       node)
+                    node.children[block] = child
+                    self._blocks += 1
+                    self._bytes += nbytes
+                    added += 1
+                child.last_use = self._clock
+                node = child
+        if self.max_blocks and self._blocks > self.max_blocks:
+            self.evict()
+        return added
+
+    def _leaves(self) -> List[_StateNode]:
+        out: List[_StateNode] = []
+        stack = [self._root]
+        while stack:
+            node = stack.pop()
+            kids = list(node.children.values())
+            if not kids and node is not self._root:
+                out.append(node)
+            stack.extend(kids)
+        return out
+
+    def evict(self) -> int:
+        """Drop least-recently-used LEAF blocks until the soft block
+        budget holds (a block with cached children anchors their
+        prefix and is never dropped first). Same one-walk heap shape
+        as :meth:`PrefixCache.evict`. Counted per dropped block."""
+        import heapq
+        dropped = 0
+        with self._lock:
+            if not self.max_blocks:
+                return 0
+            heap = [(n.last_use, i, n)
+                    for i, n in enumerate(self._leaves())]
+            heapq.heapify(heap)
+            tie = len(heap)
+            while heap and self._blocks > self.max_blocks:
+                _, _, victim = heapq.heappop(heap)
+                parent = victim.parent
+                if victim.children or parent is None \
+                        or parent.children.get(victim.tokens) \
+                        is not victim:
+                    continue           # stale heap entry
+                parent.children.pop(victim.tokens, None)
+                self._blocks -= 1
+                self._bytes -= victim.nbytes
+                dropped += 1
+                if parent is not self._root and not parent.children:
+                    heapq.heappush(heap, (parent.last_use, tie,
+                                          parent))
+                    tie += 1
+        if dropped:
+            inc("veles_o1_state_evictions_total", dropped)
+        return dropped
+
+    def clear(self) -> None:
+        with self._lock:
+            self._root = _StateNode((), None, 0, None)
+            self._blocks = 0
+            self._bytes = 0
+
+    def state_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {"blocks": self._blocks,
+                    "bytes": self._bytes}

@@ -448,10 +448,18 @@ class SlotScheduler:
     def __init__(self, max_slots: int, buckets: Tuple[int, ...],
                  max_context: int,
                  page_pool: Optional[PagePool] = None,
-                 beam_width: int = 4, spec_gamma: int = 4) -> None:
+                 beam_width: int = 4, spec_gamma: int = 4,
+                 slot_kind: str = "paged") -> None:
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         self.max_slots = int(max_slots)
+        #: what a slot's per-request memory IS: "paged" rows hold a
+        #: page table over the KV pool, "state" rows (the O(1) lane,
+        #: serving/recurrent.py) hold a fixed recurrent-state tensor
+        #: and never touch the page ledger. Stats/metrics key off this
+        #: so a pageless replica's rows never enter the fleet's
+        #: veles_serving_pages_* math
+        self.slot_kind = str(slot_kind)
         self.buckets = tuple(sorted(int(b) for b in buckets))
         self.max_context = int(max_context)
         if self.buckets[-1] > self.max_context:

@@ -241,6 +241,26 @@ DESCRIPTIONS = {
     "veles_prefix_evictions_total":
         "Prefix-cache blocks dropped by LRU leaf eviction (allocator "
         "pressure or the soft block budget)",
+    # O(1)-state serving lane (serving/recurrent.py RecurrentEngine +
+    # serving/pages.py StateCache): bench.py's gate asserts these read
+    # 0 in non-recurrent runs
+    "veles_o1_state_checkpoints_total":
+        "Recurrent state snapshots cached at page_size-token block "
+        "boundaries after a prefill scan (the state lane's prefix-"
+        "cache writes)",
+    "veles_o1_state_restores_total":
+        "Admissions that adopted a cached state checkpoint copy-on-"
+        "write and scanned only the unmatched prompt suffix",
+    "veles_o1_state_restored_tokens_total":
+        "Prompt tokens skipped by adopting state checkpoints instead "
+        "of re-scanning them (the restore savings, summed)",
+    "veles_o1_state_rescans_total":
+        "State restores degraded to a full re-scan from zeros "
+        "(injected serve.state_restore checkpoint loss; answers stay "
+        "correct, only the scan work is repaid)",
+    "veles_o1_state_evictions_total":
+        "State-cache checkpoint blocks dropped by LRU leaf eviction "
+        "(the soft max_blocks budget)",
     # fleet-wide distributed tracing (telemetry/spans.py ring pulls +
     # telemetry/fleet.py cross-process assembly): bench.py's gate
     # asserts these read 0 in non-fleet runs

@@ -227,13 +227,23 @@ class WebStatusServer(Logger):
                             _serving.engines().items()):
                         safe = _re.sub(r"[^A-Za-z0-9_]", "_", ename)
                         st = engine.stats()
-                        for gkey, help_frag in (
-                                ("slots_busy", "busy KV-cache slots"),
-                                ("slots", "total KV-cache slots"),
-                                ("peak_slots",
-                                 "peak concurrent busy slots"),
-                                ("queue_depth", "queued requests"),
-                                ("programs", "jitted programs built"),
+                        paged = st.get("slot_kind", "paged") != "state"
+                        # per-slot-kind rows: the page-ledger gauges
+                        # render ONLY for paged engines — a pageless
+                        # O(1)-state replica (serving/recurrent.py)
+                        # must never inject zero pages_total /
+                        # fragmentation rows into fleet page math
+                        # (aggregators average what they scrape)
+                        rows = [
+                            ("slots_busy", "busy serving slots"),
+                            ("slots", "total serving slots"),
+                            ("peak_slots",
+                             "peak concurrent busy slots"),
+                            ("queue_depth", "queued requests"),
+                            ("programs", "jitted programs built"),
+                        ]
+                        if paged:
+                            rows += [
                                 ("pages_total",
                                  "usable KV-cache pages in the paged "
                                  "pool"),
@@ -246,31 +256,60 @@ class WebStatusServer(Logger):
                                  "allocated-but-unoccupied fraction "
                                  "of in-use pages (tail-of-page "
                                  "waste; shared pages counted once)"),
-                                ("prefix_cache",
-                                 "1 = prefix-sharing page cache on"),
-                                ("prefix_blocks",
-                                 "token blocks held by the prefix "
-                                 "cache"),
-                                ("prefilling",
-                                 "rows mid chunked prefill"),
-                                ("prefill_stall_seconds",
-                                 "worst per-tick decode stall from "
-                                 "prefill work (chunked prefill "
-                                 "bounds this)"),
-                                ("artifact_mode",
-                                 "1 = serving from an AOT artifact "
-                                 "(zero jit compiles)"),
-                                ("quant_weights",
-                                 "1 = int8 weight quantization on"),
-                                ("quant_kv",
-                                 "1 = int8 KV-cache pool on"),
-                                ("kv_pool_bytes",
-                                 "KV-cache pool HBM bytes")):
+                            ]
+                        rows += [
+                            ("prefix_cache",
+                             "1 = prefix-sharing cache on"),
+                            ("prefix_blocks",
+                             "token blocks held by the prefix "
+                             "cache"),
+                            ("prefilling",
+                             "rows mid chunked prefill"),
+                            ("prefill_stall_seconds",
+                             "worst per-tick decode stall from "
+                             "prefill work (chunked prefill "
+                             "bounds this)"),
+                            ("artifact_mode",
+                             "1 = serving from an AOT artifact "
+                             "(zero jit compiles)"),
+                            ("quant_weights",
+                             "1 = int8 weight quantization on"),
+                            ("quant_kv",
+                             "1 = int8 KV-cache pool on"),
+                            ("kv_pool_bytes",
+                             "per-request cache pool HBM bytes "
+                             "(paged KV pool, or the O(1) lane's "
+                             "fixed state pool)"),
+                        ]
+                        for gkey, help_frag in rows:
                             gauges["veles_serving_%s_%s"
                                    % (gkey, safe)] = (
                                 st[gkey],
                                 "Serving engine %s: %s"
                                 % (ename, help_frag))
+                        if not paged:
+                            for gkey, skey, help_frag in (
+                                    ("state_bytes_per_slot",
+                                     "state_bytes_per_slot",
+                                     "recurrent state HBM per slot "
+                                     "(constant in sequence length)"),
+                                    ("state_cache_blocks",
+                                     "state_cache_blocks",
+                                     "checkpoint blocks held by the "
+                                     "state cache"),
+                                    ("state_cache_bytes",
+                                     "state_cache_bytes",
+                                     "host bytes held by state-cache "
+                                     "checkpoints"),
+                                    ("checkpoint_interval",
+                                     "page_size",
+                                     "tokens between state "
+                                     "checkpoints")):
+                                gauges["veles_o1_%s_%s"
+                                       % (gkey, safe)] = (
+                                    st[skey],
+                                    "O(1)-state engine %s: %s"
+                                    % (ename, help_frag))
                     # model-health gauges (telemetry/tensormon.py):
                     # grad norm, per-layer update ratios, activation
                     # saturation — empty until the first drained
