@@ -685,6 +685,14 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # scan-vs-recurrent id-exactness and slots-at-equal-HBM
         # measurements are gate_o1state's live proof
         "o1state": _o1state_section(),
+        # overload-hardened request plane (serving/overload.py QoS +
+        # veles_tpu/loadgen/): the bench never runs QoS or the load
+        # harness, so every preemption/throttle/brownout/loadgen
+        # counter MUST read zero here — the gate fails on leakage;
+        # the interactive-SLO-under-2x-load, preempt-resume-id-exact
+        # and exactly-once-terminal measurements are gate_overload's
+        # live drill
+        "overload": _overload_section(),
         "extras": [ae, lm],
     }
 
@@ -833,6 +841,24 @@ def _fleet_section():
             counters.get("veles_router_duplicate_answers_total")),
         "respawns": int(counters.get("veles_router_respawns_total")),
     }
+
+
+def _overload_section():
+    """Every QoS + loadgen counter for this bench process — absolute
+    reads (one process, counters start at zero). The bench never runs
+    QoS admission, preemption, brownout or the load harness, so every
+    count MUST be zero — ``bench.py gate`` fails on leakage (QoS-off
+    runs must be bit-identical to the QoS-less plane). The live
+    overload drill (a 2-replica fleet at ~2x sustained capacity
+    keeping interactive within SLO while batch is throttled/
+    preempted, preempted decodes finishing id-exact, exactly one
+    terminal per admitted request) runs inside ``gate_overload``."""
+    from veles_tpu.loadgen import LOADGEN_COUNTERS
+    from veles_tpu.serving import QOS_COUNTERS
+    from veles_tpu.telemetry.counters import counters
+    short = lambda n: n[len("veles_"):-len("_total")]  # noqa: E731
+    return {short(name): int(counters.get(name))
+            for name in QOS_COUNTERS + LOADGEN_COUNTERS}
 
 
 def _lossless_section():
@@ -3111,6 +3137,318 @@ def _o1state_proof():
     return failures, metrics
 
 
+def gate_overload(baseline_doc=None, current_doc=None):
+    """``overload`` gate section: (1) every QoS + loadgen counter
+    must be registered with a HELP string; (2) bench documents must
+    carry ZERO QoS/loadgen activity — the bench runs QoS-off, so a
+    preemption/throttle/brownout/loadgen count in a training
+    measurement means the overload plane leaked into the feature-off
+    path; (3) the clean gate process must read zero before the
+    drill; (4) live drill (:func:`_overload_proof`): preempted batch
+    decodes finish bit-identical to their uninterrupted solo runs
+    (greedy AND sampled) with exactly-once terminal accounting, and
+    an open-loop loadgen burst at ~2x sustained capacity against a
+    2-replica QoS fleet keeps interactive lossless and within SLO
+    while batch absorbs the pressure, ledgers draining to zero."""
+    from veles_tpu.loadgen import LOADGEN_COUNTERS
+    from veles_tpu.serving import QOS_COUNTERS
+    from veles_tpu.telemetry.counters import DESCRIPTIONS, counters
+    failures = []
+    for name in QOS_COUNTERS + LOADGEN_COUNTERS:
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "overload: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc),
+                     ("current", current_doc)):
+        sec = (doc or {}).get("overload")
+        if not sec:
+            continue
+        for key, value in sec.items():
+            if value:
+                failures.append(
+                    "overload: %s doc has %s=%s — QoS/loadgen work "
+                    "leaked into a QoS-off bench run"
+                    % (tag, key, value))
+    # the zero check must precede the live drill (which preempts,
+    # throttles and load-generates for real)
+    for name in QOS_COUNTERS + LOADGEN_COUNTERS:
+        value = counters.get(name)
+        if value:
+            failures.append(
+                "overload: %s = %s before any QoS machinery ran in "
+                "this process" % (name, value))
+    proof_failures, metrics = _overload_proof()
+    if metrics:
+        print("overload proof: preempted batch id-exact "
+              "(greedy+sampled, %d preemption(s), %d token(s) "
+              "carried), %d-request 2x burst on a 2-replica QoS "
+              "fleet — interactive lossless (ttft_p99 %sms), %d "
+              "throttle(s)/%d deferral(s), goodput %.1f tok/s, "
+              "exactly-once terminals, ledgers zero"
+              % (metrics["preemptions"], metrics["preempted_tokens"],
+                 metrics["offered"], metrics["interactive_ttft_p99_ms"],
+                 metrics["throttled"], metrics["deferrals"],
+                 metrics["goodput_tokens_per_s"]))
+    return failures + proof_failures
+
+
+def _overload_proof():
+    """THE overload drill, live on this process's backend, two parts.
+
+    **Preempt-and-resume lock** — one tiny char_lm stack on a
+    1-slot QoS engine, driven TICK BY TICK (the engine is never
+    started; step boundaries are explicit, so the preemption point is
+    deterministic): a batch decode is run solo for the reference,
+    then re-run and preempted mid-decode by an interactive arrival.
+    The batch request must requeue, resume and finish **bit-identical
+    to its uninterrupted solo decode** — greedy AND sampled — with
+    exactly one terminal per request (e2e/queue-wait histogram counts
+    and the admitted counter move once per request, however many
+    times the row bounced) and the page ledger at zero after drain.
+
+    **Overload drill** — two QoS GenerationAPI replicas behind a
+    QoS FleetRouter, hit by an open-loop loadgen burst (mixed
+    interactive/batch, ~2x what the 4 total slots sustain). The
+    interactive class must come through lossless and within a
+    generous SLO while the QoS plane visibly works (throttles,
+    deferrals or preemptions > 0), goodput must not collapse, every
+    offered request must be answered exactly once (server-side
+    retired terminals == client-side 200s), and both replicas'
+    page/queue ledgers must read zero after the drain.
+
+    Returns (failures, metrics) so the caller can gate and stamp."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy
+    import char_lm
+    import veles_tpu as vt
+    from veles_tpu import prng
+    from veles_tpu.config import root as vt_root
+    from veles_tpu.loadgen import LoadGen, Workload
+    from veles_tpu.loadgen import verdict as loadgen_verdict
+    from veles_tpu.serving.engine import ContinuousEngine, make_request
+    from veles_tpu.serving.router import FleetRouter
+    from veles_tpu.serving.scheduler import Ticket
+    from veles_tpu.telemetry.counters import counters as _ctrs
+    from veles_tpu.telemetry.counters import histograms
+
+    failures = []
+    prng.seed_all(8282)
+    wf = char_lm.build_workflow(epochs=1, minibatch_size=32,
+                                n_blocks=1, dim=32, n_train=64,
+                                n_valid=32)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    rng = numpy.random.RandomState(41)
+    prompt_b = [int(t) for t in rng.randint(0, char_lm.VOCAB, 6)]
+    prompt_i = [int(t) for t in rng.randint(0, char_lm.VOCAB, 5)]
+
+    # -- part 1: preempt-and-resume bit-identical, greedy AND sampled
+    vt_root.common.serving.qos = True
+    preemptions = preempted_tokens = 0
+    try:
+        for mode, temp in (("greedy", 0.0), ("sample", 0.9)):
+            eng = ContinuousEngine(wf, max_slots=1, buckets=(8, 24),
+                                   max_context=48,
+                                   name="bench_overload_" + mode)
+
+            def drive(done, limit=3000):
+                for _ in range(limit):
+                    if done():
+                        return True
+                    eng._tick()
+                return done()
+
+            req = make_request(prompt_b, 16, temperature=temp,
+                               seed=77, mode=mode)
+            req["priority"] = "batch"
+            # uninterrupted solo decode: THE reference
+            t_solo = Ticket()
+            eng.submit(dict(req), t_solo)
+            if not drive(t_solo.event.is_set):
+                failures.append("overload: %s solo reference decode "
+                                "never finished" % mode)
+                continue
+            expected = t_solo.result["tokens"]
+            e2e0 = histograms.count("veles_serving_e2e_seconds")
+            qw0 = histograms.count("veles_serving_queue_wait_seconds")
+            adm0 = _ctrs.get("veles_serving_admitted_total")
+            # the same request again — preempted mid-decode this time
+            t_b, t_i = Ticket(), Ticket()
+            eng.submit(dict(req), t_b)
+
+            def mid_decode():
+                active = eng.scheduler.active()
+                return bool(active and active[0].tokens
+                            and active[0].prefilled is None
+                            and len(active[0].tokens) < 12)
+            if not drive(mid_decode, limit=200):
+                failures.append("overload: %s batch row never reached "
+                                "mid-decode" % mode)
+            req_i = make_request(prompt_i, 4)
+            req_i["priority"] = "interactive"
+            eng.submit(req_i, t_i)
+            if not drive(lambda: t_b.event.is_set()
+                         and t_i.event.is_set()):
+                failures.append(
+                    "overload: %s preemption drill never drained"
+                    % mode)
+                continue
+            if t_i.error is not None:
+                failures.append(
+                    "overload: interactive co-tenant failed in the "
+                    "%s drill: %s" % (mode, t_i.error))
+            if t_b.error is not None \
+                    or t_b.result["tokens"] != expected:
+                failures.append(
+                    "overload: preempted %s batch decode diverged "
+                    "from its uninterrupted solo run" % mode)
+            if eng.preemptions < 1:
+                failures.append(
+                    "overload: the %s drill finished without a "
+                    "preemption — slot pressure never forced the "
+                    "batch row out" % mode)
+            preemptions += eng.preemptions
+            preempted_tokens += eng.preempted_tokens
+            # exactly-once terminal accounting across
+            # preempt -> requeue -> finish: 2 requests, 2 samples in
+            # every per-request histogram, 2 admissions — however
+            # many times the batch row bounced
+            e2e_d = histograms.count("veles_serving_e2e_seconds") \
+                - e2e0
+            qw_d = histograms.count(
+                "veles_serving_queue_wait_seconds") - qw0
+            adm_d = _ctrs.get("veles_serving_admitted_total") - adm0
+            if not e2e_d == qw_d == int(adm_d) == 2:
+                failures.append(
+                    "overload: %s terminal accounting not "
+                    "exactly-once (e2e %d, queue_wait %d, admitted "
+                    "%d for 2 requests)" % (mode, e2e_d, qw_d, adm_d))
+            if eng.page_pool.in_use():
+                failures.append(
+                    "overload: %d page(s) still held after the %s "
+                    "drill drained"
+                    % (eng.page_pool.in_use(), mode))
+    finally:
+        vt_root.common.serving.qos = False
+
+    # -- part 2: the 2x overload drill through loadgen
+    vt_root.common.serving.qos = True
+    vt_root.common.router.qos = True
+    vt_root.common.router.slo_ttft_ms = 500.0
+    apis = [vt.GenerationAPI(wf, port=0, engine="continuous",
+                             max_slots=2, buckets=(8, 16),
+                             max_context=32,
+                             name="overload_bench_%d" % i)
+            for i in range(2)]
+    router = None
+    metrics = {}
+    try:
+        for api in apis:
+            api.initialize()
+        router = FleetRouter(
+            ["127.0.0.1:%d" % api.port for api in apis],
+            probe_interval=0.2, failure_threshold=3,
+            retry_budget=2, attempt_timeout=60.0,
+            request_timeout=90.0, name="overload_bench.router").start()
+        # ~2x capacity: 24 mixed requests offered in well under the
+        # fleet's 4-slot service time — the queue MUST form
+        workload = Workload(n_requests=24, rate=400.0, shape="burst",
+                            min_prompt=4, max_prompt=8, n_new=4,
+                            vocab=char_lm.VOCAB, batch_fraction=0.5,
+                            stream_fraction=0.0, sample_fraction=0.0,
+                            shared_fraction=0.25, seed=11)
+        e2e0 = histograms.count("veles_serving_e2e_seconds")
+        pressure0 = sum(int(_ctrs.get(n)) for n in
+                        ("veles_qos_throttled_total",
+                         "veles_qos_preemptions_total",
+                         "veles_qos_batch_deferrals_total"))
+        report = LoadGen("http://127.0.0.1:%d" % router.port,
+                         workload, timeout=120.0,
+                         name="bench.loadgen").run()
+        agg = report["aggregates"]
+        slo = loadgen_verdict(report, slo_ttft_ms=30000.0,
+                              max_interactive_loss=0.0,
+                              min_goodput_tokens_per_s=0.5)
+        if report["answered"] != report["offered"]:
+            failures.append(
+                "overload: %d of %d offered requests never answered"
+                % (report["offered"] - report["answered"],
+                   report["offered"]))
+        accounted = sum(agg[c]["ok"] + agg[c]["shed"]
+                        + agg[c]["errors"]
+                        for c in ("interactive", "batch"))
+        if accounted != report["offered"]:
+            failures.append(
+                "overload: %d terminals for %d offered requests — "
+                "a request was dropped or double-answered"
+                % (accounted, report["offered"]))
+        if agg["interactive"]["shed"] or agg["interactive"]["errors"]:
+            failures.append(
+                "overload: interactive lost %d shed + %d errors "
+                "under the burst — the protected class must come "
+                "through lossless"
+                % (agg["interactive"]["shed"],
+                   agg["interactive"]["errors"]))
+        for check in slo["checks"]:
+            if not check["ok"]:
+                failures.append(
+                    "overload: SLO verdict failed %s (%s vs bound "
+                    "%s)" % (check["name"], check["observed"],
+                             check["bound"]))
+        pressure = sum(int(_ctrs.get(n)) for n in
+                       ("veles_qos_throttled_total",
+                        "veles_qos_preemptions_total",
+                        "veles_qos_batch_deferrals_total")) \
+            - pressure0
+        if pressure < 1:
+            failures.append(
+                "overload: the 2x burst never pressured the QoS "
+                "plane (no throttle, no preemption, no deferral)")
+        # server-side retired terminals == client-side 200s:
+        # exactly-once through however much requeueing happened
+        ok_total = agg["interactive"]["ok"] + agg["batch"]["ok"]
+        e2e_d = histograms.count("veles_serving_e2e_seconds") - e2e0
+        if e2e_d != ok_total:
+            failures.append(
+                "overload: %d retired terminals server-side for %d "
+                "client 200s — terminal accounting broke under "
+                "load" % (e2e_d, ok_total))
+        deadline = time.time() + 15
+        while time.time() < deadline and any(
+                api._engine.scheduler.busy_count()
+                or api._engine.scheduler.queue_depth()
+                for api in apis):
+            time.sleep(0.1)
+        for api in apis:
+            held = api._engine.page_pool.in_use()
+            if held or api._engine.scheduler.queue_depth():
+                failures.append(
+                    "overload: replica %s ledger dirty after drain "
+                    "(%d pages held, %d queued)"
+                    % (api.name, held,
+                       api._engine.scheduler.queue_depth()))
+        metrics = {
+            "preemptions": int(preemptions),
+            "preempted_tokens": int(preempted_tokens),
+            "offered": report["offered"],
+            "interactive_ttft_p99_ms":
+                agg.get("server_ttft_p99_ms")
+                or agg["interactive"]["ttft_p99_ms"],
+            "throttled": int(_ctrs.get("veles_qos_throttled_total")),
+            "deferrals": int(
+                _ctrs.get("veles_qos_batch_deferrals_total")),
+            "goodput_tokens_per_s": agg["goodput_tokens_per_s"],
+        }
+    finally:
+        vt_root.common.serving.qos = False
+        vt_root.common.router.qos = False
+        if router is not None:
+            router.stop()
+        for api in apis:
+            api.stop()
+    return failures, metrics
+
+
 def gate_tensormon(baseline_doc=None, current_doc=None):
     """``tensormon`` gate section: (1) the model-health counters must
     be registered; (2) a monitoring-OFF bench document must carry ZERO
@@ -3222,7 +3560,13 @@ def _gate_main(argv):
                 # the O(1)-state drill serves its own private pool,
                 # so like the others it runs after the doc-leakage
                 # assertions above
-                + gate_o1state(baseline, current))
+                + gate_o1state(baseline, current)
+                # LAST: the overload drill preempts, throttles and
+                # load-generates for real — its own zero-before-proof
+                # check must see a process no earlier QoS work
+                # touched, and it legitimately moves the serving/
+                # router counters every gate above already proved
+                + gate_overload(baseline, current))
     for failure in failures:
         print("GATE FAIL %s" % failure, file=sys.stderr)
     if failures:
@@ -3244,7 +3588,9 @@ def _gate_main(argv):
           "bound, quant "
           "clean + int8 greedy token-exact + artifact serves with "
           "zero compiles, o1state clean + pooled scan/recurrent "
-          "id-exact + flat state bytes + equal-HBM slot multiplier)"
+          "id-exact + flat state bytes + equal-HBM slot multiplier, "
+          "overload clean + preempted batch id-exact + interactive "
+          "lossless under a 2x burst + exactly-once terminals)"
           % (argv[1], argv[0],
              " — %d legacy section(s) compared on wall-clock" % legacy
              if legacy else ""))

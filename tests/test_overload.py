@@ -1,0 +1,375 @@
+"""Overload-hardened request plane (ISSUE 17): QoS classes with
+preempt-and-resume, deadline propagation, adaptive admission +
+brownout, dynamic Retry-After.
+
+The contract under test: (1) batch rows preempted at a step boundary
+for interactive arrivals requeue with resume progress and finish
+**bit-identical** to their uninterrupted solo decodes — greedy AND
+sampled, continuous AND recurrent — with exactly one terminal per
+request however many times the row bounced; (2) the scheduler's
+expiry sweep honors per-ticket deadlines (a short-deadline ticket
+expires while its long-deadline co-tenant survives); (3) the AIMD
+controller, brownout ladder and retry token bucket are deterministic
+given injected clocks/values, and interactive is never throttled;
+(4) with every QoS knob off (the default), admission order, outputs
+and counters are bit-identical to the QoS-less plane.
+
+Budget discipline: one tiny TRAINED transformer char_lm plus one
+initialized lstm twin, both module-scoped; the engines are driven
+TICK BY TICK (never started) so every preemption point is
+deterministic.
+"""
+import time
+
+import numpy
+import pytest
+
+import veles_tpu as vt
+from veles_tpu import prng
+from veles_tpu.config import root
+from veles_tpu.serving import QOS_COUNTERS, RecurrentEngine
+from veles_tpu.serving.engine import ContinuousEngine, make_request
+from veles_tpu.serving.overload import (AIMDController, BrownoutLadder,
+                                        OverloadGovernor,
+                                        RetryTokenBucket,
+                                        clear_pressure_provider,
+                                        dynamic_retry_after,
+                                        governor_from_config,
+                                        request_priority,
+                                        retry_after_hint,
+                                        set_pressure_provider)
+from veles_tpu.serving.scheduler import (SlotScheduler, Ticket,
+                                         shed_expired, split_expired)
+from veles_tpu.telemetry.counters import counters, histograms
+
+from conftest import import_model
+
+
+# -- pure QoS plumbing (no jax) ----------------------------------------------
+
+def test_request_priority_default_and_labels():
+    assert request_priority({}) == "interactive"
+    assert request_priority({"priority": "batch"}) == "batch"
+    assert request_priority({"priority": "interactive"}) \
+        == "interactive"
+    # junk never crashes the hot path — it degrades to the default
+    assert request_priority({"priority": 7}) == "interactive"
+
+
+def test_retry_after_hint_scales_and_clamps():
+    # empty queue: the base hint passes through untouched
+    assert retry_after_hint(0, 16, 1.0, 30.0) == 1.0
+    # deeper queue -> larger hint, monotone
+    shallow = retry_after_hint(4, 16, 1.0, 30.0)
+    deep = retry_after_hint(64, 16, 1.0, 30.0)
+    assert 1.0 <= shallow <= deep <= 30.0
+    # the clamp holds whatever the depth
+    assert retry_after_hint(10 ** 6, 16, 1.0, 30.0) == 30.0
+
+
+def test_dynamic_retry_after_provider_lifecycle():
+    # no provider registered (the feature-off default): passthrough
+    assert dynamic_retry_after(5.0) == 5.0
+    provider = lambda: (32, 16)  # noqa: E731
+    set_pressure_provider(provider)
+    try:
+        assert dynamic_retry_after(1.0) > 1.0
+    finally:
+        clear_pressure_provider(provider)
+    assert dynamic_retry_after(1.0) == 1.0
+    # a ticket's hint rides the same path: base when nothing is
+    # registered (tests elsewhere pin ticket.retry_after exactly)
+    ticket = Ticket()
+    ticket.retry_after = 5.0
+    assert ticket.retry_after_hint() == 5.0
+
+
+def test_aimd_controller_is_deterministic():
+    aimd = AIMDController(slo_ms=100.0, floor=0.05, additive=0.1,
+                          multiplicative=0.5, interval=0.0)
+    assert aimd.rate == 1.0
+    aimd.observe(value_ms=250.0)     # over SLO: multiplicative cut
+    assert aimd.rate == 0.5
+    aimd.observe(value_ms=250.0)
+    assert aimd.rate == 0.25
+    aimd.observe(value_ms=50.0)      # under SLO: additive recovery
+    assert aimd.rate == pytest.approx(0.35)
+    for _ in range(50):
+        aimd.observe(value_ms=500.0)
+    assert aimd.rate == 0.05         # never below the floor
+    # the credit-accumulator grant is deterministic, no RNG: at rate
+    # 0.5 exactly every other grant passes
+    aimd.rate = 0.5
+    aimd._credit = 0.0
+    grants = [aimd.grant() for _ in range(8)]
+    assert grants == [False, True] * 4
+
+
+def test_brownout_ladder_hysteresis():
+    ladder = BrownoutLadder(slo_ms=100.0, enter=1.5, exit=0.8,
+                            patience=2, cap_n_new=4)
+    assert ladder.level == 0
+    ladder.observe(200.0)            # one hot observation: patience
+    assert ladder.level == 0         # guards against flapping
+    ladder.observe(200.0)
+    assert ladder.level == 1         # cap_n_new
+    ladder.observe(90.0)             # between exit and enter: hold
+    ladder.observe(90.0)
+    assert ladder.level == 1
+    ladder.observe(200.0)
+    ladder.observe(200.0)
+    assert ladder.level == 2         # no_spec
+    ladder.observe(50.0)
+    assert ladder.level == 2         # one cool obs is not enough
+    ladder.observe(50.0)
+    assert ladder.level == 1
+    ladder.observe(50.0)
+    ladder.observe(50.0)
+    assert ladder.level == 0
+
+
+def test_retry_token_bucket_injected_clock():
+    clock = {"t": 0.0}
+    bucket = RetryTokenBucket(rate=2.0, burst=3,
+                              clock=lambda: clock["t"])
+    assert [bucket.take() for _ in range(3)] == [True] * 3
+    assert bucket.take() is False    # burst exhausted, no time passed
+    clock["t"] = 1.0                 # 2 tokens refilled
+    assert bucket.take() and bucket.take()
+    assert bucket.take() is False
+    clock["t"] = 100.0               # refill caps at burst
+    assert [bucket.take() for _ in range(3)] == [True] * 3
+    assert bucket.take() is False
+
+
+def test_governor_off_by_default_and_interactive_never_shed():
+    assert governor_from_config() is None     # feature-off lock
+    root.common.router.qos = True
+    try:
+        gov = governor_from_config()
+        assert isinstance(gov, OverloadGovernor)
+        # interactive is admitted whatever the controller thinks
+        gov.aimd.rate = 0.0
+        gov.ladder.level = 3                  # shed_batch
+        assert gov.admit({"priority": "interactive"}) is None
+        assert gov.admit({}) is None
+        # batch is shed at the top rung, with a counted reason
+        before = counters.get("veles_qos_throttled_total")
+        assert gov.admit({"priority": "batch"}) is not None
+        assert counters.get("veles_qos_throttled_total") \
+            - before == 1
+        snap = gov.snapshot()
+        assert set(snap) == {"veles_qos_admit_rate",
+                             "veles_qos_brownout_level",
+                             "veles_qos_retry_tokens"}
+    finally:
+        root.common.router.qos = False
+
+
+# -- scheduler: promotion + the per-ticket deadline sweep --------------------
+
+def _queue_with(scheduler, reqs):
+    tickets = [Ticket() for _ in reqs]
+    for req, ticket in zip(reqs, tickets):
+        scheduler.push(req, ticket)
+    return tickets
+
+
+def test_qos_promotion_and_fifo_when_off():
+    reqs = [{"prompt": [1, 2], "n_new": 1, "priority": "batch",
+             "tag": 0},
+            {"prompt": [1, 2], "n_new": 1, "priority": "interactive",
+             "tag": 1},
+            {"prompt": [1, 2], "n_new": 1, "priority": "batch",
+             "tag": 2},
+            {"prompt": [1, 2], "n_new": 1, "priority": "interactive",
+             "tag": 3}]
+    # feature off (the default): strict FIFO, deferral counter silent
+    sched = SlotScheduler(max_slots=4, buckets=(8,), max_context=16)
+    before = counters.get("veles_qos_batch_deferrals_total")
+    _queue_with(sched, [dict(r) for r in reqs])
+    slots, expired = sched.take_admissions()
+    assert not expired
+    assert [s.req["tag"] for s in slots] == [0, 1, 2, 3]
+    assert counters.get("veles_qos_batch_deferrals_total") == before
+    # feature on: interactive jumps queued batch, stable per class
+    sched = SlotScheduler(max_slots=4, buckets=(8,), max_context=16)
+    sched.qos = True
+    _queue_with(sched, [dict(r) for r in reqs])
+    slots, expired = sched.take_admissions()
+    assert not expired
+    assert [s.req["tag"] for s in slots] == [1, 3, 0, 2]
+    assert counters.get("veles_qos_batch_deferrals_total") > before
+
+
+def test_deadline_sweep_honors_per_ticket_deadline():
+    """Regression: the sweep must expire each ticket against ITS OWN
+    deadline — a short-deadline request dies on time while the
+    long-deadline co-tenant enqueued at the same instant survives."""
+    # one slot but take_admissions is never called: both tickets wait
+    # in the queue until the sweep runs
+    sched = SlotScheduler(max_slots=1, buckets=(8,), max_context=8)
+    now = time.time()
+    short, long_ = Ticket(deadline=now - 0.1), \
+        Ticket(deadline=now + 60.0)
+    sched.push({"tag": "short"}, short)
+    sched.push({"tag": "long"}, long_)
+    with sched.cv:
+        live, expired = split_expired(list(sched._queue))
+        sched._queue.clear()
+        sched._queue.extend(live)
+    shed_expired(expired)
+    assert [req["tag"] for req, _t in sched._queue] == ["long"]
+    assert short.code == 503 and short.outcome == "expired"
+    assert short.error is not None
+    assert long_.error is None and not long_.event.is_set()
+
+
+# -- the engines: preempt-and-resume bit-identical ---------------------------
+
+@pytest.fixture(scope="module")
+def paged_wf():
+    lm = import_model("char_lm")
+    prng.seed_all(1717)
+    wf = lm.build_workflow(epochs=1, minibatch_size=32, n_blocks=1,
+                           dim=32, n_train=64, n_valid=32)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+    return lm, wf
+
+
+@pytest.fixture(scope="module")
+def lstm_wf():
+    lm = import_model("char_lm")
+    prng.seed_all(1718)
+    wf = lm.build_workflow(epochs=1, minibatch_size=32, n_blocks=1,
+                           dim=32, n_train=64, n_valid=32,
+                           arch="lstm")
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    return lm, wf
+
+
+def _drive(engine, done, limit=3000):
+    """Tick the (never-started) engine until ``done()`` — manual step
+    boundaries make the preemption point deterministic."""
+    for _ in range(limit):
+        if done():
+            return True
+        engine._tick()
+    return done()
+
+
+def _preempt_drill(engine, prompt_b, prompt_i, mode, temp):
+    """Solo-decode a batch request for the reference, then re-run it
+    under a mid-decode interactive arrival on a 1-slot pool; returns
+    (expected, got, interactive_ticket, accounting deltas)."""
+    req = make_request(prompt_b, 12, temperature=temp, seed=99,
+                       mode=mode)
+    req["priority"] = "batch"
+    t_solo = Ticket()
+    assert engine.submit(dict(req), t_solo)
+    assert _drive(engine, t_solo.event.is_set)
+    assert t_solo.error is None
+    expected = t_solo.result["tokens"]
+
+    e2e0 = histograms.count("veles_serving_e2e_seconds")
+    qw0 = histograms.count("veles_serving_queue_wait_seconds")
+    adm0 = counters.get("veles_serving_admitted_total")
+    pre0 = counters.get("veles_qos_preemptions_total")
+    t_b, t_i = Ticket(), Ticket()
+    assert engine.submit(dict(req), t_b)
+
+    def mid_decode():
+        active = engine.scheduler.active()
+        return bool(active and active[0].tokens
+                    and active[0].prefilled is None
+                    and len(active[0].tokens) < 8)
+    assert _drive(engine, mid_decode, limit=200)
+    req_i = make_request(prompt_i, 3)
+    req_i["priority"] = "interactive"
+    assert engine.submit(req_i, t_i)
+    assert _drive(engine, lambda: t_b.event.is_set()
+                  and t_i.event.is_set())
+    assert t_i.error is None and t_b.error is None
+    assert counters.get("veles_qos_preemptions_total") - pre0 >= 1
+    deltas = (histograms.count("veles_serving_e2e_seconds") - e2e0,
+              histograms.count("veles_serving_queue_wait_seconds")
+              - qw0,
+              int(counters.get("veles_serving_admitted_total")
+                  - adm0))
+    return expected, t_b.result["tokens"], t_i, deltas
+
+
+@pytest.mark.parametrize("mode,temp", [("greedy", 0.0),
+                                       ("sample", 0.9)])
+def test_continuous_preempt_resume_bit_identical(paged_wf, mode,
+                                                 temp):
+    lm, wf = paged_wf
+    rng = numpy.random.RandomState(5)
+    prompt_b = [int(t) for t in rng.randint(0, lm.VOCAB, 6)]
+    prompt_i = [int(t) for t in rng.randint(0, lm.VOCAB, 5)]
+    root.common.serving.qos = True
+    try:
+        eng = ContinuousEngine(wf, max_slots=1, buckets=(8, 24),
+                               max_context=48,
+                               name="qos_cont_" + mode)
+        expected, got, t_i, deltas = _preempt_drill(
+            eng, prompt_b, prompt_i, mode, temp)
+        # THE tentpole bar: preempted == uninterrupted, bit-identical
+        assert got == expected
+        assert len(t_i.result["tokens"]) == 3
+        # exactly-once terminal accounting across
+        # preempt -> requeue -> finish: 2 requests, 2 samples in
+        # every per-request series, 2 admissions
+        assert deltas == (2, 2, 2)
+        assert eng.page_pool.in_use() == 0   # ledger drained
+    finally:
+        root.common.serving.qos = False
+
+
+@pytest.mark.parametrize("mode,temp", [("greedy", 0.0),
+                                       ("sample", 0.9)])
+def test_recurrent_preempt_resume_bit_identical(lstm_wf, mode, temp):
+    lm, wf = lstm_wf
+    rng = numpy.random.RandomState(6)
+    prompt_b = [int(t) for t in rng.randint(0, lm.VOCAB, 6)]
+    prompt_i = [int(t) for t in rng.randint(0, lm.VOCAB, 5)]
+    root.common.serving.qos = True
+    try:
+        eng = RecurrentEngine(wf, max_slots=1, max_context=48,
+                              page_size=8, name="qos_rec_" + mode)
+        expected, got, t_i, deltas = _preempt_drill(
+            eng, prompt_b, prompt_i, mode, temp)
+        assert got == expected
+        assert len(t_i.result["tokens"]) == 3
+        assert deltas == (2, 2, 2)
+    finally:
+        root.common.serving.qos = False
+
+
+def test_feature_off_lock_no_qos_counters(paged_wf):
+    """With every knob off (the default), a mixed-priority load moves
+    ZERO QoS counters and admits strictly FIFO — the QoS-off plane is
+    the PR 16 plane."""
+    lm, wf = paged_wf
+    rng = numpy.random.RandomState(7)
+    before = {name: counters.get(name) for name in QOS_COUNTERS}
+    eng = ContinuousEngine(wf, max_slots=2, buckets=(8,),
+                           max_context=24, name="qos_off")
+    assert eng.qos is False and eng.scheduler.qos is False
+    reqs, tickets = [], []
+    for i in range(4):
+        req = make_request(
+            [int(t) for t in rng.randint(0, lm.VOCAB, 5)], 3)
+        req["priority"] = "batch" if i % 2 else "interactive"
+        ticket = Ticket()
+        assert eng.submit(req, ticket)
+        reqs.append(req)
+        tickets.append(ticket)
+    assert _drive(eng, lambda: all(t.event.is_set()
+                                   for t in tickets))
+    for ticket in tickets:
+        assert ticket.error is None
+    assert eng.preemptions == 0
+    for name in QOS_COUNTERS:
+        assert counters.get(name) == before[name], name

@@ -182,6 +182,19 @@ def test_parse_spec_fields():
     assert faults.parse_spec("  ;  ") == []
 
 
+def test_parse_spec_window_field():
+    """``window=T0:T1`` (the loadgen chaos-storm clause) parses as a
+    (T0, T1) trigger-count window and survives the clause's own colon
+    thanks to the maxsplit grammar."""
+    (fault,) = faults.parse_spec("dispatch:raise:window=1:3")
+    assert fault.window == (1, 3)
+    assert "window=1:3" in repr(fault)
+    # composes with the other params in one clause
+    (fault,) = faults.parse_spec(
+        "serve.page_alloc:raise:window=50:80,p=0.5")
+    assert fault.window == (50, 80) and fault.p == 0.5
+
+
 @pytest.mark.parametrize("bad", [
     "nonsense",                       # no action
     "no.such.point:raise",            # unregistered point
@@ -189,6 +202,10 @@ def test_parse_spec_fields():
     "dispatch:raise:frequency=2",     # unknown param
     "dispatch:raise:p=lots",          # unparseable value
     "dispatch:raise:p=1.5",           # probability out of range
+    "dispatch:raise:window=3:1",      # empty window (T1 <= T0)
+    "dispatch:raise:window=5",        # not a T0:T1 pair
+    "dispatch:raise:window=x:y",      # unparseable bounds
+    "dispatch:raise:window=-1:3",     # negative trigger count
 ])
 def test_parse_spec_rejects(bad):
     with pytest.raises(VelesError):
@@ -211,6 +228,20 @@ def test_fire_after_skips_first_hits(monkeypatch):
     assert faults.fire("download") is None
     with pytest.raises(faults.FaultInjected):
         faults.fire("download")
+
+
+def test_fire_window_arms_then_heals(monkeypatch):
+    """A ``window=1:3`` clause is a timed storm: the first hit passes,
+    hits 2..3 fire, and the point HEALS from hit 4 on — trigger-count
+    indexed, so the storm is reproducible run-to-run."""
+    monkeypatch.setenv("VELES_FAULTS", "download:raise:window=1:3")
+    assert faults.fire("download") is None         # hit 1: pre-storm
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("download")                    # hit 2: armed
+    with pytest.raises(faults.FaultInjected):
+        faults.fire("download")                    # hit 3: armed
+    assert faults.fire("download") is None         # hit 4: healed
+    assert faults.fire("download") is None         # ...and stays so
 
 
 def test_fire_corrupt_returns_fault(monkeypatch):
@@ -734,3 +765,5 @@ def test_faults_list_cli():
                   "dispatch", "download", "distributed.init",
                   "snapshot.load"):
         assert point in r.stdout
+    # the chaos-storm window field is surfaced in the clause grammar
+    assert "window=T0:T1" in r.stdout

@@ -43,6 +43,10 @@ def main(argv=None) -> int:
         # resilience subcommand family:
         #   veles-tpu faults list
         return _faults_cli(argv[1:])
+    if argv and argv[0] == "loadgen":
+        # load/chaos harness (veles_tpu/loadgen/):
+        #   veles-tpu loadgen URL [--requests N] [--rate R] [...]
+        return _loadgen_cli(argv[1:])
     if argv and argv[0] == "blackbox":
         # flight-recorder subcommand family (telemetry/recorder.py):
         #   veles-tpu blackbox dump [--out PATH]
@@ -102,6 +106,12 @@ def main(argv=None) -> int:
     if args.serve_drain_handoff is not None:
         _root.common.serving.drain_handoff = \
             args.serve_drain_handoff == "on"
+    if args.serve_qos is not None:
+        _root.common.serving.qos = args.serve_qos == "on"
+    if args.router_qos is not None:
+        _root.common.router.qos = args.router_qos == "on"
+    if args.router_slo_ttft_ms is not None:
+        _root.common.router.slo_ttft_ms = args.router_slo_ttft_ms
     # quantization policy (veles_tpu/quant/): the flags arm the config
     # tree; the serving engine (and any programmatic consumer) reads
     # root.common.quant.*
@@ -413,9 +423,127 @@ def _faults_cli(argv) -> int:
           "root.common.resilience.faults):")
     for name, desc in sorted(faults.list_points().items()):
         print("  %-17s %s" % (name, desc))
+    print("clause grammar: point:action[:p=P,after=N,times=N,"
+          "delay=S,window=T0:T1]")
+    print("  window=T0:T1 arms the action only between the T0-th and "
+          "T1-th trigger\n  of the point (then it heals) — the timed "
+          "chaos-storm form `veles-tpu\n  loadgen --storm` requires")
     spec = faults.plane.current_spec()
     print("active spec: %s" % (spec or "(none)"))
     return 0
+
+
+def _loadgen_cli(argv) -> int:
+    """``veles-tpu loadgen URL`` — drive a serving endpoint (replica
+    or router front) open-loop with a seeded synthetic workload
+    (veles_tpu/loadgen/), optionally under timed chaos storms, and
+    print the per-class latency aggregates plus the SLO verdict.
+    Storms arm the PROCESS-LOCAL fault plane, so they reach
+    in-process fleets only — arm a remote replica through its own
+    VELES_FAULTS."""
+    import argparse
+    import json as _json
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu loadgen",
+        description="open-loop fleet load/chaos harness "
+                    "(docs/services.md 'Overload & QoS')")
+    parser.add_argument("url", metavar="URL",
+                        help="endpoint to drive (http://host:port)")
+    parser.add_argument("--path", default="/generate",
+                        help="POST path (default /generate)")
+    parser.add_argument("--requests", type=int, default=100,
+                        metavar="N", help="requests to offer")
+    parser.add_argument("--rate", type=float, default=20.0,
+                        metavar="R", help="offered req/s (base rate)")
+    parser.add_argument("--shape", default="steady",
+                        choices=("steady", "burst", "diurnal"),
+                        help="arrival shape (default steady)")
+    parser.add_argument("--n-new", type=int, default=8, metavar="T",
+                        help="tokens to decode per request")
+    parser.add_argument("--min-prompt", type=int, default=4)
+    parser.add_argument("--max-prompt", type=int, default=64)
+    parser.add_argument("--vocab", type=int, default=128,
+                        help="prompt token id upper bound (match the "
+                             "served model's vocabulary)")
+    parser.add_argument("--batch-fraction", type=float, default=0.5,
+                        metavar="F",
+                        help="fraction labeled priority=batch")
+    parser.add_argument("--stream-fraction", type=float, default=0.0,
+                        metavar="F", help="fraction streaming (SSE)")
+    parser.add_argument("--sample-fraction", type=float, default=0.25,
+                        metavar="F", help="fraction mode=sample")
+    parser.add_argument("--shared-fraction", type=float, default=0.5,
+                        metavar="F",
+                        help="fraction opening with a shared prefix")
+    parser.add_argument("--deadline-ms", type=float, default=None,
+                        metavar="MS",
+                        help="per-request deadline for interactive "
+                             "requests (propagated to the fleet)")
+    parser.add_argument("--storm", action="append", default=[],
+                        metavar="SPEC",
+                        help="timed chaos storm, a fault clause with "
+                             "a window= field (repeatable), e.g. "
+                             "serve.replica_death:raise:window=50:51")
+    parser.add_argument("--timeout", type=float, default=60.0,
+                        metavar="SEC", help="per-request client "
+                        "patience (default 60)")
+    parser.add_argument("--slo-ttft-ms", type=float, default=2000.0,
+                        metavar="MS", help="interactive TTFT p99 "
+                        "bound for the verdict (default 2000)")
+    parser.add_argument("--max-interactive-loss", type=float,
+                        default=0.05, metavar="F",
+                        help="interactive shed+error fraction bound")
+    parser.add_argument("--min-goodput", type=float, default=0.0,
+                        metavar="TPS",
+                        help="goodput floor (tokens/s) for the "
+                             "verdict (default 0 = no floor)")
+    parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--json", default=None, metavar="PATH",
+                        help="write the full report (records "
+                             "included) as JSON")
+    args = parser.parse_args(argv)
+    from .loadgen import LoadGen, Workload, parse_storm, verdict
+    workload = Workload(
+        n_requests=args.requests, rate=args.rate, shape=args.shape,
+        min_prompt=args.min_prompt, max_prompt=args.max_prompt,
+        n_new=args.n_new, vocab=args.vocab,
+        shared_fraction=args.shared_fraction,
+        batch_fraction=args.batch_fraction,
+        stream_fraction=args.stream_fraction,
+        sample_fraction=args.sample_fraction,
+        deadline_ms=args.deadline_ms, seed=args.seed)
+    storms = [parse_storm(s) for s in args.storm]
+    url = args.url if "://" in args.url else "http://" + args.url
+    report = LoadGen(url, workload, storms=storms, path=args.path,
+                     timeout=args.timeout).run()
+    slo = verdict(report, slo_ttft_ms=args.slo_ttft_ms,
+                  max_interactive_loss=args.max_interactive_loss,
+                  min_goodput_tokens_per_s=args.min_goodput)
+    report["verdict"] = slo
+    agg = report["aggregates"]
+    print("offered %d, answered %d in %.1fs (goodput %.1f tok/s)"
+          % (report["offered"], report["answered"],
+             report["wall_seconds"], agg["goodput_tokens_per_s"]))
+    for cls in ("interactive", "batch"):
+        row = agg[cls]
+        print("  %-11s ok=%d shed=%d err=%d ttft_p99=%sms "
+              "e2e_p99=%sms" % (cls, row["ok"], row["shed"],
+                                row["errors"], row["ttft_p99_ms"],
+                                row["e2e_p99_ms"]))
+    if agg["server_ttft_p99_ms"] is not None:
+        print("  server ttft_p99=%sms queue_wait_p99=%sms"
+              % (agg["server_ttft_p99_ms"],
+                 agg["server_queue_wait_p99_ms"]))
+    for check in slo["checks"]:
+        print("  [%s] %s: %s vs %s"
+              % ("ok" if check["ok"] else "FAIL", check["name"],
+                 check["observed"], check["bound"]))
+    if args.json:
+        with open(args.json, "w") as fh:
+            _json.dump(report, fh, indent=2, sort_keys=True)
+        print("report written: %s" % args.json)
+    print("verdict: %s" % ("PASS" if slo["pass"] else "FAIL"))
+    return 0 if slo["pass"] else 1
 
 
 def _route_cli(argv) -> int:

@@ -195,6 +195,31 @@ def make_parser() -> argparse.ArgumentParser:
                         "token-streaming responses (default on; "
                         "root.common.serving.stream — off answers "
                         "them buffered)")
+    p.add_argument("--serve-qos", default=None,
+                   choices=("on", "off"),
+                   help="QoS classes on the serving plane (default "
+                        "off; root.common.serving.qos): requests "
+                        "carry priority=interactive|batch, admission "
+                        "promotes interactive past queued batch, and "
+                        "under slot pressure the engine preempts "
+                        "batch rows at a step boundary — they requeue "
+                        "with resume progress and finish bit-"
+                        "identical (docs/services.md 'Overload & "
+                        "QoS')")
+    p.add_argument("--router-qos", default=None,
+                   choices=("on", "off"),
+                   help="adaptive admission at the fleet router "
+                        "(default off; root.common.router.qos): AIMD "
+                        "controller keyed on the TTFT p99 vs "
+                        "--router-slo-ttft-ms throttles batch first, "
+                        "a retry token bucket caps failover "
+                        "amplification, and a hysteresis-guarded "
+                        "brownout ladder degrades before shedding")
+    p.add_argument("--router-slo-ttft-ms", type=float, default=None,
+                   metavar="MS",
+                   help="TTFT p99 SLO the router's AIMD controller "
+                        "defends (root.common.router.slo_ttft_ms, "
+                        "default 500)")
     p.add_argument("--serve-artifact", default=None, metavar="DIR",
                    help="AOT serve-artifact package (from `veles-tpu "
                         "export serve-artifact`): the continuous "

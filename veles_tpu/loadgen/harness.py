@@ -1,0 +1,257 @@
+"""The open-loop load driver and its SLO verdict.
+
+:class:`LoadGen` fires a :class:`~veles_tpu.loadgen.workload.Workload`
+at a fleet endpoint on the workload's own clock — one thread per
+in-flight request, dispatched at the scheduled arrival instant whether
+or not earlier requests have answered (open loop: offered load is the
+schedule's, not the fleet's). Each request records its client-observed
+outcome (status, TTFT for streamed requests, end-to-end latency,
+tokens); :func:`verdict` folds those records — plus the server-side
+SLO histograms when the fleet shares this process's registry — into a
+pass/fail report against explicit SLO bounds.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..logger import Logger
+from ..telemetry.counters import histograms, inc
+from .storm import ChaosStorm, StormPlan
+from .workload import Workload
+
+
+def percentile(values: Sequence[float], q: float) -> Optional[float]:
+    """Nearest-rank client-side percentile; None on empty input."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return None
+    rank = max(0, min(len(vals) - 1,
+                      int(round(q * (len(vals) - 1)))))
+    return vals[rank]
+
+
+def _send(url: str, body: Dict[str, Any],
+          timeout: float) -> Dict[str, Any]:
+    """POST one request; returns the client-observed record. A
+    streamed request's TTFT is the first token event's arrival; a
+    buffered one cannot observe first-token time client-side (its
+    ttft_s is None — the server histograms cover it)."""
+    rec: Dict[str, Any] = {
+        "priority": body.get("priority", "interactive"),
+        "stream": bool(body.get("stream")), "status": None,
+        "error": None, "shed": False, "ttft_s": None, "e2e_s": None,
+        "tokens": 0,
+    }
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        url, data=data, headers={"Content-Type": "application/json"})
+    t0 = time.time()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            if body.get("stream") and "event-stream" in (
+                    resp.headers.get("Content-Type", "")):
+                tokens = 0
+                for line in resp:
+                    line = line.strip()
+                    if not line.startswith(b"data:"):
+                        continue
+                    try:
+                        ev = json.loads(line[5:].strip())
+                    except ValueError:
+                        continue
+                    if not isinstance(ev, dict):
+                        continue
+                    if ev.get("done"):
+                        rec["status"] = int(ev.get("code", 200))
+                        if ev.get("error") is not None:
+                            rec["error"] = str(ev["error"])
+                        toks = ev.get("tokens")
+                        if isinstance(toks, list):
+                            tokens = max(tokens, len(toks))
+                        break
+                    toks = ev.get("tokens")
+                    if isinstance(toks, list) and toks:
+                        if rec["ttft_s"] is None:
+                            rec["ttft_s"] = time.time() - t0
+                        tokens += len(toks)
+                rec["tokens"] = tokens
+                if rec["status"] is None:
+                    rec["status"] = 200
+                    rec["error"] = "stream ended without a terminal"
+            else:
+                payload = json.loads(resp.read() or b"{}")
+                rec["status"] = resp.status
+                toks = payload.get("tokens")
+                rec["tokens"] = (len(toks)
+                                 if isinstance(toks, list) else 0)
+    except urllib.error.HTTPError as e:
+        rec["status"] = e.code
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except ValueError:
+            payload = {}
+        rec["error"] = str(payload.get("error", "HTTP %d" % e.code))
+        rec["shed"] = e.code == 503
+    except Exception as e:      # noqa: BLE001 — a dead fleet is data
+        rec["error"] = "%s: %s" % (type(e).__name__, e)
+    rec["e2e_s"] = time.time() - t0
+    return rec
+
+
+class LoadGen(Logger):
+    """Drive ``workload`` at ``url`` open-loop, optionally under
+    ``storms``; :meth:`run` returns the full report (records +
+    aggregates + the storm/workload stamps)."""
+
+    def __init__(self, url: str, workload: Workload,
+                 storms: Sequence[ChaosStorm] = (),
+                 path: str = "/generate",
+                 timeout: float = 60.0,
+                 time_scale: float = 1.0,
+                 name: str = "loadgen") -> None:
+        super().__init__()
+        self.url = url.rstrip("/")
+        self.path = path
+        self.workload = workload
+        self.storms = list(storms)
+        self.timeout = float(timeout)
+        #: compress (<1) or stretch (>1) the arrival schedule —
+        #: drills run the same WORKLOAD faster without changing its
+        #: per-request content
+        self.time_scale = float(time_scale)
+        self.name = name
+
+    def run(self) -> Dict[str, Any]:
+        arrivals = self.workload.arrivals()
+        bodies = self.workload.requests()
+        records: List[Optional[Dict[str, Any]]] = [None] * len(bodies)
+        threads: List[threading.Thread] = []
+        target = self.url + self.path
+
+        def fire(i: int, body: Dict[str, Any]) -> None:
+            inc("veles_loadgen_requests_total")
+            rec = _send(target, body, self.timeout)
+            rec["i"] = i
+            if rec["shed"]:
+                inc("veles_loadgen_shed_total")
+            elif rec["error"] is not None:
+                inc("veles_loadgen_errors_total")
+            records[i] = rec
+
+        self.info("%s: offering %d requests at %s (shape=%s, "
+                  "%d storm(s))", self.name, len(bodies), target,
+                  self.workload.shape, len(self.storms))
+        t_run = time.time()
+        with StormPlan(self.storms):
+            t0 = time.time()
+            for i, (at, body) in enumerate(zip(arrivals, bodies)):
+                # open loop: sleep to the SCHEDULED instant, then
+                # dispatch — never wait for an answer
+                delay = at * self.time_scale - (time.time() - t0)
+                if delay > 0:
+                    time.sleep(delay)
+                th = threading.Thread(target=fire, args=(i, body),
+                                      daemon=True,
+                                      name="%s.%d" % (self.name, i))
+                th.start()
+                threads.append(th)
+            deadline = time.time() + self.timeout + 5.0
+            for th in threads:
+                th.join(timeout=max(0.1, deadline - time.time()))
+        done = [r for r in records if r is not None]
+        wall = time.time() - t_run
+        return {
+            "workload": self.workload.describe(),
+            "storms": [s.spec() for s in self.storms],
+            "wall_seconds": round(wall, 3),
+            "offered": len(bodies),
+            "answered": len(done),
+            "records": done,
+            "aggregates": aggregate(done, wall),
+        }
+
+
+def aggregate(records: Sequence[Dict[str, Any]],
+              wall: float) -> Dict[str, Any]:
+    """Per-priority-class client-side aggregates + fleet goodput."""
+    out: Dict[str, Any] = {}
+    for cls in ("interactive", "batch"):
+        rows = [r for r in records if r["priority"] == cls]
+        ok = [r for r in rows if r["status"] == 200
+              and r["error"] is None]
+        ttfts = [r["ttft_s"] for r in ok if r["ttft_s"] is not None]
+        e2es = [r["e2e_s"] for r in ok if r["e2e_s"] is not None]
+        out[cls] = {
+            "offered": len(rows),
+            "ok": len(ok),
+            "shed": sum(1 for r in rows if r["shed"]),
+            "errors": sum(1 for r in rows if r["error"] is not None
+                          and not r["shed"]),
+            "tokens": sum(r["tokens"] for r in ok),
+            "ttft_p50_ms": _ms(percentile(ttfts, 0.50)),
+            "ttft_p99_ms": _ms(percentile(ttfts, 0.99)),
+            "e2e_p50_ms": _ms(percentile(e2es, 0.50)),
+            "e2e_p99_ms": _ms(percentile(e2es, 0.99)),
+        }
+    total_tokens = sum(out[c]["tokens"] for c in out)
+    out["goodput_tokens_per_s"] = round(
+        total_tokens / wall, 2) if wall > 0 else 0.0
+    # server-side SLO histograms: meaningful when the fleet shares
+    # this process's registry (the in-process drill); a remote fleet
+    # reports None here and is judged on the client-side numbers
+    out["server_ttft_p99_ms"] = _ms(
+        histograms.quantile("veles_serving_ttft_seconds", 0.99))
+    out["server_queue_wait_p99_ms"] = _ms(
+        histograms.quantile("veles_serving_queue_wait_seconds", 0.99))
+    return out
+
+
+def _ms(seconds: Optional[float]) -> Optional[float]:
+    return None if seconds is None else round(seconds * 1000.0, 3)
+
+
+def verdict(report: Dict[str, Any],
+            slo_ttft_ms: float = 2000.0,
+            max_interactive_loss: float = 0.05,
+            min_goodput_tokens_per_s: float = 0.0
+            ) -> Dict[str, Any]:
+    """Fold a :meth:`LoadGen.run` report into an explicit pass/fail
+    SLO verdict:
+
+    - **interactive TTFT p99** (server histogram when available, else
+      the streamed client observations) within ``slo_ttft_ms``;
+    - **interactive loss** (sheds + errors over offered) at most
+      ``max_interactive_loss`` — batch absorbs the overload, the
+      protected class keeps answering;
+    - **goodput** at least ``min_goodput_tokens_per_s`` — brownout
+      degrades, it must not collapse.
+    """
+    agg = report["aggregates"]
+    inter = agg["interactive"]
+    checks: List[Dict[str, Any]] = []
+
+    ttft = agg.get("server_ttft_p99_ms")
+    if ttft is None:
+        ttft = inter["ttft_p99_ms"]
+    checks.append({
+        "name": "interactive_ttft_p99_ms",
+        "observed": ttft, "bound": slo_ttft_ms,
+        "ok": ttft is None or ttft <= slo_ttft_ms})
+    loss = ((inter["shed"] + inter["errors"]) / inter["offered"]
+            if inter["offered"] else 0.0)
+    checks.append({
+        "name": "interactive_loss_fraction",
+        "observed": round(loss, 4), "bound": max_interactive_loss,
+        "ok": loss <= max_interactive_loss})
+    goodput = agg["goodput_tokens_per_s"]
+    checks.append({
+        "name": "goodput_tokens_per_s",
+        "observed": goodput, "bound": min_goodput_tokens_per_s,
+        "ok": goodput >= min_goodput_tokens_per_s})
+    return {"pass": all(c["ok"] for c in checks), "checks": checks}

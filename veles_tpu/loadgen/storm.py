@@ -1,0 +1,96 @@
+"""Timed chaos storms for the load harness.
+
+A storm is nothing but a ``window=T0:T1`` fault clause
+(resilience/faults.py): the action arms between the T0-th and T1-th
+trigger of an existing injection point and then HEALS — so "replica 2
+dies mid-burst" or "page allocation fails for 30 admissions" are plain
+specs, reproducible because the trigger count (not wall time) indexes
+the storm. The harness arms every storm's clause on the process-global
+fault plane for the run and restores whatever spec was active before.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Sequence, Tuple
+
+from ..resilience.faults import parse_spec, plane
+from ..telemetry.counters import inc
+
+
+class ChaosStorm:
+    """One timed storm: ``point:action:window=T0:T1[,k=v...]``."""
+
+    def __init__(self, point: str, action: str = "raise",
+                 window: Tuple[int, int] = (0, 1),
+                 p: float = 1.0) -> None:
+        self.point = point
+        self.action = action
+        self.window = (int(window[0]), int(window[1]))
+        self.p = float(p)
+        # parse eagerly: a typo'd point/action fails at harness
+        # CONSTRUCTION, not silently mid-run
+        parse_spec(self.spec())
+
+    def spec(self) -> str:
+        clause = "%s:%s:window=%d:%d" % (self.point, self.action,
+                                         *self.window)
+        if self.p < 1.0:
+            clause += ",p=%g" % self.p
+        return clause
+
+    def __repr__(self) -> str:
+        return "<ChaosStorm %s>" % self.spec()
+
+
+def parse_storm(text: str) -> ChaosStorm:
+    """CLI-facing storm parser: a full fault clause with a mandatory
+    ``window=`` field (``veles-tpu loadgen --storm ...``)."""
+    faults = parse_spec(text)
+    if len(faults) != 1:
+        raise ValueError("one storm per --storm flag (got %r)" % text)
+    fault = faults[0]
+    if fault.window is None:
+        raise ValueError(
+            "a storm needs a window=T0:T1 field (got %r)" % text)
+    return ChaosStorm(fault.point, fault.action,
+                      window=fault.window, p=fault.p)
+
+
+class StormPlan:
+    """Arm a set of storms on the process-global fault plane for the
+    duration of a run; context-manager shaped so the previous spec is
+    ALWAYS restored (a crashed harness must not leave the fleet
+    haunted). Arming goes through the ``VELES_FAULTS`` env var — the
+    plane re-resolves env/config on every fire, so a bare
+    ``plane.configure(text)`` would be reverted at the next call
+    site; the env var (which WINS the resolution) sticks for the
+    whole run. Storms therefore reach in-process fleets only; a
+    remote replica wants the same clause in its own VELES_FAULTS."""
+
+    def __init__(self, storms: Sequence[ChaosStorm]) -> None:
+        self.storms: List[ChaosStorm] = list(storms)
+        self._prior_env: "str | None" = None
+
+    def spec(self) -> str:
+        return ";".join(s.spec() for s in self.storms)
+
+    def __enter__(self) -> "StormPlan":
+        if self.storms:
+            self._prior_env = os.environ.get("VELES_FAULTS")
+            prior = plane.current_spec()
+            combined = self.spec()
+            if prior:
+                combined = prior + ";" + combined
+            os.environ["VELES_FAULTS"] = combined
+            plane.configure()
+            inc("veles_loadgen_storms_total", len(self.storms))
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self.storms:
+            if self._prior_env is None:
+                os.environ.pop("VELES_FAULTS", None)
+            else:
+                os.environ["VELES_FAULTS"] = self._prior_env
+            plane.configure()

@@ -1,0 +1,172 @@
+"""Deterministic open-loop workload synthesis for the load harness.
+
+One :class:`Workload` is a SEEDED program: the same knobs + seed
+produce the same arrival instants and the same request bodies, so a
+stamped loadgen verdict is reproducible run-to-run (the ROADMAP's
+"reproduces stamped p50/p99 within tolerance across two runs" gate
+depends on exactly this).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional
+
+import numpy
+
+#: arrival-rate shapes (offered load over the run's duration)
+SHAPES = ("steady", "burst", "diurnal")
+
+
+class Workload:
+    """Synthesize ``n_requests`` request bodies plus their open-loop
+    arrival offsets (seconds from harness start).
+
+    - **prompt lengths** are Zipf-distributed (exponent ``zipf_a``)
+      clipped to ``[min_prompt, max_prompt]`` — the heavy-tailed mix
+      real traffic has (most prompts short, a long tail of huge ones);
+    - **shared prefixes**: a ``shared_fraction`` of requests open with
+      one of ``n_prefixes`` fixed ``prefix_len``-token system prompts,
+      exercising the radix prefix / state-checkpoint caches;
+    - **QoS mix**: ``batch_fraction`` of requests are labeled
+      ``priority=batch`` (the rest interactive — the class the SLO
+      verdict defends); interactive requests carry ``deadline_ms``
+      when set;
+    - **client mix**: ``stream_fraction`` stream (SSE), the rest
+      buffer; ``sample_fraction`` decode with ``mode=sample`` at
+      ``temperature`` (per-request seeds), the rest greedy;
+    - **arrival shape**: ``steady`` (homogeneous Poisson at ``rate``),
+      ``burst`` (a ``burst_fraction`` span mid-run at ``burst_factor``
+      × rate), ``diurnal`` (sinusoidal modulation, one full period
+      over the run) — all open-loop: the schedule never waits for
+      answers.
+    """
+
+    def __init__(self, n_requests: int = 100, rate: float = 20.0,
+                 shape: str = "steady", burst_factor: float = 4.0,
+                 burst_fraction: float = 0.25,
+                 diurnal_amplitude: float = 0.6,
+                 zipf_a: float = 1.4, min_prompt: int = 4,
+                 max_prompt: int = 64, n_new: int = 8,
+                 shared_fraction: float = 0.5, prefix_len: int = 12,
+                 n_prefixes: int = 3, vocab: int = 128,
+                 batch_fraction: float = 0.5,
+                 stream_fraction: float = 0.0,
+                 sample_fraction: float = 0.25,
+                 temperature: float = 0.8,
+                 deadline_ms: Optional[float] = None,
+                 seed: int = 0) -> None:
+        if shape not in SHAPES:
+            raise ValueError("shape must be one of %s" % (SHAPES,))
+        if not 1 <= min_prompt <= max_prompt:
+            raise ValueError("need 1 <= min_prompt <= max_prompt")
+        if rate <= 0:
+            raise ValueError("rate must be > 0 req/s")
+        self.n_requests = int(n_requests)
+        self.rate = float(rate)
+        self.shape = shape
+        self.burst_factor = float(burst_factor)
+        self.burst_fraction = min(1.0, max(0.0, float(burst_fraction)))
+        self.diurnal_amplitude = min(0.95, max(0.0,
+                                               float(diurnal_amplitude)))
+        self.zipf_a = float(zipf_a)
+        self.min_prompt = int(min_prompt)
+        self.max_prompt = int(max_prompt)
+        self.n_new = int(n_new)
+        self.shared_fraction = min(1.0, max(0.0,
+                                            float(shared_fraction)))
+        self.prefix_len = min(int(prefix_len), self.min_prompt)
+        self.n_prefixes = max(1, int(n_prefixes))
+        self.vocab = int(vocab)
+        self.batch_fraction = min(1.0, max(0.0, float(batch_fraction)))
+        self.stream_fraction = min(1.0, max(0.0,
+                                            float(stream_fraction)))
+        self.sample_fraction = min(1.0, max(0.0,
+                                            float(sample_fraction)))
+        self.temperature = float(temperature)
+        self.deadline_ms = (None if deadline_ms is None
+                            else float(deadline_ms))
+        self.seed = int(seed)
+
+    def _rate_at(self, frac: float) -> float:
+        """Offered rate at run fraction ``frac`` in [0, 1)."""
+        if self.shape == "burst":
+            lo = 0.5 - self.burst_fraction / 2.0
+            hi = 0.5 + self.burst_fraction / 2.0
+            return self.rate * (self.burst_factor
+                                if lo <= frac < hi else 1.0)
+        if self.shape == "diurnal":
+            return self.rate * (1.0 + self.diurnal_amplitude
+                                * math.sin(2.0 * math.pi * frac))
+        return self.rate
+
+    def arrivals(self) -> List[float]:
+        """Open-loop arrival offsets (seconds, sorted ascending)."""
+        rng = numpy.random.RandomState(self.seed)
+        out, t = [], 0.0
+        for i in range(self.n_requests):
+            rate = max(1e-6, self._rate_at(i / max(1, self.n_requests)))
+            t += float(rng.exponential(1.0 / rate))
+            out.append(t)
+        return out
+
+    def _prompt_len(self, rng) -> int:
+        span = self.max_prompt - self.min_prompt
+        if span == 0:
+            return self.min_prompt
+        # Zipf over the EXTRA length past min_prompt, clipped to the
+        # span: heavy-tailed, bounded, seeded
+        extra = int(rng.zipf(self.zipf_a)) - 1
+        return self.min_prompt + min(span, extra)
+
+    def requests(self) -> List[Dict[str, Any]]:
+        """The request bodies, index-aligned with :meth:`arrivals`.
+        Seeded independently of the arrival stream so changing the
+        shape never reshuffles the prompts."""
+        rng = numpy.random.RandomState(self.seed + 1)
+        prefixes = [
+            [int(x) for x in rng.randint(1, self.vocab,
+                                         size=self.prefix_len)]
+            for _ in range(self.n_prefixes)]
+        out = []
+        for i in range(self.n_requests):
+            t_p = self._prompt_len(rng)
+            prompt = [int(x) for x in rng.randint(1, self.vocab,
+                                                  size=t_p)]
+            if self.prefix_len and rng.rand() < self.shared_fraction:
+                pfx = prefixes[int(rng.randint(self.n_prefixes))]
+                prompt[:len(pfx)] = pfx
+            body: Dict[str, Any] = {
+                "prompt": prompt, "n_new": self.n_new,
+                "priority": ("batch"
+                             if rng.rand() < self.batch_fraction
+                             else "interactive"),
+            }
+            if rng.rand() < self.sample_fraction:
+                body["mode"] = "sample"
+                body["temperature"] = self.temperature
+                body["seed"] = int(rng.randint(1, 2 ** 31 - 1))
+            else:
+                body["mode"] = "greedy"
+            if rng.rand() < self.stream_fraction:
+                body["stream"] = True
+            if self.deadline_ms is not None \
+                    and body["priority"] == "interactive":
+                body["deadline_ms"] = self.deadline_ms
+            out.append(body)
+        return out
+
+    def describe(self) -> Dict[str, Any]:
+        """The knob block, stamped into every loadgen report."""
+        return {
+            "n_requests": self.n_requests, "rate": self.rate,
+            "shape": self.shape, "zipf_a": self.zipf_a,
+            "min_prompt": self.min_prompt,
+            "max_prompt": self.max_prompt, "n_new": self.n_new,
+            "shared_fraction": self.shared_fraction,
+            "prefix_len": self.prefix_len,
+            "batch_fraction": self.batch_fraction,
+            "stream_fraction": self.stream_fraction,
+            "sample_fraction": self.sample_fraction,
+            "deadline_ms": self.deadline_ms, "seed": self.seed,
+        }

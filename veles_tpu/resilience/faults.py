@@ -22,7 +22,12 @@ Actions:
 Params: ``p`` (fire probability, default 1 — the die is rolled on the
 PRNG-seeded ``faults`` stream, so a seeded run injects the same faults
 every time), ``after`` (skip the first N hits), ``times`` (fire at
-most N times), ``delay`` (seconds, for action=delay).
+most N times), ``delay`` (seconds, for action=delay), ``window=T0:T1``
+(armed only between the T0-th and T1-th trigger: the clause skips the
+first T0 hits and disarms after the T1-th — a timed chaos STORM as a
+plain spec, e.g. ``serve.page_alloc:raise:window=50:80`` fails page
+allocations 51..80 and then heals; the loadgen harness arms its storms
+this way).
 
 The spec comes from the ``VELES_FAULTS`` env var (wins) or
 ``root.common.resilience.faults``. With neither set, every
@@ -36,7 +41,7 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..config import root
 from ..error import VelesError
@@ -198,7 +203,8 @@ class Fault:
 
     def __init__(self, point: str, action: str, p: float = 1.0,
                  after: int = 0, times: Optional[int] = None,
-                 delay: float = 0.05) -> None:
+                 delay: float = 0.05,
+                 window: Optional[Tuple[int, int]] = None) -> None:
         if point not in POINTS:
             raise VelesError(
                 "unknown fault injection point %r (registered: %s)"
@@ -208,12 +214,19 @@ class Fault:
                              % (action, "/".join(ACTIONS)))
         if not 0.0 <= p <= 1.0:
             raise VelesError("fault probability p=%r outside [0, 1]" % p)
+        if window is not None:
+            lo, hi = int(window[0]), int(window[1])
+            if lo < 0 or hi <= lo:
+                raise VelesError(
+                    "fault window=%d:%d needs 0 <= T0 < T1" % (lo, hi))
+            window = (lo, hi)
         self.point = point
         self.action = action
         self.p = float(p)
         self.after = int(after)
         self.times = None if times is None else int(times)
         self.delay = float(delay)
+        self.window = window
         self.hits = 0
         self.fired = 0
 
@@ -221,6 +234,11 @@ class Fault:
         """Roll this clause once; True when it fires now."""
         self.hits += 1
         if self.hits <= self.after:
+            return False
+        if self.window is not None and not (
+                self.window[0] < self.hits <= self.window[1]):
+            # a timed storm: armed only between the T0-th and T1-th
+            # trigger, then the point heals
             return False
         if self.times is not None and self.fired >= self.times:
             return False
@@ -241,9 +259,11 @@ class Fault:
         return data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
 
     def __repr__(self) -> str:
-        return ("<Fault %s:%s p=%g after=%d times=%s fired=%d/%d>"
+        win = ("" if self.window is None
+               else " window=%d:%d" % self.window)
+        return ("<Fault %s:%s p=%g after=%d times=%s%s fired=%d/%d>"
                 % (self.point, self.action, self.p, self.after,
-                   self.times, self.fired, self.hits))
+                   self.times, win, self.fired, self.hits))
 
 
 def parse_spec(text: str) -> List[Fault]:
@@ -251,22 +271,34 @@ def parse_spec(text: str) -> List[Fault]:
     the grammar). Empty/whitespace text parses to no faults."""
     faults: List[Fault] = []
     for clause in filter(None, (c.strip() for c in (text or "").split(";"))):
-        parts = clause.split(":")
+        # maxsplit=2: the param field may itself contain ":"
+        # (window=T0:T1) — only the first two colons structure the
+        # clause
+        parts = clause.split(":", 2)
         if len(parts) < 2:
             raise VelesError(
                 "fault clause %r is not point:action[:k=v,...]" % clause)
-        kwargs: Dict[str, float] = {}
+        kwargs: Dict[str, object] = {}
         if len(parts) > 2 and parts[2].strip():
             for kv in parts[2].split(","):
                 key, sep, val = kv.partition("=")
                 key = key.strip()
-                if not sep or key not in ("p", "after", "times", "delay"):
+                if not sep or key not in ("p", "after", "times",
+                                          "delay", "window"):
                     raise VelesError(
                         "fault param %r in %r is not one of "
-                        "p/after/times/delay=value" % (kv, clause))
+                        "p/after/times/delay/window=value"
+                        % (kv, clause))
                 try:
-                    kwargs[key] = (float(val) if key in ("p", "delay")
-                                   else int(val))
+                    if key == "window":
+                        lo, sep2, hi = val.partition(":")
+                        if not sep2:
+                            raise ValueError("want window=T0:T1")
+                        kwargs[key] = (int(lo), int(hi))
+                    else:
+                        kwargs[key] = (float(val)
+                                       if key in ("p", "delay")
+                                       else int(val))
                 except ValueError as e:
                     raise VelesError("bad fault param %r: %s" % (kv, e))
         faults.append(Fault(parts[0].strip(), parts[1].strip(), **kwargs))

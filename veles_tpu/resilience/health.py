@@ -195,8 +195,16 @@ def shed(handler, retry_after: float = 1.0,
     ``request_id`` (the ticket's, or the router-supplied one) rides
     the body so a fleet router can correlate the shed with the
     attempt it belongs to — success bodies already carry the id via
-    ``Ticket.succeed``."""
+    ``Ticket.succeed``. With QoS on, the stamped hint scales with the
+    live queue pressure (serving/overload.py) so storming clients back
+    off proportionally; with it off the hint passes through
+    unchanged."""
     inc("veles_shed_requests_total")
+    try:
+        from ..serving.overload import dynamic_retry_after
+        retry_after = dynamic_retry_after(retry_after) or retry_after
+    except Exception:       # noqa: BLE001 — shedding must never fail
+        pass
     body = {"error": reason, "retry_after": retry_after}
     if request_id is not None:
         body["request_id"] = request_id

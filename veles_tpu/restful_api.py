@@ -481,12 +481,31 @@ class GenerationAPI(Unit):
             raise ValueError("'stream' must be a boolean")
         if stream and not bool(root.common.serving.get("stream", True)):
             stream = False
+        # QoS class + deadline (docs/services.md "Overload & QoS"):
+        # unlabeled requests are interactive (batch is OPT-IN to
+        # throttling/preemption); deadline_ms replaces the global
+        # request_timeout for this request's queue sweep and handler
+        # wait, capped by it — a client can only tighten
+        from .serving.overload import QOS_PRIORITIES
+        priority = body.get("priority", "interactive")
+        if priority not in QOS_PRIORITIES:
+            raise ValueError("'priority' must be one of %s"
+                             % (QOS_PRIORITIES,))
+        deadline_ms = body.get("deadline_ms")
+        if deadline_ms is not None:
+            if isinstance(deadline_ms, bool) \
+                    or not isinstance(deadline_ms, (int, float)) \
+                    or deadline_ms <= 0:
+                raise ValueError("'deadline_ms' must be a positive "
+                                 "number of milliseconds")
+            deadline_ms = float(deadline_ms)
         req = {"prompt": [int(t) for t in prompt] + resume_tokens,
                "n_new": n_new, "resume_k": len(resume_tokens),
                "mode": mode, "temperature": temperature, "seed": seed,
                "gamma": gamma, "beam": beam, "eos_id": eos_id,
                "request_id": request_id, "trace_id": trace_id,
-               "attempt": attempt, "stream": stream}
+               "attempt": attempt, "stream": stream,
+               "priority": priority, "deadline_ms": deadline_ms}
         if req["gamma"] < 1:
             raise ValueError("'gamma' must be >= 1")
         if req["beam"] < 1:
@@ -895,8 +914,16 @@ class GenerationAPI(Unit):
                 # through lifecycle spans, flight events and the
                 # response body by the Ticket itself) — unless a fleet
                 # router already assigned one upstream
+                # the request's own deadline (when set) replaces the
+                # global request_timeout for the queue sweep AND this
+                # handler's wait — capped by the global so a client
+                # can only tighten, never extend
+                wait_budget = api.request_timeout
+                if req.get("deadline_ms"):
+                    wait_budget = min(wait_budget,
+                                      req["deadline_ms"] / 1000.0)
                 ticket = _Ticket(
-                    deadline=time.time() + api.request_timeout,
+                    deadline=time.time() + wait_budget,
                     request_id=req.get("request_id"),
                     mode=req.get("mode", "greedy"),
                     trace_id=req.get("trace_id"),
@@ -978,15 +1005,20 @@ class GenerationAPI(Unit):
                     api._inflight += 1
                 try:
                     if ticket.stream:
-                        self._stream_reply(ticket, via_engine)
+                        self._stream_reply(ticket, via_engine,
+                                           wait_budget)
                     else:
-                        self._await_and_reply(ticket, via_engine)
+                        self._await_and_reply(ticket, via_engine,
+                                              wait_budget)
                 finally:
                     with api._cv:
                         api._inflight -= 1
                         api._cv.notify_all()
 
-            def _await_and_reply(self, ticket, via_engine):
+            def _await_and_reply(self, ticket, via_engine,
+                                 wait_budget=None):
+                if wait_budget is None:
+                    wait_budget = api.request_timeout
                 try:
                     # the replica-death chaos point, request-path
                     # site: the request IS in flight (admitted to a
@@ -1019,7 +1051,7 @@ class GenerationAPI(Unit):
                 # slack past the deadline: the queue-side expiry
                 # (503 + Retry-After, counted) should win the race
                 # against this handler's own last-resort 504
-                if not ticket.event.wait(api.request_timeout + 1.0):
+                if not ticket.event.wait(wait_budget + 1.0):
                     json_reply(self, 504,
                                {"error": "generation timed out",
                                 "request_id": ticket.request_id})
@@ -1038,7 +1070,9 @@ class GenerationAPI(Unit):
                         api.requests_served += 1
                 if ticket.error is not None:
                     headers = None
-                    retry_after = getattr(ticket, "retry_after", None)
+                    # pressure-scaled backoff hint (no-op with QoS
+                    # off: the hint equals the stamped value then)
+                    retry_after = ticket.retry_after_hint()
                     if retry_after:
                         import math as _math
                         headers = {"Retry-After": str(max(1, int(
@@ -1049,7 +1083,8 @@ class GenerationAPI(Unit):
                     return
                 json_reply(self, 200, ticket.result)
 
-            def _stream_reply(self, ticket, via_engine):
+            def _stream_reply(self, ticket, via_engine,
+                              wait_budget=None):
                 """``stream=true``: chunked-transfer SSE — one
                 ``data: {tokens, i}`` event per step boundary (the
                 engine pushes at chunk ends; window-plane requests
@@ -1059,6 +1094,8 @@ class GenerationAPI(Unit):
                 resume progress included, so a router proxying this
                 stream re-streams only the remainder after a replica
                 death)."""
+                if wait_budget is None:
+                    wait_budget = api.request_timeout
                 import queue as _q
                 try:
                     # the replica-death chaos point, request-path
@@ -1088,7 +1125,7 @@ class GenerationAPI(Unit):
                     sse_event(self, payload)
 
                 sent = 0
-                deadline = time.time() + api.request_timeout + 1.0
+                deadline = time.time() + wait_budget + 1.0
                 try:
                     while True:
                         budget = deadline - time.time()

@@ -47,6 +47,11 @@ from .recurrent import (RecurrentEngine,               # noqa: F401
 from .journal import RequestJournal                   # noqa: F401
 from .router import (CircuitBreaker, FleetRouter,     # noqa: F401
                      ROUTER_COUNTERS, Replica, ReplicaSupervisor)
+from .overload import (AIMDController,                # noqa: F401
+                       BrownoutLadder, OverloadGovernor,
+                       QOS_PRIORITIES, RetryTokenBucket,
+                       dynamic_retry_after, governor_from_config,
+                       request_priority, retry_after_hint)
 
 #: every counter the lossless request plane increments (durable
 #: journal + token-level failover resume + drain-by-handoff) —
@@ -106,6 +111,21 @@ O1_COUNTERS = (
     "veles_o1_state_restored_tokens_total",
     "veles_o1_state_rescans_total",
     "veles_o1_state_evictions_total",
+)
+
+#: every counter the overload-hardened request plane increments (QoS
+#: preempt-and-resume + AIMD admission + brownout ladder + retry
+#: storm control, serving/overload.py) — registered with HELP strings
+#: in telemetry/counters.py DESCRIPTIONS and asserted zero in QoS-off
+#: runs by ``python bench.py gate``'s overload section
+QOS_COUNTERS = (
+    "veles_qos_preemptions_total",
+    "veles_qos_preempted_tokens_total",
+    "veles_qos_batch_deferrals_total",
+    "veles_qos_throttled_total",
+    "veles_qos_brownout_transitions_total",
+    "veles_qos_degraded_requests_total",
+    "veles_qos_retry_denied_total",
 )
 
 #: every latency histogram the request-plane SLO layer records

@@ -340,6 +340,23 @@ class ContinuousEngine(Logger):
                                        page_pool=self.page_pool,
                                        beam_width=self.beam_width,
                                        spec_gamma=self.spec_gamma)
+        # QoS plane (root.common.serving.qos, CLI --serve-qos;
+        # docs/services.md "Overload & QoS"): priority-aware admission
+        # + lossless batch preemption. Off (the default) = scheduler
+        # order, dispatch counts and outputs bit-identical to the
+        # FIFO engine (test-locked feature-off lock).
+        self.qos = bool(serving_cfg.get("qos", False))
+        self.scheduler.qos = self.qos
+        #: stable pressure source for dynamic Retry-After hints —
+        #: registered only while a QoS engine runs (a bound method is
+        #: a fresh object per access, so the identity-checked
+        #: clear_pressure_provider needs this one stored)
+        self._pressure_fn = lambda: (self.scheduler.queue_depth(),
+                                     max(8, self.max_slots * 8))
+        #: batch rows preempted for interactive admission / decoded
+        #: tokens those preemptions preserved losslessly (stats keys)
+        self.preemptions = 0
+        self.preempted_tokens = 0
         # the draft workflow enables mode=speculative on the pool; an
         # unusable draft degrades spec to the window plane, never the
         # whole engine
@@ -410,6 +427,9 @@ class ContinuousEngine(Logger):
         if self.artifact and not self.artifact_mode:
             self._load_artifact()
         self._closing = False
+        if self.qos:
+            from .overload import set_pressure_provider
+            set_pressure_provider(self._pressure_fn)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=self.name + ".engine")
         self._thread.start()
@@ -446,6 +466,8 @@ class ContinuousEngine(Logger):
             # retired above, the refcount ledger must balance to zero
             # (the poisoning regression test closes the loop)
             self.prefix_cache.clear()
+        from .overload import clear_pressure_provider
+        clear_pressure_provider(self._pressure_fn)
         from . import unregister_engine
         unregister_engine(self)
 
@@ -624,6 +646,12 @@ class ContinuousEngine(Logger):
             "queue_depth": self.scheduler.queue_depth(),
             "admitted": self.admitted,
             "retired": self.retired,
+            # QoS plane (docs/services.md "Overload & QoS"): priority
+            # admission + lossless batch preemption, all zero with the
+            # knob off
+            "qos": int(self.qos),
+            "preemptions": self.preemptions,
+            "preempted_tokens": self.preempted_tokens,
             "programs": len(self._progs),
             # slot-kind discriminator: "paged" rows page a KV pool;
             # the O(1) lane (serving/recurrent.py) reports "state" and
@@ -806,6 +834,11 @@ class ContinuousEngine(Logger):
         # chunked-prefill stall gauge measures exactly that window
         had_inflight = self.scheduler.busy_count() > 0
         t_prefill = time.time()
+        if self.qos:
+            # QoS preemption happens HERE, at the step boundary
+            # before admission, so freed slots/pages are handed to
+            # the waiting interactive requests in this same tick
+            self._preempt_for_interactive()
         admissions, expired = self.scheduler.take_admissions()
         shed_expired(expired)
         for slot in admissions:
@@ -864,6 +897,81 @@ class ContinuousEngine(Logger):
             # shed with Retry-After, the pool stays consistent (the
             # fault fires before the dispatch)
             self._abort_active(str(e), code=503, retry_after=1.0)
+
+    # -- QoS preemption --------------------------------------------------------
+    @staticmethod
+    def _emitted(slot) -> List[int]:
+        """Every token this request has emitted since the CLIENT's
+        submission: tokens an in-engine preemption folded back into
+        the prompt (``_qos_prefix``) plus this slot's own decode
+        output. Progress snapshots and final results are built from
+        this, so preemption stays invisible on the wire — a router's
+        own ``resume_tokens`` are NOT included (the router accounts
+        for those itself, exactly as before)."""
+        return list(slot.req.get("_qos_prefix", ())) + list(slot.tokens)
+
+    def _preempt_victims(self, need: int) -> List:
+        """Pick up to ``need`` preemptable batch rows: plain decode
+        modes only (their PRNG stream resumes exactly), fully
+        prefilled, with at least one emitted token and at least one
+        still to go (a row about to finish is cheaper to let finish).
+        Cheapest first — fewest decoded tokens means the smallest
+        re-prefill on resume."""
+        from .overload import request_priority
+        victims = [s for s in self.scheduler.active()
+                   if s.group is None and s.mode in _STEP_MODES
+                   and request_priority(s.req) == "batch"
+                   and s.prefilled is None and s.tokens
+                   and len(s.tokens) < s.n_new]
+        victims.sort(key=lambda s: (len(s.tokens), s.idx))
+        return victims[:max(0, need)]
+
+    def _preempt_for_interactive(self) -> None:
+        """QoS preemption at the step boundary (docs/services.md
+        "Overload & QoS"): when more interactive requests wait than
+        free slots exist, batch rows are preempted through the
+        token-level resume path — emitted tokens fold back into the
+        prompt (:func:`fold_resume`), ``resume_k`` accumulates so the
+        resumed prefill re-enters the per-slot PRNG stream exactly,
+        and the SAME un-terminated ticket requeues. No terminal
+        fires, no histogram double-samples: the client of a preempted
+        batch request just sees a pause, and its final answer is
+        bit-identical to an uninterrupted decode (test-locked)."""
+        from .overload import qos_preempt_enabled, request_priority
+        if not qos_preempt_enabled():
+            return
+        with self.scheduler.cv:
+            waiting = sum(
+                1 for req, _t in self.scheduler._queue
+                if request_priority(req) == "interactive")
+            free = len(self.scheduler._free)
+        if waiting <= free:
+            return
+        for slot in self._preempt_victims(waiting - free):
+            emitted = self._emitted(slot)
+            resumed = fold_resume(slot.req, slot.tokens)
+            # chained folds accumulate: the PRNG must advance one
+            # split per token EVER emitted for this request, not just
+            # this preemption's batch (fold_resume alone records only
+            # the latest fold — correct for the router's single-shot
+            # wire form, not for repeated in-engine preemption)
+            resumed["resume_k"] = (int(slot.req.get("resume_k", 0)
+                                       or 0) + len(slot.tokens))
+            resumed["_qos_prefix"] = emitted
+            resumed["_requeued"] = True
+            # progress rides the ticket too: a failure between
+            # preemption and completion still answers with the full
+            # resume record
+            slot.ticket.set_progress(emitted)
+            self._retire_slot(slot)
+            self.scheduler.push(resumed, slot.ticket)
+            self.preemptions += 1
+            self.preempted_tokens += len(slot.tokens)
+            inc("veles_qos_preemptions_total")
+            inc("veles_qos_preempted_tokens_total", len(slot.tokens))
+            self.debug("%s: preempted batch request %s at %d tokens "
+                       "(lossless resume queued)", self.name,
+                       slot.ticket.request_id, len(emitted))
 
     def _prepare_params(self) -> Dict:
         """Fresh device-side params for the serving programs: the
@@ -997,9 +1105,13 @@ class ContinuousEngine(Logger):
                 self._draft_caches)
             inc("veles_serving_prefill_dispatches_total")
         if group is None:
-            inc("veles_serving_admitted_total")
-            inc("veles_serving_queue_wait_seconds_total", wait)
-            self.admitted += 1
+            if not slot.req.get("_requeued"):
+                # a preempted-and-requeued request was admitted (and
+                # its queue wait counted) once already — exactly-once
+                # accounting holds across preempt → requeue → finish
+                inc("veles_serving_admitted_total")
+                inc("veles_serving_queue_wait_seconds_total", wait)
+                self.admitted += 1
             first = int(first)
             # the int() above synced the prefill dispatch: this step
             # boundary IS prefill-done and first-token time (host-side
@@ -1131,9 +1243,12 @@ class ContinuousEngine(Logger):
             inc("veles_resume_tokens_total", resume_k)
         wait = max(0.0, (slot.ticket.admitted or time.time())
                    - slot.ticket.enqueued)
-        inc("veles_serving_admitted_total")
-        inc("veles_serving_queue_wait_seconds_total", wait)
-        self.admitted += 1
+        if not slot.req.get("_requeued"):
+            # preempted-and-requeued rows were counted at their first
+            # admission (see _admit) — never twice
+            inc("veles_serving_admitted_total")
+            inc("veles_serving_queue_wait_seconds_total", wait)
+            self.admitted += 1
         slot.prefilled = start
         self._pos[slot.idx] = start
         self._temp[slot.idx] = slot.temperature
@@ -1180,7 +1295,7 @@ class ContinuousEngine(Logger):
                 # shed with a resume payload: nothing was emitted yet,
                 # so the payload is the (possibly empty) progress — a
                 # router retry redoes the prefill elsewhere
-                slot.ticket.set_progress(slot.tokens)
+                slot.ticket.set_progress(self._emitted(slot))
                 self._retire_slot(slot)
                 if slot.ticket.fail(
                         "injected prefill-chunk fault: %s" % e,
@@ -1274,7 +1389,8 @@ class ContinuousEngine(Logger):
             # fail()'s first-terminal True keeps a ticket another
             # sweep already answered from counting twice
             if slot.mode in _STEP_MODES:
-                victims[0].ticket.set_progress(victims[0].tokens)
+                victims[0].ticket.set_progress(
+                    self._emitted(victims[0]))
             if victims[0].ticket.fail(
                     "serving page pool exhausted mid-decode",
                     code=503, retry_after=1.0):
@@ -1462,7 +1578,11 @@ class ContinuousEngine(Logger):
         # depend on which plane served the request
         batched_with = max(0, self.scheduler.busy_count() - 1)
         self._retire_slot(slot)
-        result = {"tokens": list(slot.tokens),
+        # _emitted prepends any tokens an in-engine QoS preemption
+        # folded back into the prompt — the client's answer covers
+        # the WHOLE generation, bit-identical to an uninterrupted run
+        tokens = self._emitted(slot)
+        result = {"tokens": tokens,
                   "batched_with": batched_with,
                   "engine": "continuous"}
         if slot.mode == "speculative":
@@ -1474,7 +1594,7 @@ class ContinuousEngine(Logger):
         # not push retired past admitted
         if slot.ticket.succeed(result):
             inc("veles_serving_retired_total")
-            inc("veles_serving_tokens_total", len(slot.tokens))
+            inc("veles_serving_tokens_total", len(tokens))
             self.retired += 1
 
     def _finish_beam(self, group) -> None:
@@ -1507,8 +1627,10 @@ class ContinuousEngine(Logger):
             # carries {resume: ...} and a failover retry re-enters the
             # decode at tokens_done instead of token 0 (plain decode
             # modes only — spec/beam retries restart from scratch)
-            if slot.mode in _STEP_MODES and slot.tokens:
-                slot.ticket.set_progress(slot.tokens)
+            if slot.mode in _STEP_MODES \
+                    and (slot.tokens
+                         or slot.req.get("_qos_prefix")):
+                slot.ticket.set_progress(self._emitted(slot))
             self._retire_slot(slot)
             if id(slot.ticket) not in answered:
                 answered.add(id(slot.ticket))
@@ -1570,7 +1692,7 @@ class ContinuousEngine(Logger):
                         "%s (%s) — handing off without resume",
                         self.name, ticket.request_id, e)
                 if snapshot_ok and slot.mode in _STEP_MODES:
-                    ticket.set_progress(slot.tokens)
+                    ticket.set_progress(self._emitted(slot))
                 if ticket.fail(reason, code=503, retry_after=1.0,
                                outcome="handoff"):
                     if ticket.progress:

@@ -1,0 +1,476 @@
+"""Overload-control plane: QoS classes, adaptive admission, brownout.
+
+The request plane survives *failures* (router failover, journal
+replay, token-level resume) but until this module nothing defended it
+when offered load exceeds capacity: every request was equal priority,
+one global ``request_timeout`` governed every deadline, shedding was a
+binary 503 and a storm of client retries amplified exactly the
+overload that caused it. This module is the host-side policy layer —
+pure bookkeeping, no jax — that the scheduler, the engines, the
+GenerationAPI and the FleetRouter consult:
+
+* **QoS classes** — requests carry ``priority`` (``interactive`` |
+  ``batch``). With ``root.common.serving.qos`` on, the
+  ``SlotScheduler`` admits interactive requests past queued batch
+  work, and the engines preempt batch rows at a step boundary via the
+  token-level resume path (``fold_resume`` + ``advanced_prng_key``)
+  so preempted work finishes bit-identical to an uninterrupted
+  decode — preemption is lossless, never wasteful.
+
+* **Adaptive admission** (:class:`AIMDController`) — the FleetRouter
+  throttles BATCH admission with an additive-increase /
+  multiplicative-decrease rate keyed on the observed TTFT p99 vs an
+  SLO target (the PR 11 histograms). Interactive traffic is never
+  AIMD-throttled: the controller exists to protect it.
+
+* **Brownout ladder** (:class:`BrownoutLadder`) — hysteresis-guarded
+  graceful degradation: level 1 caps ``n_new``, level 2 disables
+  speculative decoding (downgraded to the equivalent plain mode),
+  level 3 sheds batch outright. Entry and exit each require
+  ``patience`` consecutive observations beyond their (asymmetric)
+  thresholds, so a noisy p99 cannot flap the fleet between levels.
+
+* **Retry token bucket** (:class:`RetryTokenBucket`) — a router-wide
+  budget on failover retries, capping retry amplification during a
+  storm: when the bucket is dry the router answers with the last
+  attempt's error instead of hammering the surviving replicas.
+
+* **Dynamic Retry-After** (:func:`retry_after_hint`,
+  :func:`dynamic_retry_after`) — shed answers derive their backoff
+  hint from live queue pressure instead of a static constant, clamped
+  to ``[base, RETRY_AFTER_MAX]`` so storming clients back off
+  proportionally. The pressure provider is registered only while a
+  QoS-enabled engine runs (feature-off lock: with the knob off, every
+  shed answer is byte-identical to the static hints).
+
+Every knob defaults OFF; with defaults the scheduler order, dispatch
+counts and outputs are bit-identical to the pre-QoS plane
+(test-enforced by tests/test_overload.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..telemetry.counters import histograms, inc
+
+#: the two service classes; requests that say nothing are
+#: ``interactive`` — unlabeled traffic is latency-sensitive by
+#: default, batch is an explicit opt-in to being throttled/preempted
+QOS_PRIORITIES = ("interactive", "batch")
+
+#: dynamic Retry-After clamp ceiling (seconds) — a hint must stay
+#: actionable; "come back in 10 minutes" is a disguised outage
+RETRY_AFTER_MAX = 30.0
+
+
+def request_priority(req: Dict) -> str:
+    """The request's service class, defaulting unlabeled traffic to
+    ``interactive`` (see :data:`QOS_PRIORITIES`)."""
+    p = req.get("priority")
+    return p if p in QOS_PRIORITIES else "interactive"
+
+
+def qos_enabled() -> bool:
+    """THE serving-side QoS switch (``root.common.serving.qos``,
+    default off). Gates priority-aware admission, batch preemption
+    and the dynamic Retry-After pressure provider — off means the
+    request plane behaves bit-identically to the pre-QoS code."""
+    try:
+        from ..config import root
+        return bool(root.common.serving.get("qos", False))
+    except Exception:       # noqa: BLE001 — config not importable
+        return False
+
+
+def qos_preempt_enabled() -> bool:
+    """Whether QoS may preempt batch rows mid-decode
+    (``root.common.serving.qos_preempt``, default on — only consulted
+    when :func:`qos_enabled` already is)."""
+    try:
+        from ..config import root
+        return bool(root.common.serving.get("qos_preempt", True))
+    except Exception:       # noqa: BLE001
+        return True
+
+
+# -- dynamic Retry-After ------------------------------------------------------
+def retry_after_hint(depth: int, capacity: int,
+                     lo: float = 1.0,
+                     hi: float = RETRY_AFTER_MAX) -> float:
+    """Backoff hint proportional to queue pressure: ``lo`` at an
+    empty queue, ``hi`` at (or past) ``capacity`` queued requests.
+    Pure function — the planes feed it their live depth."""
+    cap = max(1, int(capacity))
+    frac = min(1.0, max(0, int(depth)) / float(cap))
+    return lo + (hi - lo) * frac
+
+
+_pressure_lock = threading.Lock()
+_pressure_provider: Optional[Callable[[], Tuple[int, int]]] = None
+
+
+def set_pressure_provider(fn: Callable[[], Tuple[int, int]]) -> None:
+    """Register the live ``() -> (queue_depth, capacity)`` source
+    shed answers derive their Retry-After from. A QoS-enabled engine
+    registers its scheduler here at start; last writer wins (one
+    provider per process is enough — any engine's pressure is the
+    process's pressure)."""
+    global _pressure_provider
+    with _pressure_lock:
+        _pressure_provider = fn
+
+
+def clear_pressure_provider(fn: Callable[[], Tuple[int, int]]) -> None:
+    """Unregister ``fn`` if it is still the current provider (an
+    engine stopping must not clobber a sibling's registration)."""
+    global _pressure_provider
+    with _pressure_lock:
+        if _pressure_provider is fn:
+            _pressure_provider = None
+
+
+def dynamic_retry_after(base: Optional[float]) -> Optional[float]:
+    """The one Retry-After derivation every shed answer goes through
+    (``Ticket.error_payload``, ``health.shed``): with a pressure
+    provider registered, scale the static ``base`` hint by live queue
+    depth, clamped to ``[base, RETRY_AFTER_MAX]`` — an idle queue
+    answers exactly ``base``, so values only ever change under real
+    pressure (and never at all with QoS off, when no provider is
+    registered). Never raises: a broken provider answers ``base``."""
+    if base is None:
+        return None
+    fn = _pressure_provider
+    if fn is None:
+        return base
+    try:
+        depth, capacity = fn()
+        hint = retry_after_hint(int(depth), int(capacity), lo=base)
+    except Exception:       # noqa: BLE001 — hint only, never the answer
+        return base
+    return min(RETRY_AFTER_MAX, max(float(base), float(hint)))
+
+
+# -- adaptive admission -------------------------------------------------------
+class AIMDController:
+    """Additive-increase / multiplicative-decrease admission rate for
+    BATCH traffic, keyed on an observed latency quantile vs an SLO
+    target. ``rate`` is the fraction of batch requests admitted
+    (1.0 = all). The grant decision is a DETERMINISTIC credit
+    accumulator, not a coin flip — at rate r, exactly ``floor(n*r)``
+    of any n consecutive batch arrivals are admitted, so tests and
+    drills reproduce bit-for-bit."""
+
+    def __init__(self, slo_ms: float = 500.0,
+                 metric: str = "veles_serving_ttft_seconds",
+                 quantile: float = 0.99,
+                 floor: float = 0.05, additive: float = 0.05,
+                 multiplicative: float = 0.5,
+                 interval: float = 0.5) -> None:
+        self.slo_ms = float(slo_ms)
+        self.metric = str(metric)
+        self.quantile = float(quantile)
+        self.floor = float(floor)
+        self.additive = float(additive)
+        self.multiplicative = float(multiplicative)
+        self.interval = float(interval)
+        self.rate = 1.0
+        self._credit = 0.0
+        self._last_obs = 0.0
+        self._lock = threading.Lock()
+
+    def observed_ms(self) -> Optional[float]:
+        """The controller's live signal: the configured quantile of
+        the configured histogram, in milliseconds (None before any
+        sample — the controller holds at its current rate)."""
+        q = histograms.quantile(self.metric, self.quantile)
+        return None if q is None else q * 1000.0
+
+    def observe(self, now: Optional[float] = None,
+                value_ms: Optional[float] = None) -> float:
+        """Poll the signal (at most once per ``interval``) and adjust:
+        above SLO → multiplicative decrease toward ``floor``; at or
+        below → additive increase toward 1.0. Returns the current
+        rate. ``value_ms`` injects the signal directly (tests, and
+        the ladder sharing one poll)."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if value_ms is None:
+                if now - self._last_obs < self.interval:
+                    return self.rate
+                self._last_obs = now
+                value_ms = self.observed_ms()
+            if value_ms is None:
+                return self.rate
+            if value_ms > self.slo_ms:
+                self.rate = max(self.floor,
+                                self.rate * self.multiplicative)
+            else:
+                self.rate = min(1.0, self.rate + self.additive)
+            return self.rate
+
+    def grant(self) -> bool:
+        """Admit-or-throttle for one batch arrival at the current
+        rate (deterministic thinning via credit accumulation)."""
+        with self._lock:
+            self._credit += self.rate
+            if self._credit >= 1.0:
+                self._credit -= 1.0
+                return True
+            return False
+
+
+class BrownoutLadder:
+    """Hysteresis-guarded graceful degradation. Levels::
+
+        0  normal      — nothing degraded
+        1  cap_n_new   — batch generation budgets capped
+        2  no_spec     — speculative decoding downgraded to its
+                         plain equivalent (greedy / sample)
+        3  shed_batch  — batch requests shed outright (503);
+                         interactive still served
+
+    A level is ENTERED after ``patience`` consecutive observations
+    above ``slo_ms * enter`` and EXITED after ``patience`` consecutive
+    observations below ``slo_ms * exit`` — the asymmetric band
+    (enter > exit) plus the patience counters are the hysteresis that
+    keeps a noisy p99 from flapping the fleet between levels."""
+
+    LEVELS = ("normal", "cap_n_new", "no_spec", "shed_batch")
+
+    def __init__(self, slo_ms: float = 500.0, enter: float = 1.5,
+                 exit: float = 0.8, patience: int = 3,
+                 cap_n_new: int = 32) -> None:
+        if exit >= enter:
+            raise ValueError(
+                "brownout exit threshold %.3g must sit below the "
+                "enter threshold %.3g (the hysteresis band)"
+                % (exit, enter))
+        self.slo_ms = float(slo_ms)
+        self.enter = float(enter)
+        self.exit = float(exit)
+        self.patience = max(1, int(patience))
+        self.cap_n_new = max(1, int(cap_n_new))
+        self.level = 0
+        self.transitions = 0
+        self._hot = 0
+        self._cool = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value_ms: Optional[float]) -> int:
+        """Feed one latency observation (ms); returns the (possibly
+        changed) level. ``None`` (no samples yet) holds the level."""
+        with self._lock:
+            if value_ms is None:
+                return self.level
+            if value_ms > self.slo_ms * self.enter:
+                self._hot += 1
+                self._cool = 0
+                if self._hot >= self.patience \
+                        and self.level < len(self.LEVELS) - 1:
+                    self.level += 1
+                    self.transitions += 1
+                    self._hot = 0
+                    inc("veles_qos_brownout_transitions_total")
+            elif value_ms < self.slo_ms * self.exit:
+                self._cool += 1
+                self._hot = 0
+                if self._cool >= self.patience and self.level > 0:
+                    self.level -= 1
+                    self.transitions += 1
+                    self._cool = 0
+                    inc("veles_qos_brownout_transitions_total")
+            else:
+                # inside the hysteresis band: hold, reset streaks
+                self._hot = 0
+                self._cool = 0
+            return self.level
+
+    def degrade(self, body: Dict) -> bool:
+        """Apply the current level's degradation to a request body
+        IN PLACE (level 1+: cap ``n_new``; level 2+: speculative →
+        its plain equivalent — temperature 0 speculative IS greedy
+        and sampled speculative keeps its sampling distribution as
+        ``mode=sample``, so answers stay within contract while the
+        draft/verify cost disappears). Returns True when anything
+        was changed (the caller counts degraded requests). Level 3
+        shedding is an ADMISSION decision, not a mutation — see
+        :meth:`OverloadGovernor.admit`."""
+        changed = False
+        if self.level >= 1:
+            n_new = body.get("n_new")
+            if isinstance(n_new, int) and n_new > self.cap_n_new:
+                body["n_new"] = self.cap_n_new
+                changed = True
+        if self.level >= 2 and body.get("mode") == "speculative":
+            t = body.get("temperature", 0.0)
+            body["mode"] = ("sample"
+                            if isinstance(t, (int, float)) and t > 0
+                            else "greedy")
+            changed = True
+        return changed
+
+
+class RetryTokenBucket:
+    """Router-wide failover-retry budget: ``rate`` tokens/second up
+    to ``burst``. Every failover retry takes one token; a dry bucket
+    denies the retry, capping the amplification factor a storm of
+    failing attempts can impose on surviving replicas. Thread-safe;
+    the clock is injectable for tests."""
+
+    def __init__(self, rate: float = 10.0, burst: float = 20.0,
+                 clock: Optional[Callable[[], float]] = None) -> None:
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._clock = clock or time.monotonic
+        self._tokens = self.burst
+        self._last = self._clock()
+        self._lock = threading.Lock()
+
+    def take(self) -> bool:
+        with self._lock:
+            now = self._clock()
+            self._tokens = min(
+                self.burst,
+                self._tokens + max(0.0, now - self._last) * self.rate)
+            self._last = now
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def available(self) -> float:
+        with self._lock:
+            now = self._clock()
+            return min(
+                self.burst,
+                self._tokens + max(0.0, now - self._last) * self.rate)
+
+
+class OverloadGovernor:
+    """The FleetRouter's overload policy bundle: one AIMD controller,
+    one brownout ladder and one retry bucket sharing a single
+    (interval-throttled) poll of the SLO histograms. The router asks
+    :meth:`admit` before dispatching a request, :meth:`degrade` to
+    apply brownout mutations, and :meth:`allow_retry` before each
+    failover retry; :meth:`snapshot` feeds the router's /metrics
+    gauges."""
+
+    def __init__(self, slo_ms: float = 500.0,
+                 metric: str = "veles_serving_ttft_seconds",
+                 quantile: float = 0.99,
+                 aimd_floor: float = 0.05, aimd_add: float = 0.05,
+                 aimd_mult: float = 0.5, interval: float = 0.5,
+                 brownout_enter: float = 1.5,
+                 brownout_exit: float = 0.8,
+                 brownout_patience: int = 3,
+                 brownout_cap_n_new: int = 32,
+                 retry_rate: float = 10.0,
+                 retry_burst: float = 20.0) -> None:
+        self.aimd = AIMDController(
+            slo_ms=slo_ms, metric=metric, quantile=quantile,
+            floor=aimd_floor, additive=aimd_add,
+            multiplicative=aimd_mult, interval=interval)
+        self.ladder = BrownoutLadder(
+            slo_ms=slo_ms, enter=brownout_enter, exit=brownout_exit,
+            patience=brownout_patience, cap_n_new=brownout_cap_n_new)
+        self.retries = RetryTokenBucket(rate=retry_rate,
+                                        burst=retry_burst)
+        self._obs_lock = threading.Lock()
+        self._last_obs = 0.0
+
+    def observe(self, now: Optional[float] = None,
+                value_ms: Optional[float] = None) -> None:
+        """One throttled poll feeding BOTH the AIMD rate and the
+        ladder (they must see the same signal, or they could disagree
+        about which regime the fleet is in)."""
+        now = time.monotonic() if now is None else now
+        with self._obs_lock:
+            if value_ms is None:
+                if now - self._last_obs < self.aimd.interval:
+                    return
+                self._last_obs = now
+                value_ms = self.aimd.observed_ms()
+        self.aimd.observe(now=now, value_ms=value_ms)
+        self.ladder.observe(value_ms)
+
+    def admit(self, body: Dict) -> Optional[str]:
+        """Admission verdict for one request: None to admit, else the
+        shed reason. Interactive traffic is ALWAYS admitted — the
+        whole apparatus exists to protect it; batch absorbs the
+        throttling (AIMD thinning, then level-3 outright shedding)."""
+        self.observe()
+        if request_priority(body) != "batch":
+            return None
+        if self.ladder.level >= 3:
+            inc("veles_qos_throttled_total")
+            return ("brownout level %d (%s): batch requests shed"
+                    % (self.ladder.level,
+                       self.ladder.LEVELS[self.ladder.level]))
+        if not self.aimd.grant():
+            inc("veles_qos_throttled_total")
+            return ("batch admission throttled (AIMD rate %.2f vs "
+                    "TTFT p99 over %.0f ms SLO)"
+                    % (self.aimd.rate, self.aimd.slo_ms))
+        return None
+
+    def degrade(self, body: Dict) -> None:
+        """Apply brownout mutations to an ADMITTED request body,
+        counting each degraded request once."""
+        if self.ladder.degrade(body):
+            inc("veles_qos_degraded_requests_total")
+
+    def allow_retry(self) -> bool:
+        """One failover retry's token — False caps the storm (the
+        router answers with the last attempt's error instead)."""
+        if self.retries.take():
+            return True
+        inc("veles_qos_retry_denied_total")
+        return False
+
+    def retry_after(self, base: float = 1.0) -> float:
+        """Shed-answer backoff hint scaled by how throttled batch
+        admission currently is (rate 1.0 → ``base``; at the AIMD
+        floor → :data:`RETRY_AFTER_MAX`)."""
+        pressure = 1.0 - self.aimd.rate
+        return min(RETRY_AFTER_MAX,
+                   max(base, base + (RETRY_AFTER_MAX - base)
+                       * pressure))
+
+    def snapshot(self) -> Dict[str, float]:
+        """Live gauges for /metrics (documented in
+        docs/observability.md)."""
+        return {"veles_qos_admit_rate": round(self.aimd.rate, 4),
+                "veles_qos_brownout_level": float(self.ladder.level),
+                "veles_qos_retry_tokens": round(
+                    self.retries.available(), 2)}
+
+
+def governor_from_config() -> Optional[OverloadGovernor]:
+    """Build the router's governor from ``root.common.router.*``
+    knobs, or None when ``root.common.router.qos`` (default off) is
+    not set — the feature-off router runs the exact pre-QoS path."""
+    try:
+        from ..config import root
+        cfg = root.common.router
+        if not bool(cfg.get("qos", False)):
+            return None
+        return OverloadGovernor(
+            slo_ms=float(cfg.get("slo_ttft_ms", 500.0)),
+            metric=str(cfg.get("slo_metric",
+                               "veles_serving_ttft_seconds")),
+            quantile=float(cfg.get("slo_quantile", 0.99)),
+            aimd_floor=float(cfg.get("aimd_floor", 0.05)),
+            aimd_add=float(cfg.get("aimd_add", 0.05)),
+            aimd_mult=float(cfg.get("aimd_mult", 0.5)),
+            interval=float(cfg.get("aimd_interval", 0.5)),
+            brownout_enter=float(cfg.get("brownout_enter", 1.5)),
+            brownout_exit=float(cfg.get("brownout_exit", 0.8)),
+            brownout_patience=int(cfg.get("brownout_patience", 3)),
+            brownout_cap_n_new=int(cfg.get("brownout_cap_n_new", 32)),
+            retry_rate=float(cfg.get("retry_rate", 10.0)),
+            retry_burst=float(cfg.get("retry_burst", 20.0)))
+    except Exception:       # noqa: BLE001 — config not importable
+        return None

@@ -167,6 +167,15 @@ class RecurrentEngine(Logger):
                                        self.max_context,
                                        page_pool=None,
                                        slot_kind="state")
+        #: QoS plane (docs/services.md "Overload & QoS"): off by
+        #: default — the feature-off lock keeps admission strict FIFO
+        #: and the preemption path structurally unreachable
+        self.qos = bool(serving_cfg.get("qos", False))
+        self.scheduler.qos = self.qos
+        self._pressure_fn = lambda: (self.scheduler.queue_depth(),
+                                     max(8, self.max_slots * 8))
+        self.preemptions = 0
+        self.preempted_tokens = 0
         self._progs: Dict = {}
         self._params = None
         self._states = None
@@ -198,6 +207,9 @@ class RecurrentEngine(Logger):
             return self
         if self.artifact and not self.artifact_mode:
             self._load_artifact()
+        if self.qos:
+            from .overload import set_pressure_provider
+            set_pressure_provider(self._pressure_fn)
         self._closing = False
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=self.name + ".engine")
@@ -227,6 +239,8 @@ class RecurrentEngine(Logger):
                            retry_after=5.0, count_shed=False)
         if self.state_cache is not None:
             self.state_cache.clear()
+        from .overload import clear_pressure_provider
+        clear_pressure_provider(self._pressure_fn)
         from . import unregister_engine
         unregister_engine(self)
 
@@ -314,6 +328,9 @@ class RecurrentEngine(Logger):
             "queue_depth": self.scheduler.queue_depth(),
             "admitted": self.admitted,
             "retired": self.retired,
+            "qos": int(self.qos),
+            "preemptions": self.preemptions,
+            "preempted_tokens": self.preempted_tokens,
             "programs": len(self._progs),
             # the slot-kind discriminator: /metrics renders
             # veles_serving_pages_* rows ONLY for paged engines, so a
@@ -431,6 +448,8 @@ class RecurrentEngine(Logger):
             params = self._params = params_of(self.wf)
         self._ensure_pool(params)
         from .scheduler import shed_expired
+        if self.qos:
+            self._preempt_for_interactive()
         admissions, expired = self.scheduler.take_admissions()
         shed_expired(expired)
         for slot in admissions:
@@ -460,6 +479,62 @@ class RecurrentEngine(Logger):
                 self._decode(params)
         except FaultInjected as e:
             self._abort_active(str(e), code=503, retry_after=1.0)
+
+    # -- QoS preemption --------------------------------------------------------
+    @staticmethod
+    def _emitted(slot) -> List[int]:
+        """Every token the client's ORIGINAL request has produced so
+        far: internally-folded preempt prefixes plus this admission's
+        tokens. All progress/result reporting goes through this so
+        preemption stays invisible to the wire."""
+        return list(slot.req.get("_qos_prefix", ())) + list(slot.tokens)
+
+    def _preempt_victims(self, need: int) -> List:
+        from .overload import request_priority
+        victims = [s for s in self.scheduler.active()
+                   if s.group is None and s.mode in _STEP_MODES
+                   and request_priority(s.req) == "batch"
+                   and s.prefilled is None and s.tokens
+                   and len(s.tokens) < s.n_new]
+        # evict the least-invested first (fewest tokens to re-fold)
+        victims.sort(key=lambda s: (len(s.tokens), s.idx))
+        return victims[:max(0, need)]
+
+    def _preempt_for_interactive(self) -> None:
+        """Free state slots for queued interactive requests by
+        requeueing batch rows at this step boundary with their resume
+        payload — same fold_resume/advanced_prng_key machinery as
+        failover, so the preempted decode finishes bit-identical."""
+        from .overload import qos_preempt_enabled, request_priority
+        if not qos_preempt_enabled():
+            return
+        with self.scheduler.cv:
+            waiting = sum(1 for req, _t in self.scheduler._queue
+                          if request_priority(req) == "interactive")
+            free = len(self.scheduler._free)
+        if waiting <= free:
+            return
+        for slot in self._preempt_victims(waiting - free):
+            emitted = self._emitted(slot)
+            resumed = fold_resume(slot.req, slot.tokens)
+            # fold_resume records only THIS fold's length; the PRNG
+            # re-entry point is every token ever emitted, so a twice-
+            # preempted request must accumulate
+            resumed["resume_k"] = (int(slot.req.get("resume_k", 0)
+                                       or 0) + len(slot.tokens))
+            resumed["_qos_prefix"] = emitted
+            resumed["_requeued"] = True
+            slot.ticket.set_progress(emitted)
+            self._retire_slot(slot)
+            self.scheduler.push(resumed, slot.ticket)
+            self.preemptions += 1
+            self.preempted_tokens += len(slot.tokens)
+            inc("veles_qos_preemptions_total")
+            inc("veles_qos_preempted_tokens_total", len(slot.tokens))
+            self.debug("%s: preempted batch slot %d at %d tokens for "
+                       "an interactive admission (request %s)",
+                       self.name, slot.idx, len(slot.tokens),
+                       slot.ticket.request_id)
 
     def _ensure_pool(self, params) -> None:
         if self._states is not None:
@@ -574,9 +649,12 @@ class RecurrentEngine(Logger):
                 p0 = boundary
         self._pos[slot.idx] = t_p
         self._temp[slot.idx] = slot.temperature
-        inc("veles_serving_admitted_total")
-        inc("veles_serving_queue_wait_seconds_total", wait)
-        self.admitted += 1
+        if not slot.req.get("_requeued"):
+            # a preempt-requeue is the SAME admitted request coming
+            # back — count it once, at its first admission
+            inc("veles_serving_admitted_total")
+            inc("veles_serving_queue_wait_seconds_total", wait)
+            self.admitted += 1
         first = int(first)
         slot.ticket.mark_prefill_done()
         slot.ticket.mark_first_token()
@@ -661,12 +739,13 @@ class RecurrentEngine(Logger):
     def _finish(self, slot) -> None:
         batched_with = max(0, self.scheduler.busy_count() - 1)
         self._retire_slot(slot)
-        result = {"tokens": list(slot.tokens),
+        tokens = self._emitted(slot)
+        result = {"tokens": tokens,
                   "batched_with": batched_with,
                   "engine": "recurrent"}
         if slot.ticket.succeed(result):
             inc("veles_serving_retired_total")
-            inc("veles_serving_tokens_total", len(slot.tokens))
+            inc("veles_serving_tokens_total", len(tokens))
             self.retired += 1
 
     def _abort_active(self, reason: str, code: int = 500,
@@ -674,8 +753,9 @@ class RecurrentEngine(Logger):
                       count_shed: bool = True) -> None:
         answered = set()
         for slot in self.scheduler.active():
-            if slot.mode in _STEP_MODES and slot.tokens:
-                slot.ticket.set_progress(slot.tokens)
+            if slot.mode in _STEP_MODES and (
+                    slot.tokens or slot.req.get("_qos_prefix")):
+                slot.ticket.set_progress(self._emitted(slot))
             self._retire_slot(slot)
             if id(slot.ticket) not in answered:
                 answered.add(id(slot.ticket))
@@ -721,7 +801,7 @@ class RecurrentEngine(Logger):
                         "%s (%s) — handing off without resume",
                         self.name, ticket.request_id, e)
                 if snapshot_ok and slot.mode in _STEP_MODES:
-                    ticket.set_progress(slot.tokens)
+                    ticket.set_progress(self._emitted(slot))
                 if ticket.fail(reason, code=503, retry_after=1.0,
                                outcome="handoff"):
                     if ticket.progress:

@@ -442,6 +442,11 @@ class FleetRouter(Logger):
         #: backlog) — the journal-pending gauge without re-reading
         #: the segments on every /metrics scrape
         self._journal_outstanding = 0
+        # overload governor (serving/overload.py, docs/services.md
+        # "Overload & QoS"): None unless root.common.router.qos —
+        # the feature-off router runs the exact pre-QoS path
+        from .overload import governor_from_config
+        self.governor = governor_from_config()
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "FleetRouter":
@@ -698,6 +703,25 @@ class FleetRouter(Logger):
         return None
 
     # -- routing -------------------------------------------------------------
+    def _request_budget(self, body: Dict) -> float:
+        """Per-request routing budget: a sane ``deadline_ms`` CAPS
+        the global request_timeout (deadline propagation's router
+        leg — the replica applies the same cap to its ticket, so one
+        number bounds the whole client→router→replica→sweep chain); a
+        client can only tighten, never extend. Garbage values fall
+        back to the global (the replica's _parse answers the 400)."""
+        budget = self.request_timeout
+        dl = body.get("deadline_ms")
+        if dl is None or isinstance(dl, bool):
+            return budget
+        try:
+            dl = float(dl)
+        except (TypeError, ValueError):
+            return budget
+        if dl > 0:
+            budget = min(budget, dl / 1000.0)
+        return budget
+
     def _attempt(self, replica: Replica, data: bytes, rid: str,
                  answered: _Answer, state: _Attempt,
                  timeout: float, prefix: Sequence[int] = (),
@@ -828,7 +852,8 @@ class FleetRouter(Logger):
         answered.request_id = rid
         answered.trace_id = tid
         t_req = time.time()
-        deadline = t_req + self.request_timeout
+        budget = self._request_budget(body)
+        deadline = t_req + budget
         tried: List[Replica] = []
         n_attempts = 0
         last_reason = "no ready replica"
@@ -836,7 +861,16 @@ class FleetRouter(Logger):
             remaining = deadline - time.time()
             if remaining <= 0:
                 last_reason = ("request budget %.0fs exhausted"
-                               % self.request_timeout)
+                               % budget)
+                break
+            if tried and self.governor is not None \
+                    and not self.governor.allow_retry():
+                # the router-wide retry token bucket: a storm of
+                # failing attempts must not amplify into a storm of
+                # failover retries — deny and answer with the last
+                # attempt's error
+                last_reason = ("failover retry denied by the "
+                               "router retry budget (storm control)")
                 break
             replica = self.pick(exclude=tried)
             if replica is None:
@@ -982,7 +1016,8 @@ class FleetRouter(Logger):
         base_k = len(prefix)
         inc("veles_router_requests_total")
         t_req = time.time()
-        deadline = t_req + self.request_timeout
+        budget = self._request_budget(body)
+        deadline = t_req + budget
         state = {"headers": False, "sent": 0}
 
         def event(payload):
@@ -1029,7 +1064,12 @@ class FleetRouter(Logger):
             remaining = deadline - time.time()
             if remaining <= 0:
                 last_reason = ("request budget %.0fs exhausted"
-                               % self.request_timeout)
+                               % budget)
+                break
+            if tried and self.governor is not None \
+                    and not self.governor.allow_retry():
+                last_reason = ("failover retry denied by the "
+                               "router retry budget (storm control)")
                 break
             replica = self.pick(exclude=tried)
             if replica is None:
@@ -1365,6 +1405,21 @@ class FleetRouter(Logger):
                 "(in flight or awaiting replay)")
             gauges["veles_router_journal_enabled"] = (
                 1, "1 when the durable request journal is on")
+        if self.governor is not None:
+            snap = self.governor.snapshot()
+            gauges["veles_qos_admit_rate"] = (
+                snap["veles_qos_admit_rate"],
+                "AIMD batch admission rate (1.0 = unthrottled, "
+                "falls multiplicatively while TTFT p99 exceeds "
+                "the SLO)")
+            gauges["veles_qos_brownout_level"] = (
+                snap["veles_qos_brownout_level"],
+                "Brownout ladder level (0 normal, 1 cap n_new, "
+                "2 no speculative, 3 shed batch)")
+            gauges["veles_qos_retry_tokens"] = (
+                snap["veles_qos_retry_tokens"],
+                "Failover retry tokens currently available in the "
+                "router-wide storm-control bucket")
         return gauges
 
     def roster(self) -> Dict[str, Any]:
@@ -1448,6 +1503,23 @@ class FleetRouter(Logger):
                                {"error": "bad request: 'stream' "
                                          "must be a boolean"})
                     return
+                gov = router.governor
+                if gov is not None:
+                    # adaptive admission BEFORE the durability
+                    # boundary: a throttled request was never
+                    # accepted, so nothing to journal or replay.
+                    # Interactive always passes; brownout mutations
+                    # (n_new cap, speculative off) apply to whatever
+                    # is admitted
+                    reason = gov.admit(body)
+                    if reason is not None:
+                        health.shed(self,
+                                    retry_after=gov.retry_after(),
+                                    reason=reason,
+                                    request_id=body.get("request_id")
+                                    or new_request_id())
+                        return
+                    gov.degrade(body)
                 # the durability boundary: the request exists in the
                 # journal BEFORE its first dispatch, so a router
                 # SIGKILL after this line loses nothing — restart

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..resilience.faults import FaultInjected, fire as fire_fault
 from ..telemetry.counters import inc, observe
+from .overload import dynamic_retry_after, request_priority
 from .pages import PagePool, pages_for
 
 _request_ids = itertools.count(1)
@@ -264,7 +265,12 @@ class Ticket:
         body: Dict = {"error": self.error,
                       "request_id": self.request_id}
         if self.retry_after is not None:
-            body["retry_after"] = self.retry_after
+            # dynamic backoff (docs/services.md "Overload & QoS"):
+            # with a QoS pressure provider registered, the hint
+            # scales with live queue depth so storming clients back
+            # off proportionally; with QoS off, exactly the static
+            # hint the terminal call set
+            body["retry_after"] = self.retry_after_hint()
         if self.progress is not None:
             # the token-level resume record: this ATTEMPT's emitted
             # tokens (a resumed attempt reports only its own new
@@ -273,6 +279,13 @@ class Ticket:
             body["resume"] = {"tokens": list(self.progress),
                               "tokens_done": len(self.progress)}
         return body
+
+    def retry_after_hint(self) -> Optional[float]:
+        """The ``Retry-After`` value this ticket's failure answer
+        should carry — the static hint :meth:`fail` set, scaled by
+        live queue pressure when a QoS pressure provider is
+        registered (serving/overload.py)."""
+        return dynamic_retry_after(self.retry_after)
 
     def _account(self, outcome: str) -> None:
         """Terminal SLO accounting — histograms always, span/flight
@@ -479,6 +492,13 @@ class SlotScheduler:
         self._queue: deque = deque()
         self._free: List[int] = list(range(self.max_slots))
         self.slots: List[Optional[Slot]] = [None] * self.max_slots
+        #: QoS switch (set by the owning engine from
+        #: ``root.common.serving.qos``): True makes admission
+        #: priority-aware — interactive requests jump queued batch
+        #: work (see :meth:`_promote_interactive_locked`). False (the
+        #: default) keeps strict FIFO, bit-identical to the pre-QoS
+        #: scheduler.
+        self.qos = False
 
     # -- admission geometry --------------------------------------------------
     def bucket_for(self, t_p: int) -> Optional[int]:
@@ -606,6 +626,8 @@ class SlotScheduler:
         admissions: List[Slot] = []
         expired: List[Ticket] = []
         with self.cv:
+            if self.qos and len(self._queue) > 1:
+                self._promote_interactive_locked()
             while self._queue:
                 req, ticket = self._queue[0]
                 if ticket.deadline is not None and now > ticket.deadline:
@@ -692,6 +714,28 @@ class SlotScheduler:
             self._queue = deque(live)
             expired.extend(exp)
         return admissions, expired
+
+    def _promote_interactive_locked(self) -> None:
+        """QoS admission order (``self.qos`` on, under ``cv``): a
+        stable two-lane reorder — interactive tickets move ahead of
+        queued batch work, each class keeping its own FIFO order —
+        after which the admission loop runs UNCHANGED, so the
+        page-wait / beam-cap semantics are identical in both modes.
+        Batch is deferred, never dropped: it admits the moment no
+        interactive request is waiting. Counts how many batch
+        requests an interactive arrival actually jumped."""
+        q = list(self._queue)
+        hot = [p for p in q if request_priority(p[0]) == "interactive"]
+        cold = [p for p in q if request_priority(p[0]) != "interactive"]
+        if not hot or not cold or q == hot + cold:
+            return
+        last_hot = max(i for i, p in enumerate(q)
+                       if request_priority(p[0]) == "interactive")
+        jumped = sum(1 for p in q[:last_hot]
+                     if request_priority(p[0]) != "interactive")
+        if jumped:
+            inc("veles_qos_batch_deferrals_total", jumped)
+        self._queue = deque(hot + cold)
 
     def retire(self, slot: Slot) -> None:
         """Free the row — the very next :meth:`take_admissions` can

@@ -274,6 +274,48 @@ DESCRIPTIONS = {
     "veles_trace_fleet_merges_total":
         "Cross-process fleet traces assembled (span pulls merged "
         "onto one clock, one Chrome-trace lane per process)",
+    # overload-hardened request plane (serving/overload.py QoS +
+    # brownout governor, engine preempt-and-resume): bench.py's gate
+    # asserts these read 0 in QoS-off runs
+    "veles_qos_preemptions_total":
+        "Batch decode rows preempted at a step boundary to free "
+        "slots for waiting interactive requests (the row requeues "
+        "with its emitted tokens and resumes bit-identical)",
+    "veles_qos_preempted_tokens_total":
+        "Tokens already decoded by preempted batch rows at the "
+        "moment of preemption (all carried through the resume, none "
+        "re-decoded)",
+    "veles_qos_batch_deferrals_total":
+        "Queued batch requests jumped by interactive arrivals in the "
+        "priority-aware admission order (each deferral counts once "
+        "per sweep it was overtaken in)",
+    "veles_qos_throttled_total":
+        "Batch requests refused admission by the router's AIMD "
+        "controller or brownout ladder (503 + scaled Retry-After; "
+        "interactive is never throttled)",
+    "veles_qos_brownout_transitions_total":
+        "Brownout ladder level changes in either direction "
+        "(normal -> cap_n_new -> no_spec -> shed_batch and back)",
+    "veles_qos_degraded_requests_total":
+        "Admitted requests degraded by the brownout ladder (n_new "
+        "capped or speculative decoding stripped)",
+    "veles_qos_retry_denied_total":
+        "Failover retries denied by the router-wide retry token "
+        "bucket (storm control: failed first attempts still answer, "
+        "they just do not amplify)",
+    # load/chaos harness (veles_tpu/loadgen/): bench.py's gate
+    # asserts these read 0 in non-loadgen runs
+    "veles_loadgen_requests_total":
+        "Requests dispatched open-loop by the load harness",
+    "veles_loadgen_shed_total":
+        "Load-harness requests answered 503 (shed/throttled/expired "
+        "by the fleet under test)",
+    "veles_loadgen_errors_total":
+        "Load-harness requests that failed for any non-shed reason "
+        "(transport errors, non-503 HTTP errors, timeouts)",
+    "veles_loadgen_storms_total":
+        "Timed chaos storms armed on the fault plane by the load "
+        "harness (one per storm clause per run)",
 }
 
 
