@@ -224,7 +224,7 @@ def _section_counters(before, step=None, seconds=None, smoke=False,
 
 
 def _section_devtime(run_epoch, sync, epochs, durations, counters_rec,
-                     n_chips=1):
+                     n_chips=1, dtype=None):
     """The section's device-time stamp (telemetry/devtime.py):
     ``{device_time_s, wall_time_s, mfu_device, device_time_per_epoch,
     source, ...}``.
@@ -237,8 +237,13 @@ def _section_devtime(run_epoch, sync, epochs, durations, counters_rec,
     ``veles_devtime_fallbacks_total``), the stamp falls back to the
     sync-bracketed window wall time itself. ``mfu_device`` is the
     CostModel FLOPs-per-epoch (from the section's counters record)
-    over device-time-per-epoch and the chip's nominal bf16 peak — the
-    MFU the ISSUE-9 roofline targets are stated against."""
+    over device-time-per-epoch and the chip's nominal peak FOR THE
+    SECTION'S COMPUTE DTYPE (``dtype=`` — f32 sections are graded
+    against PEAK_F32, not mispriced 2x against the bf16 peak; default
+    bf16 preserves the historical denominator for mixed-precision
+    sections). The peak used is stamped into the record
+    (``peak_flops_used``/``peak_dtype``/``peak_source``) so every MFU
+    names its own denominator."""
     from veles_tpu.telemetry import devtime as _devtime
     rec = _devtime.measure(run_epoch, sync)
     med_eps = statistics.median(epochs)
@@ -268,11 +273,16 @@ def _section_devtime(run_epoch, sync, epochs, durations, counters_rec,
         # `veles-tpu trace self-time --spans` prints)
         out["spans"] = {k: round(v["device_time_s"], 6)
                         for k, v in rec["spans"].items()}
+    from veles_tpu.telemetry.cost import peak_flops_entry
+    peak_source, peak = peak_flops_entry(dtype or "bfloat16")
+    out["peak_flops_used"] = peak
+    out["peak_dtype"] = str(dtype or "bfloat16")
+    out["peak_source"] = peak_source
     flops = (counters_rec or {}).get("flops")
     n_eps = (counters_rec or {}).get("epochs")
     if flops and n_eps and per_epoch > 0:
         out["mfu_device"] = (flops / n_eps) / per_epoch / (
-            peak_bf16_flops() * n_chips)
+            peak * n_chips)
     return out
 
 
@@ -281,8 +291,10 @@ def _stamp_devtime(section, devtime_rec):
     level — ``{device_time_s, wall_time_s, mfu_device}`` — plus the
     full record under ``devtime`` (what ``bench.py gate`` reads)."""
     section["devtime"] = devtime_rec
-    for key in ("device_time_s", "wall_time_s", "mfu_device"):
-        section[key] = devtime_rec[key]
+    for key in ("device_time_s", "wall_time_s", "mfu_device",
+                "peak_flops_used", "peak_dtype", "peak_source"):
+        if key in devtime_rec:
+            section[key] = devtime_rec[key]
     return section
 
 
@@ -318,8 +330,11 @@ def bench_mnist(dev, n_chips, smoke=False, h=None):
     counters_rec = _section_counters(before, wf.train_step,
                                      seconds=sum(durs), smoke=smoke,
                                      n_chips=n_chips, epochs=sum(eps))
+    # the mnist section trains in plain f32 — its MFU denominator is
+    # the f32 peak, not the bf16 one (satellite of the linalg family)
     dt = _section_devtime(run_epoch, lambda: host_sync(wf.train_step),
-                          eps, durs, counters_rec, n_chips=n_chips)
+                          eps, durs, counters_rec, n_chips=n_chips,
+                          dtype="float32")
     from veles_tpu import datasets
     return _stamp_devtime({
         "samples_per_sec_per_chip": statistics.median(rates) / n_chips,
@@ -407,7 +422,8 @@ def _bench_conv_ae_inner(dev, n_chips, minibatch_size=64):
                                      n_chips=n_chips,
                                      epochs=sum(epochs))
     dt = _section_devtime(run_epoch, lambda: host_sync(wf.train_step),
-                          epochs, durs, counters_rec, n_chips=n_chips)
+                          epochs, durs, counters_rec, n_chips=n_chips,
+                          dtype="bfloat16")
     from veles_tpu.config import root
     # rates count every served sample; the metric is labeled TRAIN
     # throughput, so scale out the validation passes each epoch carries
@@ -479,7 +495,7 @@ def bench_lm(dev, n_chips, cfg_overrides=None,
         dt = _section_devtime(run_epoch,
                               lambda: host_sync(wf.train_step),
                               epochs, durs, counters_rec,
-                              n_chips=n_chips)
+                              n_chips=n_chips, dtype="bfloat16")
         train_frac = n_tr / (n_tr + n_va)
         return _stamp_devtime({
             "metric": "lm_train_tokens_per_sec_per_chip",
@@ -693,6 +709,13 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # and exactly-once-terminal measurements are gate_overload's
         # live drill
         "overload": _overload_section(),
+        # distributed linear-algebra family (veles_tpu/linalg/): the
+        # training bench never runs blocked kernels or solvers, so
+        # every linalg counter MUST read zero here — the gate fails on
+        # leakage; the blocked-vs-dense residual, dtype-correct MFU
+        # and predicted-vs-measured measurements are gate_linalg's
+        # live proof (and `python bench.py linalg` standalone)
+        "linalg": _linalg_section(),
         "extras": [ae, lm],
     }
 
@@ -859,6 +882,26 @@ def _overload_section():
     short = lambda n: n[len("veles_"):-len("_total")]  # noqa: E731
     return {short(name): int(counters.get(name))
             for name in QOS_COUNTERS + LOADGEN_COUNTERS}
+
+
+def _linalg_section():
+    """Every distributed linear-algebra counter for this bench process
+    — absolute reads (one process, counters start at zero). The bench
+    trains neural nets and never dispatches a blocked kernel or runs a
+    solver, so every count MUST be zero — ``bench.py gate`` fails on
+    leakage. The live proof (blocked matmul / Cholesky solve / CG on
+    the Poisson operator matching the dense reference within stated
+    tolerance, MFU graded against the f32 peak, predicted-vs-measured
+    SUMMA step time) runs inside ``gate_linalg`` and stamps its
+    numbers there. ``linalg_bench`` marks a document produced by
+    ``bench.py linalg`` where nonzero counts are the point."""
+    from veles_tpu.linalg import LINALG_COUNTERS
+    from veles_tpu.telemetry.counters import counters
+    short = lambda n: n[len("veles_linalg_"):-len("_total")]  # noqa: E731
+    out = {"linalg_bench": False}
+    out.update((short(name), int(counters.get(name)))
+               for name in LINALG_COUNTERS)
+    return out
 
 
 def _lossless_section():
@@ -3137,6 +3180,186 @@ def _o1state_proof():
     return failures, metrics
 
 
+def gate_linalg(baseline_doc=None, current_doc=None):
+    """``linalg`` gate section: (1) every distributed linear-algebra
+    counter must be registered with a HELP string; (2) legacy bench
+    documents that predate the linalg family (no ``linalg`` section at
+    all) are TOLERATED — counted on
+    ``veles_bench_legacy_sections_total``, never a crash, the same
+    rule legacy device-time documents get; (3) documents that do carry
+    the section must show ZERO linalg activity unless stamped
+    ``linalg_bench`` — the training bench never dispatches a blocked
+    kernel, so a matmul/solve count in a training measurement means
+    the workload family leaked; (4) live proof
+    (:func:`_linalg_proof`): blocked matmul and Cholesky solve match
+    the dense reference within the stated dtype tolerance on this
+    process's device mesh, CG on the Poisson operator converges below
+    1e-5, MFU is graded against the f32 peak table (not bf16), and
+    the SUMMA step prediction states its inputs next to the measured
+    time."""
+    from veles_tpu.linalg import LINALG_COUNTERS
+    from veles_tpu.telemetry.counters import DESCRIPTIONS, inc
+    failures = []
+    for name in LINALG_COUNTERS:
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "linalg: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc),
+                     ("current", current_doc)):
+        if doc and "linalg" not in doc:
+            # pre-family document: tolerated and counted, never a
+            # crash (the PR 8 legacy-document rule)
+            inc("veles_bench_legacy_sections_total")
+            continue
+        sec = (doc or {}).get("linalg")
+        if not sec:
+            continue
+        if sec.get("linalg_bench"):
+            continue      # a `bench.py linalg` run counts on purpose
+        for key, value in sec.items():
+            if key != "linalg_bench" and value:
+                failures.append(
+                    "linalg: %s doc has %s=%s — linear-algebra "
+                    "workload leaked into a training bench run"
+                    % (tag, key, value))
+    proof_failures, metrics = _linalg_proof()
+    if metrics:
+        print("linalg proof: matmul rel err %.1e / cholesky solve "
+              "rel err %.1e vs dense (tol %.1e) on grid %s, CG "
+              "converged in %d iters to %.1e, MFU %.2e at %s, "
+              "SUMMA measured/predicted %.2f"
+              % (metrics["matmul_rel_err"], metrics["chol_rel_err"],
+                 metrics["tolerance"], metrics["grid"],
+                 metrics["cg_iterations"], metrics["cg_residual"],
+                 metrics["mfu"], metrics["peak_source"],
+                 metrics["measured_over_predicted"]))
+    return failures + proof_failures
+
+
+def _linalg_proof():
+    """THE distributed linear-algebra drill, live on this process's
+    devices. Small f32 problems with deliberately awkward shapes
+    (non-divisible blocks) prove the family's claims:
+
+    1. **blocked == dense** — the block-cyclic SUMMA matmul and the
+       right-looking blocked Cholesky solve match ``numpy.linalg``
+       within the stated f32 tolerance on whatever device mesh this
+       process has (1x1 on the gate's CPU, wider on a chip).
+    2. **CG converges and verifies** — the Workflow-graph solver on
+       the 5-point Poisson operator reaches < 1e-5 relative residual
+       and survives the trusted dense re-verification.
+    3. **dtype-correct MFU** — the achieved-FLOP grade divides by the
+       f32 peak table entry, and the stamped source label proves it
+       (an f32 solve graded against the bf16 peak would flatter
+       itself 2x).
+    4. **stated prediction** — ``predict_summa_time`` publishes its
+       inputs (panel bytes, psum bytes, assumed ICI bandwidth) next
+       to the measured step time, the same falsifiable-record shape
+       as SCALING.json.
+
+    Returns (failures, metrics) so the caller can gate and stamp."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy
+    from veles_tpu.linalg import (blocked_matmul, cholesky_solve,
+                                  build_cg_workflow, default_tolerance,
+                                  linalg_mesh, poisson2d_matvec,
+                                  predict_summa_time)
+    from veles_tpu.telemetry.cost import peak_flops_entry
+
+    failures = []
+    rng = numpy.random.RandomState(20260807)
+    mesh = linalg_mesh()
+    grid = tuple(mesh.devices.shape)
+    tol = default_tolerance(numpy.float32)
+
+    # 1a. blocked-cyclic SUMMA matmul vs dense, awkward shapes
+    m, k, n = 96, 80, 72
+    a = rng.standard_normal((m, k)).astype(numpy.float32)
+    b = rng.standard_normal((k, n)).astype(numpy.float32)
+    c = numpy.asarray(blocked_matmul(a, b, block=32, mesh=mesh))
+    ref = a.astype(numpy.float64) @ b.astype(numpy.float64)
+    mm_err = float(numpy.linalg.norm(c - ref)
+                   / numpy.linalg.norm(ref))
+    if not mm_err < tol:
+        failures.append(
+            "linalg: blocked matmul off dense reference by %.3e "
+            "(tolerance %.3e) on grid %s" % (mm_err, tol, grid))
+    # timed step (second call: compiled) for MFU + the prediction row
+    t0 = time.perf_counter()
+    blocked_matmul(a, b, block=32, mesh=mesh)
+    measured_s = max(time.perf_counter() - t0, 1e-9)
+    peak_source, peak = peak_flops_entry("float32")
+    if "PEAK_F32" not in peak_source:
+        failures.append(
+            "linalg: f32 matmul graded against %s — MFU must use the "
+            "f32 peak table, not bf16" % peak_source)
+    mfu = (2.0 * m * n * k) / (measured_s * peak * mesh.size)
+    pred = predict_summa_time(m, k, n, grid, t1_step_s=measured_s,
+                              dtype=numpy.float32)
+    for field in ("block_bytes_a_panel", "block_bytes_b_panel",
+                  "psum_bytes_per_device",
+                  "ici_bw_assumed_bytes_per_s", "ici_bw_source"):
+        if field not in pred["inputs"]:
+            failures.append(
+                "linalg: predict_summa_time hides its %s input — the "
+                "prediction must state every assumption" % field)
+
+    # 1b. blocked Cholesky solve vs dense (check=True re-verifies the
+    # residual through the trusted dense path and raises on failure)
+    size = 72
+    g = rng.standard_normal((size, size)).astype(numpy.float32)
+    spd = g @ g.T + size * numpy.eye(size, dtype=numpy.float32)
+    rhs = rng.standard_normal((size, 3)).astype(numpy.float32)
+    try:
+        x = numpy.asarray(cholesky_solve(spd, rhs, block=32,
+                                         mesh=mesh, check=True))
+        xref = numpy.linalg.solve(spd.astype(numpy.float64),
+                                  rhs.astype(numpy.float64))
+        ch_err = float(numpy.linalg.norm(x - xref)
+                       / numpy.linalg.norm(xref))
+    except Exception as e:        # noqa: BLE001
+        ch_err = float("inf")
+        failures.append("linalg: cholesky_solve failed live: %s" % e)
+    if not ch_err < tol:
+        failures.append(
+            "linalg: cholesky solve off dense reference by %.3e "
+            "(tolerance %.3e)" % (ch_err, tol))
+
+    # 2. CG on the Poisson model problem, on the Workflow graph
+    pn = 16
+    prhs = rng.standard_normal(pn * pn).astype(numpy.float32)
+    wf = build_cg_workflow(poisson2d_matvec(pn), prhs, tol=1e-6,
+                           max_iters=400)
+    wf.initialize()
+    wf.run()
+    cg = wf.cg_decision.get_metric_values()
+    if not (cg["converged"] and cg["residual"] < 1e-5):
+        failures.append(
+            "linalg: CG on the %dx%d Poisson operator did not reach "
+            "1e-5 (converged=%s residual=%.3e after %d iters)"
+            % (pn, pn, cg["converged"], cg["residual"],
+               cg["iterations"]))
+
+    metrics = {
+        "grid": "%dx%d" % grid,
+        "tolerance": tol,
+        "matmul_rel_err": mm_err,
+        "chol_rel_err": ch_err,
+        "cg_iterations": int(cg["iterations"]),
+        "cg_residual": float(cg["residual"]),
+        "mfu": mfu,
+        "peak_source": peak_source,
+        "peak_flops_used": peak,
+        "measured_step_s": measured_s,
+        "predicted_step_s": pred["predicted_step_s"],
+        "measured_over_predicted": (measured_s
+                                    / max(pred["predicted_step_s"],
+                                          1e-12)),
+    }
+    return failures, metrics
+
+
 def gate_overload(baseline_doc=None, current_doc=None):
     """``overload`` gate section: (1) every QoS + loadgen counter
     must be registered with a HELP string; (2) bench documents must
@@ -3561,6 +3784,11 @@ def _gate_main(argv):
                 # so like the others it runs after the doc-leakage
                 # assertions above
                 + gate_o1state(baseline, current)
+                # the linalg drill runs its own blocked kernels and
+                # solver (moving veles_linalg_* in THIS process), so
+                # like the other live proofs it runs after every
+                # doc-leakage assertion above
+                + gate_linalg(baseline, current)
                 # LAST: the overload drill preempts, throttles and
                 # load-generates for real — its own zero-before-proof
                 # check must see a process no earlier QoS work
@@ -3589,6 +3817,9 @@ def _gate_main(argv):
           "clean + int8 greedy token-exact + artifact serves with "
           "zero compiles, o1state clean + pooled scan/recurrent "
           "id-exact + flat state bytes + equal-HBM slot multiplier, "
+          "linalg clean + blocked matmul/Cholesky within dense "
+          "tolerance + CG converged and re-verified + f32-peak MFU "
+          "stamped, "
           "overload clean + preempted batch id-exact + interactive "
           "lossless under a 2x burst + exactly-once terminals)"
           % (argv[1], argv[0],
@@ -3687,9 +3918,24 @@ def _quant_main():
     return 1 if failures else 0
 
 
+def _linalg_main():
+    """``python bench.py linalg`` — run the distributed linear-algebra
+    drill standalone (blocked-vs-dense residuals, CG convergence,
+    f32-peak MFU, SUMMA prediction) and print its metrics as one JSON
+    line (the numbers docs/perf.md's linalg row cites)."""
+    failures, metrics = _linalg_proof()
+    for failure in failures:
+        print("LINALG FAIL %s" % failure, file=sys.stderr)
+    print(json.dumps(dict(metrics, linalg_bench=True,
+                          failures=len(failures))))
+    return 1 if failures else 0
+
+
 if __name__ == "__main__":
     if len(sys.argv) > 1 and sys.argv[1] == "gate":
         sys.exit(_gate_main(sys.argv[2:]))
     if len(sys.argv) > 1 and sys.argv[1] == "quant":
         sys.exit(_quant_main())
+    if len(sys.argv) > 1 and sys.argv[1] == "linalg":
+        sys.exit(_linalg_main())
     main()

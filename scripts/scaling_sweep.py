@@ -97,13 +97,52 @@ print("RESULT " + json.dumps({
 """
 
 
-def run_width(n: int) -> dict:
+# the distributed linear-algebra width probe (veles_tpu/linalg/): one
+# block-cyclic SUMMA matmul per mesh width, checked against the dense
+# numpy.linalg reference and timed (second call — compiled) for the
+# predicted-vs-measured row. Same virtual-CPU caveat as the training
+# sweep: correctness at every width, not speed.
+LINALG_CHILD = r"""
+import json, os, sys, time
+sys.path.insert(0, %(repo)r)
+import numpy
+from veles_tpu.linalg import (blocked_matmul, default_tolerance,
+                              linalg_mesh)
+
+n = %(n)d
+dim = %(dim)d
+block = %(block)d
+mesh = linalg_mesh()
+grid = tuple(int(g) for g in mesh.devices.shape)
+rng = numpy.random.RandomState(0)
+a = rng.standard_normal((dim, dim)).astype(numpy.float32)
+b = rng.standard_normal((dim, dim)).astype(numpy.float32)
+c = numpy.asarray(blocked_matmul(a, b, block=block, mesh=mesh))
+ref = a.astype(numpy.float64) @ b.astype(numpy.float64)
+rel = float(numpy.linalg.norm(c - ref) / numpy.linalg.norm(ref))
+t0 = time.perf_counter()
+numpy.asarray(blocked_matmul(a, b, block=block, mesh=mesh))
+step = time.perf_counter() - t0
+import jax
+print("RESULT " + json.dumps({
+    "n": n, "grid": list(grid), "dim": dim, "block": block,
+    "rel_err": rel, "tolerance": default_tolerance(numpy.float32),
+    "matches_dense": rel < default_tolerance(numpy.float32),
+    "step_s": round(step, 6),
+    "device_kind": str(getattr(jax.devices()[0], "device_kind",
+                               "unknown")),
+}))
+"""
+
+
+def _run_child(source: str, n: int, **fields) -> dict:
     env = dict(os.environ)
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
                         + " --xla_force_host_platform_device_count=%d" % n)
+    fields.update(repo=REPO, n=n)
     proc = subprocess.run(
-        [sys.executable, "-c", CHILD % {"repo": REPO, "n": n}],
+        [sys.executable, "-c", source % fields],
         capture_output=True, text=True, env=env, timeout=900)
     for line in proc.stdout.splitlines():
         if line.startswith("RESULT "):
@@ -112,11 +151,30 @@ def run_width(n: int) -> dict:
                        % (n, proc.stdout[-2000:], proc.stderr[-2000:]))
 
 
+def run_width(n: int) -> dict:
+    return _run_child(CHILD, n)
+
+
+def run_linalg_width(n: int, dim: int, block: int) -> dict:
+    return _run_child(LINALG_CHILD, n, dim=dim, block=block)
+
+
 def main(argv=None):
     p = argparse.ArgumentParser()
     p.add_argument("--widths", default="1,2,4,8,16,32,64")
     p.add_argument("--out", default=os.path.join(REPO, "SCALING.json"))
+    p.add_argument("--linalg-widths", default="1,2,4,8",
+                   help="mesh widths for the linalg SUMMA sweep")
+    p.add_argument("--linalg-dim", type=int, default=384,
+                   help="square matmul side for the linalg sweep")
+    p.add_argument("--linalg-block", type=int, default=64)
+    p.add_argument("--linalg-only", action="store_true",
+                   help="run only the linalg sweep and merge its "
+                        "block into the existing --out document "
+                        "(the conv sweep's rows are left untouched)")
     args = p.parse_args(argv)
+    if args.linalg_only:
+        return _linalg_main(args)
     widths = sorted({int(w) for w in args.widths.split(",")})
     if widths[0] != 1:
         # the artifact's claim is equivalence TO the 1-device run —
@@ -152,11 +210,48 @@ def main(argv=None):
             "init_s": r["init_s"], "run_s": r["run_s"],
         })
     report["scaling_model"] = scaling_model_block(results)
+    report["linalg"] = _run_linalg_sweep(args)
     with open(args.out, "w") as fout:
         json.dump(report, fout, indent=1)
     print("equivalent across widths:", report["equivalent"])
     print("wrote", args.out)
     return 0 if report["equivalent"] else 1
+
+
+def _run_linalg_sweep(args) -> dict:
+    widths = sorted({int(w) for w in args.linalg_widths.split(",")})
+    if widths[0] != 1:
+        widths.insert(0, 1)      # t1_step_s anchors the prediction
+    results = []
+    for n in widths:
+        t0 = time.time()
+        r = run_linalg_width(n, args.linalg_dim, args.linalg_block)
+        r["wall_s"] = round(time.time() - t0, 1)
+        results.append(r)
+        print("linalg width %2d (grid %dx%d): rel_err=%.2e  "
+              "step=%.3fs  wall=%.0fs"
+              % (n, r["grid"][0], r["grid"][1], r["rel_err"],
+                 r["step_s"], r["wall_s"]), flush=True)
+    return linalg_scaling_block(results)
+
+
+def _linalg_main(args) -> int:
+    """--linalg-only: refresh just the ``linalg`` block of an existing
+    SCALING.json (the conv sweep is ~an hour; the SUMMA sweep is
+    minutes — they regenerate independently)."""
+    block = _run_linalg_sweep(args)
+    try:
+        with open(args.out) as fin:
+            report = json.load(fin)
+    except (OSError, ValueError):
+        report = {}
+    report["linalg"] = block
+    with open(args.out, "w") as fout:
+        json.dump(report, fout, indent=1)
+    ok = all(r["matches_dense"] for r in block["per_width"])
+    print("linalg matches dense at every width:", ok)
+    print("wrote", args.out)
+    return 0 if ok else 1
 
 
 def scaling_model_block(results):
@@ -213,6 +308,78 @@ def scaling_model_block(results):
                     if not on_chip else
                     "measured_step_s includes the first step's "
                     "jit compile"),
+        "per_width": rows,
+    }
+
+
+def linalg_scaling_block(results):
+    """The linalg family's falsifiable predicted-vs-measured row,
+    mirroring :func:`scaling_model_block` (the PR 9 elastic row): the
+    SUMMA model ``t_pred = t1_step/N + psum_bytes/ici_bw`` with every
+    input stated — the measured 1-device step time, the per-device A/B
+    panel bytes and summed psum traffic of the G-panel broadcast
+    schedule, and the assumed ICI bandwidth
+    (telemetry/cost.py DEFAULT_ICI_BW unless a chip names a better
+    entry). Virtual-CPU caveat identical to the training row: the 1/N
+    compute term is refuted by design off-chip; blocked-vs-dense
+    correctness is the claim that must hold at every width."""
+    sys.path.insert(0, REPO)
+    from veles_tpu.linalg import predict_summa_time
+    base = results[0]
+    device_kind = base.get("device_kind", "unknown")
+    on_chip = "tpu" in device_kind.lower()
+    dim, blk = base["dim"], base["block"]
+    rows = []
+    for r in results:
+        pred = predict_summa_time(dim, dim, dim, tuple(r["grid"]),
+                                  t1_step_s=base["step_s"],
+                                  device_kind=device_kind)
+        rows.append({
+            "n": r["n"],
+            "grid": r["grid"],
+            "rel_err_vs_dense": r["rel_err"],
+            "matches_dense": r["matches_dense"],
+            "predicted_step_s": round(pred["predicted_step_s"], 6),
+            "predicted_compute_s": round(pred["compute_s"], 6),
+            "predicted_comm_s": round(pred["comm_s"], 9),
+            "block_bytes_a_panel": pred["inputs"][
+                "block_bytes_a_panel"],
+            "block_bytes_b_panel": pred["inputs"][
+                "block_bytes_b_panel"],
+            "psum_bytes_per_device": pred["inputs"][
+                "psum_bytes_per_device"],
+            "measured_step_s": r["step_s"],
+            "measured_over_predicted": round(
+                r["step_s"] / pred["predicted_step_s"], 3)
+            if pred["predicted_step_s"] else None,
+        })
+    ref = predict_summa_time(dim, dim, dim, tuple(base["grid"]),
+                             t1_step_s=base["step_s"],
+                             device_kind=device_kind)
+    return {
+        "workflow": "blocked_matmul %dx%dx%d f32, block %d, "
+                    "block-cyclic SUMMA over the (rows, cols) mesh"
+                    % (dim, dim, dim, blk),
+        "formula": "t_pred(grid) = t1_step/(pr*pc) + G*(2*(pc-1)/pc*"
+                   "a_panel_bytes + 2*(pr-1)/pr*b_panel_bytes)/ici_bw",
+        "inputs": {
+            "t1_step_s": base["step_s"],
+            "dim": dim,
+            "block": blk,
+            "dtype": "float32",
+            "tolerance_vs_dense": base["tolerance"],
+            "ici_bw_assumed_bytes_per_s": ref["inputs"][
+                "ici_bw_assumed_bytes_per_s"],
+            "ici_bw_source": ref["inputs"]["ici_bw_source"],
+            "device_kind": device_kind,
+        },
+        "caveats": ("virtual CPU mesh shares one host core: the "
+                    "1/N compute term is expected to be refuted "
+                    "here; blocked-vs-dense equality is the claim "
+                    "that must hold at every width. A real N-chip "
+                    "run confirms or refutes the timing directly."
+                    if not on_chip else
+                    "measured_step_s is the second (compiled) call"),
         "per_width": rows,
     }
 

@@ -60,6 +60,11 @@ def main(argv=None) -> int:
         # export subcommand family (veles_tpu/export/):
         #   veles-tpu export serve-artifact MODEL.py --out DIR [...]
         return _export_cli(argv[1:])
+    if argv and argv[0] == "linalg":
+        # distributed linear-algebra family (veles_tpu/linalg/):
+        #   veles-tpu linalg bench [--m M --k K --n N] [--grid PRxPC]
+        #   veles-tpu linalg solve [--n N] [--precondition]
+        return _linalg_cli(argv[1:])
     parser = make_parser()
     # intermixed parsing: config overrides (positionals) may appear
     # between/after flags — see cmdline.parse_args
@@ -431,6 +436,168 @@ def _faults_cli(argv) -> int:
     spec = faults.plane.current_spec()
     print("active spec: %s" % (spec or "(none)"))
     return 0
+
+
+def _linalg_cli(argv) -> int:
+    """``veles-tpu linalg bench|solve`` — the distributed
+    linear-algebra workload family (veles_tpu/linalg/,
+    docs/workloads.md) from the command line.
+
+    ``bench`` runs the blocked kernels (block-cyclic SUMMA matmul,
+    right-looking Cholesky solve) over the device mesh, checks each
+    against the dense ``numpy.linalg`` reference within the stated
+    dtype tolerance, and prints one JSON line with the relative
+    errors, the achieved MFU graded against the dtype-correct peak
+    table and the stated SUMMA step-time prediction.
+
+    ``solve`` runs conjugate gradient on the 5-point Poisson model
+    problem as a Workflow graph (``--precondition`` arms the 2-level
+    multigrid V-cycle) and prints the per-iteration residual story."""
+    import argparse
+    import json as _json
+    import time as _time
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu linalg",
+        description="distributed linear-algebra workloads "
+                    "(docs/workloads.md)")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    bench = sub.add_parser(
+        "bench", help="blocked kernels vs the dense reference + MFU")
+    bench.add_argument("--m", type=int, default=512)
+    bench.add_argument("--k", type=int, default=512)
+    bench.add_argument("--n", type=int, default=512)
+    bench.add_argument("--cholesky", type=int, default=256,
+                       metavar="N",
+                       help="SPD factor/solve size (0 skips it)")
+    bench.add_argument("--block", type=int, default=None,
+                       help="block size (default linalg.DEFAULT_BLOCK)")
+    bench.add_argument("--grid", default=None, metavar="PRxPC",
+                       help="device grid, e.g. 2x4 (default: squarest "
+                            "factorization of the visible devices)")
+    bench.add_argument("--dtype", default="float32",
+                       choices=("float32", "float64"),
+                       help="computation dtype (grades MFU against "
+                            "the matching peak table)")
+    bench.add_argument("--seed", type=int, default=0)
+    solve = sub.add_parser(
+        "solve", help="CG on the Poisson problem as a Workflow graph")
+    solve.add_argument("--n", type=int, default=64, metavar="N",
+                       help="interior grid side (N^2 unknowns)")
+    solve.add_argument("--tol", type=float, default=1e-6)
+    solve.add_argument("--max-iters", type=int, default=500)
+    solve.add_argument("--precondition", action="store_true",
+                       help="2-level multigrid V-cycle preconditioner "
+                            "(needs even --n)")
+    solve.add_argument("--grid", default=None, metavar="PRxPC")
+    solve.add_argument("--block", type=int, default=None)
+    solve.add_argument("--seed", type=int, default=0)
+    solve.add_argument("--json", default=None, metavar="PATH",
+                       help="write {iterations, residual, history} "
+                            "as JSON")
+    args = parser.parse_args(argv)
+    import numpy
+    from .linalg import (DEFAULT_BLOCK, LinalgError, TwoLevelPoisson,
+                         blocked_matmul, build_cg_workflow,
+                         cholesky_solve, default_tolerance,
+                         linalg_mesh, poisson2d_matvec,
+                         predict_summa_time)
+    grid = None
+    if args.grid:
+        try:
+            pr, _, pc = args.grid.lower().partition("x")
+            grid = (int(pr), int(pc))
+        except ValueError:
+            parser.error("--grid wants PRxPC, e.g. 2x4")
+    block = args.block or DEFAULT_BLOCK
+    mesh = linalg_mesh(grid)
+    rng = numpy.random.RandomState(args.seed)
+    if args.cmd == "solve":
+        rhs = rng.standard_normal(args.n * args.n).astype(numpy.float32)
+        precond = None
+        if args.precondition:
+            precond = TwoLevelPoisson(args.n, block=block, mesh=mesh)
+        wf = build_cg_workflow(poisson2d_matvec(args.n), rhs,
+                               tol=args.tol, max_iters=args.max_iters,
+                               preconditioner=precond)
+        wf.initialize()
+        try:
+            wf.run()
+        except LinalgError as e:
+            print("linalg solve FAILED verification: %s" % e,
+                  file=sys.stderr)
+            return 1
+        res = wf.cg_decision.get_metric_values()
+        print("poisson %dx%d (%d unknowns)%s: %s in %d iteration(s), "
+              "recurrence residual %.3e, verified true residual %s"
+              % (args.n, args.n, args.n * args.n,
+                 " + multigrid V-cycle" if precond else "",
+                 "converged" if res["converged"] else
+                 "DID NOT CONVERGE", res["iterations"],
+                 res["residual"],
+                 "%.3e" % res["true_residual"]
+                 if res["true_residual"] is not None else "(skipped)"))
+        history = res["residual_history"]
+        for i in range(0, len(history),
+                       max(1, len(history) // 10) or 1):
+            print("  iter %-4d residual %.3e" % (i, history[i]))
+        if args.json:
+            with open(args.json, "w") as fh:
+                _json.dump(res, fh, indent=2, sort_keys=True)
+            print("report written: %s" % args.json)
+        return 0 if res["converged"] else 1
+    # bench
+    from .telemetry.cost import peak_flops_entry
+    dtype = numpy.dtype(args.dtype)
+    tol = default_tolerance(dtype)
+    a = rng.standard_normal((args.m, args.k)).astype(dtype)
+    b = rng.standard_normal((args.k, args.n)).astype(dtype)
+    c = numpy.asarray(blocked_matmul(a, b, block=block, mesh=mesh))
+    ref = a.astype(numpy.float64) @ b.astype(numpy.float64)
+    mm_err = float(numpy.linalg.norm(c - ref) / numpy.linalg.norm(ref))
+    t0 = _time.perf_counter()
+    blocked_matmul(a, b, block=block, mesh=mesh)
+    step_s = max(_time.perf_counter() - t0, 1e-9)
+    peak_source, peak = peak_flops_entry(dtype)
+    pgrid = tuple(mesh.devices.shape)
+    report = {
+        "grid": "%dx%d" % pgrid,
+        "dtype": args.dtype,
+        "block": block,
+        "matmul": {"m": args.m, "k": args.k, "n": args.n,
+                   "rel_err": mm_err, "tolerance": tol,
+                   "step_s": step_s,
+                   "mfu": (2.0 * args.m * args.n * args.k)
+                   / (step_s * peak * mesh.size)},
+        "peak_flops_used": peak,
+        "peak_source": peak_source,
+        "predicted": predict_summa_time(args.m, args.k, args.n, pgrid,
+                                        t1_step_s=step_s, dtype=dtype),
+    }
+    failed = not mm_err < tol
+    if args.cholesky:
+        g = rng.standard_normal((args.cholesky,
+                                 args.cholesky)).astype(dtype)
+        spd = g @ g.T + args.cholesky * numpy.eye(args.cholesky,
+                                                  dtype=dtype)
+        rhs = rng.standard_normal((args.cholesky, 1)).astype(dtype)
+        try:
+            x = numpy.asarray(cholesky_solve(spd, rhs, block=block,
+                                             mesh=mesh, check=True))
+            xref = numpy.linalg.solve(spd.astype(numpy.float64),
+                                      rhs.astype(numpy.float64))
+            ch_err = float(numpy.linalg.norm(x - xref)
+                           / numpy.linalg.norm(xref))
+            report["cholesky"] = {"n": args.cholesky,
+                                  "rel_err": ch_err, "tolerance": tol}
+            failed = failed or not ch_err < tol
+        except LinalgError as e:
+            report["cholesky"] = {"n": args.cholesky, "error": str(e)}
+            failed = True
+    print(_json.dumps(report))
+    if failed:
+        print("linalg bench FAILED the dense-reference tolerance",
+              file=sys.stderr)
+    return 1 if failed else 0
 
 
 def _loadgen_cli(argv) -> int:

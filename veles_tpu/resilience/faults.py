@@ -194,6 +194,14 @@ for _name, _desc in (
                                "already answered from live state, so "
                                "only future same-prefix admissions "
                                "pay a re-scan)"),
+    ("linalg.block_op", "blocked linear-algebra block dispatch "
+                        "(linalg/blocked.py k-panel dots, potrf/trsm "
+                        "panels, SUMMA launches; raise = abort the "
+                        "solve, corrupt = flip bytes in the "
+                        "dispatched block — verify_residual's "
+                        "trusted dense check must then FAIL the "
+                        "solve loudly, never return a silently-"
+                        "wrong x)"),
 ):
     register_point(_name, _desc)
 

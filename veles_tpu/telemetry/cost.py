@@ -30,6 +30,18 @@ PEAK_BF16 = [
 ]
 DEFAULT_PEAK = 275e12
 
+#: nominal dense f32 peak FLOP/s per chip. The MXU computes bf16
+#: products with f32 accumulation; full-f32 matmul throughput is the
+#: STATED assumption peak_bf16/2 (a bf16x3-style decomposition costs
+#: at least that), written down as its own table so an f32 workload's
+#: MFU is graded against an f32 roofline instead of being understated
+#: 2× against the bf16 peak. Same substring matching as PEAK_BF16.
+PEAK_F32 = [
+    ("v6", 459e12), ("v5p", 229.5e12), ("v5", 98.5e12),
+    ("v4", 137.5e12), ("v3", 61.5e12), ("v2", 22.5e12),
+]
+DEFAULT_PEAK_F32 = 137.5e12
+
 
 #: assumed aggregate ICI bandwidth per chip, bytes/s (public nominal
 #: numbers, substring-matched like PEAK_BF16; first hit wins). This is
@@ -85,6 +97,48 @@ def peak_bf16_flops(device_kind: Optional[str] = None) -> float:
             device_kind = "unknown"
     kind = str(device_kind).lower()
     return next((p for key, p in PEAK_BF16 if key in kind), DEFAULT_PEAK)
+
+
+def peak_flops_entry(dtype=None, device_kind: Optional[str] = None):
+    """(source label, nominal dense peak FLOP/s) keyed on the
+    COMPUTATION dtype: f32 (and f64, which has no MXU path at all —
+    priced at the f32 table as the optimistic bound) resolves through
+    PEAK_F32, everything else (bf16/f16/int8-ish mixed precision)
+    through PEAK_BF16. The label names the exact table entry used so
+    bench sections can stamp the peak they were graded against."""
+    if dtype is None:
+        name = "bfloat16"
+    else:
+        try:            # accepts "float32", numpy.float32, dtype objects
+            import numpy
+            name = numpy.dtype(dtype).name
+        except TypeError:       # e.g. "bf16" shorthand, jax weak types
+            name = str(getattr(dtype, "name", dtype))
+    name = name.lower()
+    f32_class = name in ("float32", "f32", "float64", "f64")
+    table, default, tname = (
+        (PEAK_F32, DEFAULT_PEAK_F32, "PEAK_F32") if f32_class
+        else (PEAK_BF16, DEFAULT_PEAK, "PEAK_BF16"))
+    if device_kind is None:
+        import jax
+        try:
+            device_kind = str(getattr(jax.devices()[0], "device_kind",
+                                      "unknown"))
+        except Exception:            # noqa: BLE001 — backend init failure
+            device_kind = "unknown"
+    kind = str(device_kind).lower()
+    for key, p in table:
+        if key in kind:
+            return "telemetry.cost.%s[%s]" % (tname, key), p
+    return "telemetry.cost.DEFAULT_%s" % ("PEAK_F32" if f32_class
+                                          else "PEAK"), default
+
+
+def peak_flops(dtype=None, device_kind: Optional[str] = None) -> float:
+    """Nominal dense peak FLOP/s for ``dtype`` on ``device_kind``
+    (default: the first visible jax device) — the dtype-aware MFU
+    denominator. ``peak_flops("float32") == peak_bf16_flops()/2``."""
+    return peak_flops_entry(dtype, device_kind)[1]
 
 
 class Cost:

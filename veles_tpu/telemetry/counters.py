@@ -316,6 +316,28 @@ DESCRIPTIONS = {
     "veles_loadgen_storms_total":
         "Timed chaos storms armed on the fault plane by the load "
         "harness (one per storm clause per run)",
+    # distributed linear-algebra family (veles_tpu/linalg/): bench.py's
+    # gate asserts these read 0 in non-linalg runs
+    "veles_linalg_block_ops_total":
+        "Host-side blocked linear-algebra dispatches (k-panel dots, "
+        "potrf/trsm panels, SUMMA launches) — the linalg.block_op "
+        "fault chokepoint",
+    "veles_linalg_matmuls_total":
+        "Blocked matmuls completed (single-device panel loop or "
+        "SUMMA over the 2D mesh)",
+    "veles_linalg_factorizations_total":
+        "Blocked Cholesky factorizations completed",
+    "veles_linalg_solves_total":
+        "Linear solves completed (cholesky_solve calls and CG "
+        "workflow finishes)",
+    "veles_linalg_iterations_total":
+        "Conjugate-gradient iterations run (CGStep executions)",
+    "veles_linalg_residual_checks_total":
+        "verify_residual trusted-path checks performed (|b-Ax|/|b| "
+        "against the stated bound)",
+    "veles_linalg_residual_failures_total":
+        "Residual checks FAILED — the solve raised instead of "
+        "returning a silently-wrong answer (chaos corrupt lands here)",
 }
 
 
