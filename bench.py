@@ -716,6 +716,16 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # and predicted-vs-measured measurements are gate_linalg's
         # live proof (and `python bench.py linalg` standalone)
         "linalg": _linalg_section(),
+        # fleet watchtower (telemetry/timeseries.py + alerts.py): the
+        # bench never starts the watch sampler or the alert engine
+        # (root.common.telemetry.watch.enabled defaults OFF and off
+        # must be bit-identical to the pre-watchtower plane), so every
+        # sample/eval/transition counter MUST read zero here — the
+        # gate fails on leakage; the storm-fires-burn-rate-alert-
+        # within-the-fast-window, resolve-after-heal and
+        # transitions-visible-everywhere measurements are
+        # gate_watch's live drill
+        "watch": _watch_section(),
         "extras": [ae, lm],
     }
 
@@ -793,6 +803,14 @@ def _serving_section():
         "ttft_p99": q("veles_serving_ttft_seconds", 0.99),
         "tpot_p50": q("veles_serving_tpot_seconds", 0.5),
         "queue_wait_p99": q("veles_serving_queue_wait_seconds", 0.99),
+        # serving-plane MFU stamps (telemetry/devtime.py measure +
+        # CostModel program pricing): null in a training bench — the
+        # decode-tick and chunked-prefill windows are measured live
+        # inside gate_serving's throughput proof, which prices each
+        # window as sum(cost_of_compiled(program).flops x dispatch
+        # delta) over device self-time and the stamped nominal peak
+        "decode_mfu_device": None,
+        "prefill_chunk_mfu_device": None,
     }
 
 
@@ -882,6 +900,30 @@ def _overload_section():
     short = lambda n: n[len("veles_"):-len("_total")]  # noqa: E731
     return {short(name): int(counters.get(name))
             for name in QOS_COUNTERS + LOADGEN_COUNTERS}
+
+
+def _watch_section():
+    """{enabled} + every watchtower counter for this bench process —
+    absolute reads (one process, counters start at zero). The bench
+    never starts the watch sampler thread or the alert rule engine
+    (``root.common.telemetry.watch.enabled`` defaults OFF, and off
+    means the sampler never spawns, ``/metrics`` renders byte-
+    identical and no ``veles_watch_*``/``veles_alert_*`` counter ever
+    moves), so every count MUST be zero — ``bench.py gate`` fails on
+    leakage. The live drill (a chaos storm burning the TTFT SLO until
+    ``slo_ttft_burn`` fires within its fast window, then healing until
+    it resolves, with every transition visible in /metrics/history,
+    the flight recorder and a ``veles-tpu watch`` snapshot) runs
+    inside ``gate_watch``."""
+    from veles_tpu.config import root as vt_root
+    from veles_tpu.telemetry import WATCH_COUNTERS
+    from veles_tpu.telemetry.counters import counters
+    short = lambda n: n[len("veles_"):-len("_total")]  # noqa: E731
+    out = {"enabled": bool(
+        vt_root.common.telemetry.watch.get("enabled", False))}
+    out.update({short(name): int(counters.get(name))
+                for name in WATCH_COUNTERS})
+    return out
 
 
 def _linalg_section():
@@ -1655,11 +1697,88 @@ def _serving_throughput_proof():
               % (slo["ttft"][0] or 0.0, slo["ttft"][1] or 0.0,
                  slo["tpot"][0] or 0.0, slo["queue_wait"][1] or 0.0,
                  served))
+        # decode-tick MFU stamp: one devtime.measure window around a
+        # re-serve of the warmed mixed load (decode-step dominated —
+        # every program is compiled, so the window is execution only)
+        decode_mfu, dec_rec = _serving_window_mfu(
+            engine, lambda: engine.serve(list(reqs)))
     finally:
         engine.stop()
+    failures += _serving_mfu_stamp(wf, char_lm, reqs, decode_mfu,
+                                   dec_rec)
     failures += _paged_occupancy_proof(wf, reqs)
     failures += _pooled_modes_proof(lm=char_lm, wf=wf)
     return failures
+
+
+def _serving_window_mfu(engine, run):
+    """Measure one serving window (``devtime.measure``) and price the
+    programs it actually dispatched: ``sum(cost_of_compiled(program)
+    .flops x dispatch delta)`` over device self-time and the f32
+    nominal peak — the same CostModel-over-devtime arithmetic every
+    training section's ``mfu_device`` stamp uses, applied to the
+    engine's per-program ``prog_calls`` tally. Measurement only (no
+    kernel work, nothing gated): on the CPU CI backend device time
+    falls back to the synced wall clock, so the ratio is load-bearing
+    only on a real chip capture — the stamp names its source.
+    Returns ``(mfu_or_None, devtime_record)``."""
+    from veles_tpu.telemetry import devtime as _devtime
+    from veles_tpu.telemetry.cost import (cost_of_compiled,
+                                          peak_flops_entry)
+    calls0 = dict(engine.prog_calls)
+    rec = _devtime.measure(run, sync=lambda: None)
+    _, peak = peak_flops_entry("float32")
+    flops = 0.0
+    for key, calls in engine.prog_calls.items():
+        delta = calls - calls0.get(key, 0)
+        if not delta:
+            continue
+        prog = engine._progs.get(key)
+        exe = prog.compiled() if prog is not None else None
+        if exe is None:
+            return None, rec       # unpriceable (non-pjit backend)
+        flops += cost_of_compiled(exe).flops * delta
+    if not flops or rec["device_time_s"] <= 0:
+        return None, rec
+    return flops / rec["device_time_s"] / peak, rec
+
+
+def _serving_mfu_stamp(wf, lm, reqs, decode_mfu, dec_rec):
+    """The serving-MFU satellite: print the decode-tick window's MFU
+    (measured on the throughput engine above) and measure + print the
+    chunked-prefill window on its own chunk-enabled engine — long
+    prompts, one new token, so ``pchunk`` dispatches dominate. Pure
+    measurement (``decode_mfu_device``/``prefill_chunk_mfu_device``
+    stamp null in a training bench document); never a gate failure."""
+    from veles_tpu.serving import ContinuousEngine
+    from veles_tpu.serving.engine import make_request
+    from veles_tpu.telemetry.cost import peak_flops_entry
+    peak_source, _ = peak_flops_entry("float32")
+    rng = __import__("numpy").random.RandomState(23)
+    long_reqs = [make_request(
+        [int(t) for t in rng.randint(0, lm.VOCAB, 24)], 1,
+        seed=700 + i) for i in range(4)]
+    engine = ContinuousEngine(wf, max_slots=4, buckets=(8, 32),
+                              max_context=40, decode_block=8,
+                              prefill_chunk=8,
+                              name="bench.serving_mfu")
+    engine.start()
+    try:
+        engine.serve([dict(r) for r in long_reqs])   # warm compiles
+        chunks0 = engine.chunk_dispatches
+        prefill_mfu, pre_rec = _serving_window_mfu(
+            engine, lambda: engine.serve(
+                [dict(r) for r in long_reqs]))
+        chunked = engine.chunk_dispatches - chunks0
+    finally:
+        engine.stop()
+    fmt = lambda v: "n/a" if v is None else "%.4f" % v  # noqa: E731
+    print("serving mfu: decode-tick window %s, chunked-prefill "
+          "window %s (%d chunk dispatches) — device-time source "
+          "%s/%s vs %s peak"
+          % (fmt(decode_mfu), fmt(prefill_mfu), chunked,
+             dec_rec["source"], pre_rec["source"], peak_source))
+    return []
 
 
 def _paged_occupancy_proof(wf, reqs):
@@ -3672,6 +3791,334 @@ def _overload_proof():
     return failures, metrics
 
 
+def gate_watch(baseline_doc=None, current_doc=None):
+    """``watch`` gate section: (1) every watchtower counter must be
+    registered with a HELP string; (2) bench documents stamped with
+    the watchtower OFF must carry ZERO sample/eval/transition counts —
+    off means the sampler thread never spawns, so any movement breaks
+    the bit-identical-off contract; (3) the clean gate process must
+    read zero AND hold no live store/engine/firing-gauge rows before
+    the drill — every gate above served, routed and load-generated
+    with the knob off, so this check IS the zero-leakage live proof;
+    (4) live drill (:func:`_watch_proof`): a decode-delay chaos storm
+    burns the TTFT SLO on a live 2-replica fleet until
+    ``slo_ttft_burn`` fires within its fast window (the loadgen
+    ``--abort-on-alert`` poller stops the burst at fire time), the
+    healed fleet resolves it, and the fire→resolve pair is visible in
+    the ``/metrics/history`` cursor pull, the flight recorder and a
+    ``veles-tpu watch`` dashboard snapshot."""
+    from veles_tpu.telemetry import WATCH_COUNTERS, timeseries
+    from veles_tpu.telemetry.alerts import render_firing
+    from veles_tpu.telemetry.counters import DESCRIPTIONS, counters
+    failures = []
+    for name in WATCH_COUNTERS + ("veles_loadgen_alert_aborts_total",):
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "watch: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc),
+                     ("current", current_doc)):
+        sec = (doc or {}).get("watch")
+        if not sec or sec.get("enabled"):
+            continue
+        for key, value in sec.items():
+            if key != "enabled" and value:
+                failures.append(
+                    "watch: %s doc has %s=%s — the watch sampler/"
+                    "alert engine moved with the knob off" %
+                    (tag, key, value))
+    # the frozen-off check must precede the live drill: every gate
+    # above served, routed and load-generated for real with the
+    # watchtower off, so a live store, a rendered veles_alert_firing
+    # row or a moved counter here means off is not off
+    if timeseries.store() is not None \
+            or timeseries.alert_engine() is not None:
+        failures.append(
+            "watch: a live SeriesStore/AlertEngine exists before the "
+            "drill — maybe_start leaked with the knob off")
+    if render_firing() != "":
+        failures.append(
+            "watch: /metrics would render veles_alert_firing rows "
+            "with the watchtower off")
+    for name in WATCH_COUNTERS + ("veles_loadgen_alert_aborts_total",):
+        value = counters.get(name)
+        if value:
+            failures.append(
+                "watch: %s = %s before the watchtower ever ran in "
+                "this process" % (name, value))
+    proof_failures, metrics = _watch_proof()
+    if metrics:
+        print("watch proof: decode-delay storm burned the %.0fms "
+              "TTFT SLO on a 2-replica fleet — slo_ttft_burn fired "
+              "%.2fs after the first bad sample (fast window %.0fs), "
+              "loadgen --abort-on-alert stopped the burst after "
+              "%d/%d requests, the healed fleet resolved it; "
+              "fire→resolve visible in /metrics/history (%d samples, "
+              "%d transition records), the flight recorder and the "
+              "`veles-tpu watch` snapshot"
+              % (metrics["slo_ttft_ms"], metrics["fired_after_s"],
+                 metrics["fast_window_s"], metrics["aborted_after"],
+                 metrics["offered"], metrics["samples"],
+                 metrics["transition_records"]))
+    return failures + proof_failures
+
+
+def _watch_proof():
+    """THE watchtower drill, live on this process's backend.
+
+    A 2-replica char_lm fleet behind a FleetRouter runs with the
+    watchtower ON (short windows: period 0.25 s, fast 2 s / slow 6 s,
+    TTFT SLO 250 ms, burn factor 2 over a 0.95 objective). An
+    open-loop loadgen burst rides a ``serve.decode_step:delay`` chaos
+    storm, so queue wait blows the TTFT SLO and the burn-rate rule
+    must fire — within its fast window of the first bad sample
+    landing in the ring — while the harness's ``--abort-on-alert``
+    poller stops dispatching at fire time. The storm then heals
+    (StormPlan restores the fault plane) and a clean burst must
+    resolve the alert through the rule's hysteresis. The fire→resolve
+    pair must be observable everywhere an operator would look: the
+    ``/metrics/history`` cursor pull over HTTP (ordered with the
+    samples that caused it, detection latency computed from those
+    same records), the flight recorder, and a live ``veles-tpu watch
+    --once`` dashboard snapshot taken while the alert was firing.
+
+    Returns (failures, metrics) so the caller can gate and stamp."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import io
+    import urllib.request
+    from contextlib import redirect_stdout
+    import char_lm
+    import veles_tpu as vt
+    from veles_tpu import prng
+    from veles_tpu.config import root as vt_root
+    from veles_tpu.loadgen import ChaosStorm, LoadGen, Workload
+    from veles_tpu.serving.router import FleetRouter
+    from veles_tpu.telemetry import timeseries
+    from veles_tpu.telemetry.counters import counters as _ctrs
+    from veles_tpu.telemetry.recorder import flight
+    from veles_tpu.telemetry.timeseries import parse_history
+
+    failures = []
+    metrics = {}
+    prng.seed_all(6464)
+    wf = char_lm.build_workflow(epochs=1, minibatch_size=32,
+                                n_blocks=1, dim=32, n_train=64,
+                                n_valid=32)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+
+    PERIOD, FAST, SLOW, SLO_MS = 0.25, 2.0, 6.0, 250.0
+    watch = vt_root.common.telemetry.watch
+    # drill-sized knobs, restored to the shipped defaults in the
+    # finally below; e2e/queue/shed rules are parked out of range so
+    # the drill exercises exactly the TTFT burn-rate pair
+    overrides = {"enabled": True, "period": PERIOD,
+                 "retention": 120.0, "fast_window": FAST,
+                 "slow_window": SLOW, "burn_factor": 2.0,
+                 "objective": 0.95, "slo_ttft_ms": SLO_MS,
+                 "slo_e2e_ms": 600000.0,
+                 "queue_depth_limit": 100000.0,
+                 "shed_rate_limit": 100000.0}
+    defaults = {"enabled": False, "period": 1.0, "retention": 300.0,
+                "fast_window": 30.0, "slow_window": 120.0,
+                "burn_factor": 6.0, "objective": 0.99,
+                "slo_ttft_ms": 500.0, "slo_e2e_ms": 5000.0,
+                "queue_depth_limit": 64.0, "shed_rate_limit": 5.0}
+    saved = {k: watch.get(k, defaults[k]) for k in overrides}
+    for key, value in overrides.items():
+        setattr(watch, key, value)
+
+    def workload(n, rate, seed):
+        return Workload(n_requests=n, rate=rate, shape="steady",
+                        min_prompt=4, max_prompt=8, n_new=4,
+                        vocab=char_lm.VOCAB, batch_fraction=0.0,
+                        stream_fraction=0.0, sample_fraction=0.0,
+                        shared_fraction=0.0, seed=seed)
+
+    def alert_events():
+        store = timeseries.store()
+        return [] if store is None else [
+            e for e in store.records("watch.alert")
+            if e.get("rule") == "slo_ttft_burn"]
+
+    apis, router = [], None
+    try:
+        apis = [vt.GenerationAPI(wf, port=0, engine="continuous",
+                                 max_slots=2, buckets=(8,),
+                                 max_context=24,
+                                 name="watch_bench_%d" % i)
+                for i in range(2)]
+        for api in apis:
+            api.initialize()
+        router = FleetRouter(
+            ["127.0.0.1:%d" % api.port for api in apis],
+            probe_interval=0.2, failure_threshold=3,
+            retry_budget=2, attempt_timeout=60.0,
+            request_timeout=120.0, name="watch_bench.router").start()
+        url = "http://127.0.0.1:%d" % router.port
+        if timeseries.store() is None:
+            failures.append(
+                "watch: the sampler never started with the knob ON")
+            return failures, {}
+        # -- storm phase: burn the TTFT budget until the alert fires.
+        # Every decode step sleeps 50 ms for the whole burst, so
+        # queue wait (and the cold compiles) push TTFT far over the
+        # 250 ms SLO; the abort poller must stop the burst mid-flight
+        storm = ChaosStorm("serve.decode_step", "delay",
+                           window=(0, 1000000))
+        offered = 80
+        report = LoadGen(url, workload(offered, 8.0, seed=5),
+                         storms=[storm], timeout=120.0,
+                         abort_on_alert=True, alert_poll=0.2,
+                         name="bench.watch_storm").run()
+        aborted = report.get("aborted_on_alert")
+        if not aborted:
+            failures.append(
+                "watch: the storm burst ran all %d requests to "
+                "completion without the --abort-on-alert poller "
+                "tripping — no rule fired while load was offered"
+                % offered)
+        if int(_ctrs.get("veles_loadgen_alert_aborts_total")) != 1:
+            failures.append(
+                "watch: veles_loadgen_alert_aborts_total = %s after "
+                "one aborted burst"
+                % _ctrs.get("veles_loadgen_alert_aborts_total"))
+        deadline = time.time() + 30
+        fire_ev = None
+        while time.time() < deadline and fire_ev is None:
+            fire_ev = next((e for e in alert_events()
+                            if e.get("state") == "firing"), None)
+            if fire_ev is None:
+                time.sleep(0.2)
+        if fire_ev is None:
+            failures.append(
+                "watch: slo_ttft_burn never fired under the "
+                "decode-delay storm")
+            return failures, {}
+        # -- dashboard snapshot while firing: the operator view must
+        # show the alert (served over HTTP by the live router)
+        from veles_tpu.__main__ import _watch_cli
+        buf = io.StringIO()
+        with redirect_stdout(buf):
+            rc = _watch_cli([url, "--once", "--no-clear",
+                             "--period", "0.5", "--window", "5"])
+        frame = buf.getvalue()
+        if rc != 0:
+            failures.append(
+                "watch: `veles-tpu watch --once` exited %d against "
+                "the live fleet" % rc)
+        if "slo_ttft_burn" not in frame or "FIRING" not in frame:
+            failures.append(
+                "watch: the dashboard snapshot does not show the "
+                "firing slo_ttft_burn alert")
+        # -- heal phase: the storm is gone (StormPlan restored the
+        # fault plane when the burst returned); clean traffic must
+        # walk the rule back to ok through its resolve hysteresis
+        resolve_ev = None
+        for round_ in range(4):
+            LoadGen(url, workload(40, 12.0, seed=6 + round_),
+                    timeout=120.0,
+                    name="bench.watch_heal_%d" % round_).run()
+            resolve_ev = next(
+                (e for e in alert_events()
+                 if e.get("state") == "resolved"
+                 and e.get("ts", 0) > fire_ev["ts"]), None)
+            if resolve_ev is not None:
+                break
+        if resolve_ev is None:
+            failures.append(
+                "watch: slo_ttft_burn never resolved after the storm "
+                "healed (%d clean requests served)" % (4 * 40))
+        # -- the operator pull: one HTTP cursor pull must carry the
+        # whole story — samples AND both transitions, in order
+        with urllib.request.urlopen(url + "/metrics/history?since=0",
+                                    timeout=10) as resp:
+            header, records = parse_history(resp.read().decode())
+        if not header or not header.get("enabled"):
+            failures.append(
+                "watch: /metrics/history header does not report the "
+                "watchtower live")
+        samples = [r for r in records
+                   if r.get("kind") == "watch.sample"]
+        transitions = [r for r in records
+                       if r.get("kind") == "watch.alert"
+                       and r.get("rule") == "slo_ttft_burn"]
+        states = [r.get("state") for r in transitions]
+        if "firing" not in states or "resolved" not in states:
+            failures.append(
+                "watch: the /metrics/history pull is missing the "
+                "slo_ttft_burn firing/resolved transitions (saw %s)"
+                % states)
+        # detection latency, computed from the SAME pulled records an
+        # operator would read: first sample whose TTFT histogram grew
+        # a bucket above the SLO, to the firing transition. Must land
+        # within the fast window (+ two sample periods of eval grace)
+        fired_after = None
+        prev_bad = None
+        for rec in samples:
+            h = (rec.get("hist") or {}).get(
+                "veles_serving_ttft_seconds")
+            if not h:
+                continue
+            good = sum(c for b, c in zip(h["bounds"], h["counts"])
+                       if float(b) * 1000.0 <= SLO_MS)
+            bad = int(h.get("count", 0)) - good
+            if prev_bad is not None and bad > prev_bad \
+                    and rec.get("ts", 0) <= fire_ev["ts"]:
+                fired_after = fire_ev["ts"] - rec["ts"]
+                break
+            prev_bad = bad
+        if fired_after is None:
+            failures.append(
+                "watch: the pulled samples never show a TTFT "
+                "observation over the SLO before the firing "
+                "transition")
+        elif fired_after > FAST + 2 * PERIOD:
+            failures.append(
+                "watch: slo_ttft_burn took %.2fs after the first bad "
+                "sample to fire — outside the %.1fs fast window"
+                % (fired_after, FAST))
+        # -- the flight recorder holds the same transitions (what
+        # `veles-tpu blackbox inspect` prints after a crash)
+        if flight.enabled():
+            seen = [(r.get("rule"), r.get("state"))
+                    for r in flight.records()
+                    if r.get("kind") == "alert"]
+            for state in ("firing", "resolved"):
+                if ("slo_ttft_burn", state) not in seen:
+                    failures.append(
+                        "watch: flight recorder is missing the "
+                        "slo_ttft_burn %s transition" % state)
+        if not int(_ctrs.get("veles_watch_samples_total")):
+            failures.append("watch: the sampler counted zero samples "
+                            "over the whole drill")
+        if not int(_ctrs.get("veles_watch_pulls_total")):
+            failures.append("watch: the /metrics/history pull was "
+                            "not counted")
+        metrics = {
+            "slo_ttft_ms": SLO_MS,
+            "fast_window_s": FAST,
+            "fired_after_s": round(fired_after or -1.0, 2),
+            "aborted_after": int((aborted or {}).get(
+                "after_requests", offered)),
+            "offered": offered,
+            "samples": len(samples),
+            "transition_records": len(transitions),
+        }
+    finally:
+        try:
+            if router is not None:
+                router.stop()
+        finally:
+            for api in apis:
+                api.stop()
+            timeseries.stop_watch()
+            for key, value in saved.items():
+                setattr(watch, key, value)
+    if failures:
+        metrics = {}
+    return failures, metrics
+
+
 def gate_tensormon(baseline_doc=None, current_doc=None):
     """``tensormon`` gate section: (1) the model-health counters must
     be registered; (2) a monitoring-OFF bench document must carry ZERO
@@ -3789,12 +4236,19 @@ def _gate_main(argv):
                 # like the other live proofs it runs after every
                 # doc-leakage assertion above
                 + gate_linalg(baseline, current)
-                # LAST: the overload drill preempts, throttles and
+                # the overload drill preempts, throttles and
                 # load-generates for real — its own zero-before-proof
                 # check must see a process no earlier QoS work
                 # touched, and it legitimately moves the serving/
                 # router counters every gate above already proved
-                + gate_overload(baseline, current))
+                + gate_overload(baseline, current)
+                # LAST: the watchtower drill turns the sampler ON —
+                # its frozen-off check must see a process where every
+                # earlier drill served/routed/loadgened with the
+                # knob off and no veles_watch_*/veles_alert_* counter
+                # ever moved (and gate_overload's own
+                # zero-before-proof already ran)
+                + gate_watch(baseline, current))
     for failure in failures:
         print("GATE FAIL %s" % failure, file=sys.stderr)
     if failures:
@@ -3821,7 +4275,10 @@ def _gate_main(argv):
           "tolerance + CG converged and re-verified + f32-peak MFU "
           "stamped, "
           "overload clean + preempted batch id-exact + interactive "
-          "lossless under a 2x burst + exactly-once terminals)"
+          "lossless under a 2x burst + exactly-once terminals, "
+          "watch frozen-off clean + storm-fired burn-rate alert "
+          "within its fast window + resolved after heal + "
+          "transitions visible on every surface)"
           % (argv[1], argv[0],
              " — %d legacy section(s) compared on wall-clock" % legacy
              if legacy else ""))

@@ -177,6 +177,80 @@ def find_unregistered_histograms():
                   if not known.get(name, False))
 
 
+#: the watchtower rule engine — its shipped default rules (and the
+#: gauge whitelist its fail-closed validation accepts) are read via
+#: AST like the registries above
+ALERTS_PY = os.path.join(REPO, "veles_tpu", "telemetry", "alerts.py")
+
+
+def known_alert_gauges(path: str = ALERTS_PY) -> set:
+    """The KNOWN_GAUGES tuple literal of telemetry/alerts.py — the
+    gauge names the rule engine's fail-closed validation accepts."""
+    with open(path) as fin:
+        tree = ast.parse(fin.read())
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(getattr(t, "id", None) == "KNOWN_GAUGES"
+                   for t in node.targets):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            return {el.value for el in node.value.elts
+                    if isinstance(el, ast.Constant)}
+        break
+    raise SystemExit("KNOWN_GAUGES tuple literal not found in %s"
+                     % path)
+
+
+def default_rule_series(path: str = ALERTS_PY) -> dict:
+    """{series name: site} for every ``series="veles_..."`` literal
+    inside :func:`default_rules` — the shipped alert rules. Read via
+    AST so the pass needs no package import (and no jax)."""
+    with open(path) as fin:
+        tree = ast.parse(fin.read())
+    out = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.FunctionDef) \
+                or node.name != "default_rules":
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if not getattr(sub.func, "id", "").endswith("Rule"):
+                continue
+            # Rule constructors take (name, series, ...): the series
+            # is the second positional arg, or a series= keyword
+            candidates = []
+            if len(sub.args) >= 2:
+                candidates.append(sub.args[1])
+            candidates += [kw.value for kw in sub.keywords
+                           if kw.arg == "series"]
+            for cand in candidates:
+                if isinstance(cand, ast.Constant) \
+                        and isinstance(cand.value, str) \
+                        and cand.value.startswith("veles_"):
+                    out.setdefault(
+                        cand.value,
+                        "%s:%d" % (os.path.relpath(path, REPO),
+                                   cand.lineno))
+        return out
+    raise SystemExit("default_rules() not found in %s" % path)
+
+
+def find_unknown_alert_series():
+    """[(series, site)] for every series a SHIPPED default alert
+    rule watches that is registered nowhere — not a counter
+    (DESCRIPTIONS), not a histogram (HISTOGRAMS), not an accepted
+    gauge (alerts.KNOWN_GAUGES). Such a rule would refuse at config
+    parse (the engine validates fail-closed) and take every default
+    rule down with it — caught here at CI time instead."""
+    known = (registered_counters() | set(registered_histograms())
+             | known_alert_gauges())
+    return sorted((name, site)
+                  for name, site in default_rule_series().items()
+                  if name not in known)
+
+
 def documented_names(path: str = DOCS_MD) -> set:
     """Every veles_* name docs/observability.md mentions, brace
     families (`veles_resume_{attempts,tokens}_total`) expanded."""
@@ -222,24 +296,33 @@ def main(argv=None) -> int:
         print("UNREGISTERED histogram %s (first use: %s) — needs a "
               "HISTOGRAMS entry with help AND bucket bounds"
               % (name, site), file=sys.stderr)
+    bad_series = find_unknown_alert_series()
+    for name, site in bad_series:
+        print("UNKNOWN alert series %s (%s) — a shipped default rule "
+              "watches a series that is no registered counter, "
+              "histogram or KNOWN_GAUGES entry; the fail-closed rule "
+              "validation would refuse EVERY default rule at runtime"
+              % (name, site), file=sys.stderr)
     undocumented = find_undocumented() if check_docs else []
     for name, kind in undocumented:
         print("UNDOCUMENTED %s %s — registered in telemetry/"
               "counters.py but missing from docs/observability.md"
               % (kind, name), file=sys.stderr)
-    if missing or missing_hist or undocumented:
+    if missing or missing_hist or bad_series or undocumented:
         print("%d counter(s) / %d histogram(s) used but not "
-              "registered in telemetry/counters.py%s"
-              % (len(missing), len(missing_hist),
+              "registered in telemetry/counters.py; %d unknown alert "
+              "series%s"
+              % (len(missing), len(missing_hist), len(bad_series),
                  "; %d registered name(s) undocumented"
                  % len(undocumented) if undocumented else ""),
               file=sys.stderr)
         return 1
     print("counter registration OK (%d counters registered, %d "
           "distinct names used; %d histograms registered, %d "
-          "observed%s)"
+          "observed; %d default alert series validated%s)"
           % (len(registered_counters()), len(used_counters()),
              len(registered_histograms()), len(used_histograms()),
+             len(default_rule_series()),
              "; all documented" if check_docs else ""))
     return 0
 

@@ -456,17 +456,20 @@ def test_bench_gate_cli(tmp_path):
     bp.write_text(json.dumps(base))
     cp.write_text(json.dumps(cur))
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # the gate runs every live drill (fleet failover, overload burst,
+    # watchtower storm, ...) — budget for the whole acceptance suite,
+    # not just the doc comparison
     r = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py"), "gate",
          str(bp), str(cp)], capture_output=True, text=True, env=env,
-        timeout=120)
+        timeout=420)
     assert r.returncode == 1
     assert "GATE FAIL" in r.stderr
     cp.write_text(json.dumps(base))
     r = subprocess.run(
         [sys.executable, os.path.join(repo, "bench.py"), "gate",
          str(bp), str(cp)], capture_output=True, text=True, env=env,
-        timeout=120)
+        timeout=420)
     assert r.returncode == 0, r.stderr
 
 

@@ -35,6 +35,14 @@ def main(argv=None) -> int:
         #   veles-tpu metrics aggregate URL [URL ...]
         from .telemetry import fleet
         return fleet.main(argv[1:])
+    if argv and argv[0] == "watch":
+        # watchtower live dashboard (telemetry/timeseries.py):
+        #   veles-tpu watch URL [URL ...] [--endpoints-file ROSTER]
+        return _watch_cli(argv[1:])
+    if argv and argv[0] == "alerts":
+        # watchtower rule states (telemetry/alerts.py):
+        #   veles-tpu alerts URL
+        return _alerts_cli(argv[1:])
     if argv and argv[0] == "route":
         # serving-fleet front (serving/router.py):
         #   veles-tpu route URL [URL ...] [--port P] [...]
@@ -600,6 +608,213 @@ def _linalg_cli(argv) -> int:
     return 1 if failed else 0
 
 
+def _alerts_url(url: str) -> str:
+    url = url.strip()
+    if "://" not in url:
+        url = "http://" + url
+    url = url.rstrip("/")
+    if url.endswith("/metrics"):
+        url = url[:-len("/metrics")]
+    return url + "/alerts"
+
+
+def _fetch_alerts(urls, timeout: float = 5.0):
+    """First answering ``GET /alerts`` page across ``urls`` →
+    (payload, url) — or (None, None) when nobody answered."""
+    import json as _json
+    import urllib.request
+    for url in urls:
+        try:
+            with urllib.request.urlopen(_alerts_url(url),
+                                        timeout=timeout) as r:
+                return _json.loads(r.read() or b"{}"), url
+        except Exception:        # noqa: BLE001 — a down endpoint is data
+            continue
+    return None, None
+
+
+def _watch_frame(rep, agg, alerts) -> str:
+    """One dashboard frame (``veles-tpu watch``): fleet rates +
+    windowed quantiles from the client-side SeriesStore, roster
+    health, and the firing-alert block."""
+    def fmt(v, unit="", nd=None):
+        if v is None:
+            return "-"
+        if nd is not None:
+            v = round(v, nd)
+        return "%g%s" % (v, unit)
+    lines = ["veles-tpu watch  %s/%s endpoint(s) up"
+             % (fmt(rep["up"]), fmt(rep["endpoints"]))]
+    lines.append("  qps %-8s tok/s %-8s shed/s %s"
+                 % (fmt(rep["qps"]), fmt(rep["tok_s"]),
+                    fmt(rep["shed_s"])))
+    lines.append("  ttft p50/p99 %s/%s   tpot p50/p99 %s/%s   "
+                 "e2e p99 %s"
+                 % (fmt(rep["ttft_p50"], "s"), fmt(rep["ttft_p99"], "s"),
+                    fmt(rep["tpot_p50"], "s"), fmt(rep["tpot_p99"], "s"),
+                    fmt(rep["e2e_p99"], "s")))
+    lines.append("  slots busy %s/%s   queue %s   brownout L%s   "
+                 "admit %s"
+                 % (fmt(rep["slots_busy"]), fmt(rep["slots"]),
+                    fmt(rep["queue_depth"]), fmt(rep["brownout"]),
+                    fmt(rep["admit_rate"], nd=3)))
+    for ep in agg["endpoints"]:
+        lines.append("  %-4s %s%s"
+                     % ("up" if ep["up"] else "DOWN", ep["url"],
+                        "" if ep["up"] else "  (%s)" % ep["error"]))
+    if alerts is None:
+        lines.append("  alerts: no /alerts endpoint answered")
+    elif not alerts.get("enabled"):
+        lines.append("  alerts: watchtower off "
+                     "(root.common.telemetry.watch.enabled)")
+    else:
+        firing = [r for r in alerts.get("rules", ())
+                  if r.get("state") == "firing"]
+        if not firing:
+            lines.append("  alerts: %d rule(s), none firing"
+                         % len(alerts.get("rules", ())))
+        for r in firing:
+            lines.append("  alerts: FIRING %s (%s) value=%s since=%s"
+                         % (r.get("rule"), r.get("severity"),
+                            r.get("value"), r.get("since")))
+    return "\n".join(lines)
+
+
+def _watch_cli(argv) -> int:
+    """``veles-tpu watch URL [URL ...]`` — live terminal dashboard
+    over a serving fleet: scrape every endpoint's ``/metrics`` each
+    period into a client-side watchtower SeriesStore
+    (telemetry/timeseries.py, ``count_samples=False``), display
+    WINDOWED rates and latency quantiles (bucket deltas between
+    samples — not the cumulative-since-start ``_p99`` gauges), the
+    roster's up/down state, and the firing alerts from the fleet's
+    ``GET /alerts``."""
+    import argparse
+    import json as _json
+    import time as _time
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu watch",
+        description="live fleet watch dashboard "
+                    "(docs/observability.md 'Watchtower')")
+    parser.add_argument("urls", nargs="*", metavar="URL",
+                        help="endpoint serving /metrics (router "
+                             "and/or replicas; bare host:port "
+                             "accepted)")
+    parser.add_argument("--endpoints-file", default=None,
+                        metavar="FILE",
+                        help="replica roster file — same format as "
+                             "`route`/`metrics aggregate` (plain "
+                             "lines, or a saved GET /roster page)")
+    parser.add_argument("--period", type=float, default=1.0,
+                        metavar="SEC",
+                        help="seconds between scrapes (default 1)")
+    parser.add_argument("--window", type=float, default=30.0,
+                        metavar="SEC",
+                        help="trailing window for rates/quantiles "
+                             "(default 30)")
+    parser.add_argument("--iterations", type=int, default=0,
+                        metavar="N",
+                        help="stop after N frames (0 = run until "
+                             "interrupted)")
+    parser.add_argument("--once", action="store_true",
+                        help="two samples one period apart, one "
+                             "frame, exit (scriptable snapshot)")
+    parser.add_argument("--no-clear", action="store_true",
+                        help="append frames instead of redrawing "
+                             "(logs, tests)")
+    parser.add_argument("--json", action="store_true",
+                        help="print one JSON line per frame instead "
+                             "of the dashboard (implies --no-clear)")
+    parser.add_argument("--timeout", type=float, default=5.0,
+                        help="per-endpoint scrape timeout, seconds")
+    args = parser.parse_args(argv)
+    from .telemetry import fleet as _fleet
+    from .telemetry.timeseries import SeriesStore
+    urls = list(args.urls)
+    if args.endpoints_file:
+        try:
+            urls += _fleet.read_endpoints(args.endpoints_file)
+        except (OSError, ValueError) as e:
+            parser.error("bad --endpoints-file: %s" % e)
+    if not urls:
+        parser.error("no endpoints (positional URLs and/or "
+                     "--endpoints-file)")
+    if args.period <= 0:
+        parser.error("--period must be > 0")
+    store = SeriesStore(period=args.period,
+                        retention=max(600.0, args.period * 600),
+                        count_samples=False)
+    iterations = 2 if args.once else args.iterations
+    n = 0
+    last_up = 0
+    try:
+        while True:
+            agg = _fleet.aggregate(urls, timeout=args.timeout)
+            _fleet.ingest_aggregate(store, agg)
+            last_up = sum(1 for ep in agg["endpoints"] if ep["up"])
+            n += 1
+            final = iterations and n >= iterations
+            # --once stays quiet until its second sample: the first
+            # frame of a fresh store has no deltas to show
+            if not args.once or final:
+                rep = _fleet.interval_report(store, window=args.window)
+                alerts, _ = _fetch_alerts(urls, timeout=args.timeout)
+                if args.json:
+                    rep["alerts"] = alerts
+                    print(_json.dumps(rep, sort_keys=True))
+                else:
+                    if not args.no_clear:
+                        print("\x1b[2J\x1b[H", end="")
+                    print(_watch_frame(rep, agg, alerts), flush=True)
+            if final:
+                break
+            _time.sleep(args.period)
+    except KeyboardInterrupt:
+        pass
+    return 0 if last_up else 2
+
+
+def _alerts_cli(argv) -> int:
+    """``veles-tpu alerts URL`` — list the fleet watchtower's alert
+    rule states (``GET /alerts``). Exit 0 with nothing firing, 1
+    with at least one firing rule (scriptable: a deploy gate can
+    refuse to proceed into a burning fleet), 2 when no endpoint
+    answered."""
+    import argparse
+    import json as _json
+    parser = argparse.ArgumentParser(
+        prog="veles_tpu alerts",
+        description="watchtower alert rule states "
+                    "(docs/observability.md 'Watchtower')")
+    parser.add_argument("urls", nargs="+", metavar="URL",
+                        help="endpoint serving /alerts (first "
+                             "answering one is reported)")
+    parser.add_argument("--timeout", type=float, default=5.0)
+    parser.add_argument("--json", action="store_true",
+                        help="print the raw /alerts payload")
+    args = parser.parse_args(argv)
+    payload, url = _fetch_alerts(args.urls, timeout=args.timeout)
+    if payload is None:
+        print("alerts: no endpoint answered /alerts", file=sys.stderr)
+        return 2
+    if args.json:
+        print(_json.dumps(payload, indent=2, sort_keys=True))
+        return 1 if payload.get("firing") else 0
+    if not payload.get("enabled"):
+        print("%s: watchtower off "
+              "(set root.common.telemetry.watch.enabled)" % url)
+        return 0
+    rules = payload.get("rules", [])
+    print("%s: %d rule(s), %d firing"
+          % (url, len(rules), len(payload.get("firing", []))))
+    for r in rules:
+        print("  %-8s %-24s %-9s value=%-12s since=%s"
+              % (r.get("severity"), r.get("rule"),
+                 r.get("state") or "pending",
+                 r.get("value"), r.get("since")))
+    return 1 if payload.get("firing") else 0
+
+
 def _loadgen_cli(argv) -> int:
     """``veles-tpu loadgen URL`` — drive a serving endpoint (replica
     or router front) open-loop with a seeded synthetic workload
@@ -664,6 +879,12 @@ def _loadgen_cli(argv) -> int:
                         metavar="TPS",
                         help="goodput floor (tokens/s) for the "
                              "verdict (default 0 = no floor)")
+    parser.add_argument("--abort-on-alert", action="store_true",
+                        help="poll the fleet's GET /alerts while "
+                             "driving and stop dispatching the "
+                             "moment any watchtower rule fires — "
+                             "the run FAILS at fire time instead of "
+                             "at the end-of-run verdict")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--json", default=None, metavar="PATH",
                         help="write the full report (records "
@@ -682,7 +903,8 @@ def _loadgen_cli(argv) -> int:
     storms = [parse_storm(s) for s in args.storm]
     url = args.url if "://" in args.url else "http://" + args.url
     report = LoadGen(url, workload, storms=storms, path=args.path,
-                     timeout=args.timeout).run()
+                     timeout=args.timeout,
+                     abort_on_alert=args.abort_on_alert).run()
     slo = verdict(report, slo_ttft_ms=args.slo_ttft_ms,
                   max_interactive_loss=args.max_interactive_loss,
                   min_goodput_tokens_per_s=args.min_goodput)
@@ -691,6 +913,11 @@ def _loadgen_cli(argv) -> int:
     print("offered %d, answered %d in %.1fs (goodput %.1f tok/s)"
           % (report["offered"], report["answered"],
              report["wall_seconds"], agg["goodput_tokens_per_s"]))
+    aborted = report.get("aborted_on_alert")
+    if aborted is not None:
+        print("  ABORTED on firing alert(s) %s after %d dispatched"
+              % (",".join(aborted["rules"]) or "(unknown)",
+                 aborted["after_requests"]))
     for cls in ("interactive", "batch"):
         row = agg[cls]
         print("  %-11s ok=%d shed=%d err=%d ttft_p99=%sms "

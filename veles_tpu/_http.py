@@ -54,6 +54,44 @@ def handle_trace_spans(handler, path: str, name: str = "") -> bool:
     return True
 
 
+def handle_metrics_history(handler, path: str, name: str = "") -> bool:
+    """Serve ``GET /metrics/history[?since=CURSOR]`` — the watchtower
+    SeriesStore pull every request-plane HTTP surface exposes
+    (router, GenerationAPI, RESTfulAPI, web status), same contract as
+    :func:`handle_trace_spans`: JSONL body (header line + one line per
+    ring record) so a torn read salvages per record. With the
+    watchtower off the reply is the header alone (``enabled: false``)
+    and no ``veles_watch_*`` counter moves."""
+    if path.split("?", 1)[0] != "/metrics/history":
+        return False
+    since = 0
+    if "?" in path:
+        from urllib.parse import parse_qs
+        try:
+            since = int(parse_qs(path.split("?", 1)[1]
+                                 ).get("since", ["0"])[0])
+        except (TypeError, ValueError):
+            json_reply(handler, 400,
+                       {"error": "since must be an integer cursor"})
+            return True
+    from .telemetry import timeseries
+    bytes_reply(handler, 200,
+                timeseries.pull_payload(since, name=name).encode(),
+                "application/x-ndjson")
+    return True
+
+
+def handle_alerts(handler, path: str) -> bool:
+    """Serve ``GET /alerts`` — the watchtower rule states as JSON
+    (``veles-tpu alerts`` and loadgen ``--abort-on-alert`` poll
+    this). Off → ``{"enabled": false, "rules": []}``."""
+    if path.split("?", 1)[0] != "/alerts":
+        return False
+    from .telemetry import timeseries
+    json_reply(handler, 200, timeseries.alerts_payload())
+    return True
+
+
 def sse_headers(handler) -> None:
     """Commit a 200 ``text/event-stream`` response (token streaming —
     the GenerationAPI's stream reply and the FleetRouter's stream

@@ -35,4 +35,5 @@ LOADGEN_COUNTERS = (
     "veles_loadgen_shed_total",
     "veles_loadgen_errors_total",
     "veles_loadgen_storms_total",
+    "veles_loadgen_alert_aborts_total",
 )

@@ -114,7 +114,9 @@ class LoadGen(Logger):
                  path: str = "/generate",
                  timeout: float = 60.0,
                  time_scale: float = 1.0,
-                 name: str = "loadgen") -> None:
+                 name: str = "loadgen",
+                 abort_on_alert: bool = False,
+                 alert_poll: float = 0.5) -> None:
         super().__init__()
         self.url = url.rstrip("/")
         self.path = path
@@ -126,6 +128,40 @@ class LoadGen(Logger):
         #: per-request content
         self.time_scale = float(time_scale)
         self.name = name
+        #: poll the fleet's ``GET /alerts`` (the watchtower rule
+        #: states, telemetry/alerts.py) while driving and stop
+        #: dispatching the moment any rule fires — a storm that burns
+        #: error budget fails AT FIRE TIME, not minutes later in the
+        #: end-of-run verdict
+        self.abort_on_alert = bool(abort_on_alert)
+        self.alert_poll = float(alert_poll)
+        self._abort = threading.Event()
+        self._abort_rules: List[str] = []
+
+    def _alert_poll_loop(self, stop: threading.Event) -> None:
+        """Daemon poller behind ``abort_on_alert``: first firing rule
+        set trips the abort latch (counted
+        ``veles_loadgen_alert_aborts_total``). Poll errors are
+        ignored — a fleet without a watchtower (``enabled: false``)
+        simply never aborts."""
+        target = self.url + "/alerts"
+        while not stop.wait(self.alert_poll):
+            try:
+                with urllib.request.urlopen(
+                        target, timeout=self.alert_poll + 2.0) as r:
+                    payload = json.loads(r.read() or b"{}")
+            except Exception:    # noqa: BLE001 — observers only
+                continue
+            firing = payload.get("firing") or []
+            if payload.get("enabled") and firing:
+                self._abort_rules = [str(r) for r in firing]
+                if not self._abort.is_set():
+                    inc("veles_loadgen_alert_aborts_total")
+                    self.warning(
+                        "%s: aborting on firing alert(s): %s",
+                        self.name, ", ".join(self._abort_rules))
+                self._abort.set()
+                return
 
     def run(self) -> Dict[str, Any]:
         arrivals = self.workload.arrivals()
@@ -148,33 +184,59 @@ class LoadGen(Logger):
                   "%d storm(s))", self.name, len(bodies), target,
                   self.workload.shape, len(self.storms))
         t_run = time.time()
-        with StormPlan(self.storms):
-            t0 = time.time()
-            for i, (at, body) in enumerate(zip(arrivals, bodies)):
-                # open loop: sleep to the SCHEDULED instant, then
-                # dispatch — never wait for an answer
-                delay = at * self.time_scale - (time.time() - t0)
-                if delay > 0:
-                    time.sleep(delay)
-                th = threading.Thread(target=fire, args=(i, body),
-                                      daemon=True,
-                                      name="%s.%d" % (self.name, i))
-                th.start()
-                threads.append(th)
-            deadline = time.time() + self.timeout + 5.0
-            for th in threads:
-                th.join(timeout=max(0.1, deadline - time.time()))
+        dispatched = 0
+        poll_stop = threading.Event()
+        poller: Optional[threading.Thread] = None
+        if self.abort_on_alert:
+            self._abort.clear()
+            self._abort_rules = []
+            poller = threading.Thread(
+                target=self._alert_poll_loop, args=(poll_stop,),
+                daemon=True, name=self.name + ".alertpoll")
+            poller.start()
+        try:
+            with StormPlan(self.storms):
+                t0 = time.time()
+                for i, (at, body) in enumerate(zip(arrivals, bodies)):
+                    if self._abort.is_set():
+                        break
+                    # open loop: sleep to the SCHEDULED instant, then
+                    # dispatch — never wait for an answer
+                    delay = at * self.time_scale - (time.time() - t0)
+                    if delay > 0:
+                        if self._abort.wait(delay):
+                            break
+                    th = threading.Thread(
+                        target=fire, args=(i, body), daemon=True,
+                        name="%s.%d" % (self.name, i))
+                    th.start()
+                    threads.append(th)
+                    dispatched += 1
+                deadline = time.time() + self.timeout + 5.0
+                for th in threads:
+                    th.join(timeout=max(0.1, deadline - time.time()))
+        finally:
+            poll_stop.set()
+            if poller is not None:
+                poller.join(timeout=5)
         done = [r for r in records if r is not None]
         wall = time.time() - t_run
-        return {
+        report = {
             "workload": self.workload.describe(),
             "storms": [s.spec() for s in self.storms],
             "wall_seconds": round(wall, 3),
             "offered": len(bodies),
+            "dispatched": dispatched,
             "answered": len(done),
             "records": done,
             "aggregates": aggregate(done, wall),
         }
+        if self._abort.is_set():
+            report["aborted_on_alert"] = {
+                "rules": list(self._abort_rules),
+                "after_requests": dispatched,
+            }
+        return report
 
 
 def aggregate(records: Sequence[Dict[str, Any]],
@@ -254,4 +316,13 @@ def verdict(report: Dict[str, Any],
         "name": "goodput_tokens_per_s",
         "observed": goodput, "bound": min_goodput_tokens_per_s,
         "ok": goodput >= min_goodput_tokens_per_s})
+    aborted = report.get("aborted_on_alert")
+    if aborted is not None:
+        # --abort-on-alert tripped: the run is a FAIL at fire time
+        # whatever the partial aggregates say — the whole point of
+        # polling /alerts is failing before the storm finishes
+        checks.append({
+            "name": "aborted_on_alert",
+            "observed": ",".join(aborted.get("rules", ())) or "yes",
+            "bound": "no firing alerts", "ok": False})
     return {"pass": all(c["ok"] for c in checks), "checks": checks}

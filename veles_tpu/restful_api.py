@@ -22,7 +22,8 @@ from typing import Any, Dict, Optional
 
 import numpy
 
-from ._http import (HTTPService, bytes_reply, handle_trace_spans,
+from ._http import (HTTPService, bytes_reply, handle_alerts,
+                    handle_metrics_history, handle_trace_spans,
                     json_reply, read_json_object)
 from .config import root
 from .error import VelesError
@@ -91,14 +92,21 @@ class RESTfulAPI(Unit):
                 if handle_trace_spans(self, self.path,
                                       name="rest.%s" % api.name):
                     return
+                if handle_metrics_history(self, self.path,
+                                          name="rest.%s" % api.name):
+                    return
+                if handle_alerts(self, self.path):
+                    return
                 if self.path != "/metrics":
                     self.send_error(404)
                     return
+                from .telemetry.alerts import render_firing
                 from .telemetry.counters import (METRICS_CONTENT_TYPE,
                                                  metrics_text)
                 text = metrics_text({
                     "veles_rest_requests_served": api.requests_served,
-                    "veles_rest_pending": api._pending})
+                    "veles_rest_pending": api._pending}) \
+                    + render_firing()
                 bytes_reply(self, 200, text.encode(),
                             METRICS_CONTENT_TYPE)
 
@@ -185,6 +193,14 @@ class RESTfulAPI(Unit):
                                     self.name + ".http")
         self.port = self._service.port
         self._service.start_serving()
+        # watchtower sampler (telemetry/timeseries.py): a no-op config
+        # read unless root.common.telemetry.watch.enabled
+        from .telemetry import timeseries
+        timeseries.add_gauge_provider(
+            "rest.%s" % self.name,
+            lambda: {"veles_rest_requests_served": self.requests_served,
+                     "veles_rest_pending": self._pending})
+        timeseries.maybe_start()
         health.mark_ready("rest.%s" % self.name)
         health.heartbeats.beat("rest.%s" % self.name)
         self.info("%s: REST API on http://127.0.0.1:%d%s", self.name,
@@ -231,6 +247,8 @@ class RESTfulAPI(Unit):
 
     def stop(self) -> None:
         health.forget("rest.%s" % self.name)
+        from .telemetry import timeseries
+        timeseries.remove_gauge_provider("rest.%s" % self.name)
         if self._service is not None:
             self._service.stop_serving()
             self._service = None
@@ -675,6 +693,83 @@ class GenerationAPI(Unit):
         engine.on_death = self._on_replica_death
         return engine
 
+    def _metrics_gauges(self) -> Dict[str, Any]:
+        """Gauge dict behind ``GET /metrics`` — also registered as this
+        replica's watchtower gauge provider (telemetry/timeseries.py),
+        so the sampled series and the scrape surface cannot drift."""
+        gauges = {
+            "veles_generate_requests_served": self.requests_served,
+            "veles_generate_batches_run": self.batches_run,
+            "veles_generate_max_batch": self.max_batch,
+            "veles_generate_queue_depth": len(self._queue),
+            "veles_generate_queue_bound": self.max_queue,
+        }
+        engine = self._engine          # stop() may null it mid-read
+        if engine is not None:
+            # continuous-batching occupancy (the gauges an operator
+            # sizes max_slots/buckets with; the web_status surface
+            # serves the same names suffixed _<engine-name> — this
+            # port has ONE engine, so no suffix)
+            st = engine.stats()
+            gauges.update({
+                "veles_serving_slots": st["slots"],
+                "veles_serving_slots_busy": st["slots_busy"],
+                "veles_serving_peak_slots": st["peak_slots"],
+                "veles_serving_queue_depth": st["queue_depth"],
+                "veles_serving_programs": st["programs"],
+                # quantization/AOT mode gauges (veles_tpu/quant/):
+                # 1 = the plane is active on this engine — dashboards
+                # must know whether a throughput number is fp or int8,
+                # live jit or artifact
+                "veles_serving_artifact_mode": st["artifact_mode"],
+                "veles_quant_weights_mode": st["quant_weights"],
+                "veles_quant_kv_mode": st["quant_kv"],
+                "veles_serving_kv_pool_bytes": st["kv_pool_bytes"],
+                # prefix sharing & chunked prefill (docs/services.md
+                # "Prefix sharing & streaming"): index occupancy and
+                # the per-tick decode stall chunking bounds
+                "veles_prefix_cache_enabled": st["prefix_cache"],
+                "veles_prefix_cached_blocks": st["prefix_blocks"],
+                "veles_serving_prefilling": st["prefilling"],
+                "veles_serving_prefill_stall_seconds":
+                    st["prefill_stall_seconds"],
+            })
+            if st.get("slot_kind", "paged") != "state":
+                # paged-pool occupancy (serving/pages.py): the gauges
+                # an operator sizes pages/page_size with —
+                # fragmentation is the allocated-but-unoccupied
+                # fraction of in-use pages (tail-of-page waste).
+                # Rendered ONLY for paged engines: a pageless
+                # O(1)-state replica must never put zero rows into
+                # the fleet's page math
+                gauges.update({
+                    "veles_serving_pages_total": st["pages_total"],
+                    "veles_serving_pages_in_use": st["pages_in_use"],
+                    "veles_serving_page_size": st["page_size"],
+                    "veles_serving_page_fragmentation":
+                        st["page_fragmentation"],
+                })
+            else:
+                # O(1)-state lane occupancy (serving/recurrent.py):
+                # per-slot state HBM is CONSTANT in sequence length —
+                # the gauges an operator sizes max_slots and the
+                # state-cache budget with
+                gauges.update({
+                    "veles_o1_state_bytes_per_slot":
+                        st["state_bytes_per_slot"],
+                    "veles_o1_state_cache_blocks":
+                        st["state_cache_blocks"],
+                    "veles_o1_state_cache_bytes":
+                        st["state_cache_bytes"],
+                    "veles_o1_checkpoint_interval": st["page_size"],
+                })
+        # elastic training plane (resilience/elastic.py): generation/
+        # world-size gauges ride this surface too (a training host can
+        # serve status while elastic) — no rows while the plane is off
+        from .resilience import elastic as _elastic
+        gauges.update(_elastic.gauges())
+        return gauges
+
     def initialize(self, **kwargs):
         with self._lifecycle:
             return self._initialize_locked(**kwargs)
@@ -752,106 +847,22 @@ class GenerationAPI(Unit):
                 if handle_trace_spans(self, self.path,
                                       name="serve.%s" % api.name):
                     return
+                if handle_metrics_history(self, self.path,
+                                          name="serve.%s" % api.name):
+                    return
+                if handle_alerts(self, self.path):
+                    return
                 if self.path == "/metrics":
                     # Prometheus scrape surface (telemetry counters —
                     # the structured successor of the /stats dict; the
                     # decode dispatch/token counters land here from
                     # nn/sampling.py + nn/speculative.py), plus this
                     # unit's serving gauges
+                    from .telemetry.alerts import render_firing
                     from .telemetry.counters import (
                         METRICS_CONTENT_TYPE, metrics_text)
-                    gauges = {
-                        "veles_generate_requests_served":
-                            api.requests_served,
-                        "veles_generate_batches_run": api.batches_run,
-                        "veles_generate_max_batch": api.max_batch,
-                        "veles_generate_queue_depth": len(api._queue),
-                        "veles_generate_queue_bound": api.max_queue,
-                    }
-                    engine = api._engine   # stop() may null it mid-GET
-                    if engine is not None:
-                        # continuous-batching occupancy (the gauges an
-                        # operator sizes max_slots/buckets with; the
-                        # web_status surface serves the same names
-                        # suffixed _<engine-name> — this port has ONE
-                        # engine, so no suffix)
-                        st = engine.stats()
-                        gauges.update({
-                            "veles_serving_slots": st["slots"],
-                            "veles_serving_slots_busy":
-                                st["slots_busy"],
-                            "veles_serving_peak_slots":
-                                st["peak_slots"],
-                            "veles_serving_queue_depth":
-                                st["queue_depth"],
-                            "veles_serving_programs": st["programs"],
-                            # quantization/AOT mode gauges (veles_tpu/
-                            # quant/): 1 = the plane is active on this
-                            # engine — dashboards must know whether a
-                            # throughput number is fp or int8, live
-                            # jit or artifact
-                            "veles_serving_artifact_mode":
-                                st["artifact_mode"],
-                            "veles_quant_weights_mode":
-                                st["quant_weights"],
-                            "veles_quant_kv_mode": st["quant_kv"],
-                            "veles_serving_kv_pool_bytes":
-                                st["kv_pool_bytes"],
-                            # prefix sharing & chunked prefill
-                            # (docs/services.md "Prefix sharing &
-                            # streaming"): index occupancy and the
-                            # per-tick decode stall chunking bounds
-                            "veles_prefix_cache_enabled":
-                                st["prefix_cache"],
-                            "veles_prefix_cached_blocks":
-                                st["prefix_blocks"],
-                            "veles_serving_prefilling":
-                                st["prefilling"],
-                            "veles_serving_prefill_stall_seconds":
-                                st["prefill_stall_seconds"],
-                        })
-                        if st.get("slot_kind", "paged") != "state":
-                            # paged-pool occupancy (serving/pages.py):
-                            # the gauges an operator sizes pages/
-                            # page_size with — fragmentation is the
-                            # allocated-but-unoccupied fraction of
-                            # in-use pages (tail-of-page waste).
-                            # Rendered ONLY for paged engines: a
-                            # pageless O(1)-state replica must never
-                            # put zero rows into the fleet's page math
-                            gauges.update({
-                                "veles_serving_pages_total":
-                                    st["pages_total"],
-                                "veles_serving_pages_in_use":
-                                    st["pages_in_use"],
-                                "veles_serving_page_size":
-                                    st["page_size"],
-                                "veles_serving_page_fragmentation":
-                                    st["page_fragmentation"],
-                            })
-                        else:
-                            # O(1)-state lane occupancy (serving/
-                            # recurrent.py): per-slot state HBM is
-                            # CONSTANT in sequence length — the
-                            # gauges an operator sizes max_slots and
-                            # the state-cache budget with
-                            gauges.update({
-                                "veles_o1_state_bytes_per_slot":
-                                    st["state_bytes_per_slot"],
-                                "veles_o1_state_cache_blocks":
-                                    st["state_cache_blocks"],
-                                "veles_o1_state_cache_bytes":
-                                    st["state_cache_bytes"],
-                                "veles_o1_checkpoint_interval":
-                                    st["page_size"],
-                            })
-                    # elastic training plane (resilience/elastic.py):
-                    # generation/world-size gauges ride this surface
-                    # too (a training host can serve status while
-                    # elastic) — no rows while the plane is off
-                    from .resilience import elastic as _elastic
-                    gauges.update(_elastic.gauges())
-                    text = metrics_text(gauges)
+                    text = metrics_text(api._metrics_gauges()) \
+                        + render_firing()
                     bytes_reply(self, 200, text.encode(),
                                 METRICS_CONTENT_TYPE)
                     return
@@ -1185,6 +1196,14 @@ class GenerationAPI(Unit):
                                     self.name + ".http")
         self.port = self._service.port
         self._service.start_serving()
+        # watchtower sampler (telemetry/timeseries.py): a no-op config
+        # read unless root.common.telemetry.watch.enabled — the
+        # provider shares _metrics_gauges with /metrics, so the ring
+        # records exactly what a scrape would have seen
+        from .telemetry import timeseries
+        timeseries.add_gauge_provider("serve.%s" % self.name,
+                                      self._metrics_gauges)
+        timeseries.maybe_start()
         health.mark_ready("serve.%s" % self.name)
         self.info("%s: generation API on http://127.0.0.1:%d%s "
                   "(modes: %s%s)", self.name, self.port, self.path,
@@ -1265,6 +1284,8 @@ class GenerationAPI(Unit):
 
     def stop(self) -> None:
         with self._lifecycle:
+            from .telemetry import timeseries
+            timeseries.remove_gauge_provider("serve.%s" % self.name)
             if self._service is not None:
                 self._service.stop_serving()
                 self._service = None

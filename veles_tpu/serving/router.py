@@ -66,7 +66,8 @@ from http.server import BaseHTTPRequestHandler
 from typing import (Any, Callable, Dict, List, Optional, Sequence,
                     Tuple)
 
-from .._http import (HTTPService, bytes_reply, handle_trace_spans,
+from .._http import (HTTPService, bytes_reply, handle_alerts,
+                     handle_metrics_history, handle_trace_spans,
                      json_reply, read_json_object)
 from ..config import root
 from ..error import VelesError
@@ -464,6 +465,14 @@ class FleetRouter(Logger):
                                     self.name + ".http")
         self.port = self._service.port
         self._service.start_serving()
+        # watchtower sampler (telemetry/timeseries.py): the router's
+        # gauges() carries the fleet-level sums the probe loop keeps
+        # fresh, so fleet series ride the same ring as local ones.
+        # No-op unless root.common.telemetry.watch.enabled.
+        from ..telemetry import timeseries
+        timeseries.add_gauge_provider("router.%s" % self.name,
+                                      self.gauges)
+        timeseries.maybe_start()
         health.mark_ready("router.%s" % self.name)
         health.heartbeats.beat("router.%s" % self.name)
         self.info("%s: routing %s on http://127.0.0.1:%d%s "
@@ -482,6 +491,8 @@ class FleetRouter(Logger):
         return self
 
     def stop(self) -> None:
+        from ..telemetry import timeseries
+        timeseries.remove_gauge_provider("router.%s" % self.name)
         self._closing = True
         self._wake.set()
         if self._probe_thread is not None:
@@ -1397,6 +1408,22 @@ class FleetRouter(Logger):
                 (1 if self._draining else 0,
                  "1 while the router is draining (admission "
                  "stopped, in-flight finishing)"),
+            # fleet-level occupancy: sums of the probe-thread
+            # snapshots across the roster — the series the
+            # watchtower's fleet rules (queue_depth_high) and the
+            # `veles-tpu watch` dashboard read from the router
+            "veles_fleet_slots":
+                (sum(r.slots for r in self.replicas),
+                 "Decode slots across all roster replicas (last "
+                 "probe)"),
+            "veles_fleet_slots_busy":
+                (sum(r.slots_busy for r in self.replicas),
+                 "Busy decode slots across all roster replicas "
+                 "(last probe)"),
+            "veles_fleet_queue_depth":
+                (sum(r.queue_depth for r in self.replicas),
+                 "Queued requests across all roster replicas (last "
+                 "probe)"),
         }
         if self.journal is not None:
             gauges["veles_router_journal_pending"] = (
@@ -1447,9 +1474,17 @@ class FleetRouter(Logger):
                 if handle_trace_spans(self, self.path,
                                       name="router.%s" % router.name):
                     return
+                if handle_metrics_history(self, self.path,
+                                          name="router.%s"
+                                          % router.name):
+                    return
+                if handle_alerts(self, self.path):
+                    return
                 if self.path == "/metrics":
-                    bytes_reply(self, 200,
-                                metrics_text(router.gauges()).encode(),
+                    from ..telemetry.alerts import render_firing
+                    text = metrics_text(router.gauges()) \
+                        + render_firing()
+                    bytes_reply(self, 200, text.encode(),
                                 METRICS_CONTENT_TYPE)
                     return
                 if self.path == "/fleet/metrics":

@@ -59,6 +59,19 @@ TENSORMON_COUNTERS = (
     "veles_blackbox_dumps_total",
 )
 
+#: every counter the watchtower plane increments (SeriesStore
+#: samples, /metrics/history pulls, alert-rule sweeps/transitions,
+#: critical-unready hooks) — registered with HELP strings in
+#: counters.DESCRIPTIONS and asserted zero in watch-off runs by
+#: ``python bench.py gate``'s watch section
+WATCH_COUNTERS = (
+    "veles_watch_samples_total",
+    "veles_watch_pulls_total",
+    "veles_alert_evals_total",
+    "veles_alert_transitions_total",
+    "veles_alert_critical_unready_total",
+)
+
 #: every counter the fleet-tracing plane increments (span-ring pulls,
 #: trace-file rotations, cross-process merges) — registered with HELP
 #: strings in counters.DESCRIPTIONS and asserted zero in non-fleet

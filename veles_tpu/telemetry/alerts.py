@@ -1,0 +1,433 @@
+"""Watchtower alerting: threshold + SLO burn-rate rules over a
+:class:`~veles_tpu.telemetry.timeseries.SeriesStore`.
+
+The rule engine is the operator-facing half of the watchtower plane
+(timeseries.py is the data half): the sampler thread calls
+:meth:`AlertEngine.evaluate` after every sample, each rule derives
+one observed value from the store's windowed rates/quantiles/bucket
+deltas, and state transitions follow the brownout ladder's hysteresis
+idiom — ``fire_for`` consecutive breached evaluations to go firing,
+``resolve_for`` consecutive clean ones to resolve, so a flapping
+signal cannot strobe the pager. Two rule kinds:
+
+- :class:`ThresholdRule` — a bound on a service gauge
+  (``veles_serving_queue_depth > 64``) or on a counter's windowed
+  rate (``rate(veles_shed_requests_total) > 5/s``);
+- :class:`BurnRateRule` — multi-window SLO error-budget burn. The
+  SLO is "``objective`` of requests complete under ``slo_seconds``"
+  (error budget = 1 - objective); the burn rate over a window is
+  ``observed_error_fraction / error_budget`` (1.0 = exactly spending
+  the budget). The rule breaches only when BOTH the fast and the
+  slow window burn above ``factor`` — the standard fast+slow pair:
+  the fast window gives minutes-scale detection, the slow window
+  keeps a single bad scrape from paging.
+
+Transitions are *observable everywhere the incident will be
+debugged*: noted into the flight recorder (``blackbox inspect``
+shows them), appended to the SeriesStore ring (``/metrics/history``
+pulls see them in order with the samples), counted
+(``veles_alert_transitions_total``) and rendered as
+``veles_alert_firing{rule="..."}`` gauges on ``/metrics``. A
+``critical`` rule firing additionally marks the process unready
+(``health.mark_unready`` — the router's probe loop routes around it)
+and dumps the flight-recorder black box; resolving marks it ready
+again.
+
+Rule validation is FAIL-CLOSED: a rule referencing a series name
+that is not a registered counter (counters.DESCRIPTIONS), histogram
+(counters.HISTOGRAMS) or known service gauge (KNOWN_GAUGES) refuses
+at parse time with a ValueError — a typo'd rule that silently never
+fires is worse than no rule. scripts/check_counters.py re-runs the
+same validation over the shipped defaults in CI.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .counters import DESCRIPTIONS, HISTOGRAMS, counters
+
+#: service gauges a ThresholdRule may reference — the names the
+#: request-plane HTTP surfaces export on /metrics (restful_api.py,
+#: serving/router.py) and the watch sampler's gauge providers feed
+#: into SeriesStore samples. Gauges are not registry-backed, so this
+#: tuple IS their registration for the fail-closed rule validation.
+KNOWN_GAUGES = (
+    "veles_serving_slots",
+    "veles_serving_slots_busy",
+    "veles_serving_queue_depth",
+    "veles_serving_prefill_stall_seconds",
+    "veles_router_replicas",
+    "veles_router_replicas_ready",
+    "veles_router_breakers_open",
+    "veles_router_inflight",
+    "veles_qos_admit_rate",
+    "veles_qos_brownout_level",
+    "veles_qos_retry_tokens",
+    "veles_fleet_slots",
+    "veles_fleet_slots_busy",
+    "veles_fleet_queue_depth",
+)
+
+SEVERITIES = ("info", "warn", "critical")
+
+
+def _validate_series(rule_name: str, series: str,
+                     kinds: Sequence[str]) -> None:
+    """FAIL-CLOSED series check: ``series`` must be registered as one
+    of the allowed ``kinds`` ('counter', 'histogram', 'gauge')."""
+    ok = (("counter" in kinds and series in DESCRIPTIONS)
+          or ("histogram" in kinds and series in HISTOGRAMS)
+          or ("gauge" in kinds and series in KNOWN_GAUGES))
+    if not ok:
+        raise ValueError(
+            "alert rule %r references unregistered series %r (must "
+            "be a registered %s — counters.DESCRIPTIONS / "
+            "counters.HISTOGRAMS / alerts.KNOWN_GAUGES)"
+            % (rule_name, series, "/".join(kinds)))
+
+
+class Rule:
+    """Shared rule state machine: hysteresis streaks + severity."""
+
+    def __init__(self, name: str, severity: str = "warn",
+                 fire_for: int = 2, resolve_for: int = 3) -> None:
+        if severity not in SEVERITIES:
+            raise ValueError("alert rule %r: unknown severity %r "
+                             "(one of %s)"
+                             % (name, severity, "/".join(SEVERITIES)))
+        self.name = str(name)
+        self.severity = severity
+        self.fire_for = max(1, int(fire_for))
+        self.resolve_for = max(1, int(resolve_for))
+        self.state = "ok"
+        self.value: Optional[float] = None
+        self.since: Optional[float] = None
+        self._streak = 0
+
+    def observe(self, store) -> Optional[bool]:
+        """One evaluation: returns the breach verdict (None = not
+        enough data yet; streaks hold still)."""
+        raise NotImplementedError
+
+    def describe(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def step(self, store, now: float) -> Optional[str]:
+        """Advance the hysteresis machine one evaluation; returns
+        'firing' / 'resolved' on a transition, else None."""
+        breached = self.observe(store)
+        if breached is None:
+            return None
+        if self.state == "ok":
+            self._streak = self._streak + 1 if breached else 0
+            if self._streak >= self.fire_for:
+                self.state, self.since, self._streak = "firing", now, 0
+                return "firing"
+        else:
+            self._streak = self._streak + 1 if not breached else 0
+            if self._streak >= self.resolve_for:
+                self.state, self.since, self._streak = "ok", now, 0
+                return "resolved"
+        return None
+
+    def status(self) -> Dict[str, Any]:
+        out = {"rule": self.name, "severity": self.severity,
+               "state": self.state,
+               "value": None if self.value is None
+               else round(float(self.value), 6),
+               "since": self.since}
+        out.update(self.describe())
+        return out
+
+
+class ThresholdRule(Rule):
+    """``gauge(series) OP threshold`` or
+    ``rate(series, window) OP threshold``. ``source`` picks the
+    read: 'gauge' (latest sampled service gauge) or 'rate'
+    (windowed per-second counter rate)."""
+
+    def __init__(self, name: str, series: str, threshold: float,
+                 op: str = ">", source: str = "gauge",
+                 window: Optional[float] = None, **kwargs: Any
+                 ) -> None:
+        super().__init__(name, **kwargs)
+        if op not in (">", "<", ">=", "<="):
+            raise ValueError("alert rule %r: unknown op %r"
+                             % (name, op))
+        if source not in ("gauge", "rate"):
+            raise ValueError("alert rule %r: unknown source %r "
+                             "(gauge or rate)" % (name, source))
+        _validate_series(name, series,
+                         ("gauge",) if source == "gauge"
+                         else ("counter",))
+        self.series = series
+        self.threshold = float(threshold)
+        self.op = op
+        self.source = source
+        self.window = None if window is None else float(window)
+
+    def observe(self, store) -> Optional[bool]:
+        if self.source == "gauge":
+            value = store.gauge(self.series)
+        else:
+            value = store.rate(self.series, self.window)
+        if value is None:
+            return None
+        self.value = float(value)
+        if self.op == ">":
+            return self.value > self.threshold
+        if self.op == "<":
+            return self.value < self.threshold
+        if self.op == ">=":
+            return self.value >= self.threshold
+        return self.value <= self.threshold
+
+    def describe(self) -> Dict[str, Any]:
+        return {"type": "threshold", "series": self.series,
+                "op": self.op, "threshold": self.threshold,
+                "source": self.source, "window": self.window}
+
+
+class BurnRateRule(Rule):
+    """Multi-window SLO error-budget burn on a latency histogram.
+
+    ``objective`` of requests must complete under ``slo_seconds``;
+    the windowed error fraction comes from SeriesStore bucket deltas
+    (a request is an 'error' when its bucket's upper bound exceeds
+    the target — bucket resolution errs toward alerting). Breaches
+    when burn > ``factor`` in BOTH windows; ``value`` reports the
+    fast-window burn."""
+
+    def __init__(self, name: str, series: str, slo_seconds: float,
+                 objective: float = 0.99, fast_window: float = 30.0,
+                 slow_window: float = 120.0, factor: float = 6.0,
+                 **kwargs: Any) -> None:
+        super().__init__(name, **kwargs)
+        _validate_series(name, series, ("histogram",))
+        if not 0.0 < float(objective) < 1.0:
+            raise ValueError("alert rule %r: objective %r must be in "
+                             "(0, 1)" % (name, objective))
+        if float(slow_window) < float(fast_window):
+            raise ValueError("alert rule %r: slow_window %.3f < "
+                             "fast_window %.3f"
+                             % (name, slow_window, fast_window))
+        self.series = series
+        self.slo_seconds = float(slo_seconds)
+        self.objective = float(objective)
+        self.fast_window = float(fast_window)
+        self.slow_window = float(slow_window)
+        self.factor = float(factor)
+
+    def burn(self, store, window: float) -> Optional[float]:
+        frac = store.error_fraction(self.series, self.slo_seconds,
+                                    window)
+        if frac is None:
+            return None
+        return frac / (1.0 - self.objective)
+
+    def observe(self, store) -> Optional[bool]:
+        fast = self.burn(store, self.fast_window)
+        slow = self.burn(store, self.slow_window)
+        if fast is None or slow is None:
+            return None
+        self.value = fast
+        return fast > self.factor and slow > self.factor
+
+    def describe(self) -> Dict[str, Any]:
+        return {"type": "burn_rate", "series": self.series,
+                "slo_seconds": self.slo_seconds,
+                "objective": self.objective,
+                "fast_window": self.fast_window,
+                "slow_window": self.slow_window,
+                "factor": self.factor}
+
+
+RULE_TYPES = {"threshold": ThresholdRule, "burn_rate": BurnRateRule}
+
+
+def parse_rule(spec: Dict[str, Any]) -> Rule:
+    """One rule from a config dict — FAIL-CLOSED: unknown type,
+    unknown series, malformed field all raise at parse."""
+    spec = dict(spec)
+    kind = spec.pop("type", "threshold")
+    cls = RULE_TYPES.get(kind)
+    if cls is None:
+        raise ValueError("alert rule %r: unknown type %r (one of %s)"
+                         % (spec.get("name"), kind,
+                            "/".join(sorted(RULE_TYPES))))
+    try:
+        return cls(**spec)
+    except TypeError as e:
+        raise ValueError("alert rule %r: %s" % (spec.get("name"), e))
+
+
+def default_rules() -> List[Rule]:
+    """The shipped rule set. Window/target knobs ride
+    ``root.common.telemetry.watch.*`` so drills and small fleets can
+    shrink them without redefining the rules:
+    ``slo_ttft_ms`` (500), ``slo_e2e_ms`` (5000), ``objective``
+    (0.99), ``fast_window`` (30 s), ``slow_window`` (120 s),
+    ``burn_factor`` (6), ``queue_depth_limit`` (64),
+    ``shed_rate_limit`` (5/s)."""
+    try:
+        from ..config import root
+        node = root.common.telemetry.watch
+        get = node.get
+    except Exception:        # noqa: BLE001 — config not importable
+        get = lambda name, default=None: default      # noqa: E731
+    fast = float(get("fast_window", 30.0) or 30.0)
+    slow = float(get("slow_window", 120.0) or 120.0)
+    factor = float(get("burn_factor", 6.0) or 6.0)
+    objective = float(get("objective", 0.99) or 0.99)
+    return [
+        BurnRateRule(
+            "slo_ttft_burn", "veles_serving_ttft_seconds",
+            slo_seconds=float(get("slo_ttft_ms", 500.0) or 500.0)
+            / 1000.0,
+            objective=objective, fast_window=fast, slow_window=slow,
+            factor=factor, severity="warn"),
+        BurnRateRule(
+            "slo_e2e_burn", "veles_serving_e2e_seconds",
+            slo_seconds=float(get("slo_e2e_ms", 5000.0) or 5000.0)
+            / 1000.0,
+            objective=objective, fast_window=fast, slow_window=slow,
+            factor=factor, severity="warn"),
+        ThresholdRule(
+            "queue_depth_high", "veles_serving_queue_depth",
+            threshold=float(get("queue_depth_limit", 64) or 64),
+            op=">", source="gauge", severity="warn"),
+        ThresholdRule(
+            "shed_rate_high", "veles_shed_requests_total",
+            threshold=float(get("shed_rate_limit", 5.0) or 5.0),
+            op=">", source="rate", window=fast, severity="warn"),
+        # the brownout<->alert cross-link (docs/services.md): ladder
+        # level >= 2 means speculative decoding is stripped and batch
+        # shedding is next — the replica is past graceful degradation,
+        # so the critical hook routes traffic around it until the
+        # ladder climbs back down
+        ThresholdRule(
+            "brownout_shedding", "veles_qos_brownout_level",
+            threshold=2.0, op=">=", source="gauge",
+            severity="critical", fire_for=3, resolve_for=3),
+    ]
+
+
+def rules_from_config() -> List[Rule]:
+    """Shipped defaults + operator rules from
+    ``root.common.telemetry.watch.rules`` (a list of rule dicts —
+    JSON config or ``--watch-rules FILE``). Duplicate names: the
+    operator's rule replaces the default."""
+    rules = {r.name: r for r in default_rules()}
+    try:
+        from ..config import root
+        extra = root.common.telemetry.watch.get("rules", None) or ()
+    except Exception:        # noqa: BLE001 — config not importable
+        extra = ()
+    for spec in extra:
+        rule = parse_rule(dict(spec))
+        rules[rule.name] = rule
+    return list(rules.values())
+
+
+class AlertEngine:
+    """Evaluate a rule set against a SeriesStore; own the transition
+    side effects (flight recorder, ring events, counters, the
+    critical health hook)."""
+
+    def __init__(self, store, rules: Sequence[Rule],
+                 clock: Callable[[], float] = time.time,
+                 health_name: str = "watch",
+                 dump_on_critical: bool = True) -> None:
+        names = [r.name for r in rules]
+        if len(set(names)) != len(names):
+            raise ValueError("duplicate alert rule names: %s" % names)
+        self.store = store
+        self.rules = list(rules)
+        self.clock = clock
+        self.health_name = health_name
+        self.dump_on_critical = dump_on_critical
+
+    def evaluate(self) -> List[Dict[str, Any]]:
+        """One sweep over every rule (the sampler-thread tick after
+        each sample); returns the transitions that happened."""
+        counters.inc("veles_alert_evals_total")
+        now = float(self.clock())
+        transitions = []
+        for rule in self.rules:
+            try:
+                edge = rule.step(self.store, now)
+            except Exception:    # noqa: BLE001 — one bad rule must
+                continue         # not take the sweep down
+            if edge is not None:
+                self._transition(rule, edge, now)
+                transitions.append({"rule": rule.name, "state": edge,
+                                    "value": rule.value})
+        return transitions
+
+    def _transition(self, rule: Rule, edge: str, now: float) -> None:
+        counters.inc("veles_alert_transitions_total")
+        value = None if rule.value is None else float(rule.value)
+        self.store.note_event("watch.alert", rule=rule.name,
+                              state=edge, value=value,
+                              severity=rule.severity)
+        try:
+            from .recorder import flight
+            flight.note("alert", rule=rule.name, state=edge,
+                        value=value, severity=rule.severity)
+        except Exception:        # noqa: BLE001 — observability only
+            flight = None
+        if rule.severity != "critical":
+            return
+        # the critical hook: a firing page-severity rule flips this
+        # process unready (the router probe loop routes around it)
+        # and preserves the forensics; resolve restores admission
+        try:
+            from ..resilience import health
+            token = "alert.%s.%s" % (self.health_name, rule.name)
+            if edge == "firing":
+                health.mark_unready(token)
+                counters.inc("veles_alert_critical_unready_total")
+            else:
+                health.mark_ready(token)
+        except Exception:        # noqa: BLE001 — observability only
+            pass
+        if edge == "firing" and self.dump_on_critical \
+                and flight is not None:
+            try:
+                flight.dump("alert:%s" % rule.name)
+            except Exception:    # noqa: BLE001 — the black box must
+                pass             # not take the alert path down
+
+    def status(self) -> List[Dict[str, Any]]:
+        return [rule.status() for rule in self.rules]
+
+    def firing(self) -> List[str]:
+        return [rule.name for rule in self.rules
+                if rule.state == "firing"]
+
+    def render_firing(self) -> str:
+        """``veles_alert_firing{rule="..."}`` exposition rows (the
+        labeled-gauge style of fleet.render's endpoint_up) — appended
+        after metrics_text by every surface serving a live
+        watchtower."""
+        lines = [
+            "# HELP veles_alert_firing 1 = alert rule currently "
+            "firing (watchtower rule engine, telemetry/alerts.py)",
+            "# TYPE veles_alert_firing gauge",
+        ]
+        for rule in self.rules:
+            lines.append('veles_alert_firing{rule="%s"} %d'
+                         % (rule.name,
+                            1 if rule.state == "firing" else 0))
+        return "\n".join(lines) + "\n"
+
+
+def render_firing() -> str:
+    """Module-level :meth:`AlertEngine.render_firing` on the live
+    engine — empty string while the watchtower is off, so /metrics
+    renders byte-identical to the pre-watchtower page."""
+    from . import timeseries
+    engine = timeseries.alert_engine()
+    return "" if engine is None else engine.render_firing()

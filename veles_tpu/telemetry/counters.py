@@ -338,6 +338,29 @@ DESCRIPTIONS = {
     "veles_linalg_residual_failures_total":
         "Residual checks FAILED — the solve raised instead of "
         "returning a silently-wrong answer (chaos corrupt lands here)",
+    # watchtower plane (telemetry/timeseries.py + telemetry/
+    # alerts.py): bench.py's gate asserts these read 0 in watch-off
+    # runs — the sampler thread and rule engine must not exist at all
+    # unless root.common.telemetry.watch.enabled
+    "veles_watch_samples_total":
+        "Metric time-series samples taken by the watchtower "
+        "SeriesStore ring (one per sampler period)",
+    "veles_watch_pulls_total":
+        "Watchtower history pulls served over GET /metrics/history "
+        "(router + serving APIs + web status)",
+    "veles_alert_evals_total":
+        "Alert rule-set evaluation sweeps run by the watchtower "
+        "(one per sample)",
+    "veles_alert_transitions_total":
+        "Alert rule state transitions in either direction "
+        "(ok -> firing and firing -> resolved)",
+    "veles_alert_critical_unready_total":
+        "Critical-severity alert firings that marked this process "
+        "unready and dumped the flight-recorder black box",
+    "veles_loadgen_alert_aborts_total":
+        "Load-harness runs aborted at alert fire time "
+        "(--abort-on-alert saw a firing watchtower rule and stopped "
+        "offering load)",
 }
 
 

@@ -158,10 +158,13 @@ def merge(parsed: Sequence[Dict[str, Dict]]) -> Dict[str, Dict]:
     return out
 
 
-def quantiles(hist: Dict, qs=(0.5, 0.9, 0.99)) -> Dict[float, Optional[float]]:
-    """Recompute quantiles from a merged histogram's CUMULATIVE
-    buckets (the exposition form) via the shared
-    :func:`histogram_quantile` arithmetic."""
+def hist_to_snapshot(hist: Dict) -> Dict:
+    """A merged CUMULATIVE-bucket histogram (the exposition form) →
+    the registry-snapshot form (``{"bounds", "counts"
+    (non-cumulative, + overflow), "sum", "count"}``) —  exactly what
+    :meth:`~veles_tpu.telemetry.timeseries.SeriesStore.ingest`
+    stores, so a remote scrape and a local registry sample derive
+    windowed quantiles through the same arithmetic."""
     items = sorted(((le, cum) for le, cum in hist["buckets"].items()
                     if le != "+Inf"),
                    key=lambda kv: _le_value(kv[0]))
@@ -172,7 +175,90 @@ def quantiles(hist: Dict, qs=(0.5, 0.9, 0.99)) -> Dict[float, Optional[float]]:
         counts.append(max(0.0, cum - prev))
         prev = max(prev, cum)
     counts.append(max(0.0, float(hist["count"]) - prev))  # +Inf bucket
-    return {q: histogram_quantile(bounds, counts, q) for q in qs}
+    return {"bounds": bounds, "counts": counts,
+            "sum": float(hist.get("sum", 0.0)),
+            "count": float(hist.get("count", 0.0))}
+
+
+def quantiles(hist: Dict, qs=(0.5, 0.9, 0.99)) -> Dict[float, Optional[float]]:
+    """Recompute quantiles from a merged histogram's CUMULATIVE
+    buckets (the exposition form) via the shared
+    :func:`histogram_quantile` arithmetic."""
+    snap = hist_to_snapshot(hist)
+    return {q: histogram_quantile(snap["bounds"], snap["counts"], q)
+            for q in qs}
+
+
+def ingest_aggregate(store, agg: Dict, ts: Optional[float] = None
+                     ) -> None:
+    """Feed one :func:`aggregate` result into a client-side
+    :class:`~veles_tpu.telemetry.timeseries.SeriesStore` (built with
+    ``count_samples=False`` — a watching CLI must not move the
+    watched fleet's, or its own process's, watch counters). The
+    endpoint up/down status rides along as fleet gauges so the watch
+    loop can display roster health from the same ring."""
+    merged = agg["merged"]
+    hists = {name: hist_to_snapshot(h)
+             for name, h in merged["histograms"].items()}
+    gauges = dict(merged["gauges"])
+    gauges["veles_fleet_endpoints"] = len(agg["endpoints"])
+    gauges["veles_fleet_endpoints_up"] = sum(
+        1 for ep in agg["endpoints"] if ep["up"])
+    store.ingest(merged["counters"], hists, gauges, ts=ts)
+
+
+def interval_report(store, window: Optional[float] = None) -> Dict:
+    """One watch-interval summary from a client-side store: request/
+    token rates and WINDOWED latency quantiles (bucket deltas between
+    the window's endpoint samples — the cumulative ``_p99`` gauges on
+    the scrape page would bury a brownout under the whole run's
+    history), plus the fleet occupancy gauges of the newest sample.
+    Values are None until two samples exist."""
+    def _r(v, nd=3):
+        return None if v is None else round(v, nd)
+    return {
+        "up": store.gauge("veles_fleet_endpoints_up"),
+        "endpoints": store.gauge("veles_fleet_endpoints"),
+        "qps": _r(store.rate("veles_serving_retired_total", window)),
+        "tok_s": _r(store.rate("veles_serving_tokens_total", window)),
+        "shed_s": _r(store.rate("veles_shed_requests_total", window)),
+        "ttft_p50": _r(store.quantile(
+            "veles_serving_ttft_seconds", 0.5, window), 4),
+        "ttft_p99": _r(store.quantile(
+            "veles_serving_ttft_seconds", 0.99, window), 4),
+        "tpot_p50": _r(store.quantile(
+            "veles_serving_tpot_seconds", 0.5, window), 4),
+        "tpot_p99": _r(store.quantile(
+            "veles_serving_tpot_seconds", 0.99, window), 4),
+        "e2e_p99": _r(store.quantile(
+            "veles_serving_e2e_seconds", 0.99, window), 4),
+        "slots_busy": store.gauge("veles_serving_slots_busy"),
+        "slots": store.gauge("veles_serving_slots"),
+        "queue_depth": store.gauge("veles_serving_queue_depth"),
+        "brownout": store.gauge("veles_qos_brownout_level"),
+        "admit_rate": store.gauge("veles_qos_admit_rate"),
+    }
+
+
+def format_interval(rep: Dict) -> str:
+    """One terminal line per watch interval (``veles-tpu metrics
+    aggregate --watch``)."""
+    def fmt(v, unit=""):
+        return "-" if v is None else ("%g%s" % (v, unit))
+    parts = ["up %s/%s" % (fmt(rep["up"]), fmt(rep["endpoints"])),
+             "qps %s" % fmt(rep["qps"]),
+             "tok/s %s" % fmt(rep["tok_s"]),
+             "ttft p50/p99 %s/%s" % (fmt(rep["ttft_p50"], "s"),
+                                     fmt(rep["ttft_p99"], "s")),
+             "e2e p99 %s" % fmt(rep["e2e_p99"], "s"),
+             "busy %s/%s" % (fmt(rep["slots_busy"]),
+                             fmt(rep["slots"])),
+             "queue %s" % fmt(rep["queue_depth"])]
+    if rep.get("shed_s"):
+        parts.append("shed/s %s" % fmt(rep["shed_s"]))
+    if rep.get("brownout"):
+        parts.append("brownout L%s" % fmt(rep["brownout"]))
+    return "  ".join(parts)
 
 
 def read_endpoints(path: str) -> List[str]:
@@ -647,6 +733,15 @@ def main(argv) -> int:
     ag.add_argument("--json", action="store_true",
                     help="print the structured aggregation instead "
                          "of exposition text")
+    ag.add_argument("--watch", type=float, default=None, metavar="SEC",
+                    help="interval mode: re-scrape every SEC seconds "
+                         "and print one summary line per interval "
+                         "(windowed rates/quantiles from sample "
+                         "deltas via the watchtower SeriesStore) "
+                         "instead of one exposition page")
+    ag.add_argument("--iterations", type=int, default=0, metavar="N",
+                    help="with --watch: stop after N intervals "
+                         "(0 = run until interrupted)")
     args = parser.parse_args(argv)
     urls = list(args.urls)
     if args.endpoints_file:
@@ -657,9 +752,52 @@ def main(argv) -> int:
     if not urls:
         parser.error("no endpoints (positional URLs and/or "
                      "--endpoints-file)")
+    if args.watch is not None:
+        if args.watch <= 0:
+            parser.error("--watch period must be > 0")
+        return watch_aggregate(urls, period=args.watch,
+                               iterations=args.iterations,
+                               timeout=args.timeout,
+                               as_json=args.json)
     agg = aggregate(urls, timeout=args.timeout)
     if args.json:
         print(json.dumps(agg, indent=2, sort_keys=True))
     else:
         print(render(agg), end="")
     return 0 if any(ep["up"] for ep in agg["endpoints"]) else 2
+
+
+def watch_aggregate(urls: Sequence[str], period: float,
+                    iterations: int = 0, timeout: float = 5.0,
+                    as_json: bool = False, out=print) -> int:
+    """``veles-tpu metrics aggregate --watch SEC`` driver: a scrape +
+    merge + :func:`ingest_aggregate` loop over a client-side
+    :class:`~veles_tpu.telemetry.timeseries.SeriesStore`
+    (``count_samples=False``), one summary line per interval —
+    windowed rates and quantiles computed EXACTLY like a replica's
+    own watchtower computes them. Exit 0 while the last interval saw
+    at least one endpoint up; 2 otherwise."""
+    import time as _time
+    from .timeseries import SeriesStore
+    store = SeriesStore(period=period,
+                        retention=max(600.0, period * 600),
+                        count_samples=False)
+    n = 0
+    last_up = 0
+    try:
+        while True:
+            agg = aggregate(urls, timeout=timeout)
+            ingest_aggregate(store, agg)
+            last_up = sum(1 for ep in agg["endpoints"] if ep["up"])
+            rep = interval_report(store, window=period * 1.5)
+            if as_json:
+                out(json.dumps(rep, sort_keys=True))
+            else:
+                out(format_interval(rep))
+            n += 1
+            if iterations and n >= iterations:
+                break
+            _time.sleep(period)
+    except KeyboardInterrupt:
+        pass
+    return 0 if last_up else 2

@@ -1,0 +1,449 @@
+"""Watchtower time-series: a fixed-ring sampler over the registries.
+
+Everything the telemetry plane exposed before this module is a
+*snapshot*: ``/metrics`` renders the counter/histogram registries at
+scrape time, loadgen folds its verdict at end-of-run, the flight
+recorder speaks at crash time. The watchtower adds the time dimension
+— a :class:`SeriesStore` samples the counter registry, the histogram
+registry and a set of registered gauge providers on a fixed period
+into a bounded ring, and derives the *operational* signals from
+sample-to-sample deltas:
+
+- :meth:`SeriesStore.rate` / :meth:`SeriesStore.delta` — counter
+  growth over a trailing window (qps, tokens/sec, shed/sec);
+- :meth:`SeriesStore.quantile` — **windowed** histogram quantiles
+  from bucket deltas between two samples. The ``_p50/_p90/_p99``
+  gauges on ``/metrics`` are cumulative-since-start (they go stale on
+  long runs: an hour of good traffic buries a five-minute brownout);
+  the windowed estimate sees only the window.
+
+The ring is cursor-pullable over ``GET /metrics/history?since=N`` on
+every request-plane HTTP surface (router, GenerationAPI, RESTfulAPI,
+web status) — the ``/trace/spans?since=`` pattern: a JSONL body
+(header line + one record per line) so a torn read salvages per line.
+Alert transitions (telemetry/alerts.py) ride the same ring as
+``watch.alert`` records, so history pulls see firing/resolved edges
+in order with the samples that caused them.
+
+Default **OFF** and bit-identical off (the tensormon discipline,
+locked by tests/test_watchtower.py): with
+``root.common.telemetry.watch.enabled`` false no sampler thread
+starts, no ``veles_watch_*``/``veles_alert_*`` counter ever moves and
+the serving plane runs the exact pre-watchtower path. Knobs::
+
+    root.common.telemetry.watch.enabled     # False
+    root.common.telemetry.watch.period      # 1.0 s between samples
+    root.common.telemetry.watch.retention   # 300.0 s of ring history
+
+The store is also the client-side engine behind ``veles-tpu watch``
+and ``veles-tpu metrics aggregate --watch N``: :meth:`ingest` accepts
+parsed ``/metrics`` scrapes from *another* process, so the CLI
+computes the same windowed rates/quantiles from remote registries
+that a replica computes locally (``count_samples=False`` keeps a
+client-side store from moving this process's watch counters).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+from typing import (Any, Callable, Deque, Dict, List, Optional,
+                    Tuple)
+
+from .counters import counters, histogram_quantile, histograms
+
+#: every gauge provider registered for the process-global sampler:
+#: name -> callable returning {gauge_name: value | (value, help)}.
+#: Registration is always safe (a dict put) — providers only run
+#: while the watch sampler is on, so the feature-off path never
+#: calls them.
+_gauge_providers: Dict[str, Callable[[], Dict[str, Any]]] = {}
+
+
+def add_gauge_provider(name: str,
+                       fn: Callable[[], Dict[str, Any]]) -> None:
+    _gauge_providers[name] = fn
+
+
+def remove_gauge_provider(name: str) -> None:
+    _gauge_providers.pop(name, None)
+
+
+def watch_config() -> Dict[str, Any]:
+    """The watch knob block (missing config → shipped defaults)."""
+    try:
+        from ..config import root
+        node = root.common.telemetry.watch
+        return {
+            "enabled": bool(node.get("enabled", False)),
+            "period": float(node.get("period", 1.0) or 1.0),
+            "retention": float(node.get("retention", 300.0) or 300.0),
+        }
+    except Exception:        # noqa: BLE001 — config not importable
+        return {"enabled": False, "period": 1.0, "retention": 300.0}
+
+
+def enabled() -> bool:
+    return watch_config()["enabled"]
+
+
+class SeriesStore:
+    """Fixed-ring metric time-series with windowed derivations.
+
+    Capacity is ``retention / period`` samples (+1 so a full
+    retention window always has both endpoints buffered). Every
+    record carries a process-monotonic ``seq`` — the
+    ``/metrics/history`` pull cursor, exactly the span-ring
+    contract: a cursor older than the ring's tail silently skips
+    evicted records. ``clock`` is injectable so tests drive ring
+    wrap/window math deterministically."""
+
+    def __init__(self, period: float = 1.0, retention: float = 300.0,
+                 clock: Callable[[], float] = time.time,
+                 count_samples: bool = True) -> None:
+        self.period = max(1e-3, float(period))
+        self.retention = max(self.period, float(retention))
+        self.clock = clock
+        self._count_samples = count_samples
+        capacity = max(2, int(round(self.retention / self.period)) + 1)
+        self._lock = threading.Lock()
+        self._ring: Deque[Dict[str, Any]] = collections.deque(
+            maxlen=capacity)
+        self._seq = 0
+
+    # -- append paths --------------------------------------------------------
+    def sample(self) -> Dict[str, Any]:
+        """One sample of THIS process's registries + gauge providers
+        (the sampler-thread tick). Counted
+        ``veles_watch_samples_total``."""
+        gauges: Dict[str, float] = {}
+        for provider in list(_gauge_providers.values()):
+            try:
+                for name, val in (provider() or {}).items():
+                    if isinstance(val, tuple):
+                        val = val[0]
+                    try:
+                        gauges[name] = float(val)
+                    except (TypeError, ValueError):
+                        continue
+            except Exception:    # noqa: BLE001 — observers only
+                continue
+        return self.ingest(counters.snapshot(), histograms.snapshot(),
+                           gauges)
+
+    def ingest(self, counter_values: Dict[str, float],
+               hist_snap: Dict[str, Dict[str, Any]],
+               gauges: Dict[str, float],
+               ts: Optional[float] = None) -> Dict[str, Any]:
+        """Append one sample — local registries or a parsed remote
+        ``/metrics`` scrape (the ``veles-tpu watch`` client path)."""
+        rec = {
+            "kind": "watch.sample",
+            "ts": float(self.clock() if ts is None else ts),
+            "counters": dict(counter_values),
+            "hist": {name: {"bounds": list(h["bounds"]),
+                            "counts": list(h["counts"]),
+                            "sum": h["sum"], "count": h["count"]}
+                     for name, h in hist_snap.items()},
+            "gauges": dict(gauges),
+        }
+        self._append(rec)
+        if self._count_samples:
+            counters.inc("veles_watch_samples_total")
+        return rec
+
+    def note_event(self, kind: str, **data: Any) -> Dict[str, Any]:
+        """Append a non-sample record (alert transitions) into the
+        same ring, so cursor pulls see edges in order with the
+        samples that caused them."""
+        rec = dict(data, kind=kind, ts=float(self.clock()))
+        self._append(rec)
+        return rec
+
+    def _append(self, rec: Dict[str, Any]) -> None:
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._ring.append(rec)
+
+    # -- reads ---------------------------------------------------------------
+    def records(self, kind: Optional[str] = None
+                ) -> List[Dict[str, Any]]:
+        with self._lock:
+            recs = list(self._ring)
+        if kind is not None:
+            recs = [r for r in recs if r.get("kind") == kind]
+        return recs
+
+    def cursor(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def records_since(self, cursor: int
+                      ) -> Tuple[List[Dict[str, Any]], int]:
+        """(records appended after ``cursor``, the new cursor) —
+        the span-ring pull contract."""
+        cursor = int(cursor)
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for rec in reversed(self._ring):
+                if int(rec.get("seq", 0)) <= cursor:
+                    break
+                out.append(rec)
+            nxt = self._seq
+        out.reverse()
+        return out, nxt
+
+    def samples(self) -> List[Dict[str, Any]]:
+        return self.records("watch.sample")
+
+    def _window_pair(self, window: Optional[float]
+                     ) -> Optional[Tuple[Dict[str, Any],
+                                         Dict[str, Any]]]:
+        """(older, newest) samples spanning ~``window`` seconds:
+        the newest sample, and the newest sample at least ``window``
+        older (the whole ring when the window outruns retention).
+        None until two samples exist."""
+        recs = self.samples()
+        if len(recs) < 2:
+            return None
+        newest = recs[-1]
+        if window is None:
+            return recs[-2], newest
+        target = newest["ts"] - float(window)
+        older = recs[0]
+        for rec in recs[:-1]:
+            if rec["ts"] <= target:
+                older = rec
+            else:
+                break
+        return older, newest
+
+    def delta(self, name: str, window: Optional[float] = None
+              ) -> Optional[float]:
+        """Counter growth over the trailing window (None until two
+        samples exist). Negative deltas (a restarted remote process)
+        clamp to the newest absolute value — a restart is growth from
+        zero, not negative traffic."""
+        pair = self._window_pair(window)
+        if pair is None:
+            return None
+        older, newest = pair
+        d = newest["counters"].get(name, 0.0) \
+            - older["counters"].get(name, 0.0)
+        if d < 0:
+            d = newest["counters"].get(name, 0.0)
+        return d
+
+    def rate(self, name: str, window: Optional[float] = None
+             ) -> Optional[float]:
+        """Per-second counter rate over the trailing window."""
+        pair = self._window_pair(window)
+        if pair is None:
+            return None
+        older, newest = pair
+        dt = newest["ts"] - older["ts"]
+        if dt <= 0:
+            return None
+        d = self.delta(name, window)
+        return None if d is None else d / dt
+
+    def gauge(self, name: str) -> Optional[float]:
+        recs = self.samples()
+        if not recs:
+            return None
+        return recs[-1]["gauges"].get(name)
+
+    def hist_delta(self, name: str, window: Optional[float] = None
+                   ) -> Optional[Dict[str, Any]]:
+        """{bounds, counts, count} of the bucket DELTAS between the
+        window's endpoint samples — the windowed-quantile numerator.
+        A histogram absent from the older sample (it appeared
+        mid-window) deltas against zeros; a bounds mismatch (remote
+        restart with different registration) falls back to the
+        newest absolute counts."""
+        pair = self._window_pair(window)
+        if pair is None:
+            return None
+        older, newest = pair
+        new_h = newest["hist"].get(name)
+        if new_h is None:
+            return None
+        old_h = older["hist"].get(name)
+        bounds = list(new_h["bounds"])
+        counts = list(new_h["counts"])
+        if old_h is not None \
+                and list(old_h["bounds"]) == bounds \
+                and len(old_h["counts"]) == len(counts):
+            counts = [max(0, int(c) - int(o))
+                      for c, o in zip(counts, old_h["counts"])]
+        return {"bounds": bounds, "counts": counts,
+                "count": sum(counts)}
+
+    def quantile(self, name: str, q: float,
+                 window: Optional[float] = None) -> Optional[float]:
+        """WINDOWED histogram quantile: bucket deltas between the
+        window's endpoint samples fed to the shared
+        :func:`histogram_quantile` interpolation — the operational
+        twin of the cumulative-since-start ``_p99`` gauges. None
+        when the window saw no samples."""
+        h = self.hist_delta(name, window)
+        if h is None or not h["count"]:
+            return None
+        return histogram_quantile(tuple(h["bounds"]),
+                                  tuple(h["counts"]), q)
+
+    def error_fraction(self, name: str, slo_seconds: float,
+                       window: Optional[float] = None
+                       ) -> Optional[float]:
+        """Fraction of the window's observations ABOVE the SLO
+        target — the burn-rate numerator (telemetry/alerts.py).
+        Bucket-resolution: observations are 'good' when their whole
+        bucket's upper bound is <= the target, so a target between
+        bounds errs toward alerting. None when the window saw no
+        samples."""
+        h = self.hist_delta(name, window)
+        if h is None or not h["count"]:
+            return None
+        good = sum(cnt for bound, cnt in zip(h["bounds"], h["counts"])
+                   if float(bound) <= float(slo_seconds))
+        return max(0.0, (h["count"] - good) / float(h["count"]))
+
+
+# -- the process-global sampler ----------------------------------------------
+
+_lock = threading.Lock()
+_store: Optional[SeriesStore] = None
+_engine = None                       # telemetry.alerts.AlertEngine
+_thread: Optional[threading.Thread] = None
+_stop = threading.Event()
+
+
+def store() -> Optional[SeriesStore]:
+    """The live process-global store, or None while the watchtower
+    is off."""
+    return _store
+
+
+def alert_engine():
+    return _engine
+
+
+def maybe_start() -> Optional[SeriesStore]:
+    """Start the process-global sampler thread once, iff
+    ``root.common.telemetry.watch.enabled`` — called by every HTTP
+    surface at its own start, so enabling the knob before ANY
+    service brings the watchtower up with it. Feature-off this is a
+    config read and nothing else (the bit-identical-off contract)."""
+    global _store, _engine, _thread
+    cfg = watch_config()
+    if not cfg["enabled"]:
+        return None
+    with _lock:
+        if _store is None:
+            _store = SeriesStore(period=cfg["period"],
+                                 retention=cfg["retention"])
+            from . import alerts
+            _engine = alerts.AlertEngine(_store,
+                                         alerts.rules_from_config())
+        if _thread is None or not _thread.is_alive():
+            _stop.clear()
+            _thread = threading.Thread(target=_sampler_loop,
+                                       daemon=True,
+                                       name="veles.watch")
+            _thread.start()
+    return _store
+
+
+def stop_watch() -> None:
+    """Stop the sampler and drop the store — tests and process
+    teardown only."""
+    global _store, _engine, _thread
+    _stop.set()
+    thread = _thread
+    if thread is not None:
+        thread.join(timeout=5)
+    with _lock:
+        _store = None
+        _engine = None
+        _thread = None
+
+
+def _sampler_loop() -> None:
+    while not _stop.is_set():
+        store_, engine = _store, _engine
+        if store_ is None:
+            return
+        try:
+            store_.sample()
+            if engine is not None:
+                engine.evaluate()
+        except Exception:        # noqa: BLE001 — observability only
+            pass
+        # period re-read each tick: the knob stays live, and a
+        # stop() mid-sleep returns promptly
+        if _stop.wait(watch_config()["period"]):
+            return
+
+
+def pull_payload(since: int = 0, name: str = "") -> str:
+    """The ``GET /metrics/history?since=CURSOR`` response body: one
+    JSONL header line (enabled flag, new cursor, period, the current
+    alert states) + one line per ring record appended after
+    ``since``. Disabled → the header alone, with ``enabled: false``
+    and NO counter movement (the off path stays frozen). Counted
+    ``veles_watch_pulls_total`` when live."""
+    import os
+    store_, engine = _store, _engine
+    header: Dict[str, Any] = {"kind": "watch.header",
+                              "pid": os.getpid(),
+                              "name": str(name or ""),
+                              "enabled": store_ is not None}
+    if store_ is None:
+        header.update(cursor=0, records=0)
+        return json.dumps(header) + "\n"
+    recs, cursor = store_.records_since(since)
+    header.update(cursor=cursor, records=len(recs),
+                  wall=time.time(), period=store_.period,
+                  retention=store_.retention,
+                  alerts=engine.status() if engine is not None else [])
+    counters.inc("veles_watch_pulls_total")
+    return "\n".join(json.dumps(r, default=str)
+                     for r in [header] + recs) + "\n"
+
+
+def alerts_payload() -> Dict[str, Any]:
+    """The ``GET /alerts`` JSON body: rule states when the
+    watchtower is live, ``enabled: false`` otherwise (no counter
+    movement either way — listing rules is a read)."""
+    engine = _engine
+    if engine is None:
+        return {"enabled": False, "rules": []}
+    return {"enabled": True, "rules": engine.status(),
+            "firing": engine.firing()}
+
+
+def parse_history(text: str) -> Tuple[Optional[Dict[str, Any]],
+                                      List[Dict[str, Any]]]:
+    """Parse a ``/metrics/history`` JSONL body → (header, records).
+    Torn lines (a response truncated mid-record) are skipped — the
+    salvage-per-line contract the JSONL framing exists for."""
+    header = None
+    records: List[Dict[str, Any]] = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict):
+            continue
+        if rec.get("kind") == "watch.header":
+            header = rec
+        else:
+            records.append(rec)
+    return header, records

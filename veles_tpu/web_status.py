@@ -196,6 +196,13 @@ class WebStatusServer(Logger):
                     # when stale/unready
                     from .resilience.health import handle_health
                     handle_health(self, parts.path)
+                elif parts.path == "/metrics/history":
+                    from ._http import handle_metrics_history
+                    handle_metrics_history(self, self.path,
+                                           name="web_status")
+                elif parts.path == "/alerts":
+                    from ._http import handle_alerts
+                    handle_alerts(self, self.path)
                 elif parts.path == "/metrics":
                     # Prometheus scrape surface: the process-global
                     # telemetry counters (deterministic accounting —
@@ -321,7 +328,10 @@ class WebStatusServer(Logger):
                     # at all while the plane was never enabled
                     from .resilience import elastic as _elastic
                     gauges.update(_elastic.gauges())
-                    text = metrics_text(gauges)
+                    # watchtower firing-state rows (labeled gauges —
+                    # rendered by alerts.render_firing, "" when off)
+                    from .telemetry.alerts import render_firing
+                    text = metrics_text(gauges) + render_firing()
                     bytes_reply(self, 200, text.encode(),
                                 METRICS_CONTENT_TYPE)
                 else:
