@@ -726,6 +726,14 @@ def _assemble(mnist, ae, lm, platform, device_kind, allow_rebaseline):
         # transitions-visible-everywhere measurements are
         # gate_watch's live drill
         "watch": _watch_section(),
+        # tensor-parallel serving (serving/engine.py tp= knob): the
+        # bench trains and serves solo (tp=1), so the shard_map
+        # engine/dispatch counters MUST read zero here — the gate
+        # fails on leakage; the sharded-vs-solo id-exactness and
+        # per-chip throughput measurements are gate_tp's live proof
+        # on a 2-chip CPU virtual mesh (subprocess: the mesh needs
+        # TPU_VISIBLE_CHIPS set before jax initializes)
+        "tp_serving": _tp_section(),
         "extras": [ae, lm],
     }
 
@@ -924,6 +932,29 @@ def _watch_section():
     out.update({short(name): int(counters.get(name))
                 for name in WATCH_COUNTERS})
     return out
+
+
+def _tp_section():
+    """{tp, engines, dispatches, autotune_stale} for this bench
+    process — absolute counter reads (one process, counters start at
+    zero). The bench never starts a tensor-parallel engine (the
+    ``root.common.serving.tp`` knob defaults 1, and tp=1 runs the
+    exact pre-mesh jit path), so ``engines``/``dispatches`` MUST be
+    zero — ``bench.py gate`` fails on leakage. ``autotune_stale`` is
+    stamped for visibility only: a real-TPU bench may legitimately
+    look up pre-stamp kernel_tuning entries. The live proof (sharded
+    decode id-exact vs solo on a 2-device CPU virtual mesh, per-chip
+    tokens/sec above the stated fraction of solo) runs inside
+    ``gate_tp``'s subprocess."""
+    from veles_tpu.config import root as vt_root
+    from veles_tpu.telemetry.counters import counters
+    return {
+        "tp": int(vt_root.common.serving.get("tp", 1) or 1),
+        "engines": int(counters.get("veles_tp_engines_total")),
+        "dispatches": int(counters.get("veles_tp_dispatches_total")),
+        "autotune_stale": int(
+            counters.get("veles_autotune_stale_total")),
+    }
 
 
 def _linalg_section():
@@ -3479,6 +3510,211 @@ def _linalg_proof():
     return failures, metrics
 
 
+#: per-chip tokens/sec bar for the tensor-parallel proof: each chip
+#: of the tp=2 CPU virtual mesh must deliver at least this fraction
+#: of the solo engine's tokens/sec. Deliberately lenient — the CPU
+#: mesh pays shard_map's collective overhead on a toy model with no
+#: memory-bandwidth win to show; the bar locks "the sharded plane is
+#: not pathologically slow", real speedups are a chip measurement
+TP_PER_CHIP_FRACTION = 0.10
+
+#: wall budget for the tp proof child (compiles 2x the serving
+#: programs: solo + shard_mapped, all on CPU)
+TP_CHILD_BUDGET = 600.0
+
+
+def gate_tp(baseline_doc=None, current_doc=None):
+    """``tp`` gate section: (1) every tensor-parallel counter (and
+    the autotune staleness counter riding this PR) must be registered
+    with a HELP string; (2) bench documents must carry ZERO shard_map
+    engine/dispatch activity at tp=1 — the mesh plane leaking into a
+    solo measurement would break the tp=1-is-the-pre-mesh-path
+    contract; (3) live proof (:func:`_tp_proof`, subprocess): on a
+    2-device CPU virtual mesh the tp=2 engine answers token-identical
+    to the solo engine, counts its dispatches, reports LOGICAL page
+    gauges equal to solo's, and clears the per-chip throughput bar."""
+    from veles_tpu.serving import TP_COUNTERS
+    from veles_tpu.telemetry.counters import DESCRIPTIONS
+    failures = []
+    for name in TP_COUNTERS + ("veles_autotune_stale_total",):
+        if name not in DESCRIPTIONS:
+            failures.append(
+                "tp: counter %s not registered in telemetry "
+                "DESCRIPTIONS" % name)
+    for tag, doc in (("baseline", baseline_doc),
+                     ("current", current_doc)):
+        sec = (doc or {}).get("tp_serving")
+        if not sec:
+            continue          # legacy document predating the section
+        if int(sec.get("tp", 1) or 1) > 1:
+            continue          # a tp-mode bench dispatches on purpose
+        for key in ("engines", "dispatches"):
+            if sec.get(key):
+                failures.append(
+                    "tp: %s doc has %s=%s at tp=1 — shard_map "
+                    "serving leaked into a solo bench run"
+                    % (tag, key, sec[key]))
+    proof_failures, metrics = _tp_proof()
+    if metrics:
+        print("tp proof: tp=%d sharded decode id-exact vs solo, "
+              "%d shard_map dispatches, logical kv pool %d bytes on "
+              "both, per-chip %.2f tok/s = %.2fx solo (bar %.2fx)"
+              % (metrics["tp"], metrics["dispatches"],
+                 metrics["kv_tp"], metrics["tp_tok_s"] / metrics["tp"],
+                 metrics["per_chip_fraction"], TP_PER_CHIP_FRACTION))
+    return failures + proof_failures
+
+
+def _tp_proof():
+    """THE tensor-parallel drill. Runs in a SUBPROCESS because the
+    2-device CPU virtual mesh exists only when ``TPU_VISIBLE_CHIPS``
+    is set before jax initializes — this (gate) process already has a
+    backend up. The child (``bench.py --tp-child``) serves the same
+    request mix through a solo (tp=1) and a sharded (tp=2) engine
+    and prints one JSON line; asserted here:
+
+    - **id-exact** — the tp=2 tokens equal the solo tokens;
+    - **counted** — ``veles_tp_engines_total`` moved exactly once,
+      ``veles_tp_dispatches_total`` moved with the decode, and
+      NEITHER moved while the solo engine served (zero leakage);
+    - **logical gauges** — ``kv_pool_bytes`` identical at tp=1 and
+      tp=2 (pages are logical; only bytes-per-chip divides), with
+      ``kv_pool_bytes_per_shard`` = the pool over tp;
+    - **per-chip throughput** — tp tokens/sec over the chip count
+      stays >= ``TP_PER_CHIP_FRACTION`` x the solo tokens/sec.
+
+    Returns (failures, metrics) so the caller can gate and stamp."""
+    import subprocess
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TPU_VISIBLE_CHIPS="0,1")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--tp-child"],
+            capture_output=True, text=True, env=env,
+            timeout=TP_CHILD_BUDGET)
+    except subprocess.TimeoutExpired:
+        return ["tp: proof child exceeded %.0fs budget"
+                % TP_CHILD_BUDGET], {}
+    if r.returncode != 0 or not r.stdout.strip():
+        tail = (r.stderr or "").strip().splitlines()
+        return ["tp: proof child rc=%d%s"
+                % (r.returncode,
+                   (": " + tail[-1][-160:]) if tail else "")], {}
+    try:
+        m = json.loads(r.stdout.strip().splitlines()[-1])
+    except ValueError:
+        return ["tp: proof child printed no parseable JSON"], {}
+    failures = []
+    if not m.get("equal"):
+        failures.append("tp: tp=%s sharded decode diverged from the "
+                        "solo engine" % m.get("tp"))
+    if m.get("leak"):
+        failures.append("tp: %s tp counter increment(s) while the "
+                        "SOLO engine served — tp=1 must run the "
+                        "pre-mesh path untouched" % m["leak"])
+    if int(m.get("engines", 0)) != 1:
+        failures.append("tp: veles_tp_engines_total=%s after one "
+                        "tp engine start (want 1)" % m.get("engines"))
+    if not m.get("dispatches"):
+        failures.append("tp: veles_tp_dispatches_total never moved "
+                        "during a sharded serve")
+    if m.get("kv_solo") != m.get("kv_tp"):
+        failures.append("tp: logical kv_pool_bytes differ — solo %s "
+                        "vs tp %s (page gauges must be shard-"
+                        "agnostic)" % (m.get("kv_solo"),
+                                       m.get("kv_tp")))
+    if m.get("kv_shard") != m.get("kv_tp", 0) // max(
+            1, int(m.get("tp", 1))):
+        failures.append("tp: kv_pool_bytes_per_shard %s != pool %s "
+                        "over tp=%s" % (m.get("kv_shard"),
+                                        m.get("kv_tp"), m.get("tp")))
+    frac = 0.0
+    if m.get("solo_tok_s"):
+        frac = (m.get("tp_tok_s", 0.0) / max(1, int(m.get("tp", 1)))
+                / m["solo_tok_s"])
+    if frac < TP_PER_CHIP_FRACTION:
+        failures.append(
+            "tp: per-chip throughput %.3fx of solo under the %.2fx "
+            "bar (solo %.2f tok/s, tp %.2f over %s chips)"
+            % (frac, TP_PER_CHIP_FRACTION, m.get("solo_tok_s", 0.0),
+               m.get("tp_tok_s", 0.0), m.get("tp")))
+    metrics = dict(m, per_chip_fraction=round(frac, 3))
+    return failures, metrics
+
+
+def _tp_child_main():
+    """``bench.py --tp-child``: the in-mesh half of :func:`_tp_proof`.
+    Runs only under the parent's env (TPU_VISIBLE_CHIPS=0,1 +
+    JAX_PLATFORMS=cpu, set before this interpreter imported jax), so
+    two virtual CPU devices exist; serves one request mix through a
+    solo and a tp=2 engine and prints ONE JSON line."""
+    import numpy
+    import char_lm
+    import veles_tpu as vt
+    from veles_tpu import prng
+    from veles_tpu.serving.engine import ContinuousEngine, make_request
+    from veles_tpu.telemetry.counters import counters
+
+    tp = len([c for c in os.environ.get(
+        "TPU_VISIBLE_CHIPS", "0").split(",") if c.strip()])
+    prng.seed_all(971)
+    wf = char_lm.build_workflow(epochs=1, minibatch_size=32,
+                                n_blocks=1, dim=32, n_train=64,
+                                n_valid=32)
+    wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+    wf.run()
+
+    def requests():
+        return [make_request(
+            [int(t) for t in char_lm.make_corpus(
+                numpy.random.RandomState(100 + i), 10 + i)], 24)
+            for i in range(3)]
+
+    def run(tp_n, name):
+        eng = ContinuousEngine(wf, max_slots=4, buckets=(8, 16, 32),
+                               max_context=64, page_size=8, tp=tp_n,
+                               name=name).start()
+        try:
+            eng.serve([make_request(requests()[0]["prompt"], 2)])
+            t0 = time.time()
+            toks = eng.serve(requests())
+            dt = max(time.time() - t0, 1e-9)
+            st = eng.stats()
+        finally:
+            eng.stop()
+        return toks, sum(len(t) for t in toks) / dt, st
+
+    solo_toks, solo_tps, solo_st = run(1, "tp_proof_solo")
+    leak = int(counters.get("veles_tp_dispatches_total")) \
+        + int(counters.get("veles_tp_engines_total"))
+    tp_toks, tp_tps, tp_st = run(tp, "tp_proof_mesh")
+    print(json.dumps({
+        "tp": tp,
+        "equal": tp_toks == solo_toks,
+        "leak": leak,
+        "engines": int(counters.get("veles_tp_engines_total")),
+        "dispatches": int(
+            counters.get("veles_tp_dispatches_total")),
+        "solo_tok_s": round(solo_tps, 3),
+        "tp_tok_s": round(tp_tps, 3),
+        "kv_solo": int(solo_st["kv_pool_bytes"]),
+        "kv_tp": int(tp_st["kv_pool_bytes"]),
+        "kv_shard": int(tp_st["kv_pool_bytes_per_shard"]),
+    }))
+    return 0
+
+
+def _tp_main():
+    """``python bench.py tp`` — run the tensor-parallel drill
+    standalone and print its metrics as one JSON line (the numbers
+    docs/perf.md's tp row cites)."""
+    failures, metrics = _tp_proof()
+    for failure in failures:
+        print("TP FAIL %s" % failure, file=sys.stderr)
+    print(json.dumps(dict(metrics, failures=len(failures))))
+    return 1 if failures else 0
+
+
 def gate_overload(baseline_doc=None, current_doc=None):
     """``overload`` gate section: (1) every QoS + loadgen counter
     must be registered with a HELP string; (2) bench documents must
@@ -4236,6 +4472,11 @@ def _gate_main(argv):
                 # like the other live proofs it runs after every
                 # doc-leakage assertion above
                 + gate_linalg(baseline, current)
+                # the tp drill runs in its OWN subprocess (the CPU
+                # virtual mesh needs TPU_VISIBLE_CHIPS before jax
+                # init), so it moves no counter in this process —
+                # only its doc-leakage assertions run here
+                + gate_tp(baseline, current)
                 # the overload drill preempts, throttles and
                 # load-generates for real — its own zero-before-proof
                 # check must see a process no earlier QoS work
@@ -4273,7 +4514,9 @@ def _gate_main(argv):
           "id-exact + flat state bytes + equal-HBM slot multiplier, "
           "linalg clean + blocked matmul/Cholesky within dense "
           "tolerance + CG converged and re-verified + f32-peak MFU "
-          "stamped, "
+          "stamped, tp clean + sharded decode id-exact on a 2-chip "
+          "virtual mesh + logical page gauges shard-agnostic + "
+          "per-chip throughput above bar, "
           "overload clean + preempted batch id-exact + interactive "
           "lossless under a 2x burst + exactly-once terminals, "
           "watch frozen-off clean + storm-fired burn-rate alert "
@@ -4395,4 +4638,8 @@ if __name__ == "__main__":
         sys.exit(_quant_main())
     if len(sys.argv) > 1 and sys.argv[1] == "linalg":
         sys.exit(_linalg_main())
+    if len(sys.argv) > 1 and sys.argv[1] == "tp":
+        sys.exit(_tp_main())
+    if len(sys.argv) > 1 and sys.argv[1] == "--tp-child":
+        sys.exit(_tp_child_main())
     main()

@@ -321,3 +321,58 @@ def test_attn_seed_split_sections_merge_crossover(tuned_env):
     assert autotune.flash_min_t(64) == 8192
     entry = autotune.lookup(autotune.min_t_key(64))
     assert entry["swept"] == {"2048": False, "8192": True}
+
+
+# -- provenance stamps (PR 20): record() stamps, lookup() flags stale --
+
+
+def test_record_stamps_jax_and_device_kind(tuned_env):
+    """Every persisted entry carries the toolchain + chip that measured
+    it — the provenance a later build checks before trusting the
+    ranking."""
+    import jax
+    from veles_tpu.telemetry.counters import counters
+    c0 = counters.get("veles_autotune_stale_total")
+    autotune.record("flash_t2048_d64_causal",
+                    {"block_q": 256, "block_k": 128})
+    entry = autotune.lookup("flash_t2048_d64_causal")
+    assert entry["jax"] == str(jax.__version__)
+    assert entry["device_kind"] == "faketpu-v0"
+    # a fresh same-toolchain stamp is NOT stale
+    assert counters.get("veles_autotune_stale_total") == c0
+
+
+def test_stale_entry_counts_every_lookup_warns_once(tuned_env, caplog):
+    """An entry measured under another jax (or the pre-stamp DB format)
+    is still USED, but veles_autotune_stale_total moves on EVERY lookup
+    and the log warns ONCE per (kind, key) — the operator signal that a
+    re-sweep is due, without a log storm per trace."""
+    import logging
+    from veles_tpu.telemetry.counters import counters
+    db_path = os.path.join(str(tuned_env), "kernel_tuning.json")
+    with open(db_path, "w") as fout:
+        json.dump({"faketpu-v0": {
+            "flash_t2048_d64_causal":            # pre-stamp format
+                {"block_q": 512, "block_k": 128},
+            "flash_t8192_d64_causal":            # other-toolchain stamp
+                {"block_q": 256, "block_k": 256, "jax": "0.0.1"},
+        }}, fout)
+    c0 = counters.get("veles_autotune_stale_total")
+    with caplog.at_level(logging.WARNING,
+                         logger="veles_tpu.ops.autotune"):
+        assert autotune.lookup("flash_t2048_d64_causal")["block_q"] \
+            == 512                               # hit is still served
+        autotune.lookup("flash_t2048_d64_causal")
+        autotune.lookup("flash_t8192_d64_causal")
+    assert counters.get("veles_autotune_stale_total") == c0 + 3
+    stale = [r for r in caplog.records if "stale" in r.getMessage()]
+    assert len(stale) == 2                       # once per key
+    assert "unstamped" in stale[0].getMessage()
+    assert "0.0.1" in stale[1].getMessage()
+    # clear_memo() resets the warn-once set (fresh-process semantics)
+    autotune.clear_memo()
+    with caplog.at_level(logging.WARNING,
+                         logger="veles_tpu.ops.autotune"):
+        autotune.lookup("flash_t2048_d64_causal")
+    assert len([r for r in caplog.records
+                if "stale" in r.getMessage()]) == 3

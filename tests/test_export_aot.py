@@ -66,9 +66,9 @@ def test_artifact_is_a_v3_package_with_serving_block(served_artifact):
         contents = json.load(fin)
     assert contents["format_version"] == 3
     serving = contents["serving"]
-    # v4: the O(1)-state lane's rscan/rstep labels joined the format;
-    # paged artifacts are unchanged, so this one still reads back
-    assert serving["artifact_version"] == 4
+    # v5: the tensor-parallel mesh geometry ("tp"/"mesh") joined the
+    # signature; unsharded artifacts are unchanged otherwise
+    assert serving["artifact_version"] == 5
     assert sorted(serving["programs"]) == ["decode", "prefill_16",
                                            "prefill_8"]
     for fname in serving["programs"].values():
@@ -174,6 +174,38 @@ def test_missing_and_mismatched_artifacts_fall_back(served_artifact,
         engine.stop()
     with pytest.raises(VelesError, match="different"):
         load_serve_programs(art, {"buckets": [8, 32]})
+
+
+def test_v4_artifact_refused_with_counted_live_fallback(
+        served_artifact, tmp_path):
+    """Format-migration contract (v4 -> v5): a v4 artifact — exported
+    before the mesh geometry ("tp"/"mesh") joined the signature — is
+    REFUSED, counted in veles_artifact_load_failures_total, and the
+    engine serves correct tokens via live jit instead of running
+    programs whose sharding commitments are unknown."""
+    import shutil
+    lm, wf, art = served_artifact
+    from veles_tpu.nn import sampling
+    old = str(tmp_path / "v4_art")
+    shutil.copytree(art, old)
+    cpath = os.path.join(old, "contents.json")
+    with open(cpath) as fin:
+        contents = json.load(fin)
+    contents["serving"]["artifact_version"] = 4
+    for key in ("tp", "mesh"):
+        contents["serving"]["signature"].pop(key, None)
+    with open(cpath, "w") as fout:
+        json.dump(contents, fout)
+    with pytest.raises(VelesError, match="different"):
+        load_serve_programs(old, ContinuousEngine(
+            wf, name="aot_v4_sig", **KNOBS).stack_signature())
+    engine = _fallback_engine(wf, old, "aot_v4")
+    try:
+        req = make_request(_prompt(lm, 93), 5)
+        assert engine.serve([req])[0] == sampling.generate(
+            wf, req["prompt"], req["n_new"], temperature=0)
+    finally:
+        engine.stop()
 
 
 def test_injected_artifact_load_fault_falls_back(served_artifact,

@@ -1,0 +1,378 @@
+"""Tensor-parallel sharded decode over the mesh (serving/engine.py
+``tp=`` + parallel/compat.py shard_map): N chips serve as ONE logical
+replica — attention heads and K/V pages shard over the head axis,
+FC/embedding weights go column/row-parallel with one psum per block,
+and every request-plane structure (page tables, shared mask, slot
+metadata, PrefixCache) stays replicated host data indexing LOGICAL
+pages.
+
+The contract proven here: sharding is a pure execution detail —
+every decode mode (greedy / sample / speculative / beam), chunked
+prefill, prefix adoption AND the copy-on-write path return tokens
+id-EXACT vs the unsharded engine, on 2- and 4-device CPU virtual
+meshes (the TPU_VISIBLE_CHIPS seam from veles_tpu/__init__.py; the
+mesh children run in subprocesses because the seam must be set before
+jax initializes). Admission math and page gauges count logical pages
+once per slice (kv_pool_bytes shard-agnostic, kv_pool_bytes_per_shard
+= pool / tp), the ``veles_tp_*`` counters move only for sharded
+engines, and the ``serve.replica_death`` journaled failover drill
+stays token-level lossless when the survivor is a mesh slice.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def _run_child(code, chips, timeout=480):
+    """Run ``code`` in a fresh interpreter with the TPU_VISIBLE_CHIPS
+    seam pinned BEFORE veles_tpu/jax import — the only way a pytest
+    process (whose jax already materialized 1 CPU device) can drive a
+    multi-device mesh. The child prints ONE json line on stdout."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", TPU_VISIBLE_CHIPS=chips,
+               VELES_REPO=REPO)
+    env.pop("XLA_FLAGS", None)          # the seam owns device count
+    env.pop("VELES_FAULTS", None)
+    proc = subprocess.run([PY, "-c", code], env=env, timeout=timeout,
+                          capture_output=True, text=True)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-4000:])
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+# -- the mesh child: every serving mode, solo vs sharded -----------------------
+
+MESH_CHILD = r"""
+import json, os, sys
+sys.path.insert(0, os.environ["VELES_REPO"])
+sys.path.insert(0, os.path.join(os.environ["VELES_REPO"], "tests"))
+
+import numpy
+import veles_tpu as vt
+from veles_tpu import prng
+from veles_tpu.serving import ContinuousEngine
+from veles_tpu.serving.engine import make_request
+from veles_tpu.telemetry.counters import counters
+from conftest import import_model
+
+lm = import_model("char_lm")
+prng.seed_all(971)
+wf = lm.build_workflow(epochs=1, minibatch_size=64, n_blocks=2,
+                       dim=32, n_train=256, n_valid=64)
+wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+wf.run()
+prng.seed_all(437)
+draft = lm.build_workflow(epochs=1, minibatch_size=64, n_blocks=1,
+                          dim=16, n_train=256, n_valid=64)
+draft.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+draft.run()
+
+import jax
+tp = jax.device_count()
+
+
+def prompt(seed, length=10):
+    return [int(t) for t in
+            lm.make_corpus(numpy.random.RandomState(seed), length)]
+
+
+# all four decode modes; every prompt prefills CHUNKED
+# (prefill_chunk=8), the last two adopt the cached `shared` prefix
+shared = prompt(9, 16)
+reqs = [make_request(prompt(1, 10), 8),
+        make_request(prompt(2, 7), 8, temperature=0.8, seed=5,
+                     mode="sample"),
+        make_request(prompt(3, 7), 9, mode="speculative", gamma=3),
+        make_request(prompt(4, 6), 8, mode="beam", beam=2),
+        make_request(shared + prompt(5, 4), 6),
+        make_request(shared + prompt(6, 3), 6)]
+# COW trigger: a FULL-prompt match on the cached (page-aligned)
+# 16-token `shared` prefix — at least one token must re-prefill, and
+# the engine must recompute that last position into a COPY of the
+# final shared page (copy-on-write), never into the shared page
+cow_req = make_request(list(shared), 6)
+
+
+def run(tp_n):
+    d0 = counters.get("veles_tp_dispatches_total")
+    e0 = counters.get("veles_tp_engines_total")
+    c0 = counters.get("veles_prefix_cow_copies_total")
+    eng = ContinuousEngine(wf, max_slots=5, buckets=(8, 16, 32),
+                           max_context=64, page_size=8, spec_gamma=3,
+                           beam_width=2, draft=draft,
+                           prefix_cache=True, prefill_chunk=8,
+                           tp=tp_n, name="eng_tp%d" % tp_n).start()
+    try:
+        out = eng.serve([dict(r) for r in reqs])
+        out += eng.serve([dict(cow_req)])
+        st = eng.stats()
+    finally:
+        eng.stop()
+    return out, st, {
+        "dispatches": counters.get("veles_tp_dispatches_total") - d0,
+        "engines": counters.get("veles_tp_engines_total") - e0,
+        "cow": counters.get("veles_prefix_cow_copies_total") - c0}
+
+
+out_solo, st_solo, mv_solo = run(1)
+out_tp, st_tp, mv_tp = run(tp)
+
+print(json.dumps({
+    "devices": tp,
+    "equal": out_solo == out_tp,
+    "n_out": len(out_tp),
+    "tp_stat": st_tp["tp"], "solo_tp_stat": st_solo["tp"],
+    "prefix_requests": st_tp["prefix_requests"],
+    "chunk_dispatches": st_tp["chunk_dispatches"],
+    "solo_moved": mv_solo, "tp_moved": mv_tp,
+    "kv_solo": st_solo["kv_pool_bytes"],
+    "kv_tp": st_tp["kv_pool_bytes"],
+    "kv_shard_solo": st_solo["kv_pool_bytes_per_shard"],
+    "kv_shard_tp": st_tp["kv_pool_bytes_per_shard"],
+}))
+"""
+
+
+def _assert_mesh_doc(doc, devices):
+    assert doc["devices"] == devices, doc
+    assert doc["equal"] is True, doc
+    assert doc["n_out"] == 7
+    assert doc["tp_stat"] == devices and doc["solo_tp_stat"] == 1
+    # every prompt prefilled chunked; the shared-prefix pair adopted;
+    # the shorter-prompt request took the copy-on-write path — in the
+    # SOLO run and the SHARDED run alike (same logical request plane)
+    assert doc["chunk_dispatches"] > 0
+    assert doc["prefix_requests"] >= 2
+    assert doc["solo_moved"]["cow"] >= 1
+    assert doc["tp_moved"]["cow"] >= 1
+    # tp counters: one engine, live sharded dispatches — and ZERO
+    # leakage into the unsharded run
+    assert doc["solo_moved"]["engines"] == 0
+    assert doc["solo_moved"]["dispatches"] == 0
+    assert doc["tp_moved"]["engines"] == 1
+    assert doc["tp_moved"]["dispatches"] > 0
+    # page gauges are LOGICAL (shard-agnostic admission math): the
+    # sharded pool reports the same logical bytes, and the per-shard
+    # gauge is exactly the slice's cut of it
+    assert doc["kv_solo"] == doc["kv_tp"]
+    assert doc["kv_shard_solo"] == doc["kv_solo"]
+    assert doc["kv_shard_tp"] == doc["kv_tp"] // devices
+
+
+def test_tp2_mesh_id_exact_all_modes():
+    """THE acceptance drill (2-chip virtual mesh): greedy, sampled,
+    speculative, beam, chunked prefill, prefix adoption and prefix-COW
+    all return tokens id-exact vs the unsharded engine; page gauges
+    stay logical; veles_tp_* counters move only for the slice."""
+    _assert_mesh_doc(_run_child(MESH_CHILD, "0,1"), 2)
+
+
+@pytest.mark.slow
+def test_tp4_mesh_id_exact_all_modes():
+    """Same drill at tp=4 — the mesh width the satellite names; slow
+    lane (a second ~30 s training + double-serve child)."""
+    _assert_mesh_doc(_run_child(MESH_CHILD, "0,1,2,3"), 4)
+
+
+# -- sharded failover: the survivor is a mesh slice ----------------------------
+
+FAILOVER_CHILD = r"""
+import json, os, sys, urllib.error, urllib.request
+sys.path.insert(0, os.environ["VELES_REPO"])
+sys.path.insert(0, os.path.join(os.environ["VELES_REPO"], "tests"))
+
+import veles_tpu as vt
+from veles_tpu import prng
+from veles_tpu.config import root
+from veles_tpu.nn import sampling
+from veles_tpu.serving.router import FleetRouter
+from veles_tpu.telemetry.counters import counters
+from conftest import import_model
+
+
+def post(url, payload, timeout=120.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read() or b"{}")
+
+
+lm = import_model("char_lm")
+prng.seed_all(2025)
+wf = lm.build_workflow(epochs=1, minibatch_size=32, n_blocks=1,
+                       dim=32, n_train=64, n_valid=32)
+wf.initialize(device=vt.XLADevice(mesh_axes={"data": 1}))
+
+prompt = [2, 4, 1, 3, 5]
+n_new = 12
+solo = sampling.generate(wf, prompt, n_new, temperature=0.8, seed=17)
+
+# both replicas are tp=2 mesh slices (the CLI path: --serve-tp)
+root.common.serving.tp = 2
+apis = [vt.GenerationAPI(wf, port=0, engine="continuous", max_slots=2,
+                         buckets=(8, 16, 32), max_context=48,
+                         name="tpgasp_%d" % i) for i in range(2)]
+for api in apis:
+    api.initialize()
+router = FleetRouter(["127.0.0.1:%d" % api.port for api in apis],
+                     probe_interval=0.2, failure_threshold=1,
+                     retry_budget=2, attempt_timeout=60.0,
+                     request_timeout=120.0, name="tpgasp_router").start()
+try:
+    # replica = mesh slice on the probe surface: /readyz carries the
+    # slice shape, the roster counts chips once per slice
+    with urllib.request.urlopen(
+            "http://127.0.0.1:%d/readyz" % apis[0].port,
+            timeout=30) as r:
+        ready = json.loads(r.read())
+    # warm both replicas' programs outside the armed window
+    for api in apis:
+        code, _ = post("http://127.0.0.1:%d/generate" % api.port,
+                       {"prompt": prompt, "n_new": 2, "mode": "sample",
+                        "temperature": 0.8, "seed": 17})
+        assert code == 200, code
+    import time
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        eps = router.roster()["endpoints"]
+        if all(e.get("tp_devices") == 2 for e in eps):
+            break
+        time.sleep(0.25)
+    roster = router.roster()["endpoints"]
+    gauges = router.gauges()
+    ra = counters.get("veles_resume_attempts_total")
+    fo = counters.get("veles_router_failovers_total")
+    os.environ["VELES_FAULTS"] = \
+        "serve.replica_death:raise:after=4,times=1"
+    code, body = post(
+        "http://127.0.0.1:%d/generate" % router.port,
+        {"prompt": prompt, "n_new": n_new, "mode": "sample",
+         "temperature": 0.8, "seed": 17})
+    os.environ.pop("VELES_FAULTS", None)
+    print(json.dumps({
+        "code": code,
+        "tokens_equal": body.get("tokens") == solo,
+        "resumed_from": body.get("resumed_from", 0),
+        "resume_attempts": counters.get(
+            "veles_resume_attempts_total") - ra,
+        "failovers": counters.get("veles_router_failovers_total") - fo,
+        "readyz_tp": ready.get("tp"),
+        "roster_tp": [e.get("tp_devices") for e in roster],
+        "router_chips": gauges["veles_router_chips"][0],
+        "router_replicas": gauges["veles_router_replicas"][0],
+    }))
+finally:
+    router.stop()
+    for api in apis:
+        api.stop()
+"""
+
+
+@pytest.mark.slow
+def test_sharded_failover_resume_token_level_lossless():
+    """The serve.replica_death journal proof with mesh-slice replicas:
+    the dying tp=2 replica's 503 gasp makes the router RESUME on the
+    surviving tp=2 slice, and the stitched answer equals the
+    uninterrupted (unsharded!) solo decode exactly. The probe surface
+    reports replica = mesh slice: /readyz rides {"tp": {devices, axis}}
+    and the roster counts each slice once (2 replicas) while
+    veles_router_chips says 4."""
+    doc = _run_child(FAILOVER_CHILD, "0,1", timeout=480)
+    assert doc["code"] == 200, doc
+    assert doc["tokens_equal"] is True, doc
+    assert doc["resumed_from"] >= 1            # resumed, not redone
+    assert doc["resume_attempts"] >= 1
+    assert doc["failovers"] >= 1
+    assert doc["readyz_tp"] == {"devices": 2, "axis": "model"}
+    assert doc["roster_tp"] == [2, 2]
+    assert doc["router_replicas"] == 2         # slices, not chips
+    assert doc["router_chips"] == 4
+
+
+# -- in-process units: the replicated-host-data arithmetic ---------------------
+
+def test_per_shard_kv_heads():
+    """Per-chip K/V pool geometry: heads divide exactly or the engine
+    must refuse (a ragged shard cannot serve id-exact)."""
+    from veles_tpu.serving.pages import per_shard_kv_heads
+    assert per_shard_kv_heads(8) == 8
+    assert per_shard_kv_heads(8, 2) == 4
+    assert per_shard_kv_heads(8, 8) == 1
+    with pytest.raises(ValueError, match="ragged"):
+        per_shard_kv_heads(6, 4)
+
+
+def test_fleet_merge_folds_slice_width_into_chips():
+    """fleet.aggregate must NOT read a tp=4 slice as 4 replicas: the
+    veles_serving_tp gauge folds into veles_fleet_chips instead of the
+    generic sum, and replica-count gauges stay per-endpoint."""
+    from veles_tpu.telemetry import fleet
+    a = {"counters": {"veles_requests_total": 3.0},
+         "gauges": {"veles_serving_tp": 4.0,
+                    "veles_serving_slots": 2.0}}
+    b = {"counters": {"veles_requests_total": 2.0},
+         "gauges": {"veles_serving_tp": 1.0,
+                    "veles_serving_slots": 2.0}}
+    merged = fleet.merge([a, b])
+    assert merged["counters"]["veles_requests_total"] == 5.0
+    assert merged["gauges"]["veles_fleet_chips"] == 5.0
+    assert merged["gauges"]["veles_serving_slots"] == 4.0
+    # the raw width gauge never leaks into the merged view (a summed
+    # "tp" across a fleet is the meaningless number this guards)
+    assert "veles_serving_tp" not in merged["gauges"]
+    # an old replica without the gauge still counts one chip? No —
+    # chips are only counted where the gauge is exported; a fleet of
+    # pre-tp replicas simply has no chip gauge
+    assert fleet.merge([{"counters": {}, "gauges": {}}])[
+        "gauges"] == {}
+
+
+def test_router_counts_slice_once_and_chips_gauge():
+    """Roster arithmetic without HTTP: each Replica defaults to one
+    chip, a probed slice width lands in snapshot()["tp_devices"], and
+    gauges() sums chips while replicas stay slice-count."""
+    from veles_tpu.serving.router import FleetRouter
+    router = FleetRouter(["127.0.0.1:1", "127.0.0.1:2"],
+                         name="tp_roster_unit")
+    try:
+        assert [r.tp_devices for r in router.replicas] == [1, 1]
+        router.replicas[0].tp_devices = 4
+        snap = router.replicas[0].snapshot()
+        assert snap["tp_devices"] == 4
+        g = router.gauges()
+        assert g["veles_router_replicas"][0] == 2
+        assert g["veles_router_chips"][0] == 5
+    finally:
+        router.stop()
+
+
+def test_health_info_rides_readyz_without_shadowing():
+    """set_info publishes discovery facts on /readyz (the router probe
+    learns the slice shape for free), retracts on None, and can never
+    shadow the probe's own status/components keys."""
+    from veles_tpu.resilience import health
+    health.mark_ready("tp_info_unit")
+    try:
+        health.set_info("tp", {"devices": 2, "axis": "model"})
+        health.set_info("status", "evil")      # must NOT shadow
+        code, payload = health.readyz()
+        assert code == 200
+        assert payload["tp"] == {"devices": 2, "axis": "model"}
+        assert payload["status"] == "ok"
+        health.set_info("tp")                  # retract
+        health.set_info("status")
+        _code, payload = health.readyz()
+        assert "tp" not in payload
+    finally:
+        health.set_info("tp")
+        health.set_info("status")
+        health.forget("tp_info_unit")

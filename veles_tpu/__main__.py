@@ -109,6 +109,8 @@ def main(argv=None) -> int:
             args.serve_prefix_cache == "on"
     if args.serve_prefill_chunk is not None:
         _root.common.serving.prefill_chunk = args.serve_prefill_chunk
+    if args.serve_tp is not None:
+        _root.common.serving.tp = args.serve_tp
     if args.serve_state_cache is not None:
         _root.common.serving.state_cache = \
             args.serve_state_cache == "on"

@@ -179,6 +179,16 @@ def make_parser() -> argparse.ArgumentParser:
                         "per-tick decode stall a long admission "
                         "causes (root.common.serving.prefill_chunk; "
                         "0 = monolithic)")
+    p.add_argument("--serve-tp", type=int, default=None, metavar="N",
+                   help="tensor-parallel serving over a 1D (\"model\",)"
+                        " mesh slice: N chips serve as ONE logical "
+                        "replica — attention heads and K/V pages shard "
+                        "over the head axis, FC/embedding weights "
+                        "column/row-parallel, while page tables and "
+                        "the prefix cache stay replicated host data "
+                        "(root.common.serving.tp; 1 = solo; answers "
+                        "id-exact vs the unsharded engine; float "
+                        "plane only)")
     p.add_argument("--serve-state-cache", default=None,
                    choices=("on", "off"),
                    help="state-checkpoint prefix cache of the O(1)-"

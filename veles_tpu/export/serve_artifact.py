@@ -50,7 +50,13 @@ from ..error import VelesError
 #: pool is per-slot STATE tensors instead of paged KV (signature kind
 #: "recurrent" stamps the state leaf shapes); paged artifacts are
 #: unchanged, so v3 paged artifacts still load
-ARTIFACT_VERSION = 4
+#: v5: tensor-parallel serving — the signature stamps the mesh-slice
+#: width ("tp") and axis layout ("mesh"), and under tp>1 the exported
+#: programs are shard_mapped over the ("model",) mesh (a load needs
+#: the same device count). Every v4 artifact lacks the tp keys, so it
+#: refuses on the signature check and falls back counted to live jit
+#: — never an outage
+ARTIFACT_VERSION = 5
 
 
 def _specs_of(tree):

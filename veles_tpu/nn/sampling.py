@@ -97,20 +97,28 @@ def split_stack(forwards) -> Dict[str, object]:
             "head": head}
 
 
-def _block_prefill(block, p, x, cache_k, cache_v):
+def _block_prefill(block, p, x, cache_k, cache_v, tp=1, tp_axis=None):
     """Full-window pass through one block, writing K/V into the caches'
     first T positions. The attention goes through the SAME per-shape
     chooser as TransformerBlock.apply (attention_core: f32 softmax,
     flash kernel above the crossover) so prefill logits cannot drift
-    from the trained forward."""
+    from the trained forward.
+
+    ``tp``/``tp_axis`` (serving engine's ``--serve-tp``): inside a
+    shard_map over a 1D ``("model",)`` mesh, ``p`` holds head-sharded
+    weight shards (wq/wk/wv column, wo row) and the caches hold this
+    shard's ``kv/tp`` K/V heads (Ulysses-style head sharding); the
+    partial wo product psums into the full residual. ``hd`` always
+    derives from the FULL head count — the residual ``d`` never
+    shards."""
     import jax.numpy as jnp
     from .attention import attention_core
     from ..ops import matmul_precision
     prec = matmul_precision()
     b, t, d = x.shape
-    h = block.n_heads
-    kv = getattr(block, "n_kv_heads", h)
-    hd = d // h
+    h = block.n_heads // tp
+    kv = getattr(block, "n_kv_heads", block.n_heads) // tp
+    hd = d // block.n_heads
 
     a_in = block_norm(jnp, block, p, x, "ln1")
     q = jnp.dot(a_in, p["wq"], precision=prec).reshape(b, t, h, hd)
@@ -125,24 +133,31 @@ def _block_prefill(block, p, x, cache_k, cache_v):
     cache_v = cache_v.at[:, :t].set(v)
     o = attention_core(q, k, v, causal=True, mesh=None, n_heads=h,
                        window=getattr(block, "window", None)
-                       ).reshape(b, t, d)
-    x = x + jnp.dot(o, p["wo"], precision=prec)
+                       ).reshape(b, t, h * hd)
+    proj = jnp.dot(o, p["wo"], precision=prec)
+    if tp_axis is not None:
+        import jax
+        proj = jax.lax.psum(proj, tp_axis)
+    x = x + proj
     f_in = block_norm(jnp, block, p, x, "ln2")
-    return x + block_ffn(jnp, block, p, f_in, prec), \
+    return x + block_ffn(jnp, block, p, f_in, prec, tp_axis=tp_axis), \
         cache_k, cache_v
 
 
-def _block_step(block, p, x_t, cache_k, cache_v, pos):
+def _block_step(block, p, x_t, cache_k, cache_v, pos, tp=1,
+                tp_axis=None):
     """One-token pass: x_t (B, 1, D), caches (B, T_max, H, Dh), pos =
-    tokens already cached. Attention reads the cache rows <= pos."""
+    tokens already cached. Attention reads the cache rows <= pos.
+    ``tp``/``tp_axis``: head-sharded weights + ``kv/tp``-head caches
+    inside a shard_map, exactly as :func:`_block_prefill`."""
     import jax.numpy as jnp
     from ..ops import matmul_precision
     prec = matmul_precision()
     b, _, d = x_t.shape
-    h = block.n_heads
-    kv = getattr(block, "n_kv_heads", h)
+    h = block.n_heads // tp
+    kv = getattr(block, "n_kv_heads", block.n_heads) // tp
     g = h // kv
-    hd = d // h
+    hd = d // block.n_heads
 
     a_in = block_norm(jnp, block, p, x_t, "ln1")
     q = jnp.dot(a_in, p["wq"], precision=prec).reshape(b, 1, h, hd)
@@ -172,24 +187,54 @@ def _block_step(block, p, x_t, cache_k, cache_v, pos):
     w = w / w.sum(axis=-1, keepdims=True)
     o = jnp.einsum("bkgqt,btkd->bqkgd", w,
                    cache_v.astype(jnp.float32)).astype(x_t.dtype)
-    o = o.reshape(b, 1, d)
-    x_t = x_t + jnp.dot(o, p["wo"], precision=prec)
+    o = o.reshape(b, 1, h * hd)
+    proj = jnp.dot(o, p["wo"], precision=prec)
+    if tp_axis is not None:
+        import jax
+        proj = jax.lax.psum(proj, tp_axis)
+    x_t = x_t + proj
     f_in = block_norm(jnp, block, p, x_t, "ln2")
-    return x_t + block_ffn(jnp, block, p, f_in, prec), \
+    return x_t + block_ffn(jnp, block, p, f_in, prec,
+                           tp_axis=tp_axis), \
         cache_k, cache_v
 
 
-def _embed_prompt(stem, pos_emb, params, ids, pos0=0):
+def _embed_ids(stem, params, ids, tp=1, tp_axis=None):
+    """Embedding-table gather for int token ids of ANY shape —
+    ``mode="clip"`` semantics. Under ``tp_axis`` the table is a
+    vocab-row shard: ids are clipped against the GLOBAL vocab, rows
+    this shard owns gather locally, foreign rows contribute EXACT
+    zeros, and the psum rebuilds the full embedding bit-exactly (a
+    sum of one real row and N-1 exact zeros is the row)."""
+    import jax.numpy as jnp
+    table = params[stem.name]["table"]
+    ids = ids.astype(jnp.int32)
+    if tp_axis is None:
+        return jnp.take(table, ids, axis=0, mode="clip")
+    import jax
+    vloc = table.shape[0]
+    gids = jnp.clip(ids, 0, vloc * tp - 1)
+    local = gids - jax.lax.axis_index(tp_axis) * vloc
+    own = (local >= 0) & (local < vloc)
+    x = jnp.where(own[..., None],
+                  jnp.take(table, jnp.clip(local, 0, vloc - 1),
+                           axis=0), 0)
+    return jax.lax.psum(x, tp_axis)
+
+
+def _embed_prompt(stem, pos_emb, params, ids, pos0=0, tp=1,
+                  tp_axis=None):
     """(B, T) token ids → (B, T, D): embedding-table gather plus the
     positional rows ``pos0..pos0+T`` — THE stack entry every prompt
     consumer shares (the sampler, the serving engine's bucketed
     prefill, :func:`prompt_logits`). One definition, so a change to
     how the stack enters (a new pos-emb variant, a promotion tweak)
     cannot drift between the serving programs and the float reference
-    the quantization gate measures against."""
+    the quantization gate measures against. ``tp``/``tp_axis``: the
+    vocab-row-sharded gather of :func:`_embed_ids`; the positional
+    table stays replicated."""
     import jax.numpy as jnp
-    x = jnp.take(params[stem.name]["table"], ids.astype(jnp.int32),
-                 axis=0, mode="clip")
+    x = _embed_ids(stem, params, ids, tp=tp, tp_axis=tp_axis)
     if pos_emb is not None:
         idx = pos0 + jnp.arange(ids.shape[-1])
         x = x + jnp.take(params[pos_emb.name]["table"], idx,
@@ -197,31 +242,43 @@ def _embed_prompt(stem, pos_emb, params, ids, pos0=0):
     return x
 
 
-def _prefill_blocks(blocks, params, x, cache_len, dim):
+def _prefill_blocks(blocks, params, x, cache_len, dim, tp=1,
+                    tp_axis=None):
     """Run every transformer block's ``_block_prefill`` over fresh
     zero K/V caches of ``cache_len`` rows → (x, [(ck, cv), ...]) —
     the shared prompt forward. Each block shapes its OWN cache (the
     layers config allows heterogeneous n_heads; with GQA the cache
-    holds the unrepeated n_kv_heads rows)."""
+    holds the unrepeated n_kv_heads rows; under ``tp`` each shard
+    caches its own ``n_kv_heads/tp`` slice)."""
     import jax.numpy as jnp
     b = x.shape[0]
     caches = []
     for blk in blocks:
-        bkv = getattr(blk, "n_kv_heads", blk.n_heads)
+        bkv = getattr(blk, "n_kv_heads", blk.n_heads) // tp
         hd = dim // blk.n_heads
         ck = jnp.zeros((b, cache_len, bkv, hd), x.dtype)
         cv = jnp.zeros((b, cache_len, bkv, hd), x.dtype)
-        x, ck, cv = _block_prefill(blk, params[blk.name], x, ck, cv)
+        x, ck, cv = _block_prefill(blk, params[blk.name], x, ck, cv,
+                                   tp=tp, tp_axis=tp_axis)
         caches.append((ck, cv))
     return x, caches
 
 
-def _head_logits(head, params, x_last, prec):
+def _head_logits(head, params, x_last, prec, tp_axis=None):
     """Vocabulary head projection, shared by the same three consumers
-    as :func:`_embed_prompt`."""
+    as :func:`_embed_prompt`. Under ``tp_axis`` weights/bias are
+    vocab-column shards: each shard computes its own logit columns
+    (bit-exact — every column is one full-depth dot), and a tiled
+    all_gather rebuilds the full replicated (…, V) row so sampling
+    runs identically on every shard."""
     import jax.numpy as jnp
-    return (jnp.dot(x_last, params[head.name]["weights"],
-                    precision=prec) + params[head.name]["bias"])
+    out = (jnp.dot(x_last, params[head.name]["weights"],
+                   precision=prec) + params[head.name]["bias"])
+    if tp_axis is not None:
+        import jax
+        out = jax.lax.all_gather(out, tp_axis, axis=out.ndim - 1,
+                                 tiled=True)
+    return out
 
 
 def _build_sampler(wf, t_p, n_new, temperature):

@@ -50,21 +50,23 @@ def _rope_span(np_mod, x, pos0, base=10000.0):
     return np_mod.concatenate([rot1, rot2, x[..., 2 * half:]], axis=-1)
 
 
-def _block_span(block, p, x, cache_k, cache_v, pos0):
+def _block_span(block, p, x, cache_k, cache_v, pos0, tp=1,
+                tp_axis=None):
     """Multi-position incremental pass: x (B, g, D) are the tokens at
     positions pos0..pos0+g-1 (traced pos0); K/V land in those cache
     rows and attention reads the cache causally by GLOBAL position —
     the g-wide generalization of sampling._block_step (g=1 reduces to
-    it)."""
+    it). ``tp``/``tp_axis``: head-sharded weights + ``kv/tp``-head
+    caches inside a shard_map, same contract as ``_block_step``."""
     import jax
     import jax.numpy as jnp
     from ..ops import matmul_precision
     prec = matmul_precision()
     b, g, d = x.shape
-    h = block.n_heads
-    kv = getattr(block, "n_kv_heads", h)
+    h = block.n_heads // tp
+    kv = getattr(block, "n_kv_heads", block.n_heads) // tp
     grp = h // kv
-    hd = d // h
+    hd = d // block.n_heads
 
     a_in = block_norm(jnp, block, p, x, "ln1")
     q = jnp.dot(a_in, p["wq"], precision=prec).reshape(b, g, h, hd)
@@ -94,10 +96,14 @@ def _block_span(block, p, x, cache_k, cache_v, pos0):
     w = w / w.sum(axis=-1, keepdims=True)
     o = jnp.einsum("bkgqt,btkd->bqkgd", w,
                    cache_v.astype(jnp.float32)).astype(x.dtype)
-    o = o.reshape(b, g, d)
-    x = x + jnp.dot(o, p["wo"], precision=prec)
+    o = o.reshape(b, g, h * hd)
+    proj = jnp.dot(o, p["wo"], precision=prec)
+    if tp_axis is not None:
+        proj = jax.lax.psum(proj, tp_axis)
+    x = x + proj
     f_in = block_norm(jnp, block, p, x, "ln2")
-    return x + block_ffn(jnp, block, p, f_in, prec), cache_k, cache_v
+    return x + block_ffn(jnp, block, p, f_in, prec,
+                         tp_axis=tp_axis), cache_k, cache_v
 
 
 def _embed_at(stack, params, ids, pos0):

@@ -59,10 +59,18 @@ def block_norm(np_mod, block, p, x, which: str):
     return _layernorm(np_mod, x, p[which + "_g"], p[which + "_b"])
 
 
-def block_ffn(np_mod, block, p, x, prec=None):
+def block_ffn(np_mod, block, p, x, prec=None, tp_axis=None):
     """The block's FFN sub-layer, shared the same way. ffn="swiglu":
     W2·(silu(W1 x) ⊙ W3 x), no biases (llama convention); default
-    GELU: W2·gelu(W1 x + b1) + b2."""
+    GELU: W2·gelu(W1 x + b1) + b2.
+
+    ``tp_axis`` names a tensor-parallel mesh axis the caller is
+    shard_mapped over (serving engine, ``--serve-tp``): w1/w3 are then
+    column shards, b1 a hidden shard and w2 a row shard, so the
+    partial W2 products psum into the full output — with b2 kept
+    REPLICATED and added once AFTER the psum (a sharded b2 would be
+    N-counted). ``tp_axis=None`` is bit-identical to the pre-TP
+    path."""
     if np_mod is numpy:
         def dot(a, b):
             return a @ b
@@ -70,10 +78,17 @@ def block_ffn(np_mod, block, p, x, prec=None):
         def dot(a, b):
             return np_mod.dot(a, b, precision=prec)
     if getattr(block, "ffn", "gelu") == "swiglu":
-        return dot(_silu(np_mod, dot(x, p["w1"])) * dot(x, p["w3"]),
-                   p["w2"])
-    return dot(_gelu(np_mod, dot(x, p["w1"]) + p["b1"]),
-               p["w2"]) + p["b2"]
+        out = dot(_silu(np_mod, dot(x, p["w1"])) * dot(x, p["w3"]),
+                  p["w2"])
+        if tp_axis is not None:
+            from jax import lax
+            out = lax.psum(out, tp_axis)
+        return out
+    out = dot(_gelu(np_mod, dot(x, p["w1"]) + p["b1"]), p["w2"])
+    if tp_axis is not None:
+        from jax import lax
+        out = lax.psum(out, tp_axis)
+    return out + p["b2"]
 
 
 def _rope(np_mod, x, base=10000.0):

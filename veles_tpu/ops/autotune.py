@@ -53,6 +53,43 @@ SHIPPED = os.path.join(os.path.dirname(os.path.dirname(
 #: bad environment costs one attempt, not one per trace)
 _memo: dict = {}
 
+#: (device_kind, key) pairs whose staleness was already warned about —
+#: one log line per entry per process, however many traces look it up
+_stale_warned: set = set()
+
+
+def _jax_version() -> str:
+    try:
+        import jax
+        return str(jax.__version__)
+    except Exception:            # noqa: BLE001 — backend-less tooling
+        return "unknown"
+
+
+def _check_stale(key: str, kind: str, entry: dict) -> None:
+    """Provenance check on a DB hit: an entry measured under a
+    different jax (or none recorded — the pre-stamp DB format) may
+    rank block shapes the current Mosaic lowers differently, so the
+    hit is USED but flagged — warned once per (kind, key) and counted
+    ``veles_autotune_stale_total`` every lookup, the signal a
+    re-sweep (or chip measurement batch) clears."""
+    stamped = entry.get("jax")
+    current = _jax_version()
+    if stamped == current:
+        return
+    from ..telemetry.counters import inc
+    inc("veles_autotune_stale_total")
+    if (kind, key) in _stale_warned:
+        return
+    _stale_warned.add((kind, key))
+    import logging
+    logging.getLogger("veles_tpu.ops.autotune").warning(
+        "kernel_tuning entry %s (%s) was measured under jax %s, "
+        "running %s — reusing it, but the ranking may be stale; "
+        "re-sweep to refresh", key, kind,
+        stamped if stamped is not None else "an unstamped build",
+        current)
+
 
 def _user_path() -> str:
     from ..config import root
@@ -91,7 +128,10 @@ def flash_key(t: int, d: int, causal: bool, window: int = 0) -> str:
 
 def lookup(key: str, device_kind: Optional[str] = None) -> Optional[dict]:
     kind = device_kind or current_device_kind()
-    return _device_db(kind).get(key)
+    hit = _device_db(kind).get(key)
+    if hit is not None:
+        _check_stale(key, kind, hit)
+    return hit
 
 
 def record(key: str, entry: dict, device_kind: Optional[str] = None,
@@ -104,7 +144,11 @@ def record(key: str, entry: dict, device_kind: Optional[str] = None,
     each other's entries."""
     import fcntl
     kind = device_kind or current_device_kind()
-    entry = dict(entry, ts=time.strftime("%Y-%m-%d %H:%M:%S"))
+    # provenance stamp: which toolchain + chip measured this entry —
+    # lookup() flags (veles_autotune_stale_total) hits whose jax
+    # differs from the running one
+    entry = dict(entry, ts=time.strftime("%Y-%m-%d %H:%M:%S"),
+                 jax=_jax_version(), device_kind=kind)
     for path in ([_user_path(), SHIPPED] if shipped else [_user_path()]):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         with open(path + ".lock", "w") as lock:
@@ -411,3 +455,4 @@ def flash_blocks(t: int, d: int, causal: bool = True, window: int = 0,
 
 def clear_memo() -> None:
     _memo.clear()
+    _stale_warned.clear()

@@ -96,6 +96,26 @@ heartbeats = HeartbeatRegistry()
 _ready_lock = threading.Lock()
 _ready: Dict[str, bool] = {}
 _draining: set = set()
+#: free-form info keys merged into the /readyz payload — the roster
+#: discovery surface for facts that are NOT health (e.g. a TP engine
+#: publishes {"tp": {"devices": N, "axis": "model"}} so a router
+#: learns replica = mesh slice without a second probe endpoint)
+_info: Dict[str, Any] = {}
+
+
+def set_info(key: str, value: Any = None) -> None:
+    """Publish (or, with ``value=None``, retract) one info key on the
+    /readyz payload. Values must be JSON-serializable."""
+    with _ready_lock:
+        if value is None:
+            _info.pop(key, None)
+        else:
+            _info[key] = value
+
+
+def info() -> Dict[str, Any]:
+    with _ready_lock:
+        return dict(_info)
 
 
 def mark_ready(name: str) -> None:
@@ -167,10 +187,16 @@ def readyz() -> Tuple[int, Dict[str, Any]]:
         not_ready = {n for n, v in marks.items() if not v}
         status = ("draining" if not_ready and not_ready <= drains
                   else "not ready")
-    return (200 if ok else 503), {
+    payload: Dict[str, Any] = {
         "status": status,
         "components": {n: ("draining" if n in drains else v)
                        for n, v in marks.items()}}
+    # info keys ride the same payload (never affect the code): a
+    # router's probe learns e.g. the mesh-slice shape for free —
+    # setdefault so no info key can shadow status/components
+    for k, v in info().items():
+        payload.setdefault(k, v)
+    return (200 if ok else 503), payload
 
 
 def handle_health(handler, path: str) -> bool:

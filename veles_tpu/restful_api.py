@@ -733,6 +733,11 @@ class GenerationAPI(Unit):
                 "veles_serving_prefilling": st["prefilling"],
                 "veles_serving_prefill_stall_seconds":
                     st["prefill_stall_seconds"],
+                # mesh-slice width this replica spans (1 = solo chip).
+                # fleet.merge folds it into veles_fleet_chips instead
+                # of the generic gauge sum — N chips must never read
+                # as N replicas in the fleet roll-up
+                "veles_serving_tp": st.get("tp", 1),
             })
             if st.get("slot_kind", "paged") != "state":
                 # paged-pool occupancy (serving/pages.py): the gauges
@@ -1204,6 +1209,13 @@ class GenerationAPI(Unit):
         timeseries.add_gauge_provider("serve.%s" % self.name,
                                       self._metrics_gauges)
         timeseries.maybe_start()
+        # a tensor-parallel engine publishes its mesh-slice shape on
+        # /readyz so a fleet router learns replica = N-chip slice from
+        # the probe it already makes (router.py folds it into
+        # veles_router_chips; the replica count stays per-slice)
+        if getattr(self._engine, "tp", 1) > 1:
+            health.set_info("tp", {"devices": int(self._engine.tp),
+                                   "axis": "model"})
         health.mark_ready("serve.%s" % self.name)
         self.info("%s: generation API on http://127.0.0.1:%d%s "
                   "(modes: %s%s)", self.name, self.port, self.path,
@@ -1296,6 +1308,8 @@ class GenerationAPI(Unit):
                 self._worker.join(timeout=5)
                 self._worker = None
             if self._engine is not None:
+                if getattr(self._engine, "tp", 1) > 1:
+                    health.set_info("tp")
                 self._engine.stop()
                 self._engine = None
             # after the worker is down — its beats must not

@@ -113,6 +113,16 @@ O1_COUNTERS = (
     "veles_o1_state_evictions_total",
 )
 
+#: every counter the tensor-parallel serving plane increments
+#: (shard_mapped decode/prefill/pagecopy over the ("model",) mesh
+#: slice, engine.py ``tp=`` knob) — registered with HELP strings in
+#: telemetry/counters.py DESCRIPTIONS and asserted zero in tp=1 runs
+#: by ``python bench.py gate``'s tp section
+TP_COUNTERS = (
+    "veles_tp_engines_total",
+    "veles_tp_dispatches_total",
+)
+
 #: every counter the overload-hardened request plane increments (QoS
 #: preempt-and-resume + AIMD admission + brownout ladder + retry
 #: storm control, serving/overload.py) — registered with HELP strings

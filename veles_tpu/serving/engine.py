@@ -96,7 +96,6 @@ float-pool only:
 
 from __future__ import annotations
 
-import functools
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -237,6 +236,8 @@ class ContinuousEngine(Logger):
                  artifact: Optional[str] = None,
                  prefix_cache: Optional[bool] = None,
                  prefill_chunk: Optional[int] = None,
+                 tp: Optional[int] = None,
+                 mesh=None,
                  name: str = "serving") -> None:
         super().__init__()
         from ..config import root
@@ -371,6 +372,46 @@ class ContinuousEngine(Logger):
                 self.warning("%s: draft model unusable for pooled "
                              "speculation (%s); mode=speculative rides "
                              "the window plane", name, e)
+        # tensor-parallel serving (root.common.serving.tp, CLI
+        # --serve-tp; docs/services.md "Tensor-parallel serving"): the
+        # fixed-shape programs shard_map over a 1D ("model",) mesh
+        # slice — attention heads and K/V pages shard over the head
+        # axis, FC/embedding weights shard column/row-parallel with
+        # one psum per block, while page tables, the shared mask, slot
+        # metadata and the PrefixCache stay REPLICATED host data
+        # indexing logical pages. tp=1 (the default) is bit-identical
+        # to the single-device engine (no shard_map in the trace).
+        if mesh is not None and tp is None:
+            tp = int(numpy.prod(list(mesh.shape.values())))
+        self.tp = int(serving_cfg.get("tp", 1) if tp is None else tp)
+        if self.tp < 1:
+            raise ValueError("tp must be >= 1")
+        self._mesh_arg = mesh
+        self._tp_mesh_obj = None
+        self._tp_params_cache = None   # (float tree, its placed twin)
+        self._tp_draft_cache = None
+        if self.tp > 1:
+            if self.quant_weights or self.quant_kv:
+                # the int8 programs dequantize per-page sidecars whose
+                # scales are row-global; sharding them is future work
+                raise VelesError(
+                    "tensor-parallel serving (tp=%d) serves the float "
+                    "plane only; disable --quant-weights/--quant-kv"
+                    % self.tp)
+            reason = self._tp_unshardable(self.stack)
+            if reason:
+                raise VelesError(
+                    "stack cannot head-shard over tp=%d: %s"
+                    % (self.tp, reason))
+            if self.draft is not None:
+                dreason = self._tp_unshardable(self.draft_stack)
+                if dreason:
+                    self.warning(
+                        "%s: draft model cannot head-shard over tp=%d "
+                        "(%s); mode=speculative rides the window "
+                        "plane", name, self.tp, dreason)
+                    self.draft = None
+                    self.draft_stack = None
         pos_emb = self.stack["pos_emb"]
         self._table_len = (None if pos_emb is None else
                            pos_emb.param_arrays()["table"].shape[0])
@@ -430,19 +471,25 @@ class ContinuousEngine(Logger):
         if self.qos:
             from .overload import set_pressure_provider
             set_pressure_provider(self._pressure_fn)
+        if self.tp > 1:
+            # build the mesh eagerly so a too-small device pool fails
+            # the START, not the first admitted request's prefill
+            self._tp_mesh()
+            inc("veles_tp_engines_total")
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=self.name + ".engine")
         self._thread.start()
         from . import register_engine
         register_engine(self)
         self.info("%s: continuous batching up (slots=%d buckets=%s "
-                  "max_context=%d decode_block=%d pages=%dx%d%s%s)",
+                  "max_context=%d decode_block=%d pages=%dx%d%s%s%s)",
                   self.name, self.max_slots, list(self.buckets),
                   self.max_context, self.decode_block, self.pages,
                   self.page_size,
                   " +spec" if self.draft is not None else "",
                   " +beam" if self.beam_width <= self.max_slots
-                  else "")
+                  else "",
+                  " tp=%d" % self.tp if self.tp > 1 else "")
         return self
 
     def stop(self) -> None:
@@ -684,8 +731,22 @@ class ContinuousEngine(Logger):
             "quant_weights": int(self.quant_weights),
             "quant_kv": int(self.quant_kv),
             "compiled_live": self.compiled_live,
+            # mesh-slice width this ONE logical replica spans (1 =
+            # solo). Every page gauge above counts LOGICAL pages —
+            # host-side allocator state plus global array shapes, both
+            # shard-agnostic — so a tp=4 slice reports its occupancy
+            # ONCE, not four times (fleet.merge keys chip math off
+            # veles_serving_tp, never off page gauges)
+            "tp": self.tp,
             "kv_pool_bytes": pool_nbytes(self._caches)
             + pool_nbytes(self._draft_caches),
+            # what ONE chip of the slice actually holds: the kv-head
+            # axis shards tp ways (pages.per_shard_kv_heads), so the
+            # per-chip HBM is the logical pool over tp — the number
+            # an operator sizes a single chip's memory against
+            "kv_pool_bytes_per_shard": (
+                pool_nbytes(self._caches)
+                + pool_nbytes(self._draft_caches)) // max(1, self.tp),
         }
 
     @property
@@ -826,7 +887,7 @@ class ContinuousEngine(Logger):
         if params is None or self.scheduler.busy_count() == 0:
             params = self._params = self._prepare_params()
             if self.draft is not None:
-                self._draft_params = params_of(self.draft)
+                self._draft_params = self._prepare_draft_params()
         self._ensure_pool(params)
         from .scheduler import shed_expired
         # co-tenants in flight BEFORE this tick's admissions: only
@@ -986,6 +1047,18 @@ class ContinuousEngine(Logger):
         invisible here — their authors must call
         :meth:`invalidate_quant_cache`."""
         params = params_of(self.wf)
+        if self.tp > 1:
+            # sharded placement is cached by the same leaf-identity
+            # test the quant twin uses: unchanged weights reuse the
+            # resident shards, updated weights re-place at the next
+            # burst boundary (quant is gated off under tp)
+            cached = self._tp_params_cache
+            if cached is not None and _same_leaves(cached[0], params):
+                return cached[1]
+            placed = self._tp_place(
+                params, self._params_pspec(self.stack, params))
+            self._tp_params_cache = (params, placed)
+            return placed
         if not self.quant_weights:
             return params
         cached = self._quant_cache
@@ -995,6 +1068,20 @@ class ContinuousEngine(Logger):
         qparams, _report = quantize_params(params)
         self._quant_cache = (params, qparams)
         return qparams
+
+    def _prepare_draft_params(self) -> Dict:
+        """The draft tree — under ``tp`` placed on the mesh with the
+        same identity caching as :meth:`_prepare_params`."""
+        params = params_of(self.draft)
+        if self.tp <= 1:
+            return params
+        cached = self._tp_draft_cache
+        if cached is not None and _same_leaves(cached[0], params):
+            return cached[1]
+        placed = self._tp_place(
+            params, self._params_pspec(self.draft_stack, params))
+        self._tp_draft_cache = (params, placed)
+        return placed
 
     def _ensure_pool(self, params) -> None:
         if self._caches is not None:
@@ -1023,12 +1110,153 @@ class ContinuousEngine(Logger):
             # quant_kv makes
             self._draft_caches = pools(self.draft_stack, False)
         self._keys = jnp.zeros((self.max_slots, 2), jnp.uint32)
+        if self.tp > 1:
+            # pools shard over the kv-head axis (each chip holds every
+            # logical page's heads/tp slice — pages.py per_shard_kv);
+            # keys stay replicated. Placing them NOW keeps the
+            # donation path alias-clean from the first dispatch
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            mesh = self._tp_mesh()
+            self._caches = self._tp_place(
+                self._caches, self._caches_pspec(self.stack))
+            if self._draft_caches is not None:
+                self._draft_caches = self._tp_place(
+                    self._draft_caches,
+                    self._caches_pspec(self.draft_stack))
+            self._keys = jax.device_put(
+                self._keys, NamedSharding(mesh, P()))
 
     def _pool_dtype(self, params):
         """Float dtype of the activation path (the stem table's —
         also under quant_weights, which never touches ``table``)."""
         stem = self.stack["stem"]
         return params[stem.name]["table"].dtype
+
+    # -- tensor-parallel mesh (docs/services.md "Tensor-parallel
+    # serving") -----------------------------------------------------------
+    @property
+    def _tp_axis(self):
+        """Mesh axis name the programs shard over, or None solo."""
+        return "model" if self.tp > 1 else None
+
+    def _tp_unshardable(self, stack) -> Optional[str]:
+        """Reason string when ``stack`` cannot head/vocab-shard over
+        ``self.tp`` ways, else None. Every sharded dimension must
+        divide evenly — a ragged shard would silently change the
+        math, and id-exactness is the whole contract."""
+        tp = self.tp
+        stem, head = stack["stem"], stack["head"]
+        vocab = stem.param_arrays()["table"].shape[0]
+        if vocab % tp:
+            return "vocab %d %% tp %d != 0" % (vocab, tp)
+        hv = head.param_arrays()["weights"].shape[1]
+        if hv % tp:
+            return "head vocab %d %% tp %d != 0" % (hv, tp)
+        from .pages import per_shard_kv_heads
+        for blk in stack["blocks"]:
+            kv = getattr(blk, "n_kv_heads", blk.n_heads)
+            try:
+                per_shard_kv_heads(kv, tp)
+            except ValueError:
+                return ("%s heads %d/kv %d not divisible by tp %d"
+                        % (blk.name, blk.n_heads, kv, tp))
+            if blk.n_heads % tp:
+                return ("%s heads %d/kv %d not divisible by tp %d"
+                        % (blk.name, blk.n_heads, kv, tp))
+            hidden = blk.param_arrays()["w1"].shape[1]
+            if hidden % tp:
+                return ("%s ffn hidden %d %% tp %d != 0"
+                        % (blk.name, hidden, tp))
+        return None
+
+    def _tp_mesh(self):
+        """The 1D ``("model",)`` mesh slice this engine serves as —
+        built lazily (no jax import at construction) from the first
+        ``self.tp`` local devices, or the caller's ``mesh=`` knob."""
+        if self._tp_mesh_obj is None:
+            if self._mesh_arg is not None:
+                self._tp_mesh_obj = self._mesh_arg
+            else:
+                import jax
+                devs = jax.devices()
+                if len(devs) < self.tp:
+                    raise VelesError(
+                        "tp=%d needs %d devices; %d visible (set "
+                        "TPU_VISIBLE_CHIPS / XLA_FLAGS for a CPU "
+                        "virtual mesh)" % (self.tp, self.tp,
+                                           len(devs)))
+                from jax.sharding import Mesh
+                self._tp_mesh_obj = Mesh(
+                    numpy.array(devs[:self.tp]), ("model",))
+        return self._tp_mesh_obj
+
+    def _params_pspec(self, stack, params):
+        """PartitionSpec tree matching ``params``: wq/wk/wv/w1/w3 and
+        the head weights shard COLUMN-parallel, wo/w2 and the stem
+        table ROW-parallel, b1/head-bias along their sharded dim; b2,
+        norms and the positional table stay replicated (b2 is added
+        once AFTER the block psum — a sharded b2 would be
+        tp-counted)."""
+        from jax.sharding import PartitionSpec as P
+        stem, head = stack["stem"], stack["head"]
+        blocks = {blk.name for blk in stack["blocks"]}
+        col = {"wq", "wk", "wv", "w1", "w3"}
+        row = {"wo", "w2"}
+        out = {}
+        for uname, leaves in params.items():
+            spec = {}
+            for key in leaves:
+                if uname == stem.name and key == "table":
+                    s = P("model", None)
+                elif uname == head.name and key == "weights":
+                    s = P(None, "model")
+                elif uname == head.name and key == "bias":
+                    s = P("model")
+                elif uname in blocks and key in col:
+                    s = P(None, "model")
+                elif uname in blocks and key in row:
+                    s = P("model", None)
+                elif uname in blocks and key == "b1":
+                    s = P("model")
+                else:
+                    s = P()
+                spec[key] = s
+            out[uname] = spec
+        return out
+
+    def _caches_pspec(self, stack):
+        """Per-block K/V page-pool specs: the pool's kv-head axis
+        (axis 2 of (rows, page_size, kv, hd)) shards over the mesh —
+        each chip holds every LOGICAL page's ``kv/tp`` head slice, so
+        page ids, refcounts, COW and the eviction ledger never learn
+        about sharding."""
+        from jax.sharding import PartitionSpec as P
+        s = P(None, None, "model", None)
+        return tuple((s, s) for _ in stack["blocks"])
+
+    def _tp_place(self, tree, specs):
+        """``device_put`` a pytree onto the mesh per its spec tree."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        mesh = self._tp_mesh()
+        shardings = jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+        return jax.device_put(tree, shardings)
+
+    def _finalize(self, fn, donate=(), in_specs=None, out_specs=None):
+        """jit a program builder's raw function — plain ``jax.jit``
+        solo (bit-identical to the pre-TP engine), or jit(shard_map)
+        over the ``("model",)`` mesh under ``tp>1``. One seam, so
+        every builder stays a single definition for both planes."""
+        import jax
+        if self.tp <= 1:
+            return jax.jit(fn, donate_argnums=donate)
+        from ..parallel.compat import shard_map_compat
+        return jax.jit(
+            shard_map_compat(fn, self._tp_mesh(), in_specs, out_specs),
+            donate_argnums=donate)
 
     # -- admission ------------------------------------------------------------
     def _refresh_table_row(self, slot) -> None:
@@ -1730,6 +1958,19 @@ class ContinuousEngine(Logger):
                                                             key)
         return prog
 
+    @staticmethod
+    def _count_tp_dispatch(call):
+        """Count one ``veles_tp_dispatches_total`` per invocation —
+        the TP observability seam for artifact-installed programs
+        (the live path counts inside ``_instrument_live``)."""
+        import functools
+
+        @functools.wraps(call)
+        def counted(*args, **kwargs):
+            inc("veles_tp_dispatches_total")
+            return call(*args, **kwargs)
+        return counted
+
     def _instrument_live(self, jitted, key=None):
         """Wrap a live jitted program: every call counts one
         ``veles_decode_dispatches_total`` (the round-5 regression
@@ -1744,9 +1985,15 @@ class ContinuousEngine(Logger):
         Engine programs are fixed-shape, so one compile per program is
         exact, not a heuristic."""
         box: Dict[str, object] = {}
+        tp_on = self.tp > 1
 
         def dispatch(*args):
             inc("veles_decode_dispatches_total")
+            if tp_on:
+                # the TP observability seam: every dispatch that ran
+                # through a shard_mapped program (gate_tp's zero-
+                # leakage check asserts this NEVER moves solo)
+                inc("veles_tp_dispatches_total")
             if key is not None:
                 # per-program tally: the bench prefix gate prices a
                 # load's prefill FLOPs as sum(cost(program) x calls)
@@ -1823,6 +2070,11 @@ class ContinuousEngine(Logger):
             # artifact exported under other knobs refuses cleanly
             "prefix_cache": self.prefix_cache is not None,
             "prefill_chunk": int(self.prefill_chunk),
+            # v5: sharded programs are committed to a mesh shape — an
+            # artifact exported for one slice width refuses on another
+            # (and every v4 artifact, lacking the key, refuses too)
+            "tp": int(self.tp),
+            "mesh": ([["model", self.tp]] if self.tp > 1 else []),
         }
 
     def _load_artifact(self) -> bool:
@@ -1844,8 +2096,16 @@ class ContinuousEngine(Logger):
                 "live jit", self.name, self.artifact,
                 type(e).__name__, e)
             return False
+        tp_on = self.tp > 1
         for key, call in programs.items():
-            self._progs[key] = _count_decode_dispatches(call)
+            counted = _count_decode_dispatches(call)
+            if tp_on:
+                # artifact-installed programs are the same shard_mapped
+                # executables the live path builds, so they feed the TP
+                # dispatch seam too — otherwise a sharded engine serving
+                # from an artifact under-reports veles_tp_dispatches_total
+                counted = self._count_tp_dispatch(counted)
+            self._progs[key] = counted
         self.artifact_mode = True
         inc("veles_artifact_loads_total")
         self.info("%s: AOT artifact loaded from %s (%d programs; zero "
@@ -1875,7 +2135,7 @@ class ContinuousEngine(Logger):
         off = jnp.clip(pos % self.page_size, 0, self.page_size - 1)
         return pg, off
 
-    def _paged_row_step(self, blk, p, kp, vp):
+    def _paged_row_step(self, blk, p, kp, vp, tp=1, tp_axis=None):
         """The vmap'able single-row paged decode body shared by THE
         decode step and the spec round's draft proposal: gather the
         row's logical view through its page-table row, advance one
@@ -1889,7 +2149,8 @@ class ContinuousEngine(Logger):
             ck = self._view(kp, trow)
             cv = self._view(vp, trow)
             y, ck2, cv2 = _block_step(blk, p, x_row[None, None, :],
-                                      ck[None], cv[None], pos_row)
+                                      ck[None], cv[None], pos_row,
+                                      tp=tp, tp_axis=tp_axis)
             return (y[0, 0],
                     jnp.take(ck2[0], pos_row, axis=0, mode="clip"),
                     jnp.take(cv2[0], pos_row, axis=0, mode="clip"))
@@ -1936,8 +2197,8 @@ class ContinuousEngine(Logger):
         prec = matmul_precision()
         d = stem.dim
         quant_w, quant_kv = self.quant_weights, self.quant_kv
+        tp, tp_axis = self.tp, self._tp_axis
 
-        @functools.partial(jax.jit, donate_argnums=(7, 8))
         def prefill(params, ids, t_p, slot, temp, seed_key, table_row,
                     keys, caches):
             if quant_w:
@@ -1948,9 +2209,11 @@ class ContinuousEngine(Logger):
                 from ..quant import dequantize_params
                 params = dequantize_params(
                     params, dtype=params[stem.name]["table"].dtype)
-            x = _embed_prompt(stem, pos_emb, params, ids)
+            x = _embed_prompt(stem, pos_emb, params, ids, tp=tp,
+                              tp_axis=tp_axis)
             x, blk_caches = _prefill_blocks(blocks, params, x,
-                                            bucket, d)
+                                            bucket, d, tp=tp,
+                                            tp_axis=tp_axis)
             new_caches = []
             for (ck, cv), pool in zip(blk_caches, caches):
                 # pad rows land in the pages too; they are causal-
@@ -1979,7 +2242,8 @@ class ContinuousEngine(Logger):
                                               bucket)
                     new_caches.append((kp, vp))
             x_last = jnp.take(x[0], t_p - 1, axis=0, mode="clip")
-            logits = _head_logits(head, params, x_last, prec)
+            logits = _head_logits(head, params, x_last, prec,
+                                  tp_axis=tp_axis)
             k2 = jax.random.split(seed_key)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             samp = jax.random.categorical(
@@ -1990,7 +2254,15 @@ class ContinuousEngine(Logger):
                                                 (slot, 0))
             return first, logits, keys, tuple(new_caches)
 
-        return prefill
+        if tp <= 1:
+            return self._finalize(prefill, donate=(7, 8))
+        from jax.sharding import PartitionSpec as P
+        cs = self._caches_pspec(self.stack)
+        pspec = self._params_pspec(self.stack, params_of(self.wf))
+        return self._finalize(
+            prefill, donate=(7, 8),
+            in_specs=(pspec, P(), P(), P(), P(), P(), P(), P(), cs),
+            out_specs=(P(), P(), P(), cs))
 
     def _build_draft_prefill(self, bucket: int):
         """The draft model's prompt pass for a speculative admission:
@@ -2002,12 +2274,14 @@ class ContinuousEngine(Logger):
         stem, pos_emb = stack["stem"], stack["pos_emb"]
         blocks = stack["blocks"]
         d = stem.dim
+        tp, tp_axis = self.tp, self._tp_axis
 
-        @functools.partial(jax.jit, donate_argnums=(3,))
         def dprefill(params_d, ids, table_row, dcaches):
-            x = _embed_prompt(stem, pos_emb, params_d, ids)
+            x = _embed_prompt(stem, pos_emb, params_d, ids, tp=tp,
+                              tp_axis=tp_axis)
             _x, blk_caches = _prefill_blocks(blocks, params_d, x,
-                                             bucket, d)
+                                             bucket, d, tp=tp,
+                                             tp_axis=tp_axis)
             new_caches = []
             for (ck, cv), (kp, vp) in zip(blk_caches, dcaches):
                 kp = self._scatter_prompt(kp, ck[0], table_row, bucket)
@@ -2015,7 +2289,14 @@ class ContinuousEngine(Logger):
                 new_caches.append((kp, vp))
             return tuple(new_caches)
 
-        return dprefill
+        if tp <= 1:
+            return self._finalize(dprefill, donate=(3,))
+        from jax.sharding import PartitionSpec as P
+        cs = self._caches_pspec(stack)
+        pspec = self._params_pspec(stack, params_of(self.draft))
+        return self._finalize(
+            dprefill, donate=(3,),
+            in_specs=(pspec, P(), P(), cs), out_specs=cs)
 
     def _build_decode(self):
         """THE decode step: ``decode_block`` scan iterations of the
@@ -2042,16 +2323,16 @@ class ContinuousEngine(Logger):
         blocks, head = stack["blocks"], stack["head"]
         prec = matmul_precision()
         quant_w, quant_kv = self.quant_weights, self.quant_kv
+        tp, tp_axis = self.tp, self._tp_axis
 
         def embed_rows(params, tok, pos):
-            x = jnp.take(params[stem.name]["table"],
-                         tok.astype(jnp.int32), axis=0, mode="clip")
+            from ..nn.sampling import _embed_ids
+            x = _embed_ids(stem, params, tok, tp=tp, tp_axis=tp_axis)
             if pos_emb is not None:
                 x = x + jnp.take(params[pos_emb.name]["table"], pos,
                                  axis=0, mode="clip")
             return x                            # (S, D)
 
-        @functools.partial(jax.jit, donate_argnums=(7, 8))
         def step(params, tok, pos, temp, mask, tables, shared, keys,
                  caches):
             if quant_w:
@@ -2060,7 +2341,8 @@ class ContinuousEngine(Logger):
                     params, dtype=params[stem.name]["table"].dtype)
 
             def sample_next(tok, pos, keys, x):
-                logits = _head_logits(head, params, x, prec)  # (S, V)
+                logits = _head_logits(head, params, x, prec,
+                                      tp_axis=tp_axis)        # (S, V)
                 # _split_rows IS the id-exactness contract: the same
                 # carry/subkey convention solo and batched generate
                 # use — advanced only for rows this step owns, so
@@ -2104,7 +2386,8 @@ class ContinuousEngine(Logger):
                                 blk=blk, p=p):
                             y, ck2, cv2 = _block_step(
                                 blk, p, x_row[None, None, :],
-                                ck_row[None], cv_row[None], pos_row)
+                                ck_row[None], cv_row[None], pos_row,
+                                tp=tp, tp_axis=tp_axis)
                             return y[0, 0], ck2[0], cv2[0]
 
                         x, ck, cv = jax.vmap(row)(x, ck, cv, pos)
@@ -2185,7 +2468,15 @@ class ContinuousEngine(Logger):
                 length=self.decode_block)
             return toks, keys, caches            # toks (chunk, S)
 
-        return step
+        if tp <= 1:
+            return self._finalize(step, donate=(7, 8))
+        from jax.sharding import PartitionSpec as P
+        cs = self._caches_pspec(self.stack)
+        pspec = self._params_pspec(self.stack, params_of(self.wf))
+        return self._finalize(
+            step, donate=(7, 8),
+            in_specs=(pspec, P(), P(), P(), P(), P(), P(), P(), cs),
+            out_specs=(P(), P(), cs))
 
     def _build_spec_round(self):
         """ONE fixed-shape speculative round over the pool: the draft
@@ -2208,17 +2499,18 @@ class ContinuousEngine(Logger):
         tgt, drf = self.stack, self.draft_stack
         prec = matmul_precision()
         quant_w = self.quant_weights
+        tp, tp_axis = self.tp, self._tp_axis
 
         def embed_rows(stack, params, tok, pos):
-            x = jnp.take(params[stack["stem"].name]["table"],
-                         tok.astype(jnp.int32), axis=0, mode="clip")
+            from ..nn.sampling import _embed_ids
+            x = _embed_ids(stack["stem"], params, tok, tp=tp,
+                           tp_axis=tp_axis)
             pe = stack["pos_emb"]
             if pe is not None:
                 x = x + jnp.take(params[pe.name]["table"], pos,
                                  axis=0, mode="clip")
             return x
 
-        @functools.partial(jax.jit, donate_argnums=(7, 8, 9))
         def spec_round(params_t, params_d, tok, pos, temp, smask,
                        tables, keys, caches_t, caches_d):
             if quant_w:
@@ -2240,14 +2532,15 @@ class ContinuousEngine(Logger):
                 for blk, (kp, vp) in zip(drf["blocks"], caches_d):
                     p = params_d[blk.name]
                     x, k_new, v_new = jax.vmap(
-                        self._paged_row_step(blk, p, kp, vp))(
+                        self._paged_row_step(blk, p, kp, vp, tp=tp,
+                                             tp_axis=tp_axis))(
                             x, tables, pos + j)
                     pg, off = self._row_targets(tables, pos + j, smask)
                     kp = kp.at[pg, off].set(k_new)
                     vp = vp.at[pg, off].set(v_new)
                     new_caches.append((kp, vp))
-                logits = _head_logits(drf["head"], params_d, x, prec) \
-                    / tau[:, None]
+                logits = _head_logits(drf["head"], params_d, x, prec,
+                                      tp_axis=tp_axis) / tau[:, None]
                 greedy_t = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 samp = jax.vmap(
                     lambda k, row: jax.random.categorical(
@@ -2280,7 +2573,7 @@ class ContinuousEngine(Logger):
                     cv = self._view(vp, trow)
                     y, ck2, cv2 = _block_span(
                         blk, p, x_row[None], ck[None], cv[None],
-                        pos_row)
+                        pos_row, tp=tp, tp_axis=tp_axis)
                     news_k = [jnp.take(ck2[0], pos_row + j, axis=0,
                                        mode="clip")
                               for j in range(gamma)]
@@ -2296,7 +2589,8 @@ class ContinuousEngine(Logger):
                     vp = vp.at[pg, off].set(vnews[:, j])
                 new_caches_t.append((kp, vp))
             caches_t = tuple(new_caches_t)
-            t_logits = _head_logits(tgt["head"], params_t, x, prec) \
+            t_logits = _head_logits(tgt["head"], params_t, x, prec,
+                                    tp_axis=tp_axis) \
                 / tau[:, None, None]                # (S, gamma, V)
 
             # -- accept + emit (nn/speculative arithmetic) -------------------
@@ -2327,7 +2621,18 @@ class ContinuousEngine(Logger):
             return (out_vec, n_emit, a, new_tok, keys, caches_t,
                     caches_d)
 
-        return spec_round
+        if tp <= 1:
+            return self._finalize(spec_round, donate=(7, 8, 9))
+        from jax.sharding import PartitionSpec as P
+        cs_t = self._caches_pspec(tgt)
+        cs_d = self._caches_pspec(drf)
+        pspec_t = self._params_pspec(tgt, params_of(self.wf))
+        pspec_d = self._params_pspec(drf, params_of(self.draft))
+        return self._finalize(
+            spec_round, donate=(7, 8, 9),
+            in_specs=(pspec_t, pspec_d, P(), P(), P(), P(), P(), P(),
+                      cs_t, cs_d),
+            out_specs=(P(), P(), P(), P(), P(), cs_t, cs_d))
 
     def _build_prefill_chunk(self):
         """ONE fixed-shape suffix/chunk prefill shared by prefix-cache
@@ -2365,8 +2670,8 @@ class ContinuousEngine(Logger):
         C = self._chunk
         P = self.page_size
         quant_w = self.quant_weights
+        tp, tp_axis = self.tp, self._tp_axis
 
-        @functools.partial(jax.jit, donate_argnums=(9, 10))
         def pchunk(params, ids, p0, t_p, slot, temp, seed_key,
                    table_row, final, keys, caches):
             if quant_w:
@@ -2374,7 +2679,8 @@ class ContinuousEngine(Logger):
                 params = dequantize_params(
                     params, dtype=params[stem.name]["table"].dtype)
             x = _embed_prompt(stem, pos_emb, params, ids[None],
-                              pos0=p0)                 # (1, C, D)
+                              pos0=p0, tp=tp,
+                              tp_axis=tp_axis)         # (1, C, D)
             pos_idx = p0 + jnp.arange(C)
             pg = jnp.take(table_row, pos_idx // P, mode="fill",
                           fill_value=0)
@@ -2382,9 +2688,9 @@ class ContinuousEngine(Logger):
             new_caches = []
             for blk, (kp, vp) in zip(blocks, caches):
                 p = params[blk.name]
-                h = blk.n_heads
-                kv = getattr(blk, "n_kv_heads", h)
-                hd = d // h
+                h = blk.n_heads // tp
+                kv = getattr(blk, "n_kv_heads", blk.n_heads) // tp
+                hd = d // blk.n_heads
                 a_in = block_norm(jnp, blk, p, x, "ln1")
                 q = jnp.dot(a_in, p["wq"],
                             precision=prec).reshape(1, C, h, hd)
@@ -2421,15 +2727,20 @@ class ContinuousEngine(Logger):
                 w = jnp.exp(s - s.max(axis=-1, keepdims=True))
                 w = w / w.sum(axis=-1, keepdims=True)
                 o = jnp.einsum("bhqk,bkhd->bqhd", w.astype(q.dtype),
-                               v_full).reshape(1, C, d)
-                x = x + jnp.dot(o, p["wo"], precision=prec)
+                               v_full).reshape(1, C, h * hd)
+                proj = jnp.dot(o, p["wo"], precision=prec)
+                if tp_axis is not None:
+                    proj = jax.lax.psum(proj, tp_axis)
+                x = x + proj
                 f_in = block_norm(jnp, blk, p, x, "ln2")
-                x = x + block_ffn(jnp, blk, p, f_in, prec)
+                x = x + block_ffn(jnp, blk, p, f_in, prec,
+                                  tp_axis=tp_axis)
                 kp = kp.at[pg, off].set(k[0])
                 vp = vp.at[pg, off].set(v[0])
                 new_caches.append((kp, vp))
             x_last = jnp.take(x[0], t_p - 1 - p0, axis=0, mode="clip")
-            logits = _head_logits(head, params, x_last, prec)
+            logits = _head_logits(head, params, x_last, prec,
+                                  tp_axis=tp_axis)
             k2 = jax.random.split(seed_key)
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             samp = jax.random.categorical(
@@ -2441,7 +2752,16 @@ class ContinuousEngine(Logger):
             keys = jnp.where(final > 0, upd, keys)
             return first, keys, tuple(new_caches)
 
-        return pchunk
+        if tp <= 1:
+            return self._finalize(pchunk, donate=(9, 10))
+        from jax.sharding import PartitionSpec as PS
+        cs = self._caches_pspec(self.stack)
+        pspec = self._params_pspec(self.stack, params_of(self.wf))
+        return self._finalize(
+            pchunk, donate=(9, 10),
+            in_specs=(pspec, PS(), PS(), PS(), PS(), PS(), PS(), PS(),
+                      PS(), PS(), cs),
+            out_specs=(PS(), PS(), cs))
 
     def _build_page_copy(self):
         """Clone one slot's pages into another slot's pages — the
@@ -2455,8 +2775,10 @@ class ContinuousEngine(Logger):
         import jax
         import jax.numpy as jnp
 
-        @functools.partial(jax.jit, donate_argnums=(2,))
         def pagecopy(src_row, dst_row, caches):
+            # page ids are LOGICAL: under tp each shard copies its own
+            # kv-head slice of the same page rows — the body is
+            # axis-0 take/set, transparently shard-agnostic
             new_caches = []
             for kp, vp in caches:
                 kp = kp.at[dst_row].set(
@@ -2466,7 +2788,12 @@ class ContinuousEngine(Logger):
                 new_caches.append((kp, vp))
             return tuple(new_caches)
 
-        return pagecopy
+        if self.tp <= 1:
+            return self._finalize(pagecopy, donate=(2,))
+        from jax.sharding import PartitionSpec as P
+        cs = self._caches_pspec(self.stack)
+        return self._finalize(pagecopy, donate=(2,),
+                              in_specs=(P(), P(), cs), out_specs=cs)
 
     def _build_beam_step(self):
         """ONE fixed-shape beam step over every group: each hypothesis
@@ -2487,8 +2814,8 @@ class ContinuousEngine(Logger):
         quant_w = self.quant_weights
         W, P = self.beam_width, self.pages_per_slot
         page = self.page_size
+        tp, tp_axis = self.tp, self._tp_axis
 
-        @functools.partial(jax.jit, donate_argnums=(8,))
         def beam_step(params, cur, pos, scores, finished, eosv, gmask,
                       tables_g, caches):
             if quant_w:
@@ -2499,9 +2826,9 @@ class ContinuousEngine(Logger):
             flat_tab = tables_g.reshape(G * W, P)
             flat_cur = cur.reshape(G * W)
             flat_pos = jnp.repeat(pos, W)
-            x = jnp.take(params[stem.name]["table"],
-                         flat_cur.astype(jnp.int32), axis=0,
-                         mode="clip")
+            from ..nn.sampling import _embed_ids
+            x = _embed_ids(stem, params, flat_cur, tp=tp,
+                           tp_axis=tp_axis)
             if pos_emb is not None:
                 x = x + jnp.take(params[pos_emb.name]["table"],
                                  flat_pos, axis=0, mode="clip")
@@ -2516,13 +2843,15 @@ class ContinuousEngine(Logger):
                     cv = self._view(vp, trow)
                     y, ck2, cv2 = _block_step(
                         blk, p, x_row[None, None, :],
-                        ck[None], cv[None], pos_row)
+                        ck[None], cv[None], pos_row,
+                        tp=tp, tp_axis=tp_axis)
                     return y[0, 0], ck2[0], cv2[0]
 
                 x, ck_new, cv_new = jax.vmap(row)(x, flat_tab,
                                                   flat_pos)
                 views.append((ck_new, cv_new))  # (GW, T, kv, hd)
-            logits = _head_logits(head, params, x, prec)   # (GW, V)
+            logits = _head_logits(head, params, x, prec,
+                                  tp_axis=tp_axis)     # (GW, V)
             v = logits.shape[-1]
             logp = jax.nn.log_softmax(
                 logits.astype(jnp.float32)).reshape(G, W, v)
@@ -2560,4 +2889,13 @@ class ContinuousEngine(Logger):
                 new_caches.append((kp, vp))
             return tok, parent, new_scores, new_fin, tuple(new_caches)
 
-        return beam_step
+        if tp <= 1:
+            return self._finalize(beam_step, donate=(8,))
+        from jax.sharding import PartitionSpec as PS
+        cs = self._caches_pspec(self.stack)
+        pspec = self._params_pspec(self.stack, params_of(self.wf))
+        return self._finalize(
+            beam_step, donate=(8,),
+            in_specs=(pspec, PS(), PS(), PS(), PS(), PS(), PS(), PS(),
+                      cs),
+            out_specs=(PS(), PS(), PS(), PS(), cs))

@@ -50,6 +50,27 @@ def pages_for(positions: int, page_size: int) -> int:
     return max(0, (int(positions) + page_size - 1) // page_size)
 
 
+def per_shard_kv_heads(n_kv_heads: int, tp: int = 1) -> int:
+    """K/V heads each mesh shard STORES per logical page under
+    tensor-parallel serving (``serving/engine.py`` ``tp=`` knob).
+
+    The allocator above — page ids, tables, refcounts, ``in_use`` —
+    indexes LOGICAL pages only: one page means "``page_size``
+    positions of one slot's cache", wherever its head slices live.
+    Under ``tp=N`` the device pool's kv-head axis is sharded over the
+    ``("model",)`` mesh, so each chip holds ``n_kv_heads / N`` heads
+    of every logical page and the HOST-side admission/eviction math
+    is identical at every ``tp`` — which is exactly why the scheduler
+    can stay shard-agnostic. Raises ValueError on a ragged split
+    (a shard holding half a head would change the attention math)."""
+    n_kv_heads, tp = int(n_kv_heads), max(1, int(tp))
+    if n_kv_heads % tp:
+        raise ValueError("kv heads %d %% tp %d != 0 — a ragged "
+                         "head shard cannot serve id-exact"
+                         % (n_kv_heads, tp))
+    return n_kv_heads // tp
+
+
 class PagePool:
     """Refcounted free-list allocator over ``pages`` usable pages
     (device rows ``1..pages``; row 0 is the sink). Thread-safe; the

@@ -248,6 +248,11 @@ class Replica:
         self.slots = 0
         self.slots_busy = 0
         self.queue_depth = 0
+        #: mesh-slice width behind this endpoint (1 = solo chip): a
+        #: tensor-parallel replica publishes {"tp": {"devices": N}} on
+        #: /readyz — the roster counts it as ONE replica spanning N
+        #: chips, never as N replicas
+        self.tp_devices = 1
         self.probe_error: Optional[str] = None
         self.last_probe = 0.0
 
@@ -262,6 +267,7 @@ class Replica:
             "draining": self.draining, "slots": self.slots,
             "slots_busy": self.slots_busy,
             "queue_depth": self.queue_depth,
+            "tp_devices": self.tp_devices,
             "occupancy": round(self.occupancy(), 4),
             "breaker": self.breaker.state,
             "probe_error": self.probe_error,
@@ -685,6 +691,15 @@ class FleetRouter(Logger):
         replica.ready = code == 200
         replica.draining = payload.get("status") == "draining"
         replica.probe_error = None
+        # replica = mesh slice: a TP engine rides its slice shape on
+        # the /readyz payload (resilience/health.py set_info) — the
+        # probe the router already makes learns the chip span for free
+        try:
+            tp_info = payload.get("tp")
+            replica.tp_devices = max(1, int(
+                (tp_info or {}).get("devices", 1)))
+        except (TypeError, ValueError):
+            replica.tp_devices = 1
         body, _err = fleet.scrape(replica.url,
                                   timeout=self.probe_timeout)
         if body is not None:
@@ -696,6 +711,11 @@ class FleetRouter(Logger):
                 gauges.get("veles_serving_queue_depth",
                            gauges.get("veles_generate_queue_depth",
                                       0)))
+            if replica.tp_devices == 1:
+                # older front without the readyz info key: the
+                # veles_serving_tp gauge carries the same fact
+                replica.tp_devices = max(1, int(
+                    gauges.get("veles_serving_tp", 1)))
 
     def pick(self, exclude: Sequence[Replica] = ()) -> Optional[Replica]:
         """Least-occupied READY replica whose breaker admits a
@@ -1395,7 +1415,14 @@ class FleetRouter(Logger):
         gauges = {
             "veles_router_replicas":
                 (len(self.replicas), "Replica endpoints this router "
-                                     "fans out over"),
+                                     "fans out over (a tensor-"
+                                     "parallel mesh slice counts "
+                                     "once, however many chips it "
+                                     "spans)"),
+            "veles_router_chips":
+                (sum(max(1, r.tp_devices) for r in self.replicas),
+                 "Accelerator chips behind the roster (each "
+                 "replica's mesh-slice width, 1 for a solo engine)"),
             "veles_router_replicas_ready":
                 (ready, "Replicas currently admitting (ready, per "
                         "the last /readyz probe)"),

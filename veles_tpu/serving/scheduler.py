@@ -579,6 +579,16 @@ class SlotScheduler:
         return expired
 
     # -- page ledger -----------------------------------------------------------
+    #
+    # SHARD-AGNOSTIC by construction: every count in this ledger —
+    # pages_for(...) at validate/admission, grow()'s shortfall, the
+    # pool's free list — is in LOGICAL pages (page_size positions of
+    # one slot's cache). Under tensor-parallel serving the device
+    # pool's kv-head axis shards over the ("model",) mesh
+    # (pages.per_shard_kv_heads), which divides every page's BYTES
+    # per chip but never its position count, so identical knobs admit
+    # identical request mixes at tp=1 and tp=N — asserted by
+    # tests/test_tp_serving.py's logical-gauge comparisons.
     def _alloc_pages(self, n: int) -> Optional[List[int]]:
         """Allocation with the ``serve.page_alloc`` fault point armed —
         the injection surface for page-exhaustion chaos. Raises

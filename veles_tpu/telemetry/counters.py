@@ -142,6 +142,22 @@ DESCRIPTIONS = {
     "veles_artifact_load_failures_total":
         "AOT serve-artifact loads that failed and fell back to "
         "live jit (corrupt/mismatched/injected)",
+    # tensor-parallel serving (serving/engine.py tp= knob): shard_map
+    # over the ("model",) mesh slice — bench.py's gate asserts these
+    # read 0 in tp=1 runs
+    "veles_tp_engines_total":
+        "Serving engines started in tensor-parallel mode (one per "
+        "mesh slice, however many chips the slice spans)",
+    "veles_tp_dispatches_total":
+        "Fixed-shape serving programs dispatched as shard_mapped "
+        "mesh programs (decode steps, bucketed prefills, chunks, "
+        "page copies)",
+    # kernel autotune DB provenance (ops/autotune.py): stale-entry
+    # lookups — measured under a different jax than the running one
+    "veles_autotune_stale_total":
+        "kernel_tuning.json hits whose recorded jax version differs "
+        "from (or predates) the running toolchain — reused, but due "
+        "a re-sweep",
     # device-time measurement plane (telemetry/devtime.py): how each
     # bench section's device_time_s was obtained — profiler capture
     # vs the counted host-sync fallback — and how many gate sections

@@ -136,6 +136,16 @@ def merge(parsed: Sequence[Dict[str, Dict]]) -> Dict[str, Dict]:
         for name, val in p.get("counters", {}).items():
             out["counters"][name] = out["counters"].get(name, 0.0) + val
         for name, val in p.get("gauges", {}).items():
+            if name == "veles_serving_tp":
+                # mesh-slice width, NOT additive load: a tp=4 replica
+                # is ONE endpoint spanning 4 chips — fold the widths
+                # into the fleet chip total (solo engines export
+                # tp=1) instead of letting the generic sum read as
+                # "4 of something" on one replica's row
+                out["gauges"]["veles_fleet_chips"] = (
+                    out["gauges"].get("veles_fleet_chips", 0.0)
+                    + max(1.0, val))
+                continue
             out["gauges"][name] = out["gauges"].get(name, 0.0) + val
         for name, h in p.get("histograms", {}).items():
             tgt = out["histograms"].setdefault(
